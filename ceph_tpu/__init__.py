@@ -1,0 +1,18 @@
+"""ceph_tpu — a TPU-native erasure-coding and placement framework.
+
+A from-scratch reimplementation of the capabilities of Ceph's erasure-code
+subsystem and CRUSH placement engine (reference: Ceph v12.1.2), redesigned
+TPU-first: the GF(2^w) codec math runs as batched bitplane matrix multiplies
+on the MXU (JAX / Pallas), placement (straw2) runs as vectorized uint32/64
+integer programs under jit, and the host-side rim (registry, profiles,
+pipeline) stays thin and functional.
+
+Layout:
+  ceph_tpu.ops       GF(2^w) arithmetic, XOR-matmul kernels, crush hash ops
+  ceph_tpu.models    codec families (RS Vandermonde/RAID6, Cauchy, LRC, SHEC, ...)
+  ceph_tpu.parallel  device-mesh sharding of stripe batches and placement sweeps
+  ceph_tpu.crush     crush map model + batched straw2 mapper
+  ceph_tpu.utils     profiles, buffers, config
+"""
+
+__version__ = "0.1.0"

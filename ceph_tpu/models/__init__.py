@@ -1,0 +1,2 @@
+from .base import ErasureCode, ErasureCodeError  # noqa: F401
+from . import rs  # noqa: F401

@@ -1,0 +1,252 @@
+"""Shared machinery for generator-matrix codecs (jerasure-style techniques).
+
+Covers both encode styles of the reference jerasure plugin
+(/root/reference/src/erasure-code/jerasure/ErasureCodeJerasure.cc):
+
+  - MatrixErasureCode: element-layout GF(2^w) matrix codes
+    (reed_sol_van, reed_sol_r6_op; jerasure_matrix_encode semantics).
+  - BitmatrixErasureCode: packet-layout bitmatrix codes
+    (cauchy_*, liberation, blaum_roth, liber8tion;
+    jerasure_schedule_encode semantics with `packetsize`).
+
+Both run on the same TPU primitive (ops.xor_mm): the generator (or cached
+decode matrix — the analog of ErasureCodeIsaTableCache,
+/root/reference/src/erasure-code/isa/ErasureCodeIsaTableCache.cc) expands
+to a 0/1 bitplane matrix executed as an int8 MXU matmul.
+
+Backends: "jax" (TPU hot path) and "numpy" (exact CPU reference; also the
+monitor-side validation mode that must not require a device — the mon
+instantiates plugins to validate profiles, SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import errno
+
+import numpy as np
+
+from ..ops import gf, gf_ref
+from ..utils import profile as profile_util
+from .base import ErasureCode, ErasureCodeError
+
+LARGEST_VECTOR_WORDSIZE = 16  # reference SIMD word (ErasureCodeJerasure.cc:31)
+
+
+def _roundup(x: int, align: int) -> int:
+    return x + (align - x % align) % align if x % align else x
+
+
+class GeneratorCodec(ErasureCode):
+    """Common k/m/w parsing + cached encode/decode dispatch."""
+
+    technique = "generic"
+    DEFAULT_K = "7"
+    DEFAULT_M = "3"
+    DEFAULT_W = "8"
+
+    def __init__(self, backend: str = "jax"):
+        super().__init__()
+        self.backend = backend
+        self.k = 0
+        self.m = 0
+        self.w = 0
+        self.per_chunk_alignment = False
+        self.coding: np.ndarray | None = None   # [m, k] GF generator
+        self._bitmat: np.ndarray | None = None  # [m*w, k*w] encode bitmatrix
+        self._bitmat_dev = None
+        self._decode_cache: dict = {}
+
+    # -- profile -----------------------------------------------------------
+
+    def parse(self, profile: dict, errors: list | None = None) -> None:
+        super().parse(profile, errors)
+        self.k = profile_util.to_int("k", profile, self.DEFAULT_K, errors)
+        self.m = profile_util.to_int("m", profile, self.DEFAULT_M, errors)
+        self.w = profile_util.to_int("w", profile, self.DEFAULT_W, errors)
+        if self.chunk_mapping and len(self.chunk_mapping) != self.k + self.m:
+            self.chunk_mapping = []
+            raise ErasureCodeError(
+                errno.EINVAL,
+                "mapping maps %d chunks instead of the expected %d"
+                % (len(profile.get("mapping", "")), self.k + self.m))
+        self.sanity_check_k(self.k)
+        if self.m < 1:
+            raise ErasureCodeError(errno.EINVAL, "m=%d must be >= 1" % self.m)
+        if self.w not in gf.PRIM_POLY:
+            raise ErasureCodeError(
+                errno.EINVAL, "w=%d must be in 2..32" % self.w)
+
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def get_alignment(self) -> int:
+        raise NotImplementedError
+
+    def get_chunk_size(self, object_size: int) -> int:
+        # Shared by every jerasure-style technique
+        # (ErasureCodeJerasure.cc:74-97).
+        alignment = self.get_alignment()
+        if self.per_chunk_alignment:
+            chunk_size = -(-object_size // self.k)
+            return _roundup(max(chunk_size, alignment), alignment)
+        padded = _roundup(object_size, alignment)
+        assert padded % self.k == 0
+        return padded // self.k
+
+    # -- generator ---------------------------------------------------------
+
+    def make_generator(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def prepare(self) -> None:
+        try:
+            self.coding = self.make_generator()
+        except ValueError as e:
+            # field-size violations (k+m > 2^w etc.) are profile errors
+            raise ErasureCodeError(errno.EINVAL, str(e))
+        self._bitmat = gf.generator_to_bitmatrix(self.coding, self.w)
+        self._bitmat_dev = None
+        self._decode_cache = {}
+
+    def _device_bitmat(self):
+        if self._bitmat_dev is None:
+            import jax.numpy as jnp
+            self._bitmat_dev = jnp.asarray(self._bitmat)
+        return self._bitmat_dev
+
+    def _as_device(self, bitmat):
+        """Device copy of a bitmatrix, cached for encode + per decode entry."""
+        if bitmat is self._bitmat:
+            return self._device_bitmat()
+        for entry in self._decode_cache.values():
+            if entry["bitmat"] is bitmat:
+                if "bitmat_dev" not in entry:
+                    import jax.numpy as jnp
+                    entry["bitmat_dev"] = jnp.asarray(bitmat)
+                return entry["bitmat_dev"]
+        import jax.numpy as jnp
+        return jnp.asarray(bitmat)
+
+    def _full_decode_matrix(self, avail_rows: tuple) -> np.ndarray:
+        """[k+m, k] GF matrix mapping k available chunks -> all chunks."""
+        dec = gf.decode_matrix(self.coding, self.k, avail_rows, self.w)
+        parity = gf.gf_matmul(self.coding, dec, self.w)
+        return np.concatenate([dec, parity], axis=0)
+
+    def _decode_entry(self, avail_rows: tuple):
+        """Cache of per-erasure-signature decode matrices.
+
+        The reference's ISA plugin keeps the same LRU-style cache of decode
+        tables keyed by erasure signature
+        (ErasureCodeIsaTableCache.{h,cc}); here the cached object also
+        carries the device-side bitmatrix so repeated degraded reads hit a
+        compiled program directly.
+        """
+        entry = self._decode_cache.get(avail_rows)
+        if entry is None:
+            full = self._full_decode_matrix(avail_rows)
+            entry = {"gf": full,
+                     "bitmat": gf.generator_to_bitmatrix(full, self.w)}
+            self._decode_cache[avail_rows] = entry
+        return entry
+
+    # -- batched device API -------------------------------------------------
+
+    def _apply_matrix(self, gf_matrix: np.ndarray, bitmat: np.ndarray,
+                      data: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def encode_batch(self, data: np.ndarray) -> np.ndarray:
+        return self._apply_matrix(self.coding, self._bitmat, data)
+
+    def decode_batch(self, avail_rows: tuple, chunks: np.ndarray) -> np.ndarray:
+        if len(avail_rows) != self.k:
+            raise ErasureCodeError(errno.EIO, "need exactly k chunks")
+        entry = self._decode_entry(tuple(avail_rows))
+        return self._apply_matrix(entry["gf"], entry["bitmat"], chunks)
+
+
+class MatrixErasureCode(GeneratorCodec):
+    """Element-layout GF(2^w) matrix codec (Reed-Solomon family)."""
+
+    def parse(self, profile: dict, errors: list | None = None) -> None:
+        super().parse(profile, errors)
+        self.per_chunk_alignment = profile_util.to_bool(
+            "jerasure-per-chunk-alignment", profile, "false")
+
+    def get_alignment(self) -> int:
+        # ErasureCodeJerasure.cc:168-178.
+        if self.per_chunk_alignment:
+            return self.w * LARGEST_VECTOR_WORDSIZE
+        if (self.w * 4) % LARGEST_VECTOR_WORDSIZE:
+            return self.k * self.w * LARGEST_VECTOR_WORDSIZE
+        return self.k * self.w * 4
+
+    def _apply_matrix(self, gf_matrix, bitmat, data):
+        if self.backend == "numpy":
+            data = np.asarray(data, dtype=np.uint8)
+            return np.stack([
+                gf_ref.matrix_encode_ref(gf_matrix, data[b], self.w)
+                for b in range(data.shape[0])])
+        import jax.numpy as jnp
+        from ..ops import xor_mm
+        out = xor_mm.matrix_encode(
+            self._as_device(bitmat), jnp.asarray(data), self.w)
+        return out if _is_jax(data) else np.asarray(out)
+
+
+class BitmatrixErasureCode(GeneratorCodec):
+    """Packet-layout bitmatrix codec (Cauchy / Liberation families).
+
+    Chunk layout: S superblocks x w packets x packetsize bytes
+    (jerasure_schedule_encode semantics; packetsize default 2048,
+    ErasureCodeJerasure.h:141). Decode converts the GF-domain decode
+    matrix to a bitmatrix — valid because gf.generator_to_bitmatrix is a
+    ring homomorphism, so the bitmatrix of the inverse is the inverse of
+    the bitmatrix.
+    """
+
+    DEFAULT_PACKETSIZE = "2048"
+
+    def __init__(self, backend: str = "jax"):
+        super().__init__(backend)
+        self.packetsize = 0
+
+    def parse(self, profile: dict, errors: list | None = None) -> None:
+        super().parse(profile, errors)
+        self.packetsize = profile_util.to_int(
+            "packetsize", profile, self.DEFAULT_PACKETSIZE, errors)
+        if self.packetsize < 1:
+            raise ErasureCodeError(
+                errno.EINVAL, "packetsize=%d must be >= 1" % self.packetsize)
+        self.per_chunk_alignment = profile_util.to_bool(
+            "jerasure-per-chunk-alignment", profile, "false")
+
+    def get_alignment(self) -> int:
+        # ErasureCodeJerasure.cc:273-287.
+        if self.per_chunk_alignment:
+            return _roundup(self.w * self.packetsize, LARGEST_VECTOR_WORDSIZE)
+        if (self.w * self.packetsize * 4) % LARGEST_VECTOR_WORDSIZE:
+            return self.k * self.w * self.packetsize * LARGEST_VECTOR_WORDSIZE
+        return self.k * self.w * self.packetsize * 4
+
+    def _apply_matrix(self, gf_matrix, bitmat, data):
+        if self.backend == "numpy":
+            data = np.asarray(data, dtype=np.uint8)
+            return np.stack([
+                gf_ref.bitmatrix_encode_ref(bitmat, data[b], self.w,
+                                            self.packetsize)
+                for b in range(data.shape[0])])
+        import jax.numpy as jnp
+        from ..ops import xor_mm
+        out = xor_mm.bitmatrix_encode(
+            self._as_device(bitmat), jnp.asarray(data), self.w,
+            self.packetsize)
+        return out if _is_jax(data) else np.asarray(out)
+
+
+def _is_jax(x) -> bool:
+    return type(x).__module__.startswith("jax")

@@ -1,0 +1,1 @@
+from . import gf, gf_ref, xor_mm  # noqa: F401

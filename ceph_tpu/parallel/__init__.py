@@ -1,0 +1,1 @@
+from .mesh import make_mesh, encode_sharded  # noqa: F401

@@ -1,0 +1,180 @@
+"""Erasure-code plugin registry.
+
+Python rendition of ErasureCodePluginRegistry
+(/root/reference/src/erasure-code/ErasureCodePlugin.{h,cc}): a process
+singleton that loads plugins on demand under a lock, rejects duplicate
+registration (EEXIST), version-gates loaded plugins, and constructs codec
+instances from profiles (factory, ErasureCodePlugin.cc:92-120) with a
+profile echo check (:114-118).
+
+Built-in plugins:
+  jerasure   CPU (numpy) implementations of the 7 jerasure techniques
+  isa        CPU implementations of reed_sol_van / cauchy (ISA-L parity)
+  jax_tpu    the TPU-batched backend (the north-star plugin)
+  example    XOR k=2,m=1 interface fixture
+
+The native dlopen ABI (libec_*.so with __erasure_code_init /
+__erasure_code_version) lives in native/; this registry is the Python
+process's equivalent seam, and also powers the registry failure-mode tests
+(fixtures modeled on src/test/erasure-code/ErasureCodePlugin*.cc).
+"""
+
+from __future__ import annotations
+
+import errno
+import threading
+
+from .models.base import ErasureCode, ErasureCodeError
+
+__erasure_code_version__ = "1.0.0"
+
+
+class ErasureCodePlugin:
+    """A named factory for codec instances."""
+
+    version = __erasure_code_version__
+
+    def factory(self, profile: dict, errors: list | None = None) -> ErasureCode:
+        raise NotImplementedError
+
+
+class _TechniquePlugin(ErasureCodePlugin):
+    """Dispatches on profile["technique"] like the jerasure plugin factory
+    (ErasureCodePluginJerasure.cc:34-73)."""
+
+    def __init__(self, techniques: dict, backend: str,
+                 default_technique: str | None = None):
+        self.techniques = techniques
+        self.backend = backend
+        self.default_technique = default_technique
+
+    def factory(self, profile, errors=None):
+        t = profile.get("technique") or self.default_technique
+        cls = self.techniques.get(t)
+        if cls is None:
+            raise ErasureCodeError(
+                errno.ENOENT,
+                "technique=%s is not a valid coding technique. Choose one "
+                "of the following: %s" % (t, ", ".join(self.techniques)))
+        profile.setdefault("technique", t)
+        codec = cls(backend=self.backend)
+        codec.init(profile, errors)
+        return codec
+
+
+class _ExamplePlugin(ErasureCodePlugin):
+    def factory(self, profile, errors=None):
+        from .models.xor_example import XorExample
+        codec = XorExample()
+        codec.init(profile, errors)
+        return codec
+
+
+def _jerasure_techniques():
+    from .models import cauchy, rs
+    return {
+        "reed_sol_van": rs.ReedSolomonVandermonde,
+        "reed_sol_r6_op": rs.ReedSolomonRAID6,
+        "cauchy_orig": cauchy.CauchyOrig,
+        "cauchy_good": cauchy.CauchyGood,
+        # liberation / blaum_roth / liber8tion land with the bit-scheduled
+        # codec work (SURVEY.md §7 stage 5).
+    }
+
+
+def _isa_techniques():
+    from .models import cauchy, rs
+    return {
+        "reed_sol_van": rs.ReedSolomonVandermonde,
+        "cauchy": cauchy.CauchyGood,
+    }
+
+
+_BUILTIN_LOADERS = {
+    "jerasure": lambda: _TechniquePlugin(_jerasure_techniques(), "numpy"),
+    "isa": lambda: _TechniquePlugin(_isa_techniques(), "numpy",
+                                    default_technique="reed_sol_van"),
+    "jax_tpu": lambda: _TechniquePlugin(_jerasure_techniques(), "jax",
+                                        default_technique="reed_sol_van"),
+    "example": lambda: _ExamplePlugin(),
+}
+
+
+class ErasureCodePluginRegistry:
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.plugins: dict[str, ErasureCodePlugin] = {}
+        self.loaders = dict(_BUILTIN_LOADERS)
+        self.disable_dlclose = False
+
+    @classmethod
+    def instance(cls) -> "ErasureCodePluginRegistry":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def add(self, name: str, plugin: ErasureCodePlugin) -> None:
+        """Register a plugin; EEXIST on duplicates (ErasureCodePlugin.cc)."""
+        with self.lock:
+            if name in self.plugins:
+                raise ErasureCodeError(
+                    errno.EEXIST, "plugin %s already registered" % name)
+            self.plugins[name] = plugin
+
+    def get(self, name: str) -> ErasureCodePlugin | None:
+        with self.lock:
+            return self.plugins.get(name)
+
+    def load(self, name: str) -> ErasureCodePlugin:
+        """Load a plugin on demand; version-gate it like the dlopen path
+        (__erasure_code_version check, ErasureCodePlugin.cc:144-149)."""
+        with self.lock:
+            if name in self.plugins:
+                return self.plugins[name]
+            loader = self.loaders.get(name)
+            if loader is None:
+                raise ErasureCodeError(
+                    errno.ENOENT, "load dlopen(libec_%s.so): not found" % name)
+            plugin = loader()
+            if not isinstance(plugin, ErasureCodePlugin):
+                raise ErasureCodeError(
+                    errno.ENOENT, "plugin %s did not register itself" % name)
+            if plugin.version != __erasure_code_version__:
+                raise ErasureCodeError(
+                    errno.EXDEV,
+                    "plugin %s version %s != expected %s"
+                    % (name, plugin.version, __erasure_code_version__))
+            self.plugins[name] = plugin
+            return plugin
+
+    def preload(self, names) -> None:
+        """Preload a comma list or iterable of plugins
+        (ErasureCodePlugin.cc:186-202; called from daemon start, the analog
+        of global_init_preload_erasure_code)."""
+        if isinstance(names, str):
+            names = [n.strip() for n in names.split(",") if n.strip()]
+        for name in names:
+            self.load(name)
+
+    def factory(self, name: str, profile: dict,
+                errors: list | None = None) -> ErasureCode:
+        """Instantiate a codec (ErasureCodePlugin.cc:92-120)."""
+        with self.lock:
+            plugin = self.load(name)
+        codec = plugin.factory(profile, errors)
+        echo = codec.get_profile()
+        if echo is not profile and echo != profile:
+            raise ErasureCodeError(
+                errno.EINVAL,
+                "profile %r was not echoed back by plugin %s: %r"
+                % (profile, name, echo))
+        return codec
+
+
+def factory(name: str, profile: dict, errors: list | None = None) -> ErasureCode:
+    """Module-level convenience: build a codec from a plugin name + profile."""
+    return ErasureCodePluginRegistry.instance().factory(name, profile, errors)

@@ -1,0 +1,22 @@
+"""Test env: force the CPU backend with a virtual 8-device mesh.
+
+Tests never require TPU hardware; sharding logic is validated on a
+virtual 8-device CPU platform (the driver separately dry-runs the
+multi-chip path via __graft_entry__.dryrun_multichip).
+
+Note: this image pre-imports jax at interpreter startup with the platform
+pinned, so JAX_PLATFORMS env alone is not enough — use config.update
+before any backend initialization.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,90 @@
+"""GF(2^w) field and generator-matrix property tests."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import gf
+
+
+@pytest.mark.parametrize("w", [4, 8, 16, 32])
+def test_field_axioms_sampled(w):
+    rng = np.random.default_rng(w)
+    mask = (1 << w) - 1
+    for _ in range(50):
+        a, b, c = (int(x) & mask for x in rng.integers(0, 1 << 62, 3))
+        assert gf.gf_mult(a, b, w) == gf.gf_mult(b, a, w)
+        assert gf.gf_mult(a, gf.gf_mult(b, c, w), w) == \
+            gf.gf_mult(gf.gf_mult(a, b, w), c, w)
+        assert gf.gf_mult(a, b ^ c, w) == \
+            gf.gf_mult(a, b, w) ^ gf.gf_mult(a, c, w)
+        assert gf.gf_mult(a, 1, w) == a
+        if a:
+            assert gf.gf_mult(a, gf.gf_inv(a, w), w) == 1
+
+
+@pytest.mark.parametrize("w", [8, 16])
+def test_exp_log_tables(w):
+    exp, log = gf.exp_log_tables(w)
+    order = (1 << w) - 1
+    # exp/log are mutually inverse and multiplication via logs matches.
+    for a in (1, 2, 3, 5, 0x53, order):
+        assert log[exp[a % order]] == a % order
+    rng = np.random.default_rng(0)
+    for _ in range(100):
+        a, b = (int(x) for x in rng.integers(1, 1 << w, 2))
+        assert int(exp[log[a] + log[b]]) == gf.gf_mult(a, b, w)
+
+
+def test_gf8_mul_table():
+    t = gf.gf8_mul_table()
+    rng = np.random.default_rng(1)
+    for _ in range(200):
+        a, b = (int(x) for x in rng.integers(0, 256, 2))
+        assert int(t[a, b]) == gf.gf_mult(a, b, 8)
+
+
+@pytest.mark.parametrize("w", [8, 16, 32])
+def test_mult_bitmatrix(w):
+    rng = np.random.default_rng(2)
+    for _ in range(20):
+        g, x = (int(v) & ((1 << w) - 1) for v in rng.integers(0, 1 << 62, 2))
+        m = gf.gf_mult_bitmatrix(g, w)
+        xbits = np.array([(x >> c) & 1 for c in range(w)], dtype=np.int64)
+        ybits = (m.astype(np.int64) @ xbits) & 1
+        y = sum(int(b) << r for r, b in enumerate(ybits))
+        assert y == gf.gf_mult(g, x, w)
+
+
+def _all_k_subsets_invertible(coding, k, m, w):
+    import itertools
+    full = gf.systematic_full_generator(coding, k)
+    for rows in itertools.combinations(range(k + m), k):
+        gf.gf_invert_matrix(full[list(rows)], w)  # raises if singular
+
+
+@pytest.mark.parametrize("w", [8, 16])
+@pytest.mark.parametrize("k,m", [(2, 1), (4, 2), (5, 3)])
+def test_vandermonde_mds(k, m, w):
+    coding = gf.rs_vandermonde_generator(k, m, w)
+    _all_k_subsets_invertible(coding, k, m, w)
+
+
+@pytest.mark.parametrize("k", [2, 4, 8])
+def test_raid6_mds(k):
+    coding = gf.rs_r6_generator(k, 8)
+    _all_k_subsets_invertible(coding, k, 2, 8)
+
+
+@pytest.mark.parametrize("maker", [gf.cauchy_original_generator,
+                                   gf.cauchy_good_generator])
+@pytest.mark.parametrize("k,m,w", [(4, 2, 8), (5, 3, 8), (4, 2, 4)])
+def test_cauchy_mds(maker, k, m, w):
+    coding = maker(k, m, w)
+    _all_k_subsets_invertible(coding, k, m, w)
+
+
+def test_decode_matrix_identity_when_data_available():
+    k, m, w = 4, 2, 8
+    coding = gf.rs_vandermonde_generator(k, m, w)
+    d = gf.decode_matrix(coding, k, (0, 1, 2, 3), w)
+    assert np.array_equal(d, np.eye(k, dtype=np.int64))

@@ -1,0 +1,134 @@
+"""Registry behavior + failure-mode tests.
+
+Modeled on src/test/erasure-code/TestErasureCodePlugin.cc and its broken
+plugin fixtures (FailToInitialize / FailToRegister / MissingVersion /
+MissingEntryPoint).
+"""
+
+import errno
+
+import pytest
+
+from ceph_tpu import registry
+from ceph_tpu.models.base import ErasureCodeError
+from ceph_tpu.registry import (ErasureCodePlugin, ErasureCodePluginRegistry,
+                               __erasure_code_version__)
+
+
+@pytest.fixture
+def reg():
+    # fresh registry instance, isolated from the singleton
+    return ErasureCodePluginRegistry()
+
+
+def test_unknown_plugin(reg):
+    with pytest.raises(ErasureCodeError) as e:
+        reg.factory("does_not_exist", {})
+    assert e.value.errno == errno.ENOENT
+
+
+def test_duplicate_add(reg):
+    p = ErasureCodePlugin()
+    reg.add("p", p)
+    with pytest.raises(ErasureCodeError) as e:
+        reg.add("p", ErasureCodePlugin())
+    assert e.value.errno == errno.EEXIST
+    assert reg.get("p") is p
+
+
+def test_version_mismatch(reg):
+    class Stale(ErasureCodePlugin):
+        version = "0.0.0-stale"
+    reg.loaders["stale"] = Stale
+    with pytest.raises(ErasureCodeError) as e:
+        reg.load("stale")
+    assert e.value.errno == errno.EXDEV
+
+
+def test_fail_to_initialize(reg):
+    class Broken(ErasureCodePlugin):
+        def factory(self, profile, errors=None):
+            raise ErasureCodeError(errno.ESHUTDOWN, "init failed")
+    reg.loaders["broken"] = Broken
+    with pytest.raises(ErasureCodeError) as e:
+        reg.factory("broken", {})
+    assert e.value.errno == errno.ESHUTDOWN
+
+
+def test_fail_to_register(reg):
+    reg.loaders["liar"] = lambda: "not a plugin"
+    with pytest.raises(ErasureCodeError) as e:
+        reg.load("liar")
+    assert e.value.errno == errno.ENOENT
+
+
+def test_preload_comma_list(reg):
+    reg.preload("jerasure,example")
+    assert reg.get("jerasure") is not None
+    assert reg.get("example") is not None
+
+
+def test_technique_dispatch_enoent(reg):
+    with pytest.raises(ErasureCodeError) as e:
+        reg.factory("jerasure", {"technique": "no_such_technique"})
+    assert e.value.errno == errno.ENOENT
+
+
+def test_profile_echo():
+    profile = {"technique": "reed_sol_van", "k": "4", "m": "2"}
+    codec = registry.factory("jerasure", profile)
+    # resolved defaults are echoed back into the profile (registry contract)
+    assert profile["w"] == "8"
+    assert codec.get_profile() is profile
+
+
+def test_singleton():
+    assert ErasureCodePluginRegistry.instance() is \
+        ErasureCodePluginRegistry.instance()
+
+
+def test_example_plugin_roundtrip():
+    import numpy as np
+    codec = registry.factory("example", {})
+    raw = bytes(range(200)) * 5
+    enc = codec.encode({0, 1, 2}, raw)
+    dec = codec.decode({0}, {1: enc[1], 2: enc[2]})
+    assert np.array_equal(dec[0], enc[0])
+    # cost-aware selection prefers the cheap chunks
+    assert codec.minimum_to_decode_with_cost(
+        {2}, {0: 1, 1: 9, 2: 1}) == {2}
+
+
+def test_malformed_int_profile_rejected():
+    # reference to_int fails init with -EINVAL on malformed ints
+    with pytest.raises(ErasureCodeError) as e:
+        registry.factory("jerasure", {"technique": "reed_sol_van",
+                                      "k": "1o", "m": "2", "w": "8"})
+    assert e.value.errno == errno.EINVAL
+
+
+def test_invalid_geometry_rejected():
+    for prof in ({"technique": "reed_sol_van", "k": "4", "m": "0"},
+                 {"technique": "cauchy_good", "k": "4", "m": "2", "w": "33"},
+                 {"technique": "cauchy_good", "k": "4", "m": "2",
+                  "packetsize": "0"},
+                 {"technique": "reed_sol_van", "k": "300", "m": "2",
+                  "w": "8"}):
+        with pytest.raises(ErasureCodeError) as e:
+            registry.factory("jerasure", dict(prof))
+        assert e.value.errno == errno.EINVAL, prof
+
+
+def test_cauchy_unusual_w_accepted():
+    # cauchy supports any 2 <= w <= 32 (not just {8,16,32})
+    codec = registry.factory("jerasure", {"technique": "cauchy_good",
+                                          "k": "4", "m": "2", "w": "20",
+                                          "packetsize": "4"})
+    assert codec.w == 20
+
+
+def test_example_cost_recovers_expensive_chunk():
+    # all chunks available, one is expensive -> recover it from the others
+    codec = registry.factory("example", {})
+    assert codec.minimum_to_decode_with_cost(
+        {0}, {0: 9, 1: 1, 2: 1}) == {1, 2}
