@@ -23,6 +23,9 @@ extra keys   encode_MBps / decode_MBps / h2d_MBps (end-to-end including
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -44,6 +47,14 @@ def _bench(fn, iters):
 
 def main() -> None:
     import jax
+
+    if "--cpu" in sys.argv:
+        jax.config.update("jax_platforms", "cpu")
+    run_bench()
+
+
+def run_bench() -> None:
+    import jax
     import jax.numpy as jnp
 
     from ceph_tpu import registry
@@ -52,6 +63,10 @@ def main() -> None:
                "w": str(W)}
     tpu = registry.factory("jax_tpu", dict(profile))
     cpu = registry.factory("jerasure", dict(profile))
+
+    global BATCH, ITERS
+    if jax.devices()[0].platform == "cpu":
+        BATCH, ITERS = 4, 3  # keep the fallback run bounded
 
     n = tpu.get_chunk_size(OBJ_SIZE)
     rng = np.random.default_rng(0)
@@ -101,5 +116,30 @@ def main() -> None:
     }))
 
 
+def _supervised() -> None:
+    """Run the bench in a child with a timeout; the tunneled TPU device
+    can wedge (axon relay lease loss), and a hung bench is worse than a
+    CPU number. Falls back to the CPU backend, labeled as such."""
+    here = os.path.abspath(__file__)
+    for args, timeout in (([sys.executable, here, "--worker"], 1500),
+                          ([sys.executable, here, "--worker", "--cpu"], 900)):
+        try:
+            proc = subprocess.run(args, timeout=timeout, capture_output=True,
+                                  text=True)
+        except subprocess.TimeoutExpired:
+            continue
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if ln.startswith("{")), None)
+        if proc.returncode == 0 and line:
+            print(line)
+            return
+    print(json.dumps({"metric": "ec_encode_decode_MBps_rs_k8_m3_w8",
+                      "value": 0, "unit": "MB/s", "vs_baseline": 0,
+                      "error": "device unavailable (axon tunnel wedged)"}))
+
+
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        main()
+    else:
+        _supervised()

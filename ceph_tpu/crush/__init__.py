@@ -1,0 +1,2 @@
+from .map import CrushMap, Rule  # noqa: F401
+from .mapper_ref import crush_do_rule  # noqa: F401

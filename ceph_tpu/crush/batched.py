@@ -1,0 +1,341 @@
+"""Batched CRUSH mapping on TPU: all PGs in one device program.
+
+The reference recomputes PG mappings with a pool of CPU threads walking
+crush_do_rule one PG at a time (OSDMapMapping/ParallelPGMapper,
+/root/reference/src/osd/OSDMapMapping.h:17-169). Here the whole sweep is
+one jitted integer program: hashes, fixed-point ln, draws and argmaxes
+vectorized over [batch, replica, bucket-item], bit-exact against
+mapper.c (differential tests compile the reference C as the oracle).
+
+Scope of the device fast path: straw2 hierarchies (the modern default
+bucket type — and the only one the reference's EC rules generate via
+ErasureCode::create_rule) with choose/chooseleaf in indep mode (EC
+pools), for rules of the canonical take -> choose(leaf) -> emit shape.
+firstn (replicated pools), legacy bucket algs, multi-step rules, and
+malformed maps fall back to the scalar interpreter
+(ceph_tpu.crush.mapper_ref), which handles the full op set.
+
+Int64 fixed-point math requires x64; the public entry points wrap traces
+in jax.enable_x64() so the global flag stays untouched.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from . import hashing
+from .ln import LN_MIN_OFFSET, crush_ln, straw2_draw_divide
+from .map import (CRUSH_ITEM_NONE, CRUSH_ITEM_UNDEF, CrushMap,
+                  RULE_CHOOSE_FIRSTN, RULE_CHOOSE_INDEP,
+                  RULE_CHOOSELEAF_FIRSTN, RULE_CHOOSELEAF_INDEP, RULE_EMIT,
+                  RULE_SET_CHOOSE_TRIES, RULE_SET_CHOOSELEAF_TRIES, RULE_TAKE)
+
+S64_MIN = -(1 << 63)
+
+
+@dataclass(frozen=True)
+class CompiledMap:
+    """Dense array form of a straw2 CrushMap for device execution."""
+    items: np.ndarray      # [NB, S] int64, padded with 0
+    weights: np.ndarray    # [NB, S] int64 (16.16), padded with 0
+    size: np.ndarray       # [NB] int64
+    btype: np.ndarray      # [NB] int64
+    depth: int             # max descent depth (levels of buckets)
+    max_devices: int
+
+
+def compile_map(cmap: CrushMap) -> CompiledMap:
+    nb = cmap.max_buckets
+    s = max(b.size for b in cmap.buckets.values())
+    items = np.zeros((nb, s), dtype=np.int64)
+    weights = np.zeros((nb, s), dtype=np.int64)
+    size = np.zeros(nb, dtype=np.int64)
+    btype = np.zeros(nb, dtype=np.int64)
+    for bid, b in cmap.buckets.items():
+        if b.alg != "straw2":
+            raise NotImplementedError(
+                "batched mapper requires straw2 buckets (got %r); use "
+                "mapper_ref for legacy algs" % b.alg)
+        idx = -1 - bid
+        items[idx, :b.size] = b.items
+        weights[idx, :b.size] = b.weights
+        size[idx] = b.size
+        btype[idx] = b.type
+
+    def depth_of(bid, seen=frozenset()):
+        if bid not in cmap.buckets:
+            raise ValueError("dangling bucket reference %d" % bid)
+        if bid in seen:
+            raise ValueError("cycle through bucket %d" % bid)
+        b = cmap.buckets[bid]
+        kids = [int(i) for i in b.items if i < 0]
+        if not kids:
+            return 1
+        return 1 + max(depth_of(k, seen | {bid}) for k in kids)
+
+    depth = max(depth_of(bid) for bid in cmap.buckets)
+    return CompiledMap(items=items, weights=weights, size=size, btype=btype,
+                       depth=depth, max_devices=cmap.max_devices)
+
+
+def _straw2_choose(cm_items, cm_weights, cm_size, bucket_idx, x, r, xp):
+    """Vectorized bucket_straw2_choose (mapper.c:322-367).
+
+    bucket_idx, x, r: [...] int64 arrays -> chosen item [...] int64."""
+    items = cm_items[bucket_idx]          # [..., S]
+    weights = cm_weights[bucket_idx]      # [..., S]
+    size = cm_size[bucket_idx]            # [...]
+    u = hashing.hash32_3(
+        x[..., None].astype(xp.uint32),
+        items.astype(xp.uint32),
+        r[..., None].astype(xp.uint32), xp=xp).astype(xp.int64) & 0xFFFF
+    lnv = crush_ln(u, xp=xp) - LN_MIN_OFFSET
+    draw = straw2_draw_divide(lnv, xp.maximum(weights, 1), xp)
+    s_idx = xp.arange(items.shape[-1], dtype=xp.int64)
+    valid = (s_idx < size[..., None]) & (weights > 0)
+    draw = xp.where(valid, draw, S64_MIN)
+    # C keeps the first maximum (strict >); argmax returns first occurrence
+    high = xp.argmax(draw, axis=-1)
+    return xp.take_along_axis(items, high[..., None], axis=-1)[..., 0]
+
+
+def _is_out(weight_vec, item, x, max_devices, xp):
+    """Vectorized is_out (mapper.c:407-421); item assumed >= 0."""
+    idx = xp.clip(item, 0, len(weight_vec) - 1)
+    w = weight_vec[idx]
+    oob = item >= len(weight_vec)
+    full = w >= 0x10000
+    zero = w == 0
+    h = hashing.hash32_2(x.astype(xp.uint32), item.astype(xp.uint32),
+                         xp=xp).astype(xp.int64) & 0xFFFF
+    probabilistic_in = h < w
+    return oob | (~full & (zero | ~probabilistic_in))
+
+
+def _descend(cm: CompiledMap, arrays, root_idx, x, r, target_type, xp):
+    """Walk from root until an item of target_type is chosen.
+
+    Returns (item, ok, permanent): ok False on any failure; permanent True
+    for the failures crush_choose_indep turns into CRUSH_ITEM_NONE without
+    retrying (bad item id, wrong-type device, dangling bucket ref —
+    mapper.c:724-751). Empty buckets and exhausted depth stay retryable
+    (the C inner for(;;) just breaks, leaving the slot UNDEF)."""
+    items_a, weights_a, size_a, btype_a = arrays
+    nb = items_a.shape[0]
+    root = xp.asarray(root_idx, dtype=xp.int64)
+    # invalid roots (e.g. -1-item where item was a device) are clipped and
+    # marked failed
+    fail = (root < 0) | (root >= nb)
+    cur = xp.broadcast_to(xp.clip(root, 0, nb - 1), x.shape).astype(xp.int64)
+    fail = xp.broadcast_to(fail, x.shape)
+    perm = xp.zeros(x.shape, dtype=bool)
+    done = fail
+    chosen = xp.zeros(x.shape, dtype=xp.int64)
+    for _ in range(cm.depth):
+        fail = fail | (~done & (size_a[cur] == 0))  # empty bucket: retryable
+        done = done | fail
+        item = _straw2_choose(items_a, weights_a, size_a, cur, x, r, xp)
+        is_dev = item >= 0
+        bad_dev = is_dev & (item >= cm.max_devices)
+        bad_bucket = ~is_dev & ((-1 - item) >= nb)
+        itype = xp.where(is_dev, 0, btype_a[xp.clip(-1 - item, 0, nb - 1)])
+        hit = (itype == target_type) & ~bad_dev & ~bad_bucket
+        newly_bad = ~done & ~hit & (is_dev | bad_dev | bad_bucket)
+        perm = perm | newly_bad
+        chosen = xp.where(~done & hit, item, chosen)
+        fail = fail | newly_bad
+        cur = xp.where(~done & ~hit & ~is_dev,
+                       xp.clip(-1 - item, 0, nb - 1), cur)
+        done = done | hit | fail
+    fail = fail | ~done
+    return chosen, ~fail, perm
+
+
+def _make_indep(cm: CompiledMap, out_size: int, numrep: int,
+                target_type: int, chooseleaf: bool, tries: int,
+                recurse_tries: int):
+    """Build the jitted indep kernel for static (map, rule) geometry.
+
+    out_size slots are filled, but retry strides use the rule's full
+    numrep (crush_do_rule clamps only the output count, mapper.c:1039-1046).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def run(items_a, weights_a, size_a, btype_a, xs, weight_vec, root_idx):
+        arrays = (items_a, weights_a, size_a, btype_a)
+        b = xs.shape[0]
+        undef = jnp.int64(CRUSH_ITEM_UNDEF)
+        none = jnp.int64(CRUSH_ITEM_NONE)
+        out = jnp.full((b, out_size), undef)
+        out2 = jnp.full((b, out_size), undef)
+        reps = jnp.arange(out_size, dtype=jnp.int64)
+        xsb = jnp.broadcast_to(xs[:, None], (b, out_size))
+
+        def round_body(state):
+            ftotal, out, out2 = state
+            # Candidate selection is a pure function of (x, r), so the
+            # hash/ln-heavy work runs vectorized over [B, R] in one pass;
+            # only acceptance (the C rep loop's collision ordering) stays
+            # sequential.
+            rr = jnp.broadcast_to((reps + numrep * ftotal)[None, :],
+                                  (b, out_size))
+            item, ok0, perm = _descend(cm, arrays, root_idx, xsb, rr,
+                                       target_type, jnp)
+            leaf = None
+            if chooseleaf:
+                # inner descent (crush_choose_indep recursion with left=1,
+                # outpos=rep; mapper.c:767-786): r = rep + parent_r +
+                # numrep * ftotal_inner
+                leaf = jnp.full((b, out_size), undef)
+                for ft2 in range(recurse_tries):
+                    r2 = rr + reps[None, :] + numrep * ft2
+                    cand, lok, _ = _descend(cm, arrays, -1 - item, xsb, r2,
+                                            0, jnp)
+                    lok = lok & ~_is_out(weight_vec, cand, xsb,
+                                         cm.max_devices, jnp)
+                    take = (leaf == undef) & lok
+                    leaf = jnp.where(take, cand, leaf)
+                ok0 = ok0 & (leaf != undef)
+            elif target_type == 0:
+                ok0 = ok0 & ~_is_out(weight_vec, item, xsb,
+                                     cm.max_devices, jnp)
+
+            def rep_body(rep, carry):
+                out, out2 = carry
+                need = out[:, rep] == undef
+                cand = item[:, rep]
+                collide = jnp.any(out == cand[:, None], axis=1)
+                ok = ok0[:, rep] & ~collide & need
+                # permanent failures become NONE and stop retrying
+                # (mapper.c:724-751)
+                make_none = need & perm[:, rep]
+                if chooseleaf:
+                    out2 = out2.at[:, rep].set(
+                        jnp.where(ok, leaf[:, rep],
+                                  jnp.where(make_none, none, out2[:, rep])))
+                out = out.at[:, rep].set(
+                    jnp.where(ok, cand,
+                              jnp.where(make_none, none, out[:, rep])))
+                return out, out2
+
+            out, out2 = jax.lax.fori_loop(0, out_size, rep_body, (out, out2))
+            return ftotal + 1, out, out2
+
+        def cond(state):
+            ftotal, out, _ = state
+            return (ftotal < tries) & jnp.any(out == undef)
+
+        _, out, out2 = jax.lax.while_loop(cond, round_body, (0, out, out2))
+        result = out2 if chooseleaf else out
+        result = jnp.where(out == undef, jnp.int64(CRUSH_ITEM_NONE), result)
+        return result
+
+    return jax.jit(run)
+
+
+_KERNEL_CACHE: dict = {}
+
+
+def _indep_kernel(cm: CompiledMap, out_size, numrep, target_type, chooseleaf,
+                  tries, recurse_tries):
+    key = (cm.items.tobytes(), cm.weights.tobytes(), cm.size.tobytes(),
+           cm.btype.tobytes(), cm.depth, cm.max_devices,
+           out_size, numrep, target_type, chooseleaf, tries, recurse_tries)
+    kernel = _KERNEL_CACHE.get(key)
+    if kernel is None:
+        kernel = _make_indep(cm, out_size, numrep, target_type, chooseleaf,
+                             tries, recurse_tries)
+        if len(_KERNEL_CACHE) > 64:
+            _KERNEL_CACHE.clear()
+        _KERNEL_CACHE[key] = kernel
+    return kernel
+
+
+def _rule_shape(cmap: CrushMap, ruleno: int):
+    """Extract (root, op, numrep_arg, type) from a canonical 3-step rule;
+    None if the rule is outside the batched fast path."""
+    steps = [s for s in cmap.rules[ruleno].steps]
+    choose_tries = None
+    leaf_tries = None
+    core = []
+    for s in steps:
+        if s[0] == RULE_SET_CHOOSE_TRIES:
+            choose_tries = s[1]
+        elif s[0] == RULE_SET_CHOOSELEAF_TRIES:
+            leaf_tries = s[1]
+        else:
+            core.append(s)
+    if len(core) != 3 or core[0][0] != RULE_TAKE or core[2][0] != RULE_EMIT:
+        return None
+    op = core[1][0]
+    if op not in (RULE_CHOOSE_INDEP, RULE_CHOOSELEAF_INDEP,
+                  RULE_CHOOSE_FIRSTN, RULE_CHOOSELEAF_FIRSTN):
+        return None
+    return dict(root=core[0][1], op=op, numrep_arg=core[1][1],
+                type=core[1][2], choose_tries=choose_tries,
+                leaf_tries=leaf_tries)
+
+
+def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
+                    weight=None):
+    """Map a whole batch of inputs in one device program.
+
+    xs: [B] int array of crush inputs (pg seeds). Returns [B, result_max]
+    int64 (CRUSH_ITEM_NONE marks holes). Falls back to the scalar
+    interpreter when the rule/map is outside the fast path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    shape = _rule_shape(cmap, ruleno)
+    xs = np.asarray(xs)
+    if (shape is None
+            or shape["op"] in (RULE_CHOOSE_FIRSTN, RULE_CHOOSELEAF_FIRSTN)
+            or (shape["op"] == RULE_CHOOSELEAF_INDEP and shape["type"] == 0)
+            or any(b.alg != "straw2" for b in cmap.buckets.values())):
+        from .mapper_ref import crush_do_rule
+        out = np.full((len(xs), result_max), CRUSH_ITEM_NONE, dtype=np.int64)
+        for i, x in enumerate(xs):
+            res = crush_do_rule(cmap, ruleno, int(x), result_max, weight)
+            out[i, :len(res)] = res
+        return out
+
+    try:
+        cm = compile_map(cmap)
+    except ValueError:
+        # malformed map (dangling refs, cycles): scalar interpreter
+        # degrades per-slot instead of failing the whole sweep
+        from .mapper_ref import crush_do_rule
+        out = np.full((len(xs), result_max), CRUSH_ITEM_NONE, dtype=np.int64)
+        for i, x in enumerate(xs):
+            res = crush_do_rule(cmap, ruleno, int(x), result_max, weight)
+            out[i, :len(res)] = res
+        return out
+    numrep = shape["numrep_arg"]
+    if numrep <= 0:
+        numrep += result_max
+    out_size = min(numrep, result_max)
+    t = cmap.tunables
+    tries = shape["choose_tries"] or (t.choose_total_tries + 1)
+    recurse_tries = shape["leaf_tries"] or 1
+    chooseleaf = shape["op"] == RULE_CHOOSELEAF_INDEP
+    if weight is None:
+        weight = np.full(cm.max_devices, 0x10000, dtype=np.int64)
+
+    kernel = _indep_kernel(cm, out_size, numrep, shape["type"], chooseleaf,
+                           tries, recurse_tries)
+    with jax.enable_x64():
+        out = kernel(jnp.asarray(cm.items), jnp.asarray(cm.weights),
+                     jnp.asarray(cm.size), jnp.asarray(cm.btype),
+                     jnp.asarray(xs, dtype=jnp.int64),
+                     jnp.asarray(weight, dtype=jnp.int64),
+                     -1 - shape["root"])
+    res = np.asarray(out)
+    if res.shape[1] < result_max:
+        pad = np.full((len(xs), result_max - res.shape[1]), CRUSH_ITEM_NONE,
+                      dtype=np.int64)
+        res = np.concatenate([res, pad], axis=1)
+    return res

@@ -1,0 +1,72 @@
+"""rjenkins1 — the only hash CRUSH uses.
+
+Robert Jenkins' 32-bit mix (public algorithm,
+burtleburtle.net/bob/hash/evahash.html), with CRUSH's seed and argument
+framing (/root/reference/src/crush/hash.c). Written array-generic: works
+identically on numpy uint32 arrays and jax uint32 arrays because both
+wrap on overflow; all placement math downstream is bit-exact integer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+CRUSH_HASH_SEED = 1315423911
+CRUSH_HASH_RJENKINS1 = 0
+
+
+def _mix(a, b, c):
+    """One Jenkins mix round; a, b, c are uint32 arrays (any backend)."""
+    a = a - b; a = a - c; a = a ^ (c >> 13)      # noqa: E702
+    b = b - c; b = b - a; b = b ^ (a << 8)       # noqa: E702
+    c = c - a; c = c - b; c = c ^ (b >> 13)      # noqa: E702
+    a = a - b; a = a - c; a = a ^ (c >> 12)      # noqa: E702
+    b = b - c; b = b - a; b = b ^ (a << 16)      # noqa: E702
+    c = c - a; c = c - b; c = c ^ (b >> 5)       # noqa: E702
+    a = a - b; a = a - c; a = a ^ (c >> 3)       # noqa: E702
+    b = b - c; b = b - a; b = b ^ (a << 10)      # noqa: E702
+    c = c - a; c = c - b; c = c ^ (b >> 15)      # noqa: E702
+    return a, b, c
+
+
+def _u32(x, xp):
+    return xp.asarray(x).astype(xp.uint32)
+
+
+def hash32_2(a, b, xp=np):
+    a = _u32(a, xp); b = _u32(b, xp)             # noqa: E702
+    x = xp.uint32(231232)
+    y = xp.uint32(1232)
+    h = xp.uint32(CRUSH_HASH_SEED) ^ a ^ b
+    a, b, h = _mix(a, b, h)
+    x, a, h = _mix(x, a, h)
+    b, y, h = _mix(b, y, h)
+    return h
+
+
+def hash32_3(a, b, c, xp=np):
+    a = _u32(a, xp); b = _u32(b, xp); c = _u32(c, xp)   # noqa: E702
+    x = xp.uint32(231232)
+    y = xp.uint32(1232)
+    h = xp.uint32(CRUSH_HASH_SEED) ^ a ^ b ^ c
+    a, b, h = _mix(a, b, h)
+    c, x, h = _mix(c, x, h)
+    y, a, h = _mix(y, a, h)
+    b, x, h = _mix(b, x, h)
+    y, c, h = _mix(y, c, h)
+    return h
+
+
+def hash32_4(a, b, c, d, xp=np):
+    a = _u32(a, xp); b = _u32(b, xp)             # noqa: E702
+    c = _u32(c, xp); d = _u32(d, xp)             # noqa: E702
+    x = xp.uint32(231232)
+    y = xp.uint32(1232)
+    h = xp.uint32(CRUSH_HASH_SEED) ^ a ^ b ^ c ^ d
+    a, b, h = _mix(a, b, h)
+    c, d, h = _mix(c, d, h)
+    a, x, h = _mix(a, x, h)
+    y, b, h = _mix(y, b, h)
+    c, x, h = _mix(c, x, h)
+    y, d, h = _mix(y, d, h)
+    return h

@@ -1,0 +1,60 @@
+"""crush_ln: fixed-point 2^44 * log2(x+1), bit-exact, vectorized.
+
+Semantics from /root/reference/src/crush/mapper.c:247-290 (normalize to
+[2^15, 2^17), two-level table lookup, 16.16-era fixed point). Array-generic:
+runs on numpy and jax uint/int arrays with identical results, including
+the int64 wraparound the C code exhibits for the x=0x10000 input.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .ln_tables import LL_TBL, RH_LH_TBL
+
+
+def _floor_log2(x, xp):
+    """floor(log2(x)) for x in [1, 2^17), via 17 comparisons (vector-safe)."""
+    thresholds = xp.asarray(np.left_shift(np.int64(1), np.arange(1, 18)))
+    return (x[..., None] >= thresholds).sum(axis=-1).astype(xp.int64)
+
+
+def crush_ln(xin, xp=np):
+    """2^44*log2(input+1) as int64. Input: any uint array (straw2 passes
+    values in [0, 0xffff])."""
+    x = xp.asarray(xin).astype(xp.int64) + 1
+
+    # normalize into [2^15, 2^17): if neither bit 15 nor 16 is set,
+    # left-shift so bit 15 becomes the top bit (mapper.c:257-265)
+    needs_norm = (x & 0x18000) == 0
+    fl = _floor_log2(x, xp)
+    bits = xp.where(needs_norm, 15 - fl, 0)
+    x = xp.left_shift(x, bits)
+    iexpon = xp.where(needs_norm, fl, xp.int64(15))
+
+    index1 = (x >> 8) << 1
+    rh_lh = xp.asarray(RH_LH_TBL)
+    rh = rh_lh[index1 - 256]       # ~2^56/index1
+    lh = rh_lh[index1 + 1 - 256]   # ~2^48*log2(index1/256)
+
+    # RH*x ~ 2^48 * (2^15 + xf); deliberately allowed to wrap like the C
+    # (__s64) multiply for x = 0x10000
+    with np.errstate(over="ignore"):
+        xl64 = (x * rh) >> 48
+    index2 = (xl64 & 0xFF).astype(xp.int64)
+    ll = xp.asarray(LL_TBL)[index2]
+
+    result = iexpon << 44
+    result = result + ((lh + ll) >> 4)
+    return result
+
+
+LN_MIN_OFFSET = 0x1000000000000  # straw2 subtracts 2^48 to map into <= 0
+
+
+def straw2_draw_divide(ln, weight, xp=np):
+    """div64_s64(ln, weight): C truncating division (toward zero).
+
+    ln <= 0 (after the 2^48 offset), weight > 0 -> -((-ln) // w).
+    """
+    return -((-ln) // weight)

@@ -1,0 +1,333 @@
+"""LRC — layered locally-repairable erasure code.
+
+Parity target: /root/reference/src/erasure-code/lrc/ErasureCodeLrc.{h,cc}.
+An LRC profile is a stack of layers, each a chunks_map string over the
+full chunk set ('D' data, 'c' coding, '_' not in layer) plus an inner
+codec profile; encode walks layers top-down
+(ErasureCodeLrc.cc encode_chunks), decode walks bottom-up re-using chunks
+recovered by lower layers (decode_chunks), and minimum_to_decode prefers
+the layer that can repair with the fewest reads (:minimum_to_decode,
+local-repair set search). The k/m/l shorthand generates the canonical
+global + per-group-local layer stack (parse_kml, ErasureCodeLrc.cc:295).
+
+Inner layers are real plugins resolved through the registry (recursive
+factory, like the reference's layers_init) — the north-star config runs
+LRC over the jax_tpu inner plugin so every layer's math lands on the MXU.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+
+import numpy as np
+
+from ..utils import profile as profile_util
+from .base import ErasureCode, ErasureCodeError
+
+
+class LrcLayer:
+    def __init__(self, chunks_map: str, profile: dict):
+        self.chunks_map = chunks_map
+        self.profile = profile
+        self.data = [i for i, c in enumerate(chunks_map) if c == "D"]
+        self.coding = [i for i, c in enumerate(chunks_map) if c == "c"]
+        self.chunks = self.data + self.coding
+        self.chunks_as_set = set(self.chunks)
+        self.codec: ErasureCode | None = None
+
+
+class Lrc(ErasureCode):
+    technique = "lrc"
+    DEFAULT_KML = "-1"
+
+    def __init__(self, backend: str = "jax",
+                 default_inner_plugin: str | None = None):
+        super().__init__()
+        self.backend = backend
+        self.default_inner_plugin = default_inner_plugin or (
+            "jax_tpu" if backend == "jax" else "jerasure")
+        self.layers: list[LrcLayer] = []
+        self.mapping = ""
+        self.chunk_count = 0
+        self.data_chunk_count = 0
+        self.rule_steps: list = [("chooseleaf", "host", 0)]
+
+    # -- init --------------------------------------------------------------
+
+    def init(self, profile: dict, errors: list | None = None) -> None:
+        self.parse_kml(profile, errors)
+        self.rule_root = profile_util.to_string("crush-root", profile,
+                                                "default")
+        self.rule_device_class = profile_util.to_string(
+            "crush-device-class", profile, "")
+        layers_desc = profile.get("layers")
+        if not layers_desc:
+            raise ErasureCodeError(errno.EINVAL,
+                                   "could not find 'layers' in profile")
+        self._layers_parse(layers_desc)
+        self._layers_init()
+        mapping = profile.get("mapping")
+        if not mapping:
+            raise ErasureCodeError(errno.EINVAL,
+                                   "the 'mapping' profile is missing")
+        self.mapping = mapping
+        self.chunk_mapping = profile_util.to_mapping({"mapping": mapping})
+        self.data_chunk_count = mapping.count("D")
+        self.chunk_count = len(mapping)
+        for layer in self.layers:
+            if len(layer.chunks_map) != self.chunk_count:
+                raise ErasureCodeError(
+                    errno.EINVAL,
+                    "layer %r must be %d characters long"
+                    % (layer.chunks_map, self.chunk_count))
+        # kml-generated parameters are not echoed back
+        # (ErasureCodeLrc.cc init :547-553)
+        if profile.get("l") and profile["l"] != self.DEFAULT_KML:
+            profile.pop("mapping", None)
+            profile.pop("layers", None)
+        self._profile = profile
+
+    def parse_kml(self, profile: dict, errors: list | None = None) -> None:
+        # ErasureCodeLrc.cc:295-399
+        k = profile_util.to_int("k", profile, self.DEFAULT_KML, errors)
+        m = profile_util.to_int("m", profile, self.DEFAULT_KML, errors)
+        l = profile_util.to_int("l", profile, self.DEFAULT_KML, errors)
+        if k == -1 and m == -1 and l == -1:
+            return
+        if -1 in (k, m, l):
+            raise ErasureCodeError(
+                errno.EINVAL, "All of k, m, l must be set or none of them")
+        for p in ("mapping", "layers", "crush-steps"):
+            if profile.get(p):
+                raise ErasureCodeError(
+                    errno.EINVAL,
+                    "the %s parameter cannot be set when k, m, l are" % p)
+        if (k + m) % l:
+            raise ErasureCodeError(errno.EINVAL,
+                                   "k + m must be a multiple of l")
+        groups = (k + m) // l
+        if k % groups:
+            raise ErasureCodeError(errno.EINVAL,
+                                   "k must be a multiple of (k + m) / l")
+        if m % groups:
+            raise ErasureCodeError(errno.EINVAL,
+                                   "m must be a multiple of (k + m) / l")
+        mapping = ""
+        for _ in range(groups):
+            mapping += "D" * (k // groups) + "_" * (m // groups) + "_"
+        profile["mapping"] = mapping
+        layers = []
+        global_map = ""
+        for _ in range(groups):
+            global_map += "D" * (k // groups) + "c" * (m // groups) + "_"
+        layers.append([global_map, ""])
+        for i in range(groups):
+            local = ""
+            for j in range(groups):
+                local += ("D" * l + "c") if i == j else "_" * (l + 1)
+            layers.append([local, ""])
+        profile["layers"] = json.dumps(layers)
+        locality = profile.get("crush-locality", "")
+        failure_domain = profile.get("crush-failure-domain", "host")
+        if locality:
+            self.rule_steps = [("choose", locality, groups),
+                               ("chooseleaf", failure_domain, l + 1)]
+        elif failure_domain:
+            self.rule_steps = [("chooseleaf", failure_domain, 0)]
+
+    def _layers_parse(self, description: str) -> None:
+        try:
+            desc = json.loads(description)
+        except json.JSONDecodeError as e:
+            raise ErasureCodeError(errno.EINVAL,
+                                   "failed to parse layers=%r: %s"
+                                   % (description, e))
+        if not isinstance(desc, list):
+            raise ErasureCodeError(errno.EINVAL,
+                                   "layers must be a JSON array")
+        self.layers = []
+        for pos, entry in enumerate(desc):
+            if not isinstance(entry, list) or not entry:
+                raise ErasureCodeError(
+                    errno.EINVAL,
+                    "element %d of layers must be a non-empty array" % pos)
+            chunks_map = entry[0]
+            if not isinstance(chunks_map, str):
+                raise ErasureCodeError(
+                    errno.EINVAL, "layer %d chunks map must be a string" % pos)
+            prof: dict = {}
+            if len(entry) > 1:
+                opts = entry[1]
+                if isinstance(opts, str):
+                    for tok in opts.split():
+                        if "=" in tok:
+                            key, val = tok.split("=", 1)
+                            prof[key] = val
+                elif isinstance(opts, dict):
+                    prof.update({str(a): str(b) for a, b in opts.items()})
+                else:
+                    raise ErasureCodeError(
+                        errno.EINVAL,
+                        "layer %d options must be string or object" % pos)
+            self.layers.append(LrcLayer(chunks_map, prof))
+        if not self.layers:
+            raise ErasureCodeError(errno.EINVAL,
+                                   "layers parameter has zero entries")
+
+    def _layers_init(self) -> None:
+        # ErasureCodeLrc.cc layers_init: recursive registry factory
+        from .. import registry
+        for layer in self.layers:
+            layer.profile.setdefault("k", str(len(layer.data)))
+            layer.profile.setdefault("m", str(len(layer.coding)))
+            layer.profile.setdefault("plugin", self.default_inner_plugin)
+            layer.profile.setdefault("technique", "reed_sol_van")
+            plugin = layer.profile["plugin"]
+            inner_profile = {a: b for a, b in layer.profile.items()
+                             if a != "plugin"}
+            layer.codec = registry.factory(plugin, inner_profile)
+
+    # -- geometry ----------------------------------------------------------
+
+    def get_chunk_count(self) -> int:
+        return self.chunk_count
+
+    def get_data_chunk_count(self) -> int:
+        return self.data_chunk_count
+
+    def get_chunk_size(self, object_size: int) -> int:
+        return self.layers[0].codec.get_chunk_size(object_size)
+
+    # -- encode ------------------------------------------------------------
+
+    def encode(self, want_to_encode: set, raw) -> dict:
+        raw = np.frombuffer(raw, dtype=np.uint8) if isinstance(
+            raw, (bytes, bytearray, memoryview)) else np.asarray(
+                raw, dtype=np.uint8).reshape(-1)
+        blocksize = self.get_chunk_size(raw.size)
+        buffers = {i: np.zeros(blocksize, dtype=np.uint8)
+                   for i in range(self.chunk_count)}
+        data_positions = [i for i, c in enumerate(self.mapping) if c == "D"]
+        for di, pos in enumerate(data_positions):
+            lo = di * blocksize
+            chunk = raw[lo:lo + blocksize]
+            buffers[pos][:chunk.size] = chunk
+        self.encode_chunks_inplace(set(range(self.chunk_count)), buffers)
+        return {i: buffers[i] for i in want_to_encode}
+
+    def encode_chunks_inplace(self, want_to_encode: set, buffers: dict) -> None:
+        # ErasureCodeLrc.cc encode_chunks: find the lowest layer that
+        # covers everything wanted, then encode from there down.
+        top = len(self.layers)
+        for layer in reversed(self.layers):
+            top -= 1
+            if want_to_encode <= layer.chunks_as_set:
+                break
+        for layer in self.layers[top:]:
+            data = np.stack([buffers[c] for c in layer.data])
+            parity = layer.codec.encode_batch(data[None])[0]
+            for j, c in enumerate(layer.coding):
+                buffers[c][:] = np.asarray(parity[j])
+
+    # -- decode ------------------------------------------------------------
+
+    def decode(self, want_to_read: set, chunks: dict) -> dict:
+        # ErasureCodeLrc.cc decode_chunks: bottom-up layer walk, each
+        # layer re-using chunks recovered by the previous ones.
+        have = set(chunks)
+        if want_to_read <= have:
+            return {i: np.asarray(chunks[i], dtype=np.uint8)
+                    for i in want_to_read}
+        blocksize = len(next(iter(chunks.values())))
+        decoded = {}
+        erasures = set()
+        for i in range(self.chunk_count):
+            if i in chunks:
+                decoded[i] = np.asarray(chunks[i], dtype=np.uint8)
+            else:
+                decoded[i] = np.zeros(blocksize, dtype=np.uint8)
+                erasures.add(i)
+        want_erasures = want_to_read & erasures
+        for layer in reversed(self.layers):
+            layer_erasures = layer.chunks_as_set & erasures
+            if len(layer_erasures) > layer.codec.get_coding_chunk_count():
+                continue  # too many erasures for this layer
+            if not layer_erasures:
+                continue
+            layer_chunks = {}
+            layer_want = set()
+            for j, c in enumerate(layer.chunks):
+                if c not in erasures:
+                    layer_chunks[j] = decoded[c]
+                if c in want_to_read or c in layer_erasures:
+                    layer_want.add(j)
+            try:
+                layer_decoded = layer.codec.decode(layer_want, layer_chunks)
+            except ErasureCodeError:
+                continue
+            for j, c in enumerate(layer.chunks):
+                if j in layer_decoded:
+                    decoded[c] = np.asarray(layer_decoded[j])
+                if c in erasures and j in layer_decoded:
+                    erasures.discard(c)
+            want_erasures = want_to_read & erasures
+            if not want_erasures:
+                break
+        if want_erasures:
+            raise ErasureCodeError(
+                errno.EIO, "unable to read %s" % sorted(want_erasures))
+        return {i: decoded[i] for i in set(want_to_read) | (have & set(decoded))}
+
+    # -- minimum_to_decode -------------------------------------------------
+
+    def minimum_to_decode(self, want_to_read: set, available: set) -> set:
+        # ErasureCodeLrc.cc minimum_to_decode: prefer local repair.
+        erasures_total = set(range(self.chunk_count)) - set(available)
+        erasures_not_recovered = set(erasures_total)
+        erasures_want = want_to_read & erasures_total
+        if not erasures_want:
+            return set(want_to_read)
+        minimum: set = set()
+        for layer in reversed(self.layers):
+            layer_want = want_to_read & layer.chunks_as_set
+            if not layer_want:
+                continue
+            layer_erasures = layer_want & erasures_want
+            if not layer_erasures:
+                minimum |= layer_want
+                continue
+            erasures = layer.chunks_as_set & erasures_not_recovered
+            if len(erasures) > layer.codec.get_coding_chunk_count():
+                continue  # hope an upper layer does better
+            minimum |= layer.chunks_as_set - erasures_not_recovered
+            for j in erasures:
+                erasures_not_recovered.discard(j)
+                erasures_want.discard(j)
+        if not erasures_want:
+            minimum |= set(want_to_read)
+            minimum -= erasures_total
+            return minimum
+        # Case 3 (ErasureCodeLrc.cc): recover chunks even from layers
+        # containing nothing we want, hoping the cascade unlocks the
+        # upper layers; if everything is recoverable, read all available.
+        remaining = set(erasures_total)
+        for layer in reversed(self.layers):
+            layer_erasures = layer.chunks_as_set & remaining
+            if not layer_erasures:
+                continue
+            if len(layer_erasures) <= layer.codec.get_coding_chunk_count():
+                remaining -= layer_erasures
+        if not remaining:
+            return set(available)
+        raise ErasureCodeError(errno.EIO, "not enough chunks to decode")
+
+    # -- batch API (delegates to the dict paths) ---------------------------
+
+    def encode_batch(self, data: np.ndarray) -> np.ndarray:
+        raise NotImplementedError(
+            "LRC is position-structured; use encode()/decode()")
+
+    def decode_batch(self, avail_rows: tuple, chunks: np.ndarray) -> np.ndarray:
+        raise NotImplementedError(
+            "LRC is position-structured; use encode()/decode()")

@@ -70,6 +70,17 @@ class _ExamplePlugin(ErasureCodePlugin):
         return codec
 
 
+class _LrcPlugin(ErasureCodePlugin):
+    def __init__(self, backend: str):
+        self.backend = backend
+
+    def factory(self, profile, errors=None):
+        from .models.lrc import Lrc
+        codec = Lrc(backend=self.backend)
+        codec.init(profile, errors)
+        return codec
+
+
 def _jerasure_techniques():
     from .models import cauchy, rs
     return {
@@ -90,12 +101,26 @@ def _isa_techniques():
     }
 
 
+def _shec_techniques():
+    from .models import shec
+    return {
+        "multiple": shec.ShecMultiple,
+        "single": shec.ShecSingle,
+    }
+
+
 _BUILTIN_LOADERS = {
     "jerasure": lambda: _TechniquePlugin(_jerasure_techniques(), "numpy"),
     "isa": lambda: _TechniquePlugin(_isa_techniques(), "numpy",
                                     default_technique="reed_sol_van"),
     "jax_tpu": lambda: _TechniquePlugin(_jerasure_techniques(), "jax",
                                         default_technique="reed_sol_van"),
+    "shec": lambda: _TechniquePlugin(_shec_techniques(), "numpy",
+                                     default_technique="multiple"),
+    "shec_tpu": lambda: _TechniquePlugin(_shec_techniques(), "jax",
+                                         default_technique="multiple"),
+    "lrc": lambda: _LrcPlugin("numpy"),
+    "lrc_tpu": lambda: _LrcPlugin("jax"),
     "example": lambda: _ExamplePlugin(),
 }
 

@@ -1,0 +1,86 @@
+"""Test-time compilation of the reference CRUSH C core as a ctypes oracle.
+
+Compiles /root/reference/src/crush/{mapper,builder,crush,hash}.c together
+with tests/crush_oracle_shim.c into a shared library under /tmp. Skipped
+(returns None) when the reference tree or a C compiler is unavailable —
+differential tests must pytest.skip in that case.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+REF = "/root/reference/src"
+_CACHED = None
+_FAILED = False
+
+
+def get_oracle():
+    global _CACHED, _FAILED
+    if _CACHED is not None or _FAILED:
+        return _CACHED
+    shim = os.path.join(os.path.dirname(__file__), "crush_oracle_shim.c")
+    if not os.path.isdir(REF) or not os.path.exists(shim):
+        _FAILED = True
+        return None
+    tmp = tempfile.mkdtemp(prefix="crush_oracle_")
+    stub = os.path.join(tmp, "stub")
+    os.makedirs(stub, exist_ok=True)
+    open(os.path.join(stub, "acconfig.h"), "w").close()
+    so = os.path.join(tmp, "libcrush_oracle.so")
+    cmd = ["gcc", "-O2", "-fPIC", "-shared", "-I" + stub, "-I" + REF,
+           "-I" + REF + "/crush", "-o", so, shim,
+           REF + "/crush/builder.c", REF + "/crush/crush.c",
+           REF + "/crush/hash.c"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except Exception:
+        _FAILED = True
+        return None
+    lib = ctypes.CDLL(so)
+    lib.oracle_crush_ln.restype = ctypes.c_longlong
+    lib.oracle_crush_ln.argtypes = [ctypes.c_uint]
+    lib.oracle_hash32_2.restype = ctypes.c_uint
+    lib.oracle_hash32_2.argtypes = [ctypes.c_uint] * 2
+    lib.oracle_hash32_3.restype = ctypes.c_uint
+    lib.oracle_hash32_3.argtypes = [ctypes.c_uint] * 3
+    lib.oracle_hash32_4.restype = ctypes.c_uint
+    lib.oracle_hash32_4.argtypes = [ctypes.c_uint] * 4
+    lib.oracle_map_run2.restype = ctypes.c_int
+    lib.oracle_map_run2.argtypes = [
+        ctypes.c_int,                      # leaf_alg
+        ctypes.c_int, ctypes.c_int,        # num_hosts, devs_per_host
+        ctypes.POINTER(ctypes.c_uint),     # dev_weights
+        ctypes.c_int,                      # flat
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,  # rule_op, type, numrep
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,  # rule_op2, type2, numrep2
+        ctypes.c_int,                      # x
+        ctypes.POINTER(ctypes.c_uint), ctypes.c_int,  # reweight, len
+        ctypes.POINTER(ctypes.c_int),      # tunables[6]
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int,   # result, result_max
+    ]
+    _CACHED = lib
+    return lib
+
+
+def oracle_map_run(lib, leaf_alg, num_hosts, devs_per_host, dev_weights,
+                   flat, rule_op, choose_type, numrep, x, reweight,
+                   tunables, result_max, rule_op2=0, choose_type2=0,
+                   numrep2=0):
+    import numpy as np
+    dw = np.asarray(dev_weights, dtype=np.uint32)
+    rw = np.asarray(reweight, dtype=np.uint32)
+    tun = np.asarray(tunables, dtype=np.int32)
+    res = np.zeros(result_max, dtype=np.int32)
+    n = lib.oracle_map_run2(
+        leaf_alg, num_hosts, devs_per_host,
+        dw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint)),
+        flat, rule_op, choose_type, numrep,
+        rule_op2, choose_type2, numrep2, x,
+        rw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint)), len(rw),
+        tun.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        res.ctypes.data_as(ctypes.POINTER(ctypes.c_int)), result_max)
+    return list(res[:n]) if n >= 0 else None

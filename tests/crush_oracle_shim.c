@@ -1,0 +1,128 @@
+/* Test-time oracle shim: exposes the reference CRUSH C core (compiled
+ * straight from /root/reference at test time, never vendored into this
+ * repo) so the Python/JAX reimplementation can be differentially tested
+ * for bit-exactness.
+ *
+ * #include "mapper.c" pulls in the static functions (crush_ln,
+ * bucket_straw2_choose, ...) so they can be wrapped here.
+ */
+
+#include "mapper.c"
+#include "builder.h"
+#include "hash.h"
+
+#include <stdlib.h>
+#include <string.h>
+
+long long oracle_crush_ln(unsigned int x) { return (long long)crush_ln(x); }
+
+unsigned oracle_hash32_2(unsigned a, unsigned b) {
+    return crush_hash32_2(CRUSH_HASH_RJENKINS1, a, b);
+}
+unsigned oracle_hash32_3(unsigned a, unsigned b, unsigned c) {
+    return crush_hash32_3(CRUSH_HASH_RJENKINS1, a, b, c);
+}
+unsigned oracle_hash32_4(unsigned a, unsigned b, unsigned c, unsigned d) {
+    return crush_hash32_4(CRUSH_HASH_RJENKINS1, a, b, c, d);
+}
+
+/* Build a map:
+ *   flat=1: one bucket (id -1) of all devices, alg=leaf_alg.
+ *   flat=0: root (straw2, id -1) over num_hosts host buckets (alg=leaf_alg,
+ *           type 1), each with devs_per_host devices; host weight = sum of
+ *           its device weights.
+ * Rule: TAKE(-1), <rule_op>(numrep, choose_type),
+ *       [<rule_op2>(numrep2, choose_type2) if rule_op2 > 0], EMIT.
+ * tun = {choose_total_tries, choose_local_tries, choose_local_fallback_tries,
+ *        chooseleaf_descend_once, chooseleaf_vary_r, chooseleaf_stable}
+ * Returns result_len, or -1 on build failure.
+ */
+int oracle_map_run2(int leaf_alg,
+                    int num_hosts, int devs_per_host, unsigned *dev_weights,
+                    int flat,
+                    int rule_op, int choose_type, int numrep,
+                    int rule_op2, int choose_type2, int numrep2,
+                    int x,
+                    unsigned *reweight, int reweight_len,
+                    int *tun,
+                    int *result, int result_max)
+{
+    struct crush_map *map = crush_create();
+    if (!map) return -1;
+    map->choose_total_tries = tun[0];
+    map->choose_local_tries = tun[1];
+    map->choose_local_fallback_tries = tun[2];
+    map->chooseleaf_descend_once = tun[3];
+    map->chooseleaf_vary_r = tun[4];
+    map->chooseleaf_stable = tun[5];
+
+    int ndev = num_hosts * devs_per_host;
+    int ret = -1;
+    if (flat) {
+        int *items = malloc(sizeof(int) * ndev);
+        int *weights = malloc(sizeof(int) * ndev);
+        for (int i = 0; i < ndev; i++) { items[i] = i; weights[i] = (int)dev_weights[i]; }
+        struct crush_bucket *b =
+            crush_make_bucket(map, leaf_alg, CRUSH_HASH_RJENKINS1, 1, ndev, items, weights);
+        free(items); free(weights);
+        if (!b) goto out;
+        int id;
+        if (crush_add_bucket(map, -1, b, &id) < 0) goto out;
+    } else {
+        int *host_ids = malloc(sizeof(int) * num_hosts);
+        int *host_weights = malloc(sizeof(int) * num_hosts);
+        for (int h = 0; h < num_hosts; h++) {
+            int *items = malloc(sizeof(int) * devs_per_host);
+            int *weights = malloc(sizeof(int) * devs_per_host);
+            unsigned sum = 0;
+            for (int i = 0; i < devs_per_host; i++) {
+                items[i] = h * devs_per_host + i;
+                weights[i] = (int)dev_weights[h * devs_per_host + i];
+                sum += dev_weights[h * devs_per_host + i];
+            }
+            struct crush_bucket *b =
+                crush_make_bucket(map, leaf_alg, CRUSH_HASH_RJENKINS1, 1,
+                                  devs_per_host, items, weights);
+            free(items); free(weights);
+            if (!b) goto out;
+            int id;
+            if (crush_add_bucket(map, -2 - h, b, &id) < 0) goto out;
+            host_ids[h] = id;
+            host_weights[h] = (int)sum;
+        }
+        struct crush_bucket *root =
+            crush_make_bucket(map, CRUSH_BUCKET_STRAW2, CRUSH_HASH_RJENKINS1, 2,
+                              num_hosts, host_ids, host_weights);
+        if (!root) goto out;
+        int id;
+        if (crush_add_bucket(map, -1, root, &id) < 0) goto out;
+        free(host_ids); free(host_weights);
+    }
+
+    {
+        int nsteps = rule_op2 > 0 ? 4 : 3;
+        struct crush_rule *rule = crush_make_rule(nsteps, 0, 1, 1, result_max);
+        if (!rule) goto out;
+        int pos = 0;
+        crush_rule_set_step(rule, pos++, CRUSH_RULE_TAKE, -1, 0);
+        crush_rule_set_step(rule, pos++, rule_op, numrep, choose_type);
+        if (rule_op2 > 0)
+            crush_rule_set_step(rule, pos++, rule_op2, numrep2, choose_type2);
+        crush_rule_set_step(rule, pos++, CRUSH_RULE_EMIT, 0, 0);
+        if (crush_add_rule(map, rule, 0) < 0) goto out;
+    }
+
+    crush_finalize(map);
+
+    {
+        size_t wsize = crush_work_size(map, result_max);
+        char *cwin = malloc(wsize + 3 * result_max * sizeof(int));
+        crush_init_workspace(map, cwin);
+        ret = crush_do_rule(map, 0, x, result, result_max,
+                            reweight, reweight_len, cwin, NULL);
+        free(cwin);
+    }
+out:
+    crush_destroy(map);
+    return ret;
+}

@@ -1,0 +1,334 @@
+"""CRUSH differential tests: Python/JAX reimplementation vs the reference
+C core compiled at test time (bit-exactness is the contract — BASELINE.md
+correctness gate: batched mapping exhaustively equal to crush_do_rule).
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import batched, hashing, ln, map as cmap_mod, mapper_ref
+from ceph_tpu.crush.map import CrushMap, Rule, CRUSH_ITEM_NONE
+
+from . import crush_oracle
+
+ALG_UNIFORM, ALG_LIST, ALG_STRAW2 = 1, 2, 5
+OP_CHOOSE_FIRSTN, OP_CHOOSE_INDEP = 2, 3
+OP_CHOOSELEAF_FIRSTN, OP_CHOOSELEAF_INDEP = 6, 7
+TUN_DEFAULT = [51, 0, 0, 1, 1, 1]  # total_tries+1 handled in C; see below
+
+
+def lib_or_skip():
+    lib = crush_oracle.get_oracle()
+    if lib is None:
+        pytest.skip("reference C oracle unavailable")
+    return lib
+
+
+def make_two_level(num_hosts, devs_per_host, dev_weights, leaf_alg="straw2"):
+    m = CrushMap()
+    m.type_names = {"osd": 0, "host": 1, "root": 2}
+    host_ids = []
+    host_weights = []
+    for h in range(num_hosts):
+        items = [h * devs_per_host + i for i in range(devs_per_host)]
+        w = [int(dev_weights[i]) for i in items]
+        hid = m.add_bucket(leaf_alg, 1, items, w, id=-2 - h)
+        host_ids.append(hid)
+        host_weights.append(sum(w))
+    m.add_bucket("straw2", 2, host_ids, host_weights, id=-1, name="default")
+    return m
+
+
+def make_flat(ndev, dev_weights, leaf_alg="straw2"):
+    m = CrushMap()
+    m.type_names = {"osd": 0, "host": 1}
+    m.add_bucket(leaf_alg, 1, list(range(ndev)),
+                 [int(w) for w in dev_weights], id=-1, name="default")
+    return m
+
+
+def crush_tunables(m):
+    t = m.tunables
+    return [t.choose_total_tries, t.choose_local_tries,
+            t.choose_local_fallback_tries, t.chooseleaf_descend_once,
+            t.chooseleaf_vary_r, t.chooseleaf_stable]
+
+
+def test_crush_ln_full_domain():
+    lib = lib_or_skip()
+    ref = np.array([lib.oracle_crush_ln(u) for u in range(0x10000)],
+                   dtype=np.int64)
+    assert np.array_equal(np.asarray(ln.crush_ln(np.arange(0x10000))), ref)
+
+
+def test_crush_ln_jax_full_domain():
+    lib = lib_or_skip()
+    import jax
+    import jax.numpy as jnp
+    ref = np.array([lib.oracle_crush_ln(u) for u in range(0x10000)],
+                   dtype=np.int64)
+    with jax.enable_x64():
+        out = jax.jit(lambda u: ln.crush_ln(u, xp=jnp))(jnp.arange(0x10000))
+    assert np.array_equal(np.asarray(out), ref)
+
+
+def test_rjenkins_hashes():
+    lib = lib_or_skip()
+    rng = np.random.default_rng(0)
+    abc = rng.integers(0, 2**32, size=(300, 3), dtype=np.uint64).astype(
+        np.uint32)
+    with np.errstate(over="ignore"):
+        m2 = np.asarray(hashing.hash32_2(abc[:, 0], abc[:, 1]))
+        m3 = np.asarray(hashing.hash32_3(abc[:, 0], abc[:, 1], abc[:, 2]))
+        m4 = np.asarray(hashing.hash32_4(abc[:, 0], abc[:, 1], abc[:, 2],
+                                         abc[:, 0] ^ abc[:, 1]))
+    for i, (a, b, c) in enumerate(abc):
+        assert m2[i] == lib.oracle_hash32_2(int(a), int(b))
+        assert m3[i] == lib.oracle_hash32_3(int(a), int(b), int(c))
+        assert m4[i] == lib.oracle_hash32_4(int(a), int(b), int(c),
+                                            int(a) ^ int(b))
+
+
+@pytest.mark.parametrize("op,steps_op", [
+    (OP_CHOOSE_INDEP, cmap_mod.RULE_CHOOSE_INDEP),
+    (OP_CHOOSE_FIRSTN, cmap_mod.RULE_CHOOSE_FIRSTN),
+])
+def test_flat_bucket_vs_oracle(op, steps_op):
+    lib = lib_or_skip()
+    rng = np.random.default_rng(1)
+    ndev = 12
+    weights = rng.integers(1, 4 * 0x10000, size=ndev, dtype=np.uint32)
+    reweight = np.full(ndev, 0x10000, dtype=np.uint32)
+    reweight[3] = 0            # marked out
+    reweight[7] = 0x8000       # half reweighted
+    m = make_flat(ndev, weights)
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1), (steps_op, 3, 0),
+                           (cmap_mod.RULE_EMIT,)]))
+    for x in range(60):
+        ref = crush_oracle.oracle_map_run(
+            lib, ALG_STRAW2, 1, ndev, weights, 1, op, 0, 3, x,
+            reweight, crush_tunables(m), 3)
+        mine = mapper_ref.crush_do_rule(m, 0, x, 3, list(reweight))
+        assert mine == ref, (x, mine, ref)
+
+
+@pytest.mark.parametrize("op,steps_op,leaf_alg,calg", [
+    (OP_CHOOSELEAF_INDEP, cmap_mod.RULE_CHOOSELEAF_INDEP, "straw2", ALG_STRAW2),
+    (OP_CHOOSELEAF_FIRSTN, cmap_mod.RULE_CHOOSELEAF_FIRSTN, "straw2", ALG_STRAW2),
+    (OP_CHOOSELEAF_INDEP, cmap_mod.RULE_CHOOSELEAF_INDEP, "list", ALG_LIST),
+    (OP_CHOOSELEAF_INDEP, cmap_mod.RULE_CHOOSELEAF_INDEP, "uniform", ALG_UNIFORM),
+])
+def test_two_level_chooseleaf_vs_oracle(op, steps_op, leaf_alg, calg):
+    lib = lib_or_skip()
+    rng = np.random.default_rng(2)
+    hosts, per = 5, 4
+    ndev = hosts * per
+    if leaf_alg == "uniform":
+        weights = np.full(ndev, 0x10000, dtype=np.uint32)
+    else:
+        weights = rng.integers(1, 3 * 0x10000, size=ndev, dtype=np.uint32)
+    reweight = np.full(ndev, 0x10000, dtype=np.uint32)
+    reweight[5] = 0
+    reweight[11] = 0x4000
+    m = make_two_level(hosts, per, weights, leaf_alg=leaf_alg)
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1), (steps_op, 4, 1),
+                           (cmap_mod.RULE_EMIT,)]))
+    for x in range(40):
+        ref = crush_oracle.oracle_map_run(
+            lib, calg, hosts, per, weights, 0, op, 1, 4, x,
+            reweight, crush_tunables(m), 4)
+        mine = mapper_ref.crush_do_rule(m, 0, x, 4, list(reweight))
+        assert mine == ref, (leaf_alg, x, mine, ref)
+
+
+def test_legacy_tunables_vs_oracle():
+    # pre-jewel tunables: local retries + fallback + vary_r=0 + stable=0
+    lib = lib_or_skip()
+    rng = np.random.default_rng(3)
+    hosts, per = 4, 3
+    ndev = hosts * per
+    weights = rng.integers(1, 2 * 0x10000, size=ndev, dtype=np.uint32)
+    reweight = np.full(ndev, 0x10000, dtype=np.uint32)
+    reweight[2] = 0
+    m = make_two_level(hosts, per, weights)
+    m.tunables = cmap_mod.Tunables(
+        choose_total_tries=19, choose_local_tries=2,
+        choose_local_fallback_tries=5, chooseleaf_descend_once=0,
+        chooseleaf_vary_r=0, chooseleaf_stable=0)
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1),
+                           (cmap_mod.RULE_CHOOSELEAF_FIRSTN, 3, 1),
+                           (cmap_mod.RULE_EMIT,)]))
+    for x in range(40):
+        ref = crush_oracle.oracle_map_run(
+            lib, ALG_STRAW2, hosts, per, weights, 0,
+            OP_CHOOSELEAF_FIRSTN, 1, 3, x, reweight, crush_tunables(m), 3)
+        mine = mapper_ref.crush_do_rule(m, 0, x, 3, list(reweight))
+        assert mine == ref, (x, mine, ref)
+
+
+def test_batched_matches_ref_flat_indep():
+    rng = np.random.default_rng(4)
+    ndev = 10
+    weights = rng.integers(1, 3 * 0x10000, size=ndev, dtype=np.uint32)
+    m = make_flat(ndev, weights)
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1),
+                           (cmap_mod.RULE_CHOOSE_INDEP, 4, 0),
+                           (cmap_mod.RULE_EMIT,)]))
+    reweight = np.full(ndev, 0x10000, dtype=np.int64)
+    reweight[1] = 0
+    reweight[8] = 0x9000
+    xs = np.arange(300)
+    got = batched.batched_do_rule(m, 0, xs, 4, reweight)
+    for x in xs:
+        ref = mapper_ref.crush_do_rule(m, 0, int(x), 4, list(reweight))
+        assert list(got[x]) == ref, (x, list(got[x]), ref)
+
+
+def test_batched_matches_ref_two_level_chooseleaf_indep():
+    # the EC placement shape: take root -> chooseleaf indep over hosts
+    rng = np.random.default_rng(5)
+    hosts, per = 6, 4
+    ndev = hosts * per
+    weights = rng.integers(1, 3 * 0x10000, size=ndev, dtype=np.uint32)
+    m = make_two_level(hosts, per, weights)
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1),
+                           (cmap_mod.RULE_CHOOSELEAF_INDEP, 5, 1),
+                           (cmap_mod.RULE_EMIT,)]))
+    reweight = np.full(ndev, 0x10000, dtype=np.int64)
+    reweight[0] = 0
+    reweight[13] = 0x2000
+    xs = np.arange(300)
+    got = batched.batched_do_rule(m, 0, xs, 5, reweight)
+    for x in xs:
+        ref = mapper_ref.crush_do_rule(m, 0, int(x), 5, list(reweight))
+        assert list(got[x]) == ref, (x, list(got[x]), ref)
+
+
+def test_batched_indep_holes_are_positional():
+    # indep leaves CRUSH_ITEM_NONE holes rather than shifting (required by
+    # EC shard positioning, ecbackend.rst:100-105)
+    m = make_flat(4, [0x10000] * 4)
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1),
+                           (cmap_mod.RULE_CHOOSE_INDEP, 4, 0),
+                           (cmap_mod.RULE_EMIT,)]))
+    # mark two devices fully out: only 2 of 4 slots can fill
+    reweight = np.array([0x10000, 0, 0x10000, 0], dtype=np.int64)
+    got = batched.batched_do_rule(m, 0, np.arange(50), 4, reweight)
+    ref_holes = 0
+    for row in got:
+        for v in row:
+            assert v in (0, 2, CRUSH_ITEM_NONE)
+        ref_holes += sum(1 for v in row if v == CRUSH_ITEM_NONE)
+    assert ref_holes == 50 * 2  # exactly the out devices leave holes
+
+
+def test_create_rule_integration():
+    # ErasureCode.create_rule analog: codec geometry drives rule creation
+    from ceph_tpu import registry
+    codec = registry.factory("jax_tpu", {"technique": "reed_sol_van",
+                                         "k": "4", "m": "2", "w": "8"})
+    m = make_two_level(8, 2, [0x10000] * 16)
+    ruleno = m.add_simple_rule("ecpool", "default", "host", mode="indep",
+                               rule_type=cmap_mod.POOL_TYPE_ERASURE)
+    res = batched.batched_do_rule(m, ruleno, np.arange(20),
+                                  codec.get_chunk_count())
+    assert res.shape == (20, 6)
+    for row in res:
+        real = [v for v in row if v != CRUSH_ITEM_NONE]
+        assert len(set(real)) == len(real)  # distinct devices
+
+
+@pytest.mark.parametrize("op1,op2,pop1,pop2", [
+    (OP_CHOOSE_FIRSTN, OP_CHOOSE_FIRSTN,
+     cmap_mod.RULE_CHOOSE_FIRSTN, cmap_mod.RULE_CHOOSE_FIRSTN),
+    (OP_CHOOSE_INDEP, OP_CHOOSE_INDEP,
+     cmap_mod.RULE_CHOOSE_INDEP, cmap_mod.RULE_CHOOSE_INDEP),
+])
+def test_two_step_rule_vs_oracle(op1, op2, pop1, pop2):
+    # multi-bucket working vector: choose N hosts, then 1 osd per host
+    # (exercises the o+osize slice semantics of crush_do_rule:1019-1056)
+    lib = lib_or_skip()
+    rng = np.random.default_rng(7)
+    hosts, per = 5, 3
+    ndev = hosts * per
+    weights = rng.integers(1, 3 * 0x10000, size=ndev, dtype=np.uint32)
+    reweight = np.full(ndev, 0x10000, dtype=np.uint32)
+    reweight[4] = 0
+    m = make_two_level(hosts, per, weights)
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1), (pop1, 3, 1),
+                           (pop2, 1, 0), (cmap_mod.RULE_EMIT,)]))
+    for x in range(40):
+        ref = crush_oracle.oracle_map_run(
+            lib, ALG_STRAW2, hosts, per, weights, 0, op1, 1, 3, x,
+            reweight, crush_tunables(m), 3, rule_op2=op2, choose_type2=0,
+            numrep2=1)
+        mine = mapper_ref.crush_do_rule(m, 0, x, 3, list(reweight))
+        assert mine == ref, (x, mine, ref)
+
+
+def test_numrep_exceeds_result_max_vs_oracle():
+    # C keeps the rule numrep as the retry stride even when result_max
+    # truncates the output count (mapper.c:1039-1046)
+    lib = lib_or_skip()
+    rng = np.random.default_rng(8)
+    ndev = 10
+    weights = rng.integers(1, 3 * 0x10000, size=ndev, dtype=np.uint32)
+    reweight = np.full(ndev, 0x10000, dtype=np.uint32)
+    reweight[2] = 0
+    m = make_flat(ndev, weights)
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1),
+                           (cmap_mod.RULE_CHOOSE_INDEP, 6, 0),
+                           (cmap_mod.RULE_EMIT,)]))
+    for x in range(40):
+        ref = crush_oracle.oracle_map_run(
+            lib, ALG_STRAW2, 1, ndev, weights, 1, OP_CHOOSE_INDEP, 0, 6, x,
+            reweight, crush_tunables(m), 4)
+        mine = mapper_ref.crush_do_rule(m, 0, x, 4, list(reweight))
+        assert mine == ref, (x, mine, ref)
+    # batched fast path agrees too
+    got = batched.batched_do_rule(m, 0, np.arange(40), 4,
+                                  np.asarray(reweight, dtype=np.int64))
+    for x in range(40):
+        ref = mapper_ref.crush_do_rule(m, 0, x, 4, list(reweight))
+        assert list(got[x]) == ref, (x, list(got[x]), ref)
+
+
+def test_batched_device_at_root_level_permanent_none():
+    # a device directly under the root alongside host buckets: chooseleaf
+    # over hosts must mark reps landing on the device as permanent NONE
+    # (mapper.c:744-751), in both the interpreter and the batched kernel
+    m = CrushMap()
+    m.type_names = {"osd": 0, "host": 1, "root": 2}
+    m.add_bucket("straw2", 1, [0, 1], [0x10000, 0x10000], id=-2)
+    m.add_bucket("straw2", 1, [2, 3], [0x10000, 0x10000], id=-3)
+    # root holds two hosts AND a bare device 4
+    m.add_bucket("straw2", 2, [-2, -3, 4], [0x20000, 0x20000, 0x10000],
+                 id=-1, name="default")
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1),
+                           (cmap_mod.RULE_CHOOSELEAF_INDEP, 3, 1),
+                           (cmap_mod.RULE_EMIT,)]))
+    xs = np.arange(200)
+    got = batched.batched_do_rule(m, 0, xs, 3)
+    saw_hole = False
+    for x in xs:
+        ref = mapper_ref.crush_do_rule(m, 0, int(x), 3)
+        assert list(got[x]) == ref, (x, list(got[x]), ref)
+        saw_hole = saw_hole or CRUSH_ITEM_NONE in ref
+    assert saw_hole  # the bare device must have produced permanent holes
+
+
+def test_batched_malformed_map_falls_back():
+    # dangling bucket reference: batched path must degrade like the
+    # scalar interpreter (holes), not crash
+    m = CrushMap()
+    m.type_names = {"osd": 0, "host": 1, "root": 2}
+    m.add_bucket("straw2", 1, [0, 1], [0x10000] * 2, id=-2)
+    m.add_bucket("straw2", 2, [-2, -9], [0x20000, 0x20000], id=-1,
+                 name="default")
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1),
+                           (cmap_mod.RULE_CHOOSELEAF_INDEP, 2, 1),
+                           (cmap_mod.RULE_EMIT,)]))
+    got = batched.batched_do_rule(m, 0, np.arange(20), 2)
+    for x in range(20):
+        ref = mapper_ref.crush_do_rule(m, 0, x, 2)
+        assert list(got[x]) == ref

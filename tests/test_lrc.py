@@ -1,0 +1,153 @@
+"""LRC layered codec tests (modeled on src/test/erasure-code/TestErasureCodeLrc.cc)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu import registry
+from ceph_tpu.models.base import ErasureCodeError
+
+
+def make(plugin="lrc", **profile):
+    prof = {str(k): str(v) for k, v in profile.items()}
+    return registry.factory(plugin, prof)
+
+
+def payload(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+def test_kml_shorthand_geometry():
+    # k=4 m=2 l=3 -> 2 groups, 8 chunks (4 data, 2 global, 2 local parity)
+    codec = make(k=4, m=2, l=3)
+    assert codec.get_chunk_count() == 8
+    assert codec.get_data_chunk_count() == 4
+    assert len(codec.layers) == 3  # one global + two local
+    # generated params are not echoed back (ErasureCodeLrc.cc:547-553)
+    assert "mapping" not in codec.get_profile()
+    assert "layers" not in codec.get_profile()
+
+
+def test_kml_validation():
+    for bad in ({"k": "4", "m": "2"},               # l missing
+                {"k": "4", "m": "2", "l": "4"},     # (k+m) % l != 0
+                {"k": "3", "m": "3", "l": "3"},     # k % groups != 0
+                ):
+        with pytest.raises(ErasureCodeError):
+            make(**bad)
+
+
+@pytest.mark.parametrize("plugin", ["lrc", "lrc_tpu"])
+def test_kml_roundtrip_all_single_erasures(plugin):
+    codec = make(plugin, k=4, m=2, l=3)
+    raw = payload(4097)
+    want = set(range(8))
+    enc = codec.encode(want, raw)
+    assert codec.decode_concat(enc)[:len(raw)] == raw
+    for gone in range(8):
+        chunks = {i: enc[i] for i in want if i != gone}
+        dec = codec.decode({gone}, chunks)
+        assert np.array_equal(dec[gone], enc[gone]), gone
+
+
+def test_double_erasure_recovery():
+    codec = make(k=4, m=2, l=3)
+    raw = payload(2222, seed=1)
+    want = set(range(8))
+    enc = codec.encode(want, raw)
+    import itertools
+    recovered = 0
+    for gone in itertools.combinations(range(8), 2):
+        chunks = {i: enc[i] for i in want if i not in gone}
+        try:
+            dec = codec.decode(set(gone), chunks)
+        except ErasureCodeError:
+            continue
+        for i in gone:
+            assert np.array_equal(dec[i], enc[i]), gone
+        recovered += 1
+    assert recovered > 0
+
+
+def test_local_repair_minimum():
+    # single erasure within a group should be repaired locally (l reads)
+    codec = make(k=4, m=2, l=3)
+    chunk_count = codec.get_chunk_count()
+    # erase one data chunk; minimum must be smaller than global k reads
+    # would imply for a same-group local repair
+    data_pos = codec.chunk_mapping[0]
+    avail = set(range(chunk_count)) - {data_pos}
+    minimum = codec.minimum_to_decode({data_pos}, avail)
+    assert len(minimum) == 3, minimum  # l local chunks
+    assert data_pos not in minimum
+
+
+def test_explicit_layers_json():
+    layers = '[ [ "DDc", "" ] ]'
+    codec = make(layers=layers, mapping="DD_")
+    assert codec.get_chunk_count() == 3
+    assert codec.get_data_chunk_count() == 2
+    raw = payload(333, seed=2)
+    enc = codec.encode({0, 1, 2}, raw)
+    dec = codec.decode({1}, {0: enc[0], 2: enc[2]})
+    assert np.array_equal(dec[1], enc[1])
+
+
+def test_layers_json_with_options():
+    layers = '[ [ "DDDDc", {"technique": "reed_sol_van", "w": "16"} ] ]'
+    codec = make(layers=layers, mapping="DDDD_")
+    assert codec.layers[0].codec.w == 16
+    raw = payload(555, seed=3)
+    enc = codec.encode(set(range(5)), raw)
+    dec = codec.decode({2}, {i: enc[i] for i in (0, 1, 3, 4)})
+    assert np.array_equal(dec[2], enc[2])
+
+
+def test_bad_layers_rejected():
+    for bad in ("not json", '{"a": 1}', "[ [ 42, \"\" ] ]", "[]"):
+        with pytest.raises(ErasureCodeError):
+            make(layers=bad, mapping="DD_")
+
+
+def test_inner_plugin_is_tpu_for_lrc_tpu():
+    codec = make("lrc_tpu", k=4, m=2, l=3)
+    assert codec.layers[0].codec.backend == "jax"
+
+
+def test_unrecoverable_raises_eio():
+    import errno
+    codec = make(k=4, m=2, l=3)
+    raw = payload(999, seed=4)
+    enc = codec.encode(set(range(8)), raw)
+    # erase an entire group plus a global parity: unrecoverable
+    gone = {0, 1, 2, 3, 7}
+    chunks = {i: enc[i] for i in range(8) if i not in gone}
+    with pytest.raises(ErasureCodeError) as e:
+        codec.decode(gone, chunks)
+    assert e.value.errno == errno.EIO
+
+
+def test_minimum_cascaded_recovery_case3():
+    # erase {0,1,6}: only the second local layer (no wanted chunk) can
+    # start the cascade; Case 3 must return available_chunks, and decode
+    # from that set must succeed (ErasureCodeLrc.cc minimum Case 3)
+    codec = make(k=4, m=2, l=3)
+    raw = payload(1111, seed=7)
+    enc = codec.encode(set(range(8)), raw)
+    gone = {0, 1, 6}
+    avail = set(range(8)) - gone
+    minimum = codec.minimum_to_decode({0}, avail)
+    assert minimum == avail
+    dec = codec.decode({0}, {i: enc[i] for i in minimum})
+    assert np.array_equal(dec[0], enc[0])
+
+
+def test_decode_from_minimum_set():
+    codec = make(k=4, m=2, l=3)
+    raw = payload(1212, seed=8)
+    enc = codec.encode(set(range(8)), raw)
+    for gone in range(8):
+        avail = set(range(8)) - {gone}
+        minimum = codec.minimum_to_decode({gone}, avail)
+        dec = codec.decode({gone}, {i: enc[i] for i in minimum})
+        assert np.array_equal(dec[gone], enc[gone]), gone
