@@ -292,10 +292,8 @@ def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
 
     shape = _rule_shape(cmap, ruleno)
     xs = np.asarray(xs)
-    if (shape is None
-            or shape["op"] in (RULE_CHOOSE_FIRSTN, RULE_CHOOSELEAF_FIRSTN)
-            or (shape["op"] == RULE_CHOOSELEAF_INDEP and shape["type"] == 0)
-            or any(b.alg != "straw2" for b in cmap.buckets.values())):
+
+    def scalar_fallback():
         from .mapper_ref import crush_do_rule
         out = np.full((len(xs), result_max), CRUSH_ITEM_NONE, dtype=np.int64)
         for i, x in enumerate(xs):
@@ -303,17 +301,18 @@ def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
             out[i, :len(res)] = res
         return out
 
+    if (shape is None
+            or shape["op"] in (RULE_CHOOSE_FIRSTN, RULE_CHOOSELEAF_FIRSTN)
+            or (shape["op"] == RULE_CHOOSELEAF_INDEP and shape["type"] == 0)
+            or any(b.alg != "straw2" for b in cmap.buckets.values())):
+        return scalar_fallback()
+
     try:
         cm = compile_map(cmap)
     except ValueError:
         # malformed map (dangling refs, cycles): scalar interpreter
         # degrades per-slot instead of failing the whole sweep
-        from .mapper_ref import crush_do_rule
-        out = np.full((len(xs), result_max), CRUSH_ITEM_NONE, dtype=np.int64)
-        for i, x in enumerate(xs):
-            res = crush_do_rule(cmap, ruleno, int(x), result_max, weight)
-            out[i, :len(res)] = res
-        return out
+        return scalar_fallback()
     numrep = shape["numrep_arg"]
     if numrep <= 0:
         numrep += result_max

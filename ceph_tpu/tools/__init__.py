@@ -1,0 +1,11 @@
+"""Command-line tools mirroring the reference's EC tool suite.
+
+Each module has a ``main(argv) -> int`` entry point and a console wrapper:
+
+  erasure_code_benchmark  ceph_erasure_code_benchmark CLI + output contract
+                          (src/test/erasure-code/ceph_erasure_code_benchmark.cc)
+  erasure_code            ceph_erasure_code probe/info tool
+                          (src/test/erasure-code/ceph_erasure_code.cc)
+  non_regression          ceph_erasure_code_non_regression golden corpora
+                          (src/test/erasure-code/ceph_erasure_code_non_regression.cc)
+"""

@@ -1,0 +1,207 @@
+"""Tools: benchmark CLI/output contract, probe tool, non-regression corpora.
+
+Models the reference's usage of its EC tool suite (canonical invocations in
+src/erasure-code/isa/README:36-46 and the compile-command footers of the
+tool sources)."""
+
+import re
+
+import numpy as np
+import pytest
+
+from ceph_tpu.tools import erasure_code, erasure_code_benchmark, non_regression
+
+OUT_RE = re.compile(r"^(\d+\.\d{6})\t(\d+)$")
+
+
+def run_bench(argv):
+    return erasure_code_benchmark.main(argv)
+
+
+class TestBenchmarkTool:
+    def _run(self, capsys, argv):
+        code = run_bench(argv)
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        return code, out
+
+    def test_encode_output_contract(self, capsys):
+        code, out = self._run(capsys, [
+            "-p", "jerasure", "-P", "technique=reed_sol_van",
+            "-P", "k=2", "-P", "m=1", "-s", "4096", "-i", "3"])
+        assert code == 0
+        m = OUT_RE.match(out)
+        assert m, out
+        assert int(m.group(2)) == 3 * (4096 // 1024)
+
+    def test_decode_random(self, capsys):
+        code, out = self._run(capsys, [
+            "-w", "decode", "-e", "2",
+            "-p", "jerasure", "-P", "technique=reed_sol_van",
+            "-P", "k=4", "-P", "m=2", "-s", "4096", "-i", "2"])
+        assert code == 0
+        assert OUT_RE.match(out), out
+
+    def test_decode_exhaustive_verifies(self, capsys):
+        code, out = self._run(capsys, [
+            "-w", "decode", "-e", "2", "-E", "exhaustive",
+            "-p", "jerasure", "-P", "technique=reed_sol_van",
+            "-P", "k=4", "-P", "m=2", "-s", "2048", "-i", "1"])
+        assert code == 0
+        assert OUT_RE.match(out), out
+
+    def test_decode_erased_list(self, capsys):
+        code = run_bench([
+            "-w", "decode", "--erased", "0", "--erased", "3",
+            "-p", "jerasure", "-P", "technique=reed_sol_van",
+            "-P", "k=3", "-P", "m=2", "-s", "2048", "-i", "2"])
+        out = capsys.readouterr().out
+        assert code == 0
+        assert "(0)" in out and "(3)" in out  # display_chunks markers
+
+    def test_batched_encode(self, capsys):
+        code, out = self._run(capsys, [
+            "-p", "jax_tpu", "-P", "technique=reed_sol_van",
+            "-P", "k=8", "-P", "m=3", "-s", "4096", "-i", "2",
+            "--batch", "4"])
+        assert code == 0
+        m = OUT_RE.match(out)
+        assert m and int(m.group(2)) == 2 * 4 * (4096 // 1024)
+
+    def test_exhaustive_with_erased(self, capsys):
+        code, out = self._run(capsys, [
+            "-w", "decode", "-E", "exhaustive", "-e", "1", "--erased", "0",
+            "-p", "jerasure", "-P", "technique=reed_sol_van",
+            "-P", "k=3", "-P", "m=2", "-s", "2048", "-i", "1"])
+        assert code == 0
+        assert OUT_RE.match(out), out
+
+    def test_decode_report_ignores_batch(self, capsys):
+        # decode never batches; KiB must not be inflated by --batch
+        code, out = self._run(capsys, [
+            "-w", "decode", "-e", "1", "--batch", "4",
+            "-p", "jerasure", "-P", "technique=reed_sol_van",
+            "-P", "k=2", "-P", "m=1", "-s", "4096", "-i", "2"])
+        assert code == 0
+        assert int(OUT_RE.match(out).group(2)) == 2 * (4096 // 1024)
+
+    def test_batch_unsupported_plugin(self, capsys, monkeypatch):
+        # a codec without a batched path must yield a clean CLI error,
+        # not a traceback
+        from ceph_tpu.models import rs
+
+        def boom(self, data):
+            raise NotImplementedError
+        monkeypatch.setattr(rs.ReedSolomonVandermonde, "encode_batch", boom)
+        code = run_bench(["-p", "jerasure", "--batch", "2",
+                          "-P", "technique=reed_sol_van",
+                          "-P", "k=2", "-P", "m=1", "-s", "2048", "-i", "1"])
+        assert code == 1
+        assert "does not support --batch" in capsys.readouterr().err
+
+    def test_bad_k_rejected(self, capsys):
+        assert run_bench(["-P", "m=1"]) == 1
+
+    def test_mismatched_km_rejected(self, capsys):
+        # shec with c consumes different geometry; claim wrong m
+        assert run_bench(["-p", "jerasure",
+                          "-P", "technique=reed_sol_van",
+                          "-P", "k=2", "-P", "m=1",
+                          "-P", "mapping=_DD"]) in (0, 1)
+
+
+class TestProbeTool:
+    def test_plugin_exists(self):
+        assert erasure_code.main(["--plugin_exists", "jerasure"]) == 0
+        assert erasure_code.main(["--plugin_exists", "jax_tpu"]) == 0
+
+    def test_plugin_missing(self, capsys):
+        code = erasure_code.main(["--plugin_exists", "no_such_plugin"])
+        assert code != 0
+        assert "libec_no_such_plugin" in capsys.readouterr().err
+
+    def test_display_all(self, capsys):
+        code = erasure_code.main([
+            "--all", "-P", "plugin=jerasure",
+            "-P", "technique=reed_sol_van", "-P", "k=2", "-P", "m=2"])
+        out = capsys.readouterr().out
+        assert code == 0
+        assert "get_data_chunk_count\t2" in out
+        assert "get_coding_chunk_count\t2" in out
+        assert "get_chunk_count\t4" in out
+        assert re.search(r"get_chunk_size\(1024\)\t\d+", out)
+
+    def test_plugin_mandatory(self, capsys):
+        assert erasure_code.main(["--all"]) == 1
+        assert "plugin=<plugin> is mandatory" in capsys.readouterr().err
+
+
+PROFILES = [
+    ("jerasure", ["technique=reed_sol_van", "k=2", "m=2"]),
+    ("jerasure", ["technique=cauchy_good", "k=4", "m=2", "packetsize=64"]),
+    ("jax_tpu", ["technique=reed_sol_van", "k=8", "m=3"]),
+    ("shec", ["k=4", "m=3", "c=2"]),
+    ("lrc", ["k=4", "m=2", "l=3"]),
+]
+
+
+class TestNonRegression:
+    @pytest.mark.parametrize("plugin,params", PROFILES)
+    def test_create_then_check(self, tmp_path, plugin, params):
+        argv = ["--plugin", plugin, "--base", str(tmp_path),
+                "--stripe-width", "3181"]
+        for p in params:
+            argv += ["--parameter", p]
+        assert non_regression.main(argv + ["--create"]) == 0
+        assert non_regression.main(argv + ["--check"]) == 0
+
+    def test_check_detects_corruption(self, tmp_path, capsys):
+        argv = ["--plugin", "jerasure", "--base", str(tmp_path),
+                "--parameter", "technique=reed_sol_van",
+                "--parameter", "k=2", "--parameter", "m=2"]
+        assert non_regression.main(argv + ["--create"]) == 0
+        # corrupt chunk 1 on disk
+        nr = non_regression.NonRegression(
+            non_regression.build_parser().parse_args(argv))
+        path = nr.chunk_path(1)
+        buf = bytearray(open(path, "rb").read())
+        buf[0] ^= 0xFF
+        open(path, "wb").write(bytes(buf))
+        assert non_regression.main(argv + ["--check"]) == 1
+        assert "encodes differently" in capsys.readouterr().err
+
+    def test_check_without_corpus(self, tmp_path, capsys):
+        argv = ["--plugin", "jerasure", "--base", str(tmp_path),
+                "--parameter", "technique=reed_sol_van",
+                "--parameter", "k=2", "--parameter", "m=2"]
+        assert non_regression.main(argv + ["--check"]) == 1
+        assert "FileNotFoundError" in capsys.readouterr().err
+
+    def test_create_twice(self, tmp_path, capsys):
+        argv = ["--plugin", "jerasure", "--base", str(tmp_path),
+                "--parameter", "technique=reed_sol_van",
+                "--parameter", "k=2", "--parameter", "m=2"]
+        assert non_regression.main(argv + ["--create"]) == 0
+        assert non_regression.main(argv + ["--create"]) == 1
+        assert "FileExistsError" in capsys.readouterr().err
+
+    def test_cross_plugin_bit_exactness(self, tmp_path):
+        """jax_tpu must reproduce the CPU plugin's chunks bit-for-bit —
+        the corpus contract that lets plugins interoperate on one pool."""
+        argv_cpu = ["--plugin", "jerasure", "--base", str(tmp_path),
+                    "--parameter", "technique=reed_sol_van",
+                    "--parameter", "k=8", "--parameter", "m=3"]
+        assert non_regression.main(argv_cpu + ["--create"]) == 0
+        nr = non_regression.NonRegression(
+            non_regression.build_parser().parse_args(argv_cpu))
+        content = open(nr.content_path(), "rb").read()
+
+        from ceph_tpu import registry
+        tpu = registry.factory("jax_tpu", {"technique": "reed_sol_van",
+                                           "k": "8", "m": "3"})
+        encoded = tpu.encode(set(range(11)), content)
+        for chunk in range(11):
+            disk = np.frombuffer(open(nr.chunk_path(chunk), "rb").read(),
+                                 dtype=np.uint8)
+            np.testing.assert_array_equal(
+                disk, np.asarray(encoded[chunk]),
+                err_msg="chunk %d differs between plugins" % chunk)
