@@ -1,0 +1,19 @@
+"""Core runtime: the framework's equivalent of Ceph's src/common layer.
+
+Components (reference citations in each module):
+
+  buffer         segmented buffers (bufferlist, src/include/buffer.h)
+  options        typed option schema (src/common/options.cc)
+  config         layered config w/ observers (src/common/config.{h,cc})
+  perf_counters  metrics registry (src/common/perf_counters.{h,cc})
+  log            leveled in-memory-ring logger (src/log/, src/common/debug.h)
+  throttle       backpressure primitives (src/common/Throttle.{h,cc})
+  workqueue      thread pools, finisher, timer (src/common/WorkQueue.h)
+  heartbeat_map  thread-liveness watchdog (src/common/HeartbeatMap.{h,cc})
+  admin_socket   per-daemon command server (src/common/admin_socket.{h,cc})
+  context        CephContext analog wiring the above together
+"""
+
+from .context import Context
+
+__all__ = ["Context"]

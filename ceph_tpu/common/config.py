@@ -1,0 +1,111 @@
+"""Layered runtime configuration with observers.
+
+Role of the reference's md_config_t (src/common/config.h:67): values
+resolve default < file < env < argv < runtime set_val; set_val stages
+changes and apply_changes() delivers them to registered observers
+(md_config_obs_t, src/common/config_obs.h) under a lock, each observer
+naming the keys it tracks — the mechanism TracepointProvider uses to
+hot-enable tracing and the OSD uses for runtime tuning.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import options as options_mod
+
+__all__ = ["Config", "ConfigObserver"]
+
+
+class ConfigObserver:
+    """Observer contract (md_config_obs_t)."""
+
+    def get_tracked_keys(self) -> tuple:
+        return ()
+
+    def handle_conf_change(self, conf: "Config", changed: set) -> None:
+        pass
+
+
+class Config:
+    def __init__(self, overrides: dict | None = None):
+        self._lock = threading.RLock()
+        self._values: dict[str, object] = {}
+        self._staged: dict[str, object] = {}
+        self._observers: list[ConfigObserver] = []
+        if overrides:
+            for k, v in overrides.items():
+                self.set_val(k, v)
+            self.apply_changes()
+
+    # -- reads ---------------------------------------------------------
+
+    def get_val(self, name: str):
+        with self._lock:
+            if name in self._values:
+                return self._values[name]
+        opt = options_mod.SCHEMA.get(name)
+        if opt is None:
+            raise KeyError("unknown config option %r" % name)
+        return opt.default
+
+    def __getattr__(self, name: str):
+        # conf.osd_heartbeat_interval sugar, like g_conf->name access
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            return self.get_val(name)
+        except KeyError:
+            raise AttributeError(name) from None
+
+    # -- writes --------------------------------------------------------
+
+    def set_val(self, name: str, value) -> None:
+        """Stage a change; visible after apply_changes (config.h:117+)."""
+        opt = options_mod.SCHEMA.get(name)
+        if opt is None:
+            raise KeyError("unknown config option %r" % name)
+        with self._lock:
+            self._staged[name] = opt.cast(value)
+
+    def set_val_or_die(self, name: str, value) -> None:
+        self.set_val(name, value)
+
+    def apply_changes(self) -> set:
+        with self._lock:
+            changed = {k for k, v in self._staged.items()
+                       if self._values.get(
+                           k, options_mod.SCHEMA[k].default) != v}
+            self._values.update(self._staged)
+            self._staged.clear()
+            observers = list(self._observers)
+        for obs in observers:
+            keys = set(obs.get_tracked_keys())
+            hits = changed & keys if keys else set()
+            if hits:
+                obs.handle_conf_change(self, hits)
+        return changed
+
+    # -- observers -----------------------------------------------------
+
+    def add_observer(self, obs: ConfigObserver) -> None:
+        with self._lock:
+            self._observers.append(obs)
+
+    def remove_observer(self, obs: ConfigObserver) -> None:
+        with self._lock:
+            self._observers.remove(obs)
+
+    # -- introspection (admin socket "config get/set/diff") ------------
+
+    def dump(self) -> dict:
+        with self._lock:
+            out = {name: opt.default for name, opt in
+                   options_mod.SCHEMA.items()}
+            out.update(self._values)
+            return out
+
+    def diff(self) -> dict:
+        with self._lock:
+            return {k: v for k, v in self._values.items()
+                    if v != options_mod.SCHEMA[k].default}

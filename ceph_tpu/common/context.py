@@ -1,0 +1,82 @@
+"""Process context: one object wiring config, log, counters, watchdog.
+
+Role of the reference's CephContext (src/common/ceph_context.{h,cc}):
+every daemon/tool holds one context carrying its config, logger, perf
+counter collection, heartbeat map, and (optionally) an admin socket —
+created by global_init (src/global/global_init.cc), which also preloads
+erasure-code plugins (global_init_preload_erasure_code, :484-519).
+"""
+
+from __future__ import annotations
+
+from .admin_socket import AdminSocket
+from .config import Config
+from .heartbeat_map import HeartbeatMap
+from .log import Log
+from .perf_counters import PerfCountersCollection
+
+__all__ = ["Context", "global_init"]
+
+
+class Context:
+    def __init__(self, overrides: dict | None = None, name: str = "ctx"):
+        self.name = name
+        self.conf = Config(overrides)
+        self.log = Log(self.conf)
+        self.perf = PerfCountersCollection()
+        self.hbmap = HeartbeatMap(name + "-hb")
+        self.admin_socket: AdminSocket | None = None
+
+    def dout(self, subsys: str, level: int, msg: str) -> None:
+        self.log.dout(subsys, level, msg)
+
+    def derr(self, subsys: str, msg: str) -> None:
+        self.log.derr(subsys, msg)
+
+    def init_admin_socket(self, path: str) -> AdminSocket:
+        sock = AdminSocket(path)
+        sock.register("perf dump", lambda args: self.perf.perf_dump(),
+                      "dump perf counters")
+        sock.register("config get",
+                      lambda args: {args["key"]:
+                                    self.conf.get_val(args["key"])},
+                      "get a config value")
+        sock.register("config set", self._config_set, "set a config value")
+        sock.register("config diff", lambda args: self.conf.diff(),
+                      "options changed from default")
+        sock.register("log dump", lambda args: self.log.dump_recent(),
+                      "dump the recent-events ring")
+        sock.register("health", lambda args: {
+            "healthy": self.hbmap.is_healthy(),
+            "unhealthy_workers": self.hbmap.unhealthy_workers()},
+            "internal thread liveness")
+        sock.init()
+        self.admin_socket = sock
+        return sock
+
+    def _config_set(self, args: dict) -> dict:
+        self.conf.set_val(args["key"], args["value"])
+        changed = self.conf.apply_changes()
+        return {"changed": sorted(changed)}
+
+    def shutdown(self) -> None:
+        if self.admin_socket is not None:
+            self.admin_socket.shutdown()
+            self.admin_socket = None
+
+
+def global_init(overrides: dict | None = None, name: str = "ctx",
+                preload_plugins: bool = True) -> Context:
+    """Build a context and preload EC plugins like daemon start does."""
+    ctx = Context(overrides, name)
+    if preload_plugins:
+        from .. import registry
+        names = ctx.conf.get_val("osd_erasure_code_plugins").split()
+        reg = registry.ErasureCodePluginRegistry.instance()
+        for plugin in names:
+            try:
+                reg.load(plugin)
+            except Exception as e:
+                ctx.derr("ec", "failed to preload plugin %s: %s"
+                         % (plugin, e))
+    return ctx

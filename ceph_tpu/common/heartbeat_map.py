@@ -1,0 +1,78 @@
+"""Thread-liveness watchdog.
+
+Role of the reference's HeartbeatMap (src/common/HeartbeatMap.{h,cc}):
+worker threads hold a handle and renew a lease before each unit of work;
+is_healthy() reports any thread whose lease expired (wedged on IO, a
+lock, or a device). Daemons answer internal liveness probes with this,
+so one stuck worker turns into a visible health failure instead of a
+silent stall — the same signal the suicide_grace kill path uses.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["HeartbeatMap"]
+
+
+class _Handle:
+    __slots__ = ("hbmap", "name", "grace", "suicide_grace", "deadline",
+                 "suicide_deadline")
+
+    def __init__(self, hbmap, name, grace, suicide_grace):
+        self.hbmap = hbmap
+        self.name = name
+        self.grace = grace
+        self.suicide_grace = suicide_grace
+        self.deadline = 0.0          # 0 = not currently on the clock
+        self.suicide_deadline = 0.0
+
+    def renew(self) -> None:
+        now = time.monotonic()
+        self.deadline = now + self.grace
+        self.suicide_deadline = now + self.suicide_grace \
+            if self.suicide_grace else 0.0
+
+    def clear(self) -> None:
+        """Off the clock (blocked intentionally, e.g. idle wait)."""
+        self.deadline = 0.0
+        self.suicide_deadline = 0.0
+
+    def remove(self) -> None:
+        self.hbmap.remove(self)
+
+
+class HeartbeatMap:
+    def __init__(self, name: str = "hbmap"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._handles: list[_Handle] = []
+
+    def add(self, thread_name: str, grace: float,
+            suicide_grace: float = 0.0) -> _Handle:
+        h = _Handle(self, thread_name, grace, suicide_grace)
+        h.renew()
+        with self._lock:
+            self._handles.append(h)
+        return h
+
+    def remove(self, handle: _Handle) -> None:
+        with self._lock:
+            if handle in self._handles:
+                self._handles.remove(handle)
+
+    def is_healthy(self) -> bool:
+        return not self.unhealthy_workers()
+
+    def unhealthy_workers(self) -> list[str]:
+        now = time.monotonic()
+        with self._lock:
+            return [h.name for h in self._handles
+                    if h.deadline and now > h.deadline]
+
+    def check_touch(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            return {h.name: max(0.0, h.deadline - now) if h.deadline else None
+                    for h in self._handles}

@@ -1,0 +1,104 @@
+"""Subsystem-leveled logging with a crash ring.
+
+Role of the reference's src/log/ + dout/derr (src/common/debug.h):
+every entry carries (subsystem, level); entries at or below the
+subsystem's configured level are emitted, and the most recent N entries
+of ANY level are retained in a memory ring that dump_recent() flushes on
+crash — the property that makes post-mortem debugging possible without
+verbose steady-state logging. Config observers hot-reconfigure levels
+(debug_<subsys> options).
+"""
+
+from __future__ import annotations
+
+import collections
+import sys
+import threading
+import time
+import traceback
+
+from .config import Config, ConfigObserver
+
+__all__ = ["Log", "SUBSYS"]
+
+SUBSYS = ("ec", "osd", "crush", "ms", "mon")
+
+
+class Log(ConfigObserver):
+    def __init__(self, conf: Config | None = None, sink=None):
+        self._lock = threading.Lock()
+        self.conf = conf
+        self.sink = sink  # callable(str) or None -> stderr when enabled
+        self.levels = {s: 1 for s in SUBSYS}
+        self.max_recent = 500
+        self.to_stderr = False
+        self._recent = collections.deque(maxlen=self.max_recent)
+        if conf is not None:
+            for s in SUBSYS:
+                self.levels[s] = conf.get_val("debug_" + s)
+            self.max_recent = conf.get_val("log_max_recent")
+            self.to_stderr = conf.get_val("log_to_stderr")
+            self._recent = collections.deque(maxlen=self.max_recent)
+            conf.add_observer(self)
+
+    # -- config observer ----------------------------------------------
+
+    def get_tracked_keys(self):
+        return tuple("debug_" + s for s in SUBSYS) + (
+            "log_max_recent", "log_to_stderr")
+
+    def handle_conf_change(self, conf, changed):
+        with self._lock:
+            for key in changed:
+                if key.startswith("debug_"):
+                    self.levels[key[len("debug_"):]] = conf.get_val(key)
+                elif key == "log_max_recent":
+                    self.max_recent = conf.get_val(key)
+                    self._recent = collections.deque(
+                        self._recent, maxlen=self.max_recent)
+                elif key == "log_to_stderr":
+                    self.to_stderr = conf.get_val(key)
+
+    # -- emit ----------------------------------------------------------
+
+    def dout(self, subsys: str, level: int, msg: str) -> None:
+        entry = (time.time(), subsys, level, msg)
+        with self._lock:
+            self._recent.append(entry)
+            emit = level <= self.levels.get(subsys, 0)
+        if emit:
+            self._emit(entry)
+
+    def derr(self, subsys: str, msg: str) -> None:
+        self.dout(subsys, -1, msg)  # level -1 always emits
+
+    def _emit(self, entry) -> None:
+        ts, subsys, level, msg = entry
+        line = "%.6f %s %2d : %s" % (ts, subsys, level, msg)
+        if self.sink is not None:
+            self.sink(line)
+        elif self.to_stderr:
+            print(line, file=sys.stderr)
+
+    # -- crash ring ----------------------------------------------------
+
+    def dump_recent(self, out=None) -> list[str]:
+        """Flush the ring (the on-crash dump of src/log/Log.cc)."""
+        with self._lock:
+            entries = list(self._recent)
+        lines = ["%.6f %s %2d : %s" % e for e in entries]
+        if out is not None:
+            out.write("--- begin dump of recent events ---\n")
+            for line in lines:
+                out.write(line + "\n")
+            out.write("--- end dump of recent events ---\n")
+        return lines
+
+    def dump_on_exception(self, exc: BaseException) -> list[str]:
+        lines = self.dump_recent()
+        tb = "".join(traceback.format_exception(exc))
+        if self.sink is not None:
+            self.sink(tb)
+        else:
+            sys.stderr.write(tb)
+        return lines

@@ -1,0 +1,100 @@
+"""Typed option schema.
+
+Role of the reference's src/common/options.cc: every config option is a
+schema entry with type, default, level, and description; daemons read
+through a typed get. This module carries the subset the framework uses,
+plus the machinery to declare more. Schema names follow the reference
+(erasure_code_dir: options.cc:295, osd_erasure_code_plugins: :1714,
+fault-injection options: :1250-3953).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = ["Option", "SCHEMA", "add_option"]
+
+LEVEL_BASIC = "basic"
+LEVEL_ADVANCED = "advanced"
+LEVEL_DEV = "dev"
+
+
+@dataclass(frozen=True)
+class Option:
+    name: str
+    type: type                  # str | int | float | bool
+    default: object
+    level: str = LEVEL_ADVANCED
+    description: str = ""
+
+    def cast(self, value):
+        if self.type is bool and isinstance(value, str):
+            if value.lower() in ("true", "1", "yes", "on"):
+                return True
+            if value.lower() in ("false", "0", "no", "off"):
+                return False
+            raise ValueError("invalid bool %r for %s" % (value, self.name))
+        return self.type(value)
+
+
+SCHEMA: dict[str, Option] = {}
+
+
+def add_option(name, type_, default, level=LEVEL_ADVANCED, description=""):
+    opt = Option(name, type_, default, level, description)
+    SCHEMA[name] = opt
+    return opt
+
+
+def _declare_defaults():
+    o = add_option
+    # erasure code
+    o("erasure_code_dir", str, "", LEVEL_ADVANCED,
+      "directory for erasure-code plugins (dlopen path in the reference)")
+    o("osd_erasure_code_plugins", str, "jerasure isa lrc shec jax_tpu",
+      LEVEL_ADVANCED, "plugins preloaded at daemon start")
+    o("ec_batch_max_stripes", int, 64, LEVEL_ADVANCED,
+      "max stripes coalesced into one device encode call")
+    o("ec_batch_linger_us", int, 200, LEVEL_ADVANCED,
+      "how long the batching queue waits to fill a device batch")
+    # logging
+    o("log_to_stderr", bool, False, LEVEL_BASIC)
+    o("log_max_recent", int, 500, LEVEL_ADVANCED,
+      "size of the in-memory ring dumped on crash")
+    o("debug_ec", int, 1, LEVEL_ADVANCED)
+    o("debug_osd", int, 1, LEVEL_ADVANCED)
+    o("debug_crush", int, 1, LEVEL_ADVANCED)
+    o("debug_ms", int, 0, LEVEL_ADVANCED)
+    o("debug_mon", int, 1, LEVEL_ADVANCED)
+    # osd
+    o("osd_pool_default_size", int, 3, LEVEL_BASIC)
+    o("osd_pool_default_pg_num", int, 8, LEVEL_BASIC)
+    o("osd_heartbeat_interval", float, 0.25, LEVEL_ADVANCED,
+      "seconds between peer pings (scaled down for in-process clusters)")
+    o("osd_heartbeat_grace", float, 1.0, LEVEL_ADVANCED,
+      "seconds without a reply before reporting a peer failed")
+    o("osd_max_write_size", int, 90 << 20, LEVEL_ADVANCED)
+    o("osd_client_op_priority", int, 63, LEVEL_ADVANCED)
+    o("osd_recovery_op_priority", int, 3, LEVEL_ADVANCED)
+    o("osd_op_num_shards", int, 4, LEVEL_ADVANCED,
+      "ShardedOpWQ shard count (src/osd/OSD.h:1623)")
+    # mon
+    o("mon_osd_down_out_interval", float, 2.0, LEVEL_ADVANCED,
+      "seconds after down before an osd is marked out")
+    o("mon_osd_min_down_reporters", int, 1, LEVEL_ADVANCED)
+    o("paxos_propose_interval", float, 0.05, LEVEL_ADVANCED)
+    # fault injection (dev-level, like options.cc:1250-3953)
+    o("ms_inject_socket_failures", int, 0, LEVEL_DEV,
+      "drop 1 in N messages at the messenger")
+    o("ms_inject_delay_max", float, 0.0, LEVEL_DEV,
+      "random extra delivery delay upper bound, seconds")
+    o("objectstore_inject_read_err", bool, False, LEVEL_DEV,
+      "make reads of marked objects return EIO")
+    o("osd_inject_failure_on_write", float, 0.0, LEVEL_DEV,
+      "probability a sub-write is dropped before commit")
+    # throttles
+    o("objecter_inflight_ops", int, 1024, LEVEL_ADVANCED)
+    o("osd_client_message_cap", int, 256, LEVEL_ADVANCED)
+
+
+_declare_defaults()

@@ -1,0 +1,135 @@
+"""Backpressure primitives.
+
+Role of the reference's Throttle (src/common/Throttle.{h,cc}): a counted
+budget; get() blocks while the budget is exhausted, put() releases.
+BackoffThrottle adds randomized delay shaping instead of a hard wall
+(used by BlueStore's deferred-write shaping). These guard every queue
+the daemons expose to untrusted producers (client message cap, objecter
+inflight ops).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+__all__ = ["Throttle", "BackoffThrottle", "ThrottleTimeout"]
+
+
+class ThrottleTimeout(Exception):
+    pass
+
+
+class Throttle:
+    def __init__(self, name: str, max_: int):
+        self.name = name
+        self._max = max_
+        self._current = 0
+        self._cond = threading.Condition()
+
+    # -- core ----------------------------------------------------------
+
+    def get(self, count: int = 1, timeout: float | None = None) -> None:
+        """Block until count fits within the budget (Throttle::get)."""
+        if self._max <= 0:  # unlimited, like max=0 in the reference
+            with self._cond:
+                self._current += count
+            return
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._current + count > self._max and count <= self._max:
+                remaining = None if deadline is None else \
+                    deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise ThrottleTimeout(
+                        "%s: waited %.3fs for %d/%d" %
+                        (self.name, timeout, count, self._max))
+                self._cond.wait(remaining)
+            self._current += count
+
+    def get_or_fail(self, count: int = 1) -> bool:
+        with self._cond:
+            if self._max > 0 and self._current + count > self._max \
+                    and count <= self._max:
+                return False
+            self._current += count
+            return True
+
+    def put(self, count: int = 1) -> None:
+        with self._cond:
+            self._current -= count
+            self._cond.notify_all()
+
+    # -- introspection -------------------------------------------------
+
+    def get_current(self) -> int:
+        with self._cond:
+            return self._current
+
+    def get_max(self) -> int:
+        return self._max
+
+    def past_midpoint(self) -> bool:
+        with self._cond:
+            return self._current >= self._max / 2
+
+    class _Guard:
+        __slots__ = ("t", "count")
+
+        def __init__(self, t, count):
+            self.t, self.count = t, count
+
+        def __enter__(self):
+            self.t.get(self.count)
+            return self
+
+        def __exit__(self, *exc):
+            self.t.put(self.count)
+
+    def guard(self, count: int = 1) -> "_Guard":
+        return self._Guard(self, count)
+
+
+class BackoffThrottle:
+    """Delay-shaping throttle: instead of blocking at the wall, injects
+    growing sleeps as utilization crosses low/high watermarks
+    (src/common/Throttle.h BackoffThrottle)."""
+
+    def __init__(self, name: str, max_: int,
+                 low_threshold: float = 0.5, high_threshold: float = 0.9,
+                 low_delay: float = 0.0005, high_delay: float = 0.01):
+        self.name = name
+        self._max = max_
+        self._low = low_threshold
+        self._high = high_threshold
+        self._low_delay = low_delay
+        self._high_delay = high_delay
+        self._current = 0
+        self._lock = threading.Lock()
+
+    def _delay(self, util: float) -> float:
+        if util < self._low:
+            return 0.0
+        if util < self._high:
+            frac = (util - self._low) / (self._high - self._low)
+            return self._low_delay + frac * (self._high_delay -
+                                             self._low_delay)
+        return self._high_delay
+
+    def get(self, count: int = 1) -> float:
+        with self._lock:
+            self._current += count
+            util = self._current / self._max if self._max else 0.0
+        delay = self._delay(util)
+        if delay:
+            time.sleep(delay * (0.5 + random.random()))
+        return delay
+
+    def put(self, count: int = 1) -> None:
+        with self._lock:
+            self._current -= count
+
+    def get_current(self) -> int:
+        with self._lock:
+            return self._current

@@ -1,0 +1,322 @@
+"""Common runtime: buffers, config/observers, counters, log ring,
+throttles, workqueues, heartbeat map, admin socket."""
+
+import io
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common import Context
+from ceph_tpu.common.admin_socket import AdminSocketClient
+from ceph_tpu.common.buffer import Buffer, BufferList
+from ceph_tpu.common.config import Config, ConfigObserver
+from ceph_tpu.common.heartbeat_map import HeartbeatMap
+from ceph_tpu.common.log import Log
+from ceph_tpu.common.perf_counters import PerfCountersBuilder
+from ceph_tpu.common.throttle import BackoffThrottle, Throttle, ThrottleTimeout
+from ceph_tpu.common.workqueue import (Finisher, SafeTimer, ShardedThreadPool,
+                                       ThreadPool)
+
+
+class TestBufferList:
+    def test_append_and_length(self):
+        bl = BufferList()
+        bl.append(b"hello")
+        bl.append(b" world")
+        assert len(bl) == 11
+        assert bl.get_num_buffers() == 2
+        assert bl.tobytes() == b"hello world"
+
+    def test_rebuild_aligned(self):
+        bl = BufferList(b"x" * 33)
+        bl.rebuild_aligned(32)
+        assert len(bl) == 64
+        assert bl.is_contiguous()
+        assert bl.tobytes() == b"x" * 33 + b"\0" * 31
+
+    def test_substr_splice(self):
+        bl = BufferList(b"0123456789")
+        assert bl.substr(2, 3).tobytes() == b"234"
+        mid = bl.splice(2, 3)
+        assert mid.tobytes() == b"234"
+        assert bl.tobytes() == b"0156789"
+
+    def test_contents_equal_and_crc(self):
+        a, b = BufferList(b"abc"), BufferList()
+        b.append(b"a")
+        b.append(b"bc")
+        assert a.contents_equal(b)
+        assert a.crc32c() == b.crc32c()
+        assert a.crc32c() != BufferList(b"abd").crc32c()
+
+    def test_zero_copy_view(self):
+        arr = np.arange(16, dtype=np.uint8)
+        bl = BufferList(arr)
+        assert bl.to_array() is not None
+        assert np.shares_memory(bl.to_array(), arr)
+
+    def test_file_io(self, tmp_path):
+        p = str(tmp_path / "bl")
+        BufferList(b"data").write_file(p)
+        assert BufferList.read_file(p).tobytes() == b"data"
+
+    def test_buffer_alloc_and_align(self):
+        buf = Buffer(64)
+        assert len(buf) == 64
+        assert buf.tobytes() == b"\0" * 64
+
+
+class TestConfig:
+    def test_defaults_and_set(self):
+        conf = Config()
+        assert conf.get_val("osd_pool_default_size") == 3
+        conf.set_val("osd_pool_default_size", "5")
+        assert conf.get_val("osd_pool_default_size") == 3  # staged only
+        conf.apply_changes()
+        assert conf.get_val("osd_pool_default_size") == 5
+        assert conf.osd_pool_default_size == 5  # attribute sugar
+
+    def test_unknown_key_rejected(self):
+        conf = Config()
+        with pytest.raises(KeyError):
+            conf.set_val("no_such_option", 1)
+        with pytest.raises(KeyError):
+            conf.get_val("no_such_option")
+
+    def test_bool_cast(self):
+        conf = Config({"log_to_stderr": "true"})
+        assert conf.get_val("log_to_stderr") is True
+        with pytest.raises(ValueError):
+            conf.set_val("log_to_stderr", "maybe")
+
+    def test_observer(self):
+        conf = Config()
+        seen = []
+
+        class Obs(ConfigObserver):
+            def get_tracked_keys(self):
+                return ("debug_osd",)
+
+            def handle_conf_change(self, c, changed):
+                seen.append((sorted(changed), c.get_val("debug_osd")))
+
+        conf.add_observer(Obs())
+        conf.set_val("debug_osd", 20)
+        conf.set_val("debug_mon", 20)  # not tracked
+        conf.apply_changes()
+        assert seen == [(["debug_osd"], 20)]
+        conf.set_val("debug_osd", 20)  # unchanged -> no callback
+        conf.apply_changes()
+        assert len(seen) == 1
+
+    def test_diff(self):
+        conf = Config({"debug_ec": 10})
+        assert conf.diff() == {"debug_ec": 10}
+
+
+class TestPerfCounters:
+    def test_counter_kinds(self):
+        pc = (PerfCountersBuilder("osd")
+              .add_u64_counter("ops")
+              .add_time_avg("op_latency")
+              .add_histogram("op_size")
+              .create_perf_counters())
+        pc.inc("ops", 3)
+        pc.tinc("op_latency", 0.5)
+        pc.tinc("op_latency", 1.5)
+        pc.hinc("op_size", 4096)
+        d = pc.dump()
+        assert d["ops"] == 3
+        assert d["op_latency"] == {"avgcount": 2, "sum": 2.0}
+        assert pc.avg("op_latency") == 1.0
+        assert d["op_size"]["count"] == 1
+
+    def test_time_context(self):
+        pc = (PerfCountersBuilder("x").add_time_avg("lat")
+              .create_perf_counters())
+        with pc.time("lat"):
+            time.sleep(0.01)
+        assert pc.avg("lat") >= 0.01
+
+    def test_collection(self):
+        ctx = Context(name="t")
+        pc = PerfCountersBuilder("sub").add_u64("v").create_perf_counters()
+        ctx.perf.add(pc)
+        pc.set("v", 42)
+        assert ctx.perf.perf_dump() == {"sub": {"v": 42}}
+
+
+class TestLog:
+    def test_level_filtering_and_ring(self):
+        lines = []
+        conf = Config({"debug_osd": 5})
+        log = Log(conf, sink=lines.append)
+        log.dout("osd", 1, "emitted")
+        log.dout("osd", 10, "suppressed")
+        log.derr("osd", "error")
+        assert len(lines) == 2
+        # ring kept everything, including the suppressed entry
+        recent = log.dump_recent()
+        assert len(recent) == 3
+        assert any("suppressed" in line for line in recent)
+
+    def test_hot_reconfigure(self):
+        lines = []
+        conf = Config()
+        log = Log(conf, sink=lines.append)
+        log.dout("ms", 5, "hidden")  # debug_ms defaults to 0
+        conf.set_val("debug_ms", 10)
+        conf.apply_changes()
+        log.dout("ms", 5, "visible")
+        assert [ln for ln in lines if "hidden" in ln] == []
+        assert any("visible" in ln for ln in lines)
+
+    def test_crash_dump_format(self):
+        log = Log()
+        log.dout("ec", 0, "hello")
+        out = io.StringIO()
+        log.dump_recent(out)
+        text = out.getvalue()
+        assert "begin dump of recent events" in text
+        assert "hello" in text
+
+
+class TestThrottle:
+    def test_blocking_get(self):
+        t = Throttle("t", 2)
+        t.get(2)
+        released = []
+
+        def releaser():
+            time.sleep(0.05)
+            released.append(True)
+            t.put(2)
+
+        threading.Thread(target=releaser).start()
+        t0 = time.monotonic()
+        t.get(1)  # must block until put
+        assert released and time.monotonic() - t0 >= 0.04
+        assert t.get_current() == 1
+
+    def test_timeout(self):
+        t = Throttle("t", 1)
+        t.get(1)
+        with pytest.raises(ThrottleTimeout):
+            t.get(1, timeout=0.05)
+
+    def test_get_or_fail_and_guard(self):
+        t = Throttle("t", 1)
+        with t.guard(1):
+            assert not t.get_or_fail(1)
+        assert t.get_or_fail(1)
+        t.put(1)
+
+    def test_oversized_request_admitted(self):
+        # count > max must not deadlock (reference admits it)
+        t = Throttle("t", 2)
+        t.get(5)
+        assert t.get_current() == 5
+        t.put(5)
+
+    def test_backoff_delays(self):
+        bt = BackoffThrottle("b", 10, low_threshold=0.5,
+                             high_threshold=0.9)
+        assert bt.get(1) == 0.0       # 10% utilization: below the ramp
+        assert bt._delay(0.7) > bt._delay(0.6) > 0.0  # ramp grows
+        assert bt._delay(0.95) == bt._high_delay
+
+
+class TestWorkQueues:
+    def test_threadpool_runs_work(self):
+        tp = ThreadPool("tp", 2)
+        tp.start()
+        done = []
+        for i in range(10):
+            tp.queue(done.append, i)
+        tp.drain()
+        time.sleep(0.05)
+        tp.stop()
+        assert sorted(done) == list(range(10))
+
+    def test_sharded_ordering(self):
+        stp = ShardedThreadPool("s", 4)
+        stp.start()
+        order = {k: [] for k in range(8)}
+        for i in range(50):
+            for k in range(8):
+                stp.queue(k, order[k].append, i)
+        stp.drain()
+        time.sleep(0.1)
+        stp.stop()
+        for k in range(8):  # per-key FIFO preserved
+            assert order[k] == list(range(50))
+
+    def test_finisher(self):
+        f = Finisher()
+        f.start()
+        hits = []
+        f.queue(hits.append, 1)
+        f.wait_for_empty()
+        time.sleep(0.02)
+        f.stop()
+        assert hits == [1]
+
+    def test_safe_timer(self):
+        timer = SafeTimer()
+        timer.init()
+        hits = []
+        timer.add_event_after(0.02, hits.append, "a")
+        tok = timer.add_event_after(0.04, hits.append, "b")
+        timer.cancel_event(tok)
+        time.sleep(0.1)
+        timer.shutdown()
+        assert hits == ["a"]
+
+
+class TestHeartbeatMap:
+    def test_healthy_then_expired(self):
+        hb = HeartbeatMap()
+        h = hb.add("worker", grace=0.05)
+        assert hb.is_healthy()
+        time.sleep(0.08)
+        assert hb.unhealthy_workers() == ["worker"]
+        h.renew()
+        assert hb.is_healthy()
+        h.clear()  # intentionally off the clock
+        time.sleep(0.06)
+        assert hb.is_healthy()
+        h.remove()
+
+
+class TestAdminSocket:
+    def test_roundtrip(self, tmp_path):
+        ctx = Context(name="asok-test")
+        path = str(tmp_path / "d.asok")
+        ctx.init_admin_socket(path)
+        try:
+            client = AdminSocketClient(path)
+            ver = client.do_request("version")
+            assert ver == {"version": "1.0.0"}
+            client.do_request("config set", key="debug_osd", value=7)
+            got = client.do_request("config get", key="debug_osd")
+            assert got == {"debug_osd": 7}
+            assert "perf dump" in client.do_request("help")
+            health = client.do_request("health")
+            assert health["healthy"] is True
+            bad = client.do_request("nope")
+            assert "error" in bad
+        finally:
+            ctx.shutdown()
+
+    def test_broken_hook_contained(self, tmp_path):
+        ctx = Context(name="asok-test2")
+        path = str(tmp_path / "d2.asok")
+        sock = ctx.init_admin_socket(path)
+        sock.register("boom", lambda args: 1 / 0)
+        try:
+            reply = AdminSocketClient(path).do_request("boom")
+            assert "ZeroDivisionError" in reply["error"]
+        finally:
+            ctx.shutdown()
