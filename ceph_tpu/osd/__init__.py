@@ -1,0 +1,17 @@
+"""OSD data-plane: stripe math, write planning, caching, backends.
+
+The framework's rendition of src/osd/'s erasure-coded IO path
+(SURVEY.md §2.2), re-shaped TPU-first: where the reference encodes one
+stripe per call inside ECUtil::encode's loop (src/osd/ECUtil.cc:116),
+this layer reshapes whole objects (and, in the batching queue, many
+objects) into one device call.
+
+  ec_util         stripe_info_t arithmetic, batched encode/decode seam,
+                  HashInfo integrity hashes
+  ec_transaction  WritePlan: logical writes -> stripe-aligned read/write
+                  sets (RMW planning)
+  extent_cache    pinned extents for pipelined RMW overwrites
+  pg_transaction  logical object operations (PGTransaction)
+  ec_backend      the two-phase write/read/recovery pipeline
+  replicated_backend  the replication strategy peer
+"""

@@ -1,0 +1,133 @@
+"""Pinned-extent cache for pipelined RMW overwrites.
+
+Role of the reference's ExtentCache (src/osd/ExtentCache.{h,cc}): when
+write A must read-modify-write a stripe and write B to the same stripe
+is right behind it, B must see A's post-image without waiting for A to
+commit to disk. Each in-flight write pins the extents it reads/writes;
+reads check the cache first and only fetch the holes remotely; on
+write-apply the new bytes land in the cache; a pin releases on commit
+and fully-released extents are dropped.
+
+API shape follows the reference: open_write_pin / reserve_extents_for_rmw
+-> must_read holes; get_remaining_extents_for_rmw after the readback;
+present_rmw_update with the written bytes; release_write_pin on commit.
+"""
+
+from __future__ import annotations
+
+from ..common.interval_set import ExtentMap, IntervalSet
+
+__all__ = ["ExtentCache", "WritePin"]
+
+
+class WritePin:
+    def __init__(self, tid):
+        self.tid = tid
+        self.pinned: dict = {}  # oid -> IntervalSet
+
+
+class _ObjectState:
+    def __init__(self):
+        self.cache = ExtentMap()
+        self.pin_counts: dict = {}  # (start,len) granular counting via sets
+
+    def empty(self) -> bool:
+        return not self.pin_counts
+
+
+class ExtentCache:
+    def __init__(self):
+        self._objects: dict = {}
+
+    def open_write_pin(self, tid) -> WritePin:
+        return WritePin(tid)
+
+    # -- reserve -------------------------------------------------------
+
+    def reserve_extents_for_rmw(self, oid, pin: WritePin,
+                                to_read: IntervalSet,
+                                will_write: IntervalSet) -> IntervalSet:
+        """Pin to_read+will_write; return the subset of to_read NOT in
+        the cache (must be fetched from shards)."""
+        state = self._objects.setdefault(oid, _ObjectState())
+        pinned = pin.pinned.setdefault(oid, IntervalSet())
+        pinned.union_of(to_read)
+        pinned.union_of(will_write)
+        for off, length in pinned:
+            key = (off, length)
+            state.pin_counts[key] = state.pin_counts.get(key, 0) + 1
+
+        must_read = IntervalSet()
+        cached = state.cache.intervals()
+        for off, length in to_read:
+            seg = IntervalSet([(off, length)])
+            hit = seg.intersect(cached)
+            for s, e_len in hit:
+                seg.erase(s, e_len)
+            must_read.union_of(seg)
+        return must_read
+
+    # -- fill ----------------------------------------------------------
+
+    def present_read(self, oid, offset: int, data) -> None:
+        """Insert readback bytes fetched for an RMW."""
+        state = self._objects.setdefault(oid, _ObjectState())
+        state.cache.insert(offset, data)
+
+    def get_remaining_extents_for_rmw(self, oid,
+                                      to_read: IntervalSet) -> ExtentMap:
+        """Return the cached bytes covering to_read (post-readback)."""
+        state = self._objects.get(oid)
+        out = ExtentMap()
+        if state is None:
+            return out
+        for off, length in to_read:
+            got = state.cache.get(off, length)
+            if got is not None:
+                out.insert(off, got)
+            else:
+                for s, d in state.cache:
+                    lo, hi = max(s, off), min(s + d.size, off + length)
+                    if lo < hi:
+                        out.insert(lo, d[lo - s:hi - s])
+        return out
+
+    def present_rmw_update(self, oid, written: ExtentMap) -> None:
+        """Write-apply: the op's post-image becomes visible to later
+        pipelined ops immediately (before commit)."""
+        state = self._objects.setdefault(oid, _ObjectState())
+        for off, data in written:
+            state.cache.insert(off, data)
+
+    # -- release -------------------------------------------------------
+
+    def release_write_pin(self, pin: WritePin) -> None:
+        for oid, pinned in pin.pinned.items():
+            state = self._objects.get(oid)
+            if state is None:
+                continue
+            for off, length in pinned:
+                key = (off, length)
+                count = state.pin_counts.get(key, 0) - 1
+                if count <= 0:
+                    state.pin_counts.pop(key, None)
+                    # drop bytes no longer pinned by anyone
+                    still = IntervalSet()
+                    for (o2, l2) in state.pin_counts:
+                        still.union_insert(o2, l2)
+                    if not still.intersects(off, length):
+                        state.cache.erase(off, length)
+                else:
+                    state.pin_counts[key] = count
+            if state.empty():
+                self._objects.pop(oid, None)
+        pin.pinned = {}
+
+    # -- introspection -------------------------------------------------
+
+    def contains_object(self, oid) -> bool:
+        return oid in self._objects
+
+    def dump(self) -> dict:
+        return {str(oid): [(s, d.size) for s, d in state.cache]
+                for oid, state in self._objects.items()}

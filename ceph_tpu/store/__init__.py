@@ -1,0 +1,14 @@
+"""Local object store: the framework's src/os/ layer.
+
+  object_store  ObjectStore interface + Transaction op list
+                (src/os/ObjectStore.h:68, Transaction :1457)
+  mem_store     MemStore in-memory backend (src/os/memstore/MemStore.cc)
+                — the test/fake backend of the reference, and the
+                default store of the in-process cluster harness
+  kv            KeyValueDB interface + MemDB (src/kv/)
+"""
+
+from .object_store import ObjectStore, Transaction
+from .mem_store import MemStore
+
+__all__ = ["ObjectStore", "Transaction", "MemStore"]

@@ -1,0 +1,85 @@
+"""KeyValueDB interface + MemDB.
+
+Role of the reference's src/kv/ (KeyValueDB.h over RocksDB/LevelDB/
+MemDB): ordered string-keyed store with prefixed namespaces and atomic
+write batches — used by the monitor's MonitorDBStore and BlueStore's
+metadata. MemDB is the in-memory backend (reference src/kv/MemDB.cc).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+__all__ = ["KeyValueDB", "MemDB"]
+
+
+class _Batch:
+    def __init__(self):
+        self.ops: list[tuple] = []
+
+    def set(self, prefix: str, key: str, value: bytes) -> None:
+        self.ops.append(("set", prefix, key, bytes(value)))
+
+    def rmkey(self, prefix: str, key: str) -> None:
+        self.ops.append(("rm", prefix, key))
+
+    def rmkeys_by_prefix(self, prefix: str) -> None:
+        self.ops.append(("rm_prefix", prefix))
+
+
+class KeyValueDB:
+    def get_transaction(self) -> _Batch:
+        return _Batch()
+
+    def submit_transaction(self, batch: _Batch) -> None:
+        raise NotImplementedError
+
+    # sync == async for the in-memory db; kept for API parity
+    def submit_transaction_sync(self, batch: _Batch) -> None:
+        self.submit_transaction(batch)
+
+    def get(self, prefix: str, key: str) -> bytes | None:
+        raise NotImplementedError
+
+
+class MemDB(KeyValueDB):
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._data: dict[str, dict[str, bytes]] = {}
+        self._keys: dict[str, list[str]] = {}  # sorted key index
+
+    def submit_transaction(self, batch: _Batch) -> None:
+        with self._lock:
+            for op in batch.ops:
+                if op[0] == "set":
+                    _, prefix, key, value = op
+                    ns = self._data.setdefault(prefix, {})
+                    if key not in ns:
+                        bisect.insort(self._keys.setdefault(prefix, []), key)
+                    ns[key] = value
+                elif op[0] == "rm":
+                    _, prefix, key = op
+                    if self._data.get(prefix, {}).pop(key, None) is not None:
+                        self._keys[prefix].remove(key)
+                elif op[0] == "rm_prefix":
+                    self._data.pop(op[1], None)
+                    self._keys.pop(op[1], None)
+
+    def get(self, prefix: str, key: str) -> bytes | None:
+        with self._lock:
+            return self._data.get(prefix, {}).get(key)
+
+    def get_iterator(self, prefix: str):
+        """Ordered (key, value) pairs within a prefix."""
+        with self._lock:
+            keys = list(self._keys.get(prefix, []))
+            ns = self._data.get(prefix, {})
+            return [(k, ns[k]) for k in keys]
+
+    def lower_bound(self, prefix: str, key: str):
+        with self._lock:
+            keys = self._keys.get(prefix, [])
+            i = bisect.bisect_left(keys, key)
+            ns = self._data.get(prefix, {})
+            return [(k, ns[k]) for k in keys[i:]]

@@ -1,0 +1,183 @@
+"""In-memory ObjectStore.
+
+Role of the reference's MemStore (src/os/memstore/MemStore.cc): the
+store used when durability is mocked — unit tests, the in-process
+cluster harness, fault-injection runs. Transactions apply atomically
+under one lock; completions run inline or via a Finisher when provided
+(the reference queues them on the OSD's finishers so callbacks never run
+in the IO path's lock scope).
+
+Supports EIO injection on marked objects
+(objectstore_inject_read_err analog: mark via inject_read_error)."""
+
+from __future__ import annotations
+
+import threading
+
+from .object_store import Collection, ObjectStore, Transaction
+
+__all__ = ["MemStore"]
+
+
+class _Object:
+    __slots__ = ("data", "xattrs", "omap")
+
+    def __init__(self):
+        self.data = bytearray()
+        self.xattrs: dict = {}
+        self.omap: dict = {}
+
+    def clone(self) -> "_Object":
+        o = _Object()
+        o.data = bytearray(self.data)
+        o.xattrs = dict(self.xattrs)
+        o.omap = dict(self.omap)
+        return o
+
+
+class MemStore(ObjectStore):
+    def __init__(self, finisher=None):
+        self._lock = threading.RLock()
+        self._colls: dict = {}
+        self._finisher = finisher
+        self._read_errors: set = set()
+        self.mounted = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def mount(self) -> None:
+        self.mounted = True
+
+    def umount(self) -> None:
+        self.mounted = False
+
+    # -- fault injection ----------------------------------------------
+
+    def inject_read_error(self, cid, oid) -> None:
+        with self._lock:
+            self._read_errors.add((cid, oid))
+
+    def clear_read_error(self, cid, oid) -> None:
+        with self._lock:
+            self._read_errors.discard((cid, oid))
+
+    # -- mutation ------------------------------------------------------
+
+    def queue_transaction(self, txn: Transaction) -> None:
+        with self._lock:
+            for op in txn.ops:
+                self._apply(op)
+        for cb in txn.on_applied:
+            self._complete(cb)
+        for cb in txn.on_commit:
+            self._complete(cb)
+
+    def _complete(self, cb) -> None:
+        if self._finisher is not None:
+            self._finisher.queue(cb)
+        else:
+            cb()
+
+    def _coll(self, cid) -> Collection:
+        coll = self._colls.get(cid)
+        if coll is None:
+            raise KeyError("no collection %r" % (cid,))
+        return coll
+
+    def _obj(self, cid, oid, create: bool = False) -> _Object:
+        coll = self._coll(cid)
+        obj = coll.objects.get(oid)
+        if obj is None:
+            if not create:
+                raise KeyError("no object %r in %r" % (oid, cid))
+            obj = coll.objects[oid] = _Object()
+        return obj
+
+    def _apply(self, op: tuple) -> None:
+        kind = op[0]
+        if kind == "create_collection":
+            self._colls.setdefault(op[1], Collection(op[1]))
+        elif kind == "remove_collection":
+            self._colls.pop(op[1], None)
+        elif kind == "touch":
+            self._obj(op[1], op[2], create=True)
+        elif kind == "write":
+            _, cid, oid, offset, data = op
+            obj = self._obj(cid, oid, create=True)
+            end = offset + len(data)
+            if len(obj.data) < end:
+                obj.data.extend(b"\0" * (end - len(obj.data)))
+            obj.data[offset:end] = data
+        elif kind == "zero":
+            _, cid, oid, offset, length = op
+            obj = self._obj(cid, oid, create=True)
+            end = offset + length
+            if len(obj.data) < end:
+                obj.data.extend(b"\0" * (end - len(obj.data)))
+            obj.data[offset:end] = b"\0" * length
+        elif kind == "truncate":
+            _, cid, oid, size = op
+            obj = self._obj(cid, oid, create=True)
+            if len(obj.data) > size:
+                del obj.data[size:]
+            else:
+                obj.data.extend(b"\0" * (size - len(obj.data)))
+        elif kind == "remove":
+            self._coll(op[1]).objects.pop(op[2], None)
+        elif kind == "clone":
+            _, cid, src, dst = op
+            self._coll(cid).objects[dst] = self._obj(cid, src).clone()
+        elif kind == "move_rename":
+            _, src_cid, src_oid, dst_cid, dst_oid = op
+            obj = self._coll(src_cid).objects.pop(src_oid)
+            self._coll(dst_cid).objects[dst_oid] = obj
+        elif kind == "setattr":
+            _, cid, oid, name, value = op
+            self._obj(cid, oid, create=True).xattrs[name] = value
+        elif kind == "rmattr":
+            self._obj(op[1], op[2]).xattrs.pop(op[3], None)
+        elif kind == "omap_setkeys":
+            self._obj(op[1], op[2], create=True).omap.update(op[3])
+        elif kind == "omap_rmkeys":
+            omap = self._obj(op[1], op[2]).omap
+            for key in op[3]:
+                omap.pop(key, None)
+        else:
+            raise ValueError("unknown op %r" % kind)
+
+    # -- reads ---------------------------------------------------------
+
+    def read(self, cid, oid, offset: int = 0, length: int = 0) -> bytes:
+        with self._lock:
+            if (cid, oid) in self._read_errors:
+                raise OSError(5, "injected EIO on %r/%r" % (cid, oid))
+            obj = self._obj(cid, oid)
+            if length == 0:
+                length = len(obj.data) - offset
+            return bytes(obj.data[offset:offset + length])
+
+    def stat(self, cid, oid) -> dict | None:
+        with self._lock:
+            coll = self._colls.get(cid)
+            obj = coll.objects.get(oid) if coll else None
+            return {"size": len(obj.data)} if obj is not None else None
+
+    def exists(self, cid, oid) -> bool:
+        return self.stat(cid, oid) is not None
+
+    def getattr(self, cid, oid, name: str):
+        with self._lock:
+            return self._obj(cid, oid).xattrs.get(name)
+
+    def omap_get(self, cid, oid) -> dict:
+        with self._lock:
+            return dict(self._obj(cid, oid).omap)
+
+    def list_objects(self, cid) -> list:
+        with self._lock:
+            coll = self._colls.get(cid)
+            return sorted(coll.objects) if coll else []
+
+    def list_collections(self) -> list:
+        with self._lock:
+            return sorted(self._colls)
