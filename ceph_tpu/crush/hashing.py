@@ -16,7 +16,10 @@ CRUSH_HASH_RJENKINS1 = 0
 
 
 def _mix(a, b, c):
-    """One Jenkins mix round; a, b, c are uint32 arrays (any backend)."""
+    """One Jenkins mix round; a, b, c are uint32 arrays (any backend).
+
+    uint32 wraparound is the whole point; numpy 2 warns on scalar
+    overflow, so callers run under errstate(over="ignore")."""
     a = a - b; a = a - c; a = a ^ (c >> 13)      # noqa: E702
     b = b - c; b = b - a; b = b ^ (a << 8)       # noqa: E702
     c = c - a; c = c - b; c = c ^ (b >> 13)      # noqa: E702
@@ -33,6 +36,15 @@ def _u32(x, xp):
     return xp.asarray(x).astype(xp.uint32)
 
 
+def _quiet(fn):
+    """Silence numpy's intended-uint32-wraparound overflow warnings."""
+    def wrapped(*args, **kwargs):
+        with np.errstate(over="ignore"):
+            return fn(*args, **kwargs)
+    return wrapped
+
+
+@_quiet
 def hash32_2(a, b, xp=np):
     a = _u32(a, xp); b = _u32(b, xp)             # noqa: E702
     x = xp.uint32(231232)
@@ -44,6 +56,7 @@ def hash32_2(a, b, xp=np):
     return h
 
 
+@_quiet
 def hash32_3(a, b, c, xp=np):
     a = _u32(a, xp); b = _u32(b, xp); c = _u32(c, xp)   # noqa: E702
     x = xp.uint32(231232)
@@ -57,6 +70,7 @@ def hash32_3(a, b, c, xp=np):
     return h
 
 
+@_quiet
 def hash32_4(a, b, c, d, xp=np):
     a = _u32(a, xp); b = _u32(b, xp)             # noqa: E702
     c = _u32(c, xp); d = _u32(d, xp)             # noqa: E702

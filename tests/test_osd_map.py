@@ -1,0 +1,278 @@
+"""OSDMap: placement pipeline, overrides, incrementals, bulk mapping.
+
+Models the mapping assertions of src/test/osd/TestOSDMap.cc (upmap,
+pg_temp, primary affinity) and the OSDMapMapping parity checks."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.map import (CRUSH_ITEM_NONE, CrushMap,
+                                POOL_TYPE_ERASURE, POOL_TYPE_REPLICATED)
+from ceph_tpu.osd.osd_map import (Incremental, OSDMap, OSDMapMapping, PGID,
+                                  PGPool, stable_mod, str_hash_rjenkins)
+
+
+def build_map(num_hosts=4, osds_per_host=2, pool_type=POOL_TYPE_REPLICATED,
+              size=3, pg_num=32):
+    """num_hosts hosts x osds_per_host devices, one rule over hosts."""
+    m = OSDMap()
+    crush = CrushMap()
+    crush.type_names = {"osd": 0, "host": 1, "root": 10}
+    host_ids = []
+    n = num_hosts * osds_per_host
+    for h in range(num_hosts):
+        devs = list(range(h * osds_per_host, (h + 1) * osds_per_host))
+        hid = crush.add_bucket("straw2", 1, devs, [0x10000] * len(devs),
+                               name="host%d" % h)
+        host_ids.append(hid)
+    crush.add_bucket("straw2", 10, host_ids, [0x10000 * osds_per_host] *
+                     num_hosts, name="default")
+    mode = "firstn" if pool_type == POOL_TYPE_REPLICATED else "indep"
+    crush.add_simple_rule("data", "default", failure_domain="host",
+                          mode=mode, rule_type=pool_type)
+    inc = Incremental(1)
+    inc.new_max_osd = n
+    inc.new_crush = crush
+    inc.new_pools[1] = PGPool(pool_id=1, name="p", type=pool_type,
+                              size=size, pg_num=pg_num, crush_rule=0)
+    for osd in range(n):
+        inc.new_up[osd] = ("127.0.0.1", 7000 + osd)
+        inc.new_weight[osd] = 0x10000
+    m.apply_incremental(inc)
+    return m
+
+
+class TestHashAndMod:
+    def test_stable_mod(self):
+        # growing pg_num splits buckets without moving everything
+        assert stable_mod(5, 8, 15) == 5
+        assert stable_mod(13, 8, 15) == 5   # 13&15=13 >= 8 -> 13&7=5
+        assert stable_mod(11, 12, 15) == 11  # 11 < 12: keeps its bucket
+
+    def test_known_rjenkins_vectors(self):
+        # pinned vector (verified against the compiled reference)
+        assert str_hash_rjenkins(b"") == 3175731469
+        assert str_hash_rjenkins("foo") == str_hash_rjenkins(b"foo")
+        assert str_hash_rjenkins("foo") != str_hash_rjenkins("bar")
+
+    def test_rjenkins_differential(self):
+        """Bit-exact vs the reference C, compiled as an oracle."""
+        import ctypes
+        import random
+        import subprocess
+        import tempfile
+
+        src = "/root/reference/src/common/ceph_hash.cc"
+        try:
+            tmp = tempfile.mkdtemp(prefix="hash_oracle_")
+            so = tmp + "/libh.so"
+            # the file only needs __u32; provide include/types.h shim
+            inc = tmp + "/include"
+            import os
+            os.makedirs(inc)
+            with open(inc + "/types.h", "w") as f:
+                f.write("typedef unsigned int __u32;\n"
+                        "#define CEPH_STR_HASH_LINUX 0x1\n"
+                        "#define CEPH_STR_HASH_RJENKINS 0x2\n")
+            subprocess.run(["g++", "-O2", "-fPIC", "-shared", "-I", tmp,
+                            "-o", so, src], check=True,
+                           capture_output=True, timeout=120)
+            lib = ctypes.CDLL(so)
+        except Exception:
+            pytest.skip("reference hash oracle unavailable")
+        fn = None
+        for sym in ("ceph_str_hash_rjenkins",        # extern "C" linkage
+                    "_Z22ceph_str_hash_rjenkinsPKcj"):  # C++ mangled
+            try:
+                fn = getattr(lib, sym)
+                break
+            except AttributeError:
+                continue
+        if fn is None:
+            pytest.skip("symbol not found")
+        fn.restype = ctypes.c_uint
+        rng = random.Random(7)
+        for _ in range(500):
+            n = rng.randrange(0, 64)
+            s = bytes(rng.randrange(256) for _ in range(n))
+            assert fn(s, n) == str_hash_rjenkins(s)
+
+
+class TestPlacementPipeline:
+    def test_replicated_mapping_basics(self):
+        m = build_map()
+        for ps in range(32):
+            up, upp, acting, actp = m.pg_to_up_acting_osds(PGID(1, ps))
+            assert len(up) == 3
+            assert len(set(up)) == 3
+            assert upp == up[0]
+            assert acting == up and actp == upp
+            # failure domain: one osd per host
+            hosts = {o // 2 for o in up}
+            assert len(hosts) == 3
+
+    def test_ec_holes_preserved(self):
+        m = build_map(pool_type=POOL_TYPE_ERASURE, size=3)
+        # kill one osd: EC mapping keeps a positional hole
+        inc = Incremental(2)
+        inc.new_down = [0]
+        m.apply_incremental(inc)
+        saw_hole = False
+        for ps in range(32):
+            up, upp, acting, actp = m.pg_to_up_acting_osds(PGID(1, ps))
+            assert len(up) == 3
+            for i, o in enumerate(up):
+                if o == CRUSH_ITEM_NONE:
+                    saw_hole = True
+                else:
+                    assert o != 0
+        assert saw_hole
+
+    def test_replicated_shifts_down_osds(self):
+        m = build_map()
+        inc = Incremental(2)
+        inc.new_down = [0, 1]  # whole host down
+        m.apply_incremental(inc)
+        for ps in range(32):
+            up, _, _, _ = m.pg_to_up_acting_osds(PGID(1, ps))
+            assert CRUSH_ITEM_NONE not in up
+            assert 0 not in up and 1 not in up
+
+    def test_out_osd_remapped(self):
+        m = build_map()
+        before = {ps: m.pg_to_up_acting_osds(PGID(1, ps))[0]
+                  for ps in range(32)}
+        inc = Incremental(2)
+        inc.new_weight[3] = 0  # mark out: CRUSH reweights around it
+        m.apply_incremental(inc)
+        for ps in range(32):
+            up, _, _, _ = m.pg_to_up_acting_osds(PGID(1, ps))
+            assert 3 not in up
+            assert len(up) == 3
+        assert any(3 in osds for osds in before.values())
+
+    def test_pg_temp_overlay(self):
+        m = build_map()
+        pgid = PGID(1, 0)
+        up, upp, _, _ = m.pg_to_up_acting_osds(pgid)
+        temp = [o for o in range(8) if o not in up][:3]
+        inc = Incremental(2)
+        inc.new_pg_temp[pgid] = temp
+        m.apply_incremental(inc)
+        up2, upp2, acting, actp = m.pg_to_up_acting_osds(pgid)
+        assert up2 == up          # up unchanged
+        assert acting == temp     # acting overridden
+        assert actp == temp[0]
+        # clearing restores
+        inc2 = Incremental(3)
+        inc2.new_pg_temp[pgid] = []
+        m.apply_incremental(inc2)
+        _, _, acting3, _ = m.pg_to_up_acting_osds(pgid)
+        assert acting3 == up
+
+    def test_primary_temp(self):
+        m = build_map()
+        pgid = PGID(1, 5)
+        up, upp, _, _ = m.pg_to_up_acting_osds(pgid)
+        inc = Incremental(2)
+        inc.new_primary_temp[pgid] = up[1]
+        m.apply_incremental(inc)
+        _, _, acting, actp = m.pg_to_up_acting_osds(pgid)
+        assert actp == up[1]
+        assert acting == up
+
+    def test_pg_upmap(self):
+        m = build_map()
+        pgid = PGID(1, 3)
+        up, _, _, _ = m.pg_to_up_acting_osds(pgid)
+        target = [o for o in range(8) if o not in up][:3]
+        inc = Incremental(2)
+        inc.new_pg_upmap[pgid] = target
+        m.apply_incremental(inc)
+        up2, _, _, _ = m.pg_to_up_acting_osds(pgid)
+        assert up2 == target
+
+    def test_pg_upmap_items(self):
+        m = build_map()
+        pgid = PGID(1, 7)
+        up, _, _, _ = m.pg_to_up_acting_osds(pgid)
+        spare = [o for o in range(8) if o not in up][0]
+        inc = Incremental(2)
+        inc.new_pg_upmap_items[pgid] = [(up[1], spare)]
+        m.apply_incremental(inc)
+        up2, _, _, _ = m.pg_to_up_acting_osds(pgid)
+        assert up2[1] == spare
+        assert up2[0] == up[0] and up2[2] == up[2]
+
+    def test_upmap_to_out_osd_rejected(self):
+        m = build_map()
+        pgid = PGID(1, 2)
+        up, _, _, _ = m.pg_to_up_acting_osds(pgid)
+        spare = [o for o in range(8) if o not in up][0]
+        inc = Incremental(2)
+        inc.new_weight[spare] = 0  # out
+        inc.new_pg_upmap[pgid] = [spare] + up[1:]
+        m.apply_incremental(inc)
+        up2, _, _, _ = m.pg_to_up_acting_osds(pgid)
+        assert spare not in up2  # explicit mapping ignored
+
+    def test_primary_affinity_zero_never_primary(self):
+        m = build_map()
+        inc = Incremental(2)
+        inc.new_primary_affinity[0] = 0
+        inc.new_primary_affinity[1] = 0
+        m.apply_incremental(inc)
+        for ps in range(32):
+            up, upp, _, actp = m.pg_to_up_acting_osds(PGID(1, ps))
+            if set(up) - {0, 1}:
+                assert upp not in (0, 1)
+
+    def test_unknown_pool_and_ps(self):
+        m = build_map(pg_num=8)
+        assert m.pg_to_up_acting_osds(PGID(9, 0)) == ([], -1, [], -1)
+        assert m.pg_to_up_acting_osds(PGID(1, 8)) == ([], -1, [], -1)
+
+
+class TestOSDMapMapping:
+    @pytest.mark.parametrize("pool_type", [POOL_TYPE_REPLICATED,
+                                           POOL_TYPE_ERASURE])
+    def test_bulk_equals_scalar(self, pool_type):
+        m = build_map(pool_type=pool_type, pg_num=64)
+        # make it interesting: one down osd, one out, a pg_temp, an upmap
+        inc = Incremental(2)
+        inc.new_down = [2]
+        inc.new_weight[5] = 0
+        inc.new_pg_temp[PGID(1, 1)] = [6, 7, 4]
+        inc.new_pg_upmap_items[PGID(1, 9)] = [(0, 6)]
+        m.apply_incremental(inc)
+
+        batched = OSDMapMapping()
+        batched.update(m, batched=True)
+        scalar = OSDMapMapping()
+        scalar.update(m, batched=False)
+        assert batched.by_pg == scalar.by_pg
+        assert batched.epoch == m.epoch
+
+    def test_by_osd_index(self):
+        m = build_map(pg_num=64)
+        mapping = OSDMapMapping()
+        mapping.update(m)
+        total = sum(len(v) for v in mapping.by_osd.values())
+        assert total == 64 * 3
+        # each osd appears only in pgs that actually map to it
+        for osd, pgs in mapping.by_osd.items():
+            for pgid in pgs:
+                assert osd in mapping.get(pgid)[2]
+
+
+class TestObjectToPG:
+    def test_distribution(self):
+        m = build_map(pg_num=16)
+        pool = m.pools[1]
+        counts = [0] * 16
+        for i in range(2000):
+            raw = m.object_to_pg(1, "obj-%d" % i)
+            pg = pool.raw_pg_to_pg(raw)
+            counts[pg.ps] += 1
+        assert min(counts) > 0
+        assert max(counts) < 2000 / 16 * 2.5
