@@ -1,0 +1,17 @@
+"""Wire layer: typed messages + pluggable transport.
+
+The framework's rendition of src/msg/ + src/messages/ (SURVEY.md §2.5):
+a Messenger owns connections and a dispatcher chain; daemons hold
+several messengers for separate traffic classes (public, cluster,
+heartbeat — the reference's ceph-osd creates 7, src/ceph_osd.cc:461-483).
+
+  message    typed Message classes (the src/messages/*.h catalog subset)
+  messenger  threaded TCP transport with per-connection ordered delivery,
+             reconnect for lossless policies, and message-drop/delay
+             fault injection (ms_inject_socket_failures analog)
+"""
+
+from .message import Message
+from .messenger import Messenger, Dispatcher, EntityAddr
+
+__all__ = ["Message", "Messenger", "Dispatcher", "EntityAddr"]

@@ -1,0 +1,250 @@
+"""Typed messages.
+
+Role of the reference's src/messages/*.h catalog (~190 versioned
+Message subclasses over bufferlists): every wire interaction is a typed,
+self-describing payload. The subset here covers the data plane (client
+ops, EC/replicated sub-ops), the control plane (maps, boot, failure
+reports, mon commands), and heartbeats — the types the SURVEY call
+stacks traverse (MOSDOp, MOSDECSubOpWrite/Reply, MOSDECSubOpRead/Reply,
+MOSDRepOp/Reply, MOSDPing, MOSDMap, MOSDBoot, MOSDFailure).
+
+Encoding: length-prefixed pickle of the typed object (the framing in
+messenger.py). The reference hand-rolls versioned encode/decode per
+type; here the contract is the typed class surface, not the byte
+layout.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+__all__ = [
+    "Message", "MPing", "MPingReply", "MOSDOp", "MOSDOpReply",
+    "MOSDECSubOpWrite", "MOSDECSubOpWriteReply", "MOSDECSubOpRead",
+    "MOSDECSubOpReadReply", "MOSDRepOp", "MOSDRepOpReply", "MOSDPGPush",
+    "MOSDPGPull", "MOSDMap", "MOSDBoot", "MOSDFailure", "MOSDAlive",
+    "MMonCommand", "MMonCommandReply", "MMonSubscribe", "MMonPaxos",
+    "MMonElection",
+]
+
+_seq = itertools.count(1)
+
+
+@dataclass
+class Message:
+    """Base: source entity + transport-stamped fields."""
+
+    def __post_init__(self):
+        self.seq = next(_seq)
+        self.from_name = None      # ("osd", 3) / ("client", 1) / ("mon", 0)
+        self.from_addr = None
+
+    def get_type(self) -> str:
+        return self.__class__.__name__
+
+
+# -- liveness ----------------------------------------------------------
+
+@dataclass
+class MPing(Message):
+    """MOSDPing PING op (heartbeat probe)."""
+    stamp: float = 0.0
+    epoch: int = 0
+
+
+@dataclass
+class MPingReply(Message):
+    stamp: float = 0.0
+    epoch: int = 0
+
+
+# -- client data plane -------------------------------------------------
+
+@dataclass
+class MOSDOp(Message):
+    """Client -> primary OSD op (src/messages/MOSDOp.h)."""
+    client_id: int = 0
+    tid: int = 0
+    pgid: object = None            # PGID (raw)
+    oid: str = ""
+    ops: list = field(default_factory=list)  # [(op, args...)]
+    map_epoch: int = 0
+
+
+@dataclass
+class MOSDOpReply(Message):
+    tid: int = 0
+    result: int = 0
+    data: object = None
+    map_epoch: int = 0
+
+
+# -- EC sub-ops (src/osd/ECMsgTypes.h via MOSDECSubOp*) ----------------
+
+@dataclass
+class MOSDECSubOpWrite(Message):
+    pgid: object = None
+    shard: int = 0                 # target shard id
+    from_osd: int = 0
+    tid: int = 0
+    at_version: int = 0
+    trim_to: int = 0
+    roll_forward_to: int = 0
+    log_entries: list = field(default_factory=list)
+    txn_ops: list = field(default_factory=list)   # store Transaction.ops
+    backfill: bool = False
+    map_epoch: int = 0
+
+
+@dataclass
+class MOSDECSubOpWriteReply(Message):
+    pgid: object = None
+    shard: int = 0
+    from_osd: int = 0
+    tid: int = 0
+    last_complete: int = 0
+    committed: bool = False
+    applied: bool = False
+
+
+@dataclass
+class MOSDECSubOpRead(Message):
+    pgid: object = None
+    shard: int = 0
+    from_osd: int = 0
+    tid: int = 0
+    to_read: list = field(default_factory=list)   # [(oid, off, len, flags)]
+    attrs_to_read: list = field(default_factory=list)
+    map_epoch: int = 0
+
+
+@dataclass
+class MOSDECSubOpReadReply(Message):
+    pgid: object = None
+    shard: int = 0
+    from_osd: int = 0
+    tid: int = 0
+    buffers_read: dict = field(default_factory=dict)  # oid -> [(off, bytes)]
+    attrs_read: dict = field(default_factory=dict)
+    errors: dict = field(default_factory=dict)        # oid -> errno
+
+
+# -- replicated sub-ops ------------------------------------------------
+
+@dataclass
+class MOSDRepOp(Message):
+    pgid: object = None
+    from_osd: int = 0
+    tid: int = 0
+    at_version: int = 0
+    log_entries: list = field(default_factory=list)
+    txn_ops: list = field(default_factory=list)
+    map_epoch: int = 0
+
+
+@dataclass
+class MOSDRepOpReply(Message):
+    pgid: object = None
+    from_osd: int = 0
+    tid: int = 0
+    result: int = 0
+    committed: bool = False
+
+
+# -- recovery push/pull ------------------------------------------------
+
+@dataclass
+class MOSDPGPush(Message):
+    pgid: object = None
+    from_osd: int = 0
+    shard: int = -1
+    oid: str = ""
+    data: bytes = b""
+    attrs: dict = field(default_factory=dict)
+    omap: dict = field(default_factory=dict)
+    version: int = 0
+    map_epoch: int = 0
+
+
+@dataclass
+class MOSDPGPull(Message):
+    pgid: object = None
+    from_osd: int = 0
+    shard: int = -1
+    oid: str = ""
+    map_epoch: int = 0
+
+
+# -- control plane -----------------------------------------------------
+
+@dataclass
+class MOSDMap(Message):
+    """Full map or incrementals from the mon (src/messages/MOSDMap.h)."""
+    full_map: object = None
+    incrementals: list = field(default_factory=list)
+    epoch: int = 0
+
+
+@dataclass
+class MOSDBoot(Message):
+    osd_id: int = -1
+    public_addr: object = None
+    cluster_addr: object = None
+    hb_addr: object = None
+
+
+@dataclass
+class MOSDFailure(Message):
+    """OSD reporting a peer failed (OSDMonitor::prepare_failure)."""
+    reporter: int = -1
+    target: int = -1
+    failed_for: float = 0.0
+    epoch: int = 0
+
+
+@dataclass
+class MOSDAlive(Message):
+    osd_id: int = -1
+    epoch: int = 0
+
+
+@dataclass
+class MMonCommand(Message):
+    """CLI-style command ('osd pool create', ...)."""
+    tid: int = 0
+    cmd: dict = field(default_factory=dict)
+
+
+@dataclass
+class MMonCommandReply(Message):
+    tid: int = 0
+    result: int = 0
+    outs: str = ""
+    data: object = None
+
+
+@dataclass
+class MMonSubscribe(Message):
+    """Subscribe to map updates ('osdmap' from epoch X)."""
+    what: str = "osdmap"
+    start_epoch: int = 0
+
+
+# -- mon internal ------------------------------------------------------
+
+@dataclass
+class MMonPaxos(Message):
+    op: str = ""                  # collect/last/begin/accept/commit/lease
+    pn: int = 0
+    last_committed: int = 0
+    values: dict = field(default_factory=dict)
+    lease_until: float = 0.0
+
+
+@dataclass
+class MMonElection(Message):
+    op: str = ""                  # propose/ack/victory
+    epoch: int = 0
+    rank: int = -1
+    quorum: list = field(default_factory=list)

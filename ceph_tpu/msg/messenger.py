@@ -1,0 +1,336 @@
+"""Threaded TCP transport with dispatcher chain and fault injection.
+
+Role of the reference's Messenger/AsyncMessenger (src/msg/Messenger.h,
+src/msg/async/): daemons bind a listening address, connections carry
+ordered typed messages, incoming messages walk a dispatcher chain
+(Dispatcher::ms_dispatch, first taker wins), and per-peer policy decides
+lossy vs lossless (reconnect + resend) behavior. The reference runs
+epoll worker threads; here each connection has a writer queue + reader
+thread — same ordering and failure semantics at framework scale.
+
+Fault injection mirrors `ms inject socket failures` (qa msgr-failures
+fragments): drop 1-in-N messages, add bounded random delivery delay.
+
+Framing: 4-byte magic, 4-byte length, pickle of the typed Message.
+Pickle is the serialization seam; swapping in a schema codec changes
+one function pair (_encode/_decode).
+"""
+
+from __future__ import annotations
+
+import pickle
+import random
+import socket
+import struct
+import threading
+import time
+
+__all__ = ["EntityAddr", "Dispatcher", "Messenger", "Connection"]
+
+_MAGIC = b"CTPU"
+_HDR = struct.Struct("<4sI")
+
+
+class EntityAddr(tuple):
+    """(host, port); tuple so it pickles/compares naturally."""
+
+    def __new__(cls, host: str, port: int):
+        return super().__new__(cls, (host, port))
+
+    @property
+    def host(self):
+        return self[0]
+
+    @property
+    def port(self):
+        return self[1]
+
+
+class Dispatcher:
+    """ms_dispatch contract (src/msg/Dispatcher.h)."""
+
+    def ms_dispatch(self, msg) -> bool:
+        """Return True if this dispatcher consumed the message."""
+        return False
+
+    def ms_handle_reset(self, addr) -> None:
+        """Peer connection dropped (lossy) — state cleanup hook."""
+
+
+def _encode(msg) -> bytes:
+    payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    return _HDR.pack(_MAGIC, len(payload)) + payload
+
+
+def _read_exact(sock, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class Connection:
+    """One ordered peer link: writer queue + reader thread."""
+
+    def __init__(self, msgr: "Messenger", peer_addr, sock=None):
+        self.msgr = msgr
+        self.peer_addr = peer_addr
+        self.sock = sock
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.out_q: list = []
+        self.closed = False
+        self.writer = threading.Thread(target=self._writer_loop,
+                                       daemon=True)
+        self.reader: threading.Thread | None = None
+
+    def start(self) -> None:
+        self.writer.start()
+        if self.sock is not None:
+            self._start_reader()
+
+    def _start_reader(self) -> None:
+        self.reader = threading.Thread(target=self._reader_loop,
+                                       daemon=True)
+        self.reader.start()
+
+    def send(self, msg) -> None:
+        with self.lock:
+            if self.closed:
+                return
+            self.out_q.append(msg)
+            self.cond.notify()
+
+    # -- writer --------------------------------------------------------
+
+    def _connect(self) -> bool:
+        try:
+            sock = socket.create_connection(tuple(self.peer_addr),
+                                            timeout=5.0)
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self.sock = sock
+            self._start_reader()
+            return True
+        except OSError:
+            return False
+
+    def _writer_loop(self) -> None:
+        backoff = 0.01
+        while True:
+            with self.lock:
+                while not self.out_q and not self.closed:
+                    self.cond.wait(0.5)
+                if self.closed and not self.out_q:
+                    return
+                msg = self.out_q[0]
+            if self.sock is None:
+                if not self._connect():
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 1.0)
+                    if self.msgr.policy_lossy:
+                        with self.lock:
+                            self.out_q.clear()
+                        self.msgr._notify_reset(self.peer_addr)
+                    continue
+                backoff = 0.01
+            if self.msgr._inject_should_drop():
+                with self.lock:
+                    self.out_q.pop(0)
+                continue
+            delay = self.msgr._inject_delay()
+            if delay:
+                time.sleep(delay)
+            try:
+                self.sock.sendall(_encode(msg))
+                with self.lock:
+                    self.out_q.pop(0)
+            except OSError:
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                self.sock = None
+                if self.msgr.policy_lossy:
+                    with self.lock:
+                        self.out_q.clear()
+                    self.msgr._notify_reset(self.peer_addr)
+                # lossless: keep msg at head, reconnect and resend
+
+    # -- reader --------------------------------------------------------
+
+    def _reader_loop(self) -> None:
+        sock = self.sock
+        while not self.closed and sock is not None:
+            try:
+                hdr = _read_exact(sock, _HDR.size)
+                if hdr is None:
+                    break
+                magic, length = _HDR.unpack(hdr)
+                if magic != _MAGIC:
+                    break
+                payload = _read_exact(sock, length)
+                if payload is None:
+                    break
+            except OSError:
+                break
+            try:
+                msg = pickle.loads(payload)
+            except Exception:
+                continue
+            msg.from_addr = self.peer_addr
+            self.msgr._dispatch(msg)
+        if sock is self.sock:
+            self.sock = None
+
+    def close(self) -> None:
+        with self.lock:
+            self.closed = True
+            self.cond.notify_all()
+        sock, self.sock = self.sock, None
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class Messenger:
+    """Bind + accept + per-peer outgoing connections."""
+
+    def __init__(self, name, nonce: str = "", conf=None,
+                 policy_lossy: bool = False):
+        self.name = name              # ("osd", 3) etc.
+        self.conf = conf
+        self.policy_lossy = policy_lossy
+        self.dispatchers: list[Dispatcher] = []
+        self.my_addr: EntityAddr | None = None
+        self._server: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        self._conns: dict = {}       # peer_addr -> Connection (outgoing)
+        self._in_conns: list = []
+        self._lock = threading.Lock()
+        self._stopping = False
+        self._rng = random.Random()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def bind(self, host: str = "127.0.0.1", port: int = 0) -> EntityAddr:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(64)
+        srv.settimeout(0.2)
+        self._server = srv
+        self.my_addr = EntityAddr(host, srv.getsockname()[1])
+        return self.my_addr
+
+    def start(self) -> None:
+        if self._server is None:
+            self.bind()
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                sock, addr = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = Connection(self, EntityAddr(*addr), sock=sock)
+            conn.start()
+            with self._lock:
+                self._in_conns.append(conn)
+
+    def shutdown(self) -> None:
+        self._stopping = True
+        if self._server is not None:
+            self._server.close()
+        with self._lock:
+            conns = list(self._conns.values()) + list(self._in_conns)
+            self._conns.clear()
+            self._in_conns.clear()
+        for conn in conns:
+            conn.close()
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2)
+
+    # -- dispatch ------------------------------------------------------
+
+    def add_dispatcher_head(self, d: Dispatcher) -> None:
+        self.dispatchers.insert(0, d)
+
+    def add_dispatcher_tail(self, d: Dispatcher) -> None:
+        self.dispatchers.append(d)
+
+    def _dispatch(self, msg) -> None:
+        for d in self.dispatchers:
+            try:
+                if d.ms_dispatch(msg):
+                    return
+            except Exception:
+                import traceback
+                traceback.print_exc()
+                return
+
+    def _notify_reset(self, addr) -> None:
+        for d in self.dispatchers:
+            try:
+                d.ms_handle_reset(addr)
+            except Exception:
+                pass
+
+    # -- send ----------------------------------------------------------
+
+    def send_message(self, msg, dest_addr) -> None:
+        if dest_addr is None:
+            return
+        dest_addr = EntityAddr(*dest_addr)
+        msg.from_name = self.name
+        with self._lock:
+            conn = self._conns.get(dest_addr)
+            if conn is None or conn.closed:
+                conn = Connection(self, dest_addr)
+                self._conns[dest_addr] = conn
+                conn.start()
+        conn.send(msg)
+
+    def mark_down(self, dest_addr) -> None:
+        """Drop the connection (Messenger::mark_down)."""
+        dest_addr = EntityAddr(*dest_addr)
+        with self._lock:
+            conn = self._conns.pop(dest_addr, None)
+        if conn is not None:
+            conn.close()
+
+    def mark_down_all(self) -> None:
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for conn in conns:
+            conn.close()
+
+    # -- fault injection ----------------------------------------------
+
+    def _inject_should_drop(self) -> bool:
+        if self.conf is None:
+            return False
+        n = self.conf.get_val("ms_inject_socket_failures")
+        return n > 0 and self._rng.randrange(n) == 0
+
+    def _inject_delay(self) -> float:
+        if self.conf is None:
+            return 0.0
+        mx = self.conf.get_val("ms_inject_delay_max")
+        return self._rng.uniform(0, mx) if mx > 0 else 0.0
