@@ -1,0 +1,19 @@
+"""Cluster consensus: monitors, election, paxos, map admission.
+
+The framework's rendition of src/mon/ (SURVEY.md layer 6): a small
+quorum of monitors elects a leader (Elector), replicates state changes
+through a Paxos-shaped commit protocol over MonitorDBStore, and runs
+services on top — OSDMonitor being the one the EC path needs (osdmap
+mutation, EC profile admission by instantiating the plugin, failure
+report accounting, down->out transitions).
+
+  paxos        leader-driven replicated commits + election
+  monitor      the daemon: messenger, services, command handling
+  osd_monitor  OSDMap state machine (boot/failure/pool/profile)
+  mon_client   client session: commands, map subscriptions
+"""
+
+from .monitor import Monitor
+from .mon_client import MonClient
+
+__all__ = ["Monitor", "MonClient"]

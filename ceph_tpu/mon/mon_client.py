@@ -1,0 +1,108 @@
+"""Client session with the monitor quorum.
+
+Role of the reference's MonClient (src/mon/MonClient.h): daemons and
+clients use one of these to send commands, subscribe to maps, and learn
+the current osdmap. Picks a monitor from the monmap; commands are
+synchronous with timeout; map updates arrive asynchronously and invoke
+the registered callback.
+"""
+
+from __future__ import annotations
+
+import itertools
+import pickle
+import threading
+
+from ..msg.message import MMonCommand, MMonSubscribe
+from ..msg.messenger import Dispatcher, Messenger
+
+__all__ = ["MonClient"]
+
+
+class MonClient(Dispatcher):
+    def __init__(self, monmap: dict, msgr: Messenger, name: str = "client"):
+        self.monmap = dict(monmap)
+        self.msgr = msgr
+        self.name = name
+        self._tid = itertools.count(1)
+        self._lock = threading.Lock()
+        self._waiters: dict = {}     # tid -> [event, reply]
+        self.osdmap = None
+        self.map_callbacks: list = []
+        self._map_event = threading.Event()
+        msgr.add_dispatcher_tail(self)
+
+    # -- dispatch ------------------------------------------------------
+
+    def ms_dispatch(self, msg) -> bool:
+        t = msg.get_type()
+        if t == "MMonCommandReply":
+            with self._lock:
+                waiter = self._waiters.pop(msg.tid, None)
+            if waiter is not None:
+                waiter[1] = msg
+                waiter[0].set()
+            return True
+        if t == "MOSDMap":
+            self._handle_osdmap(msg)
+            return True
+        return False
+
+    def _handle_osdmap(self, msg) -> None:
+        if msg.full_map is not None:
+            newmap = pickle.loads(msg.full_map)
+            if self.osdmap is None or newmap.epoch > self.osdmap.epoch:
+                self.osdmap = newmap
+        for inc in msg.incrementals:
+            if self.osdmap is not None and \
+                    inc.epoch == self.osdmap.epoch + 1:
+                self.osdmap.apply_incremental(inc)
+            elif self.osdmap is None or inc.epoch > self.osdmap.epoch + 1:
+                # gap: pull a full map
+                self.sub_want(start_epoch=0)
+        for cb in list(self.map_callbacks):
+            try:
+                cb(self.osdmap)
+            except Exception:
+                pass
+        with self._lock:
+            self._map_event.set()
+
+    # -- API -----------------------------------------------------------
+
+    def _mon_addr(self):
+        return self.monmap[min(self.monmap)]
+
+    def command(self, cmd: dict, timeout: float = 10.0):
+        """Send a command; returns (result, outs, data)."""
+        tid = next(self._tid)
+        waiter = [threading.Event(), None]
+        with self._lock:
+            self._waiters[tid] = waiter
+        # try each mon until one answers (leader forwarding handles the
+        # rest)
+        msg = MMonCommand(tid=tid, cmd=cmd, reply_to=self.msgr.my_addr)
+        self.msgr.send_message(msg, self._mon_addr())
+        if not waiter[0].wait(timeout):
+            with self._lock:
+                self._waiters.pop(tid, None)
+            raise TimeoutError("mon command %r timed out" % cmd)
+        reply = waiter[1]
+        return reply.result, reply.outs, reply.data
+
+    def sub_want(self, what: str = "osdmap", start_epoch: int = 0) -> None:
+        self.msgr.send_message(
+            MMonSubscribe(what=what, start_epoch=start_epoch,
+                          reply_to=self.msgr.my_addr),
+            self._mon_addr())
+
+    def wait_for_map(self, epoch: int = 1, timeout: float = 10.0):
+        """Block until an osdmap with epoch >= epoch arrives."""
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.osdmap is not None and self.osdmap.epoch >= epoch:
+                return self.osdmap
+            self._map_event.wait(0.05)
+            self._map_event.clear()
+        raise TimeoutError("no osdmap epoch >= %d" % epoch)

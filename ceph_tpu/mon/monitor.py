@@ -1,0 +1,203 @@
+"""The monitor daemon.
+
+Role of the reference's Monitor (src/mon/Monitor.cc): owns the
+messenger, the elector, paxos, and the services; answers client
+commands; pushes map updates to subscribers. Monitors know each other
+from a static monmap ({rank: addr}) given at startup, like the
+reference's bootstrap monmap.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+
+from ..common import Context
+from ..common.workqueue import SafeTimer
+from ..msg.message import (MMonCommandReply, MOSDMap)
+from ..msg.messenger import Dispatcher, Messenger
+from ..store.kv import MemDB
+from .osd_monitor import OSDMonitor
+from .paxos import Elector, Paxos
+
+__all__ = ["Monitor"]
+
+STATE_PROBING = "probing"
+STATE_ELECTING = "electing"
+STATE_LEADER = "leader"
+STATE_PEON = "peon"
+
+
+class Monitor(Dispatcher):
+    def __init__(self, rank: int, monmap: dict, ctx: Context | None = None):
+        self.rank = rank
+        self.monmap = dict(monmap)          # rank -> (host, port)
+        self.ctx = ctx or Context(name="mon.%d" % rank)
+        self.election_timeout = 0.3
+        self.state = STATE_PROBING
+        self.quorum: list[int] = []
+        self.leader_rank: int | None = None
+        self.store = MemDB()
+        self.msgr = Messenger(("mon", rank), conf=self.ctx.conf)
+        self.timer = SafeTimer("mon%d-timer" % rank)
+        self.elector = Elector(self)
+        self.paxos = Paxos(self, self.store)
+        self.osdmon = OSDMonitor(self)
+        self._lock = threading.RLock()
+        self._propose_pending = False
+        self._subscribers: dict = {}        # addr -> last epoch sent
+        self._tick_token = None
+        self._running = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def init(self) -> None:
+        addr = self.monmap[self.rank]
+        self.msgr.bind(addr[0], addr[1])
+        self.msgr.add_dispatcher_head(self)
+        self.msgr.start()
+        self.timer.init()
+        self._running = True
+        self.state = STATE_ELECTING
+        self.elector.start()
+        self._tick()
+
+    def shutdown(self) -> None:
+        self._running = False
+        self.timer.shutdown()
+        self.msgr.shutdown()
+        self.ctx.shutdown()
+
+    def _tick(self) -> None:
+        if not self._running:
+            return
+        if self.is_leader():
+            self.osdmon.tick()
+        self.timer.add_event_after(0.25, self._tick)
+
+    # -- roles ---------------------------------------------------------
+
+    def peer_ranks(self):
+        return [r for r in self.monmap if r != self.rank]
+
+    def quorum_size(self) -> int:
+        return len(self.monmap) // 2 + 1
+
+    def is_leader(self) -> bool:
+        return self.state == STATE_LEADER
+
+    def _become_leader(self, quorum: list) -> None:
+        with self._lock:
+            self.state = STATE_LEADER
+            self.leader_rank = self.rank
+            self.quorum = quorum
+        self.ctx.dout("mon", 1, "mon.%d won election, quorum %s"
+                      % (self.rank, quorum))
+        # bring peons up to date
+        for rank in quorum:
+            if rank != self.rank:
+                self.paxos.share_state(rank, 0)
+
+    def _become_peon(self, leader: int, quorum: list) -> None:
+        with self._lock:
+            self.state = STATE_PEON
+            self.leader_rank = leader
+            self.quorum = quorum
+        self.ctx.dout("mon", 1, "mon.%d peon of mon.%d" % (self.rank,
+                                                           leader))
+
+    def send_mon(self, rank: int, msg) -> None:
+        self.msgr.send_message(msg, self.monmap.get(rank))
+
+    # -- proposal pump -------------------------------------------------
+
+    def propose_soon(self) -> None:
+        """Batch pending service changes into one paxos proposal
+        (paxos_propose_interval batching)."""
+        with self._lock:
+            if self._propose_pending:
+                return
+            self._propose_pending = True
+        self.timer.add_event_after(
+            self.ctx.conf.get_val("paxos_propose_interval"),
+            self._do_propose)
+
+    def _do_propose(self) -> None:
+        with self._lock:
+            self._propose_pending = False
+        if not self.is_leader():
+            return  # peons' services forward to the leader instead
+        if self.osdmon.have_pending():
+            value = self.osdmon.encode_pending()
+            self.paxos.propose(value)
+
+    def _on_paxos_commit(self, version: int, value: bytes) -> None:
+        service, payload = pickle.loads(value)
+        if service == "osdmap":
+            self.osdmon.apply_committed(payload)
+
+    # -- map publication ----------------------------------------------
+
+    def publish_osdmap(self, inc) -> None:
+        with self._lock:
+            subs = list(self._subscribers)
+        for addr in subs:
+            self.msgr.send_message(
+                MOSDMap(incrementals=[inc], epoch=inc.epoch), addr)
+
+    # -- dispatch ------------------------------------------------------
+
+    def ms_dispatch(self, msg) -> bool:
+        t = msg.get_type()
+        if t == "MMonElection":
+            self.elector.handle(msg)
+            return True
+        if t == "MMonPaxos":
+            self.paxos.handle(msg)
+            return True
+        if t == "MOSDBoot":
+            if self._forward_if_peon(msg):
+                return True
+            self.osdmon.handle_boot(msg)
+            self._subscribe_addr(msg.public_addr or msg.from_addr)
+            return True
+        if t == "MOSDFailure":
+            if self._forward_if_peon(msg):
+                return True
+            self.osdmon.handle_failure(msg)
+            return True
+        if t == "MMonSubscribe":
+            self._subscribe_addr(msg.reply_to or msg.from_addr,
+                                 msg.start_epoch)
+            return True
+        if t == "MMonCommand":
+            if self._forward_if_peon(msg):
+                return True
+            result, outs, data = self.osdmon.handle_command(msg.cmd)
+            self.msgr.send_message(
+                MMonCommandReply(tid=msg.tid, result=result, outs=outs,
+                                 data=data), msg.reply_to or msg.from_addr)
+            return True
+        return False
+
+    def _forward_if_peon(self, msg) -> bool:
+        if self.is_leader():
+            return False
+        if self.leader_rank is None or self.leader_rank == self.rank:
+            return False
+        # preserve the original reply address
+        self.msgr.send_message(msg, self.monmap[self.leader_rank])
+        return True
+
+    def _subscribe_addr(self, addr, start_epoch: int = 0) -> None:
+        if addr is None:
+            return
+        with self._lock:
+            self._subscribers[tuple(addr)] = start_epoch
+        # immediately share the current full map
+        full = self.osdmon.osdmap
+        if full.epoch > start_epoch:
+            self.msgr.send_message(
+                MOSDMap(full_map=pickle.dumps(full), epoch=full.epoch),
+                addr)

@@ -1,0 +1,268 @@
+"""OSDMap state machine service.
+
+Role of the reference's OSDMonitor (src/mon/OSDMonitor.cc): the paxos
+service owning the osdmap. Mutations accumulate in a pending
+Incremental proposed on a short interval; handled here:
+
+  boot            MOSDBoot -> mark up + record addrs (OSDMonitor boot)
+  failure report  MOSDFailure -> grace accounting; enough distinct
+                  reporters -> mark down (prepare_failure :1979,
+                  check_failures :1860)
+  down -> out     after mon_osd_down_out_interval (tick)
+  pool create     'osd pool create' incl. erasure pools: the EC profile
+                  is validated by INSTANTIATING the plugin (the mon
+                  loads codecs too — crush_rule_create_erasure :5450),
+                  stripe_width derived from get_chunk_size (:5671-5702)
+  profile set     'osd erasure-code-profile set' (:5100-5148)
+  osd out/in/rm   weight edits
+  pg-upmap        explicit override admission
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+
+from ..osd.osd_map import (Incremental, OSDMap, PGID, PGPool,
+                           POOL_TYPE_ERASURE, POOL_TYPE_REPLICATED)
+
+__all__ = ["OSDMonitor"]
+
+
+class OSDMonitor:
+    def __init__(self, mon):
+        self.mon = mon
+        self.osdmap = OSDMap()
+        self.pending: Incremental | None = None
+        self.ec_profiles: dict[str, dict] = {
+            "default": {"plugin": "jerasure",
+                        "technique": "reed_sol_van", "k": "2", "m": "1"}}
+        self.failure_reports: dict[int, dict] = {}  # target -> reporter->ts
+        self.down_stamps: dict[int, float] = {}
+        self._lock = threading.RLock()
+        self._next_pool_id = 1
+
+    # -- pending incremental ------------------------------------------
+
+    def _pend(self) -> Incremental:
+        if self.pending is None:
+            self.pending = Incremental(self.osdmap.epoch + 1)
+        return self.pending
+
+    def have_pending(self) -> bool:
+        return self.pending is not None
+
+    def encode_pending(self) -> bytes:
+        inc, self.pending = self.pending, None
+        return pickle.dumps(("osdmap", inc))
+
+    def apply_committed(self, inc: Incremental) -> None:
+        with self._lock:
+            # a rejoining mon may replay old versions; skip stale epochs
+            if inc.epoch != self.osdmap.epoch + 1:
+                return
+            for osd in inc.new_down:
+                self.down_stamps.setdefault(osd, time.monotonic())
+            for osd in inc.new_up:
+                self.down_stamps.pop(osd, None)
+                self.failure_reports.pop(osd, None)
+            self.osdmap.apply_incremental(inc)
+        self.mon.publish_osdmap(inc)
+
+    # -- boot / failure ------------------------------------------------
+
+    def handle_boot(self, msg) -> None:
+        with self._lock:
+            inc = self._pend()
+            inc.new_up[msg.osd_id] = {
+                "public": msg.public_addr,
+                "cluster": msg.cluster_addr,
+                "hb": msg.hb_addr,
+            }
+            if msg.osd_id >= self.osdmap.max_osd and \
+                    (inc.new_max_osd or 0) <= msg.osd_id:
+                inc.new_max_osd = msg.osd_id + 1
+        self.mon.propose_soon()
+
+    def handle_failure(self, msg) -> None:
+        conf = self.mon.ctx.conf
+        with self._lock:
+            if not self.osdmap.is_up(msg.target):
+                return
+            reports = self.failure_reports.setdefault(msg.target, {})
+            reports[msg.reporter] = time.monotonic()
+            if len(reports) >= conf.get_val("mon_osd_min_down_reporters"):
+                inc = self._pend()
+                if msg.target not in inc.new_down:
+                    inc.new_down.append(msg.target)
+                self.failure_reports.pop(msg.target, None)
+                self.mon.ctx.dout(
+                    "mon", 1, "osd.%d reported failed by %d reporters -> "
+                    "marking down" % (msg.target, len(reports)))
+        self.mon.propose_soon()
+
+    def tick(self) -> None:
+        """down->out transitions (OSDMonitor::tick)."""
+        conf = self.mon.ctx.conf
+        grace = conf.get_val("mon_osd_down_out_interval")
+        now = time.monotonic()
+        with self._lock:
+            for osd, since in list(self.down_stamps.items()):
+                if self.osdmap.is_up(osd):
+                    self.down_stamps.pop(osd, None)
+                    continue
+                if now - since >= grace and self.osdmap.is_in(osd):
+                    self._pend().new_weight[osd] = 0
+                    self.mon.ctx.dout("mon", 1,
+                                      "osd.%d down too long -> out" % osd)
+        if self.pending is not None:
+            self.mon.propose_soon()
+
+    # -- commands ------------------------------------------------------
+
+    def handle_command(self, cmd: dict):
+        """Returns (result, outs, data)."""
+        prefix = cmd.get("prefix", "")
+        with self._lock:
+            if prefix == "osd erasure-code-profile set":
+                return self._profile_set(cmd)
+            if prefix == "osd erasure-code-profile get":
+                name = cmd.get("name", "default")
+                prof = self.ec_profiles.get(name)
+                if prof is None:
+                    return -2, "profile %s does not exist" % name, None
+                return 0, "", dict(prof)
+            if prefix == "osd erasure-code-profile ls":
+                return 0, "", sorted(self.ec_profiles)
+            if prefix == "osd pool create":
+                return self._pool_create(cmd)
+            if prefix == "osd out":
+                self._pend().new_weight[int(cmd["id"])] = 0
+                self.mon.propose_soon()
+                return 0, "marked out osd.%s" % cmd["id"], None
+            if prefix == "osd in":
+                self._pend().new_weight[int(cmd["id"])] = 0x10000
+                self.mon.propose_soon()
+                return 0, "marked in osd.%s" % cmd["id"], None
+            if prefix == "osd down":
+                inc = self._pend()
+                inc.new_down.append(int(cmd["id"]))
+                self.mon.propose_soon()
+                return 0, "marked down osd.%s" % cmd["id"], None
+            if prefix == "osd pg-upmap-items":
+                pgid = PGID(*cmd["pgid"])
+                self._pend().new_pg_upmap_items[pgid] = \
+                    [tuple(x) for x in cmd["mappings"]]
+                self.mon.propose_soon()
+                return 0, "", None
+            if prefix == "osd dump":
+                return 0, "", self._dump()
+            if prefix == "osd getmap":
+                return 0, "", pickle.dumps(self.osdmap)
+        return -22, "unknown command %r" % prefix, None
+
+    def _profile_set(self, cmd: dict):
+        name = cmd["name"]
+        profile = dict(cmd.get("profile", {}))
+        profile.setdefault("plugin", "jerasure")
+        # mon-side validation: instantiate the plugin (§3.5 note)
+        try:
+            from .. import registry
+            registry.factory(profile["plugin"], profile)
+        except Exception as e:
+            return -22, "invalid erasure code profile: %s" % e, None
+        if name in self.ec_profiles and self.ec_profiles[name] != profile:
+            if not cmd.get("force"):
+                return -1, ("will not override erasure code profile %s"
+                            % name), None
+        self.ec_profiles[name] = profile
+        return 0, "", None
+
+    def _pool_create(self, cmd: dict):
+        name = cmd["pool"]
+        conf = self.mon.ctx.conf
+        for pool in self.osdmap.pools.values():
+            if pool.name == name:
+                return 0, "pool '%s' already exists" % name, None
+        for pool in (self.pending.new_pools.values()
+                     if self.pending else []):
+            if pool.name == name:
+                return 0, "pool '%s' already exists" % name, None
+        pg_num = int(cmd.get("pg_num")
+                     or conf.get_val("osd_pool_default_pg_num"))
+        pool_type = cmd.get("pool_type", "replicated")
+        pool_id = self._next_pool_id
+        self._next_pool_id += 1
+        crush = self.osdmap.crush
+        if pool_type == "erasure":
+            prof_name = cmd.get("erasure_code_profile", "default")
+            profile = self.ec_profiles.get(prof_name)
+            if profile is None:
+                return -2, ("specified erasure code profile %s doesn't "
+                            "exist" % prof_name), None
+            from .. import registry
+            try:
+                codec = registry.factory(profile["plugin"], dict(profile))
+            except Exception as e:
+                return -22, str(e), None
+            size = codec.get_chunk_count()
+            min_size = codec.get_data_chunk_count() + 1
+            # stripe_width from get_chunk_size (OSDMonitor.cc:5671-5702)
+            stripe_unit = int(profile.get("stripe_unit", 4096))
+            k = codec.get_data_chunk_count()
+            stripe_width = k * codec.get_chunk_size(stripe_unit * k)
+            rule_name = cmd.get("crush_rule") or name
+            ruleno = crush.rule_by_name(rule_name)
+            if ruleno is None:
+                # ErasureCode::create_rule: indep rule over the profile's
+                # failure domain (ErasureCode.cc:55-74)
+                ruleno = crush.add_simple_rule(
+                    rule_name,
+                    profile.get("crush-root", "default"),
+                    failure_domain=profile.get("crush-failure-domain",
+                                               "host"),
+                    mode="indep", rule_type=POOL_TYPE_ERASURE)
+            pool = PGPool(pool_id=pool_id, name=name,
+                          type=POOL_TYPE_ERASURE, size=size,
+                          min_size=min_size, pg_num=pg_num,
+                          crush_rule=ruleno,
+                          erasure_code_profile=prof_name,
+                          stripe_width=stripe_width)
+        else:
+            size = int(cmd.get("size")
+                       or conf.get_val("osd_pool_default_size"))
+            rule_name = cmd.get("crush_rule") or "replicated_rule"
+            ruleno = crush.rule_by_name(rule_name)
+            if ruleno is None:
+                ruleno = crush.add_simple_rule(
+                    rule_name, "default", failure_domain="host",
+                    mode="firstn", rule_type=POOL_TYPE_REPLICATED)
+            pool = PGPool(pool_id=pool_id, name=name,
+                          type=POOL_TYPE_REPLICATED, size=size,
+                          min_size=max(1, size - 1), pg_num=pg_num,
+                          crush_rule=ruleno)
+        inc = self._pend()
+        inc.new_pools[pool_id] = pool
+        inc.new_crush = crush
+        self.mon.propose_soon()
+        return 0, "pool '%s' created" % name, pool_id
+
+    def _dump(self) -> dict:
+        m = self.osdmap
+        return {
+            "epoch": m.epoch,
+            "max_osd": m.max_osd,
+            "osds": [{
+                "osd": o,
+                "up": int(m.is_up(o)),
+                "in": int(m.is_in(o)),
+                "weight": m.osd_weight[o] / 0x10000,
+            } for o in range(m.max_osd) if m.exists(o)],
+            "pools": [{
+                "pool": p.pool_id, "pool_name": p.name, "type": p.type,
+                "size": p.size, "min_size": p.min_size,
+                "pg_num": p.pg_num,
+                "erasure_code_profile": p.erasure_code_profile,
+            } for p in m.pools.values()],
+        }

@@ -1,0 +1,191 @@
+"""Leader election + replicated commit.
+
+Role of the reference's Elector (src/mon/Elector.cc) and Paxos
+(src/mon/Paxos.cc): the mon quorum elects the lowest-ranked reachable
+monitor as leader; all state mutations funnel through the leader, which
+replicates them as numbered transactions and commits once a majority
+accepts. The reference implements full multi-round Paxos with leases;
+this keeps the same roles (leader proposes, peons accept, majority
+commits, versions are monotonic) with a collapsed message flow — the
+invariant the services rely on is identical: a committed version is on
+a majority and survives any minority failure.
+
+Values are opaque bytes stored in the MonitorDBStore under ("paxos",
+str(version)); services consume committed values in order.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..msg.message import MMonElection, MMonPaxos
+
+__all__ = ["Elector", "Paxos"]
+
+
+class Elector:
+    """Rank-based: lowest reachable rank wins (Elector.cc bully)."""
+
+    def __init__(self, mon):
+        self.mon = mon
+        self.epoch = 0
+        self.electing = False
+        self.acks: set[int] = set()
+        self.deferred_to: int | None = None
+        self._lock = threading.RLock()
+
+    def start(self) -> None:
+        with self._lock:
+            self.electing = True
+            self.epoch += 1
+            self.acks = {self.mon.rank}
+            self.deferred_to = None
+        for rank in self.mon.peer_ranks():
+            self.mon.send_mon(rank, MMonElection(
+                op="propose", epoch=self.epoch, rank=self.mon.rank))
+        # if nobody outranks us after the election timeout, declare
+        self.mon.timer.add_event_after(self.mon.election_timeout,
+                                       self._maybe_victory, self.epoch)
+
+    def handle(self, msg: MMonElection) -> None:
+        with self._lock:
+            if msg.op == "propose":
+                if msg.epoch > self.epoch:
+                    self.epoch = msg.epoch
+                if msg.rank < self.mon.rank:
+                    # they outrank us: defer
+                    self.deferred_to = msg.rank
+                    self.mon.send_mon(msg.rank, MMonElection(
+                        op="ack", epoch=self.epoch, rank=self.mon.rank))
+                    if not self.electing:
+                        self.electing = True
+                else:
+                    # we outrank them: counter-propose
+                    if not self.electing:
+                        self.start()
+            elif msg.op == "ack":
+                if msg.epoch == self.epoch:
+                    self.acks.add(msg.rank)
+            elif msg.op == "victory":
+                self.epoch = max(self.epoch, msg.epoch)
+                self.electing = False
+                self.deferred_to = msg.rank
+                self.mon._become_peon(msg.rank, list(msg.quorum))
+
+    def _maybe_victory(self, epoch: int) -> None:
+        with self._lock:
+            if not self.electing or epoch != self.epoch:
+                return
+            if self.deferred_to is not None and \
+                    self.deferred_to < self.mon.rank:
+                return  # someone better is around
+            quorum = sorted(self.acks)
+            if len(quorum) < self.mon.quorum_size():
+                # not enough peers: retry
+                self.electing = False
+                self.mon.timer.add_event_after(
+                    self.mon.election_timeout, self.start)
+                return
+            self.electing = False
+        for rank in self.mon.peer_ranks():
+            self.mon.send_mon(rank, MMonElection(
+                op="victory", epoch=self.epoch, rank=self.mon.rank,
+                quorum=quorum))
+        self.mon._become_leader(quorum)
+
+
+class Paxos:
+    def __init__(self, mon, store):
+        self.mon = mon
+        self.store = store
+        self.last_committed = 0
+        self.accepted: dict[int, bytes] = {}
+        self.pending_acks: dict[int, set] = {}
+        self._lock = threading.RLock()
+        self._commit_waiters: dict[int, list] = {}
+
+    # -- leader side ---------------------------------------------------
+
+    def propose(self, value: bytes, on_commit=None) -> int:
+        """Leader replicates value as version last_committed+1."""
+        assert self.mon.is_leader()
+        with self._lock:
+            version = self.last_committed + 1 + len(self.pending_acks)
+            self.accepted[version] = value
+            self.pending_acks[version] = {self.mon.rank}
+            if on_commit:
+                self._commit_waiters.setdefault(version, []).append(
+                    on_commit)
+        for rank in self.mon.quorum:
+            if rank != self.mon.rank:
+                self.mon.send_mon(rank, MMonPaxos(
+                    op="begin", pn=version,
+                    last_committed=self.last_committed,
+                    values={version: value}))
+        self._check_commit(version)
+        return version
+
+    def _check_commit(self, version: int) -> None:
+        with self._lock:
+            acks = self.pending_acks.get(version)
+            if acks is None or len(acks) < self.mon.quorum_size():
+                return
+            # commit in order only
+            if version != self.last_committed + 1:
+                return
+            del self.pending_acks[version]
+            value = self.accepted[version]
+            self._commit_local(version, value)
+            waiters = self._commit_waiters.pop(version, [])
+        for rank in self.mon.quorum:
+            if rank != self.mon.rank:
+                self.mon.send_mon(rank, MMonPaxos(
+                    op="commit", pn=version, last_committed=version,
+                    values={version: value}))
+        for cb in waiters:
+            cb(version)
+        # cascade: next pending version may now be committable
+        self._check_commit(version + 1)
+
+    # -- peon side -----------------------------------------------------
+
+    def handle(self, msg: MMonPaxos) -> None:
+        if msg.op == "begin":
+            with self._lock:
+                for version, value in msg.values.items():
+                    self.accepted[version] = value
+            self.mon.send_mon(msg.from_name[1], MMonPaxos(
+                op="accept", pn=msg.pn, last_committed=self.last_committed))
+        elif msg.op == "accept":
+            with self._lock:
+                acks = self.pending_acks.get(msg.pn)
+                if acks is not None:
+                    acks.add(msg.from_name[1])
+            self._check_commit(msg.pn)
+        elif msg.op == "commit":
+            with self._lock:
+                for version in sorted(msg.values):
+                    if version == self.last_committed + 1:
+                        self._commit_local(version, msg.values[version])
+
+    def _commit_local(self, version: int, value: bytes) -> None:
+        batch = self.store.get_transaction()
+        batch.set("paxos", "%016d" % version, value)
+        batch.set("paxos", "last_committed", str(version).encode())
+        self.store.submit_transaction(batch)
+        self.last_committed = version
+        self.mon._on_paxos_commit(version, value)
+
+    # -- catch-up (a rejoining peon pulls missed versions) -------------
+
+    def share_state(self, rank: int, from_version: int) -> None:
+        values = {}
+        for version in range(from_version + 1, self.last_committed + 1):
+            raw = self.store.get("paxos", "%016d" % version)
+            if raw is not None:
+                values[version] = raw
+        if values:
+            self.mon.send_mon(rank, MMonPaxos(
+                op="commit", pn=self.last_committed,
+                last_committed=self.last_committed, values=values))

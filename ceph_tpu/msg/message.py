@@ -211,9 +211,12 @@ class MOSDAlive(Message):
 
 @dataclass
 class MMonCommand(Message):
-    """CLI-style command ('osd pool create', ...)."""
+    """CLI-style command ('osd pool create', ...). reply_to carries the
+    requester's listening address so a forwarding peon doesn't swallow
+    the reply path."""
     tid: int = 0
     cmd: dict = field(default_factory=dict)
+    reply_to: object = None
 
 
 @dataclass
@@ -229,6 +232,7 @@ class MMonSubscribe(Message):
     """Subscribe to map updates ('osdmap' from epoch X)."""
     what: str = "osdmap"
     start_epoch: int = 0
+    reply_to: object = None
 
 
 # -- mon internal ------------------------------------------------------

@@ -32,10 +32,15 @@ _HDR = struct.Struct("<4sI")
 
 
 class EntityAddr(tuple):
-    """(host, port); tuple so it pickles/compares naturally."""
+    """(host, port); tuple so it compares naturally."""
 
     def __new__(cls, host: str, port: int):
         return super().__new__(cls, (host, port))
+
+    def __getnewargs__(self):
+        # tuple subclass with a (host, port) __new__: tell pickle to
+        # call it with two args, not one tuple
+        return (self[0], self[1])
 
     @property
     def host(self):

@@ -1,0 +1,215 @@
+"""Monitor quorum: election, paxos commits, OSDMonitor state machine.
+
+Models qa/standalone-style localhost multi-daemon checks at unit scale."""
+
+import pickle
+import socket
+import time
+
+import pytest
+
+from ceph_tpu.common import Context
+from ceph_tpu.crush.map import CrushMap
+from ceph_tpu.mon import MonClient, Monitor
+from ceph_tpu.msg.message import MOSDBoot, MOSDFailure
+from ceph_tpu.msg.messenger import Messenger
+
+
+def free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def make_monmap(n):
+    return {r: ("127.0.0.1", p) for r, p in enumerate(free_ports(n))}
+
+
+def wait_until(fn, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def bootstrap_crush(mon):
+    """Give the leader's osdmap a host tree so pools can create rules."""
+    crush = CrushMap()
+    crush.type_names = {"osd": 0, "host": 1, "root": 10}
+    hosts = []
+    for h in range(3):
+        hid = crush.add_bucket("straw2", 1, [h], [0x10000],
+                               name="host%d" % h)
+        hosts.append(hid)
+    crush.add_bucket("straw2", 10, hosts, [0x10000] * 3, name="default")
+    mon.osdmon.osdmap.crush = crush
+
+
+class TestSingleMon:
+    def setup_method(self):
+        self.monmap = make_monmap(1)
+        self.mon = Monitor(0, self.monmap)
+        self.mon.init()
+        assert wait_until(self.mon.is_leader)
+        bootstrap_crush(self.mon)
+        self.client_msgr = Messenger(("client", 1))
+        self.client_msgr.start()
+        self.mc = MonClient(self.monmap, self.client_msgr)
+
+    def teardown_method(self):
+        self.client_msgr.shutdown()
+        self.mon.shutdown()
+
+    def test_command_roundtrip(self):
+        res, outs, data = self.mc.command({"prefix": "osd dump"})
+        assert res == 0
+        assert data["epoch"] == 0
+
+    def test_osd_boot_flows_to_map(self):
+        self.mon.msgr._dispatch  # noqa - direct sends below
+        boot_msgr = Messenger(("osd", 0))
+        boot_msgr.start()
+        try:
+            boot_msgr.send_message(
+                MOSDBoot(osd_id=0, public_addr=boot_msgr.my_addr),
+                self.monmap[0])
+            assert wait_until(lambda: self.mon.osdmon.osdmap.is_up(0))
+            assert self.mon.osdmon.osdmap.epoch >= 1
+        finally:
+            boot_msgr.shutdown()
+
+    def test_ec_profile_validation(self):
+        res, outs, _ = self.mc.command({
+            "prefix": "osd erasure-code-profile set", "name": "bad",
+            "profile": {"plugin": "jerasure",
+                        "technique": "no_such_technique",
+                        "k": "2", "m": "1"}})
+        assert res == -22
+        assert "invalid erasure code profile" in outs
+        res, _, _ = self.mc.command({
+            "prefix": "osd erasure-code-profile set", "name": "k8m3",
+            "profile": {"plugin": "jax_tpu",
+                        "technique": "reed_sol_van",
+                        "k": "8", "m": "3"}})
+        assert res == 0
+        res, _, prof = self.mc.command({
+            "prefix": "osd erasure-code-profile get", "name": "k8m3"})
+        assert res == 0 and prof["k"] == "8"
+        # no-force override rejected
+        res, outs, _ = self.mc.command({
+            "prefix": "osd erasure-code-profile set", "name": "k8m3",
+            "profile": {"plugin": "jerasure",
+                        "technique": "reed_sol_van",
+                        "k": "4", "m": "2"}})
+        assert res == -1 and "will not override" in outs
+
+    def test_pool_create_erasure_geometry(self):
+        res, _, _ = self.mc.command({
+            "prefix": "osd erasure-code-profile set", "name": "p42",
+            "profile": {"plugin": "jerasure",
+                        "technique": "reed_sol_van", "k": "4", "m": "2",
+                        "crush-failure-domain": "host"}})
+        assert res == 0
+        res, outs, pool_id = self.mc.command({
+            "prefix": "osd pool create", "pool": "ecpool",
+            "pool_type": "erasure", "erasure_code_profile": "p42",
+            "pg_num": 8})
+        assert res == 0, outs
+        assert wait_until(
+            lambda: pool_id in self.mon.osdmon.osdmap.pools)
+        pool = self.mon.osdmon.osdmap.pools[pool_id]
+        assert pool.size == 6                 # k+m
+        assert pool.min_size == 5             # k+1
+        assert pool.is_erasure()
+        assert pool.stripe_width == 4 * 4096  # k * chunk(stripe_unit*k)
+        # rule exists and is indep-typed
+        rule = self.mon.osdmon.osdmap.crush.rules[pool.crush_rule]
+        assert any("indep" in str(s[0]) for s in rule.steps)
+
+    def test_failure_report_marks_down_then_out(self):
+        # boot osd 2 first
+        boot_msgr = Messenger(("osd", 2))
+        boot_msgr.start()
+        try:
+            boot_msgr.send_message(
+                MOSDBoot(osd_id=2, public_addr=boot_msgr.my_addr),
+                self.monmap[0])
+            assert wait_until(lambda: self.mon.osdmon.osdmap.is_up(2))
+            # report failure
+            boot_msgr.send_message(
+                MOSDFailure(reporter=1, target=2, failed_for=2.0),
+                self.monmap[0])
+            assert wait_until(
+                lambda: self.mon.osdmon.osdmap.is_down(2))
+            # and after the down-out interval it goes out
+            assert wait_until(
+                lambda: self.mon.osdmon.osdmap.is_out(2), timeout=8.0)
+        finally:
+            boot_msgr.shutdown()
+
+
+class TestQuorum:
+    def test_three_mons_elect_and_replicate(self):
+        monmap = make_monmap(3)
+        mons = [Monitor(r, monmap) for r in monmap]
+        for m in mons:
+            m.init()
+        try:
+            assert wait_until(lambda: mons[0].is_leader())
+            assert wait_until(
+                lambda: all(m.state in ("leader", "peon") for m in mons))
+            assert not mons[1].is_leader() and not mons[2].is_leader()
+            bootstrap_crush(mons[0])
+
+            msgr = Messenger(("client", 9))
+            msgr.start()
+            try:
+                mc = MonClient(monmap, msgr)
+                res, _, _ = mc.command({"prefix": "osd pool create",
+                                        "pool": "rep", "pg_num": 8})
+                assert res == 0
+                # the commit replicates to every mon's paxos store
+                assert wait_until(
+                    lambda: all(m.paxos.last_committed >= 1
+                                for m in mons))
+                assert wait_until(
+                    lambda: all(any(p.name == "rep"
+                                    for p in m.osdmon.osdmap.pools
+                                    .values())
+                                for m in mons))
+            finally:
+                msgr.shutdown()
+        finally:
+            for m in mons:
+                m.shutdown()
+
+    def test_peon_forwards_commands(self):
+        monmap = make_monmap(3)
+        mons = [Monitor(r, monmap) for r in monmap]
+        for m in mons:
+            m.init()
+        try:
+            assert wait_until(
+                lambda: all(m.state in ("leader", "peon") for m in mons))
+            bootstrap_crush(mons[0])
+            msgr = Messenger(("client", 8))
+            msgr.start()
+            try:
+                mc = MonClient(monmap, msgr)
+                # force the client to talk to a peon
+                mc._mon_addr = lambda: monmap[2]
+                res, outs, _ = mc.command({"prefix": "osd dump"})
+                assert res == 0
+            finally:
+                msgr.shutdown()
+        finally:
+            for m in mons:
+                m.shutdown()
