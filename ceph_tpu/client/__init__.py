@@ -1,0 +1,12 @@
+"""Client stack: the librados/Objecter layer.
+
+The framework's rendition of src/librados + src/osdc (SURVEY.md layer
+8): a RadosClient connects to the monitors, computes placement
+client-side (object -> PG -> primary via the same CRUSH pipeline the
+OSDs run — Objecter::_calc_target, src/osdc/Objecter.cc:2749), sends
+MOSDOp to the primary, and resends on map change or timeout.
+"""
+
+from .rados import RadosClient, IoCtx
+
+__all__ = ["RadosClient", "IoCtx"]

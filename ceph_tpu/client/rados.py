@@ -1,0 +1,227 @@
+"""RadosClient + IoCtx + the op-tracking Objecter core.
+
+Reference shape (src/librados/librados.cc C API over IoCtxImpl over
+Objecter): IoCtx carries a pool; each op computes its target
+(object_to_pg -> pg_to_up_acting_osds -> primary), ships a typed MOSDOp,
+and blocks on the reply with resend-on-new-map (Objecter::op_submit
+:2253, _calc_target :2749, resends on map change). The inflight-ops
+throttle mirrors objecter_inflight_ops.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from ..common import Context
+from ..common.throttle import Throttle
+from ..mon.mon_client import MonClient
+from ..msg.message import MOSDOp
+from ..msg.messenger import Dispatcher, Messenger
+
+__all__ = ["RadosClient", "IoCtx", "RadosError"]
+
+
+class RadosError(OSError):
+    pass
+
+
+class _InflightOp:
+    def __init__(self, tid):
+        self.tid = tid
+        self.event = threading.Event()
+        self.result = None
+        self.data = None
+
+
+class RadosClient(Dispatcher):
+    def __init__(self, monmap: dict, ctx: Context | None = None,
+                 client_id: int = 0):
+        self.ctx = ctx if ctx is not None else Context(
+            name="client.%d" % client_id)
+        self.client_id = client_id
+        self.msgr = Messenger(("client", client_id),
+                              conf=self.ctx.conf)
+        self.msgr.start()
+        self.msgr.add_dispatcher_head(self)
+        self.mon_client = MonClient(monmap, self.msgr,
+                                    "client.%d" % client_id)
+        self._tids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._inflight: dict[int, _InflightOp] = {}
+        self._throttle = Throttle(
+            "objecter", self.ctx.conf.get_val("objecter_inflight_ops"))
+
+    # -- lifecycle -----------------------------------------------------
+
+    def connect(self, timeout: float = 10.0) -> None:
+        self.mon_client.sub_want()
+        self.mon_client.wait_for_map(1, timeout)
+
+    def shutdown(self) -> None:
+        self.msgr.shutdown()
+        self.ctx.shutdown()
+
+    @property
+    def osdmap(self):
+        return self.mon_client.osdmap
+
+    # -- pools ---------------------------------------------------------
+
+    def pool_id(self, name: str) -> int:
+        for pool_id, pool in self.osdmap.pools.items():
+            if pool.name == name:
+                return pool_id
+        raise RadosError(2, "pool %r does not exist" % name)
+
+    def open_ioctx(self, pool_name: str) -> "IoCtx":
+        return IoCtx(self, self.pool_id(pool_name))
+
+    def mon_command(self, cmd: dict, timeout: float = 10.0):
+        return self.mon_client.command(cmd, timeout)
+
+    # -- dispatch ------------------------------------------------------
+
+    def ms_dispatch(self, msg) -> bool:
+        if msg.get_type() == "MOSDOpReply":
+            with self._lock:
+                op = self._inflight.pop(msg.tid, None)
+            if op is not None:
+                op.result = msg.result
+                op.data = msg.data
+                op.event.set()
+                self._throttle.put()
+            return True
+        return False
+
+    # -- op submission (Objecter::op_submit collapsed) ------------------
+
+    def _target_for(self, pool_id: int, oid: str):
+        m = self.osdmap
+        raw_pg = m.object_to_pg(pool_id, oid)
+        pool = m.pools[pool_id]
+        pgid = pool.raw_pg_to_pg(raw_pg)
+        up, upp, acting, actp = m.pg_to_up_acting_osds(pgid)
+        return pgid, actp
+
+    def submit_op(self, pool_id: int, oid: str, ops: list,
+                  timeout: float = 30.0, pgid=None):
+        """Send; resend on EAGAIN/timeout slices until deadline.
+
+        pgid pins the target PG explicitly (PG-scoped ops like list);
+        otherwise the object name hashes to its PG."""
+        deadline = time.monotonic() + timeout
+        backoff = 0.05
+        fixed_pgid = pgid
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise RadosError(110, "op on %r timed out" % oid)
+            if fixed_pgid is not None:
+                pgid = fixed_pgid
+                _, _, _, primary = \
+                    self.osdmap.pg_to_up_acting_osds(pgid)
+            else:
+                pgid, primary = self._target_for(pool_id, oid)
+            if primary == -1:
+                time.sleep(min(backoff, remaining))
+                backoff = min(backoff * 2, 0.5)
+                continue
+            addrs = self.osdmap.get_addr(primary)
+            addr = addrs.get("public") if isinstance(addrs, dict) \
+                else addrs
+            if addr is None:
+                time.sleep(min(backoff, remaining))
+                continue
+            tid = next(self._tids)
+            op = _InflightOp(tid)
+            self._throttle.get()
+            with self._lock:
+                self._inflight[tid] = op
+            self.msgr.send_message(
+                MOSDOp(client_id=self.client_id, tid=tid, pgid=pgid,
+                       oid=oid, ops=ops,
+                       map_epoch=self.osdmap.epoch), addr)
+            # wait a slice, then re-target (map may have changed)
+            if op.event.wait(min(remaining, 1.0)):
+                if op.result == -11:  # EAGAIN: wrong/unready primary
+                    time.sleep(min(backoff, 0.2))
+                    backoff = min(backoff * 2, 0.5)
+                    continue
+                return op.result, op.data
+            with self._lock:
+                dropped = self._inflight.pop(tid, None)
+            if dropped is not None:
+                self._throttle.put()
+            # resend with fresh target
+
+
+class IoCtx:
+    """Per-pool IO interface (librados IoCtx surface subset)."""
+
+    def __init__(self, client: RadosClient, pool_id: int):
+        self.client = client
+        self.pool_id = pool_id
+
+    def _op(self, oid: str, ops: list, timeout: float = 30.0):
+        result, data = self.client.submit_op(self.pool_id, oid, ops,
+                                             timeout)
+        if result < 0:
+            raise RadosError(-result, "op on %r failed: %d"
+                             % (oid, result))
+        return data
+
+    # -- writes --------------------------------------------------------
+
+    def write_full(self, oid: str, data: bytes) -> None:
+        self._op(oid, [("writefull", bytes(data))])
+
+    def write(self, oid: str, data: bytes, offset: int = 0) -> None:
+        self._op(oid, [("write", offset, bytes(data))])
+
+    def append(self, oid: str, data: bytes) -> None:
+        self._op(oid, [("append", bytes(data))])
+
+    def truncate(self, oid: str, size: int) -> None:
+        self._op(oid, [("truncate", size)])
+
+    def remove(self, oid: str) -> None:
+        self._op(oid, [("remove",)])
+
+    def set_xattr(self, oid: str, name: str, value: bytes) -> None:
+        self._op(oid, [("setxattr", name, value)])
+
+    def omap_set(self, oid: str, kv: dict) -> None:
+        self._op(oid, [("omap_set", kv)])
+
+    # -- reads ---------------------------------------------------------
+
+    def read(self, oid: str, length: int = 0, offset: int = 0) -> bytes:
+        data = self._op(oid, [("read", offset, length)])
+        return bytes(data) if data is not None else b""
+
+    def stat(self, oid: str) -> dict:
+        return self._op(oid, [("stat",)])
+
+    def get_xattr(self, oid: str, name: str) -> bytes:
+        return self._op(oid, [("getxattr", name)])
+
+    def omap_get(self, oid: str) -> dict:
+        return self._op(oid, [("omap_get",)])
+
+    def list_objects(self) -> list:
+        """Union of object listings across the pool's PG primaries."""
+        from ..osd.osd_map import PGID
+        pool = self.client.osdmap.pools[self.pool_id]
+        seen = set()
+        for ps in range(pool.pg_num):
+            try:
+                result, data = self.client.submit_op(
+                    self.pool_id, "", [("list",)], timeout=5.0,
+                    pgid=PGID(self.pool_id, ps))
+            except RadosError:
+                continue
+            if result == 0:
+                seen.update(data or [])
+        return sorted(seen)

@@ -83,7 +83,35 @@ class OSDMonitor:
             if msg.osd_id >= self.osdmap.max_osd and \
                     (inc.new_max_osd or 0) <= msg.osd_id:
                 inc.new_max_osd = msg.osd_id + 1
+            self._crush_register(inc, msg.osd_id)
         self.mon.propose_soon()
+
+    def _crush_register(self, inc: Incremental, osd_id: int) -> None:
+        """Place a booting osd in the crush tree under its own host
+        bucket (the 'osd crush create-or-move' done at boot). One host
+        per osd keeps failure-domain=host meaningful at test scale."""
+        import copy
+
+        import numpy as np
+        crush = inc.new_crush if inc.new_crush is not None \
+            else copy.deepcopy(self.osdmap.crush)
+        crush.type_names.setdefault("osd", 0)
+        crush.type_names.setdefault("host", 1)
+        crush.type_names.setdefault("root", 10)
+        host_name = "host%d" % osd_id
+        if host_name not in crush.bucket_names:
+            hid = crush.add_bucket("straw2", 1, [osd_id], [0x10000],
+                                   name=host_name)
+            root_id = crush.bucket_names.get("default")
+            if root_id is None:
+                crush.add_bucket("straw2", 10, [hid], [0x10000],
+                                 name="default")
+            else:
+                root = crush.buckets[root_id]
+                if hid not in root.items:
+                    root.items = np.append(root.items, hid)
+                    root.weights = np.append(root.weights, 0x10000)
+        inc.new_crush = crush
 
     def handle_failure(self, msg) -> None:
         conf = self.mon.ctx.conf
@@ -177,6 +205,9 @@ class OSDMonitor:
                 return -1, ("will not override erasure code profile %s"
                             % name), None
         self.ec_profiles[name] = profile
+        # profiles travel in the osdmap so OSDs can build codecs
+        self._pend().new_ec_profiles[name] = profile
+        self.mon.propose_soon()
         return 0, "", None
 
     def _pool_create(self, cmd: dict):
@@ -194,7 +225,10 @@ class OSDMonitor:
         pool_type = cmd.get("pool_type", "replicated")
         pool_id = self._next_pool_id
         self._next_pool_id += 1
-        crush = self.osdmap.crush
+        import copy
+        inc = self._pend()
+        crush = inc.new_crush if inc.new_crush is not None \
+            else copy.deepcopy(self.osdmap.crush)
         if pool_type == "erasure":
             prof_name = cmd.get("erasure_code_profile", "default")
             profile = self.ec_profiles.get(prof_name)
@@ -242,9 +276,11 @@ class OSDMonitor:
                           type=POOL_TYPE_REPLICATED, size=size,
                           min_size=max(1, size - 1), pg_num=pg_num,
                           crush_rule=ruleno)
-        inc = self._pend()
         inc.new_pools[pool_id] = pool
         inc.new_crush = crush
+        if pool.erasure_code_profile:
+            inc.new_ec_profiles[pool.erasure_code_profile] = \
+                self.ec_profiles[pool.erasure_code_profile]
         self.mon.propose_soon()
         return 0, "pool '%s' created" % name, pool_id
 

@@ -23,7 +23,8 @@ __all__ = [
     "Message", "MPing", "MPingReply", "MOSDOp", "MOSDOpReply",
     "MOSDECSubOpWrite", "MOSDECSubOpWriteReply", "MOSDECSubOpRead",
     "MOSDECSubOpReadReply", "MOSDRepOp", "MOSDRepOpReply", "MOSDPGPush",
-    "MOSDPGPull", "MOSDMap", "MOSDBoot", "MOSDFailure", "MOSDAlive",
+    "MOSDPGPull", "MOSDPGScan", "MOSDMap", "MOSDBoot", "MOSDFailure",
+    "MOSDAlive",
     "MMonCommand", "MMonCommandReply", "MMonSubscribe", "MMonPaxos",
     "MMonElection",
 ]
@@ -164,6 +165,18 @@ class MOSDPGPush(Message):
     attrs: dict = field(default_factory=dict)
     omap: dict = field(default_factory=dict)
     version: int = 0
+    map_epoch: int = 0
+
+
+@dataclass
+class MOSDPGScan(Message):
+    """Primary <-> replica object inventory exchange driving recovery
+    (stands in for the reference's pg-log/backfill scan machinery)."""
+    pgid: object = None
+    from_osd: int = 0
+    shard: int = -1
+    op: str = "request"            # request | reply
+    objects: dict = field(default_factory=dict)   # oid -> version
     map_epoch: int = 0
 
 

@@ -1,0 +1,468 @@
+"""Erasure-coded PG backend: the two-phase write/read/recovery pipeline.
+
+Role of the reference's ECBackend (src/osd/ECBackend.{h,cc}):
+
+  write   submit_transaction (:1437) -> start_rmw plans the op (:1756)
+          -> the op walks three wait queues — waiting_state (needs
+          readback?), waiting_reads (readback in flight), waiting_commit
+          (sub-writes in flight) — advanced by try_state_to_reads
+          (:1782), try_reads_to_commit (:1857, where ECTransaction
+          generates per-shard transactions and MOSDECSubOpWrite fans
+          out :1989), try_finish_rmw (:2017). The local shard
+          self-delivers (:1998). All-shards-commit completes the op.
+  replica handle_sub_write (:917): apply the shard transaction, ack
+          with sub_write_committed (:840).
+  read    objects_read_and_reconstruct (:2258): pick min shards via
+          minimum_to_decode (:1488-1556), sub-read chunk extents
+          (handle_sub_read :982 on each shard), reassemble/decode on
+          reply (:1115), complete in order.
+  recovery  reconstruct a lost shard from k survivors and push it
+          (continue_recovery_op :531 reshaped into the PG's recovery
+          drive).
+
+TPU-first: encode/decode of whole multi-stripe extents happen as single
+batched device calls through ec_util; the per-op pipeline itself is
+plain host orchestration.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+
+import numpy as np
+
+from ..common.interval_set import ExtentMap, IntervalSet
+from ..msg.message import (MOSDECSubOpRead, MOSDECSubOpReadReply,
+                           MOSDECSubOpWrite, MOSDECSubOpWriteReply)
+from ..store.object_store import Transaction
+from . import ec_transaction, ec_util
+from .extent_cache import ExtentCache
+from .osd_map import CRUSH_ITEM_NONE
+
+__all__ = ["ECBackend"]
+
+
+class _InflightWrite:
+    def __init__(self, tid, pg_txn, at_version, on_commit):
+        self.tid = tid
+        self.pg_txn = pg_txn
+        self.at_version = at_version
+        self.on_commit = on_commit
+        self.plan = None
+        self.pin = None
+        self.must_read: dict = {}     # oid -> IntervalSet
+        self.remote_read_result: dict = {}  # oid -> ExtentMap
+        self.pending_reads = 0
+        self.pending_commits: set = set()   # shard ids
+        self.state = "state"          # state -> reads -> commit -> done
+
+
+class _InflightRead:
+    def __init__(self, tid, oid, off, length, on_done):
+        self.tid = tid
+        self.oid = oid
+        self.off = off
+        self.length = length
+        self.on_done = on_done
+        self.raw_shards_cb = None     # recovery: wants raw shard streams
+        self.shard_data: dict = {}    # shard -> bytes
+        self.want_shards: set = set()
+        self.chunk_off = 0
+        self.chunk_len = 0
+        self.errors: dict = {}
+
+
+class ECBackend:
+    def __init__(self, pg, codec, stripe_width: int):
+        self.pg = pg                  # owning PG (listener interface)
+        self.codec = codec
+        self.sinfo = ec_util.StripeInfo(codec.get_data_chunk_count(),
+                                        stripe_width)
+        self.cache = ExtentCache()
+        self._tids = itertools.count(1)
+        self.lock = threading.RLock()
+        # the three wait queues (ECBackend.h:561-563)
+        self.waiting_state: list[_InflightWrite] = []
+        self.waiting_reads: list[_InflightWrite] = []
+        self.waiting_commit: list[_InflightWrite] = []
+        self.inflight_reads: dict = {}
+        self.hinfo_cache: dict = {}
+
+    # -- geometry ------------------------------------------------------
+
+    @property
+    def k(self) -> int:
+        return self.codec.get_data_chunk_count()
+
+    @property
+    def n(self) -> int:
+        return self.codec.get_chunk_count()
+
+    def get_hinfo(self, oid) -> ec_util.HashInfo:
+        h = self.hinfo_cache.get(oid)
+        if h is None:
+            raw = self.pg.local_getattr(oid, ec_transaction.HINFO_KEY)
+            if raw is not None:
+                h = ec_util.HashInfo.from_dict(json.loads(
+                    raw.decode() if isinstance(raw, bytes) else raw))
+            else:
+                h = ec_util.HashInfo(self.n)
+            self.hinfo_cache[oid] = h
+        return h
+
+    # =================================================================
+    # write pipeline (primary)
+    # =================================================================
+
+    def submit_transaction(self, pg_txn, at_version: int,
+                           on_commit) -> int:
+        tid = next(self._tids)
+        op = _InflightWrite(tid, pg_txn, at_version, on_commit)
+        with self.lock:
+            self.waiting_state.append(op)
+        self.check_ops()
+        return tid
+
+    def check_ops(self) -> None:
+        """Advance every queue as far as possible (check_ops :2065)."""
+        while self._try_state_to_reads():
+            pass
+        while self._try_reads_to_commit():
+            pass
+
+    def _try_state_to_reads(self) -> bool:
+        with self.lock:
+            if not self.waiting_state:
+                return False
+            op = self.waiting_state[0]
+            op.plan = ec_transaction.get_write_plan(
+                self.sinfo, op.pg_txn, self.get_hinfo)
+            op.pin = self.cache.open_write_pin(op.tid)
+            must_read_total = 0
+            for oid, to_read in op.plan.to_read.items():
+                will_write = op.plan.will_write.get(oid) or IntervalSet()
+                must = self.cache.reserve_extents_for_rmw(
+                    oid, op.pin, to_read, will_write)
+                if must:
+                    op.must_read[oid] = must
+                    must_read_total += 1
+            for oid in op.plan.will_write:
+                if oid not in op.plan.to_read:
+                    self.cache.reserve_extents_for_rmw(
+                        oid, op.pin, IntervalSet(),
+                        op.plan.will_write[oid])
+            self.waiting_state.pop(0)
+            op.state = "reads"
+            self.waiting_reads.append(op)
+            launch = dict(op.must_read)
+        # launch RMW readbacks outside the lock
+        for oid, must in launch.items():
+            for off, length in must:
+                op.pending_reads += 1
+                self._start_read(oid, off, length,
+                                 lambda data, o=op, i=oid, f=off:
+                                 self._rmw_read_done(o, i, f, data),
+                                 internal=True)
+        return True
+
+    def _rmw_read_done(self, op, oid, off, data) -> None:
+        with self.lock:
+            if data is not None:
+                self.cache.present_read(oid, off, data)
+            op.pending_reads -= 1
+        self.check_ops()
+
+    def _try_reads_to_commit(self) -> bool:
+        with self.lock:
+            if not self.waiting_reads:
+                return False
+            op = self.waiting_reads[0]
+            if op.pending_reads > 0:
+                return False
+            self.waiting_reads.pop(0)
+            op.state = "commit"
+            # collect cached extents for the planner
+            partial = {}
+            for oid, to_read in op.plan.to_read.items():
+                partial[oid] = self.cache.get_remaining_extents_for_rmw(
+                    oid, to_read)
+            shards = self.pg.acting_shards()     # shard -> osd (may hole)
+            txns, written = ec_transaction.generate_transactions(
+                op.plan, self.codec, self.sinfo, partial,
+                list(range(self.n)), self.pg.cid_of_shard)
+            for oid, wmap in written.items():
+                self.cache.present_rmw_update(oid, wmap)
+            op.pending_commits = {s for s, osd in shards.items()
+                                  if osd != CRUSH_ITEM_NONE}
+            self.waiting_commit.append(op)
+            log_entry = [(op.at_version, oid, "modify")
+                         for oid in op.plan.t.op_map]
+        for shard, osd in shards.items():
+            if osd == CRUSH_ITEM_NONE:
+                continue
+            msg = MOSDECSubOpWrite(
+                pgid=self.pg.pgid, shard=shard, from_osd=self.pg.whoami,
+                tid=op.tid, at_version=op.at_version,
+                log_entries=log_entry,
+                txn_ops=txns[shard].ops, map_epoch=self.pg.map_epoch())
+            if osd == self.pg.whoami:
+                self.handle_sub_write(msg, local=True)
+            else:
+                self.pg.send_to_osd(osd, msg)
+        return True
+
+    def _try_finish_rmw(self, op) -> None:
+        with self.lock:
+            if op.pending_commits:
+                return
+            if op in self.waiting_commit:
+                self.waiting_commit.remove(op)
+            self.cache.release_write_pin(op.pin)
+            on_commit = op.on_commit
+        if on_commit:
+            on_commit()
+        self.check_ops()
+
+    # -- replica side --------------------------------------------------
+
+    def handle_sub_write(self, msg, local: bool = False) -> None:
+        """Apply a shard transaction + log, then ack (:917-979)."""
+        txn = Transaction()
+        txn.ops = list(msg.txn_ops)
+        self.pg.log_operation(msg.log_entries, msg.at_version, msg.shard)
+        done = threading.Event()
+
+        def on_commit():
+            reply = MOSDECSubOpWriteReply(
+                pgid=self.pg.pgid, shard=msg.shard,
+                from_osd=self.pg.whoami, tid=msg.tid,
+                committed=True, applied=True)
+            if local:
+                self.handle_sub_write_reply(reply)
+            else:
+                self.pg.send_to_osd(msg.from_osd, reply)
+            done.set()
+
+        txn.register_on_commit(on_commit)
+        self.pg.store.queue_transaction(txn)
+
+    def handle_sub_write_reply(self, msg) -> None:
+        target = None
+        with self.lock:
+            for op in self.waiting_commit:
+                if op.tid == msg.tid:
+                    op.pending_commits.discard(msg.shard)
+                    target = op
+                    break
+        if target is not None:
+            self._try_finish_rmw(target)
+
+    # =================================================================
+    # read path
+    # =================================================================
+
+    def objects_read(self, oid, off: int, length: int, on_done) -> None:
+        """Async logical read [off, off+length) -> on_done(bytes|None).
+
+        Sub-reads the covering chunk range from the available shards
+        (data shards when whole, any k when degraded), decodes if any
+        data shard is missing, slices the requested range."""
+        self._start_read(oid, off, length, on_done)
+
+    def _start_read(self, oid, off, length, on_done,
+                    internal: bool = False) -> None:
+        size = self._object_logical_size(oid)
+        if size == 0:
+            on_done(b"" if not internal else None)
+            return
+        if length == 0:
+            length = max(0, size - off)
+        end = min(off + length, size)
+        if off >= end:
+            on_done(b"")
+            return
+        stripe_off, stripe_len = self.sinfo.offset_len_to_stripe_bounds(
+            (off, end - off))
+        chunk_off = self.sinfo.aligned_logical_offset_to_chunk_offset(
+            stripe_off)
+        chunk_len = self.sinfo.aligned_logical_offset_to_chunk_offset(
+            stripe_len)
+
+        shards_avail = self.pg.acting_shards()
+        avail = {s for s, osd in shards_avail.items()
+                 if osd != CRUSH_ITEM_NONE}
+        want = {self.codec.chunk_index(i) for i in range(self.k)}
+        try:
+            to_read = self.codec.minimum_to_decode(want, avail)
+        except Exception:
+            on_done(None)
+            return
+
+        tid = next(self._tids)
+        read = _InflightRead(tid, oid, off, end - off, on_done)
+        read.want_shards = set(to_read)
+        read.chunk_off = chunk_off
+        read.chunk_len = chunk_len
+        with self.lock:
+            self.inflight_reads[tid] = read
+        for shard in to_read:
+            osd = shards_avail[shard]
+            msg = MOSDECSubOpRead(
+                pgid=self.pg.pgid, shard=shard, from_osd=self.pg.whoami,
+                tid=tid, to_read=[(oid, chunk_off, chunk_len, 0)],
+                map_epoch=self.pg.map_epoch())
+            if osd == self.pg.whoami:
+                self.handle_sub_read(msg, local=True)
+            else:
+                self.pg.send_to_osd(osd, msg)
+
+    def _object_logical_size(self, oid) -> int:
+        return self.get_hinfo(oid).get_total_logical_size(self.sinfo)
+
+    def handle_sub_read(self, msg, local: bool = False) -> None:
+        """Raw per-shard store read (:982-1012) — no decode here."""
+        reply = MOSDECSubOpReadReply(
+            pgid=self.pg.pgid, shard=msg.shard, from_osd=self.pg.whoami,
+            tid=msg.tid)
+        for oid, chunk_off, chunk_len, _flags in msg.to_read:
+            try:
+                data = self.pg.local_read_shard(msg.shard, oid,
+                                                chunk_off, chunk_len)
+                if chunk_len and len(data) < chunk_len:
+                    # shard shorter than requested (e.g. mid-recovery):
+                    # zero-pad so decode sees equal-length streams
+                    data = data + b"\0" * (chunk_len - len(data))
+                reply.buffers_read.setdefault(oid, []).append(
+                    (chunk_off, data))
+            except (OSError, KeyError) as e:
+                reply.errors[oid] = getattr(e, "errno", None) or 5
+        for name in msg.attrs_to_read:
+            reply.attrs_read[name] = self.pg.local_getattr(
+                msg.to_read[0][0], name)
+        if local:
+            self.handle_sub_read_reply(reply)
+        else:
+            self.pg.send_to_osd(msg.from_osd, reply)
+
+    def handle_sub_read_reply(self, msg) -> None:
+        with self.lock:
+            read = self.inflight_reads.get(msg.tid)
+            if read is None:
+                return
+            if msg.errors:
+                read.errors[msg.shard] = msg.errors
+                # error on a shard: try to substitute another shard
+                shards_avail = self.pg.acting_shards()
+                avail = {s for s, osd in shards_avail.items()
+                         if osd != CRUSH_ITEM_NONE
+                         and s not in read.errors
+                         and s not in read.want_shards}
+                if avail:
+                    sub = min(avail)
+                    read.want_shards.discard(msg.shard)
+                    read.want_shards.add(sub)
+                    resend = (sub, shards_avail[sub])
+                else:
+                    self.inflight_reads.pop(msg.tid, None)
+                    on_done, read = read.on_done, None
+            else:
+                for oid, bufs in msg.buffers_read.items():
+                    data = b"".join(b for _off, b in bufs)
+                    read.shard_data[msg.shard] = data
+                resend = None
+        if read is None:
+            on_done(None)
+            return
+        if msg.errors and resend is not None:
+            sub, osd = resend
+            m = MOSDECSubOpRead(
+                pgid=self.pg.pgid, shard=sub, from_osd=self.pg.whoami,
+                tid=msg.tid,
+                to_read=[(read.oid, read.chunk_off, read.chunk_len, 0)],
+                map_epoch=self.pg.map_epoch())
+            if osd == self.pg.whoami:
+                self.handle_sub_read(m, local=True)
+            else:
+                self.pg.send_to_osd(osd, m)
+            return
+        self._maybe_complete_read(msg.tid)
+
+    def _maybe_complete_read(self, tid) -> None:
+        with self.lock:
+            read = self.inflight_reads.get(tid)
+            if read is None:
+                return
+            if set(read.shard_data) != read.want_shards:
+                return
+            self.inflight_reads.pop(tid)
+        if read.raw_shards_cb is not None:
+            read.raw_shards_cb(dict(read.shard_data))
+            return
+        # reassemble: decode the chunk streams back to logical bytes
+        try:
+            out = ec_util.decode_concat(self.sinfo, self.codec,
+                                        dict(read.shard_data))
+        except Exception:
+            read.on_done(None)
+            return
+        stripe_off = self.sinfo.aligned_chunk_offset_to_logical_offset(
+            read.chunk_off)
+        start = read.off - stripe_off
+        read.on_done(out[start:start + read.length])
+
+    # =================================================================
+    # recovery (reconstruct one shard and push it)
+    # =================================================================
+
+    def recover_object(self, oid, target_shard: int, on_done) -> None:
+        """Reconstruct target_shard's chunk stream from k survivors.
+
+        continue_recovery_op reshaped: read the full chunk streams from
+        the available shards, decode-all (ONE batched device call),
+        hand the target shard's bytes + attrs to on_done(shard_bytes)."""
+        size = self._object_logical_size(oid)
+        chunk_total = self.sinfo.aligned_logical_offset_to_chunk_offset(
+            self.sinfo.logical_to_next_stripe_offset(size))
+        if chunk_total == 0:
+            on_done(b"")
+            return
+        shards_avail = self.pg.acting_shards()
+        avail = {s for s, osd in shards_avail.items()
+                 if osd != CRUSH_ITEM_NONE and s != target_shard}
+        tid = next(self._tids)
+        read = _InflightRead(tid, oid, 0, 0, None)
+        use = tuple(sorted(avail))[:self.k]
+        if len(use) < self.k:
+            on_done(None)
+            return
+        read.want_shards = set(use)
+        read.chunk_off = 0
+        read.chunk_len = chunk_total
+
+        def finish(shard_data: dict):
+            try:
+                decoded = ec_util.decode(self.sinfo, self.codec,
+                                         shard_data,
+                                         want={target_shard})
+            except Exception:
+                on_done(None)
+                return
+            on_done(np.asarray(
+                decoded[target_shard], dtype=np.uint8).tobytes())
+
+        read.raw_shards_cb = finish
+        read.on_done = lambda _data: on_done(None)  # error path only
+        with self.lock:
+            self.inflight_reads[tid] = read
+        for shard in use:
+            osd = shards_avail[shard]
+            msg = MOSDECSubOpRead(
+                pgid=self.pg.pgid, shard=shard, from_osd=self.pg.whoami,
+                tid=tid, to_read=[(oid, 0, chunk_total, 0)],
+                map_epoch=self.pg.map_epoch())
+            if osd == self.pg.whoami:
+                self.handle_sub_read(msg, local=True)
+            else:
+                self.pg.send_to_osd(osd, msg)

@@ -1,0 +1,258 @@
+"""The OSD daemon.
+
+Role of the reference's OSD (src/osd/OSD.{h,cc}): boot (mount store,
+announce to mon, catch up on maps — OSD::init :2373), fast-dispatch
+incoming messages onto a sharded op queue keyed by PG (ms_fast_dispatch
+:6688 -> ShardedOpWQ, OSD.h:1623), heartbeat peers and report failures
+(handle_osd_ping :4731 / failure reports to the mon), react to new maps
+by re-peering every hosted PG, and serve the client/cluster/heartbeat
+traffic classes on separate messengers (src/ceph_osd.cc:461-483).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..common import Context
+from ..common.workqueue import Finisher, SafeTimer, ShardedThreadPool
+from ..mon.mon_client import MonClient
+from ..msg.message import (MOSDBoot, MOSDFailure, MOSDOpReply, MPing,
+                           MPingReply)
+from ..msg.messenger import Dispatcher, Messenger
+from ..store.mem_store import MemStore
+from .osd_map import OSDMap
+from .pg import PG
+
+__all__ = ["OSDDaemon"]
+
+
+class OSDDaemon(Dispatcher):
+    def __init__(self, whoami: int, monmap: dict,
+                 ctx: Context | None = None, store=None):
+        self.whoami = whoami
+        self.ctx = ctx or Context(name="osd.%d" % whoami)
+        conf = self.ctx.conf
+        self.finisher = Finisher("osd%d-fin" % whoami)
+        self.store = store or MemStore(self.finisher)
+        self.public_msgr = Messenger(("osd", whoami), conf=conf)
+        self.cluster_msgr = Messenger(("osd", whoami), conf=conf)
+        self.hb_msgr = Messenger(("osd", whoami), conf=conf)
+        self.monmap = dict(monmap)
+        self.mon_client = MonClient(monmap, self.public_msgr,
+                                    "osd.%d" % whoami)
+        self.osdmap = OSDMap()
+        self.pgs: dict = {}
+        self.lock = threading.RLock()
+        self.op_wq = ShardedThreadPool(
+            "osd%d-op" % whoami, conf.get_val("osd_op_num_shards"),
+            self.ctx.hbmap)
+        self.timer = SafeTimer("osd%d-timer" % whoami)
+        self.hb_peers: dict = {}       # osd -> last reply stamp
+        self.hb_pending: dict = {}     # osd -> first unacked ping stamp
+        self._running = False
+        self.stopped_pgs = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def init(self) -> None:
+        self.store.mount()
+        for msgr in (self.public_msgr, self.cluster_msgr, self.hb_msgr):
+            msgr.bind()
+            msgr.add_dispatcher_head(self)
+            msgr.start()
+        self.finisher.start()
+        self.op_wq.start()
+        self.timer.init()
+        self._running = True
+        self.mon_client.map_callbacks.append(self._on_osdmap)
+        self.mon_client.sub_want()
+        self._boot()
+        self._hb_tick()
+
+    def _boot(self) -> None:
+        self.public_msgr.send_message(
+            MOSDBoot(osd_id=self.whoami,
+                     public_addr=self.public_msgr.my_addr,
+                     cluster_addr=self.cluster_msgr.my_addr,
+                     hb_addr=self.hb_msgr.my_addr),
+            self.monmap[min(self.monmap)])
+
+    def shutdown(self) -> None:
+        self._running = False
+        self.timer.shutdown()
+        self.op_wq.stop()
+        self.finisher.stop()
+        for msgr in (self.public_msgr, self.cluster_msgr, self.hb_msgr):
+            msgr.shutdown()
+        self.store.umount()
+        self.ctx.shutdown()
+
+    # -- map handling --------------------------------------------------
+
+    def map_epoch(self) -> int:
+        return self.osdmap.epoch
+
+    def ec_profile_for(self, pool) -> dict:
+        """Resolve the pool's EC profile from the published osdmap."""
+        prof = self.osdmap.ec_profiles.get(pool.erasure_code_profile)
+        if prof is None:
+            raise KeyError("no EC profile %r" % pool.erasure_code_profile)
+        return prof
+
+    def _on_osdmap(self, newmap) -> None:
+        if newmap is None:
+            return
+        with self.lock:
+            self.osdmap = newmap
+            pgs = list(self.pgs.values())
+        for pg in pgs:
+            self.op_wq.queue(pg.pgid, pg.on_map_change)
+        self._scan_for_new_pgs()
+
+    def _scan_for_new_pgs(self) -> None:
+        """Instantiate PGs this OSD is acting in (load_pgs analog)."""
+        from .osd_map import PGID
+        m = self.osdmap
+        for pool_id, pool in m.pools.items():
+            for ps in range(pool.pg_num):
+                pgid = PGID(pool_id, ps)
+                with self.lock:
+                    if pgid in self.pgs:
+                        continue
+                up, upp, acting, actp = m.pg_to_up_acting_osds(pgid)
+                if self.whoami in acting or self.whoami in up:
+                    self._get_pg(pgid, pool)
+
+    def _get_pg(self, pgid, pool=None):
+        with self.lock:
+            pg = self.pgs.get(pgid)
+            if pg is None:
+                if pool is None:
+                    pool = self.osdmap.pools.get(pgid.pool)
+                    if pool is None:
+                        return None
+                pg = self.pgs[pgid] = PG(self, pgid, pool)
+                self.op_wq.queue(pgid, pg.on_map_change)
+        return pg
+
+    def queue_recovery(self, pg) -> None:
+        self.op_wq.queue(pg.pgid, pg.start_recovery)
+
+    # -- sends ---------------------------------------------------------
+
+    def _osd_addr(self, osd: int, kind: str):
+        addrs = self.osdmap.get_addr(osd)
+        if isinstance(addrs, dict):
+            return addrs.get(kind)
+        return addrs
+
+    def send_to_osd_cluster(self, osd: int, msg) -> None:
+        addr = self._osd_addr(osd, "cluster")
+        if addr is not None:
+            self.cluster_msgr.send_message(msg, addr)
+
+    # -- heartbeats ----------------------------------------------------
+
+    def _hb_tick(self) -> None:
+        if not self._running:
+            return
+        conf = self.ctx.conf
+        now = time.monotonic()
+        grace = conf.get_val("osd_heartbeat_grace")
+        peers = [o for o in self.osdmap.get_up_osds()
+                 if o != self.whoami]
+        for osd in peers:
+            addr = self._osd_addr(osd, "hb")
+            if addr is None:
+                continue
+            self.hb_pending.setdefault(osd, now)
+            self.hb_msgr.send_message(
+                MPing(stamp=now, epoch=self.map_epoch()), addr)
+            first_unacked = self.hb_pending[osd]
+            if now - first_unacked > grace:
+                self.ctx.dout("osd", 1,
+                              "osd.%d no reply from osd.%d for %.2fs -> "
+                              "reporting failure"
+                              % (self.whoami, osd, now - first_unacked))
+                self.public_msgr.send_message(
+                    MOSDFailure(reporter=self.whoami, target=osd,
+                                failed_for=now - first_unacked,
+                                epoch=self.map_epoch()),
+                    self.monmap[min(self.monmap)])
+                self.hb_pending[osd] = now  # don't spam
+        self.timer.add_event_after(
+            conf.get_val("osd_heartbeat_interval"), self._hb_tick)
+
+    # -- dispatch ------------------------------------------------------
+
+    def ms_dispatch(self, msg) -> bool:
+        t = msg.get_type()
+        if t == "MPing":
+            self.hb_msgr.send_message(
+                MPingReply(stamp=msg.stamp, epoch=self.map_epoch()),
+                msg.from_addr)
+            return True
+        if t == "MPingReply":
+            osd = msg.from_name[1] if msg.from_name else None
+            if osd is not None:
+                self.hb_peers[osd] = msg.stamp
+                self.hb_pending.pop(osd, None)
+            return True
+        if t == "MOSDOp":
+            self._enqueue_client_op(msg)
+            return True
+        if t in ("MOSDECSubOpWrite", "MOSDECSubOpWriteReply",
+                 "MOSDECSubOpRead", "MOSDECSubOpReadReply",
+                 "MOSDRepOp", "MOSDRepOpReply", "MOSDPGScan",
+                 "MOSDPGPush"):
+            self._enqueue_sub_op(msg)
+            return True
+        return False
+
+    def _enqueue_client_op(self, msg) -> None:
+        pg = self._get_pg(msg.pgid and self._normalize_pgid(msg.pgid))
+        client_addr = msg.from_addr
+
+        def reply(result, data):
+            self.public_msgr.send_message(
+                MOSDOpReply(tid=msg.tid, result=result, data=data,
+                            map_epoch=self.map_epoch()), client_addr)
+
+        if pg is None:
+            reply(-11, None)
+            return
+        self.op_wq.queue(pg.pgid, pg.do_op, msg, reply)
+
+    def _normalize_pgid(self, raw_pgid):
+        pool = self.osdmap.pools.get(raw_pgid.pool)
+        if pool is None:
+            return raw_pgid
+        return pool.raw_pg_to_pg(raw_pgid)
+
+    def _enqueue_sub_op(self, msg) -> None:
+        pg = self._get_pg(msg.pgid)
+        if pg is None:
+            return
+        t = msg.get_type()
+
+        def run():
+            backend = pg.backend
+            if t == "MOSDECSubOpWrite":
+                backend.handle_sub_write(msg)
+            elif t == "MOSDECSubOpWriteReply":
+                backend.handle_sub_write_reply(msg)
+            elif t == "MOSDECSubOpRead":
+                backend.handle_sub_read(msg)
+            elif t == "MOSDECSubOpReadReply":
+                backend.handle_sub_read_reply(msg)
+            elif t == "MOSDRepOp":
+                backend.handle_rep_op(msg)
+            elif t == "MOSDRepOpReply":
+                backend.handle_rep_op_reply(msg)
+            elif t == "MOSDPGScan":
+                pg.handle_scan(msg)
+            elif t == "MOSDPGPush":
+                pg.handle_push(msg)
+
+        self.op_wq.queue(msg.pgid, run)

@@ -135,6 +135,7 @@ class Incremental:
         self.old_pg_upmap_items: list[PGID] = []
         self.new_max_osd: int | None = None
         self.new_crush: CrushMap | None = None
+        self.new_ec_profiles: dict[str, dict] = {}
 
 
 class OSDMap:
@@ -152,6 +153,8 @@ class OSDMap:
         self.primary_temp: dict[PGID, int] = {}
         self.pg_upmap: dict[PGID, list] = {}
         self.pg_upmap_items: dict[PGID, list] = {}
+        # erasure-code profiles ride in the map (OSDMap::erasure_code_profiles)
+        self.ec_profiles: dict[str, dict] = {}
 
     # -- device state --------------------------------------------------
 
@@ -238,6 +241,7 @@ class OSDMap:
             self.pg_upmap_items[pgid] = list(items)
         for pgid in inc.old_pg_upmap_items:
             self.pg_upmap_items.pop(pgid, None)
+        self.ec_profiles.update(inc.new_ec_profiles)
 
     def clone(self) -> "OSDMap":
         return copy.deepcopy(self)

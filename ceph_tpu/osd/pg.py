@@ -1,0 +1,379 @@
+"""Placement group: op execution, logging, peering-lite, recovery drive.
+
+Role of the reference's PG/PrimaryLogPG (src/osd/PG.{h,cc},
+PrimaryLogPG.cc): a PG executes client ops in order through its backend
+(do_op -> execute_ctx -> submit_transaction), maintains a per-PG op log
+(PGLog), reacts to map changes (the peering statechart collapsed into
+on_map_change: new interval -> re-role -> primary drives recovery), and
+recovers missing objects by comparing inventories and pushing
+reconstructed state (the storage world's elastic recovery).
+
+Collections: one per (pg, shard) — EC shard s lives in cid
+("pg", str(pgid), s) on its host OSD; replicated uses shard -1
+(mirroring ghobject shard_id_t namespacing).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..msg.message import MOSDPGPush, MOSDPGScan
+from ..store.object_store import Transaction
+from .ec_backend import ECBackend
+from .osd_map import CRUSH_ITEM_NONE, POOL_TYPE_ERASURE
+from .pg_transaction import PGTransaction
+from .replicated_backend import ReplicatedBackend
+
+__all__ = ["PG"]
+
+VERSION_ATTR = "_v"
+
+
+class PG:
+    def __init__(self, daemon, pgid, pool):
+        self.daemon = daemon
+        self.pgid = pgid
+        self.pool = pool
+        self.whoami = daemon.whoami
+        self.store = daemon.store
+        self.lock = threading.RLock()
+        self.acting: list[int] = []
+        self.acting_primary = -1
+        self.up: list[int] = []
+        self.interval = 0
+        self.last_version = 0
+        self.pg_log: list[tuple] = []
+        self.waiting_for_active: list = []
+        if pool.is_erasure():
+            from .. import registry
+            profile = daemon.ec_profile_for(pool)
+            codec = registry.factory(profile["plugin"], dict(profile))
+            self.backend = ECBackend(self, codec, pool.stripe_width)
+        else:
+            self.backend = ReplicatedBackend(self)
+        self._ensure_collections()
+
+    # -- identity / listener interface for backends --------------------
+
+    def cid_of_shard(self, shard: int):
+        return ("pg", str(self.pgid), shard)
+
+    def my_shard(self) -> int:
+        """This OSD's shard in the acting set (-1 for replicated)."""
+        if not self.pool.is_erasure():
+            return -1
+        with self.lock:
+            for i, osd in enumerate(self.acting):
+                if osd == self.whoami:
+                    return i
+        return -1
+
+    def acting_osds(self) -> list:
+        with self.lock:
+            return list(self.acting)
+
+    def acting_shards(self) -> dict:
+        """shard -> osd (CRUSH_ITEM_NONE holes preserved for EC)."""
+        with self.lock:
+            return {i: osd for i, osd in enumerate(self.acting)}
+
+    def is_primary(self) -> bool:
+        with self.lock:
+            return self.acting_primary == self.whoami
+
+    def map_epoch(self) -> int:
+        return self.daemon.map_epoch()
+
+    def send_to_osd(self, osd: int, msg) -> None:
+        self.daemon.send_to_osd_cluster(osd, msg)
+
+    def local_read_shard(self, shard: int, oid, off: int,
+                         length: int) -> bytes:
+        if shard != -1 and self.pool.is_erasure():
+            # replicas serve THEIR shard; the cid names it explicitly
+            return self.store.read(self.cid_of_shard(shard), oid, off,
+                                   length)
+        return self.store.read(self.cid_of_shard(-1), oid, off, length)
+
+    def local_getattr(self, oid, name):
+        shard = self.my_shard()
+        try:
+            return self.store.getattr(self.cid_of_shard(shard), oid, name)
+        except KeyError:
+            return None
+
+    def log_operation(self, log_entries, at_version, shard) -> None:
+        with self.lock:
+            self.pg_log.extend(log_entries)
+            self.last_version = max(self.last_version, at_version)
+
+    def _ensure_collections(self) -> None:
+        txn = Transaction()
+        if self.pool.is_erasure():
+            for shard in range(self.pool.size):
+                txn.create_collection(self.cid_of_shard(shard))
+        txn.create_collection(self.cid_of_shard(-1))
+        self.store.queue_transaction(txn)
+
+    # -- peering-lite --------------------------------------------------
+
+    def on_map_change(self) -> None:
+        m = self.daemon.osdmap
+        up, upp, acting, actp = m.pg_to_up_acting_osds(self.pgid)
+        with self.lock:
+            changed = acting != self.acting or actp != self.acting_primary
+            self.up = up
+            self.acting = acting
+            self.acting_primary = actp
+            if changed:
+                self.interval += 1
+            waiting, self.waiting_for_active = \
+                self.waiting_for_active, []
+        if changed and self.is_primary():
+            self.daemon.queue_recovery(self)
+        for fn in waiting:
+            fn()
+
+    def active_for_write(self) -> bool:
+        with self.lock:
+            alive = sum(1 for o in self.acting if o != CRUSH_ITEM_NONE)
+            return alive >= self.pool.min_size and self.is_primary()
+
+    def active_for_read(self) -> bool:
+        with self.lock:
+            alive = sum(1 for o in self.acting if o != CRUSH_ITEM_NONE)
+            if self.pool.is_erasure():
+                k = self.backend.codec.get_data_chunk_count()
+                return alive >= k and self.is_primary()
+            return self.is_primary()
+
+    # -- client op execution (PrimaryLogPG::do_op collapsed) -----------
+
+    def do_op(self, msg, reply_fn) -> None:
+        if not self.is_primary():
+            reply_fn(-11, None)  # EAGAIN: wrong primary / not peered
+            return
+        reads = [op for op in msg.ops if op[0] in
+                 ("read", "stat", "getxattr", "omap_get", "list")]
+        if reads and len(reads) == len(msg.ops):
+            self._do_read_ops(msg, reply_fn)
+            return
+        if not self.active_for_write():
+            # hold until peered enough (waiting_for_active)
+            with self.lock:
+                self.waiting_for_active.append(
+                    lambda: self.do_op(msg, reply_fn))
+            return
+        self._do_write_ops(msg, reply_fn)
+
+    def _do_read_ops(self, msg, reply_fn) -> None:
+        if not self.active_for_read():
+            with self.lock:
+                self.waiting_for_active.append(
+                    lambda: self.do_op(msg, reply_fn))
+            return
+        op = msg.ops[0]
+        kind = op[0]
+        oid = msg.oid
+        if kind == "stat":
+            size = self._object_size(oid)
+            if size is None:
+                reply_fn(-2, None)
+            else:
+                reply_fn(0, {"size": size})
+            return
+        if kind == "getxattr":
+            cid = self.cid_of_shard(self.my_shard())
+            try:
+                reply_fn(0, self.store.getattr(cid, oid, op[1]))
+            except KeyError:
+                reply_fn(-2, None)
+            return
+        if kind == "omap_get":
+            cid = self.cid_of_shard(self.my_shard())
+            try:
+                reply_fn(0, self.store.omap_get(cid, oid))
+            except KeyError:
+                reply_fn(-2, None)
+            return
+        if kind == "list":
+            cid = self.cid_of_shard(self.my_shard())
+            reply_fn(0, self.store.list_objects(cid))
+            return
+        # read (off, len)
+        if self._object_size(oid) is None:
+            reply_fn(-2, None)
+            return
+        off, length = op[1], op[2]
+        self.backend.objects_read(
+            oid, off, length,
+            lambda data: reply_fn(0 if data is not None else -5, data))
+
+    def _object_size(self, oid):
+        if self.pool.is_erasure():
+            h = self.backend.get_hinfo(oid)
+            if h.get_total_chunk_size() == 0:
+                # distinguish empty object from absent
+                st = self.store.stat(self.cid_of_shard(self.my_shard()),
+                                     oid)
+                return 0 if st is not None else None
+            # logical size tracked via size xattr for exactness
+            raw = self.local_getattr(oid, "_size")
+            if raw is not None:
+                return int(raw)
+            return h.get_total_logical_size(self.backend.sinfo)
+        st = self.store.stat(self.cid_of_shard(-1), oid)
+        return st["size"] if st is not None else None
+
+    def _do_write_ops(self, msg, reply_fn) -> None:
+        t = PGTransaction()
+        oid = msg.oid
+        logical_size = self._object_size(oid) or 0
+        for op in msg.ops:
+            kind = op[0]
+            if kind == "create":
+                t.create(oid)
+            elif kind == "write":
+                t.write(oid, op[1], op[2])
+                logical_size = max(logical_size, op[1] + len(op[2]))
+            elif kind == "writefull":
+                if self._object_size(oid) is not None:
+                    t.remove(oid)
+                t.create(oid)
+                t.write(oid, 0, op[1])
+                logical_size = len(op[1])
+            elif kind == "append":
+                t.write(oid, logical_size, op[1])
+                logical_size += len(op[1])
+            elif kind == "zero":
+                t.zero(oid, op[1], op[2])
+            elif kind == "truncate":
+                t.truncate(oid, op[1])
+                logical_size = op[1]
+            elif kind == "remove":
+                t.remove(oid)
+                logical_size = 0
+            elif kind == "setxattr":
+                t.setattr(oid, op[1], op[2])
+            elif kind == "rmxattr":
+                t.rmattr(oid, op[1])
+            elif kind == "omap_set":
+                t.omap_setkeys(oid, op[1])
+            elif kind == "omap_rm":
+                t.omap_rmkeys_op(oid, op[1])
+            else:
+                reply_fn(-95, None)  # EOPNOTSUPP
+                return
+        with self.lock:
+            self.last_version += 1
+            version = self.last_version
+        # version + logical size ride as xattrs on every shard
+        still_exists = not (len(msg.ops) == 1 and msg.ops[0][0] == "remove")
+        if still_exists:
+            t.setattr(oid, VERSION_ATTR, str(version).encode())
+            t.setattr(oid, "_size", str(logical_size).encode())
+        self.backend.submit_transaction(
+            t, version, lambda: reply_fn(0, version))
+
+    # -- recovery (primary-driven) -------------------------------------
+
+    def start_recovery(self) -> None:
+        """Ask every acting peer for its inventory; push what's missing."""
+        if not self.is_primary():
+            return
+        shards = self.acting_shards()
+        for shard, osd in shards.items():
+            if osd == CRUSH_ITEM_NONE or osd == self.whoami:
+                continue
+            self.send_to_osd(osd, MOSDPGScan(
+                pgid=self.pgid, from_osd=self.whoami, shard=shard,
+                op="request", map_epoch=self.map_epoch()))
+        # also reconcile our own shard(s) synchronously
+        my_inv = self._local_inventory(self.my_shard())
+        self._reconcile_inventory(self.my_shard(), self.whoami, my_inv)
+
+    def _local_inventory(self, shard: int) -> dict:
+        cid = self.cid_of_shard(shard)
+        inv = {}
+        for oid in self.store.list_objects(cid):
+            try:
+                raw = self.store.getattr(cid, oid, VERSION_ATTR)
+                inv[oid] = int(raw) if raw else 0
+            except KeyError:
+                inv[oid] = 0
+        return inv
+
+    def handle_scan(self, msg) -> None:
+        if msg.op == "request":
+            # a replica answers with its shard's inventory
+            inv = self._local_inventory(
+                msg.shard if self.pool.is_erasure() else -1)
+            self.send_to_osd(msg.from_osd, MOSDPGScan(
+                pgid=self.pgid, from_osd=self.whoami, shard=msg.shard,
+                op="reply", objects=inv, map_epoch=self.map_epoch()))
+            return
+        # primary side: compare against authoritative inventory
+        self._reconcile_inventory(msg.shard, msg.from_osd, msg.objects)
+
+    def _authoritative_inventory(self) -> dict:
+        """Union of all local shard inventories (primary's knowledge)."""
+        out = {}
+        if self.pool.is_erasure():
+            for shard in range(self.pool.size):
+                for oid, v in self._local_inventory(shard).items():
+                    out[oid] = max(out.get(oid, 0), v)
+        for oid, v in self._local_inventory(-1).items():
+            out[oid] = max(out.get(oid, 0), v)
+        return out
+
+    def _reconcile_inventory(self, shard: int, peer_osd: int,
+                             peer_inv: dict) -> None:
+        want = self._authoritative_inventory()
+        missing = [oid for oid, v in want.items()
+                   if peer_inv.get(oid, -1) < v]
+        for oid in missing:
+            self._push_object(oid, shard, peer_osd)
+
+    def _push_object(self, oid, shard: int, peer_osd: int) -> None:
+        src_cid = self.cid_of_shard(
+            self.my_shard() if self.pool.is_erasure() else -1)
+        try:
+            attrs = {}
+            for name in (VERSION_ATTR, "_size",
+                         "hinfo_key"):
+                val = self.store.getattr(src_cid, oid, name)
+                if val is not None:
+                    attrs[name] = val
+            omap = self.store.omap_get(src_cid, oid)
+        except KeyError:
+            attrs, omap = {}, {}
+
+        def on_data(data):
+            if data is None:
+                return
+            version = int(attrs.get(VERSION_ATTR, b"0") or 0)
+            msg = MOSDPGPush(
+                pgid=self.pgid, from_osd=self.whoami, shard=shard,
+                oid=oid, data=data, attrs=attrs, omap=omap,
+                version=version, map_epoch=self.map_epoch())
+            if peer_osd == self.whoami:
+                self.handle_push(msg)
+            else:
+                self.send_to_osd(peer_osd, msg)
+
+        self.backend.recover_object(oid, shard, on_data)
+
+    def handle_push(self, msg) -> None:
+        """Apply a recovery push to the local shard store."""
+        cid = self.cid_of_shard(
+            msg.shard if self.pool.is_erasure() else -1)
+        txn = Transaction()
+        txn.remove(cid, msg.oid)
+        txn.touch(cid, msg.oid)
+        if msg.data:
+            txn.write(cid, msg.oid, 0, msg.data)
+        for name, val in msg.attrs.items():
+            txn.setattr(cid, msg.oid, name, val)
+        if msg.omap:
+            txn.omap_setkeys(cid, msg.oid, msg.omap)
+        self.store.queue_transaction(txn)

@@ -1,0 +1,142 @@
+"""Replicated PG backend.
+
+Role of the reference's ReplicatedBackend (src/osd/ReplicatedBackend.cc):
+the primary applies the logical transaction locally, fans MOSDRepOp with
+the physical ops to every replica, and completes the client op when all
+acting replicas commit. Reads are local to the primary. Recovery is
+push-based: the primary sends the whole object state.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+from ..msg.message import MOSDRepOp, MOSDRepOpReply
+from ..store.object_store import Transaction
+
+__all__ = ["ReplicatedBackend"]
+
+
+class _Inflight:
+    def __init__(self, tid, on_commit, waiting_on):
+        self.tid = tid
+        self.on_commit = on_commit
+        self.waiting_on = set(waiting_on)
+
+
+class ReplicatedBackend:
+    def __init__(self, pg):
+        self.pg = pg
+        self._tids = itertools.count(1)
+        self.lock = threading.RLock()
+        self.inflight: dict[int, _Inflight] = {}
+
+    # -- write ---------------------------------------------------------
+
+    def submit_transaction(self, pg_txn, at_version: int,
+                           on_commit) -> int:
+        tid = next(self._tids)
+        txn = self._physical_txn(pg_txn)
+        peers = [o for o in self.pg.acting_osds() if o >= 0]
+        log_entries = [(at_version, oid, "modify")
+                       for oid in pg_txn.op_map]
+        op = _Inflight(tid, on_commit, peers)
+        with self.lock:
+            self.inflight[tid] = op
+        for osd in peers:
+            msg = MOSDRepOp(pgid=self.pg.pgid, from_osd=self.pg.whoami,
+                            tid=tid, at_version=at_version,
+                            log_entries=log_entries, txn_ops=txn.ops,
+                            map_epoch=self.pg.map_epoch())
+            if osd == self.pg.whoami:
+                self.handle_rep_op(msg, local=True)
+            else:
+                self.pg.send_to_osd(osd, msg)
+        return tid
+
+    def _physical_txn(self, pg_txn) -> Transaction:
+        """Logical -> physical is 1:1 for replication (no striping)."""
+        cid = self.pg.cid_of_shard(-1)
+        txn = Transaction()
+        for oid, op in pg_txn.safe_create_traverse():
+            if op.deletes_first():
+                txn.remove(cid, oid)
+            if op.init_type == "create":
+                txn.touch(cid, oid)
+            elif op.init_type == "clone":
+                txn.clone(cid, op.source, oid)
+            elif op.init_type == "rename":
+                txn.collection_move_rename(cid, op.source, cid, oid)
+            if op.truncate is not None:
+                txn.truncate(cid, oid, op.truncate[0])
+            for upd in op.buffer_updates:
+                if upd[0] == "write":
+                    txn.write(cid, oid, upd[1], upd[2])
+                else:
+                    txn.zero(cid, oid, upd[1], upd[2])
+            if op.truncate is not None and \
+                    op.truncate[1] != op.truncate[0]:
+                txn.truncate(cid, oid, op.truncate[1])
+            for name, value in op.attr_updates.items():
+                if value is None:
+                    txn.rmattr(cid, oid, name)
+                else:
+                    txn.setattr(cid, oid, name, value)
+            if op.omap_updates:
+                txn.omap_setkeys(cid, oid, op.omap_updates)
+            if op.omap_rmkeys:
+                txn.omap_rmkeys(cid, oid, op.omap_rmkeys)
+        return txn
+
+    # -- replica -------------------------------------------------------
+
+    def handle_rep_op(self, msg, local: bool = False) -> None:
+        txn = Transaction()
+        txn.ops = list(msg.txn_ops)
+        self.pg.log_operation(msg.log_entries, msg.at_version, -1)
+
+        def on_commit():
+            reply = MOSDRepOpReply(pgid=self.pg.pgid,
+                                   from_osd=self.pg.whoami,
+                                   tid=msg.tid, committed=True)
+            if local:
+                self.handle_rep_op_reply(reply)
+            else:
+                self.pg.send_to_osd(msg.from_osd, reply)
+
+        txn.register_on_commit(on_commit)
+        self.pg.store.queue_transaction(txn)
+
+    def handle_rep_op_reply(self, msg) -> None:
+        with self.lock:
+            op = self.inflight.get(msg.tid)
+            if op is None:
+                return
+            op.waiting_on.discard(msg.from_osd)
+            if op.waiting_on:
+                return
+            self.inflight.pop(msg.tid, None)
+        if op.on_commit:
+            op.on_commit()
+
+    # -- read ----------------------------------------------------------
+
+    def objects_read(self, oid, off: int, length: int, on_done) -> None:
+        try:
+            data = self.pg.local_read_shard(-1, oid, off, length)
+        except (OSError, KeyError):
+            on_done(None)
+            return
+        on_done(data)
+
+    # -- recovery ------------------------------------------------------
+
+    def recover_object(self, oid, target_shard: int, on_done) -> None:
+        """Full-copy push source: the primary's bytes ARE the object."""
+        try:
+            data = self.pg.local_read_shard(-1, oid, 0, 0)
+        except (OSError, KeyError):
+            on_done(None)
+            return
+        on_done(data)

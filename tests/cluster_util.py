@@ -1,0 +1,172 @@
+"""In-process mini-cluster: mons + osds + client on localhost.
+
+The framework's qa/standalone/ceph-helpers.sh (run_mon/run_osd)
+equivalent: boots a monitor quorum and N OSD daemons in one process,
+waits for the map to settle, hands out connected clients. Used by the
+integration and thrash tests.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+from ceph_tpu.client import RadosClient
+from ceph_tpu.common import Context
+from ceph_tpu.mon import Monitor
+from ceph_tpu.osd.osd_daemon import OSDDaemon
+
+
+def free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def wait_until(fn, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class MiniCluster:
+    def __init__(self, num_mons=1, num_osds=3, conf_overrides=None):
+        self.conf_overrides = conf_overrides or {}
+        self.monmap = {r: ("127.0.0.1", p)
+                       for r, p in enumerate(free_ports(num_mons))}
+        self.mons = []
+        self.osds: dict[int, OSDDaemon] = {}
+        self.clients = []
+        self.num_osds = num_osds
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self):
+        for rank in self.monmap:
+            mon = Monitor(rank, self.monmap,
+                          Context(self.conf_overrides,
+                                  name="mon.%d" % rank))
+            mon.init()
+            self.mons.append(mon)
+        assert wait_until(
+            lambda: any(m.is_leader() for m in self.mons)), \
+            "no mon leader"
+        for osd_id in range(self.num_osds):
+            self.start_osd(osd_id)
+        assert wait_until(self.all_osds_up, timeout=15), \
+            "osds never came up: %s" % self.leader().osdmon._dump()
+        return self
+
+    def start_osd(self, osd_id: int, store=None) -> OSDDaemon:
+        osd = OSDDaemon(osd_id, self.monmap,
+                        Context(self.conf_overrides,
+                                name="osd.%d" % osd_id), store=store)
+        osd.init()
+        self.osds[osd_id] = osd
+        return osd
+
+    def stop_osd(self, osd_id: int, hard: bool = True):
+        """Kill an osd (thrasher kill_osd analog). Keeps the store so a
+        revive keeps its data."""
+        osd = self.osds.pop(osd_id, None)
+        if osd is None:
+            return None
+        store = osd.store
+        osd.shutdown()
+        return store
+
+    def revive_osd(self, osd_id: int, store=None):
+        return self.start_osd(osd_id, store=store)
+
+    def leader(self) -> Monitor:
+        for m in self.mons:
+            if m.is_leader():
+                return m
+        return self.mons[0]
+
+    def all_osds_up(self) -> bool:
+        m = self.leader().osdmon.osdmap
+        return all(m.is_up(o) for o in self.osds)
+
+    def osdmap_epoch(self) -> int:
+        return self.leader().osdmon.osdmap.epoch
+
+    def client(self) -> RadosClient:
+        client = RadosClient(self.monmap,
+                             Context(self.conf_overrides,
+                                     name="client.%d"
+                                     % len(self.clients)),
+                             client_id=len(self.clients))
+        client.connect()
+        self.clients.append(client)
+        return client
+
+    # -- pool helpers --------------------------------------------------
+
+    def create_replicated_pool(self, client, name, size=3, pg_num=8):
+        res, outs, pool_id = client.mon_command({
+            "prefix": "osd pool create", "pool": name, "size": size,
+            "pg_num": pg_num})
+        assert res == 0, outs
+        self._wait_pool(client, name)
+        return pool_id
+
+    def create_ec_pool(self, client, name, profile, pg_num=8,
+                       profile_name=None):
+        profile_name = profile_name or (name + "-profile")
+        res, outs, _ = client.mon_command({
+            "prefix": "osd erasure-code-profile set",
+            "name": profile_name, "profile": profile})
+        assert res == 0, outs
+        res, outs, pool_id = client.mon_command({
+            "prefix": "osd pool create", "pool": name,
+            "pool_type": "erasure", "erasure_code_profile": profile_name,
+            "pg_num": pg_num})
+        assert res == 0, outs
+        self._wait_pool(client, name)
+        return pool_id
+
+    def _wait_pool(self, client, name):
+        def ready():
+            m = client.osdmap
+            return m is not None and any(p.name == name
+                                         for p in m.pools.values())
+        assert wait_until(ready), "pool %s never appeared" % name
+
+    def wait_clean(self, pool_id: int, timeout=20.0) -> bool:
+        """All PGs of the pool have a full healthy acting set."""
+        from ceph_tpu.osd.osd_map import CRUSH_ITEM_NONE, PGID
+
+        def clean():
+            m = self.leader().osdmon.osdmap
+            pool = m.pools.get(pool_id)
+            if pool is None:
+                return False
+            for ps in range(pool.pg_num):
+                up, upp, acting, actp = m.pg_to_up_acting_osds(
+                    PGID(pool_id, ps))
+                if len(acting) < pool.size or actp == -1:
+                    return False
+                if any(o == CRUSH_ITEM_NONE for o in acting):
+                    return False
+            return True
+        return wait_until(clean, timeout)
+
+    def stop(self):
+        for client in self.clients:
+            client.shutdown()
+        for osd in list(self.osds.values()):
+            osd.shutdown()
+        self.osds.clear()
+        for mon in self.mons:
+            mon.shutdown()
+        self.mons.clear()
