@@ -87,10 +87,16 @@ class Connection:
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
         self.out_q: list = []
+        self.peer_name = None
         self.closed = False
         self.writer = threading.Thread(target=self._writer_loop,
                                        daemon=True)
         self.reader: threading.Thread | None = None
+
+    def __repr__(self):
+        return "<Connection peer=%s name=%s%s>" % (
+            self.peer_addr, self.peer_name,
+            " closed" if self.closed else "")
 
     def start(self) -> None:
         self.writer.start()
@@ -117,6 +123,13 @@ class Connection:
                                             timeout=5.0)
             sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # banner (the msgr protocol's handshake): advertise our
+            # bound address so the acceptor can route replies back over
+            # this same connection (Ceph learns the peer_addr during the
+            # connect handshake; replies never dial the ephemeral port)
+            sock.sendall(_encode(
+                ("BANNER", tuple(self.msgr.my_addr or ("", 0)),
+                 self.msgr.name)))
             self.sock = sock
             self._start_reader()
             return True
@@ -149,13 +162,16 @@ class Connection:
             delay = self.msgr._inject_delay()
             if delay:
                 time.sleep(delay)
+            sock = self.sock
+            if sock is None:
+                continue  # reader tore it down mid-flight; reconnect
             try:
-                self.sock.sendall(_encode(msg))
+                sock.sendall(_encode(msg))
                 with self.lock:
                     self.out_q.pop(0)
             except OSError:
                 try:
-                    self.sock.close()
+                    sock.close()
                 except OSError:
                     pass
                 self.sock = None
@@ -185,6 +201,14 @@ class Connection:
             try:
                 msg = pickle.loads(payload)
             except Exception:
+                continue
+            if (isinstance(msg, tuple) and len(msg) == 3
+                    and msg[0] == "BANNER"):
+                # acceptor side: adopt the peer's advertised listening
+                # address and register so sends to it reuse this pipe
+                self.peer_addr = EntityAddr(*msg[1])
+                self.peer_name = msg[2]
+                self.msgr._register_inbound(self)
                 continue
             msg.from_addr = self.peer_addr
             self.msgr._dispatch(msg)
@@ -288,6 +312,14 @@ class Messenger:
                 import traceback
                 traceback.print_exc()
                 return
+
+    def _register_inbound(self, conn: Connection) -> None:
+        """Route future sends to this peer over its inbound connection
+        (unless we already dialed them ourselves)."""
+        with self._lock:
+            existing = self._conns.get(conn.peer_addr)
+            if existing is None or existing.closed:
+                self._conns[conn.peer_addr] = conn
 
     def _notify_reset(self, addr) -> None:
         for d in self.dispatchers:

@@ -120,7 +120,12 @@ def decode(sinfo: StripeInfo, codec, to_decode: dict,
     """
     if not to_decode:
         raise ErasureCodeError(22, "decode with no chunks")
-    lengths = {len(np.asarray(v).reshape(-1)) for v in to_decode.values()}
+    to_decode = {
+        shard: (np.frombuffer(v, dtype=np.uint8)
+                if isinstance(v, (bytes, bytearray, memoryview))
+                else np.asarray(v, dtype=np.uint8).reshape(-1))
+        for shard, v in to_decode.items()}
+    lengths = {v.size for v in to_decode.values()}
     if len(lengths) != 1:
         raise ErasureCodeError(22, "chunks have unequal lengths %s" % lengths)
     total = lengths.pop()
@@ -134,12 +139,8 @@ def decode(sinfo: StripeInfo, codec, to_decode: dict,
     stripes = total // sinfo.chunk_size
 
     inv = {codec.chunk_index(i): i for i in range(n)}
-    logical = {}
-    for shard, buf in to_decode.items():
-        arr = np.frombuffer(buf, dtype=np.uint8) if isinstance(
-            buf, (bytes, bytearray, memoryview)) else \
-            np.asarray(buf, dtype=np.uint8).reshape(-1)
-        logical[inv[shard]] = arr.reshape(stripes, sinfo.chunk_size)
+    logical = {inv[shard]: buf.reshape(stripes, sinfo.chunk_size)
+               for shard, buf in to_decode.items()}
 
     have = set(to_decode)
     if want <= have:
@@ -158,7 +159,7 @@ def decode(sinfo: StripeInfo, codec, to_decode: dict,
         if idx not in want:
             continue
         if idx in to_decode:
-            out[idx] = np.asarray(to_decode[idx], dtype=np.uint8).reshape(-1)
+            out[idx] = to_decode[idx]
         else:
             out[idx] = np.ascontiguousarray(full[:, i, :]).reshape(-1)
     return out

@@ -201,10 +201,20 @@ class PG:
             reply_fn(0, self.store.list_objects(cid))
             return
         # read (off, len)
-        if self._object_size(oid) is None:
+        size = self._object_size(oid)
+        if size is None:
             reply_fn(-2, None)
             return
         off, length = op[1], op[2]
+        # clamp to the LOGICAL size: the EC backend's hinfo only knows
+        # padded chunk-stream bounds (object_info_t.size analog)
+        if length == 0:
+            length = max(0, size - off)
+        else:
+            length = max(0, min(length, size - off))
+        if length == 0:
+            reply_fn(0, b"")
+            return
         self.backend.objects_read(
             oid, off, length,
             lambda data: reply_fn(0 if data is not None else -5, data))

@@ -226,6 +226,17 @@ class TestECPoolJaxTpuPlugin:
     """The north-star plugin serving a real (mini) cluster."""
 
     def test_jax_tpu_pool_roundtrip(self):
+        # pre-warm the XLA compile outside the cluster: the first encode
+        # otherwise stalls an OSD op thread past the (FAST) heartbeat
+        # grace and the mon marks the OSD down mid-test
+        from ceph_tpu import registry
+        from ceph_tpu.osd import ec_util
+        codec = registry.factory(
+            "jax_tpu", {"technique": "reed_sol_van", "k": "2", "m": "1"})
+        # warm the exact shape the in-cluster write hits (jit programs
+        # are shape-specialized): 65536 B over stripe_width 8192 = batch 8
+        sinfo = ec_util.StripeInfo(2, 8192)
+        ec_util.encode(sinfo, codec, b"\0" * 65536)
         cluster = MiniCluster(num_mons=1, num_osds=4,
                               conf_overrides=FAST).start()
         try:
