@@ -1,0 +1,122 @@
+// The native erasure-code interface + shared base.
+//
+// Semantics parity with the reference ABI
+// (/root/reference/src/erasure-code/ErasureCodeInterface.h:170-449 and
+// ErasureCode.{h,cc}): systematic chunks, profile echo, padding/alignment
+// (encode_prepare, ErasureCode.cc:122-157), greedy minimum_to_decode
+// (:91-108), chunk remapping (:235-254), decode_concat (:306-322).
+// Fresh TPU-first design: data lives in flat contiguous buffers so the
+// same pointers can be handed to the TPU batching bridge without copies.
+
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <set>
+#include <string>
+#include <vector>
+
+namespace ectpu {
+
+using Profile = std::map<std::string, std::string>;
+using Chunk = std::vector<uint8_t>;
+
+constexpr int SIMD_ALIGN = 32;  // ErasureCode.cc:30
+
+class ErasureCodeInterface {
+ public:
+  virtual ~ErasureCodeInterface() = default;
+
+  // Parse + prepare; echoes resolved defaults back into profile
+  // (registry contract, ErasureCodePlugin.cc:114-118). Returns 0 or
+  // -errno with a message in *err.
+  virtual int init(Profile& profile, std::string* err) = 0;
+
+  virtual const Profile& get_profile() const = 0;
+  virtual unsigned get_chunk_count() const = 0;
+  virtual unsigned get_data_chunk_count() const = 0;
+  unsigned get_coding_chunk_count() const {
+    return get_chunk_count() - get_data_chunk_count();
+  }
+  virtual unsigned get_chunk_size(unsigned object_size) const = 0;
+
+  virtual const std::vector<int>& get_chunk_mapping() const = 0;
+  virtual int chunk_index(int i) const = 0;
+
+  virtual int minimum_to_decode(const std::set<int>& want,
+                                const std::set<int>& available,
+                                std::set<int>* minimum) = 0;
+
+  // Encode `in[0..len)` -> chunks for `want` (chunk-mapped indices).
+  virtual int encode(const std::set<int>& want, const uint8_t* in,
+                     size_t len, std::map<int, Chunk>* encoded) = 0;
+
+  // Raw batched form: data = k pointers, parity = m pointers, each
+  // blocksize bytes, logical (unmapped) order. The TPU bridge speaks
+  // this shape.
+  virtual int encode_chunks(const uint8_t* const* data,
+                            uint8_t* const* parity, size_t blocksize) = 0;
+
+  // Reconstruct `want` from available chunks (all same length).
+  virtual int decode(const std::set<int>& want,
+                     const std::map<int, Chunk>& chunks,
+                     std::map<int, Chunk>* decoded) = 0;
+
+  virtual int decode_concat(const std::map<int, Chunk>& chunks,
+                            Chunk* out) = 0;
+};
+
+using ErasureCodeInterfaceRef = std::shared_ptr<ErasureCodeInterface>;
+
+// Shared base: profile parsing helpers + generic encode/decode built on
+// encode_chunks/apply_decode_matrix.
+class ErasureCode : public ErasureCodeInterface {
+ public:
+  int init(Profile& profile, std::string* err) override;
+  const Profile& get_profile() const override { return profile_; }
+  const std::vector<int>& get_chunk_mapping() const override {
+    return chunk_mapping_;
+  }
+  int chunk_index(int i) const override {
+    return i < (int)chunk_mapping_.size() ? chunk_mapping_[i] : i;
+  }
+  int minimum_to_decode(const std::set<int>& want,
+                        const std::set<int>& available,
+                        std::set<int>* minimum) override;
+  int encode(const std::set<int>& want, const uint8_t* in, size_t len,
+             std::map<int, Chunk>* encoded) override;
+  int decode(const std::set<int>& want, const std::map<int, Chunk>& chunks,
+             std::map<int, Chunk>* decoded) override;
+  int decode_concat(const std::map<int, Chunk>& chunks, Chunk* out) override;
+
+ protected:
+  // Subclass hooks.
+  virtual int parse(Profile& profile, std::string* err);
+  virtual int prepare(std::string* err) { (void)err; return 0; }
+  // Reconstruct all n chunk streams given k available logical rows.
+  virtual int decode_chunks(const std::vector<int>& avail_rows,
+                            const uint8_t* const* avail,
+                            std::vector<Chunk>* all, size_t blocksize) = 0;
+
+  // Profile accessors (to_int/to_bool semantics, ErasureCode.cc:256-304).
+  static int to_int(const std::string& name, Profile& profile,
+                    const char* dflt, std::string* err, int* out);
+  static bool to_bool(const std::string& name, Profile& profile,
+                      const char* dflt);
+  static std::string to_string(const std::string& name, Profile& profile,
+                               const char* dflt);
+
+  Profile profile_;
+  std::vector<int> chunk_mapping_;
+};
+
+// A named factory: one per plugin .so (ErasureCodePlugin.h:30-43).
+class ErasureCodePlugin {
+ public:
+  virtual ~ErasureCodePlugin() = default;
+  virtual int factory(Profile& profile, ErasureCodeInterfaceRef* codec,
+                      std::string* err) = 0;
+};
+
+}  // namespace ectpu

@@ -1,0 +1,76 @@
+// GF(2^w) arithmetic for the native erasure-code runtime.
+//
+// Same field conventions as the Python oracle (ceph_tpu/ops/gf.py):
+// primitive polynomials 0x11D (w=8), 0x1100B (w=16), 0x100400007 (w=32);
+// little-endian w-bit elements inside chunk buffers. Everything here must
+// stay bit-identical to ceph_tpu.ops.gf_ref — the tests cross-check.
+//
+// Role parity: the vendored gf-complete/jerasure/isa-l kernels the
+// reference links against (absent submodules; call signatures at
+// /root/reference/src/erasure-code/jerasure/ErasureCodeJerasure.cc:99-164)
+// — implemented from first principles, not copied.
+
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+namespace ectpu {
+
+// w -> primitive polynomial (with the leading x^w term present).
+uint64_t gf_poly(int w);
+
+// Scalar field ops (any w in 2..32).
+uint32_t gf_mult(uint32_t a, uint32_t b, int w);
+uint32_t gf_inv(uint32_t a, int w);
+uint32_t gf_div(uint32_t a, uint32_t b, int w);
+uint32_t gf_pow(uint32_t a, uint64_t n, int w);
+
+// dst[i] ^= g * src[i] over `n` bytes of w-bit little-endian elements.
+// The region kernel every matrix codec reduces to (ISA-L's
+// gf_vect_mad / jerasure's galois_w08_region_multiply analog).
+// n must be a multiple of w/8. g==0 is a no-op.
+void gf_region_madd(uint8_t* dst, const uint8_t* src, uint32_t g, size_t n,
+                    int w);
+
+// dst[i] = g * src[i] (overwrite variant).
+void gf_region_mul(uint8_t* dst, const uint8_t* src, uint32_t g, size_t n,
+                   int w);
+
+// dst[i] ^= src[i] over n bytes (the parity special case g==1).
+void xor_region(uint8_t* dst, const uint8_t* src, size_t n);
+
+// Dense square-matrix inverse over GF(2^w); a is row-major [n, n].
+// Returns false if singular.
+bool gf_invert_matrix(const uint32_t* a, uint32_t* inv, int n, int w);
+
+// c[i,j] = sum_GF a[i,l] * b[l,j]; a is [n,p], b is [p,m], c is [n,m].
+void gf_matmul(const uint32_t* a, const uint32_t* b, uint32_t* c, int n,
+               int p, int m, int w);
+
+// --- generator constructions (mirror ceph_tpu/ops/gf.py exactly) ---------
+
+// [m, k] systematic RS coding matrix from a Vandermonde system.
+std::vector<uint32_t> rs_vandermonde_generator(int k, int m, int w);
+// [2, k] RAID6 P+Q rows.
+std::vector<uint32_t> rs_r6_generator(int k, int w);
+// [m, k] Cauchy C[i,j] = 1/(i ^ (m+j)).
+std::vector<uint32_t> cauchy_original_generator(int k, int m, int w);
+// Cauchy with rows/cols scaled to minimize bitmatrix density.
+std::vector<uint32_t> cauchy_good_generator(int k, int m, int w);
+
+// w x w bitmatrix of "multiply by g" (column c = bits of g * x^c).
+void gf_mult_bitmatrix(uint32_t g, int w, uint8_t* out /* [w, w] */);
+
+// Expand an [rows, cols] GF generator into [rows*w, cols*w] 0/1 bitmatrix.
+std::vector<uint8_t> generator_to_bitmatrix(const uint32_t* gen, int rows,
+                                            int cols, int w);
+
+// Decode matrix: [k, k] mapping the k available logical chunk rows (sorted
+// avail, indices into 0..k+m-1 over [I; coding]) back to the data rows.
+// Returns false if singular (cannot happen for MDS generators).
+bool gf_decode_matrix(const uint32_t* coding, int k, int m,
+                      const int* avail, uint32_t* out, int w);
+
+}  // namespace ectpu

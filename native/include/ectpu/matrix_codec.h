@@ -1,0 +1,115 @@
+// Generator-matrix codecs: the native rendition of the jerasure/isa
+// technique families.
+//
+// Two encode styles, matching the Python models
+// (ceph_tpu/models/matrix_base.py) and the reference plugin
+// (/root/reference/src/erasure-code/jerasure/ErasureCodeJerasure.cc):
+//   - MatrixCodec: element-layout GF(2^w) matrix codes (reed_sol_van,
+//     reed_sol_r6_op; jerasure_matrix_encode semantics, w in {8,16,32}).
+//   - BitmatrixCodec: packet-layout XOR schedule codes (cauchy_orig,
+//     cauchy_good; jerasure_schedule_encode semantics with packetsize).
+
+#pragma once
+
+#include "ectpu/erasure_code.h"
+
+#include <map>
+
+namespace ectpu {
+
+constexpr int LARGEST_VECTOR_WORDSIZE = 16;  // ErasureCodeJerasure.cc:31
+
+class GeneratorCodec : public ErasureCode {
+ public:
+  unsigned get_chunk_count() const override { return (unsigned)(k_ + m_); }
+  unsigned get_data_chunk_count() const override { return (unsigned)k_; }
+  unsigned get_chunk_size(unsigned object_size) const override;
+
+  int k_ = 0, m_ = 0, w_ = 0;
+  bool per_chunk_alignment_ = false;
+
+ protected:
+  virtual const char* default_k() const { return "7"; }
+  virtual const char* default_m() const { return "3"; }
+  virtual const char* default_w() const { return "8"; }
+  virtual unsigned get_alignment() const = 0;
+  virtual int make_generator(std::string* err) = 0;
+
+  int parse(Profile& profile, std::string* err) override;
+  int prepare(std::string* err) override;
+
+  // Cached per-erasure-signature decode matrices, the native analog of
+  // ErasureCodeIsaTableCache (/root/reference/src/erasure-code/isa/
+  // ErasureCodeIsaTableCache.cc).
+  const std::vector<uint32_t>& decode_entry(const std::vector<int>& avail);
+
+  std::vector<uint32_t> coding_;  // [m, k] GF generator
+  std::map<std::vector<int>, std::vector<uint32_t>> decode_cache_;
+};
+
+class MatrixCodec : public GeneratorCodec {
+ public:
+  int encode_chunks(const uint8_t* const* data, uint8_t* const* parity,
+                    size_t blocksize) override;
+
+ protected:
+  unsigned get_alignment() const override;
+  int parse(Profile& profile, std::string* err) override;
+  int decode_chunks(const std::vector<int>& avail_rows,
+                    const uint8_t* const* avail, std::vector<Chunk>* all,
+                    size_t blocksize) override;
+  // apply an [rows, k] GF matrix to k source streams
+  void apply_matrix(const uint32_t* mat, int rows,
+                    const uint8_t* const* src, uint8_t* const* dst,
+                    size_t blocksize) const;
+};
+
+class BitmatrixCodec : public GeneratorCodec {
+ public:
+  int encode_chunks(const uint8_t* const* data, uint8_t* const* parity,
+                    size_t blocksize) override;
+
+  int packetsize_ = 0;
+
+ protected:
+  const char* default_packetsize() const { return "2048"; }
+  unsigned get_alignment() const override;
+  int parse(Profile& profile, std::string* err) override;
+  int prepare(std::string* err) override;
+  int decode_chunks(const std::vector<int>& avail_rows,
+                    const uint8_t* const* avail, std::vector<Chunk>* all,
+                    size_t blocksize) override;
+  // apply an [rows*w, k*w] bitmatrix as a packet XOR schedule
+  void apply_bitmatrix(const uint8_t* bitmat, int rows,
+                       const uint8_t* const* src, uint8_t* const* dst,
+                       size_t blocksize) const;
+
+  std::vector<uint8_t> encode_bitmat_;  // [m*w, k*w]
+  std::map<std::vector<int>, std::vector<uint8_t>> decode_bitmat_cache_;
+};
+
+// --- concrete techniques -------------------------------------------------
+
+class ReedSolomonVandermonde : public MatrixCodec {
+ protected:
+  int make_generator(std::string* err) override;
+};
+
+class ReedSolomonRAID6 : public MatrixCodec {
+ protected:
+  const char* default_m() const override { return "2"; }
+  int parse(Profile& profile, std::string* err) override;  // forces m=2
+  int make_generator(std::string* err) override;
+};
+
+class CauchyOrig : public BitmatrixCodec {
+ protected:
+  int make_generator(std::string* err) override;
+};
+
+class CauchyGood : public BitmatrixCodec {
+ protected:
+  int make_generator(std::string* err) override;
+};
+
+}  // namespace ectpu

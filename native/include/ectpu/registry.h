@@ -1,0 +1,61 @@
+// The dlopen plugin registry.
+//
+// ABI parity with the reference
+// (/root/reference/src/erasure-code/ErasureCodePlugin.{h,cc}): plugins
+// are shared objects named libec_<name>.so in a configured directory;
+// each exports
+//     extern "C" const char* __erasure_code_version();
+//     extern "C" int __erasure_code_init(const char* plugin_name,
+//                                        const char* directory);
+// The init entry must call ErasureCodePluginRegistry::instance().add().
+// Version mismatch fails the load (-EXDEV, ErasureCodePlugin.cc:144-149);
+// a plugin that inits without registering is -EBADF (:151-177); loaded
+// .so's are never dlclosed (disable_dlclose semantics).
+
+#pragma once
+
+#include "ectpu/erasure_code.h"
+
+#include <mutex>
+
+#define ECTPU_VERSION_STRING "1.0.0"
+
+namespace ectpu {
+
+class ErasureCodePluginRegistry {
+ public:
+  static ErasureCodePluginRegistry& instance();
+
+  // Called from a plugin's __erasure_code_init.
+  int add(const std::string& name, ErasureCodePlugin* plugin);
+  ErasureCodePlugin* get(const std::string& name);
+
+  // Load-on-demand + construct (ErasureCodePlugin.cc:92-120). The
+  // profile echo is checked: a factory that rewrites the caller's
+  // explicit parameters is a bug.
+  int factory(const std::string& name, const std::string& directory,
+              Profile& profile, ErasureCodeInterfaceRef* codec,
+              std::string* err);
+
+  int load(const std::string& name, const std::string& directory,
+           std::string* err);
+
+  int preload(const std::string& names, const std::string& directory,
+              std::string* err);
+
+  bool disable_dlclose = true;
+
+ private:
+  ErasureCodePluginRegistry() = default;
+  std::mutex lock_;
+  bool loading_ = false;
+  std::map<std::string, ErasureCodePlugin*> plugins_;
+};
+
+}  // namespace ectpu
+
+extern "C" {
+// Exported so plugins built as separate .so's resolve them from the core
+// library at load time.
+int ectpu_registry_add(const char* name, ectpu::ErasureCodePlugin* plugin);
+}

@@ -1,0 +1,52 @@
+// The host-side async batching queue bridging native OSD/benchmark
+// threads to the (Python/JAX) TPU dispatcher.
+//
+// This is the new seam SURVEY.md §7 stage 3 describes: many in-flight
+// (k, m, w, blocksize) encode requests from concurrent C++ threads are
+// coalesced into one device batch — the shape the reference's per-stripe
+// CPU loop (/root/reference/src/osd/ECUtil.cc:116) can never reach. The
+// dispatcher is registered from Python via ctypes (no pybind11 in this
+// image); when none is registered, callers fall back to the native CPU
+// kernels, which is also the monitor-side validation mode (the mon
+// instantiates plugins to validate profiles, SURVEY.md §3.5 — it must
+// never need a TPU).
+
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+typedef struct ec_tpu_request {
+  uint32_t k, m, w;
+  const char* technique;        // NUL-terminated, stable for the call
+  uint64_t blocksize;           // bytes per chunk
+  const uint8_t* const* data;   // k pointers (logical order)
+  uint8_t* const* parity;       // m pointers, written by the dispatcher
+} ec_tpu_request;
+
+// Dispatch a homogeneous batch (same k/m/w/technique/blocksize).
+// Returns 0 on success; nonzero fails every request in the batch (the
+// caller falls back to CPU).
+typedef int (*ec_tpu_dispatch_fn)(const ec_tpu_request* reqs,
+                                  uint32_t count, void* user);
+
+// Install / clear the process-wide dispatcher. max_batch bounds the
+// coalesced batch size; max_delay_us is how long the collector waits for
+// more work after the first request arrives (0 = dispatch whatever is
+// queued as soon as the thread wakes).
+void ec_tpu_register_dispatcher(ec_tpu_dispatch_fn fn, void* user,
+                                uint32_t max_batch, uint32_t max_delay_us);
+void ec_tpu_unregister_dispatcher(void);
+int ec_tpu_dispatcher_active(void);
+
+// Blocking encode through the batching queue. Returns the dispatcher's
+// status, or -EAGAIN when no dispatcher is installed.
+int ec_tpu_encode(const ec_tpu_request* req);
+
+// Batch observability (perf-counter feed).
+uint64_t ec_tpu_batches_dispatched(void);
+uint64_t ec_tpu_requests_dispatched(void);
+
+}  // extern "C"

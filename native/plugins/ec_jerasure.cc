@@ -1,0 +1,59 @@
+// libec_jerasure.so — the native CPU codec plugin (jerasure parity).
+//
+// Registers the technique family under the plugin name "jerasure" the way
+// the reference's ErasureCodePluginJerasure does
+// (/root/reference/src/erasure-code/jerasure/ErasureCodePluginJerasure.cc:34-73):
+// one plugin, technique selected by profile["technique"].
+
+#include <cerrno>
+
+#include "ectpu/matrix_codec.h"
+#include "ectpu/registry.h"
+
+namespace ectpu {
+
+class JerasurePlugin : public ErasureCodePlugin {
+ public:
+  int factory(Profile& profile, ErasureCodeInterfaceRef* codec,
+              std::string* err) override {
+    std::string technique;
+    auto it = profile.find("technique");
+    if (it != profile.end()) technique = it->second;
+    if (technique.empty()) technique = "reed_sol_van";
+    profile["technique"] = technique;
+    ErasureCode* impl = nullptr;
+    if (technique == "reed_sol_van")
+      impl = new ReedSolomonVandermonde();
+    else if (technique == "reed_sol_r6_op")
+      impl = new ReedSolomonRAID6();
+    else if (technique == "cauchy_orig")
+      impl = new CauchyOrig();
+    else if (technique == "cauchy_good")
+      impl = new CauchyGood();
+    else {
+      if (err)
+        *err += technique +
+                " is not a valid coding technique. Choose one of: "
+                "reed_sol_van, reed_sol_r6_op, cauchy_orig, cauchy_good";
+      return -ENOENT;
+    }
+    ErasureCodeInterfaceRef ref(impl);
+    int r = impl->init(profile, err);
+    if (r) return r;
+    *codec = ref;
+    return 0;
+  }
+};
+
+}  // namespace ectpu
+
+extern "C" {
+
+const char* __erasure_code_version() { return ECTPU_VERSION_STRING; }
+
+int __erasure_code_init(const char* plugin_name, const char* directory) {
+  (void)directory;
+  return ectpu_registry_add(plugin_name, new ectpu::JerasurePlugin());
+}
+
+}  // extern "C"

@@ -1,0 +1,188 @@
+#include "ectpu/erasure_code.h"
+
+#include <algorithm>
+#include <cerrno>
+#include <cstring>
+#include <sstream>
+
+namespace ectpu {
+
+int ErasureCode::init(Profile& profile, std::string* err) {
+  int r = parse(profile, err);
+  if (r) return r;
+  r = prepare(err);
+  if (r) return r;
+  profile_ = profile;
+  return 0;
+}
+
+int ErasureCode::parse(Profile& profile, std::string* err) {
+  chunk_mapping_.clear();
+  auto it = profile.find("mapping");
+  if (it != profile.end()) {
+    // "DDD_D_" style position map (ErasureCode.cc:235-254): character c
+    // at position i means chunk i carries data stream position; we keep
+    // the same identity-permutation convention as the Python side
+    // (ceph_tpu/utils/profile.py to_mapping).
+    // data positions first, then coding positions, in order of
+    // appearance — identical to ceph_tpu/utils/profile.py to_mapping
+    const std::string& m = it->second;
+    for (size_t i = 0; i < m.size(); ++i)
+      if (m[i] == 'D') chunk_mapping_.push_back((int)i);
+    for (size_t i = 0; i < m.size(); ++i)
+      if (m[i] != 'D') chunk_mapping_.push_back((int)i);
+  }
+  (void)err;
+  return 0;
+}
+
+int ErasureCode::minimum_to_decode(const std::set<int>& want,
+                                   const std::set<int>& available,
+                                   std::set<int>* minimum) {
+  // Greedy selection (ErasureCode.cc:91-108).
+  if (std::includes(available.begin(), available.end(), want.begin(),
+                    want.end())) {
+    *minimum = want;
+    return 0;
+  }
+  unsigned k = get_data_chunk_count();
+  if (available.size() < k) return -EIO;
+  minimum->clear();
+  for (int a : available) {
+    minimum->insert(a);
+    if (minimum->size() == k) break;
+  }
+  return 0;
+}
+
+int ErasureCode::encode(const std::set<int>& want, const uint8_t* in,
+                        size_t len, std::map<int, Chunk>* encoded) {
+  unsigned k = get_data_chunk_count();
+  unsigned n = get_chunk_count();
+  size_t blocksize = get_chunk_size((unsigned)len);
+  // encode_prepare: split + zero-pad (ErasureCode.cc:122-157)
+  std::vector<Chunk> data(k, Chunk(blocksize, 0));
+  size_t off = 0;
+  for (unsigned i = 0; i < k && off < len; ++i) {
+    size_t take = std::min(blocksize, len - off);
+    memcpy(data[i].data(), in + off, take);
+    off += take;
+  }
+  std::vector<Chunk> parity(n - k, Chunk(blocksize, 0));
+  std::vector<const uint8_t*> dptr(k);
+  std::vector<uint8_t*> pptr(n - k);
+  for (unsigned i = 0; i < k; ++i) dptr[i] = data[i].data();
+  for (unsigned i = 0; i < n - k; ++i) pptr[i] = parity[i].data();
+  int r = encode_chunks(dptr.data(), pptr.data(), blocksize);
+  if (r) return r;
+  for (unsigned i = 0; i < n; ++i) {
+    int idx = chunk_index((int)i);
+    if (!want.count(idx)) continue;
+    (*encoded)[idx] = (i < k) ? std::move(data[i]) : std::move(parity[i - k]);
+  }
+  return 0;
+}
+
+int ErasureCode::decode(const std::set<int>& want,
+                        const std::map<int, Chunk>& chunks,
+                        std::map<int, Chunk>* decoded) {
+  unsigned k = get_data_chunk_count();
+  unsigned n = get_chunk_count();
+  bool have_all = true;
+  for (int wanted : want)
+    if (!chunks.count(wanted)) have_all = false;
+  if (have_all) {
+    for (int wanted : want) (*decoded)[wanted] = chunks.at(wanted);
+    return 0;
+  }
+  if (chunks.size() < k) return -EIO;
+  // map chunk-mapped indices back to logical rows
+  std::vector<int> inv(n);
+  for (unsigned i = 0; i < n; ++i) inv[chunk_index((int)i)] = (int)i;
+  std::vector<int> avail_rows;
+  std::vector<const uint8_t*> avail_ptrs;
+  size_t blocksize = 0;
+  std::vector<std::pair<int, const Chunk*>> logical;
+  for (auto& kv : chunks) {
+    logical.emplace_back(inv[kv.first], &kv.second);
+    blocksize = kv.second.size();
+  }
+  std::sort(logical.begin(), logical.end());
+  for (auto& kv : logical) {
+    if (avail_rows.size() == k) break;
+    avail_rows.push_back(kv.first);
+    avail_ptrs.push_back(kv.second->data());
+  }
+  std::vector<Chunk> all;
+  int r = decode_chunks(avail_rows, avail_ptrs.data(), &all, blocksize);
+  if (r) return r;
+  for (unsigned i = 0; i < n; ++i) {
+    int idx = chunk_index((int)i);
+    if (!want.count(idx) && !chunks.count(idx)) continue;
+    auto it = chunks.find(idx);
+    (*decoded)[idx] = (it != chunks.end()) ? it->second : std::move(all[i]);
+  }
+  return 0;
+}
+
+int ErasureCode::decode_concat(const std::map<int, Chunk>& chunks,
+                               Chunk* out) {
+  unsigned k = get_data_chunk_count();
+  std::set<int> want;
+  for (unsigned i = 0; i < k; ++i) want.insert(chunk_index((int)i));
+  std::map<int, Chunk> decoded;
+  int r = decode(want, chunks, &decoded);
+  if (r) return r;
+  out->clear();
+  for (unsigned i = 0; i < k; ++i) {
+    const Chunk& c = decoded.at(chunk_index((int)i));
+    out->insert(out->end(), c.begin(), c.end());
+  }
+  return 0;
+}
+
+int ErasureCode::to_int(const std::string& name, Profile& profile,
+                        const char* dflt, std::string* err, int* out) {
+  auto it = profile.find(name);
+  std::string v = (it == profile.end() || it->second.empty()) ? dflt
+                                                              : it->second;
+  char* end = nullptr;
+  long parsed = strtol(v.c_str(), &end, 10);
+  if (end == v.c_str() || *end) {
+    // malformed value: reset the default and fail init with -EINVAL — a
+    // typo'd profile must never silently become a different geometry
+    // (same stance as ceph_tpu/utils/profile.py to_int)
+    if (err) {
+      std::ostringstream os;
+      os << "could not convert " << name << "=" << v
+         << " to int, set to default " << dflt;
+      *err += os.str();
+    }
+    profile[name] = dflt;
+    *out = (int)strtol(dflt, nullptr, 10);
+    return -EINVAL;
+  }
+  profile[name] = v;  // echo back (ErasureCode.cc:256-270)
+  *out = (int)parsed;
+  return 0;
+}
+
+bool ErasureCode::to_bool(const std::string& name, Profile& profile,
+                          const char* dflt) {
+  auto it = profile.find(name);
+  std::string v = (it == profile.end() || it->second.empty()) ? dflt
+                                                              : it->second;
+  profile[name] = v;
+  return v == "true" || v == "1" || v == "yes";
+}
+
+std::string ErasureCode::to_string(const std::string& name, Profile& profile,
+                                   const char* dflt) {
+  auto it = profile.find(name);
+  std::string v = (it == profile.end() || it->second.empty()) ? dflt
+                                                              : it->second;
+  profile[name] = v;
+  return v;
+}
+
+}  // namespace ectpu

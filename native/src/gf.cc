@@ -1,0 +1,395 @@
+// GF(2^w) kernels. See include/ectpu/gf.h for the contract.
+
+#include "ectpu/gf.h"
+
+#include <algorithm>
+#include <cassert>
+#include <cstring>
+#include <mutex>
+#include <stdexcept>
+
+#if defined(__SSSE3__)
+#include <tmmintrin.h>
+#endif
+
+namespace ectpu {
+
+uint64_t gf_poly(int w) {
+  switch (w) {
+    case 2: return 0x7;
+    case 3: return 0xB;
+    case 4: return 0x13;
+    case 5: return 0x25;
+    case 6: return 0x43;
+    case 7: return 0x89;
+    case 8: return 0x11D;
+    case 9: return 0x211;
+    case 10: return 0x409;
+    case 11: return 0x805;
+    case 12: return 0x1053;
+    case 13: return 0x201B;
+    case 14: return 0x4143;
+    case 15: return 0x8003;
+    case 16: return 0x1100B;
+    case 17: return 0x20009;
+    case 18: return 0x40081;
+    case 19: return 0x80027;
+    case 20: return 0x100009;
+    case 21: return 0x200005;
+    case 22: return 0x400003;
+    case 23: return 0x800021;
+    case 24: return 0x1000087;
+    case 25: return 0x2000009;
+    case 26: return 0x4000047;
+    case 27: return 0x8000027;
+    case 28: return 0x10000009;
+    case 29: return 0x20000005;
+    case 30: return 0x40000053;
+    case 31: return 0x80000009;
+    case 32: return 0x100400007ULL;
+    default: throw std::invalid_argument("w out of range");
+  }
+}
+
+static uint64_t clmul64(uint64_t a, uint64_t b) {
+  uint64_t r = 0;
+  while (b) {
+    if (b & 1) r ^= a;
+    a <<= 1;
+    b >>= 1;
+  }
+  return r;
+}
+
+static uint64_t poly_mod(uint64_t a, uint64_t poly, int w) {
+  for (int bit = 63; bit >= w; --bit) {
+    if (a >> bit) a ^= poly << (bit - w);
+  }
+  return a;
+}
+
+uint32_t gf_mult(uint32_t a, uint32_t b, int w) {
+  return (uint32_t)poly_mod(clmul64(a, b), gf_poly(w), w);
+}
+
+uint32_t gf_pow(uint32_t a, uint64_t n, int w) {
+  uint32_t r = 1;
+  while (n) {
+    if (n & 1) r = gf_mult(r, a, w);
+    a = gf_mult(a, a, w);
+    n >>= 1;
+  }
+  return r;
+}
+
+uint32_t gf_inv(uint32_t a, int w) {
+  if (a == 0) throw std::domain_error("gf_inv(0)");
+  // a^(2^w - 2) == a^-1 in GF(2^w)
+  return gf_pow(a, ((uint64_t)1 << w) - 2, w);
+}
+
+uint32_t gf_div(uint32_t a, uint32_t b, int w) {
+  return gf_mult(a, gf_inv(b, w), w);
+}
+
+// ---------------------------------------------------------------------------
+// w=8 fast tables
+
+struct Gf8Tables {
+  // mul[g][x] = g*x; built once (64 KiB).
+  uint8_t mul[256][256];
+  // nibble tables for the SSSE3 path: lo[g][x] = g*x (x<16),
+  // hi[g][x] = g*(x<<4).
+  uint8_t lo[256][16];
+  uint8_t hi[256][16];
+  Gf8Tables() {
+    for (int g = 0; g < 256; ++g) {
+      for (int x = 0; x < 256; ++x)
+        mul[g][x] = (uint8_t)gf_mult((uint32_t)g, (uint32_t)x, 8);
+      for (int x = 0; x < 16; ++x) {
+        lo[g][x] = mul[g][x];
+        hi[g][x] = mul[g][x << 4];
+      }
+    }
+  }
+};
+
+static const Gf8Tables& gf8() {
+  static Gf8Tables t;
+  return t;
+}
+
+static void gf8_region_madd(uint8_t* dst, const uint8_t* src, uint8_t g,
+                            size_t n) {
+  if (g == 0) return;
+  const Gf8Tables& t = gf8();
+  size_t i = 0;
+#if defined(__SSSE3__)
+  __m128i tlo = _mm_loadu_si128((const __m128i*)t.lo[g]);
+  __m128i thi = _mm_loadu_si128((const __m128i*)t.hi[g]);
+  __m128i mask = _mm_set1_epi8(0x0f);
+  for (; i + 16 <= n; i += 16) {
+    __m128i s = _mm_loadu_si128((const __m128i*)(src + i));
+    __m128i d = _mm_loadu_si128((const __m128i*)(dst + i));
+    __m128i l = _mm_shuffle_epi8(tlo, _mm_and_si128(s, mask));
+    __m128i h = _mm_shuffle_epi8(
+        thi, _mm_and_si128(_mm_srli_epi64(s, 4), mask));
+    d = _mm_xor_si128(d, _mm_xor_si128(l, h));
+    _mm_storeu_si128((__m128i*)(dst + i), d);
+  }
+#endif
+  const uint8_t* row = t.mul[g];
+  for (; i < n; ++i) dst[i] ^= row[src[i]];
+}
+
+// ---------------------------------------------------------------------------
+// w=16 / w=32: per-constant split tables (ISA-L gf_vect style: the
+// product of a w-bit element by a constant is the XOR of per-byte
+// partial products).
+
+static void gf16_region_madd(uint8_t* dst8, const uint8_t* src8, uint32_t g,
+                             size_t n) {
+  if (g == 0) return;
+  uint16_t t0[256], t1[256];
+  for (int x = 0; x < 256; ++x) {
+    t0[x] = (uint16_t)gf_mult(g, (uint32_t)x, 16);
+    t1[x] = (uint16_t)gf_mult(g, (uint32_t)x << 8, 16);
+  }
+  size_t ne = n / 2;
+  uint16_t* dst;
+  const uint16_t* src;
+  memcpy(&dst, &dst8, sizeof(dst));
+  memcpy(&src, &src8, sizeof(src));
+  for (size_t i = 0; i < ne; ++i) {
+    uint16_t s = src[i];
+    dst[i] ^= (uint16_t)(t0[s & 0xff] ^ t1[s >> 8]);
+  }
+}
+
+static void gf32_region_madd(uint8_t* dst8, const uint8_t* src8, uint32_t g,
+                             size_t n) {
+  if (g == 0) return;
+  static thread_local uint32_t cached_g = 0;
+  static thread_local uint32_t t[4][256];
+  if (cached_g != g) {
+    for (int b = 0; b < 4; ++b)
+      for (int x = 0; x < 256; ++x)
+        t[b][x] = gf_mult(g, (uint32_t)x << (8 * b), 32);
+    cached_g = g;
+  }
+  size_t ne = n / 4;
+  uint32_t* dst;
+  const uint32_t* src;
+  memcpy(&dst, &dst8, sizeof(dst));
+  memcpy(&src, &src8, sizeof(src));
+  for (size_t i = 0; i < ne; ++i) {
+    uint32_t s = src[i];
+    dst[i] ^= t[0][s & 0xff] ^ t[1][(s >> 8) & 0xff] ^
+              t[2][(s >> 16) & 0xff] ^ t[3][s >> 24];
+  }
+}
+
+void xor_region(uint8_t* dst, const uint8_t* src, size_t n) {
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    uint64_t a, b;
+    memcpy(&a, dst + i, 8);
+    memcpy(&b, src + i, 8);
+    a ^= b;
+    memcpy(dst + i, &a, 8);
+  }
+  for (; i < n; ++i) dst[i] ^= src[i];
+}
+
+void gf_region_madd(uint8_t* dst, const uint8_t* src, uint32_t g, size_t n,
+                    int w) {
+  if (g == 0) return;
+  if (g == 1) {
+    xor_region(dst, src, n);
+    return;
+  }
+  switch (w) {
+    case 8: gf8_region_madd(dst, src, (uint8_t)g, n); break;
+    case 16: gf16_region_madd(dst, src, g, n); break;
+    case 32: gf32_region_madd(dst, src, g, n); break;
+    default: throw std::invalid_argument("region w must be 8/16/32");
+  }
+}
+
+void gf_region_mul(uint8_t* dst, const uint8_t* src, uint32_t g, size_t n,
+                   int w) {
+  memset(dst, 0, n);
+  gf_region_madd(dst, src, g, n, w);
+}
+
+// ---------------------------------------------------------------------------
+// Matrix ops
+
+void gf_matmul(const uint32_t* a, const uint32_t* b, uint32_t* c, int n,
+               int p, int m, int w) {
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j < m; ++j) {
+      uint32_t acc = 0;
+      for (int l = 0; l < p; ++l)
+        acc ^= gf_mult(a[i * p + l], b[l * m + j], w);
+      c[i * m + j] = acc;
+    }
+  }
+}
+
+bool gf_invert_matrix(const uint32_t* a_in, uint32_t* inv, int n, int w) {
+  std::vector<uint32_t> a(a_in, a_in + (size_t)n * n);
+  for (int i = 0; i < n; ++i)
+    for (int j = 0; j < n; ++j) inv[i * n + j] = (i == j) ? 1u : 0u;
+  for (int col = 0; col < n; ++col) {
+    int pivot = -1;
+    for (int r = col; r < n; ++r)
+      if (a[r * n + col]) { pivot = r; break; }
+    if (pivot < 0) return false;
+    if (pivot != col) {
+      for (int j = 0; j < n; ++j) {
+        std::swap(a[pivot * n + j], a[col * n + j]);
+        std::swap(inv[pivot * n + j], inv[col * n + j]);
+      }
+    }
+    uint32_t d = gf_inv(a[col * n + col], w);
+    for (int j = 0; j < n; ++j) {
+      a[col * n + j] = gf_mult(a[col * n + j], d, w);
+      inv[col * n + j] = gf_mult(inv[col * n + j], d, w);
+    }
+    for (int r = 0; r < n; ++r) {
+      if (r == col) continue;
+      uint32_t f = a[r * n + col];
+      if (!f) continue;
+      for (int j = 0; j < n; ++j) {
+        a[r * n + j] ^= gf_mult(f, a[col * n + j], w);
+        inv[r * n + j] ^= gf_mult(f, inv[col * n + j], w);
+      }
+    }
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Generator constructions (must mirror ceph_tpu/ops/gf.py bit-for-bit)
+
+std::vector<uint32_t> rs_vandermonde_generator(int k, int m, int w) {
+  if ((uint64_t)(k + m) > ((uint64_t)1 << w))
+    throw std::invalid_argument("k+m exceeds field size");
+  std::vector<uint32_t> v((size_t)(k + m) * k);
+  for (int i = 0; i < k + m; ++i)
+    for (int j = 0; j < k; ++j)
+      v[(size_t)i * k + j] =
+          (i == 0 && j == 0) ? 1u : gf_pow((uint32_t)i, (uint64_t)j, w);
+  std::vector<uint32_t> top_inv((size_t)k * k);
+  if (!gf_invert_matrix(v.data(), top_inv.data(), k, w))
+    throw std::runtime_error("vandermonde top not invertible");
+  std::vector<uint32_t> out((size_t)m * k);
+  gf_matmul(v.data() + (size_t)k * k, top_inv.data(), out.data(), m, k, k, w);
+  return out;
+}
+
+std::vector<uint32_t> rs_r6_generator(int k, int w) {
+  if ((uint64_t)k > (((uint64_t)1 << w) - 1))
+    throw std::invalid_argument("k exceeds 2^w - 1");
+  std::vector<uint32_t> gen((size_t)2 * k);
+  for (int j = 0; j < k; ++j) {
+    gen[j] = 1;
+    gen[k + j] = gf_pow(2, (uint64_t)j, w);
+  }
+  return gen;
+}
+
+std::vector<uint32_t> cauchy_original_generator(int k, int m, int w) {
+  if ((uint64_t)(k + m) > ((uint64_t)1 << w))
+    throw std::invalid_argument("k+m exceeds field size");
+  std::vector<uint32_t> gen((size_t)m * k);
+  for (int i = 0; i < m; ++i)
+    for (int j = 0; j < k; ++j)
+      gen[(size_t)i * k + j] = gf_inv((uint32_t)(i ^ (m + j)), w);
+  return gen;
+}
+
+void gf_mult_bitmatrix(uint32_t g, int w, uint8_t* out) {
+  // column c holds the bits of g * x^c
+  for (int c = 0; c < w; ++c) {
+    uint32_t v = gf_mult(g, (uint32_t)1 << c, w);
+    for (int r = 0; r < w; ++r)
+      out[(size_t)r * w + c] = (uint8_t)((v >> r) & 1);
+  }
+}
+
+static int bitmatrix_ones(uint32_t g, int w) {
+  std::vector<uint8_t> bm((size_t)w * w);
+  gf_mult_bitmatrix(g, w, bm.data());
+  int ones = 0;
+  for (uint8_t b : bm) ones += b;
+  return ones;
+}
+
+std::vector<uint32_t> cauchy_good_generator(int k, int m, int w) {
+  std::vector<uint32_t> gen = cauchy_original_generator(k, m, w);
+  for (int j = 0; j < k; ++j) {
+    uint32_t f = gf_inv(gen[j], w);
+    for (int i = 0; i < m; ++i)
+      gen[(size_t)i * k + j] = gf_mult(gen[(size_t)i * k + j], f, w);
+  }
+  for (int i = 1; i < m; ++i) {
+    uint32_t best_div = 1;
+    long best_cost = -1;
+    // candidate divisors: the row's own (distinct, sorted) elements
+    std::vector<uint32_t> cands(gen.begin() + (size_t)i * k,
+                                gen.begin() + (size_t)(i + 1) * k);
+    std::sort(cands.begin(), cands.end());
+    cands.erase(std::unique(cands.begin(), cands.end()), cands.end());
+    for (uint32_t div : cands) {
+      uint32_t dinv = gf_inv(div, w);
+      long cost = 0;
+      for (int j = 0; j < k; ++j)
+        cost += bitmatrix_ones(gf_mult(gen[(size_t)i * k + j], dinv, w), w);
+      if (best_cost < 0 || cost < best_cost) {
+        best_div = div;
+        best_cost = cost;
+      }
+    }
+    uint32_t dinv = gf_inv(best_div, w);
+    for (int j = 0; j < k; ++j)
+      gen[(size_t)i * k + j] = gf_mult(gen[(size_t)i * k + j], dinv, w);
+  }
+  return gen;
+}
+
+std::vector<uint8_t> generator_to_bitmatrix(const uint32_t* gen, int rows,
+                                            int cols, int w) {
+  std::vector<uint8_t> out((size_t)rows * w * cols * w);
+  std::vector<uint8_t> cell((size_t)w * w);
+  for (int i = 0; i < rows; ++i) {
+    for (int j = 0; j < cols; ++j) {
+      gf_mult_bitmatrix(gen[(size_t)i * cols + j], w, cell.data());
+      for (int r = 0; r < w; ++r)
+        for (int c = 0; c < w; ++c)
+          out[((size_t)i * w + r) * (size_t)cols * w + (size_t)j * w + c] =
+              cell[(size_t)r * w + c];
+    }
+  }
+  return out;
+}
+
+bool gf_decode_matrix(const uint32_t* coding, int k, int m, const int* avail,
+                      uint32_t* out, int w) {
+  (void)m;
+  // rows of [I_k; coding] selected by avail (sorted, k entries)
+  std::vector<uint32_t> sub((size_t)k * k, 0);
+  for (int r = 0; r < k; ++r) {
+    int row = avail[r];
+    if (row < k) {
+      sub[(size_t)r * k + row] = 1;
+    } else {
+      for (int j = 0; j < k; ++j)
+        sub[(size_t)r * k + j] = coding[(size_t)(row - k) * k + j];
+    }
+  }
+  return gf_invert_matrix(sub.data(), out, k, w);
+}
+
+}  // namespace ectpu

@@ -1,0 +1,267 @@
+#include "ectpu/matrix_codec.h"
+
+#include <algorithm>
+#include <cerrno>
+#include <cstring>
+
+#include "ectpu/gf.h"
+
+namespace ectpu {
+
+static size_t roundup(size_t x, size_t align) {
+  return x % align ? x + align - x % align : x;
+}
+
+// ---------------------------------------------------------------------------
+// GeneratorCodec
+
+int GeneratorCodec::parse(Profile& profile, std::string* err) {
+  int r = ErasureCode::parse(profile, err);
+  if (r) return r;
+  if ((r = to_int("k", profile, default_k(), err, &k_))) return r;
+  if ((r = to_int("m", profile, default_m(), err, &m_))) return r;
+  if ((r = to_int("w", profile, default_w(), err, &w_))) return r;
+  if (!chunk_mapping_.empty() &&
+      (int)chunk_mapping_.size() != k_ + m_) {
+    if (err) *err += "mapping size does not match k+m";
+    chunk_mapping_.clear();
+    return -EINVAL;
+  }
+  if (k_ < 2) {
+    if (err) *err += "k must be >= 2";
+    return -EINVAL;
+  }
+  if (m_ < 1) {
+    if (err) *err += "m must be >= 1";
+    return -EINVAL;
+  }
+  if (w_ != 8 && w_ != 16 && w_ != 32) {
+    if (err) *err += "w must be one of 8, 16, 32";
+    return -EINVAL;
+  }
+  return 0;
+}
+
+int GeneratorCodec::prepare(std::string* err) {
+  decode_cache_.clear();
+  return make_generator(err);
+}
+
+unsigned GeneratorCodec::get_chunk_size(unsigned object_size) const {
+  // ErasureCodeJerasure.cc:74-97 semantics (shared by the Python
+  // GeneratorCodec, ceph_tpu/models/matrix_base.py:91-100).
+  size_t alignment = get_alignment();
+  if (per_chunk_alignment_) {
+    size_t chunk = (object_size + k_ - 1) / k_;
+    return (unsigned)roundup(std::max(chunk, alignment), alignment);
+  }
+  size_t padded = roundup(object_size, alignment);
+  return (unsigned)(padded / k_);
+}
+
+const std::vector<uint32_t>& GeneratorCodec::decode_entry(
+    const std::vector<int>& avail) {
+  auto it = decode_cache_.find(avail);
+  if (it != decode_cache_.end()) return it->second;
+  // [k+m, k]: data-recovery matrix stacked with re-encode rows, the same
+  // shape the Python side caches (matrix_base.py _full_decode_matrix)
+  std::vector<uint32_t> dec((size_t)k_ * k_);
+  gf_decode_matrix(coding_.data(), k_, m_, avail.data(), dec.data(), w_);
+  std::vector<uint32_t> full((size_t)(k_ + m_) * k_);
+  memcpy(full.data(), dec.data(), (size_t)k_ * k_ * sizeof(uint32_t));
+  gf_matmul(coding_.data(), dec.data(), full.data() + (size_t)k_ * k_, m_,
+            k_, k_, w_);
+  return decode_cache_.emplace(avail, std::move(full)).first->second;
+}
+
+// ---------------------------------------------------------------------------
+// MatrixCodec
+
+int MatrixCodec::parse(Profile& profile, std::string* err) {
+  int r = GeneratorCodec::parse(profile, err);
+  if (r) return r;
+  per_chunk_alignment_ =
+      to_bool("jerasure-per-chunk-alignment", profile, "false");
+  return 0;
+}
+
+unsigned MatrixCodec::get_alignment() const {
+  // ErasureCodeJerasure.cc:168-178
+  if (per_chunk_alignment_) return (unsigned)(w_ * LARGEST_VECTOR_WORDSIZE);
+  if ((w_ * 4) % LARGEST_VECTOR_WORDSIZE)
+    return (unsigned)(k_ * w_ * LARGEST_VECTOR_WORDSIZE);
+  return (unsigned)(k_ * w_ * 4);
+}
+
+void MatrixCodec::apply_matrix(const uint32_t* mat, int rows,
+                               const uint8_t* const* src,
+                               uint8_t* const* dst,
+                               size_t blocksize) const {
+  for (int i = 0; i < rows; ++i) {
+    memset(dst[i], 0, blocksize);
+    for (int j = 0; j < k_; ++j)
+      gf_region_madd(dst[i], src[j], mat[(size_t)i * k_ + j], blocksize, w_);
+  }
+}
+
+int MatrixCodec::encode_chunks(const uint8_t* const* data,
+                               uint8_t* const* parity, size_t blocksize) {
+  if (blocksize % (size_t)(w_ / 8)) return -EINVAL;
+  apply_matrix(coding_.data(), m_, data, parity, blocksize);
+  return 0;
+}
+
+int MatrixCodec::decode_chunks(const std::vector<int>& avail_rows,
+                               const uint8_t* const* avail,
+                               std::vector<Chunk>* all, size_t blocksize) {
+  const std::vector<uint32_t>& full = decode_entry(avail_rows);
+  all->assign((size_t)(k_ + m_), Chunk(blocksize, 0));
+  std::vector<uint8_t*> out(k_ + m_);
+  for (int i = 0; i < k_ + m_; ++i) out[i] = (*all)[i].data();
+  apply_matrix(full.data(), k_ + m_, avail, out.data(), blocksize);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// BitmatrixCodec
+
+int BitmatrixCodec::parse(Profile& profile, std::string* err) {
+  int r = GeneratorCodec::parse(profile, err);
+  if (r) return r;
+  if ((r = to_int("packetsize", profile, default_packetsize(), err,
+                  &packetsize_)))
+    return r;
+  if (packetsize_ < 1) {
+    if (err) *err += "packetsize must be >= 1";
+    return -EINVAL;
+  }
+  per_chunk_alignment_ =
+      to_bool("jerasure-per-chunk-alignment", profile, "false");
+  return 0;
+}
+
+int BitmatrixCodec::prepare(std::string* err) {
+  int r = GeneratorCodec::prepare(err);
+  if (r) return r;
+  encode_bitmat_ = generator_to_bitmatrix(coding_.data(), m_, k_, w_);
+  decode_bitmat_cache_.clear();
+  return 0;
+}
+
+unsigned BitmatrixCodec::get_alignment() const {
+  // ErasureCodeJerasure.cc:273-287
+  if (per_chunk_alignment_)
+    return (unsigned)roundup((size_t)w_ * packetsize_,
+                             LARGEST_VECTOR_WORDSIZE);
+  if (((size_t)w_ * packetsize_ * 4) % LARGEST_VECTOR_WORDSIZE)
+    return (unsigned)((size_t)k_ * w_ * packetsize_ *
+                      LARGEST_VECTOR_WORDSIZE);
+  return (unsigned)((size_t)k_ * w_ * packetsize_ * 4);
+}
+
+void BitmatrixCodec::apply_bitmatrix(const uint8_t* bitmat, int rows,
+                                     const uint8_t* const* src,
+                                     uint8_t* const* dst,
+                                     size_t blocksize) const {
+  // chunk = S superblocks x w packets x packetsize bytes
+  // (jerasure_schedule_encode layout; gf_ref.bitmatrix_encode_ref)
+  size_t super = (size_t)w_ * packetsize_;
+  size_t nsuper = blocksize / super;
+  int cols = k_ * w_;
+  for (size_t s = 0; s < nsuper; ++s) {
+    for (int i = 0; i < rows; ++i) {
+      for (int r = 0; r < w_; ++r) {
+        uint8_t* out = dst[i] + s * super + (size_t)r * packetsize_;
+        memset(out, 0, (size_t)packetsize_);
+        const uint8_t* row = bitmat + ((size_t)i * w_ + r) * cols;
+        for (int j = 0; j < k_; ++j) {
+          for (int c = 0; c < w_; ++c) {
+            if (!row[j * w_ + c]) continue;
+            xor_region(out, src[j] + s * super + (size_t)c * packetsize_,
+                       (size_t)packetsize_);
+          }
+        }
+      }
+    }
+  }
+}
+
+int BitmatrixCodec::encode_chunks(const uint8_t* const* data,
+                                  uint8_t* const* parity,
+                                  size_t blocksize) {
+  if (blocksize % ((size_t)w_ * packetsize_)) return -EINVAL;
+  apply_bitmatrix(encode_bitmat_.data(), m_, data, parity, blocksize);
+  return 0;
+}
+
+int BitmatrixCodec::decode_chunks(const std::vector<int>& avail_rows,
+                                  const uint8_t* const* avail,
+                                  std::vector<Chunk>* all,
+                                  size_t blocksize) {
+  auto it = decode_bitmat_cache_.find(avail_rows);
+  if (it == decode_bitmat_cache_.end()) {
+    const std::vector<uint32_t>& full = decode_entry(avail_rows);
+    it = decode_bitmat_cache_
+             .emplace(avail_rows,
+                      generator_to_bitmatrix(full.data(), k_ + m_, k_, w_))
+             .first;
+  }
+  all->assign((size_t)(k_ + m_), Chunk(blocksize, 0));
+  std::vector<uint8_t*> out(k_ + m_);
+  for (int i = 0; i < k_ + m_; ++i) out[i] = (*all)[i].data();
+  apply_bitmatrix(it->second.data(), k_ + m_, avail, out.data(), blocksize);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Techniques
+
+int ReedSolomonVandermonde::make_generator(std::string* err) {
+  try {
+    coding_ = rs_vandermonde_generator(k_, m_, w_);
+  } catch (const std::exception& e) {
+    if (err) *err += e.what();
+    return -EINVAL;
+  }
+  return 0;
+}
+
+int ReedSolomonRAID6::parse(Profile& profile, std::string* err) {
+  int r = MatrixCodec::parse(profile, err);
+  if (r) return r;
+  m_ = 2;  // RAID6 is always P+Q (ErasureCodeJerasure.h:112-133)
+  profile["m"] = "2";
+  return 0;
+}
+
+int ReedSolomonRAID6::make_generator(std::string* err) {
+  try {
+    coding_ = rs_r6_generator(k_, w_);
+  } catch (const std::exception& e) {
+    if (err) *err += e.what();
+    return -EINVAL;
+  }
+  return 0;
+}
+
+int CauchyOrig::make_generator(std::string* err) {
+  try {
+    coding_ = cauchy_original_generator(k_, m_, w_);
+  } catch (const std::exception& e) {
+    if (err) *err += e.what();
+    return -EINVAL;
+  }
+  return 0;
+}
+
+int CauchyGood::make_generator(std::string* err) {
+  try {
+    coding_ = cauchy_good_generator(k_, m_, w_);
+  } catch (const std::exception& e) {
+    if (err) *err += e.what();
+    return -EINVAL;
+  }
+  return 0;
+}
+
+}  // namespace ectpu

@@ -1,0 +1,140 @@
+#include "ectpu/registry.h"
+
+#include <dlfcn.h>
+
+#include <cerrno>
+#include <cstring>
+#include <sstream>
+
+namespace ectpu {
+
+ErasureCodePluginRegistry& ErasureCodePluginRegistry::instance() {
+  static ErasureCodePluginRegistry reg;
+  return reg;
+}
+
+int ErasureCodePluginRegistry::add(const std::string& name,
+                                   ErasureCodePlugin* plugin) {
+  // mutex held by load() during __erasure_code_init; direct calls (tests,
+  // built-ins) take it themselves via loading_ flag check
+  if (plugins_.count(name)) return -EEXIST;
+  plugins_[name] = plugin;
+  return 0;
+}
+
+ErasureCodePlugin* ErasureCodePluginRegistry::get(const std::string& name) {
+  auto it = plugins_.find(name);
+  return it == plugins_.end() ? nullptr : it->second;
+}
+
+int ErasureCodePluginRegistry::factory(const std::string& name,
+                                       const std::string& directory,
+                                       Profile& profile,
+                                       ErasureCodeInterfaceRef* codec,
+                                       std::string* err) {
+  ErasureCodePlugin* plugin;
+  {
+    std::unique_lock<std::mutex> l(lock_);
+    plugin = get(name);
+    if (plugin == nullptr) {
+      loading_ = true;
+      int r = load(name, directory, err);
+      loading_ = false;
+      if (r) return r;
+      plugin = get(name);
+    }
+  }
+  if (plugin == nullptr) return -ENOENT;
+  Profile requested = profile;
+  int r = plugin->factory(profile, codec, err);
+  if (r) return r;
+  // profile echo check (ErasureCodePlugin.cc:114-118)
+  for (const auto& kv : requested) {
+    auto it = profile.find(kv.first);
+    if (it == profile.end() || it->second != kv.second) {
+      if (err) {
+        std::ostringstream os;
+        os << "profile " << kv.first << "=" << kv.second
+           << " was not echoed back by plugin " << name;
+        *err += os.str();
+      }
+      return -EINVAL;
+    }
+  }
+  return 0;
+}
+
+int ErasureCodePluginRegistry::load(const std::string& name,
+                                    const std::string& directory,
+                                    std::string* err) {
+  std::string path = directory + "/libec_" + name + ".so";
+  void* library = dlopen(path.c_str(), RTLD_NOW | RTLD_GLOBAL);
+  if (!library) {
+    if (err) *err += std::string("load dlopen(") + path + "): " + dlerror();
+    return -EIO;
+  }
+  using version_fn = const char* (*)();
+  version_fn version =
+      (version_fn)dlsym(library, "__erasure_code_version");
+  if (version == nullptr) {
+    if (err)
+      *err += path + " does not have a __erasure_code_version function";
+    dlclose(library);
+    return -EXDEV;
+  }
+  if (strcmp(version(), ECTPU_VERSION_STRING) != 0) {
+    if (err)
+      *err += std::string("expected plugin version ") +
+              ECTPU_VERSION_STRING + " but " + path + " is " + version();
+    dlclose(library);
+    return -EXDEV;
+  }
+  using init_fn = int (*)(const char*, const char*);
+  init_fn init = (init_fn)dlsym(library, "__erasure_code_init");
+  if (init == nullptr) {
+    if (err) *err += path + " does not have an __erasure_code_init function";
+    dlclose(library);
+    return -ENOENT;
+  }
+  int r = init(name.c_str(), directory.c_str());
+  if (r != 0) {
+    if (err) {
+      std::ostringstream os;
+      os << "erasure_code_init(" << name << "," << directory
+         << "): " << strerror(-r);
+      *err += os.str();
+    }
+    dlclose(library);
+    return r;
+  }
+  if (get(name) == nullptr) {
+    if (err)
+      *err += "erasure_code_init did not register plugin " + name;
+    dlclose(library);
+    return -EBADF;
+  }
+  // never dlclose a live plugin (disable_dlclose)
+  return 0;
+}
+
+int ErasureCodePluginRegistry::preload(const std::string& names,
+                                       const std::string& directory,
+                                       std::string* err) {
+  std::istringstream ss(names);
+  std::string name;
+  while (std::getline(ss, name, ',')) {
+    if (name.empty()) continue;
+    std::unique_lock<std::mutex> l(lock_);
+    if (get(name)) continue;
+    int r = load(name, directory, err);
+    if (r) return r;
+  }
+  return 0;
+}
+
+}  // namespace ectpu
+
+extern "C" int ectpu_registry_add(const char* name,
+                                  ectpu::ErasureCodePlugin* plugin) {
+  return ectpu::ErasureCodePluginRegistry::instance().add(name, plugin);
+}

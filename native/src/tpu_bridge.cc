@@ -1,0 +1,164 @@
+#include "ectpu/tpu_bridge.h"
+
+#include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Pending {
+  ec_tpu_request req;
+  int result = 0;
+  bool done = false;
+};
+
+struct Bridge {
+  std::mutex lock;
+  std::condition_variable work_cv;    // collector wakeups
+  std::condition_variable done_cv;    // requester wakeups
+  std::deque<Pending*> queue;
+  ec_tpu_dispatch_fn fn = nullptr;
+  void* user = nullptr;
+  uint32_t max_batch = 64;
+  uint32_t max_delay_us = 100;
+  bool running = false;
+  bool stopping = false;
+  std::thread collector;
+  std::atomic<uint64_t> batches{0};
+  std::atomic<uint64_t> requests{0};
+
+  static Bridge& get() {
+    static Bridge b;
+    return b;
+  }
+
+  static bool compatible(const ec_tpu_request& a, const ec_tpu_request& b) {
+    return a.k == b.k && a.m == b.m && a.w == b.w &&
+           a.blocksize == b.blocksize &&
+           strcmp(a.technique, b.technique) == 0;
+  }
+
+  void collector_loop() {
+    std::unique_lock<std::mutex> l(lock);
+    while (!stopping) {
+      work_cv.wait(l, [&] { return stopping || !queue.empty(); });
+      if (stopping) break;
+      // small grace window so concurrent writers can coalesce
+      if (max_delay_us && queue.size() < max_batch) {
+        work_cv.wait_for(l, std::chrono::microseconds(max_delay_us),
+                         [&] { return stopping || queue.size() >= max_batch; });
+        if (stopping) break;
+      }
+      // pop a homogeneous batch (leave incompatible requests queued)
+      std::vector<Pending*> batch;
+      std::deque<Pending*> rest;
+      while (!queue.empty() && batch.size() < max_batch) {
+        Pending* p = queue.front();
+        queue.pop_front();
+        if (batch.empty() || compatible(batch[0]->req, p->req))
+          batch.push_back(p);
+        else
+          rest.push_back(p);
+      }
+      for (auto it = rest.rbegin(); it != rest.rend(); ++it)
+        queue.push_front(*it);
+      ec_tpu_dispatch_fn f = fn;
+      void* u = user;
+      l.unlock();
+      std::vector<ec_tpu_request> reqs;
+      reqs.reserve(batch.size());
+      for (Pending* p : batch) reqs.push_back(p->req);
+      int r = f ? f(reqs.data(), (uint32_t)reqs.size(), u) : -EAGAIN;
+      l.lock();
+      batches.fetch_add(1, std::memory_order_relaxed);
+      requests.fetch_add(batch.size(), std::memory_order_relaxed);
+      for (Pending* p : batch) {
+        p->result = r;
+        p->done = true;
+      }
+      done_cv.notify_all();
+    }
+  }
+
+  void start_locked() {
+    if (running) return;
+    stopping = false;
+    running = true;
+    collector = std::thread([this] { collector_loop(); });
+  }
+
+  void stop() {
+    std::thread t;
+    {
+      std::unique_lock<std::mutex> l(lock);
+      if (!running) return;
+      stopping = true;
+      work_cv.notify_all();
+      t = std::move(collector);
+      running = false;
+    }
+    if (t.joinable()) t.join();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void ec_tpu_register_dispatcher(ec_tpu_dispatch_fn fn, void* user,
+                                uint32_t max_batch, uint32_t max_delay_us) {
+  Bridge& b = Bridge::get();
+  std::unique_lock<std::mutex> l(b.lock);
+  b.fn = fn;
+  b.user = user;
+  if (max_batch) b.max_batch = max_batch;
+  b.max_delay_us = max_delay_us;
+  b.start_locked();
+}
+
+void ec_tpu_unregister_dispatcher(void) {
+  Bridge& b = Bridge::get();
+  {
+    std::unique_lock<std::mutex> l(b.lock);
+    b.fn = nullptr;
+    b.user = nullptr;
+  }
+  b.stop();
+}
+
+int ec_tpu_dispatcher_active(void) {
+  Bridge& b = Bridge::get();
+  std::unique_lock<std::mutex> l(b.lock);
+  return b.fn != nullptr;
+}
+
+int ec_tpu_encode(const ec_tpu_request* req) {
+  Bridge& b = Bridge::get();
+  Pending p;
+  p.req = *req;
+  {
+    std::unique_lock<std::mutex> l(b.lock);
+    if (!b.fn || !b.running) return -EAGAIN;
+    b.queue.push_back(&p);
+    b.work_cv.notify_all();
+    b.done_cv.wait(l, [&] { return p.done; });
+  }
+  return p.result;
+}
+
+uint64_t ec_tpu_batches_dispatched(void) {
+  return Bridge::get().batches.load(std::memory_order_relaxed);
+}
+
+uint64_t ec_tpu_requests_dispatched(void) {
+  return Bridge::get().requests.load(std::memory_order_relaxed);
+}
+
+}  // extern "C"
