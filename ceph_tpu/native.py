@@ -1,0 +1,257 @@
+"""ctypes bindings for the native runtime (native/build/libectpu.so).
+
+The Python<->C++ seam of this framework: the native library carries the
+dlopen plugin registry + CPU codecs (reference ABI:
+/root/reference/src/erasure-code/ErasureCodePlugin.{h,cc}) and the TPU
+batching bridge (native/src/tpu_bridge.cc); this module loads it, drives
+codecs through the flat C API (native/include/ectpu/c_api.h), and can
+install a JAX-backed dispatcher into the bridge so native threads'
+encode calls coalesce into device batches.
+
+No pybind11 in this image — ctypes is the binding layer, mirroring how
+the reference binds Python via Cython rather than pybind11
+(src/pybind/rados/rados.pyx).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(_REPO, "native")
+BUILD_DIR = os.path.join(NATIVE_DIR, "build")
+LIB_PATH = os.path.join(BUILD_DIR, "libectpu.so")
+
+_lib = None
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def build(targets=("all",)) -> None:
+    """Invoke the native Makefile (idempotent; cheap when up to date)."""
+    subprocess.run(["make", "-C", NATIVE_DIR, *targets], check=True,
+                   capture_output=True)
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(LIB_PATH):
+        try:
+            build()
+        except (OSError, subprocess.CalledProcessError) as e:
+            raise NativeUnavailable("cannot build native runtime: %s" % e)
+    L = ctypes.CDLL(LIB_PATH, mode=ctypes.RTLD_GLOBAL)
+    L.ec_codec_create.restype = ctypes.c_void_p
+    L.ec_codec_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_size_t]
+    L.ec_codec_destroy.argtypes = [ctypes.c_void_p]
+    L.ec_codec_k.argtypes = [ctypes.c_void_p]
+    L.ec_codec_m.argtypes = [ctypes.c_void_p]
+    L.ec_codec_chunk_size.restype = ctypes.c_uint
+    L.ec_codec_chunk_size.argtypes = [ctypes.c_void_p, ctypes.c_uint]
+    L.ec_codec_profile.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+    L.ec_codec_chunk_mapping.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int)]
+    L.ec_codec_minimum_to_decode.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+    L.ec_codec_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
+    L.ec_codec_encode_chunks.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
+    L.ec_codec_decode.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_char_p]
+    for name in ("ec_tpu_batches_dispatched", "ec_tpu_requests_dispatched"):
+        getattr(L, name).restype = ctypes.c_uint64
+    _lib = L
+    return L
+
+
+class NativeCodec:
+    """A codec instance living in the native runtime."""
+
+    def __init__(self, plugin: str, profile: dict,
+                 directory: str = BUILD_DIR):
+        L = lib()
+        kv = " ".join("%s=%s" % (k, v) for k, v in profile.items())
+        err = ctypes.create_string_buffer(512)
+        self._h = L.ec_codec_create(plugin.encode(), directory.encode(),
+                                    kv.encode(), err, 512)
+        if not self._h:
+            raise OSError(err.value.decode() or "codec create failed")
+        self._L = L
+        self.k = L.ec_codec_k(self._h)
+        self.m = L.ec_codec_m(self._h)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._L.ec_codec_destroy(self._h)
+            self._h = None
+
+    def get_profile(self) -> dict:
+        buf = ctypes.create_string_buffer(4096)
+        self._L.ec_codec_profile(self._h, buf, 4096)
+        out = {}
+        for line in buf.value.decode().splitlines():
+            if "=" in line:
+                k, v = line.split("=", 1)
+                out[k] = v
+        return out
+
+    def get_chunk_size(self, object_size: int) -> int:
+        return self._L.ec_codec_chunk_size(self._h, object_size)
+
+    def chunk_mapping(self) -> list:
+        n = self.k + self.m
+        arr = (ctypes.c_int * n)()
+        self._L.ec_codec_chunk_mapping(self._h, arr)
+        return list(arr)
+
+    def minimum_to_decode(self, want, avail) -> list:
+        w = (ctypes.c_int * len(want))(*want)
+        a = (ctypes.c_int * len(avail))(*avail)
+        out = (ctypes.c_int * (self.k + self.m))()
+        nmin = ctypes.c_int()
+        r = self._L.ec_codec_minimum_to_decode(
+            self._h, w, len(want), a, len(avail), out,
+            ctypes.byref(nmin))
+        if r:
+            raise OSError(-r, os.strerror(-r))
+        return list(out[: nmin.value])
+
+    def encode(self, data: bytes) -> dict:
+        bs = self.get_chunk_size(len(data))
+        n = self.k + self.m
+        out = ctypes.create_string_buffer(n * bs)
+        r = self._L.ec_codec_encode(self._h, data, len(data), out)
+        if r:
+            raise OSError(-r, os.strerror(-r))
+        raw = out.raw
+        return {i: raw[i * bs:(i + 1) * bs] for i in range(n)}
+
+    def decode(self, available: dict, want=None) -> dict:
+        ids = sorted(available)
+        bs = len(available[ids[0]])
+        if want is None:
+            want = list(range(self.k + self.m))
+        a = (ctypes.c_int * len(ids))(*ids)
+        w = (ctypes.c_int * len(want))(*want)
+        chunks = b"".join(available[i] for i in ids)
+        out = ctypes.create_string_buffer(len(want) * bs)
+        r = self._L.ec_codec_decode(self._h, a, len(ids), chunks, bs, w,
+                                    len(want), out)
+        if r:
+            raise OSError(-r, os.strerror(-r))
+        raw = out.raw
+        return {wid: raw[j * bs:(j + 1) * bs] for j, wid in enumerate(want)}
+
+
+# -- TPU bridge dispatcher ------------------------------------------------
+
+_DISPATCH_CFUNC = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
+                                   ctypes.c_uint32, ctypes.c_void_p)
+# Keepalive for every CFUNCTYPE thunk ever installed: the collector
+# thread copies the fn pointer before invoking it unlocked, so a thunk
+# being replaced can still be mid-call — freeing it would crash.
+_installed_dispatchers: list = []
+
+
+class _ECRequest(ctypes.Structure):
+    _fields_ = [
+        ("k", ctypes.c_uint32), ("m", ctypes.c_uint32),
+        ("w", ctypes.c_uint32),
+        ("technique", ctypes.c_char_p),
+        ("blocksize", ctypes.c_uint64),
+        ("data", ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))),
+        ("parity", ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))),
+    ]
+
+
+def install_jax_dispatcher(max_batch: int = 64,
+                           max_delay_us: int = 200) -> None:
+    """Register a JAX-backed encode dispatcher into the native bridge.
+
+    Native threads calling ec_tpu_encode() block while the bridge
+    coalesces concurrent requests; this callback runs one batched device
+    encode per homogeneous batch and scatters parity back through the
+    request pointers.
+    """
+    import numpy as np
+
+    from . import registry
+
+    L = lib()
+    codecs = {}
+
+    def dispatch(reqs_ptr, count, _user):
+        try:
+            reqs = ctypes.cast(
+                reqs_ptr, ctypes.POINTER(_ECRequest * count)).contents
+            r0 = reqs[0]
+            key = (r0.k, r0.m, r0.w, r0.technique)
+            codec = codecs.get(key)
+            if codec is None:
+                codec = codecs[key] = registry.factory("jax_tpu", {
+                    "technique": (r0.technique or b"reed_sol_van").decode(),
+                    "k": str(r0.k), "m": str(r0.m), "w": str(r0.w)})
+            bs = int(r0.blocksize)
+            batch = np.empty((count, r0.k, bs), dtype=np.uint8)
+            for i in range(count):
+                for j in range(r0.k):
+                    src = ctypes.cast(
+                        reqs[i].data[j],
+                        ctypes.POINTER(ctypes.c_uint8 * bs)).contents
+                    batch[i, j] = np.frombuffer(src, dtype=np.uint8)
+            parity = np.asarray(codec.encode_batch(batch))
+            for i in range(count):
+                for j in range(r0.m):
+                    dst = ctypes.cast(
+                        reqs[i].parity[j],
+                        ctypes.POINTER(ctypes.c_uint8 * bs)).contents
+                    ctypes.memmove(dst, parity[i, j].tobytes(), bs)
+            return 0
+        except Exception:
+            return -5  # EIO: every request falls back to CPU
+
+    thunk = _DISPATCH_CFUNC(dispatch)
+    _installed_dispatchers.append(thunk)
+    L.ec_tpu_register_dispatcher(thunk, None, max_batch, max_delay_us)
+
+
+def uninstall_dispatcher() -> None:
+    if _lib is not None:
+        _lib.ec_tpu_unregister_dispatcher()
+
+
+def bridge_encode(k: int, m: int, w: int, technique: str,
+                  data_chunks: list) -> list:
+    """Blocking encode through the native batching bridge (the path a
+    native OSD thread takes). Returns m parity chunks; raises if no
+    dispatcher is installed (-EAGAIN) or the dispatch failed."""
+    L = lib()
+    L.ec_tpu_encode.argtypes = [ctypes.POINTER(_ECRequest)]
+    bs = len(data_chunks[0])
+    dbufs = [ctypes.create_string_buffer(c, bs) for c in data_chunks]
+    pbufs = [ctypes.create_string_buffer(bs) for _ in range(m)]
+    dptr = (ctypes.POINTER(ctypes.c_uint8) * k)(
+        *[ctypes.cast(b, ctypes.POINTER(ctypes.c_uint8)) for b in dbufs])
+    pptr = (ctypes.POINTER(ctypes.c_uint8) * m)(
+        *[ctypes.cast(b, ctypes.POINTER(ctypes.c_uint8)) for b in pbufs])
+    tech = technique.encode()
+    req = _ECRequest(k=k, m=m, w=w, technique=tech, blocksize=bs,
+                     data=dptr, parity=pptr)
+    r = L.ec_tpu_encode(ctypes.byref(req))
+    if r:
+        raise OSError(-r, os.strerror(-r))
+    return [b.raw for b in pbufs]

@@ -1,0 +1,62 @@
+// Flat C API over the native erasure-code runtime.
+//
+// This is the Python<->C++ seam for this image (no pybind11 baked in):
+// ctypes loads libectpu.so and drives codecs through these functions.
+// It doubles as the stable ABI a non-Python embedder would use, the way
+// the reference's librados exposes a C API over the C++ core
+// (/root/reference/src/librados/librados.cc:3682).
+
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// Create a codec through the plugin registry (dlopen of
+// libec_<plugin>.so under `directory` on first use).
+// `profile` is a whitespace-separated list of key=value pairs.
+// Returns an opaque handle, or NULL with a message in errbuf.
+void* ec_codec_create(const char* plugin, const char* directory,
+                      const char* profile, char* errbuf, size_t errlen);
+void ec_codec_destroy(void* codec);
+
+int ec_codec_k(void* codec);
+int ec_codec_m(void* codec);
+unsigned ec_codec_chunk_size(void* codec, unsigned object_size);
+// Writes the resolved (echoed) profile as "k=v\n..." into buf; returns
+// the number of bytes that would be needed (snprintf contract).
+int ec_codec_profile(void* codec, char* buf, size_t buflen);
+// chunk_mapping[i] = physical chunk index of logical chunk i; identity
+// when the profile carries no remap. `out` must hold k+m ints.
+int ec_codec_chunk_mapping(void* codec, int* out);
+
+// Greedy minimum_to_decode. out_min must hold k+m ints; *nmin is set to
+// the count. Returns 0 or -errno.
+int ec_codec_minimum_to_decode(void* codec, const int* want, int nwant,
+                               const int* avail, int navail, int* out_min,
+                               int* nmin);
+
+// Encode a whole object: `in[0..len)` -> all k+m chunks, each
+// ec_codec_chunk_size(len) bytes, concatenated into `out` in chunk-id
+// order. Returns 0 or -errno.
+int ec_codec_encode(void* codec, const uint8_t* in, size_t len,
+                    uint8_t* out);
+
+// Raw chunk form: data = k chunk streams of `blocksize` bytes each
+// (logical order, concatenated); parity (m * blocksize) is written.
+int ec_codec_encode_chunks(void* codec, const uint8_t* data,
+                           uint8_t* parity, size_t blocksize);
+
+// Reconstruct chunks: avail_ids/navail name the surviving chunk ids whose
+// contents are concatenated in `chunks` (navail * blocksize). Every id in
+// want_ids is written to `out` (nwant * blocksize) in want order.
+int ec_codec_decode(void* codec, const int* avail_ids, int navail,
+                    const uint8_t* chunks, size_t blocksize,
+                    const int* want_ids, int nwant, uint8_t* out);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif

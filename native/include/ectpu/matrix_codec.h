@@ -40,8 +40,9 @@ class GeneratorCodec : public ErasureCode {
 
   // Cached per-erasure-signature decode matrices, the native analog of
   // ErasureCodeIsaTableCache (/root/reference/src/erasure-code/isa/
-  // ErasureCodeIsaTableCache.cc).
-  const std::vector<uint32_t>& decode_entry(const std::vector<int>& avail);
+  // ErasureCodeIsaTableCache.cc). nullptr when the submatrix is
+  // singular (non-MDS technique / bad rows) — never cached.
+  const std::vector<uint32_t>* decode_entry(const std::vector<int>& avail);
 
   std::vector<uint32_t> coding_;  // [m, k] GF generator
   std::map<std::vector<int>, std::vector<uint32_t>> decode_cache_;

@@ -47,8 +47,9 @@ class ErasureCodePluginRegistry {
 
  private:
   ErasureCodePluginRegistry() = default;
-  std::mutex lock_;
-  bool loading_ = false;
+  // recursive: factory() holds it across dlopen -> __erasure_code_init
+  // -> add()
+  std::recursive_mutex lock_;
   std::map<std::string, ErasureCodePlugin*> plugins_;
 };
 

@@ -1,0 +1,140 @@
+#include "ectpu/c_api.h"
+
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <sstream>
+
+#include "ectpu/registry.h"
+
+namespace {
+
+struct Handle {
+  ectpu::ErasureCodeInterfaceRef codec;
+};
+
+ectpu::Profile parse_profile(const char* s) {
+  ectpu::Profile p;
+  if (!s) return p;
+  std::istringstream ss(s);
+  std::string tok;
+  while (ss >> tok) {
+    auto eq = tok.find('=');
+    if (eq == std::string::npos) continue;
+    p[tok.substr(0, eq)] = tok.substr(eq + 1);
+  }
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ec_codec_create(const char* plugin, const char* directory,
+                      const char* profile, char* errbuf, size_t errlen) {
+  ectpu::Profile prof = parse_profile(profile);
+  ectpu::ErasureCodeInterfaceRef codec;
+  std::string err;
+  int r = ectpu::ErasureCodePluginRegistry::instance().factory(
+      plugin ? plugin : "", directory ? directory : ".", prof, &codec,
+      &err);
+  if (r != 0 || !codec) {
+    if (errbuf && errlen)
+      snprintf(errbuf, errlen, "factory: %s (%d)", err.c_str(), r);
+    return nullptr;
+  }
+  return new Handle{codec};
+}
+
+void ec_codec_destroy(void* codec) { delete (Handle*)codec; }
+
+int ec_codec_k(void* codec) {
+  return (int)((Handle*)codec)->codec->get_data_chunk_count();
+}
+
+int ec_codec_m(void* codec) {
+  auto& c = ((Handle*)codec)->codec;
+  return (int)(c->get_chunk_count() - c->get_data_chunk_count());
+}
+
+unsigned ec_codec_chunk_size(void* codec, unsigned object_size) {
+  return ((Handle*)codec)->codec->get_chunk_size(object_size);
+}
+
+int ec_codec_profile(void* codec, char* buf, size_t buflen) {
+  std::ostringstream os;
+  for (const auto& kv : ((Handle*)codec)->codec->get_profile())
+    os << kv.first << "=" << kv.second << "\n";
+  return snprintf(buf, buflen, "%s", os.str().c_str());
+}
+
+int ec_codec_chunk_mapping(void* codec, int* out) {
+  auto& c = ((Handle*)codec)->codec;
+  unsigned n = c->get_chunk_count();
+  for (unsigned i = 0; i < n; ++i) out[i] = c->chunk_index((int)i);
+  return 0;
+}
+
+int ec_codec_minimum_to_decode(void* codec, const int* want, int nwant,
+                               const int* avail, int navail, int* out_min,
+                               int* nmin) {
+  std::set<int> w(want, want + nwant), a(avail, avail + navail), m;
+  int r = ((Handle*)codec)->codec->minimum_to_decode(w, a, &m);
+  if (r) return r;
+  int i = 0;
+  for (int id : m) out_min[i++] = id;
+  *nmin = i;
+  return 0;
+}
+
+int ec_codec_encode(void* codec, const uint8_t* in, size_t len,
+                    uint8_t* out) {
+  auto& c = ((Handle*)codec)->codec;
+  unsigned n = c->get_chunk_count();
+  size_t blocksize = c->get_chunk_size((unsigned)len);
+  std::set<int> want;
+  for (unsigned i = 0; i < n; ++i) want.insert((int)i);
+  std::map<int, ectpu::Chunk> encoded;
+  int r = c->encode(want, in, len, &encoded);
+  if (r) return r;
+  for (unsigned i = 0; i < n; ++i) {
+    auto it = encoded.find((int)i);
+    if (it == encoded.end()) return -EIO;
+    memcpy(out + (size_t)i * blocksize, it->second.data(), blocksize);
+  }
+  return 0;
+}
+
+int ec_codec_encode_chunks(void* codec, const uint8_t* data,
+                           uint8_t* parity, size_t blocksize) {
+  auto& c = ((Handle*)codec)->codec;
+  unsigned k = c->get_data_chunk_count();
+  unsigned m = c->get_chunk_count() - k;
+  std::vector<const uint8_t*> dptr(k);
+  std::vector<uint8_t*> pptr(m);
+  for (unsigned i = 0; i < k; ++i) dptr[i] = data + (size_t)i * blocksize;
+  for (unsigned i = 0; i < m; ++i) pptr[i] = parity + (size_t)i * blocksize;
+  return c->encode_chunks(dptr.data(), pptr.data(), blocksize);
+}
+
+int ec_codec_decode(void* codec, const int* avail_ids, int navail,
+                    const uint8_t* chunks, size_t blocksize,
+                    const int* want_ids, int nwant, uint8_t* out) {
+  auto& c = ((Handle*)codec)->codec;
+  std::map<int, ectpu::Chunk> in;
+  for (int i = 0; i < navail; ++i)
+    in[avail_ids[i]].assign(chunks + (size_t)i * blocksize,
+                            chunks + (size_t)(i + 1) * blocksize);
+  std::set<int> want(want_ids, want_ids + nwant);
+  std::map<int, ectpu::Chunk> decoded;
+  int r = c->decode(want, in, &decoded);
+  if (r) return r;
+  for (int i = 0; i < nwant; ++i) {
+    auto it = decoded.find(want_ids[i]);
+    if (it == decoded.end() || it->second.size() != blocksize) return -EIO;
+    memcpy(out + (size_t)i * blocksize, it->second.data(), blocksize);
+  }
+  return 0;
+}
+
+}  // extern "C"

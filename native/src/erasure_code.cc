@@ -59,6 +59,9 @@ int ErasureCode::encode(const std::set<int>& want, const uint8_t* in,
                         size_t len, std::map<int, Chunk>* encoded) {
   unsigned k = get_data_chunk_count();
   unsigned n = get_chunk_count();
+  // get_chunk_size takes unsigned; a silently wrapped len would encode
+  // only the first 4 GiB of the object
+  if (len > 0xffffffffULL) return -EFBIG;
   size_t blocksize = get_chunk_size((unsigned)len);
   // encode_prepare: split + zero-pad (ErasureCode.cc:122-157)
   std::vector<Chunk> data(k, Chunk(blocksize, 0));
@@ -96,6 +99,12 @@ int ErasureCode::decode(const std::set<int>& want,
     return 0;
   }
   if (chunks.size() < k) return -EIO;
+  // caller-supplied ids cross the C ABI unvalidated; reject out-of-range
+  // before they index anything
+  for (int wanted : want)
+    if (wanted < 0 || wanted >= (int)n) return -EINVAL;
+  for (auto& kv : chunks)
+    if (kv.first < 0 || kv.first >= (int)n) return -EINVAL;
   // map chunk-mapped indices back to logical rows
   std::vector<int> inv(n);
   for (unsigned i = 0; i < n; ++i) inv[chunk_index((int)i)] = (int)i;

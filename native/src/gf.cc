@@ -5,6 +5,7 @@
 #include <algorithm>
 #include <cassert>
 #include <cstring>
+#include <map>
 #include <mutex>
 #include <stdexcept>
 
@@ -147,14 +148,34 @@ static void gf8_region_madd(uint8_t* dst, const uint8_t* src, uint8_t g,
 // product of a w-bit element by a constant is the XOR of per-byte
 // partial products).
 
+// Per-coefficient multiply tables, cached per thread: apply_matrix walks
+// a different coefficient per (row, col), so a single-entry cache always
+// misses, and the generator/decode matrices only ever use a small set of
+// distinct coefficients. Bounded so pathological coefficient churn can't
+// grow without limit.
+struct Gf16Tables {
+  uint16_t t0[256], t1[256];
+};
+
+static const Gf16Tables& gf16_tables(uint32_t g) {
+  static thread_local std::map<uint32_t, Gf16Tables> cache;
+  auto it = cache.find(g);
+  if (it == cache.end()) {
+    if (cache.size() >= 4096) cache.clear();
+    Gf16Tables t;
+    for (int x = 0; x < 256; ++x) {
+      t.t0[x] = (uint16_t)gf_mult(g, (uint32_t)x, 16);
+      t.t1[x] = (uint16_t)gf_mult(g, (uint32_t)x << 8, 16);
+    }
+    it = cache.emplace(g, t).first;
+  }
+  return it->second;
+}
+
 static void gf16_region_madd(uint8_t* dst8, const uint8_t* src8, uint32_t g,
                              size_t n) {
   if (g == 0) return;
-  uint16_t t0[256], t1[256];
-  for (int x = 0; x < 256; ++x) {
-    t0[x] = (uint16_t)gf_mult(g, (uint32_t)x, 16);
-    t1[x] = (uint16_t)gf_mult(g, (uint32_t)x << 8, 16);
-  }
+  const Gf16Tables& t = gf16_tables(g);
   size_t ne = n / 2;
   uint16_t* dst;
   const uint16_t* src;
@@ -162,21 +183,32 @@ static void gf16_region_madd(uint8_t* dst8, const uint8_t* src8, uint32_t g,
   memcpy(&src, &src8, sizeof(src));
   for (size_t i = 0; i < ne; ++i) {
     uint16_t s = src[i];
-    dst[i] ^= (uint16_t)(t0[s & 0xff] ^ t1[s >> 8]);
+    dst[i] ^= (uint16_t)(t.t0[s & 0xff] ^ t.t1[s >> 8]);
   }
+}
+
+struct Gf32Tables {
+  uint32_t t[4][256];
+};
+
+static const Gf32Tables& gf32_tables(uint32_t g) {
+  static thread_local std::map<uint32_t, Gf32Tables> cache;
+  auto it = cache.find(g);
+  if (it == cache.end()) {
+    if (cache.size() >= 4096) cache.clear();
+    Gf32Tables t;
+    for (int b = 0; b < 4; ++b)
+      for (int x = 0; x < 256; ++x)
+        t.t[b][x] = gf_mult(g, (uint32_t)x << (8 * b), 32);
+    it = cache.emplace(g, t).first;
+  }
+  return it->second;
 }
 
 static void gf32_region_madd(uint8_t* dst8, const uint8_t* src8, uint32_t g,
                              size_t n) {
   if (g == 0) return;
-  static thread_local uint32_t cached_g = 0;
-  static thread_local uint32_t t[4][256];
-  if (cached_g != g) {
-    for (int b = 0; b < 4; ++b)
-      for (int x = 0; x < 256; ++x)
-        t[b][x] = gf_mult(g, (uint32_t)x << (8 * b), 32);
-    cached_g = g;
-  }
+  const Gf32Tables& t = gf32_tables(g);
   size_t ne = n / 4;
   uint32_t* dst;
   const uint32_t* src;
@@ -184,8 +216,8 @@ static void gf32_region_madd(uint8_t* dst8, const uint8_t* src8, uint32_t g,
   memcpy(&src, &src8, sizeof(src));
   for (size_t i = 0; i < ne; ++i) {
     uint32_t s = src[i];
-    dst[i] ^= t[0][s & 0xff] ^ t[1][(s >> 8) & 0xff] ^
-              t[2][(s >> 16) & 0xff] ^ t[3][s >> 24];
+    dst[i] ^= t.t[0][s & 0xff] ^ t.t[1][(s >> 8) & 0xff] ^
+              t.t[2][(s >> 16) & 0xff] ^ t.t[3][s >> 24];
   }
 }
 

@@ -3,6 +3,7 @@
 #include <algorithm>
 #include <cerrno>
 #include <cstring>
+#include <numeric>
 
 #include "ectpu/gf.h"
 
@@ -59,19 +60,21 @@ unsigned GeneratorCodec::get_chunk_size(unsigned object_size) const {
   return (unsigned)(padded / k_);
 }
 
-const std::vector<uint32_t>& GeneratorCodec::decode_entry(
+const std::vector<uint32_t>* GeneratorCodec::decode_entry(
     const std::vector<int>& avail) {
   auto it = decode_cache_.find(avail);
-  if (it != decode_cache_.end()) return it->second;
+  if (it != decode_cache_.end()) return &it->second;
   // [k+m, k]: data-recovery matrix stacked with re-encode rows, the same
   // shape the Python side caches (matrix_base.py _full_decode_matrix)
   std::vector<uint32_t> dec((size_t)k_ * k_);
-  gf_decode_matrix(coding_.data(), k_, m_, avail.data(), dec.data(), w_);
+  if (!gf_decode_matrix(coding_.data(), k_, m_, avail.data(), dec.data(),
+                        w_))
+    return nullptr;  // singular submatrix: fail, never cache
   std::vector<uint32_t> full((size_t)(k_ + m_) * k_);
   memcpy(full.data(), dec.data(), (size_t)k_ * k_ * sizeof(uint32_t));
   gf_matmul(coding_.data(), dec.data(), full.data() + (size_t)k_ * k_, m_,
             k_, k_, w_);
-  return decode_cache_.emplace(avail, std::move(full)).first->second;
+  return &decode_cache_.emplace(avail, std::move(full)).first->second;
 }
 
 // ---------------------------------------------------------------------------
@@ -114,11 +117,13 @@ int MatrixCodec::encode_chunks(const uint8_t* const* data,
 int MatrixCodec::decode_chunks(const std::vector<int>& avail_rows,
                                const uint8_t* const* avail,
                                std::vector<Chunk>* all, size_t blocksize) {
-  const std::vector<uint32_t>& full = decode_entry(avail_rows);
+  if (blocksize % (size_t)(w_ / 8)) return -EINVAL;
+  const std::vector<uint32_t>* full = decode_entry(avail_rows);
+  if (!full) return -EIO;
   all->assign((size_t)(k_ + m_), Chunk(blocksize, 0));
   std::vector<uint8_t*> out(k_ + m_);
   for (int i = 0; i < k_ + m_; ++i) out[i] = (*all)[i].data();
-  apply_matrix(full.data(), k_ + m_, avail, out.data(), blocksize);
+  apply_matrix(full->data(), k_ + m_, avail, out.data(), blocksize);
   return 0;
 }
 
@@ -149,10 +154,12 @@ int BitmatrixCodec::prepare(std::string* err) {
 }
 
 unsigned BitmatrixCodec::get_alignment() const {
-  // ErasureCodeJerasure.cc:273-287
+  // ErasureCodeJerasure.cc:273-287; per-chunk alignment must stay a
+  // multiple of the w*packetsize superblock or encode_chunks would
+  // reject its own chunk size (lcm, not roundup)
   if (per_chunk_alignment_)
-    return (unsigned)roundup((size_t)w_ * packetsize_,
-                             LARGEST_VECTOR_WORDSIZE);
+    return (unsigned)std::lcm((size_t)w_ * packetsize_,
+                              (size_t)LARGEST_VECTOR_WORDSIZE);
   if (((size_t)w_ * packetsize_ * 4) % LARGEST_VECTOR_WORDSIZE)
     return (unsigned)((size_t)k_ * w_ * packetsize_ *
                       LARGEST_VECTOR_WORDSIZE);
@@ -198,12 +205,14 @@ int BitmatrixCodec::decode_chunks(const std::vector<int>& avail_rows,
                                   const uint8_t* const* avail,
                                   std::vector<Chunk>* all,
                                   size_t blocksize) {
+  if (blocksize % ((size_t)w_ * packetsize_)) return -EINVAL;
   auto it = decode_bitmat_cache_.find(avail_rows);
   if (it == decode_bitmat_cache_.end()) {
-    const std::vector<uint32_t>& full = decode_entry(avail_rows);
+    const std::vector<uint32_t>* full = decode_entry(avail_rows);
+    if (!full) return -EIO;
     it = decode_bitmat_cache_
              .emplace(avail_rows,
-                      generator_to_bitmatrix(full.data(), k_ + m_, k_, w_))
+                      generator_to_bitmatrix(full->data(), k_ + m_, k_, w_))
              .first;
   }
   all->assign((size_t)(k_ + m_), Chunk(blocksize, 0));
@@ -227,11 +236,12 @@ int ReedSolomonVandermonde::make_generator(std::string* err) {
 }
 
 int ReedSolomonRAID6::parse(Profile& profile, std::string* err) {
-  int r = MatrixCodec::parse(profile, err);
-  if (r) return r;
-  m_ = 2;  // RAID6 is always P+Q (ErasureCodeJerasure.h:112-133)
+  // RAID6 is always P+Q (ErasureCodeJerasure.h:112-133); force m before
+  // the base parse so the chunk-mapping size check validates against the
+  // real k+2 (an explicit conflicting m then fails the registry's
+  // profile-echo check rather than corrupting state)
   profile["m"] = "2";
-  return 0;
+  return MatrixCodec::parse(profile, err);
 }
 
 int ReedSolomonRAID6::make_generator(std::string* err) {

@@ -15,14 +15,17 @@ ErasureCodePluginRegistry& ErasureCodePluginRegistry::instance() {
 
 int ErasureCodePluginRegistry::add(const std::string& name,
                                    ErasureCodePlugin* plugin) {
-  // mutex held by load() during __erasure_code_init; direct calls (tests,
-  // built-ins) take it themselves via loading_ flag check
+  // recursive: factory() holds lock_ across load() -> __erasure_code_init
+  // -> here, while direct registrations (tests, built-ins) arrive with no
+  // lock held
+  std::unique_lock<std::recursive_mutex> l(lock_);
   if (plugins_.count(name)) return -EEXIST;
   plugins_[name] = plugin;
   return 0;
 }
 
 ErasureCodePlugin* ErasureCodePluginRegistry::get(const std::string& name) {
+  std::unique_lock<std::recursive_mutex> l(lock_);
   auto it = plugins_.find(name);
   return it == plugins_.end() ? nullptr : it->second;
 }
@@ -34,12 +37,10 @@ int ErasureCodePluginRegistry::factory(const std::string& name,
                                        std::string* err) {
   ErasureCodePlugin* plugin;
   {
-    std::unique_lock<std::mutex> l(lock_);
+    std::unique_lock<std::recursive_mutex> l(lock_);
     plugin = get(name);
     if (plugin == nullptr) {
-      loading_ = true;
       int r = load(name, directory, err);
-      loading_ = false;
       if (r) return r;
       plugin = get(name);
     }
@@ -124,7 +125,7 @@ int ErasureCodePluginRegistry::preload(const std::string& names,
   std::string name;
   while (std::getline(ss, name, ',')) {
     if (name.empty()) continue;
-    std::unique_lock<std::mutex> l(lock_);
+    std::unique_lock<std::recursive_mutex> l(lock_);
     if (get(name)) continue;
     int r = load(name, directory, err);
     if (r) return r;

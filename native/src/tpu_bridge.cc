@@ -39,6 +39,10 @@ struct Bridge {
     return b;
   }
 
+  // the function-local static is destroyed at process exit; a joinable
+  // collector thread at that point would std::terminate
+  ~Bridge() { stop(); }
+
   static bool compatible(const ec_tpu_request& a, const ec_tpu_request& b) {
     return a.k == b.k && a.m == b.m && a.w == b.w &&
            a.blocksize == b.blocksize &&
@@ -105,6 +109,19 @@ struct Bridge {
       running = false;
     }
     if (t.joinable()) t.join();
+    {
+      // the collector exits without draining; complete anything still
+      // queued with -EAGAIN so no ec_tpu_encode caller is left blocked
+      // holding a stack-allocated Pending the queue still points at
+      std::unique_lock<std::mutex> l(lock);
+      while (!queue.empty()) {
+        Pending* p = queue.front();
+        queue.pop_front();
+        p->result = -EAGAIN;
+        p->done = true;
+      }
+      done_cv.notify_all();
+    }
   }
 };
 

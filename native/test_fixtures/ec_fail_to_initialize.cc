@@ -1,0 +1,8 @@
+// Fixture: init entry returns an error — load propagates it.
+#include "ectpu/registry.h"
+extern "C" const char* __erasure_code_version() {
+  return ECTPU_VERSION_STRING;
+}
+extern "C" int __erasure_code_init(const char*, const char*) {
+  return -88;  // -ESRCH-ish sentinel the test asserts on
+}

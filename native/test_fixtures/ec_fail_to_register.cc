@@ -1,0 +1,6 @@
+// Fixture: init succeeds but never calls registry add — -EBADF.
+#include "ectpu/registry.h"
+extern "C" const char* __erasure_code_version() {
+  return ECTPU_VERSION_STRING;
+}
+extern "C" int __erasure_code_init(const char*, const char*) { return 0; }

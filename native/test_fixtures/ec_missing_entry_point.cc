@@ -1,0 +1,5 @@
+// Fixture: version but no __erasure_code_init — load fails -ENOENT.
+#include "ectpu/registry.h"
+extern "C" const char* __erasure_code_version() {
+  return ECTPU_VERSION_STRING;
+}

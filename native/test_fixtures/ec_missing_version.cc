@@ -1,0 +1,4 @@
+// Fixture: a plugin .so with no __erasure_code_version — the registry
+// must refuse it with -EXDEV (reference: MissingVersion.cc fixture,
+// /root/reference/src/test/erasure-code/TestErasureCodePlugin.cc).
+extern "C" int __erasure_code_init(const char*, const char*) { return 0; }
