@@ -226,9 +226,14 @@ class BitmatrixErasureCode(GeneratorCodec):
             "jerasure-per-chunk-alignment", profile, "false")
 
     def get_alignment(self) -> int:
-        # ErasureCodeJerasure.cc:273-287.
+        # ErasureCodeJerasure.cc:273-287; per-chunk alignment must stay a
+        # multiple of the w*packetsize superblock or encode would reject
+        # its own chunk size (lcm, not roundup — same fix as the native
+        # BitmatrixCodec::get_alignment)
         if self.per_chunk_alignment:
-            return _roundup(self.w * self.packetsize, LARGEST_VECTOR_WORDSIZE)
+            import math
+            return math.lcm(self.w * self.packetsize,
+                            LARGEST_VECTOR_WORDSIZE)
         if (self.w * self.packetsize * 4) % LARGEST_VECTOR_WORDSIZE:
             return self.k * self.w * self.packetsize * LARGEST_VECTOR_WORDSIZE
         return self.k * self.w * self.packetsize * 4

@@ -143,6 +143,10 @@ class NativeCodec:
     def decode(self, available: dict, want=None) -> dict:
         ids = sorted(available)
         bs = len(available[ids[0]])
+        if any(len(available[i]) != bs for i in ids):
+            # the C side reads navail*blocksize contiguous bytes; ragged
+            # chunks would read past the joined buffer
+            raise ValueError("all available chunks must be equal length")
         if want is None:
             want = list(range(self.k + self.m))
         a = (ctypes.c_int * len(ids))(*ids)

@@ -82,14 +82,15 @@ class _LrcPlugin(ErasureCodePlugin):
 
 
 def _jerasure_techniques():
-    from .models import cauchy, rs
+    from .models import cauchy, liberation, rs
     return {
         "reed_sol_van": rs.ReedSolomonVandermonde,
         "reed_sol_r6_op": rs.ReedSolomonRAID6,
         "cauchy_orig": cauchy.CauchyOrig,
         "cauchy_good": cauchy.CauchyGood,
-        # liberation / blaum_roth / liber8tion land with the bit-scheduled
-        # codec work (SURVEY.md §7 stage 5).
+        "liberation": liberation.Liberation,
+        "blaum_roth": liberation.BlaumRoth,
+        "liber8tion": liberation.Liber8tion,
     }
 
 

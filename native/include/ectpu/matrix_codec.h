@@ -113,4 +113,52 @@ class CauchyGood : public BitmatrixCodec {
   int make_generator(std::string* err) override;
 };
 
+// Bitmatrix codec whose parity is NOT GF(2^w)-linear (liberation /
+// blaum_roth): the encode matrix comes from make_bitmatrix() and decode
+// entries are built by GF(2) inversion of the stacked [I; coding]
+// bitmatrix (mirrors ceph_tpu/models/liberation.py PureBitmatrixCode).
+class PureBitmatrixCodec : public BitmatrixCodec {
+ protected:
+  int make_generator(std::string* err) override {  // no GF generator
+    (void)err;
+    return 0;
+  }
+  virtual std::vector<uint8_t> make_bitmatrix() = 0;
+  int prepare(std::string* err) override;
+  int decode_chunks(const std::vector<int>& avail_rows,
+                    const uint8_t* const* avail, std::vector<Chunk>* all,
+                    size_t blocksize) override;
+};
+
+// RAID-6 liberation (Plank FAST'08): w prime, k <= w, m = 2.
+class Liberation : public PureBitmatrixCodec {
+ protected:
+  const char* default_k() const override { return "2"; }
+  const char* default_m() const override { return "2"; }
+  const char* default_w() const override { return "7"; }
+  int parse(Profile& profile, std::string* err) override;
+  std::vector<uint8_t> make_bitmatrix() override;
+};
+
+// RAID-6 Blaum-Roth over GF(2)[x]/M_p(x), p = w+1 prime.
+class BlaumRoth : public PureBitmatrixCodec {
+ protected:
+  const char* default_k() const override { return "2"; }
+  const char* default_m() const override { return "2"; }
+  const char* default_w() const override { return "6"; }
+  int parse(Profile& profile, std::string* err) override;
+  std::vector<uint8_t> make_bitmatrix() override;
+};
+
+// RAID-6 with w fixed at 8, k <= 8 (GF(2^8) generator [1...1; 1,g,g^2..]
+// — behaviorally equivalent to the published search-derived tables).
+class Liber8tion : public BitmatrixCodec {
+ protected:
+  const char* default_k() const override { return "2"; }
+  const char* default_m() const override { return "2"; }
+  const char* default_w() const override { return "8"; }
+  int parse(Profile& profile, std::string* err) override;
+  int make_generator(std::string* err) override;
+};
+
 }  // namespace ectpu

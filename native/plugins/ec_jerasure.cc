@@ -30,11 +30,18 @@ class JerasurePlugin : public ErasureCodePlugin {
       impl = new CauchyOrig();
     else if (technique == "cauchy_good")
       impl = new CauchyGood();
+    else if (technique == "liberation")
+      impl = new Liberation();
+    else if (technique == "blaum_roth")
+      impl = new BlaumRoth();
+    else if (technique == "liber8tion")
+      impl = new Liber8tion();
     else {
       if (err)
         *err += technique +
                 " is not a valid coding technique. Choose one of: "
-                "reed_sol_van, reed_sol_r6_op, cauchy_orig, cauchy_good";
+                "reed_sol_van, reed_sol_r6_op, cauchy_orig, cauchy_good, "
+                "liberation, blaum_roth, liber8tion";
       return -ENOENT;
     }
     ErasureCodeInterfaceRef ref(impl);

@@ -78,9 +78,18 @@ int ec_codec_chunk_mapping(void* codec, int* out) {
 int ec_codec_minimum_to_decode(void* codec, const int* want, int nwant,
                                const int* avail, int navail, int* out_min,
                                int* nmin) {
+  auto& c = ((Handle*)codec)->codec;
+  int n = (int)c->get_chunk_count();
+  // out_min is documented as k+m ints; unvalidated ids would both
+  // overflow it and index past codec state downstream
+  for (int i = 0; i < nwant; ++i)
+    if (want[i] < 0 || want[i] >= n) return -EINVAL;
+  for (int i = 0; i < navail; ++i)
+    if (avail[i] < 0 || avail[i] >= n) return -EINVAL;
   std::set<int> w(want, want + nwant), a(avail, avail + navail), m;
-  int r = ((Handle*)codec)->codec->minimum_to_decode(w, a, &m);
+  int r = c->minimum_to_decode(w, a, &m);
   if (r) return r;
+  if ((int)m.size() > n) return -EINVAL;
   int i = 0;
   for (int id : m) out_min[i++] = id;
   *nmin = i;

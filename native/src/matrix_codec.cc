@@ -36,8 +36,8 @@ int GeneratorCodec::parse(Profile& profile, std::string* err) {
     if (err) *err += "m must be >= 1";
     return -EINVAL;
   }
-  if (w_ != 8 && w_ != 16 && w_ != 32) {
-    if (err) *err += "w must be one of 8, 16, 32";
+  if (w_ < 2 || w_ > 32) {
+    if (err) *err += "w must be in 2..32";
     return -EINVAL;
   }
   return 0;
@@ -83,6 +83,12 @@ const std::vector<uint32_t>* GeneratorCodec::decode_entry(
 int MatrixCodec::parse(Profile& profile, std::string* err) {
   int r = GeneratorCodec::parse(profile, err);
   if (r) return r;
+  // element-layout region kernels exist for machine word sizes only;
+  // bitmatrix codecs are packet-XOR and take any w in 2..32
+  if (w_ != 8 && w_ != 16 && w_ != 32) {
+    if (err) *err += "w must be one of 8, 16, 32";
+    return -EINVAL;
+  }
   per_chunk_alignment_ =
       to_bool("jerasure-per-chunk-alignment", profile, "false");
   return 0;
@@ -271,6 +277,191 @@ int CauchyGood::make_generator(std::string* err) {
     if (err) *err += e.what();
     return -EINVAL;
   }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Liberation family (mirrors ceph_tpu/models/liberation.py)
+
+// GF(2) Gaussian elimination; false when singular.
+static bool binary_invert(std::vector<uint8_t> a, int n,
+                          std::vector<uint8_t>* out) {
+  std::vector<uint8_t> inv((size_t)n * n, 0);
+  for (int i = 0; i < n; ++i) inv[(size_t)i * n + i] = 1;
+  for (int col = 0; col < n; ++col) {
+    int piv = -1;
+    for (int r = col; r < n; ++r)
+      if (a[(size_t)r * n + col]) {
+        piv = r;
+        break;
+      }
+    if (piv < 0) return false;
+    if (piv != col) {
+      for (int c = 0; c < n; ++c) {
+        std::swap(a[(size_t)col * n + c], a[(size_t)piv * n + c]);
+        std::swap(inv[(size_t)col * n + c], inv[(size_t)piv * n + c]);
+      }
+    }
+    for (int r = 0; r < n; ++r) {
+      if (r == col || !a[(size_t)r * n + col]) continue;
+      for (int c = 0; c < n; ++c) {
+        a[(size_t)r * n + c] ^= a[(size_t)col * n + c];
+        inv[(size_t)r * n + c] ^= inv[(size_t)col * n + c];
+      }
+    }
+  }
+  *out = std::move(inv);
+  return true;
+}
+
+static bool is_prime(int n) {
+  if (n < 2) return false;
+  for (int d = 2; d * d <= n; ++d)
+    if (n % d == 0) return false;
+  return true;
+}
+
+int PureBitmatrixCodec::prepare(std::string* err) {
+  (void)err;
+  coding_.clear();
+  encode_bitmat_ = make_bitmatrix();
+  decode_bitmat_cache_.clear();
+  decode_cache_.clear();
+  return 0;
+}
+
+int PureBitmatrixCodec::decode_chunks(const std::vector<int>& avail_rows,
+                                      const uint8_t* const* avail,
+                                      std::vector<Chunk>* all,
+                                      size_t blocksize) {
+  if (blocksize % ((size_t)w_ * packetsize_)) return -EINVAL;
+  auto it = decode_bitmat_cache_.find(avail_rows);
+  if (it == decode_bitmat_cache_.end()) {
+    // stacked [I; coding] bitmatrix: [(k+m)w, kw]
+    int kw = k_ * w_, nw = (k_ + m_) * w_;
+    std::vector<uint8_t> full((size_t)nw * kw, 0);
+    for (int i = 0; i < kw; ++i) full[(size_t)i * kw + i] = 1;
+    for (int r = 0; r < m_ * w_; ++r)
+      memcpy(&full[(size_t)(kw + r) * kw], &encode_bitmat_[(size_t)r * kw],
+             (size_t)kw);
+    std::vector<uint8_t> sub((size_t)kw * kw);
+    for (int i = 0; i < k_; ++i)
+      memcpy(&sub[(size_t)i * w_ * kw],
+             &full[(size_t)avail_rows[i] * w_ * kw], (size_t)w_ * kw);
+    std::vector<uint8_t> inv;
+    if (!binary_invert(std::move(sub), kw, &inv)) return -EIO;
+    std::vector<uint8_t> dec((size_t)nw * kw, 0);
+    for (int r = 0; r < nw; ++r)
+      for (int t = 0; t < kw; ++t) {
+        if (!full[(size_t)r * kw + t]) continue;
+        for (int c = 0; c < kw; ++c)
+          dec[(size_t)r * kw + c] ^= inv[(size_t)t * kw + c];
+      }
+    it = decode_bitmat_cache_.emplace(avail_rows, std::move(dec)).first;
+  }
+  all->assign((size_t)(k_ + m_), Chunk(blocksize, 0));
+  std::vector<uint8_t*> out(k_ + m_);
+  for (int i = 0; i < k_ + m_; ++i) out[i] = (*all)[i].data();
+  apply_bitmatrix(it->second.data(), k_ + m_, avail, out.data(), blocksize);
+  return 0;
+}
+
+int Liberation::parse(Profile& profile, std::string* err) {
+  profile["m"] = "2";
+  int r = BitmatrixCodec::parse(profile, err);
+  if (r) return r;
+  if (!is_prime(w_)) {
+    if (err) *err += "w must be prime for liberation";
+    return -EINVAL;
+  }
+  if (k_ > w_) {
+    if (err) *err += "k must be <= w for liberation";
+    return -EINVAL;
+  }
+  if (packetsize_ % 8) {
+    if (err) *err += "packetsize must be a multiple of 8";
+    return -EINVAL;
+  }
+  return 0;
+}
+
+std::vector<uint8_t> Liberation::make_bitmatrix() {
+  int k = k_, w = w_;
+  std::vector<uint8_t> mat((size_t)2 * w * k * w, 0);
+  size_t cols = (size_t)k * w;
+  for (int i = 0; i < k; ++i) {
+    for (int j = 0; j < w; ++j) {
+      mat[(size_t)j * cols + i * w + j] = 1;                // P: identity
+      mat[(size_t)(w + j) * cols + i * w + (j + i) % w] = 1;  // Q: shift
+    }
+    if (i > 0) {
+      int j = (i * ((w - 1) / 2)) % w;                      // extra bit
+      mat[(size_t)(w + j) * cols + i * w + (j + i - 1 + w) % w] ^= 1;
+    }
+  }
+  return mat;
+}
+
+int BlaumRoth::parse(Profile& profile, std::string* err) {
+  profile["m"] = "2";
+  int r = BitmatrixCodec::parse(profile, err);
+  if (r) return r;
+  if (!is_prime(w_ + 1)) {
+    if (err) *err += "w+1 must be prime for blaum_roth";
+    return -EINVAL;
+  }
+  if (k_ > w_) {
+    if (err) *err += "k must be <= w for blaum_roth";
+    return -EINVAL;
+  }
+  if (packetsize_ % 8) {
+    if (err) *err += "packetsize must be a multiple of 8";
+    return -EINVAL;
+  }
+  return 0;
+}
+
+std::vector<uint8_t> BlaumRoth::make_bitmatrix() {
+  int k = k_, w = w_, p = w_ + 1;
+  std::vector<uint8_t> mat((size_t)2 * w * k * w, 0);
+  size_t cols = (size_t)k * w;
+  for (int i = 0; i < k; ++i) {
+    for (int j = 0; j < w; ++j)
+      mat[(size_t)j * cols + i * w + j] = 1;  // P: identity
+    // Q column block i: multiply-by-x^i in GF(2)[x]/M_p(x); x^w reduces
+    // to 1 + x + ... + x^{w-1}
+    for (int c = 0; c < w; ++c) {
+      int e = (c + i) % p;
+      if (e == w)
+        for (int t = 0; t < w; ++t)
+          mat[(size_t)(w + t) * cols + i * w + c] ^= 1;
+      else
+        mat[(size_t)(w + e) * cols + i * w + c] ^= 1;
+    }
+  }
+  return mat;
+}
+
+int Liber8tion::parse(Profile& profile, std::string* err) {
+  profile["m"] = "2";
+  if (profile.find("w") == profile.end()) profile["w"] = "8";
+  int r = BitmatrixCodec::parse(profile, err);
+  if (r) return r;
+  if (w_ != 8) {
+    if (err) *err += "w must be 8 for liber8tion";
+    return -EINVAL;
+  }
+  if (k_ > 8) {
+    if (err) *err += "k must be <= 8 for liber8tion";
+    return -EINVAL;
+  }
+  return 0;
+}
+
+int Liber8tion::make_generator(std::string* err) {
+  (void)err;
+  coding_.assign((size_t)2 * k_, 1);
+  for (int i = 0; i < k_; ++i) coding_[(size_t)k_ + i] = gf_pow(2, i, 8);
   return 0;
 }
 

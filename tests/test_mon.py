@@ -31,7 +31,7 @@ def make_monmap(n):
     return {r: ("127.0.0.1", p) for r, p in enumerate(free_ports(n))}
 
 
-def wait_until(fn, timeout=5.0):
+def wait_until(fn, timeout=20.0):  # generous: full-suite load can slow election
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if fn():
