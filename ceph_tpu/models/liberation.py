@@ -35,9 +35,9 @@ import errno
 import numpy as np
 
 from ..ops import gf
-from ..utils import profile as profile_util
 from .base import ErasureCodeError
 from .matrix_base import BitmatrixErasureCode
+from .table_cache import xor_parity_rows
 
 __all__ = ["Liberation", "BlaumRoth", "Liber8tion"]
 
@@ -81,6 +81,22 @@ class PureBitmatrixCode(BitmatrixErasureCode):
     def make_bitmatrix(self) -> np.ndarray:
         raise NotImplementedError
 
+    def _check_geometry(self, primality_ok: bool = False) -> None:
+        if not primality_ok and not _is_prime(self.w):
+            raise ErasureCodeError(
+                errno.EINVAL,
+                "w=%d must be prime for %s" % (self.w, self.technique))
+        if self.k > self.w:
+            raise ErasureCodeError(
+                errno.EINVAL,
+                "k=%d must be <= w=%d for %s" % (self.k, self.w,
+                                                 self.technique))
+        if self.packetsize % 8:
+            # jerasure requires packetsize to cover whole machine words
+            raise ErasureCodeError(
+                errno.EINVAL,
+                "packetsize=%d must be a multiple of 8" % self.packetsize)
+
     def prepare(self) -> None:
         try:
             self._bitmat = np.ascontiguousarray(
@@ -89,7 +105,8 @@ class PureBitmatrixCode(BitmatrixErasureCode):
             raise ErasureCodeError(errno.EINVAL, str(e))
         self.coding = None
         self._bitmat_dev = None
-        self._decode_cache = {}
+        self._decode_cache.clear()
+        self._xor_rows = xor_parity_rows(self._bitmat, self.k, self.w)
 
     def _stacked_bitmat(self) -> np.ndarray:
         kw = self.k * self.w
@@ -109,8 +126,8 @@ class PureBitmatrixCode(BitmatrixErasureCode):
                     errno.EIO, "erasure pattern %r is not decodable"
                     % (avail_rows,))
             dec = (full.astype(np.uint16) @ inv.astype(np.uint16)) % 2
-            entry = {"gf": None, "bitmat": dec.astype(np.uint8)}
-            self._decode_cache[avail_rows] = entry
+            entry = self._decode_cache.put(
+                avail_rows, {"gf": None, "bitmat": dec.astype(np.uint8)})
         return entry
 
 
@@ -126,20 +143,6 @@ class Liberation(PureBitmatrixCode):
         profile["m"] = "2"  # P+Q only, like reed_sol_r6_op
         super().parse(profile, errors)
         self._check_geometry()
-
-    def _check_geometry(self) -> None:
-        if not _is_prime(self.w):
-            raise ErasureCodeError(
-                errno.EINVAL, "w=%d must be prime for liberation" % self.w)
-        if self.k > self.w:
-            raise ErasureCodeError(
-                errno.EINVAL,
-                "k=%d must be <= w=%d for liberation" % (self.k, self.w))
-        if self.packetsize % 8:
-            # jerasure requires packetsize to cover whole machine words
-            raise ErasureCodeError(
-                errno.EINVAL,
-                "packetsize=%d must be a multiple of 8" % self.packetsize)
 
     def make_bitmatrix(self) -> np.ndarray:
         k, w = self.k, self.w
@@ -169,14 +172,7 @@ class BlaumRoth(PureBitmatrixCode):
             raise ErasureCodeError(
                 errno.EINVAL,
                 "w=%d: w+1 must be prime for blaum_roth" % self.w)
-        if self.k > self.w:
-            raise ErasureCodeError(
-                errno.EINVAL,
-                "k=%d must be <= w=%d for blaum_roth" % (self.k, self.w))
-        if self.packetsize % 8:
-            raise ErasureCodeError(
-                errno.EINVAL,
-                "packetsize=%d must be a multiple of 8" % self.packetsize)
+        self._check_geometry(primality_ok=True)
 
     def make_bitmatrix(self) -> np.ndarray:
         k, w = self.k, self.w
@@ -219,6 +215,11 @@ class Liber8tion(BitmatrixErasureCode):
         if self.k > 8:
             raise ErasureCodeError(
                 errno.EINVAL, "k=%d must be <= 8 for liber8tion" % self.k)
+        if self.packetsize % 8:
+            # same whole-machine-word requirement as the rest of the family
+            raise ErasureCodeError(
+                errno.EINVAL,
+                "packetsize=%d must be a multiple of 8" % self.packetsize)
 
     def make_generator(self) -> np.ndarray:
         gen = np.zeros((2, self.k), dtype=np.uint32)

@@ -28,6 +28,7 @@ import numpy as np
 from ..ops import gf, gf_ref
 from ..utils import profile as profile_util
 from .base import ErasureCode, ErasureCodeError
+from .table_cache import TableCache, xor_parity_rows, xor_recover
 
 LARGEST_VECTOR_WORDSIZE = 16  # reference SIMD word (ErasureCodeJerasure.cc:31)
 
@@ -54,7 +55,9 @@ class GeneratorCodec(ErasureCode):
         self.coding: np.ndarray | None = None   # [m, k] GF generator
         self._bitmat: np.ndarray | None = None  # [m*w, k*w] encode bitmatrix
         self._bitmat_dev = None
-        self._decode_cache: dict = {}
+        self._decode_cache = TableCache()
+        self._xor_rows: list[int] = []  # parity rows that are plain XORs
+        self.xor_fast_hits = 0
 
     # -- profile -----------------------------------------------------------
 
@@ -109,7 +112,8 @@ class GeneratorCodec(ErasureCode):
             raise ErasureCodeError(errno.EINVAL, str(e))
         self._bitmat = gf.generator_to_bitmatrix(self.coding, self.w)
         self._bitmat_dev = None
-        self._decode_cache = {}
+        self._decode_cache.clear()
+        self._xor_rows = xor_parity_rows(self._bitmat, self.k, self.w)
 
     def _device_bitmat(self):
         if self._bitmat_dev is None:
@@ -148,10 +152,49 @@ class GeneratorCodec(ErasureCode):
         entry = self._decode_cache.get(avail_rows)
         if entry is None:
             full = self._full_decode_matrix(avail_rows)
-            entry = {"gf": full,
-                     "bitmat": gf.generator_to_bitmatrix(full, self.w)}
-            self._decode_cache[avail_rows] = entry
+            entry = self._decode_cache.put(
+                avail_rows,
+                {"gf": full,
+                 "bitmat": gf.generator_to_bitmatrix(full, self.w)})
         return entry
+
+    def table_cache_stats(self) -> dict:
+        stats = self._decode_cache.stats()
+        stats["xor_fast_hits"] = self.xor_fast_hits
+        return stats
+
+    # -- single-erasure XOR fast path ---------------------------------------
+
+    def decode_all(self, chunks: dict) -> dict:
+        fast = self._xor_decode_all(chunks)
+        return fast if fast is not None else super().decode_all(chunks)
+
+    def _xor_decode_all(self, chunks: dict):
+        """Region-XOR shortcut for a single erasure (isa/xor_op analog).
+
+        Applies when exactly one chunk is missing and it is either a data
+        chunk or the XOR parity itself; recovery is then a byte-wise XOR
+        over the survivors of the XOR group — no inversion, no device
+        round-trip.
+        """
+        if not self._xor_rows:
+            return None
+        n = self.get_chunk_count()
+        if len(chunks) != n - 1:
+            return None
+        inv = {self.chunk_index(i): i for i in range(n)}
+        logical = {inv[idx]: np.asarray(buf, dtype=np.uint8)
+                   for idx, buf in chunks.items()}
+        missing = (set(range(n)) - set(logical)).pop()
+        if missing < self.k:
+            row = self._xor_rows[0]
+        elif missing - self.k in self._xor_rows:
+            row = missing - self.k
+        else:
+            return None  # a non-XOR parity is missing; need real decode
+        logical[missing] = xor_recover(missing, self.k, row, logical)
+        self.xor_fast_hits += 1
+        return {self.chunk_index(i): logical[i] for i in range(n)}
 
     # -- batched device API -------------------------------------------------
 

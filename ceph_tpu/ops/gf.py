@@ -244,7 +244,18 @@ def rs_vandermonde_generator(k: int, m: int, w: int) -> np.ndarray:
         for j in range(k):
             v[i, j] = gf_pow(i, j, w) if not (i == 0 and j == 0) else 1
     top_inv = gf_invert_matrix(v[:k], w)
-    return gf_matmul(v[k:], top_inv, w)
+    c = gf_matmul(v[k:], top_inv, w)
+    # Normalize so the first parity row is all ones (as jerasure's
+    # reed_sol_vandermonde_coding_matrix guarantees): scale parity column j
+    # by inv(C[0,j]). Column scaling of the parity block is equivalent to
+    # scaling column j of [I; C] then rescaling data row j — both preserve
+    # every k x k subdeterminant, so the code stays systematic and MDS.
+    # This enables the single-erasure XOR fast path (isa/xor_op analog).
+    for j in range(k):
+        f = gf_inv(int(c[0, j]), w)
+        for i in range(m):
+            c[i, j] = gf_mult(int(c[i, j]), f, w)
+    return c
 
 
 def rs_r6_generator(k: int, w: int) -> np.ndarray:

@@ -318,6 +318,14 @@ std::vector<uint32_t> rs_vandermonde_generator(int k, int m, int w) {
     throw std::runtime_error("vandermonde top not invertible");
   std::vector<uint32_t> out((size_t)m * k);
   gf_matmul(v.data() + (size_t)k * k, top_inv.data(), out.data(), m, k, k, w);
+  // Normalize the first parity row to all ones (column scaling of the
+  // parity block preserves systematic form + MDS); enables the
+  // single-erasure XOR fast path and mirrors gf.py.
+  for (int j = 0; j < k; ++j) {
+    uint32_t f = gf_inv(out[j], w);
+    for (int i = 0; i < m; ++i)
+      out[(size_t)i * k + j] = gf_mult(out[(size_t)i * k + j], f, w);
+  }
   return out;
 }
 

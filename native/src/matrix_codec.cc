@@ -455,6 +455,10 @@ int Liber8tion::parse(Profile& profile, std::string* err) {
     if (err) *err += "k must be <= 8 for liber8tion";
     return -EINVAL;
   }
+  if (packetsize_ % 8) {
+    if (err) *err += "packetsize must be a multiple of 8";
+    return -EINVAL;
+  }
   return 0;
 }
 

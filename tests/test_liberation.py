@@ -58,8 +58,7 @@ class TestEncodeDecode:
         data = b"x" * 500
         enc = c.encode(set(range(n)), data)
         avail = {i: enc[i] for i in range(n - 3)}
-        if len(avail) >= k:
-            return  # k survivors still suffice for m=2 codes w/ n-3 >= k
+        assert len(avail) < k  # m=2 family: n-3 == k-1 survivors, always short
         with pytest.raises(ErasureCodeError):
             c.decode(set(range(n - 3, n)), avail)
 

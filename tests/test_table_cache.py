@@ -1,0 +1,124 @@
+"""Decode-table cache + single-erasure XOR fast path.
+
+Models the reference's ISA table-cache behavior
+(src/erasure-code/isa/ErasureCodeIsaTableCache.{h,cc}: LRU of decode
+tables keyed by erasure signature) and the single-erasure region-XOR
+shortcut (src/erasure-code/isa/xor_op.{h,cc}).
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu import registry
+from ceph_tpu.models.table_cache import TableCache, xor_parity_rows
+
+
+def make(plugin, **profile):
+    prof = {str(k): str(v) for k, v in profile.items()}
+    return registry.factory(plugin, prof)
+
+
+def payload(size, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=size, dtype=np.uint8).tobytes()
+
+
+class TestTableCache:
+    def test_lru_eviction_and_stats(self):
+        c = TableCache(capacity=2)
+        c.put(("a",), {"v": 1})
+        c.put(("b",), {"v": 2})
+        assert c.get(("a",)) == {"v": 1}     # refresh a
+        c.put(("c",), {"v": 3})              # evicts b (LRU)
+        assert c.get(("b",)) is None
+        assert c.get(("a",)) is not None
+        assert c.get(("c",)) is not None
+        s = c.stats()
+        assert s["entries"] == 2 and s["evictions"] == 1
+        assert s["hits"] == 3 and s["misses"] == 1
+
+    def test_put_race_first_writer_wins(self):
+        c = TableCache()
+        first = c.put(("s",), {"v": 1})
+        second = c.put(("s",), {"v": 2})
+        assert first is second and second["v"] == 1
+
+    def test_bad_capacity(self):
+        with pytest.raises(ValueError):
+            TableCache(capacity=0)
+
+
+class TestCodecCacheIntegration:
+    def test_repeated_signature_hits_cache(self):
+        codec = make("jerasure", technique="reed_sol_van", k=4, m=2, w=8)
+        raw = payload(4096)
+        encoded = codec.encode(set(range(6)), raw)
+        for _ in range(3):
+            chunks = {i: encoded[i] for i in range(6) if i not in (0, 1)}
+            decoded = codec.decode({0, 1}, chunks)
+            assert np.array_equal(decoded[0], encoded[0])
+        stats = codec.table_cache_stats()
+        assert stats["misses"] == 1 and stats["hits"] == 2
+
+    def test_prepare_clears_cache(self):
+        codec = make("jerasure", technique="reed_sol_van", k=4, m=2, w=8)
+        raw = payload(4096)
+        encoded = codec.encode(set(range(6)), raw)
+        chunks = {i: encoded[i] for i in range(6) if i not in (0, 1)}
+        codec.decode({0, 1}, chunks)
+        codec.prepare()
+        assert codec.table_cache_stats()["entries"] == 0
+
+
+class TestXorFastPath:
+    @pytest.mark.parametrize("technique,kw", [
+        ("reed_sol_van", dict(k=4, m=2, w=8)),
+        ("liberation", dict(k=3, m=2, w=7)),
+        ("blaum_roth", dict(k=4, m=2, w=6)),
+        ("liber8tion", dict(k=4, m=2, w=8)),
+        ("cauchy_good", dict(k=4, m=2, w=8)),
+    ])
+    def test_single_data_erasure_uses_xor(self, technique, kw):
+        codec = make("jerasure", technique=technique, **kw)
+        assert codec._xor_rows, technique  # first parity is a plain XOR
+        raw = payload(8192, seed=3)
+        n = codec.get_chunk_count()
+        encoded = codec.encode(set(range(n)), raw)
+        chunks = {i: encoded[i] for i in range(n) if i != 2}
+        decoded = codec.decode({2}, chunks)
+        assert np.array_equal(decoded[2], encoded[2])
+        assert codec.xor_fast_hits == 1
+        assert codec.table_cache_stats()["misses"] == 0  # never hit the cache
+
+    def test_xor_parity_erasure_uses_xor(self):
+        codec = make("jerasure", technique="reed_sol_van", k=4, m=2, w=8)
+        raw = payload(4096, seed=5)
+        encoded = codec.encode(set(range(6)), raw)
+        chunks = {i: encoded[i] for i in range(6) if i != 4}  # parity row 0
+        decoded = codec.decode({4}, chunks)
+        assert np.array_equal(decoded[4], encoded[4])
+        assert codec.xor_fast_hits == 1
+
+    def test_non_xor_parity_falls_back(self):
+        codec = make("jerasure", technique="reed_sol_van", k=4, m=2, w=8)
+        raw = payload(4096, seed=7)
+        encoded = codec.encode(set(range(6)), raw)
+        chunks = {i: encoded[i] for i in range(6) if i != 5}  # parity row 1
+        decoded = codec.decode({5}, chunks)
+        assert np.array_equal(decoded[5], encoded[5])
+        assert codec.xor_fast_hits == 0
+
+    def test_double_erasure_falls_back(self):
+        codec = make("jerasure", technique="reed_sol_van", k=4, m=2, w=8)
+        raw = payload(4096, seed=9)
+        encoded = codec.encode(set(range(6)), raw)
+        chunks = {i: encoded[i] for i in range(6) if i not in (1, 3)}
+        decoded = codec.decode({1, 3}, chunks)
+        assert np.array_equal(decoded[1], encoded[1])
+        assert np.array_equal(decoded[3], encoded[3])
+        assert codec.xor_fast_hits == 0
+
+    def test_xor_rows_detection(self):
+        codec = make("jerasure", technique="reed_sol_van", k=4, m=3, w=8)
+        rows = xor_parity_rows(codec._bitmat, codec.k, codec.w)
+        assert rows == [0]  # Vandermonde: only the first parity is all-ones
