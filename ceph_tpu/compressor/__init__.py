@@ -1,0 +1,21 @@
+"""Compression plugin subsystem.
+
+Second instance of the reference's dlopen-plugin idiom
+(/root/reference/src/compressor/: `Compressor` interface in
+Compressor.{h,cc}, `CompressionPlugin.h`, per-algorithm plugin dirs
+zlib/ snappy/ zstd/ lz4/). Mirrors the same registry contract as the
+erasure-code side (load-on-demand under a lock, EEXIST on duplicate
+registration, version gating) and the `Compressor::create` alias
+resolution + BlueStore compression-mode policy
+(none/passive/aggressive/force, Compressor.h `CompressionMode`).
+
+Algorithms: zlib (stdlib) and zstd (zstandard package) always work in
+this image; snappy and lz4 register but fail to load with ENOENT when
+their host libraries are absent — the same observable behavior as a
+missing libceph_snappy.so in the reference.
+"""
+
+from .base import Compressor, CompressorError, MODE_AGGRESSIVE  # noqa: F401
+from .base import MODE_FORCE, MODE_NONE, MODE_PASSIVE  # noqa: F401
+from .registry import CompressionPluginRegistry, create  # noqa: F401
+from .base import should_compress, compress_if_worthwhile  # noqa: F401

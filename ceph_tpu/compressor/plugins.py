@@ -1,0 +1,92 @@
+"""Built-in compression algorithms.
+
+Parity set with the reference's plugin dirs
+(/root/reference/src/compressor/{zlib,snappy,zstd,lz4}/). zlib rides the
+stdlib; zstd rides the `zstandard` package; snappy and lz4 depend on host
+libraries that may be absent — their loaders raise ENOENT then, matching
+a missing plugin .so in the reference.
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import zlib as _zlib
+
+from .base import Compressor, CompressorError
+
+
+class ZlibCompressor(Compressor):
+    """Deflate (src/compressor/zlib/ZlibCompressor.cc); level matches the
+    reference's compressor_zlib_level default of 5."""
+
+    name = "zlib"
+
+    def __init__(self, level: int = 5):
+        self.level = level
+
+    def compress(self, data: bytes) -> bytes:
+        return _zlib.compress(bytes(data), self.level)
+
+    def decompress(self, data: bytes) -> bytes:
+        try:
+            return _zlib.decompress(bytes(data))
+        except _zlib.error as e:
+            raise CompressorError(_errno.EIO, "zlib decompress: %s" % e)
+
+
+class ZstdCompressor(Compressor):
+    """Zstandard (src/compressor/zstd/); level matches the reference's
+    compressor_zstd_level default of 1."""
+
+    name = "zstd"
+
+    def __init__(self, level: int = 1):
+        import zstandard
+        self._mod = zstandard
+        self.level = level
+        # persistent contexts, like the reference plugin's zstd stream state
+        self._cctx = zstandard.ZstdCompressor(level=level)
+        self._dctx = zstandard.ZstdDecompressor()
+
+    def compress(self, data: bytes) -> bytes:
+        return self._cctx.compress(bytes(data))
+
+    def decompress(self, data: bytes) -> bytes:
+        try:
+            return self._dctx.decompress(bytes(data))
+        except self._mod.ZstdError as e:
+            raise CompressorError(_errno.EIO, "zstd decompress: %s" % e)
+
+
+class SnappyCompressor(Compressor):
+    name = "snappy"
+
+    def __init__(self):
+        import snappy
+        self._mod = snappy
+
+    def compress(self, data: bytes) -> bytes:
+        return self._mod.compress(bytes(data))
+
+    def decompress(self, data: bytes) -> bytes:
+        try:
+            return self._mod.decompress(bytes(data))
+        except Exception as e:
+            raise CompressorError(_errno.EIO, "snappy decompress: %s" % e)
+
+
+class Lz4Compressor(Compressor):
+    name = "lz4"
+
+    def __init__(self):
+        import lz4.block
+        self._mod = lz4.block
+
+    def compress(self, data: bytes) -> bytes:
+        return self._mod.compress(bytes(data))
+
+    def decompress(self, data: bytes) -> bytes:
+        try:
+            return self._mod.decompress(bytes(data))
+        except Exception as e:
+            raise CompressorError(_errno.EIO, "lz4 decompress: %s" % e)

@@ -1,0 +1,111 @@
+"""Compression plugin registry.
+
+Same singleton/load-on-demand/version-gate contract as the erasure-code
+registry — the reference deliberately reuses one plugin idiom for both
+subsystems (src/compressor/CompressionPlugin.h vs
+src/erasure-code/ErasureCodePlugin.h); so do we. `create` adds the
+Compressor::create alias behavior ("" / "none" -> no compressor).
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import threading
+
+from .base import Compressor, CompressorError
+
+__compression_version__ = "1.0.0"
+
+
+class CompressionPlugin:
+    version = __compression_version__
+
+    def __init__(self, factory_fn):
+        self._factory_fn = factory_fn
+
+    def factory(self) -> Compressor:
+        return self._factory_fn()
+
+
+def _builtin_loaders():
+    from . import plugins
+
+    def probe(cls):
+        # Import errors surface at load() time, like a missing .so.
+        def loader():
+            try:
+                cls()  # probe the host library once
+            except ImportError as e:
+                raise CompressorError(
+                    _errno.ENOENT,
+                    "load dlopen(libceph_%s.so): %s" % (cls.name, e))
+            return CompressionPlugin(cls)
+        return loader
+
+    return {
+        "zlib": probe(plugins.ZlibCompressor),
+        "zstd": probe(plugins.ZstdCompressor),
+        "snappy": probe(plugins.SnappyCompressor),
+        "lz4": probe(plugins.Lz4Compressor),
+    }
+
+
+class CompressionPluginRegistry:
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.plugins: dict[str, CompressionPlugin] = {}
+        self.loaders = _builtin_loaders()
+
+    @classmethod
+    def instance(cls) -> "CompressionPluginRegistry":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def add(self, name: str, plugin: CompressionPlugin) -> None:
+        with self.lock:
+            if name in self.plugins:
+                raise CompressorError(
+                    _errno.EEXIST, "plugin %s already registered" % name)
+            self.plugins[name] = plugin
+
+    def load(self, name: str) -> CompressionPlugin:
+        with self.lock:
+            if name in self.plugins:
+                return self.plugins[name]
+            loader = self.loaders.get(name)
+            if loader is None:
+                raise CompressorError(
+                    _errno.ENOENT,
+                    "load dlopen(libceph_%s.so): not found" % name)
+            plugin = loader()
+            if plugin.version != __compression_version__:
+                raise CompressorError(
+                    _errno.EXDEV,
+                    "plugin %s version %s != expected %s"
+                    % (name, plugin.version, __compression_version__))
+            self.plugins[name] = plugin
+            return plugin
+
+    def preload(self, names) -> None:
+        if isinstance(names, str):
+            names = [n.strip() for n in names.split(",") if n.strip()]
+        for name in names:
+            self.load(name)
+
+    def factory(self, name: str) -> Compressor:
+        with self.lock:
+            plugin = self.load(name)
+        return plugin.factory()
+
+
+def create(name: str) -> Compressor | None:
+    """Compressor::create semantics (Compressor.cc): '' and 'none' mean no
+    compression; unknown names raise ENOENT."""
+    if not name or name == "none":
+        return None
+    return CompressionPluginRegistry.instance().factory(name)

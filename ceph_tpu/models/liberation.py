@@ -91,11 +91,7 @@ class PureBitmatrixCode(BitmatrixErasureCode):
                 errno.EINVAL,
                 "k=%d must be <= w=%d for %s" % (self.k, self.w,
                                                  self.technique))
-        if self.packetsize % 8:
-            # jerasure requires packetsize to cover whole machine words
-            raise ErasureCodeError(
-                errno.EINVAL,
-                "packetsize=%d must be a multiple of 8" % self.packetsize)
+        self.require_word_packetsize()
 
     def prepare(self) -> None:
         try:
@@ -106,6 +102,7 @@ class PureBitmatrixCode(BitmatrixErasureCode):
         self.coding = None
         self._bitmat_dev = None
         self._decode_cache.clear()
+        self.xor_fast_hits = 0
         self._xor_rows = xor_parity_rows(self._bitmat, self.k, self.w)
 
     def _stacked_bitmat(self) -> np.ndarray:
@@ -215,11 +212,7 @@ class Liber8tion(BitmatrixErasureCode):
         if self.k > 8:
             raise ErasureCodeError(
                 errno.EINVAL, "k=%d must be <= 8 for liber8tion" % self.k)
-        if self.packetsize % 8:
-            # same whole-machine-word requirement as the rest of the family
-            raise ErasureCodeError(
-                errno.EINVAL,
-                "packetsize=%d must be a multiple of 8" % self.packetsize)
+        self.require_word_packetsize()
 
     def make_generator(self) -> np.ndarray:
         gen = np.zeros((2, self.k), dtype=np.uint32)

@@ -113,6 +113,7 @@ class GeneratorCodec(ErasureCode):
         self._bitmat = gf.generator_to_bitmatrix(self.coding, self.w)
         self._bitmat_dev = None
         self._decode_cache.clear()
+        self.xor_fast_hits = 0
         self._xor_rows = xor_parity_rows(self._bitmat, self.k, self.w)
 
     def _device_bitmat(self):
@@ -121,17 +122,18 @@ class GeneratorCodec(ErasureCode):
             self._bitmat_dev = jnp.asarray(self._bitmat)
         return self._bitmat_dev
 
-    def _as_device(self, bitmat):
-        """Device copy of a bitmatrix, cached for encode + per decode entry."""
+    def _as_device(self, bitmat, entry: dict | None = None):
+        """Device copy of a bitmatrix, cached on the encode path or inside
+        the decode-cache entry (so a repeated erasure signature reuses the
+        already-transferred constant — no scan, no re-upload)."""
         if bitmat is self._bitmat:
             return self._device_bitmat()
-        for entry in self._decode_cache.values():
-            if entry["bitmat"] is bitmat:
-                if "bitmat_dev" not in entry:
-                    import jax.numpy as jnp
-                    entry["bitmat_dev"] = jnp.asarray(bitmat)
-                return entry["bitmat_dev"]
         import jax.numpy as jnp
+        if entry is not None:
+            dev = entry.get("bitmat_dev")
+            if dev is None:
+                dev = entry.setdefault("bitmat_dev", jnp.asarray(bitmat))
+            return dev
         return jnp.asarray(bitmat)
 
     def _full_decode_matrix(self, avail_rows: tuple) -> np.ndarray:
@@ -165,41 +167,73 @@ class GeneratorCodec(ErasureCode):
 
     # -- single-erasure XOR fast path ---------------------------------------
 
-    def decode_all(self, chunks: dict) -> dict:
-        fast = self._xor_decode_all(chunks)
-        return fast if fast is not None else super().decode_all(chunks)
-
-    def _xor_decode_all(self, chunks: dict):
-        """Region-XOR shortcut for a single erasure (isa/xor_op analog).
-
-        Applies when exactly one chunk is missing and it is either a data
-        chunk or the XOR parity itself; recovery is then a byte-wise XOR
-        over the survivors of the XOR group — no inversion, no device
-        round-trip.
-        """
+    def xor_group(self, missing_logical: int):
+        """Logical chunk rows whose byte-wise XOR reproduces the missing
+        row, or None when no plain-XOR parity covers it (isa/xor_op
+        analog). Valid for a missing data row (any XOR parity row serves)
+        or a missing XOR parity row itself."""
         if not self._xor_rows:
             return None
-        n = self.get_chunk_count()
-        if len(chunks) != n - 1:
-            return None
-        inv = {self.chunk_index(i): i for i in range(n)}
-        logical = {inv[idx]: np.asarray(buf, dtype=np.uint8)
-                   for idx, buf in chunks.items()}
-        missing = (set(range(n)) - set(logical)).pop()
-        if missing < self.k:
+        if missing_logical < self.k:
             row = self._xor_rows[0]
-        elif missing - self.k in self._xor_rows:
-            row = missing - self.k
+        elif missing_logical - self.k in self._xor_rows:
+            row = missing_logical - self.k
         else:
-            return None  # a non-XOR parity is missing; need real decode
-        logical[missing] = xor_recover(missing, self.k, row, logical)
-        self.xor_fast_hits += 1
-        return {self.chunk_index(i): logical[i] for i in range(n)}
+            return None
+        group = set(range(self.k))
+        group.add(self.k + row)
+        group.discard(missing_logical)
+        return group
+
+    def minimum_to_decode(self, want_to_read: set, available: set) -> set:
+        """Prefer the XOR group for a single erasure so the read path
+        fetches exactly the shards the region-XOR shortcut needs (the
+        reference's ISA plugin biases shard selection the same way)."""
+        if want_to_read <= available:
+            return set(want_to_read)
+        missing = want_to_read - available
+        if len(missing) == 1:
+            n = self.get_chunk_count()
+            inv = {self.chunk_index(i): i for i in range(n)}
+            ml = inv.get(next(iter(missing)))
+            group = self.xor_group(ml) if ml is not None else None
+            if group is not None:
+                phys = {self.chunk_index(i) for i in group}
+                if phys <= available:
+                    return phys
+        return super().minimum_to_decode(want_to_read, available)
+
+    def decode(self, want_to_read: set, chunks: dict) -> dict:
+        """Single-erasure region-XOR shortcut before the matrix path.
+
+        Fires when exactly one wanted chunk is missing and every member of
+        its XOR group survived — whether the caller handed us all n-1
+        survivors or just the k chunks minimum_to_decode asked for.
+        """
+        have = set(chunks)
+        missing = want_to_read - have
+        if len(missing) == 1:
+            n = self.get_chunk_count()
+            inv = {self.chunk_index(i): i for i in range(n)}
+            m_phys = next(iter(missing))
+            ml = inv.get(m_phys)
+            group = self.xor_group(ml) if ml is not None else None
+            if group is not None and {self.chunk_index(i)
+                                      for i in group} <= have:
+                rec = xor_recover(
+                    {i: chunks[self.chunk_index(i)] for i in group})
+                self.xor_fast_hits += 1
+                out = {m_phys: rec}
+                for idx in have:  # base decode echoes survivors back too
+                    out[idx] = np.asarray(chunks[idx], dtype=np.uint8)
+                return out
+        return super().decode(want_to_read, chunks)
 
     # -- batched device API -------------------------------------------------
 
     def _apply_matrix(self, gf_matrix: np.ndarray, bitmat: np.ndarray,
-                      data: np.ndarray) -> np.ndarray:
+                      data: np.ndarray, entry: dict | None = None
+                      ) -> np.ndarray:
         raise NotImplementedError
 
     def encode_batch(self, data: np.ndarray) -> np.ndarray:
@@ -209,7 +243,8 @@ class GeneratorCodec(ErasureCode):
         if len(avail_rows) != self.k:
             raise ErasureCodeError(errno.EIO, "need exactly k chunks")
         entry = self._decode_entry(tuple(avail_rows))
-        return self._apply_matrix(entry["gf"], entry["bitmat"], chunks)
+        return self._apply_matrix(entry["gf"], entry["bitmat"], chunks,
+                                  entry)
 
 
 class MatrixErasureCode(GeneratorCodec):
@@ -228,7 +263,7 @@ class MatrixErasureCode(GeneratorCodec):
             return self.k * self.w * LARGEST_VECTOR_WORDSIZE
         return self.k * self.w * 4
 
-    def _apply_matrix(self, gf_matrix, bitmat, data):
+    def _apply_matrix(self, gf_matrix, bitmat, data, entry=None):
         if self.backend == "numpy":
             data = np.asarray(data, dtype=np.uint8)
             return np.stack([
@@ -237,7 +272,7 @@ class MatrixErasureCode(GeneratorCodec):
         import jax.numpy as jnp
         from ..ops import xor_mm
         out = xor_mm.matrix_encode(
-            self._as_device(bitmat), jnp.asarray(data), self.w)
+            self._as_device(bitmat, entry), jnp.asarray(data), self.w)
         return out if _is_jax(data) else np.asarray(out)
 
 
@@ -268,6 +303,14 @@ class BitmatrixErasureCode(GeneratorCodec):
         self.per_chunk_alignment = profile_util.to_bool(
             "jerasure-per-chunk-alignment", profile, "false")
 
+    def require_word_packetsize(self) -> None:
+        """jerasure's liberation-family constraint: packetsize must cover
+        whole machine words (shared by liberation/blaum_roth/liber8tion)."""
+        if self.packetsize % 8:
+            raise ErasureCodeError(
+                errno.EINVAL,
+                "packetsize=%d must be a multiple of 8" % self.packetsize)
+
     def get_alignment(self) -> int:
         # ErasureCodeJerasure.cc:273-287; per-chunk alignment must stay a
         # multiple of the w*packetsize superblock or encode would reject
@@ -281,7 +324,7 @@ class BitmatrixErasureCode(GeneratorCodec):
             return self.k * self.w * self.packetsize * LARGEST_VECTOR_WORDSIZE
         return self.k * self.w * self.packetsize * 4
 
-    def _apply_matrix(self, gf_matrix, bitmat, data):
+    def _apply_matrix(self, gf_matrix, bitmat, data, entry=None):
         if self.backend == "numpy":
             data = np.asarray(data, dtype=np.uint8)
             return np.stack([
@@ -291,7 +334,7 @@ class BitmatrixErasureCode(GeneratorCodec):
         import jax.numpy as jnp
         from ..ops import xor_mm
         out = xor_mm.bitmatrix_encode(
-            self._as_device(bitmat), jnp.asarray(data), self.w,
+            self._as_device(bitmat, entry), jnp.asarray(data), self.w,
             self.packetsize)
         return out if _is_jax(data) else np.asarray(out)
 

@@ -110,20 +110,15 @@ def xor_parity_rows(bitmat: np.ndarray, k: int, w: int) -> list[int]:
     return rows
 
 
-def xor_recover(missing: int, k: int, xor_row: int,
-                chunks: dict) -> np.ndarray:
-    """Recover one missing chunk by XOR over the survivors.
+def xor_recover(chunks: dict) -> np.ndarray:
+    """XOR all buffers together: the recovery kernel of the fast path.
 
-    `chunks` maps logical chunk row -> uint8 array; must contain every row
-    of the XOR set {0..k-1, k+xor_row} except `missing`. Valid when
-    `missing` is a data row or the XOR parity row itself.
+    `chunks` holds the surviving members of an XOR group (every row of
+    {0..k-1, k+xor_row} except the missing one); their byte-wise XOR IS
+    the missing row. Pure host bandwidth — no GF math, no device.
     """
-    group = list(range(k)) + [k + xor_row]
-    assert missing in group
     out = None
-    for i in group:
-        if i == missing:
-            continue
-        buf = np.asarray(chunks[i], dtype=np.uint8)
+    for buf in chunks.values():
+        buf = np.asarray(buf, dtype=np.uint8)
         out = buf.copy() if out is None else np.bitwise_xor(out, buf, out=out)
     return out

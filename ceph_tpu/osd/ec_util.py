@@ -147,6 +147,27 @@ def decode(sinfo: StripeInfo, codec, to_decode: dict,
         return {s: np.ascontiguousarray(
             logical[inv[s]]).reshape(-1) for s in want}
 
+    # Single-erasure region-XOR shortcut (isa/xor_op analog), batched over
+    # every stripe in the extent: if the one missing wanted shard is
+    # covered by an XOR parity group that fully survived, reconstruct it
+    # with one vectorized XOR instead of the matrix path.
+    missing_want = want - have
+    if len(missing_want) == 1 and hasattr(codec, "xor_group"):
+        m_phys = next(iter(missing_want))
+        ml = inv.get(m_phys)
+        group = codec.xor_group(ml) if ml is not None else None
+        if group is not None and group <= set(logical):
+            rec = None
+            for i in group:
+                rec = (logical[i].copy() if rec is None
+                       else np.bitwise_xor(rec, logical[i], out=rec))
+            codec.xor_fast_hits += 1
+            out = {}
+            for s in want:
+                out[s] = (to_decode[s] if s in to_decode
+                          else np.ascontiguousarray(rec).reshape(-1))
+            return out
+
     use = tuple(sorted(logical))[:k]
     if len(use) < k:
         raise ErasureCodeError(5, "not enough chunks to decode (%d < %d)"

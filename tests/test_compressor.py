@@ -1,0 +1,139 @@
+"""Compression plugin subsystem tests.
+
+Models the reference's compressor unit tests
+(src/test/compressor/test_compression.cc: round-trip across algorithms,
+Compressor::create alias behavior) and reuses the registry failure-mode
+pattern from the EC side.
+"""
+
+import errno
+
+import numpy as np
+import pytest
+
+from ceph_tpu import compressor
+from ceph_tpu.compressor import registry as creg
+from ceph_tpu.compressor.base import CompressorError
+
+
+def fresh_registry():
+    return creg.CompressionPluginRegistry()
+
+
+def compressible_payload(size=1 << 16):
+    rng = np.random.default_rng(0)
+    # low-entropy: long runs + a small alphabet
+    return bytes(rng.integers(0, 4, size=size, dtype=np.uint8)) + b"\0" * size
+
+
+def random_payload(size=1 << 16):
+    return bytes(np.random.default_rng(1).integers(
+        0, 256, size=size, dtype=np.uint8))
+
+
+AVAILABLE = ["zlib", "zstd"]
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("alg", AVAILABLE)
+    def test_roundtrip(self, alg):
+        c = compressor.create(alg)
+        for payload in (b"", b"x", compressible_payload(), random_payload()):
+            assert c.decompress(c.compress(payload)) == payload
+
+    @pytest.mark.parametrize("alg", AVAILABLE)
+    def test_compressible_data_shrinks(self, alg):
+        c = compressor.create(alg)
+        data = compressible_payload()
+        assert len(c.compress(data)) < len(data) // 2
+
+    @pytest.mark.parametrize("alg", AVAILABLE)
+    def test_corrupt_input_raises_eio(self, alg):
+        c = compressor.create(alg)
+        with pytest.raises(CompressorError) as ei:
+            c.decompress(b"this is not a compressed frame")
+        assert ei.value.errno == errno.EIO
+
+
+class TestCreateAliases:
+    def test_none_and_empty(self):
+        assert compressor.create("") is None
+        assert compressor.create("none") is None
+
+    def test_unknown_enoent(self):
+        with pytest.raises(CompressorError) as ei:
+            compressor.create("brotli9000")
+        assert ei.value.errno == errno.ENOENT
+
+    def test_type_name(self):
+        assert compressor.create("zlib").get_type_name() == "zlib"
+
+
+class TestRegistry:
+    def test_duplicate_add_eexist(self):
+        reg = fresh_registry()
+        reg.load("zlib")
+        with pytest.raises(CompressorError) as ei:
+            reg.add("zlib", creg.CompressionPlugin(lambda: None))
+        assert ei.value.errno == errno.EEXIST
+
+    def test_version_gate_exdev(self):
+        reg = fresh_registry()
+        bad = creg.CompressionPlugin(lambda: None)
+        bad.version = "0.0.1"
+        reg.loaders["bad"] = lambda: bad
+        with pytest.raises(CompressorError) as ei:
+            reg.load("bad")
+        assert ei.value.errno == errno.EXDEV
+
+    def test_preload_comma_list(self):
+        reg = fresh_registry()
+        reg.preload("zlib, zstd")
+        assert set(reg.plugins) == {"zlib", "zstd"}
+
+    def test_load_caches_plugin(self):
+        reg = fresh_registry()
+        assert reg.load("zlib") is reg.load("zlib")
+
+    def test_missing_host_library_enoent(self):
+        # snappy/lz4 are not installed in this image; if that ever changes
+        # the load must simply succeed instead.
+        reg = fresh_registry()
+        for name in ("snappy", "lz4"):
+            try:
+                plugin = reg.load(name)
+            except CompressorError as e:
+                assert e.errno == errno.ENOENT
+            else:
+                c = plugin.factory()
+                assert c.decompress(c.compress(b"abc" * 100)) == b"abc" * 100
+
+
+class TestPolicy:
+    def test_modes(self):
+        sc = compressor.should_compress
+        assert not sc(compressor.MODE_NONE, hint_compressible=True)
+        assert sc(compressor.MODE_FORCE, hint_incompressible=True)
+        assert sc(compressor.MODE_PASSIVE, hint_compressible=True)
+        assert not sc(compressor.MODE_PASSIVE)
+        assert sc(compressor.MODE_AGGRESSIVE)
+        assert not sc(compressor.MODE_AGGRESSIVE, hint_incompressible=True)
+        with pytest.raises(CompressorError):
+            sc("sometimes")
+
+    def test_required_ratio_gate(self):
+        c = compressor.create("zlib")
+        alg, blob = compressor.compress_if_worthwhile(c, compressible_payload())
+        assert alg == "zlib"
+        assert c.decompress(blob) == compressible_payload()
+        # random data fails the 0.875 ratio -> stored raw
+        raw = random_payload()
+        alg, blob = compressor.compress_if_worthwhile(c, raw)
+        assert alg is None and blob == raw
+
+    def test_no_compressor_passthrough(self):
+        alg, blob = compressor.compress_if_worthwhile(None, b"abc")
+        assert alg is None and blob == b"abc"
+        alg, blob = compressor.compress_if_worthwhile(
+            compressor.create("zlib"), b"")
+        assert alg is None and blob == b""

@@ -122,3 +122,30 @@ class TestXorFastPath:
         codec = make("jerasure", technique="reed_sol_van", k=4, m=3, w=8)
         rows = xor_parity_rows(codec._bitmat, codec.k, codec.w)
         assert rows == [0]  # Vandermonde: only the first parity is all-ones
+
+    def test_minimum_to_decode_prefers_xor_group(self):
+        codec = make("jerasure", technique="reed_sol_van", k=4, m=2, w=8)
+        # shard 0 lost; the XOR group {1,2,3,P0=4} beats {1,2,3,5}
+        assert codec.minimum_to_decode({0}, {1, 2, 3, 4, 5}) == {1, 2, 3, 4}
+        # XOR parity unavailable too -> greedy fallback
+        assert codec.minimum_to_decode({0}, {1, 2, 3, 5}) == {1, 2, 3, 5}
+
+    def test_osd_read_path_uses_xor(self):
+        """The ECBackend degraded-read flow (minimum_to_decode -> fetch ->
+        ec_util.decode) must hit the batched XOR shortcut, not the matrix
+        path."""
+        from ceph_tpu.osd import ec_util
+        codec = make("jerasure", technique="reed_sol_van", k=4, m=2, w=8)
+        sinfo = ec_util.StripeInfo(4, 4 * 64)
+        payload = bytes(np.random.default_rng(11).integers(
+            0, 256, size=3 * sinfo.stripe_width, dtype=np.uint8))
+        shards = ec_util.encode(sinfo, codec, payload)
+        want = {0, 1, 2, 3}          # all data shards (a normal read)
+        avail = set(shards) - {2}    # one data shard's OSD is down
+        to_read = codec.minimum_to_decode(want, avail)
+        assert to_read == {0, 1, 3, 4}
+        fetched = {s: shards[s] for s in to_read}
+        assert ec_util.decode_concat(sinfo, codec, fetched)[:len(payload)] \
+            == payload
+        assert codec.xor_fast_hits == 1
+        assert codec.table_cache_stats()["misses"] == 0
