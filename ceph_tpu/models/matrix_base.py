@@ -185,6 +185,23 @@ class GeneratorCodec(ErasureCode):
         group.discard(missing_logical)
         return group
 
+    def xor_plan(self, missing_phys: int, available_phys) -> set | None:
+        """Physical chunk set whose XOR reproduces `missing_phys`, or None.
+
+        The single shared planner behind the region-XOR shortcut: maps
+        the missing physical index through the chunk mapping, asks
+        xor_group for the logical group, and checks every member
+        survived in `available_phys`.
+        """
+        n = self.get_chunk_count()
+        inv = {self.chunk_index(i): i for i in range(n)}
+        ml = inv.get(missing_phys)
+        group = self.xor_group(ml) if ml is not None else None
+        if group is None:
+            return None
+        phys = {self.chunk_index(i) for i in group}
+        return phys if phys <= set(available_phys) else None
+
     def minimum_to_decode(self, want_to_read: set, available: set) -> set:
         """Prefer the XOR group for a single erasure so the read path
         fetches exactly the shards the region-XOR shortcut needs (the
@@ -193,14 +210,9 @@ class GeneratorCodec(ErasureCode):
             return set(want_to_read)
         missing = want_to_read - available
         if len(missing) == 1:
-            n = self.get_chunk_count()
-            inv = {self.chunk_index(i): i for i in range(n)}
-            ml = inv.get(next(iter(missing)))
-            group = self.xor_group(ml) if ml is not None else None
-            if group is not None:
-                phys = {self.chunk_index(i) for i in group}
-                if phys <= available:
-                    return phys
+            plan = self.xor_plan(next(iter(missing)), available)
+            if plan is not None:
+                return plan
         return super().minimum_to_decode(want_to_read, available)
 
     def decode(self, want_to_read: set, chunks: dict) -> dict:
@@ -213,15 +225,10 @@ class GeneratorCodec(ErasureCode):
         have = set(chunks)
         missing = want_to_read - have
         if len(missing) == 1:
-            n = self.get_chunk_count()
-            inv = {self.chunk_index(i): i for i in range(n)}
             m_phys = next(iter(missing))
-            ml = inv.get(m_phys)
-            group = self.xor_group(ml) if ml is not None else None
-            if group is not None and {self.chunk_index(i)
-                                      for i in group} <= have:
-                rec = xor_recover(
-                    {i: chunks[self.chunk_index(i)] for i in group})
+            plan = self.xor_plan(m_phys, have)
+            if plan is not None:
+                rec = xor_recover({i: chunks[i] for i in plan})
                 self.xor_fast_hits += 1
                 out = {m_phys: rec}
                 for idx in have:  # base decode echoes survivors back too

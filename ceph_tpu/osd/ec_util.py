@@ -152,15 +152,12 @@ def decode(sinfo: StripeInfo, codec, to_decode: dict,
     # covered by an XOR parity group that fully survived, reconstruct it
     # with one vectorized XOR instead of the matrix path.
     missing_want = want - have
-    if len(missing_want) == 1 and hasattr(codec, "xor_group"):
+    if len(missing_want) == 1 and hasattr(codec, "xor_plan"):
         m_phys = next(iter(missing_want))
-        ml = inv.get(m_phys)
-        group = codec.xor_group(ml) if ml is not None else None
-        if group is not None and group <= set(logical):
-            rec = None
-            for i in group:
-                rec = (logical[i].copy() if rec is None
-                       else np.bitwise_xor(rec, logical[i], out=rec))
+        plan = codec.xor_plan(m_phys, have)
+        if plan is not None:
+            from ..models.table_cache import xor_recover
+            rec = xor_recover({s: logical[inv[s]] for s in plan})
             codec.xor_fast_hits += 1
             out = {}
             for s in want:

@@ -1,0 +1,246 @@
+"""crushtool / osdmaptool CLI tests.
+
+Models the reference's offline-tooling checks: compile/decompile
+round-trips (crushtool is the validation oracle for CRUSH edits,
+src/tools/crushtool.cc), CrushTester distribution runs, osdmaptool
+--createsimple / --test-map-pgs / --upmap
+(src/tools/osdmaptool.cc).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osd.osd_map import OSDMapMapping, PGID
+from ceph_tpu.tools import crushtool, osdmaptool
+
+SAMPLE_MAP = """
+# begin crush map
+tunable choose_local_tries 0
+tunable choose_total_tries 50
+tunable chooseleaf_vary_r 1
+
+# devices
+device 0 osd.0
+device 1 osd.1 class ssd
+device 2 osd.2
+device 3 osd.3
+
+# types
+type 0 osd
+type 1 host
+type 2 root
+
+# buckets
+host host0 {
+\tid -2
+\talg straw2
+\thash 0
+\titem osd.0 weight 1.000
+\titem osd.1 weight 2.000
+}
+host host1 {
+\tid -3
+\talg straw2
+\thash 0
+\titem osd.2 weight 1.000
+\titem osd.3 weight 1.000
+}
+root default {
+\tid -1
+\talg straw2
+\thash 0
+\titem host0 weight 3.000
+\titem host1 weight 2.000
+}
+
+# rules
+rule replicated_rule {
+\truleset 0
+\ttype replicated
+\tmin_size 1
+\tmax_size 10
+\tstep take default
+\tstep chooseleaf firstn 0 type host
+\tstep emit
+}
+rule ec_rule {
+\truleset 1
+\ttype erasure
+\tmin_size 3
+\tmax_size 4
+\tstep set_chooseleaf_tries 5
+\tstep take default
+\tstep chooseleaf indep 0 type osd
+\tstep emit
+}
+# end crush map
+"""
+
+
+class TestCrushCompile:
+    def test_compile_basics(self):
+        m = crushtool.compile_text(SAMPLE_MAP)
+        assert set(m.bucket_names) == {"host0", "host1", "default"}
+        assert m.max_devices == 4
+        assert m.device_classes == {1: "ssd"}
+        assert m.tunables.choose_total_tries == 50
+        assert len(m.rules) == 2
+        assert m.rules[1].type == crushtool.POOL_TYPE_ERASURE
+        assert m.rules[1].steps[0] == (
+            crushtool.RULE_SET_CHOOSELEAF_TRIES, 5)
+        b = m.buckets[m.bucket_names["host0"]]
+        assert list(b.items) == [0, 1]
+        assert list(b.weights) == [0x10000, 0x20000]
+
+    def test_decompile_compile_roundtrip(self):
+        m1 = crushtool.compile_text(SAMPLE_MAP)
+        text = crushtool.decompile(m1)
+        m2 = crushtool.compile_text(text)
+        # identical mapping behavior, not just identical structure
+        for ruleno in range(2):
+            for x in range(64):
+                assert crushtool.crush_do_rule(m1, ruleno, x, 3) == \
+                    crushtool.crush_do_rule(m2, ruleno, x, 3)
+
+    def test_json_roundtrip(self):
+        m1 = crushtool.compile_text(SAMPLE_MAP)
+        m2 = crushtool.map_from_json(
+            json.loads(json.dumps(crushtool.map_to_json(m1))))
+        for x in range(64):
+            assert crushtool.crush_do_rule(m1, 0, x, 3) == \
+                crushtool.crush_do_rule(m2, 0, x, 3)
+
+    @pytest.mark.parametrize("bad,msg", [
+        ("tunable bogus 1", "tunable"),
+        ("device 0 osd.1", "named"),
+        ("rule r {\nstep fly\n}", "step"),
+        ("type 1 host\nhost h {\nitem osd.0\nalg nope\n}", "alg"),
+        ("type 1 host\nhost h {\nid -1\nalg straw2\n", "unterminated"),
+    ])
+    def test_compile_errors(self, bad, msg):
+        with pytest.raises(crushtool.CompileError, match=msg):
+            crushtool.compile_text(bad)
+
+    def test_build(self):
+        m = crushtool.build_map(8, [("host", "straw2", 2),
+                                    ("root", "straw2", 0)])
+        assert len([b for b in m.buckets.values() if b.type == 1]) == 4
+        assert "default" in m.bucket_names
+        m.add_simple_rule("r", "default", failure_domain="host")
+        res = crushtool.crush_do_rule(m, 0, 1234, 3)
+        assert len(set(res)) == 3
+        # failure-domain separation: chosen osds live on distinct hosts
+        hosts = {dev // 2 for dev in res}
+        assert len(hosts) == 3
+
+
+class TestCrushTester:
+    def test_distribution_and_report(self):
+        m = crushtool.compile_text(SAMPLE_MAP)
+        counts, results = crushtool.run_test(m, 0, 2, 0, 255)
+        assert counts.sum() == 2 * 256
+        assert all(c > 0 for c in counts)  # every device used
+        report = crushtool.format_test_report(m, counts, results, 0, 2)
+        assert "num_rep 2" in report and "stddev" in report
+
+    def test_batched_matches_reference(self):
+        m = crushtool.compile_text(SAMPLE_MAP)
+        c_ref, r_ref = crushtool.run_test(m, 1, 4, 0, 127)
+        c_bat, r_bat = crushtool.run_test(m, 1, 4, 0, 127, batched=True)
+        assert r_ref == r_bat
+        assert list(c_ref) == list(c_bat)
+
+    def test_cli(self, tmp_path, capsys):
+        src = tmp_path / "map.txt"
+        src.write_text(SAMPLE_MAP)
+        cmp_file = tmp_path / "map.json"
+        assert crushtool.main(["-c", str(src), "-o", str(cmp_file)]) == 0
+        assert crushtool.main(["-d", str(cmp_file)]) == 0
+        out = capsys.readouterr().out
+        assert "step take default" in out
+        assert crushtool.main(
+            ["-i", str(cmp_file), "--test", "--rule", "0",
+             "--num-rep", "3", "--max-x", "63", "--show-utilization"]) == 0
+        assert "stddev" in capsys.readouterr().out
+        assert crushtool.main(["-d", str(tmp_path / "nope.json")]) == 1
+
+
+class TestOsdMapTool:
+    def test_createsimple_and_map(self, tmp_path):
+        m = osdmaptool.create_simple(8, pg_num=64, pool_size=3, hosts=4)
+        assert m.max_osd == 8
+        assert all(m.is_up(o) and m.is_in(o) for o in range(8))
+        up, up_p, acting, acting_p = m.pg_to_up_acting_osds(PGID(0, 5))
+        assert len(acting) == 3 and acting_p in acting
+        hosts = {o // 2 for o in acting}
+        assert len(hosts) == 3  # host failure domain honored
+
+    def test_json_roundtrip_preserves_mapping(self):
+        m1 = osdmaptool.create_simple(6, pg_num=32)
+        doc = json.loads(json.dumps(osdmaptool.osdmap_to_json(m1)))
+        m2 = osdmaptool.osdmap_from_json(doc)
+        for ps in range(32):
+            assert m1.pg_to_up_acting_osds(PGID(0, ps)) == \
+                m2.pg_to_up_acting_osds(PGID(0, ps))
+
+    def test_test_map_pgs_report(self):
+        m = osdmaptool.create_simple(8, pg_num=64)
+        report = osdmaptool.test_map_pgs(m)
+        assert "#osd\tcount" in report
+        assert "total 64 pgs" in report
+        assert "osd.7" in report
+        # min/max lines must agree with the per-osd table
+        counts = [int(line.split("\t")[1]) for line in report.splitlines()
+                  if line.startswith("osd.")]
+        min_line = next(line for line in report.splitlines()
+                        if line.startswith(" min "))
+        assert int(min_line.split()[-1]) == min(counts)
+
+    def test_batched_matches_sequential(self):
+        m = osdmaptool.create_simple(8, pg_num=64, hosts=4)
+        a = OSDMapMapping(); a.update(m, batched=False)
+        b = OSDMapMapping(); b.update(m, batched=True)
+        assert a.by_pg == b.by_pg
+
+    def test_upmap_balances(self):
+        m = osdmaptool.create_simple(5, pg_num=64, pool_size=2, hosts=5)
+        mapping = OSDMapMapping()
+        mapping.update(m, batched=False)
+        before = np.zeros(m.max_osd, dtype=np.int64)
+        for _, (_, _, acting, _) in mapping.by_pg.items():
+            for o in acting:
+                before[o] += 1
+        changes = osdmaptool.calc_pg_upmaps(m, max_changes=20)
+        assert changes  # an uneven 5-osd map always has something to move
+        for pgid, pairs in changes:
+            inc = osdmaptool.Incremental(m.epoch + 1)
+            inc.new_pg_upmap_items[pgid] = pairs
+            m.apply_incremental(inc)
+        mapping.update(m, batched=False)
+        after = np.zeros(m.max_osd, dtype=np.int64)
+        for _, (_, _, acting, _) in mapping.by_pg.items():
+            for o in acting:
+                after[o] += 1
+        assert after.max() - after.min() <= before.max() - before.min()
+        assert after.sum() == before.sum()  # no replicas lost
+
+    def test_cli_flow(self, tmp_path, capsys):
+        mapfile = tmp_path / "osdmap.json"
+        assert osdmaptool.main(
+            ["--createsimple", "8", str(mapfile), "--pg-num", "32"]) == 0
+        assert osdmaptool.main([str(mapfile), "--print"]) == 0
+        assert "pools 0 'rbd'" in capsys.readouterr().out
+        assert osdmaptool.main([str(mapfile), "--test-map-pgs"]) == 0
+        assert "total 32 pgs" in capsys.readouterr().out
+        assert osdmaptool.main(
+            [str(mapfile), "--test-map-object", "foo", "--pool", "0"]) == 0
+        assert "object 'foo'" in capsys.readouterr().out
+        upfile = tmp_path / "upmaps.txt"
+        assert osdmaptool.main([str(mapfile), "--upmap", str(upfile)]) == 0
+        capsys.readouterr()
+        assert osdmaptool.main(
+            [str(mapfile), "--mark-down", "3", "-o", str(mapfile)]) == 0
+        assert osdmaptool.main([str(mapfile), "--test-map-pgs"]) == 0
+        assert "total 32 pgs" in capsys.readouterr().out
