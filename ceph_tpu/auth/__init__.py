@@ -1,0 +1,15 @@
+"""Authentication subsystem (cephx-style tickets).
+
+Rendition of the reference's auth layer (/root/reference/src/auth/):
+entity keyrings, a monitor-side key server that verifies clients by
+challenge-response and issues session tickets, and per-connection
+authorizers that services verify without talking to the monitor —
+the cephx trust model (doc/dev/cephx_protocol.rst). Crypto primitives
+are stdlib-only: HMAC-SHA256 for proofs/integrity and an HMAC counter
+keystream for ticket confidentiality (where the reference uses AES).
+"""
+
+from .keyring import KeyRing, generate_secret  # noqa: F401
+from .cephx import (  # noqa: F401
+    AuthError, CephxClient, CephxServer, CephxServiceHandler,
+    seal, unseal)

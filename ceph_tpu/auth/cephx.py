@@ -1,0 +1,212 @@
+"""cephx-style ticket protocol.
+
+The reference's cephx (/root/reference/src/auth/cephx/,
+doc/dev/cephx_protocol.rst) in three roles:
+
+  CephxServer          monitor-side key server: challenge-response
+                       against the entity's keyring secret, then issues
+                       a (ticket, sealed session key) pair. The ticket is
+                       sealed with the *service* secret, so services can
+                       verify it offline.
+  CephxClient          client-side state machine: prove identity, unseal
+                       the session key, mint per-connection authorizers.
+  CephxServiceHandler  daemon-side verifier: validates an authorizer
+                       using only the shared service secret (no monitor
+                       round-trip), answers with a mutual-auth proof.
+
+Crypto: HMAC-SHA256 proofs; `seal`/`unseal` provide authenticated
+encryption from the stdlib (HMAC counter keystream + HMAC tag) standing
+in for the reference's AES.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import pickle
+import struct
+import time
+
+AUTH_SERVICE = "auth"
+DEFAULT_TICKET_TTL = 3600.0   # auth_service_ticket_ttl (options.cc)
+
+
+class AuthError(Exception):
+    """EACCES-class failure: bad key, bad ticket, expired, tampered."""
+
+
+# ---------------------------------------------------------------------------
+# stdlib authenticated encryption
+
+
+def _keystream(key: bytes, nonce: bytes, n: int) -> bytes:
+    out = bytearray()
+    counter = 0
+    while len(out) < n:
+        out += hmac.new(key, nonce + struct.pack("<Q", counter),
+                        hashlib.sha256).digest()
+        counter += 1
+    return bytes(out[:n])
+
+
+def seal(key: bytes, plaintext: bytes) -> bytes:
+    """Encrypt-then-MAC: nonce || ciphertext || tag."""
+    nonce = os.urandom(16)
+    ct = bytes(a ^ b for a, b in
+               zip(plaintext, _keystream(key, nonce, len(plaintext))))
+    tag = hmac.new(key, nonce + ct, hashlib.sha256).digest()
+    return nonce + ct + tag
+
+
+def unseal(key: bytes, blob: bytes) -> bytes:
+    if len(blob) < 48:
+        raise AuthError("sealed blob too short")
+    nonce, ct, tag = blob[:16], blob[16:-32], blob[-32:]
+    if not hmac.compare_digest(
+            tag, hmac.new(key, nonce + ct, hashlib.sha256).digest()):
+        raise AuthError("sealed blob failed integrity check")
+    return bytes(a ^ b for a, b in
+                 zip(ct, _keystream(key, nonce, len(ct))))
+
+
+def _proof(key: bytes, challenge: bytes) -> bytes:
+    return hmac.new(key, b"cephx-proof" + challenge,
+                    hashlib.sha256).digest()
+
+
+# ---------------------------------------------------------------------------
+# monitor side
+
+
+class CephxServer:
+    """Key server: verifies entities, issues tickets.
+
+    keyring: entity secrets (client.admin, osd.0, ...).
+    service_secrets: per-service ticket-sealing keys (the rotating
+    secrets the monitor shares with daemons in the reference).
+    """
+
+    def __init__(self, keyring, service_secrets: dict[str, bytes],
+                 ticket_ttl: float = DEFAULT_TICKET_TTL):
+        self.keyring = keyring
+        self.service_secrets = dict(service_secrets)
+        self.ticket_ttl = ticket_ttl
+        self._challenges: dict[str, bytes] = {}
+
+    def get_challenge(self, entity: str) -> bytes:
+        ch = os.urandom(16)
+        self._challenges[entity] = ch
+        return ch
+
+    def handle_request(self, entity: str, proof: bytes,
+                       service: str = "osd",
+                       now: float | None = None) -> dict:
+        """Verify the challenge proof; issue {ticket, sealed session key}.
+
+        Raises AuthError on unknown entity / wrong key / no challenge.
+        """
+        secret = self.keyring.get_secret_bytes(entity)
+        challenge = self._challenges.pop(entity, None)
+        if secret is None or challenge is None:
+            raise AuthError("entity %s: unknown or no challenge" % entity)
+        if not hmac.compare_digest(proof, _proof(secret, challenge)):
+            raise AuthError("entity %s: bad proof (wrong key)" % entity)
+        svc_secret = self.service_secrets.get(service)
+        if svc_secret is None:
+            raise AuthError("no service secret for %r" % service)
+        session_key = os.urandom(32)
+        now = time.time() if now is None else now
+        ticket = seal(svc_secret, pickle.dumps({
+            "entity": entity,
+            "caps": self.keyring.get_caps(entity).get(service, ""),
+            "session_key": session_key,
+            "expires": now + self.ticket_ttl,
+            "service": service,
+        }))
+        return {"service": service,
+                "ticket": ticket,
+                "sealed_session_key": seal(secret, session_key)}
+
+
+# ---------------------------------------------------------------------------
+# client side
+
+
+class CephxClient:
+    def __init__(self, entity: str, secret_b64: str):
+        import base64
+        self.entity = entity
+        self.secret = base64.b64decode(secret_b64)
+        self.tickets: dict[str, dict] = {}   # service -> {ticket, key}
+
+    def build_proof(self, challenge: bytes) -> bytes:
+        return _proof(self.secret, challenge)
+
+    def open_session(self, reply: dict) -> None:
+        """Consume a CephxServer.handle_request reply."""
+        session_key = unseal(self.secret, reply["sealed_session_key"])
+        self.tickets[reply["service"]] = {
+            "ticket": reply["ticket"], "session_key": session_key}
+
+    def build_authorizer(self, service: str = "osd") -> dict:
+        """Per-connection authorizer presented in the banner."""
+        t = self.tickets.get(service)
+        if t is None:
+            raise AuthError("no ticket for service %r" % service)
+        nonce = os.urandom(16)
+        return {
+            "entity": self.entity,
+            "service": service,
+            "ticket": t["ticket"],
+            "nonce": nonce,
+            "proof": hmac.new(t["session_key"], b"authorizer" + nonce,
+                              hashlib.sha256).digest(),
+        }
+
+    def verify_reply(self, service: str, reply_proof: bytes,
+                     nonce: bytes) -> bool:
+        """Mutual auth: the service proves it could read the ticket."""
+        t = self.tickets.get(service)
+        if t is None:
+            return False
+        want = hmac.new(t["session_key"], b"authorizer-reply" + nonce,
+                        hashlib.sha256).digest()
+        return hmac.compare_digest(reply_proof, want)
+
+
+# ---------------------------------------------------------------------------
+# service (daemon) side
+
+
+class CephxServiceHandler:
+    def __init__(self, service: str, service_secret: bytes):
+        self.service = service
+        self.service_secret = service_secret
+
+    def verify_authorizer(self, authorizer: dict,
+                          now: float | None = None) -> dict:
+        """Validate an authorizer offline; returns
+        {entity, caps, session_key, reply_proof} or raises AuthError."""
+        try:
+            ticket = pickle.loads(
+                unseal(self.service_secret, authorizer["ticket"]))
+        except (KeyError, TypeError, pickle.UnpicklingError) as e:
+            raise AuthError("malformed authorizer: %s" % e)
+        now = time.time() if now is None else now
+        if ticket["service"] != self.service:
+            raise AuthError("ticket for %r used on %r"
+                            % (ticket["service"], self.service))
+        if now > ticket["expires"]:
+            raise AuthError("ticket for %s expired" % ticket["entity"])
+        if ticket["entity"] != authorizer.get("entity"):
+            raise AuthError("authorizer entity mismatch")
+        nonce = authorizer.get("nonce", b"")
+        want = hmac.new(ticket["session_key"], b"authorizer" + nonce,
+                        hashlib.sha256).digest()
+        if not hmac.compare_digest(authorizer.get("proof", b""), want):
+            raise AuthError("authorizer proof invalid")
+        reply = hmac.new(ticket["session_key"], b"authorizer-reply" + nonce,
+                         hashlib.sha256).digest()
+        return {"entity": ticket["entity"], "caps": ticket["caps"],
+                "session_key": ticket["session_key"], "reply_proof": reply}

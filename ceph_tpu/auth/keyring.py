@@ -1,0 +1,93 @@
+"""Entity keyrings.
+
+Models the reference's KeyRing (/root/reference/src/auth/KeyRing.{h,cc})
+and its text format:
+
+    [client.admin]
+        key = <base64 secret>
+        caps mon = "allow *"
+
+Secrets are random 32-byte keys, base64-encoded on disk.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+
+
+def generate_secret() -> str:
+    return base64.b64encode(os.urandom(32)).decode("ascii")
+
+
+class KeyRing:
+    def __init__(self):
+        self._keys: dict[str, str] = {}      # entity -> base64 secret
+        self._caps: dict[str, dict] = {}     # entity -> {service: capspec}
+
+    def add(self, entity: str, secret: str | None = None,
+            caps: dict | None = None) -> str:
+        secret = secret or generate_secret()
+        self._keys[entity] = secret
+        if caps:
+            self._caps[entity] = dict(caps)
+        return secret
+
+    def remove(self, entity: str) -> None:
+        self._keys.pop(entity, None)
+        self._caps.pop(entity, None)
+
+    def get(self, entity: str) -> str | None:
+        return self._keys.get(entity)
+
+    def get_secret_bytes(self, entity: str) -> bytes | None:
+        s = self._keys.get(entity)
+        return base64.b64decode(s) if s is not None else None
+
+    def get_caps(self, entity: str) -> dict:
+        return dict(self._caps.get(entity, {}))
+
+    def entities(self) -> list[str]:
+        return sorted(self._keys)
+
+    # -- text format ---------------------------------------------------
+
+    def emit(self) -> str:
+        out = []
+        for entity in sorted(self._keys):
+            out.append("[%s]" % entity)
+            out.append("\tkey = %s" % self._keys[entity])
+            for svc, spec in sorted(self._caps.get(entity, {}).items()):
+                out.append('\tcaps %s = "%s"' % (svc, spec))
+        return "\n".join(out) + "\n"
+
+    @classmethod
+    def parse(cls, text: str) -> "KeyRing":
+        kr = cls()
+        entity = None
+        for raw in text.splitlines():
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line.startswith("[") and line.endswith("]"):
+                entity = line[1:-1]
+                continue
+            if entity is None:
+                raise ValueError("keyring line outside a section: %r" % line)
+            if line.startswith("key"):
+                _, _, v = line.partition("=")
+                kr._keys[entity] = v.strip()
+            elif line.startswith("caps"):
+                head, _, v = line.partition("=")
+                svc = head.split()[1]
+                kr._caps.setdefault(entity, {})[svc] = v.strip().strip('"')
+        return kr
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.emit())
+
+    @classmethod
+    def load(cls, path: str) -> "KeyRing":
+        with open(path) as f:
+            return cls.parse(f.read())

@@ -13,7 +13,7 @@ import itertools
 import pickle
 import threading
 
-from ..msg.message import MMonCommand, MMonSubscribe
+from ..msg.message import MAuth, MMonCommand, MMonSubscribe
 from ..msg.messenger import Dispatcher, Messenger
 
 __all__ = ["MonClient"]
@@ -30,13 +30,14 @@ class MonClient(Dispatcher):
         self.osdmap = None
         self.map_callbacks: list = []
         self._map_event = threading.Event()
+        self.auth_client = None      # CephxClient after authenticate()
         msgr.add_dispatcher_tail(self)
 
     # -- dispatch ------------------------------------------------------
 
     def ms_dispatch(self, msg) -> bool:
         t = msg.get_type()
-        if t == "MMonCommandReply":
+        if t in ("MMonCommandReply", "MAuthReply"):
             with self._lock:
                 waiter = self._waiters.pop(msg.tid, None)
             if waiter is not None:
@@ -73,22 +74,54 @@ class MonClient(Dispatcher):
     def _mon_addr(self):
         return self.monmap[min(self.monmap)]
 
-    def command(self, cmd: dict, timeout: float = 10.0):
-        """Send a command; returns (result, outs, data)."""
+    def _send_and_wait(self, msg, timeout: float, what: str):
+        """Synchronous request/reply: allocate tid, register a waiter,
+        send to the mon, block for the matching reply."""
         tid = next(self._tid)
+        msg.tid = tid
         waiter = [threading.Event(), None]
         with self._lock:
             self._waiters[tid] = waiter
-        # try each mon until one answers (leader forwarding handles the
-        # rest)
-        msg = MMonCommand(tid=tid, cmd=cmd, reply_to=self.msgr.my_addr)
         self.msgr.send_message(msg, self._mon_addr())
         if not waiter[0].wait(timeout):
             with self._lock:
                 self._waiters.pop(tid, None)
-            raise TimeoutError("mon command %r timed out" % cmd)
-        reply = waiter[1]
+            raise TimeoutError("%s timed out" % what)
+        return waiter[1]
+
+    def command(self, cmd: dict, timeout: float = 10.0):
+        """Send a command; returns (result, outs, data). Leader
+        forwarding on the mon side handles non-leader targets."""
+        reply = self._send_and_wait(
+            MMonCommand(cmd=cmd, reply_to=self.msgr.my_addr),
+            timeout, "mon command %r" % cmd)
         return reply.result, reply.outs, reply.data
+
+    def authenticate(self, entity: str, secret_b64: str,
+                     service: str = "osd", timeout: float = 10.0):
+        """cephx handshake with the monitor (MonClient::authenticate):
+        challenge round, proof round, ticket install. Returns the
+        CephxClient holding the session ticket; raises PermissionError
+        on a bad key."""
+        from ..auth import CephxClient
+        client = CephxClient(entity, secret_b64)
+        r1 = self._send_and_wait(
+            MAuth(entity=entity, service=service,
+                  reply_to=self.msgr.my_addr), timeout, "auth round")
+        if r1.result != 0:
+            raise PermissionError(r1.outs)
+        if not r1.challenge and r1.ticket is None:
+            self.auth_client = client   # auth none cluster
+            return client
+        r2 = self._send_and_wait(
+            MAuth(entity=entity, service=service,
+                  proof=client.build_proof(r1.challenge),
+                  reply_to=self.msgr.my_addr), timeout, "auth round")
+        if r2.result != 0 or r2.ticket is None:
+            raise PermissionError(r2.outs or "auth failed")
+        client.open_session(r2.ticket)
+        self.auth_client = client
+        return client
 
     def sub_want(self, what: str = "osdmap", start_epoch: int = 0) -> None:
         self.msgr.send_message(

@@ -30,7 +30,8 @@ STATE_PEON = "peon"
 
 
 class Monitor(Dispatcher):
-    def __init__(self, rank: int, monmap: dict, ctx: Context | None = None):
+    def __init__(self, rank: int, monmap: dict, ctx: Context | None = None,
+                 keyring=None, service_secrets: dict | None = None):
         self.rank = rank
         self.monmap = dict(monmap)          # rank -> (host, port)
         self.ctx = ctx or Context(name="mon.%d" % rank)
@@ -49,6 +50,12 @@ class Monitor(Dispatcher):
         self._subscribers: dict = {}        # addr -> last epoch sent
         self._tick_token = None
         self._running = False
+        # cephx key server (src/auth/cephx/CephxKeyServer): present when
+        # the cluster runs with auth enabled
+        self.key_server = None
+        if keyring is not None:
+            from ..auth import CephxServer
+            self.key_server = CephxServer(keyring, service_secrets or {})
 
     # -- lifecycle -----------------------------------------------------
 
@@ -179,7 +186,39 @@ class Monitor(Dispatcher):
                 MMonCommandReply(tid=msg.tid, result=result, outs=outs,
                                  data=data), msg.reply_to or msg.from_addr)
             return True
+        if t == "MAuth":
+            self._handle_auth(msg)
+            return True
         return False
+
+    def _handle_auth(self, msg) -> None:
+        """cephx two-round handshake (doc/dev/cephx_protocol.rst):
+        an empty proof asks for a challenge; the second round carries
+        HMAC(secret, challenge) and earns a service ticket."""
+        import errno as _errno
+
+        from ..auth import AuthError
+        from ..msg.message import MAuthReply
+        dest = msg.reply_to or msg.from_addr
+        if self.key_server is None:
+            self.msgr.send_message(
+                MAuthReply(tid=msg.tid, result=0, outs="auth none"), dest)
+            return
+        if not msg.proof:
+            ch = self.key_server.get_challenge(msg.entity)
+            self.msgr.send_message(
+                MAuthReply(tid=msg.tid, result=0, challenge=ch), dest)
+            return
+        try:
+            ticket = self.key_server.handle_request(
+                msg.entity, msg.proof, service=msg.service)
+        except AuthError as e:
+            self.msgr.send_message(
+                MAuthReply(tid=msg.tid, result=-_errno.EACCES,
+                           outs=str(e)), dest)
+            return
+        self.msgr.send_message(
+            MAuthReply(tid=msg.tid, result=0, ticket=ticket), dest)
 
     def _forward_if_peon(self, msg) -> bool:
         if self.is_leader():

@@ -26,7 +26,7 @@ __all__ = [
     "MOSDPGPull", "MOSDPGScan", "MOSDMap", "MOSDBoot", "MOSDFailure",
     "MOSDAlive",
     "MMonCommand", "MMonCommandReply", "MMonSubscribe", "MMonPaxos",
-    "MMonElection",
+    "MMonElection", "MAuth", "MAuthReply",
 ]
 
 _seq = itertools.count(1)
@@ -246,6 +246,27 @@ class MMonSubscribe(Message):
     what: str = "osdmap"
     start_epoch: int = 0
     reply_to: object = None
+
+
+# -- auth (cephx handshake, MAuth/MAuthReply) ---------------------------
+
+@dataclass
+class MAuth(Message):
+    """Client -> mon auth round: request a challenge, then prove it."""
+    entity: str = ""
+    service: str = "osd"
+    proof: bytes = b""          # empty on the first (challenge) round
+    tid: int = 0
+    reply_to: object = None
+
+
+@dataclass
+class MAuthReply(Message):
+    tid: int = 0
+    result: int = 0             # 0 ok, -EACCES on failure
+    challenge: bytes = b""
+    ticket: object = None       # CephxServer.handle_request reply dict
+    outs: str = ""
 
 
 # -- mon internal ------------------------------------------------------

@@ -88,6 +88,10 @@ class Connection:
         self.cond = threading.Condition(self.lock)
         self.out_q: list = []
         self.peer_name = None
+        self.auth_info = None        # verified cephx info (entity, caps)
+        self.inbound = sock is not None   # accepted vs dialed
+        self.auth_confirmed = False  # dialer saw a valid BANNER_ACK
+        self._sent_authorizer = None
         self.closed = False
         self.writer = threading.Thread(target=self._writer_loop,
                                        daemon=True)
@@ -118,6 +122,15 @@ class Connection:
     # -- writer --------------------------------------------------------
 
     def _connect(self) -> bool:
+        # Mint the authorizer outside the socket try: a failing factory
+        # (no ticket yet) must read as a failed connect attempt, not kill
+        # the writer thread.
+        authorizer = None
+        if self.msgr.authorizer_factory is not None:
+            try:
+                authorizer = self.msgr.authorizer_factory()
+            except Exception:
+                return False
         try:
             sock = socket.create_connection(tuple(self.peer_addr),
                                             timeout=5.0)
@@ -129,7 +142,8 @@ class Connection:
             # connect handshake; replies never dial the ephemeral port)
             sock.sendall(_encode(
                 ("BANNER", tuple(self.msgr.my_addr or ("", 0)),
-                 self.msgr.name)))
+                 self.msgr.name, authorizer)))
+            self._sent_authorizer = authorizer
             self.sock = sock
             self._start_reader()
             return True
@@ -202,13 +216,53 @@ class Connection:
                 msg = pickle.loads(payload)
             except Exception:
                 continue
-            if (isinstance(msg, tuple) and len(msg) == 3
+            if (isinstance(msg, tuple) and len(msg) in (3, 4)
                     and msg[0] == "BANNER"):
                 # acceptor side: adopt the peer's advertised listening
-                # address and register so sends to it reuse this pipe
+                # address and register so sends to it reuse this pipe.
+                # With auth enabled, the banner must carry a valid
+                # authorizer or the connection is dropped (EACCES).
+                verifier = self.msgr.auth_verifier
+                if verifier is not None:
+                    authorizer = msg[3] if len(msg) == 4 else None
+                    try:
+                        info = verifier.verify_authorizer(authorizer or {})
+                    except Exception:
+                        self.close()
+                        break
+                    self.auth_info = info
+                    # mutual auth: prove we could read the ticket
+                    try:
+                        sock.sendall(_encode(
+                            ("BANNER_ACK", info.get("reply_proof"))))
+                    except OSError:
+                        break
                 self.peer_addr = EntityAddr(*msg[1])
                 self.peer_name = msg[2]
                 self.msgr._register_inbound(self)
+                continue
+            if (isinstance(msg, tuple) and len(msg) == 2
+                    and msg[0] == "BANNER_ACK"):
+                # dialer side: the service proved possession of the
+                # session key (cephx mutual auth)
+                confirm = self.msgr.auth_confirm
+                if confirm is not None and not confirm(
+                        self._sent_authorizer, msg[1]):
+                    self.close()
+                    break
+                self.auth_confirmed = True
+                continue
+            # Inbound connections behind a verifier may not deliver
+            # anything before a valid banner: a peer that skips the
+            # handshake is cut off, not dispatched.
+            if (self.inbound and self.msgr.auth_verifier is not None
+                    and self.auth_info is None):
+                self.close()
+                break
+            # A dialer expecting mutual auth ignores inbound traffic
+            # until the service has proven itself.
+            if (not self.inbound and self.msgr.auth_confirm is not None
+                    and not self.auth_confirmed):
                 continue
             msg.from_addr = self.peer_addr
             self.msgr._dispatch(msg)
@@ -235,10 +289,20 @@ class Messenger:
     """Bind + accept + per-peer outgoing connections."""
 
     def __init__(self, name, nonce: str = "", conf=None,
-                 policy_lossy: bool = False):
+                 policy_lossy: bool = False,
+                 authorizer_factory=None, auth_verifier=None,
+                 auth_confirm=None):
         self.name = name              # ("osd", 3) etc.
         self.conf = conf
         self.policy_lossy = policy_lossy
+        # cephx connection auth (src/msg AuthAuthorizer plumbing):
+        # authorizer_factory() -> dict attached to our outgoing banner;
+        # auth_verifier.verify_authorizer(dict) gates inbound banners;
+        # auth_confirm(sent_authorizer, reply_proof) -> bool validates
+        # the service's mutual-auth BANNER_ACK on dialed connections.
+        self.authorizer_factory = authorizer_factory
+        self.auth_verifier = auth_verifier
+        self.auth_confirm = auth_confirm
         self.dispatchers: list[Dispatcher] = []
         self.my_addr: EntityAddr | None = None
         self._server: socket.socket | None = None
