@@ -87,16 +87,34 @@ class CephxServer:
     secrets the monitor shares with daemons in the reference).
     """
 
+    MAX_CHALLENGES = 1024          # unauthenticated-state bound
+    CHALLENGE_TTL = 60.0
+
     def __init__(self, keyring, service_secrets: dict[str, bytes],
                  ticket_ttl: float = DEFAULT_TICKET_TTL):
         self.keyring = keyring
         self.service_secrets = dict(service_secrets)
         self.ticket_ttl = ticket_ttl
-        self._challenges: dict[str, bytes] = {}
+        # (entity, challenge) -> issue time: multiple outstanding
+        # challenges per entity so concurrent authentications don't
+        # clobber each other; bounded + expiring because round 1 is
+        # unauthenticated (anyone can ask)
+        self._challenges: dict[tuple, float] = {}
 
-    def get_challenge(self, entity: str) -> bytes:
+    def _prune_challenges(self, now: float) -> None:
+        dead = [k for k, ts in self._challenges.items()
+                if now - ts > self.CHALLENGE_TTL]
+        for k in dead:
+            del self._challenges[k]
+        while len(self._challenges) >= self.MAX_CHALLENGES:
+            self._challenges.pop(next(iter(self._challenges)))
+
+    def get_challenge(self, entity: str,
+                      now: float | None = None) -> bytes:
+        now = time.time() if now is None else now
+        self._prune_challenges(now)
         ch = os.urandom(16)
-        self._challenges[entity] = ch
+        self._challenges[(entity, ch)] = now
         return ch
 
     def handle_request(self, entity: str, proof: bytes,
@@ -106,22 +124,31 @@ class CephxServer:
 
         Raises AuthError on unknown entity / wrong key / no challenge.
         """
+        now_t = time.time() if now is None else now
         secret = self.keyring.get_secret_bytes(entity)
-        challenge = self._challenges.pop(entity, None)
-        if secret is None or challenge is None:
+        if secret is None:
             raise AuthError("entity %s: unknown or no challenge" % entity)
-        if not hmac.compare_digest(proof, _proof(secret, challenge)):
+        matched = None
+        for (ent, ch), ts in self._challenges.items():
+            if ent == entity and now_t - ts <= self.CHALLENGE_TTL \
+                    and hmac.compare_digest(proof, _proof(secret, ch)):
+                matched = (ent, ch)
+                break
+        if matched is None:
+            if not any(ent == entity for ent, _ in self._challenges):
+                raise AuthError(
+                    "entity %s: unknown or no challenge" % entity)
             raise AuthError("entity %s: bad proof (wrong key)" % entity)
+        del self._challenges[matched]
         svc_secret = self.service_secrets.get(service)
         if svc_secret is None:
             raise AuthError("no service secret for %r" % service)
         session_key = os.urandom(32)
-        now = time.time() if now is None else now
         ticket = seal(svc_secret, pickle.dumps({
             "entity": entity,
             "caps": self.keyring.get_caps(entity).get(service, ""),
             "session_key": session_key,
-            "expires": now + self.ticket_ttl,
+            "expires": now_t + self.ticket_ttl,
             "service": service,
         }))
         return {"service": service,

@@ -136,6 +136,9 @@ class Connection:
                                             timeout=5.0)
             sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # a fresh socket means a fresh peer: mutual auth must be
+            # re-proven before inbound traffic is trusted again
+            self.auth_confirmed = False
             # banner (the msgr protocol's handshake): advertise our
             # bound address so the acceptor can route replies back over
             # this same connection (Ceph learns the peer_addr during the

@@ -5,10 +5,17 @@
   mem_store     MemStore in-memory backend (src/os/memstore/MemStore.cc)
                 — the test/fake backend of the reference, and the
                 default store of the in-process cluster harness
-  kv            KeyValueDB interface + MemDB (src/kv/)
+  file_store    FileStore persistent backend: write-ahead journal +
+                checkpoint + replay-on-mount
+                (src/os/filestore/{FileStore,FileJournal}.cc)
+  kv            KeyValueDB interface + MemDB + persistent FileDB
+                (src/kv/)
 """
 
 from .object_store import ObjectStore, Transaction
 from .mem_store import MemStore
+from .file_store import FileStore
+from .kv import FileDB, KeyValueDB, MemDB
 
-__all__ = ["ObjectStore", "Transaction", "MemStore"]
+__all__ = ["ObjectStore", "Transaction", "MemStore", "FileStore",
+           "KeyValueDB", "MemDB", "FileDB"]

@@ -1,0 +1,204 @@
+"""File-backed ObjectStore with a write-ahead journal.
+
+Persistent-store analog of the reference's FileStore
+(/root/reference/src/os/filestore/FileStore.cc + FileJournal.cc),
+journal-ahead ("writeahead") mode:
+
+  1. every Transaction is serialized and appended to an fsynced journal
+     (FileJournal: framed entries with seq + crc; framing/replay shared
+     with FileDB via ceph_tpu.store.wal); on_commit fires once the
+     journal write is durable,
+  2. ops then apply to the in-memory state (the page-cache analog;
+     on_applied fires here),
+  3. `sync()` checkpoints dirty objects to per-object files under
+     current/ and advances the committed seq marker (FileStore's
+     sync_entry/op_seq), after which the journal restarts.
+
+mount() loads the checkpoint and replays journal entries newer than the
+committed seq — crash recovery is replay, exactly the reference's
+model. A torn or corrupt journal tail ends replay at the last valid
+entry and is truncated away so post-recovery writes stay replayable.
+
+Layout under `path/`:
+  journal         framed WAL (wal.FramedLog; payload = pickled (seq, ops))
+  commit_seq      last checkpointed op seq (atomic rename)
+  current/<h>     one pickle per object: {cid, oid, data, xattrs, omap}
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+
+from .mem_store import MemStore
+from .object_store import Transaction
+from .wal import FramedLog, fsync_dir, write_atomic
+
+__all__ = ["FileStore"]
+
+
+class FileStore(MemStore):
+    def __init__(self, path: str, finisher=None, journal_sync: bool = True,
+                 sync_threshold: int = 64 << 20):
+        super().__init__(finisher=finisher)
+        self.path = path
+        self.journal_path = os.path.join(path, "journal")
+        self.commit_seq_path = os.path.join(path, "commit_seq")
+        self.current_dir = os.path.join(path, "current")
+        self.sync_threshold = sync_threshold  # journal bytes before autosync
+        self._journal = FramedLog(self.journal_path, sync=journal_sync)
+        self._seq = 0                 # last journaled op seq
+        self._committed_seq = 0       # last checkpointed op seq
+        self._dirty: set = set()      # (cid, oid) pending checkpoint
+        self._removed: set = set()    # (cid, oid) deleted since checkpoint
+        self._dirty_colls = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def mount(self) -> None:
+        os.makedirs(self.current_dir, exist_ok=True)
+        self._load_checkpoint()
+        for blob in self._journal.open():
+            try:
+                seq, ops = pickle.loads(blob)
+            except Exception:
+                continue
+            if seq <= self._committed_seq:
+                continue  # already checkpointed
+            for op in ops:
+                self._apply_tracked(op)
+            self._seq = seq
+        self.mounted = True
+
+    def umount(self) -> None:
+        if self.mounted:
+            self.sync()
+        self._journal.close()
+        self.mounted = False
+
+    # -- checkpoint load -----------------------------------------------
+
+    def _load_checkpoint(self) -> None:
+        try:
+            with open(self.commit_seq_path) as f:
+                self._committed_seq = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            self._committed_seq = 0
+        self._seq = self._committed_seq
+        from .object_store import Collection
+        for name in os.listdir(self.current_dir):
+            fpath = os.path.join(self.current_dir, name)
+            try:
+                with open(fpath, "rb") as f:
+                    doc = pickle.load(f)
+            except Exception:
+                continue  # half-written checkpoint file; journal re-creates
+            if doc.get("kind") == "collection":
+                self._colls.setdefault(doc["cid"], Collection(doc["cid"]))
+                continue
+            coll = self._colls.setdefault(doc["cid"],
+                                          Collection(doc["cid"]))
+            obj = coll.objects[doc["oid"]] = self._new_object()
+            obj.data = bytearray(doc["data"])
+            obj.xattrs = dict(doc["xattrs"])
+            obj.omap = dict(doc["omap"])
+
+    @staticmethod
+    def _new_object():
+        from .mem_store import _Object
+        return _Object()
+
+    # -- write path ----------------------------------------------------
+
+    def queue_transaction(self, txn: Transaction) -> None:
+        if not self.mounted:
+            raise RuntimeError("FileStore not mounted")
+        with self._lock:
+            self._seq += 1
+            # journal-ahead: durable once append returns
+            self._journal.append(pickle.dumps((self._seq, txn.ops)))
+            for op in txn.ops:
+                self._apply_tracked(op)
+        for cb in txn.on_commit:
+            self._complete(cb)
+        for cb in txn.on_applied:
+            self._complete(cb)
+        if self._journal.size >= self.sync_threshold:
+            self.sync()
+
+    def _apply_tracked(self, op: tuple) -> None:
+        """Apply one op and track dirty/removed objects for checkpoint."""
+        kind = op[0]
+        if kind == "remove_collection":
+            # capture the doomed objects before the op erases them, so
+            # their checkpoint files are deleted too (otherwise mount
+            # would resurrect the collection from stale object files)
+            coll = self._colls.get(op[1])
+            if coll is not None:
+                for oid in coll.objects:
+                    self._dirty.discard((op[1], oid))
+                    self._removed.add((op[1], oid))
+        self._apply(op)
+        if kind in ("create_collection", "remove_collection"):
+            self._dirty_colls = True
+        elif kind == "remove":
+            self._dirty.discard((op[1], op[2]))
+            self._removed.add((op[1], op[2]))
+        elif kind == "move_rename":
+            _, src_cid, src_oid, dst_cid, dst_oid = op
+            self._dirty.discard((src_cid, src_oid))
+            self._removed.add((src_cid, src_oid))
+            self._removed.discard((dst_cid, dst_oid))
+            self._dirty.add((dst_cid, dst_oid))
+        elif len(op) >= 3:
+            self._removed.discard((op[1], op[2]))
+            self._dirty.add((op[1], op[2]))
+
+    # -- checkpoint ----------------------------------------------------
+
+    def _obj_path(self, cid, oid) -> str:
+        h = hashlib.sha1(pickle.dumps((cid, oid))).hexdigest()
+        return os.path.join(self.current_dir, h)
+
+    def _coll_path(self, cid) -> str:
+        h = hashlib.sha1(pickle.dumps(("__coll__", cid))).hexdigest()
+        return os.path.join(self.current_dir, "c_" + h)
+
+    def sync(self) -> None:
+        """Checkpoint dirty state and advance the committed seq
+        (FileStore::sync_entry); afterwards the journal restarts."""
+        with self._lock:
+            dirty = list(self._dirty)
+            removed = list(self._removed)
+            seq = self._seq
+            self._dirty.clear()
+            self._removed.clear()
+            dirty_colls, self._dirty_colls = self._dirty_colls, False
+            if dirty_colls:
+                live = {self._coll_path(cid) for cid in self._colls}
+                for cid in self._colls:
+                    write_atomic(self._coll_path(cid), pickle.dumps(
+                        {"kind": "collection", "cid": cid}))
+                for name in os.listdir(self.current_dir):
+                    fpath = os.path.join(self.current_dir, name)
+                    if name.startswith("c_") and fpath not in live:
+                        os.unlink(fpath)
+            for cid, oid in removed:
+                try:
+                    os.unlink(self._obj_path(cid, oid))
+                except OSError:
+                    pass
+            for cid, oid in dirty:
+                coll = self._colls.get(cid)
+                obj = coll.objects.get(oid) if coll else None
+                if obj is None:
+                    continue
+                write_atomic(self._obj_path(cid, oid), pickle.dumps({
+                    "cid": cid, "oid": oid, "data": bytes(obj.data),
+                    "xattrs": obj.xattrs, "omap": obj.omap}))
+            fsync_dir(self.current_dir)
+            write_atomic(self.commit_seq_path, str(seq).encode("ascii"))
+            self._committed_seq = seq
+            # journal trim: everything up to seq is checkpointed
+            self._journal.restart()

@@ -1,17 +1,22 @@
-"""KeyValueDB interface + MemDB.
+"""KeyValueDB interface + MemDB + FileDB.
 
 Role of the reference's src/kv/ (KeyValueDB.h over RocksDB/LevelDB/
 MemDB): ordered string-keyed store with prefixed namespaces and atomic
 write batches — used by the monitor's MonitorDBStore and BlueStore's
-metadata. MemDB is the in-memory backend (reference src/kv/MemDB.cc).
+metadata. MemDB is the in-memory backend (reference src/kv/MemDB.cc);
+FileDB is the persistent backend standing in for the RocksDB wrapper:
+a write-ahead log of batches replayed over a compacted snapshot, the
+same LSM-style durability contract (log first, compact later).
 """
 
 from __future__ import annotations
 
 import bisect
+import os
+import pickle
 import threading
 
-__all__ = ["KeyValueDB", "MemDB"]
+__all__ = ["KeyValueDB", "MemDB", "FileDB"]
 
 
 class _Batch:
@@ -83,3 +88,63 @@ class MemDB(KeyValueDB):
             i = bisect.bisect_left(keys, key)
             ns = self._data.get(prefix, {})
             return [(k, ns[k]) for k in keys[i:]]
+
+
+class FileDB(MemDB):
+    """Durable KeyValueDB: snapshot + write-ahead log under `path/`.
+
+    Every submitted batch is appended (framed, crc-guarded, fsynced —
+    wal.FramedLog, shared with FileStore's journal) before it applies in
+    memory; `compact()` snapshots the whole map to `snap` (atomic
+    rename) and restarts the log. open() loads the snapshot and replays
+    the log; a torn tail is truncated away.
+    """
+
+    def __init__(self, path: str, log_sync: bool = True,
+                 compact_threshold: int = 8 << 20):
+        super().__init__()
+        from .wal import FramedLog
+        self.path = path
+        self.snap_path = os.path.join(path, "snap")
+        self.log_path = os.path.join(path, "log")
+        self.compact_threshold = compact_threshold
+        self._log = FramedLog(self.log_path, sync=log_sync)
+        self._opened = False
+
+    def open(self) -> "FileDB":
+        os.makedirs(self.path, exist_ok=True)
+        try:
+            with open(self.snap_path, "rb") as f:
+                data = pickle.load(f)
+            for prefix, ns in data.items():
+                self._data[prefix] = dict(ns)
+                self._keys[prefix] = sorted(ns)
+        except OSError:
+            pass
+        for blob in self._log.open():
+            batch = _Batch()
+            batch.ops = pickle.loads(blob)
+            super().submit_transaction(batch)
+        self._opened = True
+        return self
+
+    def close(self) -> None:
+        if self._opened:
+            self.compact()
+            self._log.close()
+            self._opened = False
+
+    def submit_transaction(self, batch: _Batch) -> None:
+        if not self._opened:
+            raise RuntimeError("FileDB not opened")
+        with self._lock:
+            self._log.append(pickle.dumps(batch.ops))
+            super().submit_transaction(batch)
+        if self._log.size >= self.compact_threshold:
+            self.compact()
+
+    def compact(self) -> None:
+        from .wal import write_atomic
+        with self._lock:
+            write_atomic(self.snap_path, pickle.dumps(self._data))
+            self._log.restart()

@@ -1,0 +1,104 @@
+"""Shared write-ahead-log machinery.
+
+One implementation of the durability-critical primitives both
+persistent backends ride (FileStore's journal, FileDB's batch log —
+reference: src/os/filestore/FileJournal.cc and the RocksDB WAL it
+stands in for):
+
+  - framed, crc-guarded append-only log,
+  - replay that stops at a torn/corrupt tail AND truncates the file
+    back to the last valid entry before reopening for append — without
+    the truncate, post-recovery fsync-acknowledged entries would land
+    behind the garbage where no future replay ever reads them,
+  - atomic whole-file writes (tmp + fsync + rename) for checkpoints.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+__all__ = ["FramedLog", "write_atomic", "fsync_dir"]
+
+_FRAME = struct.Struct("<III")    # magic, length, crc
+_MAGIC = 0x0CEF57A2
+
+
+def write_atomic(path: str, blob: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class FramedLog:
+    """Append-only log of opaque blobs with torn-tail recovery."""
+
+    def __init__(self, path: str, sync: bool = True):
+        self.path = path
+        self.sync = sync
+        self._fd = None
+        self.size = 0
+
+    def open(self) -> list[bytes]:
+        """Replay valid entries, truncate any torn tail, open for
+        append. Returns the replayed blobs in order."""
+        blobs: list[bytes] = []
+        valid_end = 0
+        try:
+            with open(self.path, "rb") as f:
+                while True:
+                    hdr = f.read(_FRAME.size)
+                    if len(hdr) < _FRAME.size:
+                        break
+                    magic, length, crc = _FRAME.unpack(hdr)
+                    if magic != _MAGIC:
+                        break
+                    blob = f.read(length)
+                    if len(blob) < length or zlib.crc32(blob) != crc:
+                        break
+                    blobs.append(blob)
+                    valid_end += _FRAME.size + length
+        except OSError:
+            pass
+        # Drop the garbage so post-recovery appends are replayable.
+        if os.path.exists(self.path) and \
+                os.path.getsize(self.path) > valid_end:
+            with open(self.path, "r+b") as f:
+                f.truncate(valid_end)
+        self._fd = open(self.path, "ab")
+        self.size = valid_end
+        return blobs
+
+    def append(self, blob: bytes) -> None:
+        if self._fd is None:
+            raise RuntimeError("log not open")
+        self._fd.write(_FRAME.pack(_MAGIC, len(blob), zlib.crc32(blob))
+                       + blob)
+        self._fd.flush()
+        if self.sync:
+            os.fsync(self._fd.fileno())
+        self.size += _FRAME.size + len(blob)
+
+    def restart(self) -> None:
+        """Truncate to empty (everything is checkpointed)."""
+        if self._fd is not None:
+            self._fd.close()
+        self._fd = open(self.path, "wb")
+        self.size = 0
+
+    def close(self) -> None:
+        if self._fd is not None:
+            self._fd.close()
+            self._fd = None
