@@ -26,6 +26,7 @@ import hmac
 import os
 import pickle
 import struct
+import threading
 import time
 
 AUTH_SERVICE = "auth"
@@ -98,8 +99,10 @@ class CephxServer:
         # (entity, challenge) -> issue time: multiple outstanding
         # challenges per entity so concurrent authentications don't
         # clobber each other; bounded + expiring because round 1 is
-        # unauthenticated (anyone can ask)
+        # unauthenticated (anyone can ask). Locked: handlers run on
+        # concurrent messenger reader threads.
         self._challenges: dict[tuple, float] = {}
+        self._chal_lock = threading.Lock()
 
     def _prune_challenges(self, now: float) -> None:
         dead = [k for k, ts in self._challenges.items()
@@ -112,9 +115,10 @@ class CephxServer:
     def get_challenge(self, entity: str,
                       now: float | None = None) -> bytes:
         now = time.time() if now is None else now
-        self._prune_challenges(now)
         ch = os.urandom(16)
-        self._challenges[(entity, ch)] = now
+        with self._chal_lock:
+            self._prune_challenges(now)
+            self._challenges[(entity, ch)] = now
         return ch
 
     def handle_request(self, entity: str, proof: bytes,
@@ -128,18 +132,21 @@ class CephxServer:
         secret = self.keyring.get_secret_bytes(entity)
         if secret is None:
             raise AuthError("entity %s: unknown or no challenge" % entity)
-        matched = None
-        for (ent, ch), ts in self._challenges.items():
-            if ent == entity and now_t - ts <= self.CHALLENGE_TTL \
-                    and hmac.compare_digest(proof, _proof(secret, ch)):
-                matched = (ent, ch)
-                break
-        if matched is None:
-            if not any(ent == entity for ent, _ in self._challenges):
+        with self._chal_lock:
+            matched = None
+            for (ent, ch), ts in self._challenges.items():
+                if ent == entity and now_t - ts <= self.CHALLENGE_TTL \
+                        and hmac.compare_digest(proof, _proof(secret, ch)):
+                    matched = (ent, ch)
+                    break
+            if matched is None:
+                if not any(ent == entity
+                           for ent, _ in self._challenges):
+                    raise AuthError(
+                        "entity %s: unknown or no challenge" % entity)
                 raise AuthError(
-                    "entity %s: unknown or no challenge" % entity)
-            raise AuthError("entity %s: bad proof (wrong key)" % entity)
-        del self._challenges[matched]
+                    "entity %s: bad proof (wrong key)" % entity)
+            del self._challenges[matched]
         svc_secret = self.service_secrets.get(service)
         if svc_secret is None:
             raise AuthError("no service secret for %r" % service)

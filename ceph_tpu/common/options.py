@@ -78,6 +78,15 @@ def _declare_defaults():
     o("osd_recovery_op_priority", int, 3, LEVEL_ADVANCED)
     o("osd_op_num_shards", int, 4, LEVEL_ADVANCED,
       "ShardedOpWQ shard count (src/osd/OSD.h:1623)")
+    o("osd_op_history_size", int, 20, LEVEL_ADVANCED,
+      "completed ops kept for dump_historic_ops")
+    o("osd_op_history_duration", float, 600.0, LEVEL_ADVANCED,
+      "seconds a completed op stays in history")
+    o("osd_op_complaint_time", float, 30.0, LEVEL_ADVANCED,
+      "age after which an in-flight op counts as a slow request")
+    # tracing (TracepointProvider/blkin gating)
+    o("trace_enable", bool, False, LEVEL_ADVANCED,
+      "collect zipkin-style spans on the op path")
     # mon
     o("mon_osd_down_out_interval", float, 2.0, LEVEL_ADVANCED,
       "seconds after down before an osd is marked out")

@@ -51,7 +51,8 @@ class Elector:
     def handle(self, msg: MMonElection) -> None:
         with self._lock:
             if msg.op == "propose":
-                if msg.epoch > self.epoch:
+                bumped = msg.epoch > self.epoch
+                if bumped:
                     self.epoch = msg.epoch
                 if msg.rank < self.mon.rank:
                     # they outrank us: defer
@@ -61,8 +62,12 @@ class Elector:
                     if not self.electing:
                         self.electing = True
                 else:
-                    # we outrank them: counter-propose
-                    if not self.electing:
+                    # we outrank them: counter-propose. Restarting is
+                    # also required when their propose BUMPED our epoch
+                    # mid-election — the pending _maybe_victory timer is
+                    # keyed to the old epoch and would no-op, leaving
+                    # every mon stuck in "electing" forever.
+                    if not self.electing or bumped:
                         self.start()
             elif msg.op == "ack":
                 if msg.epoch == self.epoch:

@@ -1,0 +1,142 @@
+"""Per-op event history + in-flight op tracking.
+
+Rendition of the reference's OpTracker/OpRequest
+(/root/reference/src/osd/OpRequest.{h,cc},
+src/common/TrackedOp.{h,cc}): every client op carries a timestamped
+event trail (queued, reached_pg, started, commit_sent, done); the
+tracker holds all in-flight ops plus a bounded history of completed
+ones, served over the admin socket as `dump_ops_in_flight` /
+`dump_historic_ops` — and flags ops older than the complaint time the
+way the OSD's "slow request" warnings do.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+
+__all__ = ["OpRequest", "OpTracker"]
+
+_ids = itertools.count(1)
+
+
+class OpRequest:
+    def __init__(self, description: str, tracker: "OpTracker | None" = None):
+        self.id = next(_ids)
+        self.description = description
+        self.initiated_at = time.time()
+        self.events: list[tuple[float, str]] = []
+        self.done_at: float | None = None
+        self._tracker = tracker
+
+    def mark_event(self, name: str) -> None:
+        self.events.append((time.time(), name))
+
+    def mark_started(self) -> None:
+        self.mark_event("started")
+
+    def mark_commit_sent(self) -> None:
+        self.mark_event("commit_sent")
+
+    def mark_done(self) -> None:
+        self.done_at = time.time()
+        self.mark_event("done")
+        if self._tracker is not None:
+            self._tracker.unregister_inflight_op(self)
+
+    @property
+    def duration(self) -> float:
+        end = self.done_at if self.done_at is not None else time.time()
+        return end - self.initiated_at
+
+    def dump(self) -> dict:
+        return {
+            "id": self.id,
+            "description": self.description,
+            "initiated_at": self.initiated_at,
+            "age": time.time() - self.initiated_at,
+            "duration": self.duration,
+            "type_data": {
+                "events": [{"time": ts, "event": name}
+                           for ts, name in self.events],
+            },
+        }
+
+
+class OpTracker:
+    """In-flight registry + completed-op history (TrackedOp machinery).
+
+    history_size / history_duration mirror osd_op_history_size (20) and
+    osd_op_history_duration (600s); complaint_time mirrors
+    osd_op_complaint_time (30s).
+    """
+
+    def __init__(self, history_size: int = 20,
+                 history_duration: float = 600.0,
+                 complaint_time: float = 30.0):
+        self.history_size = history_size
+        self.history_duration = history_duration
+        self.complaint_time = complaint_time
+        self._lock = threading.Lock()
+        self._inflight: dict[int, OpRequest] = {}
+        self._history: deque[OpRequest] = deque()
+
+    def create_request(self, description: str) -> OpRequest:
+        op = OpRequest(description, tracker=self)
+        op.mark_event("initiated")
+        with self._lock:
+            self._inflight[op.id] = op
+        return op
+
+    def unregister_inflight_op(self, op: OpRequest) -> None:
+        with self._lock:
+            self._inflight.pop(op.id, None)
+            self._history.append(op)
+            self._prune_locked()
+
+    def _prune_locked(self) -> None:
+        now = time.time()
+        while len(self._history) > self.history_size:
+            self._history.popleft()
+        while self._history and (self._history[0].done_at or now) \
+                < now - self.history_duration:
+            self._history.popleft()
+
+    # -- introspection (admin socket surface) ---------------------------
+
+    def dump_ops_in_flight(self) -> dict:
+        with self._lock:
+            ops = [op.dump() for op in self._inflight.values()]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic_ops(self) -> dict:
+        with self._lock:
+            self._prune_locked()
+            ops = [op.dump() for op in self._history]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic_ops_by_duration(self) -> dict:
+        doc = self.dump_historic_ops()
+        doc["ops"].sort(key=lambda o: o["duration"], reverse=True)
+        return doc
+
+    def get_slow_ops(self, now: float | None = None) -> list[dict]:
+        """Ops in flight longer than the complaint time (the OSD's
+        'slow request' warning source)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            return [op.dump() for op in self._inflight.values()
+                    if now - op.initiated_at > self.complaint_time]
+
+    def register_admin_commands(self, asok) -> None:
+        asok.register("dump_ops_in_flight",
+                      lambda args: self.dump_ops_in_flight(),
+                      "show ops currently in flight")
+        asok.register("dump_historic_ops",
+                      lambda args: self.dump_historic_ops(),
+                      "show recently completed ops")
+        asok.register("dump_historic_ops_by_duration",
+                      lambda args: self.dump_historic_ops_by_duration(),
+                      "show slowest recent ops first")

@@ -21,6 +21,8 @@ from ..msg.message import (MOSDBoot, MOSDFailure, MOSDOpReply, MPing,
                            MPingReply)
 from ..msg.messenger import Dispatcher, Messenger
 from ..store.mem_store import MemStore
+from ..utils.trace import Tracer
+from .op_request import OpTracker
 from .osd_map import OSDMap
 from .pg import PG
 
@@ -47,6 +49,15 @@ class OSDDaemon(Dispatcher):
         self.op_wq = ShardedThreadPool(
             "osd%d-op" % whoami, conf.get_val("osd_op_num_shards"),
             self.ctx.hbmap)
+        # per-op event history + slow-request detection (OpTracker)
+        self.op_tracker = OpTracker(
+            history_size=conf.get_val("osd_op_history_size"),
+            history_duration=conf.get_val("osd_op_history_duration"),
+            complaint_time=conf.get_val("osd_op_complaint_time"))
+        # zipkin/blkin-style spans, config-gated (trace_enable)
+        self.tracer = Tracer(conf=conf)
+        if self.ctx.admin_socket is not None:
+            self.op_tracker.register_admin_commands(self.ctx.admin_socket)
         self.timer = SafeTimer("osd%d-timer" % whoami)
         self.hb_peers: dict = {}       # osd -> last reply stamp
         self.hb_pending: dict = {}     # osd -> first unacked ping stamp
@@ -213,16 +224,35 @@ class OSDDaemon(Dispatcher):
     def _enqueue_client_op(self, msg) -> None:
         pg = self._get_pg(msg.pgid and self._normalize_pgid(msg.pgid))
         client_addr = msg.from_addr
+        op = self.op_tracker.create_request(
+            "osd_op(tid=%s pg=%s %s)" % (msg.tid, msg.pgid,
+                                         getattr(msg, "op", "?")))
+        span = self.tracer.start_trace("osd_op", "osd.%d" % self.whoami)
+        span.keyval("tid", msg.tid)
+        span.keyval("pg", str(msg.pgid))
 
         def reply(result, data):
+            op.mark_commit_sent()
             self.public_msgr.send_message(
                 MOSDOpReply(tid=msg.tid, result=result, data=data,
                             map_epoch=self.map_epoch()), client_addr)
+            op.mark_done()
+            span.keyval("result", result)
+            span.finish()
 
         if pg is None:
+            op.mark_event("no_pg")
             reply(-11, None)
             return
-        self.op_wq.queue(pg.pgid, pg.do_op, msg, reply)
+        op.mark_event("queued_for_pg")
+
+        def run(m, r):
+            op.mark_event("reached_pg")
+            op.mark_started()
+            with span.child("pg_do_op"):
+                pg.do_op(m, r)
+
+        self.op_wq.queue(pg.pgid, run, msg, reply)
 
     def _normalize_pgid(self, raw_pgid):
         pool = self.osdmap.pools.get(raw_pgid.pool)

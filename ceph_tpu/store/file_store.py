@@ -32,7 +32,7 @@ import os
 import pickle
 
 from .mem_store import MemStore
-from .object_store import Transaction
+from .object_store import Collection, Transaction
 from .wal import FramedLog, fsync_dir, write_atomic
 
 __all__ = ["FileStore"]
@@ -86,7 +86,6 @@ class FileStore(MemStore):
         except (OSError, ValueError):
             self._committed_seq = 0
         self._seq = self._committed_seq
-        from .object_store import Collection
         for name in os.listdir(self.current_dir):
             fpath = os.path.join(self.current_dir, name)
             try:
@@ -99,15 +98,10 @@ class FileStore(MemStore):
                 continue
             coll = self._colls.setdefault(doc["cid"],
                                           Collection(doc["cid"]))
-            obj = coll.objects[doc["oid"]] = self._new_object()
+            obj = coll.objects[doc["oid"]] = self.make_object()
             obj.data = bytearray(doc["data"])
             obj.xattrs = dict(doc["xattrs"])
             obj.omap = dict(doc["omap"])
-
-    @staticmethod
-    def _new_object():
-        from .mem_store import _Object
-        return _Object()
 
     # -- write path ----------------------------------------------------
 
@@ -151,6 +145,10 @@ class FileStore(MemStore):
             self._removed.add((src_cid, src_oid))
             self._removed.discard((dst_cid, dst_oid))
             self._dirty.add((dst_cid, dst_oid))
+        elif kind == "clone":
+            _, cid, _src, dst = op
+            self._removed.discard((cid, dst))
+            self._dirty.add((cid, dst))
         elif len(op) >= 3:
             self._removed.discard((op[1], op[2]))
             self._dirty.add((op[1], op[2]))

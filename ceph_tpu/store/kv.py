@@ -16,6 +16,8 @@ import os
 import pickle
 import threading
 
+from .wal import FramedLog, write_atomic
+
 __all__ = ["KeyValueDB", "MemDB", "FileDB"]
 
 
@@ -103,7 +105,6 @@ class FileDB(MemDB):
     def __init__(self, path: str, log_sync: bool = True,
                  compact_threshold: int = 8 << 20):
         super().__init__()
-        from .wal import FramedLog
         self.path = path
         self.snap_path = os.path.join(path, "snap")
         self.log_path = os.path.join(path, "log")
@@ -144,7 +145,6 @@ class FileDB(MemDB):
             self.compact()
 
     def compact(self) -> None:
-        from .wal import write_atomic
         with self._lock:
             write_atomic(self.snap_path, pickle.dumps(self._data))
             self._log.restart()

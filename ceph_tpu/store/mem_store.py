@@ -36,6 +36,10 @@ class _Object:
 
 
 class MemStore(ObjectStore):
+    # object-record factory, overridable/reusable by derived stores
+    # (FileStore rebuilds records from checkpoint files through this)
+    make_object = staticmethod(_Object)
+
     def __init__(self, finisher=None):
         self._lock = threading.RLock()
         self._colls: dict = {}

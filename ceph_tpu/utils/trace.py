@@ -1,0 +1,156 @@
+"""Distributed tracing spans (blkin/zipkin analog).
+
+Rendition of the reference's ZTracer/blkin integration
+(/root/reference/src/common/zipkin_trace.h; spans threaded through the
+EC write path at ECBackend.cc:1978-1983 — one child span per shard) and
+the lazily-enabled TracepointProvider pattern
+(src/common/TracepointProvider.h: tracing stays zero-cost until a
+config option turns it on).
+
+A `Tracer` collects finished spans in a bounded ring; `Trace` is a
+root span, `child()` hangs sub-spans off it (trace_id/span_id/parent).
+When the tracer is disabled every call is a no-op on a shared null
+object, so instrumented hot paths pay only a truthiness check.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+
+__all__ = ["Tracer", "Trace", "NULL_TRACE"]
+
+_ids = itertools.count(1)
+
+
+class Trace:
+    """One span: named interval with key-value annotations + events."""
+
+    __slots__ = ("tracer", "name", "endpoint", "trace_id", "span_id",
+                 "parent_id", "start", "end", "keyvals", "events")
+
+    def __init__(self, tracer, name, endpoint="", trace_id=None,
+                 parent_id=None):
+        self.tracer = tracer
+        self.name = name
+        self.endpoint = endpoint
+        self.span_id = next(_ids)
+        self.trace_id = trace_id if trace_id is not None else self.span_id
+        self.parent_id = parent_id
+        self.start = time.time()
+        self.end: float | None = None
+        self.keyvals: dict = {}
+        self.events: list[tuple[float, str]] = []
+
+    def valid(self) -> bool:
+        return True
+
+    def child(self, name: str) -> "Trace":
+        return Trace(self.tracer, name, self.endpoint,
+                     trace_id=self.trace_id, parent_id=self.span_id)
+
+    def keyval(self, key: str, value) -> None:
+        self.keyvals[key] = value
+
+    def event(self, name: str) -> None:
+        self.events.append((time.time(), name))
+
+    def finish(self) -> None:
+        if self.end is None:
+            self.end = time.time()
+            self.tracer._record(self)
+
+    def __enter__(self) -> "Trace":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.finish()
+
+    def dump(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_id": self.parent_id, "name": self.name,
+                "endpoint": self.endpoint, "start": self.start,
+                "duration": (self.end or time.time()) - self.start,
+                "keyvals": dict(self.keyvals),
+                "events": list(self.events)}
+
+
+class _NullTrace:
+    """Shared no-op span: the disabled-tracing fast path."""
+
+    def valid(self) -> bool:
+        return False
+
+    def child(self, name: str) -> "_NullTrace":
+        return self
+
+    def keyval(self, key: str, value) -> None:
+        pass
+
+    def event(self, name: str) -> None:
+        pass
+
+    def finish(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+
+NULL_TRACE = _NullTrace()
+
+
+class Tracer:
+    """Span collector, config-gated like TracepointProvider.
+
+    Pass a Context conf with option 'trace_enable' to have enablement
+    follow the option (hot-toggling included, via config observer when
+    the conf supports it); or toggle .enabled directly.
+    """
+
+    def __init__(self, capacity: int = 4096, conf=None,
+                 option: str = "trace_enable"):
+        self.capacity = capacity
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._spans: deque[Trace] = deque(maxlen=capacity)
+        if conf is not None:
+            tracer = self
+
+            class _Obs:  # md_config_obs_t contract (config.ConfigObserver)
+                def get_tracked_keys(self):
+                    return (option,)
+
+                def handle_conf_change(self, cfg, changed):
+                    tracer.enabled = bool(cfg.get_val(option))
+
+            try:
+                self.enabled = bool(conf.get_val(option))
+                conf.add_observer(_Obs())
+            except KeyError:
+                pass  # option not in the schema: stay disabled
+
+    def start_trace(self, name: str, endpoint: str = ""):
+        """Root span, or the shared null span when disabled."""
+        if not self.enabled:
+            return NULL_TRACE
+        return Trace(self, name, endpoint)
+
+    def _record(self, span: Trace) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    def dump(self, trace_id: int | None = None) -> list[dict]:
+        with self._lock:
+            spans = list(self._spans)
+        return [s.dump() for s in spans
+                if trace_id is None or s.trace_id == trace_id]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()

@@ -152,6 +152,21 @@ class TestFileStore:
         assert not st2.exists("pg1", "dst")
         st2.umount()
 
+    def test_bare_clone_survives_sync_and_remount(self, tmp_path):
+        """A clone with no further writes to the destination must still
+        be checkpointed (the dst is dirty even though no op names it as
+        (op[1], op[2]))."""
+        st = make_store(tmp_path)
+        write_obj(st, "pg1", "src", b"cloneme")
+        t = Transaction()
+        t.clone("pg1", "src", "dst")
+        st.queue_transaction(t)
+        st.sync()   # trims the journal holding the clone op
+        st.umount()
+        st2 = make_store(tmp_path)
+        assert st2.read("pg1", "dst") == b"cloneme"
+        st2.umount()
+
     def test_autosync_threshold(self, tmp_path):
         st = make_store(tmp_path, sync_threshold=1024)
         for i in range(8):
