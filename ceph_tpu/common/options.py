@@ -78,6 +78,16 @@ def _declare_defaults():
     o("osd_recovery_op_priority", int, 3, LEVEL_ADVANCED)
     o("osd_op_num_shards", int, 4, LEVEL_ADVANCED,
       "ShardedOpWQ shard count (src/osd/OSD.h:1623)")
+    o("osd_op_queue", str, "wpq", LEVEL_ADVANCED,
+      "op scheduling discipline: wpq | mclock_opclass | fifo")
+    o("osd_op_queue_mclock_client_res", float, 0.0, LEVEL_ADVANCED,
+      "dmclock reservation (ops/s) for client ops; 0 = none")
+    o("osd_op_queue_mclock_client_wgt", float, 500.0, LEVEL_ADVANCED)
+    o("osd_op_queue_mclock_client_lim", float, 0.0, LEVEL_ADVANCED,
+      "dmclock limit (ops/s) for client ops; 0 = unlimited")
+    o("osd_op_queue_mclock_recovery_res", float, 0.0, LEVEL_ADVANCED)
+    o("osd_op_queue_mclock_recovery_wgt", float, 1.0, LEVEL_ADVANCED)
+    o("osd_op_queue_mclock_recovery_lim", float, 0.0, LEVEL_ADVANCED)
     o("osd_op_history_size", int, 20, LEVEL_ADVANCED,
       "completed ops kept for dump_historic_ops")
     o("osd_op_history_duration", float, 600.0, LEVEL_ADVANCED,

@@ -100,7 +100,9 @@ class ShardedThreadPool:
         for s in self._shards:
             s.start()
 
-    def queue(self, key, fn, *args) -> None:
+    def queue(self, key, fn, *args, **qos) -> None:
+        # qos kwargs (klass/priority/cost) are accepted for signature
+        # parity with QosShardedOpWQ; FIFO ignores them
         self._shards[hash(key) % self.num_shards].queue(fn, *args)
 
     def drain(self) -> None:

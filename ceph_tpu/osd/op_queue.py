@@ -1,0 +1,376 @@
+"""QoS op queues: weighted-priority and dmClock scheduling.
+
+Renditions of the reference's OSD op-queue disciplines, selected by the
+`osd_op_queue` option (src/common/options.cc):
+
+  WeightedPriorityQueue   src/common/WeightedPriorityQueue.h — a strict
+                          band for high-priority ops plus deficit-
+                          weighted round-robin across priority buckets,
+                          so a flood of low-priority work (recovery,
+                          scrub) cannot starve client ops but still
+                          makes progress.
+  MClockOpClassQueue      src/osd/mClockOpClassQueue.{h,cc} over the
+                          vendored dmclock library (src/dmclock/):
+                          per-op-class (client / recovery / scrub /
+                          snaptrim) reservation + weight + limit tags;
+                          reservations are served first, spare capacity
+                          is shared by weight, and limits cap a class
+                          even when the device is idle.
+
+`QosShardedOpWQ` is the ShardedOpWQ shape (hash key -> shard, one
+worker per shard preserving per-PG ordering) with one of these queues
+inside each shard.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+
+__all__ = ["OpQueue", "WeightedPriorityQueue", "MClockOpClassQueue",
+           "QosShardedOpWQ", "make_op_queue"]
+
+
+class OpQueue:
+    """Discipline contract (src/common/OpQueue.h)."""
+
+    def enqueue(self, klass: str, priority: int, cost: int, item) -> None:
+        raise NotImplementedError
+
+    def enqueue_strict(self, klass: str, priority: int, item) -> None:
+        raise NotImplementedError
+
+    def dequeue(self, now: float | None = None):
+        """Next item, or None when every class is limit-throttled."""
+        raise NotImplementedError
+
+    def next_ready_in(self, now: float | None = None) -> float | None:
+        """Seconds until a throttled head becomes eligible (None = no
+        throttled work)."""
+        return None
+
+    def empty(self) -> bool:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+
+class WeightedPriorityQueue(OpQueue):
+    """Strict band + deficit-weighted round-robin buckets.
+
+    Ops enqueued strict dequeue first, highest priority first, FIFO
+    within. Normal ops land in per-priority buckets; each round-robin
+    visit grants a bucket `priority` worth of deficit and it drains
+    cost units against it — bandwidth proportional to priority, order
+    preserved within a bucket.
+    """
+
+    def __init__(self, min_cost: int = 4096):
+        self.min_cost = min_cost
+        self._strict: deque = deque()       # (priority, item), sorted-ish
+        self._buckets: "OrderedDict[int, deque]" = OrderedDict()
+        self._deficit: dict[int, float] = {}
+        self._size = 0
+
+    def enqueue(self, klass, priority, cost, item):
+        b = self._buckets.get(priority)
+        if b is None:
+            b = self._buckets[priority] = deque()
+            self._deficit.setdefault(priority, 0.0)
+        b.append((max(cost, 0), item))
+        self._size += 1
+
+    def enqueue_strict(self, klass, priority, item):
+        # keep strict band ordered by priority (descending), FIFO within
+        self._strict.append((priority, item))
+        self._size += 1
+
+    def _cost_units(self, cost: int) -> float:
+        return max(cost, self.min_cost) / self.min_cost
+
+    def dequeue(self, now=None):
+        if self._strict:
+            best = max(range(len(self._strict)),
+                       key=lambda i: (self._strict[i][0], -i))
+            # max() prefers later equal elements with -i keeping FIFO
+            prio, item = self._strict[best]
+            del self._strict[best]
+            self._size -= 1
+            return item
+        # Deficit round robin: a bucket at the front keeps serving while
+        # its deficit covers the head's cost, then earns `priority` more
+        # and rotates — so over a full rotation each priority p drains
+        # ~p/cost items and bandwidth is proportional to priority.
+        # Deficit grows only while unaffordable, so it stays bounded and
+        # the loop terminates.
+        while self._buckets:
+            priority, bucket = next(iter(self._buckets.items()))
+            if self._deficit[priority] >= self._cost_units(bucket[0][0]):
+                cost, item = bucket.popleft()
+                self._deficit[priority] -= self._cost_units(cost)
+                self._size -= 1
+                if not bucket:
+                    del self._buckets[priority]
+                    del self._deficit[priority]
+                return item
+            # quantum floor of 1: a zero/negative priority must still
+            # make progress or the shard worker spins forever on it
+            self._deficit[priority] += max(priority, 1)
+            self._buckets.move_to_end(priority)
+        return None
+
+    def empty(self) -> bool:
+        return self._size == 0
+
+    def __len__(self) -> int:
+        return self._size
+
+
+class _MClass:
+    __slots__ = ("reservation", "weight", "limit", "q",
+                 "r_tag", "p_tag", "l_tag")
+
+    def __init__(self, reservation: float, weight: float, limit: float):
+        self.reservation = reservation
+        self.weight = weight
+        self.limit = limit
+        self.q: deque = deque()     # (r, p, l, item) per-op tags
+        # None = never active: the first op of a (re)activated class
+        # tags at `now` (dmclock's new-client rule) and only rate debt
+        # pushes tags into the future
+        self.r_tag: float | None = None
+        self.p_tag: float | None = None
+        self.l_tag: float | None = None
+
+
+class MClockOpClassQueue(OpQueue):
+    """dmClock over op classes.
+
+    client_info: {class: (reservation_ops_per_s, weight, limit_ops_per_s)}
+    (0 reservation = none; 0 limit = unlimited). Dequeue serves overdue
+    reservations first (min r-tag <= now), then shares by weight among
+    classes under their limit; returns None when everything queued is
+    limit-throttled (next_ready_in says how long).
+    """
+
+    DEFAULT_INFO = {
+        "client": (0.0, 500.0, 0.0),
+        "osd_subop": (0.0, 500.0, 0.0),
+        "recovery": (0.0, 1.0, 0.0),
+        "scrub": (0.0, 1.0, 0.0),
+        "snaptrim": (0.0, 1.0, 0.0),
+    }
+
+    def __init__(self, client_info: dict | None = None,
+                 min_cost: int = 4096):
+        self.info = dict(self.DEFAULT_INFO)
+        if client_info:
+            self.info.update(client_info)
+        self.min_cost = min_cost
+        self._classes: dict[str, _MClass] = {}
+        self._strict: deque = deque()
+        self._size = 0
+
+    def _class(self, klass: str) -> _MClass:
+        c = self._classes.get(klass)
+        if c is None:
+            res, wgt, lim = self.info.get(klass, (0.0, 1.0, 0.0))
+            c = self._classes[klass] = _MClass(res, wgt, lim)
+        return c
+
+    @staticmethod
+    def _next_tag(prev: float | None, rate: float, scale: float,
+                  now: float) -> float:
+        """max(now, prev + scale/rate); a fresh/long-idle class tags at
+        now so its first op is immediately eligible."""
+        if prev is None:
+            return now
+        return max(now, prev + scale / rate)
+
+    def enqueue(self, klass, priority, cost, item):
+        now = time.monotonic()
+        c = self._class(klass)
+        # normalize byte cost into units so weights stay the dominant
+        # signal (raw bytes would advance a 1MB client op's tag by
+        # minutes and invert the configured client:recovery ratio)
+        scale = max(cost, self.min_cost) / self.min_cost
+        if c.reservation > 0:
+            r = self._next_tag(c.r_tag, c.reservation, scale, now)
+            c.r_tag = r
+        else:
+            r = float("inf")
+        p = self._next_tag(c.p_tag, c.weight, scale, now)
+        c.p_tag = p
+        if c.limit > 0:
+            lim = self._next_tag(c.l_tag, c.limit, scale, now)
+            c.l_tag = lim
+        else:
+            lim = 0.0
+        c.q.append((r, p, lim, item))
+        self._size += 1
+
+    def enqueue_strict(self, klass, priority, item):
+        self._strict.append(item)
+        self._size += 1
+
+    def dequeue(self, now=None):
+        if self._strict:
+            self._size -= 1
+            return self._strict.popleft()
+        now = time.monotonic() if now is None else now
+        # reservation phase
+        best = None
+        for klass, c in self._classes.items():
+            if c.q and c.q[0][0] <= now:
+                if best is None or c.q[0][0] < best[0]:
+                    best = (c.q[0][0], c)
+        if best is not None:
+            _, _, _, item = best[1].q.popleft()
+            self._size -= 1
+            return item
+        # proportional phase (limit-gated)
+        best = None
+        for klass, c in self._classes.items():
+            if c.q and c.q[0][2] <= now:
+                if best is None or c.q[0][1] < best[0]:
+                    best = (c.q[0][1], c)
+        if best is not None:
+            _, _, _, item = best[1].q.popleft()
+            self._size -= 1
+            return item
+        return None
+
+    def next_ready_in(self, now=None):
+        now = time.monotonic() if now is None else now
+        waits = [c.q[0][2] - now for c in self._classes.values() if c.q]
+        return max(0.0, min(waits)) if waits else None
+
+    def empty(self) -> bool:
+        return self._size == 0
+
+    def __len__(self) -> int:
+        return self._size
+
+
+def make_op_queue(conf=None) -> OpQueue | None:
+    """Build the discipline named by osd_op_queue; None means plain FIFO."""
+    name = conf.get_val("osd_op_queue") if conf is not None else "wpq"
+    if name == "wpq":
+        return WeightedPriorityQueue()
+    if name == "mclock_opclass":
+        info = {}
+        for klass in ("client", "recovery"):
+            info[klass] = (
+                conf.get_val("osd_op_queue_mclock_%s_res" % klass),
+                conf.get_val("osd_op_queue_mclock_%s_wgt" % klass),
+                conf.get_val("osd_op_queue_mclock_%s_lim" % klass))
+        return MClockOpClassQueue(info)
+    if name == "fifo":
+        return None
+    raise ValueError("unknown osd_op_queue %r" % name)
+
+
+class QosShardedOpWQ:
+    """ShardedOpWQ with a QoS discipline inside each shard.
+
+    Same contract as ShardedThreadPool (hash key -> shard, one worker
+    per shard => per-PG ordering within a priority class), but each
+    shard drains an OpQueue so client ops outrank recovery/scrub work.
+    """
+
+    def __init__(self, name: str, num_shards: int, queue_factory,
+                 hbmap=None, grace: float = 30.0):
+        self.name = name
+        self.num_shards = num_shards
+        self._shards = [_QosShard("%s-s%d" % (name, i), queue_factory(),
+                                  hbmap, grace)
+                        for i in range(num_shards)]
+
+    def start(self) -> None:
+        for s in self._shards:
+            s.start()
+
+    def queue(self, key, fn, *args, klass: str = "client",
+              priority: int = 63, cost: int = 0) -> None:
+        self._shards[hash(key) % self.num_shards].enqueue(
+            klass, priority, cost, (fn, args))
+
+    def drain(self) -> None:
+        for s in self._shards:
+            s.drain()
+
+    def stop(self) -> None:
+        for s in self._shards:
+            s.stop()
+
+
+class _QosShard:
+    def __init__(self, name: str, opq: OpQueue, hbmap, grace: float):
+        self.name = name
+        self.opq = opq
+        self._hbmap = hbmap
+        self._grace = grace
+        # idle wakeups must outpace the heartbeat grace or an idle
+        # shard reads as wedged
+        self._wait_cap = min(1.0, grace / 2) if hbmap else 1.0
+        self._cond = threading.Condition()
+        self._stopping = False
+        self._inflight = 0
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._worker,
+                                        name=self.name, daemon=True)
+        self._thread.start()
+
+    def enqueue(self, klass, priority, cost, item) -> None:
+        with self._cond:
+            self.opq.enqueue(klass, priority, cost, item)
+            self._cond.notify()
+
+    def _worker(self) -> None:
+        handle = self._hbmap.add(self.name, self._grace) \
+            if self._hbmap else None
+        while True:
+            with self._cond:
+                while True:
+                    if handle:  # idle loops must stay visibly alive
+                        handle.renew()
+                    if self._stopping:
+                        if handle:
+                            handle.remove()
+                        return
+                    item = self.opq.dequeue()
+                    if item is not None:
+                        self._inflight += 1
+                        break
+                    wait = self.opq.next_ready_in()
+                    self._cond.wait(min(wait, self._wait_cap)
+                                    if wait is not None
+                                    else self._wait_cap)
+            if handle:
+                handle.renew()
+            fn, args = item
+            try:
+                fn(*args)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    self._cond.notify_all()
+
+    def drain(self) -> None:
+        with self._cond:
+            while not self.opq.empty() or self._inflight:
+                self._cond.wait(0.01)
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

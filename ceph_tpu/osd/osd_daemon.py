@@ -22,6 +22,7 @@ from ..msg.message import (MOSDBoot, MOSDFailure, MOSDOpReply, MPing,
 from ..msg.messenger import Dispatcher, Messenger
 from ..store.mem_store import MemStore
 from ..utils.trace import Tracer
+from .op_queue import QosShardedOpWQ, make_op_queue
 from .op_request import OpTracker
 from .osd_map import OSDMap
 from .pg import PG
@@ -46,9 +47,18 @@ class OSDDaemon(Dispatcher):
         self.osdmap = OSDMap()
         self.pgs: dict = {}
         self.lock = threading.RLock()
-        self.op_wq = ShardedThreadPool(
-            "osd%d-op" % whoami, conf.get_val("osd_op_num_shards"),
-            self.ctx.hbmap)
+        # op scheduling: QoS discipline per osd_op_queue (wpq default,
+        # like the reference's luminous OSD), plain FIFO as fallback
+        if conf.get_val("osd_op_queue") == "fifo":
+            self.op_wq = ShardedThreadPool(
+                "osd%d-op" % whoami, conf.get_val("osd_op_num_shards"),
+                self.ctx.hbmap)
+        else:
+            self.op_wq = QosShardedOpWQ(
+                "osd%d-op" % whoami, conf.get_val("osd_op_num_shards"),
+                lambda: make_op_queue(conf), self.ctx.hbmap)
+        self.client_op_priority = conf.get_val("osd_client_op_priority")
+        self.recovery_op_priority = conf.get_val("osd_recovery_op_priority")
         # per-op event history + slow-request detection (OpTracker)
         self.op_tracker = OpTracker(
             history_size=conf.get_val("osd_op_history_size"),
@@ -148,7 +158,9 @@ class OSDDaemon(Dispatcher):
         return pg
 
     def queue_recovery(self, pg) -> None:
-        self.op_wq.queue(pg.pgid, pg.start_recovery)
+        self.op_wq.queue(pg.pgid, pg.start_recovery,
+                         klass="recovery",
+                         priority=self.recovery_op_priority)
 
     # -- sends ---------------------------------------------------------
 
@@ -231,7 +243,12 @@ class OSDDaemon(Dispatcher):
         span.keyval("tid", msg.tid)
         span.keyval("pg", str(msg.pgid))
 
+        replied = [False]
+
         def reply(result, data):
+            if replied[0]:
+                return
+            replied[0] = True
             op.mark_commit_sent()
             self.public_msgr.send_message(
                 MOSDOpReply(tid=msg.tid, result=result, data=data,
@@ -249,10 +266,20 @@ class OSDDaemon(Dispatcher):
         def run(m, r):
             op.mark_event("reached_pg")
             op.mark_started()
-            with span.child("pg_do_op"):
-                pg.do_op(m, r)
+            try:
+                with span.child("pg_do_op"):
+                    pg.do_op(m, r)
+            except Exception:
+                # never leak the op as in-flight-forever or leave the
+                # client hanging: fail it with EIO
+                op.mark_event("exception")
+                reply(-5, None)
+                raise
 
-        self.op_wq.queue(pg.pgid, run, msg, reply)
+        self.op_wq.queue(pg.pgid, run, msg, reply,
+                         klass="client",
+                         priority=self.client_op_priority,
+                         cost=len(getattr(msg, "data", b"") or b""))
 
     def _normalize_pgid(self, raw_pgid):
         pool = self.osdmap.pools.get(raw_pgid.pool)
@@ -285,4 +312,5 @@ class OSDDaemon(Dispatcher):
             elif t == "MOSDPGPush":
                 pg.handle_push(msg)
 
-        self.op_wq.queue(msg.pgid, run)
+        self.op_wq.queue(msg.pgid, run, klass="osd_subop",
+                         priority=self.client_op_priority)
