@@ -195,6 +195,9 @@ class IoCtx:
     def omap_set(self, oid: str, kv: dict) -> None:
         self._op(oid, [("omap_set", kv)])
 
+    def omap_rm_keys(self, oid: str, keys) -> None:
+        self._op(oid, [("omap_rm", list(keys))])
+
     # -- reads ---------------------------------------------------------
 
     def read(self, oid: str, length: int = 0, offset: int = 0) -> bytes:

@@ -1,0 +1,164 @@
+"""Block-image layer over RADOS (librbd analog).
+
+Rendition of the reference's librbd surface
+(/root/reference/src/librbd/, image format per doc/dev/rbd-layering.rst
+basics): an image is a header object (`rbd_header.<name>`) holding
+size/order, a pool-wide directory object (`rbd_directory`) listing
+images in its omap, and data blocks (`rbd_data.<name>.%016x`) of
+2^order bytes each, addressed by offset — the striping degenerate case
+stripe_count=1, object_size=stripe_unit=2^order, like rbd's default
+layout. Sparse blocks read as zeros; discard removes whole blocks and
+zero-fills partials.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from .striper import FileLayout
+
+__all__ = ["RBD", "Image", "ImageNotFound", "ImageExists"]
+
+DIR_OID = "rbd_directory"
+DEFAULT_ORDER = 22          # 4 MiB objects (rbd_default_order)
+
+
+class ImageNotFound(Exception):
+    pass
+
+
+class ImageExists(Exception):
+    pass
+
+
+def _header_oid(name: str) -> str:
+    return "rbd_header.%s" % name
+
+
+def _data_oid(name: str, block: int) -> str:
+    return "rbd_data.%s.%016x" % (name, block)
+
+
+class RBD:
+    """Pool-level image operations (librbd.h rbd_create/list/remove)."""
+
+    @staticmethod
+    def create(ioctx, name: str, size: int,
+               order: int = DEFAULT_ORDER) -> None:
+        if name in RBD.list(ioctx):
+            raise ImageExists(name)
+        ioctx.write_full(_header_oid(name),
+                         struct.pack("<QB", size, order))
+        ioctx.omap_set(DIR_OID, {name: b"1"})
+
+    @staticmethod
+    def list(ioctx) -> list[str]:
+        try:
+            return sorted(ioctx.omap_get(DIR_OID))
+        except Exception:
+            return []
+
+    @staticmethod
+    def remove(ioctx, name: str) -> None:
+        img = Image(ioctx, name)   # raises ImageNotFound
+        nblocks = -(-img.size() // img.block_size)
+        for b in range(nblocks):
+            try:
+                ioctx.remove(_data_oid(name, b))
+            except Exception:
+                pass
+        ioctx.remove(_header_oid(name))
+        # targeted key removal: a read-modify-write of the whole
+        # directory would erase concurrently created images
+        ioctx.omap_rm_keys(DIR_OID, [name])
+
+
+class Image:
+    """One open image (librbd Image): offset-addressed block IO."""
+
+    def __init__(self, ioctx, name: str):
+        self.ioctx = ioctx
+        self.name = name
+        try:
+            hdr = ioctx.read(_header_oid(name))
+        except Exception:
+            raise ImageNotFound(name)
+        if len(hdr) < 9:
+            raise ImageNotFound(name)
+        self._size, self.order = struct.unpack("<QB", hdr[:9])
+        self.block_size = 1 << self.order
+        self.layout = FileLayout(self.block_size, 1, self.block_size)
+
+    def size(self) -> int:
+        return self._size
+
+    def stat(self) -> dict:
+        return {"size": self._size, "order": self.order,
+                "block_name_prefix": "rbd_data.%s" % self.name,
+                "num_objs": -(-self._size // self.block_size)}
+
+    def _check_extent(self, offset: int, length: int) -> None:
+        if offset < 0 or length < 0 or offset + length > self._size:
+            raise ValueError("extent %d~%d outside image size %d"
+                             % (offset, length, self._size))
+
+    def write(self, offset: int, data: bytes) -> int:
+        self._check_extent(offset, len(data))
+        for blk, blk_off, n, foff in self.layout.map_extent(
+                offset, len(data)):
+            self.ioctx.write(_data_oid(self.name, blk),
+                             data[foff - offset:foff - offset + n],
+                             blk_off)
+        return len(data)
+
+    def read(self, offset: int, length: int) -> bytes:
+        self._check_extent(offset, length)
+        out = bytearray(length)
+        for blk, blk_off, n, foff in self.layout.map_extent(
+                offset, length):
+            try:
+                piece = self.ioctx.read(_data_oid(self.name, blk),
+                                        n, blk_off)
+            except Exception:
+                piece = b""  # sparse block reads as zeros
+            out[foff - offset:foff - offset + len(piece)] = piece
+        return bytes(out)
+
+    def discard(self, offset: int, length: int) -> None:
+        """Free whole blocks; zero partial block edges (rbd_discard)."""
+        self._check_extent(offset, length)
+        for blk, blk_off, n, _ in self.layout.map_extent(offset, length):
+            oid = _data_oid(self.name, blk)
+            if blk_off == 0 and n == self.block_size:
+                try:
+                    self.ioctx.remove(oid)
+                except Exception:
+                    pass
+            else:
+                try:
+                    self.ioctx.write(oid, b"\0" * n, blk_off)
+                except Exception:
+                    pass
+
+    def resize(self, new_size: int) -> None:
+        if new_size < self._size:
+            first_dead = -(-new_size // self.block_size)
+            last = -(-self._size // self.block_size)
+            for blk in range(first_dead, last):
+                try:
+                    self.ioctx.remove(_data_oid(self.name, blk))
+                except Exception:
+                    pass
+            # zero the tail of the new boundary block
+            if new_size % self.block_size:
+                blk = new_size // self.block_size
+                tail_off = new_size % self.block_size
+                try:
+                    self.ioctx.write(
+                        _data_oid(self.name, blk),
+                        b"\0" * (self.block_size - tail_off), tail_off)
+                except Exception:
+                    pass
+        self._size = new_size
+        self.ioctx.write_full(_header_oid(self.name),
+                              struct.pack("<QB", new_size, self.order))

@@ -1,0 +1,174 @@
+"""Client-side striping over RADOS objects.
+
+Rendition of libradosstriper (/root/reference/src/libradosstriper/,
+striping model per /root/reference/doc/dev/file-striping.rst): a
+logical "striped file" maps onto many backing objects through
+(stripe_unit, stripe_count, object_size):
+
+  - the byte stream is cut into stripe_unit-sized blocks,
+  - blocks round-robin across stripe_count objects ("a stripe"),
+  - each object holds object_size/stripe_unit blocks per object set;
+    when a set fills, the layout advances to the next set of objects.
+
+Object naming mirrors the striper's `<soid>.%016x` scheme; the logical
+size rides an xattr on the first object (striper.size), like the
+reference's striper metadata.
+"""
+
+from __future__ import annotations
+
+import struct
+
+__all__ = ["StripedObject", "FileLayout"]
+
+
+class FileLayout:
+    """(stripe_unit, stripe_count, object_size) triple + the address
+    arithmetic (file-striping.rst)."""
+
+    def __init__(self, stripe_unit: int = 1 << 22, stripe_count: int = 1,
+                 object_size: int = 1 << 22):
+        if stripe_unit <= 0 or stripe_count <= 0 or object_size <= 0:
+            raise ValueError("layout parameters must be positive")
+        if object_size % stripe_unit:
+            raise ValueError("object_size %d must be a multiple of "
+                             "stripe_unit %d" % (object_size, stripe_unit))
+        self.stripe_unit = stripe_unit
+        self.stripe_count = stripe_count
+        self.object_size = object_size
+        self.stripes_per_object = object_size // stripe_unit
+
+    def map_extent(self, offset: int, length: int):
+        """Yield (object_no, object_offset, length, file_offset) pieces
+        covering [offset, offset+length)."""
+        end = offset + length
+        while offset < end:
+            block_no = offset // self.stripe_unit
+            block_off = offset % self.stripe_unit
+            stripe_no = block_no // self.stripe_count
+            stripe_pos = block_no % self.stripe_count
+            set_no = stripe_no // self.stripes_per_object
+            obj_no = set_no * self.stripe_count + stripe_pos
+            obj_block = stripe_no % self.stripes_per_object
+            obj_off = obj_block * self.stripe_unit + block_off
+            n = min(self.stripe_unit - block_off, end - offset)
+            yield obj_no, obj_off, n, offset
+            offset += n
+
+
+class StripedObject:
+    """One striped logical object over an IoCtx (RadosStriperImpl)."""
+
+    SIZE_XATTR = "striper.size"
+    LAYOUT_XATTR = "striper.layout"
+
+    def __init__(self, ioctx, soid: str, layout: FileLayout | None = None):
+        self.ioctx = ioctx
+        self.soid = soid
+        existing = self._read_layout()
+        if existing is not None:
+            self.layout = existing
+        else:
+            self.layout = layout or FileLayout()
+
+    def _obj_name(self, obj_no: int) -> str:
+        return "%s.%016x" % (self.soid, obj_no)
+
+    def _read_layout(self) -> FileLayout | None:
+        try:
+            blob = self.ioctx.get_xattr(self._obj_name(0),
+                                        self.LAYOUT_XATTR)
+        except Exception:
+            return None
+        if not blob:
+            return None
+        su, sc, os_ = struct.unpack("<QQQ", blob)
+        return FileLayout(su, sc, os_)
+
+    def _write_meta(self, size: int) -> None:
+        first = self._obj_name(0)
+        self.ioctx.write(first, b"", 0)  # ensure the anchor exists
+        self.ioctx.set_xattr(first, self.LAYOUT_XATTR, struct.pack(
+            "<QQQ", self.layout.stripe_unit, self.layout.stripe_count,
+            self.layout.object_size))
+        self.ioctx.set_xattr(first, self.SIZE_XATTR,
+                             struct.pack("<Q", size))
+
+    # -- API (libradosstriper surface) ---------------------------------
+
+    def size(self) -> int:
+        try:
+            blob = self.ioctx.get_xattr(self._obj_name(0), self.SIZE_XATTR)
+        except Exception:
+            return 0
+        return struct.unpack("<Q", blob)[0] if blob else 0
+
+    def write(self, data: bytes, offset: int = 0) -> None:
+        for obj_no, obj_off, n, foff in self.layout.map_extent(
+                offset, len(data)):
+            piece = data[foff - offset:foff - offset + n]
+            self.ioctx.write(self._obj_name(obj_no), piece, obj_off)
+        new_end = offset + len(data)
+        if new_end > self.size():
+            self._write_meta(new_end)
+        elif self._read_layout() is None:
+            self._write_meta(self.size())
+
+    def append(self, data: bytes) -> None:
+        self.write(data, self.size())
+
+    def read(self, length: int = 0, offset: int = 0) -> bytes:
+        total = self.size()
+        if offset >= total:
+            return b""
+        if length == 0 or offset + length > total:
+            length = total - offset
+        out = bytearray(length)
+        for obj_no, obj_off, n, foff in self.layout.map_extent(
+                offset, length):
+            try:
+                piece = self.ioctx.read(self._obj_name(obj_no), n, obj_off)
+            except Exception:
+                piece = b""  # sparse/missing backing object reads as holes
+            out[foff - offset:foff - offset + len(piece)] = piece
+        return bytes(out)
+
+    def truncate(self, size: int) -> None:
+        old = self.size()
+        if size < old:
+            # drop whole objects past the new end; zero the truncated
+            # range inside kept objects so a later extend (or stale
+            # read) cannot resurrect deleted data
+            last_needed = -1
+            if size > 0:
+                last_needed = max(o for o, _, _, _ in
+                                  self.layout.map_extent(0, size))
+            for obj_no, obj_off, n, _ in self.layout.map_extent(
+                    size, old - size):
+                try:
+                    if obj_no > last_needed:
+                        self.ioctx.remove(self._obj_name(obj_no))
+                    else:
+                        self.ioctx.write(self._obj_name(obj_no),
+                                         b"\0" * n, obj_off)
+                except Exception:
+                    pass
+        self._write_meta(size)
+
+    def remove(self) -> None:
+        total = self.size()
+        names = {self._obj_name(0)}
+        if total:
+            for obj_no, _, _, _ in self.layout.map_extent(0, total):
+                names.add(self._obj_name(obj_no))
+        for name in sorted(names):
+            try:
+                self.ioctx.remove(name)
+            except Exception:
+                pass
+
+    def stat(self) -> dict:
+        return {"size": self.size(),
+                "stripe_unit": self.layout.stripe_unit,
+                "stripe_count": self.layout.stripe_count,
+                "object_size": self.layout.object_size}

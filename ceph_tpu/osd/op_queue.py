@@ -24,6 +24,7 @@ inside each shard.
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from collections import OrderedDict, deque
@@ -69,7 +70,8 @@ class WeightedPriorityQueue(OpQueue):
 
     def __init__(self, min_cost: int = 4096):
         self.min_cost = min_cost
-        self._strict: deque = deque()       # (priority, item), sorted-ish
+        self._strict: dict[int, deque] = {}  # priority -> FIFO
+        self._strict_prios: list[int] = []   # sorted ascending
         self._buckets: "OrderedDict[int, deque]" = OrderedDict()
         self._deficit: dict[int, float] = {}
         self._size = 0
@@ -83,20 +85,26 @@ class WeightedPriorityQueue(OpQueue):
         self._size += 1
 
     def enqueue_strict(self, klass, priority, item):
-        # keep strict band ordered by priority (descending), FIFO within
-        self._strict.append((priority, item))
+        # strict band: highest priority first, FIFO within; per-priority
+        # deques keep every pop O(1) even under a peering storm
+        band = self._strict.get(priority)
+        if band is None:
+            band = self._strict[priority] = deque()
+            bisect.insort(self._strict_prios, priority)
+        band.append(item)
         self._size += 1
 
     def _cost_units(self, cost: int) -> float:
         return max(cost, self.min_cost) / self.min_cost
 
     def dequeue(self, now=None):
-        if self._strict:
-            best = max(range(len(self._strict)),
-                       key=lambda i: (self._strict[i][0], -i))
-            # max() prefers later equal elements with -i keeping FIFO
-            prio, item = self._strict[best]
-            del self._strict[best]
+        if self._strict_prios:
+            prio = self._strict_prios[-1]
+            band = self._strict[prio]
+            item = band.popleft()
+            if not band:
+                del self._strict[prio]
+                self._strict_prios.pop()
             self._size -= 1
             return item
         # Deficit round robin: a bucket at the front keeps serving while
@@ -226,18 +234,22 @@ class MClockOpClassQueue(OpQueue):
             if c.q and c.q[0][0] <= now:
                 if best is None or c.q[0][0] < best[0]:
                     best = (c.q[0][0], c)
+        if best is None:
+            # proportional phase (limit-gated)
+            for klass, c in self._classes.items():
+                if c.q and c.q[0][2] <= now:
+                    if best is None or c.q[0][1] < best[0]:
+                        best = (c.q[0][1], c)
         if best is not None:
-            _, _, _, item = best[1].q.popleft()
-            self._size -= 1
-            return item
-        # proportional phase (limit-gated)
-        best = None
-        for klass, c in self._classes.items():
-            if c.q and c.q[0][2] <= now:
-                if best is None or c.q[0][1] < best[0]:
-                    best = (c.q[0][1], c)
-        if best is not None:
-            _, _, _, item = best[1].q.popleft()
+            c = best[1]
+            _, _, _, item = c.q.popleft()
+            if not c.q:
+                # drained class: forget rate/weight debt so a later
+                # reactivation tags at `now` (dmclock idle rule); the
+                # limit tag keeps its debt — draining must not be a
+                # way around a configured ceiling
+                c.r_tag = None
+                c.p_tag = None
             self._size -= 1
             return item
         return None
@@ -339,9 +351,17 @@ class _QosShard:
                     if handle:  # idle loops must stay visibly alive
                         handle.renew()
                     if self._stopping:
-                        if handle:
-                            handle.remove()
-                        return
+                        # drain before exit (ShardedThreadPool parity:
+                        # its shutdown sentinel sits BEHIND pending
+                        # work); limits are bypassed — a stopping OSD
+                        # must not strand throttled replies
+                        item = self.opq.dequeue(now=float("inf"))
+                        if item is None:
+                            if handle:
+                                handle.remove()
+                            return
+                        self._inflight += 1
+                        break
                     item = self.opq.dequeue()
                     if item is not None:
                         self._inflight += 1

@@ -312,5 +312,11 @@ class OSDDaemon(Dispatcher):
             elif t == "MOSDPGPush":
                 pg.handle_push(msg)
 
-        self.op_wq.queue(msg.pgid, run, klass="osd_subop",
-                         priority=self.client_op_priority)
+        # recovery data movement (push/pull/scan) must ride the recovery
+        # class or QoS settings have no effect on actual backfill traffic
+        if t in ("MOSDPGPush", "MOSDPGPull", "MOSDPGScan"):
+            self.op_wq.queue(msg.pgid, run, klass="recovery",
+                             priority=self.recovery_op_priority)
+        else:
+            self.op_wq.queue(msg.pgid, run, klass="osd_subop",
+                             priority=self.client_op_priority)

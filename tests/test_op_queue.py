@@ -188,6 +188,39 @@ class TestFactoryAndShards:
         finally:
             wq.stop()
 
+    def test_stop_drains_pending_work(self):
+        """stop() must finish queued work first (ShardedThreadPool
+        sentinel parity) — dropping it would strand client replies."""
+        done = []
+        wq = QosShardedOpWQ("t", 1, WeightedPriorityQueue)
+        wq.start()
+        for i in range(200):
+            wq.queue("k", done.append, i)
+        wq.stop()
+        assert done == list(range(200))
+
+    def test_stop_drains_through_mclock_limits(self):
+        done = []
+        wq = QosShardedOpWQ(
+            "t", 1, lambda: MClockOpClassQueue(
+                {"recovery": (0.0, 1.0, 2.0)}))   # 2 ops/s limit
+        wq.start()
+        for i in range(6):
+            wq.queue("k", done.append, i, klass="recovery")
+        wq.stop()   # must not wait ~3s for limit slots
+        assert done == list(range(6))
+
+    def test_mclock_idle_class_reactivates_fresh(self):
+        q = MClockOpClassQueue({"recovery": (0.0, 1.0, 0.0),
+                                "client": (0.0, 500.0, 0.0)})
+        t0 = time.monotonic()
+        for i in range(50):   # builds ~50s of p_tag debt at weight 1
+            q.enqueue("recovery", 0, 0, ("r", i))
+        assert len(drain(q, now=t0 + 1000)) == 50
+        # class drained -> debt forgotten; a fresh op competes at `now`
+        q.enqueue("recovery", 0, 0, ("r", "fresh"))
+        assert q.dequeue(time.monotonic() + 0.001) == ("r", "fresh")
+
     def test_idle_shard_stays_heartbeat_healthy(self):
         from ceph_tpu.common.heartbeat_map import HeartbeatMap
         hb = HeartbeatMap()

@@ -1,0 +1,185 @@
+"""Striper + RBD layer tests.
+
+Models libradosstriper's behavior (src/libradosstriper/, striping per
+doc/dev/file-striping.rst) and librbd's image surface
+(src/test/librbd basics: create/list/remove, block IO, sparse reads,
+discard, resize) against a live in-process cluster.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.client.rbd import RBD, Image, ImageExists, ImageNotFound
+from ceph_tpu.client.striper import FileLayout, StripedObject
+
+from .cluster_util import MiniCluster
+
+FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+        "mon_osd_down_out_interval": 1.0, "paxos_propose_interval": 0.02}
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    cluster = MiniCluster(num_mons=1, num_osds=3,
+                          conf_overrides=FAST).start()
+    client = cluster.client()
+    cluster.create_replicated_pool(client, "stripes", size=2, pg_num=8)
+    ioctx = client.open_ioctx("stripes")
+    yield cluster, ioctx
+    cluster.stop()
+
+
+class TestFileLayout:
+    def test_rejects_bad_params(self):
+        with pytest.raises(ValueError):
+            FileLayout(0, 1, 4096)
+        with pytest.raises(ValueError):
+            FileLayout(4096, 1, 10000)  # not a multiple
+
+    def test_round_robin_mapping(self):
+        # 3 objects, 1k stripe unit, 2 stripes per object
+        lay = FileLayout(stripe_unit=1024, stripe_count=3,
+                         object_size=2048)
+        pieces = list(lay.map_extent(0, 1024 * 9))
+        # blocks 0..8: objects 0,1,2,0,1,2 then next set 3,4,5
+        assert [p[0] for p in pieces] == [0, 1, 2, 0, 1, 2, 3, 4, 5]
+        assert [p[1] for p in pieces] == [0, 0, 0, 1024, 1024, 1024,
+                                          0, 0, 0]
+
+    def test_unaligned_extent_split(self):
+        lay = FileLayout(stripe_unit=1024, stripe_count=2,
+                         object_size=2048)
+        pieces = list(lay.map_extent(1000, 100))
+        assert [(p[0], p[1], p[2]) for p in pieces] == [
+            (0, 1000, 24), (1, 0, 76)]
+
+
+class TestStriper:
+    def test_write_read_across_objects(self, ctx):
+        _, ioctx = ctx
+        so = StripedObject(ioctx, "file1",
+                           FileLayout(4096, 3, 8192))
+        payload = bytes(np.random.default_rng(0).integers(
+            0, 256, 50000, dtype=np.uint8))
+        so.write(payload)
+        assert so.size() == len(payload)
+        assert so.read() == payload
+        # data really spread over multiple backing objects
+        backing = [o for o in ioctx.list_objects()
+                   if o.startswith("file1.")]
+        assert len(backing) > 3
+
+    def test_partial_read_write(self, ctx):
+        _, ioctx = ctx
+        so = StripedObject(ioctx, "file2", FileLayout(1024, 2, 2048))
+        so.write(b"A" * 10000)
+        so.write(b"B" * 500, offset=3000)
+        data = so.read()
+        assert data[2999:3500] == b"A" + b"B" * 500
+        assert data[3500] == ord("A")
+        assert so.read(200, 3100) == b"B" * 200
+
+    def test_layout_persisted_and_reloaded(self, ctx):
+        _, ioctx = ctx
+        StripedObject(ioctx, "file3", FileLayout(2048, 4, 4096)) \
+            .write(b"z" * 9000)
+        so2 = StripedObject(ioctx, "file3")   # layout from xattr
+        assert so2.layout.stripe_count == 4
+        assert so2.read() == b"z" * 9000
+
+    def test_append_and_truncate(self, ctx):
+        _, ioctx = ctx
+        so = StripedObject(ioctx, "file4", FileLayout(1024, 2, 2048))
+        so.write(b"x" * 3000)
+        so.append(b"y" * 1000)
+        assert so.size() == 4000
+        assert so.read()[-1000:] == b"y" * 1000
+        so.truncate(1500)
+        assert so.size() == 1500
+        assert so.read() == b"x" * 1500
+
+    def test_truncate_then_extend_reads_zeros(self, ctx):
+        """Shrink+grow must not resurrect deleted bytes (the boundary
+        object's stale tail is zeroed at truncate)."""
+        _, ioctx = ctx
+        so = StripedObject(ioctx, "file6", FileLayout(1024, 2, 2048))
+        so.write(b"S" * 6000)
+        so.truncate(100)
+        so.truncate(6000)
+        data = so.read()
+        assert data[:100] == b"S" * 100
+        assert data[100:] == b"\0" * 5900
+
+    def test_remove_cleans_backing_objects(self, ctx):
+        _, ioctx = ctx
+        so = StripedObject(ioctx, "file5", FileLayout(1024, 2, 2048))
+        so.write(b"q" * 8000)
+        assert any(o.startswith("file5.") for o in ioctx.list_objects())
+        so.remove()
+        assert not any(o.startswith("file5.")
+                       for o in ioctx.list_objects())
+
+
+class TestRBD:
+    def test_create_list_remove(self, ctx):
+        _, ioctx = ctx
+        RBD.create(ioctx, "img1", 1 << 24, order=20)
+        RBD.create(ioctx, "img2", 1 << 20, order=20)
+        assert RBD.list(ioctx) == ["img1", "img2"]
+        with pytest.raises(ImageExists):
+            RBD.create(ioctx, "img1", 1)
+        RBD.remove(ioctx, "img2")
+        assert RBD.list(ioctx) == ["img1"]
+        with pytest.raises(ImageNotFound):
+            Image(ioctx, "img2")
+
+    def test_block_io_and_sparse_reads(self, ctx):
+        _, ioctx = ctx
+        RBD.create(ioctx, "disk", 1 << 22, order=16)  # 64k blocks
+        img = Image(ioctx, "disk")
+        assert img.stat()["num_objs"] == 64
+        payload = bytes(np.random.default_rng(1).integers(
+            0, 256, 200000, dtype=np.uint8))
+        img.write(100000, payload)
+        assert img.read(100000, len(payload)) == payload
+        # unwritten region reads as zeros
+        assert img.read(0, 4096) == b"\0" * 4096
+        # straddling read: zeros then data
+        got = img.read(99000, 2000)
+        assert got[:1000] == b"\0" * 1000
+        assert got[1000:] == payload[:1000]
+
+    def test_write_past_end_rejected(self, ctx):
+        _, ioctx = ctx
+        RBD.create(ioctx, "small", 4096, order=12)
+        img = Image(ioctx, "small")
+        with pytest.raises(ValueError):
+            img.write(4000, b"x" * 200)
+        with pytest.raises(ValueError):
+            img.read(0, 5000)
+
+    def test_discard(self, ctx):
+        _, ioctx = ctx
+        RBD.create(ioctx, "ddisk", 1 << 18, order=16)
+        img = Image(ioctx, "ddisk")
+        img.write(0, b"\xff" * (1 << 18))
+        img.discard(0, 1 << 16)              # whole first block freed
+        img.discard((1 << 16) + 100, 200)    # partial: zero-filled
+        assert img.read(0, 1 << 16) == b"\0" * (1 << 16)
+        got = img.read(1 << 16, 400)
+        assert got[:100] == b"\xff" * 100
+        assert got[100:300] == b"\0" * 200
+        assert got[300:] == b"\xff" * 100
+
+    def test_resize_shrink_zeroes_tail(self, ctx):
+        _, ioctx = ctx
+        RBD.create(ioctx, "rdisk", 1 << 18, order=16)
+        img = Image(ioctx, "rdisk")
+        img.write(0, b"\xaa" * (1 << 18))
+        img.resize(100000)
+        assert img.size() == 100000
+        img.resize(1 << 18)   # grow back: truncated region must be zero
+        assert img.read(100000, 1000) == b"\0" * 1000
+        assert img.read(0, 1000) == b"\xaa" * 1000
+        # reopening sees the persisted size
+        assert Image(ioctx, "rdisk").size() == 1 << 18
