@@ -13,9 +13,16 @@ zero-fills partials.
 
 from __future__ import annotations
 
+import errno as _errno
 import struct
 
 from .striper import FileLayout
+
+
+def _enoent(e: Exception) -> bool:
+    """True only for a genuinely missing object; timeouts/EIO are real
+    failures and must surface, not read as sparse holes."""
+    return isinstance(e, OSError) and e.errno == _errno.ENOENT
 
 __all__ = ["RBD", "Image", "ImageNotFound", "ImageExists"]
 
@@ -55,18 +62,24 @@ class RBD:
     def list(ioctx) -> list[str]:
         try:
             return sorted(ioctx.omap_get(DIR_OID))
-        except Exception:
-            return []
+        except OSError as e:
+            if _enoent(e):
+                return []  # directory object not created yet
+            raise  # a transient failure must not read as "no images"
 
     @staticmethod
     def remove(ioctx, name: str) -> None:
+        """Data blocks and header go first; the directory entry is only
+        dropped once they are really gone — otherwise a later create
+        with the same name would resurrect stale block data."""
         img = Image(ioctx, name)   # raises ImageNotFound
         nblocks = -(-img.size() // img.block_size)
         for b in range(nblocks):
             try:
                 ioctx.remove(_data_oid(name, b))
-            except Exception:
-                pass
+            except OSError as e:
+                if not _enoent(e):
+                    raise
         ioctx.remove(_header_oid(name))
         # targeted key removal: a read-modify-write of the whole
         # directory would erase concurrently created images
@@ -81,8 +94,10 @@ class Image:
         self.name = name
         try:
             hdr = ioctx.read(_header_oid(name))
-        except Exception:
-            raise ImageNotFound(name)
+        except OSError as e:
+            if _enoent(e):
+                raise ImageNotFound(name)
+            raise
         if len(hdr) < 9:
             raise ImageNotFound(name)
         self._size, self.order = struct.unpack("<QB", hdr[:9])
@@ -119,7 +134,9 @@ class Image:
             try:
                 piece = self.ioctx.read(_data_oid(self.name, blk),
                                         n, blk_off)
-            except Exception:
+            except OSError as e:
+                if not _enoent(e):
+                    raise  # timeout/EIO must not read as zeros
                 piece = b""  # sparse block reads as zeros
             out[foff - offset:foff - offset + len(piece)] = piece
         return bytes(out)
@@ -132,13 +149,11 @@ class Image:
             if blk_off == 0 and n == self.block_size:
                 try:
                     self.ioctx.remove(oid)
-                except Exception:
-                    pass
+                except OSError as e:
+                    if not _enoent(e):
+                        raise
             else:
-                try:
-                    self.ioctx.write(oid, b"\0" * n, blk_off)
-                except Exception:
-                    pass
+                self.ioctx.write(oid, b"\0" * n, blk_off)
 
     def resize(self, new_size: int) -> None:
         if new_size < self._size:
@@ -147,18 +162,16 @@ class Image:
             for blk in range(first_dead, last):
                 try:
                     self.ioctx.remove(_data_oid(self.name, blk))
-                except Exception:
-                    pass
+                except OSError as e:
+                    if not _enoent(e):
+                        raise
             # zero the tail of the new boundary block
             if new_size % self.block_size:
                 blk = new_size // self.block_size
                 tail_off = new_size % self.block_size
-                try:
-                    self.ioctx.write(
-                        _data_oid(self.name, blk),
-                        b"\0" * (self.block_size - tail_off), tail_off)
-                except Exception:
-                    pass
+                self.ioctx.write(
+                    _data_oid(self.name, blk),
+                    b"\0" * (self.block_size - tail_off), tail_off)
         self._size = new_size
         self.ioctx.write_full(_header_oid(self.name),
                               struct.pack("<QB", new_size, self.order))

@@ -17,9 +17,14 @@ reference's striper metadata.
 
 from __future__ import annotations
 
+import errno as _errno
 import struct
 
 __all__ = ["StripedObject", "FileLayout"]
+
+
+def _enoent(e: Exception) -> bool:
+    return isinstance(e, OSError) and e.errno == _errno.ENOENT
 
 
 class FileLayout:
@@ -65,11 +70,14 @@ class StripedObject:
     def __init__(self, ioctx, soid: str, layout: FileLayout | None = None):
         self.ioctx = ioctx
         self.soid = soid
+        self._size_cache: int | None = None
         existing = self._read_layout()
         if existing is not None:
             self.layout = existing
+            self._meta_written = True
         else:
             self.layout = layout or FileLayout()
+            self._meta_written = False
 
     def _obj_name(self, obj_no: int) -> str:
         return "%s.%016x" % (self.soid, obj_no)
@@ -78,8 +86,10 @@ class StripedObject:
         try:
             blob = self.ioctx.get_xattr(self._obj_name(0),
                                         self.LAYOUT_XATTR)
-        except Exception:
-            return None
+        except OSError as e:
+            if _enoent(e):
+                return None
+            raise
         if not blob:
             return None
         su, sc, os_ = struct.unpack("<QQQ", blob)
@@ -93,15 +103,22 @@ class StripedObject:
             self.layout.object_size))
         self.ioctx.set_xattr(first, self.SIZE_XATTR,
                              struct.pack("<Q", size))
+        self._meta_written = True
+        self._size_cache = size
 
     # -- API (libradosstriper surface) ---------------------------------
 
     def size(self) -> int:
+        if self._size_cache is not None:
+            return self._size_cache
         try:
             blob = self.ioctx.get_xattr(self._obj_name(0), self.SIZE_XATTR)
-        except Exception:
-            return 0
-        return struct.unpack("<Q", blob)[0] if blob else 0
+        except OSError as e:
+            if not _enoent(e):
+                raise
+            blob = b""
+        self._size_cache = struct.unpack("<Q", blob)[0] if blob else 0
+        return self._size_cache
 
     def write(self, data: bytes, offset: int = 0) -> None:
         for obj_no, obj_off, n, foff in self.layout.map_extent(
@@ -109,10 +126,8 @@ class StripedObject:
             piece = data[foff - offset:foff - offset + n]
             self.ioctx.write(self._obj_name(obj_no), piece, obj_off)
         new_end = offset + len(data)
-        if new_end > self.size():
-            self._write_meta(new_end)
-        elif self._read_layout() is None:
-            self._write_meta(self.size())
+        if new_end > self.size() or not self._meta_written:
+            self._write_meta(max(new_end, self.size()))
 
     def append(self, data: bytes) -> None:
         self.write(data, self.size())
@@ -128,8 +143,10 @@ class StripedObject:
                 offset, length):
             try:
                 piece = self.ioctx.read(self._obj_name(obj_no), n, obj_off)
-            except Exception:
-                piece = b""  # sparse/missing backing object reads as holes
+            except OSError as e:
+                if not _enoent(e):
+                    raise  # timeouts/EIO must not read as holes
+                piece = b""  # missing backing object = sparse hole
             out[foff - offset:foff - offset + len(piece)] = piece
         return bytes(out)
 
@@ -151,8 +168,9 @@ class StripedObject:
                     else:
                         self.ioctx.write(self._obj_name(obj_no),
                                          b"\0" * n, obj_off)
-                except Exception:
-                    pass
+                except OSError as e:
+                    if not _enoent(e):
+                        raise
         self._write_meta(size)
 
     def remove(self) -> None:
@@ -164,8 +182,11 @@ class StripedObject:
         for name in sorted(names):
             try:
                 self.ioctx.remove(name)
-            except Exception:
-                pass
+            except OSError as e:
+                if not _enoent(e):
+                    raise
+        self._size_cache = 0
+        self._meta_written = False
 
     def stat(self) -> dict:
         return {"size": self.size(),

@@ -1,0 +1,15 @@
+"""Manager daemon: metrics aggregation + Python module host.
+
+Role of the reference's ceph-mgr (/root/reference/src/mgr/ — embeds
+CPython to host modules under src/pybind/mgr/): daemons stream perf
+reports to the mgr, which aggregates them as DaemonState and exposes
+cluster state to pluggable Python modules (prometheus exporter,
+status/dashboard, restful). Here modules subclass MgrModule
+(mirroring src/pybind/mgr/mgr_module.py:33) and the bundled modules
+are `prometheus` (text exposition format) and `status`.
+"""
+
+from .daemon_state import DaemonStateIndex  # noqa: F401
+from .mgr_daemon import MgrDaemon  # noqa: F401
+from .mgr_module import MgrModule  # noqa: F401
+from .modules import PrometheusModule, StatusModule  # noqa: F401

@@ -1,0 +1,78 @@
+"""Aggregated per-daemon state.
+
+Rendition of the reference's DaemonState/DaemonStateIndex
+(/root/reference/src/mgr/DaemonState.h): the mgr's view of every
+reporting daemon — metadata plus the latest perf-counter dump, with
+staleness tracking so a dead daemon's metrics age out of reports.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["DaemonStateIndex"]
+
+
+class _DaemonState:
+    __slots__ = ("name", "metadata", "perf", "last_report")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.metadata: dict = {}
+        self.perf: dict = {}
+        self.last_report = 0.0
+
+
+class DaemonStateIndex:
+    def __init__(self, stale_after: float = 10.0):
+        self.stale_after = stale_after
+        self._lock = threading.Lock()
+        self._daemons: dict[str, _DaemonState] = {}
+
+    def report(self, name: str, perf: dict,
+               metadata: dict | None = None) -> None:
+        with self._lock:
+            d = self._daemons.get(name)
+            if d is None:
+                d = self._daemons[name] = _DaemonState(name)
+            d.perf = dict(perf)
+            if metadata:
+                d.metadata.update(metadata)
+            d.last_report = time.monotonic()
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._daemons.pop(name, None)
+
+    def names(self, include_stale: bool = True) -> list[str]:
+        now = time.monotonic()
+        with self._lock:
+            return sorted(
+                n for n, d in self._daemons.items()
+                if include_stale
+                or now - d.last_report <= self.stale_after)
+
+    def get_perf(self, name: str) -> dict:
+        with self._lock:
+            d = self._daemons.get(name)
+            return dict(d.perf) if d else {}
+
+    def get_metadata(self, name: str) -> dict:
+        with self._lock:
+            d = self._daemons.get(name)
+            return dict(d.metadata) if d else {}
+
+    def is_stale(self, name: str) -> bool:
+        with self._lock:
+            d = self._daemons.get(name)
+            if d is None:
+                return True
+            return time.monotonic() - d.last_report > self.stale_after
+
+    def all_perf(self, include_stale: bool = False) -> dict[str, dict]:
+        now = time.monotonic()
+        with self._lock:
+            return {n: dict(d.perf) for n, d in self._daemons.items()
+                    if include_stale
+                    or now - d.last_report <= self.stale_after}

@@ -1,0 +1,120 @@
+"""The mgr daemon: report sink + module host.
+
+Rendition of ceph-mgr's core loop (/root/reference/src/mgr/Mgr.cc,
+DaemonServer.cc): daemons send MMgrReport messages carrying their
+perf-counter dumps; the mgr folds them into DaemonStateIndex, keeps the
+latest osdmap via its MonClient subscription, hosts MgrModule
+instances, fans out notify() on map changes, and routes module
+commands ("mgr module command") by COMMANDS prefix.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..common.context import Context
+from ..mon.mon_client import MonClient
+from ..msg.messenger import Dispatcher, Messenger
+
+__all__ = ["MgrDaemon"]
+
+
+class MgrDaemon(Dispatcher):
+    def __init__(self, monmap: dict, ctx: Context | None = None):
+        self.ctx = ctx or Context(name="mgr")
+        self.msgr = Messenger(("mgr", 0), conf=self.ctx.conf)
+        self.monmap = dict(monmap)
+        self.mon_client: MonClient | None = None
+        from .daemon_state import DaemonStateIndex
+        self.daemon_state = DaemonStateIndex()
+        self.modules: dict[str, object] = {}
+        self.health: dict[str, dict] = {}     # module -> checks
+        self._lock = threading.Lock()
+        self.osdmap = None
+        self._running = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def init(self) -> None:
+        self.msgr.bind()
+        self.msgr.add_dispatcher_head(self)
+        self.msgr.start()
+        self.mon_client = MonClient(self.monmap, self.msgr, "mgr")
+        self.mon_client.map_callbacks.append(self._on_osdmap)
+        self.mon_client.sub_want()
+        self._running = True
+
+    def shutdown(self) -> None:
+        self._running = False
+        for mod in self.modules.values():
+            try:
+                mod.shutdown()
+            except Exception:
+                pass
+        self.msgr.shutdown()
+        self.ctx.shutdown()
+
+    @property
+    def addr(self):
+        return self.msgr.my_addr
+
+    # -- modules -------------------------------------------------------
+
+    def register_module(self, module_cls) -> object:
+        mod = module_cls(self)
+        self.modules[mod.name] = mod
+        return mod
+
+    def set_module_health(self, module: str, checks: dict) -> None:
+        with self._lock:
+            if checks:
+                self.health[module] = dict(checks)
+            else:
+                self.health.pop(module, None)
+
+    def _notify_all(self, notify_type: str, notify_id=None) -> None:
+        for mod in list(self.modules.values()):
+            try:
+                mod.notify(notify_type, notify_id)
+            except Exception:
+                pass
+
+    def module_command(self, cmd: dict):
+        """Route a command to the module claiming its prefix."""
+        prefix = cmd.get("prefix", "")
+        for mod in self.modules.values():
+            for spec in mod.COMMANDS:
+                if prefix == spec["cmd"] or \
+                        prefix.startswith(spec["cmd"] + " "):
+                    return mod.handle_command(cmd)
+        return -22, "", "no mgr module handles %r" % prefix
+
+    # -- state for modules ---------------------------------------------
+
+    def get_state(self, data_name: str):
+        if data_name == "osd_map":
+            return self.osdmap
+        if data_name == "daemons":
+            return self.daemon_state.names()
+        if data_name == "perf_counters":
+            return self.daemon_state.all_perf()
+        if data_name == "health":
+            with self._lock:
+                return {k: dict(v) for m in self.health.values()
+                        for k, v in m.items()}
+        raise KeyError(data_name)
+
+    # -- dispatch ------------------------------------------------------
+
+    def ms_dispatch(self, msg) -> bool:
+        if msg.get_type() == "MMgrReport":
+            self.daemon_state.report(msg.daemon_name, msg.perf,
+                                     msg.metadata)
+            self._notify_all("perf_schema", msg.daemon_name)
+            return True
+        return False
+
+    def _on_osdmap(self, newmap) -> None:
+        self.osdmap = newmap
+        self._notify_all("osd_map",
+                         newmap.epoch if newmap is not None else None)

@@ -1,0 +1,55 @@
+"""The module API every mgr module implements.
+
+Mirrors the reference's MgrModule contract
+(/root/reference/src/pybind/mgr/mgr_module.py:33): modules read cluster
+state through self.get(<data name>), receive change notifications via
+notify(), expose CLI commands through COMMANDS/handle_command, and
+raise/clear health checks with set_health_checks.
+"""
+
+from __future__ import annotations
+
+__all__ = ["MgrModule"]
+
+
+class MgrModule:
+    COMMANDS: list[dict] = []   # [{"cmd": prefix, "desc": ...}]
+
+    def __init__(self, mgr):
+        self.mgr = mgr
+        self.name = type(self).__name__
+
+    # -- cluster state access (MgrModule.get) ---------------------------
+
+    def get(self, data_name: str):
+        """Named cluster state: 'osd_map', 'daemons', 'perf_counters',
+        'health'."""
+        return self.mgr.get_state(data_name)
+
+    def get_perf_counters(self, daemon: str) -> dict:
+        return self.mgr.daemon_state.get_perf(daemon)
+
+    def get_metadata(self, daemon: str) -> dict:
+        return self.mgr.daemon_state.get_metadata(daemon)
+
+    # -- health ---------------------------------------------------------
+
+    def set_health_checks(self, checks: dict) -> None:
+        """{check name: {"severity": "warning"|"error",
+        "summary": str, "detail": [str]}}"""
+        self.mgr.set_module_health(self.name, checks)
+
+    # -- hooks -----------------------------------------------------------
+
+    def notify(self, notify_type: str, notify_id) -> None:
+        """Called on cluster events ('osd_map', 'perf_schema')."""
+
+    def handle_command(self, cmd: dict):
+        """-> (retcode, stdout, stderr)"""
+        return -22, "", "module %s has no commands" % self.name
+
+    def serve(self) -> None:
+        """Long-running modules override (dashboard/exporter loops)."""
+
+    def shutdown(self) -> None:
+        pass

@@ -1,0 +1,180 @@
+"""Bundled mgr modules: prometheus exporter + status.
+
+Counterparts of the reference's src/pybind/mgr/prometheus (text
+exposition of cluster + per-daemon perf metrics, optionally over HTTP)
+and src/pybind/mgr/status (operator-facing summaries).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+
+from .mgr_module import MgrModule
+
+__all__ = ["PrometheusModule", "StatusModule"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(*parts: str) -> str:
+    return _NAME_RE.sub("_", "_".join(p for p in parts if p)).lower()
+
+
+class PrometheusModule(MgrModule):
+    """Text exposition format renderer (+ optional stdlib HTTP server)."""
+
+    COMMANDS = [{"cmd": "prometheus metrics",
+                 "desc": "render the exposition text"}]
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self.name = "prometheus"
+        self._httpd = None
+        self._thread = None
+
+    # -- rendering -----------------------------------------------------
+
+    def render(self) -> str:
+        out: list[str] = []
+
+        def emit(name: str, value, labels: dict | None = None,
+                 mtype: str = "gauge", help_: str = ""):
+            if help_:
+                out.append("# HELP %s %s" % (name, help_))
+                out.append("# TYPE %s %s" % (name, mtype))
+            lbl = ""
+            if labels:
+                lbl = "{%s}" % ",".join(
+                    '%s="%s"' % (k, v) for k, v in sorted(labels.items()))
+            out.append("%s%s %s" % (name, lbl, float(value)))
+
+        osdmap = self.get("osd_map")
+        if osdmap is not None:
+            emit("ceph_osdmap_epoch", osdmap.epoch,
+                 help_="current osdmap epoch")
+            ups = ins = 0
+            for osd in range(osdmap.max_osd):
+                if not osdmap.exists(osd):
+                    continue
+                up = int(osdmap.is_up(osd))
+                inn = int(osdmap.is_in(osd))
+                ups += up
+                ins += inn
+                emit("ceph_osd_up", up, {"ceph_daemon": "osd.%d" % osd})
+                emit("ceph_osd_in", inn, {"ceph_daemon": "osd.%d" % osd})
+                emit("ceph_osd_weight",
+                     osdmap.osd_weight[osd] / 0x10000,
+                     {"ceph_daemon": "osd.%d" % osd})
+            emit("ceph_num_osd_up", ups)
+            emit("ceph_num_osd_in", ins)
+            for pool in osdmap.pools.values():
+                emit("ceph_pool_pg_num", pool.pg_num,
+                     {"pool_id": pool.pool_id, "name": pool.name})
+        health = self.get("health")
+        emit("ceph_health_detail", len(health),
+             help_="number of active health checks")
+        # per-daemon perf counters (reference: perf_counters as
+        # ceph_<daemon-type>_<counter>{ceph_daemon=...})
+        for daemon, perf in sorted(self.get("perf_counters").items()):
+            dtype = daemon.split(".", 1)[0]
+            for group, counters in perf.items():
+                for cname, val in counters.items():
+                    if isinstance(val, dict):
+                        # avg/time counters: export sum+count
+                        for sub in ("sum", "avgcount"):
+                            if sub in val:
+                                emit(_metric_name(
+                                    "ceph", dtype, group, cname, sub),
+                                    val[sub], {"ceph_daemon": daemon},
+                                    mtype="counter")
+                    elif isinstance(val, (int, float)):
+                        emit(_metric_name("ceph", dtype, group, cname),
+                             val, {"ceph_daemon": daemon})
+        return "\n".join(out) + "\n"
+
+    def handle_command(self, cmd):
+        if cmd.get("prefix") == "prometheus metrics":
+            return 0, self.render(), ""
+        return super().handle_command(cmd)
+
+    # -- optional HTTP endpoint ----------------------------------------
+
+    def serve_http(self, host: str = "127.0.0.1", port: int = 0) -> tuple:
+        import http.server
+
+        module = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path not in ("/", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = module.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                      Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._httpd.server_address
+
+    def shutdown(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+class StatusModule(MgrModule):
+    """Operator summaries ('osd status', 'fs status' in the reference)."""
+
+    COMMANDS = [{"cmd": "osd status", "desc": "osd table"},
+                {"cmd": "status", "desc": "cluster summary"}]
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self.name = "status"
+
+    def handle_command(self, cmd):
+        prefix = cmd.get("prefix")
+        osdmap = self.get("osd_map")
+        if osdmap is None:
+            return -11, "", "no osdmap yet"
+        if prefix == "osd status":
+            lines = ["id\tup\tin\tweight\treporting"]
+            daemons = set(self.get("daemons"))
+            for osd in range(osdmap.max_osd):
+                if not osdmap.exists(osd):
+                    continue
+                lines.append("%d\t%s\t%s\t%.3f\t%s" % (
+                    osd,
+                    "up" if osdmap.is_up(osd) else "down",
+                    "in" if osdmap.is_in(osd) else "out",
+                    osdmap.osd_weight[osd] / 0x10000,
+                    "yes" if "osd.%d" % osd in daemons else "no"))
+            return 0, "\n".join(lines), ""
+        if prefix == "status":
+            ups = sum(1 for o in range(osdmap.max_osd) if osdmap.is_up(o))
+            health = self.get("health")
+            state = "HEALTH_OK" if not health else "HEALTH_WARN"
+            return 0, (
+                "  health: %s\n  osdmap e%d: %d osds: %d up, %d in\n"
+                "  pools: %d"
+                % (state, osdmap.epoch, sum(
+                    1 for o in range(osdmap.max_osd) if osdmap.exists(o)),
+                   ups,
+                   sum(1 for o in range(osdmap.max_osd)
+                       if osdmap.is_in(o)),
+                   len(osdmap.pools))), ""
+        return super().handle_command(cmd)

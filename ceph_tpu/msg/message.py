@@ -26,7 +26,7 @@ __all__ = [
     "MOSDPGPull", "MOSDPGScan", "MOSDMap", "MOSDBoot", "MOSDFailure",
     "MOSDAlive",
     "MMonCommand", "MMonCommandReply", "MMonSubscribe", "MMonPaxos",
-    "MMonElection", "MAuth", "MAuthReply",
+    "MMonElection", "MAuth", "MAuthReply", "MMgrReport",
 ]
 
 _seq = itertools.count(1)
@@ -246,6 +246,16 @@ class MMonSubscribe(Message):
     what: str = "osdmap"
     start_epoch: int = 0
     reply_to: object = None
+
+
+# -- mgr ---------------------------------------------------------------
+
+@dataclass
+class MMgrReport(Message):
+    """Daemon -> mgr perf-counter report (src/messages/MMgrReport.h)."""
+    daemon_name: str = ""
+    perf: dict = field(default_factory=dict)
+    metadata: dict = field(default_factory=dict)
 
 
 # -- auth (cephx handshake, MAuth/MAuthReply) ---------------------------

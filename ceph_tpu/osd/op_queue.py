@@ -204,6 +204,17 @@ class MClockOpClassQueue(OpQueue):
         # signal (raw bytes would advance a 1MB client op's tag by
         # minutes and invert the configured client:recovery ratio)
         scale = max(cost, self.min_cost) / self.min_cost
+        if not c.q:
+            # re-activation after a drain: clamp accumulated debt down
+            # to `now` so a burst's leftover tags don't exile the class
+            # for minutes — the next tag still advances by scale/rate
+            # from now, so a trickler is paced at its configured share
+            # rather than evading it (dequeue-side tag resets would
+            # allow exactly that evasion)
+            for attr in ("r_tag", "p_tag", "l_tag"):
+                prev = getattr(c, attr)
+                if prev is not None and prev > now:
+                    setattr(c, attr, now)
         if c.reservation > 0:
             r = self._next_tag(c.r_tag, c.reservation, scale, now)
             c.r_tag = r
@@ -241,15 +252,7 @@ class MClockOpClassQueue(OpQueue):
                     if best is None or c.q[0][1] < best[0]:
                         best = (c.q[0][1], c)
         if best is not None:
-            c = best[1]
-            _, _, _, item = c.q.popleft()
-            if not c.q:
-                # drained class: forget rate/weight debt so a later
-                # reactivation tags at `now` (dmclock idle rule); the
-                # limit tag keeps its debt — draining must not be a
-                # way around a configured ceiling
-                c.r_tag = None
-                c.p_tag = None
+            _, _, _, item = best[1].q.popleft()
             self._size -= 1
             return item
         return None
@@ -393,4 +396,7 @@ class _QosShard:
             self._stopping = True
             self._cond.notify_all()
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            # unbounded: stop() guarantees the drain completed — a
+            # timed join would let shutdown race the very replies the
+            # drain protects
+            self._thread.join()

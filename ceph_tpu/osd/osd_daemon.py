@@ -71,6 +71,15 @@ class OSDDaemon(Dispatcher):
         self.timer = SafeTimer("osd%d-timer" % whoami)
         self.hb_peers: dict = {}       # osd -> last reply stamp
         self.hb_pending: dict = {}     # osd -> first unacked ping stamp
+        self.mgr_addr = None           # set when an mgr joins the cluster
+        # l_osd_* counters (OSD.cc's PerfCounters), streamed to the mgr
+        from ..common.perf_counters import PerfCountersBuilder
+        self.perf = (PerfCountersBuilder("osd")
+                     .add_u64_counter("op", "client operations")
+                     .add_u64_counter("op_in_bytes", "client bytes written")
+                     .add_time_avg("op_latency", "client op latency")
+                     .create_perf_counters())
+        self.ctx.perf.add(self.perf)
         self._running = False
         self.stopped_pgs = False
 
@@ -204,6 +213,16 @@ class OSDDaemon(Dispatcher):
                                 epoch=self.map_epoch()),
                     self.monmap[min(self.monmap)])
                 self.hb_pending[osd] = now  # don't spam
+        # mgr perf report rides the heartbeat cadence (DaemonServer's
+        # MMgrReport stream); mgr_addr is installed by the harness or
+        # operator once an mgr exists
+        if self.mgr_addr is not None:
+            from ..msg.message import MMgrReport
+            self.public_msgr.send_message(
+                MMgrReport(daemon_name="osd.%d" % self.whoami,
+                           perf=self.ctx.perf.perf_dump(),
+                           metadata={"id": self.whoami}),
+                self.mgr_addr)
         self.timer.add_event_after(
             conf.get_val("osd_heartbeat_interval"), self._hb_tick)
 
@@ -245,10 +264,15 @@ class OSDDaemon(Dispatcher):
 
         replied = [False]
 
+        self.perf.inc("op")
+        self.perf.inc("op_in_bytes",
+                      len(getattr(msg, "data", b"") or b""))
+
         def reply(result, data):
             if replied[0]:
                 return
             replied[0] = True
+            self.perf.tinc("op_latency", op.duration)
             op.mark_commit_sent()
             self.public_msgr.send_message(
                 MOSDOpReply(tid=msg.tid, result=result, data=data,
@@ -312,9 +336,9 @@ class OSDDaemon(Dispatcher):
             elif t == "MOSDPGPush":
                 pg.handle_push(msg)
 
-        # recovery data movement (push/pull/scan) must ride the recovery
+        # recovery data movement (push/scan) must ride the recovery
         # class or QoS settings have no effect on actual backfill traffic
-        if t in ("MOSDPGPush", "MOSDPGPull", "MOSDPGScan"):
+        if t in ("MOSDPGPush", "MOSDPGScan"):
             self.op_wq.queue(msg.pgid, run, klass="recovery",
                              priority=self.recovery_op_priority)
         else:

@@ -210,6 +210,20 @@ class TestFactoryAndShards:
         wq.stop()   # must not wait ~3s for limit slots
         assert done == list(range(6))
 
+    def test_mclock_reactivated_class_cannot_evade_weight(self):
+        """A class that drains between single ops (a trickler) must not
+        jump ahead of a heavier class: debt clamps to now, but the next
+        tag still advances by 1/weight."""
+        q = MClockOpClassQueue({"client": (0.0, 500.0, 0.0),
+                                "recovery": (0.0, 1.0, 0.0)})
+        q.enqueue("recovery", 0, 0, "r0")
+        assert q.dequeue(time.monotonic() + 5) == "r0"   # drain
+        q.enqueue("recovery", 0, 0, "r1")   # reactivation
+        q.enqueue("client", 0, 0, "c0")
+        # both eligible: the client's weight tag is nearer to now
+        assert q.dequeue(time.monotonic() + 5) == "c0"
+        assert q.dequeue(time.monotonic() + 5) == "r1"
+
     def test_mclock_idle_class_reactivates_fresh(self):
         q = MClockOpClassQueue({"recovery": (0.0, 1.0, 0.0),
                                 "client": (0.0, 500.0, 0.0)})
