@@ -198,6 +198,11 @@ class IoCtx:
     def omap_rm_keys(self, oid: str, keys) -> None:
         self._op(oid, [("omap_rm", list(keys))])
 
+    def exec(self, oid: str, cls: str, method: str,
+             data: bytes = b"") -> bytes:
+        """Invoke an in-OSD object-class method (rados_exec)."""
+        return self._op(oid, [("call", cls, method, bytes(data))])
+
     # -- reads ---------------------------------------------------------
 
     def read(self, oid: str, length: int = 0, offset: int = 0) -> bytes:

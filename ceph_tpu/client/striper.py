@@ -70,7 +70,6 @@ class StripedObject:
     def __init__(self, ioctx, soid: str, layout: FileLayout | None = None):
         self.ioctx = ioctx
         self.soid = soid
-        self._size_cache: int | None = None
         existing = self._read_layout()
         if existing is not None:
             self.layout = existing
@@ -104,21 +103,19 @@ class StripedObject:
         self.ioctx.set_xattr(first, self.SIZE_XATTR,
                              struct.pack("<Q", size))
         self._meta_written = True
-        self._size_cache = size
 
     # -- API (libradosstriper surface) ---------------------------------
 
     def size(self) -> int:
-        if self._size_cache is not None:
-            return self._size_cache
+        # always read fresh: another handle/client may have extended
+        # the file (the immutable layout IS cached; the size is not)
         try:
             blob = self.ioctx.get_xattr(self._obj_name(0), self.SIZE_XATTR)
         except OSError as e:
             if not _enoent(e):
                 raise
             blob = b""
-        self._size_cache = struct.unpack("<Q", blob)[0] if blob else 0
-        return self._size_cache
+        return struct.unpack("<Q", blob)[0] if blob else 0
 
     def write(self, data: bytes, offset: int = 0) -> None:
         for obj_no, obj_off, n, foff in self.layout.map_extent(
@@ -126,8 +123,9 @@ class StripedObject:
             piece = data[foff - offset:foff - offset + n]
             self.ioctx.write(self._obj_name(obj_no), piece, obj_off)
         new_end = offset + len(data)
-        if new_end > self.size() or not self._meta_written:
-            self._write_meta(max(new_end, self.size()))
+        cur = self.size()
+        if new_end > cur or not self._meta_written:
+            self._write_meta(max(new_end, cur))
 
     def append(self, data: bytes) -> None:
         self.write(data, self.size())
@@ -185,7 +183,6 @@ class StripedObject:
             except OSError as e:
                 if not _enoent(e):
                     raise
-        self._size_cache = 0
         self._meta_written = False
 
     def stat(self) -> dict:

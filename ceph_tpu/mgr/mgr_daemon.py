@@ -95,13 +95,28 @@ class MgrDaemon(Dispatcher):
         if data_name == "osd_map":
             return self.osdmap
         if data_name == "daemons":
-            return self.daemon_state.names()
+            # module-visible view excludes daemons that stopped
+            # reporting (same contract as all_perf)
+            return self.daemon_state.names(include_stale=False)
         if data_name == "perf_counters":
             return self.daemon_state.all_perf()
         if data_name == "health":
             with self._lock:
-                return {k: dict(v) for m in self.health.values()
-                        for k, v in m.items()}
+                merged: dict = {}
+                for checks in self.health.values():
+                    for name, check in checks.items():
+                        prev = merged.get(name)
+                        if prev is None:
+                            merged[name] = dict(check)
+                        else:
+                            # same check from two modules: error beats
+                            # warning, details concatenate
+                            if check.get("severity") == "error":
+                                prev["severity"] = "error"
+                            prev.setdefault("detail", [])
+                            prev["detail"] = list(prev["detail"]) + \
+                                list(check.get("detail", []))
+                return merged
         raise KeyError(data_name)
 
     # -- dispatch ------------------------------------------------------

@@ -153,6 +153,9 @@ class PG:
         if not self.is_primary():
             reply_fn(-11, None)  # EAGAIN: wrong primary / not peered
             return
+        if any(op[0] == "call" for op in msg.ops):
+            self._do_call_op(msg, reply_fn)
+            return
         reads = [op for op in msg.ops if op[0] in
                  ("read", "stat", "getxattr", "omap_get", "list")]
         if reads and len(reads) == len(msg.ops):
@@ -165,6 +168,52 @@ class PG:
                     lambda: self.do_op(msg, reply_fn))
             return
         self._do_write_ops(msg, reply_fn)
+
+    def _do_call_op(self, msg, reply_fn) -> None:
+        """Object-class exec (PrimaryLogPG do_osd_ops CEPH_OSD_OP_CALL).
+
+        Classes need synchronous local reads, which EC pools cannot
+        serve (objects_read_sync -EOPNOTSUPP, ecbackend.rst:79-83) —
+        so, like the reference, cls is refused on erasure pools.
+        """
+        from .objclass import CLS_METHOD_WR, ClassHandler, MethodContext
+        if self.pool.is_erasure():
+            reply_fn(-95, None)  # EOPNOTSUPP
+            return
+        if len(msg.ops) != 1:
+            # mixing exec with other ops in one message would silently
+            # drop the rest; reject the vector outright
+            reply_fn(-22, None)  # EINVAL
+            return
+        _, cls_name, method_name, indata = msg.ops[0]
+        method = ClassHandler.instance().get_method(cls_name, method_name)
+        if method is None:
+            reply_fn(-95, None)  # unknown class/method (reference: same)
+            return
+        if method.flags & CLS_METHOD_WR and not self.active_for_write():
+            with self.lock:
+                self.waiting_for_active.append(
+                    lambda: self.do_op(msg, reply_fn))
+            return
+        hctx = MethodContext(self, msg.oid)
+        try:
+            ret, out = method.fn(hctx, indata)
+        except Exception:
+            reply_fn(-5, None)
+            return
+        if ret != 0 or not hctx.wrote:
+            reply_fn(ret, out)
+            return
+        if not method.flags & CLS_METHOD_WR:
+            reply_fn(-1, None)  # EPERM: RD-only method tried to write
+            return
+        with self.lock:
+            self.last_version += 1
+            version = self.last_version
+        if not hctx.removed:  # a version xattr would resurrect the object
+            hctx.txn.setattr(msg.oid, VERSION_ATTR, str(version).encode())
+        self.backend.submit_transaction(
+            hctx.txn, version, lambda: reply_fn(ret, out))
 
     def _do_read_ops(self, msg, reply_fn) -> None:
         if not self.active_for_read():

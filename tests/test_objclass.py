@@ -1,0 +1,145 @@
+"""Object-class (cls) tests.
+
+Models the reference's cls coverage (src/test/cls_hello,
+src/test/cls_lock, src/test/cls_refcount): method dispatch via the
+exec op against a live cluster, RD/WR flag enforcement, built-in class
+semantics, and the EC-pool EOPNOTSUPP rule
+(ecbackend.rst:79-83).
+"""
+
+import pickle
+
+import pytest
+
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.osd.objclass import (CLS_METHOD_RD, CLS_METHOD_WR,
+                                   ClassHandler)
+
+from .cluster_util import MiniCluster
+
+FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+        "mon_osd_down_out_interval": 1.0, "paxos_propose_interval": 0.02}
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    cluster = MiniCluster(num_mons=1, num_osds=3,
+                          conf_overrides=FAST).start()
+    client = cluster.client()
+    cluster.create_replicated_pool(client, "clspool", size=2, pg_num=4)
+    ioctx = client.open_ioctx("clspool")
+    yield cluster, client, ioctx
+    cluster.stop()
+
+
+class TestRegistry:
+    def test_register_and_lookup(self):
+        h = ClassHandler()
+        c = h.register_class("custom")
+        c.register_method("m", CLS_METHOD_RD, lambda hctx, d: (0, d))
+        assert h.get_method("custom", "m").flags == CLS_METHOD_RD
+        assert h.get_method("custom", "nope") is None
+        assert h.get_method("nope", "m") is None
+        with pytest.raises(ValueError):
+            c.register_method("m", CLS_METHOD_RD, lambda hctx, d: (0, d))
+
+    def test_builtins_present(self):
+        h = ClassHandler.instance()
+        for cls_name, method in (("hello", "say_hello"),
+                                 ("lock", "lock"),
+                                 ("refcount", "get")):
+            assert h.get_method(cls_name, method) is not None
+
+
+class TestHello:
+    def test_say_hello(self, ctx):
+        _, _, ioctx = ctx
+        assert ioctx.exec("greet", "hello", "say_hello") == b"Hello, world!"
+        assert ioctx.exec("greet", "hello", "say_hello",
+                          b"ceph") == b"Hello, ceph!"
+
+    def test_record_hello_writes_and_eexist(self, ctx):
+        _, _, ioctx = ctx
+        ioctx.exec("note", "hello", "record_hello", b"first")
+        assert ioctx.get_xattr("note", "hello.greeted") == b"first"
+        with pytest.raises(RadosError) as ei:
+            ioctx.exec("note", "hello", "record_hello", b"second")
+        assert ei.value.errno == 17  # EEXIST
+
+    def test_unknown_class_or_method(self, ctx):
+        _, _, ioctx = ctx
+        for cls_name, method in (("nope", "x"), ("hello", "nope")):
+            with pytest.raises(RadosError) as ei:
+                ioctx.exec("greet", cls_name, method)
+            assert ei.value.errno == 95  # EOPNOTSUPP
+
+
+class TestLock:
+    def test_exclusive_lock_cycle(self, ctx):
+        _, _, ioctx = ctx
+        req = {"name": "l1", "cookie": "c1", "type": "exclusive"}
+        ioctx.exec("locked", "lock", "lock", pickle.dumps(req))
+        # a second locker is refused
+        with pytest.raises(RadosError) as ei:
+            ioctx.exec("locked", "lock", "lock", pickle.dumps(
+                {"name": "l1", "cookie": "c2", "type": "exclusive"}))
+        assert ei.value.errno == 16  # EBUSY
+        info = pickle.loads(ioctx.exec(
+            "locked", "lock", "get_info", pickle.dumps({"name": "l1"})))
+        assert list(info["lockers"]) == ["c1"]
+        ioctx.exec("locked", "lock", "unlock",
+                   pickle.dumps({"name": "l1", "cookie": "c1"}))
+        # now c2 can take it
+        ioctx.exec("locked", "lock", "lock", pickle.dumps(
+            {"name": "l1", "cookie": "c2", "type": "exclusive"}))
+
+    def test_shared_lock(self, ctx):
+        _, _, ioctx = ctx
+        for cookie in ("s1", "s2"):
+            ioctx.exec("shared", "lock", "lock", pickle.dumps(
+                {"name": "l", "cookie": cookie, "type": "shared"}))
+        info = pickle.loads(ioctx.exec(
+            "shared", "lock", "get_info", pickle.dumps({"name": "l"})))
+        assert sorted(info["lockers"]) == ["s1", "s2"]
+        # exclusive is refused while shared lockers hold it
+        with pytest.raises(RadosError):
+            ioctx.exec("shared", "lock", "lock", pickle.dumps(
+                {"name": "l", "cookie": "x", "type": "exclusive"}))
+
+    def test_unlock_wrong_cookie_enoent(self, ctx):
+        _, _, ioctx = ctx
+        with pytest.raises(RadosError) as ei:
+            ioctx.exec("locked", "lock", "unlock",
+                       pickle.dumps({"name": "l1", "cookie": "ghost"}))
+        assert ei.value.errno == 2
+
+
+class TestRefcount:
+    def test_get_put_and_final_removal(self, ctx):
+        _, _, ioctx = ctx
+        ioctx.write_full("counted", b"payload")
+        ioctx.exec("counted", "refcount", "get", b"tagA")
+        ioctx.exec("counted", "refcount", "get", b"tagB")
+        refs = pickle.loads(ioctx.exec("counted", "refcount", "read"))
+        assert refs == ["tagA", "tagB"]
+        ioctx.exec("counted", "refcount", "put", b"tagA")
+        assert ioctx.read("counted") == b"payload"   # still referenced
+        ioctx.exec("counted", "refcount", "put", b"tagB")
+        # last ref dropped -> the object is gone
+        with pytest.raises(RadosError) as ei:
+            ioctx.stat("counted")
+        assert ei.value.errno == 2
+
+
+class TestECPoolRefusal:
+    def test_exec_on_ec_pool_eopnotsupp(self, ctx):
+        cluster, client, _ = ctx
+        cluster.create_ec_pool(client, "clsec",
+                               {"plugin": "jerasure",
+                                "technique": "reed_sol_van",
+                                "k": "2", "m": "1"}, pg_num=4)
+        ec_io = client.open_ioctx("clsec")
+        ec_io.write_full("obj", b"data")
+        with pytest.raises(RadosError) as ei:
+            ec_io.exec("obj", "hello", "say_hello")
+        assert ei.value.errno == 95  # EOPNOTSUPP (ecbackend.rst:79-83)
