@@ -76,14 +76,25 @@ class MonClient(Dispatcher):
 
     def _send_and_wait(self, msg, timeout: float, what: str):
         """Synchronous request/reply: allocate tid, register a waiter,
-        send to the mon, block for the matching reply."""
+        send to the mon, block for the matching reply. Resends on the
+        same tid every slice so a dropped message or reply (lossy
+        links, msgr fault injection) is retried instead of timing out
+        — MonClient's resend-on-interval behavior."""
+        import time as _time
         tid = next(self._tid)
         msg.tid = tid
         waiter = [threading.Event(), None]
         with self._lock:
             self._waiters[tid] = waiter
-        self.msgr.send_message(msg, self._mon_addr())
-        if not waiter[0].wait(timeout):
+        deadline = _time.monotonic() + timeout
+        while True:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                break  # out of budget: no pointless final send
+            self.msgr.send_message(msg, self._mon_addr())
+            if waiter[0].wait(min(remaining, 1.0)):
+                break
+        if not waiter[0].is_set():
             with self._lock:
                 self._waiters.pop(tid, None)
             raise TimeoutError("%s timed out" % what)

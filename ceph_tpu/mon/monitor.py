@@ -48,6 +48,7 @@ class Monitor(Dispatcher):
         self._lock = threading.RLock()
         self._propose_pending = False
         self._subscribers: dict = {}        # addr -> last epoch sent
+        self._cmd_replies: dict = {}        # (requester, tid) -> reply
         self._tick_token = None
         self._running = False
         # cephx key server (src/auth/cephx/CephxKeyServer): present when
@@ -181,10 +182,23 @@ class Monitor(Dispatcher):
         if t == "MMonCommand":
             if self._forward_if_peon(msg):
                 return True
-            result, outs, data = self.osdmon.handle_command(msg.cmd)
-            self.msgr.send_message(
-                MMonCommandReply(tid=msg.tid, result=result, outs=outs,
-                                 data=data), msg.reply_to or msg.from_addr)
+            dest = msg.reply_to or msg.from_addr
+            key = (tuple(dest) if dest else None, msg.tid)
+            with self._lock:
+                cached = self._cmd_replies.get(key)
+            if cached is None:
+                # commands are not idempotent (pool create, osd in):
+                # dedup retransmits by (requester, tid) and replay the
+                # original reply instead of re-executing
+                result, outs, data = self.osdmon.handle_command(msg.cmd)
+                cached = MMonCommandReply(tid=msg.tid, result=result,
+                                          outs=outs, data=data)
+                with self._lock:
+                    self._cmd_replies[key] = cached
+                    while len(self._cmd_replies) > 1024:
+                        self._cmd_replies.pop(
+                            next(iter(self._cmd_replies)))
+            self.msgr.send_message(cached, dest)
             return True
         if t == "MAuth":
             self._handle_auth(msg)

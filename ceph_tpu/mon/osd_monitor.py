@@ -40,6 +40,7 @@ class OSDMonitor:
                         "technique": "reed_sol_van", "k": "2", "m": "1"}}
         self.failure_reports: dict[int, dict] = {}  # target -> reporter->ts
         self.down_stamps: dict[int, float] = {}
+        self._boot_epoch: dict[int, int] = {}   # osd -> epoch of last boot
         self._lock = threading.RLock()
         self._next_pool_id = 1
 
@@ -80,6 +81,12 @@ class OSDMonitor:
                 "cluster": msg.cluster_addr,
                 "hb": msg.hb_addr,
             }
+            # a boot supersedes any in-flight failure reports against
+            # the previous incarnation; remember the epoch so late
+            # reports for the old addresses can't kill the fresh daemon
+            # (OSDMonitor up_from/boot-epoch accounting)
+            self.failure_reports.pop(msg.osd_id, None)
+            self._boot_epoch[msg.osd_id] = self.osdmap.epoch + 1
             if msg.osd_id >= self.osdmap.max_osd and \
                     (inc.new_max_osd or 0) <= msg.osd_id:
                 inc.new_max_osd = msg.osd_id + 1
@@ -117,6 +124,10 @@ class OSDMonitor:
         conf = self.mon.ctx.conf
         with self._lock:
             if not self.osdmap.is_up(msg.target):
+                return
+            if msg.epoch < self._boot_epoch.get(msg.target, 0):
+                # report predates the target's latest boot: it describes
+                # the dead incarnation, not the live one
                 return
             reports = self.failure_reports.setdefault(msg.target, {})
             reports[msg.reporter] = time.monotonic()

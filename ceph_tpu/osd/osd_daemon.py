@@ -72,6 +72,7 @@ class OSDDaemon(Dispatcher):
         self.hb_peers: dict = {}       # osd -> last reply stamp
         self.hb_pending: dict = {}     # osd -> first unacked ping stamp
         self.mgr_addr = None           # set when an mgr joins the cluster
+        self._boot_sent_epoch = -1     # epoch of the last MOSDBoot sent
         # l_osd_* counters (OSD.cc's PerfCounters), streamed to the mgr
         from ..common.perf_counters import PerfCountersBuilder
         self.perf = (PerfCountersBuilder("osd")
@@ -101,6 +102,7 @@ class OSDDaemon(Dispatcher):
         self._hb_tick()
 
     def _boot(self) -> None:
+        self._boot_sent_epoch = self.map_epoch()
         self.public_msgr.send_message(
             MOSDBoot(osd_id=self.whoami,
                      public_addr=self.public_msgr.my_addr,
@@ -133,6 +135,15 @@ class OSDDaemon(Dispatcher):
     def _on_osdmap(self, newmap) -> None:
         if newmap is None:
             return
+        # the map says we're dead but we're clearly not: re-boot (the
+        # reference OSD does the same when it sees itself marked down —
+        # covers a late failure report racing a quick restart). Only
+        # once per epoch: a boot is already in flight for maps at or
+        # below the epoch we last booted against.
+        if self._running and newmap.exists(self.whoami) \
+                and newmap.is_down(self.whoami) \
+                and newmap.epoch > self._boot_sent_epoch:
+            self._boot()
         with self.lock:
             self.osdmap = newmap
             pgs = list(self.pgs.values())
@@ -247,7 +258,7 @@ class OSDDaemon(Dispatcher):
         if t in ("MOSDECSubOpWrite", "MOSDECSubOpWriteReply",
                  "MOSDECSubOpRead", "MOSDECSubOpReadReply",
                  "MOSDRepOp", "MOSDRepOpReply", "MOSDPGScan",
-                 "MOSDPGPush"):
+                 "MOSDPGPush", "MOSDPGPull"):
             self._enqueue_sub_op(msg)
             return True
         return False
@@ -335,10 +346,12 @@ class OSDDaemon(Dispatcher):
                 pg.handle_scan(msg)
             elif t == "MOSDPGPush":
                 pg.handle_push(msg)
+            elif t == "MOSDPGPull":
+                pg.handle_pull(msg)
 
-        # recovery data movement (push/scan) must ride the recovery
+        # recovery data movement (push/pull/scan) must ride the recovery
         # class or QoS settings have no effect on actual backfill traffic
-        if t in ("MOSDPGPush", "MOSDPGScan"):
+        if t in ("MOSDPGPush", "MOSDPGScan", "MOSDPGPull"):
             self.op_wq.queue(msg.pgid, run, klass="recovery",
                              priority=self.recovery_op_priority)
         else:

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import threading
 
-from ..msg.message import MOSDPGPush, MOSDPGScan
+from ..msg.message import MOSDPGPull, MOSDPGPush, MOSDPGScan
 from ..store.object_store import Transaction
 from .ec_backend import ECBackend
 from .osd_map import CRUSH_ITEM_NONE, POOL_TYPE_ERASURE
@@ -52,6 +52,14 @@ class PG:
         else:
             self.backend = ReplicatedBackend(self)
         self._ensure_collections()
+        # a (re)started OSD must never mint versions below what its own
+        # store has seen, or recovery judges stale peer copies "newer"
+        # and clobbers acked writes
+        for shard in ([-1] if not pool.is_erasure()
+                      else list(range(pool.size)) + [-1]):
+            for v in self._local_inventory(shard).values():
+                if v > self.last_version:
+                    self.last_version = v
 
     # -- identity / listener interface for backends --------------------
 
@@ -392,6 +400,33 @@ class PG:
                    if peer_inv.get(oid, -1) < v]
         for oid in missing:
             self._push_object(oid, shard, peer_osd)
+        if peer_osd == self.whoami:
+            return
+        # The peer may be AHEAD of us: a revived primary that missed
+        # writes must pull them before serving authoritatively, or
+        # acked data reads as lost (the peering GetLog/GetMissing
+        # role, collapsed onto version xattrs). Deletes that happened
+        # while we were down are indistinguishable from new objects
+        # without divergent-log handling — resurrection is the known
+        # limitation here, data loss is not.
+        behind = [oid for oid, v in peer_inv.items()
+                  if want.get(oid, -1) < v]
+        my_shard = self.my_shard() if self.pool.is_erasure() else -1
+        for oid in behind:
+            self.send_to_osd(peer_osd, MOSDPGPull(
+                pgid=self.pgid, from_osd=self.whoami, shard=my_shard,
+                oid=oid, map_epoch=self.map_epoch()))
+        if peer_inv:
+            maxv = max(peer_inv.values())
+            with self.lock:
+                # never mint versions below what the cluster has seen
+                if maxv > self.last_version:
+                    self.last_version = maxv
+
+    def handle_pull(self, msg) -> None:
+        """A (usually freshly revived) primary asks for our newer copy
+        of an object: push it to the requester's shard."""
+        self._push_object(msg.oid, msg.shard, msg.from_osd)
 
     def _push_object(self, oid, shard: int, peer_osd: int) -> None:
         src_cid = self.cid_of_shard(
@@ -426,6 +461,16 @@ class PG:
         """Apply a recovery push to the local shard store."""
         cid = self.cid_of_shard(
             msg.shard if self.pool.is_erasure() else -1)
+        # never let an in-flight push of an older version clobber a
+        # fresher local copy (an acked client write may have landed
+        # while the push was in transit)
+        try:
+            raw = self.store.getattr(cid, msg.oid, VERSION_ATTR)
+            local_v = int(raw) if raw else 0
+        except KeyError:
+            local_v = -1
+        if msg.version and local_v >= msg.version:
+            return
         txn = Transaction()
         txn.remove(cid, msg.oid)
         txn.touch(cid, msg.oid)

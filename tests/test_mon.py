@@ -143,9 +143,11 @@ class TestSingleMon:
                 MOSDBoot(osd_id=2, public_addr=boot_msgr.my_addr),
                 self.monmap[0])
             assert wait_until(lambda: self.mon.osdmon.osdmap.is_up(2))
-            # report failure
+            # report failure at the current epoch (a stale-epoch report
+            # is ignored as describing a previous incarnation)
             boot_msgr.send_message(
-                MOSDFailure(reporter=1, target=2, failed_for=2.0),
+                MOSDFailure(reporter=1, target=2, failed_for=2.0,
+                            epoch=self.mon.osdmon.osdmap.epoch),
                 self.monmap[0])
             assert wait_until(
                 lambda: self.mon.osdmon.osdmap.is_down(2))

@@ -1,0 +1,163 @@
+"""Chaos tests: workload under OSD churn and message-level faults.
+
+Models teuthology's thrash suites
+(qa/suites/rados/thrash-erasure-code/, qa/tasks/ceph_manager.py
+Thrasher) and the msgr-failures fragments ('ms inject socket
+failures') at in-process scale: a writer keeps writing checksummed
+objects while the thrasher kills/revives OSDs; when the dust settles
+every acknowledged object must read back bit-exact.
+"""
+
+import hashlib
+import threading
+import time
+
+import pytest
+
+from .cluster_util import MiniCluster, wait_until
+from .thrasher import Thrasher
+
+FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+        "mon_osd_down_out_interval": 1.0, "paxos_propose_interval": 0.02}
+
+
+def payload_for(i: int) -> bytes:
+    seed = ("obj-%d" % i).encode()
+    return hashlib.sha256(seed).digest() * 200   # 6.4k, content-derived
+
+
+class _Writer(threading.Thread):
+    """Foreground workload: keep writing; remember what was ACKED."""
+
+    def __init__(self, ioctx, stop_evt):
+        super().__init__(name="thrash-writer", daemon=True)
+        self.ioctx = ioctx
+        self.stop_evt = stop_evt
+        self.acked: list[int] = []
+        self.write_errors = 0
+
+    def run(self):
+        i = 0
+        while not self.stop_evt.is_set():
+            try:
+                self.ioctx.write_full("obj-%d" % i, payload_for(i))
+                self.acked.append(i)
+            except Exception:
+                # a write may time out mid-failover; only ACKED writes
+                # carry a durability promise
+                self.write_errors += 1
+            i += 1
+            time.sleep(0.02)
+
+
+class TestThrashReplicated:
+    def test_workload_survives_osd_churn(self):
+        cluster = MiniCluster(num_mons=1, num_osds=4,
+                              conf_overrides=FAST).start()
+        try:
+            client = cluster.client()
+            cluster.create_replicated_pool(client, "thrash", size=2,
+                                           pg_num=8)
+            ioctx = client.open_ioctx("thrash")
+            stop_evt = threading.Event()
+            writer = _Writer(ioctx, stop_evt)
+            # min_in=3 of 4: at most one osd down at a time, so a
+            # size-2 pool always keeps one replica serving (the
+            # reference thrasher maintains the same invariant via
+            # min_in/min_live)
+            thrasher = Thrasher(cluster, seed=7, min_in=3,
+                                interval=1.5, revive_delay=0.5)
+            writer.start()
+            thrasher.start()
+            time.sleep(10.0)         # several kill/revive cycles
+            thrasher.stop_and_heal()
+            stop_evt.set()
+            writer.join(timeout=10)
+            kills = [a for a in thrasher.log if a[0] == "kill"]
+            assert kills, "thrasher never killed anything"
+            assert len(writer.acked) > 20, \
+                "workload starved: %d acked" % len(writer.acked)
+            # every acknowledged write must read back bit-exact
+            deadline = time.monotonic() + 30
+            missing = list(writer.acked)
+            while missing and time.monotonic() < deadline:
+                still = []
+                for i in missing:
+                    try:
+                        if ioctx.read("obj-%d" % i) != payload_for(i):
+                            still.append(i)
+                    except Exception:
+                        still.append(i)
+                missing = still
+                if missing:
+                    time.sleep(0.5)
+            assert not missing, \
+                "%d acked objects lost after thrash (e.g. %s); log=%s" \
+                % (len(missing), missing[:5], thrasher.log)
+        finally:
+            cluster.stop()
+
+
+class TestMessageFaults:
+    def test_io_completes_under_socket_failures(self):
+        """'ms inject socket failures' analog: lossless retransmit must
+        mask injected drops and delays."""
+        conf = dict(FAST)
+        conf["ms_inject_socket_failures"] = 30   # drop 1 in 30
+        conf["ms_inject_delay_max"] = 0.01
+        cluster = MiniCluster(num_mons=1, num_osds=3,
+                              conf_overrides=conf).start()
+        try:
+            client = cluster.client()
+            cluster.create_replicated_pool(client, "lossy", size=2,
+                                           pg_num=4)
+            ioctx = client.open_ioctx("lossy")
+            for i in range(25):
+                ioctx.write_full("m%d" % i, payload_for(i), )
+            for i in range(25):
+                assert ioctx.read("m%d" % i) == payload_for(i)
+        finally:
+            cluster.stop()
+
+
+class TestEIOInjection:
+    def test_ec_read_reconstructs_around_injected_eio(self):
+        """qa/standalone/erasure-code/test-erasure-eio.sh analog: a
+        shard that returns EIO must not fail the client read — the
+        backend reconstructs from the other shards."""
+        cluster = MiniCluster(num_mons=1, num_osds=4,
+                              conf_overrides=FAST).start()
+        try:
+            client = cluster.client()
+            cluster.create_ec_pool(client, "eiopool",
+                                   {"plugin": "jerasure",
+                                    "technique": "reed_sol_van",
+                                    "k": "2", "m": "1"}, pg_num=4)
+            ioctx = client.open_ioctx("eiopool")
+            payload = payload_for(99)
+            ioctx.write_full("eobj", payload)
+            assert ioctx.read("eobj") == payload
+            # find one shard's holder and poison exactly that object
+            poisoned = 0
+            for osd in cluster.osds.values():
+                for cid in osd.store.list_collections():
+                    if "eobj" in osd.store.list_objects(cid):
+                        osd.store.inject_read_error(cid, "eobj")
+                        poisoned += 1
+                        break
+                if poisoned:
+                    break
+            assert poisoned == 1
+            deadline = time.monotonic() + 15
+            data = None
+            while time.monotonic() < deadline:
+                try:
+                    data = ioctx.read("eobj")
+                    if data == payload:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.2)
+            assert data == payload, "EIO was not reconstructed around"
+        finally:
+            cluster.stop()

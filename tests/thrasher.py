@@ -1,0 +1,99 @@
+"""Randomized failure injection against a live MiniCluster.
+
+Models the reference's teuthology Thrasher
+(qa/tasks/ceph_manager.py:98 — kill_osd :205, revive_osd :426): a
+background loop that keeps killing and reviving OSDs (never dipping
+below min_in) while a foreground workload runs, so recovery,
+re-peering, and degraded IO get exercised under churn instead of in
+staged one-shot tests.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from .cluster_util import wait_until
+
+__all__ = ["Thrasher"]
+
+
+class Thrasher:
+    def __init__(self, cluster, seed: int = 0, min_in: int = 2,
+                 interval: float = 0.5, revive_delay: float = 0.8):
+        self.cluster = cluster
+        self.rng = random.Random(seed)
+        self.min_in = min_in
+        self.interval = interval
+        self.revive_delay = revive_delay
+        self.dead: dict[int, object] = {}     # osd_id -> store
+        self.log: list[tuple[str, int]] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.errors: list[str] = []
+
+    # -- actions (kill_osd / revive_osd) -------------------------------
+
+    def _alive(self) -> list[int]:
+        return sorted(set(self.cluster.osds) - set(self.dead))
+
+    def kill_one(self) -> int | None:
+        alive = self._alive()
+        if len(alive) <= self.min_in:
+            return None
+        victim = self.rng.choice(alive)
+        store = self.cluster.stop_osd(victim)
+        self.dead[victim] = store
+        self.log.append(("kill", victim))
+        return victim
+
+    def revive_one(self) -> int | None:
+        if not self.dead:
+            return None
+        osd_id = self.rng.choice(sorted(self.dead))
+        store = self.dead.pop(osd_id)
+        self.cluster.revive_osd(osd_id, store=store)
+        # an auto-marked-out osd needs an explicit "in" (ceph_manager
+        # revive_osd does the same)
+        client = self.cluster.clients[0] if self.cluster.clients else None
+        if client is not None:
+            try:
+                client.mon_command({"prefix": "osd in", "id": osd_id})
+            except Exception:
+                pass
+        self.log.append(("revive", osd_id))
+        return osd_id
+
+    # -- loop ----------------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                # weighted choice mirroring the reference's thrasher:
+                # mostly kill/revive churn
+                if self.dead and (len(self._alive()) <= self.min_in
+                                  or self.rng.random() < 0.5):
+                    self.revive_one()
+                    time.sleep(self.revive_delay)
+                else:
+                    self.kill_one()
+                self._stop.wait(self.interval)
+        except Exception as e:  # surface loop crashes to the test
+            self.errors.append(repr(e))
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run,
+                                        name="thrasher", daemon=True)
+        self._thread.start()
+
+    def stop_and_heal(self, timeout: float = 30.0) -> None:
+        """Stop thrashing, revive everything, wait for all-up."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        while self.dead:
+            self.revive_one()
+        assert wait_until(self.cluster.all_osds_up, timeout=timeout), \
+            "cluster never healed after thrash: %s" % (self.log[-6:],)
+        assert not self.errors, self.errors
