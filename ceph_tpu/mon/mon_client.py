@@ -31,6 +31,10 @@ class MonClient(Dispatcher):
         self.map_callbacks: list = []
         self._map_event = threading.Event()
         self.auth_client = None      # CephxClient after authenticate()
+        # per-client nonce so the monitor's retransmit dedup never
+        # matches a different client that reused our ephemeral port
+        import uuid
+        self.session = uuid.uuid4().hex
         msgr.add_dispatcher_tail(self)
 
     # -- dispatch ------------------------------------------------------
@@ -83,6 +87,8 @@ class MonClient(Dispatcher):
         import time as _time
         tid = next(self._tid)
         msg.tid = tid
+        if hasattr(msg, "session"):
+            msg.session = self.session
         waiter = [threading.Event(), None]
         with self._lock:
             self._waiters[tid] = waiter

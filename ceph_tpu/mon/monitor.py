@@ -183,7 +183,8 @@ class Monitor(Dispatcher):
             if self._forward_if_peon(msg):
                 return True
             dest = msg.reply_to or msg.from_addr
-            key = (tuple(dest) if dest else None, msg.tid)
+            key = (getattr(msg, "session", "")
+                   or (tuple(dest) if dest else None), msg.tid)
             with self._lock:
                 cached = self._cmd_replies.get(key)
             if cached is None:
@@ -223,16 +224,25 @@ class Monitor(Dispatcher):
             self.msgr.send_message(
                 MAuthReply(tid=msg.tid, result=0, challenge=ch), dest)
             return
-        try:
-            ticket = self.key_server.handle_request(
-                msg.entity, msg.proof, service=msg.service)
-        except AuthError as e:
-            self.msgr.send_message(
-                MAuthReply(tid=msg.tid, result=-_errno.EACCES,
-                           outs=str(e)), dest)
-            return
-        self.msgr.send_message(
-            MAuthReply(tid=msg.tid, result=0, ticket=ticket), dest)
+        # the proof round consumes its one-shot challenge, so a
+        # retransmit (client resend after a dropped ticket reply) must
+        # replay the cached outcome instead of re-verifying — or a
+        # correct key reads as EACCES
+        key = (getattr(msg, "session", "")
+               or (tuple(dest) if dest else None), msg.tid)
+        with self._lock:
+            cached = self._cmd_replies.get(key)
+        if cached is None:
+            try:
+                ticket = self.key_server.handle_request(
+                    msg.entity, msg.proof, service=msg.service)
+                cached = MAuthReply(tid=msg.tid, result=0, ticket=ticket)
+            except AuthError as e:
+                cached = MAuthReply(tid=msg.tid, result=-_errno.EACCES,
+                                    outs=str(e))
+            with self._lock:
+                self._cmd_replies[key] = cached
+        self.msgr.send_message(cached, dest)
 
     def _forward_if_peon(self, msg) -> bool:
         if self.is_leader():

@@ -230,6 +230,7 @@ class MMonCommand(Message):
     tid: int = 0
     cmd: dict = field(default_factory=dict)
     reply_to: object = None
+    session: str = ""       # per-client nonce: dedup key survives port reuse
 
 
 @dataclass
@@ -268,6 +269,7 @@ class MAuth(Message):
     proof: bytes = b""          # empty on the first (challenge) round
     tid: int = 0
     reply_to: object = None
+    session: str = ""
 
 
 @dataclass

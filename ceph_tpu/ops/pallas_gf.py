@@ -1,0 +1,94 @@
+"""Pallas TPU kernel for the GF(2^8) bitplane encode.
+
+The XLA path (ops.xor_mm) lowers unpack -> int8 einsum -> pack as
+separate fused ops; this kernel does the whole thing in one VMEM
+residency per tile: bytes are expanded to bitplanes, hit the MXU as an
+int8 matmul against the [m*8, k*8] generator bitmatrix, and fold back
+to parity bytes — no intermediate bit tensor ever round-trips to HBM.
+
+Layout matches ops.gf_ref / ops.xor_mm exactly (bit b of byte j lives
+at row k*8+b), so outputs are bit-identical to the reference path —
+asserted by the tests, which run the kernel in interpreter mode on CPU.
+
+Scope: w=8 (the flagship RS configuration). Other widths stay on the
+XLA path. ops.xor_mm auto-dispatches here on TPU when the chunk length
+tiles evenly; CEPH_TPU_PALLAS=0 forces the XLA path everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["matrix_encode8", "available"]
+
+_TILE_N = 512          # bytes of chunk per grid step (multiple of 128)
+
+
+def available() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _encode_kernel(bitmat_ref, data_ref, out_ref):
+    """One (batch, N-tile) cell: [k, T] bytes -> [m, T] parity bytes."""
+    data = data_ref[0]                     # [k, T] uint8
+    k, t = data.shape
+    rows = bitmat_ref.shape[0]             # m*8
+    m = rows // 8
+    # int32 throughout the bit twiddling: Mosaic supports only 16/32-bit
+    # iota and has no unsigned reductions
+    shifts = jax.lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1)
+    # unpack: [k, T] -> [k, 8, T] bitplanes -> [k*8, T] int8
+    data_i = data.astype(jnp.int32)
+    bits = ((data_i[:, None, :] >> shifts) & 1).astype(jnp.int8)
+    bits = bits.reshape(k * 8, t)
+    # XOR-matmul on the MXU: int8 x int8 -> int32, parity = & 1
+    acc = jax.lax.dot_general(
+        bitmat_ref[...].astype(jnp.int8), bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    obits = (acc & 1).reshape(m, 8, t)      # int32 bitplanes
+    # pack: fold the 8 bitplanes back into parity bytes (int32 math —
+    # Mosaic has no unsigned reductions)
+    shifts_i = jax.lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1)
+    out_ref[0] = jnp.sum(obits << shifts_i, axis=1).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def matrix_encode8(bitmat: jax.Array, data: jax.Array,
+                   interpret: bool = False) -> jax.Array:
+    """[B, k, N] uint8 -> [B, m, N] parity, w=8, N % 512 == 0.
+
+    bitmat: [m*8, k*8] 0/1 (encode or cached decode bitmatrix).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, k, n = data.shape
+    rows = bitmat.shape[0]
+    m = rows // 8
+    assert n % _TILE_N == 0, "N must be a multiple of %d" % _TILE_N
+    grid = (b, n // _TILE_N)
+    return pl.pallas_call(
+        _encode_kernel,
+        out_shape=jax.ShapeDtypeStruct((b, m, n), jnp.uint8),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((rows, k * 8), lambda i, j: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, k, _TILE_N),
+                             lambda i, j: (i, 0, j),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, m, _TILE_N),
+                                   lambda i, j: (i, 0, j),
+                                   memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(bitmat.astype(jnp.uint8), data)

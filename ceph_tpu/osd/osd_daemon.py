@@ -101,8 +101,11 @@ class OSDDaemon(Dispatcher):
         self._boot()
         self._hb_tick()
 
-    def _boot(self) -> None:
-        self._boot_sent_epoch = self.map_epoch()
+    def _boot(self, epoch: int | None = None) -> None:
+        # record the epoch of the map that PROMPTED this boot (the new
+        # map is not installed yet when called from _on_osdmap)
+        self._boot_sent_epoch = self.map_epoch() if epoch is None \
+            else epoch
         self.public_msgr.send_message(
             MOSDBoot(osd_id=self.whoami,
                      public_addr=self.public_msgr.my_addr,
@@ -143,7 +146,7 @@ class OSDDaemon(Dispatcher):
         if self._running and newmap.exists(self.whoami) \
                 and newmap.is_down(self.whoami) \
                 and newmap.epoch > self._boot_sent_epoch:
-            self._boot()
+            self._boot(epoch=newmap.epoch)
         with self.lock:
             self.osdmap = newmap
             pgs = list(self.pgs.values())

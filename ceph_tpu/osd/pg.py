@@ -16,6 +16,7 @@ Collections: one per (pg, shard) — EC shard s lives in cid
 from __future__ import annotations
 
 import threading
+import time as _time
 
 from ..msg.message import MOSDPGPull, MOSDPGPush, MOSDPGScan
 from ..store.object_store import Transaction
@@ -44,6 +45,7 @@ class PG:
         self.last_version = 0
         self.pg_log: list[tuple] = []
         self.waiting_for_active: list = []
+        self._pulling: dict = {}   # oid -> pull sent at (monotonic)
         if pool.is_erasure():
             from .. import registry
             profile = daemon.ec_profile_for(pool)
@@ -412,7 +414,14 @@ class PG:
         behind = [oid for oid, v in peer_inv.items()
                   if want.get(oid, -1) < v]
         my_shard = self.my_shard() if self.pool.is_erasure() else -1
+        now = _time.monotonic()
         for oid in behind:
+            # in-flight pull tracking: repeated scan replies during
+            # churn must not multiply EC reconstructions of the same
+            # object; re-pull only after a timeout (lost push)
+            if now - self._pulling.get(oid, -1e9) < 5.0:
+                continue
+            self._pulling[oid] = now
             self.send_to_osd(peer_osd, MOSDPGPull(
                 pgid=self.pgid, from_osd=self.whoami, shard=my_shard,
                 oid=oid, map_epoch=self.map_epoch()))
@@ -469,7 +478,11 @@ class PG:
             local_v = int(raw) if raw else 0
         except KeyError:
             local_v = -1
-        if msg.version and local_v >= msg.version:
+        # only a strictly newer push may replace an existing copy; a
+        # versionless push (source object vanished mid-recovery) must
+        # never clobber versioned local data
+        self._pulling.pop(msg.oid, None)
+        if local_v >= 0 and local_v >= msg.version:
             return
         txn = Transaction()
         txn.remove(cid, msg.oid)

