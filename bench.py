@@ -101,7 +101,25 @@ def run_bench() -> None:
     t_cpu_d = _bench(lambda: cpu.decode_batch(avail, cpu_batch), CPU_ITERS)
     cpu_mbps = 2 * 2 * OBJ_SIZE / (t_cpu_e + t_cpu_d) / 1e6
 
-    print(json.dumps({
+    # native C++ plugin baseline (the ISA-class CPU stand-in from
+    # native/): encode one object per call, like
+    # ceph_erasure_code_benchmark's loop
+    native_mbps = None
+    try:
+        from ceph_tpu import native as native_mod
+        nat = native_mod.NativeCodec("jerasure", dict(profile))
+        payload = data_host[0].tobytes()
+        t_nat_e = _bench(lambda: nat.encode(payload), max(ITERS, 10))
+        encoded = nat.encode(payload)
+        survivors = {i: encoded[i] for i in range(K + M)
+                     if i not in (1, 4, 9)}
+        t_nat_d = _bench(lambda: nat.decode(survivors), max(ITERS, 10))
+        # same combined enc+dec protocol as `value`, apples-to-apples
+        native_mbps = 2 * len(payload) / (t_nat_e + t_nat_d) / 1e6
+    except Exception:
+        pass  # native lib not built on this host: report null
+
+    doc = {
         "metric": "ec_encode_decode_MBps_rs_k8_m3_w8",
         "value": round(value, 1),
         "unit": "MB/s",
@@ -113,7 +131,11 @@ def run_bench() -> None:
         "batch": BATCH,
         "object_size": OBJ_SIZE,
         "device": jax.devices()[0].platform,
-    }))
+    }
+    if native_mbps is not None:
+        doc["native_cpu_MBps"] = round(native_mbps, 1)
+        doc["vs_native"] = round(value / native_mbps, 2)
+    print(json.dumps(doc))
 
 
 def _supervised() -> None:

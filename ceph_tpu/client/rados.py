@@ -154,7 +154,10 @@ class RadosClient(Dispatcher):
                 dropped = self._inflight.pop(tid, None)
             if dropped is not None:
                 self._throttle.put()
-            # resend with fresh target
+            # resend with fresh target; also renew the map subscription
+            # — repeated slice timeouts often mean our map is stale
+            # because the mon's push was lost on a lossy link
+            self.mon_client.renew_subs()
 
 
 class IoCtx:
@@ -174,8 +177,9 @@ class IoCtx:
 
     # -- writes --------------------------------------------------------
 
-    def write_full(self, oid: str, data: bytes) -> None:
-        self._op(oid, [("writefull", bytes(data))])
+    def write_full(self, oid: str, data: bytes,
+                   timeout: float = 30.0) -> None:
+        self._op(oid, [("writefull", bytes(data))], timeout=timeout)
 
     def write(self, oid: str, data: bytes, offset: int = 0) -> None:
         self._op(oid, [("write", offset, bytes(data))])

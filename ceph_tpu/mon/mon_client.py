@@ -140,6 +140,19 @@ class MonClient(Dispatcher):
         self.auth_client = client
         return client
 
+    def renew_subs(self, min_interval: float = 1.0) -> None:
+        """Rate-limited subscription renewal at our CURRENT epoch (the
+        reference MonClient's tick): a dropped MOSDMap push is one-shot,
+        so anything waiting on map progress calls this in its loop. The
+        mon only re-sends when it actually has a newer map."""
+        import time as _time
+        now = _time.monotonic()
+        if now - getattr(self, "_last_renew", 0.0) < min_interval:
+            return
+        self._last_renew = now
+        self.sub_want(start_epoch=self.osdmap.epoch
+                      if self.osdmap is not None else 0)
+
     def sub_want(self, what: str = "osdmap", start_epoch: int = 0) -> None:
         self.msgr.send_message(
             MMonSubscribe(what=what, start_epoch=start_epoch,
@@ -147,12 +160,18 @@ class MonClient(Dispatcher):
             self._mon_addr())
 
     def wait_for_map(self, epoch: int = 1, timeout: float = 10.0):
-        """Block until an osdmap with epoch >= epoch arrives."""
+        """Block until an osdmap with epoch >= epoch arrives.
+
+        Renews the subscription every second while waiting: a dropped
+        MOSDMap push (lossy link) is otherwise never re-sent — the
+        reference MonClient renews subs on its tick for the same
+        reason."""
         import time
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if self.osdmap is not None and self.osdmap.epoch >= epoch:
                 return self.osdmap
+            self.renew_subs()
             self._map_event.wait(0.05)
             self._map_event.clear()
         raise TimeoutError("no osdmap epoch >= %d" % epoch)

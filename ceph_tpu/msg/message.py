@@ -166,6 +166,7 @@ class MOSDPGPush(Message):
     omap: dict = field(default_factory=dict)
     version: int = 0
     map_epoch: int = 0
+    force: bool = False    # scrub repair: overwrite same-version bitrot
 
 
 @dataclass

@@ -73,6 +73,7 @@ class OSDDaemon(Dispatcher):
         self.hb_pending: dict = {}     # osd -> first unacked ping stamp
         self.mgr_addr = None           # set when an mgr joins the cluster
         self._boot_sent_epoch = -1     # epoch of the last MOSDBoot sent
+        self._boot_sent_at = 0.0       # for boot retransmit rate-limit
         # l_osd_* counters (OSD.cc's PerfCounters), streamed to the mgr
         from ..common.perf_counters import PerfCountersBuilder
         self.perf = (PerfCountersBuilder("osd")
@@ -106,6 +107,7 @@ class OSDDaemon(Dispatcher):
         # map is not installed yet when called from _on_osdmap)
         self._boot_sent_epoch = self.map_epoch() if epoch is None \
             else epoch
+        self._boot_sent_at = time.monotonic()
         self.public_msgr.send_message(
             MOSDBoot(osd_id=self.whoami,
                      public_addr=self.public_msgr.my_addr,
@@ -180,6 +182,19 @@ class OSDDaemon(Dispatcher):
                 self.op_wq.queue(pgid, pg.on_map_change)
         return pg
 
+    def scrub_pg(self, pgid) -> bool:
+        """Kick a scrub of one PG ('ceph pg scrub' surface); runs on
+        the op queue at scrub class priority."""
+        pg = self.pgs.get(pgid)
+        if pg is None:
+            return False
+        # synchronous marker: callers polling scrub_stats must not read
+        # a PREVIOUS scrub's terminal state as this scrub's result
+        pg.scrub_stats = {"state": "queued"}
+        self.op_wq.queue(pg.pgid, pg.scrub, klass="scrub",
+                         priority=self.recovery_op_priority)
+        return True
+
     def queue_recovery(self, pg) -> None:
         self.op_wq.queue(pg.pgid, pg.start_recovery,
                          klass="recovery",
@@ -205,6 +220,17 @@ class OSDDaemon(Dispatcher):
             return
         conf = self.ctx.conf
         now = time.monotonic()
+        # the boot message is one-shot: on a lossy link a dropped
+        # MOSDBoot would strand the OSD forever, so retransmit while
+        # the map doesn't show us up (rate-limited)
+        if not self.osdmap.is_up(self.whoami) \
+                and now - self._boot_sent_at >= 1.0:
+            self._boot()
+        # likewise the mon's map pushes are one-shot: renew the
+        # subscription periodically so a dropped MOSDMap doesn't leave
+        # this OSD on a stale map (PGs never instantiated -> every
+        # client op bounces with EAGAIN)
+        self.mon_client.renew_subs()
         grace = conf.get_val("osd_heartbeat_grace")
         peers = [o for o in self.osdmap.get_up_osds()
                  if o != self.whoami]

@@ -46,6 +46,9 @@ class PG:
         self.pg_log: list[tuple] = []
         self.waiting_for_active: list = []
         self._pulling: dict = {}   # oid -> pull sent at (monotonic)
+        self.scrub_stats: dict = {"state": "never"}
+        self._scrub_waiting: set = set()
+        self._scrub_replies: dict = {}
         if pool.is_erasure():
             from .. import registry
             profile = daemon.ec_profile_for(pool)
@@ -381,8 +384,135 @@ class PG:
                 pgid=self.pgid, from_osd=self.whoami, shard=msg.shard,
                 op="reply", objects=inv, map_epoch=self.map_epoch()))
             return
+        if msg.op == "scrub_request":
+            inv = self._scrub_inventory(
+                msg.shard if self.pool.is_erasure() else -1)
+            self.send_to_osd(msg.from_osd, MOSDPGScan(
+                pgid=self.pgid, from_osd=self.whoami, shard=msg.shard,
+                op="scrub_reply", objects=inv,
+                map_epoch=self.map_epoch()))
+            return
+        if msg.op == "scrub_reply":
+            self._handle_scrub_reply(msg.from_osd, msg.shard,
+                                     msg.objects)
+            return
         # primary side: compare against authoritative inventory
         self._reconcile_inventory(msg.shard, msg.from_osd, msg.objects)
+
+    # -- scrub (PG_STATE_SCRUBBING; PrimaryLogPG scrub + repair) --------
+
+    def _scrub_inventory(self, shard: int) -> dict:
+        """oid -> (version, crc32(data), size) for one shard."""
+        import zlib
+        cid = self.cid_of_shard(shard)
+        inv = {}
+        for oid in self.store.list_objects(cid):
+            try:
+                data = self.store.read(cid, oid)
+                raw = self.store.getattr(cid, oid, VERSION_ATTR)
+                inv[oid] = (int(raw) if raw else 0,
+                            zlib.crc32(data), len(data))
+            except (KeyError, OSError):
+                inv[oid] = (-1, 0, 0)   # unreadable shard: scrub error
+        return inv
+
+    def scrub(self) -> dict | None:
+        """Primary-driven scrub: collect per-object (version, crc, size)
+        from every acting peer, compare against the local copy, and
+        push repairs for mismatches. Returns immediately; results land
+        in self.scrub_stats once all replies arrive."""
+        if not self.is_primary():
+            return None
+        shards = self.acting_shards()
+        with self.lock:
+            self._scrub_seq = getattr(self, "_scrub_seq", 0) + 1
+            seq = self._scrub_seq
+            self._scrub_waiting = {
+                osd for shard, osd in shards.items()
+                if osd not in (CRUSH_ITEM_NONE, self.whoami)}
+            self._scrub_replies = {}
+            self.scrub_stats = {"state": "scrubbing", "errors": 0,
+                                "repaired": 0, "objects": 0}
+        self._send_scrub_requests(shards)
+        if not self._scrub_waiting:
+            self._finish_scrub()
+        else:
+            # one-shot messages wedge on lossy links: retransmit to
+            # laggard peers a few times, then give up loudly
+            self.daemon.timer.add_event_after(
+                1.0, self._scrub_retry, seq, 0)
+        return self.scrub_stats
+
+    def _send_scrub_requests(self, shards, only: set | None = None):
+        for shard, osd in shards.items():
+            if osd in (CRUSH_ITEM_NONE, self.whoami):
+                continue
+            if only is not None and osd not in only:
+                continue
+            self.send_to_osd(osd, MOSDPGScan(
+                pgid=self.pgid, from_osd=self.whoami, shard=shard,
+                op="scrub_request", map_epoch=self.map_epoch()))
+
+    def _scrub_retry(self, seq: int, attempt: int) -> None:
+        with self.lock:
+            if seq != getattr(self, "_scrub_seq", 0) \
+                    or not self._scrub_waiting:
+                return  # this scrub finished or was superseded
+            waiting = set(self._scrub_waiting)
+            if attempt >= 5:
+                self._scrub_waiting = set()
+                self.scrub_stats = {"state": "failed", "errors": 0,
+                                    "repaired": 0, "objects": 0,
+                                    "unreachable": sorted(waiting)}
+                return
+        self._send_scrub_requests(self.acting_shards(), only=waiting)
+        self.daemon.timer.add_event_after(
+            1.0, self._scrub_retry, seq, attempt + 1)
+
+    def _handle_scrub_reply(self, peer_osd: int, shard: int,
+                            inv: dict) -> None:
+        with self.lock:
+            if peer_osd not in getattr(self, "_scrub_waiting", set()):
+                return
+            self._scrub_waiting.discard(peer_osd)
+            self._scrub_replies[(peer_osd, shard)] = inv
+            done = not self._scrub_waiting
+        if done:
+            self._finish_scrub()
+
+    def _finish_scrub(self) -> None:
+        """Compare every replica's inventory to the primary's copy.
+
+        Replicated pools only compare like-for-like copies; EC shards
+        hold different bytes per shard, so EC scrub checks only version
+        presence (deep EC parity verification = decode check, a later
+        round). Authoritative copy = highest version, primary wins
+        ties; mismatches are repaired by pushing it."""
+        local = self._scrub_inventory(
+            self.my_shard() if self.pool.is_erasure() else -1)
+        errors = repaired = 0
+        replicated = not self.pool.is_erasure()
+        for (peer_osd, shard), inv in self._scrub_replies.items():
+            for oid in set(local) | set(inv):
+                mine = local.get(oid)
+                theirs = inv.get(oid)
+                if mine == theirs:
+                    continue
+                if not replicated:
+                    # EC: only flag version divergence
+                    if mine is not None and theirs is not None \
+                            and mine[0] == theirs[0]:
+                        continue
+                errors += 1
+                if mine is not None and (
+                        theirs is None or theirs[0] <= mine[0]):
+                    self._push_object(oid, shard, peer_osd, force=True)
+                    repaired += 1
+        with self.lock:
+            self.scrub_stats = {
+                "state": "clean" if errors == repaired else "inconsistent",
+                "errors": errors, "repaired": repaired,
+                "objects": len(local)}
 
     def _authoritative_inventory(self) -> dict:
         """Union of all local shard inventories (primary's knowledge)."""
@@ -437,7 +567,8 @@ class PG:
         of an object: push it to the requester's shard."""
         self._push_object(msg.oid, msg.shard, msg.from_osd)
 
-    def _push_object(self, oid, shard: int, peer_osd: int) -> None:
+    def _push_object(self, oid, shard: int, peer_osd: int,
+                     force: bool = False) -> None:
         src_cid = self.cid_of_shard(
             self.my_shard() if self.pool.is_erasure() else -1)
         try:
@@ -458,7 +589,8 @@ class PG:
             msg = MOSDPGPush(
                 pgid=self.pgid, from_osd=self.whoami, shard=shard,
                 oid=oid, data=data, attrs=attrs, omap=omap,
-                version=version, map_epoch=self.map_epoch())
+                version=version, map_epoch=self.map_epoch(),
+                force=force)
             if peer_osd == self.whoami:
                 self.handle_push(msg)
             else:
@@ -482,7 +614,12 @@ class PG:
         # versionless push (source object vanished mid-recovery) must
         # never clobber versioned local data
         self._pulling.pop(msg.oid, None)
-        if local_v >= 0 and local_v >= msg.version:
+        # scrub repairs (force) may overwrite SAME-version bitrot; no
+        # push — forced or not — may ever roll back a strictly newer
+        # (acked) local copy
+        if local_v >= 0 and (local_v > msg.version
+                             or (local_v == msg.version
+                                 and not msg.force)):
             return
         txn = Transaction()
         txn.remove(cid, msg.oid)

@@ -1,0 +1,211 @@
+"""rados: object CLI + load benchmark.
+
+Counterpart of the reference's rados tool
+(/root/reference/src/tools/rados/rados.cc) including `rados bench`
+(src/common/obj_bencher.{h,cc}: write_bench/seq_read_bench :77-78):
+put/get/ls/rm/stat against a pool, pool create, and a timed write or
+sequential-read benchmark reporting MB/s, IOPS and latency percentiles.
+
+Connects to a running cluster through a monmap file (one
+`rank host:port` per line — vstart writes one) or repeated
+--mon host:port flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from ..client.rados import RadosClient
+from ..common.context import Context
+
+
+def parse_monmap(args) -> dict:
+    monmap: dict[int, tuple] = {}
+    if args.monmap:
+        with open(args.monmap) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                rank, addr = line.split()
+                host, port = addr.rsplit(":", 1)
+                monmap[int(rank)] = (host, int(port))
+    next_rank = max(monmap, default=-1) + 1
+    for i, m in enumerate(args.mon or []):
+        host, port = m.rsplit(":", 1)
+        monmap[next_rank + i] = (host, int(port))
+    if not monmap:
+        raise SystemExit("rados: need --monmap FILE or --mon host:port")
+    return monmap
+
+
+def connect(args) -> RadosClient:
+    client = RadosClient(parse_monmap(args),
+                         Context(name="rados-cli"))
+    client.connect()
+    return client
+
+
+# ---------------------------------------------------------------------------
+# bench (obj_bencher)
+
+
+def run_write_bench(ioctx, seconds: float, block_size: int,
+                    prefix: str) -> dict:
+    payload = b"\xb5" * block_size
+    lat: list[float] = []
+    deadline = time.monotonic() + seconds
+    i = 0
+    t0 = time.monotonic()
+    while time.monotonic() < deadline:
+        s = time.monotonic()
+        ioctx.write_full("%s_%d" % (prefix, i), payload)
+        lat.append(time.monotonic() - s)
+        i += 1
+    elapsed = time.monotonic() - t0
+    return _report("write", i, block_size, elapsed, lat)
+
+
+def run_seq_bench(ioctx, seconds: float, block_size: int,
+                  prefix: str) -> dict:
+    lat: list[float] = []
+    deadline = time.monotonic() + seconds
+    done = 0
+    i = 0
+    t0 = time.monotonic()
+    while time.monotonic() < deadline:
+        s = time.monotonic()
+        try:
+            data = ioctx.read("%s_%d" % (prefix, i))
+        except Exception:
+            if i == 0:
+                raise SystemExit(
+                    "rados bench seq: no objects written by a prior "
+                    "write bench with prefix %r" % prefix)
+            i = 0
+            continue
+        if not data:
+            i = 0
+            continue
+        lat.append(time.monotonic() - s)
+        done += 1
+        i += 1
+    elapsed = time.monotonic() - t0
+    return _report("seq", done, block_size, elapsed, lat)
+
+
+def _report(mode: str, ops: int, block_size: int, elapsed: float,
+            lat: list[float]) -> dict:
+    lat_sorted = sorted(lat)
+
+    def pct(p):
+        if not lat_sorted:
+            return 0.0
+        return lat_sorted[min(len(lat_sorted) - 1,
+                              int(p * len(lat_sorted)))]
+
+    return {
+        "mode": mode,
+        "ops": ops,
+        "seconds": round(elapsed, 3),
+        "bandwidth_MBps": round(ops * block_size / max(elapsed, 1e-9)
+                                / 1e6, 2),
+        "iops": round(ops / max(elapsed, 1e-9), 1),
+        "avg_lat_ms": round(sum(lat) / len(lat) * 1000, 3) if lat else 0,
+        "p50_lat_ms": round(pct(0.50) * 1000, 3),
+        "p99_lat_ms": round(pct(0.99) * 1000, 3),
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="rados", description="object store utility")
+    p.add_argument("--monmap", help="monmap file (rank host:port lines)")
+    p.add_argument("--mon", action="append", help="monitor host:port")
+    p.add_argument("-p", "--pool", help="pool name")
+    sub = p.add_subparsers(dest="op", required=True)
+    sub.add_parser("lspools")
+    mk = sub.add_parser("mkpool")
+    mk.add_argument("name")
+    mk.add_argument("--size", type=int, default=2)
+    mk.add_argument("--pg-num", type=int, default=8)
+    sub.add_parser("ls")
+    for name in ("put", "get"):
+        c = sub.add_parser(name)
+        c.add_argument("obj")
+        c.add_argument("file")
+    for name in ("rm", "stat"):
+        c = sub.add_parser(name)
+        c.add_argument("obj")
+    b = sub.add_parser("bench")
+    b.add_argument("seconds", type=float)
+    b.add_argument("mode", choices=["write", "seq"])
+    b.add_argument("-b", "--block-size", type=int, default=1 << 20)
+    b.add_argument("--run-name", default="benchmark_data")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    client = connect(args)
+    try:
+        if args.op == "lspools":
+            m = client.osdmap
+            for pool in m.pools.values():
+                sys.stdout.write("%d %s\n" % (pool.pool_id, pool.name))
+            return 0
+        if args.op == "mkpool":
+            res, outs, _ = client.mon_command({
+                "prefix": "osd pool create", "pool": args.name,
+                "size": args.size, "pg_num": args.pg_num})
+            sys.stdout.write("%s\n" % (outs or "pool created"))
+            return 0 if res == 0 else 1
+        if not args.pool:
+            raise SystemExit("rados: -p/--pool required for %s" % args.op)
+        ioctx = client.open_ioctx(args.pool)
+        if args.op == "ls":
+            for oid in ioctx.list_objects():
+                sys.stdout.write("%s\n" % oid)
+            return 0
+        if args.op == "put":
+            with open(args.file, "rb") as f:
+                ioctx.write_full(args.obj, f.read())
+            return 0
+        if args.op == "get":
+            data = ioctx.read(args.obj)
+            if args.file == "-":
+                sys.stdout.buffer.write(data)
+            else:
+                with open(args.file, "wb") as f:
+                    f.write(data)
+            return 0
+        if args.op == "rm":
+            ioctx.remove(args.obj)
+            return 0
+        if args.op == "stat":
+            st = ioctx.stat(args.obj)
+            sys.stdout.write("%s size %d\n" % (args.obj, st["size"]))
+            return 0
+        if args.op == "bench":
+            if args.mode == "write":
+                rep = run_write_bench(ioctx, args.seconds,
+                                      args.block_size, args.run_name)
+            else:
+                rep = run_seq_bench(ioctx, args.seconds,
+                                    args.block_size, args.run_name)
+            import json
+            sys.stdout.write(json.dumps(rep) + "\n")
+            return 0
+    finally:
+        client.shutdown()
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

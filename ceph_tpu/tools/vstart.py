@@ -1,0 +1,148 @@
+"""vstart: boot a development cluster in one process.
+
+Counterpart of the reference's src/vstart.sh (and the
+qa/standalone/ceph-helpers.sh run_mon/run_osd pattern): start N
+monitors, N OSDs and optionally an mgr on localhost, write a monmap
+file other tools (rados, ceph CLI) can point at, then serve until
+interrupted. Stores are MemStore by default or FileStore under
+--data DIR for durability across restarts.
+
+  vstart --mons 1 --osds 3 --monmap /tmp/monmap [--data /tmp/cstore]
+  rados --monmap /tmp/monmap mkpool data
+  rados --monmap /tmp/monmap -p data bench 10 write
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import sys
+import time
+
+from ..common.context import Context
+from ..mgr.mgr_daemon import MgrDaemon
+from ..mon.monitor import Monitor
+from ..osd.osd_daemon import OSDDaemon
+
+
+def free_ports(n: int) -> list[int]:
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="vstart", description="run a dev cluster in one process")
+    p.add_argument("--mons", type=int, default=1)
+    p.add_argument("--osds", type=int, default=3)
+    p.add_argument("--mgr", action="store_true",
+                   help="also run a manager daemon")
+    p.add_argument("--monmap", required=True,
+                   help="write the monmap here for client tools")
+    p.add_argument("--data",
+                   help="directory for FileStore-backed OSDs "
+                        "(default: in-memory stores)")
+    p.add_argument("--conf", action="append", default=[],
+                   metavar="KEY=VALUE", help="config override")
+    p.add_argument("--run-seconds", type=float, default=0,
+                   help="exit after N seconds (0 = until SIGINT)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    overrides = {}
+    for kv in args.conf:
+        k, _, v = kv.partition("=")
+        try:
+            overrides[k] = float(v) if "." in v else int(v)
+        except ValueError:
+            overrides[k] = v
+
+    monmap = {r: ("127.0.0.1", p)
+              for r, p in enumerate(free_ports(args.mons))}
+    with open(args.monmap, "w") as f:
+        for rank, (host, port) in monmap.items():
+            f.write("%d %s:%d\n" % (rank, host, port))
+
+    mons = []
+    for rank in monmap:
+        mon = Monitor(rank, monmap,
+                      Context(overrides, name="mon.%d" % rank))
+        mon.init()
+        mons.append(mon)
+    deadline = time.monotonic() + 15
+    while not any(m.is_leader() for m in mons):
+        if time.monotonic() > deadline:
+            sys.stderr.write("vstart: no mon leader\n")
+            return 1
+        time.sleep(0.05)
+    sys.stdout.write("vstart: %d mon(s) up, leader elected\n"
+                     % len(mons))
+
+    osds = []
+    for osd_id in range(args.osds):
+        store = None
+        if args.data:
+            from ..store.file_store import FileStore
+            path = os.path.join(args.data, "osd.%d" % osd_id)
+            os.makedirs(path, exist_ok=True)
+            store = FileStore(path)
+        osd = OSDDaemon(osd_id, monmap,
+                        Context(overrides, name="osd.%d" % osd_id),
+                        store=store)
+        osd.init()
+        osds.append(osd)
+
+    deadline = time.monotonic() + 30
+    leader = next(m for m in mons if m.is_leader())
+    while not all(leader.osdmon.osdmap.is_up(o) for o in
+                  range(args.osds)):
+        if time.monotonic() > deadline:
+            sys.stderr.write("vstart: osds never came up\n")
+            return 1
+        time.sleep(0.05)
+    sys.stdout.write("vstart: %d osd(s) up\n" % len(osds))
+
+    mgr = None
+    if args.mgr:
+        mgr = MgrDaemon(monmap, Context(overrides, name="mgr"))
+        mgr.init()
+        for osd in osds:
+            osd.mgr_addr = mgr.addr
+        sys.stdout.write("vstart: mgr up at %s\n" % (mgr.addr,))
+
+    sys.stdout.write("vstart: cluster ready (monmap: %s)\n"
+                     % args.monmap)
+    sys.stdout.flush()
+
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    t0 = time.monotonic()
+    while not stop:
+        if args.run_seconds and time.monotonic() - t0 > args.run_seconds:
+            break
+        time.sleep(0.2)
+
+    sys.stdout.write("vstart: shutting down\n")
+    if mgr is not None:
+        mgr.shutdown()
+    for osd in osds:
+        osd.shutdown()
+    for mon in mons:
+        mon.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

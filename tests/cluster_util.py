@@ -138,8 +138,13 @@ class MiniCluster:
     def _wait_pool(self, client, name):
         def ready():
             m = client.osdmap
-            return m is not None and any(p.name == name
-                                         for p in m.pools.values())
+            if m is not None and any(p.name == name
+                                     for p in m.pools.values()):
+                return True
+            # renew the subscription while waiting: on lossy links the
+            # mon's one-shot map push may have been dropped
+            client.mon_client.renew_subs()
+            return False
         assert wait_until(ready), "pool %s never appeared" % name
 
     def wait_clean(self, pool_id: int, timeout=20.0) -> bool:

@@ -1,0 +1,99 @@
+"""vstart + rados CLI tests: the operator workflow end-to-end.
+
+Models the reference's vstart.sh / rados.cc usage pattern: boot a
+cluster (subprocess, like a real operator would), drive it with the
+rados CLI (mkpool, put/get/stat/ls/rm, bench write + seq), tear down.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+
+
+def rados(monmap, *argv):
+    return subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.tools.rados_cli",
+         "--monmap", monmap, *argv],
+        capture_output=True, text=True, timeout=120, env=ENV, cwd=REPO)
+
+
+@pytest.fixture(scope="module")
+def vstart_cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("vstart")
+    monmap = str(tmp / "monmap")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ceph_tpu.tools.vstart",
+         "--mons", "1", "--osds", "3", "--monmap", monmap,
+         "--conf", "osd_heartbeat_interval=0.1",
+         "--conf", "paxos_propose_interval=0.02"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=ENV, cwd=REPO)
+    # wait for the ready line
+    deadline = time.time() + 60
+    ready = False
+    lines = []
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        lines.append(line)
+        if "cluster ready" in line:
+            ready = True
+            break
+    if not ready:
+        proc.kill()
+        pytest.fail("vstart never became ready: %s" % "".join(lines))
+    yield monmap
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=20)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+class TestRadosCli:
+    def test_full_object_workflow(self, vstart_cluster, tmp_path):
+        monmap = vstart_cluster
+        r = rados(monmap, "mkpool", "clidata", "--size", "2")
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = rados(monmap, "lspools")
+        assert "clidata" in r.stdout
+        src = tmp_path / "in.bin"
+        src.write_bytes(b"cli payload " * 1000)
+        assert rados(monmap, "-p", "clidata", "put", "obj1",
+                     str(src)).returncode == 0
+        r = rados(monmap, "-p", "clidata", "stat", "obj1")
+        assert "size %d" % len(src.read_bytes()) in r.stdout
+        dst = tmp_path / "out.bin"
+        assert rados(monmap, "-p", "clidata", "get", "obj1",
+                     str(dst)).returncode == 0
+        assert dst.read_bytes() == src.read_bytes()
+        r = rados(monmap, "-p", "clidata", "ls")
+        assert "obj1" in r.stdout
+        assert rados(monmap, "-p", "clidata", "rm",
+                     "obj1").returncode == 0
+        r = rados(monmap, "-p", "clidata", "ls")
+        assert "obj1" not in r.stdout
+
+    def test_bench_write_then_seq(self, vstart_cluster):
+        monmap = vstart_cluster
+        assert rados(monmap, "mkpool", "benchpool").returncode == 0
+        r = rados(monmap, "-p", "benchpool", "bench", "2", "write",
+                  "-b", "65536")
+        assert r.returncode == 0, r.stdout + r.stderr
+        rep = json.loads(r.stdout.strip().splitlines()[-1])
+        assert rep["mode"] == "write" and rep["ops"] > 0
+        assert rep["bandwidth_MBps"] > 0 and rep["p99_lat_ms"] > 0
+        r = rados(monmap, "-p", "benchpool", "bench", "1", "seq",
+                  "-b", "65536")
+        assert r.returncode == 0, r.stdout + r.stderr
+        rep = json.loads(r.stdout.strip().splitlines()[-1])
+        assert rep["mode"] == "seq" and rep["ops"] > 0
