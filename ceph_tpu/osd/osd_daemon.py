@@ -182,16 +182,17 @@ class OSDDaemon(Dispatcher):
                 self.op_wq.queue(pgid, pg.on_map_change)
         return pg
 
-    def scrub_pg(self, pgid) -> bool:
-        """Kick a scrub of one PG ('ceph pg scrub' surface); runs on
-        the op queue at scrub class priority."""
+    def scrub_pg(self, pgid, deep: bool = False) -> bool:
+        """Kick a (deep) scrub of one PG ('ceph pg scrub' /
+        'ceph pg deep-scrub' surface); runs on the op queue at scrub
+        class priority."""
         pg = self.pgs.get(pgid)
         if pg is None:
             return False
         # synchronous marker: callers polling scrub_stats must not read
         # a PREVIOUS scrub's terminal state as this scrub's result
         pg.scrub_stats = {"state": "queued"}
-        self.op_wq.queue(pg.pgid, pg.scrub, klass="scrub",
+        self.op_wq.queue(pg.pgid, pg.scrub, deep, klass="scrub",
                          priority=self.recovery_op_priority)
         return True
 

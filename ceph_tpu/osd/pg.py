@@ -416,17 +416,23 @@ class PG:
                 inv[oid] = (-1, 0, 0)   # unreadable shard: scrub error
         return inv
 
-    def scrub(self) -> dict | None:
+    def scrub(self, deep: bool = False) -> dict | None:
         """Primary-driven scrub: collect per-object (version, crc, size)
         from every acting peer, compare against the local copy, and
         push repairs for mismatches. Returns immediately; results land
-        in self.scrub_stats once all replies arrive."""
+        in self.scrub_stats once all replies arrive.
+
+        deep=True on an EC pool additionally verifies every shard's
+        stored crc against the write-time hinfo record and rebuilds
+        divergent shards from the survivors (decode on the device) —
+        the integrity check a shallow EC scrub cannot do."""
         if not self.is_primary():
             return None
         shards = self.acting_shards()
         with self.lock:
             self._scrub_seq = getattr(self, "_scrub_seq", 0) + 1
             seq = self._scrub_seq
+            self._scrub_deep = deep
             self._scrub_waiting = {
                 osd for shard, osd in shards.items()
                 if osd not in (CRUSH_ITEM_NONE, self.whoami)}
@@ -488,11 +494,16 @@ class PG:
         presence (deep EC parity verification = decode check, a later
         round). Authoritative copy = highest version, primary wins
         ties; mismatches are repaired by pushing it."""
+        with self.lock:
+            seq = getattr(self, "_scrub_seq", 0)
+            replies = {k: dict(v)
+                       for k, v in self._scrub_replies.items()}
         local = self._scrub_inventory(
             self.my_shard() if self.pool.is_erasure() else -1)
         errors = repaired = 0
+        shallow_repaired: set = set()   # (peer_osd, shard, oid)
         replicated = not self.pool.is_erasure()
-        for (peer_osd, shard), inv in self._scrub_replies.items():
+        for (peer_osd, shard), inv in replies.items():
             for oid in set(local) | set(inv):
                 mine = local.get(oid)
                 theirs = inv.get(oid)
@@ -507,12 +518,115 @@ class PG:
                 if mine is not None and (
                         theirs is None or theirs[0] <= mine[0]):
                     self._push_object(oid, shard, peer_osd, force=True)
+                    shallow_repaired.add((peer_osd, shard, oid))
                     repaired += 1
+        if not replicated and getattr(self, "_scrub_deep", False):
+            # the deep pass reconstructs objects through the normal EC
+            # read path, whose sub-read replies are served by THIS PG's
+            # shard worker — run it on its own thread so waiting for
+            # them cannot deadlock the worker
+            def deep_worker(base_err=errors, base_rep=repaired,
+                            nobj=len(local)):
+                d_err, d_rep = self._deep_scrub_ec(
+                    local, replies, shallow_repaired)
+                err, rep = base_err + d_err, base_rep + d_rep
+                with self.lock:
+                    if seq != getattr(self, "_scrub_seq", 0):
+                        return  # a newer scrub superseded this one
+                    self.scrub_stats = {
+                        "state": "clean" if err == rep
+                        else "inconsistent",
+                        "errors": err, "repaired": rep,
+                        "objects": nobj, "deep": True}
+
+            threading.Thread(target=deep_worker, name="deep-scrub",
+                             daemon=True).start()
+            return
         with self.lock:
             self.scrub_stats = {
                 "state": "clean" if errors == repaired else "inconsistent",
                 "errors": errors, "repaired": repaired,
                 "objects": len(local)}
+
+    def _deep_scrub_ec(self, local_inv: dict, replies: dict,
+                       already_repaired: set) -> tuple[int, int]:
+        """EC shard verification against the write-time hinfo crcs.
+
+        Ground truth is the per-shard cumulative crc recorded at encode
+        time (ECUtil.HashInfo) — NOT a reconstruction, which would
+        trust whichever shards it happened to read and could launder a
+        corrupt data shard into "authoritative" bytes. A divergent
+        shard is rebuilt from the OTHER shards (recover_object excludes
+        the target), the rebuilt bytes are re-verified against the
+        hinfo crc, and only then force-pushed.
+        """
+        import zlib
+
+        errors = repaired = 0
+        shards = self.acting_shards()
+        my_shard = self.my_shard()
+        my_inv = {my_shard: local_inv}   # _finish_scrub computed this
+        for s in shards:
+            if shards[s] == self.whoami and s not in my_inv:
+                my_inv[s] = self._scrub_inventory(s)
+        for oid, (version, _, _) in sorted(local_inv.items()):
+            h = self.backend.get_hinfo(oid)
+            if not h.has_chunk_hash() or h.get_total_chunk_size() == 0:
+                continue
+            for shard, osd in shards.items():
+                if osd == CRUSH_ITEM_NONE:
+                    continue
+                if (osd, shard, oid) in already_repaired:
+                    continue   # the shallow pass just fixed this copy
+                want_crc = h.get_chunk_hash(shard)
+                if osd == self.whoami:
+                    have = my_inv.get(shard, {}).get(oid)
+                else:
+                    have = replies.get((osd, shard), {}).get(oid)
+                if have is not None and have[1] == want_crc:
+                    continue
+                errors += 1
+                done = threading.Event()
+                got: list = [None]
+
+                def on_done(data, _g=got, _d=done):
+                    _g[0] = data
+                    _d.set()
+
+                self.backend.recover_object(oid, shard, on_done)
+                if not done.wait(10.0) or got[0] is None:
+                    continue    # unrepairable now: stays inconsistent
+                rebuilt = bytes(got[0])
+                if (zlib.crc32(rebuilt) & 0xFFFFFFFF) != want_crc:
+                    continue    # survivors are bad too: do NOT launder
+                # carry the full metadata set like _push_object does:
+                # handle_push removes+rewrites, so omitting hinfo/omap
+                # would permanently strip them from the repaired shard
+                src_cid = self.cid_of_shard(my_shard)
+                attrs = {}
+                for name in (VERSION_ATTR, "_size", "hinfo_key"):
+                    try:
+                        val = self.store.getattr(src_cid, oid, name)
+                    except KeyError:
+                        val = None
+                    if val is not None:
+                        attrs[name] = val
+                attrs.setdefault(VERSION_ATTR, str(version).encode())
+                try:
+                    omap = self.store.omap_get(src_cid, oid)
+                except KeyError:
+                    omap = {}
+                push = MOSDPGPush(
+                    pgid=self.pgid, from_osd=self.whoami, shard=shard,
+                    oid=oid, data=rebuilt, attrs=attrs, omap=omap,
+                    version=version, map_epoch=self.map_epoch(),
+                    force=True)
+                if osd == self.whoami:
+                    self.handle_push(push)
+                else:
+                    self.send_to_osd(osd, push)
+                repaired += 1
+        return errors, repaired
 
     def _authoritative_inventory(self) -> dict:
         """Union of all local shard inventories (primary's knowledge)."""

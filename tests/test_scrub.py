@@ -82,6 +82,62 @@ class TestScrub:
         stats = run_scrub(cluster, primary, pgid)
         assert stats["state"] == "clean"
 
+    def test_deep_scrub_ec_repairs_corrupt_data_shard(self, ctx):
+        """Deep scrub on an EC pool verifies every shard against the
+        write-time hinfo crcs and rebuilds a corrupt shard from the
+        survivors. The adversarial case: the corrupt shard is a DATA
+        shard the normal read path would happily consume — the repair
+        must restore it, never launder the corruption into the other
+        shards."""
+        import numpy as np
+
+        cluster, client, _ = ctx
+        cluster.create_ec_pool(client, "deepec",
+                               {"plugin": "jerasure",
+                                "technique": "reed_sol_van",
+                                "k": "2", "m": "1"}, pg_num=4)
+        ec_io = client.open_ioctx("deepec")
+        payload = bytes(np.random.default_rng(5).integers(
+            0, 256, 8192, dtype=np.uint8))
+        ec_io.write_full("dobj", payload)
+        m = client.osdmap
+        pool_id = client.pool_id("deepec")
+        pgid = m.pools[pool_id].raw_pg_to_pg(
+            m.object_to_pg(pool_id, "dobj"))
+        _, _, acting, primary = m.pg_to_up_acting_osds(pgid)
+        before = {
+            s: cluster.osds[acting[s]].store.read(
+                ("pg", str(pgid), s), "dobj") for s in range(3)}
+        victim = cluster.osds[acting[1]]   # shard 1 = a data shard
+        cid = ("pg", str(pgid), 1)
+        from ceph_tpu.store.object_store import Transaction
+        txn = Transaction()
+        txn.write(cid, "dobj", 0,
+                  bytes([b ^ 0xFF for b in before[1][:64]]))
+        victim.store.queue_transaction(txn)
+        # shallow scrub cannot see it (versions/sizes agree, and EC
+        # shards legitimately differ byte-wise)
+        osd = cluster.osds[primary]
+        assert osd.scrub_pg(pgid)
+        pg = osd.pgs[pgid]
+        assert wait_until(lambda: pg.scrub_stats.get("state") in
+                          ("clean", "inconsistent", "failed"), 10)
+        assert pg.scrub_stats["errors"] == 0
+        # deep scrub pinpoints the corrupt shard via hinfo and rebuilds
+        # it from the other shards
+        assert osd.scrub_pg(pgid, deep=True)
+        assert wait_until(lambda: pg.scrub_stats.get("deep") and
+                          pg.scrub_stats.get("state") in
+                          ("clean", "inconsistent"), 20), pg.scrub_stats
+        assert pg.scrub_stats["errors"] == 1
+        assert pg.scrub_stats["repaired"] == 1
+        assert wait_until(
+            lambda: all(
+                cluster.osds[acting[s]].store.read(
+                    ("pg", str(pgid), s), "dobj") == before[s]
+                for s in range(3)), 10)
+        assert ec_io.read("dobj") == payload
+
     def test_detects_missing_replica_copy(self, ctx):
         cluster, client, ioctx = ctx
         ioctx.write_full("gone-obj", b"here" * 50)
