@@ -111,6 +111,13 @@ def _declare_defaults():
       "make reads of marked objects return EIO")
     o("osd_inject_failure_on_write", float, 0.0, LEVEL_DEV,
       "probability a sub-write is dropped before commit")
+    # filestore
+    o("filestore_compression", str, "none", LEVEL_ADVANCED,
+      "checkpoint blob compression: none|zlib|zstd|snappy|lz4")
+    o("filestore_compression_required_ratio", float, 0.875,
+      LEVEL_ADVANCED,
+      "store compressed only if <= input * ratio "
+      "(bluestore_compression_required_ratio analog)")
     # throttles
     o("objecter_inflight_ops", int, 1024, LEVEL_ADVANCED)
     o("osd_client_message_cap", int, 256, LEVEL_ADVANCED)

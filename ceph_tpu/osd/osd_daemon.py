@@ -189,10 +189,16 @@ class OSDDaemon(Dispatcher):
         pg = self.pgs.get(pgid)
         if pg is None:
             return False
-        # synchronous marker: callers polling scrub_stats must not read
-        # a PREVIOUS scrub's terminal state as this scrub's result
-        pg.scrub_stats = {"state": "queued"}
-        self.op_wq.queue(pg.pgid, pg.scrub, deep, klass="scrub",
+        # the seq bump + queued marker happen synchronously and under
+        # the PG lock: callers polling scrub_stats must never read a
+        # PREVIOUS scrub's terminal state as this scrub's result, and a
+        # superseded scrub (or its deep worker) must never write stats
+        # over a newer one's
+        with pg.lock:
+            pg._scrub_seq = getattr(pg, "_scrub_seq", 0) + 1
+            seq = pg._scrub_seq
+            pg.scrub_stats = {"state": "queued"}
+        self.op_wq.queue(pg.pgid, pg.scrub, seq, deep, klass="scrub",
                          priority=self.recovery_op_priority)
         return True
 

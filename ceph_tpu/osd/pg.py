@@ -416,11 +416,15 @@ class PG:
                 inv[oid] = (-1, 0, 0)   # unreadable shard: scrub error
         return inv
 
-    def scrub(self, deep: bool = False) -> dict | None:
+    def scrub(self, seq: int | None = None,
+              deep: bool = False) -> dict | None:
         """Primary-driven scrub: collect per-object (version, crc, size)
         from every acting peer, compare against the local copy, and
         push repairs for mismatches. Returns immediately; results land
         in self.scrub_stats once all replies arrive.
+
+        seq is the ticket minted by OSDDaemon.scrub_pg (None = direct
+        call: mint one here); a superseded ticket aborts silently.
 
         deep=True on an EC pool additionally verifies every shard's
         stored crc against the write-time hinfo record and rebuilds
@@ -430,8 +434,11 @@ class PG:
             return None
         shards = self.acting_shards()
         with self.lock:
-            self._scrub_seq = getattr(self, "_scrub_seq", 0) + 1
-            seq = self._scrub_seq
+            if seq is None:
+                self._scrub_seq = getattr(self, "_scrub_seq", 0) + 1
+                seq = self._scrub_seq
+            elif seq != getattr(self, "_scrub_seq", 0):
+                return None  # a newer scrub_pg superseded this ticket
             self._scrub_deep = deep
             self._scrub_waiting = {
                 osd for shard, osd in shards.items()
@@ -496,6 +503,7 @@ class PG:
         ties; mismatches are repaired by pushing it."""
         with self.lock:
             seq = getattr(self, "_scrub_seq", 0)
+            deep = getattr(self, "_scrub_deep", False)
             replies = {k: dict(v)
                        for k, v in self._scrub_replies.items()}
         local = self._scrub_inventory(
@@ -520,7 +528,7 @@ class PG:
                     self._push_object(oid, shard, peer_osd, force=True)
                     shallow_repaired.add((peer_osd, shard, oid))
                     repaired += 1
-        if not replicated and getattr(self, "_scrub_deep", False):
+        if not replicated and deep:
             # the deep pass reconstructs objects through the normal EC
             # read path, whose sub-read replies are served by THIS PG's
             # shard worker — run it on its own thread so waiting for
@@ -543,10 +551,20 @@ class PG:
                              daemon=True).start()
             return
         with self.lock:
-            self.scrub_stats = {
-                "state": "clean" if errors == repaired else "inconsistent",
+            if seq != getattr(self, "_scrub_seq", 0):
+                return  # superseded mid-finish: don't clobber stats
+            stats = {
+                "state": "clean" if errors == repaired
+                else "inconsistent",
                 "errors": errors, "repaired": repaired,
                 "objects": len(local)}
+            if deep:
+                # for replicated pools the shallow crc comparison IS
+                # the deep check (all copies hold identical bytes);
+                # mark completion either way so pollers keying on the
+                # 'deep' flag terminate
+                stats["deep"] = True
+            self.scrub_stats = stats
 
     def _deep_scrub_ec(self, local_inv: dict, replies: dict,
                        already_repaired: set) -> tuple[int, int]:
@@ -599,23 +617,8 @@ class PG:
                 rebuilt = bytes(got[0])
                 if (zlib.crc32(rebuilt) & 0xFFFFFFFF) != want_crc:
                     continue    # survivors are bad too: do NOT launder
-                # carry the full metadata set like _push_object does:
-                # handle_push removes+rewrites, so omitting hinfo/omap
-                # would permanently strip them from the repaired shard
-                src_cid = self.cid_of_shard(my_shard)
-                attrs = {}
-                for name in (VERSION_ATTR, "_size", "hinfo_key"):
-                    try:
-                        val = self.store.getattr(src_cid, oid, name)
-                    except KeyError:
-                        val = None
-                    if val is not None:
-                        attrs[name] = val
+                attrs, omap = self._gather_push_meta(oid)
                 attrs.setdefault(VERSION_ATTR, str(version).encode())
-                try:
-                    omap = self.store.omap_get(src_cid, oid)
-                except KeyError:
-                    omap = {}
                 push = MOSDPGPush(
                     pgid=self.pgid, from_osd=self.whoami, shard=shard,
                     oid=oid, data=rebuilt, attrs=attrs, omap=omap,
@@ -681,20 +684,29 @@ class PG:
         of an object: push it to the requester's shard."""
         self._push_object(msg.oid, msg.shard, msg.from_osd)
 
-    def _push_object(self, oid, shard: int, peer_osd: int,
-                     force: bool = False) -> None:
+    def _gather_push_meta(self, oid) -> tuple[dict, dict]:
+        """(attrs, omap) from our local shard for a recovery/repair
+        push — handle_push removes+rewrites the target, so the push
+        must carry the full metadata set or the target loses it."""
         src_cid = self.cid_of_shard(
             self.my_shard() if self.pool.is_erasure() else -1)
-        try:
-            attrs = {}
-            for name in (VERSION_ATTR, "_size",
-                         "hinfo_key"):
+        attrs: dict = {}
+        for name in (VERSION_ATTR, "_size", "hinfo_key"):
+            try:
                 val = self.store.getattr(src_cid, oid, name)
-                if val is not None:
-                    attrs[name] = val
+            except KeyError:
+                val = None
+            if val is not None:
+                attrs[name] = val
+        try:
             omap = self.store.omap_get(src_cid, oid)
         except KeyError:
-            attrs, omap = {}, {}
+            omap = {}
+        return attrs, omap
+
+    def _push_object(self, oid, shard: int, peer_osd: int,
+                     force: bool = False) -> None:
+        attrs, omap = self._gather_push_meta(oid)
 
         def on_data(data):
             if data is None:

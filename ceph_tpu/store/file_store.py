@@ -31,6 +31,8 @@ import hashlib
 import os
 import pickle
 
+from ..compressor import compress_if_worthwhile
+from ..compressor import create as compressor_create
 from .mem_store import MemStore
 from .object_store import Collection, Transaction
 from .wal import FramedLog, fsync_dir, write_atomic
@@ -40,8 +42,17 @@ __all__ = ["FileStore"]
 
 class FileStore(MemStore):
     def __init__(self, path: str, finisher=None, journal_sync: bool = True,
-                 sync_threshold: int = 64 << 20):
+                 sync_threshold: int = 64 << 20,
+                 compression: str = "none",
+                 compression_required_ratio: float = 0.875):
         super().__init__(finisher=finisher)
+        # BlueStore-style blob compression for checkpointed object data
+        # (journal entries stay raw: they are short-lived and fsynced
+        # on the latency path). The required-ratio gate keeps
+        # incompressible data stored raw.
+        self._compressor = compressor_create(compression)
+        self._required_ratio = compression_required_ratio
+        self._decompressors: dict = {}   # alg -> Compressor (mount path)
         self.path = path
         self.journal_path = os.path.join(path, "journal")
         self.commit_seq_path = os.path.join(path, "commit_seq")
@@ -99,7 +110,14 @@ class FileStore(MemStore):
             coll = self._colls.setdefault(doc["cid"],
                                           Collection(doc["cid"]))
             obj = coll.objects[doc["oid"]] = self.make_object()
-            obj.data = bytearray(doc["data"])
+            data = doc["data"]
+            alg = doc.get("compression")
+            if alg:
+                d = self._decompressors.get(alg)
+                if d is None:
+                    d = self._decompressors[alg] = compressor_create(alg)
+                data = d.decompress(data)
+            obj.data = bytearray(data)
             obj.xattrs = dict(doc["xattrs"])
             obj.omap = dict(doc["omap"])
 
@@ -192,8 +210,12 @@ class FileStore(MemStore):
                 obj = coll.objects.get(oid) if coll else None
                 if obj is None:
                     continue
+                alg, payload = compress_if_worthwhile(
+                    self._compressor, bytes(obj.data),
+                    self._required_ratio)
                 write_atomic(self._obj_path(cid, oid), pickle.dumps({
-                    "cid": cid, "oid": oid, "data": bytes(obj.data),
+                    "cid": cid, "oid": oid, "data": payload,
+                    "compression": alg,
                     "xattrs": obj.xattrs, "omap": obj.omap}))
             fsync_dir(self.current_dir)
             write_atomic(self.commit_seq_path, str(seq).encode("ascii"))

@@ -1,0 +1,155 @@
+"""ceph: the cluster administration CLI.
+
+Counterpart of the reference's `ceph` command (src/ceph.in): cluster
+status/health summaries, pool and EC-profile management, OSD state
+changes, and map inspection — all through the monitor command surface
+(MMonCommand) plus locally computed views of the subscribed osdmap
+(exactly where `ceph -s` data lives in the reference).
+
+  ceph --monmap /tmp/monmap status
+  ceph --monmap /tmp/monmap osd pool create data --size 2
+  ceph --monmap /tmp/monmap osd pool create ecpool --erasure \
+       --profile plugin=jax_tpu,technique=reed_sol_van,k=2,m=1
+  ceph --monmap /tmp/monmap osd out 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..client.rados import RadosClient
+from ..common.context import Context
+from .rados_cli import parse_monmap
+
+
+def connect(args) -> RadosClient:
+    client = RadosClient(parse_monmap(args), Context(name="ceph-cli"))
+    client.connect()
+    return client
+
+
+def cluster_status(m) -> str:
+    exists = [o for o in range(m.max_osd) if m.exists(o)]
+    ups = sum(1 for o in exists if m.is_up(o))
+    ins = sum(1 for o in exists if m.is_in(o))
+    health = "HEALTH_OK" if ups == len(exists) == ins else "HEALTH_WARN"
+    lines = [
+        "  cluster:",
+        "    health: %s" % health,
+        "",
+        "  services:",
+        "    osd: %d osds: %d up, %d in" % (len(exists), ups, ins),
+        "",
+        "  data:",
+        "    pools:   %d pools, %d pgs"
+        % (len(m.pools), sum(p.pg_num for p in m.pools.values())),
+        "    osdmap epoch: e%d" % m.epoch,
+    ]
+    return "\n".join(lines)
+
+
+def health(m) -> str:
+    problems = []
+    for o in range(m.max_osd):
+        if m.exists(o) and not m.is_up(o):
+            problems.append("osd.%d is down" % o)
+        elif m.exists(o) and not m.is_in(o):
+            problems.append("osd.%d is out" % o)
+    if not problems:
+        return "HEALTH_OK"
+    return "HEALTH_WARN %d osds down/out\n%s" % (
+        len(problems), "\n".join("    " + p for p in problems))
+
+
+def osd_tree(m) -> str:
+    lines = ["ID  STATUS  REWEIGHT  NAME"]
+    for o in range(m.max_osd):
+        if not m.exists(o):
+            continue
+        lines.append("%-3d %-7s %.5f   osd.%d"
+                     % (o, "up" if m.is_up(o) else "down",
+                        m.osd_weight[o] / 0x10000, o))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ceph",
+                                description="cluster admin utility")
+    p.add_argument("--monmap")
+    p.add_argument("--mon", action="append")
+    p.add_argument("words", nargs="+",
+                   help="command, e.g.: status | health | osd tree | "
+                        "osd pool ls | osd pool create NAME | "
+                        "osd out/in/down ID | osd dump")
+    p.add_argument("-s", "--size", type=int, default=None)
+    p.add_argument("--pg-num", type=int, default=8)
+    p.add_argument("--erasure", action="store_true")
+    p.add_argument("--profile", default="",
+                   help="EC profile k=v comma list (with --erasure)")
+    args = p.parse_args(argv)
+    client = connect(args)
+    try:
+        w = args.words
+        m = client.osdmap
+        if w == ["status"] or w == ["-s"]:
+            sys.stdout.write(cluster_status(m) + "\n")
+            return 0
+        if w == ["health"]:
+            out = health(m)
+            sys.stdout.write(out + "\n")
+            return 0 if out == "HEALTH_OK" else 1
+        if w == ["osd", "tree"] or w == ["osd", "stat"]:
+            sys.stdout.write(osd_tree(m) + "\n")
+            return 0
+        if w == ["osd", "dump"]:
+            res, outs, data = client.mon_command({"prefix": "osd dump"})
+            sys.stdout.write(json.dumps(data, indent=1, default=str)
+                             + "\n")
+            return 0 if res == 0 else 1
+        if w == ["osd", "pool", "ls"]:
+            for pool in m.pools.values():
+                sys.stdout.write("%s\n" % pool.name)
+            return 0
+        if len(w) == 4 and w[:3] == ["osd", "pool", "create"]:
+            name = w[3]
+            cmd = {"prefix": "osd pool create", "pool": name,
+                   "pg_num": args.pg_num}
+            if args.erasure:
+                profile = dict(kv.split("=", 1)
+                               for kv in args.profile.split(",") if kv)
+                pname = name + "-profile"
+                res, outs, _ = client.mon_command({
+                    "prefix": "osd erasure-code-profile set",
+                    "name": pname, "profile": profile})
+                if res != 0:
+                    sys.stderr.write("ceph: %s\n" % outs)
+                    return 1
+                cmd["pool_type"] = "erasure"
+                cmd["erasure_code_profile"] = pname
+            elif args.size is not None:
+                cmd["size"] = args.size
+            res, outs, _ = client.mon_command(cmd)
+            sys.stdout.write("%s\n" % (outs or "pool '%s' created" % name))
+            return 0 if res == 0 else 1
+        if len(w) == 3 and w[0] == "osd" and w[1] in ("out", "in",
+                                                      "down"):
+            res, outs, _ = client.mon_command(
+                {"prefix": "osd %s" % w[1], "id": int(w[2])})
+            sys.stdout.write("%s\n" % (outs or "marked %s osd.%s"
+                                       % (w[1], w[2])))
+            return 0 if res == 0 else 1
+        if len(w) >= 4 and w[:2] == ["pg", "scrub"] or \
+                (len(w) >= 1 and w[0] == "pg"):
+            sys.stderr.write("ceph: pg commands run through the OSD "
+                             "admin surface (scrub_pg)\n")
+            return 1
+        sys.stderr.write("ceph: unknown command %r\n" % " ".join(w))
+        return 1
+    finally:
+        client.shutdown()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

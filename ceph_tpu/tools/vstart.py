@@ -96,7 +96,12 @@ def main(argv=None) -> int:
             from ..store.file_store import FileStore
             path = os.path.join(args.data, "osd.%d" % osd_id)
             os.makedirs(path, exist_ok=True)
-            store = FileStore(path)
+            store = FileStore(
+                path,
+                compression=str(overrides.get(
+                    "filestore_compression", "none")),
+                compression_required_ratio=float(overrides.get(
+                    "filestore_compression_required_ratio", 0.875)))
         osd = OSDDaemon(osd_id, monmap,
                         Context(overrides, name="osd.%d" % osd_id),
                         store=store)

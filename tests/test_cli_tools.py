@@ -25,6 +25,13 @@ def rados(monmap, *argv):
         capture_output=True, text=True, timeout=120, env=ENV, cwd=REPO)
 
 
+def ceph(monmap, *argv):
+    return subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.tools.ceph_cli",
+         "--monmap", monmap, *argv],
+        capture_output=True, text=True, timeout=120, env=ENV, cwd=REPO)
+
+
 @pytest.fixture(scope="module")
 def vstart_cluster(tmp_path_factory):
     tmp = tmp_path_factory.mktemp("vstart")
@@ -82,6 +89,42 @@ class TestRadosCli:
                      "obj1").returncode == 0
         r = rados(monmap, "-p", "clidata", "ls")
         assert "obj1" not in r.stdout
+
+    def test_ceph_cli_admin_flow(self, vstart_cluster):
+        monmap = vstart_cluster
+        r = ceph(monmap, "status")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "health: HEALTH_OK" in r.stdout
+        assert "3 up, 3 in" in r.stdout
+        assert ceph(monmap, "health").stdout.strip() == "HEALTH_OK"
+        r = ceph(monmap, "osd", "tree")
+        assert "osd.2" in r.stdout and "up" in r.stdout
+        # replicated + EC pool creation through the CLI
+        r = ceph(monmap, "osd", "pool", "create", "cephpool",
+                 "--size", "2")
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = ceph(monmap, "osd", "pool", "create", "cephec", "--erasure",
+                 "--profile",
+                 "plugin=jerasure,technique=reed_sol_van,k=2,m=1")
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = ceph(monmap, "osd", "pool", "ls")
+        assert "cephpool" in r.stdout and "cephec" in r.stdout
+        # osd out -> health degrades -> osd in heals
+        assert ceph(monmap, "osd", "out", "2").returncode == 0
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if ceph(monmap, "health").returncode == 1:
+                break
+            time.sleep(0.3)
+        assert "osd.2 is out" in ceph(monmap, "health").stdout
+        assert ceph(monmap, "osd", "in", "2").returncode == 0
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            r = ceph(monmap, "health")
+            if r.returncode == 0:
+                break
+            time.sleep(0.3)
+        assert r.stdout.strip() == "HEALTH_OK"
 
     def test_bench_write_then_seq(self, vstart_cluster):
         monmap = vstart_cluster
