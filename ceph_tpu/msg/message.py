@@ -167,6 +167,7 @@ class MOSDPGPush(Message):
     version: int = 0
     map_epoch: int = 0
     force: bool = False    # scrub repair: overwrite same-version bitrot
+    delete: bool = False   # divergent-delete propagation: remove, not write
 
 
 @dataclass
@@ -178,6 +179,7 @@ class MOSDPGScan(Message):
     shard: int = -1
     op: str = "request"            # request | reply
     objects: dict = field(default_factory=dict)   # oid -> version
+    deleted: dict = field(default_factory=dict)   # oid -> deleted-at ver
     map_epoch: int = 0
 
 

@@ -197,8 +197,9 @@ class ECBackend:
             op.pending_commits = {s for s, osd in shards.items()
                                   if osd != CRUSH_ITEM_NONE}
             self.waiting_commit.append(op)
-            log_entry = [(op.at_version, oid, "modify")
-                         for oid in op.plan.t.op_map]
+            log_entry = [(op.at_version, oid,
+                          "delete" if obj_op.is_delete() else "modify")
+                         for oid, obj_op in op.plan.t.op_map.items()]
         for shard, osd in shards.items():
             if osd == CRUSH_ITEM_NONE:
                 continue

@@ -46,6 +46,7 @@ class PG:
         self.pg_log: list[tuple] = []
         self.waiting_for_active: list = []
         self._pulling: dict = {}   # oid -> pull sent at (monotonic)
+        self._deleted_log: dict = {}   # oid -> version it was deleted at
         self.scrub_stats: dict = {"state": "never"}
         self._scrub_waiting: set = set()
         self._scrub_replies: dict = {}
@@ -115,9 +116,30 @@ class PG:
         except KeyError:
             return None
 
+    PG_LOG_CAP = 5000
+
     def log_operation(self, log_entries, at_version, shard) -> None:
         with self.lock:
             self.pg_log.extend(log_entries)
+            if len(self.pg_log) > self.PG_LOG_CAP:
+                del self.pg_log[:len(self.pg_log) - self.PG_LOG_CAP]
+            for entry in log_entries:
+                if len(entry) < 3:
+                    continue
+                v, oid, kind = entry[0], entry[1], entry[2]
+                if kind == "delete":
+                    # divergence oracle: "oid was deleted at version v".
+                    # Re-insert so dict-order eviction below stays LRU:
+                    # a re-deleted oid must not keep its ancient slot.
+                    if v > self._deleted_log.get(oid, -1):
+                        self._deleted_log.pop(oid, None)
+                        self._deleted_log[oid] = v
+                elif v > self._deleted_log.get(oid, -1):
+                    # a LATER re-create supersedes the delete record;
+                    # an older (duplicate/retransmitted) modify must not
+                    self._deleted_log.pop(oid, None)
+            while len(self._deleted_log) > self.PG_LOG_CAP:
+                self._deleted_log.pop(next(iter(self._deleted_log)))
             self.last_version = max(self.last_version, at_version)
 
     def _ensure_collections(self) -> None:
@@ -377,12 +399,17 @@ class PG:
 
     def handle_scan(self, msg) -> None:
         if msg.op == "request":
-            # a replica answers with its shard's inventory
+            # a replica answers with its shard's inventory plus its
+            # delete log, so a primary that was down during a delete
+            # learns the object is a ghost instead of re-pushing it
             inv = self._local_inventory(
                 msg.shard if self.pool.is_erasure() else -1)
+            with self.lock:
+                deleted = dict(self._deleted_log)
             self.send_to_osd(msg.from_osd, MOSDPGScan(
                 pgid=self.pgid, from_osd=self.whoami, shard=msg.shard,
-                op="reply", objects=inv, map_epoch=self.map_epoch()))
+                op="reply", objects=inv, deleted=deleted,
+                map_epoch=self.map_epoch()))
             return
         if msg.op == "scrub_request":
             inv = self._scrub_inventory(
@@ -397,7 +424,8 @@ class PG:
                                      msg.objects)
             return
         # primary side: compare against authoritative inventory
-        self._reconcile_inventory(msg.shard, msg.from_osd, msg.objects)
+        self._reconcile_inventory(msg.shard, msg.from_osd, msg.objects,
+                                  getattr(msg, "deleted", {}) or {})
 
     # -- scrub (PG_STATE_SCRUBBING; PrimaryLogPG scrub + repair) --------
 
@@ -643,11 +671,30 @@ class PG:
         return out
 
     def _reconcile_inventory(self, shard: int, peer_osd: int,
-                             peer_inv: dict) -> None:
+                             peer_inv: dict,
+                             peer_deleted: dict | None = None) -> None:
+        peer_deleted = peer_deleted or {}
         want = self._authoritative_inventory()
         missing = [oid for oid, v in want.items()
                    if peer_inv.get(oid, -1) < v]
         for oid in missing:
+            del_v = peer_deleted.get(oid, -1)
+            if del_v >= want.get(oid, -1):
+                # the peer deleted this at/after our version while we
+                # were away: our copy is the ghost — adopt the delete
+                # locally instead of resurrecting it onto the peer
+                with self.lock:
+                    if del_v > self._deleted_log.get(oid, -1):
+                        self._deleted_log.pop(oid, None)
+                        self._deleted_log[oid] = del_v
+                txn = Transaction()
+                if self.pool.is_erasure():
+                    for s in range(self.pool.size):
+                        txn.remove(self.cid_of_shard(s), oid)
+                else:
+                    txn.remove(self.cid_of_shard(-1), oid)
+                self.store.queue_transaction(txn)
+                continue
             self._push_object(oid, shard, peer_osd)
         if peer_osd == self.whoami:
             return
@@ -663,6 +710,17 @@ class PG:
         my_shard = self.my_shard() if self.pool.is_erasure() else -1
         now = _time.monotonic()
         for oid in behind:
+            # the divergence oracle: if OUR log shows the object deleted
+            # at or after the peer's version, the peer holds a ghost —
+            # propagate the delete instead of resurrecting it
+            with self.lock:
+                del_v = self._deleted_log.get(oid, -1)
+            if del_v >= peer_inv[oid]:
+                self.send_to_osd(peer_osd, MOSDPGPush(
+                    pgid=self.pgid, from_osd=self.whoami, shard=shard,
+                    oid=oid, version=del_v,
+                    map_epoch=self.map_epoch(), delete=True))
+                continue
             # in-flight pull tracking: repeated scan replies during
             # churn must not multiply EC reconstructions of the same
             # object; re-pull only after a timeout (lost push)
@@ -740,6 +798,20 @@ class PG:
         # versionless push (source object vanished mid-recovery) must
         # never clobber versioned local data
         self._pulling.pop(msg.oid, None)
+        if msg.delete:
+            # divergent-delete propagation: drop our ghost copy unless
+            # we hold a strictly newer (recreated) version — and record
+            # the delete so that if WE later become primary we can
+            # propagate it instead of pulling the ghost back
+            with self.lock:
+                if msg.version > self._deleted_log.get(msg.oid, -1):
+                    self._deleted_log.pop(msg.oid, None)
+                    self._deleted_log[msg.oid] = msg.version
+            if local_v >= 0 and local_v <= msg.version:
+                txn = Transaction()
+                txn.remove(cid, msg.oid)
+                self.store.queue_transaction(txn)
+            return
         # scrub repairs (force) may overwrite SAME-version bitrot; no
         # push — forced or not — may ever roll back a strictly newer
         # (acked) local copy

@@ -42,6 +42,12 @@ class ObjectOperation:
             and not self.attr_updates and not self.omap_updates \
             and not self.omap_rmkeys
 
+    def is_delete(self) -> bool:
+        """A pure removal: the object ends the transaction gone."""
+        return self.delete_first and self.init_type == "none" \
+            and not self.buffer_updates and self.truncate is None \
+            and not self.attr_updates and not self.omap_updates
+
 
 class PGTransaction:
     def __init__(self):

@@ -39,8 +39,9 @@ class ReplicatedBackend:
         tid = next(self._tids)
         txn = self._physical_txn(pg_txn)
         peers = [o for o in self.pg.acting_osds() if o >= 0]
-        log_entries = [(at_version, oid, "modify")
-                       for oid in pg_txn.op_map]
+        log_entries = [(at_version, oid,
+                        "delete" if op.is_delete() else "modify")
+                       for oid, op in pg_txn.op_map.items()]
         op = _Inflight(tid, on_commit, peers)
         with self.lock:
             self.inflight[tid] = op

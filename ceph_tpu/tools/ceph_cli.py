@@ -135,13 +135,20 @@ def main(argv=None) -> int:
             return 0 if res == 0 else 1
         if len(w) == 3 and w[0] == "osd" and w[1] in ("out", "in",
                                                       "down"):
+            raw_id = w[2]
+            if raw_id.startswith("osd."):  # accept the ceph spelling
+                raw_id = raw_id[4:]
+            try:
+                osd_id = int(raw_id)
+            except ValueError:
+                sys.stderr.write("ceph: invalid osd id %r\n" % w[2])
+                return 1
             res, outs, _ = client.mon_command(
-                {"prefix": "osd %s" % w[1], "id": int(w[2])})
-            sys.stdout.write("%s\n" % (outs or "marked %s osd.%s"
-                                       % (w[1], w[2])))
+                {"prefix": "osd %s" % w[1], "id": osd_id})
+            sys.stdout.write("%s\n" % (outs or "marked %s osd.%d"
+                                       % (w[1], osd_id)))
             return 0 if res == 0 else 1
-        if len(w) >= 4 and w[:2] == ["pg", "scrub"] or \
-                (len(w) >= 1 and w[0] == "pg"):
+        if w[0] == "pg":
             sys.stderr.write("ceph: pg commands run through the OSD "
                              "admin surface (scrub_pg)\n")
             return 1

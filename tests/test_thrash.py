@@ -100,6 +100,88 @@ class TestThrashReplicated:
             cluster.stop()
 
 
+class TestDivergentDeletes:
+    def test_delete_during_downtime_not_resurrected(self):
+        """Object deleted while a replica was down: when the replica
+        revives (with its stale copy) the delete must propagate to it,
+        not the stale copy back into the cluster."""
+        from ceph_tpu.client.rados import RadosError
+        cluster = MiniCluster(num_mons=1, num_osds=3,
+                              conf_overrides=FAST).start()
+        try:
+            client = cluster.client()
+            cluster.create_replicated_pool(client, "deldiv", size=2,
+                                           pg_num=4)
+            ioctx = client.open_ioctx("deldiv")
+            ioctx.write_full("ghost", b"soon to be deleted" * 100)
+            m = client.osdmap
+            pool_id = client.pool_id("deldiv")
+            pgid = m.pools[pool_id].raw_pg_to_pg(
+                m.object_to_pg(pool_id, "ghost"))
+            _, _, acting, primary = m.pg_to_up_acting_osds(pgid)
+            victim = [o for o in acting if o != primary][0]
+            store = cluster.stop_osd(victim)
+            assert wait_until(
+                lambda: cluster.leader().osdmon.osdmap.is_down(victim),
+                15)
+            ioctx.remove("ghost")
+            with pytest.raises(RadosError):
+                ioctx.stat("ghost")
+            # revive with the stale store still holding the object
+            cluster.revive_osd(victim, store=store)
+            client.mon_command({"prefix": "osd in", "id": victim})
+            assert wait_until(cluster.all_osds_up, timeout=20)
+            # recovery must propagate the delete to the revived osd...
+            cid = ("pg", str(pgid), -1)
+
+            def ghost_gone():
+                osd = cluster.osds.get(victim)
+                return osd is not None and \
+                    not osd.store.exists(cid, "ghost")
+            assert wait_until(ghost_gone, 20), \
+                "stale copy survived on the revived osd"
+            # ...and the object must stay deleted cluster-wide
+            with pytest.raises(RadosError):
+                ioctx.stat("ghost")
+        finally:
+            cluster.stop()
+
+    def test_recreated_object_not_deleted_by_stale_log(self):
+        """Delete then RE-CREATE at a higher version: the recreation
+        must survive recovery (the delete record is superseded)."""
+        cluster = MiniCluster(num_mons=1, num_osds=3,
+                              conf_overrides=FAST).start()
+        try:
+            client = cluster.client()
+            cluster.create_replicated_pool(client, "recre", size=2,
+                                           pg_num=4)
+            ioctx = client.open_ioctx("recre")
+            ioctx.write_full("phoenix", b"first life")
+            m = client.osdmap
+            pool_id = client.pool_id("recre")
+            pgid = m.pools[pool_id].raw_pg_to_pg(
+                m.object_to_pg(pool_id, "phoenix"))
+            _, _, acting, primary = m.pg_to_up_acting_osds(pgid)
+            victim = [o for o in acting if o != primary][0]
+            store = cluster.stop_osd(victim)
+            assert wait_until(
+                lambda: cluster.leader().osdmon.osdmap.is_down(victim),
+                15)
+            ioctx.remove("phoenix")
+            ioctx.write_full("phoenix", b"second life")
+            cluster.revive_osd(victim, store=store)
+            client.mon_command({"prefix": "osd in", "id": victim})
+            assert wait_until(cluster.all_osds_up, timeout=20)
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                if ioctx.read("phoenix") == b"second life":
+                    break
+                time.sleep(0.3)
+            assert ioctx.read("phoenix") == b"second life"
+        finally:
+            cluster.stop()
+
+
 class TestMessageFaults:
     def test_io_completes_under_socket_failures(self):
         """'ms inject socket failures' analog: lossless retransmit must
