@@ -46,7 +46,30 @@ def lib() -> ctypes.CDLL:
             build()
         except (OSError, subprocess.CalledProcessError) as e:
             raise NativeUnavailable("cannot build native runtime: %s" % e)
+    L = _load_and_configure()
+    _lib = L
+    return L
+
+
+def _load_and_configure(retried: bool = False) -> ctypes.CDLL:
     L = ctypes.CDLL(LIB_PATH, mode=ctypes.RTLD_GLOBAL)
+    try:
+        _configure_symbols(L)
+    except AttributeError as e:
+        # a stale .so from before a symbol was added: rebuild once
+        if retried:
+            raise NativeUnavailable(
+                "native runtime lacks symbol after rebuild: %s" % e)
+        try:
+            build()
+        except (OSError, subprocess.CalledProcessError) as be:
+            raise NativeUnavailable(
+                "stale native runtime and rebuild failed: %s" % be)
+        return _load_and_configure(retried=True)
+    return L
+
+
+def _configure_symbols(L: ctypes.CDLL) -> None:
     L.ec_codec_create.restype = ctypes.c_void_p
     L.ec_codec_create.argtypes = [
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
@@ -74,8 +97,129 @@ def lib() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_char_p]
     for name in ("ec_tpu_batches_dispatched", "ec_tpu_requests_dispatched"):
         getattr(L, name).restype = ctypes.c_uint64
-    _lib = L
-    return L
+    LL = ctypes.POINTER(ctypes.c_longlong)
+    L.ec_crush_do_rule.restype = ctypes.c_int
+    L.ec_crush_do_rule.argtypes = [
+        LL, LL, LL, LL, ctypes.c_int,             # bucket arrays
+        LL, LL,                                   # items, weights
+        LL, ctypes.c_int,                         # steps
+        ctypes.c_longlong, ctypes.c_int,          # x, result_max
+        ctypes.POINTER(ctypes.c_uint), ctypes.c_int,   # weight
+        ctypes.POINTER(ctypes.c_int),             # tunables[6]
+        ctypes.POINTER(ctypes.c_int)]             # result
+    L.ec_crush_ln.restype = ctypes.c_longlong
+    L.ec_crush_ln.argtypes = [ctypes.c_uint]
+    L.ec_crush_hash32_2.restype = ctypes.c_uint
+    L.ec_crush_hash32_2.argtypes = [ctypes.c_uint] * 2
+    L.ec_crush_hash32_3.restype = ctypes.c_uint
+    L.ec_crush_hash32_3.argtypes = [ctypes.c_uint] * 3
+
+
+# ---------------------------------------------------------------------------
+# native CRUSH (ectpu::crush_do_rule_flat over a serialized CrushMap)
+
+_STEP_OPS = {
+    "take": 1, "choose_firstn": 2, "choose_indep": 3, "emit": 4,
+    "chooseleaf_firstn": 6, "chooseleaf_indep": 7,
+    "set_choose_tries": 8, "set_chooseleaf_tries": 9,
+    "set_choose_local_tries": 10, "set_choose_local_fallback_tries": 11,
+    "set_chooseleaf_vary_r": 12, "set_chooseleaf_stable": 13,
+}
+_ALGS = {"uniform": 1, "list": 2, "straw2": 5}
+
+
+def _flatten_map(cmap):
+    """Serialize a CrushMap to the flat arrays the C side consumes,
+    cached on the map object (keyed by a cheap structural fingerprint
+    covering bucket count/ids/items/weights and rule steps, so weight
+    edits or added rules invalidate it)."""
+    import numpy as np
+    fingerprint = (
+        len(cmap.buckets),
+        sum(cmap.buckets),
+        sum(int(b.items.sum()) + int(b.weights.sum())
+            for b in cmap.buckets.values()),
+        sum(len(r.steps) for r in cmap.rules),
+        len(cmap.rules),
+    )
+    cached = getattr(cmap, "_native_flat", None)
+    if cached is not None and cached[0] == fingerprint:
+        return cached[1]
+    bids, algs, types, offs = [], [], [], [0]
+    items, weights = [], []
+    for bid in sorted(cmap.buckets):
+        b = cmap.buckets[bid]
+        if b.alg not in _ALGS:
+            raise NativeUnavailable(
+                "native crush does not support bucket alg %r" % b.alg)
+        bids.append(b.id)
+        algs.append(_ALGS[b.alg])
+        types.append(b.type)
+        items.extend(int(i) for i in b.items)
+        weights.extend(int(w) for w in b.weights)
+        offs.append(len(items))
+    rule_steps = []
+    for rule in cmap.rules:
+        steps = []
+        for step in rule.steps:
+            op = _STEP_OPS.get(step[0])
+            if op is None:
+                raise NativeUnavailable(
+                    "native crush does not support step %r" % (step[0],))
+            a1 = int(step[1]) if len(step) > 1 else 0
+            a2 = int(step[2]) if len(step) > 2 else 0
+            steps.extend([op, a1, a2])
+        rule_steps.append(np.asarray(steps, dtype=np.int64))
+
+    def arr(vals):
+        return np.asarray(vals, dtype=np.int64)
+
+    flat = {"bids": arr(bids), "algs": arr(algs), "types": arr(types),
+            "offs": arr(offs), "items": arr(items),
+            "weights": arr(weights), "rule_steps": rule_steps}
+    cmap._native_flat = (fingerprint, flat)
+    return flat
+
+
+def crush_do_rule_native(cmap, ruleno: int, x: int, result_max: int,
+                         weight=None) -> list[int]:
+    """Run a CrushMap rule through the native mapper; same contract as
+    ceph_tpu.crush.mapper_ref.crush_do_rule (bit-identical results).
+    Raises NativeUnavailable for bucket algs/steps the native side
+    doesn't implement."""
+    import numpy as np
+    L = lib()
+    if ruleno < 0 or ruleno >= len(cmap.rules):
+        return []
+    flat = _flatten_map(cmap)
+    a_steps = flat["rule_steps"][ruleno]
+    if weight is None:
+        weight = [0x10000] * cmap.max_devices
+    t = cmap.tunables
+    tun = np.asarray([t.choose_total_tries, t.choose_local_tries,
+                      t.choose_local_fallback_tries,
+                      t.chooseleaf_descend_once, t.chooseleaf_vary_r,
+                      t.chooseleaf_stable], dtype=np.int32)
+
+    LLp = ctypes.POINTER(ctypes.c_longlong)
+    a_bids, a_algs = flat["bids"], flat["algs"]
+    a_types, a_offs = flat["types"], flat["offs"]
+    a_items, a_weights = flat["items"], flat["weights"]
+    a_rw = np.asarray(weight, dtype=np.uint32)
+    res = np.zeros(max(result_max, 1), dtype=np.int32)
+    n = L.ec_crush_do_rule(
+        a_bids.ctypes.data_as(LLp), a_algs.ctypes.data_as(LLp),
+        a_types.ctypes.data_as(LLp), a_offs.ctypes.data_as(LLp),
+        len(a_bids),
+        a_items.ctypes.data_as(LLp), a_weights.ctypes.data_as(LLp),
+        a_steps.ctypes.data_as(LLp), len(a_steps) // 3,
+        x, result_max,
+        a_rw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint)), len(a_rw),
+        tun.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        res.ctypes.data_as(ctypes.POINTER(ctypes.c_int)))
+    if n < 0:
+        raise NativeUnavailable("native crush rejected the map (%d)" % n)
+    return [int(v) for v in res[:n]]
 
 
 class NativeCodec:

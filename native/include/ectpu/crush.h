@@ -1,0 +1,57 @@
+// Native CRUSH mapper: bit-identical to ceph_tpu/crush/mapper_ref.py
+// (which is itself written from the semantics of the reference's
+// src/crush/mapper.c). The map arrives as flat arrays so the ctypes
+// bridge stays a single call.
+#pragma once
+
+#include <cstdint>
+
+namespace ectpu {
+
+// Rule step opcodes (subset mirrored from crush.h rule ops).
+enum CrushStepOp : int64_t {
+  CRUSH_STEP_TAKE = 1,
+  CRUSH_STEP_CHOOSE_FIRSTN = 2,
+  CRUSH_STEP_CHOOSE_INDEP = 3,
+  CRUSH_STEP_EMIT = 4,
+  CRUSH_STEP_CHOOSELEAF_FIRSTN = 6,
+  CRUSH_STEP_CHOOSELEAF_INDEP = 7,
+  CRUSH_STEP_SET_CHOOSE_TRIES = 8,
+  CRUSH_STEP_SET_CHOOSELEAF_TRIES = 9,
+  CRUSH_STEP_SET_CHOOSE_LOCAL_TRIES = 10,
+  CRUSH_STEP_SET_CHOOSE_LOCAL_FALLBACK_TRIES = 11,
+  CRUSH_STEP_SET_CHOOSELEAF_VARY_R = 12,
+  CRUSH_STEP_SET_CHOOSELEAF_STABLE = 13,
+};
+
+enum CrushAlg : int64_t {
+  CRUSH_ALG_UNIFORM = 1,
+  CRUSH_ALG_LIST = 2,
+  CRUSH_ALG_STRAW2 = 5,
+};
+
+int64_t crush_ln(uint32_t x);
+uint32_t crush_hash32_2(uint32_t a, uint32_t b);
+uint32_t crush_hash32_3(uint32_t a, uint32_t b, uint32_t c);
+
+// Flat-map rule execution. Buckets: parallel arrays of num_buckets
+// entries; items/weights are concatenated per-bucket with
+// bucket_offsets[i]..bucket_offsets[i+1] delimiting bucket i.
+// steps: num_steps triples (op, arg1, arg2). tunables[6]:
+// {choose_total_tries, choose_local_tries,
+//  choose_local_fallback_tries, chooseleaf_descend_once,
+//  chooseleaf_vary_r, chooseleaf_stable}.
+// weight: per-device 16.16 reweights, weight_len entries.
+// Returns result length (<= result_max), or -1 on malformed input.
+int crush_do_rule_flat(
+    const int64_t* bucket_ids, const int64_t* bucket_algs,
+    const int64_t* bucket_types, const int64_t* bucket_offsets,
+    int num_buckets,
+    const int64_t* items, const int64_t* weights,
+    const int64_t* steps, int num_steps,
+    int64_t x, int result_max,
+    const uint32_t* weight, int weight_len,
+    const int32_t* tunables,
+    int32_t* result);
+
+}  // namespace ectpu

@@ -5,6 +5,7 @@
 #include <cstring>
 #include <sstream>
 
+#include "ectpu/crush.h"
 #include "ectpu/registry.h"
 
 namespace {
@@ -144,6 +145,33 @@ int ec_codec_decode(void* codec, const int* avail_ids, int navail,
     memcpy(out + (size_t)i * blocksize, it->second.data(), blocksize);
   }
   return 0;
+}
+
+// native CRUSH mapper (ectpu/crush.h) over flat arrays
+int ec_crush_do_rule(const long long* bucket_ids,
+                     const long long* bucket_algs,
+                     const long long* bucket_types,
+                     const long long* bucket_offsets, int num_buckets,
+                     const long long* items, const long long* weights,
+                     const long long* steps, int num_steps,
+                     long long x, int result_max,
+                     const unsigned* weight, int weight_len,
+                     const int* tunables, int* result) {
+  return ectpu::crush_do_rule_flat(
+      (const int64_t*)bucket_ids, (const int64_t*)bucket_algs,
+      (const int64_t*)bucket_types, (const int64_t*)bucket_offsets,
+      num_buckets, (const int64_t*)items, (const int64_t*)weights,
+      (const int64_t*)steps, num_steps, (int64_t)x, result_max,
+      (const uint32_t*)weight, weight_len, (const int32_t*)tunables,
+      (int32_t*)result);
+}
+
+long long ec_crush_ln(unsigned x) { return ectpu::crush_ln(x); }
+unsigned ec_crush_hash32_2(unsigned a, unsigned b) {
+  return ectpu::crush_hash32_2(a, b);
+}
+unsigned ec_crush_hash32_3(unsigned a, unsigned b, unsigned c) {
+  return ectpu::crush_hash32_3(a, b, c);
 }
 
 }  // extern "C"

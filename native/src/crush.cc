@@ -1,0 +1,555 @@
+// Native CRUSH mapper (straw2 / list / uniform buckets, firstn + indep
+// descent, the full tunable set) — a faithful C++ port of
+// ceph_tpu/crush/mapper_ref.py, which carries the semantics of the
+// reference's src/crush/mapper.c. Placement must be bit-identical to
+// the Python/JAX implementations; tests/test_native_crush.py asserts
+// exhaustive equality.
+
+#include "ectpu/crush.h"
+
+#include <cstring>
+#include <map>
+#include <unordered_map>
+#include <vector>
+
+#include "crush_ln_tables.gen.h"
+
+namespace ectpu {
+
+// ---------------------------------------------------------------------------
+// rjenkins hash (src/crush/hash.c semantics)
+
+#define CRUSH_HASHMIX(a, b, c) do { \
+    a = a - b; a = a - c; a = a ^ (c >> 13); \
+    b = b - c; b = b - a; b = b ^ (a << 8);  \
+    c = c - a; c = c - b; c = c ^ (b >> 13); \
+    a = a - b; a = a - c; a = a ^ (c >> 12); \
+    b = b - c; b = b - a; b = b ^ (a << 16); \
+    c = c - a; c = c - b; c = c ^ (b >> 5);  \
+    a = a - b; a = a - c; a = a ^ (c >> 3);  \
+    b = b - c; b = b - a; b = b ^ (a << 10); \
+    c = c - a; c = c - b; c = c ^ (b >> 15); \
+  } while (0)
+
+static const uint32_t kHashSeed = 1315423911u;
+
+uint32_t crush_hash32_2(uint32_t a, uint32_t b) {
+  uint32_t hash = kHashSeed ^ a ^ b;
+  uint32_t x = 231232u, y = 1232u;
+  CRUSH_HASHMIX(a, b, hash);
+  CRUSH_HASHMIX(x, a, hash);
+  CRUSH_HASHMIX(b, y, hash);
+  return hash;
+}
+
+uint32_t crush_hash32_3(uint32_t a, uint32_t b, uint32_t c) {
+  uint32_t hash = kHashSeed ^ a ^ b ^ c;
+  uint32_t x = 231232u, y = 1232u;
+  CRUSH_HASHMIX(a, b, hash);
+  CRUSH_HASHMIX(c, x, hash);
+  CRUSH_HASHMIX(y, a, hash);
+  CRUSH_HASHMIX(b, x, hash);
+  CRUSH_HASHMIX(y, c, hash);
+  return hash;
+}
+
+static uint32_t crush_hash32_4(uint32_t a, uint32_t b, uint32_t c,
+                               uint32_t d) {
+  uint32_t hash = kHashSeed ^ a ^ b ^ c ^ d;
+  uint32_t x = 231232u, y = 1232u;
+  CRUSH_HASHMIX(a, b, hash);
+  CRUSH_HASHMIX(c, d, hash);
+  CRUSH_HASHMIX(a, x, hash);
+  CRUSH_HASHMIX(y, b, hash);
+  CRUSH_HASHMIX(c, x, hash);
+  CRUSH_HASHMIX(y, d, hash);
+  return hash;
+}
+
+// ---------------------------------------------------------------------------
+// crush_ln: 2^44 * log2(x + 1), fixed point (mapper.c:247-290 semantics)
+
+int64_t crush_ln(uint32_t xin) {
+  int64_t x = (int64_t)xin + 1;
+
+  int64_t iexpon;
+  if ((x & 0x18000) == 0) {
+    // normalize so bit 15 is the top bit
+    int fl = 0;
+    for (int64_t t = x; t > 1; t >>= 1) fl++;
+    int bits = 15 - fl;
+    x <<= bits;
+    iexpon = fl;
+  } else {
+    iexpon = 15;
+  }
+
+  int64_t index1 = (x >> 8) << 1;
+  int64_t rh = CRUSH_RH_LH_TBL[index1 - 256];
+  int64_t lh = CRUSH_RH_LH_TBL[index1 + 1 - 256];
+
+  // deliberate wrap like the C (__s64) multiply for x = 0x10000
+  uint64_t prod = (uint64_t)x * (uint64_t)rh;
+  int64_t xl64 = (int64_t)prod >> 48;
+  int64_t index2 = xl64 & 0xFF;
+  int64_t ll = CRUSH_LL_TBL[index2];
+
+  int64_t result = iexpon << 44;
+  result = result + ((lh + ll) >> 4);
+  return result;
+}
+
+static const int64_t kLnMinOffset = 0x1000000000000LL;  // 2^48
+static const int64_t kS64Min = INT64_MIN;
+static const int64_t kItemUndef = 0x7FFFFFFE;
+static const int64_t kItemNone = 0x7FFFFFFF;
+
+// ---------------------------------------------------------------------------
+// in-memory map built from the flat arrays
+
+struct Bucket {
+  int64_t id;
+  int64_t alg;
+  int64_t type;
+  std::vector<int64_t> items;
+  std::vector<int64_t> weights;
+  std::vector<int64_t> sums;  // cumulative, for list buckets
+
+  size_t size() const { return items.size(); }
+};
+
+struct Map {
+  std::unordered_map<int64_t, const Bucket*> by_id;
+  std::vector<Bucket> buckets;
+  int64_t max_devices = 0;
+};
+
+struct PermState {
+  uint32_t perm_x = 0;
+  uint32_t perm_n = 0;
+  std::vector<int> perm;
+};
+
+struct Work {
+  std::map<int64_t, PermState> perm;  // bucket id -> state
+
+  PermState& get(const Bucket& b) {
+    PermState& st = perm[b.id];
+    if (st.perm.empty()) st.perm.assign(b.size(), 0);
+    return st;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// bucket choose (mapper_ref.py _bucket_*_choose)
+
+static int64_t bucket_perm_choose(const Bucket& b, Work& work, int64_t x,
+                                  int64_t r) {
+  PermState& st = work.get(b);
+  size_t pr = (size_t)(((uint64_t)r) % b.size());
+  if (st.perm_x != (uint32_t)x || st.perm_n == 0) {
+    st.perm_x = (uint32_t)x;
+    if (pr == 0) {
+      size_t s = crush_hash32_3((uint32_t)x, (uint32_t)b.id, 0) % b.size();
+      st.perm[0] = (int)s;
+      st.perm_n = 0xFFFF;
+      return b.items[s];
+    }
+    for (size_t i = 0; i < b.size(); ++i) st.perm[i] = (int)i;
+    st.perm_n = 0;
+  } else if (st.perm_n == 0xFFFF) {
+    for (size_t i = 1; i < b.size(); ++i) st.perm[i] = (int)i;
+    st.perm[st.perm[0]] = 0;
+    st.perm_n = 1;
+  }
+  while (st.perm_n <= pr) {
+    uint32_t p = st.perm_n;
+    if (p < b.size() - 1) {
+      uint32_t i = crush_hash32_3((uint32_t)x, (uint32_t)b.id, p)
+          % (uint32_t)(b.size() - p);
+      if (i) std::swap(st.perm[p + i], st.perm[p]);
+    }
+    st.perm_n++;
+  }
+  return b.items[st.perm[pr]];
+}
+
+static int64_t bucket_list_choose(const Bucket& b, int64_t x, int64_t r) {
+  for (int i = (int)b.size() - 1; i >= 0; --i) {
+    uint64_t w = crush_hash32_4((uint32_t)x, (uint32_t)b.items[i],
+                                (uint32_t)r, (uint32_t)b.id) & 0xFFFF;
+    w = (w * (uint64_t)b.sums[i]) >> 16;
+    if ((int64_t)w < b.weights[i]) return b.items[i];
+  }
+  return b.items[0];
+}
+
+static int64_t bucket_straw2_choose(const Bucket& b, int64_t x, int64_t r) {
+  size_t high = 0;
+  int64_t high_draw = 0;
+  for (size_t i = 0; i < b.size(); ++i) {
+    int64_t wt = b.weights[i];
+    int64_t draw;
+    if (wt) {
+      uint32_t u = crush_hash32_3((uint32_t)x, (uint32_t)b.items[i],
+                                  (uint32_t)r) & 0xFFFF;
+      int64_t lnv = crush_ln(u) - kLnMinOffset;
+      // div64_s64 truncation toward zero: lnv <= 0, wt > 0
+      draw = -((-lnv) / wt);
+    } else {
+      draw = kS64Min;
+    }
+    if (i == 0 || draw > high_draw) {
+      high = i;
+      high_draw = draw;
+    }
+  }
+  return b.items[high];
+}
+
+static int64_t bucket_choose(const Bucket& b, Work& work, int64_t x,
+                             int64_t r) {
+  switch (b.alg) {
+    case CRUSH_ALG_UNIFORM: return bucket_perm_choose(b, work, x, r);
+    case CRUSH_ALG_LIST:    return bucket_list_choose(b, x, r);
+    case CRUSH_ALG_STRAW2:  return bucket_straw2_choose(b, x, r);
+    default:                return kItemNone;
+  }
+}
+
+static bool is_out(const uint32_t* weight, int weight_len, int64_t item,
+                   int64_t x) {
+  if (item >= weight_len) return true;
+  uint32_t w = weight[item];
+  if (w >= 0x10000) return false;
+  if (w == 0) return true;
+  return (crush_hash32_2((uint32_t)x, (uint32_t)item) & 0xFFFF) >= w;
+}
+
+// ---------------------------------------------------------------------------
+// firstn / indep descent (mapper_ref.py _choose_firstn / _choose_indep)
+
+struct Params {
+  const Map* map;
+  const uint32_t* weight;
+  int weight_len;
+  int64_t max_devices;
+};
+
+static int choose_firstn(const Params& P, Work& work, const Bucket& bucket,
+                         int64_t x, int numrep, int64_t type,
+                         std::vector<int64_t>& out, int outpos, int out_size,
+                         int tries, int recurse_tries, int local_retries,
+                         int local_fallback_retries, bool recurse_to_leaf,
+                         int vary_r, int stable,
+                         std::vector<int64_t>* out2, int64_t parent_r) {
+  int count = out_size;
+  int rep = stable ? 0 : outpos;
+  while (rep < numrep && count > 0) {
+    int ftotal = 0;
+    bool skip_rep = false;
+    int64_t item = 0;
+    while (true) {  // retry_descent
+      bool retry_descent = false;
+      const Bucket* in_bucket = &bucket;
+      int flocal = 0;
+      while (true) {  // retry_bucket
+        bool retry_bucket = false;
+        bool collide = false;
+        bool reject = false;
+        int64_t r = rep + parent_r + ftotal;
+        if (in_bucket->size() == 0) {
+          reject = true;
+        } else {
+          if (local_fallback_retries > 0 &&
+              flocal >= (int)(in_bucket->size() >> 1) &&
+              flocal > local_fallback_retries) {
+            item = bucket_perm_choose(*in_bucket, work, x, r);
+          } else {
+            item = bucket_choose(*in_bucket, work, x, r);
+          }
+          if (item >= P.max_devices) { skip_rep = true; break; }
+          auto it = P.map->by_id.find(item);
+          if (item < 0 && it == P.map->by_id.end()) {
+            skip_rep = true;
+            break;
+          }
+          int64_t itemtype = item < 0 ? it->second->type : 0;
+          if (itemtype != type) {
+            if (item >= 0) { skip_rep = true; break; }
+            in_bucket = it->second;
+            continue;  // retry_bucket without counting a failure
+          }
+          for (int i = 0; i < outpos; ++i) {
+            if (out[i] == item) { collide = true; break; }
+          }
+          if (!collide && recurse_to_leaf) {
+            if (item < 0) {
+              int64_t sub_r = vary_r ? (r >> (vary_r - 1)) : 0;
+              if (choose_firstn(P, work, *it->second, x,
+                                stable ? 1 : outpos + 1, 0,
+                                *out2, outpos, count, recurse_tries, 0,
+                                local_retries, local_fallback_retries,
+                                false, vary_r, stable, nullptr,
+                                sub_r) <= outpos) {
+                reject = true;
+              }
+            } else {
+              (*out2)[outpos] = item;
+            }
+          }
+          if (!reject && !collide && itemtype == 0) {
+            reject = is_out(P.weight, P.weight_len, item, x);
+          }
+        }
+        if (reject || collide) {
+          ftotal++;
+          flocal++;
+          if (collide && flocal <= local_retries) {
+            retry_bucket = true;
+          } else if (local_fallback_retries > 0 &&
+                     flocal <= (int)in_bucket->size() +
+                               local_fallback_retries) {
+            retry_bucket = true;
+          } else if (ftotal < tries) {
+            retry_descent = true;
+          } else {
+            skip_rep = true;
+          }
+          if (!retry_bucket) break;
+        } else {
+          break;  // success
+        }
+      }
+      if (!retry_descent) break;
+    }
+    if (!skip_rep) {
+      out[outpos] = item;
+      outpos++;
+      count--;
+    }
+    rep++;
+  }
+  return outpos;
+}
+
+static void choose_indep(const Params& P, Work& work, const Bucket& bucket,
+                         int64_t x, int left, int numrep, int64_t type,
+                         std::vector<int64_t>& out, int outpos, int tries,
+                         int recurse_tries, bool recurse_to_leaf,
+                         std::vector<int64_t>* out2, int64_t parent_r) {
+  int endpos = outpos + left;
+  for (int rep = outpos; rep < endpos; ++rep) {
+    out[rep] = kItemUndef;
+    if (out2) (*out2)[rep] = kItemUndef;
+  }
+  int ftotal = 0;
+  while (left > 0 && ftotal < tries) {
+    for (int rep = outpos; rep < endpos; ++rep) {
+      if (out[rep] != kItemUndef) continue;
+      const Bucket* in_bucket = &bucket;
+      while (true) {
+        int64_t r = rep + parent_r;
+        if (in_bucket->alg == CRUSH_ALG_UNIFORM &&
+            in_bucket->size() % (size_t)numrep == 0) {
+          r += (int64_t)(numrep + 1) * ftotal;
+        } else {
+          r += (int64_t)numrep * ftotal;
+        }
+        if (in_bucket->size() == 0) break;
+        int64_t item = bucket_choose(*in_bucket, work, x, r);
+        auto it = item < 0 ? P.map->by_id.find(item)
+                           : P.map->by_id.end();
+        if (item >= P.max_devices ||
+            (item < 0 && it == P.map->by_id.end())) {
+          out[rep] = kItemNone;
+          if (out2) (*out2)[rep] = kItemNone;
+          left--;
+          break;
+        }
+        int64_t itemtype = item < 0 ? it->second->type : 0;
+        if (itemtype != type) {
+          if (item >= 0) {
+            out[rep] = kItemNone;
+            if (out2) (*out2)[rep] = kItemNone;
+            left--;
+            break;
+          }
+          in_bucket = it->second;
+          continue;
+        }
+        bool collide = false;
+        for (int i = outpos; i < endpos; ++i) {
+          if (out[i] == item) { collide = true; break; }
+        }
+        if (collide) break;
+        if (recurse_to_leaf) {
+          if (item < 0) {
+            choose_indep(P, work, *it->second, x, 1, numrep, 0, *out2,
+                         rep, recurse_tries, 0, false, nullptr, r);
+            if ((*out2)[rep] == kItemNone) break;
+          } else {
+            (*out2)[rep] = item;
+          }
+        }
+        if (itemtype == 0 && is_out(P.weight, P.weight_len, item, x)) {
+          break;
+        }
+        out[rep] = item;
+        left--;
+        break;
+      }
+    }
+    ftotal++;
+  }
+  for (int rep = outpos; rep < endpos; ++rep) {
+    if (out[rep] == kItemUndef) out[rep] = kItemNone;
+    if (out2 && (*out2)[rep] == kItemUndef) (*out2)[rep] = kItemNone;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// rule interpreter (mapper_ref.py crush_do_rule)
+
+int crush_do_rule_flat(
+    const int64_t* bucket_ids, const int64_t* bucket_algs,
+    const int64_t* bucket_types, const int64_t* bucket_offsets,
+    int num_buckets,
+    const int64_t* items, const int64_t* weights,
+    const int64_t* steps, int num_steps,
+    int64_t x, int result_max,
+    const uint32_t* weight, int weight_len,
+    const int32_t* tunables,
+    int32_t* result) {
+  Map map;
+  map.buckets.reserve(num_buckets);
+  for (int i = 0; i < num_buckets; ++i) {
+    Bucket b;
+    b.id = bucket_ids[i];
+    b.alg = bucket_algs[i];
+    b.type = bucket_types[i];
+    int64_t beg = bucket_offsets[i], end = bucket_offsets[i + 1];
+    if (beg > end || b.id >= 0) return -1;
+    int64_t sum = 0;
+    for (int64_t j = beg; j < end; ++j) {
+      b.items.push_back(items[j]);
+      b.weights.push_back(weights[j]);
+      sum += weights[j];
+      b.sums.push_back(sum);
+      if (items[j] >= 0 && items[j] + 1 > map.max_devices)
+        map.max_devices = items[j] + 1;
+    }
+    map.buckets.push_back(std::move(b));
+  }
+  for (const Bucket& b : map.buckets) map.by_id[b.id] = &b;
+
+  int choose_tries = tunables[0] + 1;
+  int choose_leaf_tries = 0;
+  int choose_local_retries = tunables[1];
+  int choose_local_fallback_retries = tunables[2];
+  int descend_once = tunables[3];
+  int vary_r = tunables[4];
+  int stable = tunables[5];
+
+  Params P{&map, weight, weight_len, map.max_devices};
+  Work work;
+  std::vector<int64_t> w;
+  std::vector<int64_t> res;
+
+  for (int s = 0; s < num_steps; ++s) {
+    int64_t op = steps[s * 3];
+    int64_t a1 = steps[s * 3 + 1];
+    int64_t a2 = steps[s * 3 + 2];
+    switch (op) {
+      case CRUSH_STEP_TAKE: {
+        bool dev = a1 >= 0 && a1 < map.max_devices;
+        if (dev || map.by_id.count(a1)) {
+          w.assign(1, a1);
+        }
+        break;
+      }
+      case CRUSH_STEP_SET_CHOOSE_TRIES:
+        if (a1 > 0) choose_tries = (int)a1;
+        break;
+      case CRUSH_STEP_SET_CHOOSELEAF_TRIES:
+        if (a1 > 0) choose_leaf_tries = (int)a1;
+        break;
+      case CRUSH_STEP_SET_CHOOSE_LOCAL_TRIES:
+        if (a1 >= 0) choose_local_retries = (int)a1;
+        break;
+      case CRUSH_STEP_SET_CHOOSE_LOCAL_FALLBACK_TRIES:
+        if (a1 >= 0) choose_local_fallback_retries = (int)a1;
+        break;
+      case CRUSH_STEP_SET_CHOOSELEAF_VARY_R:
+        if (a1 >= 0) vary_r = (int)a1;
+        break;
+      case CRUSH_STEP_SET_CHOOSELEAF_STABLE:
+        if (a1 >= 0) stable = (int)a1;
+        break;
+      case CRUSH_STEP_CHOOSE_FIRSTN:
+      case CRUSH_STEP_CHOOSE_INDEP:
+      case CRUSH_STEP_CHOOSELEAF_FIRSTN:
+      case CRUSH_STEP_CHOOSELEAF_INDEP: {
+        if (w.empty()) break;
+        bool firstn = op == CRUSH_STEP_CHOOSE_FIRSTN ||
+                      op == CRUSH_STEP_CHOOSELEAF_FIRSTN;
+        bool leaf = op == CRUSH_STEP_CHOOSELEAF_FIRSTN ||
+                    op == CRUSH_STEP_CHOOSELEAF_INDEP;
+        std::vector<int64_t> o, c;
+        for (int64_t wi : w) {
+          int numrep = (int)a1;
+          if (numrep <= 0) {
+            numrep += result_max;
+            if (numrep <= 0) continue;
+          }
+          auto it = map.by_id.find(wi);
+          if (wi >= 0 || it == map.by_id.end()) continue;
+          const Bucket& bucket = *it->second;
+          int osize = (int)o.size();
+          if (firstn) {
+            int recurse_tries;
+            if (choose_leaf_tries) recurse_tries = choose_leaf_tries;
+            else if (descend_once) recurse_tries = 1;
+            else recurse_tries = choose_tries;
+            std::vector<int64_t> sub_o(result_max - osize, 0);
+            std::vector<int64_t> sub_c(result_max - osize, 0);
+            int n = choose_firstn(
+                P, work, bucket, x, numrep, a2, sub_o, 0,
+                result_max - osize, choose_tries, recurse_tries,
+                choose_local_retries, choose_local_fallback_retries,
+                leaf, vary_r, stable, &sub_c, 0);
+            o.insert(o.end(), sub_o.begin(), sub_o.begin() + n);
+            c.insert(c.end(), sub_c.begin(), sub_c.begin() + n);
+          } else {
+            int out_size = numrep < result_max - osize
+                               ? numrep : result_max - osize;
+            std::vector<int64_t> sub_o(out_size, 0);
+            std::vector<int64_t> sub_c(out_size, 0);
+            choose_indep(P, work, bucket, x, out_size, numrep, a2,
+                         sub_o, 0, choose_tries,
+                         choose_leaf_tries ? choose_leaf_tries : 1,
+                         leaf, &sub_c, 0);
+            o.insert(o.end(), sub_o.begin(), sub_o.end());
+            c.insert(c.end(), sub_c.begin(), sub_c.end());
+          }
+        }
+        w = leaf ? c : o;
+        break;
+      }
+      case CRUSH_STEP_EMIT: {
+        for (int64_t v : w) {
+          if ((int)res.size() >= result_max) break;
+          res.push_back(v);
+        }
+        w.clear();
+        break;
+      }
+      default:
+        return -1;
+    }
+  }
+  for (size_t i = 0; i < res.size(); ++i) result[i] = (int32_t)res[i];
+  return (int)res.size();
+}
+
+}  // namespace ectpu

@@ -1,0 +1,169 @@
+"""Native C++ CRUSH mapper: bit-exact parity with the Python mapper.
+
+The native runtime carries its own mapper (native/src/crush.cc); like
+the JAX batched path, its contract is exhaustive equality with
+ceph_tpu.crush.mapper_ref (itself differentially tested against the
+reference C core). Sweeps algs, firstn/indep, chooseleaf, reweights,
+tunables, and randomized hierarchies.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu import native
+from ceph_tpu.crush.hashing import hash32_2, hash32_3
+from ceph_tpu.crush.ln import crush_ln
+from ceph_tpu.crush.map import CrushMap, Rule
+from ceph_tpu.crush.mapper_ref import crush_do_rule
+
+from .test_crush import make_flat, make_two_level
+
+
+def native_or_skip():
+    try:
+        native.lib()
+    except native.NativeUnavailable as e:
+        pytest.skip(str(e))
+
+
+class TestPrimitives:
+    def test_crush_ln_full_domain(self):
+        native_or_skip()
+        L = native.lib()
+        xs = np.arange(0x10000, dtype=np.uint32)
+        ref = crush_ln(xs)
+        for x in list(range(0, 0x10000, 257)) + [0, 1, 0xFFFF]:
+            assert L.ec_crush_ln(x) == int(ref[x]), x
+
+    def test_hashes(self):
+        native_or_skip()
+        L = native.lib()
+        rng = np.random.default_rng(0)
+        for _ in range(200):
+            a, b, c = (int(v) for v in rng.integers(0, 2**32, 3))
+            assert L.ec_crush_hash32_2(a, b) == int(hash32_2(a, b))
+            assert L.ec_crush_hash32_3(a, b, c) == int(hash32_3(a, b, c))
+
+
+def assert_parity(cmap, ruleno, xs, result_max, weight=None):
+    for x in xs:
+        ref = crush_do_rule(cmap, ruleno, x, result_max, weight)
+        nat = native.crush_do_rule_native(cmap, ruleno, x, result_max,
+                                          weight)
+        assert ref == nat, (ruleno, x, ref, nat)
+
+
+class TestRuleParity:
+    @pytest.mark.parametrize("alg", ["straw2", "list", "uniform"])
+    def test_flat_choose_firstn(self, alg):
+        native_or_skip()
+        rng = np.random.default_rng(1)
+        weights = ([0x10000] * 8 if alg == "uniform" else
+                   rng.integers(1, 4 * 0x10000, 8))
+        m = make_flat(8, weights, leaf_alg=alg)
+        m.add_rule(Rule(steps=[("take", -1), ("choose_firstn", 3, 0),
+                               ("emit",)]))
+        assert_parity(m, 0, range(256), 3)
+
+    @pytest.mark.parametrize("alg", ["straw2", "list"])
+    def test_flat_choose_indep(self, alg):
+        native_or_skip()
+        rng = np.random.default_rng(2)
+        m = make_flat(10, rng.integers(1, 3 * 0x10000, 10), leaf_alg=alg)
+        m.add_rule(Rule(steps=[("take", -1), ("choose_indep", 4, 0),
+                               ("emit",)]))
+        assert_parity(m, 0, range(256), 4)
+
+    def test_two_level_chooseleaf_firstn(self):
+        native_or_skip()
+        rng = np.random.default_rng(3)
+        m = make_two_level(4, 3, rng.integers(1, 2 * 0x10000, 12))
+        m.add_rule(Rule(steps=[("take", -1),
+                               ("chooseleaf_firstn", 3, 1), ("emit",)]))
+        assert_parity(m, 0, range(512), 3)
+
+    def test_two_level_chooseleaf_indep(self):
+        native_or_skip()
+        rng = np.random.default_rng(4)
+        m = make_two_level(5, 2, rng.integers(1, 2 * 0x10000, 10))
+        m.add_rule(Rule(steps=[("take", -1),
+                               ("chooseleaf_indep", 4, 1), ("emit",)]))
+        assert_parity(m, 0, range(512), 4)
+
+    def test_reweight_vector(self):
+        native_or_skip()
+        rng = np.random.default_rng(5)
+        m = make_two_level(4, 3, rng.integers(1, 2 * 0x10000, 12))
+        m.add_rule(Rule(steps=[("take", -1),
+                               ("chooseleaf_firstn", 3, 1), ("emit",)]))
+        weight = [0x10000] * 12
+        weight[2] = 0              # out
+        weight[7] = 0x8000         # half reweighted
+        weight[11] = 0x4000
+        assert_parity(m, 0, range(512), 3, weight)
+
+    def test_set_steps_and_numrep_zero(self):
+        native_or_skip()
+        rng = np.random.default_rng(6)
+        m = make_two_level(4, 2, rng.integers(1, 2 * 0x10000, 8))
+        m.add_rule(Rule(steps=[
+            ("set_chooseleaf_tries", 5), ("set_choose_tries", 100),
+            ("take", -1), ("chooseleaf_indep", 0, 1), ("emit",)]))
+        assert_parity(m, 0, range(256), 3)
+
+    def test_tunable_variants(self):
+        native_or_skip()
+        rng = np.random.default_rng(7)
+        m = make_two_level(3, 3, rng.integers(1, 2 * 0x10000, 9))
+        m.add_rule(Rule(steps=[("take", -1),
+                               ("chooseleaf_firstn", 2, 1), ("emit",)]))
+        for vary_r, stable in ((0, 0), (1, 0), (1, 1), (0, 1)):
+            m.tunables.chooseleaf_vary_r = vary_r
+            m.tunables.chooseleaf_stable = stable
+            assert_parity(m, 0, range(128), 2)
+
+    def test_multi_take_emit(self):
+        native_or_skip()
+        rng = np.random.default_rng(8)
+        m = make_two_level(4, 2, rng.integers(1, 2 * 0x10000, 8))
+        # two take/emit blocks, like LRC multi-step rules
+        m.add_rule(Rule(steps=[
+            ("take", -2), ("choose_firstn", 1, 0), ("emit",),
+            ("take", -3), ("choose_firstn", 1, 0), ("emit",)]))
+        assert_parity(m, 0, range(256), 4)
+
+    def test_randomized_hierarchies(self):
+        native_or_skip()
+        rng = np.random.default_rng(9)
+        for trial in range(10):
+            hosts = int(rng.integers(2, 6))
+            devs = int(rng.integers(1, 4))
+            n = hosts * devs
+            m = make_two_level(hosts, devs,
+                               rng.integers(1, 3 * 0x10000, n))
+            op = ["chooseleaf_firstn", "chooseleaf_indep",
+                  "choose_firstn", "choose_indep"][trial % 4]
+            ftype = 1 if op.startswith("chooseleaf") else 0
+            numrep = int(rng.integers(1, min(hosts, 4) + 1))
+            m.add_rule(Rule(steps=[("take", -1), (op, numrep, ftype),
+                                   ("emit",)]))
+            weight = [0x10000] * n
+            for dead in rng.choice(n, size=max(1, n // 4),
+                                   replace=False):
+                weight[int(dead)] = int(rng.choice([0, 0x8000]))
+            assert_parity(m, 0, range(200), numrep, weight)
+
+    def test_batched_jax_native_python_triple_parity(self):
+        """All three mappers (python, JAX-batched, native C++) agree."""
+        native_or_skip()
+        from ceph_tpu.crush.batched import batched_do_rule
+        rng = np.random.default_rng(10)
+        m = make_two_level(4, 3, rng.integers(1, 2 * 0x10000, 12))
+        m.add_rule(Rule(steps=[("take", -1),
+                               ("chooseleaf_indep", 3, 1), ("emit",)]))
+        xs = list(range(128))
+        jax_res = np.asarray(batched_do_rule(m, 0, np.asarray(xs), 3))
+        for x in xs:
+            ref = crush_do_rule(m, 0, x, 3)
+            nat = native.crush_do_rule_native(m, 0, x, 3)
+            assert ref == nat == [int(v) for v in jax_res[x][:len(ref)]]
