@@ -51,21 +51,32 @@ def lib() -> ctypes.CDLL:
     return L
 
 
-def _load_and_configure(retried: bool = False) -> ctypes.CDLL:
+def _load_and_configure() -> ctypes.CDLL:
     L = ctypes.CDLL(LIB_PATH, mode=ctypes.RTLD_GLOBAL)
     try:
         _configure_symbols(L)
     except AttributeError as e:
-        # a stale .so from before a symbol was added: rebuild once
-        if retried:
-            raise NativeUnavailable(
-                "native runtime lacks symbol after rebuild: %s" % e)
+        # a stale .so from before a symbol was added: rebuild, then
+        # load the fresh library under a UNIQUE path — dlopen of the
+        # original path would just hand back the already-mapped stale
+        # image, so an in-place reload can never pick up new symbols
         try:
             build()
         except (OSError, subprocess.CalledProcessError) as be:
             raise NativeUnavailable(
                 "stale native runtime and rebuild failed: %s" % be)
-        return _load_and_configure(retried=True)
+        import shutil
+        import tempfile
+        tmp = tempfile.NamedTemporaryFile(
+            prefix="libectpu-", suffix=".so", delete=False)
+        tmp.close()
+        shutil.copy(LIB_PATH, tmp.name)
+        L = ctypes.CDLL(tmp.name, mode=ctypes.RTLD_GLOBAL)
+        try:
+            _configure_symbols(L)
+        except AttributeError as e2:
+            raise NativeUnavailable(
+                "native runtime lacks symbol after rebuild: %s" % e2)
     return L
 
 
@@ -113,6 +124,17 @@ def _configure_symbols(L: ctypes.CDLL) -> None:
     L.ec_crush_hash32_2.argtypes = [ctypes.c_uint] * 2
     L.ec_crush_hash32_3.restype = ctypes.c_uint
     L.ec_crush_hash32_3.argtypes = [ctypes.c_uint] * 3
+    LL2 = ctypes.POINTER(ctypes.c_longlong)
+    L.ec_crush_map_create.restype = ctypes.c_void_p
+    L.ec_crush_map_create.argtypes = [LL2, LL2, LL2, LL2, ctypes.c_int,
+                                      LL2, LL2]
+    L.ec_crush_map_destroy.argtypes = [ctypes.c_void_p]
+    L.ec_crush_do_rule_map.restype = ctypes.c_int
+    L.ec_crush_do_rule_map.argtypes = [
+        ctypes.c_void_p, LL2, ctypes.c_int,
+        ctypes.c_longlong, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
 
 
 # ---------------------------------------------------------------------------
@@ -128,20 +150,56 @@ _STEP_OPS = {
 _ALGS = {"uniform": 1, "list": 2, "straw2": 5}
 
 
-def _flatten_map(cmap):
-    """Serialize a CrushMap to the flat arrays the C side consumes,
-    cached on the map object (keyed by a cheap structural fingerprint
-    covering bucket count/ids/items/weights and rule steps, so weight
-    edits or added rules invalidate it)."""
+def _map_fingerprint(cmap) -> int:
+    """Content crc over everything placement-visible: bucket ids, algs,
+    types, item and weight VECTORS (order-sensitive: swaps, moves and
+    alg changes all alter it), rule steps, tunables excluded (they ride
+    per call). Cheap: crc32 over the numpy buffers."""
+    import zlib
+    crc = 0
+    for bid in sorted(cmap.buckets):
+        b = cmap.buckets[bid]
+        hdr = ("%d|%s|%d" % (b.id, b.alg, b.type)).encode()
+        crc = zlib.crc32(hdr, crc)
+        crc = zlib.crc32(b.items.tobytes(), crc)
+        crc = zlib.crc32(b.weights.tobytes(), crc)
+    for rule in cmap.rules:
+        crc = zlib.crc32(repr(rule.steps).encode(), crc)
+    return crc
+
+
+class _NativeMapHandle:
+    """Owns one C-side map (ec_crush_map_create/destroy)."""
+
+    def __init__(self, L, flat):
+        self._L = L
+        LLp = ctypes.POINTER(ctypes.c_longlong)
+        self.ptr = L.ec_crush_map_create(
+            flat["bids"].ctypes.data_as(LLp),
+            flat["algs"].ctypes.data_as(LLp),
+            flat["types"].ctypes.data_as(LLp),
+            flat["offs"].ctypes.data_as(LLp),
+            len(flat["bids"]),
+            flat["items"].ctypes.data_as(LLp),
+            flat["weights"].ctypes.data_as(LLp))
+        if not self.ptr:
+            raise NativeUnavailable("native crush rejected the map")
+
+    def __del__(self):
+        ptr, self.ptr = getattr(self, "ptr", None), None
+        if ptr:
+            try:
+                self._L.ec_crush_map_destroy(ptr)
+            except Exception:
+                pass
+
+
+def _flatten_map(cmap, L):
+    """Serialize a CrushMap once: flat arrays + a persistent C-side map
+    handle, cached on the map object and invalidated by a content crc
+    over buckets/items/weights/rules."""
     import numpy as np
-    fingerprint = (
-        len(cmap.buckets),
-        sum(cmap.buckets),
-        sum(int(b.items.sum()) + int(b.weights.sum())
-            for b in cmap.buckets.values()),
-        sum(len(r.steps) for r in cmap.rules),
-        len(cmap.rules),
-    )
+    fingerprint = _map_fingerprint(cmap)
     cached = getattr(cmap, "_native_flat", None)
     if cached is not None and cached[0] == fingerprint:
         return cached[1]
@@ -177,6 +235,7 @@ def _flatten_map(cmap):
     flat = {"bids": arr(bids), "algs": arr(algs), "types": arr(types),
             "offs": arr(offs), "items": arr(items),
             "weights": arr(weights), "rule_steps": rule_steps}
+    flat["handle"] = _NativeMapHandle(L, flat)
     cmap._native_flat = (fingerprint, flat)
     return flat
 
@@ -191,7 +250,7 @@ def crush_do_rule_native(cmap, ruleno: int, x: int, result_max: int,
     L = lib()
     if ruleno < 0 or ruleno >= len(cmap.rules):
         return []
-    flat = _flatten_map(cmap)
+    flat = _flatten_map(cmap, L)
     a_steps = flat["rule_steps"][ruleno]
     if weight is None:
         weight = [0x10000] * cmap.max_devices
@@ -200,18 +259,11 @@ def crush_do_rule_native(cmap, ruleno: int, x: int, result_max: int,
                       t.choose_local_fallback_tries,
                       t.chooseleaf_descend_once, t.chooseleaf_vary_r,
                       t.chooseleaf_stable], dtype=np.int32)
-
     LLp = ctypes.POINTER(ctypes.c_longlong)
-    a_bids, a_algs = flat["bids"], flat["algs"]
-    a_types, a_offs = flat["types"], flat["offs"]
-    a_items, a_weights = flat["items"], flat["weights"]
     a_rw = np.asarray(weight, dtype=np.uint32)
     res = np.zeros(max(result_max, 1), dtype=np.int32)
-    n = L.ec_crush_do_rule(
-        a_bids.ctypes.data_as(LLp), a_algs.ctypes.data_as(LLp),
-        a_types.ctypes.data_as(LLp), a_offs.ctypes.data_as(LLp),
-        len(a_bids),
-        a_items.ctypes.data_as(LLp), a_weights.ctypes.data_as(LLp),
+    n = L.ec_crush_do_rule_map(
+        flat["handle"].ptr,
         a_steps.ctypes.data_as(LLp), len(a_steps) // 3,
         x, result_max,
         a_rw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint)), len(a_rw),

@@ -74,6 +74,26 @@ def osd_tree(m) -> str:
     return "\n".join(lines)
 
 
+def daemon_command(words: list[str]) -> int:
+    """`ceph daemon <asok-path> <command...>`: talk straight to one
+    daemon's unix admin socket (perf dump, dump_ops_in_flight,
+    dump_historic_ops, config get/set, help) — no monitor involved."""
+    from ..common.admin_socket import AdminSocketClient
+    if len(words) < 2:
+        sys.stderr.write("ceph daemon: need <asok-path> <command>\n")
+        return 1
+    path, prefix = words[0], " ".join(words[1:])
+    try:
+        reply = AdminSocketClient(path).do_request(prefix)
+    except (OSError, ValueError) as e:
+        # ValueError covers a truncated/garbled reply (daemon shutting
+        # down mid-request, or a non-asok socket at the path)
+        sys.stderr.write("ceph daemon: %s: %s\n" % (path, e))
+        return 1
+    sys.stdout.write(json.dumps(reply, indent=1, default=str) + "\n")
+    return 0 if "error" not in reply else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ceph",
                                 description="cluster admin utility")
@@ -82,13 +102,16 @@ def main(argv=None) -> int:
     p.add_argument("words", nargs="+",
                    help="command, e.g.: status | health | osd tree | "
                         "osd pool ls | osd pool create NAME | "
-                        "osd out/in/down ID | osd dump")
+                        "osd out/in/down ID | osd dump | "
+                        "daemon ASOK CMD...")
     p.add_argument("-s", "--size", type=int, default=None)
     p.add_argument("--pg-num", type=int, default=8)
     p.add_argument("--erasure", action="store_true")
     p.add_argument("--profile", default="",
                    help="EC profile k=v comma list (with --erasure)")
     args = p.parse_args(argv)
+    if args.words and args.words[0] == "daemon":
+        return daemon_command(args.words[1:])   # no mon connection
     client = connect(args)
     try:
         w = args.words

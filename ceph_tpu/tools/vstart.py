@@ -51,6 +51,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data",
                    help="directory for FileStore-backed OSDs "
                         "(default: in-memory stores)")
+    p.add_argument("--asok-dir",
+                   help="create per-daemon admin sockets here "
+                        "(drive with: ceph daemon <dir>/osd.N.asok "
+                        "perf dump)")
     p.add_argument("--conf", action="append", default=[],
                    metavar="KEY=VALUE", help="config override")
     p.add_argument("--run-seconds", type=float, default=0,
@@ -89,6 +93,9 @@ def main(argv=None) -> int:
     sys.stdout.write("vstart: %d mon(s) up, leader elected\n"
                      % len(mons))
 
+    if args.asok_dir:
+        os.makedirs(args.asok_dir, exist_ok=True)
+
     osds = []
     for osd_id in range(args.osds):
         store = None
@@ -102,9 +109,14 @@ def main(argv=None) -> int:
                     "filestore_compression", "none")),
                 compression_required_ratio=float(overrides.get(
                     "filestore_compression_required_ratio", 0.875)))
-        osd = OSDDaemon(osd_id, monmap,
-                        Context(overrides, name="osd.%d" % osd_id),
-                        store=store)
+        ctx = Context(overrides, name="osd.%d" % osd_id)
+        if args.asok_dir:
+            # per-daemon unix command socket ('ceph daemon' surface):
+            # must exist before the OSD constructor so the op tracker
+            # registers its dump commands on it
+            ctx.init_admin_socket(
+                os.path.join(args.asok_dir, "osd.%d.asok" % osd_id))
+        osd = OSDDaemon(osd_id, monmap, ctx, store=store)
         osd.init()
         osds.append(osd)
 

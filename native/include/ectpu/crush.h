@@ -34,6 +34,22 @@ int64_t crush_ln(uint32_t x);
 uint32_t crush_hash32_2(uint32_t a, uint32_t b);
 uint32_t crush_hash32_3(uint32_t a, uint32_t b, uint32_t c);
 
+// Persistent map handle: build once, run many (ruleno, x) mappings.
+struct Map;
+Map* crush_map_build(
+    const int64_t* bucket_ids, const int64_t* bucket_algs,
+    const int64_t* bucket_types, const int64_t* bucket_offsets,
+    int num_buckets,
+    const int64_t* items, const int64_t* weights);
+void crush_map_free(Map* map);
+int crush_do_rule_map(
+    const Map& map,
+    const int64_t* steps, int num_steps,
+    int64_t x, int result_max,
+    const uint32_t* weight, int weight_len,
+    const int32_t* tunables,
+    int32_t* result);
+
 // Flat-map rule execution. Buckets: parallel arrays of num_buckets
 // entries; items/weights are concatenated per-bucket with
 // bucket_offsets[i]..bucket_offsets[i+1] delimiting bucket i.

@@ -166,6 +166,35 @@ int ec_crush_do_rule(const long long* bucket_ids,
       (int32_t*)result);
 }
 
+// persistent-map variant: serialize once, run many mappings
+void* ec_crush_map_create(const long long* bucket_ids,
+                          const long long* bucket_algs,
+                          const long long* bucket_types,
+                          const long long* bucket_offsets,
+                          int num_buckets,
+                          const long long* items,
+                          const long long* weights) {
+  return ectpu::crush_map_build(
+      (const int64_t*)bucket_ids, (const int64_t*)bucket_algs,
+      (const int64_t*)bucket_types, (const int64_t*)bucket_offsets,
+      num_buckets, (const int64_t*)items, (const int64_t*)weights);
+}
+
+void ec_crush_map_destroy(void* map) {
+  ectpu::crush_map_free((ectpu::Map*)map);
+}
+
+int ec_crush_do_rule_map(void* map, const long long* steps, int num_steps,
+                         long long x, int result_max,
+                         const unsigned* weight, int weight_len,
+                         const int* tunables, int* result) {
+  if (!map) return -1;
+  return ectpu::crush_do_rule_map(
+      *(const ectpu::Map*)map, (const int64_t*)steps, num_steps,
+      (int64_t)x, result_max, (const uint32_t*)weight, weight_len,
+      (const int32_t*)tunables, (int32_t*)result);
+}
+
 long long ec_crush_ln(unsigned x) { return ectpu::crush_ln(x); }
 unsigned ec_crush_hash32_2(unsigned a, unsigned b) {
   return ectpu::crush_hash32_2(a, b);

@@ -411,38 +411,47 @@ static void choose_indep(const Params& P, Work& work, const Bucket& bucket,
 // ---------------------------------------------------------------------------
 // rule interpreter (mapper_ref.py crush_do_rule)
 
-int crush_do_rule_flat(
+Map* crush_map_build(
     const int64_t* bucket_ids, const int64_t* bucket_algs,
     const int64_t* bucket_types, const int64_t* bucket_offsets,
     int num_buckets,
-    const int64_t* items, const int64_t* weights,
-    const int64_t* steps, int num_steps,
-    int64_t x, int result_max,
-    const uint32_t* weight, int weight_len,
-    const int32_t* tunables,
-    int32_t* result) {
-  Map map;
-  map.buckets.reserve(num_buckets);
+    const int64_t* items, const int64_t* weights) {
+  Map* map = new Map();
+  map->buckets.reserve(num_buckets);
   for (int i = 0; i < num_buckets; ++i) {
     Bucket b;
     b.id = bucket_ids[i];
     b.alg = bucket_algs[i];
     b.type = bucket_types[i];
     int64_t beg = bucket_offsets[i], end = bucket_offsets[i + 1];
-    if (beg > end || b.id >= 0) return -1;
+    if (beg > end || b.id >= 0) {
+      delete map;
+      return nullptr;
+    }
     int64_t sum = 0;
     for (int64_t j = beg; j < end; ++j) {
       b.items.push_back(items[j]);
       b.weights.push_back(weights[j]);
       sum += weights[j];
       b.sums.push_back(sum);
-      if (items[j] >= 0 && items[j] + 1 > map.max_devices)
-        map.max_devices = items[j] + 1;
+      if (items[j] >= 0 && items[j] + 1 > map->max_devices)
+        map->max_devices = items[j] + 1;
     }
-    map.buckets.push_back(std::move(b));
+    map->buckets.push_back(std::move(b));
   }
-  for (const Bucket& b : map.buckets) map.by_id[b.id] = &b;
+  for (const Bucket& b : map->buckets) map->by_id[b.id] = &b;
+  return map;
+}
 
+void crush_map_free(Map* map) { delete map; }
+
+int crush_do_rule_map(
+    const Map& map,
+    const int64_t* steps, int num_steps,
+    int64_t x, int result_max,
+    const uint32_t* weight, int weight_len,
+    const int32_t* tunables,
+    int32_t* result) {
   int choose_tries = tunables[0] + 1;
   int choose_leaf_tries = 0;
   int choose_local_retries = tunables[1];
@@ -550,6 +559,25 @@ int crush_do_rule_flat(
   }
   for (size_t i = 0; i < res.size(); ++i) result[i] = (int32_t)res[i];
   return (int)res.size();
+}
+
+int crush_do_rule_flat(
+    const int64_t* bucket_ids, const int64_t* bucket_algs,
+    const int64_t* bucket_types, const int64_t* bucket_offsets,
+    int num_buckets,
+    const int64_t* items, const int64_t* weights,
+    const int64_t* steps, int num_steps,
+    int64_t x, int result_max,
+    const uint32_t* weight, int weight_len,
+    const int32_t* tunables,
+    int32_t* result) {
+  Map* map = crush_map_build(bucket_ids, bucket_algs, bucket_types,
+                             bucket_offsets, num_buckets, items, weights);
+  if (!map) return -1;
+  int n = crush_do_rule_map(*map, steps, num_steps, x, result_max,
+                            weight, weight_len, tunables, result);
+  crush_map_free(map);
+  return n;
 }
 
 }  // namespace ectpu

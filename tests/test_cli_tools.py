@@ -36,9 +36,11 @@ def ceph(monmap, *argv):
 def vstart_cluster(tmp_path_factory):
     tmp = tmp_path_factory.mktemp("vstart")
     monmap = str(tmp / "monmap")
+    asok_dir = str(tmp / "asok")
     proc = subprocess.Popen(
         [sys.executable, "-m", "ceph_tpu.tools.vstart",
          "--mons", "1", "--osds", "3", "--monmap", monmap,
+         "--asok-dir", asok_dir,
          "--conf", "osd_heartbeat_interval=0.1",
          "--conf", "paxos_propose_interval=0.02"],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
@@ -58,7 +60,7 @@ def vstart_cluster(tmp_path_factory):
     if not ready:
         proc.kill()
         pytest.fail("vstart never became ready: %s" % "".join(lines))
-    yield monmap
+    yield monmap, asok_dir
     proc.send_signal(signal.SIGTERM)
     try:
         proc.wait(timeout=20)
@@ -68,7 +70,7 @@ def vstart_cluster(tmp_path_factory):
 
 class TestRadosCli:
     def test_full_object_workflow(self, vstart_cluster, tmp_path):
-        monmap = vstart_cluster
+        monmap, _ = vstart_cluster
         r = rados(monmap, "mkpool", "clidata", "--size", "2")
         assert r.returncode == 0, r.stdout + r.stderr
         r = rados(monmap, "lspools")
@@ -91,7 +93,7 @@ class TestRadosCli:
         assert "obj1" not in r.stdout
 
     def test_ceph_cli_admin_flow(self, vstart_cluster):
-        monmap = vstart_cluster
+        monmap, _ = vstart_cluster
         r = ceph(monmap, "status")
         assert r.returncode == 0, r.stdout + r.stderr
         assert "health: HEALTH_OK" in r.stdout
@@ -126,8 +128,31 @@ class TestRadosCli:
             time.sleep(0.3)
         assert r.stdout.strip() == "HEALTH_OK"
 
+    def test_ceph_daemon_admin_socket(self, vstart_cluster):
+        """`ceph daemon <asok> <cmd>`: per-daemon introspection over
+        the unix admin socket — help, perf dump, op history."""
+        monmap, asok_dir = vstart_cluster
+        asok = os.path.join(asok_dir, "osd.0.asok")
+        assert os.path.exists(asok), os.listdir(asok_dir)
+        r = ceph(monmap, "daemon", asok, "help")
+        assert r.returncode == 0, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert "perf dump" in doc and "dump_ops_in_flight" in doc
+        # generate an op so history is non-trivial
+        assert rados(monmap, "mkpool", "asokpool").returncode == 0
+        r = ceph(monmap, "daemon", asok, "perf dump")
+        assert r.returncode == 0
+        assert "osd" in json.loads(r.stdout)
+        r = ceph(monmap, "daemon", asok, "dump_historic_ops")
+        assert r.returncode == 0
+        assert "num_ops" in json.loads(r.stdout)
+        # unknown command -> error payload, nonzero exit
+        r = ceph(monmap, "daemon", asok, "make me a sandwich")
+        assert r.returncode == 1
+        assert "error" in json.loads(r.stdout)
+
     def test_bench_write_then_seq(self, vstart_cluster):
-        monmap = vstart_cluster
+        monmap, _ = vstart_cluster
         assert rados(monmap, "mkpool", "benchpool").returncode == 0
         r = rados(monmap, "-p", "benchpool", "bench", "2", "write",
                   "-b", "65536")
