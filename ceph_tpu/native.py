@@ -71,12 +71,20 @@ def _load_and_configure() -> ctypes.CDLL:
             prefix="libectpu-", suffix=".so", delete=False)
         tmp.close()
         shutil.copy(LIB_PATH, tmp.name)
-        L = ctypes.CDLL(tmp.name, mode=ctypes.RTLD_GLOBAL)
+        # RTLD_LOCAL (the default): the stale image is still globally
+        # mapped, and loading the copy globally would let the fresh
+        # library's internal cross-TU calls bind to STALE definitions
+        L = ctypes.CDLL(tmp.name)
         try:
             _configure_symbols(L)
         except AttributeError as e2:
             raise NativeUnavailable(
                 "native runtime lacks symbol after rebuild: %s" % e2)
+        finally:
+            try:
+                os.unlink(tmp.name)  # the mapping survives the unlink
+            except OSError:
+                pass
     return L
 
 
@@ -134,6 +142,13 @@ def _configure_symbols(L: ctypes.CDLL) -> None:
         ctypes.c_void_p, LL2, ctypes.c_int,
         ctypes.c_longlong, ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+    L.ec_crush_do_rule_batch.restype = ctypes.c_int
+    L.ec_crush_do_rule_batch.argtypes = [
+        ctypes.c_void_p, LL2, ctypes.c_int,
+        LL2, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
 
 
@@ -194,13 +209,25 @@ class _NativeMapHandle:
                 pass
 
 
+# Cache OFF the map object: a CDLL-holding handle stored as a CrushMap
+# attribute would make the map un-deepcopyable/un-picklable, and maps
+# are cloned and pickled on the daemon paths (OSDMap clone, MOSDMap
+# distribution). Keyed by id() (CrushMap is an unhashable dataclass)
+# with a weakref finalizer evicting the entry when the map dies, so a
+# recycled id can never observe a stale entry.
+import weakref  # noqa: E402
+
+_flat_cache: dict = {}
+
+
 def _flatten_map(cmap, L):
     """Serialize a CrushMap once: flat arrays + a persistent C-side map
-    handle, cached on the map object and invalidated by a content crc
-    over buckets/items/weights/rules."""
+    handle, cached in a weak side table and invalidated by a content
+    crc over buckets/items/weights/rules."""
     import numpy as np
+    key = id(cmap)
     fingerprint = _map_fingerprint(cmap)
-    cached = getattr(cmap, "_native_flat", None)
+    cached = _flat_cache.get(key)
     if cached is not None and cached[0] == fingerprint:
         return cached[1]
     bids, algs, types, offs = [], [], [], [0]
@@ -236,7 +263,9 @@ def _flatten_map(cmap, L):
             "offs": arr(offs), "items": arr(items),
             "weights": arr(weights), "rule_steps": rule_steps}
     flat["handle"] = _NativeMapHandle(L, flat)
-    cmap._native_flat = (fingerprint, flat)
+    if key not in _flat_cache:
+        weakref.finalize(cmap, _flat_cache.pop, key, None)
+    _flat_cache[key] = (fingerprint, flat)
     return flat
 
 
@@ -272,6 +301,43 @@ def crush_do_rule_native(cmap, ruleno: int, x: int, result_max: int,
     if n < 0:
         raise NativeUnavailable("native crush rejected the map (%d)" % n)
     return [int(v) for v in res[:n]]
+
+
+def crush_do_rule_batch_native(cmap, ruleno: int, xs, result_max: int,
+                               weight=None):
+    """Bulk native mapping: all of `xs` in ONE C call (the
+    ParallelPGMapper use case on the host side). Returns a list of
+    per-x result lists, each bit-identical to crush_do_rule."""
+    import numpy as np
+    L = lib()
+    if ruleno < 0 or ruleno >= len(cmap.rules):
+        return [[] for _ in xs]
+    flat = _flatten_map(cmap, L)
+    a_steps = flat["rule_steps"][ruleno]
+    if weight is None:
+        weight = [0x10000] * cmap.max_devices
+    t = cmap.tunables
+    tun = np.asarray([t.choose_total_tries, t.choose_local_tries,
+                      t.choose_local_fallback_tries,
+                      t.chooseleaf_descend_once, t.chooseleaf_vary_r,
+                      t.chooseleaf_stable], dtype=np.int32)
+    LLp = ctypes.POINTER(ctypes.c_longlong)
+    a_xs = np.asarray(list(xs), dtype=np.int64)
+    a_rw = np.asarray(weight, dtype=np.uint32)
+    results = np.zeros((len(a_xs), max(result_max, 1)), dtype=np.int32)
+    lengths = np.zeros(len(a_xs), dtype=np.int32)
+    rc = L.ec_crush_do_rule_batch(
+        flat["handle"].ptr,
+        a_steps.ctypes.data_as(LLp), len(a_steps) // 3,
+        a_xs.ctypes.data_as(LLp), len(a_xs), result_max,
+        a_rw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint)), len(a_rw),
+        tun.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        results.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int)))
+    if rc < 0:
+        raise NativeUnavailable("native crush batch failed (%d)" % rc)
+    return [[int(v) for v in results[i][:lengths[i]]]
+            for i in range(len(a_xs))]
 
 
 class NativeCodec:

@@ -82,16 +82,33 @@ def daemon_command(words: list[str]) -> int:
     if len(words) < 2:
         sys.stderr.write("ceph daemon: need <asok-path> <command>\n")
         return 1
-    path, prefix = words[0], " ".join(words[1:])
+    path, cmd_words = words[0], words[1:]
+    client = AdminSocketClient(path)
     try:
-        reply = AdminSocketClient(path).do_request(prefix)
+        # hooks register multi-word prefixes ("config get") that take
+        # positional args ("config get KEY"): resolve the longest
+        # registered prefix and pass the remainder as key/value
+        registered = client.do_request("help")
+        prefix, rest = " ".join(cmd_words), []
+        if prefix not in registered:
+            for n in range(len(cmd_words) - 1, 0, -1):
+                cand = " ".join(cmd_words[:n])
+                if cand in registered:
+                    prefix, rest = cand, cmd_words[n:]
+                    break
+        args = {}
+        if rest:
+            args["key"] = rest[0]
+        if len(rest) > 1:
+            args["value"] = " ".join(rest[1:])
+        reply = client.do_request(prefix, **args)
     except (OSError, ValueError) as e:
         # ValueError covers a truncated/garbled reply (daemon shutting
         # down mid-request, or a non-asok socket at the path)
         sys.stderr.write("ceph daemon: %s: %s\n" % (path, e))
         return 1
     sys.stdout.write(json.dumps(reply, indent=1, default=str) + "\n")
-    return 0 if "error" not in reply else 1
+    return 0 if not (isinstance(reply, dict) and "error" in reply) else 1
 
 
 def main(argv=None) -> int:

@@ -49,6 +49,16 @@ int crush_do_rule_map(
     const uint32_t* weight, int weight_len,
     const int32_t* tunables,
     int32_t* result);
+// Bulk mapping (ParallelPGMapper use case): one call maps num_xs
+// inputs; results is [num_xs, result_max] padded with CRUSH_ITEM_NONE,
+// lengths holds the per-row emit count.
+int crush_do_rule_batch(
+    const Map& map,
+    const int64_t* steps, int num_steps,
+    const int64_t* xs, int num_xs, int result_max,
+    const uint32_t* weight, int weight_len,
+    const int32_t* tunables,
+    int32_t* results, int32_t* lengths);
 
 // Flat-map rule execution. Buckets: parallel arrays of num_buckets
 // entries; items/weights are concatenated per-bucket with

@@ -195,6 +195,19 @@ int ec_crush_do_rule_map(void* map, const long long* steps, int num_steps,
       (const int32_t*)tunables, (int32_t*)result);
 }
 
+int ec_crush_do_rule_batch(void* map, const long long* steps,
+                           int num_steps, const long long* xs, int num_xs,
+                           int result_max, const unsigned* weight,
+                           int weight_len, const int* tunables,
+                           int* results, int* lengths) {
+  if (!map) return -1;
+  return ectpu::crush_do_rule_batch(
+      *(const ectpu::Map*)map, (const int64_t*)steps, num_steps,
+      (const int64_t*)xs, num_xs, result_max, (const uint32_t*)weight,
+      weight_len, (const int32_t*)tunables, (int32_t*)results,
+      (int32_t*)lengths);
+}
+
 long long ec_crush_ln(unsigned x) { return ectpu::crush_ln(x); }
 unsigned ec_crush_hash32_2(unsigned a, unsigned b) {
   return ectpu::crush_hash32_2(a, b);

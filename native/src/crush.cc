@@ -580,4 +580,23 @@ int crush_do_rule_flat(
   return n;
 }
 
+int crush_do_rule_batch(
+    const Map& map,
+    const int64_t* steps, int num_steps,
+    const int64_t* xs, int num_xs, int result_max,
+    const uint32_t* weight, int weight_len,
+    const int32_t* tunables,
+    int32_t* results,    // [num_xs, result_max], CRUSH_ITEM_NONE padded
+    int32_t* lengths) {  // [num_xs]
+  for (int i = 0; i < num_xs; ++i) {
+    int32_t* row = results + (size_t)i * result_max;
+    int n = crush_do_rule_map(map, steps, num_steps, xs[i], result_max,
+                              weight, weight_len, tunables, row);
+    if (n < 0) return -1;
+    for (int j = n; j < result_max; ++j) row[j] = (int32_t)kItemNone;
+    lengths[i] = n;
+  }
+  return 0;
+}
+
 }  // namespace ectpu

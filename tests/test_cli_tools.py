@@ -146,6 +146,17 @@ class TestRadosCli:
         r = ceph(monmap, "daemon", asok, "dump_historic_ops")
         assert r.returncode == 0
         assert "num_ops" in json.loads(r.stdout)
+        # multi-word prefix with a positional arg: config get KEY
+        r = ceph(monmap, "daemon", asok, "config", "get",
+                 "osd_heartbeat_interval")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert json.loads(r.stdout)["osd_heartbeat_interval"] == 0.1
+        # config set KEY VALUE round-trips
+        r = ceph(monmap, "daemon", asok, "config", "set",
+                 "debug_osd", "5")
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = ceph(monmap, "daemon", asok, "config", "get", "debug_osd")
+        assert json.loads(r.stdout)["debug_osd"] == 5
         # unknown command -> error payload, nonzero exit
         r = ceph(monmap, "daemon", asok, "make me a sandwich")
         assert r.returncode == 1

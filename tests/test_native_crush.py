@@ -153,6 +153,21 @@ class TestRuleParity:
                 weight[int(dead)] = int(rng.choice([0, 0x8000]))
             assert_parity(m, 0, range(200), numrep, weight)
 
+    def test_batch_entry_matches_per_x(self):
+        """The one-call bulk entry (ParallelPGMapper shape) returns
+        exactly the per-x results."""
+        native_or_skip()
+        rng = np.random.default_rng(11)
+        m = make_two_level(4, 3, rng.integers(1, 2 * 0x10000, 12))
+        m.add_rule(Rule(steps=[("take", -1),
+                               ("chooseleaf_firstn", 3, 1), ("emit",)]))
+        weight = [0x10000] * 12
+        weight[5] = 0
+        xs = list(range(400))
+        batch = native.crush_do_rule_batch_native(m, 0, xs, 3, weight)
+        for x in xs:
+            assert batch[x] == crush_do_rule(m, 0, x, 3, weight), x
+
     def test_batched_jax_native_python_triple_parity(self):
         """All three mappers (python, JAX-batched, native C++) agree."""
         native_or_skip()
