@@ -24,10 +24,11 @@ from __future__ import annotations
 import hashlib
 import hmac
 import os
-import pickle
 import struct
 import threading
 import time
+
+from .. import encoding
 
 AUTH_SERVICE = "auth"
 DEFAULT_TICKET_TTL = 3600.0   # auth_service_ticket_ttl (options.cc)
@@ -151,7 +152,7 @@ class CephxServer:
         if svc_secret is None:
             raise AuthError("no service secret for %r" % service)
         session_key = os.urandom(32)
-        ticket = seal(svc_secret, pickle.dumps({
+        ticket = seal(svc_secret, encoding.encode_any({
             "entity": entity,
             "caps": self.keyring.get_caps(entity).get(service, ""),
             "session_key": session_key,
@@ -183,8 +184,14 @@ class CephxClient:
         self.tickets[reply["service"]] = {
             "ticket": reply["ticket"], "session_key": session_key}
 
-    def build_authorizer(self, service: str = "osd") -> dict:
-        """Per-connection authorizer presented in the banner."""
+    def build_authorizer(self, service: str = "osd",
+                         challenge: bytes | None = None) -> dict:
+        """Per-connection authorizer presented in the banner.
+
+        With `challenge` (the service's per-connection random, the
+        reference's CephxAuthorizeChallenge — the CVE-2018-1128 fix),
+        the proof covers it, so a captured authorizer cannot be
+        replayed on a new connection."""
         t = self.tickets.get(service)
         if t is None:
             raise AuthError("no ticket for service %r" % service)
@@ -194,15 +201,18 @@ class CephxClient:
             "service": service,
             "ticket": t["ticket"],
             "nonce": nonce,
-            "proof": hmac.new(t["session_key"], b"authorizer" + nonce,
-                              hashlib.sha256).digest(),
+            "has_challenge": challenge is not None,
+            "proof": hmac.new(
+                t["session_key"],
+                b"authorizer" + nonce + (challenge or b""),
+                hashlib.sha256).digest(),
         }
 
     def verify_reply(self, service: str, reply_proof: bytes,
                      nonce: bytes) -> bool:
         """Mutual auth: the service proves it could read the ticket."""
         t = self.tickets.get(service)
-        if t is None:
+        if t is None or not isinstance(reply_proof, bytes):
             return False
         want = hmac.new(t["session_key"], b"authorizer-reply" + nonce,
                         hashlib.sha256).digest()
@@ -219,14 +229,22 @@ class CephxServiceHandler:
         self.service_secret = service_secret
 
     def verify_authorizer(self, authorizer: dict,
-                          now: float | None = None) -> dict:
+                          now: float | None = None,
+                          challenge: bytes | None = None) -> dict:
         """Validate an authorizer offline; returns
-        {entity, caps, session_key, reply_proof} or raises AuthError."""
+        {entity, caps, session_key, reply_proof} or raises AuthError.
+
+        When the caller minted a per-connection `challenge`, the proof
+        must cover it (replay protection; the messenger always runs
+        this mode via its BANNER_RETRY round)."""
         try:
-            ticket = pickle.loads(
-                unseal(self.service_secret, authorizer["ticket"]))
-        except (KeyError, TypeError, pickle.UnpicklingError) as e:
+            ticket = encoding.decode_any(
+                unseal(self.service_secret, authorizer["ticket"]),
+                restricted=True)
+        except (KeyError, TypeError, encoding.DecodeError) as e:
             raise AuthError("malformed authorizer: %s" % e)
+        if not isinstance(ticket, dict):
+            raise AuthError("malformed authorizer ticket")
         now = time.time() if now is None else now
         if ticket["service"] != self.service:
             raise AuthError("ticket for %r used on %r"
@@ -236,7 +254,10 @@ class CephxServiceHandler:
         if ticket["entity"] != authorizer.get("entity"):
             raise AuthError("authorizer entity mismatch")
         nonce = authorizer.get("nonce", b"")
-        want = hmac.new(ticket["session_key"], b"authorizer" + nonce,
+        if challenge is not None and not authorizer.get("has_challenge"):
+            raise AuthError("authorizer lacks required challenge proof")
+        want = hmac.new(ticket["session_key"],
+                        b"authorizer" + nonce + (challenge or b""),
                         hashlib.sha256).digest()
         if not hmac.compare_digest(authorizer.get("proof", b""), want):
             raise AuthError("authorizer proof invalid")

@@ -18,7 +18,11 @@ reference's striper metadata.
 from __future__ import annotations
 
 import errno as _errno
+import os as _os
 import struct
+import time as _time
+
+from .. import encoding
 
 __all__ = ["StripedObject", "FileLayout"]
 
@@ -66,6 +70,10 @@ class StripedObject:
 
     SIZE_XATTR = "striper.size"
     LAYOUT_XATTR = "striper.layout"
+    LOCK_NAME = "striper.lock"
+    LOCK_EXPIRY = 30.0         # crashed-holder lock self-expiry
+    LOCK_TIMEOUT = 35.0        # EBUSY wait; > LOCK_EXPIRY so one call
+                               # outlives a crashed holder's lock
 
     def __init__(self, ioctx, soid: str, layout: FileLayout | None = None):
         self.ioctx = ioctx
@@ -104,6 +112,48 @@ class StripedObject:
                              struct.pack("<Q", size))
         self._meta_written = True
 
+    # -- size-metadata lock --------------------------------------------
+
+    def _lock_meta(self) -> str | None:
+        """Exclusive advisory lock (cls_lock) on the anchor object
+        guarding the striper.size read-modify-write — the reference
+        striper takes the same object lock so concurrent writers can't
+        overwrite each other's larger size
+        (src/libradosstriper/RadosStriperImpl.cc lock plumbing).
+        Returns the cookie, or None when cls ops are unavailable
+        (EC pools: EOPNOTSUPP -> unlocked best-effort, single-writer).
+        """
+        cookie = _os.urandom(8).hex()
+        # duration-bounded: a crashed holder's lock self-expires after
+        # LOCK_EXPIRY instead of wedging the object read-only forever
+        payload = encoding.encode_any({
+            "name": self.LOCK_NAME, "cookie": cookie,
+            "type": "exclusive", "duration": self.LOCK_EXPIRY})
+        deadline = _time.monotonic() + self.LOCK_TIMEOUT
+        while True:
+            try:
+                self.ioctx.exec(self._obj_name(0), "lock", "lock",
+                                payload)
+                return cookie
+            except OSError as e:
+                if e.errno == _errno.EOPNOTSUPP:
+                    return None
+                if e.errno != _errno.EBUSY \
+                        or _time.monotonic() > deadline:
+                    raise
+                _time.sleep(0.005)
+
+    def _unlock_meta(self, cookie: str | None) -> None:
+        if cookie is None:
+            return
+        try:
+            self.ioctx.exec(self._obj_name(0), "lock", "unlock",
+                            encoding.encode_any({
+                                "name": self.LOCK_NAME,
+                                "cookie": cookie}))
+        except OSError:
+            pass   # lock state is advisory; never fail the data op
+
     # -- API (libradosstriper surface) ---------------------------------
 
     def size(self) -> int:
@@ -118,17 +168,28 @@ class StripedObject:
         return struct.unpack("<Q", blob)[0] if blob else 0
 
     def write(self, data: bytes, offset: int = 0) -> None:
-        for obj_no, obj_off, n, foff in self.layout.map_extent(
-                offset, len(data)):
-            piece = data[foff - offset:foff - offset + n]
-            self.ioctx.write(self._obj_name(obj_no), piece, obj_off)
-        new_end = offset + len(data)
-        cur = self.size()
-        if new_end > cur or not self._meta_written:
-            self._write_meta(max(new_end, cur))
+        self._locked_write(data, offset)
 
     def append(self, data: bytes) -> None:
-        self.write(data, self.size())
+        # the size read and the write must share one lock hold, or two
+        # appenders pick the same offset
+        self._locked_write(data, None)
+
+    def _locked_write(self, data: bytes, offset: int | None) -> None:
+        cookie = self._lock_meta()
+        try:
+            if offset is None:
+                offset = self.size()
+            for obj_no, obj_off, n, foff in self.layout.map_extent(
+                    offset, len(data)):
+                piece = data[foff - offset:foff - offset + n]
+                self.ioctx.write(self._obj_name(obj_no), piece, obj_off)
+            new_end = offset + len(data)
+            cur = self.size()
+            if new_end > cur or not self._meta_written:
+                self._write_meta(max(new_end, cur))
+        finally:
+            self._unlock_meta(cookie)
 
     def read(self, length: int = 0, offset: int = 0) -> bytes:
         total = self.size()
@@ -149,6 +210,13 @@ class StripedObject:
         return bytes(out)
 
     def truncate(self, size: int) -> None:
+        cookie = self._lock_meta()
+        try:
+            self._truncate_locked(size)
+        finally:
+            self._unlock_meta(cookie)
+
+    def _truncate_locked(self, size: int) -> None:
         old = self.size()
         if size < old:
             # drop whole objects past the new end; zero the truncated

@@ -1,0 +1,130 @@
+"""Codec registrations for every type that crosses the wire or rests
+on disk.
+
+Role of the reference's per-type encode/decode methods (each struct in
+src/osd/osd_types.h, src/crush/CrushWrapper.h, src/messages/*.h
+implements `void encode(bufferlist&)` with its own version pair): here
+the registrations are centralized so the registry is populated by one
+import, and the dencoder tool can enumerate them.
+
+Importing this module is what arms `encoding.decode` to materialize
+framework structs; transports and stores import it at module load.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from . import encoding
+from .encoding import register_codec
+
+# -- helpers ------------------------------------------------------------
+
+
+def register_dataclass(cls, name: str, version: int = 1,
+                       compat: int = 1) -> None:
+    encoding.encodable(name, version=version, compat=compat)(cls)
+
+
+def register_attrs(cls, name: str, attrs: list[str], factory,
+                   version: int = 1, compat: int = 1) -> None:
+    """Non-dataclass structs: encode listed attrs in order; decode makes
+    a blank instance via factory() and sets what the payload carries
+    (missing trailing attrs keep the factory's defaults)."""
+    def enc_f(enc, obj):
+        for a in attrs:
+            enc.any(getattr(obj, a))
+
+    def dec_f(dec, struct_v, end):
+        obj = factory()
+        for a in attrs:
+            if dec.pos >= end:
+                break
+            setattr(obj, a, dec.any())
+        return obj
+
+    register_codec(name, cls, version, compat, enc_f, dec_f)
+
+
+def register_message(cls, version: int = 1, compat: int = 1) -> None:
+    """Messages carry transport header (seq, from_name) + dataclass
+    fields. Appending fields (with defaults) is the version bump."""
+    names = [f.name for f in dataclasses.fields(cls)]
+
+    def enc_f(enc, obj):
+        enc.varint(obj.seq)
+        enc.any(obj.from_name)
+        for fname in names:
+            enc.any(getattr(obj, fname))
+
+    def dec_f(dec, struct_v, end):
+        seq = dec.varint()
+        from_name = dec.any()
+        kw = {}
+        for fname in names:
+            if dec.pos >= end:
+                break
+            kw[fname] = dec.any()
+        obj = cls(**kw)
+        obj.seq = seq
+        obj.from_name = from_name
+        return obj
+
+    register_codec("msg." + cls.__name__, cls, version, compat,
+                   enc_f, dec_f)
+
+
+# -- crush --------------------------------------------------------------
+
+from .crush.map import Bucket, CrushMap, Rule, Tunables  # noqa: E402
+
+register_dataclass(Tunables, "crush.Tunables")
+register_dataclass(Bucket, "crush.Bucket")
+register_dataclass(Rule, "crush.Rule")
+register_dataclass(CrushMap, "crush.CrushMap")
+
+# -- osd map ------------------------------------------------------------
+
+from .osd.osd_map import Incremental, OSDMap, PGID, PGPool  # noqa: E402
+
+register_dataclass(PGID, "osd.PGID")
+register_dataclass(PGPool, "osd.PGPool")
+register_attrs(OSDMap, "osd.OSDMap", [
+    "epoch", "max_osd", "crush", "pools", "osd_exists", "osd_up",
+    "osd_weight", "osd_addrs", "osd_primary_affinity", "pg_temp",
+    "primary_temp", "pg_upmap", "pg_upmap_items", "ec_profiles",
+], OSDMap)
+register_attrs(Incremental, "osd.Incremental", [
+    "epoch", "new_pools", "old_pools", "new_up", "new_down",
+    "new_weight", "new_primary_affinity", "new_pg_temp",
+    "new_primary_temp", "new_pg_upmap", "old_pg_upmap",
+    "new_pg_upmap_items", "old_pg_upmap_items", "new_max_osd",
+    "new_crush", "new_ec_profiles",
+], lambda: Incremental(0))
+
+# -- messenger address --------------------------------------------------
+
+from .msg.messenger import EntityAddr  # noqa: E402
+
+
+def _enc_addr(enc, addr):
+    enc.str_(addr[0])
+    enc.varint(addr[1])
+
+
+def _dec_addr(dec, struct_v, end):
+    host = dec.str_()
+    return EntityAddr(host, dec.varint())
+
+
+register_codec("msg.EntityAddr", EntityAddr, 1, 1, _enc_addr, _dec_addr)
+
+# -- message catalog ----------------------------------------------------
+
+from .msg import message as _m  # noqa: E402
+
+for _name in _m.__all__:
+    _cls = getattr(_m, _name)
+    if _name != "Message" and isinstance(_cls, type) \
+            and issubclass(_cls, _m.Message):
+        register_message(_cls)

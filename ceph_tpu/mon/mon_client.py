@@ -10,8 +10,9 @@ the registered callback.
 from __future__ import annotations
 
 import itertools
-import pickle
 import threading
+
+from .. import encoding
 
 from ..msg.message import MAuth, MMonCommand, MMonSubscribe
 from ..msg.messenger import Dispatcher, Messenger
@@ -55,7 +56,7 @@ class MonClient(Dispatcher):
 
     def _handle_osdmap(self, msg) -> None:
         if msg.full_map is not None:
-            newmap = pickle.loads(msg.full_map)
+            newmap = encoding.decode_any(msg.full_map)
             if self.osdmap is None or newmap.epoch > self.osdmap.epoch:
                 self.osdmap = newmap
         for inc in msg.incrementals:

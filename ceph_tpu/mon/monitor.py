@@ -9,10 +9,10 @@ reference's bootstrap monmap.
 
 from __future__ import annotations
 
-import pickle
 import threading
 import time
 
+from .. import encoding
 from ..common import Context
 from ..common.workqueue import SafeTimer
 from ..msg.message import (MMonCommandReply, MOSDMap)
@@ -141,7 +141,7 @@ class Monitor(Dispatcher):
             self.paxos.propose(value)
 
     def _on_paxos_commit(self, version: int, value: bytes) -> None:
-        service, payload = pickle.loads(value)
+        service, payload = encoding.decode_any(value)
         if service == "osdmap":
             self.osdmon.apply_committed(payload)
 
@@ -262,5 +262,5 @@ class Monitor(Dispatcher):
         full = self.osdmon.osdmap
         if full.epoch > start_epoch:
             self.msgr.send_message(
-                MOSDMap(full_map=pickle.dumps(full), epoch=full.epoch),
+                MOSDMap(full_map=encoding.encode_any(full), epoch=full.epoch),
                 addr)

@@ -20,9 +20,10 @@ Incremental proposed on a short interval; handled here:
 
 from __future__ import annotations
 
-import pickle
 import threading
 import time
+
+from .. import encoding
 
 from ..osd.osd_map import (Incremental, OSDMap, PGID, PGPool,
                            POOL_TYPE_ERASURE, POOL_TYPE_REPLICATED)
@@ -56,7 +57,7 @@ class OSDMonitor:
 
     def encode_pending(self) -> bytes:
         inc, self.pending = self.pending, None
-        return pickle.dumps(("osdmap", inc))
+        return encoding.encode_any(("osdmap", inc))
 
     def apply_committed(self, inc: Incremental) -> None:
         with self._lock:
@@ -198,7 +199,7 @@ class OSDMonitor:
             if prefix == "osd dump":
                 return 0, "", self._dump()
             if prefix == "osd getmap":
-                return 0, "", pickle.dumps(self.osdmap)
+                return 0, "", encoding.encode_any(self.osdmap)
         return -22, "unknown command %r" % prefix, None
 
     def _profile_set(self, cmd: dict):

@@ -8,9 +8,11 @@ reports, mon commands), and heartbeats — the types the SURVEY call
 stacks traverse (MOSDOp, MOSDECSubOpWrite/Reply, MOSDECSubOpRead/Reply,
 MOSDRepOp/Reply, MOSDPing, MOSDMap, MOSDBoot, MOSDFailure).
 
-Encoding: length-prefixed pickle of the typed object (the framing in
-messenger.py). The reference hand-rolls versioned encode/decode per
-type; here the contract is the typed class surface, not the byte
+Encoding: length-prefixed versioned binary frames (ceph_tpu.encoding;
+registrations in ceph_tpu.codecs). Like the reference's per-type
+versioned encode/decode, each message carries a (version, compat)
+frame: appending dataclass fields (with defaults) is the compatible
+version bump, and the golden corpus under tests/corpus/ pins the byte
 layout.
 """
 

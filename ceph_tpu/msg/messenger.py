@@ -11,19 +11,27 @@ thread — same ordering and failure semantics at framework scale.
 Fault injection mirrors `ms inject socket failures` (qa msgr-failures
 fragments): drop 1-in-N messages, add bounded random delivery delay.
 
-Framing: 4-byte magic, 4-byte length, pickle of the typed Message.
-Pickle is the serialization seam; swapping in a schema codec changes
-one function pair (_encode/_decode).
+Framing: 4-byte magic, 4-byte length, versioned binary encoding of the
+typed Message (ceph_tpu.encoding — no pickle: inbound bytes can only
+materialize the closed set of registered types, never run code).
+Connection auth is the cephx authorizer handshake with a mandatory
+per-connection server challenge (the reference's
+CephxAuthorizeChallenge): BANNER -> BANNER_RETRY(challenge) ->
+BANNER(challenge proof) -> BANNER_ACK(mutual-auth proof). Pre-auth
+frames on a guarded connection are parsed in restricted mode (builtins
+only) and anything but the handshake drops the connection.
 """
 
 from __future__ import annotations
 
-import pickle
+import os
 import random
 import socket
 import struct
 import threading
 import time
+
+from .. import encoding
 
 __all__ = ["EntityAddr", "Dispatcher", "Messenger", "Connection"]
 
@@ -63,7 +71,7 @@ class Dispatcher:
 
 
 def _encode(msg) -> bytes:
-    payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = encoding.encode_any(msg)
     return _HDR.pack(_MAGIC, len(payload)) + payload
 
 
@@ -92,6 +100,8 @@ class Connection:
         self.inbound = sock is not None   # accepted vs dialed
         self.auth_confirmed = False  # dialer saw a valid BANNER_ACK
         self._sent_authorizer = None
+        self._server_challenge = None     # acceptor's per-conn random
+        self._auth_ready = threading.Event()  # dialer handshake done
         self.closed = False
         self.writer = threading.Thread(target=self._writer_loop,
                                        daemon=True)
@@ -139,6 +149,7 @@ class Connection:
             # a fresh socket means a fresh peer: mutual auth must be
             # re-proven before inbound traffic is trusted again
             self.auth_confirmed = False
+            self._auth_ready.clear()
             # banner (the msgr protocol's handshake): advertise our
             # bound address so the acceptor can route replies back over
             # this same connection (Ceph learns the peer_addr during the
@@ -149,9 +160,22 @@ class Connection:
             self._sent_authorizer = authorizer
             self.sock = sock
             self._start_reader()
-            return True
         except OSError:
             return False
+        if self.msgr.auth_confirm is not None \
+                or self.msgr.authorizer_factory is not None:
+            # hold data until the challenge round + mutual auth land:
+            # the acceptor cuts connections that send data pre-auth
+            if not self._auth_ready.wait(timeout=5.0) \
+                    or not self.auth_confirmed:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                if self.sock is sock:
+                    self.sock = None
+                return False
+        return True
 
     def _writer_loop(self) -> None:
         backoff = 0.01
@@ -215,21 +239,46 @@ class Connection:
                     break
             except OSError:
                 break
+            # pre-auth frames may only materialize closed-set builtins
+            # (no registered-struct construction), so an unauthenticated
+            # peer cannot reach any type's constructor
+            restricted = (
+                (self.inbound and self.msgr.auth_verifier is not None
+                 and self.auth_info is None)
+                or (not self.inbound
+                    and self.msgr.auth_confirm is not None
+                    and not self.auth_confirmed))
             try:
-                msg = pickle.loads(payload)
-            except Exception:
+                msg = encoding.decode_any(payload, restricted=restricted)
+            except encoding.DecodeError:
+                if restricted:
+                    # a guarded peer sent a non-handshake frame pre-auth
+                    self.close()
+                    break
                 continue
             if (isinstance(msg, tuple) and len(msg) in (3, 4)
                     and msg[0] == "BANNER"):
                 # acceptor side: adopt the peer's advertised listening
                 # address and register so sends to it reuse this pipe.
-                # With auth enabled, the banner must carry a valid
-                # authorizer or the connection is dropped (EACCES).
+                # With auth enabled, the banner must carry an authorizer
+                # whose proof covers our per-connection challenge
+                # (BANNER_RETRY round) or the connection drops (EACCES).
                 verifier = self.msgr.auth_verifier
                 if verifier is not None:
                     authorizer = msg[3] if len(msg) == 4 else None
+                    if self._server_challenge is None:
+                        self._server_challenge = os.urandom(16)
+                    if not (isinstance(authorizer, dict)
+                            and authorizer.get("has_challenge")):
+                        try:
+                            sock.sendall(_encode(
+                                ("BANNER_RETRY", self._server_challenge)))
+                        except OSError:
+                            break
+                        continue
                     try:
-                        info = verifier.verify_authorizer(authorizer or {})
+                        info = verifier.verify_authorizer(
+                            authorizer, challenge=self._server_challenge)
                     except Exception:
                         self.close()
                         break
@@ -240,20 +289,55 @@ class Connection:
                             ("BANNER_ACK", info.get("reply_proof"))))
                     except OSError:
                         break
+                else:
+                    # no verifier: ack so an auth-capable dialer's
+                    # handshake wait resolves (its auth_confirm, if any,
+                    # decides whether a proof-less ack is acceptable)
+                    try:
+                        sock.sendall(_encode(("BANNER_ACK", None)))
+                    except OSError:
+                        break
                 self.peer_addr = EntityAddr(*msg[1])
                 self.peer_name = msg[2]
                 self.msgr._register_inbound(self)
                 continue
             if (isinstance(msg, tuple) and len(msg) == 2
-                    and msg[0] == "BANNER_ACK"):
-                # dialer side: the service proved possession of the
-                # session key (cephx mutual auth)
-                confirm = self.msgr.auth_confirm
-                if confirm is not None and not confirm(
-                        self._sent_authorizer, msg[1]):
+                    and msg[0] == "BANNER_RETRY"):
+                # dialer side: the acceptor wants the proof to cover its
+                # challenge — re-mint the authorizer and resend the banner
+                factory = self.msgr.authorizer_factory
+                if self.inbound or factory is None:
+                    continue
+                try:
+                    authorizer = factory(challenge=msg[1])
+                except Exception:
                     self.close()
                     break
+                self._sent_authorizer = authorizer
+                try:
+                    sock.sendall(_encode(
+                        ("BANNER", tuple(self.msgr.my_addr or ("", 0)),
+                         self.msgr.name, authorizer)))
+                except OSError:
+                    break
+                continue
+            if (isinstance(msg, tuple) and len(msg) == 2
+                    and msg[0] == "BANNER_ACK"):
+                # dialer side: the service proved possession of the
+                # session key (cephx mutual auth). The proof bytes are
+                # peer-controlled: a confirm that chokes on them is a
+                # failed confirmation, not a dead reader thread.
+                confirm = self.msgr.auth_confirm
+                if confirm is not None:
+                    try:
+                        ok = confirm(self._sent_authorizer, msg[1])
+                    except Exception:
+                        ok = False
+                    if not ok:
+                        self.close()
+                        break
                 self.auth_confirmed = True
+                self._auth_ready.set()
                 continue
             # Inbound connections behind a verifier may not deliver
             # anything before a valid banner: a peer that skips the
@@ -299,10 +383,12 @@ class Messenger:
         self.conf = conf
         self.policy_lossy = policy_lossy
         # cephx connection auth (src/msg AuthAuthorizer plumbing):
-        # authorizer_factory() -> dict attached to our outgoing banner;
-        # auth_verifier.verify_authorizer(dict) gates inbound banners;
-        # auth_confirm(sent_authorizer, reply_proof) -> bool validates
-        # the service's mutual-auth BANNER_ACK on dialed connections.
+        # authorizer_factory(challenge=None) -> dict attached to our
+        # outgoing banner (called again with the acceptor's challenge
+        # on the BANNER_RETRY round); auth_verifier.verify_authorizer
+        # gates inbound banners; auth_confirm(sent_authorizer,
+        # reply_proof) -> bool validates the service's mutual-auth
+        # BANNER_ACK on dialed connections.
         self.authorizer_factory = authorizer_factory
         self.auth_verifier = auth_verifier
         self.auth_confirm = auth_confirm
@@ -438,3 +524,8 @@ class Messenger:
             return 0.0
         mx = self.conf.get_val("ms_inject_delay_max")
         return self._rng.uniform(0, mx) if mx > 0 else 0.0
+
+
+# Arm the decode registry (message catalog + map/crush structs). At the
+# module bottom to break the codecs -> messenger import cycle.
+from .. import codecs  # noqa: E402,F401

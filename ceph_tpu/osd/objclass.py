@@ -20,9 +20,10 @@ Built-ins mirror reference classes: `hello` (src/cls/hello/),
 
 from __future__ import annotations
 
-import pickle
 import threading
 import time
+
+from .. import encoding
 
 __all__ = ["ClassHandler", "MethodContext", "CLS_METHOD_RD",
            "CLS_METHOD_WR"]
@@ -190,40 +191,72 @@ def _register_builtins(handler: ClassHandler) -> None:
 
     def _load_lock(hctx, name):
         blob = hctx.getxattr(LOCK_XATTR % name)
-        return pickle.loads(blob) if blob else {"type": None,
+        return encoding.decode_any(blob) if blob else {"type": None,
                                                 "lockers": {}}
 
+    def _prune_expired(st, now):
+        # cls_lock lock duration semantics (cls_lock_types.h): a locker
+        # with a nonzero duration self-expires, so a crashed holder
+        # cannot wedge the object forever
+        dead = [c for c, info in st["lockers"].items()
+                if info.get("expires") and now > info["expires"]]
+        for c in dead:
+            del st["lockers"][c]
+        if not st["lockers"]:
+            st["type"] = None
+
     def lock_lock(hctx, indata: bytes):
-        req = pickle.loads(indata)   # {name, cookie, type: excl|shared}
+        # {name, cookie, type: exclusive|shared, duration: secs (0=forever)}
+        req = encoding.decode_any(indata)
+        now = time.time()
         st = _load_lock(hctx, req["name"])
+        _prune_expired(st, now)
         if st["lockers"]:
             if st["type"] == "exclusive" or req["type"] == "exclusive":
                 if req["cookie"] not in st["lockers"]:
                     return -16, b""  # EBUSY
+        duration = float(req.get("duration") or 0.0)
         st["type"] = req["type"]
-        st["lockers"][req["cookie"]] = {"acquired": time.time()}
-        hctx.setxattr(LOCK_XATTR % req["name"], pickle.dumps(st))
+        st["lockers"][req["cookie"]] = {
+            "acquired": now,
+            "expires": now + duration if duration else None}
+        hctx.setxattr(LOCK_XATTR % req["name"], encoding.encode_any(st))
         return 0, b""
 
-    def lock_unlock(hctx, indata: bytes):
-        req = pickle.loads(indata)   # {name, cookie}
+    def lock_break(hctx, indata: bytes):
+        # {name, cookie}: forcibly evict another client's locker
+        # (cls_lock break_lock, the admin/recovery path)
+        req = encoding.decode_any(indata)
         st = _load_lock(hctx, req["name"])
         if req["cookie"] not in st["lockers"]:
             return -2, b""           # ENOENT
         del st["lockers"][req["cookie"]]
         if not st["lockers"]:
             st["type"] = None
-        hctx.setxattr(LOCK_XATTR % req["name"], pickle.dumps(st))
+        hctx.setxattr(LOCK_XATTR % req["name"], encoding.encode_any(st))
+        return 0, b""
+
+    def lock_unlock(hctx, indata: bytes):
+        req = encoding.decode_any(indata)   # {name, cookie}
+        st = _load_lock(hctx, req["name"])
+        if req["cookie"] not in st["lockers"]:
+            return -2, b""           # ENOENT
+        del st["lockers"][req["cookie"]]
+        if not st["lockers"]:
+            st["type"] = None
+        hctx.setxattr(LOCK_XATTR % req["name"], encoding.encode_any(st))
         return 0, b""
 
     def lock_get_info(hctx, indata: bytes):
-        req = pickle.loads(indata)   # {name}
-        return 0, pickle.dumps(_load_lock(hctx, req["name"]))
+        req = encoding.decode_any(indata)   # {name}
+        return 0, encoding.encode_any(_load_lock(hctx, req["name"]))
 
     lock_cls.register_method("lock", CLS_METHOD_RD | CLS_METHOD_WR,
                              lock_lock)
     lock_cls.register_method("unlock", CLS_METHOD_RD | CLS_METHOD_WR,
                              lock_unlock)
+    lock_cls.register_method("break_lock", CLS_METHOD_RD | CLS_METHOD_WR,
+                             lock_break)
     lock_cls.register_method("get_info", CLS_METHOD_RD, lock_get_info)
 
     # -- refcount (src/cls/refcount/) -----------------------------------
@@ -232,13 +265,13 @@ def _register_builtins(handler: ClassHandler) -> None:
 
     def _load_refs(hctx):
         blob = hctx.getxattr(REF_XATTR)
-        return pickle.loads(blob) if blob else set()
+        return encoding.decode_any(blob) if blob else set()
 
     def ref_get(hctx, indata: bytes):
         tag = indata.decode()
         refs = _load_refs(hctx)
         refs.add(tag)
-        hctx.setxattr(REF_XATTR, pickle.dumps(refs))
+        hctx.setxattr(REF_XATTR, encoding.encode_any(refs))
         return 0, b""
 
     def ref_put(hctx, indata: bytes):
@@ -246,14 +279,14 @@ def _register_builtins(handler: ClassHandler) -> None:
         refs = _load_refs(hctx)
         refs.discard(tag)
         if refs:
-            hctx.setxattr(REF_XATTR, pickle.dumps(refs))
+            hctx.setxattr(REF_XATTR, encoding.encode_any(refs))
         else:
             # last reference dropped: the object goes away
             hctx.remove()
         return 0, b""
 
     def ref_read(hctx, indata: bytes):
-        return 0, pickle.dumps(sorted(_load_refs(hctx)))
+        return 0, encoding.encode_any(sorted(_load_refs(hctx)))
 
     refc.register_method("get", CLS_METHOD_RD | CLS_METHOD_WR, ref_get)
     refc.register_method("put", CLS_METHOD_RD | CLS_METHOD_WR, ref_put)

@@ -259,7 +259,11 @@ class MClockOpClassQueue(OpQueue):
 
     def next_ready_in(self, now=None):
         now = time.monotonic() if now is None else now
-        waits = [c.q[0][2] - now for c in self._classes.values() if c.q]
+        # a head op becomes serviceable at the earlier of its
+        # reservation tag and its limit tag (dequeue serves the
+        # r-phase first), so the wait must take min over both
+        waits = [min(c.q[0][0], c.q[0][2]) - now
+                 for c in self._classes.values() if c.q]
         return max(0.0, min(waits)) if waits else None
 
     def empty(self) -> bool:

@@ -19,18 +19,26 @@ committed seq — crash recovery is replay, exactly the reference's
 model. A torn or corrupt journal tail ends replay at the last valid
 entry and is truncated away so post-recovery writes stay replayable.
 
+Replay is IDEMPOTENT by construction: every journaled op sets absolute
+state for the regions it touches (writes carry offsets, clones and
+moves are journaled with their captured source content — clone_data /
+move_data). So a crash between checkpoint-file writes and the
+commit_seq advance is safe: replaying ops the checkpoint already
+includes reproduces the same bytes instead of corrupting them (the
+reference gets the same property from FileStore's op_seq guard).
+
 Layout under `path/`:
-  journal         framed WAL (wal.FramedLog; payload = pickled (seq, ops))
+  journal         framed WAL (wal.FramedLog; payload = encoded (seq, ops))
   commit_seq      last checkpointed op seq (atomic rename)
-  current/<h>     one pickle per object: {cid, oid, data, xattrs, omap}
+  current/<h>     one encoded doc per object: {cid, oid, data, ...}
 """
 
 from __future__ import annotations
 
 import hashlib
 import os
-import pickle
 
+from .. import encoding
 from ..compressor import compress_if_worthwhile
 from ..compressor import create as compressor_create
 from .mem_store import MemStore
@@ -72,7 +80,7 @@ class FileStore(MemStore):
         self._load_checkpoint()
         for blob in self._journal.open():
             try:
-                seq, ops = pickle.loads(blob)
+                seq, ops = encoding.decode_any(blob)
             except Exception:
                 continue
             if seq <= self._committed_seq:
@@ -101,7 +109,7 @@ class FileStore(MemStore):
             fpath = os.path.join(self.current_dir, name)
             try:
                 with open(fpath, "rb") as f:
-                    doc = pickle.load(f)
+                    doc = encoding.decode_any(f.read())
             except Exception:
                 continue  # half-written checkpoint file; journal re-creates
             if doc.get("kind") == "collection":
@@ -128,16 +136,40 @@ class FileStore(MemStore):
             raise RuntimeError("FileStore not mounted")
         with self._lock:
             self._seq += 1
-            # journal-ahead: durable once append returns
-            self._journal.append(pickle.dumps((self._seq, txn.ops)))
+            # capture content for non-idempotent ops (clone/move) so the
+            # journaled form replays to the same bytes, then apply; the
+            # captures must run interleaved with the applies so a clone
+            # sees earlier writes from the same transaction
+            jops = []
             for op in txn.ops:
+                op = self._capture(op)
+                jops.append(op)
                 self._apply_tracked(op)
+            # journal-ahead: durable once append returns (nothing is
+            # acked to the caller until this line)
+            self._journal.append(encoding.encode_any((self._seq, jops)))
         for cb in txn.on_commit:
             self._complete(cb)
         for cb in txn.on_applied:
             self._complete(cb)
         if self._journal.size >= self.sync_threshold:
             self.sync()
+
+    def _capture(self, op: tuple) -> tuple:
+        """Rewrite clone/move ops into content-captured, idempotent
+        forms for the journal (and the in-memory apply, same path)."""
+        kind = op[0]
+        if kind == "clone":
+            _, cid, src_oid, dst = op
+            obj = self._obj(cid, src_oid)
+            return ("clone_data", cid, dst, bytes(obj.data),
+                    dict(obj.xattrs), dict(obj.omap))
+        if kind == "move_rename":
+            _, src_cid, src_oid, dst_cid, dst_oid = op
+            obj = self._obj(src_cid, src_oid)
+            return ("move_data", src_cid, src_oid, dst_cid, dst_oid,
+                    bytes(obj.data), dict(obj.xattrs), dict(obj.omap))
+        return op
 
     def _apply_tracked(self, op: tuple) -> None:
         """Apply one op and track dirty/removed objects for checkpoint."""
@@ -157,14 +189,15 @@ class FileStore(MemStore):
         elif kind == "remove":
             self._dirty.discard((op[1], op[2]))
             self._removed.add((op[1], op[2]))
-        elif kind == "move_rename":
-            _, src_cid, src_oid, dst_cid, dst_oid = op
+        elif kind in ("move_rename", "move_data"):
+            src_cid, src_oid, dst_cid, dst_oid = op[1:5]
             self._dirty.discard((src_cid, src_oid))
             self._removed.add((src_cid, src_oid))
             self._removed.discard((dst_cid, dst_oid))
             self._dirty.add((dst_cid, dst_oid))
-        elif kind == "clone":
-            _, cid, _src, dst = op
+        elif kind in ("clone", "clone_data"):
+            _, cid, *rest = op
+            dst = op[3] if kind == "clone" else op[2]
             self._removed.discard((cid, dst))
             self._dirty.add((cid, dst))
         elif len(op) >= 3:
@@ -174,11 +207,11 @@ class FileStore(MemStore):
     # -- checkpoint ----------------------------------------------------
 
     def _obj_path(self, cid, oid) -> str:
-        h = hashlib.sha1(pickle.dumps((cid, oid))).hexdigest()
+        h = hashlib.sha1(encoding.encode_any((cid, oid))).hexdigest()
         return os.path.join(self.current_dir, h)
 
     def _coll_path(self, cid) -> str:
-        h = hashlib.sha1(pickle.dumps(("__coll__", cid))).hexdigest()
+        h = hashlib.sha1(encoding.encode_any(("__coll__", cid))).hexdigest()
         return os.path.join(self.current_dir, "c_" + h)
 
     def sync(self) -> None:
@@ -194,7 +227,7 @@ class FileStore(MemStore):
             if dirty_colls:
                 live = {self._coll_path(cid) for cid in self._colls}
                 for cid in self._colls:
-                    write_atomic(self._coll_path(cid), pickle.dumps(
+                    write_atomic(self._coll_path(cid), encoding.encode_any(
                         {"kind": "collection", "cid": cid}))
                 for name in os.listdir(self.current_dir):
                     fpath = os.path.join(self.current_dir, name)
@@ -213,7 +246,7 @@ class FileStore(MemStore):
                 alg, payload = compress_if_worthwhile(
                     self._compressor, bytes(obj.data),
                     self._required_ratio)
-                write_atomic(self._obj_path(cid, oid), pickle.dumps({
+                write_atomic(self._obj_path(cid, oid), encoding.encode_any({
                     "cid": cid, "oid": oid, "data": payload,
                     "compression": alg,
                     "xattrs": obj.xattrs, "omap": obj.omap}))

@@ -13,9 +13,9 @@ from __future__ import annotations
 
 import bisect
 import os
-import pickle
 import threading
 
+from .. import encoding
 from .wal import FramedLog, write_atomic
 
 __all__ = ["KeyValueDB", "MemDB", "FileDB"]
@@ -116,7 +116,7 @@ class FileDB(MemDB):
         os.makedirs(self.path, exist_ok=True)
         try:
             with open(self.snap_path, "rb") as f:
-                data = pickle.load(f)
+                data = encoding.decode_any(f.read())
             for prefix, ns in data.items():
                 self._data[prefix] = dict(ns)
                 self._keys[prefix] = sorted(ns)
@@ -124,7 +124,7 @@ class FileDB(MemDB):
             pass
         for blob in self._log.open():
             batch = _Batch()
-            batch.ops = pickle.loads(blob)
+            batch.ops = encoding.decode_any(blob)
             super().submit_transaction(batch)
         self._opened = True
         return self
@@ -139,12 +139,12 @@ class FileDB(MemDB):
         if not self._opened:
             raise RuntimeError("FileDB not opened")
         with self._lock:
-            self._log.append(pickle.dumps(batch.ops))
+            self._log.append(encoding.encode_any(batch.ops))
             super().submit_transaction(batch)
         if self._log.size >= self.compact_threshold:
             self.compact()
 
     def compact(self) -> None:
         with self._lock:
-            write_atomic(self.snap_path, pickle.dumps(self._data))
+            write_atomic(self.snap_path, encoding.encode_any(self._data))
             self._log.restart()

@@ -131,10 +131,29 @@ class MemStore(ObjectStore):
         elif kind == "clone":
             _, cid, src, dst = op
             self._coll(cid).objects[dst] = self._obj(cid, src).clone()
+        elif kind == "clone_data":
+            # content-captured clone (FileStore journals these so replay
+            # is idempotent: the captured bytes, not the live source)
+            _, cid, dst, data, xattrs, omap = op
+            obj = self._obj(cid, dst, create=True)
+            obj.data = bytearray(data)
+            obj.xattrs = dict(xattrs)
+            obj.omap = dict(omap)
         elif kind == "move_rename":
             _, src_cid, src_oid, dst_cid, dst_oid = op
             obj = self._coll(src_cid).objects.pop(src_oid)
             self._coll(dst_cid).objects[dst_oid] = obj
+        elif kind == "move_data":
+            # content-captured move_rename (idempotent on replay: a
+            # missing source means the move already happened)
+            _, src_cid, src_oid, dst_cid, dst_oid, data, xattrs, omap = op
+            src_coll = self._colls.get(src_cid)
+            if src_coll is not None:
+                src_coll.objects.pop(src_oid, None)
+            obj = self._obj(dst_cid, dst_oid, create=True)
+            obj.data = bytearray(data)
+            obj.xattrs = dict(xattrs)
+            obj.omap = dict(omap)
         elif kind == "setattr":
             _, cid, oid, name, value = op
             self._obj(cid, oid, create=True).xattrs[name] = value

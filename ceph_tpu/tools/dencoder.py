@@ -1,0 +1,225 @@
+"""dencoder — encode/decode inspection + golden-corpus maintenance.
+
+Role of the reference's ceph-dencoder (src/tools/ceph-dencoder/,
+src/test/encoding/readable.sh + ceph-object-corpus): enumerate every
+registered encodable type, decode arbitrary payloads to a readable
+dump, and maintain a committed corpus of golden encodings so format
+breaks are caught by CI rather than by a cluster that can no longer
+read its own disks.
+
+CLI:
+  python -m ceph_tpu.tools.dencoder list_types
+  python -m ceph_tpu.tools.dencoder decode <hexfile|->        # dump
+  python -m ceph_tpu.tools.dencoder generate_corpus <dir>     # goldens
+  python -m ceph_tpu.tools.dencoder check_corpus <dir>
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+
+import numpy as np
+
+import ceph_tpu.codecs  # noqa: F401  (arms the registry)
+from ceph_tpu import encoding
+
+__all__ = ["list_types", "dump", "corpus_samples", "generate_corpus",
+           "check_corpus", "main"]
+
+
+def list_types() -> list[str]:
+    return encoding.registered_types()
+
+
+def dump(value, indent: int = 0) -> str:
+    """Readable, deterministic rendition of a decoded value (the
+    ceph-dencoder `dump_json` analog)."""
+    pad = "  " * indent
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        lines = ["%s%s {" % (pad, type(value).__name__)]
+        for f in dataclasses.fields(value):
+            v = getattr(value, f.name)
+            lines.append("%s  %s: %s" % (pad, f.name,
+                                         dump(v, indent + 1).lstrip()))
+        lines.append(pad + "}")
+        return "\n".join(lines)
+    if isinstance(value, np.ndarray):
+        return "%sndarray(%s, %s, %s)" % (pad, value.dtype,
+                                          value.shape, value.tolist())
+    if isinstance(value, dict):
+        if not value:
+            return pad + "{}"
+        items = sorted(value.items(), key=lambda kv: repr(kv[0]))
+        lines = [pad + "{"]
+        for k, v in items:
+            lines.append("%s  %r: %s" % (pad, k,
+                                         dump(v, indent + 1).lstrip()))
+        lines.append(pad + "}")
+        return "\n".join(lines)
+    if isinstance(value, (list, tuple)):
+        body = ", ".join(dump(v).strip() for v in value)
+        return "%s%s%s%s" % (pad, "[" if isinstance(value, list) else "(",
+                             body,
+                             "]" if isinstance(value, list) else ")")
+    if hasattr(value, "__dict__") and type(value).__module__ != "builtins":
+        lines = ["%s%s {" % (pad, type(value).__name__)]
+        for k in sorted(vars(value)):
+            lines.append("%s  %s: %s" % (pad, k,
+                                         dump(vars(value)[k],
+                                              indent + 1).lstrip()))
+        lines.append(pad + "}")
+        return "\n".join(lines)
+    return pad + repr(value)
+
+
+def corpus_samples() -> dict[str, object]:
+    """One canonical, deterministic instance per interesting type —
+    the committed-corpus generators."""
+    from ceph_tpu.crush.map import CrushMap, Rule, weight_fixed
+    from ceph_tpu.msg import message as m
+    from ceph_tpu.msg.messenger import EntityAddr
+    from ceph_tpu.osd.osd_map import Incremental, OSDMap, PGID, PGPool
+
+    samples: dict[str, object] = {}
+
+    samples["msg.EntityAddr"] = EntityAddr("10.0.0.1", 6800)
+    samples["osd.PGID"] = PGID(3, 0x1F)
+    samples["osd.PGPool"] = PGPool(2, "bench", type=3, size=11,
+                                   min_size=9, pg_num=64, crush_rule=1,
+                                   erasure_code_profile="k8m3")
+
+    cm = CrushMap()
+    cm.type_names.update({"osd": 0, "host": 1, "root": 10})
+    hosts = []
+    for h in range(3):
+        hid = cm.add_bucket("straw2", 1, [h], [weight_fixed(1.0)],
+                            name="host%d" % h)
+        hosts.append(hid)
+    cm.add_bucket("straw2", 10, hosts, [weight_fixed(1.0)] * 3,
+                  name="root")
+    cm.add_simple_rule("replicated_rule", "root", "host")
+    samples["crush.CrushMap"] = cm
+    samples["crush.Rule"] = Rule(steps=[("take", -4),
+                                        ("chooseleaf_firstn", 0, 1),
+                                        ("emit",)], name="r")
+
+    om = OSDMap()
+    om.set_max_osd(3)
+    for o in range(3):
+        om.osd_exists[o] = True
+        om.osd_up[o] = True
+        om.osd_weight[o] = 0x10000
+        om.osd_addrs[o] = EntityAddr("10.0.0.%d" % o, 6800 + o)
+    om.crush = cm
+    om.epoch = 7
+    om.pools[1] = PGPool(1, "rbd", pg_num=8)
+    om.pg_temp[PGID(1, 3)] = [2, 0, 1]
+    samples["osd.OSDMap"] = om
+
+    inc = Incremental(8)
+    inc.new_down = [1]
+    inc.new_weight = {1: 0}
+    inc.new_pg_temp = {PGID(1, 3): []}
+    samples["osd.Incremental"] = inc
+
+    # message catalog: default-constructed + transport header (seq is
+    # process-global; pin it for determinism)
+    for name in m.__all__:
+        cls = getattr(m, name)
+        if name == "Message" or not isinstance(cls, type):
+            continue
+        msg = cls()
+        msg.seq = 42
+        msg.from_name = ("corpus", 0)
+        samples["msg." + name] = msg
+
+    # a loaded data-plane op, beyond the defaults
+    op = m.MOSDOp(client_id=4, tid=9, pgid=PGID(1, 5), oid="obj-1",
+                  ops=[("write", 0, b"\x00\x01payload"),
+                       ("setxattr", "k", b"v")], map_epoch=7)
+    op.seq = 43
+    op.from_name = ("client", 4)
+    samples["msg.MOSDOp+loaded"] = op
+    return samples
+
+
+def generate_corpus(dirpath: str) -> int:
+    import os
+    os.makedirs(dirpath, exist_ok=True)
+    n = 0
+    for name, value in sorted(corpus_samples().items()):
+        blob = encoding.encode_any(value)
+        base = os.path.join(dirpath, name.replace("/", "_"))
+        with open(base + ".bin", "wb") as f:
+            f.write(blob)
+        with open(base + ".dump", "w") as f:
+            f.write(dump(encoding.decode_any(blob)) + "\n")
+        n += 1
+    return n
+
+
+def check_corpus(dirpath: str) -> list[str]:
+    """Decode every committed .bin and compare its dump against the
+    committed .dump — a format break shows as a diff, exactly the
+    readable.sh contract. Returns failures."""
+    import os
+    failures = []
+    for fname in sorted(os.listdir(dirpath)):
+        if not fname.endswith(".bin"):
+            continue
+        base = os.path.join(dirpath, fname[:-4])
+        with open(base + ".bin", "rb") as f:
+            blob = f.read()
+        try:
+            got = dump(encoding.decode_any(blob)) + "\n"
+        except encoding.DecodeError as e:
+            failures.append("%s: decode failed: %s" % (fname, e))
+            continue
+        try:
+            with open(base + ".dump") as f:
+                want = f.read()
+        except OSError:
+            failures.append("%s: missing .dump" % fname)
+            continue
+        if got != want:
+            failures.append("%s: dump mismatch" % fname)
+    return failures
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(__doc__)
+        return 2
+    cmd = argv[0]
+    if cmd == "list_types":
+        for t in list_types():
+            print(t)
+        return 0
+    if cmd == "decode":
+        data = (sys.stdin.buffer.read() if argv[1] == "-"
+                else open(argv[1], "rb").read())
+        try:
+            data = bytes.fromhex(data.decode("ascii").strip())
+        except (UnicodeDecodeError, ValueError):
+            pass                      # already raw binary
+        print(dump(encoding.decode_any(data)))
+        return 0
+    if cmd == "generate_corpus":
+        n = generate_corpus(argv[1])
+        print("wrote %d corpus entries to %s" % (n, argv[1]))
+        return 0
+    if cmd == "check_corpus":
+        failures = check_corpus(argv[1])
+        for f in failures:
+            print("FAIL: " + f)
+        print("%s" % ("OK" if not failures else
+                      "%d failures" % len(failures)))
+        return 1 if failures else 0
+    print("unknown command %r" % cmd)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -166,7 +166,8 @@ class TestMessengerAuth:
         server_msgr.start()
         client_msgr = Messenger(
             ("client", 1),
-            authorizer_factory=lambda: client.build_authorizer("osd"))
+            authorizer_factory=lambda challenge=None: client.build_authorizer(
+                "osd", challenge))
         client_msgr.bind()
         client_msgr.start()
         try:
@@ -213,9 +214,9 @@ class TestMessengerAuth:
         """A raw TCP peer that skips the banner entirely must not get
         its messages dispatched (the gate is per-connection, not
         per-banner)."""
-        import pickle
         import socket
         import struct
+        from ceph_tpu import encoding
         from ceph_tpu.msg.message import MPing
         from ceph_tpu.msg.messenger import Dispatcher, Messenger
         _, svc_secret = self._handshake_world()
@@ -233,7 +234,7 @@ class TestMessengerAuth:
         addr = server_msgr.bind()
         server_msgr.start()
         try:
-            payload = pickle.dumps(MPing(stamp=9.9))
+            payload = encoding.encode_any(MPing(stamp=9.9))
             frame = struct.pack("<4sI", b"CTPU", len(payload)) + payload
             with socket.create_connection(tuple(addr), timeout=2) as s:
                 s.sendall(frame)
@@ -270,7 +271,8 @@ class TestMessengerAuth:
         server_msgr.start()
         client_msgr = Messenger(
             ("client", 1),
-            authorizer_factory=lambda: client.build_authorizer("osd"),
+            authorizer_factory=lambda challenge=None: client.build_authorizer(
+                "osd", challenge),
             auth_confirm=lambda authorizer, proof: client.verify_reply(
                 authorizer["service"], proof, authorizer["nonce"]))
         client_msgr.add_dispatcher_tail(Echo(client_msgr))

@@ -1,0 +1,256 @@
+"""Versioned binary encoding: round-trips, version evolution, safety.
+
+Mirrors the reference's encoding tests (src/test/encoding/ +
+ceph-dencoder readable.sh): every type round-trips, old payloads decode
+under newer code (defaults for missing fields), new payloads decode
+under older code (trailing fields skipped), and the compat gate refuses
+payloads marked unreadable."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import ceph_tpu.codecs  # noqa: F401  (arms the registry)
+from ceph_tpu import encoding
+from ceph_tpu.encoding import (DecodeError, Decoder, Encoder, decode_any,
+                               encode_any)
+
+
+class TestPrimitives:
+    def test_fixed_width(self):
+        enc = Encoder()
+        enc.u8(0xAB)
+        enc.u16(0xBEEF)
+        enc.u32(0xDEADBEEF)
+        enc.u64(0x0123456789ABCDEF)
+        enc.float64(3.5)
+        enc.bool_(True)
+        dec = Decoder(enc.getvalue())
+        assert dec.u8() == 0xAB
+        assert dec.u16() == 0xBEEF
+        assert dec.u32() == 0xDEADBEEF
+        assert dec.u64() == 0x0123456789ABCDEF
+        assert dec.float64() == 3.5
+        assert dec.bool_() is True
+
+    @pytest.mark.parametrize("v", [0, 1, 127, 128, 300, 2**32, 2**70])
+    def test_varint(self, v):
+        enc = Encoder()
+        enc.varint(v)
+        assert Decoder(enc.getvalue()).varint() == v
+
+    @pytest.mark.parametrize("v", [0, -1, 1, -(2**40), 2**40, -(2**70)])
+    def test_svarint(self, v):
+        enc = Encoder()
+        enc.svarint(v)
+        assert Decoder(enc.getvalue()).svarint() == v
+
+    def test_varint_rejects_negative(self):
+        with pytest.raises(encoding.EncodeError):
+            Encoder().varint(-1)
+
+    def test_str_bytes(self):
+        enc = Encoder()
+        enc.str_("héllo")
+        enc.bytes_(b"\x00\xff")
+        dec = Decoder(enc.getvalue())
+        assert dec.str_() == "héllo"
+        assert dec.bytes_() == b"\x00\xff"
+
+    def test_truncation_raises(self):
+        enc = Encoder()
+        enc.u64(7)
+        with pytest.raises(DecodeError):
+            Decoder(enc.getvalue()[:3]).u64()
+
+
+class TestAny:
+    @pytest.mark.parametrize("v", [
+        None, True, False, 0, -17, 2**66, 2.25, b"raw", "text",
+        [1, "a", None], (1, (2, 3)), {"k": [1, 2], 3: b"x"},
+        {1, 2, 3}, frozenset({"a"}), bytearray(b"mut"),
+    ])
+    def test_roundtrip(self, v):
+        out = decode_any(encode_any(v))
+        assert out == v
+        assert type(out) is type(v)
+
+    def test_ndarray(self):
+        a = np.arange(12, dtype=np.uint8).reshape(3, 4)
+        out = decode_any(encode_any(a))
+        assert out.dtype == a.dtype and out.shape == a.shape
+        assert np.array_equal(out, a)
+
+    def test_numpy_scalars_decay(self):
+        assert decode_any(encode_any(np.int64(-5))) == -5
+        assert decode_any(encode_any(np.float64(1.5))) == 1.5
+
+    def test_nested_structs_in_containers(self):
+        from ceph_tpu.osd.osd_map import PGID
+        v = {PGID(1, 2): [PGID(3, 4)]}
+        assert decode_any(encode_any(v)) == v
+
+    def test_unencodable_raises(self):
+        with pytest.raises(encoding.EncodeError):
+            encode_any(object())
+
+    def test_unknown_struct_name_raises(self):
+        enc = Encoder()
+        enc.u8(11)                       # _T_STRUCT
+        enc.str_("no.such.type")
+        enc.u8(1)
+        enc.u8(1)
+        enc.u32(0)
+        with pytest.raises(DecodeError):
+            decode_any(enc.getvalue())
+
+    def test_restricted_refuses_structs(self):
+        from ceph_tpu.osd.osd_map import PGID
+        blob = encode_any(PGID(0, 0))
+        with pytest.raises(DecodeError):
+            decode_any(blob, restricted=True)
+        # builtins still fine
+        assert decode_any(encode_any({"a": (1, b"x")}),
+                          restricted=True) == {"a": (1, b"x")}
+
+
+@dataclasses.dataclass
+class _V1:
+    a: int = 0
+    b: str = ""
+
+
+@dataclasses.dataclass
+class _V2:
+    a: int = 0
+    b: str = ""
+    c: float = 1.5          # appended in "version 2"
+    d: list = dataclasses.field(default_factory=list)
+
+
+class TestVersionEvolution:
+    """The simulated version bump VERDICT item 6 asks for."""
+
+    @classmethod
+    def setup_class(cls):
+        encoding.encodable("test.EvolvingV1", version=1)(_V1)
+        encoding.encodable("test.EvolvingV2", version=2)(_V2)
+
+    def test_old_payload_new_decoder(self):
+        # encode with the v1 layout, decode as if it were v2's name
+        blob = bytearray(encode_any(_V1(a=7, b="x")))
+        # patch the struct name v1 -> v2 (same frame layout)
+        blob = bytes(blob).replace(b"test.EvolvingV1", b"test.EvolvingV2")
+        out = decode_any(blob)
+        assert isinstance(out, _V2)
+        assert out.a == 7 and out.b == "x"
+        assert out.c == 1.5 and out.d == []   # defaults for new fields
+
+    def test_new_payload_old_decoder(self):
+        blob = bytes(encode_any(_V2(a=9, b="y", c=2.5, d=[1])))
+        blob = blob.replace(b"test.EvolvingV2", b"test.EvolvingV1")
+        out = decode_any(blob)
+        assert isinstance(out, _V1)
+        assert out.a == 9 and out.b == "y"    # trailing fields skipped
+
+    def test_compat_gate(self):
+        enc = Encoder()
+        enc.u8(11)                      # struct tag
+        enc.str_("test.EvolvingV1")
+        enc.u8(9)                       # struct_v 9
+        enc.u8(9)                       # compat_v 9 > our 1
+        enc.u32(0)
+        with pytest.raises(DecodeError, match="requires version"):
+            decode_any(enc.getvalue())
+
+
+class TestMessageCodecs:
+    def test_all_message_types_roundtrip(self):
+        """Every type in the catalog encodes with defaults and carries
+        its transport header."""
+        from ceph_tpu.msg import message as m
+        for name in m.__all__:
+            cls = getattr(m, name)
+            if name == "Message" or not isinstance(cls, type):
+                continue
+            msg = cls()
+            msg.from_name = ("test", 0)
+            out = decode_any(encode_any(msg))
+            assert type(out) is cls
+            assert out.seq == msg.seq
+            assert out.from_name == ("test", 0)
+
+    def test_osdmap_roundtrip_maps_identically(self):
+        from ceph_tpu.crush.map import CrushMap, Rule, weight_fixed
+        from ceph_tpu.osd.osd_map import OSDMap, PGID, PGPool
+
+        cm = CrushMap()
+        cm.type_names.update({"osd": 0, "host": 1, "root": 10})
+        hosts = []
+        for h in range(3):
+            hid = cm.add_bucket("straw2", 1, [h], [weight_fixed(1.0)],
+                                name="host%d" % h)
+            hosts.append(hid)
+        cm.add_bucket("straw2", 10, hosts,
+                      [weight_fixed(1.0)] * 3, name="root")
+        cm.add_simple_rule("data", "root", "host")
+        om = OSDMap()
+        om.set_max_osd(3)
+        for o in range(3):
+            om.osd_exists[o] = True
+            om.osd_up[o] = True
+            om.osd_weight[o] = 0x10000
+        om.crush = cm
+        om.epoch = 3
+        om.pools[1] = PGPool(1, "p", pg_num=8, crush_rule=0)
+
+        om2 = decode_any(encode_any(om))
+        for ps in range(8):
+            pgid = PGID(1, ps)
+            assert om.pg_to_up_acting_osds(pgid) == \
+                om2.pg_to_up_acting_osds(pgid)
+
+
+class TestHostileFrames:
+    """Review findings: every malformed-payload failure mode must be
+    DecodeError, never a raw TypeError/UnicodeDecodeError/etc."""
+
+    def test_bad_utf8_str(self):
+        enc = Encoder()
+        enc.u8(6)                       # _T_STR
+        enc.bytes_(b"\xff\xfe")
+        with pytest.raises(DecodeError):
+            decode_any(enc.getvalue())
+
+    def test_unhashable_dict_key(self):
+        enc = Encoder()
+        enc.u8(9)                       # _T_DICT
+        enc.varint(1)
+        enc.any([1])                    # list key: unhashable
+        enc.any(2)
+        with pytest.raises(DecodeError):
+            decode_any(enc.getvalue())
+
+    def test_bogus_dtype(self):
+        enc = Encoder()
+        enc.u8(13)                      # _T_NDARRAY
+        enc.str_("zzz9")
+        enc.varint(1)
+        enc.varint(0)
+        enc.bytes_(b"")
+        with pytest.raises(DecodeError):
+            decode_any(enc.getvalue())
+
+    def test_deep_nesting_bounded(self):
+        blob = bytes([7, 1]) * 2000 + bytes([0])   # 2000 nested lists
+        with pytest.raises(DecodeError):
+            decode_any(blob)
+
+    def test_depth_limit_allows_normal_nesting(self):
+        v = [1]
+        for _ in range(50):
+            v = [v]
+        assert decode_any(encode_any(v)) == v

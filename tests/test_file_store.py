@@ -7,7 +7,7 @@ checkpoint + trim, and the KV write-ahead log.
 """
 
 import os
-import pickle
+from ceph_tpu import encoding
 import struct
 
 import pytest

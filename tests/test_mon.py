@@ -2,7 +2,7 @@
 
 Models qa/standalone-style localhost multi-daemon checks at unit scale."""
 
-import pickle
+from ceph_tpu import encoding
 import socket
 import time
 

@@ -7,7 +7,7 @@ semantics, and the EC-pool EOPNOTSUPP rule
 (ecbackend.rst:79-83).
 """
 
-import pickle
+from ceph_tpu import encoding
 
 import pytest
 
@@ -78,39 +78,39 @@ class TestLock:
     def test_exclusive_lock_cycle(self, ctx):
         _, _, ioctx = ctx
         req = {"name": "l1", "cookie": "c1", "type": "exclusive"}
-        ioctx.exec("locked", "lock", "lock", pickle.dumps(req))
+        ioctx.exec("locked", "lock", "lock", encoding.encode_any(req))
         # a second locker is refused
         with pytest.raises(RadosError) as ei:
-            ioctx.exec("locked", "lock", "lock", pickle.dumps(
+            ioctx.exec("locked", "lock", "lock", encoding.encode_any(
                 {"name": "l1", "cookie": "c2", "type": "exclusive"}))
         assert ei.value.errno == 16  # EBUSY
-        info = pickle.loads(ioctx.exec(
-            "locked", "lock", "get_info", pickle.dumps({"name": "l1"})))
+        info = encoding.decode_any(ioctx.exec(
+            "locked", "lock", "get_info", encoding.encode_any({"name": "l1"})))
         assert list(info["lockers"]) == ["c1"]
         ioctx.exec("locked", "lock", "unlock",
-                   pickle.dumps({"name": "l1", "cookie": "c1"}))
+                   encoding.encode_any({"name": "l1", "cookie": "c1"}))
         # now c2 can take it
-        ioctx.exec("locked", "lock", "lock", pickle.dumps(
+        ioctx.exec("locked", "lock", "lock", encoding.encode_any(
             {"name": "l1", "cookie": "c2", "type": "exclusive"}))
 
     def test_shared_lock(self, ctx):
         _, _, ioctx = ctx
         for cookie in ("s1", "s2"):
-            ioctx.exec("shared", "lock", "lock", pickle.dumps(
+            ioctx.exec("shared", "lock", "lock", encoding.encode_any(
                 {"name": "l", "cookie": cookie, "type": "shared"}))
-        info = pickle.loads(ioctx.exec(
-            "shared", "lock", "get_info", pickle.dumps({"name": "l"})))
+        info = encoding.decode_any(ioctx.exec(
+            "shared", "lock", "get_info", encoding.encode_any({"name": "l"})))
         assert sorted(info["lockers"]) == ["s1", "s2"]
         # exclusive is refused while shared lockers hold it
         with pytest.raises(RadosError):
-            ioctx.exec("shared", "lock", "lock", pickle.dumps(
+            ioctx.exec("shared", "lock", "lock", encoding.encode_any(
                 {"name": "l", "cookie": "x", "type": "exclusive"}))
 
     def test_unlock_wrong_cookie_enoent(self, ctx):
         _, _, ioctx = ctx
         with pytest.raises(RadosError) as ei:
             ioctx.exec("locked", "lock", "unlock",
-                       pickle.dumps({"name": "l1", "cookie": "ghost"}))
+                       encoding.encode_any({"name": "l1", "cookie": "ghost"}))
         assert ei.value.errno == 2
 
 
@@ -120,7 +120,7 @@ class TestRefcount:
         ioctx.write_full("counted", b"payload")
         ioctx.exec("counted", "refcount", "get", b"tagA")
         ioctx.exec("counted", "refcount", "get", b"tagB")
-        refs = pickle.loads(ioctx.exec("counted", "refcount", "read"))
+        refs = encoding.decode_any(ioctx.exec("counted", "refcount", "read"))
         assert refs == ["tagA", "tagB"]
         ioctx.exec("counted", "refcount", "put", b"tagA")
         assert ioctx.read("counted") == b"payload"   # still referenced
