@@ -114,6 +114,9 @@ def _configure_symbols(L: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
         ctypes.c_char_p, ctypes.c_size_t,
         ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_char_p]
+    L.ec_codec_decode_chunks.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p]
     for name in ("ec_tpu_batches_dispatched", "ec_tpu_requests_dispatched"):
         getattr(L, name).restype = ctypes.c_uint64
     LL = ctypes.POINTER(ctypes.c_longlong)
@@ -401,6 +404,36 @@ class NativeCodec:
             raise OSError(-r, os.strerror(-r))
         raw = out.raw
         return {i: raw[i * bs:(i + 1) * bs] for i in range(n)}
+
+    def encode_chunks(self, data, parity) -> None:
+        """Zero-copy chunk-level encode: `data` is a C-contiguous
+        uint8 array of shape [k, blocksize] (numpy), `parity` a
+        writable [m, blocksize]. The benchmark-honest path — no
+        split/pad copies, matching the reference's aligned-bufferlist
+        plugin loop."""
+        import numpy as np
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        assert parity.flags["C_CONTIGUOUS"]
+        r = self._L.ec_codec_encode_chunks(
+            self._h, data.ctypes.data_as(ctypes.c_char_p),
+            parity.ctypes.data_as(ctypes.c_char_p), data.shape[1])
+        if r:
+            raise OSError(-r, os.strerror(-r))
+
+    def decode_chunks(self, avail_rows, chunks, out) -> None:
+        """Zero-copy reconstruction of all k+m rows: `chunks` is
+        [len(avail_rows), blocksize] (ascending logical rows), `out` a
+        writable [k+m, blocksize]."""
+        import numpy as np
+        chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+        assert out.flags["C_CONTIGUOUS"]
+        rows = (ctypes.c_int * len(avail_rows))(*avail_rows)
+        r = self._L.ec_codec_decode_chunks(
+            self._h, rows, len(avail_rows),
+            chunks.ctypes.data_as(ctypes.c_void_p), chunks.shape[1],
+            out.ctypes.data_as(ctypes.c_void_p))
+        if r:
+            raise OSError(-r, os.strerror(-r))
 
     def decode(self, available: dict, want=None) -> dict:
         ids = sorted(available)

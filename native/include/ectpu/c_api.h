@@ -57,6 +57,14 @@ int ec_codec_decode(void* codec, const int* avail_ids, int navail,
                     const uint8_t* chunks, size_t blocksize,
                     const int* want_ids, int nwant, uint8_t* out);
 
+// Raw chunk reconstruction (zero-copy on matrix codecs): avail_rows are
+// LOGICAL rows (post chunk-mapping) in ascending order with their
+// contents concatenated in `chunks`; all k+m logical rows are written
+// to `out` ((k+m) * blocksize).
+int ec_codec_decode_chunks(void* codec, const int* avail_rows, int navail,
+                           const uint8_t* chunks, size_t blocksize,
+                           uint8_t* out);
+
 #ifdef __cplusplus
 }  // extern "C"
 #endif

@@ -99,6 +99,17 @@ class ErasureCode : public ErasureCodeInterface {
                             const uint8_t* const* avail,
                             std::vector<Chunk>* all, size_t blocksize) = 0;
 
+ public:
+  // Zero-copy variant: reconstruct straight into caller buffers (one
+  // per logical row, k+m of them). Matrix codecs write through their
+  // vertical kernel with no intermediate Chunk allocation; the default
+  // wraps decode_chunks + copy.
+  virtual int decode_chunks_into(const std::vector<int>& avail_rows,
+                                 const uint8_t* const* avail,
+                                 uint8_t* const* out, size_t blocksize);
+
+ protected:
+
   // Profile accessors (to_int/to_bool semantics, ErasureCode.cc:256-304).
   static int to_int(const std::string& name, Profile& profile,
                     const char* dflt, std::string* err, int* out);

@@ -41,6 +41,15 @@ void gf_region_mul(uint8_t* dst, const uint8_t* src, uint32_t g, size_t n,
 // dst[i] ^= src[i] over n bytes (the parity special case g==1).
 void xor_region(uint8_t* dst, const uint8_t* src, size_t n);
 
+// Vertical multi-output GF(2^8) matrix apply (ISA-L gf_Nvect_mad
+// analog): dst[i] = sum_j mat[i*k+j] * src[j], reading each source
+// block ONCE per output row-group instead of once per output row —
+// the row-by-row madd loop is memory-bound at ~1/7 of what the
+// vector units can do. Falls back to the madd loop off-AVX2.
+void gf8_apply_matrix(const uint32_t* mat, int rows, int k,
+                      const uint8_t* const* src, uint8_t* const* dst,
+                      size_t n);
+
 // Dense square-matrix inverse over GF(2^w); a is row-major [n, n].
 // Returns false if singular.
 bool gf_invert_matrix(const uint32_t* a, uint32_t* inv, int n, int w);

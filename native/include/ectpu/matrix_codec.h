@@ -52,6 +52,9 @@ class MatrixCodec : public GeneratorCodec {
  public:
   int encode_chunks(const uint8_t* const* data, uint8_t* const* parity,
                     size_t blocksize) override;
+  int decode_chunks_into(const std::vector<int>& avail_rows,
+                         const uint8_t* const* avail,
+                         uint8_t* const* out, size_t blocksize) override;
 
  protected:
   unsigned get_alignment() const override;

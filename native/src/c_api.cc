@@ -147,6 +147,22 @@ int ec_codec_decode(void* codec, const int* avail_ids, int navail,
   return 0;
 }
 
+int ec_codec_decode_chunks(void* codec, const int* avail_rows, int navail,
+                           const uint8_t* chunks, size_t blocksize,
+                           uint8_t* out) {
+  auto& c = ((Handle*)codec)->codec;
+  auto* ec = dynamic_cast<ectpu::ErasureCode*>(c.get());
+  if (!ec) return -ENOTSUP;   // interface-only implementations
+  unsigned n = c->get_chunk_count();
+  std::vector<int> rows(avail_rows, avail_rows + navail);
+  std::vector<const uint8_t*> ptrs((size_t)navail);
+  for (int i = 0; i < navail; ++i)
+    ptrs[(size_t)i] = chunks + (size_t)i * blocksize;
+  std::vector<uint8_t*> outs(n);
+  for (unsigned i = 0; i < n; ++i) outs[i] = out + (size_t)i * blocksize;
+  return ec->decode_chunks_into(rows, ptrs.data(), outs.data(), blocksize);
+}
+
 // native CRUSH mapper (ectpu/crush.h) over flat arrays
 int ec_crush_do_rule(const long long* bucket_ids,
                      const long long* bucket_algs,

@@ -134,6 +134,17 @@ int ErasureCode::decode(const std::set<int>& want,
   return 0;
 }
 
+int ErasureCode::decode_chunks_into(const std::vector<int>& avail_rows,
+                                    const uint8_t* const* avail,
+                                    uint8_t* const* out, size_t blocksize) {
+  std::vector<Chunk> all;
+  int r = decode_chunks(avail_rows, avail, &all, blocksize);
+  if (r) return r;
+  for (size_t i = 0; i < all.size(); ++i)
+    memcpy(out[i], all[i].data(), blocksize);
+  return 0;
+}
+
 int ErasureCode::decode_concat(const std::map<int, Chunk>& chunks,
                                Chunk* out) {
   unsigned k = get_data_chunk_count();

@@ -106,6 +106,12 @@ void MatrixCodec::apply_matrix(const uint32_t* mat, int rows,
                                const uint8_t* const* src,
                                uint8_t* const* dst,
                                size_t blocksize) const {
+  if (w_ == 8) {
+    // vertical multi-output kernel: each source block read once per
+    // row-group (gf.cc gf8_apply_matrix, the ISA-L Nvect-mad analog)
+    gf8_apply_matrix(mat, rows, k_, src, dst, blocksize);
+    return;
+  }
   for (int i = 0; i < rows; ++i) {
     memset(dst[i], 0, blocksize);
     for (int j = 0; j < k_; ++j)
@@ -123,13 +129,19 @@ int MatrixCodec::encode_chunks(const uint8_t* const* data,
 int MatrixCodec::decode_chunks(const std::vector<int>& avail_rows,
                                const uint8_t* const* avail,
                                std::vector<Chunk>* all, size_t blocksize) {
+  all->assign((size_t)(k_ + m_), Chunk(blocksize));
+  std::vector<uint8_t*> out(k_ + m_);
+  for (int i = 0; i < k_ + m_; ++i) out[i] = (*all)[i].data();
+  return decode_chunks_into(avail_rows, avail, out.data(), blocksize);
+}
+
+int MatrixCodec::decode_chunks_into(const std::vector<int>& avail_rows,
+                                    const uint8_t* const* avail,
+                                    uint8_t* const* out, size_t blocksize) {
   if (blocksize % (size_t)(w_ / 8)) return -EINVAL;
   const std::vector<uint32_t>* full = decode_entry(avail_rows);
   if (!full) return -EIO;
-  all->assign((size_t)(k_ + m_), Chunk(blocksize, 0));
-  std::vector<uint8_t*> out(k_ + m_);
-  for (int i = 0; i < k_ + m_; ++i) out[i] = (*all)[i].data();
-  apply_matrix(full->data(), k_ + m_, avail, out.data(), blocksize);
+  apply_matrix(full->data(), k_ + m_, avail, out, blocksize);
   return 0;
 }
 
