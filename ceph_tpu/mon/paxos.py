@@ -149,8 +149,12 @@ class Paxos:
         # collect phase (leader)
         self._collect_pn = 0
         self._collect_replies: set[int] = set()
-        self._promise_pn = 0              # best promise seen
         self._best_uncommitted = None     # (pn, version, value)
+        # lease acks (leader): the leader's own read authority is only
+        # as fresh as the last lease round the whole quorum acked
+        self._lease_round = 0
+        self._lease_acks: set[int] = set()
+        self._lease_ack_deadline = 0.0
         # update phase (leader)
         self._accepts: set[int] = set()
         self._inflight = None             # (version, value, waiters)
@@ -254,10 +258,11 @@ class Paxos:
             self._handle_commit(msg)
         elif op == "lease":
             self._handle_lease(msg)
+        elif op == "lease_ack":
+            self._handle_lease_ack(msg)
         elif op == "catchup":
             # a peon discovered a commit hole: stream it the range
             self.share_state(msg.from_name[1], msg.last_committed)
-        # lease_ack is informational under this transport
 
     # -- collect / last (recovery) -------------------------------------
 
@@ -295,7 +300,6 @@ class Paxos:
     def _handle_last(self, msg: MMonPaxos) -> None:
         """Leader: absorb promises (Paxos.cc handle_last)."""
         peer = msg.from_name[1]
-        share_to = None
         with self._lock:
             if self.state != STATE_RECOVERING or not self.mon.is_leader():
                 return
@@ -304,10 +308,15 @@ class Paxos:
                 if v == self.last_committed + 1:
                     self._commit_local(v, msg.values[v])
             if msg.last_committed < self.last_committed:
-                share_to = (peer, msg.last_committed)
+                # backfill the lagging peon BEFORE any lease can reach
+                # it on the same ordered connection — a behind peon
+                # must not become readable ahead of its catch-up
+                self.share_state(peer, msg.last_committed)
             if msg.pn > self._collect_pn:
                 # someone promised a higher pn elsewhere: restart the
-                # collect above it
+                # collect ABOVE it (bounding by the observed pn, not
+                # one stride at a time — Paxos.cc collect(last->pn))
+                self._collect_pn = msg.pn
                 self._start_collect()
                 return
             if msg.pn == self._collect_pn:
@@ -321,8 +330,6 @@ class Paxos:
                         self._best_uncommitted = cand
                 if self._collect_replies >= set(self.mon.quorum):
                     self._collect_done()
-        if share_to is not None:
-            self.share_state(*share_to)
 
     def _collect_done(self) -> None:
         self.state = STATE_ACTIVE
@@ -473,32 +480,67 @@ class Paxos:
     def _extend_lease_locked(self) -> None:
         if not self.mon.is_leader():
             return
-        self.lease_until = time.monotonic() + self.LEASE_DURATION
         wall_until = time.time() + self.LEASE_DURATION
+        if len(self.mon.quorum) == 1:
+            self.lease_until = time.monotonic() + self.LEASE_DURATION
+            return
+        # the leader's OWN read authority comes from the quorum acking
+        # this round — a partitioned ex-leader must NOT stay readable
+        # on self-granted leases (Paxos.cc lease_ack_timeout)
+        self._lease_round += 1
+        self._lease_acks = {self.mon.rank}
+        if self._lease_ack_deadline == 0.0:
+            self._lease_ack_deadline = \
+                time.monotonic() + self.LEASE_DURATION * 3
         for rank in self.mon.quorum:
             if rank != self.mon.rank:
                 self.mon.send_mon(rank, MMonPaxos(
-                    op="lease", last_committed=self.last_committed,
+                    op="lease", pn=self._lease_round,
+                    last_committed=self.last_committed,
                     lease_until=wall_until))
 
     def _handle_lease(self, msg: MMonPaxos) -> None:
+        behind = False
         with self._lock:
-            # convert the leader's wall-clock grant to a local monotonic
-            # deadline (clock skew bounded by the transport, as in the
-            # reference's mon_clock_drift_allowed)
-            remaining = max(0.0, msg.lease_until - time.time())
-            self.lease_until = time.monotonic() + remaining
             self._lease_grace_until = \
                 time.monotonic() + self.LEASE_DURATION * 3
+            if msg.last_committed > self.last_committed:
+                # we are missing commits: ack (the leader's round must
+                # complete) but do NOT become readable on stale state
+                behind = True
+            else:
+                # convert the leader's wall-clock grant to a local
+                # monotonic deadline (clock skew bounded by the
+                # transport, as in mon_clock_drift_allowed)
+                remaining = max(0.0, msg.lease_until - time.time())
+                self.lease_until = time.monotonic() + remaining
+        if behind:
+            self.mon.send_mon(msg.from_name[1], MMonPaxos(
+                op="catchup", last_committed=self.last_committed))
         self.mon.send_mon(msg.from_name[1], MMonPaxos(
-            op="lease_ack", last_committed=self.last_committed))
+            op="lease_ack", pn=msg.pn,
+            last_committed=self.last_committed))
+
+    def _handle_lease_ack(self, msg: MMonPaxos) -> None:
+        with self._lock:
+            if msg.pn != self._lease_round:
+                return
+            self._lease_acks.add(msg.from_name[1])
+            if self._lease_acks >= set(self.mon.quorum):
+                self.lease_until = \
+                    time.monotonic() + self.LEASE_DURATION
+                self._lease_ack_deadline = 0.0
 
     def is_readable(self) -> bool:
-        """A mon may serve reads while it holds a live lease (leader
-        while active; peon within the granted window)."""
+        """A mon may serve reads while it holds a live lease: a peon
+        within the granted window, a leader only while the quorum keeps
+        acking its lease rounds (a partitioned ex-leader goes stale)."""
         with self._lock:
             if self.mon.is_leader():
-                return self.state in (STATE_ACTIVE, STATE_UPDATING)
+                if self.state not in (STATE_ACTIVE, STATE_UPDATING):
+                    return False
+                if len(self.mon.quorum) == 1:
+                    return True
             return time.monotonic() < self.lease_until
 
     def is_writeable(self) -> bool:
@@ -516,6 +558,14 @@ class Paxos:
                     # new election rather than commit past it
                     # (Paxos.cc accept_timeout -> bootstrap)
                     self._inflight = None
+                    self.state = STATE_RECOVERING
+                    restart = True
+                elif self._lease_ack_deadline and \
+                        time.monotonic() > self._lease_ack_deadline:
+                    # the quorum stopped acking our leases: step down
+                    # and re-elect instead of serving stale reads
+                    self._lease_ack_deadline = 0.0
+                    self.lease_until = 0.0
                     self.state = STATE_RECOVERING
                     restart = True
                 else:

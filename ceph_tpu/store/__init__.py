@@ -8,6 +8,10 @@
   file_store    FileStore persistent backend: write-ahead journal +
                 checkpoint + replay-on-mount
                 (src/os/filestore/{FileStore,FileJournal}.cc)
+  block_store   BlockStore: allocator-based raw-block store with kv
+                metadata, per-chunk checksums, deferred small writes,
+                COW clones — the BlueStore analog
+                (src/os/bluestore/BlueStore.cc, doc/dev/bluestore.rst)
   kv            KeyValueDB interface + MemDB + persistent FileDB
                 (src/kv/)
 """
@@ -15,7 +19,8 @@
 from .object_store import ObjectStore, Transaction
 from .mem_store import MemStore
 from .file_store import FileStore
+from .block_store import BlockStore
 from .kv import FileDB, KeyValueDB, MemDB
 
 __all__ = ["ObjectStore", "Transaction", "MemStore", "FileStore",
-           "KeyValueDB", "MemDB", "FileDB"]
+           "BlockStore", "KeyValueDB", "MemDB", "FileDB"]

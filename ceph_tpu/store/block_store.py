@@ -1,0 +1,779 @@
+"""BlockStore — allocator-based raw-block ObjectStore (the BlueStore
+analog).
+
+Role of the reference's BlueStore (/root/reference/src/os/bluestore/
+BlueStore.cc, design per doc/dev/bluestore.rst): object data lives in a
+raw block file carved into allocator extents; all metadata (onodes with
+extent maps, blob records with per-chunk checksums, omap, collections)
+lives in a transactional KV store whose batch commit IS the transaction
+commit point. The write path follows BlueStore's two lanes:
+
+  big writes      allocate fresh extents, write the bytes, (optionally)
+                  flush, THEN commit the kv batch that references them —
+                  a crash before the commit leaves only unreferenced
+                  space (BlueStore _do_write_big / COW semantics).
+  deferred writes small overwrites inside an existing blob ride the kv
+                  commit itself as deferred records and are applied to
+                  the block file after the commit; mount replays any
+                  outstanding records (BlueStore deferred_txn / _deferred
+                  _replay). Replay is idempotent (absolute offsets).
+
+Checksums: crc32c-style per csum-chunk (zlib.crc32 here) stored in the
+blob record and verified on every read — bit-rot surfaces as EIO, which
+the scrub/repair machinery treats exactly like an injected read error
+(BlueStore _verify_csum -> -EIO).
+
+Compression: blob-level through ceph_tpu.compressor with the
+required-ratio gate (BlueStore compression_mode / blob compression).
+
+Clones are COW: the clone references the same blobs (per-blob refcount,
+the role of BlueStore's shared blobs + bluestore_extent_ref_map);
+overwrites punch the cloned range and write new blobs, never touching
+shared bytes. Space from fully-unreferenced blobs returns to the
+allocator, whose free map is rebuilt from blob metadata at mount
+(fsck-on-mount style, like modern BlueStore's NCB allocation recovery).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import zlib
+
+from .. import encoding
+from ..compressor import compress_if_worthwhile
+from ..compressor import create as compressor_create
+from .kv import FileDB
+from .object_store import ObjectStore, Transaction
+
+__all__ = ["BlockStore", "FreeList"]
+
+MIN_ALLOC = 4096            # bluestore_min_alloc_size
+CSUM_CHUNK = 4096           # bluestore_csum_block (crc granularity)
+DEFERRED_MAX = 64 * 1024    # bluestore_prefer_deferred_size-ish
+
+
+def _crc(data) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+class FreeList:
+    """First-fit extent allocator over [0, device_size), growable.
+
+    The role of BlueStore's Allocator (src/os/bluestore/Allocator.h) at
+    framework scale: allocate/release extents, coalesce on release,
+    grow the device when nothing fits."""
+
+    def __init__(self, device_size: int = 0):
+        self.device_size = device_size
+        self._free: list[list[int]] = []     # sorted [off, len]
+        if device_size:
+            self._free.append([0, device_size])
+
+    def allocate(self, want: int, align: int = MIN_ALLOC) -> int:
+        want = -(-want // align) * align
+        for ext in self._free:
+            if ext[1] >= want:
+                off = ext[0]
+                ext[0] += want
+                ext[1] -= want
+                if ext[1] == 0:
+                    self._free.remove(ext)
+                return off
+        # grow the device
+        off = self.device_size
+        self.device_size += max(want, 4 * 1024 * 1024)
+        grown = self.device_size - off - want
+        if grown:
+            self.release(off + want, grown)
+        return off
+
+    def release(self, off: int, length: int) -> None:
+        if length <= 0:
+            return
+        import bisect
+        i = bisect.bisect_left(self._free, [off, 0])
+        # coalesce with predecessor / successor
+        if i > 0 and self._free[i - 1][0] + self._free[i - 1][1] == off:
+            i -= 1
+            self._free[i][1] += length
+        else:
+            self._free.insert(i, [off, length])
+        if i + 1 < len(self._free) and \
+                self._free[i][0] + self._free[i][1] == self._free[i + 1][0]:
+            self._free[i][1] += self._free[i + 1][1]
+            del self._free[i + 1]
+
+    def mark_used(self, off: int, length: int) -> None:
+        """Carve [off, off+len) out of the free map (mount rebuild)."""
+        import bisect
+        end = off + length
+        i = bisect.bisect_right(self._free, [off, float("inf")]) - 1
+        if i < 0:
+            i = 0
+        while i < len(self._free):
+            foff, flen = self._free[i]
+            fend = foff + flen
+            if fend <= off:
+                i += 1
+                continue
+            if foff >= end:
+                break
+            keep_front = max(0, off - foff)
+            keep_back = max(0, fend - end)
+            del self._free[i]
+            if keep_front:
+                self._free.insert(i, [foff, keep_front])
+                i += 1
+            if keep_back:
+                self._free.insert(i, [end, keep_back])
+            break
+
+    def free_bytes(self) -> int:
+        return sum(length for _, length in self._free)
+
+
+class _Blob:
+    """One on-device allocation: checksummed, possibly compressed,
+    shared between extents via refcount (BlueStore blob + shared_blob).
+    """
+
+    __slots__ = ("bid", "poff", "alen", "clen", "raw", "comp", "csums",
+                 "refs")
+
+    def __init__(self, bid, poff, alen, clen, raw, comp, csums, refs=1):
+        self.bid = bid
+        self.poff = poff      # device offset
+        self.alen = alen      # allocated bytes
+        self.clen = clen      # stored bytes (== raw unless compressed)
+        self.raw = raw        # logical (uncompressed) bytes
+        self.comp = comp      # compression alg or None
+        self.csums = csums    # crc per CSUM_CHUNK of the STORED bytes
+        self.refs = refs
+
+    def to_doc(self) -> dict:
+        return {"poff": self.poff, "alen": self.alen, "clen": self.clen,
+                "raw": self.raw, "comp": self.comp, "csums": self.csums,
+                "refs": self.refs}
+
+    @classmethod
+    def from_doc(cls, bid, doc) -> "_Blob":
+        return cls(bid, doc["poff"], doc["alen"], doc["clen"],
+                   doc["raw"], doc["comp"], list(doc["csums"]),
+                   doc["refs"])
+
+
+class _Onode:
+    """Object metadata: size, sorted extent map, xattrs (BlueStore
+    Onode; extents are (loff, len, blob_id, blob_off) into blob RAW
+    space)."""
+
+    __slots__ = ("cid", "oid", "size", "extents", "xattrs")
+
+    def __init__(self, cid, oid):
+        self.cid = cid
+        self.oid = oid
+        self.size = 0
+        self.extents: list[list] = []    # [loff, len, bid, boff]
+        self.xattrs: dict = {}
+
+    def to_doc(self) -> dict:
+        return {"cid": self.cid, "oid": self.oid, "size": self.size,
+                "extents": [list(e) for e in self.extents],
+                "xattrs": self.xattrs}
+
+    @classmethod
+    def from_doc(cls, doc) -> "_Onode":
+        o = cls(doc["cid"], doc["oid"])
+        o.size = doc["size"]
+        o.extents = [list(e) for e in doc["extents"]]
+        o.xattrs = dict(doc["xattrs"])
+        return o
+
+
+def _okey(cid, oid) -> str:
+    return encoding.encode_any((cid, oid)).hex()
+
+
+def _ckey(cid) -> str:
+    return encoding.encode_any(cid).hex()
+
+
+class BlockStore(ObjectStore):
+    def __init__(self, path: str, block_sync: bool = True,
+                 kv_sync: bool = True,
+                 min_alloc: int = MIN_ALLOC,
+                 csum_chunk: int = CSUM_CHUNK,
+                 deferred_max: int = DEFERRED_MAX,
+                 compression: str = "none",
+                 compression_required_ratio: float = 0.875,
+                 finisher=None):
+        self.path = path
+        self.block_path = os.path.join(path, "block")
+        self.min_alloc = min_alloc
+        self.csum_chunk = csum_chunk
+        self.deferred_max = deferred_max
+        self.block_sync = block_sync
+        self._compressor = compressor_create(compression)
+        self._required_ratio = compression_required_ratio
+        self._decompressors: dict = {}
+        self._finisher = finisher
+        self._lock = threading.RLock()
+        self.db = FileDB(os.path.join(path, "db"), log_sync=kv_sync)
+        self._fd: int | None = None
+        self.allocator = FreeList()
+        self._colls: dict = {}           # ckey -> cid
+        self._onodes: dict = {}          # okey -> _Onode
+        self._blobs: dict = {}           # bid -> _Blob
+        self._next_blob = 1
+        self._deferred_seq = 1
+        self._read_errors: set = set()
+        self.mounted = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def mount(self) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        self.db.open()
+        self._fd = os.open(self.block_path, os.O_RDWR | os.O_CREAT, 0o644)
+        for key, raw in self.db.get_iterator("C"):
+            self._colls[key] = encoding.decode_any(raw)
+        for key, raw in self.db.get_iterator("O"):
+            self._onodes[key] = _Onode.from_doc(encoding.decode_any(raw))
+        max_end = 0
+        for key, raw in self.db.get_iterator("B"):
+            blob = _Blob.from_doc(int(key), encoding.decode_any(raw))
+            self._blobs[blob.bid] = blob
+            self._next_blob = max(self._next_blob, blob.bid + 1)
+            max_end = max(max_end, blob.poff + blob.alen)
+        # fsck-style allocator rebuild: free = device minus live blobs.
+        # The device extent is the real file high-water mark, so holes
+        # left by deleted blobs (anywhere below it) come back as free
+        # space instead of being forgotten.
+        file_size = os.fstat(self._fd).st_size
+        device = -(-max(max_end, file_size) // MIN_ALLOC) * MIN_ALLOC
+        self.allocator = FreeList(device)
+        for blob in self._blobs.values():
+            self.allocator.mark_used(blob.poff, blob.alen)
+        # replay outstanding deferred writes (idempotent: absolute offs)
+        for key, raw in self.db.get_iterator("D"):
+            rec = encoding.decode_any(raw)
+            os.pwrite(self._fd, rec["data"], rec["poff"])
+            self._deferred_seq = max(self._deferred_seq,
+                                     int(key) + 1)
+        self.mounted = True
+
+    def umount(self) -> None:
+        if not self.mounted:
+            return
+        self.sync()
+        self.db.close()
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+        self.mounted = False
+
+    def sync(self) -> None:
+        """Make the block file durable and retire the deferred records
+        it now covers (BlueStore _deferred_submit + kv cleanup)."""
+        with self._lock:
+            os.fsync(self._fd)
+            batch = self.db.get_transaction()
+            batch.rmkeys_by_prefix("D")
+            self.db.submit_transaction(batch)
+
+    # -- fault injection (scrub/thrash parity with MemStore) ----------
+
+    def inject_read_error(self, cid, oid) -> None:
+        with self._lock:
+            self._read_errors.add((cid, oid))
+
+    def clear_read_error(self, cid, oid) -> None:
+        with self._lock:
+            self._read_errors.discard((cid, oid))
+
+    # -- transaction apply ---------------------------------------------
+
+    def queue_transaction(self, txn: Transaction) -> None:
+        if not self.mounted:
+            raise RuntimeError("BlockStore not mounted")
+        with self._lock:
+            batch = self.db.get_transaction()
+            deferred: list[list] = []     # [poff, data] pending
+            self._pending_deferred = deferred
+            flush_before_commit = False
+            try:
+                for op in txn.ops:
+                    if self._apply_op(op, batch, deferred):
+                        flush_before_commit = True
+            except Exception:
+                # the applied prefix already mutated in-memory state
+                # (MemStore semantics: no rollback) — commit its batch
+                # so memory and kv agree after a failed op; the failing
+                # op itself mutates nothing before raising
+                self._pending_deferred = None
+                if flush_before_commit and self.block_sync:
+                    os.fsync(self._fd)
+                self.db.submit_transaction(batch)
+                for poff, data in deferred:
+                    os.pwrite(self._fd, data, poff)
+                raise
+            self._pending_deferred = None
+            # big-write bytes must be on disk before the kv commit that
+            # references them survives a crash
+            if flush_before_commit and self.block_sync:
+                os.fsync(self._fd)
+            self.db.submit_transaction(batch)
+            # deferred bytes apply AFTER their kv record is durable
+            for poff, data in deferred:
+                os.pwrite(self._fd, data, poff)
+        for cb in txn.on_commit:
+            self._complete(cb)
+        for cb in txn.on_applied:
+            self._complete(cb)
+
+    def _complete(self, cb) -> None:
+        if self._finisher is not None:
+            self._finisher.queue(cb)
+        else:
+            cb()
+
+    def _apply_op(self, op, batch, deferred) -> bool:
+        """Returns True if the op wrote big (pre-commit-flush) data."""
+        kind = op[0]
+        if kind == "create_collection":
+            ck = _ckey(op[1])
+            self._colls[ck] = op[1]
+            batch.set("C", ck, encoding.encode_any(op[1]))
+            return False
+        if kind == "remove_collection":
+            cid = op[1]
+            for key in [k for k, o in self._onodes.items()
+                        if o.cid == cid]:
+                self._remove_onode(key, batch)
+            ck = _ckey(cid)
+            self._colls.pop(ck, None)
+            batch.rmkey("C", ck)
+            return False
+        if kind == "touch":
+            self._get_onode(op[1], op[2], batch, create=True)
+            return False
+        if kind == "write":
+            _, cid, oid, offset, data = op
+            return self._do_write(cid, oid, offset, data, batch,
+                                  deferred)
+        if kind == "zero":
+            _, cid, oid, offset, length = op
+            onode = self._get_onode(cid, oid, batch, create=True)
+            self._punch(onode, offset, length, batch)
+            onode.size = max(onode.size, offset + length)
+            self._put_onode(onode, batch)
+            return False
+        if kind == "truncate":
+            _, cid, oid, size = op
+            onode = self._get_onode(cid, oid, batch, create=True)
+            if size < onode.size:
+                self._punch(onode, size, onode.size - size, batch)
+            onode.size = size
+            self._put_onode(onode, batch)
+            return False
+        if kind == "remove":
+            key = _okey(op[1], op[2])
+            if key in self._onodes:
+                self._remove_onode(key, batch)
+            return False
+        if kind in ("clone", "clone_data"):
+            if kind == "clone":
+                _, cid, src_oid, dst_oid = op
+                return self._do_clone(cid, src_oid, dst_oid, batch)
+            _, cid, dst_oid, data, xattrs, omap = op
+            self._remove_if_exists(cid, dst_oid, batch)
+            wrote = self._do_write(cid, dst_oid, 0, data, batch,
+                                   deferred)
+            onode = self._get_onode(cid, dst_oid, batch, create=True)
+            onode.size = len(data)
+            onode.xattrs = dict(xattrs)
+            self._put_onode(onode, batch)
+            self._omap_replace(cid, dst_oid, omap, batch)
+            return wrote
+        if kind in ("move_rename", "move_data"):
+            src_cid, src_oid, dst_cid, dst_oid = op[1:5]
+            src_key = _okey(src_cid, src_oid)
+            if src_key not in self._onodes and kind == "move_rename":
+                # fail BEFORE touching dst: a missing source must not
+                # destroy the destination (MemStore order)
+                raise KeyError("no object %r in %r" % (src_oid, src_cid))
+            if (src_cid, src_oid) == (dst_cid, dst_oid):
+                if kind == "move_data" and src_key not in self._onodes:
+                    pass          # fall through to captured-content path
+                else:
+                    return False  # self-move: nothing to do
+            self._remove_if_exists(dst_cid, dst_oid, batch)
+            onode = self._onodes.pop(src_key, None)
+            if onode is None and kind == "move_data":
+                # replay after the move already happened: restore from
+                # the captured content
+                _, _, _, _, _, data, xattrs, omap = op
+                wrote = self._do_write(dst_cid, dst_oid, 0, data, batch,
+                                       deferred)
+                onode = self._get_onode(dst_cid, dst_oid, batch,
+                                        create=True)
+                onode.size = len(data)
+                onode.xattrs = dict(xattrs)
+                self._put_onode(onode, batch)
+                self._omap_replace(dst_cid, dst_oid, omap, batch)
+                return wrote
+            if onode is None:
+                raise KeyError("no object %r in %r" % (src_oid, src_cid))
+            batch.rmkey("O", src_key)
+            self._omap_move(src_cid, src_oid, dst_cid, dst_oid, batch)
+            onode.cid, onode.oid = dst_cid, dst_oid
+            self._onodes[_okey(dst_cid, dst_oid)] = onode
+            self._put_onode(onode, batch)
+            return False
+        if kind == "setattr":
+            _, cid, oid, name, value = op
+            onode = self._get_onode(cid, oid, batch, create=True)
+            onode.xattrs[name] = value
+            self._put_onode(onode, batch)
+            return False
+        if kind == "rmattr":
+            onode = self._get_onode(op[1], op[2], batch)
+            onode.xattrs.pop(op[3], None)
+            self._put_onode(onode, batch)
+            return False
+        if kind == "omap_setkeys":
+            _, cid, oid, kv = op
+            self._get_onode(cid, oid, batch, create=True)
+            key = _okey(cid, oid)
+            for k, v in kv.items():
+                batch.set("M", key + ":" + encoding.encode_any(k).hex(),
+                          encoding.encode_any(v))
+            return False
+        if kind == "omap_rmkeys":
+            _, cid, oid, keys = op
+            self._get_onode(cid, oid, batch)   # KeyError on missing
+            okey = _okey(cid, oid)
+            for k in keys:
+                batch.rmkey("M", okey + ":" +
+                            encoding.encode_any(k).hex())
+            return False
+        raise ValueError("unknown op %r" % kind)
+
+    # -- onode / blob plumbing ----------------------------------------
+
+    def _get_onode(self, cid, oid, batch, create=False) -> _Onode:
+        key = _okey(cid, oid)
+        onode = self._onodes.get(key)
+        if onode is None:
+            if not create:
+                raise KeyError("no object %r in %r" % (oid, cid))
+            ck = _ckey(cid)
+            if ck not in self._colls:
+                raise KeyError("no collection %r" % (cid,))
+            onode = self._onodes[key] = _Onode(cid, oid)
+            self._put_onode(onode, batch)
+        return onode
+
+    def _put_onode(self, onode, batch) -> None:
+        batch.set("O", _okey(onode.cid, onode.oid),
+                  encoding.encode_any(onode.to_doc()))
+
+    def _put_blob(self, blob, batch) -> None:
+        batch.set("B", str(blob.bid), encoding.encode_any(blob.to_doc()))
+
+    def _blob_decref(self, bid, batch) -> None:
+        blob = self._blobs[bid]
+        blob.refs -= 1
+        if blob.refs <= 0:
+            self.allocator.release(blob.poff, blob.alen)
+            del self._blobs[bid]
+            batch.rmkey("B", str(bid))
+            # cancel same-transaction deferred writes aimed at the
+            # freed range: the allocator may hand that space to a big
+            # write later in this txn, and the post-commit deferred
+            # apply must not clobber it
+            pend = getattr(self, "_pending_deferred", None)
+            if pend:
+                pend[:] = [d for d in pend
+                           if d[0] + len(d[1]) <= blob.poff
+                           or d[0] >= blob.poff + blob.alen]
+        else:
+            self._put_blob(blob, batch)
+
+    def _remove_onode(self, key, batch) -> None:
+        onode = self._onodes.pop(key)
+        for _, _, bid, _ in onode.extents:
+            self._blob_decref(bid, batch)
+        batch.rmkey("O", key)
+        for mkey, _ in self.db.lower_bound("M", key + ":"):
+            if not mkey.startswith(key + ":"):
+                break
+            batch.rmkey("M", mkey)
+
+    def _remove_if_exists(self, cid, oid, batch) -> None:
+        key = _okey(cid, oid)
+        if key in self._onodes:
+            self._remove_onode(key, batch)
+
+    def _punch(self, onode, off, length, batch) -> None:
+        """Drop extent coverage of [off, off+length); trims keep their
+        blob reference, full removals decref (possibly freeing)."""
+        if length <= 0:
+            return
+        end = off + length
+        out = []
+        for loff, elen, bid, boff in onode.extents:
+            eend = loff + elen
+            if eend <= off or loff >= end:
+                out.append([loff, elen, bid, boff])
+                continue
+            referenced = False
+            if loff < off:                      # keep the front
+                out.append([loff, off - loff, bid, boff])
+                referenced = True
+            if eend > end:                      # keep the back
+                out.append([end, eend - end, bid, boff + (end - loff)])
+                if referenced:
+                    # the blob now has one MORE extent referencing it
+                    blob = self._blobs[bid]
+                    blob.refs += 1
+                    self._put_blob(blob, batch)
+                referenced = True
+            if not referenced:
+                self._blob_decref(bid, batch)
+        out.sort(key=lambda e: e[0])
+        onode.extents = out
+
+    def _do_write(self, cid, oid, off, data, batch, deferred) -> bool:
+        data = bytes(data)
+        if not data:
+            self._get_onode(cid, oid, batch, create=True)
+            return False
+        onode = self._get_onode(cid, oid, batch, create=True)
+
+        # deferred lane: a small overwrite fully inside one exclusive,
+        # uncompressed blob updates in place through the kv journal
+        if len(data) <= self.deferred_max:
+            hit = self._find_inplace(onode, off, len(data))
+            if hit is not None:
+                loff, elen, bid, boff = hit
+                blob = self._blobs[bid]
+                woff = boff + (off - loff)          # stored-byte offset
+                self._update_csums(blob, woff, data, deferred)
+                self._put_blob(blob, batch)
+                seq = self._deferred_seq
+                self._deferred_seq += 1
+                batch.set("D", "%016d" % seq, encoding.encode_any(
+                    {"poff": blob.poff + woff, "data": data}))
+                deferred.append([blob.poff + woff, data])
+                onode.size = max(onode.size, off + len(data))
+                self._put_onode(onode, batch)
+                return False
+
+        # big lane: new blob, fresh extents, COW
+        alg, payload = compress_if_worthwhile(
+            self._compressor, data, self._required_ratio)
+        alen = -(-len(payload) // self.min_alloc) * self.min_alloc
+        poff = self.allocator.allocate(len(payload), self.min_alloc)
+        os.pwrite(self._fd, payload, poff)
+        csums = [_crc(payload[i:i + self.csum_chunk])
+                 for i in range(0, len(payload), self.csum_chunk)]
+        bid = self._next_blob
+        self._next_blob += 1
+        blob = _Blob(bid, poff, alen, len(payload), len(data), alg,
+                     csums)
+        self._blobs[bid] = blob
+        self._put_blob(blob, batch)
+        self._punch(onode, off, len(data), batch)
+        onode.extents.append([off, len(data), bid, 0])
+        onode.extents.sort(key=lambda e: e[0])
+        onode.size = max(onode.size, off + len(data))
+        self._put_onode(onode, batch)
+        return True
+
+    def _find_inplace(self, onode, off, length):
+        """The extent eligible for an in-place deferred overwrite:
+        covers the range, uncompressed, not shared (COW safety)."""
+        end = off + length
+        for loff, elen, bid, boff in onode.extents:
+            if loff <= off and end <= loff + elen:
+                blob = self._blobs[bid]
+                if blob.comp is None and blob.refs == 1:
+                    return (loff, elen, bid, boff)
+                return None
+        return None
+
+    def _update_csums(self, blob, woff, data, deferred=()) -> None:
+        """Recompute the csum chunks a sub-blob overwrite touches
+        (read-modify over the stored bytes, seen through any deferred
+        writes of this transaction that have not hit the device yet)."""
+        first = woff // self.csum_chunk
+        last = (woff + len(data) - 1) // self.csum_chunk
+        for chunk in range(first, last + 1):
+            coff = chunk * self.csum_chunk
+            clen = min(self.csum_chunk, blob.clen - coff)
+            cur = bytearray(os.pread(self._fd, clen, blob.poff + coff))
+            if len(cur) < clen:
+                cur += b"\0" * (clen - len(cur))
+            # overlay pending same-txn deferred bytes
+            base = blob.poff + coff
+            for dpoff, ddata in deferred:
+                s = max(dpoff, base)
+                e = min(dpoff + len(ddata), base + clen)
+                if s < e:
+                    cur[s - base:e - base] = \
+                        ddata[s - dpoff:e - dpoff]
+            s = max(woff, coff) - coff
+            e = min(woff + len(data), coff + clen) - coff
+            cur[s:e] = data[max(woff, coff) - woff:
+                            min(woff + len(data), coff + clen) - woff]
+            while chunk >= len(blob.csums):
+                blob.csums.append(0)
+            blob.csums[chunk] = _crc(bytes(cur))
+
+    # -- reads ---------------------------------------------------------
+
+    def _blob_read(self, blob, boff, length) -> bytes:
+        """Read [boff, boff+length) of the blob's RAW space, verifying
+        checksums of every stored chunk touched."""
+        if blob.comp:
+            stored = os.pread(self._fd, blob.clen, blob.poff)
+            self._verify(blob, stored, 0, blob.clen)
+            d = self._decompressors.get(blob.comp)
+            if d is None:
+                d = self._decompressors[blob.comp] = \
+                    compressor_create(blob.comp)
+            raw = d.decompress(stored)
+            return raw[boff:boff + length]
+        first = (boff // self.csum_chunk) * self.csum_chunk
+        last = min(blob.clen,
+                   -(-(boff + length) // self.csum_chunk)
+                   * self.csum_chunk)
+        stored = os.pread(self._fd, last - first, blob.poff + first)
+        self._verify(blob, stored, first, last)
+        return stored[boff - first:boff - first + length]
+
+    def _verify(self, blob, stored, first, last) -> None:
+        for chunk in range(first // self.csum_chunk,
+                           -(-last // self.csum_chunk)):
+            coff = chunk * self.csum_chunk - first
+            clen = min(self.csum_chunk, blob.clen -
+                       chunk * self.csum_chunk)
+            want = blob.csums[chunk] if chunk < len(blob.csums) else 0
+            got = _crc(stored[coff:coff + clen])
+            if got != want:
+                raise OSError(
+                    5, "csum mismatch blob %d chunk %d (0x%08x != "
+                       "0x%08x)" % (blob.bid, chunk, got, want))
+
+    def read(self, cid, oid, offset: int = 0, length: int = 0) -> bytes:
+        with self._lock:
+            if (cid, oid) in self._read_errors:
+                raise OSError(5, "injected EIO on %r/%r" % (cid, oid))
+            onode = self._onodes.get(_okey(cid, oid))
+            if onode is None:
+                raise KeyError("no object %r in %r" % (oid, cid))
+            if length == 0:
+                length = max(0, onode.size - offset)
+            length = max(0, min(length, onode.size - offset))
+            out = bytearray(length)
+            end = offset + length
+            for loff, elen, bid, boff in onode.extents:
+                eend = loff + elen
+                if eend <= offset or loff >= end:
+                    continue
+                s = max(loff, offset)
+                e = min(eend, end)
+                piece = self._blob_read(self._blobs[bid],
+                                        boff + (s - loff), e - s)
+                out[s - offset:e - offset] = piece
+            return bytes(out)
+
+    def stat(self, cid, oid) -> dict | None:
+        with self._lock:
+            onode = self._onodes.get(_okey(cid, oid))
+            return {"size": onode.size} if onode is not None else None
+
+    def exists(self, cid, oid) -> bool:
+        return self.stat(cid, oid) is not None
+
+    def getattr(self, cid, oid, name: str):
+        with self._lock:
+            onode = self._onodes.get(_okey(cid, oid))
+            if onode is None:
+                raise KeyError("no object %r in %r" % (oid, cid))
+            return onode.xattrs.get(name)
+
+    def omap_get(self, cid, oid) -> dict:
+        with self._lock:
+            key = _okey(cid, oid)
+            if key not in self._onodes:
+                raise KeyError("no object %r in %r" % (oid, cid))
+            out = {}
+            for mkey, raw in self.db.lower_bound("M", key + ":"):
+                if not mkey.startswith(key + ":"):
+                    break
+                user = bytes.fromhex(mkey[len(key) + 1:])
+                out[encoding.decode_any(user)] = encoding.decode_any(raw)
+            return out
+
+    def list_objects(self, cid) -> list:
+        with self._lock:
+            return sorted(o.oid for o in self._onodes.values()
+                          if o.cid == cid)
+
+    def list_collections(self) -> list:
+        with self._lock:
+            return sorted(self._colls.values())
+
+    # -- clone / omap helpers ------------------------------------------
+
+    def _do_clone(self, cid, src_oid, dst_oid, batch) -> bool:
+        src = self._get_onode(cid, src_oid, batch)
+        if src_oid == dst_oid:
+            return False          # self-clone: nothing to do
+        self._remove_if_exists(cid, dst_oid, batch)
+        dst = self._get_onode(cid, dst_oid, batch, create=True)
+        dst.size = src.size
+        dst.xattrs = dict(src.xattrs)
+        dst.extents = [list(e) for e in src.extents]
+        for _, _, bid, _ in dst.extents:
+            blob = self._blobs[bid]
+            blob.refs += 1
+            self._put_blob(blob, batch)
+        self._put_onode(dst, batch)
+        self._omap_replace(cid, dst_oid, self.omap_get(cid, src_oid),
+                           batch)
+        return False
+
+    def _omap_replace(self, cid, oid, omap, batch) -> None:
+        key = _okey(cid, oid)
+        for mkey, _ in self.db.lower_bound("M", key + ":"):
+            if not mkey.startswith(key + ":"):
+                break
+            batch.rmkey("M", mkey)
+        for k, v in omap.items():
+            batch.set("M", key + ":" + encoding.encode_any(k).hex(),
+                      encoding.encode_any(v))
+
+    def _omap_move(self, src_cid, src_oid, dst_cid, dst_oid,
+                   batch) -> None:
+        skey = _okey(src_cid, src_oid)
+        dkey = _okey(dst_cid, dst_oid)
+        for mkey, raw in self.db.lower_bound("M", skey + ":"):
+            if not mkey.startswith(skey + ":"):
+                break
+            batch.rmkey("M", mkey)
+            batch.set("M", dkey + mkey[len(skey):], raw)
+
+    # -- introspection (tests / objectstore tool) ----------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "device_size": self.allocator.device_size,
+                "free_bytes": self.allocator.free_bytes(),
+                "blobs": len(self._blobs),
+                "onodes": len(self._onodes),
+            }

@@ -100,15 +100,25 @@ def main(argv=None) -> int:
     for osd_id in range(args.osds):
         store = None
         if args.data:
-            from ..store.file_store import FileStore
             path = os.path.join(args.data, "osd.%d" % osd_id)
             os.makedirs(path, exist_ok=True)
-            store = FileStore(
-                path,
-                compression=str(overrides.get(
-                    "filestore_compression", "none")),
-                compression_required_ratio=float(overrides.get(
-                    "filestore_compression_required_ratio", 0.875)))
+            # osd_objectstore picks the durable backend, like the
+            # reference's bluestore/filestore choice
+            kind = str(overrides.get("osd_objectstore", "filestore"))
+            if kind == "bluestore":
+                from ..store.block_store import BlockStore
+                store = BlockStore(
+                    path,
+                    compression=str(overrides.get(
+                        "bluestore_compression", "none")))
+            else:
+                from ..store.file_store import FileStore
+                store = FileStore(
+                    path,
+                    compression=str(overrides.get(
+                        "filestore_compression", "none")),
+                    compression_required_ratio=float(overrides.get(
+                        "filestore_compression_required_ratio", 0.875)))
         ctx = Context(overrides, name="osd.%d" % osd_id)
         if args.asok_dir:
             # per-daemon unix command socket ('ceph daemon' surface):

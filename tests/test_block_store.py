@@ -1,0 +1,480 @@
+"""BlockStore: allocator, checksums, deferred writes, COW clones.
+
+The VERDICT round-1 'done' gates for the BlueStore analog: drop-in
+ObjectStore semantics (differential vs MemStore), partial-block RMW,
+allocator reuse after delete, checksum-detected corruption surfacing
+as EIO, plus crash-replay of the deferred lane."""
+
+from __future__ import annotations
+
+import os
+import random
+
+import pytest
+
+from ceph_tpu.store.block_store import BlockStore, FreeList
+from ceph_tpu.store.mem_store import MemStore
+from ceph_tpu.store.object_store import Transaction
+
+
+def make_store(path, **kw):
+    kw.setdefault("block_sync", False)
+    kw.setdefault("kv_sync", False)
+    st = BlockStore(str(path), **kw)
+    st.mount()
+    return st
+
+
+def txn(*ops_fns):
+    t = Transaction()
+    for fn in ops_fns:
+        fn(t)
+    return t
+
+
+class TestBasics:
+    def test_write_read_roundtrip(self, tmp_path):
+        st = make_store(tmp_path)
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 0, b"hello world")
+        st.queue_transaction(t)
+        assert st.read("c", "o") == b"hello world"
+        assert st.stat("c", "o") == {"size": 11}
+        st.umount()
+
+    def test_sparse_reads_zero_filled(self, tmp_path):
+        st = make_store(tmp_path)
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 8192, b"tail")
+        st.queue_transaction(t)
+        data = st.read("c", "o")
+        assert data == b"\0" * 8192 + b"tail"
+        st.umount()
+
+    def test_xattr_omap(self, tmp_path):
+        st = make_store(tmp_path)
+        t = Transaction()
+        t.create_collection("c")
+        t.touch("c", "o")
+        t.setattr("c", "o", "k", b"v")
+        t.omap_setkeys("c", "o", {"a": b"1", "b": b"2"})
+        st.queue_transaction(t)
+        assert st.getattr("c", "o", "k") == b"v"
+        assert st.omap_get("c", "o") == {"a": b"1", "b": b"2"}
+        t = Transaction()
+        t.omap_rmkeys("c", "o", ["a"])
+        t.rmattr("c", "o", "k")
+        st.queue_transaction(t)
+        assert st.omap_get("c", "o") == {"b": b"2"}
+        assert st.getattr("c", "o", "k") is None
+        st.umount()
+
+    def test_persistence_across_remount(self, tmp_path):
+        st = make_store(tmp_path)
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 0, b"x" * 100000)
+        t.setattr("c", "o", "n", b"val")
+        t.omap_setkeys("c", "o", {"k": b"v"})
+        st.queue_transaction(t)
+        st.umount()
+
+        st2 = make_store(tmp_path)
+        assert st2.read("c", "o") == b"x" * 100000
+        assert st2.getattr("c", "o", "n") == b"val"
+        assert st2.omap_get("c", "o") == {"k": b"v"}
+        assert st2.list_collections() == ["c"]
+        assert st2.list_objects("c") == ["o"]
+        st2.umount()
+
+
+class TestPartialBlockRMW:
+    def test_small_overwrite_inside_big_object(self, tmp_path):
+        st = make_store(tmp_path)
+        base = bytes(random.Random(1).randbytes(1 << 20))
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 0, base)
+        st.queue_transaction(t)
+        # sub-alloc overwrite straddling a csum chunk boundary takes
+        # the deferred lane
+        patch = b"P" * 1000
+        t = Transaction()
+        t.write("c", "o", 4096 - 500, patch)
+        st.queue_transaction(t)
+        want = bytearray(base)
+        want[4096 - 500:4096 - 500 + 1000] = patch
+        assert st.read("c", "o") == bytes(want)
+        # checksums updated: full read passes verification
+        assert st.read("c", "o", 0, 8192) == bytes(want[:8192])
+        st.umount()
+
+    def test_deferred_write_replays_after_crash(self, tmp_path):
+        st = make_store(tmp_path, block_sync=True)
+        base = b"A" * 65536
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 0, base)
+        st.queue_transaction(t)
+        t = Transaction()
+        t.write("c", "o", 100, b"deferred-bytes")
+        st.queue_transaction(t)
+        # simulate a crash: no sync/umount — the deferred record sits
+        # in the kv log; wipe the bytes from the device to prove the
+        # replay (not the earlier pwrite) restores them
+        os.pwrite(st._fd, b"A" * 14, st._blobs[1].poff + 100)
+        st.db.close()
+        os.close(st._fd)
+
+        st2 = make_store(tmp_path)
+        want = bytearray(base)
+        want[100:114] = b"deferred-bytes"
+        assert st2.read("c", "o") == bytes(want)
+        st2.umount()
+
+    def test_many_small_writes_same_chunk_one_txn(self, tmp_path):
+        st = make_store(tmp_path)
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 0, b"\0" * 16384)
+        st.queue_transaction(t)
+        t = Transaction()
+        t.write("c", "o", 10, b"aaaa")
+        t.write("c", "o", 12, b"bbbb")   # overlaps the first
+        st.queue_transaction(t)
+        assert st.read("c", "o", 10, 6) == b"aabbbb"
+        st.umount()
+
+
+class TestAllocator:
+    def test_unit_allocate_release_coalesce(self):
+        fl = FreeList(65536)
+        a = fl.allocate(4096)
+        b = fl.allocate(8192)
+        assert a != b
+        fl.release(a, 4096)
+        fl.release(b, 8192)
+        assert fl.free_bytes() == 65536
+        assert len(fl._free) == 1          # coalesced back to one run
+
+    def test_space_reused_after_delete(self, tmp_path):
+        st = make_store(tmp_path)
+        t = Transaction()
+        t.create_collection("c")
+        st.queue_transaction(t)
+        for cycle in range(5):
+            t = Transaction()
+            t.write("c", "o%d" % cycle, 0, b"z" * (1 << 20))
+            st.queue_transaction(t)
+            size_now = st.stats()["device_size"]
+            t = Transaction()
+            t.remove("c", "o%d" % cycle)
+            st.queue_transaction(t)
+            if cycle == 0:
+                first_size = size_now
+        # rewrite cycles reuse freed extents: the device never grows
+        assert st.stats()["device_size"] == first_size
+        assert st.stats()["blobs"] == 0
+        st.umount()
+
+    def test_allocator_rebuilt_at_mount(self, tmp_path):
+        st = make_store(tmp_path)
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "keep", 0, b"k" * 300000)
+        t.write("c", "drop", 0, b"d" * 300000)
+        st.queue_transaction(t)
+        t = Transaction()
+        t.remove("c", "drop")
+        st.queue_transaction(t)
+        st.umount()
+        st2 = make_store(tmp_path)
+        # the dropped blob's space is visible as free after the rebuild
+        assert st2.stats()["free_bytes"] >= 300000
+        size_before = st2.stats()["device_size"]
+        # and the free space is really usable: a same-size write fits
+        # without growing the device
+        t = Transaction()
+        t.write("c", "new", 0, b"n" * 300000)
+        st2.queue_transaction(t)
+        assert st2.stats()["device_size"] == size_before
+        assert st2.read("c", "new") == b"n" * 300000
+        assert st2.read("c", "keep") == b"k" * 300000
+        st2.umount()
+
+
+class TestChecksums:
+    def test_corruption_detected_as_eio(self, tmp_path):
+        st = make_store(tmp_path)
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 0, b"Q" * 50000)
+        st.queue_transaction(t)
+        blob = next(iter(st._blobs.values()))
+        # flip bytes on the device behind the store's back (bit rot)
+        os.pwrite(st._fd, b"XX", blob.poff + 10000)
+        with pytest.raises(OSError) as ei:
+            st.read("c", "o")
+        assert ei.value.errno == 5
+        # reads not touching the rotten chunk still verify clean
+        assert st.read("c", "o", 0, 4096) == b"Q" * 4096
+        st.umount()
+
+    def test_injected_read_error(self, tmp_path):
+        st = make_store(tmp_path)
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 0, b"ok")
+        st.queue_transaction(t)
+        st.inject_read_error("c", "o")
+        with pytest.raises(OSError):
+            st.read("c", "o")
+        st.clear_read_error("c", "o")
+        assert st.read("c", "o") == b"ok"
+        st.umount()
+
+
+class TestCloneCOW:
+    def test_clone_shares_then_diverges(self, tmp_path):
+        st = make_store(tmp_path)
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "src", 0, b"S" * 200000)
+        t.setattr("c", "src", "a", b"1")
+        t.omap_setkeys("c", "src", {"m": b"2"})
+        st.queue_transaction(t)
+        blobs_before = st.stats()["blobs"]
+        t = Transaction()
+        t.clone("c", "src", "dst")
+        st.queue_transaction(t)
+        # COW: no new data blobs for the clone
+        assert st.stats()["blobs"] == blobs_before
+        assert st.read("c", "dst") == b"S" * 200000
+        assert st.getattr("c", "dst", "a") == b"1"
+        assert st.omap_get("c", "dst") == {"m": b"2"}
+        # overwriting the clone leaves the source untouched
+        t = Transaction()
+        t.write("c", "dst", 0, b"D" * 100000)
+        st.queue_transaction(t)
+        assert st.read("c", "dst", 0, 100000) == b"D" * 100000
+        assert st.read("c", "dst", 100000) == b"S" * 100000
+        assert st.read("c", "src") == b"S" * 200000
+        # removing the source keeps the shared bytes alive
+        t = Transaction()
+        t.remove("c", "src")
+        st.queue_transaction(t)
+        assert st.read("c", "dst", 100000) == b"S" * 100000
+        st.umount()
+
+    def test_deferred_lane_refuses_shared_blob(self, tmp_path):
+        """A small overwrite of a SHARED blob must not write in place
+        (it would change the other referent's bytes)."""
+        st = make_store(tmp_path)
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "src", 0, b"Z" * 100000)
+        t.clone("c", "src", "dst")
+        st.queue_transaction(t)
+        t = Transaction()
+        t.write("c", "dst", 10, b"tiny")
+        st.queue_transaction(t)
+        assert st.read("c", "dst", 10, 4) == b"tiny"
+        assert st.read("c", "src", 10, 4) == b"ZZZZ"
+        st.umount()
+
+
+class TestCompression:
+    def test_compressible_data_stored_smaller(self, tmp_path):
+        st = make_store(tmp_path, compression="zlib")
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 0, b"compressme" * 100000)
+        st.queue_transaction(t)
+        used = st.stats()["device_size"] - st.stats()["free_bytes"]
+        assert used < 1000000 * 0.5
+        assert st.read("c", "o") == b"compressme" * 100000
+        st.umount()
+        st2 = make_store(tmp_path)   # no compression configured
+        assert st2.read("c", "o") == b"compressme" * 100000
+        st2.umount()
+
+
+class TestDropIn:
+    """Differential proof: identical op streams applied to MemStore and
+    BlockStore must yield identical observable state."""
+
+    OIDS = ["a", "b", "c"]
+
+    def _random_ops(self, rng, n):
+        ops = []
+        for _ in range(n):
+            kind = rng.choice(
+                ["write", "write_small", "zero", "truncate", "remove",
+                 "clone", "setattr", "omap", "move"])
+            oid = rng.choice(self.OIDS)
+            if kind == "write":
+                off = rng.randrange(0, 1 << 17)
+                ln = rng.randrange(1, 1 << 16)
+                ops.append(("write", "c", oid, off,
+                            bytes(rng.randbytes(ln))))
+            elif kind == "write_small":
+                off = rng.randrange(0, 1 << 16)
+                ops.append(("write", "c", oid, off,
+                            bytes(rng.randbytes(rng.randrange(1, 64)))))
+            elif kind == "zero":
+                ops.append(("zero", "c", oid, rng.randrange(0, 1 << 16),
+                            rng.randrange(1, 1 << 15)))
+            elif kind == "truncate":
+                ops.append(("truncate", "c", oid,
+                            rng.randrange(0, 1 << 17)))
+            elif kind == "remove":
+                ops.append(("remove", "c", oid))
+            elif kind == "clone":
+                ops.append(("clone", "c", oid,
+                            rng.choice(self.OIDS)))
+            elif kind == "setattr":
+                ops.append(("setattr", "c", oid, "x%d" % rng.randrange(3),
+                            bytes(rng.randbytes(8))))
+            elif kind == "omap":
+                ops.append(("omap_setkeys", "c", oid,
+                            {"k%d" % rng.randrange(4):
+                             bytes(rng.randbytes(8))}))
+            else:
+                ops.append(("move_rename", "c", oid, "c",
+                            rng.choice(self.OIDS)))
+        return ops
+
+    def test_differential_vs_memstore(self, tmp_path):
+        rng = random.Random(7)
+        mem = MemStore()
+        mem.mount()
+        blk = make_store(tmp_path)
+        t = Transaction()
+        t.create_collection("c")
+        mem.queue_transaction(t)
+        blk.queue_transaction(txn(lambda t: t.create_collection("c")))
+
+        for round_no in range(30):
+            ops = self._random_ops(rng, rng.randrange(1, 4))
+            for store in (mem, blk):
+                t = Transaction()
+                for op in ops:
+                    try:
+                        t.ops = [op]
+                        store.queue_transaction(t)
+                    except KeyError:
+                        pass   # op on missing object: both must agree
+                    t = Transaction()
+            assert mem.list_objects("c") == blk.list_objects("c"), \
+                "round %d" % round_no
+            for oid in mem.list_objects("c"):
+                assert mem.read("c", oid) == blk.read("c", oid), \
+                    (round_no, oid)
+                assert mem.omap_get("c", oid) == blk.omap_get("c", oid)
+                mo = mem._colls["c"].objects[oid]
+                for name, val in mo.xattrs.items():
+                    assert blk.getattr("c", oid, name) == val
+        blk.umount()
+
+    def test_missing_object_ops_raise_like_memstore(self, tmp_path):
+        mem = MemStore()
+        mem.mount()
+        blk = make_store(tmp_path)
+        for store in (mem, blk):
+            t = Transaction()
+            t.create_collection("c")
+            store.queue_transaction(t)
+        for op in [("clone", "c", "ghost", "x"),
+                   ("rmattr", "c", "ghost", "a"),
+                   ("omap_rmkeys", "c", "ghost", ["k"]),
+                   ("move_rename", "c", "ghost", "c", "y")]:
+            for store in (mem, blk):
+                t = Transaction()
+                t.ops = [op]
+                with pytest.raises(KeyError):
+                    store.queue_transaction(t)
+        blk.umount()
+
+
+class TestCrashConsistency:
+    def test_big_write_crash_before_kv_commit_leaves_old_data(
+            self, tmp_path):
+        """Simulate the crash window: data written to the device but kv
+        batch never committed — the object must still read as its OLD
+        committed content after remount (space was merely scribbled)."""
+        st = make_store(tmp_path, block_sync=True)
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 0, b"OLD" * 40000)
+        st.queue_transaction(t)
+        st.umount()
+
+        st = make_store(tmp_path, block_sync=True)
+        # hand-simulate the torn write: scribble on FREE space only
+        # (what a crashed big write leaves behind), then drop the store
+        poff = st.allocator.allocate(120000)
+        os.pwrite(st._fd, b"NEW" * 40000, poff)
+        os.fsync(st._fd)
+        st.db.close()
+        os.close(st._fd)
+
+        st2 = make_store(tmp_path)
+        assert st2.read("c", "o") == b"OLD" * 40000
+        st2.umount()
+
+
+class TestBlockStoreInCluster:
+    def test_osd_data_survives_daemon_restart(self, tmp_path):
+        """Drop-in proof at the daemon level: OSDs backed by BlockStore
+        serve the replicated write path, survive a hard kill + revive
+        on the same directory, and the revived store really holds the
+        bytes (the BlueStore-analog durability contract)."""
+        from ceph_tpu.common.context import Context
+        from ceph_tpu.mon.monitor import Monitor
+        from .cluster_util import MiniCluster, wait_until
+        FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+                "mon_osd_down_out_interval": 1.0,
+                "paxos_propose_interval": 0.02}
+        cluster = MiniCluster(num_mons=1, num_osds=0, conf_overrides=FAST)
+        for rank in cluster.monmap:
+            mon = Monitor(rank, cluster.monmap,
+                          Context(FAST, name="mon.%d" % rank))
+            mon.init()
+            cluster.mons.append(mon)
+        assert wait_until(lambda: any(m.is_leader() for m in cluster.mons))
+        stores = {}
+        try:
+            for osd_id in range(3):
+                path = tmp_path / ("osd.%d" % osd_id)
+                path.mkdir()
+                stores[osd_id] = BlockStore(str(path), block_sync=False,
+                                            kv_sync=False)
+                stores[osd_id].mount()
+                cluster.start_osd(osd_id, store=stores[osd_id])
+            cluster.num_osds = 3
+            assert wait_until(cluster.all_osds_up, timeout=15)
+            client = cluster.client()
+            cluster.create_replicated_pool(client, "bdur", size=3,
+                                           pg_num=4)
+            ioctx = client.open_ioctx("bdur")
+            payload = b"block store payload " * 50
+            ioctx.write_full("bobj", payload)
+            assert ioctx.read("bobj") == payload
+            cluster.stop_osd(0)
+            if stores[0].mounted:
+                stores[0].umount()
+            reopened = BlockStore(str(tmp_path / "osd.0"),
+                                  block_sync=False, kv_sync=False)
+            reopened.mount()
+            cluster.revive_osd(0, store=reopened)
+            assert wait_until(cluster.all_osds_up, timeout=15)
+            assert ioctx.read("bobj") == payload
+            total = sum(
+                len(reopened.read(cid, oid))
+                for cid in reopened.list_collections()
+                for oid in reopened.list_objects(cid))
+            assert total >= len(payload)
+        finally:
+            cluster.stop()

@@ -308,3 +308,52 @@ class TestCommitGap:
         net.mons[0].paxos.propose(b"b")
         net.pump()
         assert net.mons[2].committed == [(1, b"a"), (2, b"b")]
+
+
+class TestLeaderLeaseAuthority:
+    def test_partitioned_ex_leader_goes_stale(self):
+        """A leader whose quorum stops acking its leases must lose
+        readability and step down for re-election — never serve stale
+        reads on self-granted leases."""
+        net = Net(3)
+        net.make_leader(0, [0, 1, 2])
+        net.pump()                          # collect + lease + acks
+        lead = net.mons[0].paxos
+        assert lead.is_readable()
+
+        # partition: peons unreachable, their lease acks never arrive
+        net.down.update({1, 2})
+        lead.LEASE_DURATION = 0.0           # current grant expires now
+        lead._lease_ack_deadline = 1e-9     # ack window already blown
+        lead.lease_until = 0.0
+        assert not lead.is_readable()
+        lead.tick()
+        assert net.mons[0].elector.restarts == 1
+        assert lead.state == STATE_RECOVERING
+
+    def test_behind_peon_refuses_lease(self):
+        """A peon that is missing commits acks the lease round but does
+        not become readable, and asks for the missing range."""
+        net = Net(3)
+        net.make_leader(0, [0, 1, 2])
+        net.pump()
+        # mon.2 misses the commit of v1 AND loses its catchup reply;
+        # the next lease advertises last_committed=1
+        net.mons[0].paxos.propose(b"a")
+        net.pump(drop=lambda s, d, m:
+                 (m.op == "commit" or m.op == "catchup") and 2 in (s, d))
+        assert net.mons[2].committed == []
+        stale = net.mons[2].paxos
+        stale.lease_until = 0.0
+        # a fresh lease arrives while still behind: no readability
+        net.mons[0].paxos._extend_lease_locked()
+        net.pump(drop=lambda s, d, m: m.op == "catchup")
+        assert not stale.is_readable()
+        # once the catchup flows, the peon converges; the NEXT lease
+        # round (the leader ticks them continuously) restores reads
+        net.mons[0].paxos._extend_lease_locked()
+        net.pump()
+        assert net.mons[2].committed == [(1, b"a")]
+        net.mons[0].paxos._extend_lease_locked()
+        net.pump()
+        assert stale.is_readable()
