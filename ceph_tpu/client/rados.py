@@ -17,7 +17,7 @@ import time
 from ..common import Context
 from ..common.throttle import Throttle
 from ..mon.mon_client import MonClient
-from ..msg.message import MOSDOp
+from ..msg.message import MOSDOp, MWatchNotifyAck
 from ..msg.messenger import Dispatcher, Messenger
 
 __all__ = ["RadosClient", "IoCtx", "RadosError"]
@@ -52,6 +52,7 @@ class RadosClient(Dispatcher):
         self._inflight: dict[int, _InflightOp] = {}
         self._throttle = Throttle(
             "objecter", self.ctx.conf.get_val("objecter_inflight_ops"))
+        self._watches: dict = {}      # cookie -> (oid, callback)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -93,6 +94,21 @@ class RadosClient(Dispatcher):
                 op.event.set()
                 self._throttle.put()
             return True
+        if msg.get_type() == "MWatchNotify":
+            with self._lock:
+                watch = self._watches.get(msg.cookie)
+            reply = b""
+            if watch is not None:
+                _, callback = watch
+                try:
+                    reply = callback(msg.notify_id, msg.payload) or b""
+                except Exception:
+                    reply = b""
+            self.msgr.send_message(MWatchNotifyAck(
+                pgid=msg.pgid, oid=msg.oid, cookie=msg.cookie,
+                notify_id=msg.notify_id, reply=bytes(reply)),
+                msg.from_addr)
+            return True
         return False
 
     # -- op submission (Objecter::op_submit collapsed) ------------------
@@ -106,11 +122,13 @@ class RadosClient(Dispatcher):
         return pgid, actp
 
     def submit_op(self, pool_id: int, oid: str, ops: list,
-                  timeout: float = 30.0, pgid=None):
+                  timeout: float = 30.0, pgid=None,
+                  snapc=None, snap: int = 0):
         """Send; resend on EAGAIN/timeout slices until deadline.
 
         pgid pins the target PG explicitly (PG-scoped ops like list);
-        otherwise the object name hashes to its PG."""
+        otherwise the object name hashes to its PG. snapc rides on
+        writes (SnapContext), snap selects the read snapshot."""
         deadline = time.monotonic() + timeout
         backoff = 0.05
         fixed_pgid = pgid
@@ -142,7 +160,8 @@ class RadosClient(Dispatcher):
             self.msgr.send_message(
                 MOSDOp(client_id=self.client_id, tid=tid, pgid=pgid,
                        oid=oid, ops=ops,
-                       map_epoch=self.osdmap.epoch), addr)
+                       map_epoch=self.osdmap.epoch,
+                       snapc=snapc or (0, ()), snap=snap), addr)
             # wait a slice, then re-target (map may have changed)
             if op.event.wait(min(remaining, 1.0)):
                 if op.result == -11:  # EAGAIN: wrong/unready primary
@@ -166,14 +185,148 @@ class IoCtx:
     def __init__(self, client: RadosClient, pool_id: int):
         self.client = client
         self.pool_id = pool_id
+        self._snapc = None            # self-managed SnapContext override
+        self._read_snap = 0           # snap id reads resolve against
 
-    def _op(self, oid: str, ops: list, timeout: float = 30.0):
-        result, data = self.client.submit_op(self.pool_id, oid, ops,
-                                             timeout)
+    def _pool(self):
+        return self.client.osdmap.pools.get(self.pool_id) \
+            if self.client.osdmap else None
+
+    def _write_snapc(self) -> tuple:
+        if self._snapc is not None:
+            return self._snapc
+        pool = self._pool()
+        return pool.snap_context() if pool is not None else (0, ())
+
+    def _op(self, oid: str, ops: list, timeout: float = 30.0,
+            snap_override: int | None = None):
+        result, data = self.client.submit_op(
+            self.pool_id, oid, ops, timeout,
+            snapc=self._write_snapc(),
+            snap=self._read_snap if snap_override is None
+            else snap_override)
         if result < 0:
             raise RadosError(-result, "op on %r failed: %d"
                              % (oid, result))
         return data
+
+    # -- watch / notify (librados watch surface) -----------------------
+
+    def watch(self, oid: str, callback) -> int:
+        """Register interest in notifications on oid
+        (rados_watch3). callback(notify_id, payload) -> optional reply
+        bytes; runs on the messenger reader thread. Returns the watch
+        cookie. After a primary change, re-watch (the reference's
+        linger resend is the client's burden here too)."""
+        cookie = next(self.client._tids)
+        with self.client._lock:
+            self.client._watches[cookie] = (oid, callback)
+        try:
+            self._op(oid, [("watch", cookie)])
+        except Exception:
+            with self.client._lock:
+                self.client._watches.pop(cookie, None)
+            raise
+        return cookie
+
+    def unwatch(self, oid: str, cookie: int) -> None:
+        with self.client._lock:
+            self.client._watches.pop(cookie, None)
+        self._op(oid, [("unwatch", cookie)])
+
+    def notify(self, oid: str, payload: bytes = b"",
+               timeout: float = 3.0) -> dict:
+        """Notify every watcher; blocks until all ack or the timeout
+        (rados_notify2). Returns {"replies": {cookie: bytes},
+        "timed_out": [cookie, ...]}."""
+        return self._op(oid, [("notify", bytes(payload), timeout)],
+                        timeout=timeout + 10.0)
+
+    # -- snapshots (librados snap surface) -----------------------------
+
+    def set_snap_context(self, seq: int, snaps) -> None:
+        """Self-managed SnapContext for subsequent writes
+        (rados_ioctx_selfmanaged_snap_set_write_ctx)."""
+        self._snapc = (seq, tuple(sorted(snaps, reverse=True)))
+
+    def snap_set_read(self, snap_id: int) -> None:
+        """Reads resolve against this snap (rados_ioctx_snap_set_read;
+        0 = head)."""
+        self._read_snap = snap_id
+
+    def selfmanaged_snap_create(self) -> int:
+        """Allocate a self-managed snap id from the monitor."""
+        pool = self._pool()
+        res, outs, snap_id = self.client.mon_command({
+            "prefix": "osd pool selfmanaged-snap-create",
+            "pool": pool.name if pool else ""})
+        if res != 0:
+            raise RadosError(-res, outs)
+        self._wait_pool(lambda p: p.snap_seq >= snap_id)
+        return snap_id
+
+    def create_snap(self, name: str) -> int:
+        """Pool snapshot (rados_ioctx_snap_create / rados mksnap)."""
+        pool = self._pool()
+        res, outs, snap_id = self.client.mon_command({
+            "prefix": "osd pool mksnap",
+            "pool": pool.name if pool else "", "snap": name})
+        if res != 0:
+            raise RadosError(-res, outs)
+        self._wait_pool(lambda p: name in (p.snaps or {}))
+        return snap_id
+
+    def remove_snap(self, name: str) -> None:
+        pool = self._pool()
+        res, outs, _ = self.client.mon_command({
+            "prefix": "osd pool rmsnap",
+            "pool": pool.name if pool else "", "snap": name})
+        if res != 0:
+            raise RadosError(-res, outs)
+        self._wait_pool(lambda p: name not in (p.snaps or {}))
+
+    def _wait_pool(self, pred, timeout: float = 10.0) -> None:
+        """Block until the client's map shows the snap change (the
+        mon's commit propagates via the subscription)."""
+        import time as _t
+        deadline = _t.monotonic() + timeout
+        while _t.monotonic() < deadline:
+            pool = self._pool()
+            if pool is not None and pred(pool):
+                return
+            self.client.mon_client.renew_subs()
+            _t.sleep(0.02)
+        raise RadosError(110, "pool snap change never propagated")
+
+    def lookup_snap(self, name: str) -> int:
+        pool = self._pool()
+        snap_id = (pool.snaps or {}).get(name) if pool else None
+        if snap_id is None:
+            raise RadosError(2, "snap %r does not exist" % name)
+        return snap_id
+
+    def rollback(self, oid: str, snap_name: str) -> None:
+        """rados_ioctx_snap_rollback: head becomes the snap's state."""
+        self._op(oid, [("rollback", self.lookup_snap(snap_name))])
+
+    def rollback_id(self, oid: str, snap_id: int) -> None:
+        """Rollback against a self-managed snap id
+        (rados_ioctx_selfmanaged_snap_rollback)."""
+        self._op(oid, [("rollback", snap_id)])
+
+    def selfmanaged_snap_remove(self, snap_id: int) -> None:
+        """Retire a self-managed snap id; OSDs trim its clones."""
+        pool = self._pool()
+        res, outs, _ = self.client.mon_command({
+            "prefix": "osd pool selfmanaged-snap-remove",
+            "pool": pool.name if pool else "", "snap_id": snap_id})
+        if res != 0:
+            raise RadosError(-res, outs)
+        self._wait_pool(lambda p: snap_id in p.removed_snaps)
+
+    def list_snaps(self, oid: str) -> dict:
+        """Per-object clone listing (rados listsnaps)."""
+        return self._op(oid, [("list_snaps",)])
 
     # -- writes --------------------------------------------------------
 
@@ -209,8 +362,10 @@ class IoCtx:
 
     # -- reads ---------------------------------------------------------
 
-    def read(self, oid: str, length: int = 0, offset: int = 0) -> bytes:
-        data = self._op(oid, [("read", offset, length)])
+    def read(self, oid: str, length: int = 0, offset: int = 0,
+             snap: int | None = None) -> bytes:
+        data = self._op(oid, [("read", offset, length)],
+                        snap_override=snap)
         return bytes(data) if data is not None else b""
 
     def stat(self, oid: str) -> dict:

@@ -1,14 +1,23 @@
 """Block-image layer over RADOS (librbd analog).
 
 Rendition of the reference's librbd surface
-(/root/reference/src/librbd/, image format per doc/dev/rbd-layering.rst
-basics): an image is a header object (`rbd_header.<name>`) holding
-size/order, a pool-wide directory object (`rbd_directory`) listing
-images in its omap, and data blocks (`rbd_data.<name>.%016x`) of
-2^order bytes each, addressed by offset — the striping degenerate case
-stripe_count=1, object_size=stripe_unit=2^order, like rbd's default
-layout. Sparse blocks read as zeros; discard removes whole blocks and
+(/root/reference/src/librbd/, image format per doc/dev/rbd-layering.rst):
+an image is a header object (`rbd_header.<name>`) holding size/order
+plus an encoded metadata trailer (snapshots, parent pointer), a
+pool-wide directory object (`rbd_directory`) listing images in its
+omap, and data blocks (`rbd_data.<name>.%016x`) of 2^order bytes each —
+the striping degenerate case stripe_count=1, like rbd's default layout.
+Sparse blocks read as zeros; discard removes whole blocks and
 zero-fills partials.
+
+Snapshots ride RADOS self-managed snaps (librbd's model): snap_create
+allocates a snap id from the monitor and image writes carry the
+image's own SnapContext, so block objects COW into clones; snap reads
+and rollback resolve per block. Clones (rbd-layering) are new images
+whose header records (parent image, parent snap id): reads fall
+through to the parent's snap for blocks the child hasn't copied; the
+first child write copies the parent block up (copy-up), and flatten()
+severs the dependency.
 """
 
 from __future__ import annotations
@@ -16,6 +25,7 @@ from __future__ import annotations
 import errno as _errno
 import struct
 
+from .. import encoding
 from .striper import FileLayout
 
 
@@ -46,6 +56,21 @@ def _data_oid(name: str, block: int) -> str:
     return "rbd_data.%s.%016x" % (name, block)
 
 
+def _pack_header(size: int, order: int, meta: dict) -> bytes:
+    return struct.pack("<QB", size, order) + encoding.encode_any(meta)
+
+
+def _unpack_header(hdr: bytes):
+    size, order = struct.unpack("<QB", hdr[:9])
+    meta = {"snaps": {}, "parent": None}
+    if len(hdr) > 9:
+        try:
+            meta.update(encoding.decode_any(hdr[9:]))
+        except encoding.DecodeError:
+            pass
+    return size, order, meta
+
+
 class RBD:
     """Pool-level image operations (librbd.h rbd_create/list/remove)."""
 
@@ -55,8 +80,28 @@ class RBD:
         if name in RBD.list(ioctx):
             raise ImageExists(name)
         ioctx.write_full(_header_oid(name),
-                         struct.pack("<QB", size, order))
+                         _pack_header(size, order,
+                                      {"snaps": {}, "parent": None}))
         ioctx.omap_set(DIR_OID, {name: b"1"})
+
+    @staticmethod
+    def clone(ioctx, parent_name: str, snap_name: str,
+              clone_name: str) -> None:
+        """rbd clone (rbd-layering.rst): a new image COW-backed by the
+        parent's snapshot."""
+        parent = Image(ioctx, parent_name)
+        snap = parent.meta["snaps"].get(snap_name)
+        if snap is None:
+            raise ImageNotFound("%s@%s" % (parent_name, snap_name))
+        if clone_name in RBD.list(ioctx):
+            raise ImageExists(clone_name)
+        ioctx.write_full(_header_oid(clone_name), _pack_header(
+            snap["size"], parent.order,
+            {"snaps": {},
+             "parent": {"image": parent_name, "snap_id": snap["id"],
+                        "snap_name": snap_name,
+                        "size": snap["size"]}}))
+        ioctx.omap_set(DIR_OID, {clone_name: b"1"})
 
     @staticmethod
     def list(ioctx) -> list[str]:
@@ -100,7 +145,7 @@ class Image:
             raise
         if len(hdr) < 9:
             raise ImageNotFound(name)
-        self._size, self.order = struct.unpack("<QB", hdr[:9])
+        self._size, self.order, self.meta = _unpack_header(hdr)
         self.block_size = 1 << self.order
         self.layout = FileLayout(self.block_size, 1, self.block_size)
 
@@ -110,7 +155,118 @@ class Image:
     def stat(self) -> dict:
         return {"size": self._size, "order": self.order,
                 "block_name_prefix": "rbd_data.%s" % self.name,
-                "num_objs": -(-self._size // self.block_size)}
+                "num_objs": -(-self._size // self.block_size),
+                "parent": self.meta.get("parent")}
+
+    # -- snapshots (librbd snap_create/list/rollback/remove) -----------
+
+    def _save_header(self) -> None:
+        self.ioctx.write_full(_header_oid(self.name), _pack_header(
+            self._size, self.order, self.meta))
+
+    def _image_snapc(self) -> tuple:
+        ids = sorted((s["id"] for s in self.meta["snaps"].values()),
+                     reverse=True)
+        return (ids[0] if ids else 0, tuple(ids))
+
+    def _apply_snapc(self) -> None:
+        # image writes carry THIS image's SnapContext (librbd keeps a
+        # per-image snap context, not the pool's)
+        seq, ids = self._image_snapc()
+        self.ioctx.set_snap_context(seq, ids)
+
+    def snap_create(self, snap_name: str) -> int:
+        if snap_name in self.meta["snaps"]:
+            raise ImageExists("%s@%s" % (self.name, snap_name))
+        snap_id = self.ioctx.selfmanaged_snap_create()
+        self.meta["snaps"][snap_name] = {"id": snap_id,
+                                         "size": self._size}
+        self._save_header()
+        return snap_id
+
+    def snap_list(self) -> list:
+        return sorted(
+            ({"name": n, "id": s["id"], "size": s["size"]}
+             for n, s in self.meta["snaps"].items()),
+            key=lambda s: s["id"])
+
+    def snap_remove(self, snap_name: str) -> None:
+        snap = self.meta["snaps"].pop(snap_name, None)
+        if snap is None:
+            raise ImageNotFound("%s@%s" % (self.name, snap_name))
+        self._save_header()
+        # retire the id: OSDs trim the block clones it pinned
+        self.ioctx.selfmanaged_snap_remove(snap["id"])
+
+    def snap_rollback(self, snap_name: str) -> None:
+        snap = self.meta["snaps"].get(snap_name)
+        if snap is None:
+            raise ImageNotFound("%s@%s" % (self.name, snap_name))
+        snap_id, snap_size = snap["id"], snap["size"]
+        self._apply_snapc()
+        nblocks = -(-max(self._size, snap_size) // self.block_size)
+        for blk in range(nblocks):
+            oid = _data_oid(self.name, blk)
+            if blk * self.block_size >= snap_size:
+                try:
+                    self.ioctx.remove(oid)
+                except OSError as e:
+                    if not _enoent(e):
+                        raise
+                continue
+            try:
+                self.ioctx.rollback_id(oid, snap_id)
+            except OSError as e:
+                if not _enoent(e):
+                    raise    # block absent at snap AND now: nothing
+        if self._size != snap_size:
+            self._size = snap_size
+            self._save_header()
+
+    # -- layering (clone reads / copy-up / flatten) --------------------
+
+    def _parent_block(self, blk: int) -> bytes | None:
+        parent = self.meta.get("parent")
+        if parent is None:
+            return None
+        off = blk * self.block_size
+        if off >= parent["size"]:
+            return None
+        try:
+            return self.ioctx.read(_data_oid(parent["image"], blk),
+                                   self.block_size, 0,
+                                   snap=parent["snap_id"])
+        except OSError as e:
+            if _enoent(e):
+                return None
+            raise
+
+    def _copy_up(self, blk: int) -> None:
+        """First write to an un-copied block of a clone pulls the
+        parent's bytes in (librbd copy-up)."""
+        data = self._parent_block(blk)
+        if data:
+            self.ioctx.write(_data_oid(self.name, blk), data, 0)
+
+    def flatten(self) -> None:
+        """Copy every still-inherited block; drop the parent link."""
+        if self.meta.get("parent") is None:
+            return
+        self._apply_snapc()
+        nblocks = -(-self._size // self.block_size)
+        for blk in range(nblocks):
+            oid = _data_oid(self.name, blk)
+            try:
+                self.ioctx.stat(oid)
+                continue             # child already owns this block
+            except OSError as e:
+                if not _enoent(e):
+                    raise
+            data = self._parent_block(blk)
+            if data:
+                self.ioctx.write(oid, data, 0)
+        self.meta["parent"] = None
+        self._save_header()
 
     def _check_extent(self, offset: int, length: int) -> None:
         if offset < 0 or length < 0 or offset + length > self._size:
@@ -119,9 +275,22 @@ class Image:
 
     def write(self, offset: int, data: bytes) -> int:
         self._check_extent(offset, len(data))
+        self._apply_snapc()
+        parented = self.meta.get("parent") is not None
         for blk, blk_off, n, foff in self.layout.map_extent(
                 offset, len(data)):
-            self.ioctx.write(_data_oid(self.name, blk),
+            oid = _data_oid(self.name, blk)
+            if parented and (blk_off != 0 or n != self.block_size):
+                # partial write to a possibly-inherited block: copy the
+                # parent bytes up first so the rest of the block keeps
+                # its COW content (librbd copy-up)
+                try:
+                    self.ioctx.stat(oid)
+                except OSError as e:
+                    if not _enoent(e):
+                        raise
+                    self._copy_up(blk)
+            self.ioctx.write(oid,
                              data[foff - offset:foff - offset + n],
                              blk_off)
         return len(data)
@@ -137,41 +306,72 @@ class Image:
             except OSError as e:
                 if not _enoent(e):
                     raise  # timeout/EIO must not read as zeros
-                piece = b""  # sparse block reads as zeros
+                # clone: fall through to the parent's snapshot
+                inherited = self._parent_block(blk)
+                piece = (inherited[blk_off:blk_off + n]
+                         if inherited else b"")
             out[foff - offset:foff - offset + len(piece)] = piece
         return bytes(out)
 
     def discard(self, offset: int, length: int) -> None:
-        """Free whole blocks; zero partial block edges (rbd_discard)."""
+        """Free whole blocks; zero partial block edges (rbd_discard).
+        On a clone, discarded blocks are MASKED with zeros rather than
+        removed, or the parent's bytes would resurface."""
         self._check_extent(offset, length)
+        self._apply_snapc()
+        parented = self.meta.get("parent") is not None
         for blk, blk_off, n, _ in self.layout.map_extent(offset, length):
             oid = _data_oid(self.name, blk)
-            if blk_off == 0 and n == self.block_size:
+            if blk_off == 0 and n == self.block_size and not parented:
                 try:
                     self.ioctx.remove(oid)
                 except OSError as e:
                     if not _enoent(e):
                         raise
             else:
+                if parented and (blk_off != 0 or n != self.block_size):
+                    try:
+                        self.ioctx.stat(oid)
+                    except OSError as e:
+                        if not _enoent(e):
+                            raise
+                        self._copy_up(blk)
                 self.ioctx.write(oid, b"\0" * n, blk_off)
 
     def resize(self, new_size: int) -> None:
+        self._apply_snapc()
+        parented = self.meta.get("parent") is not None
         if new_size < self._size:
             first_dead = -(-new_size // self.block_size)
             last = -(-self._size // self.block_size)
             for blk in range(first_dead, last):
+                oid = _data_oid(self.name, blk)
+                if parented:
+                    # mask, don't remove: a later grow must read zeros
+                    # here, not the parent's bytes resurfacing
+                    self.ioctx.write(oid, b"\0" * self.block_size, 0)
+                    continue
                 try:
-                    self.ioctx.remove(_data_oid(self.name, blk))
+                    self.ioctx.remove(oid)
                 except OSError as e:
                     if not _enoent(e):
                         raise
-            # zero the tail of the new boundary block
+            # zero the tail of the new boundary block; on a clone the
+            # head of that block may still be inherited — copy it up
+            # first or the zeros would sit in an otherwise-absent
+            # object and shadow the parent bytes below new_size
             if new_size % self.block_size:
                 blk = new_size // self.block_size
                 tail_off = new_size % self.block_size
+                oid = _data_oid(self.name, blk)
+                if parented:
+                    try:
+                        self.ioctx.stat(oid)
+                    except OSError as e:
+                        if not _enoent(e):
+                            raise
+                        self._copy_up(blk)
                 self.ioctx.write(
-                    _data_oid(self.name, blk),
-                    b"\0" * (self.block_size - tail_off), tail_off)
+                    oid, b"\0" * (self.block_size - tail_off), tail_off)
         self._size = new_size
-        self.ioctx.write_full(_header_oid(self.name),
-                              struct.pack("<QB", new_size, self.order))
+        self._save_header()

@@ -200,7 +200,83 @@ class OSDMonitor:
                 return 0, "", self._dump()
             if prefix == "osd getmap":
                 return 0, "", encoding.encode_any(self.osdmap)
+            if prefix == "osd pool mksnap":
+                return self._pool_mksnap(cmd)
+            if prefix == "osd pool rmsnap":
+                return self._pool_rmsnap(cmd)
+            if prefix == "osd pool selfmanaged-snap-create":
+                return self._selfmanaged_snap_create(cmd)
+            if prefix == "osd pool selfmanaged-snap-remove":
+                pool = self._find_pool(cmd.get("pool", ""))
+                if pool is None:
+                    return -2, "pool %r does not exist" \
+                        % cmd.get("pool"), None
+                staged = self._pending_pool(pool)
+                staged.removed_snaps = list(staged.removed_snaps) + \
+                    [int(cmd["snap_id"])]
+                self.mon.propose_soon()
+                return 0, "", None
         return -22, "unknown command %r" % prefix, None
+
+    # -- snapshots (OSDMonitor pool snap commands) ---------------------
+
+    def _find_pool(self, name):
+        for pool in self.osdmap.pools.values():
+            if pool.name == name:
+                return pool
+        return None
+
+    def _pending_pool(self, pool):
+        """A mutable copy of the pool staged in the pending
+        incremental (prepare_new_pool-style copy-on-write)."""
+        import copy
+        inc = self._pend()
+        staged = inc.new_pools.get(pool.pool_id)
+        if staged is None:
+            staged = inc.new_pools[pool.pool_id] = copy.deepcopy(pool)
+        return staged
+
+    def _pool_mksnap(self, cmd: dict):
+        pool = self._find_pool(cmd.get("pool", ""))
+        if pool is None:
+            return -2, "pool %r does not exist" % cmd.get("pool"), None
+        snap = cmd.get("snap", "")
+        if not snap:
+            return -22, "snap name required", None
+        if snap in pool.snaps:
+            return -17, "snap %s already exists" % snap, None
+        staged = self._pending_pool(pool)
+        staged.snap_seq += 1
+        staged.snaps = dict(staged.snaps)
+        staged.snaps[snap] = staged.snap_seq
+        self.mon.propose_soon()
+        return 0, "created pool %s snap %s" % (pool.name, snap), \
+            staged.snap_seq
+
+    def _pool_rmsnap(self, cmd: dict):
+        pool = self._find_pool(cmd.get("pool", ""))
+        if pool is None:
+            return -2, "pool %r does not exist" % cmd.get("pool"), None
+        snap = cmd.get("snap", "")
+        staged = self._pending_pool(pool)
+        if snap not in staged.snaps:
+            return -2, "snap %s does not exist" % snap, None
+        staged.snaps = dict(staged.snaps)
+        snap_id = staged.snaps.pop(snap)
+        staged.removed_snaps = list(staged.removed_snaps) + [snap_id]
+        self.mon.propose_soon()
+        return 0, "removed pool %s snap %s" % (pool.name, snap), snap_id
+
+    def _selfmanaged_snap_create(self, cmd: dict):
+        """Allocate a self-managed snap id (the librados
+        selfmanaged_snap_create path rbd snapshots ride on)."""
+        pool = self._find_pool(cmd.get("pool", ""))
+        if pool is None:
+            return -2, "pool %r does not exist" % cmd.get("pool"), None
+        staged = self._pending_pool(pool)
+        staged.snap_seq += 1
+        self.mon.propose_soon()
+        return 0, "", staged.snap_seq
 
     def _profile_set(self, cmd: dict):
         name = cmd["name"]

@@ -25,8 +25,9 @@ __all__ = [
     "Message", "MPing", "MPingReply", "MOSDOp", "MOSDOpReply",
     "MOSDECSubOpWrite", "MOSDECSubOpWriteReply", "MOSDECSubOpRead",
     "MOSDECSubOpReadReply", "MOSDRepOp", "MOSDRepOpReply", "MOSDPGPush",
-    "MOSDPGPull", "MOSDPGScan", "MOSDMap", "MOSDBoot", "MOSDFailure",
-    "MOSDAlive",
+    "MOSDPGPull", "MOSDPGScan", "MOSDPGQuery", "MOSDPGNotify",
+    "MOSDPGLog", "MOSDMap", "MOSDBoot", "MOSDFailure",
+    "MOSDAlive", "MWatchNotify", "MWatchNotifyAck",
     "MMonCommand", "MMonCommandReply", "MMonSubscribe", "MMonPaxos",
     "MMonElection", "MAuth", "MAuthReply", "MMgrReport",
 ]
@@ -73,6 +74,9 @@ class MOSDOp(Message):
     oid: str = ""
     ops: list = field(default_factory=list)  # [(op, args...)]
     map_epoch: int = 0
+    # snapshots (appended fields — compatible evolution):
+    snapc: tuple = (0, ())         # write SnapContext (seq, snaps desc)
+    snap: int = 0                  # read snap id (0 = head)
 
 
 @dataclass
@@ -192,6 +196,70 @@ class MOSDPGPull(Message):
     shard: int = -1
     oid: str = ""
     map_epoch: int = 0
+
+
+# -- peering (GetInfo/GetLog/GetMissing rounds) ------------------------
+
+@dataclass
+class MOSDPGQuery(Message):
+    """Primary asks a peer for its info or its log since an eversion
+    (src/messages/MOSDPGQuery.h)."""
+    pgid: object = None
+    from_osd: int = 0
+    shard: int = -1
+    what: str = "info"             # info | log
+    since: tuple = (0, 0)          # eversion for what=log
+    map_epoch: int = 0
+
+
+@dataclass
+class MOSDPGNotify(Message):
+    """Peer -> primary: pg info, and (post-merge) the peer's missing
+    set (src/messages/MOSDPGNotify.h + the GetMissing leg)."""
+    pgid: object = None
+    from_osd: int = 0
+    shard: int = -1
+    info: dict = field(default_factory=dict)
+    missing: list = field(default_factory=list)   # [oid, ...]
+    map_epoch: int = 0
+
+
+@dataclass
+class MOSDPGLog(Message):
+    """A log segment: authoritative reply to what=log, or the
+    activation delta the primary sends each replica
+    (src/messages/MOSDPGLog.h)."""
+    pgid: object = None
+    from_osd: int = 0
+    shard: int = -1
+    entries: list = field(default_factory=list)   # PGLog.dump() rows
+    head: tuple = (0, 0)
+    contiguous: bool = True
+    info: dict = field(default_factory=dict)
+    map_epoch: int = 0
+
+
+# -- watch/notify ------------------------------------------------------
+
+@dataclass
+class MWatchNotify(Message):
+    """Primary OSD -> watching client (src/messages/MWatchNotify.h)."""
+    pgid: object = None
+    oid: str = ""
+    cookie: int = 0
+    notify_id: int = 0
+    payload: bytes = b""
+    from_osd: int = -1
+
+
+@dataclass
+class MWatchNotifyAck(Message):
+    """Watcher's completion ack back to the notifying primary."""
+    pgid: object = None
+    oid: str = ""
+    cookie: int = 0
+    notify_id: int = 0
+    reply: bytes = b""
 
 
 # -- control plane -----------------------------------------------------

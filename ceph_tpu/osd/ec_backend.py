@@ -197,9 +197,8 @@ class ECBackend:
             op.pending_commits = {s for s, osd in shards.items()
                                   if osd != CRUSH_ITEM_NONE}
             self.waiting_commit.append(op)
-            log_entry = [(op.at_version, oid,
-                          "delete" if obj_op.is_delete() else "modify")
-                         for oid, obj_op in op.plan.t.op_map.items()]
+            log_entry = self.pg.mint_log_entries(op.plan.t.op_map,
+                                                 op.at_version)
         for shard, osd in shards.items():
             if osd == CRUSH_ITEM_NONE:
                 continue
@@ -232,7 +231,9 @@ class ECBackend:
         """Apply a shard transaction + log, then ack (:917-979)."""
         txn = Transaction()
         txn.ops = list(msg.txn_ops)
-        self.pg.log_operation(msg.log_entries, msg.at_version, msg.shard)
+        # log keys ride the same store transaction as the shard data
+        self.pg.log_operation(msg.log_entries, msg.at_version,
+                              msg.shard, txn=txn)
         done = threading.Event()
 
         def on_commit():

@@ -38,6 +38,11 @@ class OSDDaemon(Dispatcher):
         conf = self.ctx.conf
         self.finisher = Finisher("osd%d-fin" % whoami)
         self.store = store or MemStore(self.finisher)
+        # a handed-over store (daemon restart over the same data) must
+        # deliver completions through THIS daemon's finisher — its
+        # creator's finisher died with the old daemon, and callbacks
+        # queued there black-hole (no commit acks => wedged writes)
+        self.store._finisher = self.finisher
         self.public_msgr = Messenger(("osd", whoami), conf=conf)
         self.cluster_msgr = Messenger(("osd", whoami), conf=conf)
         self.hb_msgr = Messenger(("osd", whoami), conf=conf)
@@ -215,6 +220,11 @@ class OSDDaemon(Dispatcher):
             return addrs.get(kind)
         return addrs
 
+    def send_to_client(self, addr, msg) -> None:
+        """Push a message to a client's advertised address (the
+        watch/notify path rides the public messenger)."""
+        self.public_msgr.send_message(msg, addr)
+
     def send_to_osd_cluster(self, osd: int, msg) -> None:
         addr = self._osd_addr(osd, "cluster")
         if addr is not None:
@@ -294,7 +304,8 @@ class OSDDaemon(Dispatcher):
         if t in ("MOSDECSubOpWrite", "MOSDECSubOpWriteReply",
                  "MOSDECSubOpRead", "MOSDECSubOpReadReply",
                  "MOSDRepOp", "MOSDRepOpReply", "MOSDPGScan",
-                 "MOSDPGPush", "MOSDPGPull"):
+                 "MOSDPGPush", "MOSDPGPull", "MOSDPGQuery",
+                 "MOSDPGNotify", "MOSDPGLog", "MWatchNotifyAck"):
             self._enqueue_sub_op(msg)
             return True
         return False
@@ -384,10 +395,19 @@ class OSDDaemon(Dispatcher):
                 pg.handle_push(msg)
             elif t == "MOSDPGPull":
                 pg.handle_pull(msg)
+            elif t == "MOSDPGQuery":
+                pg.handle_query(msg)
+            elif t == "MOSDPGNotify":
+                pg.handle_notify(msg)
+            elif t == "MOSDPGLog":
+                pg.handle_log(msg)
+            elif t == "MWatchNotifyAck":
+                pg.handle_notify_ack(msg)
 
         # recovery data movement (push/pull/scan) must ride the recovery
         # class or QoS settings have no effect on actual backfill traffic
-        if t in ("MOSDPGPush", "MOSDPGScan", "MOSDPGPull"):
+        if t in ("MOSDPGPush", "MOSDPGScan", "MOSDPGPull",
+                 "MOSDPGQuery", "MOSDPGNotify", "MOSDPGLog"):
             self.op_wq.queue(msg.pgid, run, klass="recovery",
                              priority=self.recovery_op_priority)
         else:

@@ -82,6 +82,15 @@ class PGPool:
     erasure_code_profile: str = ""
     hashpspool: bool = True
     stripe_width: int = 0
+    # snapshots (pg_pool_t snap_seq / snaps / removed_snaps)
+    snap_seq: int = 0
+    snaps: dict = field(default_factory=dict)      # name -> snap id
+    removed_snaps: list = field(default_factory=list)
+
+    def snap_context(self) -> tuple:
+        """Pool-snap SnapContext for writes: (seq, ids descending)."""
+        return (self.snap_seq,
+                tuple(sorted(self.snaps.values(), reverse=True)))
 
     def __post_init__(self):
         if self.pgp_num == 0:

@@ -1,12 +1,28 @@
-"""Placement group: op execution, logging, peering-lite, recovery drive.
+"""Placement group: op execution, logging, peering, recovery drive.
 
 Role of the reference's PG/PrimaryLogPG (src/osd/PG.{h,cc},
 PrimaryLogPG.cc): a PG executes client ops in order through its backend
-(do_op -> execute_ctx -> submit_transaction), maintains a per-PG op log
-(PGLog), reacts to map changes (the peering statechart collapsed into
-on_map_change: new interval -> re-role -> primary drives recovery), and
-recovers missing objects by comparing inventories and pushing
-reconstructed state (the storage world's elastic recovery).
+(do_op -> execute_ctx -> submit_transaction), maintains a durable
+per-PG op log (ceph_tpu.osd.pg_log; entries stamped with (epoch,
+version) eversions), and converges replicas through the peering rounds
+of the reference statechart (PG.h:1811):
+
+  GetInfo      on an interval change the primary queries every up/
+               acting peer for its pg info (MOSDPGQuery what=info);
+  GetLog       the peer with the highest last_update is authoritative;
+               if that is not us, we pull its log delta and MERGE —
+               divergent entries (dead-interval writes) are undone,
+               newer authoritative entries become `missing`
+               (PGLog.merge; ecbackend.rst:149-174 roll-forward);
+  GetMissing   activation sends every replica the log segment it
+               lacks; replicas merge, report their missing sets, and
+               the primary pushes exactly those objects — no inventory
+               scan when logs overlap. Scan-based backfill remains the
+               fallback for peers whose logs do not overlap (the
+               reference's backfill lane).
+
+Writes are gated on activation (active_for_write), so a new primary
+cannot mint entries on a stale chain that a later merge would rewind.
 
 Collections: one per (pg, shard) — EC shard s lives in cid
 ("pg", str(pgid), s) on its host OSD; replicated uses shard -1
@@ -18,16 +34,33 @@ from __future__ import annotations
 import threading
 import time as _time
 
-from ..msg.message import MOSDPGPull, MOSDPGPush, MOSDPGScan
+from .. import encoding
+from ..msg.message import (MOSDPGLog, MOSDPGNotify, MOSDPGPull,
+                           MOSDPGPush, MOSDPGQuery, MOSDPGScan,
+                           MWatchNotify)
 from ..store.object_store import Transaction
 from .ec_backend import ECBackend
 from .osd_map import CRUSH_ITEM_NONE, POOL_TYPE_ERASURE
+from .pg_log import PGLog, entry_from_tuple
 from .pg_transaction import PGTransaction
 from .replicated_backend import ReplicatedBackend
 
 __all__ = ["PG"]
 
 VERSION_ATTR = "_v"
+META_OID = "__pg_meta__"
+SNAPSET_ATTR = "_ss"
+WHITEOUT_ATTR = "_whiteout"
+
+
+def clone_name(oid, cloneid: int) -> str:
+    """Clone objects live beside the head as '<oid>@<cloneid>'
+    (the ghobject snap id at framework scale)."""
+    return "%s@%d" % (oid, cloneid)
+
+
+def is_clone_oid(oid) -> bool:
+    return isinstance(oid, str) and "@" in oid
 
 
 class PG:
@@ -43,13 +76,28 @@ class PG:
         self.up: list[int] = []
         self.interval = 0
         self.last_version = 0
-        self.pg_log: list[tuple] = []
+        self.pg_log = PGLog()
         self.waiting_for_active: list = []
         self._pulling: dict = {}   # oid -> pull sent at (monotonic)
         self._deleted_log: dict = {}   # oid -> version it was deleted at
         self.scrub_stats: dict = {"state": "never"}
         self._scrub_waiting: set = set()
         self._scrub_replies: dict = {}
+        # peering (GetInfo/GetLog/GetMissing)
+        self.peer_state = "idle"      # idle|peering|active|replica
+        self._peer_seq = 0
+        self._peer_infos: dict = {}   # osd -> info dict
+        self._peer_wait: set = set()
+        self.missing: dict = {}       # oid -> version we need
+        self._missing_src: dict = {}  # oid -> osd holding it
+        self._missing_waiters: dict = {}   # oid -> [continuations]
+        self._trimmed_snaps: set = set()
+        # watch/notify (PrimaryLogPG watchers; volatile on the primary,
+        # clients re-watch after a primary change like the Objecter's
+        # linger resend)
+        self.watchers: dict = {}      # oid -> {cookie: client addr}
+        self._notifies: dict = {}     # notify_id -> state
+        self._notify_seq = 0
         if pool.is_erasure():
             from .. import registry
             profile = daemon.ec_profile_for(pool)
@@ -58,6 +106,7 @@ class PG:
         else:
             self.backend = ReplicatedBackend(self)
         self._ensure_collections()
+        self._load_log()
         # a (re)started OSD must never mint versions below what its own
         # store has seen, or recovery judges stale peer copies "newer"
         # and clobbers acked writes
@@ -66,6 +115,8 @@ class PG:
             for v in self._local_inventory(shard).values():
                 if v > self.last_version:
                     self.last_version = v
+        self.last_version = max(self.last_version,
+                                self.pg_log.head[1])
 
     # -- identity / listener interface for backends --------------------
 
@@ -118,29 +169,109 @@ class PG:
 
     PG_LOG_CAP = 5000
 
-    def log_operation(self, log_entries, at_version, shard) -> None:
+    def mint_log_entries(self, op_map, at_version: int) -> list:
+        """Wire-form entries for a write being submitted: (version,
+        oid, kind, epoch, prior_version) — the eversion's epoch half is
+        what lets a later merge tell two same-numbered forks apart."""
+        epoch = self.map_epoch()
+        out = []
+        for oid, obj_op in op_map.items():
+            kind = "delete" if obj_op.is_delete() else "modify"
+            prior = self._object_version(oid)
+            out.append((epoch, at_version, oid, kind, prior))
+        return out
+
+    def _object_version(self, oid) -> int:
+        raw = self.local_getattr(oid, VERSION_ATTR)
+        try:
+            return int(raw) if raw else 0
+        except ValueError:
+            return 0
+
+    def log_operation(self, log_entries, at_version, shard,
+                      txn=None) -> None:
+        """Record entries in the in-memory log and make them durable.
+        With `txn` (the backend's store transaction for this write)
+        the log omap keys ride THE SAME transaction as the data — one
+        commit, atomic, like the reference writing pg log keys in the
+        op's ObjectStore::Transaction."""
+        entries = [entry_from_tuple(t) for t in log_entries]
         with self.lock:
-            self.pg_log.extend(log_entries)
-            if len(self.pg_log) > self.PG_LOG_CAP:
-                del self.pg_log[:len(self.pg_log) - self.PG_LOG_CAP]
-            for entry in log_entries:
-                if len(entry) < 3:
-                    continue
-                v, oid, kind = entry[0], entry[1], entry[2]
+            for entry in entries:
+                self.pg_log.append(entry)
+                self.missing.pop(entry.oid, None)
+                v, oid, kind = entry.version, entry.oid, entry.kind
                 if kind == "delete":
-                    # divergence oracle: "oid was deleted at version v".
-                    # Re-insert so dict-order eviction below stays LRU:
-                    # a re-deleted oid must not keep its ancient slot.
+                    # divergence oracle for the scan/backfill lane:
+                    # "oid was deleted at version v" (LRU re-insert)
                     if v > self._deleted_log.get(oid, -1):
                         self._deleted_log.pop(oid, None)
                         self._deleted_log[oid] = v
                 elif v > self._deleted_log.get(oid, -1):
-                    # a LATER re-create supersedes the delete record;
-                    # an older (duplicate/retransmitted) modify must not
                     self._deleted_log.pop(oid, None)
             while len(self._deleted_log) > self.PG_LOG_CAP:
                 self._deleted_log.pop(next(iter(self._deleted_log)))
             self.last_version = max(self.last_version, at_version)
+        if txn is not None:
+            cid = self._meta_cid()
+            txn.touch(cid, META_OID)
+            kv = {self._log_key(e): encoding.encode_any(
+                (e.epoch, e.version, e.oid, e.kind, e.prior_version))
+                for e in entries}
+            if kv:
+                txn.omap_setkeys(cid, META_OID, kv)
+        else:
+            self._persist_log_delta(entries)
+
+    # -- durable log (meta object omap, the reference's pg log omap) ---
+
+    def _meta_cid(self):
+        return self.cid_of_shard(-1)
+
+    @staticmethod
+    def _log_key(entry) -> str:
+        return "log:%016d.%016d" % (entry.epoch, entry.version)
+
+    def _persist_log_delta(self, entries) -> None:
+        txn = Transaction()
+        cid = self._meta_cid()
+        txn.touch(cid, META_OID)
+        kv = {self._log_key(e): encoding.encode_any(
+            (e.epoch, e.version, e.oid, e.kind, e.prior_version))
+            for e in entries}
+        if kv:
+            txn.omap_setkeys(cid, META_OID, kv)
+        self.store.queue_transaction(txn)
+
+    def _persist_log_full(self) -> None:
+        """Rewrite the whole durable log (after a merge rewound it)."""
+        txn = Transaction()
+        cid = self._meta_cid()
+        txn.remove(cid, META_OID)
+        txn.touch(cid, META_OID)
+        with self.lock:
+            rows = self.pg_log.dump()
+        kv = {"log:%016d.%016d" % (r[0], r[1]): encoding.encode_any(r)
+              for r in rows}
+        if kv:
+            txn.omap_setkeys(cid, META_OID, kv)
+        self.store.queue_transaction(txn)
+
+    def _load_log(self) -> None:
+        try:
+            omap = self.store.omap_get(self._meta_cid(), META_OID)
+        except KeyError:
+            return
+        rows = []
+        for key, raw in omap.items():
+            if isinstance(key, str) and key.startswith("log:"):
+                try:
+                    rows.append(encoding.decode_any(raw))
+                except encoding.DecodeError:
+                    continue
+        if rows:
+            rows.sort(key=lambda r: (r[0], r[1]))
+            self.pg_log.load(rows)
 
     def _ensure_collections(self) -> None:
         txn = Transaction()
@@ -154,6 +285,16 @@ class PG:
 
     def on_map_change(self) -> None:
         m = self.daemon.osdmap
+        newpool = m.pools.get(self.pgid.pool)
+        if newpool is not None and newpool is not self.pool:
+            # pool metadata (snap_seq, snaps, removed_snaps) rides the
+            # map; trim clones for newly removed snaps
+            fresh = [s for s in newpool.removed_snaps
+                     if s not in self._trimmed_snaps]
+            self.pool = newpool
+            if fresh:
+                self._trimmed_snaps.update(fresh)
+                self.trim_snaps(fresh)
         up, upp, acting, actp = m.pg_to_up_acting_osds(self.pgid)
         with self.lock:
             changed = acting != self.acting or actp != self.acting_primary
@@ -162,20 +303,34 @@ class PG:
             self.acting_primary = actp
             if changed:
                 self.interval += 1
-            waiting, self.waiting_for_active = \
-                self.waiting_for_active, []
+                # a new interval invalidates the old activation: the
+                # primary re-peers, replicas wait for its log
+                self.peer_state = ("peering" if actp == self.whoami
+                                   else "replica")
+            elif self.peer_state == "idle":
+                self.peer_state = ("peering" if actp == self.whoami
+                                   else "replica")
+                changed = True     # first sight of our role: peer once
         if changed and self.is_primary():
             self.daemon.queue_recovery(self)
-        for fn in waiting:
-            fn()
+        if not self.is_primary():
+            # replicas don't gate anything locally; release waiters
+            with self.lock:
+                waiting, self.waiting_for_active = \
+                    self.waiting_for_active, []
+            for fn in waiting:
+                fn()
 
     def active_for_write(self) -> bool:
         with self.lock:
             alive = sum(1 for o in self.acting if o != CRUSH_ITEM_NONE)
-            return alive >= self.pool.min_size and self.is_primary()
+            return alive >= self.pool.min_size and self.is_primary() \
+                and self.peer_state == "active"
 
     def active_for_read(self) -> bool:
         with self.lock:
+            if self.peer_state != "active":
+                return False
             alive = sum(1 for o in self.acting if o != CRUSH_ITEM_NONE)
             if self.pool.is_erasure():
                 k = self.backend.codec.get_data_chunk_count()
@@ -188,11 +343,35 @@ class PG:
         if not self.is_primary():
             reply_fn(-11, None)  # EAGAIN: wrong primary / not peered
             return
+        # an object we know we're missing must be recovered before any
+        # op touches it — serving the local copy would expose stale
+        # bytes for an acked write (PrimaryLogPG wait_for_missing)
+        repull = None
+        with self.lock:
+            if msg.oid in self.missing:
+                self._missing_waiters.setdefault(msg.oid, []).append(
+                    lambda: self.do_op(msg, reply_fn))
+                now = _time.monotonic()
+                if now - self._pulling.get(msg.oid, -1e9) > 2.0:
+                    self._pulling[msg.oid] = now
+                    repull = self._missing_src.get(msg.oid)
+        if repull is not None:
+            self.send_to_osd(repull, MOSDPGPull(
+                pgid=self.pgid, from_osd=self.whoami,
+                shard=self.my_shard() if self.pool.is_erasure() else -1,
+                oid=msg.oid, map_epoch=self.map_epoch()))
+        with self.lock:
+            if msg.oid in self.missing:
+                return
         if any(op[0] == "call" for op in msg.ops):
             self._do_call_op(msg, reply_fn)
             return
+        if msg.ops and msg.ops[0][0] in ("watch", "unwatch", "notify"):
+            self._do_watch_ops(msg, reply_fn)
+            return
         reads = [op for op in msg.ops if op[0] in
-                 ("read", "stat", "getxattr", "omap_get", "list")]
+                 ("read", "stat", "getxattr", "omap_get", "list",
+                  "list_snaps")]
         if reads and len(reads) == len(msg.ops):
             self._do_read_ops(msg, reply_fn)
             return
@@ -250,6 +429,71 @@ class PG:
         self.backend.submit_transaction(
             hctx.txn, version, lambda: reply_fn(ret, out))
 
+    # -- watch / notify (PrimaryLogPG do_osd_op_watch + do_notify) -----
+
+    def _do_watch_ops(self, msg, reply_fn) -> None:
+        op = msg.ops[0]
+        kind = op[0]
+        oid = msg.oid
+        if kind == "watch":
+            cookie = op[1]
+            addr = tuple(msg.from_addr) if msg.from_addr else None
+            if addr is None:
+                reply_fn(-22, None)
+                return
+            with self.lock:
+                self.watchers.setdefault(oid, {})[cookie] = addr
+            reply_fn(0, None)
+            return
+        if kind == "unwatch":
+            with self.lock:
+                self.watchers.get(oid, {}).pop(op[1], None)
+            reply_fn(0, None)
+            return
+        # notify: fan out to every watcher, complete when all ack or
+        # the timeout fires (Objecter notify linger semantics)
+        payload = op[1] if len(op) > 1 else b""
+        timeout = op[2] if len(op) > 2 else 3.0
+        with self.lock:
+            watchers = dict(self.watchers.get(oid, {}))
+            self._notify_seq += 1
+            notify_id = (self.whoami << 32) | self._notify_seq
+        if not watchers:
+            reply_fn(0, {"replies": {}, "timed_out": []})
+            return
+        state = {"waiting": set(watchers), "replies": {},
+                 "reply_fn": reply_fn}
+        with self.lock:
+            self._notifies[notify_id] = state
+        for cookie, addr in watchers.items():
+            self.daemon.send_to_client(addr, MWatchNotify(
+                pgid=self.pgid, oid=oid, cookie=cookie,
+                notify_id=notify_id, payload=payload,
+                from_osd=self.whoami))
+        self.daemon.timer.add_event_after(
+            timeout or 3.0, self._notify_timeout, notify_id)
+
+    def handle_notify_ack(self, msg) -> None:
+        with self.lock:
+            state = self._notifies.get(msg.notify_id)
+            if state is None:
+                return
+            state["waiting"].discard(msg.cookie)
+            state["replies"][msg.cookie] = msg.reply
+            done = not state["waiting"]
+            if done:
+                self._notifies.pop(msg.notify_id, None)
+        if done:
+            state["reply_fn"](0, {"replies": state["replies"],
+                                  "timed_out": []})
+
+    def _notify_timeout(self, notify_id: int) -> None:
+        with self.lock:
+            state = self._notifies.pop(notify_id, None)
+        if state is not None:
+            state["reply_fn"](0, {"replies": state["replies"],
+                                  "timed_out": sorted(state["waiting"])})
+
     def _do_read_ops(self, msg, reply_fn) -> None:
         if not self.active_for_read():
             with self.lock:
@@ -259,6 +503,32 @@ class PG:
         op = msg.ops[0]
         kind = op[0]
         oid = msg.oid
+        snap = getattr(msg, "snap", 0)
+        if kind == "list_snaps":
+            ss = self._load_snapset(oid)
+            head_alive = (self._object_size(oid) is not None
+                          and not self._is_whiteout(oid))
+            reply_fn(0, {
+                "seq": ss["seq"],
+                "clones": [{"id": c, "snaps": ss["snaps"].get(c, []),
+                            "size": ss["sizes"].get(c, 0)}
+                           for c in sorted(ss["clones"])],
+                "head_exists": head_alive})
+            return
+        if snap and not self.pool.is_erasure():
+            resolved = self._resolve_snap(oid, snap)
+            if resolved is None or (
+                    resolved == oid and (self._is_whiteout(oid)
+                                         or self._object_size(oid)
+                                         is None)):
+                reply_fn(-2, None)   # did not exist at that snap
+                return
+            oid = resolved
+        elif self._is_whiteout(oid) and kind in ("read", "stat",
+                                                 "getxattr",
+                                                 "omap_get"):
+            reply_fn(-2, None)       # tombstone reads as absent
+            return
         if kind == "stat":
             size = self._object_size(oid)
             if size is None:
@@ -282,7 +552,8 @@ class PG:
             return
         if kind == "list":
             cid = self.cid_of_shard(self.my_shard())
-            reply_fn(0, self.store.list_objects(cid))
+            reply_fn(0, [o for o in self.store.list_objects(cid)
+                         if o != META_OID and not is_clone_oid(o)])
             return
         # read (off, len)
         size = self._object_size(oid)
@@ -319,9 +590,161 @@ class PG:
         st = self.store.stat(self.cid_of_shard(-1), oid)
         return st["size"] if st is not None else None
 
+    # -- snapshots (PrimaryLogPG make_writeable / snapset machinery) ---
+
+    def _load_snapset(self, oid) -> dict:
+        raw = self.local_getattr(oid, SNAPSET_ATTR)
+        if raw:
+            try:
+                return encoding.decode_any(raw)
+            except encoding.DecodeError:
+                pass
+        return {"seq": 0, "clones": [], "snaps": {}, "sizes": {}}
+
+    def _is_whiteout(self, oid) -> bool:
+        return self.local_getattr(oid, WHITEOUT_ATTR) is not None
+
+    def _head_cid(self):
+        return self.cid_of_shard(-1)
+
+    def make_writeable(self, t: PGTransaction, oid, snapc) -> None:
+        """Before the first mutation of a write whose SnapContext names
+        snaps newer than the newest clone, preserve the current head as
+        a clone covering them (PrimaryLogPG::make_writeable,
+        PrimaryLogPG.cc around :3151 execute_ctx). The clone is emitted
+        as captured bytes (not a store-level clone op) so it is
+        pre-mutation by construction and replicas apply it
+        deterministically. EC pools don't carry snaps here (the
+        reference gates rbd/self-managed snaps onto replicated pools
+        in this era); their writes proceed uncloned.
+
+        Returns the in-flight snapset (so later ops in the SAME
+        transaction see the new clone), or None when nothing was
+        preserved."""
+        if self.pool.is_erasure() or not snapc or not snapc[0]:
+            return None
+        seq, snaps = snapc[0], list(snapc[1] or ())
+        size = self._object_size(oid)
+        if size is None or self._is_whiteout(oid):
+            # the object is being BORN under this SnapContext: stamp
+            # the snapset seq so snap reads older than its birth
+            # resolve to "did not exist" (object_info/snapset seq
+            # semantics), keeping any clones a prior life left behind
+            ss = self._load_snapset(oid)
+            if seq > ss["seq"]:
+                ss["seq"] = seq
+                t.setattr(oid, SNAPSET_ATTR, encoding.encode_any(ss))
+                return ss
+            return None            # no head to preserve
+        ss = self._load_snapset(oid)
+        new_snaps = sorted(s for s in snaps if s > ss["seq"])
+        if not new_snaps or seq <= ss["seq"]:
+            return None
+        cid = self._head_cid()
+        cname = clone_name(oid, seq)
+        data = self.store.read(cid, oid)
+        t.create(cname)
+        if data:
+            t.write(cname, 0, data)
+        t.setattr(cname, VERSION_ATTR,
+                  str(self._object_version(oid)).encode())
+        t.setattr(cname, "_size", str(size).encode())
+        try:
+            omap = self.store.omap_get(cid, oid)
+        except KeyError:
+            omap = {}
+        if omap:
+            t.omap_setkeys(cname, omap)
+        ss["clones"].append(seq)
+        ss["clones"].sort()
+        ss["snaps"][seq] = new_snaps
+        ss["sizes"][seq] = size
+        ss["seq"] = seq
+        t.setattr(oid, SNAPSET_ATTR, encoding.encode_any(ss))
+        return ss
+
+    def _resolve_snap(self, oid, snap: int):
+        """Which stored object serves reads at snap id `snap`?
+        Clone c covers snaps in (previous clone, c]; newer than the
+        newest clone reads from head — unless the head was born after
+        the snap (snapset seq > snap with no covering clone), which is
+        'did not exist then': None."""
+        ss = self._load_snapset(oid)
+        for c in sorted(ss["clones"]):
+            if c >= snap:
+                covered = ss["snaps"].get(c, [])
+                if covered and snap < min(covered):
+                    # the clone's coverage starts after `snap`: the
+                    # object was born between them — did not exist
+                    return None
+                return clone_name(oid, c)
+        if ss["seq"] >= snap:
+            # no covering clone and the head's (re)birth context
+            # already included `snap`: the object did not exist then
+            # (a write under snapc seq S postdates every snap <= S)
+            return None
+        return oid                  # head
+
+    def trim_snaps(self, removed: list) -> None:
+        """Drop removed snaps from clone coverage; clones covering
+        nothing are deleted (snap trimming; each OSD trims its own
+        store deterministically from the map's removed_snaps)."""
+        if self.pool.is_erasure() or not removed:
+            return
+        removed = set(removed)
+        cid = self._head_cid()
+        for oid in list(self.store.list_objects(cid)):
+            if is_clone_oid(oid) or oid == META_OID:
+                continue
+            raw = None
+            try:
+                raw = self.store.getattr(cid, oid, SNAPSET_ATTR)
+            except KeyError:
+                continue
+            if not raw:
+                continue
+            try:
+                ss = encoding.decode_any(raw)
+            except encoding.DecodeError:
+                continue
+            dirty = False
+            txn = Transaction()
+            for c in list(ss["clones"]):
+                keep = [s for s in ss["snaps"].get(c, [])
+                        if s not in removed]
+                if keep != ss["snaps"].get(c, []):
+                    dirty = True
+                if keep:
+                    ss["snaps"][c] = keep
+                else:
+                    ss["clones"].remove(c)
+                    ss["snaps"].pop(c, None)
+                    ss["sizes"].pop(c, None)
+                    txn.remove(cid, clone_name(oid, c))
+            if dirty:
+                if not ss["clones"] and self._is_whiteout(oid):
+                    # nothing references the whiteout anymore
+                    txn.remove(cid, oid)
+                else:
+                    txn.setattr(cid, oid, SNAPSET_ATTR,
+                                encoding.encode_any(ss))
+                self.store.queue_transaction(txn)
+
     def _do_write_ops(self, msg, reply_fn) -> None:
         t = PGTransaction()
         oid = msg.oid
+        snapc = getattr(msg, "snapc", (0, ()))
+        mutates = any(op[0] in ("write", "writefull", "append", "zero",
+                                "truncate", "remove", "rollback")
+                      for op in msg.ops)
+        ss_inflight = None
+        if mutates:
+            ss_inflight = self.make_writeable(t, oid, snapc)
+        if self._is_whiteout(oid):
+            # recreating over a whiteout: clear the tombstone, keep ss
+            if any(op[0] in ("create", "write", "writefull", "append")
+                   for op in msg.ops):
+                t.rmattr(oid, WHITEOUT_ATTR)
         logical_size = self._object_size(oid) or 0
         for op in msg.ops:
             kind = op[0]
@@ -345,8 +768,49 @@ class PG:
                 t.truncate(oid, op[1])
                 logical_size = op[1]
             elif kind == "remove":
-                t.remove(oid)
+                ss = ss_inflight or self._load_snapset(oid)
+                if ss["clones"] and not self.pool.is_erasure():
+                    # live clones still reference the snapset: leave a
+                    # whiteout tombstone instead of erasing it
+                    # (PrimaryLogPG whiteout semantics)
+                    t.truncate(oid, 0)
+                    t.setattr(oid, WHITEOUT_ATTR, b"1")
+                else:
+                    t.remove(oid)
                 logical_size = 0
+            elif kind == "rollback":
+                # CEPH_OSD_OP_ROLLBACK: head becomes the clone that
+                # serves snap op[1]; rolling back to head is a no-op
+                src = self._resolve_snap(oid, op[1])
+                if src is None:
+                    # the object did not exist at that snap: rollback
+                    # means delete (whiteout if clones remain)
+                    ss = ss_inflight or self._load_snapset(oid)
+                    if ss["clones"]:
+                        t.truncate(oid, 0)
+                        t.setattr(oid, WHITEOUT_ATTR, b"1")
+                    else:
+                        t.remove(oid)
+                    logical_size = 0
+                elif src != oid:
+                    cid = self._head_cid()
+                    try:
+                        data = self.store.read(cid, src)
+                    except KeyError:
+                        reply_fn(-2, None)
+                        return
+                    ss = ss_inflight or self._load_snapset(oid)
+                    t.remove(oid)
+                    t.create(oid)
+                    if data:
+                        t.write(oid, 0, data)
+                    t.setattr(oid, SNAPSET_ATTR,
+                              encoding.encode_any(ss))
+                    logical_size = len(data)
+                elif self._is_whiteout(oid) or \
+                        self._object_size(oid) is None:
+                    reply_fn(-2, None)
+                    return
             elif kind == "setxattr":
                 t.setattr(oid, op[1], op[2])
             elif kind == "rmxattr":
@@ -361,35 +825,314 @@ class PG:
         with self.lock:
             self.last_version += 1
             version = self.last_version
-        # version + logical size ride as xattrs on every shard
-        still_exists = not (len(msg.ops) == 1 and msg.ops[0][0] == "remove")
+        # version + logical size ride as xattrs on every shard; a
+        # whiteout tombstone still exists physically and keeps them
+        head_op = t.op_map.get(oid)
+        still_exists = head_op is None or not head_op.is_delete()
         if still_exists:
             t.setattr(oid, VERSION_ATTR, str(version).encode())
             t.setattr(oid, "_size", str(logical_size).encode())
         self.backend.submit_transaction(
             t, version, lambda: reply_fn(0, version))
 
-    # -- recovery (primary-driven) -------------------------------------
+    # -- peering: GetInfo / GetLog / GetMissing ------------------------
 
     def start_recovery(self) -> None:
-        """Ask every acting peer for its inventory; push what's missing."""
+        """Entry point from the recovery queue: run the peering rounds
+        (log-based convergence), then scan-backfill any peer whose log
+        does not overlap."""
         if not self.is_primary():
             return
+        self.start_peering()
+
+    def _my_info(self) -> dict:
+        with self.lock:
+            return {"osd": self.whoami,
+                    "last_update": list(self.pg_log.head),
+                    "log_tail": list(self.pg_log.tail)}
+
+    def start_peering(self) -> None:
+        with self.lock:
+            self.peer_state = "peering"
+            self._peer_seq += 1
+            seq = self._peer_seq
+            self._peer_infos = {self.whoami: self._my_info()}
+            targets = {osd for osd in set(self.up) | set(self.acting)
+                       if osd not in (CRUSH_ITEM_NONE, self.whoami)}
+            self._peer_wait = set(targets)
+        if not targets:
+            self._choose_authoritative(seq)
+            return
+        for osd in targets:
+            self.send_to_osd(osd, MOSDPGQuery(
+                pgid=self.pgid, from_osd=self.whoami, what="info",
+                map_epoch=self.map_epoch()))
+        # peers that never answer must not wedge the PG: after the
+        # grace, proceed with whoever responded (they re-peer via a
+        # later map change / backfill when they return)
+        self.daemon.timer.add_event_after(
+            0.5, self._peering_retry, seq, 0)
+
+    def _peer_quorum(self) -> int:
+        """How many infos (self included) we must hold before
+        activating: enough that the responder set provably intersects
+        ANY set that could have acked a write in a prior interval (the
+        role of the reference's prior-interval maybe_went_rw gate).
+        An ack set has >= min_size members out of `size`, so
+        intersection needs responders > size - min_size, i.e.
+        size - min_size + 1 — for size=3/min_size=2 that is 2; for
+        size=2/min_size=1 it is 2 (both, the price of min_size=1).
+        EC additionally needs k responders to reconstruct anything."""
+        need = self.pool.size - min(self.pool.min_size,
+                                    self.pool.size) + 1
+        if self.pool.is_erasure():
+            need = max(need, self.backend.codec.get_data_chunk_count())
+        return min(need, self.pool.size)
+
+    def _peering_retry(self, seq: int, attempt: int) -> None:
+        with self.lock:
+            if seq != self._peer_seq or self.peer_state != "peering":
+                return
+            waiting = set(self._peer_wait)
+            if not waiting:
+                return
+            if attempt >= 2 and \
+                    len(self._peer_infos) >= self._peer_quorum():
+                # enough of the prior world answered: any acked write
+                # is represented among the responders — proceed
+                self._peer_wait = set()
+                go = True
+            else:
+                go = False
+        if go:
+            self._choose_authoritative(seq)
+            return
+        # not safe to proceed (the acked state might live only on the
+        # silent peers): keep asking — the PG stays inactive, exactly
+        # like the reference's down/incomplete states, until enough
+        # peers return or a map change restarts peering
+        for osd in waiting:
+            self.send_to_osd(osd, MOSDPGQuery(
+                pgid=self.pgid, from_osd=self.whoami, what="info",
+                map_epoch=self.map_epoch()))
+        self.daemon.timer.add_event_after(
+            0.5, self._peering_retry, seq, attempt + 1)
+
+    def handle_query(self, msg) -> None:
+        """Peer side of GetInfo/GetLog."""
+        if msg.what == "info":
+            self.send_to_osd(msg.from_osd, MOSDPGNotify(
+                pgid=self.pgid, from_osd=self.whoami,
+                info=self._my_info(), map_epoch=self.map_epoch()))
+            return
+        if msg.what == "log":
+            since = tuple(msg.since)
+            with self.lock:
+                if self.pg_log.overlaps(since):
+                    entries = [(e.epoch, e.version, e.oid, e.kind,
+                                e.prior_version)
+                               for e in self.pg_log.entries_since(since)]
+                    contiguous = True
+                else:
+                    entries = self.pg_log.dump()
+                    contiguous = False
+                head = list(self.pg_log.head)
+            self.send_to_osd(msg.from_osd, MOSDPGLog(
+                pgid=self.pgid, from_osd=self.whoami, entries=entries,
+                head=head, contiguous=contiguous,
+                info=self._my_info(), map_epoch=self.map_epoch()))
+
+    def handle_notify(self, msg) -> None:
+        """Primary side: a peer's info (GetInfo reply) or its missing
+        set (GetMissing leg, after it merged our activation log)."""
+        if msg.missing:
+            shards = self.acting_shards()
+            shard = next((s for s, o in shards.items()
+                          if o == msg.from_osd), -1)
+            if not self.pool.is_erasure():
+                shard = -1
+            for oid in msg.missing:
+                self._push_object(oid, shard, msg.from_osd)
+            return
+        proceed = False
+        with self.lock:
+            if self.peer_state != "peering":
+                return
+            seq = self._peer_seq
+            self._peer_infos[msg.from_osd] = dict(msg.info)
+            self._peer_wait.discard(msg.from_osd)
+            proceed = not self._peer_wait
+        if proceed:
+            self._choose_authoritative(seq)
+
+    def _choose_authoritative(self, seq: int) -> None:
+        """GetLog: the highest last_update owns history."""
+        with self.lock:
+            if seq != self._peer_seq or self.peer_state != "peering":
+                return
+            if len(self._peer_infos) < self._peer_quorum():
+                return   # unsafe: acked state may be on silent peers
+            infos = dict(self._peer_infos)
+            my_head = self.pg_log.head
+        best_osd, best_lu = self.whoami, my_head
+        for osd, info in infos.items():
+            lu = tuple(info.get("last_update", (0, 0)))
+            if lu > best_lu:
+                best_osd, best_lu = osd, lu
+        if best_osd == self.whoami:
+            self._activate(seq)
+            return
+        self.send_to_osd(best_osd, MOSDPGQuery(
+            pgid=self.pgid, from_osd=self.whoami, what="log",
+            since=tuple(my_head), map_epoch=self.map_epoch()))
+        # the authoritative peer may die mid-GetLog: re-run the rounds
+        # (if its extra entries were acked they live on another
+        # responder too; if not, they were never acknowledged)
+        self.daemon.timer.add_event_after(
+            1.5, self._getlog_timeout, seq)
+
+    def _getlog_timeout(self, seq: int) -> None:
+        with self.lock:
+            if seq != self._peer_seq or self.peer_state != "peering":
+                return
+        self.start_peering()
+
+    def handle_log(self, msg) -> None:
+        """A log segment arrived: on a peering primary this is the
+        authoritative GetLog reply; on a replica it is the activation
+        delta from the primary."""
+        entries = [entry_from_tuple(r) for r in msg.entries]
+        if self.is_primary():
+            with self.lock:
+                if self.peer_state != "peering":
+                    return
+                seq = self._peer_seq
+                updates, divergent = self.pg_log.merge(
+                    entries, tuple(msg.head))
+                self.last_version = max(self.last_version,
+                                        self.pg_log.head[1])
+            self._persist_log_full()
+            self._apply_log_updates(updates, msg.from_osd, divergent)
+            self._activate(seq)
+            return
+        # replica: merge, then report what we now know we're missing
+        with self.lock:
+            updates, divergent = self.pg_log.merge(entries,
+                                                   tuple(msg.head))
+            self.last_version = max(self.last_version,
+                                    self.pg_log.head[1])
+        self._persist_log_full()
+        need = self._apply_log_updates(updates, msg.from_osd, divergent,
+                                       pull=False)
+        self.send_to_osd(msg.from_osd, MOSDPGNotify(
+            pgid=self.pgid, from_osd=self.whoami, missing=sorted(need),
+            map_epoch=self.map_epoch()))
+
+    def _apply_log_updates(self, updates: dict, source_osd: int,
+                           divergent: set = frozenset(),
+                           pull: bool = True) -> set:
+        """Act on a merge result: version 0 means the object must not
+        exist here (divergent create / authoritative delete) — remove
+        it; a positive version goes into `missing` and (on the
+        primary) is pulled from the authoritative peer. A DIVERGENT
+        local copy is dropped first: its version xattr was minted by a
+        dead-interval fork and must never win a version comparison
+        against the authoritative copy. Returns the set of oids still
+        missing locally."""
+        need: set = set()
+        my_shard = self.my_shard() if self.pool.is_erasure() else -1
+        for oid, version in sorted(updates.items()):
+            if version == 0 or oid in divergent:
+                txn = Transaction()
+                if self.pool.is_erasure():
+                    for s in range(self.pool.size):
+                        txn.remove(self.cid_of_shard(s), oid)
+                else:
+                    txn.remove(self.cid_of_shard(-1), oid)
+                self.store.queue_transaction(txn)
+                with self.lock:
+                    self.missing.pop(oid, None)
+                if version == 0:
+                    continue
+            if self._object_version(oid) >= version:
+                continue            # already have it (or newer)
+            need.add(oid)
+            with self.lock:
+                self.missing[oid] = version
+                self._missing_src[oid] = source_osd
+            if pull and source_osd != self.whoami:
+                self._pulling[oid] = _time.monotonic()
+                self.send_to_osd(source_osd, MOSDPGPull(
+                    pgid=self.pgid, from_osd=self.whoami,
+                    shard=my_shard, oid=oid,
+                    map_epoch=self.map_epoch()))
+        return need
+
+    def _activate(self, seq: int) -> None:
+        """Activation: ship every known peer the log delta it lacks
+        (replicas merge + report missing), fall back to scan backfill
+        for non-overlapping peers, release held client ops."""
+        with self.lock:
+            if seq != self._peer_seq or self.peer_state != "peering":
+                return
+            self.peer_state = "active"
+            infos = dict(self._peer_infos)
+            waiting, self.waiting_for_active = \
+                self.waiting_for_active, []
+            head = self.pg_log.head
         shards = self.acting_shards()
-        for shard, osd in shards.items():
-            if osd == CRUSH_ITEM_NONE or osd == self.whoami:
+        backfill = []
+        for osd, info in infos.items():
+            if osd == self.whoami:
                 continue
+            peer_lu = tuple(info.get("last_update", (0, 0)))
+            if peer_lu == head:
+                continue
+            with self.lock:
+                overlaps = self.pg_log.overlaps(peer_lu)
+                if overlaps:
+                    entries = [(e.epoch, e.version, e.oid, e.kind,
+                                e.prior_version)
+                               for e in
+                               self.pg_log.entries_since(peer_lu)]
+                else:
+                    # divergent or forked peer: ship the FULL log so
+                    # its merge can find the common point and roll its
+                    # dead-interval entries back (never the scan lane,
+                    # which would resurrect them as "newer versions")
+                    entries = self.pg_log.dump()
+                    if peer_lu < self.pg_log.tail:
+                        # pre-history peer: the log can't cover it all
+                        backfill.append(osd)
+            self.send_to_osd(osd, MOSDPGLog(
+                pgid=self.pgid, from_osd=self.whoami,
+                entries=entries, head=list(head),
+                contiguous=overlaps, map_epoch=self.map_epoch()))
+        # non-overlapping peers (or peers that never answered) converge
+        # through the scan/backfill lane
+        silent = [osd for s, osd in shards.items()
+                  if osd not in (CRUSH_ITEM_NONE, self.whoami)
+                  and osd not in infos]
+        for osd in set(backfill + silent):
+            shard = next((s for s, o in shards.items() if o == osd), -1)
             self.send_to_osd(osd, MOSDPGScan(
                 pgid=self.pgid, from_osd=self.whoami, shard=shard,
                 op="request", map_epoch=self.map_epoch()))
-        # also reconcile our own shard(s) synchronously
+        # reconcile our own shard(s) (objects only we lost)
         my_inv = self._local_inventory(self.my_shard())
         self._reconcile_inventory(self.my_shard(), self.whoami, my_inv)
+        for fn in waiting:
+            fn()
 
     def _local_inventory(self, shard: int) -> dict:
         cid = self.cid_of_shard(shard)
         inv = {}
         for oid in self.store.list_objects(cid):
+            if oid == META_OID:
+                # the durable-log object is per-OSD state: pushing it
+                # would graft OUR log head onto a replica that has
+                # none of the data behind it
+                continue
             try:
                 raw = self.store.getattr(cid, oid, VERSION_ATTR)
                 inv[oid] = int(raw) if raw else 0
@@ -435,6 +1178,8 @@ class PG:
         cid = self.cid_of_shard(shard)
         inv = {}
         for oid in self.store.list_objects(cid):
+            if oid == META_OID:
+                continue   # per-OSD durable log, not replicated data
             try:
                 data = self.store.read(cid, oid)
                 raw = self.store.getattr(cid, oid, VERSION_ATTR)
@@ -798,34 +1543,49 @@ class PG:
         # versionless push (source object vanished mid-recovery) must
         # never clobber versioned local data
         self._pulling.pop(msg.oid, None)
-        if msg.delete:
-            # divergent-delete propagation: drop our ghost copy unless
-            # we hold a strictly newer (recreated) version — and record
-            # the delete so that if WE later become primary we can
-            # propagate it instead of pulling the ghost back
-            with self.lock:
-                if msg.version > self._deleted_log.get(msg.oid, -1):
-                    self._deleted_log.pop(msg.oid, None)
-                    self._deleted_log[msg.oid] = msg.version
-            if local_v >= 0 and local_v <= msg.version:
-                txn = Transaction()
-                txn.remove(cid, msg.oid)
-                self.store.queue_transaction(txn)
-            return
-        # scrub repairs (force) may overwrite SAME-version bitrot; no
-        # push — forced or not — may ever roll back a strictly newer
-        # (acked) local copy
-        if local_v >= 0 and (local_v > msg.version
-                             or (local_v == msg.version
-                                 and not msg.force)):
-            return
-        txn = Transaction()
-        txn.remove(cid, msg.oid)
-        txn.touch(cid, msg.oid)
-        if msg.data:
-            txn.write(cid, msg.oid, 0, msg.data)
-        for name, val in msg.attrs.items():
-            txn.setattr(cid, msg.oid, name, val)
-        if msg.omap:
-            txn.omap_setkeys(cid, msg.oid, msg.omap)
-        self.store.queue_transaction(txn)
+        waiters = []
+        with self.lock:
+            if self.missing.get(msg.oid, 0) <= msg.version:
+                self.missing.pop(msg.oid, None)
+                self._missing_src.pop(msg.oid, None)
+                waiters = self._missing_waiters.pop(msg.oid, [])
+        try:
+            if msg.delete:
+                # divergent-delete propagation: drop our ghost copy
+                # unless we hold a strictly newer (recreated) version —
+                # and record the delete so that if WE later become
+                # primary we can propagate it instead of pulling the
+                # ghost back
+                with self.lock:
+                    if msg.version > self._deleted_log.get(msg.oid, -1):
+                        self._deleted_log.pop(msg.oid, None)
+                        self._deleted_log[msg.oid] = msg.version
+                if local_v >= 0 and local_v <= msg.version:
+                    txn = Transaction()
+                    txn.remove(cid, msg.oid)
+                    self.store.queue_transaction(txn)
+                return
+            # scrub repairs (force) may overwrite SAME-version bitrot;
+            # no push — forced or not — may ever roll back a strictly
+            # newer (acked) local copy
+            if local_v >= 0 and (local_v > msg.version
+                                 or (local_v == msg.version
+                                     and not msg.force)):
+                return
+            txn = Transaction()
+            txn.remove(cid, msg.oid)
+            txn.touch(cid, msg.oid)
+            if msg.data:
+                txn.write(cid, msg.oid, 0, msg.data)
+            for name, val in msg.attrs.items():
+                txn.setattr(cid, msg.oid, name, val)
+            if msg.omap:
+                txn.omap_setkeys(cid, msg.oid, msg.omap)
+            self.store.queue_transaction(txn)
+        finally:
+            # the recovered object unblocks any ops held on it
+            for fn in waiters:
+                try:
+                    fn()
+                except Exception:
+                    pass

@@ -39,9 +39,7 @@ class ReplicatedBackend:
         tid = next(self._tids)
         txn = self._physical_txn(pg_txn)
         peers = [o for o in self.pg.acting_osds() if o >= 0]
-        log_entries = [(at_version, oid,
-                        "delete" if op.is_delete() else "modify")
-                       for oid, op in pg_txn.op_map.items()]
+        log_entries = self.pg.mint_log_entries(pg_txn.op_map, at_version)
         op = _Inflight(tid, on_commit, peers)
         with self.lock:
             self.inflight[tid] = op
@@ -95,7 +93,9 @@ class ReplicatedBackend:
     def handle_rep_op(self, msg, local: bool = False) -> None:
         txn = Transaction()
         txn.ops = list(msg.txn_ops)
-        self.pg.log_operation(msg.log_entries, msg.at_version, -1)
+        # log keys ride the same store transaction as the data
+        self.pg.log_operation(msg.log_entries, msg.at_version, -1,
+                              txn=txn)
 
         def on_commit():
             reply = MOSDRepOpReply(pgid=self.pg.pgid,

@@ -143,6 +143,14 @@ def build_parser() -> argparse.ArgumentParser:
     for name in ("rm", "stat"):
         c = sub.add_parser(name)
         c.add_argument("obj")
+    for name in ("mksnap", "rmsnap"):
+        c = sub.add_parser(name)
+        c.add_argument("snap")
+    rb = sub.add_parser("rollback")
+    rb.add_argument("obj")
+    rb.add_argument("snap")
+    lsn = sub.add_parser("listsnaps")
+    lsn.add_argument("obj")
     b = sub.add_parser("bench")
     b.add_argument("seconds", type=float)
     b.add_argument("mode", choices=["write", "seq"])
@@ -191,6 +199,30 @@ def main(argv=None) -> int:
         if args.op == "stat":
             st = ioctx.stat(args.obj)
             sys.stdout.write("%s size %d\n" % (args.obj, st["size"]))
+            return 0
+        if args.op == "mksnap":
+            sid = ioctx.create_snap(args.snap)
+            sys.stdout.write("created pool %s snap %s (%d)\n"
+                             % (args.pool, args.snap, sid))
+            return 0
+        if args.op == "rmsnap":
+            ioctx.remove_snap(args.snap)
+            sys.stdout.write("removed pool %s snap %s\n"
+                             % (args.pool, args.snap))
+            return 0
+        if args.op == "rollback":
+            ioctx.rollback(args.obj, args.snap)
+            sys.stdout.write("rolled back %s to %s\n"
+                             % (args.obj, args.snap))
+            return 0
+        if args.op == "listsnaps":
+            info = ioctx.list_snaps(args.obj)
+            sys.stdout.write("%s:\n" % args.obj)
+            for c in info["clones"]:
+                sys.stdout.write("  clone %d snaps %s size %d\n"
+                                 % (c["id"], c["snaps"], c["size"]))
+            sys.stdout.write("  head exists: %s\n"
+                             % info["head_exists"])
             return 0
         if args.op == "bench":
             if args.mode == "write":

@@ -1,0 +1,245 @@
+"""Object snapshots: SnapContext COW, snap reads, rollback, trim.
+
+The reference semantics under test (PrimaryLogPG::make_writeable +
+snapset machinery, librados snap API): a write whose SnapContext names
+new snaps preserves the pre-write head as a clone; reads at a snap id
+resolve to the covering clone; rollback rewrites the head from it;
+removing a snap trims clones nothing references."""
+
+from __future__ import annotations
+
+import pytest
+
+from ceph_tpu.client.rados import RadosError
+
+from .cluster_util import MiniCluster, wait_until
+
+FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+        "mon_osd_down_out_interval": 1.0,
+        "paxos_propose_interval": 0.02}
+
+
+@pytest.fixture(scope="class")
+def cluster():
+    c = MiniCluster(num_mons=1, num_osds=3, conf_overrides=FAST).start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="class")
+def ioctx(cluster):
+    client = cluster.client()
+    cluster.create_replicated_pool(client, "snappool", size=3, pg_num=4)
+    return client.open_ioctx("snappool")
+
+
+class TestPoolSnaps:
+    def test_cow_and_snap_read(self, ioctx):
+        ioctx.write_full("obj", b"version-one")
+        s1 = ioctx.create_snap("s1")
+        ioctx.write_full("obj", b"version-TWO!")
+        assert ioctx.read("obj") == b"version-TWO!"
+        ioctx.snap_set_read(s1)
+        try:
+            assert ioctx.read("obj") == b"version-one"
+        finally:
+            ioctx.snap_set_read(0)
+
+    def test_list_snaps(self, ioctx):
+        ioctx.write_full("ls", b"aaa")
+        s = ioctx.create_snap("ls-snap")
+        ioctx.write_full("ls", b"bbbb")
+        info = ioctx.list_snaps("ls")
+        assert info["head_exists"]
+        assert [c["id"] for c in info["clones"]] == [s]
+        assert s in info["clones"][0]["snaps"]
+        assert info["clones"][0]["size"] == 3
+
+    def test_rollback(self, ioctx):
+        ioctx.write_full("rb", b"keep-me")
+        ioctx.create_snap("rb-snap")
+        ioctx.write_full("rb", b"overwritten")
+        ioctx.rollback("rb", "rb-snap")
+        assert ioctx.read("rb") == b"keep-me"
+
+    def test_snapshot_of_unmodified_object_reads_head(self, ioctx):
+        ioctx.write_full("calm", b"steady")
+        ioctx.create_snap("calm-snap")
+        # no write after the snap: no clone; snap reads serve the head
+        sid = ioctx.lookup_snap("calm-snap")
+        ioctx.snap_set_read(sid)
+        try:
+            assert ioctx.read("calm") == b"steady"
+        finally:
+            ioctx.snap_set_read(0)
+        assert ioctx.list_snaps("calm")["clones"] == []
+
+    def test_delete_leaves_whiteout_snap_still_readable(self, ioctx):
+        ioctx.write_full("doomed", b"survives-in-snap")
+        s = ioctx.create_snap("del-snap")
+        ioctx.write_full("doomed", b"newer")   # forces the clone
+        ioctx.remove("doomed")
+        with pytest.raises(RadosError):
+            ioctx.read("doomed")
+        ioctx.snap_set_read(s)
+        try:
+            assert ioctx.read("doomed") == b"survives-in-snap"
+        finally:
+            ioctx.snap_set_read(0)
+        # recreate over the whiteout
+        ioctx.write_full("doomed", b"reborn")
+        assert ioctx.read("doomed") == b"reborn"
+
+    def test_multiple_snap_levels(self, ioctx):
+        ioctx.write_full("multi", b"one")
+        s1 = ioctx.create_snap("m1")
+        ioctx.write_full("multi", b"two")
+        s2 = ioctx.create_snap("m2")
+        ioctx.write_full("multi", b"three")
+        for snap_id, want in ((s1, b"one"), (s2, b"two")):
+            ioctx.snap_set_read(snap_id)
+            try:
+                assert ioctx.read("multi") == want
+            finally:
+                ioctx.snap_set_read(0)
+        assert ioctx.read("multi") == b"three"
+
+
+class TestSnapTrim:
+    def test_rmsnap_trims_unreferenced_clones(self, cluster, ioctx):
+        ioctx.write_full("trimmed", b"old-bytes")
+        ioctx.create_snap("t-snap")
+        ioctx.write_full("trimmed", b"new-bytes")
+        assert len(ioctx.list_snaps("trimmed")["clones"]) == 1
+        ioctx.remove_snap("t-snap")
+
+        def clone_gone():
+            info = ioctx.list_snaps("trimmed")
+            if info["clones"]:
+                return False
+            # and the clone objects really left every OSD store
+            for osd in cluster.osds.values():
+                for cid in osd.store.list_collections():
+                    for oid in osd.store.list_objects(cid):
+                        if isinstance(oid, str) and \
+                                oid.startswith("trimmed@"):
+                            return False
+            return True
+        assert wait_until(clone_gone, timeout=15)
+        assert ioctx.read("trimmed") == b"new-bytes"
+
+
+class TestSelfManagedSnaps:
+    def test_selfmanaged_snap_context(self, ioctx):
+        ioctx.write_full("sm", b"gen0")
+        sid = ioctx.selfmanaged_snap_create()
+        ioctx.set_snap_context(sid, [sid])
+        ioctx.write_full("sm", b"gen1")
+        ioctx.snap_set_read(sid)
+        try:
+            assert ioctx.read("sm") == b"gen0"
+        finally:
+            ioctx.snap_set_read(0)
+        assert ioctx.read("sm") == b"gen1"
+        ioctx.set_snap_context(0, [])
+
+
+class TestSnapRecovery:
+    def test_clones_survive_osd_death(self, cluster, ioctx):
+        """Clones are first-class objects: recovery pushes them like
+        heads, so snap reads survive an OSD loss (the EC-thrash-with-
+        snaps workload shape, qa/erasure-code thrash yamls)."""
+        ioctx.write_full("recov", b"snapped-state")
+        s = ioctx.create_snap("r-snap")
+        ioctx.write_full("recov", b"latest-state")
+        osd_id = 2
+        cluster.stop_osd(osd_id)
+        assert wait_until(
+            lambda: not cluster.leader().osdmon.osdmap.is_up(osd_id),
+            timeout=10)
+        assert ioctx.read("recov") == b"latest-state"
+        ioctx.snap_set_read(s)
+        try:
+            assert ioctx.read("recov") == b"snapped-state"
+        finally:
+            ioctx.snap_set_read(0)
+        cluster.revive_osd(osd_id)
+        assert wait_until(cluster.all_osds_up, timeout=15)
+        ioctx.snap_set_read(s)
+        try:
+            assert ioctx.read("recov") == b"snapped-state"
+        finally:
+            ioctx.snap_set_read(0)
+
+
+class TestWatchNotify:
+    def test_notify_reaches_watchers_with_replies(self, cluster, ioctx):
+        import threading
+        got = []
+        ev = threading.Event()
+
+        def on_notify(notify_id, payload):
+            got.append(payload)
+            ev.set()
+            return b"pong:" + payload
+
+        ioctx.write_full("watched", b"x")
+        cookie = ioctx.watch("watched", on_notify)
+        try:
+            result = ioctx.notify("watched", b"ping")
+            assert ev.wait(5)
+            assert got == [b"ping"]
+            assert result["timed_out"] == []
+            assert list(result["replies"].values()) == [b"pong:ping"]
+        finally:
+            ioctx.unwatch("watched", cookie)
+        # after unwatch, notify completes with no watchers
+        result = ioctx.notify("watched", b"again")
+        assert result == {"replies": {}, "timed_out": []}
+
+    def test_two_clients_watch(self, cluster, ioctx):
+        import threading
+        client2 = cluster.client()
+        io2 = client2.open_ioctx("snappool")
+        hits = []
+        ev = threading.Event()
+
+        def cb2(notify_id, payload):
+            hits.append(payload)
+            ev.set()
+            return b"c2"
+
+        ioctx.write_full("shared-watch", b"x")
+        cookie2 = io2.watch("shared-watch", cb2)
+        try:
+            result = ioctx.notify("shared-watch", b"hello")
+            assert ev.wait(5)
+            assert hits == [b"hello"]
+            assert result["timed_out"] == []
+        finally:
+            io2.unwatch("shared-watch", cookie2)
+
+
+class TestSnapEdges:
+    def test_read_at_snap_before_birth_is_enoent(self, ioctx):
+        """A snap taken before an object existed must read ENOENT even
+        after later writes create clones (coverage-list resolution)."""
+        pre = ioctx.create_snap("pre-birth")
+        ioctx.write_full("newborn", b"first")
+        ioctx.create_snap("post-birth")
+        ioctx.write_full("newborn", b"second")   # clone for post-birth
+        ioctx.snap_set_read(pre)
+        try:
+            with pytest.raises(RadosError):
+                ioctx.read("newborn")
+        finally:
+            ioctx.snap_set_read(0)
+
+    def test_pool_listing_hides_internal_objects(self, ioctx):
+        ioctx.write_full("visible", b"x")
+        ioctx.create_snap("hide-snap")
+        ioctx.write_full("visible", b"y")   # creates a clone object
+        names = ioctx.list_objects()
+        assert "visible" in names
+        assert not any("@" in n for n in names)
+        assert not any(n.startswith("__pg_") for n in names)

@@ -183,3 +183,87 @@ class TestRBD:
         assert img.read(0, 1000) == b"\xaa" * 1000
         # reopening sees the persisted size
         assert Image(ioctx, "rdisk").size() == 1 << 18
+
+
+class TestRBDSnapshots:
+    BS = 1 << 16   # small order for cheap tests
+
+    def test_snap_create_read_rollback(self, ctx):
+        from ceph_tpu.client.rbd import RBD, Image
+        _, ioctx = ctx
+        RBD.create(ioctx, "snapimg", 4 * self.BS, order=16)
+        img = Image(ioctx, "snapimg")
+        img.write(0, b"A" * self.BS)
+        img.write(self.BS, b"B" * self.BS)
+        img.snap_create("s1")
+        img.write(0, b"X" * self.BS)          # COW after the snap
+        assert img.read(0, self.BS) == b"X" * self.BS
+        img.snap_rollback("s1")
+        assert img.read(0, self.BS) == b"A" * self.BS
+        assert img.read(self.BS, self.BS) == b"B" * self.BS
+        assert [s["name"] for s in img.snap_list()] == ["s1"]
+
+    def test_snap_rollback_removes_post_snap_blocks(self, ctx):
+        from ceph_tpu.client.rbd import RBD, Image
+        _, ioctx = ctx
+        RBD.create(ioctx, "snapimg2", 4 * self.BS, order=16)
+        img = Image(ioctx, "snapimg2")
+        img.write(0, b"a" * self.BS)
+        img.snap_create("pre")
+        img.write(2 * self.BS, b"late" * 4)   # block born after snap
+        img.snap_rollback("pre")
+        assert img.read(2 * self.BS, 16) == b"\0" * 16
+        assert img.read(0, self.BS) == b"a" * self.BS
+
+    def test_snap_remove_trims(self, ctx):
+        from ceph_tpu.client.rbd import RBD, Image
+        _, ioctx = ctx
+        RBD.create(ioctx, "snapimg3", 2 * self.BS, order=16)
+        img = Image(ioctx, "snapimg3")
+        img.write(0, b"one" * 10)
+        img.snap_create("gone")
+        img.write(0, b"two" * 10)
+        img.snap_remove("gone")
+        assert [s for s in img.snap_list()] == []
+        assert img.read(0, 30) == b"two" * 10
+
+
+class TestRBDClone:
+    BS = 1 << 16
+
+    def test_clone_cow_and_flatten(self, ctx):
+        from ceph_tpu.client.rbd import Image, RBD
+        _, ioctx = ctx
+        RBD.create(ioctx, "parent", 4 * self.BS, order=16)
+        parent = Image(ioctx, "parent")
+        parent.write(0, b"P" * self.BS)
+        parent.write(self.BS, b"Q" * self.BS)
+        parent.snap_create("base")
+        parent.write(0, b"Z" * self.BS)       # parent diverges after
+
+        RBD.clone(ioctx, "parent", "base", "child")
+        child = Image(ioctx, "child")
+        # the child sees the parent AT THE SNAP, not its head
+        assert child.read(0, self.BS) == b"P" * self.BS
+        assert child.read(self.BS, self.BS) == b"Q" * self.BS
+        # child writes COW locally; the parent is untouched
+        child.write(0, b"C" * 100)
+        assert child.read(0, 100) == b"C" * 100
+        assert child.read(100, self.BS - 100) == b"P" * (self.BS - 100)
+        assert parent.read(0, self.BS) == b"Z" * self.BS
+
+        child.flatten()
+        assert Image(ioctx, "child").stat()["parent"] is None
+        assert child.read(self.BS, self.BS) == b"Q" * self.BS
+
+    def test_clone_discard_masks_parent(self, ctx):
+        from ceph_tpu.client.rbd import Image, RBD
+        _, ioctx = ctx
+        RBD.create(ioctx, "p2", 2 * self.BS, order=16)
+        parent = Image(ioctx, "p2")
+        parent.write(0, b"M" * self.BS)
+        parent.snap_create("b")
+        RBD.clone(ioctx, "p2", "b", "c2")
+        child = Image(ioctx, "c2")
+        child.discard(0, self.BS)
+        assert child.read(0, self.BS) == b"\0" * self.BS

@@ -253,3 +253,65 @@ class TestEIOInjection:
             assert data == payload, "EIO was not reconstructed around"
         finally:
             cluster.stop()
+
+
+class TestSnapThrash:
+    def test_snaps_and_rollbacks_survive_osd_churn(self):
+        """The EC-thrash-with-snaps workload shape
+        (qa/erasure-code/ec-rados-plugin=jerasure*.yaml runs snap_create/
+        snap_remove/rollback under churn): concurrent snaps, writes and
+        rollbacks with OSDs dying must preserve every acked state."""
+        from .cluster_util import MiniCluster, wait_until
+        from .thrasher import Thrasher
+        FAST = {"osd_heartbeat_interval": 0.1,
+                "osd_heartbeat_grace": 0.6,
+                "mon_osd_down_out_interval": 1.0,
+                "paxos_propose_interval": 0.02}
+        cluster = MiniCluster(num_mons=1, num_osds=5,
+                              conf_overrides=FAST).start()
+        try:
+            client = cluster.client()
+            cluster.create_replicated_pool(client, "snapthrash",
+                                           size=3, pg_num=4)
+            ioctx = client.open_ioctx("snapthrash")
+            thrasher = Thrasher(cluster, seed=11, min_in=3,
+                                interval=0.4)
+            thrasher.start()
+            import random
+            rng = random.Random(3)
+            snaps: dict[str, dict[str, bytes]] = {}   # snap -> oid-> data
+            state: dict[str, bytes] = {}
+            try:
+                for step in range(30):
+                    action = rng.random()
+                    oid = "sobj-%d" % rng.randrange(4)
+                    if action < 0.5 or not snaps:
+                        data = bytes(rng.randbytes(256)) * 4
+                        ioctx.write_full(oid, data, timeout=60)
+                        state[oid] = data
+                    elif action < 0.7 and len(snaps) < 4:
+                        name = "ts-%d" % step
+                        ioctx.create_snap(name)
+                        snaps[name] = dict(state)
+                    else:
+                        name = rng.choice(sorted(snaps))
+                        frozen = snaps[name]
+                        if oid in frozen:
+                            ioctx.rollback(oid, name)
+                            state[oid] = frozen[oid]
+            finally:
+                thrasher.stop_and_heal(timeout=60)
+            # every acked head state is intact
+            for oid, want in state.items():
+                assert ioctx.read(oid) == want, oid
+            # and every snapshot still reads frozen-in-time data
+            for name, frozen in snaps.items():
+                sid = ioctx.lookup_snap(name)
+                ioctx.snap_set_read(sid)
+                try:
+                    for oid, want in frozen.items():
+                        assert ioctx.read(oid) == want, (name, oid)
+                finally:
+                    ioctx.snap_set_read(0)
+        finally:
+            cluster.stop()
