@@ -204,10 +204,16 @@ class Image:
             raise ImageNotFound("%s@%s" % (self.name, snap_name))
         snap_id, snap_size = snap["id"], snap["size"]
         self._apply_snapc()
+        parented = self.meta.get("parent") is not None
         nblocks = -(-max(self._size, snap_size) // self.block_size)
         for blk in range(nblocks):
             oid = _data_oid(self.name, blk)
             if blk * self.block_size >= snap_size:
+                if parented:
+                    # mask, don't remove: removing would re-expose the
+                    # parent's bytes through the COW fall-through
+                    self.ioctx.write(oid, b"\0" * self.block_size, 0)
+                    continue
                 try:
                     self.ioctx.remove(oid)
                 except OSError as e:

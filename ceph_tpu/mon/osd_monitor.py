@@ -243,9 +243,11 @@ class OSDMonitor:
         snap = cmd.get("snap", "")
         if not snap:
             return -22, "snap name required", None
-        if snap in pool.snaps:
-            return -17, "snap %s already exists" % snap, None
         staged = self._pending_pool(pool)
+        if snap in staged.snaps:
+            # checked against the PENDING copy: two mksnaps of one name
+            # in the same propose window must not both succeed
+            return -17, "snap %s already exists" % snap, None
         staged.snap_seq += 1
         staged.snaps = dict(staged.snaps)
         staged.snaps[snap] = staged.snap_seq

@@ -222,6 +222,7 @@ class MOSDPGNotify(Message):
     info: dict = field(default_factory=dict)
     missing: list = field(default_factory=list)   # [oid, ...]
     map_epoch: int = 0
+    kind: str = "info"             # info | missing (GetMissing reply)
 
 
 @dataclass

@@ -196,9 +196,10 @@ class PG:
         commit, atomic, like the reference writing pg log keys in the
         op's ObjectStore::Transaction."""
         entries = [entry_from_tuple(t) for t in log_entries]
+        dropped: list = []
         with self.lock:
             for entry in entries:
-                self.pg_log.append(entry)
+                dropped.extend(self.pg_log.append(entry))
                 self.missing.pop(entry.oid, None)
                 v, oid, kind = entry.version, entry.oid, entry.kind
                 if kind == "delete":
@@ -220,8 +221,13 @@ class PG:
                 for e in entries}
             if kv:
                 txn.omap_setkeys(cid, META_OID, kv)
+            if dropped:
+                # the durable omap trims with the in-memory log, or it
+                # (and the log reloaded at restart) grows forever
+                txn.omap_rmkeys(cid, META_OID,
+                                [self._log_key(e) for e in dropped])
         else:
-            self._persist_log_delta(entries)
+            self._persist_log_delta(entries, dropped)
 
     # -- durable log (meta object omap, the reference's pg log omap) ---
 
@@ -232,7 +238,7 @@ class PG:
     def _log_key(entry) -> str:
         return "log:%016d.%016d" % (entry.epoch, entry.version)
 
-    def _persist_log_delta(self, entries) -> None:
+    def _persist_log_delta(self, entries, dropped=()) -> None:
         txn = Transaction()
         cid = self._meta_cid()
         txn.touch(cid, META_OID)
@@ -241,6 +247,9 @@ class PG:
             for e in entries}
         if kv:
             txn.omap_setkeys(cid, META_OID, kv)
+        if dropped:
+            txn.omap_rmkeys(cid, META_OID,
+                            [self._log_key(e) for e in dropped])
         self.store.queue_transaction(txn)
 
     def _persist_log_full(self) -> None:
@@ -345,24 +354,29 @@ class PG:
             return
         # an object we know we're missing must be recovered before any
         # op touches it — serving the local copy would expose stale
-        # bytes for an acked write (PrimaryLogPG wait_for_missing)
+        # bytes for an acked write (PrimaryLogPG wait_for_missing).
+        # Register-and-return under ONE lock hold: a second check after
+        # registering would race a concurrent push into running the op
+        # twice (once via the waiter, once here).
+        parked = False
         repull = None
         with self.lock:
             if msg.oid in self.missing:
+                parked = True
                 self._missing_waiters.setdefault(msg.oid, []).append(
                     lambda: self.do_op(msg, reply_fn))
                 now = _time.monotonic()
                 if now - self._pulling.get(msg.oid, -1e9) > 2.0:
                     self._pulling[msg.oid] = now
                     repull = self._missing_src.get(msg.oid)
-        if repull is not None:
-            self.send_to_osd(repull, MOSDPGPull(
-                pgid=self.pgid, from_osd=self.whoami,
-                shard=self.my_shard() if self.pool.is_erasure() else -1,
-                oid=msg.oid, map_epoch=self.map_epoch()))
-        with self.lock:
-            if msg.oid in self.missing:
-                return
+        if parked:
+            if repull is not None:
+                self.send_to_osd(repull, MOSDPGPull(
+                    pgid=self.pgid, from_osd=self.whoami,
+                    shard=(self.my_shard() if self.pool.is_erasure()
+                           else -1),
+                    oid=msg.oid, map_epoch=self.map_epoch()))
+            return
         if any(op[0] == "call" for op in msg.ops):
             self._do_call_op(msg, reply_fn)
             return
@@ -944,8 +958,10 @@ class PG:
 
     def handle_notify(self, msg) -> None:
         """Primary side: a peer's info (GetInfo reply) or its missing
-        set (GetMissing leg, after it merged our activation log)."""
-        if msg.missing:
+        set (GetMissing leg, after it merged our activation log) —
+        distinguished by the kind flag, because an EMPTY missing
+        report must not masquerade as an info reply."""
+        if getattr(msg, "kind", "info") == "missing":
             shards = self.acting_shards()
             shard = next((s for s, o in shards.items()
                           if o == msg.from_osd), -1)
@@ -982,6 +998,11 @@ class PG:
         if best_osd == self.whoami:
             self._activate(seq)
             return
+        with self.lock:
+            # only THIS peer's reply may serve as the authoritative
+            # log for this round — a delayed MOSDPGLog from an old
+            # interval must not short-circuit peering
+            self._getlog_from = best_osd
         self.send_to_osd(best_osd, MOSDPGQuery(
             pgid=self.pgid, from_osd=self.whoami, what="log",
             since=tuple(my_head), map_epoch=self.map_epoch()))
@@ -1006,6 +1027,9 @@ class PG:
             with self.lock:
                 if self.peer_state != "peering":
                     return
+                if msg.from_osd != getattr(self, "_getlog_from", None):
+                    return   # not the authoritative reply we asked for
+                self._getlog_from = None
                 seq = self._peer_seq
                 updates, divergent = self.pg_log.merge(
                     entries, tuple(msg.head))
@@ -1026,7 +1050,7 @@ class PG:
                                        pull=False)
         self.send_to_osd(msg.from_osd, MOSDPGNotify(
             pgid=self.pgid, from_osd=self.whoami, missing=sorted(need),
-            map_epoch=self.map_epoch()))
+            kind="missing", map_epoch=self.map_epoch()))
 
     def _apply_log_updates(self, updates: dict, source_osd: int,
                            divergent: set = frozenset(),

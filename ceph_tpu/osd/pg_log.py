@@ -72,17 +72,23 @@ class PGLog:
     def __len__(self):
         return len(self.entries)
 
-    def append(self, entry: LogEntry) -> None:
+    def append(self, entry: LogEntry) -> list:
+        """Returns the entries trimmed off the tail (so the durable
+        omap can drop their keys — the on-disk log must not grow
+        unboundedly while the in-memory one caps at CAP)."""
         self.entries.append(entry)
         if entry.ev > self.head:
             self.head = entry.ev
-        self._trim()
+        return self._trim()
 
-    def _trim(self) -> None:
+    def _trim(self) -> list:
+        dropped: list = []
         if len(self.entries) > self.CAP:
             drop = len(self.entries) - self.CAP
+            dropped = self.entries[:drop]
             self.entries = self.entries[drop:]
             self.tail = self.entries[0].ev
+        return dropped
 
     def has_ev(self, ev: tuple) -> bool:
         return any(e.ev == tuple(ev) for e in self.entries)
@@ -155,6 +161,7 @@ class PGLog:
         auth_latest: dict = {}
         for e in auth_entries:
             auth_latest[e.oid] = e
+        reverted: set = set()
         for e in divergent:
             ae = auth_latest.get(e.oid)
             if ae is not None and ae.ev <= auth_head:
@@ -162,11 +169,13 @@ class PGLog:
                 # truth for the object
                 updates[e.oid] = 0 if ae.kind == "delete" else \
                     ae.version
-            else:
+            elif e.oid not in reverted:
                 # the object's only history beyond common is divergent:
-                # revert to its state AT common — prior_version if the
-                # divergent entry recorded one, else it must not exist
+                # revert to its state AT common — the EARLIEST divergent
+                # entry's prior_version (later divergent entries' priors
+                # are themselves divergent versions nobody can serve)
                 updates[e.oid] = e.prior_version
+                reverted.add(e.oid)
         # drop divergent entries from our log (rewind)
         self.entries = [e for e in self.entries
                         if e.ev <= common or e.ev in auth_evs]
@@ -196,3 +205,4 @@ class PGLog:
         if self.entries:
             self.head = self.entries[-1].ev
             self.tail = self.entries[0].ev
+        self._trim()

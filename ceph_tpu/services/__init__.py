@@ -1,0 +1,9 @@
+"""Services on RADOS (SURVEY §1 layer 9): the object gateway.
+
+  rgw    S3-subset REST gateway over client/rados.py — the role of
+         src/rgw/rgw_rest_s3.cc at framework scale.
+"""
+
+from .rgw import RGWServer, S3Error
+
+__all__ = ["RGWServer", "S3Error"]

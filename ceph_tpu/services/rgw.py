@@ -1,0 +1,316 @@
+"""rgw — S3-subset object gateway over RADOS.
+
+Role of the reference's radosgw REST front
+(/root/reference/src/rgw/rgw_rest_s3.cc + rgw_op.cc, bucket index per
+rgw_bucket.cc): an HTTP server that maps the S3 object API onto rados
+objects, with bucket indexes kept in omap — the same layering, at
+framework scale:
+
+  service GET  /                 list buckets (XML)
+  bucket  PUT  /<bucket>         create
+          GET  /<bucket>         list objects (prefix= & max-keys=)
+          DELETE /<bucket>       remove (409 unless empty)
+  object  PUT  /<bucket>/<key>   store (returns ETag = md5, like S3)
+          GET  /<bucket>/<key>   fetch
+          HEAD /<bucket>/<key>   stat
+          DELETE /<bucket>/<key>
+
+Layout in the backing pool: bucket roster in the omap of
+`.rgw.buckets`; per-bucket index object `.bucket.index.<bucket>` whose
+omap maps key -> {size, etag, mtime} (the reference's bucket index
+shards, unsharded here); object data in `<bucket>/<key>`.
+
+Auth: AWS signature v2 ("Authorization: AWS <access>:<sig>",
+HMAC-SHA1 over the canonical StringToSign — rgw_auth_s3.cc role).
+Anonymous access is refused when credentials are configured.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlsplit
+from xml.sax.saxutils import escape
+
+from .. import encoding
+
+__all__ = ["RGWServer", "S3Error"]
+
+ROSTER_OID = ".rgw.buckets"
+
+
+def _index_oid(bucket: str) -> str:
+    return ".bucket.index.%s" % bucket
+
+
+def _data_oid(bucket: str, key: str) -> str:
+    return "%s/%s" % (bucket, key)
+
+
+class S3Error(Exception):
+    def __init__(self, status: int, code: str, message: str = ""):
+        super().__init__(code)
+        self.status = status
+        self.code = code
+        self.message = message or code
+
+    def body(self) -> bytes:
+        return ("<?xml version=\"1.0\" encoding=\"UTF-8\"?>"
+                "<Error><Code>%s</Code><Message>%s</Message></Error>"
+                % (self.code, self.message)).encode()
+
+
+class _Store:
+    """The rados-facing half (rgw_op.cc's RGWOp execute bodies)."""
+
+    def __init__(self, ioctx):
+        self.ioctx = ioctx
+        self._lock = threading.Lock()
+
+    # -- buckets -------------------------------------------------------
+
+    def list_buckets(self) -> list[str]:
+        try:
+            return sorted(self.ioctx.omap_get(ROSTER_OID))
+        except OSError:
+            return []
+
+    def create_bucket(self, bucket: str) -> None:
+        with self._lock:
+            if bucket in self.list_buckets():
+                raise S3Error(409, "BucketAlreadyExists", bucket)
+            self.ioctx.write_full(_index_oid(bucket), b"")
+            self.ioctx.omap_set(ROSTER_OID, {bucket: b"1"})
+
+    def _require_bucket(self, bucket: str) -> None:
+        if bucket not in self.list_buckets():
+            raise S3Error(404, "NoSuchBucket", bucket)
+
+    def delete_bucket(self, bucket: str) -> None:
+        with self._lock:
+            self._require_bucket(bucket)
+            if self.list_objects(bucket):
+                raise S3Error(409, "BucketNotEmpty", bucket)
+            self.ioctx.remove(_index_oid(bucket))
+            self.ioctx.omap_rm_keys(ROSTER_OID, [bucket])
+
+    # -- objects -------------------------------------------------------
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     max_keys: int = 1000) -> list[dict]:
+        self._require_bucket(bucket)
+        try:
+            index = self.ioctx.omap_get(_index_oid(bucket))
+        except OSError:
+            return []
+        out = []
+        for key in sorted(index):
+            if prefix and not key.startswith(prefix):
+                continue
+            meta = encoding.decode_any(index[key])
+            meta["key"] = key
+            out.append(meta)
+            if len(out) >= max_keys:
+                break
+        return out
+
+    def put_object(self, bucket: str, key: str, data: bytes) -> str:
+        self._require_bucket(bucket)
+        etag = hashlib.md5(data).hexdigest()
+        self.ioctx.write_full(_data_oid(bucket, key), data)
+        self.ioctx.omap_set(_index_oid(bucket), {
+            key: encoding.encode_any({
+                "size": len(data), "etag": etag,
+                "mtime": time.time()})})
+        return etag
+
+    def head_object(self, bucket: str, key: str) -> dict:
+        self._require_bucket(bucket)
+        try:
+            index = self.ioctx.omap_get(_index_oid(bucket))
+            raw = index[key]
+        except (OSError, KeyError):
+            raise S3Error(404, "NoSuchKey", key)
+        return encoding.decode_any(raw)
+
+    def get_object(self, bucket: str, key: str) -> tuple[bytes, dict]:
+        meta = self.head_object(bucket, key)
+        data = self.ioctx.read(_data_oid(bucket, key))
+        return data, meta
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        self.head_object(bucket, key)       # 404 if absent
+        self.ioctx.remove(_data_oid(bucket, key))
+        self.ioctx.omap_rm_keys(_index_oid(bucket), [key])
+
+
+def _sign_v2(secret: str, string_to_sign: str) -> str:
+    mac = hmac.new(secret.encode(), string_to_sign.encode(),
+                   hashlib.sha1)
+    return base64.b64encode(mac.digest()).decode()
+
+
+def string_to_sign(method: str, path: str, headers: dict) -> str:
+    """AWS v2 canonical string (the subset the gateway checks)."""
+    return "\n".join([
+        method,
+        headers.get("content-md5", ""),
+        headers.get("content-type", ""),
+        headers.get("date", ""),
+        path,
+    ])
+
+
+class RGWServer:
+    """The HTTP front (rgw_rest_s3.cc's handler table)."""
+
+    def __init__(self, ioctx, host: str = "127.0.0.1", port: int = 0,
+                 credentials: dict | None = None):
+        self.store = _Store(ioctx)
+        self.credentials = dict(credentials or {})
+        gw = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):   # quiet
+                pass
+
+            def _dispatch(self, method):
+                try:
+                    gw._check_auth(method, self)
+                    status, headers, body = gw._route(method, self)
+                except S3Error as e:
+                    status, body = e.status, e.body()
+                    headers = {"Content-Type": "application/xml"}
+                except Exception as e:   # internal
+                    status = 500
+                    body = S3Error(500, "InternalError",
+                                   str(e)).body()
+                    headers = {"Content-Type": "application/xml"}
+                self.send_response(status)
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if method != "HEAD":
+                    self.wfile.write(body)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+            def do_HEAD(self):
+                self._dispatch("HEAD")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.addr = self.httpd.server_address
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "RGWServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="rgw", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # -- auth ----------------------------------------------------------
+
+    def _check_auth(self, method, req) -> None:
+        if not self.credentials:
+            return
+        auth = req.headers.get("Authorization", "")
+        if not auth.startswith("AWS "):
+            raise S3Error(403, "AccessDenied", "missing AWS auth")
+        try:
+            access, sig = auth[4:].split(":", 1)
+        except ValueError:
+            raise S3Error(403, "AccessDenied", "malformed auth")
+        secret = self.credentials.get(access)
+        if secret is None:
+            raise S3Error(403, "InvalidAccessKeyId", access)
+        path = urlsplit(req.path).path
+        hdrs = {k.lower(): v for k, v in req.headers.items()}
+        want = _sign_v2(secret, string_to_sign(method, path, hdrs))
+        if not hmac.compare_digest(sig, want):
+            raise S3Error(403, "SignatureDoesNotMatch", "")
+
+    # -- routing -------------------------------------------------------
+
+    def _route(self, method, req):
+        split = urlsplit(req.path)
+        parts = unquote(split.path).lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        query = parse_qs(split.query)
+        if not bucket:
+            if method == "GET":
+                return self._list_buckets()
+            raise S3Error(405, "MethodNotAllowed", method)
+        if not key:
+            if method == "PUT":
+                self.store.create_bucket(bucket)
+                return 200, {"Location": "/" + bucket}, b""
+            if method == "DELETE":
+                self.store.delete_bucket(bucket)
+                return 204, {}, b""
+            if method == "GET":
+                return self._list_objects(bucket, query)
+            raise S3Error(405, "MethodNotAllowed", method)
+        if method == "PUT":
+            length = int(req.headers.get("Content-Length", "0"))
+            data = req.rfile.read(length) if length else b""
+            etag = self.store.put_object(bucket, key, data)
+            return 200, {"ETag": '"%s"' % etag}, b""
+        if method == "GET":
+            data, meta = self.store.get_object(bucket, key)
+            return 200, {"Content-Type": "binary/octet-stream",
+                         "ETag": '"%s"' % meta["etag"]}, data
+        if method == "HEAD":
+            meta = self.store.head_object(bucket, key)
+            return 200, {"Content-Length-Real": str(meta["size"]),
+                         "ETag": '"%s"' % meta["etag"]}, b""
+        if method == "DELETE":
+            self.store.delete_object(bucket, key)
+            return 204, {}, b""
+        raise S3Error(405, "MethodNotAllowed", method)
+
+    # -- XML renderings (rgw_rest_s3 dump_* role) ----------------------
+
+    def _list_buckets(self):
+        rows = "".join(
+            "<Bucket><Name>%s</Name></Bucket>" % escape(b)
+            for b in self.store.list_buckets())
+        body = ("<?xml version=\"1.0\" encoding=\"UTF-8\"?>"
+                "<ListAllMyBucketsResult><Buckets>%s</Buckets>"
+                "</ListAllMyBucketsResult>" % rows).encode()
+        return 200, {"Content-Type": "application/xml"}, body
+
+    def _list_objects(self, bucket, query):
+        prefix = (query.get("prefix") or [""])[0]
+        max_keys = int((query.get("max-keys") or ["1000"])[0])
+        entries = self.store.list_objects(bucket, prefix, max_keys)
+        rows = "".join(
+            "<Contents><Key>%s</Key><Size>%d</Size>"
+            "<ETag>&quot;%s&quot;</ETag></Contents>"
+            % (escape(e["key"]), e["size"], e["etag"])
+            for e in entries)
+        body = ("<?xml version=\"1.0\" encoding=\"UTF-8\"?>"
+                "<ListBucketResult><Name>%s</Name><Prefix>%s</Prefix>"
+                "%s</ListBucketResult>"
+                % (escape(bucket), escape(prefix), rows)).encode()
+        return 200, {"Content-Type": "application/xml"}, body

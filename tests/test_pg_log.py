@@ -119,3 +119,26 @@ class TestHelpers:
         assert len(log.entries) == 10
         assert log.tail == (1, 15)
         assert not log.overlaps((1, 3))
+
+
+class TestChainedDivergence:
+    def test_chained_divergent_entries_revert_to_earliest_prior(self):
+        """Two divergent writes to one oid: the revert target is the
+        EARLIEST divergent entry's prior_version — later priors are
+        divergent versions nobody can serve."""
+        log = make_log(E(1, 4, "o"), E(2, 5, "o", prior=4),
+                       E(2, 6, "o", prior=5))
+        auth = [E(1, 4, "o"), E(3, 5, "x")]
+        updates, divergent = log.merge(auth, (3, 5))
+        assert updates["o"] == 4          # not 5
+        assert "o" in divergent
+        assert updates["x"] == 5
+
+    def test_trim_reports_dropped_entries(self):
+        log = PGLog()
+        log.CAP = 3
+        dropped = []
+        for i in range(1, 6):
+            dropped.extend(log.append(E(1, i, "o%d" % i)))
+        assert [e.version for e in dropped] == [1, 2]
+        assert len(log.entries) == 3

@@ -1,0 +1,137 @@
+"""S3-subset gateway over a live cluster (rgw_rest_s3 role)."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import http.client
+
+import pytest
+
+from ceph_tpu.services.rgw import RGWServer, string_to_sign
+
+from .cluster_util import MiniCluster
+
+FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+        "mon_osd_down_out_interval": 1.0,
+        "paxos_propose_interval": 0.02}
+
+ACCESS, SECRET = "testkey", "testsecret"
+
+
+@pytest.fixture(scope="module")
+def gw():
+    cluster = MiniCluster(num_mons=1, num_osds=3,
+                          conf_overrides=FAST).start()
+    client = cluster.client()
+    cluster.create_replicated_pool(client, "rgw", size=3, pg_num=4)
+    server = RGWServer(client.open_ioctx("rgw"),
+                       credentials={ACCESS: SECRET}).start()
+    yield server
+    server.stop()
+    cluster.stop()
+
+
+def request(gw_server, method, path, body=b"", sign=True,
+            headers=None):
+    headers = dict(headers or {})
+    if sign:
+        hdrs = {k.lower(): v for k, v in headers.items()}
+        sts = string_to_sign(method, path.split("?")[0], hdrs)
+        sig = base64.b64encode(hmac.new(
+            SECRET.encode(), sts.encode(),
+            hashlib.sha1).digest()).decode()
+        headers["Authorization"] = "AWS %s:%s" % (ACCESS, sig)
+    conn = http.client.HTTPConnection(*gw_server.addr)
+    try:
+        conn.request(method, path, body=body, headers=headers)
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+class TestBuckets:
+    def test_create_list_delete(self, gw):
+        status, _, _ = request(gw, "PUT", "/mybucket")
+        assert status == 200
+        status, _, body = request(gw, "GET", "/")
+        assert status == 200 and b"<Name>mybucket</Name>" in body
+        status, _, body = request(gw, "PUT", "/mybucket")
+        assert status == 409 and b"BucketAlreadyExists" in body
+        status, _, _ = request(gw, "DELETE", "/mybucket")
+        assert status == 204
+        status, _, body = request(gw, "GET", "/")
+        assert b"mybucket" not in body
+
+    def test_delete_nonempty_refused(self, gw):
+        request(gw, "PUT", "/full")
+        request(gw, "PUT", "/full/obj", body=b"x")
+        status, _, body = request(gw, "DELETE", "/full")
+        assert status == 409 and b"BucketNotEmpty" in body
+        request(gw, "DELETE", "/full/obj")
+        status, _, _ = request(gw, "DELETE", "/full")
+        assert status == 204
+
+
+class TestObjects:
+    def test_put_get_head_delete(self, gw):
+        request(gw, "PUT", "/objs")
+        payload = b"the quick brown payload" * 100
+        status, headers, _ = request(gw, "PUT", "/objs/data.bin",
+                                     body=payload)
+        assert status == 200
+        want_etag = '"%s"' % hashlib.md5(payload).hexdigest()
+        assert headers["ETag"] == want_etag
+
+        status, headers, body = request(gw, "GET", "/objs/data.bin")
+        assert status == 200 and body == payload
+        assert headers["ETag"] == want_etag
+
+        status, headers, _ = request(gw, "HEAD", "/objs/data.bin")
+        assert status == 200
+
+        status, _, _ = request(gw, "DELETE", "/objs/data.bin")
+        assert status == 204
+        status, _, body = request(gw, "GET", "/objs/data.bin")
+        assert status == 404 and b"NoSuchKey" in body
+
+    def test_listing_with_prefix(self, gw):
+        request(gw, "PUT", "/listb")
+        for key in ("a/1", "a/2", "b/1"):
+            request(gw, "PUT", "/listb/" + key, body=b"v")
+        status, _, body = request(gw, "GET", "/listb?prefix=a/")
+        assert status == 200
+        assert b"a/1" in body and b"a/2" in body and b"b/1" not in body
+        status, _, body = request(gw, "GET", "/listb?max-keys=2")
+        assert body.count(b"<Contents>") == 2
+
+    def test_missing_bucket_404(self, gw):
+        status, _, body = request(gw, "GET", "/ghost/key")
+        assert status == 404 and b"NoSuchBucket" in body
+
+
+class TestAuth:
+    def test_anonymous_denied(self, gw):
+        status, _, body = request(gw, "GET", "/", sign=False)
+        assert status == 403 and b"AccessDenied" in body
+
+    def test_bad_signature_denied(self, gw):
+        status, _, body = request(
+            gw, "GET", "/", sign=False,
+            headers={"Authorization": "AWS %s:bogus" % ACCESS})
+        assert status == 403 and b"SignatureDoesNotMatch" in body
+
+    def test_unknown_key_denied(self, gw):
+        status, _, body = request(
+            gw, "GET", "/", sign=False,
+            headers={"Authorization": "AWS nobody:sig"})
+        assert status == 403 and b"InvalidAccessKeyId" in body
+
+    def test_data_survives_in_rados(self, gw):
+        """The gateway is a view over rados: the bytes really live in
+        the backing pool's objects."""
+        request(gw, "PUT", "/durab")
+        request(gw, "PUT", "/durab/obj", body=b"rados-backed")
+        assert gw.store.ioctx.read("durab/obj") == b"rados-backed"
