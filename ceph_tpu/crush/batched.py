@@ -8,12 +8,12 @@ vectorized over [batch, replica, bucket-item], bit-exact against
 mapper.c (differential tests compile the reference C as the oracle).
 
 Scope of the device fast path: straw2 hierarchies (the modern default
-bucket type — and the only one the reference's EC rules generate via
-ErasureCode::create_rule) with choose/chooseleaf in indep mode (EC
-pools), for rules of the canonical take -> choose(leaf) -> emit shape.
-firstn (replicated pools), legacy bucket algs, multi-step rules, and
-malformed maps fall back to the scalar interpreter
-(ceph_tpu.crush.mapper_ref), which handles the full op set.
+bucket type) with choose/chooseleaf in BOTH indep (EC pools) and
+firstn (replicated pools) modes, for rules of the canonical
+take -> choose(leaf) -> emit shape under the jewel tunables. Legacy
+bucket algs, multi-step rules, exotic tunables, and malformed maps
+fall back to the scalar interpreter (ceph_tpu.crush.mapper_ref),
+which handles the full op set.
 
 Int64 fixed-point math requires x64; the public entry points wrap traces
 in jax.enable_x64() so the global flag stays untouched.
@@ -236,18 +236,146 @@ def _make_indep(cm: CompiledMap, out_size: int, numrep: int,
     return jax.jit(run)
 
 
+def _make_firstn(cm: CompiledMap, result_max: int, numrep: int,
+                 target_type: int, chooseleaf: bool, tries: int,
+                 recurse_tries: int, vary_r: int):
+    """Jitted firstn kernel (crush_choose_firstn, mapper.c:443-560,
+    under the jewel tunables the fast path gates on:
+    choose_local_tries=0, choose_local_fallback_tries=0, stable=1).
+
+    Candidate descents are pure functions of (x, rep, ftotal), so the
+    hash-heavy work precomputes [B, numrep, tries] (+ [.., recurse]
+    leaf candidates) in one vectorized pass; only the C loop's
+    acceptance order — first-fit with collision against the accepted
+    prefix, skip_rep on permanent failures — runs as a (cheap,
+    batch-vectorized) sequential scan."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(items_a, weights_a, size_a, btype_a, xs, weight_vec,
+            root_idx):
+        arrays = (items_a, weights_a, size_a, btype_a)
+        b = xs.shape[0]
+        none = jnp.int64(CRUSH_ITEM_NONE)
+        reps = jnp.arange(numrep, dtype=jnp.int64)
+        fts = jnp.arange(tries, dtype=jnp.int64)
+        # r = rep + parent_r(0) + ftotal (mapper.c:494-497)
+        rr = jnp.broadcast_to(reps[None, :, None] + fts[None, None, :],
+                              (b, numrep, tries))
+        xb = jnp.broadcast_to(xs[:, None, None], (b, numrep, tries))
+        item, ok, perm = _descend(cm, arrays, root_idx, xb, rr,
+                                  target_type, jnp)
+        # perm (bad item id / bad type) => skip_rep: the rep is
+        # abandoned, not retried (mapper.c:514-536); other failures
+        # retry at the next ftotal
+        if chooseleaf:
+            # inner recursion: numrep=1 (stable), parent_r = sub_r
+            # (mapper.c:552-575), r_inner = sub_r + ftotal_inner
+            sub_r = rr if vary_r else jnp.zeros_like(rr)
+            if vary_r > 1:
+                sub_r = rr >> (vary_r - 1)
+            f2 = jnp.arange(recurse_tries, dtype=jnp.int64)
+            r2 = sub_r[..., None] + f2[None, None, None, :]
+            x2 = jnp.broadcast_to(xb[..., None],
+                                  (b, numrep, tries, recurse_tries))
+            leafcand, lok, lperm = _descend(
+                cm, arrays, -1 - item[..., None], x2, r2, 0, jnp)
+            lok = lok & ~_is_out(weight_vec, leafcand, x2,
+                                 cm.max_devices, jnp)
+        elif target_type == 0:
+            okdev = ok & ~_is_out(weight_vec, item, xb,
+                                  cm.max_devices, jnp)
+        else:
+            # bucket-emitting rule: is_out applies to devices only
+            # (mapper.c:581-585 gates on itemtype == 0)
+            okdev = ok
+
+        out = jnp.full((b, result_max), none)
+        out2 = jnp.full((b, result_max), none)
+        outpos = jnp.zeros((b,), dtype=jnp.int64)
+        slots = jnp.arange(result_max, dtype=jnp.int64)
+
+        def rep_body(rep, carry):
+            out, out2, outpos = carry
+            cand = item[:, rep, :]               # [B, T]
+            # collision against the accepted prefix (it is fixed for
+            # the duration of this rep's scan)
+            collide = jnp.any(out[:, None, :] == cand[:, :, None],
+                              axis=-1)           # [B, T]
+            if chooseleaf:
+                lc = leafcand[:, rep, :, :]      # [B, T, T2]
+                lcollide = jnp.any(
+                    out2[:, None, None, :] == lc[..., None], axis=-1)
+                lacc = lok[:, rep, :, :] & ~lcollide
+                lbad = lperm[:, rep, :, :]
+                first_lacc = jnp.argmax(lacc, axis=-1)
+                any_lacc = jnp.any(lacc, axis=-1)
+                first_lbad = jnp.where(
+                    jnp.any(lbad, axis=-1),
+                    jnp.argmax(lbad, axis=-1),
+                    jnp.int64(recurse_tries))
+                leaf_found = any_lacc & (first_lacc < first_lbad)
+                leaf_pick = jnp.take_along_axis(
+                    lc, first_lacc[..., None], axis=-1)[..., 0]
+                acceptable = ok[:, rep, :] & ~collide & leaf_found
+            else:
+                acceptable = okdev[:, rep, :] & ~collide
+            bad = perm[:, rep, :]
+            first_acc = jnp.argmax(acceptable, axis=-1)
+            any_acc = jnp.any(acceptable, axis=-1)
+            first_bad = jnp.where(jnp.any(bad, axis=-1),
+                                  jnp.argmax(bad, axis=-1),
+                                  jnp.int64(tries))
+            accept = any_acc & (first_acc < first_bad) & \
+                (outpos < result_max)
+            pick = jnp.take_along_axis(cand, first_acc[:, None],
+                                       axis=-1)[:, 0]
+            at = slots[None, :] == outpos[:, None]
+            sel = at & accept[:, None]
+            out = jnp.where(sel, pick[:, None], out)
+            if chooseleaf:
+                lp = jnp.take_along_axis(leaf_pick,
+                                         first_acc[:, None],
+                                         axis=-1)[:, 0]
+                out2 = jnp.where(sel, lp[:, None], out2)
+            outpos = outpos + accept.astype(jnp.int64)
+            return out, out2, outpos
+
+        out, out2, outpos = jax.lax.fori_loop(
+            0, numrep, rep_body, (out, out2, outpos))
+        return out2 if chooseleaf else out
+
+    return jax.jit(run)
+
+
 _KERNEL_CACHE: dict = {}
 
 
 def _indep_kernel(cm: CompiledMap, out_size, numrep, target_type, chooseleaf,
                   tries, recurse_tries):
-    key = (cm.items.tobytes(), cm.weights.tobytes(), cm.size.tobytes(),
-           cm.btype.tobytes(), cm.depth, cm.max_devices,
+    key = ("indep", cm.items.tobytes(), cm.weights.tobytes(),
+           cm.size.tobytes(), cm.btype.tobytes(), cm.depth, cm.max_devices,
            out_size, numrep, target_type, chooseleaf, tries, recurse_tries)
     kernel = _KERNEL_CACHE.get(key)
     if kernel is None:
         kernel = _make_indep(cm, out_size, numrep, target_type, chooseleaf,
                              tries, recurse_tries)
+        if len(_KERNEL_CACHE) > 64:
+            _KERNEL_CACHE.clear()
+        _KERNEL_CACHE[key] = kernel
+    return kernel
+
+
+def _firstn_kernel(cm: CompiledMap, result_max, numrep, target_type,
+                   chooseleaf, tries, recurse_tries, vary_r):
+    key = ("firstn", cm.items.tobytes(), cm.weights.tobytes(),
+           cm.size.tobytes(), cm.btype.tobytes(), cm.depth, cm.max_devices,
+           result_max, numrep, target_type, chooseleaf, tries,
+           recurse_tries, vary_r)
+    kernel = _KERNEL_CACHE.get(key)
+    if kernel is None:
+        kernel = _make_firstn(cm, result_max, numrep, target_type,
+                              chooseleaf, tries, recurse_tries, vary_r)
         if len(_KERNEL_CACHE) > 64:
             _KERNEL_CACHE.clear()
         _KERNEL_CACHE[key] = kernel
@@ -301,9 +429,19 @@ def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
             out[i, :len(res)] = res
         return out
 
+    t = cmap.tunables
+    firstn = shape is not None and shape["op"] in (
+        RULE_CHOOSE_FIRSTN, RULE_CHOOSELEAF_FIRSTN)
+    # the firstn kernel bakes in the jewel defaults it is bit-exact
+    # for; exotic tunables ride the scalar interpreter
+    firstn_ok = (firstn and t.choose_local_tries == 0
+                 and t.choose_local_fallback_tries == 0
+                 and t.chooseleaf_stable == 1)
     if (shape is None
-            or shape["op"] in (RULE_CHOOSE_FIRSTN, RULE_CHOOSELEAF_FIRSTN)
-            or (shape["op"] == RULE_CHOOSELEAF_INDEP and shape["type"] == 0)
+            or (firstn and not firstn_ok)
+            or (shape["op"] in (RULE_CHOOSELEAF_INDEP,
+                                RULE_CHOOSELEAF_FIRSTN)
+                and shape["type"] == 0)
             or any(b.alg != "straw2" for b in cmap.buckets.values())):
         return scalar_fallback()
 
@@ -317,15 +455,29 @@ def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
     if numrep <= 0:
         numrep += result_max
     out_size = min(numrep, result_max)
-    t = cmap.tunables
     tries = shape["choose_tries"] or (t.choose_total_tries + 1)
-    recurse_tries = shape["leaf_tries"] or 1
-    chooseleaf = shape["op"] == RULE_CHOOSELEAF_INDEP
+    chooseleaf = shape["op"] in (RULE_CHOOSELEAF_INDEP,
+                                 RULE_CHOOSELEAF_FIRSTN)
     if weight is None:
         weight = np.full(cm.max_devices, 0x10000, dtype=np.int64)
 
-    kernel = _indep_kernel(cm, out_size, numrep, shape["type"], chooseleaf,
-                           tries, recurse_tries)
+    if firstn:
+        # recurse_tries per do_rule (mapper.c:1014-1020):
+        # choose_leaf_tries, else 1 under chooseleaf_descend_once,
+        # else choose_tries
+        if shape["leaf_tries"]:
+            recurse_tries = shape["leaf_tries"]
+        elif t.chooseleaf_descend_once:
+            recurse_tries = 1
+        else:
+            recurse_tries = tries
+        kernel = _firstn_kernel(cm, result_max, numrep, shape["type"],
+                                chooseleaf, tries, recurse_tries,
+                                t.chooseleaf_vary_r)
+    else:
+        recurse_tries = shape["leaf_tries"] or 1
+        kernel = _indep_kernel(cm, out_size, numrep, shape["type"],
+                               chooseleaf, tries, recurse_tries)
     with jax.enable_x64():
         out = kernel(jnp.asarray(cm.items), jnp.asarray(cm.weights),
                      jnp.asarray(cm.size), jnp.asarray(cm.btype),

@@ -14,6 +14,7 @@ import time
 
 from .. import encoding
 from ..common import Context
+from ..common.lockdep import make_rlock
 from ..common.workqueue import SafeTimer
 from ..msg.message import (MMonCommandReply, MOSDMap)
 from ..msg.messenger import Dispatcher, Messenger
@@ -45,7 +46,7 @@ class Monitor(Dispatcher):
         self.elector = Elector(self)
         self.paxos = Paxos(self, self.store)
         self.osdmon = OSDMonitor(self)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("mon")
         self._propose_pending = False
         self._subscribers: dict = {}        # addr -> last epoch sent
         self._cmd_replies: dict = {}        # (requester, tid) -> reply

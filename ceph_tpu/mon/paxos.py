@@ -41,6 +41,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ..common.lockdep import make_rlock
 from ..msg.message import MMonElection, MMonPaxos
 
 __all__ = ["Elector", "Paxos"]
@@ -136,7 +137,7 @@ class Paxos:
     def __init__(self, mon, store):
         self.mon = mon
         self.store = store
-        self._lock = threading.RLock()
+        self._lock = make_rlock("paxos")
         self.state = STATE_RECOVERING
         # durable state (reload so promises survive a restart)
         self.last_committed = self._load_int("last_committed")

@@ -34,6 +34,7 @@ import threading
 import numpy as np
 
 from ..common.interval_set import ExtentMap, IntervalSet
+from ..common.lockdep import make_rlock
 from ..msg.message import (MOSDECSubOpRead, MOSDECSubOpReadReply,
                            MOSDECSubOpWrite, MOSDECSubOpWriteReply)
 from ..store.object_store import Transaction
@@ -82,7 +83,7 @@ class ECBackend:
                                         stripe_width)
         self.cache = ExtentCache()
         self._tids = itertools.count(1)
-        self.lock = threading.RLock()
+        self.lock = make_rlock("ec-backend")
         # the three wait queues (ECBackend.h:561-563)
         self.waiting_state: list[_InflightWrite] = []
         self.waiting_reads: list[_InflightWrite] = []

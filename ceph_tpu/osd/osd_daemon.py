@@ -21,6 +21,7 @@ from ..msg.message import (MOSDBoot, MOSDFailure, MOSDOpReply, MPing,
                            MPingReply)
 from ..msg.messenger import Dispatcher, Messenger
 from ..store.mem_store import MemStore
+from ..common.lockdep import make_rlock
 from ..utils.trace import Tracer
 from .op_queue import QosShardedOpWQ, make_op_queue
 from .op_request import OpTracker
@@ -51,7 +52,7 @@ class OSDDaemon(Dispatcher):
                                     "osd.%d" % whoami)
         self.osdmap = OSDMap()
         self.pgs: dict = {}
-        self.lock = threading.RLock()
+        self.lock = make_rlock("osd")
         # op scheduling: QoS discipline per osd_op_queue (wpq default,
         # like the reference's luminous OSD), plain FIFO as fallback
         if conf.get_val("osd_op_queue") == "fifo":

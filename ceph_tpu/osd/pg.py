@@ -35,6 +35,7 @@ import threading
 import time as _time
 
 from .. import encoding
+from ..common.lockdep import make_rlock
 from ..msg.message import (MOSDPGLog, MOSDPGNotify, MOSDPGPull,
                            MOSDPGPush, MOSDPGQuery, MOSDPGScan,
                            MWatchNotify)
@@ -70,7 +71,7 @@ class PG:
         self.pool = pool
         self.whoami = daemon.whoami
         self.store = daemon.store
-        self.lock = threading.RLock()
+        self.lock = make_rlock("pg")
         self.acting: list[int] = []
         self.acting_primary = -1
         self.up: list[int] = []

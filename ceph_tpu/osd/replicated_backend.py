@@ -12,6 +12,7 @@ from __future__ import annotations
 import itertools
 import threading
 
+from ..common.lockdep import make_rlock
 from ..msg.message import MOSDRepOp, MOSDRepOpReply
 from ..store.object_store import Transaction
 
@@ -29,7 +30,7 @@ class ReplicatedBackend:
     def __init__(self, pg):
         self.pg = pg
         self._tids = itertools.count(1)
-        self.lock = threading.RLock()
+        self.lock = make_rlock("rep-backend")
         self.inflight: dict[int, _Inflight] = {}
 
     # -- write ---------------------------------------------------------

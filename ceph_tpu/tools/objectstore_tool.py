@@ -1,0 +1,242 @@
+"""objectstore-tool — offline store surgery.
+
+Role of the reference's ceph-objectstore-tool
+(/root/reference/src/tools/ceph_objectstore_tool.cc): operate directly
+on a (stopped) OSD's object store for disaster recovery — list PGs and
+objects, export a whole PG (data + xattrs + omap + the durable PG log)
+to a file, import it into another OSD's store, remove PGs, and poke
+individual objects.
+
+  python -m ceph_tpu.tools.objectstore_tool --data-path DIR \\
+      [--store filestore|bluestore] --op list-pgs
+      --op list [--pgid PG]
+      --op export --pgid PG --file OUT
+      --op import --file IN
+      --op remove --pgid PG
+      --op get-bytes --pgid PG --oid OID --file OUT
+      --op set-bytes --pgid PG --oid OID --file IN
+
+The export payload is a versioned-encoding document, so it survives
+format evolution the same way the wire does (the reference exports
+through the same encode/decode layer its disks use).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .. import encoding
+
+__all__ = ["open_store", "list_pgs", "list_objects", "export_pg",
+           "import_pg", "remove_pg", "main"]
+
+EXPORT_VERSION = 1
+
+
+def open_store(path: str, kind: str = "filestore"):
+    """Mount a store offline. The OSD that owns it must be stopped
+    (the tool takes the reference's same you-get-to-keep-the-pieces
+    stance on concurrent access)."""
+    if kind == "bluestore":
+        from ..store.block_store import BlockStore
+        store = BlockStore(path)
+    elif kind == "memstore":
+        raise SystemExit("memstore has no on-disk form to operate on")
+    else:
+        from ..store.file_store import FileStore
+        store = FileStore(path)
+    store.mount()
+    return store
+
+
+def _pg_collections(store, pgid: str) -> list:
+    """Every collection belonging to one PG (all EC shards + -1)."""
+    return [cid for cid in store.list_collections()
+            if isinstance(cid, tuple) and len(cid) == 3
+            and cid[0] == "pg" and str(cid[1]) == pgid]
+
+
+def list_pgs(store) -> list[str]:
+    pgs = {str(cid[1]) for cid in store.list_collections()
+           if isinstance(cid, tuple) and len(cid) == 3
+           and cid[0] == "pg"}
+    return sorted(pgs)
+
+
+def list_objects(store, pgid: str | None = None) -> list:
+    colls = (store.list_collections() if pgid is None
+             else _pg_collections(store, pgid))
+    return [(cid, oid) for cid in colls
+            for oid in store.list_objects(cid)]
+
+
+def _dump_object(store, cid, oid) -> dict:
+    data = store.read(cid, oid)
+    coll_obj = {"data": bytes(data), "xattrs": {}, "omap": {}}
+    # xattrs: the store interface exposes getattr-by-name only;
+    # FileStore/BlockStore both let us enumerate via their records
+    xattrs = _all_xattrs(store, cid, oid)
+    coll_obj["xattrs"] = xattrs
+    try:
+        coll_obj["omap"] = store.omap_get(cid, oid)
+    except KeyError:
+        pass
+    return coll_obj
+
+
+def _all_xattrs(store, cid, oid) -> dict:
+    # both persistent stores keep full xattr dicts in their object
+    # records; reach them via the narrowest surface each exposes
+    from ..store.block_store import BlockStore, _okey
+    from ..store.mem_store import MemStore
+    if isinstance(store, BlockStore):
+        onode = store._onodes.get(_okey(cid, oid))
+        return dict(onode.xattrs) if onode is not None else {}
+    if isinstance(store, MemStore):      # FileStore derives from it
+        coll = store._colls.get(cid)
+        obj = coll.objects.get(oid) if coll else None
+        return dict(obj.xattrs) if obj is not None else {}
+    return {}
+
+
+def export_pg(store, pgid: str) -> bytes:
+    """Serialize one PG: every shard collection with every object's
+    data/xattrs/omap (the durable log rides along in __pg_meta__)."""
+    colls = _pg_collections(store, pgid)
+    if not colls:
+        raise SystemExit("pgid %s not present in this store" % pgid)
+    doc = {"version": EXPORT_VERSION, "pgid": pgid, "collections": []}
+    for cid in colls:
+        entry = {"cid": list(cid), "objects": {}}
+        for oid in store.list_objects(cid):
+            entry["objects"][oid] = _dump_object(store, cid, oid)
+        doc["collections"].append(entry)
+    return encoding.encode_any(doc)
+
+
+def import_pg(store, blob: bytes, force: bool = False) -> str:
+    """Recreate an exported PG in this store. Refuses to clobber an
+    existing PG unless force (the reference requires removing first)."""
+    from ..store.object_store import Transaction
+    doc = encoding.decode_any(blob)
+    if not isinstance(doc, dict) or "pgid" not in doc:
+        raise SystemExit("not a PG export")
+    pgid = doc["pgid"]
+    if _pg_collections(store, pgid):
+        if not force:
+            raise SystemExit(
+                "pgid %s already present (remove it or --force)" % pgid)
+        # force CLOBBERS: a merge would resurrect objects deleted
+        # after the export was taken
+        remove_pg(store, pgid)
+    for entry in doc["collections"]:
+        cid = tuple(entry["cid"])
+        txn = Transaction()
+        txn.create_collection(cid)
+        store.queue_transaction(txn)
+        for oid, rec in entry["objects"].items():
+            txn = Transaction()
+            txn.remove(cid, oid)
+            txn.touch(cid, oid)
+            if rec["data"]:
+                txn.write(cid, oid, 0, rec["data"])
+            for name, val in rec["xattrs"].items():
+                txn.setattr(cid, oid, name, val)
+            if rec["omap"]:
+                txn.omap_setkeys(cid, oid, rec["omap"])
+            store.queue_transaction(txn)
+    return pgid
+
+
+def remove_pg(store, pgid: str) -> int:
+    from ..store.object_store import Transaction
+    colls = _pg_collections(store, pgid)
+    for cid in colls:
+        txn = Transaction()
+        txn.remove_collection(cid)
+        store.queue_transaction(txn)
+    return len(colls)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="objectstore-tool",
+                                description=__doc__.split("\n")[0])
+    p.add_argument("--data-path", required=True)
+    p.add_argument("--store", default="filestore",
+                   choices=["filestore", "bluestore"])
+    p.add_argument("--op", required=True,
+                   choices=["list", "list-pgs", "export", "import",
+                            "remove", "get-bytes", "set-bytes"])
+    p.add_argument("--pgid")
+    p.add_argument("--oid")
+    p.add_argument("--file")
+    p.add_argument("--force", action="store_true")
+    args = p.parse_args(argv)
+
+    store = open_store(args.data_path, args.store)
+    try:
+        if args.op == "list-pgs":
+            for pg in list_pgs(store):
+                print(pg)
+            return 0
+        if args.op == "list":
+            for cid, oid in list_objects(store, args.pgid):
+                print("%s\t%s" % (cid, oid))
+            return 0
+        if args.op == "export":
+            if not (args.pgid and args.file):
+                raise SystemExit("export needs --pgid and --file")
+            blob = export_pg(store, args.pgid)
+            with open(args.file, "wb") as f:
+                f.write(blob)
+            print("exported %s (%d bytes)" % (args.pgid, len(blob)))
+            return 0
+        if args.op == "import":
+            if not args.file:
+                raise SystemExit("import needs --file")
+            with open(args.file, "rb") as f:
+                blob = f.read()
+            pgid = import_pg(store, blob, force=args.force)
+            print("imported %s" % pgid)
+            return 0
+        if args.op == "remove":
+            if not args.pgid:
+                raise SystemExit("remove needs --pgid")
+            n = remove_pg(store, args.pgid)
+            print("removed %d collections of pg %s" % (n, args.pgid))
+            return 0
+        if args.op in ("get-bytes", "set-bytes"):
+            if not (args.pgid and args.oid and args.file):
+                raise SystemExit("%s needs --pgid --oid --file"
+                                 % args.op)
+            colls = _pg_collections(store, args.pgid)
+            if not colls:
+                raise SystemExit("pgid %s not present" % args.pgid)
+            cid = next((c for c in colls
+                        if args.oid in store.list_objects(c)), colls[0])
+            if args.op == "get-bytes":
+                data = store.read(cid, args.oid)
+                out = (sys.stdout.buffer if args.file == "-"
+                       else open(args.file, "wb"))
+                out.write(bytes(data))
+                if out is not sys.stdout.buffer:
+                    out.close()
+            else:
+                from ..store.object_store import Transaction
+                with open(args.file, "rb") as f:
+                    data = f.read()
+                txn = Transaction()
+                txn.remove(cid, args.oid)
+                txn.touch(cid, args.oid)
+                if data:
+                    txn.write(cid, args.oid, 0, data)
+                store.queue_transaction(txn)
+            return 0
+        return 2
+    finally:
+        store.umount()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

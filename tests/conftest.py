@@ -20,3 +20,20 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Lock-order cycle detection rides along for the WHOLE suite (the
+# reference runs its qa with lockdep enabled the same way); the daemon
+# locks created through common.lockdep.make_rlock become DebugRLocks.
+# Violations collect rather than raise; the session-end hook surfaces
+# any cycle the workload tests provoked.
+from ceph_tpu.common import lockdep  # noqa: E402
+
+lockdep.enable()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if lockdep.violations:
+        print("\nLOCKDEP: %d lock-order violation(s) detected:"
+              % len(lockdep.violations))
+        for v in lockdep.violations[:3]:
+            print(v)

@@ -332,3 +332,105 @@ def test_batched_malformed_map_falls_back():
     for x in range(20):
         ref = mapper_ref.crush_do_rule(m, 0, x, 2)
         assert list(got[x]) == ref
+
+
+def test_batched_matches_ref_flat_firstn():
+    # the replicated-pool shape: choose firstn over devices
+    rng = np.random.default_rng(6)
+    ndev = 10
+    weights = rng.integers(1, 3 * 0x10000, size=ndev, dtype=np.uint32)
+    m = make_flat(ndev, weights)
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1),
+                           (cmap_mod.RULE_CHOOSE_FIRSTN, 3, 0),
+                           (cmap_mod.RULE_EMIT,)]))
+    reweight = np.full(ndev, 0x10000, dtype=np.int64)
+    reweight[2] = 0
+    reweight[7] = 0x8000
+    xs = np.arange(300)
+    got = batched.batched_do_rule(m, 0, xs, 3, reweight)
+    for x in xs:
+        ref = mapper_ref.crush_do_rule(m, 0, int(x), 3, list(reweight))
+        mine = [int(v) for v in got[x] if v != CRUSH_ITEM_NONE]
+        assert mine == ref, (x, mine, ref)
+
+
+def test_batched_matches_ref_two_level_chooseleaf_firstn():
+    # the canonical replicated rule: take root -> chooseleaf firstn
+    # over hosts -> emit (CrushWrapper::add_simple_rule default)
+    rng = np.random.default_rng(7)
+    hosts, per = 6, 4
+    ndev = hosts * per
+    weights = rng.integers(1, 3 * 0x10000, size=ndev, dtype=np.uint32)
+    m = make_two_level(hosts, per, weights)
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1),
+                           (cmap_mod.RULE_CHOOSELEAF_FIRSTN, 3, 1),
+                           (cmap_mod.RULE_EMIT,)]))
+    reweight = np.full(ndev, 0x10000, dtype=np.int64)
+    reweight[5] = 0
+    reweight[16] = 0x4000
+    xs = np.arange(300)
+    got = batched.batched_do_rule(m, 0, xs, 3, reweight)
+    for x in xs:
+        ref = mapper_ref.crush_do_rule(m, 0, int(x), 3, list(reweight))
+        mine = [int(v) for v in got[x] if v != CRUSH_ITEM_NONE]
+        assert mine == ref, (x, mine, ref)
+
+
+def test_batched_firstn_compacts_not_holes():
+    # firstn output shifts out devices away (can_shift_osds), unlike
+    # indep's positional holes
+    m = make_flat(4, [0x10000] * 4)
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1),
+                           (cmap_mod.RULE_CHOOSE_FIRSTN, 4, 0),
+                           (cmap_mod.RULE_EMIT,)]))
+    reweight = np.array([0x10000, 0, 0x10000, 0], dtype=np.int64)
+    got = batched.batched_do_rule(m, 0, np.arange(50), 4, reweight)
+    for x in range(50):
+        ref = mapper_ref.crush_do_rule(m, 0, x, 4, list(reweight))
+        mine = [int(v) for v in got[x] if v != CRUSH_ITEM_NONE]
+        assert mine == ref
+        # holes only at the tail (compacted prefix)
+        row = list(got[x])
+        assert row[:len(mine)] == mine
+
+
+def test_batched_firstn_numrep_exceeds_available():
+    m = make_two_level(3, 2, [0x10000] * 6)
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1),
+                           (cmap_mod.RULE_CHOOSELEAF_FIRSTN, 5, 1),
+                           (cmap_mod.RULE_EMIT,)]))
+    got = batched.batched_do_rule(m, 0, np.arange(100), 5)
+    for x in range(100):
+        ref = mapper_ref.crush_do_rule(m, 0, x, 5, None)
+        mine = [int(v) for v in got[x] if v != CRUSH_ITEM_NONE]
+        assert mine == ref, (x, mine, ref)
+
+
+def test_batched_firstn_exotic_tunables_fall_back():
+    # non-jewel local retries ride the scalar interpreter
+    m = make_flat(6, [0x10000] * 6)
+    m.tunables.choose_local_tries = 2
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1),
+                           (cmap_mod.RULE_CHOOSE_FIRSTN, 3, 0),
+                           (cmap_mod.RULE_EMIT,)]))
+    got = batched.batched_do_rule(m, 0, np.arange(30), 3)
+    for x in range(30):
+        ref = mapper_ref.crush_do_rule(m, 0, x, 3, None)
+        mine = [int(v) for v in got[x] if v != CRUSH_ITEM_NONE]
+        assert mine == ref
+
+
+def test_batched_firstn_bucket_target_ignores_device_reweight():
+    # choose firstn emitting BUCKETS: is_out applies to devices only
+    # (mapper.c:581-585); reweight must not reject host buckets
+    m = make_two_level(4, 2, [0x10000] * 8)
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1),
+                           (cmap_mod.RULE_CHOOSE_FIRSTN, 2, 1),
+                           (cmap_mod.RULE_EMIT,)]))
+    reweight = np.full(8, 0x10000, dtype=np.int64)
+    reweight[0] = 0
+    got = batched.batched_do_rule(m, 0, np.arange(20), 2, reweight)
+    for x in range(20):
+        ref = mapper_ref.crush_do_rule(m, 0, x, 2, list(reweight))
+        mine = [int(v) for v in got[x] if v != CRUSH_ITEM_NONE]
+        assert mine == ref, (x, mine, ref)

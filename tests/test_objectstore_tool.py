@@ -1,0 +1,174 @@
+"""objectstore-tool: offline PG export/import/remove surgery.
+
+The VERDICT round-1 'done' gate: kill an OSD, surgically export a PG
+from its store, import it on another OSD, and the cluster recovers —
+the ceph-objectstore-tool disaster-recovery workflow."""
+
+from __future__ import annotations
+
+import pytest
+
+from ceph_tpu.store.block_store import BlockStore
+from ceph_tpu.store.file_store import FileStore
+from ceph_tpu.store.object_store import Transaction
+from ceph_tpu.tools import objectstore_tool as ost
+
+from .cluster_util import MiniCluster, wait_until
+
+FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+        "mon_osd_down_out_interval": 1.0,
+        "paxos_propose_interval": 0.02}
+
+
+def seeded_store(path, kind=FileStore):
+    st = kind(str(path))
+    st.mount()
+    txn = Transaction()
+    cid = ("pg", "1.0", -1)
+    txn.create_collection(cid)
+    txn.write(cid, "alpha", 0, b"alpha-bytes")
+    txn.setattr(cid, "alpha", "_v", b"3")
+    txn.omap_setkeys(cid, "alpha", {"k": b"v"})
+    txn.write(cid, "beta", 0, b"beta-bytes")
+    st.queue_transaction(txn)
+    return st
+
+
+class TestOffline:
+    def test_list_pgs_and_objects(self, tmp_path):
+        st = seeded_store(tmp_path / "osd")
+        assert ost.list_pgs(st) == ["1.0"]
+        objs = [oid for _, oid in ost.list_objects(st, "1.0")]
+        assert set(objs) == {"alpha", "beta"}
+        st.umount()
+
+    @pytest.mark.parametrize("kind", [FileStore, BlockStore])
+    def test_export_import_roundtrip(self, tmp_path, kind):
+        src = seeded_store(tmp_path / "src", kind)
+        blob = ost.export_pg(src, "1.0")
+        src.umount()
+
+        dst = kind(str(tmp_path / "dst"))
+        dst.mount()
+        assert ost.import_pg(dst, blob) == "1.0"
+        cid = ("pg", "1.0", -1)
+        assert dst.read(cid, "alpha") == b"alpha-bytes"
+        assert dst.getattr(cid, "alpha", "_v") == b"3"
+        assert dst.omap_get(cid, "alpha") == {"k": b"v"}
+        assert dst.read(cid, "beta") == b"beta-bytes"
+        # refuses to clobber without force
+        with pytest.raises(SystemExit):
+            ost.import_pg(dst, blob)
+        ost.import_pg(dst, blob, force=True)
+        dst.umount()
+
+    def test_remove_pg(self, tmp_path):
+        st = seeded_store(tmp_path / "osd")
+        assert ost.remove_pg(st, "1.0") == 1
+        assert ost.list_pgs(st) == []
+        st.umount()
+
+    def test_cli_surface(self, tmp_path, capsys):
+        seeded_store(tmp_path / "osd").umount()
+        assert ost.main(["--data-path", str(tmp_path / "osd"),
+                         "--op", "list-pgs"]) == 0
+        assert "1.0" in capsys.readouterr().out
+        out_file = tmp_path / "export.bin"
+        assert ost.main(["--data-path", str(tmp_path / "osd"),
+                         "--op", "export", "--pgid", "1.0",
+                         "--file", str(out_file)]) == 0
+        assert out_file.stat().st_size > 0
+        got = tmp_path / "alpha.bin"
+        assert ost.main(["--data-path", str(tmp_path / "osd"),
+                         "--op", "get-bytes", "--pgid", "1.0",
+                         "--oid", "alpha", "--file", str(got)]) == 0
+        assert got.read_bytes() == b"alpha-bytes"
+
+
+class TestDisasterRecovery:
+    def test_export_dead_osd_import_elsewhere_cluster_recovers(
+            self, tmp_path):
+        """The headline workflow: OSD dies for good; its PG copy is
+        surgically exported offline and imported into a replacement
+        OSD's store; the cluster serves the data again."""
+        cluster = MiniCluster(num_mons=1, num_osds=0,
+                              conf_overrides=FAST)
+        from ceph_tpu.common.context import Context
+        from ceph_tpu.mon.monitor import Monitor
+        for rank in cluster.monmap:
+            mon = Monitor(rank, cluster.monmap,
+                          Context(FAST, name="mon.%d" % rank))
+            mon.init()
+            cluster.mons.append(mon)
+        assert wait_until(lambda: any(m.is_leader()
+                                      for m in cluster.mons))
+        stores = {}
+        try:
+            for osd_id in range(3):
+                path = tmp_path / ("osd.%d" % osd_id)
+                path.mkdir()
+                stores[osd_id] = FileStore(str(path),
+                                           journal_sync=False)
+                stores[osd_id].mount()
+                cluster.start_osd(osd_id, store=stores[osd_id])
+            cluster.num_osds = 3
+            assert wait_until(cluster.all_osds_up, timeout=15)
+            client = cluster.client()
+            cluster.create_replicated_pool(client, "dr", size=2,
+                                           pg_num=1)
+            ioctx = client.open_ioctx("dr")
+            ioctx.write_full("precious", b"must survive surgery")
+
+            # find a PG copy and its host; kill that OSD permanently
+            holder = next(o for o in range(3)
+                          if ost.list_pgs(stores[o]))
+            pgid = ost.list_pgs(stores[holder])[0]
+            cluster.stop_osd(holder)
+            stores[holder].umount() if stores[holder].mounted else None
+
+            # offline surgery: export from the dead OSD's directory,
+            # import into a brand-new OSD's store
+            dead = ost.open_store(str(tmp_path / ("osd.%d" % holder)))
+            blob = ost.export_pg(dead, pgid)
+            dead.umount()
+            newpath = tmp_path / "osd.9"
+            newpath.mkdir()
+            surgeon = ost.open_store(str(newpath))
+            ost.import_pg(surgeon, blob)
+            surgeon.umount()
+
+            # boot the replacement OSD on the repaired store
+            replacement = FileStore(str(newpath), journal_sync=False)
+            replacement.mount()
+            cluster.start_osd(9, store=replacement)
+            assert wait_until(
+                lambda: cluster.leader().osdmon.osdmap.is_up(9),
+                timeout=15)
+            assert ioctx.read("precious") == b"must survive surgery"
+            # the imported copy really participates: the replacement's
+            # store holds the bytes
+            found = any(
+                b"must survive surgery" in bytes(
+                    replacement.read(cid, oid))
+                for cid, oid in ost.list_objects(replacement)
+                if not str(oid).startswith("__pg_"))
+            assert found
+        finally:
+            cluster.stop()
+
+
+class TestForceClobbers:
+    def test_force_import_does_not_resurrect_deleted_objects(
+            self, tmp_path):
+        st = seeded_store(tmp_path / "osd")
+        blob = ost.export_pg(st, "1.0")
+        # an object deleted AFTER the export must not survive a forced
+        # re-import (clobber, not merge)
+        cid = ("pg", "1.0", -1)
+        txn = Transaction()
+        txn.write(cid, "post-export-ghost", 0, b"stale")
+        st.queue_transaction(txn)
+        ost.import_pg(st, blob, force=True)
+        assert "post-export-ghost" not in st.list_objects(cid)
+        assert st.read(cid, "alpha") == b"alpha-bytes"
+        st.umount()
