@@ -24,7 +24,6 @@ from __future__ import annotations
 import hashlib
 import hmac
 import os
-import struct
 import threading
 import time
 
@@ -39,37 +38,34 @@ class AuthError(Exception):
 
 
 # ---------------------------------------------------------------------------
-# stdlib authenticated encryption
+# authenticated encryption via the pluggable crypto provider slot
+# (src/crypto/ role; the default stdlib provider is the HMAC keystream
+# construction this module originally inlined)
 
 
-def _keystream(key: bytes, nonce: bytes, n: int) -> bytes:
-    out = bytearray()
-    counter = 0
-    while len(out) < n:
-        out += hmac.new(key, nonce + struct.pack("<Q", counter),
-                        hashlib.sha256).digest()
-        counter += 1
-    return bytes(out[:n])
+def _provider():
+    from . import crypto
+    return crypto.create(_crypto_provider_name)
+
+
+_crypto_provider_name = "stdlib"
+
+
+def set_crypto_provider(name: str) -> None:
+    """Select the registered crypto provider cephx uses."""
+    from . import crypto
+    crypto.create(name)            # ENOENT on absent, like the reference
+    global _crypto_provider_name
+    _crypto_provider_name = name
 
 
 def seal(key: bytes, plaintext: bytes) -> bytes:
     """Encrypt-then-MAC: nonce || ciphertext || tag."""
-    nonce = os.urandom(16)
-    ct = bytes(a ^ b for a, b in
-               zip(plaintext, _keystream(key, nonce, len(plaintext))))
-    tag = hmac.new(key, nonce + ct, hashlib.sha256).digest()
-    return nonce + ct + tag
+    return _provider().seal(key, plaintext)
 
 
 def unseal(key: bytes, blob: bytes) -> bytes:
-    if len(blob) < 48:
-        raise AuthError("sealed blob too short")
-    nonce, ct, tag = blob[:16], blob[16:-32], blob[-32:]
-    if not hmac.compare_digest(
-            tag, hmac.new(key, nonce + ct, hashlib.sha256).digest()):
-        raise AuthError("sealed blob failed integrity check")
-    return bytes(a ^ b for a, b in
-                 zip(ct, _keystream(key, nonce, len(ct))))
+    return _provider().unseal(key, blob)
 
 
 def _proof(key: bytes, challenge: bytes) -> bytes:

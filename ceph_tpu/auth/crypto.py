@@ -1,0 +1,104 @@
+"""Crypto provider plugin slot.
+
+Role of the reference's src/crypto/ (CryptoPlugin + the isa-l and
+openssl accelerated providers, loaded through the same plugin registry
+as the erasure codecs): the symmetric crypto cephx uses is pluggable,
+so accelerated implementations can replace the baseline without
+touching the protocol.
+
+Providers implement authenticated encryption (seal/unseal) and keyed
+MACs. The baseline `stdlib` provider is the HMAC-SHA256
+encrypt-then-MAC keystream construction cephx shipped with; alternate
+providers register under their own name (create("isal")-style lookup,
+ENOENT on absent ones, mirroring the compressor registry's contract).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import struct
+
+__all__ = ["CryptoProvider", "StdlibProvider", "register", "create",
+           "providers"]
+
+
+class CryptoProvider:
+    """Provider interface (CryptoPlugin/CryptoHandler role)."""
+
+    name = "none"
+
+    def seal(self, key: bytes, plaintext: bytes) -> bytes:
+        raise NotImplementedError
+
+    def unseal(self, key: bytes, blob: bytes) -> bytes:
+        raise NotImplementedError
+
+    def mac(self, key: bytes, data: bytes) -> bytes:
+        raise NotImplementedError
+
+
+class StdlibProvider(CryptoProvider):
+    """Baseline: HMAC-SHA256 counter keystream + encrypt-then-MAC —
+    authenticated encryption from the stdlib, standing in for the
+    reference's AES providers."""
+
+    name = "stdlib"
+
+    @staticmethod
+    def _keystream(key: bytes, nonce: bytes, n: int) -> bytes:
+        out = bytearray()
+        counter = 0
+        while len(out) < n:
+            out += hmac.new(key, nonce + struct.pack("<Q", counter),
+                            hashlib.sha256).digest()
+            counter += 1
+        return bytes(out[:n])
+
+    def seal(self, key: bytes, plaintext: bytes) -> bytes:
+        nonce = os.urandom(16)
+        ks = self._keystream(key, nonce, len(plaintext))
+        ct = bytes(a ^ b for a, b in zip(plaintext, ks))
+        tag = hmac.new(key, nonce + ct, hashlib.sha256).digest()
+        return nonce + ct + tag
+
+    def unseal(self, key: bytes, blob: bytes) -> bytes:
+        from .cephx import AuthError
+        if len(blob) < 48:
+            raise AuthError("sealed blob too short")
+        nonce, ct, tag = blob[:16], blob[16:-32], blob[-32:]
+        want = hmac.new(key, nonce + ct, hashlib.sha256).digest()
+        if not hmac.compare_digest(tag, want):
+            raise AuthError("sealed blob failed integrity check")
+        ks = self._keystream(key, nonce, len(ct))
+        return bytes(a ^ b for a, b in zip(ct, ks))
+
+    def mac(self, key: bytes, data: bytes) -> bytes:
+        return hmac.new(key, data, hashlib.sha256).digest()
+
+
+_PROVIDERS: dict[str, CryptoProvider] = {}
+
+
+def register(provider: CryptoProvider) -> None:
+    if provider.name in _PROVIDERS:
+        raise FileExistsError(
+            "crypto provider %r already registered" % provider.name)
+    _PROVIDERS[provider.name] = provider
+
+
+def providers() -> list[str]:
+    return sorted(_PROVIDERS)
+
+
+def create(name: str = "stdlib") -> CryptoProvider:
+    p = _PROVIDERS.get(name)
+    if p is None:
+        raise FileNotFoundError(
+            2, "crypto provider %r not found (have: %s)"
+            % (name, ", ".join(providers())))
+    return p
+
+
+register(StdlibProvider())

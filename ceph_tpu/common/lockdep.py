@@ -7,6 +7,12 @@ lock already held by the thread; an edge that closes a cycle in the
 global order graph is a potential deadlock and is reported with both
 acquisition sites.
 
+Names are per-INSTANCE (e.g. "pg:1.3", "osd:2"), so inversions
+between two locks of the same class — the classic PG-A/PG-B deadlock —
+are visible, and a pgA->osd, osd->pgB chain is not falsely aliased
+into a pg<->osd cycle. (The reference registers by name string too;
+instance-unique names are what make that sound.)
+
 Usage: the daemon code creates its locks through make_rlock(name).
 With lockdep disabled (the default) that returns a plain
 threading.RLock — zero overhead. Enabled (enable(), or the

@@ -46,7 +46,7 @@ class Monitor(Dispatcher):
         self.elector = Elector(self)
         self.paxos = Paxos(self, self.store)
         self.osdmon = OSDMonitor(self)
-        self._lock = make_rlock("mon")
+        self._lock = make_rlock("mon:%d" % rank)
         self._propose_pending = False
         self._subscribers: dict = {}        # addr -> last epoch sent
         self._cmd_replies: dict = {}        # (requester, tid) -> reply

@@ -137,7 +137,7 @@ class Paxos:
     def __init__(self, mon, store):
         self.mon = mon
         self.store = store
-        self._lock = make_rlock("paxos")
+        self._lock = make_rlock("paxos:%d" % mon.rank)
         self.state = STATE_RECOVERING
         # durable state (reload so promises survive a restart)
         self.last_committed = self._load_int("last_committed")

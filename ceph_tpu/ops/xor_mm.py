@@ -90,8 +90,18 @@ def matrix_encode(bitmat: jax.Array, data: jax.Array, w: int) -> jax.Array:
     """
     if w == 8 and data.ndim == 3 and _pallas_enabled():
         from . import pallas_gf
-        if data.shape[-1] % pallas_gf._TILE_N == 0:
+        n = data.shape[-1]
+        pad = (-n) % pallas_gf._TILE_N
+        if pad == 0:
             return pallas_gf.matrix_encode8(bitmat, data)
+        if n >= pallas_gf._TILE_N:
+            # ragged tail: zero-pad to the tile (zeros are the XOR
+            # identity, so the padded columns encode to zeros) and
+            # slice back — the whole w=8 shape family rides the fused
+            # kernel, not just exact multiples
+            padded = jnp.pad(data, ((0, 0), (0, 0), (0, pad)))
+            return pallas_gf.matrix_encode8(bitmat, padded)[..., :n]
+        # tiny chunks (< one tile): the XLA path wins
     bits = unpack_element_bits(data, w)
     out_bits = xor_matmul(bitmat, bits)
     return pack_element_bits(out_bits, w)

@@ -83,7 +83,7 @@ class ECBackend:
                                         stripe_width)
         self.cache = ExtentCache()
         self._tids = itertools.count(1)
-        self.lock = make_rlock("ec-backend")
+        self.lock = make_rlock("ec-backend:%s" % (pg.pgid,))
         # the three wait queues (ECBackend.h:561-563)
         self.waiting_state: list[_InflightWrite] = []
         self.waiting_reads: list[_InflightWrite] = []

@@ -52,7 +52,7 @@ class OSDDaemon(Dispatcher):
                                     "osd.%d" % whoami)
         self.osdmap = OSDMap()
         self.pgs: dict = {}
-        self.lock = make_rlock("osd")
+        self.lock = make_rlock("osd:%d" % whoami)
         # op scheduling: QoS discipline per osd_op_queue (wpq default,
         # like the reference's luminous OSD), plain FIFO as fallback
         if conf.get_val("osd_op_queue") == "fifo":

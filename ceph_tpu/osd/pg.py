@@ -71,7 +71,7 @@ class PG:
         self.pool = pool
         self.whoami = daemon.whoami
         self.store = daemon.store
-        self.lock = make_rlock("pg")
+        self.lock = make_rlock("pg:%s" % (pgid,))
         self.acting: list[int] = []
         self.acting_primary = -1
         self.up: list[int] = []

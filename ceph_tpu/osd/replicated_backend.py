@@ -30,7 +30,7 @@ class ReplicatedBackend:
     def __init__(self, pg):
         self.pg = pg
         self._tids = itertools.count(1)
-        self.lock = make_rlock("rep-backend")
+        self.lock = make_rlock("rep-backend:%s" % (pg.pgid,))
         self.inflight: dict[int, _Inflight] = {}
 
     # -- write ---------------------------------------------------------

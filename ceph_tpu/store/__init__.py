@@ -12,6 +12,8 @@
                 metadata, per-chunk checksums, deferred small writes,
                 COW clones — the BlueStore analog
                 (src/os/bluestore/BlueStore.cc, doc/dev/bluestore.rst)
+  k_store       KStore: everything-in-kv backend (stripe keys for
+                data, prefixed metadata) — src/os/kstore/KStore.cc
   kv            KeyValueDB interface + MemDB + persistent FileDB
                 (src/kv/)
 """
@@ -20,7 +22,8 @@ from .object_store import ObjectStore, Transaction
 from .mem_store import MemStore
 from .file_store import FileStore
 from .block_store import BlockStore
+from .k_store import KStore
 from .kv import FileDB, KeyValueDB, MemDB
 
 __all__ = ["ObjectStore", "Transaction", "MemStore", "FileStore",
-           "BlockStore", "KeyValueDB", "MemDB", "FileDB"]
+           "BlockStore", "KStore", "KeyValueDB", "MemDB", "FileDB"]

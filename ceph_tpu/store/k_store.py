@@ -1,0 +1,366 @@
+"""KStore — ObjectStore over a KeyValueDB.
+
+Role of the reference's KStore (src/os/kstore/KStore.cc): the
+"everything in the kv store" backend — object data lives in
+fixed-size stripe keys, metadata (onodes, omap, collections) in
+prefixed namespaces, and every transaction is one atomic kv batch.
+Simpler than BlueStore (no allocator, no raw device) at the cost of
+writing data through the kv engine; the reference keeps it as the
+reference implementation of the kv-centric design.
+
+Layout (prefix -> key):
+  C / <ckey>                collection marker
+  O / <okey>                onode: {cid, oid, size, xattrs}
+  D / <okey>:<stripe#016x>  one stripe of object data
+  M / <okey>:<omap-key-hex> omap values
+
+Stripe size default 64 KiB (kstore_default_stripe_size)."""
+
+from __future__ import annotations
+
+import threading
+
+from .. import encoding
+from .block_store import _ckey, _okey
+from .kv import FileDB
+from .object_store import ObjectStore, Transaction
+
+__all__ = ["KStore"]
+
+STRIPE = 64 * 1024
+
+
+class KStore(ObjectStore):
+    def __init__(self, path: str, kv_sync: bool = True,
+                 stripe_size: int = STRIPE, finisher=None):
+        self.path = path
+        self.stripe = stripe_size
+        self.db = FileDB(path, log_sync=kv_sync)
+        self._finisher = finisher
+        self._lock = threading.RLock()
+        self._colls: dict = {}        # ckey -> cid
+        self._onodes: dict = {}       # okey -> {cid, oid, size, xattrs}
+        self._pending: dict | None = None   # intra-txn stripe overlay
+        self._pending_m: dict | None = None  # intra-txn omap overlay
+        self.mounted = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def mount(self) -> None:
+        import os
+        os.makedirs(self.path, exist_ok=True)
+        self.db.open()
+        for key, raw in self.db.get_iterator("C"):
+            self._colls[key] = encoding.decode_any(raw)
+        for key, raw in self.db.get_iterator("O"):
+            self._onodes[key] = encoding.decode_any(raw)
+        self.mounted = True
+
+    def umount(self) -> None:
+        if self.mounted:
+            self.db.close()
+            self.mounted = False
+
+    def sync(self) -> None:
+        self.db.compact()
+
+    # -- transaction apply --------------------------------------------
+
+    def queue_transaction(self, txn: Transaction) -> None:
+        if not self.mounted:
+            raise RuntimeError("KStore not mounted")
+        with self._lock:
+            batch = self.db.get_transaction()
+            # stripes and omap keys written earlier in THIS
+            # transaction must be visible to later reads (RMW, clone)
+            # before the batch commits
+            self._pending = {}
+            self._pending_m = {}
+            try:
+                for op in txn.ops:
+                    self._apply_op(op, batch)
+            finally:
+                self._pending = None
+                self._pending_m = None
+            self.db.submit_transaction(batch)
+        for cb in txn.on_commit:
+            self._complete(cb)
+        for cb in txn.on_applied:
+            self._complete(cb)
+
+    def _complete(self, cb) -> None:
+        if self._finisher is not None:
+            self._finisher.queue(cb)
+        else:
+            cb()
+
+    # -- onode / stripe plumbing --------------------------------------
+
+    def _get(self, cid, oid, batch=None, create=False) -> dict:
+        key = _okey(cid, oid)
+        onode = self._onodes.get(key)
+        if onode is None:
+            if not create:
+                raise KeyError("no object %r in %r" % (oid, cid))
+            if _ckey(cid) not in self._colls:
+                raise KeyError("no collection %r" % (cid,))
+            onode = self._onodes[key] = {"cid": cid, "oid": oid,
+                                         "size": 0, "xattrs": {}}
+            if batch is not None:
+                self._put(onode, batch)
+        return onode
+
+    def _put(self, onode, batch) -> None:
+        batch.set("O", _okey(onode["cid"], onode["oid"]),
+                  encoding.encode_any(onode))
+
+    @staticmethod
+    def _skey(okey: str, stripe_no: int) -> str:
+        return "%s:%016x" % (okey, stripe_no)
+
+    def _read_stripe(self, okey: str, stripe_no: int) -> bytes:
+        skey = self._skey(okey, stripe_no)
+        pending = getattr(self, "_pending", None)
+        if pending is not None and skey in pending:
+            return pending[skey] or b""
+        raw = self.db.get("D", skey)
+        return raw if raw is not None else b""
+
+    def _write_range(self, onode, offset: int, data: bytes,
+                     batch) -> None:
+        okey = _okey(onode["cid"], onode["oid"])
+        pos = 0
+        while pos < len(data):
+            sno = (offset + pos) // self.stripe
+            soff = (offset + pos) % self.stripe
+            n = min(self.stripe - soff, len(data) - pos)
+            cur = bytearray(self._read_stripe(okey, sno))
+            if len(cur) < soff + n:
+                cur += b"\0" * (soff + n - len(cur))
+            cur[soff:soff + n] = data[pos:pos + n]
+            skey = self._skey(okey, sno)
+            batch.set("D", skey, bytes(cur))
+            if self._pending is not None:
+                self._pending[skey] = bytes(cur)
+            pos += n
+        onode["size"] = max(onode["size"], offset + len(data))
+        self._put(onode, batch)
+
+    def _truncate(self, onode, size: int, batch) -> None:
+        okey = _okey(onode["cid"], onode["oid"])
+        old = onode["size"]
+        if size < old:
+            first_dead = -(-size // self.stripe)
+            for sno in range(first_dead, -(-old // self.stripe)):
+                skey = self._skey(okey, sno)
+                batch.rmkey("D", skey)
+                if self._pending is not None:
+                    self._pending[skey] = b""
+            if size % self.stripe:
+                sno = size // self.stripe
+                cur = self._read_stripe(okey, sno)[:size % self.stripe]
+                skey = self._skey(okey, sno)
+                batch.set("D", skey, cur)
+                if self._pending is not None:
+                    self._pending[skey] = cur
+        onode["size"] = size
+        self._put(onode, batch)
+
+    def _remove(self, cid, oid, batch) -> None:
+        key = _okey(cid, oid)
+        onode = self._onodes.pop(key, None)
+        if onode is None:
+            return
+        for sno in range(-(-onode["size"] // self.stripe)):
+            skey = self._skey(key, sno)
+            batch.rmkey("D", skey)
+            if self._pending is not None:
+                self._pending[skey] = b""
+        for mkey in self._omap_keys(key):
+            batch.rmkey("M", mkey)
+            if self._pending_m is not None:
+                self._pending_m[mkey] = None
+        batch.rmkey("O", key)
+
+    def _omap_keys(self, okey: str) -> list:
+        """All live M keys of an object: committed plus the current
+        transaction's overlay (same-txn writes must be removable and
+        same-txn removals must not resurrect)."""
+        keys = set()
+        for mkey, _ in self.db.lower_bound("M", okey + ":"):
+            if not mkey.startswith(okey + ":"):
+                break
+            keys.add(mkey)
+        if self._pending_m is not None:
+            for mkey, val in self._pending_m.items():
+                if mkey.startswith(okey + ":"):
+                    if val is None:
+                        keys.discard(mkey)
+                    else:
+                        keys.add(mkey)
+        return sorted(keys)
+
+    def _apply_op(self, op, batch) -> None:
+        kind = op[0]
+        if kind == "create_collection":
+            ck = _ckey(op[1])
+            self._colls[ck] = op[1]
+            batch.set("C", ck, encoding.encode_any(op[1]))
+        elif kind == "remove_collection":
+            cid = op[1]
+            for key in [k for k, o in self._onodes.items()
+                        if o["cid"] == cid]:
+                onode = self._onodes[key]
+                self._remove(cid, onode["oid"], batch)
+            ck = _ckey(cid)
+            self._colls.pop(ck, None)
+            batch.rmkey("C", ck)
+        elif kind == "touch":
+            self._get(op[1], op[2], batch, create=True)
+        elif kind == "write":
+            _, cid, oid, offset, data = op
+            onode = self._get(cid, oid, batch, create=True)
+            self._write_range(onode, offset, bytes(data), batch)
+        elif kind == "zero":
+            _, cid, oid, offset, length = op
+            onode = self._get(cid, oid, batch, create=True)
+            self._write_range(onode, offset, b"\0" * length, batch)
+        elif kind == "truncate":
+            _, cid, oid, size = op
+            onode = self._get(cid, oid, batch, create=True)
+            self._truncate(onode, size, batch)
+        elif kind == "remove":
+            # tolerant like MemStore's pop(oid, None)
+            self._remove(op[1], op[2], batch)
+        elif kind in ("clone", "clone_data"):
+            if kind == "clone":
+                _, cid, src_oid, dst_oid = op
+                if src_oid == dst_oid:
+                    return
+                src = self._get(cid, src_oid)
+                data = self.read(cid, src_oid)
+                xattrs = dict(src["xattrs"])
+                omap = self.omap_get(cid, src_oid)
+            else:
+                _, cid, dst_oid, data, xattrs, omap = op
+            if _okey(cid, dst_oid) in self._onodes:
+                self._remove(cid, dst_oid, batch)
+            dst = self._get(cid, dst_oid, batch, create=True)
+            if data:
+                self._write_range(dst, 0, bytes(data), batch)
+            dst["size"] = len(data)
+            dst["xattrs"] = dict(xattrs)
+            self._put(dst, batch)
+            self._omap_set(cid, dst_oid, omap, batch)
+        elif kind in ("move_rename", "move_data"):
+            src_cid, src_oid, dst_cid, dst_oid = op[1:5]
+            if (src_cid, src_oid) == (dst_cid, dst_oid):
+                return
+            skey = _okey(src_cid, src_oid)
+            if skey not in self._onodes:
+                if kind == "move_data":
+                    _, _, _, _, _, data, xattrs, omap = op
+                    self._apply_op(("clone_data", dst_cid, dst_oid,
+                                    data, xattrs, omap), batch)
+                    return
+                raise KeyError("no object %r in %r"
+                               % (src_oid, src_cid))
+            src = self._onodes[skey]
+            data = self.read(src_cid, src_oid)
+            xattrs = dict(src["xattrs"])
+            omap = self.omap_get(src_cid, src_oid)
+            self._remove(src_cid, src_oid, batch)
+            self._apply_op(("clone_data", dst_cid, dst_oid, data,
+                            xattrs, omap), batch)
+        elif kind == "setattr":
+            _, cid, oid, name, value = op
+            onode = self._get(cid, oid, batch, create=True)
+            onode["xattrs"][name] = value
+            self._put(onode, batch)
+        elif kind == "rmattr":
+            onode = self._get(op[1], op[2])
+            onode["xattrs"].pop(op[3], None)
+            self._put(onode, batch)
+        elif kind == "omap_setkeys":
+            _, cid, oid, kv = op
+            self._get(cid, oid, batch, create=True)
+            self._omap_set(cid, oid, kv, batch)
+        elif kind == "omap_rmkeys":
+            _, cid, oid, keys = op
+            self._get(cid, oid)
+            okey = _okey(cid, oid)
+            for k in keys:
+                mkey = okey + ":" + encoding.encode_any(k).hex()
+                batch.rmkey("M", mkey)
+                if self._pending_m is not None:
+                    self._pending_m[mkey] = None
+        else:
+            raise ValueError("unknown op %r" % kind)
+
+    def _omap_set(self, cid, oid, kv: dict, batch) -> None:
+        okey = _okey(cid, oid)
+        for k, v in kv.items():
+            mkey = okey + ":" + encoding.encode_any(k).hex()
+            raw = encoding.encode_any(v)
+            batch.set("M", mkey, raw)
+            if self._pending_m is not None:
+                self._pending_m[mkey] = raw
+
+    # -- reads ---------------------------------------------------------
+
+    def read(self, cid, oid, offset: int = 0, length: int = 0) -> bytes:
+        with self._lock:
+            onode = self._get(cid, oid)
+            if length == 0:
+                length = max(0, onode["size"] - offset)
+            length = max(0, min(length, onode["size"] - offset))
+            okey = _okey(cid, oid)
+            out = bytearray()
+            pos = offset
+            end = offset + length
+            while pos < end:
+                sno = pos // self.stripe
+                soff = pos % self.stripe
+                n = min(self.stripe - soff, end - pos)
+                stripe = self._read_stripe(okey, sno)
+                piece = stripe[soff:soff + n]
+                out += piece + b"\0" * (n - len(piece))
+                pos += n
+            return bytes(out)
+
+    def stat(self, cid, oid) -> dict | None:
+        with self._lock:
+            onode = self._onodes.get(_okey(cid, oid))
+            return {"size": onode["size"]} if onode is not None else None
+
+    def exists(self, cid, oid) -> bool:
+        return self.stat(cid, oid) is not None
+
+    def getattr(self, cid, oid, name: str):
+        with self._lock:
+            return self._get(cid, oid)["xattrs"].get(name)
+
+    def omap_get(self, cid, oid) -> dict:
+        with self._lock:
+            self._get(cid, oid)
+            okey = _okey(cid, oid)
+            out = {}
+            for mkey in self._omap_keys(okey):
+                raw = (self._pending_m.get(mkey)
+                       if self._pending_m is not None
+                       and mkey in self._pending_m
+                       else self.db.get("M", mkey))
+                if raw is None:
+                    continue
+                user = bytes.fromhex(mkey[len(okey) + 1:])
+                out[encoding.decode_any(user)] = encoding.decode_any(raw)
+            return out
+
+    def list_objects(self, cid) -> list:
+        with self._lock:
+            return sorted(o["oid"] for o in self._onodes.values()
+                          if o["cid"] == cid)
+
+    def list_collections(self) -> list:
+        with self._lock:
+            return sorted(self._colls.values())

@@ -214,7 +214,10 @@ def main(argv=None) -> int:
             if not colls:
                 raise SystemExit("pgid %s not present" % args.pgid)
             cid = next((c for c in colls
-                        if args.oid in store.list_objects(c)), colls[0])
+                        if args.oid in store.list_objects(c)), None)
+            if cid is None:
+                raise SystemExit("object %r not present in pg %s"
+                                 % (args.oid, args.pgid))
             if args.op == "get-bytes":
                 data = store.read(cid, args.oid)
                 out = (sys.stdout.buffer if args.file == "-"
@@ -226,9 +229,11 @@ def main(argv=None) -> int:
                 from ..store.object_store import Transaction
                 with open(args.file, "rb") as f:
                     data = f.read()
+                # truncate+write replaces the PAYLOAD only — xattrs and
+                # omap survive, like the reference tool's do_set_bytes
+                # (a repair must not strip the object's metadata)
                 txn = Transaction()
-                txn.remove(cid, args.oid)
-                txn.touch(cid, args.oid)
+                txn.truncate(cid, args.oid, 0)
                 if data:
                     txn.write(cid, args.oid, 0, data)
                 store.queue_transaction(txn)

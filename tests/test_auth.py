@@ -323,3 +323,51 @@ class TestMonAuthFlow:
                 msgr.shutdown()
         finally:
             mon.shutdown()
+
+
+class TestCryptoProviderSlot:
+    def test_registry_contract(self):
+        from ceph_tpu.auth import crypto
+        assert "stdlib" in crypto.providers()
+        with pytest.raises(FileNotFoundError):
+            crypto.create("isal-not-built")
+        with pytest.raises(FileExistsError):
+            crypto.register(crypto.StdlibProvider())
+
+    def test_seal_roundtrip_and_tamper(self):
+        from ceph_tpu.auth import crypto
+        p = crypto.create("stdlib")
+        key = b"k" * 32
+        blob = p.seal(key, b"secret payload")
+        assert p.unseal(key, blob) == b"secret payload"
+        from ceph_tpu.auth.cephx import AuthError
+        bad = bytearray(blob)
+        bad[20] ^= 1
+        with pytest.raises(AuthError):
+            p.unseal(key, bytes(bad))
+
+    def test_alternate_provider_plugs_into_cephx(self):
+        from ceph_tpu.auth import cephx, crypto
+
+        class XorProvider(crypto.CryptoProvider):
+            name = "xor-test"
+
+            def seal(self, key, pt):
+                return bytes(b ^ key[0] for b in pt)
+
+            def unseal(self, key, blob):
+                return bytes(b ^ key[0] for b in blob)
+
+            def mac(self, key, data):
+                return b"m"
+
+        try:
+            crypto.register(XorProvider())
+        except FileExistsError:
+            pass
+        cephx.set_crypto_provider("xor-test")
+        try:
+            blob = cephx.seal(b"\x42" + b"0" * 31, b"hi")
+            assert blob == bytes(b ^ 0x42 for b in b"hi")
+        finally:
+            cephx.set_crypto_provider("stdlib")

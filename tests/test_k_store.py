@@ -1,0 +1,153 @@
+"""KStore: the everything-in-kv ObjectStore (src/os/kstore role)."""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from ceph_tpu.store.k_store import KStore
+from ceph_tpu.store.mem_store import MemStore
+from ceph_tpu.store.object_store import Transaction
+
+from .test_block_store import TestDropIn as BlockDropIn
+
+
+def make_store(path, **kw):
+    kw.setdefault("kv_sync", False)
+    st = KStore(str(path), **kw)
+    st.mount()
+    return st
+
+
+class TestBasics:
+    def test_roundtrip_and_persistence(self, tmp_path):
+        st = make_store(tmp_path, stripe_size=4096)
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 0, b"v" * 10000)      # spans stripes
+        t.write("c", "o", 5000, b"patch")
+        t.setattr("c", "o", "a", b"x")
+        t.omap_setkeys("c", "o", {"k": b"v"})
+        st.queue_transaction(t)
+        want = bytearray(b"v" * 10000)
+        want[5000:5005] = b"patch"
+        assert st.read("c", "o") == bytes(want)
+        st.umount()
+
+        st2 = make_store(tmp_path, stripe_size=4096)
+        assert st2.read("c", "o") == bytes(want)
+        assert st2.getattr("c", "o", "a") == b"x"
+        assert st2.omap_get("c", "o") == {"k": b"v"}
+        st2.umount()
+
+    def test_truncate_across_stripes(self, tmp_path):
+        st = make_store(tmp_path, stripe_size=1024)
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 0, b"z" * 5000)
+        t.truncate("c", "o", 1500)
+        st.queue_transaction(t)
+        assert st.read("c", "o") == b"z" * 1500
+        t = Transaction()
+        t.truncate("c", "o", 3000)      # re-extend reads zeros
+        st.queue_transaction(t)
+        assert st.read("c", "o") == b"z" * 1500 + b"\0" * 1500
+        st.umount()
+
+
+class TestDropIn(BlockDropIn):
+    """The same randomized differential-vs-MemStore proof the
+    BlockStore passes, re-run against KStore."""
+
+    def test_differential_vs_memstore(self, tmp_path):
+        rng = random.Random(13)
+        mem = MemStore()
+        mem.mount()
+        blk = make_store(tmp_path, stripe_size=8192)
+        t = Transaction()
+        t.create_collection("c")
+        mem.queue_transaction(t)
+        t = Transaction()
+        t.create_collection("c")
+        blk.queue_transaction(t)
+        for round_no in range(25):
+            ops = self._random_ops(rng, rng.randrange(1, 4))
+            for store in (mem, blk):
+                for op in ops:
+                    t = Transaction()
+                    t.ops = [op]
+                    try:
+                        store.queue_transaction(t)
+                    except KeyError:
+                        pass
+            assert mem.list_objects("c") == blk.list_objects("c"), \
+                "round %d" % round_no
+            for oid in mem.list_objects("c"):
+                assert mem.read("c", oid) == blk.read("c", oid), \
+                    (round_no, oid)
+                assert mem.omap_get("c", oid) == blk.omap_get("c", oid)
+        blk.umount()
+
+    def test_missing_object_ops_raise_like_memstore(self, tmp_path):
+        mem = MemStore()
+        mem.mount()
+        blk = make_store(tmp_path)
+        for store in (mem, blk):
+            t = Transaction()
+            t.create_collection("c")
+            store.queue_transaction(t)
+        for op in [("clone", "c", "ghost", "x"),
+                   ("rmattr", "c", "ghost", "a"),
+                   ("omap_rmkeys", "c", "ghost", ["k"]),
+                   ("move_rename", "c", "ghost", "c", "y")]:
+            for store in (mem, blk):
+                t = Transaction()
+                t.ops = [op]
+                with pytest.raises(KeyError):
+                    store.queue_transaction(t)
+        blk.umount()
+
+
+class TestIntraTxnOmap:
+    def test_same_txn_omap_then_clone(self, tmp_path):
+        """Omap keys written earlier in a transaction are visible to a
+        clone later in the same transaction (the M-namespace overlay)."""
+        st = make_store(tmp_path)
+        t = Transaction()
+        t.create_collection("c")
+        t.touch("c", "src")
+        t.omap_setkeys("c", "src", {"k": b"v"})
+        t.clone("c", "src", "dst")
+        st.queue_transaction(t)
+        assert st.omap_get("c", "dst") == {"k": b"v"}
+        st.umount()
+
+    def test_same_txn_rmkeys_then_clone(self, tmp_path):
+        st = make_store(tmp_path)
+        t = Transaction()
+        t.create_collection("c")
+        t.touch("c", "src")
+        t.omap_setkeys("c", "src", {"a": b"1", "b": b"2"})
+        st.queue_transaction(t)
+        t = Transaction()
+        t.omap_rmkeys("c", "src", ["a"])
+        t.clone("c", "src", "dst")
+        st.queue_transaction(t)
+        assert st.omap_get("c", "dst") == {"b": b"2"}
+        st.umount()
+
+    def test_same_txn_setkeys_then_remove_no_orphans(self, tmp_path):
+        st = make_store(tmp_path)
+        t = Transaction()
+        t.create_collection("c")
+        t.touch("c", "o")
+        t.omap_setkeys("c", "o", {"ghost": b"x"})
+        t.remove("c", "o")
+        st.queue_transaction(t)
+        # recreate: the orphan key must not reattach
+        t = Transaction()
+        t.touch("c", "o")
+        st.queue_transaction(t)
+        assert st.omap_get("c", "o") == {}
+        st.umount()

@@ -172,3 +172,27 @@ class TestForceClobbers:
         assert "post-export-ghost" not in st.list_objects(cid)
         assert st.read(cid, "alpha") == b"alpha-bytes"
         st.umount()
+
+
+class TestSetBytesPreservesMeta:
+    def test_set_bytes_keeps_xattrs_and_omap(self, tmp_path, capsys):
+        seeded_store(tmp_path / "osd").umount()
+        newdata = tmp_path / "new.bin"
+        newdata.write_bytes(b"repaired payload")
+        assert ost.main(["--data-path", str(tmp_path / "osd"),
+                         "--op", "set-bytes", "--pgid", "1.0",
+                         "--oid", "alpha",
+                         "--file", str(newdata)]) == 0
+        st = ost.open_store(str(tmp_path / "osd"))
+        cid = ("pg", "1.0", -1)
+        assert st.read(cid, "alpha") == b"repaired payload"
+        assert st.getattr(cid, "alpha", "_v") == b"3"
+        assert st.omap_get(cid, "alpha") == {"k": b"v"}
+        st.umount()
+
+    def test_missing_oid_errors_cleanly(self, tmp_path):
+        seeded_store(tmp_path / "osd").umount()
+        with pytest.raises(SystemExit):
+            ost.main(["--data-path", str(tmp_path / "osd"),
+                      "--op", "get-bytes", "--pgid", "1.0",
+                      "--oid", "typo", "--file", str(tmp_path / "x")])
