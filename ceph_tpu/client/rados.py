@@ -53,6 +53,10 @@ class RadosClient(Dispatcher):
         self._throttle = Throttle(
             "objecter", self.ctx.conf.get_val("objecter_inflight_ops"))
         self._watches: dict = {}      # cookie -> (oid, callback)
+        # per-client nonce: (session, tid) is globally unique even
+        # when client ids and tid counters restart across processes
+        import uuid
+        self.session = uuid.uuid4().hex
 
     # -- lifecycle -----------------------------------------------------
 
@@ -92,7 +96,8 @@ class RadosClient(Dispatcher):
                 op.result = msg.result
                 op.data = msg.data
                 op.event.set()
-                self._throttle.put()
+                # the throttle slot is released by submit_op's finally
+                # (exactly once per op, however many resends/replies)
             return True
         if msg.get_type() == "MWatchNotify":
             with self._lock:
@@ -132,51 +137,64 @@ class RadosClient(Dispatcher):
         deadline = time.monotonic() + timeout
         backoff = 0.05
         fixed_pgid = pgid
-        while True:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise RadosError(110, "op on %r timed out" % oid)
-            if fixed_pgid is not None:
-                pgid = fixed_pgid
-                _, _, _, primary = \
-                    self.osdmap.pg_to_up_acting_osds(pgid)
-            else:
-                pgid, primary = self._target_for(pool_id, oid)
-            if primary == -1:
-                time.sleep(min(backoff, remaining))
-                backoff = min(backoff * 2, 0.5)
-                continue
-            addrs = self.osdmap.get_addr(primary)
-            addr = addrs.get("public") if isinstance(addrs, dict) \
-                else addrs
-            if addr is None:
-                time.sleep(min(backoff, remaining))
-                continue
-            tid = next(self._tids)
-            op = _InflightOp(tid)
-            self._throttle.get()
-            with self._lock:
-                self._inflight[tid] = op
-            self.msgr.send_message(
-                MOSDOp(client_id=self.client_id, tid=tid, pgid=pgid,
-                       oid=oid, ops=ops,
-                       map_epoch=self.osdmap.epoch,
-                       snapc=snapc or (0, ()), snap=snap), addr)
-            # wait a slice, then re-target (map may have changed)
-            if op.event.wait(min(remaining, 1.0)):
-                if op.result == -11:  # EAGAIN: wrong/unready primary
-                    time.sleep(min(backoff, 0.2))
+        # ONE tid for the op's whole lifetime: every resend reuses it,
+        # so the OSD's (client, tid) dedup can recognize retransmits —
+        # a fresh tid per retry would double-apply non-idempotent ops
+        # (append) whenever a reply was merely slow (Objecter reqid
+        # semantics)
+        tid = next(self._tids)
+        op = _InflightOp(tid)
+        self._throttle.get()
+        with self._lock:
+            self._inflight[tid] = op
+        try:
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RadosError(110, "op on %r timed out" % oid)
+                if fixed_pgid is not None:
+                    pgid = fixed_pgid
+                    _, _, _, primary = \
+                        self.osdmap.pg_to_up_acting_osds(pgid)
+                else:
+                    pgid, primary = self._target_for(pool_id, oid)
+                if primary == -1:
+                    time.sleep(min(backoff, remaining))
                     backoff = min(backoff * 2, 0.5)
                     continue
-                return op.result, op.data
+                addrs = self.osdmap.get_addr(primary)
+                addr = addrs.get("public") if isinstance(addrs, dict) \
+                    else addrs
+                if addr is None:
+                    time.sleep(min(backoff, remaining))
+                    continue
+                self.msgr.send_message(
+                    MOSDOp(client_id=self.client_id, tid=tid, pgid=pgid,
+                           oid=oid, ops=ops,
+                           map_epoch=self.osdmap.epoch,
+                           snapc=snapc or (0, ()), snap=snap,
+                           session=self.session), addr)
+                # wait a slice, then re-send (map may have changed)
+                if op.event.wait(min(remaining, 1.0)):
+                    if op.result == -11:  # EAGAIN: wrong/unready primary
+                        with self._lock:
+                            op.event.clear()
+                            op.result = None
+                            self._inflight[tid] = op
+                        time.sleep(min(backoff, 0.2))
+                        backoff = min(backoff * 2, 0.5)
+                        continue
+                    return op.result, op.data
+                with self._lock:
+                    self._inflight[tid] = op   # re-arm for the resend
+                # renew the map subscription too — repeated slice
+                # timeouts often mean our map is stale because the
+                # mon's push was lost on a lossy link
+                self.mon_client.renew_subs()
+        finally:
             with self._lock:
-                dropped = self._inflight.pop(tid, None)
-            if dropped is not None:
-                self._throttle.put()
-            # resend with fresh target; also renew the map subscription
-            # — repeated slice timeouts often mean our map is stale
-            # because the mon's push was lost on a lossy link
-            self.mon_client.renew_subs()
+                self._inflight.pop(tid, None)
+            self._throttle.put()
 
 
 class IoCtx:

@@ -1,0 +1,20 @@
+"""BoundedDict — insertion-ordered dict with size-capped eviction.
+
+The dedup/replay caches (client-op replies, sub-op seen sets) all need
+"record, bounded, oldest-out" semantics; one helper instead of three
+inlined eviction loops."""
+
+from __future__ import annotations
+
+__all__ = ["BoundedDict"]
+
+
+class BoundedDict(dict):
+    def __init__(self, cap: int = 8192):
+        super().__init__()
+        self.cap = cap
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        while len(self) > self.cap:
+            super().__delitem__(next(iter(self)))

@@ -147,6 +147,28 @@ class Monitor(Dispatcher):
         if service == "osdmap":
             self.osdmon.apply_committed(payload)
 
+    # -- full-state sync (paxos trim recovery; Monitor::sync role) -----
+
+    def get_full_state(self) -> bytes:
+        return encoding.encode_any(self.osdmon.osdmap)
+
+    def set_full_state(self, blob: bytes) -> bool:
+        try:
+            newmap = encoding.decode_any(blob)
+        except encoding.DecodeError:
+            return False
+        if not hasattr(newmap, "epoch"):
+            return False
+        if newmap.epoch > self.osdmon.osdmap.epoch:
+            with self.osdmon._lock:
+                self.osdmon.osdmap = newmap
+                self.osdmon.pending = None
+                ids = [p for p in newmap.pools]
+                if ids:
+                    self.osdmon._next_pool_id = max(
+                        self.osdmon._next_pool_id, max(ids) + 1)
+        return True
+
     # -- map publication ----------------------------------------------
 
     def publish_osdmap(self, inc) -> None:

@@ -133,6 +133,10 @@ PN_STRIDE = 100                   # Paxos.cc get_new_proposal_number
 class Paxos:
     LEASE_DURATION = 2.0          # mon_lease (reference default 5s)
     ACCEPT_TIMEOUT = 2.0          # mon_accept_timeout_factor * lease
+    # trim (paxos_min / paxos_trim_tol): keep at least TRIM_MIN
+    # committed versions, trim once the window exceeds TRIM_TOLERANCE
+    TRIM_MIN = 250
+    TRIM_TOLERANCE = 500
 
     def __init__(self, mon, store):
         self.mon = mon
@@ -264,6 +268,8 @@ class Paxos:
         elif op == "catchup":
             # a peon discovered a commit hole: stream it the range
             self.share_state(msg.from_name[1], msg.last_committed)
+        elif op == "full_state":
+            self._handle_full_state(msg)
 
     # -- collect / last (recovery) -------------------------------------
 
@@ -303,6 +309,13 @@ class Paxos:
         peer = msg.from_name[1]
         with self._lock:
             if self.state != STATE_RECOVERING or not self.mon.is_leader():
+                return
+            # a peer whose history starts after our head means our
+            # incremental path was trimmed away there: pull its full
+            # state and re-run the collect once it lands
+            if msg.first_committed > self.last_committed + 1:
+                self.mon.send_mon(peer, MMonPaxos(
+                    op="catchup", last_committed=self.last_committed))
                 return
             # sync commits the peon had and we lack
             for v in sorted(msg.values):
@@ -465,6 +478,16 @@ class Paxos:
         batch = self.store.get_transaction()
         batch.set("paxos", "%016d" % version, value)
         batch.set("paxos", "last_committed", str(version).encode())
+        # trim old versions once the window exceeds tolerance
+        # (Paxos::trim; every mon trims deterministically from its own
+        # watermark, peers too far behind get a full-state sync)
+        if version - self.first_committed > self.TRIM_TOLERANCE:
+            new_first = version - self.TRIM_MIN
+            for v in range(max(self.first_committed, 1), new_first):
+                batch.rmkey("paxos", "%016d" % v)
+            self.first_committed = new_first
+            batch.set("paxos", "first_committed",
+                      str(new_first).encode())
         self.store.submit_transaction(batch)
         self.last_committed = version
         self.mon._on_paxos_commit(version, value)
@@ -591,6 +614,16 @@ class Paxos:
     # -- catch-up (a rejoining peon pulls missed versions) -------------
 
     def share_state(self, rank: int, from_version: int) -> None:
+        if from_version < self.first_committed - 1 \
+                and self.first_committed > 1:
+            # the incremental range was trimmed away: ship the whole
+            # service state instead (the reference's mon store sync)
+            self.mon.send_mon(rank, MMonPaxos(
+                op="full_state", pn=self.accepted_pn,
+                last_committed=self.last_committed,
+                first_committed=self.first_committed,
+                values={0: self.mon.get_full_state()}))
+            return
         values = {}
         for version in range(from_version + 1, self.last_committed + 1):
             raw = self.store.get("paxos", "%016d" % version)
@@ -600,3 +633,25 @@ class Paxos:
             self.mon.send_mon(rank, MMonPaxos(
                 op="commit", pn=self.accepted_pn,
                 last_committed=self.last_committed, values=values))
+
+    def _handle_full_state(self, msg: MMonPaxos) -> None:
+        """Adopt a full service snapshot: we were trimmed past."""
+        with self._lock:
+            if msg.last_committed <= self.last_committed:
+                return
+            if not self.mon.set_full_state(msg.values.get(0, b"")):
+                return   # bad/stale snapshot: keep our watermark
+            # we hold NO incremental history: first == last, so later
+            # catchup requests below it full-sync again instead of
+            # hitting an unservable empty range
+            self.last_committed = msg.last_committed
+            self.first_committed = msg.last_committed
+            self._persist(last_committed=msg.last_committed,
+                          first_committed=msg.last_committed)
+            self._clear_uncommitted()
+            restart = self.mon.is_leader() \
+                and self.state == STATE_RECOVERING
+        if restart:
+            # we were mid-collect on a pre-sync world: run it again
+            with self._lock:
+                self._start_collect()

@@ -77,6 +77,9 @@ class MOSDOp(Message):
     # snapshots (appended fields — compatible evolution):
     snapc: tuple = (0, ())         # write SnapContext (seq, snaps desc)
     snap: int = 0                  # read snap id (0 = head)
+    session: str = ""              # per-client nonce: the dedup key
+                                   # survives client-id/tid reuse
+                                   # across processes
 
 
 @dataclass
@@ -102,6 +105,7 @@ class MOSDECSubOpWrite(Message):
     txn_ops: list = field(default_factory=list)   # store Transaction.ops
     backfill: bool = False
     map_epoch: int = 0
+    instance: str = ""             # sender-incarnation nonce (dedup)
 
 
 @dataclass
@@ -148,6 +152,7 @@ class MOSDRepOp(Message):
     log_entries: list = field(default_factory=list)
     txn_ops: list = field(default_factory=list)
     map_epoch: int = 0
+    instance: str = ""             # sender-incarnation nonce (dedup)
 
 
 @dataclass

@@ -33,6 +33,7 @@ import threading
 
 import numpy as np
 
+from ..common.bounded import BoundedDict
 from ..common.interval_set import ExtentMap, IntervalSet
 from ..common.lockdep import make_rlock
 from ..msg.message import (MOSDECSubOpRead, MOSDECSubOpReadReply,
@@ -90,6 +91,9 @@ class ECBackend:
         self.waiting_commit: list[_InflightWrite] = []
         self.inflight_reads: dict = {}
         self.hinfo_cache: dict = {}
+        import uuid
+        self.instance = uuid.uuid4().hex  # incarnation nonce (dedup)
+        self._sub_seen: BoundedDict = BoundedDict()  # key -> committed?
 
     # -- geometry ------------------------------------------------------
 
@@ -118,9 +122,10 @@ class ECBackend:
     # =================================================================
 
     def submit_transaction(self, pg_txn, at_version: int,
-                           on_commit) -> int:
+                           on_commit, reqid: tuple = ("", 0)) -> int:
         tid = next(self._tids)
         op = _InflightWrite(tid, pg_txn, at_version, on_commit)
+        op.reqid = reqid
         with self.lock:
             self.waiting_state.append(op)
         self.check_ops()
@@ -198,8 +203,10 @@ class ECBackend:
             op.pending_commits = {s for s, osd in shards.items()
                                   if osd != CRUSH_ITEM_NONE}
             self.waiting_commit.append(op)
-            log_entry = self.pg.mint_log_entries(op.plan.t.op_map,
-                                                 op.at_version)
+            log_entry = self.pg.mint_log_entries(
+                op.plan.t.op_map, op.at_version,
+                getattr(op, "reqid", ("", 0)))
+        op.sub_msgs = {}
         for shard, osd in shards.items():
             if osd == CRUSH_ITEM_NONE:
                 continue
@@ -207,12 +214,46 @@ class ECBackend:
                 pgid=self.pg.pgid, shard=shard, from_osd=self.pg.whoami,
                 tid=op.tid, at_version=op.at_version,
                 log_entries=log_entry,
-                txn_ops=txns[shard].ops, map_epoch=self.pg.map_epoch())
+                txn_ops=txns[shard].ops, map_epoch=self.pg.map_epoch(),
+                instance=self.instance)
+            op.sub_msgs[shard] = (osd, msg)
             if osd == self.pg.whoami:
                 self.handle_sub_write(msg, local=True)
             else:
                 self.pg.send_to_osd(osd, msg)
+        # at-least-once: re-fan-out to unacked shards until done (a
+        # dropped sub-op must not wedge the write; replicas dedup)
+        self.pg.daemon.timer.add_event_after(
+            1.0, self._retry_sub_writes, op.tid)
         return True
+
+    def _retry_sub_writes(self, tid: int) -> None:
+        shards_now = self.pg.acting_shards()
+        target = None
+        with self.lock:
+            op = next((o for o in self.waiting_commit
+                       if o.tid == tid), None)
+            if op is None:
+                return                 # completed
+            msgs = dict(getattr(op, "sub_msgs", {}))
+            # shards whose OSD left the acting set can never ack:
+            # stop waiting (peering roll-forward owns them now)
+            for shard in list(op.pending_commits):
+                osd, _ = msgs.get(shard, (None, None))
+                if osd is None or shards_now.get(shard) != osd:
+                    op.pending_commits.discard(shard)
+            pending = set(op.pending_commits)
+            if not pending:
+                target = op
+        if target is not None:
+            self._try_finish_rmw(target)
+            return
+        for shard in pending:
+            osd, msg = msgs.get(shard, (None, None))
+            if msg is not None and osd != self.pg.whoami:
+                self.pg.send_to_osd(osd, msg)
+        self.pg.daemon.timer.add_event_after(
+            1.0, self._retry_sub_writes, tid)
 
     def _try_finish_rmw(self, op) -> None:
         with self.lock:
@@ -229,7 +270,28 @@ class ECBackend:
     # -- replica side --------------------------------------------------
 
     def handle_sub_write(self, msg, local: bool = False) -> None:
-        """Apply a shard transaction + log, then ack (:917-979)."""
+        """Apply a shard transaction + log, then ack (:917-979).
+        Retransmits (the primary's at-least-once fan-out) replay the
+        ack without re-applying."""
+        key = (getattr(msg, "instance", "") or msg.from_osd,
+               msg.tid, msg.shard)
+        with self.lock:
+            state = self._sub_seen.get(key)
+            if state is None:
+                self._sub_seen[key] = False   # received, uncommitted
+        if state is not None:
+            # replay the ack only for a COMMITTED original; an
+            # in-flight one acks by itself when its commit lands
+            if state:
+                reply = MOSDECSubOpWriteReply(
+                    pgid=self.pg.pgid, shard=msg.shard,
+                    from_osd=self.pg.whoami, tid=msg.tid,
+                    committed=True, applied=True)
+                if local:
+                    self.handle_sub_write_reply(reply)
+                else:
+                    self.pg.send_to_osd(msg.from_osd, reply)
+            return
         txn = Transaction()
         txn.ops = list(msg.txn_ops)
         # log keys ride the same store transaction as the shard data
@@ -238,6 +300,8 @@ class ECBackend:
         done = threading.Event()
 
         def on_commit():
+            with self.lock:
+                self._sub_seen[key] = True
             reply = MOSDECSubOpWriteReply(
                 pgid=self.pg.pgid, shard=msg.shard,
                 from_osd=self.pg.whoami, tid=msg.tid,

@@ -52,6 +52,9 @@ class OSDDaemon(Dispatcher):
                                     "osd.%d" % whoami)
         self.osdmap = OSDMap()
         self.pgs: dict = {}
+        # (session, tid) -> None (executing) | (result, data)
+        from ..common.bounded import BoundedDict
+        self._op_replies: BoundedDict = BoundedDict()
         self.lock = make_rlock("osd:%d" % whoami)
         # op scheduling: QoS discipline per osd_op_queue (wpq default,
         # like the reference's luminous OSD), plain FIFO as fallback
@@ -311,9 +314,38 @@ class OSDDaemon(Dispatcher):
             return True
         return False
 
+    WRITE_OP_KINDS = frozenset((
+        "create", "write", "writefull", "append", "zero", "truncate",
+        "remove", "setxattr", "rmxattr", "omap_set", "omap_rm",
+        "rollback", "call"))
+
     def _enqueue_client_op(self, msg) -> None:
         pg = self._get_pg(msg.pgid and self._normalize_pgid(msg.pgid))
         client_addr = msg.from_addr
+        # retransmit dedup for non-idempotent ops (the client resends
+        # with the SAME tid on slow replies): an op still executing is
+        # dropped (the eventual reply satisfies the client); a finished
+        # one replays its recorded reply (PG log reqid dedup role)
+        mutating = any(op and op[0] in self.WRITE_OP_KINDS
+                       for op in msg.ops)
+        dedup_key = ((getattr(msg, "session", "") or msg.client_id,
+                      msg.tid) if mutating else None)
+        if dedup_key is not None:
+            with self.lock:
+                cached = self._op_replies.get(dedup_key, False)
+                if cached is False:
+                    # atomically claim execution (a racing duplicate
+                    # must not also execute)
+                    self._op_replies[dedup_key] = None
+            if cached is None:
+                return                 # in flight: drop the duplicate
+            if cached is not False:
+                self.public_msgr.send_message(
+                    MOSDOpReply(tid=msg.tid, result=cached[0],
+                                data=cached[1],
+                                map_epoch=self.map_epoch()),
+                    client_addr)
+                return
         op = self.op_tracker.create_request(
             "osd_op(tid=%s pg=%s %s)" % (msg.tid, msg.pgid,
                                          getattr(msg, "op", "?")))
@@ -331,6 +363,14 @@ class OSDDaemon(Dispatcher):
             if replied[0]:
                 return
             replied[0] = True
+            if dedup_key is not None:
+                with self.lock:
+                    if result == -11:
+                        # EAGAIN is not an outcome: the client retries
+                        # the same tid and it must execute next time
+                        self._op_replies.pop(dedup_key, None)
+                    else:
+                        self._op_replies[dedup_key] = (result, data)
             self.perf.tinc("op_latency", op.duration)
             op.mark_commit_sent()
             self.public_msgr.send_message(

@@ -92,6 +92,10 @@ class PG:
         self.missing: dict = {}       # oid -> version we need
         self._missing_src: dict = {}  # oid -> osd holding it
         self._missing_waiters: dict = {}   # oid -> [continuations]
+        # reqid -> version, rebuilt from the log: the failover-safe
+        # client-retransmit dedup (pg_log_entry_t::reqid role)
+        from ..common.bounded import BoundedDict
+        self._reqids: BoundedDict = BoundedDict()
         self._trimmed_snaps: set = set()
         # watch/notify (PrimaryLogPG watchers; volatile on the primary,
         # clients re-watch after a primary change like the Objecter's
@@ -170,16 +174,20 @@ class PG:
 
     PG_LOG_CAP = 5000
 
-    def mint_log_entries(self, op_map, at_version: int) -> list:
-        """Wire-form entries for a write being submitted: (version,
-        oid, kind, epoch, prior_version) — the eversion's epoch half is
-        what lets a later merge tell two same-numbered forks apart."""
+    def mint_log_entries(self, op_map, at_version: int,
+                         reqid: tuple = ("", 0)) -> list:
+        """Wire-form entries for a write being submitted: (epoch,
+        version, oid, kind, prior, session, tid). The epoch half of
+        the eversion lets a later merge tell two same-numbered forks
+        apart; the reqid rides REPLICATED so any future primary can
+        dedup a client retransmit (exactly-once across failover)."""
         epoch = self.map_epoch()
         out = []
         for oid, obj_op in op_map.items():
             kind = "delete" if obj_op.is_delete() else "modify"
             prior = self._object_version(oid)
-            out.append((epoch, at_version, oid, kind, prior))
+            out.append((epoch, at_version, oid, kind, prior,
+                        reqid[0], reqid[1]))
         return out
 
     def _object_version(self, oid) -> int:
@@ -202,6 +210,8 @@ class PG:
             for entry in entries:
                 dropped.extend(self.pg_log.append(entry))
                 self.missing.pop(entry.oid, None)
+                if entry.reqid[0]:
+                    self._reqids[tuple(entry.reqid)] = entry.version
                 v, oid, kind = entry.version, entry.oid, entry.kind
                 if kind == "delete":
                     # divergence oracle for the scan/backfill lane:
@@ -267,6 +277,13 @@ class PG:
             txn.omap_setkeys(cid, META_OID, kv)
         self.store.queue_transaction(txn)
 
+    def _rebuild_reqids(self) -> None:
+        with self.lock:
+            self._reqids.clear()
+            for e in self.pg_log.entries:
+                if e.reqid[0]:
+                    self._reqids[tuple(e.reqid)] = e.version
+
     def _load_log(self) -> None:
         try:
             omap = self.store.omap_get(self._meta_cid(), META_OID)
@@ -282,6 +299,7 @@ class PG:
         if rows:
             rows.sort(key=lambda r: (r[0], r[1]))
             self.pg_log.load(rows)
+            self._rebuild_reqids()
 
     def _ensure_collections(self) -> None:
         txn = Transaction()
@@ -353,6 +371,17 @@ class PG:
         if not self.is_primary():
             reply_fn(-11, None)  # EAGAIN: wrong primary / not peered
             return
+        # a retransmit of a write some past primary already committed
+        # (the reqid rides the replicated log) replays its outcome —
+        # the exactly-once guarantee must survive failover, not just
+        # live in one daemon's memory
+        session = getattr(msg, "session", "")
+        if session:
+            with self.lock:
+                done_v = self._reqids.get((session, msg.tid))
+            if done_v is not None:
+                reply_fn(0, done_v)
+                return
         # an object we know we're missing must be recovered before any
         # op touches it — serving the local copy would expose stale
         # bytes for an acked write (PrimaryLogPG wait_for_missing).
@@ -848,7 +877,8 @@ class PG:
             t.setattr(oid, VERSION_ATTR, str(version).encode())
             t.setattr(oid, "_size", str(logical_size).encode())
         self.backend.submit_transaction(
-            t, version, lambda: reply_fn(0, version))
+            t, version, lambda: reply_fn(0, version),
+            reqid=(getattr(msg, "session", ""), msg.tid))
 
     # -- peering: GetInfo / GetLog / GetMissing ------------------------
 
@@ -1037,6 +1067,7 @@ class PG:
                 self.last_version = max(self.last_version,
                                         self.pg_log.head[1])
             self._persist_log_full()
+            self._rebuild_reqids()
             self._apply_log_updates(updates, msg.from_osd, divergent)
             self._activate(seq)
             return
@@ -1047,6 +1078,7 @@ class PG:
             self.last_version = max(self.last_version,
                                     self.pg_log.head[1])
         self._persist_log_full()
+        self._rebuild_reqids()
         need = self._apply_log_updates(updates, msg.from_osd, divergent,
                                        pull=False)
         self.send_to_osd(msg.from_osd, MOSDPGNotify(

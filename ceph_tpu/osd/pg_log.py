@@ -34,12 +34,15 @@ __all__ = ["LogEntry", "PGLog", "entry_from_tuple"]
 
 @dataclass
 class LogEntry:
-    """One journaled PG operation (pg_log_entry_t)."""
+    """One journaled PG operation (pg_log_entry_t). reqid carries the
+    client's (session, tid) so a new primary can dedup retransmits
+    across failover (pg_log_entry_t::reqid exactly-once role)."""
     epoch: int = 0
     version: int = 0
     oid: str = ""
     kind: str = "modify"          # modify | delete
     prior_version: int = 0
+    reqid: tuple = ("", 0)
 
     @property
     def ev(self) -> tuple:
@@ -47,10 +50,14 @@ class LogEntry:
 
 
 def entry_from_tuple(t) -> LogEntry:
-    """Canonical wire/durable row: (epoch, version, oid, kind, prior).
-    Legacy 3-tuples (version, oid, kind) still parse (epoch 0)."""
+    """Canonical wire/durable row: (epoch, version, oid, kind, prior
+    [, session, tid]). Legacy 3-tuples (version, oid, kind) still
+    parse (epoch 0)."""
     if isinstance(t, LogEntry):
         return t
+    if len(t) >= 7:
+        return LogEntry(epoch=t[0], version=t[1], oid=t[2], kind=t[3],
+                        prior_version=t[4], reqid=(t[5], t[6]))
     if len(t) >= 5:
         return LogEntry(epoch=t[0], version=t[1], oid=t[2], kind=t[3],
                         prior_version=t[4])
@@ -194,13 +201,11 @@ class PGLog:
     # -- (de)serialization ---------------------------------------------
 
     def dump(self) -> list:
-        return [(e.epoch, e.version, e.oid, e.kind, e.prior_version)
-                for e in self.entries]
+        return [(e.epoch, e.version, e.oid, e.kind, e.prior_version,
+                 e.reqid[0], e.reqid[1]) for e in self.entries]
 
     def load(self, rows: list) -> None:
-        self.entries = [LogEntry(epoch=r[0], version=r[1], oid=r[2],
-                                 kind=r[3], prior_version=r[4])
-                        for r in rows]
+        self.entries = [entry_from_tuple(r) for r in rows]
         self.entries.sort(key=lambda e: e.ev)
         if self.entries:
             self.head = self.entries[-1].ev

@@ -12,6 +12,7 @@ from __future__ import annotations
 import itertools
 import threading
 
+from ..common.bounded import BoundedDict
 from ..common.lockdep import make_rlock
 from ..msg.message import MOSDRepOp, MOSDRepOpReply
 from ..store.object_store import Transaction
@@ -24,36 +25,80 @@ class _Inflight:
         self.tid = tid
         self.on_commit = on_commit
         self.waiting_on = set(waiting_on)
+        self.msg = None               # the MOSDRepOp, for retransmit
 
 
 class ReplicatedBackend:
+    # sub-ops are at-least-once: fan-out retries ride the timer until
+    # every peer acks (a dropped MOSDRepOp must not wedge the write),
+    # and replicas dedup by (from_osd, tid) so retransmits replay the
+    # ack without re-applying the transaction
+    RETRY_INTERVAL = 1.0
+
     def __init__(self, pg):
         self.pg = pg
         self._tids = itertools.count(1)
         self.lock = make_rlock("rep-backend:%s" % (pg.pgid,))
         self.inflight: dict[int, _Inflight] = {}
+        # per-instance nonce: tids restart when a daemon restarts, so
+        # the replica dedup keys on (instance, tid) — a reborn primary
+        # must never hit a dead incarnation's cache entries
+        import uuid
+        self.instance = uuid.uuid4().hex
+        self._seen: BoundedDict = BoundedDict()  # key -> committed?
 
     # -- write ---------------------------------------------------------
 
     def submit_transaction(self, pg_txn, at_version: int,
-                           on_commit) -> int:
+                           on_commit, reqid: tuple = ("", 0)) -> int:
         tid = next(self._tids)
         txn = self._physical_txn(pg_txn)
         peers = [o for o in self.pg.acting_osds() if o >= 0]
-        log_entries = self.pg.mint_log_entries(pg_txn.op_map, at_version)
+        log_entries = self.pg.mint_log_entries(pg_txn.op_map, at_version,
+                                               reqid)
         op = _Inflight(tid, on_commit, peers)
+        op.msg = MOSDRepOp(pgid=self.pg.pgid, from_osd=self.pg.whoami,
+                           tid=tid, at_version=at_version,
+                           log_entries=log_entries, txn_ops=txn.ops,
+                           map_epoch=self.pg.map_epoch(),
+                           instance=self.instance)
         with self.lock:
             self.inflight[tid] = op
         for osd in peers:
-            msg = MOSDRepOp(pgid=self.pg.pgid, from_osd=self.pg.whoami,
-                            tid=tid, at_version=at_version,
-                            log_entries=log_entries, txn_ops=txn.ops,
-                            map_epoch=self.pg.map_epoch())
             if osd == self.pg.whoami:
-                self.handle_rep_op(msg, local=True)
+                self.handle_rep_op(op.msg, local=True)
             else:
-                self.pg.send_to_osd(osd, msg)
+                self.pg.send_to_osd(osd, op.msg)
+        self.pg.daemon.timer.add_event_after(
+            self.RETRY_INTERVAL, self._retry_inflight, tid)
         return tid
+
+    def _retry_inflight(self, tid: int) -> None:
+        acting = set(self.pg.acting_osds())
+        done = None
+        with self.lock:
+            op = self.inflight.get(tid)
+            if op is None:
+                return                 # completed
+            # a peer that left the acting set can never ack: stop
+            # waiting on it (the new interval's peering roll-forward
+            # owns its convergence) — otherwise a dead replica wedges
+            # the write forever while duplicates are being dropped
+            op.waiting_on &= acting | {self.pg.whoami}
+            if not op.waiting_on:
+                self.inflight.pop(tid, None)
+                done = op
+            waiting = set(op.waiting_on)
+            msg = op.msg
+        if done is not None:
+            if done.on_commit:
+                done.on_commit()
+            return
+        for osd in waiting:
+            if osd != self.pg.whoami:
+                self.pg.send_to_osd(osd, msg)
+        self.pg.daemon.timer.add_event_after(
+            self.RETRY_INTERVAL, self._retry_inflight, tid)
 
     def _physical_txn(self, pg_txn) -> Transaction:
         """Logical -> physical is 1:1 for replication (no striping)."""
@@ -92,12 +137,6 @@ class ReplicatedBackend:
     # -- replica -------------------------------------------------------
 
     def handle_rep_op(self, msg, local: bool = False) -> None:
-        txn = Transaction()
-        txn.ops = list(msg.txn_ops)
-        # log keys ride the same store transaction as the data
-        self.pg.log_operation(msg.log_entries, msg.at_version, -1,
-                              txn=txn)
-
         def on_commit():
             reply = MOSDRepOpReply(pgid=self.pg.pgid,
                                    from_osd=self.pg.whoami,
@@ -107,7 +146,32 @@ class ReplicatedBackend:
             else:
                 self.pg.send_to_osd(msg.from_osd, reply)
 
-        txn.register_on_commit(on_commit)
+        # retransmit? replay the ack — but only once the ORIGINAL
+        # application actually committed (acking uncommitted data
+        # would let the primary complete a write a crashing replica
+        # never made durable); an uncommitted in-flight original just
+        # drops the duplicate (its own commit will ack)
+        key = (getattr(msg, "instance", "") or msg.from_osd, msg.tid)
+        with self.lock:
+            state = self._seen.get(key)
+            if state is None:
+                self._seen[key] = False     # received, not committed
+        if state is not None:
+            if state:
+                on_commit()
+            return
+
+        def commit_and_ack():
+            with self.lock:
+                self._seen[key] = True
+            on_commit()
+
+        txn = Transaction()
+        txn.ops = list(msg.txn_ops)
+        # log keys ride the same store transaction as the data
+        self.pg.log_operation(msg.log_entries, msg.at_version, -1,
+                              txn=txn)
+        txn.register_on_commit(commit_and_ack)
         self.pg.store.queue_transaction(txn)
 
     def handle_rep_op_reply(self, msg) -> None:

@@ -79,6 +79,13 @@ class KStore(ObjectStore):
             try:
                 for op in txn.ops:
                     self._apply_op(op, batch)
+            except Exception:
+                # the applied prefix already mutated the in-memory
+                # caches (MemStore semantics: no rollback) — commit its
+                # batch so memory and kv agree; each op fails before
+                # mutating anything of its own
+                self.db.submit_transaction(batch)
+                raise
             finally:
                 self._pending = None
                 self._pending_m = None

@@ -357,3 +357,59 @@ class TestLeaderLeaseAuthority:
         net.mons[0].paxos._extend_lease_locked()
         net.pump()
         assert stale.is_readable()
+
+
+class TestTrim:
+    def _trimmy(self, net):
+        for m in net.mons:
+            m.paxos.TRIM_MIN = 5
+            m.paxos.TRIM_TOLERANCE = 10
+            m.get_full_state = lambda m=m: __import__(
+                "ceph_tpu.encoding", fromlist=["x"]).encode_any(
+                    m.committed)
+            def set_full(blob, m=m):
+                m.committed = __import__(
+                    "ceph_tpu.encoding", fromlist=["x"]).decode_any(blob)
+                return True
+            m.set_full_state = set_full
+
+    def test_store_stays_bounded(self):
+        net = Net(3)
+        self._trimmy(net)
+        net.make_leader(0, [0, 1, 2])
+        net.pump()
+        for i in range(40):
+            net.mons[0].paxos.propose(b"v%d" % i)
+            net.pump()
+        lead = net.mons[0].paxos
+        assert lead.last_committed == 40
+        assert lead.first_committed >= 25
+        live = [k for k, _ in net.mons[0].store.get_iterator("paxos")
+                if k[0] == "0"]
+        assert len(live) <= lead.TRIM_TOLERANCE + 2
+        # trimmed versions really left the store
+        assert net.mons[0].store.get("paxos", "%016d" % 1) is None
+
+    def test_laggard_peon_full_syncs(self):
+        """A peon away past the trim horizon converges through the
+        full-state sync instead of wedging on missing increments."""
+        net = Net(3)
+        self._trimmy(net)
+        net.make_leader(0, [0, 1, 2])
+        net.pump()
+        net.mons[0].paxos.propose(b"seed")
+        net.pump()
+        net.down.add(2)
+        net.make_leader(0, [0, 1])
+        net.pump()
+        for i in range(30):                # way past TRIM_TOLERANCE
+            net.mons[0].paxos.propose(b"x%d" % i)
+            net.pump()
+        assert net.mons[0].paxos.first_committed > 2
+        net.down.clear()
+        net.make_leader(0, [0, 1, 2])
+        net.pump()
+        p2 = net.mons[2].paxos
+        assert p2.last_committed == net.mons[0].paxos.last_committed
+        # service state adopted wholesale (the hook swapped .committed)
+        assert net.mons[2].committed == net.mons[0].committed
