@@ -97,6 +97,10 @@ class PG:
         from ..common.bounded import BoundedDict
         self._reqids: BoundedDict = BoundedDict()
         self._trimmed_snaps: set = set()
+        # EC mutation serialization per object (ObjectContext rw-lock
+        # role): the async snapshot pre-read window must not interleave
+        # with another write to the same object
+        self._obj_gate: dict = {}
         # watch/notify (PrimaryLogPG watchers; volatile on the primary,
         # clients re-watch after a primary change like the Objecter's
         # linger resend)
@@ -559,7 +563,7 @@ class PG:
                            for c in sorted(ss["clones"])],
                 "head_exists": head_alive})
             return
-        if snap and not self.pool.is_erasure():
+        if snap:
             resolved = self._resolve_snap(oid, snap)
             if resolved is None or (
                     resolved == oid and (self._is_whiteout(oid)
@@ -651,21 +655,34 @@ class PG:
     def _head_cid(self):
         return self.cid_of_shard(-1)
 
-    def make_writeable(self, t: PGTransaction, oid, snapc) -> None:
+    def _snap_capture_needed(self, oid, snapc) -> bool:
+        """Will make_writeable need the head's BYTES? (EC pools must
+        pre-read them through the backend before planning the write.)"""
+        if not snapc or not snapc[0]:
+            return False
+        if self._object_size(oid) is None or self._is_whiteout(oid):
+            return False
+        ss = self._load_snapset(oid)
+        seq, snaps = snapc[0], list(snapc[1] or ())
+        return bool([s for s in snaps if s > ss["seq"]]) \
+            and seq > ss["seq"]
+
+    def make_writeable(self, t: PGTransaction, oid, snapc,
+                       head_data: bytes | None = None) -> None:
         """Before the first mutation of a write whose SnapContext names
         snaps newer than the newest clone, preserve the current head as
         a clone covering them (PrimaryLogPG::make_writeable,
         PrimaryLogPG.cc around :3151 execute_ctx). The clone is emitted
         as captured bytes (not a store-level clone op) so it is
         pre-mutation by construction and replicas apply it
-        deterministically. EC pools don't carry snaps here (the
-        reference gates rbd/self-managed snaps onto replicated pools
-        in this era); their writes proceed uncloned.
+        deterministically — and on EC pools the captured clone encodes
+        through the normal write path like any object (head_data is the
+        pre-read logical content the caller gathered via the backend).
 
         Returns the in-flight snapset (so later ops in the SAME
         transaction see the new clone), or None when nothing was
         preserved."""
-        if self.pool.is_erasure() or not snapc or not snapc[0]:
+        if not snapc or not snapc[0]:
             return None
         seq, snaps = snapc[0], list(snapc[1] or ())
         size = self._object_size(oid)
@@ -680,13 +697,21 @@ class PG:
                 t.setattr(oid, SNAPSET_ATTR, encoding.encode_any(ss))
                 return ss
             return None            # no head to preserve
+        if not self._snap_capture_needed(oid, snapc):
+            return None            # the ONE capture predicate
         ss = self._load_snapset(oid)
         new_snaps = sorted(s for s in snaps if s > ss["seq"])
-        if not new_snaps or seq <= ss["seq"]:
+        if self.pool.is_erasure() and head_data is None:
+            # the pre-read didn't arrive (predicate/state drift): skip
+            # the clone rather than read the dataless EC head cid
             return None
-        cid = self._head_cid()
         cname = clone_name(oid, seq)
-        data = self.store.read(cid, oid)
+        if head_data is not None:
+            data = head_data
+            cid = self.cid_of_shard(self.my_shard())
+        else:
+            cid = self._head_cid()
+            data = self.store.read(cid, oid)
         t.create(cname)
         if data:
             t.write(cname, 0, data)
@@ -732,11 +757,19 @@ class PG:
     def trim_snaps(self, removed: list) -> None:
         """Drop removed snaps from clone coverage; clones covering
         nothing are deleted (snap trimming; each OSD trims its own
-        store deterministically from the map's removed_snaps)."""
-        if self.pool.is_erasure() or not removed:
+        store deterministically from the map's removed_snaps). EC
+        shard collections trim independently — the snapset xattr is
+        replicated to every shard."""
+        if not removed:
             return
         removed = set(removed)
-        cid = self._head_cid()
+        cids = ([self._head_cid()] if not self.pool.is_erasure()
+                else [self.cid_of_shard(s)
+                      for s in range(self.pool.size)])
+        for cid in cids:
+            self._trim_snaps_cid(cid, removed)
+
+    def _trim_snaps_cid(self, cid, removed: set) -> None:
         for oid in list(self.store.list_objects(cid)):
             if is_clone_oid(oid) or oid == META_OID:
                 continue
@@ -766,7 +799,12 @@ class PG:
                     ss["sizes"].pop(c, None)
                     txn.remove(cid, clone_name(oid, c))
             if dirty:
-                if not ss["clones"] and self._is_whiteout(oid):
+                try:
+                    wout = self.store.getattr(
+                        cid, oid, WHITEOUT_ATTR) is not None
+                except KeyError:
+                    wout = False
+                if not ss["clones"] and wout:
                     # nothing references the whiteout anymore
                     txn.remove(cid, oid)
                 else:
@@ -775,6 +813,101 @@ class PG:
                 self.store.queue_transaction(txn)
 
     def _do_write_ops(self, msg, reply_fn) -> None:
+        """EC pools read asynchronously, so snapshot captures (COW of
+        the pre-write head, rollback source content) pre-read through
+        the backend before the write is planned; replicated pools read
+        their local store inline."""
+        snapc = getattr(msg, "snapc", (0, ()))
+        mutates = any(op[0] in ("write", "writefull", "append", "zero",
+                                "truncate", "remove", "rollback")
+                      for op in msg.ops)
+        if not (self.pool.is_erasure() and mutates):
+            self._plan_write_ops(msg, reply_fn, {})
+            return
+        # EC: mutations on one object run one at a time so the async
+        # pre-read can never capture a head another in-flight write is
+        # changing (the EC backend pipeline then keeps submit order)
+        from collections import deque
+
+        def run():
+            self._ec_write_with_prereads(msg, reply_fn)
+
+        with self.lock:
+            q = self._obj_gate.setdefault(msg.oid, deque())
+            q.append(run)
+            if len(q) > 1:
+                return             # a predecessor will run us
+        run()
+
+    def _release_obj_gate(self, oid) -> None:
+        nxt = None
+        with self.lock:
+            q = self._obj_gate.get(oid)
+            if q:
+                q.popleft()
+                if q:
+                    nxt = q[0]
+                else:
+                    self._obj_gate.pop(oid, None)
+        if nxt is not None:
+            nxt()
+
+    def _ec_write_with_prereads(self, msg, reply_fn) -> None:
+        snapc = getattr(msg, "snapc", (0, ()))
+        needs: list = []
+        if self._snap_capture_needed(msg.oid, snapc):
+            needs.append(msg.oid)
+        for op in msg.ops:
+            if op[0] == "rollback":
+                src_oid = self._resolve_snap(msg.oid, op[1])
+                if src_oid not in (None, msg.oid):
+                    needs.append(src_oid)
+
+        def finish(result, data):
+            try:
+                reply_fn(result, data)
+            finally:
+                self._release_obj_gate(msg.oid)
+
+        def plan(pre):
+            try:
+                self._plan_write_ops(msg, reply_fn, pre)
+            finally:
+                self._release_obj_gate(msg.oid)
+
+        if not needs:
+            plan({})
+            return
+        pre: dict = {}
+
+        def read_next(i: int) -> None:
+            if i == len(needs):
+                plan(pre)
+                return
+            roid = needs[i]
+            size = self._object_size(roid)
+            if size is None:
+                finish(-2, None)   # pre-read source vanished
+                return
+
+            def on_data(data, roid=roid, i=i):
+                if data is None:
+                    # degraded below k / reconstruction failed: error
+                    # out — b"" here would snapshot or roll back to
+                    # EMPTY content and ack it
+                    finish(-5, None)
+                    return
+                pre[roid] = bytes(data)
+                read_next(i + 1)
+
+            if size == 0:
+                on_data(b"")
+            else:
+                self.backend.objects_read(roid, 0, size, on_data)
+
+        read_next(0)
+
+    def _plan_write_ops(self, msg, reply_fn, pre: dict) -> None:
         t = PGTransaction()
         oid = msg.oid
         snapc = getattr(msg, "snapc", (0, ()))
@@ -783,7 +916,8 @@ class PG:
                       for op in msg.ops)
         ss_inflight = None
         if mutates:
-            ss_inflight = self.make_writeable(t, oid, snapc)
+            ss_inflight = self.make_writeable(t, oid, snapc,
+                                              head_data=pre.get(oid))
         if self._is_whiteout(oid):
             # recreating over a whiteout: clear the tombstone, keep ss
             if any(op[0] in ("create", "write", "writefull", "append")
@@ -813,7 +947,7 @@ class PG:
                 logical_size = op[1]
             elif kind == "remove":
                 ss = ss_inflight or self._load_snapset(oid)
-                if ss["clones"] and not self.pool.is_erasure():
+                if ss["clones"]:
                     # live clones still reference the snapset: leave a
                     # whiteout tombstone instead of erasing it
                     # (PrimaryLogPG whiteout semantics)
@@ -837,12 +971,15 @@ class PG:
                         t.remove(oid)
                     logical_size = 0
                 elif src != oid:
-                    cid = self._head_cid()
-                    try:
-                        data = self.store.read(cid, src)
-                    except KeyError:
-                        reply_fn(-2, None)
-                        return
+                    if src in pre:
+                        data = pre[src]     # EC: pre-read via backend
+                    else:
+                        cid = self._head_cid()
+                        try:
+                            data = self.store.read(cid, src)
+                        except KeyError:
+                            reply_fn(-2, None)
+                            return
                     ss = ss_inflight or self._load_snapset(oid)
                     t.remove(oid)
                     t.create(oid)
