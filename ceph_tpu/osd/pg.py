@@ -31,6 +31,7 @@ Collections: one per (pg, shard) — EC shard s lives in cid
 
 from __future__ import annotations
 
+import logging
 import threading
 import time as _time
 
@@ -863,17 +864,38 @@ class PG:
                 if src_oid not in (None, msg.oid):
                     needs.append(src_oid)
 
+        # The gate must stay held until the write COMMITS, not merely
+        # until it is planned/submitted: the snapset update rides the
+        # async shard transactions, so a successor entering the gate
+        # pre-commit would read a stale snapset and capture a second
+        # clone from a post-write head (PrimaryLogPG holds the
+        # ObjectContext rw-lock across make_writeable -> commit the
+        # same way, PrimaryLogPG.cc:5197-5311).
+        released = [False]
+
+        def release_once():
+            with self.lock:
+                if released[0]:
+                    return
+                released[0] = True
+            self._release_obj_gate(msg.oid)
+
         def finish(result, data):
             try:
                 reply_fn(result, data)
             finally:
-                self._release_obj_gate(msg.oid)
+                release_once()
 
         def plan(pre):
             try:
-                self._plan_write_ops(msg, reply_fn, pre)
-            finally:
-                self._release_obj_gate(msg.oid)
+                self._plan_write_ops(msg, finish, pre)
+            except Exception:
+                # fail the op rather than unwind into the backend's
+                # read-completion / timer context (finish releases the
+                # gate); the client sees EIO instead of a 30s timeout
+                logging.getLogger("ceph_tpu.osd").exception(
+                    "EC write planning failed for %r", msg.oid)
+                finish(-5, None)
 
         if not needs:
             plan({})
@@ -932,8 +954,18 @@ class PG:
                 t.write(oid, op[1], op[2])
                 logical_size = max(logical_size, op[1] + len(op[2]))
             elif kind == "writefull":
+                # CEPH_OSD_OP_WRITEFULL replaces the DATA only: xattrs
+                # (snapset!) and omap persist (do_osd_ops WRITEFULL is
+                # truncate+write, not delete+create — a remove here
+                # would wipe the head's snapset whenever a later writer
+                # needs no capture, losing every existing clone).
+                # Earlier data ops in the SAME transaction are
+                # superseded wholesale — including a whiteout marker a
+                # preceding remove queued (the object is being reborn).
+                t.reset_data(oid)
+                t.drop_attr_update(oid, WHITEOUT_ATTR)
                 if self._object_size(oid) is not None:
-                    t.remove(oid)
+                    t.truncate(oid, 0)
                 t.create(oid)
                 t.write(oid, 0, op[1])
                 logical_size = len(op[1])

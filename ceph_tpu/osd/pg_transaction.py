@@ -65,11 +65,28 @@ class PGTransaction:
         self._get(oid).init_type = "create"
 
     def remove(self, oid) -> None:
+        self.reset_data(oid)
         op = self._get(oid)
         op.delete_first = True
         op.init_type = "none"
+
+    def reset_data(self, oid) -> None:
+        """Drop queued data mutations (buffer updates + truncate) while
+        keeping attr/omap updates — the data half of what remove() does.
+        Used by WRITEFULL, which replaces the object's entire data
+        stream but must preserve xattrs (snapset) and omap."""
+        op = self._get(oid)
         op.buffer_updates = []
         op.truncate = None
+
+    def drop_attr_update(self, oid, name: str) -> None:
+        """Discard a QUEUED setattr — for ops that supersede a marker
+        an earlier op in the same compound queued (e.g. WRITEFULL after
+        a whiteout-remove). A queued rmattr (value None) is kept: it
+        clears persisted state, which still must happen."""
+        op = self.op_map.get(oid)
+        if op is not None and op.attr_updates.get(name) is not None:
+            op.attr_updates.pop(name)
 
     def write(self, oid, offset: int, data: bytes) -> None:
         self._get(oid).buffer_updates.append(("write", offset, bytes(data)))

@@ -245,6 +245,44 @@ class TestSnapEdges:
         assert not any(n.startswith("__pg_") for n in names)
 
 
+class TestWriteFullSemantics:
+    def test_writefull_preserves_xattr_and_omap(self, ioctx):
+        """WRITEFULL replaces the data stream only — xattrs and omap
+        survive (do_osd_ops CEPH_OSD_OP_WRITEFULL truncates+writes,
+        it does not delete the object)."""
+        ioctx.write_full("wf", b"first")
+        ioctx.set_xattr("wf", "user.tag", b"keepme")
+        ioctx.omap_set("wf", {"k1": b"v1"})
+        ioctx.write_full("wf", b"second-longer-payload")
+        assert ioctx.read("wf") == b"second-longer-payload"
+        assert ioctx.get_xattr("wf", "user.tag") == b"keepme"
+        assert ioctx.omap_get("wf")["k1"] == b"v1"
+
+    def test_compound_writefull_supersedes_earlier_data_ops(self, ioctx):
+        """Data ops queued before a WRITEFULL in the same compound op
+        are superseded wholesale: no stale truncate or append may leak
+        into the final state."""
+        ioctx.write_full("cw", b"0123456789" * 10)
+        ioctx._op("cw", [("truncate", 5), ("writefull", b"hello")])
+        assert ioctx.read("cw") == b"hello"
+        ioctx.write_full("cw2", b"X" * 100)
+        ioctx._op("cw2", [("append", b"Y" * 8), ("writefull", b"hi")])
+        assert ioctx.read("cw2") == b"hi"
+
+    def test_compound_remove_then_writefull_reborn(self, ioctx):
+        """remove followed by writefull in one compound: the object is
+        reborn with the new data — no whiteout tombstone may leak from
+        the remove half (even when live clones force the remove to
+        whiteout instead of delete)."""
+        ioctx.write_full("rw", b"mortal")
+        ioctx.create_snap("rw-snap")
+        ioctx.write_full("rw", b"clone-maker")   # creates a clone
+        assert ioctx.list_snaps("rw")["clones"]
+        ioctx._op("rw", [("remove",), ("writefull", b"reborn")])
+        assert ioctx.read("rw") == b"reborn"
+        ioctx.remove_snap("rw-snap")
+
+
 class TestECPoolSnaps:
     @pytest.fixture(scope="class")
     def ec_ioctx(self, cluster):
@@ -343,3 +381,66 @@ class TestECPoolSnaps:
             ec_ioctx.snap_set_read(0)
         info = ec_ioctx.list_snaps("race")
         assert len(info["clones"]) == 1   # exactly one capture
+
+
+class TestECSnapThrash:
+    def test_ec_snaps_with_concurrent_writes_and_churn(self):
+        """EC snap-thrash: per round, snapshot a known state then race
+        four writers against the capture while a thrasher kills and
+        revives OSDs. Every snap must read back exactly its pre-snap
+        generation (one clone, untorn) and the head must be one of the
+        acked racers."""
+        import threading
+
+        from .thrasher import Thrasher
+        cluster = MiniCluster(num_mons=1, num_osds=4,
+                              conf_overrides=FAST).start()
+        try:
+            client = cluster.client()
+            cluster.create_ec_pool(
+                client, "ecthrash",
+                {"plugin": "jax_tpu", "technique": "reed_sol_van",
+                 "k": "2", "m": "1", "w": "8"}, pg_num=2)
+            ioctx = client.open_ioctx("ecthrash")
+            thrasher = Thrasher(cluster, seed=23, min_in=3,
+                                interval=1.0, revive_delay=0.3)
+            thrasher.start()
+            snaps = []
+            try:
+                for r in range(3):
+                    gen = (b"R%d==" % r) * 64
+                    ioctx.write_full("obj", gen, timeout=60)
+                    sid = ioctx.create_snap("thr-%d" % r)
+                    snaps.append((sid, gen))
+                    errs: list = []
+
+                    def writer(i, r=r):
+                        try:
+                            ioctx.write_full(
+                                "obj", (b"w%d%d!" % (r, i)) * 64,
+                                timeout=60)
+                        except Exception as e:
+                            errs.append(e)
+                    threads = [threading.Thread(target=writer, args=(i,))
+                               for i in range(4)]
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join(60)
+                        assert not t.is_alive(), "writer wedged >60s"
+                    assert not errs, errs
+                    head = ioctx.read("obj")
+                    assert head in {(b"w%d%d!" % (r, i)) * 64
+                                    for i in range(4)}
+            finally:
+                thrasher.stop_and_heal(timeout=60)
+            for sid, gen in snaps:
+                ioctx.snap_set_read(sid)
+                try:
+                    assert ioctx.read("obj") == gen, sid
+                finally:
+                    ioctx.snap_set_read(0)
+            info = ioctx.list_snaps("obj")
+            assert len(info["clones"]) == len(snaps)
+        finally:
+            cluster.stop()
