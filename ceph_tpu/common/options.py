@@ -88,6 +88,13 @@ def _declare_defaults():
     o("osd_op_queue_mclock_recovery_res", float, 0.0, LEVEL_ADVANCED)
     o("osd_op_queue_mclock_recovery_wgt", float, 1.0, LEVEL_ADVANCED)
     o("osd_op_queue_mclock_recovery_lim", float, 0.0, LEVEL_ADVANCED)
+    o("osd_tpu_coalesce", bool, True, LEVEL_ADVANCED,
+      "batch concurrent EC device calls sharing a codec/decode matrix "
+      "into one dispatch (osd/tpu_dispatch.py)")
+    o("osd_tpu_coalesce_max_batch", int, 8, LEVEL_ADVANCED,
+      "max ops fused into one device dispatch")
+    o("osd_tpu_coalesce_max_delay_ms", float, 1.0, LEVEL_ADVANCED,
+      "max milliseconds an op waits for batch-mates before dispatch")
     o("osd_op_history_size", int, 20, LEVEL_ADVANCED,
       "completed ops kept for dump_historic_ops")
     o("osd_op_history_duration", float, 600.0, LEVEL_ADVANCED,

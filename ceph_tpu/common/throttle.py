@@ -26,6 +26,7 @@ class Throttle:
         self.name = name
         self._max = max_
         self._current = 0
+        self._waiters = 0
         self._cond = threading.Condition()
 
     # -- core ----------------------------------------------------------
@@ -45,7 +46,11 @@ class Throttle:
                     raise ThrottleTimeout(
                         "%s: waited %.3fs for %d/%d" %
                         (self.name, timeout, count, self._max))
-                self._cond.wait(remaining)
+                self._waiters += 1
+                try:
+                    self._cond.wait(remaining)
+                finally:
+                    self._waiters -= 1
             self._current += count
 
     def get_or_fail(self, count: int = 1) -> bool:
@@ -62,6 +67,12 @@ class Throttle:
             self._cond.notify_all()
 
     # -- introspection -------------------------------------------------
+
+    def num_waiters(self) -> int:
+        """Threads currently parked inside get() (read under the cond
+        lock, so >0 means a waiter is genuinely in wait())."""
+        with self._cond:
+            return self._waiters
 
     def get_current(self) -> int:
         with self._cond:

@@ -408,12 +408,17 @@ def _rule_shape(cmap: CrushMap, ruleno: int):
 
 
 def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
-                    weight=None):
+                    weight=None, xs_sharding=None):
     """Map a whole batch of inputs in one device program.
 
     xs: [B] int array of crush inputs (pg seeds). Returns [B, result_max]
     int64 (CRUSH_ITEM_NONE marks holes). Falls back to the scalar
     interpreter when the rule/map is outside the fast path.
+
+    xs_sharding: optional jax sharding for the seed batch — a
+    NamedSharding over a device mesh partitions the whole mapping sweep
+    across chips (each seed's placement is independent, so no
+    collectives are inserted).
     """
     import jax
     import jax.numpy as jnp
@@ -479,9 +484,12 @@ def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
         kernel = _indep_kernel(cm, out_size, numrep, shape["type"],
                                chooseleaf, tries, recurse_tries)
     with jax.enable_x64():
+        xs_dev = jnp.asarray(xs, dtype=jnp.int64)
+        if xs_sharding is not None:
+            xs_dev = jax.device_put(xs_dev, xs_sharding)
         out = kernel(jnp.asarray(cm.items), jnp.asarray(cm.weights),
                      jnp.asarray(cm.size), jnp.asarray(cm.btype),
-                     jnp.asarray(xs, dtype=jnp.int64),
+                     xs_dev,
                      jnp.asarray(weight, dtype=jnp.int64),
                      -1 - shape["root"])
     res = np.asarray(out)

@@ -322,12 +322,100 @@ class Lrc(ErasureCode):
             return set(available)
         raise ErasureCodeError(errno.EIO, "not enough chunks to decode")
 
-    # -- batch API (delegates to the dict paths) ---------------------------
+    # -- batch API (per-layer delegation to the inner codec's device
+    # path: ErasureCodeLrc.cc:744-776 encodes layer by layer, each an
+    # inner-plugin encode — batched here so every layer's math is ONE
+    # device call over all stripes) --------------------------------------
 
-    def encode_batch(self, data: np.ndarray) -> np.ndarray:
-        raise NotImplementedError(
-            "LRC is position-structured; use encode()/decode()")
+    DECODE_BATCH_ANY = True
 
-    def decode_batch(self, avail_rows: tuple, chunks: np.ndarray) -> np.ndarray:
-        raise NotImplementedError(
-            "LRC is position-structured; use encode()/decode()")
+    @staticmethod
+    def _stack(parts, axis=1):
+        first = parts[0]
+        if isinstance(first, np.ndarray):
+            return np.stack(parts, axis=axis)
+        import jax.numpy as jnp
+        return jnp.stack(parts, axis=axis)
+
+    def encode_batch(self, data):
+        """[B, k, N] (logical data order) -> [B, n-k, N] parity in
+        logical parity order (chunk_index(k+j) gives the physical
+        position of output row j). Walks every layer top-down, each
+        layer one batched inner-codec encode."""
+        k = self.data_chunk_count
+        data_positions = [i for i, c in enumerate(self.mapping)
+                          if c == "D"]
+        bufs: dict = {}
+        for di, pos in enumerate(data_positions):
+            bufs[pos] = data[:, di]
+        for layer in self.layers:
+            layer_data = self._stack([bufs[c] for c in layer.data])
+            parity = layer.codec.encode_batch(layer_data)
+            for j, c in enumerate(layer.coding):
+                bufs[c] = parity[:, j]
+        m = self.chunk_count - k
+        return self._stack([bufs[self.chunk_index(k + j)]
+                            for j in range(m)])
+
+    def decode_batch(self, avail_rows: tuple, chunks,
+                     want_rows: tuple | None = None):
+        """Batched bottom-up layer walk (decode_chunks): avail_rows is
+        ANY recoverable subset of logical rows (local repairs hand over
+        fewer than k). Each firing layer is one batched inner-codec
+        decode. Rows neither available nor wanted come back as zeros
+        and must not be consumed."""
+        n = self.chunk_count
+        idx_of = {self.chunk_index(i): i for i in range(n)}
+        avail_phys = {self.chunk_index(r) for r in avail_rows}
+        if want_rows is None:
+            want_phys = set(range(n)) - avail_phys
+        else:
+            want_phys = ({self.chunk_index(r) for r in want_rows}
+                         - avail_phys)
+        row_of = {r: i for i, r in enumerate(avail_rows)}
+        bufs: dict = {}
+        for r in avail_rows:
+            bufs[self.chunk_index(r)] = chunks[:, row_of[r]]
+        erasures = set(range(n)) - set(bufs)
+        progress = True
+        while (want_phys & erasures) and progress:
+            progress = False
+            for layer in reversed(self.layers):
+                layer_erasures = layer.chunks_as_set & erasures
+                if not layer_erasures:
+                    continue
+                k_l = layer.codec.get_data_chunk_count()
+                inner_avail = tuple(
+                    j for j, c in enumerate(layer.chunks)
+                    if c not in erasures)
+                if len(inner_avail) < k_l or \
+                        len(layer_erasures) > \
+                        layer.codec.get_coding_chunk_count():
+                    continue
+                use = inner_avail[:k_l]
+                stacked = self._stack(
+                    [bufs[layer.chunks[j]] for j in use])
+                try:
+                    full = layer.codec.decode_batch(use, stacked)
+                except ErasureCodeError:
+                    continue
+                for j, c in enumerate(layer.chunks):
+                    if c in erasures:
+                        bufs[c] = full[:, j]
+                        erasures.discard(c)
+                        progress = True
+        still = want_phys & erasures
+        if still:
+            raise ErasureCodeError(
+                errno.EIO, "unable to read %s" % sorted(still))
+        zeros = None
+        out = []
+        for i in range(n):
+            pos = self.chunk_index(i)
+            if pos in bufs:
+                out.append(bufs[pos])
+            else:
+                if zeros is None:
+                    zeros = np.zeros_like(np.asarray(chunks[:, 0]))
+                out.append(zeros)
+        return self._stack(out)

@@ -32,6 +32,21 @@ from .table_cache import TableCache, xor_parity_rows, xor_recover
 
 LARGEST_VECTOR_WORDSIZE = 16  # reference SIMD word (ErasureCodeJerasure.cc:31)
 
+_bank_pick_fn = None
+
+
+def _bank_pick(bank, i: int):
+    """Device-side bank row select with the index TRACED (one compiled
+    gather serves every signature). A static `bank[i]` would bake each
+    distinct index into its own tiny executable — harmless locally, but
+    each fresh compile costs an RTT-scale stall on a remote transport."""
+    global _bank_pick_fn
+    if _bank_pick_fn is None:
+        import jax
+        _bank_pick_fn = jax.jit(lambda b, j: b[j])
+    import jax.numpy as jnp
+    return _bank_pick_fn(bank, jnp.asarray(i, dtype=jnp.int32))
+
 
 def _roundup(x: int, align: int) -> int:
     return x + (align - x % align) % align if x % align else x
@@ -58,6 +73,11 @@ class GeneratorCodec(ErasureCode):
         self._decode_cache = TableCache()
         self._xor_rows: list[int] = []  # parity rows that are plain XORs
         self.xor_fast_hits = 0
+        # device-resident decode-matrix bank (see _ensure_decode_bank)
+        self._bank_state: str | None = None
+        self._bank_index: dict | None = None
+        self._bank_host = None
+        self._bank_dev = None
 
     # -- profile -----------------------------------------------------------
 
@@ -115,6 +135,10 @@ class GeneratorCodec(ErasureCode):
         self._decode_cache.clear()
         self.xor_fast_hits = 0
         self._xor_rows = xor_parity_rows(self._bitmat, self.k, self.w)
+        self._bank_state = None
+        self._bank_index = None
+        self._bank_host = None
+        self._bank_dev = None
 
     def _device_bitmat(self):
         if self._bitmat_dev is None:
@@ -142,6 +166,43 @@ class GeneratorCodec(ErasureCode):
         parity = gf.gf_matmul(self.coding, dec, self.w)
         return np.concatenate([dec, parity], axis=0)
 
+    #: precompute + device-upload the whole decode bank when the
+    #: pattern space is at most this many C(n, k) signatures
+    DECODE_BANK_LIMIT = 512
+
+    def _ensure_decode_bank(self) -> bool:
+        """Build the device-resident decode-matrix BANK: every C(n,k)
+        erasure signature's decode bitmatrix, stacked and uploaded in
+        ONE transfer. A cache miss then costs a device-side slice
+        instead of a host matrix build + per-miss H2D (which over a
+        congested transport costs an RTT per fresh signature — measured
+        2000x the decode itself). The reference's ISA table cache
+        (ErasureCodeIsaTableCache.cc) builds tables lazily per miss
+        because the CPU consumes them in place; on an accelerator the
+        bank trade (~1 MB resident for k=8,m=3) is the right one."""
+        if self._bank_state is None:
+            import math
+            n = self.get_chunk_count()
+            if self.backend != "jax" or \
+                    math.comb(n, self.k) > self.DECODE_BANK_LIMIT:
+                self._bank_state = "infeasible"
+            else:
+                import itertools
+
+                import jax.numpy as jnp
+                idx: dict = {}
+                gfs, bms = [], []
+                for avail in itertools.combinations(range(n), self.k):
+                    full = self._full_decode_matrix(avail)
+                    idx[avail] = len(gfs)
+                    gfs.append(full)
+                    bms.append(gf.generator_to_bitmatrix(full, self.w))
+                self._bank_index = idx
+                self._bank_host = (gfs, bms)
+                self._bank_dev = jnp.asarray(np.stack(bms))
+                self._bank_state = "built"
+        return self._bank_state == "built"
+
     def _decode_entry(self, avail_rows: tuple):
         """Cache of per-erasure-signature decode matrices.
 
@@ -149,15 +210,25 @@ class GeneratorCodec(ErasureCode):
         tables keyed by erasure signature
         (ErasureCodeIsaTableCache.{h,cc}); here the cached object also
         carries the device-side bitmatrix so repeated degraded reads hit a
-        compiled program directly.
+        compiled program directly — served from the device-resident bank
+        when the signature space is small enough (_ensure_decode_bank).
         """
         entry = self._decode_cache.get(avail_rows)
         if entry is None:
-            full = self._full_decode_matrix(avail_rows)
-            entry = self._decode_cache.put(
-                avail_rows,
-                {"gf": full,
-                 "bitmat": gf.generator_to_bitmatrix(full, self.w)})
+            if self._ensure_decode_bank() and \
+                    avail_rows in self._bank_index:
+                i = self._bank_index[avail_rows]
+                gfs, bms = self._bank_host
+                entry = self._decode_cache.put(
+                    avail_rows,
+                    {"gf": gfs[i], "bitmat": bms[i],
+                     "bitmat_dev": _bank_pick(self._bank_dev, i)})
+            else:
+                full = self._full_decode_matrix(avail_rows)
+                entry = self._decode_cache.put(
+                    avail_rows,
+                    {"gf": full,
+                     "bitmat": gf.generator_to_bitmatrix(full, self.w)})
         return entry
 
     def table_cache_stats(self) -> dict:

@@ -329,14 +329,24 @@ class Shec(MatrixErasureCode):
         return np.asarray(xor_mm.matrix_encode(
             jnp.asarray(bitmat), jnp.asarray(stacked), self.w))
 
-    def decode_batch(self, avail_rows: tuple, chunks: np.ndarray) -> np.ndarray:
-        """Batched reconstruction of all chunks from the given rows.
+    DECODE_BATCH_ANY = True
+
+    def decode_batch(self, avail_rows: tuple, chunks: np.ndarray,
+                     want_rows: tuple | None = None) -> np.ndarray:
+        """Batched reconstruction from the given rows.
 
         Unlike the MDS codecs, avail_rows may be any recoverable subset
-        (not necessarily of size k)."""
+        (not necessarily of size k). want_rows names the rows the caller
+        actually needs (default: every missing row) — the shingle plan
+        only has to cover those, which is what makes sub-k local-repair
+        reads work; rows neither available nor wanted come back as
+        zeros and must not be consumed."""
         k, m = self.k, self.m
         avail = frozenset(avail_rows)
-        want = frozenset(i for i in range(k + m) if i not in avail)
+        if want_rows is None:
+            want = frozenset(i for i in range(k + m) if i not in avail)
+        else:
+            want = frozenset(want_rows) - avail
         row_of = {r: i for i, r in enumerate(avail_rows)}
         out = [None] * (k + m)
         for r in avail_rows:
@@ -348,13 +358,30 @@ class Shec(MatrixErasureCode):
                 solved = self._apply_plan(inv, stacked)
                 for ci, col in enumerate(cols):
                     out[col] = solved[:, ci]
-            missing_parity = [i for i in range(m) if out[k + i] is None]
-            if missing_parity:
-                if any(out[j] is None for j in range(k)):
-                    raise ErasureCodeError(errno.EIO, "unrecoverable")
-                parity = self.encode_batch(np.stack(out[:k], axis=1))
-                for i in missing_parity:
-                    out[k + i] = parity[:, i]
+            # wanted erased parity rows: recompute each from its OWN
+            # shingle window (like decode()), not from all k data rows —
+            # minimum_to_decode hands over only the window, and
+            # demanding full data would EIO a recoverable parity
+            for i in range(m):
+                if (k + i) not in want or out[k + i] is not None:
+                    continue
+                window = [j for j in range(k) if self.coding[i, j]]
+                if any(out[j] is None for j in window):
+                    raise ErasureCodeError(errno.EIO,
+                                           "window incomplete")
+                row = self.coding[i:i + 1, window]
+                stacked = np.stack([out[j] for j in window], axis=1)
+                out[k + i] = self._apply_plan(row, stacked)[:, 0]
+            still = [r for r in want if out[r] is None]
+            if still:
+                raise ErasureCodeError(errno.EIO,
+                                       "unable to read %s" % sorted(still))
+        zeros = None
+        for r in range(k + m):
+            if out[r] is None:
+                if zeros is None:
+                    zeros = np.zeros_like(np.asarray(chunks[:, 0]))
+                out[r] = zeros
         return np.stack(out, axis=1)
 
 

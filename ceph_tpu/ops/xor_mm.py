@@ -29,10 +29,17 @@ import jax.numpy as jnp
 
 @functools.lru_cache(maxsize=1)
 def _pallas_enabled() -> bool:
-    """Use the fused Pallas kernel for w=8 on TPU (ops.pallas_gf):
-    measured slightly ahead of the XLA path and bit-identical.
-    CEPH_TPU_PALLAS=0 disables."""
-    if os.environ.get("CEPH_TPU_PALLAS", "1") == "0":
+    """The fused Pallas kernel (ops.pallas_gf) is OPT-IN
+    (CEPH_TPU_PALLAS=1). Measured on the v5e-1 bench shape
+    (B=16, k=8, m=3, N=128KiB): the XLA einsum path encodes at
+    ~583 GB/s — the HBM roofline neighborhood for this kernel's
+    traffic — while the Pallas kernel reaches only ~2.5 GB/s at every
+    tile size from 512B to 64KiB (Mosaic lowers the tiny [24,64]
+    bitplane matmul poorly). Routing the default path through Pallas
+    is what caused the r01->r02 encode regression (329 -> 149 GB/s);
+    the kernel stays available for experimentation but never serves
+    production dispatch unless explicitly requested."""
+    if os.environ.get("CEPH_TPU_PALLAS", "0") != "1":
         return False
     from . import pallas_gf
     return pallas_gf.available()
@@ -85,8 +92,9 @@ def matrix_encode(bitmat: jax.Array, data: jax.Array, w: int) -> jax.Array:
     bitmat is the [m*w, k*w] bitplane expansion of the generator
     (gf.generator_to_bitmatrix); passing it as data (not static) lets one
     compiled program serve every generator of the same shape — decode
-    matrices included. The flagship w=8 3-D shape takes the fused
-    Pallas kernel on TPU when the chunk length tiles evenly.
+    matrices included. The w=8 3-D shape can opt into the fused Pallas
+    kernel (CEPH_TPU_PALLAS=1) when the chunk length tiles evenly; the
+    default is the XLA path, which measures at the HBM roofline.
     """
     if w == 8 and data.ndim == 3 and _pallas_enabled():
         from . import pallas_gf
@@ -105,6 +113,21 @@ def matrix_encode(bitmat: jax.Array, data: jax.Array, w: int) -> jax.Array:
     bits = unpack_element_bits(data, w)
     out_bits = xor_matmul(bitmat, bits)
     return pack_element_bits(out_bits, w)
+
+
+@functools.partial(jax.jit, static_argnames=("w",))
+def matrix_encode_multi(bitmats: jax.Array, data: jax.Array,
+                        w: int) -> jax.Array:
+    """Many independent encodes/decodes in ONE device program.
+
+    bitmats: [P, R, C] — a DIFFERENT bitmatrix per lane (e.g. one
+    decode matrix per erasure signature). data: [P, ..., k, N].
+    Returns [P, ..., m, N]. This is the cross-op coalescing primitive:
+    P concurrent OSD ops (each its own generator or decode matrix)
+    become one dispatch — on a remote transport that collapses P
+    round-trips into one, and on-device the lanes fill the MXU batch
+    dimension."""
+    return jax.vmap(lambda bm, d: matrix_encode(bm, d, w))(bitmats, data)
 
 
 @functools.partial(jax.jit, static_argnames=("w", "packetsize"))

@@ -197,7 +197,9 @@ class ECBackend:
             shards = self.pg.acting_shards()     # shard -> osd (may hole)
             txns, written = ec_transaction.generate_transactions(
                 op.plan, self.codec, self.sinfo, partial,
-                list(range(self.n)), self.pg.cid_of_shard)
+                list(range(self.n)), self.pg.cid_of_shard,
+                dispatcher=getattr(self.pg.daemon, "tpu_dispatcher",
+                                   None))
             for oid, wmap in written.items():
                 self.cache.present_rmw_update(oid, wmap)
             op.pending_commits = {s for s, osd in shards.items()
@@ -298,10 +300,18 @@ class ECBackend:
         self.pg.log_operation(msg.log_entries, msg.at_version,
                               msg.shard, txn=txn)
         done = threading.Event()
+        # the shard txn rewrites hinfo xattrs BEHIND the cache: a
+        # replica whose cache kept a pre-write (empty) entry would,
+        # on becoming primary, serve a stale size — which turns a
+        # snapshot-capture write into a silent no-capture
+        touched = {op[2] for op in msg.txn_ops
+                   if len(op) > 2 and isinstance(op[2], str)}
 
         def on_commit():
             with self.lock:
                 self._sub_seen[key] = True
+                for oid in touched:
+                    self.hinfo_cache.pop(oid, None)
             reply = MOSDECSubOpWriteReply(
                 pgid=self.pg.pgid, shard=msg.shard,
                 from_osd=self.pg.whoami, tid=msg.tid,
@@ -358,8 +368,12 @@ class ECBackend:
             stripe_len)
 
         shards_avail = self.pg.acting_shards()
+        # a shard whose OSD is still recovering this object would serve
+        # STALE bytes — reconstruct around it (peer_missing / the
+        # reference's MissingLoc role)
+        stale = self.pg.osds_missing_object(oid)
         avail = {s for s, osd in shards_avail.items()
-                 if osd != CRUSH_ITEM_NONE}
+                 if osd != CRUSH_ITEM_NONE and osd not in stale}
         want = {self.codec.chunk_index(i) for i in range(self.k)}
         try:
             to_read = self.codec.minimum_to_decode(want, avail)
@@ -422,8 +436,10 @@ class ECBackend:
                 read.errors[msg.shard] = msg.errors
                 # error on a shard: try to substitute another shard
                 shards_avail = self.pg.acting_shards()
+                stale = self.pg.osds_missing_object(read.oid)
                 avail = {s for s, osd in shards_avail.items()
                          if osd != CRUSH_ITEM_NONE
+                         and osd not in stale
                          and s not in read.errors
                          and s not in read.want_shards}
                 if avail:
@@ -469,8 +485,10 @@ class ECBackend:
             return
         # reassemble: decode the chunk streams back to logical bytes
         try:
-            out = ec_util.decode_concat(self.sinfo, self.codec,
-                                        dict(read.shard_data))
+            out = ec_util.decode_concat(
+                self.sinfo, self.codec, dict(read.shard_data),
+                dispatcher=getattr(self.pg.daemon, "tpu_dispatcher",
+                                   None))
         except Exception:
             read.on_done(None)
             return
@@ -496,12 +514,22 @@ class ECBackend:
             on_done(b"")
             return
         shards_avail = self.pg.acting_shards()
+        stale = self.pg.osds_missing_object(oid)
         avail = {s for s, osd in shards_avail.items()
-                 if osd != CRUSH_ITEM_NONE and s != target_shard}
+                 if osd != CRUSH_ITEM_NONE and s != target_shard
+                 and osd not in stale}
         tid = next(self._tids)
         read = _InflightRead(tid, oid, 0, 0, None)
-        use = tuple(sorted(avail))[:self.k]
-        if len(use) < self.k:
+        # the codec picks the repair set: for RS any k survivors, for
+        # locality codecs (lrc/shec) the local group — fewer reads AND
+        # the only set guaranteed decodable
+        try:
+            use = tuple(sorted(self.codec.minimum_to_decode(
+                {target_shard}, avail)))
+        except Exception:
+            on_done(None)
+            return
+        if not use:
             on_done(None)
             return
         read.want_shards = set(use)

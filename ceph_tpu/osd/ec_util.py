@@ -77,12 +77,15 @@ class StripeInfo:
                 self.logical_to_next_stripe_offset((off - start) + length))
 
 
-def encode(sinfo: StripeInfo, codec, data, want=None) -> dict:
+def encode(sinfo: StripeInfo, codec, data, want=None,
+           dispatcher=None) -> dict:
     """Encode a stripe-aligned payload -> {shard: chunk bytes}.
 
     data: bytes/uint8 array whose length is a multiple of stripe_width.
     ONE batched device call for all stripes (vs the reference's
     per-stripe loop). Returns every shard unless `want` restricts it.
+    With a dispatcher (osd/tpu_dispatch.py), concurrent callers sharing
+    this codec coalesce into one fused device call.
     """
     arr = np.frombuffer(data, dtype=np.uint8) if isinstance(
         data, (bytes, bytearray, memoryview)) else \
@@ -98,7 +101,10 @@ def encode(sinfo: StripeInfo, codec, data, want=None) -> dict:
     stripes = arr.size // sinfo.stripe_width
     # [S, k, chunk]: stripes become the device batch dimension
     batch = arr.reshape(stripes, k, sinfo.chunk_size)
-    parity = np.asarray(codec.encode_batch(batch))
+    if dispatcher is not None:
+        parity = np.asarray(dispatcher.encode(codec, batch))
+    else:
+        parity = np.asarray(codec.encode_batch(batch))
     out = {}
     for i in range(n):
         idx = codec.chunk_index(i)
@@ -110,13 +116,16 @@ def encode(sinfo: StripeInfo, codec, data, want=None) -> dict:
 
 
 def decode(sinfo: StripeInfo, codec, to_decode: dict,
-           want=None) -> dict:
+           want=None, dispatcher=None) -> dict:
     """Reconstruct shards from per-shard chunk streams.
 
     to_decode: {shard: bytes of >= 1 chunks, equal lengths}. Returns
     {shard: bytes} for `want` (default: all shards). Batched across
     stripes in one device call (reference decode loops per stripe,
-    ECUtil.cc:8-99).
+    ECUtil.cc:8-99). With a dispatcher, concurrent reads sharing an
+    erasure signature coalesce into one fused device call (matrix
+    codecs only — the locality codecs' want_rows plumbing stays
+    direct).
     """
     if not to_decode:
         raise ErasureCodeError(22, "decode with no chunks")
@@ -165,12 +174,26 @@ def decode(sinfo: StripeInfo, codec, to_decode: dict,
                           else np.ascontiguousarray(rec).reshape(-1))
             return out
 
-    use = tuple(sorted(logical))[:k]
-    if len(use) < k:
-        raise ErasureCodeError(5, "not enough chunks to decode (%d < %d)"
-                               % (len(use), k))
-    stacked = np.stack([logical[i] for i in use], axis=1)  # [S, k, chunk]
-    full = np.asarray(codec.decode_batch(use, stacked))    # [S, n, chunk]
+    if getattr(codec, "DECODE_BATCH_ANY", False):
+        # locality codecs (lrc/shec) accept any recoverable subset and
+        # need to know which rows are wanted (a local repair hands over
+        # fewer than k shards; unwanted rows may come back as zeros)
+        use = tuple(sorted(logical))
+        stacked = np.stack([logical[i] for i in use], axis=1)
+        full = np.asarray(codec.decode_batch(
+            use, stacked,
+            want_rows=tuple(sorted(inv[s] for s in want))))
+    else:
+        use = tuple(sorted(logical))[:k]
+        if len(use) < k:
+            raise ErasureCodeError(
+                5, "not enough chunks to decode (%d < %d)"
+                % (len(use), k))
+        stacked = np.stack([logical[i] for i in use], axis=1)  # [S,k,chunk]
+        if dispatcher is not None:
+            full = np.asarray(dispatcher.decode(codec, use, stacked))
+        else:
+            full = np.asarray(codec.decode_batch(use, stacked))  # [S,n,chunk]
     out = {}
     for i in range(n):
         idx = codec.chunk_index(i)
@@ -183,12 +206,13 @@ def decode(sinfo: StripeInfo, codec, to_decode: dict,
     return out
 
 
-def decode_concat(sinfo: StripeInfo, codec, to_decode: dict) -> bytes:
+def decode_concat(sinfo: StripeInfo, codec, to_decode: dict,
+                  dispatcher=None) -> bytes:
     """Reconstruct and concatenate the data shards back into the logical
     payload (the read-path finish, ECUtil.cc:46-99)."""
     k = codec.get_data_chunk_count()
     want = {codec.chunk_index(i) for i in range(k)}
-    shards = decode(sinfo, codec, to_decode, want)
+    shards = decode(sinfo, codec, to_decode, want, dispatcher=dispatcher)
     total = len(next(iter(shards.values())))
     stripes = total // sinfo.chunk_size
     stacked = np.stack(

@@ -78,6 +78,16 @@ class OSDDaemon(Dispatcher):
         if self.ctx.admin_socket is not None:
             self.op_tracker.register_admin_commands(self.ctx.admin_socket)
         self.timer = SafeTimer("osd%d-timer" % whoami)
+        # cross-op EC device-call coalescing (osd/tpu_dispatch.py):
+        # concurrent PG encodes sharing a codec ride one dispatch
+        if conf.get_val("osd_tpu_coalesce"):
+            from .tpu_dispatch import TpuDispatcher
+            self.tpu_dispatcher = TpuDispatcher(
+                max_batch=conf.get_val("osd_tpu_coalesce_max_batch"),
+                max_delay=conf.get_val(
+                    "osd_tpu_coalesce_max_delay_ms") / 1e3)
+        else:
+            self.tpu_dispatcher = None
         self.hb_peers: dict = {}       # osd -> last reply stamp
         self.hb_pending: dict = {}     # osd -> first unacked ping stamp
         self.mgr_addr = None           # set when an mgr joins the cluster
@@ -127,6 +137,8 @@ class OSDDaemon(Dispatcher):
     def shutdown(self) -> None:
         self._running = False
         self.timer.shutdown()
+        if self.tpu_dispatcher is not None:
+            self.tpu_dispatcher.shutdown()
         self.op_wq.stop()
         self.finisher.stop()
         for msgr in (self.public_msgr, self.cluster_msgr, self.hb_msgr):
@@ -262,7 +274,10 @@ class OSDDaemon(Dispatcher):
             self.hb_pending.setdefault(osd, now)
             self.hb_msgr.send_message(
                 MPing(stamp=now, epoch=self.map_epoch()), addr)
-            first_unacked = self.hb_pending[osd]
+            # the reply handler may pop the entry between the send and
+            # this read (it raced a KeyError here once): a popped entry
+            # means the ping was acked — nothing is unacked
+            first_unacked = self.hb_pending.get(osd, now)
             if now - first_unacked > grace:
                 self.ctx.dout("osd", 1,
                               "osd.%d no reply from osd.%d for %.2fs -> "

@@ -93,6 +93,12 @@ class PG:
         self.missing: dict = {}       # oid -> version we need
         self._missing_src: dict = {}  # oid -> osd holding it
         self._missing_waiters: dict = {}   # oid -> [continuations]
+        # primary-side map of PEERS' missing objects (the reference's
+        # peer_missing / MissingLoc): a shard OSD that reported an
+        # object missing serves STALE bytes until its recovery push is
+        # acked — EC reads must reconstruct around it, not from it
+        self.peer_missing: dict = {}  # oid -> set(osd)
+        self._push_retrying: set = set()   # (oid, peer) retry chains
         # reqid -> version, rebuilt from the log: the failover-safe
         # client-retransmit dedup (pg_log_entry_t::reqid role)
         from ..common.bounded import BoundedDict
@@ -344,6 +350,19 @@ class PG:
                 self.peer_state = ("peering" if actp == self.whoami
                                    else "replica")
                 changed = True     # first sight of our role: peer once
+            if changed and actp != self.whoami:
+                # primary-side recovery bookkeeping is meaningless on a
+                # replica; keeping it would wedge cleanliness checks
+                # and steer a future primary's reads forever
+                self.peer_missing.clear()
+            if changed and self.whoami not in \
+                    set(self.acting) | set(self.up):
+                # we are a STRAY for this PG now: nobody will ever push
+                # our missing objects; drop the bookkeeping (and any
+                # parked ops — the client retargets by map)
+                self.missing.clear()
+                self._missing_src.clear()
+                self._missing_waiters.clear()
         if changed and self.is_primary():
             self.daemon.queue_recovery(self)
         if not self.is_primary():
@@ -619,9 +638,24 @@ class PG:
         if length == 0:
             reply_fn(0, b"")
             return
-        self.backend.objects_read(
-            oid, off, length,
-            lambda data: reply_fn(0 if data is not None else -5, data))
+        self._ec_read_with_retry(oid, off, length, reply_fn)
+
+    def _ec_read_with_retry(self, oid, off, length, reply_fn,
+                            attempt: int = 0) -> None:
+        """Reconstruction shortages are usually TRANSIENT (a shard
+        mid-recovery is excluded from reads until its push commits):
+        retry briefly before failing, like the reference holds ops on
+        degraded objects instead of erroring (wait_for_degraded)."""
+        def on_data(data):
+            if data is not None:
+                reply_fn(0, data)
+            elif attempt < 20:
+                self.daemon.timer.add_event_after(
+                    0.5, self._ec_read_with_retry, oid, off, length,
+                    reply_fn, attempt + 1)
+            else:
+                reply_fn(-5, None)
+        self.backend.objects_read(oid, off, length, on_data)
 
     def _object_size(self, oid):
         if self.pool.is_erasure():
@@ -902,7 +936,7 @@ class PG:
             return
         pre: dict = {}
 
-        def read_next(i: int) -> None:
+        def read_next(i: int, attempt: int = 0) -> None:
             if i == len(needs):
                 plan(pre)
                 return
@@ -914,10 +948,15 @@ class PG:
 
             def on_data(data, roid=roid, i=i):
                 if data is None:
-                    # degraded below k / reconstruction failed: error
-                    # out — b"" here would snapshot or roll back to
-                    # EMPTY content and ack it
-                    finish(-5, None)
+                    # degraded below k / reconstruction failed: b""
+                    # here would snapshot or roll back to EMPTY content
+                    # and ack it. Usually TRANSIENT (shard mid-recovery
+                    # excluded from reads): retry briefly, then error
+                    if attempt < 10:
+                        self.daemon.timer.add_event_after(
+                            0.5, read_next, i, attempt + 1)
+                    else:
+                        finish(-5, None)
                     return
                 pre[roid] = bytes(data)
                 read_next(i + 1)
@@ -1065,12 +1104,24 @@ class PG:
                     "last_update": list(self.pg_log.head),
                     "log_tail": list(self.pg_log.tail)}
 
+    def osds_missing_object(self, oid) -> set:
+        """OSDs whose shard of `oid` is known-stale (their recovery
+        push has not committed): reads must reconstruct around them."""
+        with self.lock:
+            bad = set(self.peer_missing.get(oid, ()))
+            if oid in self.missing:
+                bad.add(self.whoami)
+            return bad
+
     def start_peering(self) -> None:
         with self.lock:
             self.peer_state = "peering"
             self._peer_seq += 1
             seq = self._peer_seq
             self._peer_infos = {self.whoami: self._my_info()}
+            # a new interval recomputes who is missing what: replicas
+            # re-report after activation (handle_log missing notify)
+            self.peer_missing.clear()
             targets = {osd for osd in set(self.up) | set(self.acting)
                        if osd not in (CRUSH_ITEM_NONE, self.whoami)}
             self._peer_wait = set(targets)
@@ -1161,12 +1212,34 @@ class PG:
         set (GetMissing leg, after it merged our activation log) —
         distinguished by the kind flag, because an EMPTY missing
         report must not masquerade as an info reply."""
+        if getattr(msg, "kind", "info") == "recovered":
+            # a peer applied its recovery push: its shard is clean
+            # again and may serve reads
+            with self.lock:
+                for oid in msg.missing:
+                    peers = self.peer_missing.get(oid)
+                    if peers is not None:
+                        peers.discard(msg.from_osd)
+                        if not peers:
+                            self.peer_missing.pop(oid, None)
+            return
         if getattr(msg, "kind", "info") == "missing":
             shards = self.acting_shards()
             shard = next((s for s, o in shards.items()
                           if o == msg.from_osd), -1)
+            if self.pool.is_erasure() and shard == -1:
+                # a STRAY's report (the peer is no longer in the acting
+                # set): it holds no shard to recover — ignore; the
+                # stray clears its own state on its next map update
+                return
             if not self.pool.is_erasure():
+                if msg.from_osd not in set(self.acting) | set(self.up):
+                    return
                 shard = -1
+            with self.lock:
+                for oid in msg.missing:
+                    self.peer_missing.setdefault(oid, set()).add(
+                        msg.from_osd)
             for oid in msg.missing:
                 self._push_object(oid, shard, msg.from_osd)
             return
@@ -1716,17 +1789,25 @@ class PG:
     def _gather_push_meta(self, oid) -> tuple[dict, dict]:
         """(attrs, omap) from our local shard for a recovery/repair
         push — handle_push removes+rewrites the target, so the push
-        must carry the full metadata set or the target loses it."""
+        must carry the FULL metadata set or the target loses it. A
+        whitelist here once dropped the SNAPSET xattr, so a recovered
+        head forgot its clones and snap reads resolved to the head."""
         src_cid = self.cid_of_shard(
             self.my_shard() if self.pool.is_erasure() else -1)
-        attrs: dict = {}
-        for name in (VERSION_ATTR, "_size", "hinfo_key"):
-            try:
-                val = self.store.getattr(src_cid, oid, name)
-            except KeyError:
-                val = None
-            if val is not None:
-                attrs[name] = val
+        try:
+            attrs = {k: v for k, v in
+                     self.store.getattrs(src_cid, oid).items()
+                     if v is not None}
+        except (KeyError, NotImplementedError):
+            attrs = {}
+            for name in (VERSION_ATTR, "_size", "hinfo_key",
+                         SNAPSET_ATTR, WHITEOUT_ATTR):
+                try:
+                    val = self.store.getattr(src_cid, oid, name)
+                except KeyError:
+                    val = None
+                if val is not None:
+                    attrs[name] = val
         try:
             omap = self.store.omap_get(src_cid, oid)
         except KeyError:
@@ -1734,13 +1815,38 @@ class PG:
         return attrs, omap
 
     def _push_object(self, oid, shard: int, peer_osd: int,
-                     force: bool = False) -> None:
+                     force: bool = False, attempt: int = 0) -> None:
         attrs, omap = self._gather_push_meta(oid)
 
         def on_data(data):
             if data is None:
+                # reconstruction failed (mid-churn shortage): retry
+                # while the peer still owes this object, or its
+                # peer_missing entry never clears and reads avoid the
+                # shard forever. Bounded + deduped: one retry chain per
+                # (oid, peer), backing off, giving up after ~2 minutes
+                # (a later peer re-report or peering round re-arms)
+                key = (oid, peer_osd)
+                with self.lock:
+                    if key in self._push_retrying:
+                        return
+                    self._push_retrying.add(key)
+                delay = 1.0 if attempt < 10 else 3.0
+                if attempt < 40:
+                    self.daemon.timer.add_event_after(
+                        delay, self._retry_push, oid, shard, peer_osd,
+                        attempt + 1)
+                else:
+                    with self.lock:
+                        self._push_retrying.discard(key)
                 return
-            version = int(attrs.get(VERSION_ATTR, b"0") or 0)
+            # log-domain version: a replica's missing entry records the
+            # LOG version of the entry that created the object; a snap
+            # clone's VERSION_ATTR is the pre-capture head version
+            # (deliberately older), so pushing the attr version alone
+            # would never satisfy the replica's missing gate
+            version = max(int(attrs.get(VERSION_ATTR, b"0") or 0),
+                          self._log_version_of(oid))
             msg = MOSDPGPush(
                 pgid=self.pgid, from_osd=self.whoami, shard=shard,
                 oid=oid, data=data, attrs=attrs, omap=omap,
@@ -1753,10 +1859,32 @@ class PG:
 
         self.backend.recover_object(oid, shard, on_data)
 
+    def _log_version_of(self, oid) -> int:
+        """Latest log version touching oid (0 when not in the log)."""
+        with self.lock:
+            for e in reversed(self.pg_log.entries):
+                if e.oid == oid:
+                    return e.version
+        return 0
+
+    def _retry_push(self, oid, shard: int, peer_osd: int,
+                    attempt: int = 1) -> None:
+        with self.lock:
+            self._push_retrying.discard((oid, peer_osd))
+            if self.acting_primary != self.whoami or \
+                    oid not in self.peer_missing:
+                return
+        self._push_object(oid, shard, peer_osd, attempt=attempt)
+
     def handle_push(self, msg) -> None:
         """Apply a recovery push to the local shard store."""
         cid = self.cid_of_shard(
             msg.shard if self.pool.is_erasure() else -1)
+        # the push rewrites the hinfo xattr behind the EC backend's
+        # cache: drop the cached entry or size/crc queries serve the
+        # pre-recovery state
+        if self.pool.is_erasure():
+            self.backend.hinfo_cache.pop(msg.oid, None)
         # never let an in-flight push of an older version clobber a
         # fresher local copy (an acked client write may have landed
         # while the push was in transit)
@@ -1775,6 +1903,22 @@ class PG:
                 self.missing.pop(msg.oid, None)
                 self._missing_src.pop(msg.oid, None)
                 waiters = self._missing_waiters.pop(msg.oid, [])
+        def ack_recovered():
+            # tell the primary this shard is consistent again so its
+            # peer_missing map stops steering reads around us
+            if msg.from_osd == self.whoami:
+                with self.lock:
+                    peers = self.peer_missing.get(msg.oid)
+                    if peers is not None:
+                        peers.discard(self.whoami)
+                        if not peers:
+                            self.peer_missing.pop(msg.oid, None)
+            else:
+                self.send_to_osd(msg.from_osd, MOSDPGNotify(
+                    pgid=self.pgid, from_osd=self.whoami,
+                    missing=[msg.oid], kind="recovered",
+                    map_epoch=self.map_epoch()))
+
         try:
             if msg.delete:
                 # divergent-delete propagation: drop our ghost copy
@@ -1789,7 +1933,10 @@ class PG:
                 if local_v >= 0 and local_v <= msg.version:
                     txn = Transaction()
                     txn.remove(cid, msg.oid)
+                    txn.register_on_commit(ack_recovered)
                     self.store.queue_transaction(txn)
+                else:
+                    ack_recovered()
                 return
             # scrub repairs (force) may overwrite SAME-version bitrot;
             # no push — forced or not — may ever roll back a strictly
@@ -1797,6 +1944,7 @@ class PG:
             if local_v >= 0 and (local_v > msg.version
                                  or (local_v == msg.version
                                      and not msg.force)):
+                ack_recovered()   # our copy is already current
                 return
             txn = Transaction()
             txn.remove(cid, msg.oid)
@@ -1807,6 +1955,7 @@ class PG:
                 txn.setattr(cid, msg.oid, name, val)
             if msg.omap:
                 txn.omap_setkeys(cid, msg.oid, msg.omap)
+            txn.register_on_commit(ack_recovered)
             self.store.queue_transaction(txn)
         finally:
             # the recovered object unblocks any ops held on it

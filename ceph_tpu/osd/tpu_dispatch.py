@@ -1,0 +1,180 @@
+"""Cross-op device-call coalescing for the OSD's EC hot path.
+
+Role: the twin of the native bridge (native/src/tpu_bridge.cc) inside
+the Python OSD. The reference's ECBackend enters the codec once per op
+(src/osd/ECBackend.cc:1437 submit_transaction -> ECUtil::encode per
+transaction); under concurrency each op would pay its own device
+dispatch. Stripes are embarrassingly parallel, so concurrent ops that
+share a generator (same pool/codec) or a decode matrix (same erasure
+signature) CONCATENATE along the stripe axis and ride ONE device
+program — N dispatches become ceil(N / max_batch), and on a remote
+transport N round-trips collapse the same way.
+
+The dispatcher presents a synchronous facade (submitters block until
+their slice of the fused result lands), so the EC pipeline's ordering
+guarantees are untouched — only the device traffic is batched.
+
+Knobs ride the options schema: osd_tpu_coalesce (default on),
+osd_tpu_coalesce_max_batch, osd_tpu_coalesce_max_delay_ms.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["TpuDispatcher"]
+
+
+class _Pending:
+    __slots__ = ("batch", "event", "out", "error")
+
+    def __init__(self, batch):
+        self.batch = batch
+        self.event = threading.Event()
+        self.out = None
+        self.error = None
+
+
+class TpuDispatcher:
+    """Coalesces same-key codec calls into single device dispatches.
+
+    Key = (codec identity, kind, per-stripe shape): ops whose batches
+    stack along axis 0 into one well-formed [S_total, k, chunk] call.
+    """
+
+    def __init__(self, max_batch: int = 8, max_delay: float = 0.002):
+        self.max_batch = max_batch
+        self.max_delay = max_delay
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.queues: dict = {}     # key -> (fn, [_Pending])
+        self.stats = {"ops": 0, "dispatches": 0, "coalesced": 0}
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, name="tpu-dispatch", daemon=True)
+        self._thread.start()
+
+    # -- public API ----------------------------------------------------
+
+    @staticmethod
+    def _codec_key(codec):
+        """Identity BY VALUE: every PG backend holds its own codec
+        instance, so keying on id() would never coalesce across PGs.
+        Codecs with the same generator bitmatrix (and layout params)
+        compute the same function."""
+        cached = getattr(codec, "_dispatch_key", None)
+        if cached is not None:
+            return cached
+        bm = getattr(codec, "_bitmat", None)
+        if bm is not None:
+            key = (type(codec).__name__, getattr(codec, "w", 0),
+                   getattr(codec, "packetsize", 0),
+                   bm.shape, hash(bm.tobytes()))
+        else:
+            key = ("id", id(codec))
+        try:
+            codec._dispatch_key = key
+        except AttributeError:
+            pass
+        return key
+
+    def encode(self, codec, batch: np.ndarray) -> np.ndarray:
+        """codec.encode_batch(batch), coalesced across submitters."""
+        key = (self._codec_key(codec), "enc", batch.shape[1:],
+               str(batch.dtype))
+        return self._submit(key, codec.encode_batch, batch)
+
+    def decode(self, codec, avail_rows: tuple,
+               chunks: np.ndarray) -> np.ndarray:
+        """codec.decode_batch for one erasure signature, coalesced with
+        ops sharing the same signature (same decode matrix)."""
+        avail_rows = tuple(avail_rows)
+        key = (self._codec_key(codec), "dec", avail_rows,
+               chunks.shape[1:], str(chunks.dtype))
+        return self._submit(
+            key, lambda stacked: codec.decode_batch(avail_rows, stacked),
+            chunks)
+
+    def shutdown(self) -> None:
+        with self.cv:
+            self._stop = True
+            self.cv.notify_all()
+        self._thread.join(timeout=5)
+
+    # -- internals -----------------------------------------------------
+
+    def _submit(self, key, fn, batch):
+        p = _Pending(np.asarray(batch))
+        with self.cv:
+            q = self.queues.get(key)
+            if q is None:
+                q = self.queues[key] = (fn, [])
+            q[1].append(p)
+            self.stats["ops"] += 1
+            self.cv.notify_all()
+        if not p.event.wait(timeout=120):
+            raise TimeoutError("tpu dispatcher wedged")
+        if p.error is not None:
+            raise p.error
+        return p.out
+
+    def _take_group(self):
+        """Pick the fullest queue; wait up to max_delay for stragglers
+        unless it is already at max_batch."""
+        deadline = None
+        while True:
+            with self.cv:
+                if self._stop:
+                    return None
+                best_key, best = None, None
+                for key, (fn, pend) in self.queues.items():
+                    if pend and (best is None or
+                                 len(pend) > len(best[1])):
+                        best_key, best = key, (fn, pend)
+                if best is None:
+                    deadline = None
+                    self.cv.wait(0.5)
+                    continue
+                if len(best[1]) >= self.max_batch or (
+                        deadline is not None
+                        and time.monotonic() >= deadline):
+                    fn, pend = best
+                    take = pend[:self.max_batch]
+                    del pend[:len(take)]
+                    if not pend:
+                        self.queues.pop(best_key, None)
+                    deadline = None
+                    return fn, take
+                if deadline is None:
+                    deadline = time.monotonic() + self.max_delay
+                self.cv.wait(self.max_delay)
+
+    def _run(self):
+        while True:
+            group = self._take_group()
+            if group is None:
+                return
+            fn, pend = group
+            self.stats["dispatches"] += 1
+            if len(pend) > 1:
+                self.stats["coalesced"] += len(pend)
+            try:
+                if len(pend) == 1:
+                    out = np.asarray(fn(pend[0].batch))
+                    pend[0].out = out
+                else:
+                    stacked = np.concatenate([p.batch for p in pend])
+                    out = np.asarray(fn(stacked))
+                    off = 0
+                    for p in pend:
+                        s = p.batch.shape[0]
+                        p.out = out[off:off + s]
+                        off += s
+            except BaseException as e:   # deliver, don't kill the loop
+                for p in pend:
+                    p.error = e
+            for p in pend:
+                p.event.set()

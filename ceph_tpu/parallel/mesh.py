@@ -62,3 +62,32 @@ def encode_sharded(codec, data, mesh):
         return jax.lax.with_sharding_constraint(parity, out_sharding)
 
     return step(bitmat, jnp.asarray(data))
+
+
+def decode_sharded(codec, avail_rows, chunks, mesh):
+    """Reconstruct all chunk rows from k available ones, sharded over
+    (stripe, block) like encode_sharded: chunks [B, k, N] -> [B, n, N].
+
+    The decode bitmatrix (from the codec's table cache / bank) is the
+    same shape family as the generator, so the identical partitioning
+    applies — byte columns decode independently, no collectives.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..ops import xor_mm
+
+    stripe, block = mesh.axis_names
+    data_sharding = NamedSharding(mesh, P(stripe, None, block))
+    out_sharding = NamedSharding(mesh, P(stripe, None, block))
+    entry = codec._decode_entry(tuple(avail_rows))
+    bitmat = jnp.asarray(entry["bitmat"])
+
+    @jax.jit
+    def step(bm, x):
+        x = jax.lax.with_sharding_constraint(x, data_sharding)
+        full = xor_mm.matrix_encode(bm, x, codec.w)
+        return jax.lax.with_sharding_constraint(full, out_sharding)
+
+    return step(bitmat, jnp.asarray(chunks))

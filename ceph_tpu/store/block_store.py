@@ -705,6 +705,13 @@ class BlockStore(ObjectStore):
                 raise KeyError("no object %r in %r" % (oid, cid))
             return onode.xattrs.get(name)
 
+    def getattrs(self, cid, oid) -> dict:
+        with self._lock:
+            onode = self._onodes.get(_okey(cid, oid))
+            if onode is None:
+                raise KeyError("no object %r in %r" % (oid, cid))
+            return dict(onode.xattrs)
+
     def omap_get(self, cid, oid) -> dict:
         with self._lock:
             key = _okey(cid, oid)

@@ -347,6 +347,10 @@ class KStore(ObjectStore):
         with self._lock:
             return self._get(cid, oid)["xattrs"].get(name)
 
+    def getattrs(self, cid, oid) -> dict:
+        with self._lock:
+            return dict(self._get(cid, oid)["xattrs"])
+
     def omap_get(self, cid, oid) -> dict:
         with self._lock:
             self._get(cid, oid)

@@ -192,6 +192,10 @@ class MemStore(ObjectStore):
         with self._lock:
             return self._obj(cid, oid).xattrs.get(name)
 
+    def getattrs(self, cid, oid) -> dict:
+        with self._lock:
+            return dict(self._obj(cid, oid).xattrs)
+
     def omap_get(self, cid, oid) -> dict:
         with self._lock:
             return dict(self._obj(cid, oid).omap)

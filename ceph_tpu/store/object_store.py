@@ -117,6 +117,12 @@ class ObjectStore:
     def getattr(self, cid, oid, name: str):
         raise NotImplementedError
 
+    def getattrs(self, cid, oid) -> dict:
+        """Full xattr set (ObjectStore::getattrs): recovery pushes must
+        carry EVERY xattr — snapset, whiteout, user attrs — or the
+        recovered object silently loses state."""
+        raise NotImplementedError
+
     def omap_get(self, cid, oid) -> dict:
         raise NotImplementedError
 

@@ -185,19 +185,25 @@ class TestLog:
 
 class TestThrottle:
     def test_blocking_get(self):
+        """Event-driven (no wall-clock assertions — those flake under
+        load): the releaser waits until the getter is provably parked
+        inside get() before putting, so 'put happened before get
+        returned' is established by ordering, not timing."""
         t = Throttle("t", 2)
         t.get(2)
-        released = []
+        order = []
 
         def releaser():
-            time.sleep(0.05)
-            released.append(True)
+            deadline = time.monotonic() + 10
+            while t.num_waiters() == 0 and time.monotonic() < deadline:
+                time.sleep(0.001)
+            order.append("blocked" if t.num_waiters() else "never-blocked")
             t.put(2)
 
         threading.Thread(target=releaser).start()
-        t0 = time.monotonic()
         t.get(1)  # must block until put
-        assert released and time.monotonic() - t0 >= 0.04
+        order.append("got")
+        assert order == ["blocked", "got"]
         assert t.get_current() == 1
 
     def test_timeout(self):

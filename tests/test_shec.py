@@ -132,3 +132,36 @@ def test_decode_from_minimum_set():
             chunks = {i: enc[i] for i in minimum}
             dec = codec.decode({gone}, chunks)
             assert np.array_equal(dec[gone], enc[gone]), (k, m, c, gone)
+
+
+class TestParityShardRecovery:
+    def test_parity_recovers_from_its_shingle_window(self):
+        """The OSD recovery path (minimum_to_decode -> ec_util.decode
+        want={parity}) hands decode_batch only the parity's shingle
+        window; the batch path must recompute it from that window like
+        decode() does, not demand all k data rows."""
+        import numpy as np
+
+        from ceph_tpu import registry
+        from ceph_tpu.osd import ec_util
+        for prof in ({"technique": "multiple", "k": "3", "m": "2",
+                      "c": "1"},
+                     {"technique": "multiple", "k": "8", "m": "4",
+                      "c": "3"}):
+            codec = registry.factory("shec_tpu", dict(prof))
+            k, n = codec.k, codec.get_chunk_count()
+            sinfo = ec_util.StripeInfo(k, k * 64)
+            rng = np.random.default_rng(17)
+            payload = rng.integers(0, 256, size=2 * sinfo.stripe_width,
+                                   dtype=np.uint8).tobytes()
+            shards = ec_util.encode(sinfo, codec, payload)
+            for parity in range(k, n):
+                avail = set(shards) - {parity}
+                mini = codec.minimum_to_decode({parity}, avail)
+                fetched = {s: shards[s] for s in mini}
+                out = ec_util.decode(sinfo, codec, fetched,
+                                     want={parity})
+                assert np.array_equal(
+                    np.frombuffer(out[parity], np.uint8).reshape(-1),
+                    np.frombuffer(shards[parity], np.uint8)), \
+                    (prof, parity)

@@ -149,3 +149,49 @@ class TestXorFastPath:
             == payload
         assert codec.xor_fast_hits == 1
         assert codec.table_cache_stats()["misses"] == 0
+
+
+class TestDecodeBank:
+    """The device-resident decode-matrix bank: every C(n,k) signature's
+    bitmatrix precomputed and uploaded in one transfer, so a fresh
+    erasure signature costs a device slice, not a host build + H2D."""
+
+    def test_bank_builds_and_matches_per_entry(self):
+        import itertools
+        codec = make("jax_tpu", technique="reed_sol_van", k=4, m=2, w=8)
+        rng = np.random.default_rng(3)
+        data = rng.integers(0, 256, size=(2, 4, 512), dtype=np.uint8)
+        parity = np.asarray(codec.encode_batch(data))
+        full = np.concatenate([data, parity], axis=1)
+        # every signature decodes bit-exact through the bank
+        for avail in itertools.combinations(range(6), 4):
+            out = np.asarray(codec.decode_batch(
+                avail, full[:, list(avail), :]))
+            assert np.array_equal(out, full), avail
+        assert codec._bank_state == "built"
+        assert len(codec._bank_index) == 15   # C(6,4)
+
+    def test_bank_infeasible_falls_back(self):
+        codec = make("jax_tpu", technique="reed_sol_van", k=4, m=2, w=8)
+        codec.DECODE_BANK_LIMIT = 1           # force infeasible
+        rng = np.random.default_rng(4)
+        data = rng.integers(0, 256, size=(1, 4, 512), dtype=np.uint8)
+        parity = np.asarray(codec.encode_batch(data))
+        full = np.concatenate([data, parity], axis=1)
+        avail = (0, 2, 3, 5)
+        out = np.asarray(codec.decode_batch(
+            avail, full[:, list(avail), :]))
+        assert np.array_equal(out, full)
+        assert codec._bank_state == "infeasible"
+
+    def test_numpy_backend_never_builds_bank(self):
+        codec = make("jerasure", technique="reed_sol_van", k=3, m=2, w=8)
+        rng = np.random.default_rng(5)
+        data = rng.integers(0, 256, size=(1, 3, 256), dtype=np.uint8)
+        parity = np.asarray(codec.encode_batch(data))
+        full = np.concatenate([data, parity], axis=1)
+        avail = (1, 2, 4)
+        out = np.asarray(codec.decode_batch(
+            avail, full[:, list(avail), :]))
+        assert np.array_equal(out, full)
+        assert codec._bank_state == "infeasible"

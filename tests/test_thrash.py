@@ -69,16 +69,23 @@ class TestThrashReplicated:
                                 interval=1.5, revive_delay=0.5)
             writer.start()
             thrasher.start()
-            time.sleep(10.0)         # several kill/revive cycles
+            # adaptive window instead of a fixed sleep: run until the
+            # workload has demonstrably made progress through several
+            # kill cycles (a loaded box slows peering; a fixed window
+            # then starves the writer and flakes the floor assertion)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                kills = [a for a in thrasher.log if a[0] == "kill"]
+                if len(writer.acked) > 15 and len(kills) >= 2:
+                    break
+                time.sleep(0.5)
             thrasher.stop_and_heal(timeout=60)
             stop_evt.set()
             writer.join(timeout=10)
             kills = [a for a in thrasher.log if a[0] == "kill"]
             assert kills, "thrasher never killed anything"
-            # modest floor: under full-suite load peering slows down;
-            # the hard assertion is durability of ACKED writes below
             assert len(writer.acked) > 10, \
-                "workload starved: %d acked" % len(writer.acked)
+                "workload starved: %d acked in 60s" % len(writer.acked)
             # every acknowledged write must read back bit-exact
             deadline = time.monotonic() + 30
             missing = list(writer.acked)

@@ -1,0 +1,201 @@
+"""Cross-op device-call coalescing (osd/tpu_dispatch.py).
+
+The dispatcher batches concurrent EC codec calls sharing a generator
+(or decode matrix) into single device dispatches — the Python twin of
+native/src/tpu_bridge.cc, shadowing the per-op entry at
+src/osd/ECBackend.cc:1437. Results must be bit-exact and the dispatch
+count measurably below the op count.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from ceph_tpu import registry
+from ceph_tpu.osd.tpu_dispatch import TpuDispatcher
+
+PROFILE = {"technique": "reed_sol_van", "k": "4", "m": "2", "w": "8"}
+
+
+@pytest.fixture()
+def dispatcher():
+    # generous window: on a loaded 1-core box thread start latency can
+    # exceed a tight delay, splitting batches and flaking exact-count
+    # assertions
+    d = TpuDispatcher(max_batch=8, max_delay=0.5)
+    yield d
+    d.shutdown()
+
+
+def _codec():
+    return registry.factory("jax_tpu", dict(PROFILE))
+
+
+class TestCoalescing:
+    def test_concurrent_encodes_fuse_and_stay_bit_exact(self, dispatcher):
+        codec = _codec()
+        rng = np.random.default_rng(1)
+        batches = [rng.integers(0, 256, size=(3, 4, 512), dtype=np.uint8)
+                   for _ in range(8)]
+        direct = [np.asarray(codec.encode_batch(b)) for b in batches]
+        outs = [None] * 8
+
+        def worker(i):
+            outs[i] = np.asarray(dispatcher.encode(codec, batches[i]))
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        for i in range(8):
+            assert np.array_equal(outs[i], direct[i]), i
+        assert dispatcher.stats["ops"] == 8
+        assert dispatcher.stats["dispatches"] < 8
+        assert dispatcher.stats["coalesced"] > 0
+
+    def test_distinct_codec_instances_same_profile_coalesce(self,
+                                                            dispatcher):
+        """Every PG backend holds its own codec instance; identity is
+        by VALUE (generator bitmatrix), so cross-PG ops still fuse."""
+        c1, c2 = _codec(), _codec()
+        assert c1 is not c2
+        rng = np.random.default_rng(2)
+        b1 = rng.integers(0, 256, size=(2, 4, 512), dtype=np.uint8)
+        b2 = rng.integers(0, 256, size=(2, 4, 512), dtype=np.uint8)
+        res = {}
+
+        def w(tag, c, b):
+            res[tag] = np.asarray(dispatcher.encode(c, b))
+
+        t1 = threading.Thread(target=w, args=("a", c1, b1))
+        t2 = threading.Thread(target=w, args=("b", c2, b2))
+        t1.start(); t2.start(); t1.join(30); t2.join(30)
+        assert np.array_equal(res["a"], np.asarray(c1.encode_batch(b1)))
+        assert np.array_equal(res["b"], np.asarray(c1.encode_batch(b2)))
+        # <= 2 tolerates a straggler thread missing the window under
+        # extreme load; the by-value codec key is what is under test
+        assert dispatcher.stats["dispatches"] <= 2
+
+    def test_varying_stripe_counts_concatenate(self, dispatcher):
+        """Ops with different stripe counts (same per-stripe shape)
+        concatenate along axis 0."""
+        codec = _codec()
+        rng = np.random.default_rng(3)
+        batches = [rng.integers(0, 256, size=(s, 4, 512), dtype=np.uint8)
+                   for s in (1, 4, 2)]
+        outs = [None] * 3
+
+        def worker(i):
+            outs[i] = np.asarray(dispatcher.encode(codec, batches[i]))
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        for i, b in enumerate(batches):
+            assert outs[i].shape == (b.shape[0], 2, 512)
+            assert np.array_equal(outs[i],
+                                  np.asarray(codec.encode_batch(b))), i
+
+    def test_decode_coalesces_per_signature(self, dispatcher):
+        codec = _codec()
+        rng = np.random.default_rng(4)
+        data = rng.integers(0, 256, size=(2, 4, 512), dtype=np.uint8)
+        parity = np.asarray(codec.encode_batch(data))
+        full = np.concatenate([data, parity], axis=1)
+        avail = (0, 2, 3, 5)
+        chunks = full[:, list(avail), :]
+        res = {}
+
+        def w(tag):
+            res[tag] = np.asarray(
+                dispatcher.decode(codec, avail, chunks))
+
+        t1 = threading.Thread(target=w, args=("a",))
+        t2 = threading.Thread(target=w, args=("b",))
+        t1.start(); t2.start(); t1.join(30); t2.join(30)
+        assert np.array_equal(res["a"], full)
+        assert np.array_equal(res["b"], full)
+        assert dispatcher.stats["dispatches"] <= 2
+
+    def test_error_propagates_to_every_submitter(self, dispatcher):
+        class Boom:
+            _bitmat = None
+
+            def encode_batch(self, b):
+                raise RuntimeError("device on fire")
+
+        codec = Boom()
+        errs = []
+
+        def w():
+            try:
+                dispatcher.encode(codec, np.zeros((1, 2, 64), np.uint8))
+            except RuntimeError as e:
+                errs.append(str(e))
+
+        threads = [threading.Thread(target=w) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert errs == ["device on fire"] * 3
+
+
+class TestOsdIntegration:
+    def test_concurrent_ec_writes_need_fewer_dispatches(self):
+        """End to end: N concurrent EC writes through the cluster
+        complete bit-exact with measurably fewer device dispatches
+        than ops (the SURVEY §7 step-3 queue)."""
+        from .cluster_util import MiniCluster
+        FAST = {"osd_heartbeat_interval": 0.1,
+                "osd_heartbeat_grace": 0.6,
+                "mon_osd_down_out_interval": 1.0,
+                "paxos_propose_interval": 0.02,
+                "osd_tpu_coalesce_max_delay_ms": 15.0,
+                "osd_tpu_coalesce_max_batch": 8}
+        cluster = MiniCluster(num_mons=1, num_osds=3,
+                              conf_overrides=FAST).start()
+        try:
+            client = cluster.client()
+            cluster.create_ec_pool(
+                client, "coalesce",
+                {"plugin": "jax_tpu", "technique": "reed_sol_van",
+                 "k": "2", "m": "1", "w": "8"}, pg_num=8)
+            ioctx = client.open_ioctx("coalesce")
+            payloads = {("obj-%d" % i): (b"%02d" % i) * 2048
+                        for i in range(16)}
+            errs: list = []
+
+            def writer(oid, data):
+                try:
+                    ioctx.write_full(oid, data, timeout=60)
+                except Exception as e:
+                    errs.append(e)
+
+            threads = [threading.Thread(target=writer, args=(o, d))
+                       for o, d in payloads.items()]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+            assert not errs, errs
+            for oid, data in payloads.items():
+                assert ioctx.read(oid) == data, oid
+            ops = sum(o.tpu_dispatcher.stats["ops"]
+                      for o in cluster.osds.values()
+                      if o.tpu_dispatcher)
+            dispatches = sum(o.tpu_dispatcher.stats["dispatches"]
+                             for o in cluster.osds.values()
+                             if o.tpu_dispatcher)
+            assert ops >= 16
+            assert dispatches < ops, (dispatches, ops)
+        finally:
+            cluster.stop()
