@@ -30,8 +30,10 @@ from __future__ import annotations
 import base64
 import hashlib
 import hmac
+import re
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, unquote, urlsplit
 from xml.sax.saxutils import escape
@@ -95,6 +97,15 @@ class _Store:
             self._require_bucket(bucket)
             if self.list_objects(bucket):
                 raise S3Error(409, "BucketNotEmpty", bucket)
+            if self.list_multipart_uploads(bucket):
+                # parts would leak and a recreated bucket would
+                # resurrect stale uploads; S3 refuses the same way
+                raise S3Error(409, "BucketNotEmpty",
+                              "in-flight multipart uploads")
+            try:
+                self.ioctx.remove(self._mp_state_oid(bucket))
+            except Exception:
+                pass
             self.ioctx.remove(_index_oid(bucket))
             self.ioctx.omap_rm_keys(ROSTER_OID, [bucket])
 
@@ -146,6 +157,139 @@ class _Store:
         self.head_object(bucket, key)       # 404 if absent
         self.ioctx.remove(_data_oid(bucket, key))
         self.ioctx.omap_rm_keys(_index_oid(bucket), [key])
+
+    # -- multipart uploads (RGWInitMultipart / RGWPutObj part /
+    # RGWCompleteMultipart / RGWAbortMultipart, rgw_op.cc) ------------
+
+    def _mp_state_oid(self, bucket: str) -> str:
+        return "__rgw_mp__%s" % bucket
+
+    def _mp_part_oid(self, bucket: str, upload_id: str,
+                     part: int) -> str:
+        return "__rgw_mpp__%s/%s/%06d" % (bucket, upload_id, part)
+
+    def _mp_get_state(self, bucket: str, upload_id: str) -> dict:
+        try:
+            raw = self.ioctx.omap_get(
+                self._mp_state_oid(bucket))[upload_id]
+        except (OSError, KeyError):
+            raise S3Error(404, "NoSuchUpload", upload_id)
+        return encoding.decode_any(raw)
+
+    def _mp_put_state(self, bucket: str, upload_id: str,
+                      state: dict) -> None:
+        self.ioctx.omap_set(self._mp_state_oid(bucket),
+                            {upload_id: encoding.encode_any(state)})
+
+    def initiate_multipart(self, bucket: str, key: str) -> str:
+        self._require_bucket(bucket)
+        upload_id = uuid.uuid4().hex
+        # the state oid must exist before omap ops on some backends
+        try:
+            self.ioctx.write_full(self._mp_state_oid(bucket), b"")
+        except OSError:
+            pass
+        self._mp_put_state(bucket, upload_id,
+                           {"key": key, "parts": {}})
+        return upload_id
+
+    def upload_part(self, bucket: str, upload_id: str,
+                    part_number: int, data: bytes) -> str:
+        if not 1 <= part_number <= 10000:
+            raise S3Error(400, "InvalidArgument",
+                          "partNumber must be 1..10000")
+        self._mp_get_state(bucket, upload_id)   # 404 before the write
+        etag = hashlib.md5(data).hexdigest()
+        # the part oid is unique to (upload, part): its write needs no
+        # lock — parallel part uploads are the point of multipart; only
+        # the state read-modify-write serializes
+        self.ioctx.write_full(
+            self._mp_part_oid(bucket, upload_id, part_number), data)
+        with self._lock:
+            state = self._mp_get_state(bucket, upload_id)
+            state["parts"][str(part_number)] = {
+                "etag": etag, "size": len(data)}
+            self._mp_put_state(bucket, upload_id, state)
+        return etag
+
+    def complete_multipart(self, bucket: str, upload_id: str,
+                           parts: list) -> str:
+        """parts: [(part_number, etag)] in the client's requested
+        order — must be ascending and match the uploaded parts. The
+        final object is assembled part by part (RGW stitches a
+        manifest; atop rados, append is the same shape) and the
+        multipart ETag is md5-of-part-digests '-N' per S3."""
+        with self._lock:
+            state = self._mp_get_state(bucket, upload_id)
+            if not parts:
+                raise S3Error(400, "MalformedXML", "no parts")
+            last = 0
+            digests = b""
+            for n, etag in parts:
+                if n <= last:
+                    raise S3Error(400, "InvalidPartOrder", str(n))
+                last = n
+                have = state["parts"].get(str(n))
+                if have is None or have["etag"] != etag.strip('"'):
+                    raise S3Error(400, "InvalidPart", str(n))
+                digests += bytes.fromhex(have["etag"])
+            key = state["key"]
+            final_etag = "%s-%d" % (hashlib.md5(digests).hexdigest(),
+                                    len(parts))
+            # assemble then land in ONE write_full so a concurrent GET
+            # never observes a truncated/partial object (real RGW
+            # stitches a manifest; at framework scale the object fits)
+            data = b"".join(
+                self.ioctx.read(self._mp_part_oid(bucket, upload_id, n))
+                for n, _etag in parts)
+            self.ioctx.write_full(_data_oid(bucket, key), data)
+            self.ioctx.omap_set(_index_oid(bucket), {
+                key: encoding.encode_any({
+                    "size": len(data), "etag": final_etag,
+                    "mtime": time.time()})})
+            self._mp_cleanup(bucket, upload_id, state)
+        return final_etag
+
+    def abort_multipart(self, bucket: str, upload_id: str) -> None:
+        with self._lock:
+            state = self._mp_get_state(bucket, upload_id)
+            self._mp_cleanup(bucket, upload_id, state)
+
+    def _mp_cleanup(self, bucket: str, upload_id: str,
+                    state: dict) -> None:
+        for n in state["parts"]:
+            try:
+                self.ioctx.remove(
+                    self._mp_part_oid(bucket, upload_id, int(n)))
+            except Exception:
+                pass
+        self.ioctx.omap_rm_keys(self._mp_state_oid(bucket), [upload_id])
+
+    def list_multipart_uploads(self, bucket: str) -> list[dict]:
+        self._require_bucket(bucket)
+        try:
+            raw = self.ioctx.omap_get(self._mp_state_oid(bucket))
+        except OSError:
+            return []
+        return [{"upload_id": uid,
+                 "key": encoding.decode_any(st)["key"]}
+                for uid, st in sorted(raw.items())]
+
+
+def _parse_complete_xml(xml: str) -> list:
+    """[(part_number, etag)] from a CompleteMultipartUpload body —
+    order-agnostic WITHIN each <Part> (AWS's own request syntax puts
+    ETag before PartNumber; clients vary)."""
+    parts = []
+    for m in re.finditer(r"<Part>(.*?)</Part>", xml, re.S):
+        blk = m.group(1)
+        pn = re.search(r"<PartNumber>\s*(\d+)\s*</PartNumber>", blk)
+        et = re.search(r"<ETag>(.*?)</ETag>", blk, re.S)
+        if pn is None or et is None:
+            raise S3Error(400, "MalformedXML", "incomplete Part")
+        etag = re.sub(r"&quot;|\"", "", et.group(1)).strip()
+        parts.append((int(pn.group(1)), etag))
+    return parts
 
 
 def _sign_v2(secret: str, string_to_sign: str) -> str:
@@ -203,6 +347,9 @@ class RGWServer:
             def do_GET(self):
                 self._dispatch("GET")
 
+            def do_POST(self):
+                self._dispatch("POST")
+
             def do_PUT(self):
                 self._dispatch("PUT")
 
@@ -251,12 +398,22 @@ class RGWServer:
 
     # -- routing -------------------------------------------------------
 
+    @staticmethod
+    def _read_body(req) -> bytes:
+        try:
+            length = int(req.headers.get("Content-Length", "0") or 0)
+        except ValueError:
+            raise S3Error(400, "InvalidArgument", "Content-Length")
+        return req.rfile.read(length) if length > 0 else b""
+
     def _route(self, method, req):
         split = urlsplit(req.path)
         parts = unquote(split.path).lstrip("/").split("/", 1)
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else ""
-        query = parse_qs(split.query)
+        # keep_blank_values: S3 subresources are valueless keys
+        # ("?uploads", "?acl") that parse_qs drops by default
+        query = parse_qs(split.query, keep_blank_values=True)
         if not bucket:
             if method == "GET":
                 return self._list_buckets()
@@ -269,15 +426,69 @@ class RGWServer:
                 self.store.delete_bucket(bucket)
                 return 204, {}, b""
             if method == "GET":
+                if "uploads" in query:
+                    return self._list_uploads(bucket)
                 return self._list_objects(bucket, query)
             raise S3Error(405, "MethodNotAllowed", method)
+        if method == "POST":
+            # drain the body up front: on a keep-alive connection an
+            # unread body corrupts the next request's parse
+            body_in = self._read_body(req)
+            if "uploads" in query:
+                upload_id = self.store.initiate_multipart(bucket, key)
+                body = ("<?xml version=\"1.0\" encoding=\"UTF-8\"?>"
+                        "<InitiateMultipartUploadResult>"
+                        "<Bucket>%s</Bucket><Key>%s</Key>"
+                        "<UploadId>%s</UploadId>"
+                        "</InitiateMultipartUploadResult>"
+                        % (escape(bucket), escape(key),
+                           upload_id)).encode()
+                return 200, {"Content-Type": "application/xml"}, body
+            if "uploadId" in query:
+                parts = _parse_complete_xml(
+                    body_in.decode("utf-8", "replace"))
+                etag = self.store.complete_multipart(
+                    bucket, query["uploadId"][0], parts)
+                body = ("<?xml version=\"1.0\" encoding=\"UTF-8\"?>"
+                        "<CompleteMultipartUploadResult><Key>%s</Key>"
+                        "<ETag>&quot;%s&quot;</ETag>"
+                        "</CompleteMultipartUploadResult>"
+                        % (escape(key), etag)).encode()
+                return 200, {"Content-Type": "application/xml"}, body
+            raise S3Error(405, "MethodNotAllowed", method)
         if method == "PUT":
-            length = int(req.headers.get("Content-Length", "0"))
-            data = req.rfile.read(length) if length else b""
+            data = self._read_body(req)
+            if "partNumber" in query and "uploadId" in query:
+                try:
+                    part_no = int(query["partNumber"][0])
+                except ValueError:
+                    raise S3Error(400, "InvalidArgument",
+                                  query["partNumber"][0])
+                etag = self.store.upload_part(
+                    bucket, query["uploadId"][0], part_no, data)
+                return 200, {"ETag": '"%s"' % etag}, b""
             etag = self.store.put_object(bucket, key, data)
             return 200, {"ETag": '"%s"' % etag}, b""
         if method == "GET":
             data, meta = self.store.get_object(bucket, key)
+            rng = req.headers.get("Range", "")
+            m = re.match(r"bytes=(\d*)-(\d*)$", rng or "")
+            if m and (m.group(1) or m.group(2)):
+                total = len(data)
+                if m.group(1):
+                    lo = int(m.group(1))
+                    hi = int(m.group(2)) if m.group(2) else total - 1
+                else:               # suffix range: last N bytes
+                    lo = max(0, total - int(m.group(2)))
+                    hi = total - 1
+                if lo >= total or lo > hi:
+                    raise S3Error(416, "InvalidRange", rng)
+                hi = min(hi, total - 1)
+                return 206, {
+                    "Content-Type": "binary/octet-stream",
+                    "Content-Range": "bytes %d-%d/%d" % (lo, hi, total),
+                    "ETag": '"%s"' % meta["etag"],
+                }, data[lo:hi + 1]
             return 200, {"Content-Type": "binary/octet-stream",
                          "ETag": '"%s"' % meta["etag"]}, data
         if method == "HEAD":
@@ -285,11 +496,25 @@ class RGWServer:
             return 200, {"Content-Length-Real": str(meta["size"]),
                          "ETag": '"%s"' % meta["etag"]}, b""
         if method == "DELETE":
+            if "uploadId" in query:
+                self.store.abort_multipart(bucket, query["uploadId"][0])
+                return 204, {}, b""
             self.store.delete_object(bucket, key)
             return 204, {}, b""
         raise S3Error(405, "MethodNotAllowed", method)
 
     # -- XML renderings (rgw_rest_s3 dump_* role) ----------------------
+
+    def _list_uploads(self, bucket):
+        rows = "".join(
+            "<Upload><Key>%s</Key><UploadId>%s</UploadId></Upload>"
+            % (escape(u["key"]), u["upload_id"])
+            for u in self.store.list_multipart_uploads(bucket))
+        body = ("<?xml version=\"1.0\" encoding=\"UTF-8\"?>"
+                "<ListMultipartUploadsResult><Bucket>%s</Bucket>%s"
+                "</ListMultipartUploadsResult>"
+                % (escape(bucket), rows)).encode()
+        return 200, {"Content-Type": "application/xml"}, body
 
     def _list_buckets(self):
         rows = "".join(

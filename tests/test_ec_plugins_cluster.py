@@ -117,6 +117,46 @@ class TestLrcPool:
         assert lrc_ioctx.read("lrec") == payload
 
 
+class TestBitmatrixPools:
+    """The packet-layout bitmatrix techniques (cauchy_good, liberation)
+    behind an EC pool: the backend must be agnostic to the codec's
+    internal layout (PGBackend.cc:551-565) — same write/read/degraded
+    surface as the element-layout RS pools."""
+
+    @pytest.fixture(scope="class", params=[
+        {"plugin": "jax_tpu", "technique": "cauchy_good",
+         "k": "2", "m": "1", "w": "8", "packetsize": "512"},
+        {"plugin": "jax_tpu", "technique": "liberation",
+         "k": "2", "m": "2", "w": "7", "packetsize": "512"},
+    ], ids=["cauchy_good", "liberation"])
+    def bm_ioctx(self, request, cluster):
+        client = cluster.client()
+        name = "bmpool-%s" % request.param["technique"]
+        cluster.create_ec_pool(client, name, dict(request.param),
+                               pg_num=2)
+        return client.open_ioctx(name)
+
+    def test_round_trip(self, bm_ioctx):
+        payload = b"packet-layout-bitmatrix" * 113
+        bm_ioctx.write_full("bobj", payload)
+        assert bm_ioctx.read("bobj") == payload
+
+    def test_degraded_read(self, cluster, bm_ioctx):
+        payload = b"bitmatrix-degraded" * 77
+        bm_ioctx.write_full("bdeg", payload)
+        osd_id = 4
+        store = cluster.stop_osd(osd_id)
+        try:
+            assert wait_until(
+                lambda: not cluster.leader().osdmon.osdmap.is_up(osd_id),
+                timeout=10)
+            assert bm_ioctx.read("bdeg") == payload
+        finally:
+            cluster.revive_osd(osd_id, store=store)
+            assert wait_until(cluster.all_osds_up, timeout=20)
+            wait_clean(cluster)
+
+
 class TestShecPool:
     @pytest.fixture(scope="class")
     def shec_ioctx(self, cluster):

@@ -135,3 +135,141 @@ class TestAuth:
         request(gw, "PUT", "/durab")
         request(gw, "PUT", "/durab/obj", body=b"rados-backed")
         assert gw.store.ioctx.read("durab/obj") == b"rados-backed"
+
+
+class TestMultipartUpload:
+    def test_full_multipart_flow(self, gw):
+        import re as _re
+        request(gw, "PUT", "/mp")
+        # initiate
+        status, _, body = request(gw, "POST", "/mp/big?uploads")
+        assert status == 200
+        upload_id = _re.search(
+            rb"<UploadId>([0-9a-f]+)</UploadId>", body).group(1).decode()
+        # the in-progress upload is listed
+        status, _, body = request(gw, "GET", "/mp?uploads")
+        assert status == 200 and upload_id.encode() in body
+        # upload three parts (out of order on the wire is fine)
+        parts_data = [b"A" * 5000, b"B" * 7000, b"C" * 100]
+        etags = {}
+        for n in (2, 1, 3):
+            status, hdrs, _ = request(
+                gw, "PUT", "/mp/big?partNumber=%d&uploadId=%s"
+                % (n, upload_id), body=parts_data[n - 1])
+            assert status == 200
+            etags[n] = hdrs["ETag"].strip('"')
+        # complete with ascending part order
+        xml = ("<CompleteMultipartUpload>" + "".join(
+            "<Part><PartNumber>%d</PartNumber><ETag>\"%s\"</ETag></Part>"
+            % (n, etags[n]) for n in (1, 2, 3)) +
+            "</CompleteMultipartUpload>").encode()
+        status, _, body = request(
+            gw, "POST", "/mp/big?uploadId=%s" % upload_id, body=xml)
+        assert status == 200 and b"-3" in body   # multipart etag '-N'
+        # the assembled object reads back whole
+        status, _, body = request(gw, "GET", "/mp/big")
+        assert status == 200
+        assert body == b"".join(parts_data)
+        # state + part objects are gone
+        status, _, body = request(gw, "GET", "/mp?uploads")
+        assert upload_id.encode() not in body
+
+    def test_complete_with_wrong_etag_rejected(self, gw):
+        import re as _re
+        request(gw, "PUT", "/mp2")
+        _, _, body = request(gw, "POST", "/mp2/x?uploads")
+        upload_id = _re.search(
+            rb"<UploadId>([0-9a-f]+)</UploadId>", body).group(1).decode()
+        request(gw, "PUT", "/mp2/x?partNumber=1&uploadId=%s" % upload_id,
+                body=b"data")
+        xml = (b"<CompleteMultipartUpload><Part><PartNumber>1"
+               b"</PartNumber><ETag>\"deadbeef\"</ETag></Part>"
+               b"</CompleteMultipartUpload>")
+        status, _, body = request(
+            gw, "POST", "/mp2/x?uploadId=%s" % upload_id, body=xml)
+        assert status == 400 and b"InvalidPart" in body
+
+    def test_abort_cleans_up(self, gw):
+        import re as _re
+        request(gw, "PUT", "/mp3")
+        _, _, body = request(gw, "POST", "/mp3/y?uploads")
+        upload_id = _re.search(
+            rb"<UploadId>([0-9a-f]+)</UploadId>", body).group(1).decode()
+        request(gw, "PUT", "/mp3/y?partNumber=1&uploadId=%s" % upload_id,
+                body=b"zzz")
+        status, _, _ = request(
+            gw, "DELETE", "/mp3/y?uploadId=%s" % upload_id)
+        assert status == 204
+        status, _, body = request(gw, "GET", "/mp3?uploads")
+        assert upload_id.encode() not in body
+        # completing an aborted upload is NoSuchUpload
+        status, _, body = request(
+            gw, "POST", "/mp3/y?uploadId=%s" % upload_id,
+            body=b"<CompleteMultipartUpload><Part><PartNumber>1"
+                 b"</PartNumber><ETag>\"00\"</ETag></Part>"
+                 b"</CompleteMultipartUpload>")
+        assert status == 404 and b"NoSuchUpload" in body
+
+
+class TestRangeGet:
+    def test_byte_ranges(self, gw):
+        request(gw, "PUT", "/rg")
+        payload = bytes(range(256)) * 4
+        request(gw, "PUT", "/rg/obj", body=payload)
+        status, hdrs, body = request(gw, "GET", "/rg/obj",
+                                     headers={"Range": "bytes=10-19"})
+        assert status == 206
+        assert body == payload[10:20]
+        assert hdrs["Content-Range"] == "bytes 10-19/1024"
+        # open-ended and suffix forms
+        status, _, body = request(gw, "GET", "/rg/obj",
+                                  headers={"Range": "bytes=1000-"})
+        assert status == 206 and body == payload[1000:]
+        status, _, body = request(gw, "GET", "/rg/obj",
+                                  headers={"Range": "bytes=-24"})
+        assert status == 206 and body == payload[-24:]
+        # unsatisfiable
+        status, _, _ = request(gw, "GET", "/rg/obj",
+                               headers={"Range": "bytes=5000-"})
+        assert status == 416
+
+
+class TestMultipartEdgeCases:
+    def test_etag_before_partnumber_order_accepted(self, gw):
+        """AWS's own CompleteMultipartUpload request syntax puts ETag
+        BEFORE PartNumber inside <Part>; both orders must parse."""
+        import re as _re
+        request(gw, "PUT", "/mp4")
+        _, _, body = request(gw, "POST", "/mp4/k?uploads")
+        upload_id = _re.search(
+            rb"<UploadId>([0-9a-f]+)</UploadId>", body).group(1).decode()
+        _, hdrs, _ = request(
+            gw, "PUT", "/mp4/k?partNumber=1&uploadId=%s" % upload_id,
+            body=b"hello-multipart")
+        etag = hdrs["ETag"].strip('"')
+        xml = ("<CompleteMultipartUpload><Part>"
+               "<ETag>\"%s\"</ETag><PartNumber>1</PartNumber>"
+               "</Part></CompleteMultipartUpload>" % etag).encode()
+        status, _, _ = request(
+            gw, "POST", "/mp4/k?uploadId=%s" % upload_id, body=xml)
+        assert status == 200
+        status, _, body = request(gw, "GET", "/mp4/k")
+        assert status == 200 and body == b"hello-multipart"
+
+    def test_delete_bucket_with_inflight_upload_refused(self, gw):
+        request(gw, "PUT", "/mp5")
+        status, _, _ = request(gw, "POST", "/mp5/z?uploads")
+        assert status == 200
+        status, _, body = request(gw, "DELETE", "/mp5")
+        assert status == 409 and b"BucketNotEmpty" in body
+
+    def test_bad_part_number_is_400(self, gw):
+        request(gw, "PUT", "/mp6")
+        import re as _re
+        _, _, body = request(gw, "POST", "/mp6/q?uploads")
+        upload_id = _re.search(
+            rb"<UploadId>([0-9a-f]+)</UploadId>", body).group(1).decode()
+        status, _, body = request(
+            gw, "PUT", "/mp6/q?partNumber=abc&uploadId=%s" % upload_id,
+            body=b"x")
+        assert status == 400 and b"InvalidArgument" in body
