@@ -18,7 +18,8 @@ from ..common import Context
 from ..common.throttle import Throttle
 from ..mon.mon_client import MonClient
 from ..msg.message import MOSDOp, MWatchNotifyAck
-from ..msg.messenger import Dispatcher, Messenger
+from ..msg.async_messenger import create_messenger
+from ..msg.messenger import Dispatcher
 
 __all__ = ["RadosClient", "IoCtx", "RadosError"]
 
@@ -41,8 +42,8 @@ class RadosClient(Dispatcher):
         self.ctx = ctx if ctx is not None else Context(
             name="client.%d" % client_id)
         self.client_id = client_id
-        self.msgr = Messenger(("client", client_id),
-                              conf=self.ctx.conf)
+        self.msgr = create_messenger(("client", client_id),
+                                     conf=self.ctx.conf)
         self.msgr.start()
         self.msgr.add_dispatcher_head(self)
         self.mon_client = MonClient(monmap, self.msgr,

@@ -46,20 +46,25 @@ def register_attrs(cls, name: str, attrs: list[str], factory,
     register_codec(name, cls, version, compat, enc_f, dec_f)
 
 
-def register_message(cls, version: int = 1, compat: int = 1) -> None:
-    """Messages carry transport header (seq, from_name) + dataclass
-    fields. Appending fields (with defaults) is the version bump."""
+def register_message(cls, version: int = 2, compat: int = 1) -> None:
+    """Messages carry transport header (seq, from_name and — since
+    struct v2 — link_seq, the per-connection sequence the messenger's
+    lossless MSGACK protocol acks against, the Pipe out_seq role) +
+    dataclass fields. Appending fields (with defaults) is the version
+    bump; v1 payloads (no link_seq) still decode (compat=1)."""
     names = [f.name for f in dataclasses.fields(cls)]
 
     def enc_f(enc, obj):
         enc.varint(obj.seq)
         enc.any(obj.from_name)
+        enc.any(getattr(obj, "link_seq", None))
         for fname in names:
             enc.any(getattr(obj, fname))
 
     def dec_f(dec, struct_v, end):
         seq = dec.varint()
         from_name = dec.any()
+        link_seq = dec.any() if struct_v >= 2 else None
         kw = {}
         for fname in names:
             if dec.pos >= end:
@@ -68,6 +73,7 @@ def register_message(cls, version: int = 1, compat: int = 1) -> None:
         obj = cls(**kw)
         obj.seq = seq
         obj.from_name = from_name
+        obj.link_seq = link_seq
         return obj
 
     register_codec("msg." + cls.__name__, cls, version, compat,

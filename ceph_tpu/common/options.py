@@ -109,6 +109,9 @@ def _declare_defaults():
       "seconds after down before an osd is marked out")
     o("mon_osd_min_down_reporters", int, 1, LEVEL_ADVANCED)
     o("paxos_propose_interval", float, 0.05, LEVEL_ADVANCED)
+    o("ms_type", str, "simple", LEVEL_ADVANCED,
+      "messenger transport: simple (thread-per-connection) | async "
+      "(event-loop, the AsyncMessenger analog)")
     # fault injection (dev-level, like options.cc:1250-3953)
     o("ms_inject_socket_failures", int, 0, LEVEL_DEV,
       "drop 1 in N messages at the messenger")

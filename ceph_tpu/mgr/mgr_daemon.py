@@ -14,7 +14,8 @@ import threading
 
 from ..common.context import Context
 from ..mon.mon_client import MonClient
-from ..msg.messenger import Dispatcher, Messenger
+from ..msg.async_messenger import create_messenger
+from ..msg.messenger import Dispatcher
 
 __all__ = ["MgrDaemon"]
 
@@ -22,7 +23,7 @@ __all__ = ["MgrDaemon"]
 class MgrDaemon(Dispatcher):
     def __init__(self, monmap: dict, ctx: Context | None = None):
         self.ctx = ctx or Context(name="mgr")
-        self.msgr = Messenger(("mgr", 0), conf=self.ctx.conf)
+        self.msgr = create_messenger(("mgr", 0), conf=self.ctx.conf)
         self.monmap = dict(monmap)
         self.mon_client: MonClient | None = None
         from .daemon_state import DaemonStateIndex

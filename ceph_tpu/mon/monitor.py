@@ -17,7 +17,8 @@ from ..common import Context
 from ..common.lockdep import make_rlock
 from ..common.workqueue import SafeTimer
 from ..msg.message import (MMonCommandReply, MOSDMap)
-from ..msg.messenger import Dispatcher, Messenger
+from ..msg.async_messenger import create_messenger
+from ..msg.messenger import Dispatcher
 from ..store.kv import MemDB
 from .osd_monitor import OSDMonitor
 from .paxos import Elector, Paxos
@@ -41,7 +42,7 @@ class Monitor(Dispatcher):
         self.quorum: list[int] = []
         self.leader_rank: int | None = None
         self.store = MemDB()
-        self.msgr = Messenger(("mon", rank), conf=self.ctx.conf)
+        self.msgr = create_messenger(("mon", rank), conf=self.ctx.conf)
         self.timer = SafeTimer("mon%d-timer" % rank)
         self.elector = Elector(self)
         self.paxos = Paxos(self, self.store)

@@ -95,6 +95,13 @@ class Connection:
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
         self.out_q: list = []
+        # lossless ack protocol (the reference's out_seq/in_seq,
+        # Pipe/AsyncConnection): a sent message stays in _unacked until
+        # the peer's MSGACK covers it; reconnect requeues _unacked —
+        # bytes accepted by a dying TCP buffer are NOT delivery
+        self.out_seq = 0
+        self._unacked: list = []      # [(link_seq, msg)]
+        self._ctrl_out: list = []     # reader-queued control frames
         self.peer_name = None
         self.auth_info = None        # verified cephx info (entity, caps)
         self.inbound = sock is not None   # accepted vs dialed
@@ -103,8 +110,7 @@ class Connection:
         self._server_challenge = None     # acceptor's per-conn random
         self._auth_ready = threading.Event()  # dialer handshake done
         self.closed = False
-        self.writer = threading.Thread(target=self._writer_loop,
-                                       daemon=True)
+        self.writer: threading.Thread | None = None  # lazy (start())
         self.reader: threading.Thread | None = None
 
     def __repr__(self):
@@ -113,6 +119,8 @@ class Connection:
             " closed" if self.closed else "")
 
     def start(self) -> None:
+        self.writer = threading.Thread(target=self._writer_loop,
+                                       daemon=True)
         self.writer.start()
         if self.sock is not None:
             self._start_reader()
@@ -175,18 +183,63 @@ class Connection:
                 if self.sock is sock:
                     self.sock = None
                 return False
+        # fresh pipe: everything the old one never acked goes first
+        with self.lock:
+            if self._unacked:
+                self.out_q[0:0] = [m for _, m in self._unacked]
+                self._unacked.clear()
         return True
+
+    @property
+    def _guarded_dialer_now(self) -> bool:
+        """Dialer that runs ANY part of the auth handshake and has not
+        completed it — the one predicate behind the pre-auth data hold,
+        the restricted decode, and the direct-send handshake phase."""
+        return (not self.inbound
+                and (self.msgr.auth_confirm is not None
+                     or self.msgr.authorizer_factory is not None)
+                and not self.auth_confirmed)
+
+    def _queue_ctrl(self, data: bytes) -> None:
+        """Reader-side protocol replies (banner acks, MSGACKs) route
+        through the writer thread — two threads sendall-ing one socket
+        would interleave partial writes and corrupt the framing.
+
+        EXCEPT during the handshake, when the writer is provably not
+        sending: a guarded dialer's writer is parked inside _connect
+        waiting for _auth_ready (queueing its challenge-proof BANNER
+        there would deadlock the handshake), and a pre-registration
+        acceptor cannot have app traffic yet (nothing routes to an
+        unregistered connection). Those two phases send directly."""
+        direct = (self._guarded_dialer_now
+                  or (self.inbound and self.peer_name is None))
+        if direct:
+            sock = self.sock
+            if sock is not None:
+                sock.sendall(data)   # OSError -> caller tears down
+            return
+        with self.lock:
+            if self.closed:
+                return
+            self._ctrl_out.append(data)
+            self.cond.notify()
 
     def _writer_loop(self) -> None:
         backoff = 0.01
         while True:
             with self.lock:
-                while not self.out_q and not self.closed:
+                while not self.out_q and not self._ctrl_out \
+                        and not self.closed:
                     self.cond.wait(0.5)
                 if self.closed and not self.out_q:
                     return
-                msg = self.out_q[0]
+                ctrl = b"".join(self._ctrl_out)
+                self._ctrl_out.clear()
+                msg = self.out_q[0] if self.out_q else None
             if self.sock is None:
+                # control frames are per-pipe; a dead pipe's are moot
+                if msg is None:
+                    continue
                 if not self._connect():
                     time.sleep(backoff)
                     backoff = min(backoff * 2, 1.0)
@@ -196,6 +249,21 @@ class Connection:
                         self.msgr._notify_reset(self.peer_addr)
                     continue
                 backoff = 0.01
+                # _connect requeued unacked messages AHEAD of the
+                # captured head: loop so the oldest sends first (and
+                # the pop below always matches what was sent)
+                continue
+            sock = self.sock
+            if sock is None:
+                continue  # reader tore it down mid-flight; reconnect
+            if ctrl:
+                try:
+                    sock.sendall(ctrl)
+                except OSError:
+                    self._on_send_error(sock)
+                    continue
+            if msg is None:
+                continue
             if self.msgr._inject_should_drop():
                 with self.lock:
                     self.out_q.pop(0)
@@ -205,7 +273,9 @@ class Connection:
                 time.sleep(delay)
             sock = self.sock
             if sock is None:
-                continue  # reader tore it down mid-flight; reconnect
+                continue
+            self.out_seq += 1
+            msg.link_seq = self.out_seq
             try:
                 frame = _encode(msg)
             except Exception:
@@ -221,17 +291,22 @@ class Connection:
                 sock.sendall(frame)
                 with self.lock:
                     self.out_q.pop(0)
+                    self._unacked.append((self.out_seq, msg))
             except OSError:
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-                self.sock = None
-                if self.msgr.policy_lossy:
-                    with self.lock:
-                        self.out_q.clear()
-                    self.msgr._notify_reset(self.peer_addr)
+                self._on_send_error(sock)
                 # lossless: keep msg at head, reconnect and resend
+
+    def _on_send_error(self, sock) -> None:
+        try:
+            sock.close()
+        except OSError:
+            pass
+        self.sock = None
+        if self.msgr.policy_lossy:
+            with self.lock:
+                self.out_q.clear()
+                self._unacked.clear()
+            self.msgr._notify_reset(self.peer_addr)
 
     # -- reader --------------------------------------------------------
 
@@ -250,128 +325,147 @@ class Connection:
                     break
             except OSError:
                 break
-            # pre-auth frames may only materialize closed-set builtins
-            # (no registered-struct construction), so an unauthenticated
-            # peer cannot reach any type's constructor
-            # a dialer counts as guarded when it runs ANY part of the
-            # handshake (factory or confirm) — same condition as the
-            # data hold in _connect, so an unconfirmed server can never
-            # feed us structs
-            guarded_dialer = (not self.inbound
-                              and (self.msgr.auth_confirm is not None
-                                   or self.msgr.authorizer_factory
-                                   is not None)
-                              and not self.auth_confirmed)
-            restricted = (
-                (self.inbound and self.msgr.auth_verifier is not None
-                 and self.auth_info is None)
-                or guarded_dialer)
-            try:
-                msg = encoding.decode_any(payload, restricted=restricted)
-            except encoding.DecodeError:
-                if restricted:
-                    # a guarded peer sent a non-handshake frame pre-auth
-                    self.close()
-                    break
-                continue
-            if (isinstance(msg, tuple) and len(msg) in (3, 4)
-                    and msg[0] == "BANNER"):
-                # acceptor side: adopt the peer's advertised listening
-                # address and register so sends to it reuse this pipe.
-                # With auth enabled, the banner must carry an authorizer
-                # whose proof covers our per-connection challenge
-                # (BANNER_RETRY round) or the connection drops (EACCES).
-                verifier = self.msgr.auth_verifier
-                if verifier is not None:
-                    authorizer = msg[3] if len(msg) == 4 else None
-                    if self._server_challenge is None:
-                        self._server_challenge = os.urandom(16)
-                    if not (isinstance(authorizer, dict)
-                            and authorizer.get("has_challenge")):
-                        try:
-                            sock.sendall(_encode(
-                                ("BANNER_RETRY", self._server_challenge)))
-                        except OSError:
-                            break
-                        continue
-                    try:
-                        info = verifier.verify_authorizer(
-                            authorizer, challenge=self._server_challenge)
-                    except Exception:
-                        self.close()
-                        break
-                    self.auth_info = info
-                    # mutual auth: prove we could read the ticket
-                    try:
-                        sock.sendall(_encode(
-                            ("BANNER_ACK", info.get("reply_proof"))))
-                    except OSError:
-                        break
-                else:
-                    # no verifier: ack so an auth-capable dialer's
-                    # handshake wait resolves (its auth_confirm, if any,
-                    # decides whether a proof-less ack is acceptable)
-                    try:
-                        sock.sendall(_encode(("BANNER_ACK", None)))
-                    except OSError:
-                        break
-                self.peer_addr = EntityAddr(*msg[1])
-                self.peer_name = msg[2]
-                self.msgr._register_inbound(self)
-                continue
-            if (isinstance(msg, tuple) and len(msg) == 2
-                    and msg[0] == "BANNER_RETRY"):
-                # dialer side: the acceptor wants the proof to cover its
-                # challenge — re-mint the authorizer and resend the banner
-                factory = self.msgr.authorizer_factory
-                if self.inbound or factory is None:
-                    continue
-                try:
-                    authorizer = factory(challenge=msg[1])
-                except Exception:
-                    self.close()
-                    break
-                self._sent_authorizer = authorizer
-                try:
-                    sock.sendall(_encode(
-                        ("BANNER", tuple(self.msgr.my_addr or ("", 0)),
-                         self.msgr.name, authorizer)))
-                except OSError:
-                    break
-                continue
-            if (isinstance(msg, tuple) and len(msg) == 2
-                    and msg[0] == "BANNER_ACK"):
-                # dialer side: the service proved possession of the
-                # session key (cephx mutual auth). The proof bytes are
-                # peer-controlled: a confirm that chokes on them is a
-                # failed confirmation, not a dead reader thread.
-                confirm = self.msgr.auth_confirm
-                if confirm is not None:
-                    try:
-                        ok = confirm(self._sent_authorizer, msg[1])
-                    except Exception:
-                        ok = False
-                    if not ok:
-                        self.close()
-                        break
-                self.auth_confirmed = True
-                self._auth_ready.set()
-                continue
-            # Inbound connections behind a verifier may not deliver
-            # anything before a valid banner: a peer that skips the
-            # handshake is cut off, not dispatched.
-            if (self.inbound and self.msgr.auth_verifier is not None
-                    and self.auth_info is None):
-                self.close()
+            if not self._process_payload(payload, self._queue_ctrl):
                 break
-            # A guarded dialer ignores inbound traffic until the
-            # service has answered the handshake.
-            if guarded_dialer:
-                continue
-            msg.from_addr = self.peer_addr
-            self.msgr._dispatch(msg)
         if sock is self.sock:
             self.sock = None
+
+    def _process_payload(self, payload: bytes, send_bytes) -> bool:
+        """One inbound frame through the connection protocol (banner
+        handshake, restricted pre-auth decode, dispatch). Transport
+        agnostic: the threaded reader passes sock.sendall, the async
+        engine passes its buffered writer. Returns False to tear the
+        connection down."""
+        # pre-auth frames may only materialize closed-set builtins
+        # (no registered-struct construction), so an unauthenticated
+        # peer cannot reach any type's constructor
+        guarded_dialer = self._guarded_dialer_now
+        restricted = (
+            (self.inbound and self.msgr.auth_verifier is not None
+             and self.auth_info is None)
+            or guarded_dialer)
+        try:
+            msg = encoding.decode_any(payload, restricted=restricted)
+        except encoding.DecodeError:
+            if restricted:
+                # a guarded peer sent a non-handshake frame pre-auth
+                self.close()
+                return False
+            return True
+        if (isinstance(msg, tuple) and len(msg) in (3, 4)
+                and msg[0] == "BANNER"):
+            # acceptor side: adopt the peer's advertised listening
+            # address and register so sends to it reuse this pipe.
+            # With auth enabled, the banner must carry an authorizer
+            # whose proof covers our per-connection challenge
+            # (BANNER_RETRY round) or the connection drops (EACCES).
+            verifier = self.msgr.auth_verifier
+            if verifier is not None:
+                authorizer = msg[3] if len(msg) == 4 else None
+                if self._server_challenge is None:
+                    self._server_challenge = os.urandom(16)
+                if not (isinstance(authorizer, dict)
+                        and authorizer.get("has_challenge")):
+                    try:
+                        send_bytes(_encode(
+                            ("BANNER_RETRY", self._server_challenge)))
+                    except OSError:
+                        return False
+                    return True
+                try:
+                    info = verifier.verify_authorizer(
+                        authorizer, challenge=self._server_challenge)
+                except Exception:
+                    self.close()
+                    return False
+                self.auth_info = info
+                # mutual auth: prove we could read the ticket
+                try:
+                    send_bytes(_encode(
+                        ("BANNER_ACK", info.get("reply_proof"))))
+                except OSError:
+                    return False
+            else:
+                # no verifier: ack so an auth-capable dialer's
+                # handshake wait resolves (its auth_confirm, if any,
+                # decides whether a proof-less ack is acceptable)
+                try:
+                    send_bytes(_encode(("BANNER_ACK", None)))
+                except OSError:
+                    return False
+            self.peer_addr = EntityAddr(*msg[1])
+            self.peer_name = msg[2]
+            self.msgr._register_inbound(self)
+            return True
+        if (isinstance(msg, tuple) and len(msg) == 2
+                and msg[0] == "BANNER_RETRY"):
+            # dialer side: the acceptor wants the proof to cover its
+            # challenge — re-mint the authorizer and resend the banner
+            factory = self.msgr.authorizer_factory
+            if self.inbound or factory is None:
+                return True
+            try:
+                authorizer = factory(challenge=msg[1])
+            except Exception:
+                self.close()
+                return False
+            self._sent_authorizer = authorizer
+            try:
+                send_bytes(_encode(
+                    ("BANNER", tuple(self.msgr.my_addr or ("", 0)),
+                     self.msgr.name, authorizer)))
+            except OSError:
+                return False
+            return True
+        if (isinstance(msg, tuple) and len(msg) == 2
+                and msg[0] == "BANNER_ACK"):
+            # dialer side: the service proved possession of the
+            # session key (cephx mutual auth). The proof bytes are
+            # peer-controlled: a confirm that chokes on them is a
+            # failed confirmation, not a dead reader thread.
+            confirm = self.msgr.auth_confirm
+            if confirm is not None:
+                try:
+                    ok = confirm(self._sent_authorizer, msg[1])
+                except Exception:
+                    ok = False
+                if not ok:
+                    self.close()
+                    return False
+            self.auth_confirmed = True
+            self._auth_ready.set()
+            return True
+        # Inbound connections behind a verifier may not deliver
+        # anything before a valid banner: a peer that skips the
+        # handshake is cut off, not dispatched.
+        if (self.inbound and self.msgr.auth_verifier is not None
+                and self.auth_info is None):
+            self.close()
+            return False
+        # A guarded dialer ignores inbound traffic until the
+        # service has answered the handshake.
+        if guarded_dialer:
+            return True
+        # MSGACK sits BEHIND the auth gates: an unauthenticated peer
+        # must not be able to trim the lossless resend set
+        if (isinstance(msg, tuple) and len(msg) == 2
+                and msg[0] == "MSGACK"):
+            # the peer delivered everything up to this link_seq: those
+            # messages no longer need resending on reconnect
+            with self.lock:
+                self._unacked = [(s, m) for s, m in self._unacked
+                                 if s > msg[1]]
+            return True
+        msg.from_addr = self.peer_addr
+        self.msgr._dispatch(msg)
+        seq = getattr(msg, "link_seq", None)
+        if seq is not None:
+            # ack AFTER dispatch: delivery, not receipt (at-least-once)
+            try:
+                send_bytes(_encode(("MSGACK", seq)))
+            except OSError:
+                return False
+        return True
 
     def close(self) -> None:
         with self.lock:

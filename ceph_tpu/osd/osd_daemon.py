@@ -19,7 +19,8 @@ from ..common.workqueue import Finisher, SafeTimer, ShardedThreadPool
 from ..mon.mon_client import MonClient
 from ..msg.message import (MOSDBoot, MOSDFailure, MOSDOpReply, MPing,
                            MPingReply)
-from ..msg.messenger import Dispatcher, Messenger
+from ..msg.async_messenger import create_messenger
+from ..msg.messenger import Dispatcher
 from ..store.mem_store import MemStore
 from ..common.lockdep import make_rlock
 from ..utils.trace import Tracer
@@ -44,9 +45,9 @@ class OSDDaemon(Dispatcher):
         # creator's finisher died with the old daemon, and callbacks
         # queued there black-hole (no commit acks => wedged writes)
         self.store._finisher = self.finisher
-        self.public_msgr = Messenger(("osd", whoami), conf=conf)
-        self.cluster_msgr = Messenger(("osd", whoami), conf=conf)
-        self.hb_msgr = Messenger(("osd", whoami), conf=conf)
+        self.public_msgr = create_messenger(("osd", whoami), conf=conf)
+        self.cluster_msgr = create_messenger(("osd", whoami), conf=conf)
+        self.hb_msgr = create_messenger(("osd", whoami), conf=conf)
         self.monmap = dict(monmap)
         self.mon_client = MonClient(monmap, self.public_msgr,
                                     "osd.%d" % whoami)

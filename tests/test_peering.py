@@ -131,7 +131,7 @@ class TestLogBasedRecovery:
                 st = cluster.osds[0].store
                 oids = set(st.list_objects(cid))
                 return "ghost" not in oids and "acked" in oids
-            assert wait_until(ghost_gone_and_caught_up, timeout=20)
+            assert wait_until(ghost_gone_and_caught_up, timeout=45)
             assert ioctx.read("acked") == b"acked-data"
             assert ioctx.read("shared") == b"base"
         finally:
@@ -210,7 +210,7 @@ class TestDivergentModify:
                         b"acked-truth"
                 except KeyError:
                     return False
-            assert wait_until(fork_undone, timeout=20)
+            assert wait_until(fork_undone, timeout=45)
             assert ioctx.read("shared") == b"acked-truth"
         finally:
             cluster.stop()
