@@ -157,6 +157,7 @@ class AsyncConnection(Connection):
         self._cur_msg = None
         self._cur_seq = 0
         self._blocked_until = 0.0    # fault-injected delay gate
+        self._delay_paid = False     # head message already rolled
         self._connecting = False
         self._registered = False
         if sock is not None:
@@ -241,18 +242,24 @@ class AsyncConnection(Connection):
                 if not self.out_q:
                     break
                 msg = self.out_q[0]
-            if self.msgr._inject_should_drop():
-                with self.lock:
-                    if self.out_q and self.out_q[0] is msg:
-                        self.out_q.pop(0)
-                continue
-            delay = self.msgr._inject_delay()
-            if delay:
-                # gate the whole STREAM, not just this frame —
-                # per-frame deferral would reorder the connection
-                self._blocked_until = time.monotonic() + delay
-                self.center.call_later(delay, self._pump)
-                return
+            # fault injection rolls ONCE per message (a paid delay must
+            # not re-roll on the post-delay re-entry, or a nonzero
+            # delay_max blocks the stream forever)
+            if not self._delay_paid:
+                if self.msgr._inject_should_drop():
+                    with self.lock:
+                        if self.out_q and self.out_q[0] is msg:
+                            self.out_q.pop(0)
+                    continue
+                delay = self.msgr._inject_delay()
+                if delay:
+                    # gate the whole STREAM, not just this frame —
+                    # per-frame deferral would reorder the connection
+                    self._delay_paid = True
+                    self._blocked_until = time.monotonic() + delay
+                    self.center.call_later(delay, self._pump)
+                    return
+            self._delay_paid = False
             self.out_seq += 1
             msg.link_seq = self.out_seq
             try:
@@ -305,6 +312,7 @@ class AsyncConnection(Connection):
             with self.lock:
                 self.out_q.clear()
                 self._unacked.clear()
+            self._delay_paid = False
             self.msgr._notify_reset(self.peer_addr)
             return
         self.center.call_later(0.2, self._pump)
@@ -326,6 +334,7 @@ class AsyncConnection(Connection):
         self._cur = bytearray()
         self._cur_msg = None
         self._connecting = False
+        self._delay_paid = False     # the paid head no longer exists
         if self.closed:
             return
         if self.inbound:

@@ -1323,6 +1323,13 @@ class PG:
         self._rebuild_reqids()
         need = self._apply_log_updates(updates, msg.from_osd, divergent,
                                        pull=False)
+        # report the FULL outstanding missing map, not just newly-
+        # discovered entries (need is always a subset of it): a report
+        # sent while the primary still saw us as a stray was ignored,
+        # and re-activation may deliver no new log entries — without
+        # the full set, those objects would never be pushed
+        with self.lock:
+            need = set(self.missing)
         self.send_to_osd(msg.from_osd, MOSDPGNotify(
             pgid=self.pgid, from_osd=self.whoami, missing=sorted(need),
             kind="missing", map_epoch=self.map_epoch()))
@@ -1386,6 +1393,15 @@ class PG:
                 continue
             peer_lu = tuple(info.get("last_update", (0, 0)))
             if peer_lu == head:
+                # log-caught-up, but the peer may still hold a missing
+                # map whose earlier report was dropped or ignored
+                # (e.g. it arrived while our lagging map saw the peer
+                # as a stray) — an EMPTY activation delta makes it
+                # re-report its full outstanding set via handle_log
+                self.send_to_osd(osd, MOSDPGLog(
+                    pgid=self.pgid, from_osd=self.whoami, entries=[],
+                    head=list(head), contiguous=True,
+                    map_epoch=self.map_epoch()))
                 continue
             with self.lock:
                 overlaps = self.pg_log.overlaps(peer_lu)

@@ -40,7 +40,14 @@ def wait_until(fn, timeout=10.0, interval=0.02):
 
 class MiniCluster:
     def __init__(self, num_mons=1, num_osds=3, conf_overrides=None):
-        self.conf_overrides = conf_overrides or {}
+        self.conf_overrides = dict(conf_overrides or {})
+        # CEPH_TPU_MS_TYPE=async runs every cluster in the suite on the
+        # event-loop transport (a second full-suite configuration for
+        # the AsyncMessenger; explicit per-test ms_type still wins)
+        import os
+        env_ms = os.environ.get("CEPH_TPU_MS_TYPE")
+        if env_ms and "ms_type" not in self.conf_overrides:
+            self.conf_overrides["ms_type"] = env_ms
         self.monmap = {r: ("127.0.0.1", p)
                        for r, p in enumerate(free_ports(num_mons))}
         self.mons = []
