@@ -1319,15 +1319,18 @@ class PG:
                                                    tuple(msg.head))
             self.last_version = max(self.last_version,
                                     self.pg_log.head[1])
-        self._persist_log_full()
-        self._rebuild_reqids()
-        need = self._apply_log_updates(updates, msg.from_osd, divergent,
-                                       pull=False)
+        if entries or updates or divergent:
+            # a caught-up replica's empty activation delta (sent so it
+            # re-reports missing) must not cost a full log rewrite
+            self._persist_log_full()
+            self._rebuild_reqids()
+        self._apply_log_updates(updates, msg.from_osd, divergent,
+                                pull=False)
         # report the FULL outstanding missing map, not just newly-
-        # discovered entries (need is always a subset of it): a report
-        # sent while the primary still saw us as a stray was ignored,
-        # and re-activation may deliver no new log entries — without
-        # the full set, those objects would never be pushed
+        # discovered entries: a report sent while the primary still saw
+        # us as a stray was ignored, and re-activation may deliver no
+        # new log entries — without the full set, those objects would
+        # never be pushed
         with self.lock:
             need = set(self.missing)
         self.send_to_osd(msg.from_osd, MOSDPGNotify(

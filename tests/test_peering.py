@@ -81,7 +81,7 @@ class TestLogBasedRecovery:
                             osd.store.read(cid, oid))
                 return (b"v2" in total) and (b"v3" in total) \
                     and (b"v1" not in total)
-            assert wait_until(osd0_converged, timeout=20)
+            assert wait_until(osd0_converged, timeout=45)
             # convergence came from the log delta, not inventory scans
             # aimed at the revived OSD
             assert not [s for s in scans if s[0] == 0], scans
