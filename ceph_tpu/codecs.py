@@ -46,12 +46,15 @@ def register_attrs(cls, name: str, attrs: list[str], factory,
     register_codec(name, cls, version, compat, enc_f, dec_f)
 
 
-def register_message(cls, version: int = 2, compat: int = 1) -> None:
+def register_message(cls, version: int = 2, compat: int = 2) -> None:
     """Messages carry transport header (seq, from_name and — since
     struct v2 — link_seq, the per-connection sequence the messenger's
     lossless MSGACK protocol acks against, the Pipe out_seq role) +
-    dataclass fields. Appending fields (with defaults) is the version
-    bump; v1 payloads (no link_seq) still decode (compat=1)."""
+    dataclass fields. link_seq was inserted MID-stream (between the
+    header and the dataclass fields), not appended, so compat=2 per
+    the denc convention: a v1 decoder must reject v2 frames instead of
+    consuming link_seq as the first field and shifting everything.
+    Old v1 payloads still decode here (the struct_v >= 2 guard)."""
     names = [f.name for f in dataclasses.fields(cls)]
 
     def enc_f(enc, obj):

@@ -61,6 +61,12 @@ class EventCenter:
         self._stopping = True
         self.wakeup()
         self.thread.join(timeout=2)
+        if self.thread.is_alive():
+            # a stuck callback still owns the selector: closing it now
+            # would turn the loop into a 100%-CPU spin on OSError.
+            # Leak the fds; the loop exits at its next top-of-loop
+            # _stopping check (or with the process).
+            return
         try:
             self.sel.close()
         except Exception:
@@ -127,6 +133,8 @@ class EventCenter:
             try:
                 events = self.sel.select(timeout)
             except OSError:
+                if self._stopping:
+                    return   # selector closed under us during shutdown
                 continue
             for key, mask in events:
                 try:
@@ -156,6 +164,7 @@ class AsyncConnection(Connection):
         self._cur = bytearray()      # the in-flight frame's bytes
         self._cur_msg = None
         self._cur_seq = 0
+        self._cur_from_resend = False
         self._blocked_until = 0.0    # fault-injected delay gate
         self._delay_paid = False     # head message already rolled
         self._connecting = False
@@ -196,8 +205,42 @@ class AsyncConnection(Connection):
             self.sel_key = self.center.sel.register(
                 self.sock, self._events(), self._on_io)
             self._registered = True
-        except (KeyError, ValueError, OSError):
+        except KeyError:
+            # fd-number reuse: a socket closed behind our back (epoll
+            # silently drops closed fds, so an idle connection never
+            # gets an event to tear itself down) left a stale selectors
+            # entry under this fd. The kernel only re-issues an fd
+            # number after the old one closed, so the stale entry is
+            # provably dead — evict it and retry.
+            if self._evict_stale_fd():
+                try:
+                    self.sel_key = self.center.sel.register(
+                        self.sock, self._events(), self._on_io)
+                    self._registered = True
+                except (KeyError, ValueError, OSError):
+                    pass
+        except (ValueError, OSError):
             pass
+
+    def _evict_stale_fd(self) -> bool:
+        try:
+            fd = self.sock.fileno()
+        except (OSError, ValueError):
+            return False
+        try:
+            stale = self.center.sel.get_map().get(fd)
+        except (KeyError, RuntimeError):
+            stale = None
+        if stale is None:
+            return False
+        try:
+            self.center.sel.unregister(stale.fileobj)
+        except (KeyError, ValueError, OSError):
+            return False
+        owner = getattr(stale.data, "__self__", None)
+        if owner is not None and owner is not self:
+            owner._registered = False
+        return True
 
     def _reregister(self) -> None:
         if self._registered and self.sock is not None:
@@ -239,6 +282,26 @@ class AsyncConnection(Connection):
             return
         while not self._cur:
             with self.lock:
+                resend = self._resend[0] if self._resend else None
+            if resend is not None:
+                # reconnect resend: original link_seq on the wire so
+                # the peer's dedup can identify it (exactly-once)
+                seq, msg = resend
+                try:
+                    frame = _encode(msg, seq)
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
+                    with self.lock:
+                        if self._resend and self._resend[0] is resend:
+                            self._resend.pop(0)
+                    continue
+                self._cur = bytearray(frame)
+                self._cur_msg = msg
+                self._cur_seq = seq
+                self._cur_from_resend = True
+                break
+            with self.lock:
                 if not self.out_q:
                     break
                 msg = self.out_q[0]
@@ -261,9 +324,8 @@ class AsyncConnection(Connection):
                     return
             self._delay_paid = False
             self.out_seq += 1
-            msg.link_seq = self.out_seq
             try:
-                frame = _encode(msg)
+                frame = _encode(msg, self.out_seq)
             except Exception:
                 import traceback
                 traceback.print_exc()
@@ -274,6 +336,7 @@ class AsyncConnection(Connection):
             self._cur = bytearray(frame)
             self._cur_msg = msg
             self._cur_seq = self.out_seq
+            self._cur_from_resend = False
         self._flush()
 
     def _start_connect(self) -> None:
@@ -302,7 +365,7 @@ class AsyncConnection(Connection):
         self._connecting = True
         self._ctrl = bytearray(_encode(
             ("BANNER", tuple(self.msgr.my_addr or ("", 0)),
-             self.msgr.name, authorizer))) + self._ctrl
+             self.msgr.name, authorizer, self.conn_nonce))) + self._ctrl
         self._register_io()
 
     def _schedule_reconnect(self) -> None:
@@ -312,6 +375,7 @@ class AsyncConnection(Connection):
             with self.lock:
                 self.out_q.clear()
                 self._unacked.clear()
+                self._resend.clear()
             self._delay_paid = False
             self.msgr._notify_reset(self.peer_addr)
             return
@@ -333,13 +397,27 @@ class AsyncConnection(Connection):
         self._ctrl = bytearray()
         self._cur = bytearray()
         self._cur_msg = None
+        self._cur_from_resend = False
         self._connecting = False
         self._delay_paid = False     # the paid head no longer exists
         if self.closed:
             return
         if self.inbound:
-            self.closed = True
-            return
+            # an accepted connection with pending lossless traffic and
+            # a known peer address flips to dialer mode — dying with
+            # _unacked messages would strand them (the threaded
+            # transport's inbound conns re-dial the same way)
+            with self.lock:
+                pending = bool(self._unacked or self.out_q
+                               or self._resend)
+                if pending and not self.msgr.policy_lossy \
+                        and self.peer_name is not None:
+                    self.inbound = False
+                    self._resend[0:0] = self._unacked
+                    self._unacked.clear()
+                else:
+                    self.closed = True
+                    return
         self._schedule_reconnect()   # lossless dialers reconnect
 
     def _on_io(self, mask) -> None:
@@ -357,10 +435,11 @@ class AsyncConnection(Connection):
                 if not (self.msgr.auth_confirm is not None
                         or self.msgr.authorizer_factory is not None):
                     self.auth_confirmed = True
-                # fresh pipe: unacked messages resend first
+                # fresh pipe: unacked messages resend first, keeping
+                # their original link_seq for the peer's dedup
                 with self.lock:
                     if self._unacked:
-                        self.out_q[0:0] = [m for _, m in self._unacked]
+                        self._resend[0:0] = self._unacked
                         self._unacked.clear()
                 self._pump()
             self._flush()
@@ -389,10 +468,15 @@ class AsyncConnection(Connection):
                 # but stays in _unacked until the peer's MSGACK — bytes
                 # accepted by a dying TCP buffer are not delivery
                 with self.lock:
-                    if self.out_q and self.out_q[0] is self._cur_msg:
+                    if self._cur_from_resend:
+                        if (self._resend
+                                and self._resend[0][1] is self._cur_msg):
+                            self._resend.pop(0)
+                    elif self.out_q and self.out_q[0] is self._cur_msg:
                         self.out_q.pop(0)
                     self._unacked.append((self._cur_seq, self._cur_msg))
                 self._cur_msg = None
+                self._cur_from_resend = False
                 self.center.call_soon(self._pump)
         self._reregister()
 
@@ -418,7 +502,7 @@ class AsyncConnection(Connection):
         buf = self._inbuf
         try:
             while len(buf) - off >= _HDR.size:
-                magic, length = _HDR.unpack_from(buf, off)
+                magic, length, link_seq = _HDR.unpack_from(buf, off)
                 if magic != _MAGIC:
                     self._teardown()
                     return
@@ -429,7 +513,8 @@ class AsyncConnection(Connection):
                 off += _HDR.size + length
                 was_confirmed = self.auth_confirmed
                 if not self._process_payload(payload,
-                                             self._buffer_bytes):
+                                             self._buffer_bytes,
+                                             link_seq):
                     self._teardown()
                     return
                 if self.auth_confirmed and not was_confirmed:

@@ -36,7 +36,13 @@ from .. import encoding
 __all__ = ["EntityAddr", "Dispatcher", "Messenger", "Connection"]
 
 _MAGIC = b"CTPU"
-_HDR = struct.Struct("<4sI")
+# frame header: magic, payload length, link_seq. The per-connection
+# sequence rides the FRAME, not the message object: one message object
+# may be queued to several peers at once, and stamping a shared object
+# per-connection would race (a frame could carry another pipe's seq,
+# making the receiver's dedup drop later messages as duplicates).
+# seq 0 = control frame (handshake, acks) — unsequenced.
+_HDR = struct.Struct("<4sIQ")
 
 
 class EntityAddr(tuple):
@@ -70,9 +76,9 @@ class Dispatcher:
         """Peer connection dropped (lossy) — state cleanup hook."""
 
 
-def _encode(msg) -> bytes:
+def _encode(msg, seq: int = 0) -> bytes:
     payload = encoding.encode_any(msg)
-    return _HDR.pack(_MAGIC, len(payload)) + payload
+    return _HDR.pack(_MAGIC, len(payload), seq) + payload
 
 
 def _read_exact(sock, n: int) -> bytes | None:
@@ -101,7 +107,22 @@ class Connection:
         # bytes accepted by a dying TCP buffer are NOT delivery
         self.out_seq = 0
         self._unacked: list = []      # [(link_seq, msg)]
+        # reconnect resend set: (seq, msg) pairs that keep their
+        # ORIGINAL link_seq on the wire — the peer's dedup identifies
+        # an already-delivered resend by seq, so reassigning seqs on
+        # resend (as a fresh send would) would defeat exactly-once
+        self._resend: list = []
         self._ctrl_out: list = []     # reader-queued control frames
+        # session identity for exactly-once delivery across reconnects
+        # (the reference's connect_seq + in_seq exchange,
+        # src/msg/simple/Pipe.cc connect phase): the dialer mints a
+        # nonce per Connection incarnation; the acceptor tracks the
+        # last-delivered link_seq per (peer name, nonce) at the
+        # Messenger level — resent messages whose acks were lost are
+        # acked again but NOT re-dispatched.
+        self.conn_nonce = os.urandom(8).hex()
+        self._dedup_key = None       # acceptor: (peer_name, nonce)
+        self._in_seq = 0             # acceptor: last delivered link_seq
         self.peer_name = None
         self.auth_info = None        # verified cephx info (entity, caps)
         self.inbound = sock is not None   # accepted vs dialed
@@ -164,7 +185,7 @@ class Connection:
             # connect handshake; replies never dial the ephemeral port)
             sock.sendall(_encode(
                 ("BANNER", tuple(self.msgr.my_addr or ("", 0)),
-                 self.msgr.name, authorizer)))
+                 self.msgr.name, authorizer, self.conn_nonce)))
             self._sent_authorizer = authorizer
             self.sock = sock
             self._start_reader()
@@ -183,11 +204,18 @@ class Connection:
                 if self.sock is sock:
                     self.sock = None
                 return False
-        # fresh pipe: everything the old one never acked goes first
+        # fresh pipe: everything the old one never acked goes first,
+        # keeping its original link_seq (the peer dedups resends by it).
+        # Lossy connections DROP instead: a lossy fault discards the
+        # session (reference Pipe semantics), so stale pre-fault
+        # messages must not resurface on the next connect.
         with self.lock:
             if self._unacked:
-                self.out_q[0:0] = [m for _, m in self._unacked]
-                self._unacked.clear()
+                if self.msgr.policy_lossy:
+                    self._unacked.clear()
+                else:
+                    self._resend[0:0] = self._unacked
+                    self._unacked.clear()
         return True
 
     @property
@@ -228,17 +256,26 @@ class Connection:
         backoff = 0.01
         while True:
             with self.lock:
-                while not self.out_q and not self._ctrl_out \
-                        and not self.closed:
+                while not self.out_q and not self._resend \
+                        and not self._ctrl_out and not self.closed:
                     self.cond.wait(0.5)
-                if self.closed and not self.out_q:
+                if self.closed:
+                    # close() is explicit teardown (mark_down/shutdown):
+                    # exit NOW, queued or not — draining would mean
+                    # re-dialing a peer we were just told to drop, and
+                    # a non-empty _resend would otherwise keep this
+                    # thread dialing dead peers forever
                     return
                 ctrl = b"".join(self._ctrl_out)
                 self._ctrl_out.clear()
-                msg = self.out_q[0] if self.out_q else None
+                # resends (original seq) drain before fresh sends so
+                # link_seq stays monotonic on the wire
+                resend = self._resend[0] if self._resend else None
+                msg = (self.out_q[0]
+                       if resend is None and self.out_q else None)
             if self.sock is None:
                 # control frames are per-pipe; a dead pipe's are moot
-                if msg is None:
+                if msg is None and resend is None:
                     continue
                 if not self._connect():
                     time.sleep(backoff)
@@ -246,6 +283,7 @@ class Connection:
                     if self.msgr.policy_lossy:
                         with self.lock:
                             self.out_q.clear()
+                            self._resend.clear()
                         self.msgr._notify_reset(self.peer_addr)
                     continue
                 backoff = 0.01
@@ -262,22 +300,28 @@ class Connection:
                 except OSError:
                     self._on_send_error(sock)
                     continue
-            if msg is None:
+            if msg is None and resend is None:
                 continue
-            if self.msgr._inject_should_drop():
-                with self.lock:
-                    self.out_q.pop(0)
-                continue
-            delay = self.msgr._inject_delay()
-            if delay:
-                time.sleep(delay)
-            sock = self.sock
-            if sock is None:
-                continue
-            self.out_seq += 1
-            msg.link_seq = self.out_seq
+            if resend is not None:
+                seq, msg = resend
+            else:
+                # fault injection rolls on FRESH sends only — a resend
+                # already survived one pipe death; injecting on it too
+                # would compound drop probability per reconnect
+                if self.msgr._inject_should_drop():
+                    with self.lock:
+                        if self.out_q and self.out_q[0] is msg:
+                            self.out_q.pop(0)
+                    continue
+                delay = self.msgr._inject_delay()
+                if delay:
+                    time.sleep(delay)
+                if self.sock is None:
+                    continue
+                self.out_seq += 1
+                seq = self.out_seq
             try:
-                frame = _encode(msg)
+                frame = _encode(msg, seq)
             except Exception:
                 # poison message (a field outside the closed encodable
                 # set): drop IT, not the writer thread — pickle used to
@@ -285,14 +329,42 @@ class Connection:
                 import traceback
                 traceback.print_exc()
                 with self.lock:
-                    self.out_q.pop(0)
+                    if resend is not None:
+                        if self._resend and self._resend[0] is resend:
+                            self._resend.pop(0)
+                    elif self.out_q and self.out_q[0] is msg:
+                        self.out_q.pop(0)
+                continue
+            # bookkeep BEFORE sendall: on a fast loopback the peer's
+            # MSGACK for this seq can race the post-send append and
+            # trim nothing, redelivering the message on reconnect
+            with self.lock:
+                self._unacked.append((seq, msg))
+            sock = self.sock
+            if sock is None:
+                with self.lock:
+                    self._unacked = [(s, m) for s, m in self._unacked
+                                     if s != seq]
                 continue
             try:
                 sock.sendall(frame)
                 with self.lock:
-                    self.out_q.pop(0)
-                    self._unacked.append((self.out_seq, msg))
+                    if resend is not None:
+                        if self._resend and self._resend[0] is resend:
+                            self._resend.pop(0)
+                    elif self.out_q and self.out_q[0] is msg:
+                        self.out_q.pop(0)
             except OSError:
+                # purge from BOTH queues: the reader's EOF handler may
+                # have moved the in-flight entry into _resend already,
+                # and the message is still at its queue head — leaving
+                # it in _resend too would send it twice
+                with self.lock:
+                    self._unacked = [(s, m) for s, m in self._unacked
+                                     if s != seq]
+                    if resend is None:
+                        self._resend = [(s, m) for s, m in self._resend
+                                        if s != seq]
                 self._on_send_error(sock)
                 # lossless: keep msg at head, reconnect and resend
 
@@ -306,6 +378,7 @@ class Connection:
             with self.lock:
                 self.out_q.clear()
                 self._unacked.clear()
+                self._resend.clear()
             self.msgr._notify_reset(self.peer_addr)
 
     # -- reader --------------------------------------------------------
@@ -317,7 +390,7 @@ class Connection:
                 hdr = _read_exact(sock, _HDR.size)
                 if hdr is None:
                     break
-                magic, length = _HDR.unpack(hdr)
+                magic, length, link_seq = _HDR.unpack(hdr)
                 if magic != _MAGIC:
                     break
                 payload = _read_exact(sock, length)
@@ -325,17 +398,41 @@ class Connection:
                     break
             except OSError:
                 break
-            if not self._process_payload(payload, self._queue_ctrl):
+            if not self._process_payload(payload, self._queue_ctrl,
+                                         link_seq):
                 break
         if sock is self.sock:
             self.sock = None
+        # the pipe died: anything sendall handed to the dying socket
+        # is in _unacked with no MSGACK coming. A lossless connection
+        # must requeue and reconnect NOW — waiting for the next fresh
+        # send would park those messages forever (the reference's
+        # Pipe::fault requeues immediately for the same reason).
+        if not self.closed and not self.msgr.policy_lossy \
+                and (not self.inbound or self.peer_name is not None):
+            # (an accepted conn whose peer never advertised an address
+            # has nowhere to re-dial — leave it parked)
+            if self.inbound:
+                # from here on this conn DIALS the advertised address:
+                # it must run the dialer side of the handshake (answer
+                # BANNER_RETRY, hold data until mutual auth) or the
+                # reconnect could never complete under auth
+                self.inbound = False
+            with self.lock:
+                if self._unacked:
+                    self._resend[0:0] = self._unacked
+                    self._unacked.clear()
+                if self._resend or self.out_q:
+                    self.cond.notify_all()
 
-    def _process_payload(self, payload: bytes, send_bytes) -> bool:
+    def _process_payload(self, payload: bytes, send_bytes,
+                         link_seq: int = 0) -> bool:
         """One inbound frame through the connection protocol (banner
         handshake, restricted pre-auth decode, dispatch). Transport
         agnostic: the threaded reader passes sock.sendall, the async
-        engine passes its buffered writer. Returns False to tear the
-        connection down."""
+        engine passes its buffered writer. link_seq is the frame
+        header's per-connection sequence (0 = control frame). Returns
+        False to tear the connection down."""
         # pre-auth frames may only materialize closed-set builtins
         # (no registered-struct construction), so an unauthenticated
         # peer cannot reach any type's constructor
@@ -352,16 +449,23 @@ class Connection:
                 self.close()
                 return False
             return True
-        if (isinstance(msg, tuple) and len(msg) in (3, 4)
+        if (isinstance(msg, tuple) and len(msg) in (3, 4, 5)
                 and msg[0] == "BANNER"):
             # acceptor side: adopt the peer's advertised listening
             # address and register so sends to it reuse this pipe.
             # With auth enabled, the banner must carry an authorizer
             # whose proof covers our per-connection challenge
             # (BANNER_RETRY round) or the connection drops (EACCES).
+            # A 5th element is the dialer's session nonce: the key for
+            # exactly-once dedup across reconnects (the reference's
+            # in_seq exchange during the connect phase).
+            nonce = msg[4] if len(msg) >= 5 else None
+            if nonce is not None:
+                self._dedup_key = (repr(msg[2]), nonce)
+                self._in_seq = self.msgr._delivered_seq(self._dedup_key)
             verifier = self.msgr.auth_verifier
             if verifier is not None:
-                authorizer = msg[3] if len(msg) == 4 else None
+                authorizer = msg[3] if len(msg) >= 4 else None
                 if self._server_challenge is None:
                     self._server_challenge = os.urandom(16)
                 if not (isinstance(authorizer, dict)
@@ -379,10 +483,13 @@ class Connection:
                     self.close()
                     return False
                 self.auth_info = info
-                # mutual auth: prove we could read the ticket
+                # mutual auth: prove we could read the ticket; the
+                # third element tells the dialer our last-delivered
+                # in_seq so it can trim already-delivered resends
                 try:
                     send_bytes(_encode(
-                        ("BANNER_ACK", info.get("reply_proof"))))
+                        ("BANNER_ACK", info.get("reply_proof"),
+                         self._in_seq)))
                 except OSError:
                     return False
             else:
@@ -390,7 +497,8 @@ class Connection:
                 # handshake wait resolves (its auth_confirm, if any,
                 # decides whether a proof-less ack is acceptable)
                 try:
-                    send_bytes(_encode(("BANNER_ACK", None)))
+                    send_bytes(_encode(("BANNER_ACK", None,
+                                        self._in_seq)))
                 except OSError:
                     return False
             self.peer_addr = EntityAddr(*msg[1])
@@ -413,11 +521,11 @@ class Connection:
             try:
                 send_bytes(_encode(
                     ("BANNER", tuple(self.msgr.my_addr or ("", 0)),
-                     self.msgr.name, authorizer)))
+                     self.msgr.name, authorizer, self.conn_nonce)))
             except OSError:
                 return False
             return True
-        if (isinstance(msg, tuple) and len(msg) == 2
+        if (isinstance(msg, tuple) and len(msg) in (2, 3)
                 and msg[0] == "BANNER_ACK"):
             # dialer side: the service proved possession of the
             # session key (cephx mutual auth). The proof bytes are
@@ -432,6 +540,16 @@ class Connection:
                 if not ok:
                     self.close()
                     return False
+            # third element: the acceptor's last-delivered in_seq for
+            # our session nonce — everything at or below it was already
+            # dispatched there, so drop it from the resend sets
+            if len(msg) == 3 and isinstance(msg[2], int) and msg[2] > 0:
+                acked = msg[2]
+                with self.lock:
+                    self._unacked = [(s, m) for s, m in self._unacked
+                                     if s > acked]
+                    self._resend = [(s, m) for s, m in self._resend
+                                    if s > acked]
             self.auth_confirmed = True
             self._auth_ready.set()
             return True
@@ -457,9 +575,30 @@ class Connection:
                                  if s > msg[1]]
             return True
         msg.from_addr = self.peer_addr
+        seq = link_seq or None
+        msg.link_seq = seq
+        if seq is not None and self._dedup_key is not None:
+            # refresh from the messenger-level watermark: the previous
+            # incarnation's reader may still have been mid-dispatch
+            # when this connection snapshotted _in_seq at BANNER time
+            cur = self.msgr._delivered_seq(self._dedup_key)
+            if cur > self._in_seq:
+                self._in_seq = cur
+        if (seq is not None and self._dedup_key is not None
+                and seq <= self._in_seq):
+            # resend of a message this session already dispatched (its
+            # MSGACK was lost in the reconnect): ack again, do NOT
+            # re-deliver — exactly-once for the dispatchers
+            try:
+                send_bytes(_encode(("MSGACK", seq)))
+            except OSError:
+                return False
+            return True
         self.msgr._dispatch(msg)
-        seq = getattr(msg, "link_seq", None)
         if seq is not None:
+            if self._dedup_key is not None and seq > self._in_seq:
+                self._in_seq = seq
+                self.msgr._record_delivered(self._dedup_key, seq)
             # ack AFTER dispatch: delivery, not receipt (at-least-once)
             try:
                 send_bytes(_encode(("MSGACK", seq)))
@@ -509,6 +648,16 @@ class Messenger:
         self._accept_thread: threading.Thread | None = None
         self._conns: dict = {}       # peer_addr -> Connection (outgoing)
         self._in_conns: list = []
+        # (peer_name, session nonce) -> last delivered link_seq;
+        # survives the per-socket Connection objects so reconnect
+        # resends dedup (the reference keeps in_seq on the long-lived
+        # Connection that successive Pipes attach to). Bounded per
+        # peer name: old sessions' nonces are pruned as new ones
+        # register (a pruned-but-live session degrades to
+        # at-least-once, never to loss).
+        self._delivered: dict = {}
+        self._delivered_order: dict = {}   # peer_name -> [nonce, ...]
+        self.DELIVERED_SESSIONS_PER_PEER = 8
         self._lock = threading.Lock()
         self._stopping = False
         self._rng = random.Random()
@@ -584,6 +733,21 @@ class Messenger:
             existing = self._conns.get(conn.peer_addr)
             if existing is None or existing.closed:
                 self._conns[conn.peer_addr] = conn
+
+    def _delivered_seq(self, key) -> int:
+        with self._lock:
+            return self._delivered.get(key, 0)
+
+    def _record_delivered(self, key, seq: int) -> None:
+        with self._lock:
+            if key not in self._delivered:
+                name, nonce = key
+                order = self._delivered_order.setdefault(name, [])
+                order.append(nonce)
+                while len(order) > self.DELIVERED_SESSIONS_PER_PEER:
+                    self._delivered.pop((name, order.pop(0)), None)
+            if seq > self._delivered.get(key, 0):
+                self._delivered[key] = seq
 
     def _notify_reset(self, addr) -> None:
         for d in self.dispatchers:

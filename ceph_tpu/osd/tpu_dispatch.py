@@ -70,9 +70,13 @@ class TpuDispatcher:
             return cached
         bm = getattr(codec, "_bitmat", None)
         if bm is not None:
+            # full digest, not hash(): a 64-bit hash collision between
+            # two generators of the same shape would silently coalesce
+            # different codecs into one dispatch and return wrong bytes
+            import hashlib
             key = (type(codec).__name__, getattr(codec, "w", 0),
-                   getattr(codec, "packetsize", 0),
-                   bm.shape, hash(bm.tobytes()))
+                   getattr(codec, "packetsize", 0), bm.shape,
+                   hashlib.sha256(bm.tobytes()).digest())
         else:
             key = ("id", id(codec))
         try:

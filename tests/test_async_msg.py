@@ -87,6 +87,30 @@ class TestAsyncTransport:
         assert _wait_count(cb, 6, timeout=15)
         assert cb.got[-1].stamp == 14.0
 
+    def test_reconnect_resend_not_redelivered(self, pair):
+        """Exactly-once for dispatchers on the event-loop transport: a
+        resend whose MSGACK was lost is acked, not re-dispatched."""
+        a, b, _, cb = pair
+        m = MPing(stamp=7.7, epoch=1)
+        a.send_message(m, b.my_addr)
+        assert _wait_count(cb, 1)
+        conn = a._conns[b.my_addr]
+        deadline = time.monotonic() + 5
+        while conn._unacked and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert not conn._unacked
+        # lost-ack simulation: delivered message back in the resend
+        # set, then kill the pipe so the dialer reconnects
+        with conn.lock:
+            conn._unacked.append((conn.out_seq, m))
+        conn.sock.close()
+        a.send_message(MPing(stamp=8.8, epoch=1), b.my_addr)
+        assert _wait_count(cb, 2, timeout=15)
+        time.sleep(0.3)
+        stamps = [g.stamp for g in cb.got]
+        assert stamps.count(7.7) == 1, stamps
+        assert stamps.count(8.8) == 1, stamps
+
     def test_no_queued_message_lost_across_reset(self, pair):
         """Messages queued when the connection dies must survive the
         reconnect (at-least-once: the in-flight frame may duplicate,

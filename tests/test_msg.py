@@ -136,6 +136,39 @@ class TestMessenger:
         finally:
             a.shutdown()
 
+    def test_reconnect_resend_not_redelivered(self):
+        """Exactly-once for dispatchers: a resend whose MSGACK was
+        lost in the pipe death is acked again but NOT re-dispatched
+        (the reference's in_seq dedup across reconnects)."""
+        a, b = make_pair()
+        try:
+            coll = Collector()
+            b.add_dispatcher_tail(coll)
+            m = MPing(stamp=7.7)
+            a.send_message(m, b.my_addr)
+            assert coll.wait_for(1)
+            conn = a._conns[b.my_addr]
+            # let the MSGACK trim land, then simulate the LOST-ack
+            # case: the delivered message back in the resend set
+            deadline = time.monotonic() + 5
+            while conn._unacked and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert not conn._unacked
+            with conn.lock:
+                conn._unacked.append((conn.out_seq, m))
+            sock = conn.sock
+            conn.sock = None
+            sock.close()
+            a.send_message(MPing(stamp=8.8), b.my_addr)
+            assert coll.wait_for(2)
+            time.sleep(0.3)   # window for a wrong redelivery
+            stamps = [g.stamp for g in coll.got]
+            assert stamps.count(7.7) == 1, stamps
+            assert stamps.count(8.8) == 1, stamps
+        finally:
+            a.shutdown()
+            b.shutdown()
+
     def test_lossy_drops_on_failure(self):
         conf = Config()
         a = Messenger(("client", 1), conf=conf, policy_lossy=True)
