@@ -22,8 +22,12 @@ import json
 
 import numpy as np
 
+from ..ops import gf
 from ..utils import profile as profile_util
 from .base import ErasureCode, ErasureCodeError
+
+
+from .matrix_base import _is_jax as _is_jax_arr  # noqa: E402
 
 
 class LrcLayer:
@@ -52,6 +56,9 @@ class Lrc(ErasureCode):
         self.chunk_count = 0
         self.data_chunk_count = 0
         self.rule_steps: list = [("chooseleaf", "host", 0)]
+        self._fusable_cached: bool | None = None
+        self._fused_gen: dict | None = None
+        self._fused_dec_cache: dict = {}
 
     # -- init --------------------------------------------------------------
 
@@ -322,10 +329,126 @@ class Lrc(ErasureCode):
             return set(available)
         raise ErasureCodeError(errno.EIO, "not enough chunks to decode")
 
-    # -- batch API (per-layer delegation to the inner codec's device
-    # path: ErasureCodeLrc.cc:744-776 encodes layer by layer, each an
-    # inner-plugin encode — batched here so every layer's math is ONE
-    # device call over all stripes) --------------------------------------
+    # -- single-program fusion ---------------------------------------------
+    #
+    # Every LRC layer is a linear map over GF(2^w), so the whole layered
+    # encode composes into ONE [m, k] generator and each erasure
+    # signature's cascade into ONE [n, n] decode matrix — the layer walk
+    # runs SYMBOLICALLY at plan time (host, tiny matrices) and the data
+    # path is a single xor_mm dispatch, the same shape as plain RS.
+    # The per-layer walk (ErasureCodeLrc.cc:744-776 semantics) stays as
+    # the numpy-backend path and the fusion's oracle.
+
+    def _fusable(self) -> bool:
+        if self.backend != "jax" or not self.layers:
+            return False
+        if self._fusable_cached is None:
+            from .matrix_base import MatrixErasureCode
+            w0 = getattr(self.layers[0].codec, "w", None)
+            self._fusable_cached = all(
+                isinstance(layer.codec, MatrixErasureCode)
+                and layer.codec.w == w0 and layer.codec.backend == "jax"
+                for layer in self.layers)
+        return self._fusable_cached
+
+    def _symbolic_encode_rows(self) -> dict:
+        """physical position -> [k] GF row over the logical data
+        chunks: the layer walk applied to unit vectors."""
+        w = self.layers[0].codec.w
+        k = self.data_chunk_count
+        data_positions = [i for i, c in enumerate(self.mapping)
+                          if c == "D"]
+        R: dict = {}
+        for di, pos in enumerate(data_positions):
+            row = np.zeros(k, dtype=np.int64)
+            row[di] = 1
+            R[pos] = row
+        for layer in self.layers:
+            D = np.stack([R[c] for c in layer.data])
+            P = gf.gf_matmul(np.asarray(layer.codec.coding,
+                                        dtype=np.int64), D, w)
+            for j, c in enumerate(layer.coding):
+                R[c] = P[j]
+        return R
+
+    def _fused_encode_entry(self) -> dict:
+        if self._fused_gen is None:
+            import jax.numpy as jnp
+            w = self.layers[0].codec.w
+            k = self.data_chunk_count
+            m = self.chunk_count - k
+            R = self._symbolic_encode_rows()
+            G = np.stack([R[self.chunk_index(k + j)] for j in range(m)])
+            bm = gf.generator_to_bitmatrix(G, w)
+            self._fused_gen = {"gf": G, "bitmat": bm,
+                               "bitmat_dev": jnp.asarray(bm), "w": w}
+        return self._fused_gen
+
+    def _fused_decode_entry(self, avail_rows: tuple) -> dict:
+        """COMPACT [n, len(avail)] GF matrix whose columns follow
+        avail_rows order (logical rows): the bottom-up cascade run
+        symbolically to its fixpoint, each firing layer one GF
+        composition. Applied directly to the caller's stacked chunks —
+        no scatter pass."""
+        key = tuple(avail_rows)
+        entry = self._fused_dec_cache.get(key)
+        if entry is not None:
+            return entry
+        n = self.chunk_count
+        w = self.layers[0].codec.w
+        S: dict = {}     # physical pos -> [n] GF row over logical rows
+        for r in avail_rows:
+            row = np.zeros(n, dtype=np.int64)
+            row[r] = 1
+            S[self.chunk_index(r)] = row
+        erasures = set(range(n)) - set(S)
+        progress = True
+        while erasures and progress:
+            progress = False
+            for layer in reversed(self.layers):
+                layer_erasures = layer.chunks_as_set & erasures
+                if not layer_erasures:
+                    continue
+                k_l = layer.codec.get_data_chunk_count()
+                inner_avail = tuple(
+                    j for j, c in enumerate(layer.chunks)
+                    if c not in erasures)
+                if len(inner_avail) < k_l or \
+                        len(layer_erasures) > \
+                        layer.codec.get_coding_chunk_count():
+                    continue
+                use = inner_avail[:k_l]
+                try:
+                    full_gf = layer.codec._decode_entry(use)["gf"]
+                except (ErasureCodeError, ValueError):
+                    continue
+                stacked = np.stack([S[layer.chunks[j]] for j in use])
+                full_rows = gf.gf_matmul(
+                    np.asarray(full_gf, dtype=np.int64), stacked, w)
+                for j, c in enumerate(layer.chunks):
+                    if c in erasures:
+                        S[c] = full_rows[j]
+                        erasures.discard(c)
+                        progress = True
+        import jax.numpy as jnp
+        D = np.zeros((n, n), dtype=np.int64)
+        recovered = set()
+        for i in range(n):
+            pos = self.chunk_index(i)
+            if pos in S:
+                D[i] = S[pos]
+                recovered.add(i)
+        Dc = D[:, list(avail_rows)]
+        bm = gf.generator_to_bitmatrix(Dc, w)
+        entry = {"gf": Dc, "bitmat": bm, "bitmat_dev": jnp.asarray(bm),
+                 "recovered": recovered}
+        if len(self._fused_dec_cache) > 1024:
+            self._fused_dec_cache.clear()
+        self._fused_dec_cache[key] = entry
+        return entry
+
+    # -- batch API (fused single-program on the jax backend; per-layer
+    # delegation to the inner codec's device path otherwise) --------------
 
     DECODE_BATCH_ANY = True
 
@@ -340,8 +463,20 @@ class Lrc(ErasureCode):
     def encode_batch(self, data):
         """[B, k, N] (logical data order) -> [B, n-k, N] parity in
         logical parity order (chunk_index(k+j) gives the physical
-        position of output row j). Walks every layer top-down, each
-        layer one batched inner-codec encode."""
+        position of output row j). jax backend: the precomposed [m, k]
+        generator in ONE device dispatch; otherwise walks every layer
+        top-down, each layer one batched inner-codec encode."""
+        if self._fusable():
+            import jax.numpy as jnp
+
+            from ..ops import xor_mm
+            entry = self._fused_encode_entry()
+            out = xor_mm.matrix_encode(entry["bitmat_dev"],
+                                       jnp.asarray(data), entry["w"])
+            return out if _is_jax_arr(data) else np.asarray(out)
+        return self._encode_batch_layers(data)
+
+    def _encode_batch_layers(self, data):
         k = self.data_chunk_count
         data_positions = [i for i, c in enumerate(self.mapping)
                           if c == "D"]
@@ -359,11 +494,42 @@ class Lrc(ErasureCode):
 
     def decode_batch(self, avail_rows: tuple, chunks,
                      want_rows: tuple | None = None):
-        """Batched bottom-up layer walk (decode_chunks): avail_rows is
-        ANY recoverable subset of logical rows (local repairs hand over
-        fewer than k). Each firing layer is one batched inner-codec
-        decode. Rows neither available nor wanted come back as zeros
-        and must not be consumed."""
+        """Batched reconstruction: avail_rows is ANY recoverable subset
+        of logical rows (local repairs hand over fewer than k). jax
+        backend: the cascade precomposed into one [n, n] matrix over
+        the full logical layout, ONE device dispatch per signature.
+        Otherwise a bottom-up layer walk, each firing layer one batched
+        inner-codec decode. Both run the cascade to its fixpoint:
+        every recoverable row comes back filled, unrecoverable+unwanted
+        rows come back zero (and must not be consumed)."""
+        if self._fusable():
+            return self._decode_batch_fused(avail_rows, chunks,
+                                            want_rows)
+        return self._decode_batch_layers(avail_rows, chunks, want_rows)
+
+    def _decode_batch_fused(self, avail_rows: tuple, chunks,
+                            want_rows: tuple | None = None):
+        import jax.numpy as jnp
+
+        from ..ops import xor_mm
+        n = self.chunk_count
+        avail = set(avail_rows)
+        if want_rows is None:
+            want = set(range(n)) - avail
+        else:
+            want = set(want_rows) - avail
+        entry = self._fused_decode_entry(tuple(avail_rows))
+        still = want - entry["recovered"]
+        if still:
+            raise ErasureCodeError(
+                errno.EIO, "unable to read %s" % sorted(still))
+        w = self.layers[0].codec.w
+        out = xor_mm.matrix_encode(entry["bitmat_dev"],
+                                   jnp.asarray(chunks), w)
+        return out if _is_jax_arr(chunks) else np.asarray(out)
+
+    def _decode_batch_layers(self, avail_rows: tuple, chunks,
+                             want_rows: tuple | None = None):
         n = self.chunk_count
         idx_of = {self.chunk_index(i): i for i in range(n)}
         avail_phys = {self.chunk_index(r) for r in avail_rows}
@@ -378,7 +544,10 @@ class Lrc(ErasureCode):
             bufs[self.chunk_index(r)] = chunks[:, row_of[r]]
         erasures = set(range(n)) - set(bufs)
         progress = True
-        while (want_phys & erasures) and progress:
+        # fixpoint, not first-want-satisfied: both backends then return
+        # the same rows filled (every recoverable one), which keeps the
+        # fused path bit-equal to this oracle
+        while erasures and progress:
             progress = False
             for layer in reversed(self.layers):
                 layer_erasures = layer.chunks_as_set & erasures

@@ -75,6 +75,16 @@ class Shec(MatrixErasureCode):
         self.c = 0
         self.single = single
         self._plan_cache: dict = {}
+        self._fused_cache: dict = {}
+        self._fused_bank_state: str | None = None
+        self._fused_bank_index: dict | None = None
+
+    def prepare(self) -> None:
+        super().prepare()
+        self._plan_cache.clear()
+        self._fused_cache.clear()
+        self._fused_bank_state = None
+        self._fused_bank_index = None
 
     # -- profile -----------------------------------------------------------
 
@@ -318,6 +328,122 @@ class Shec(MatrixErasureCode):
                 out[k + i] = parity[i]
         return out
 
+    # -- fused device decode (one program per signature) -------------------
+
+    #: precompute + device-upload the decode bank when the signature
+    #: space (every recoverable erasure set of size <= m) is small
+    FUSED_BANK_LIMIT = 512
+
+    def _fused_decode_matrix(self, want: frozenset,
+                             avail: frozenset) -> np.ndarray:
+        """[n, n] GF matrix D with D @ full_chunks = all chunks, where
+        full_chunks is the n-row layout with erased rows zeroed.
+
+        Runs the recovery machinery SYMBOLICALLY: identity rows for
+        survivors, the plan's inversion rows for solved data columns,
+        and shingle-window parity recomposition as a GF combination of
+        already-composed rows. The whole reconstruction then rides ONE
+        xor_mm dispatch instead of a host loop per plan application
+        (the r3 host path measured 6 MB/s — 5 orders below encode).
+        Rows neither available nor wanted stay zero, matching the
+        decode_batch contract."""
+        k, m = self.k, self.m
+        n = k + m
+        rows, cols, inv = self._plan(want, avail)
+        D = np.zeros((n, n), dtype=np.int64)
+        for r in avail:
+            D[r, r] = 1
+        if inv is not None and rows:
+            for ci, col in enumerate(cols):
+                if col in avail:
+                    continue   # identity row already serves it
+                for j, r in enumerate(rows):
+                    D[col, r] = int(inv[ci, j])
+        # wanted erased parity rows: window recompute composed over the
+        # (identity or solved) data rows
+        for i in range(m):
+            r = k + i
+            if r not in want or r in avail:
+                continue
+            if np.any(D[r]):
+                continue   # the plan already solved it
+            window = [j for j in range(k) if self.coding[i, j]]
+            if any(not np.any(D[j]) and j not in avail for j in window):
+                raise ErasureCodeError(errno.EIO, "window incomplete")
+            D[r] = gf.gf_matmul(self.coding[i:i + 1, :k],
+                                D[:k, :], self.w)[0]
+        return D
+
+    def _ensure_fused_bank(self) -> bool:
+        """Stack every full-reconstruction signature's COMPACT fused
+        decode bitmatrix into one device upload per erasure count (the
+        RS decode bank's analog, matrix_base._ensure_decode_bank): a
+        cache miss costs a traced device-side gather, not a host
+        compose + per-miss H2D. Grouped by erased count because the
+        compact matrix width is len(avail)*w — uniform within a group."""
+        if self._fused_bank_state is None:
+            import math
+            n = self.k + self.m
+            count = sum(math.comb(n, e) for e in range(1, self.m + 1))
+            if self.backend != "jax" or count > self.FUSED_BANK_LIMIT:
+                self._fused_bank_state = "infeasible"
+            else:
+                import jax.numpy as jnp
+                banks: dict = {}
+                for e in range(1, self.m + 1):
+                    idx: dict = {}
+                    gfs, bms = [], []
+                    for erased in itertools.combinations(range(n), e):
+                        want = frozenset(erased)
+                        avail_t = tuple(i for i in range(n)
+                                        if i not in want)
+                        try:
+                            D = self._fused_decode_matrix(
+                                want, frozenset(avail_t))
+                        except ErasureCodeError:
+                            continue   # unrecoverable signature
+                        Dc = D[:, list(avail_t)]
+                        idx[(want, avail_t)] = len(gfs)
+                        gfs.append(Dc)
+                        bms.append(
+                            gf.generator_to_bitmatrix(Dc, self.w))
+                    if gfs:
+                        banks[e] = (idx, gfs, bms,
+                                    jnp.asarray(np.stack(bms)))
+                self._fused_bank_index = banks
+                self._fused_bank_state = "built"
+        return self._fused_bank_state == "built"
+
+    def _fused_entry(self, want: frozenset, avail_rows: tuple) -> dict:
+        """Compact decode entry: [n, len(avail)] GF matrix whose
+        columns follow avail_rows ORDER — applied straight to the
+        caller's stacked chunks, no scatter pass (the eager full-layout
+        scatter measured 1.76 ms vs 0.019 ms for the matmul itself)."""
+        key = (want, avail_rows)
+        entry = self._fused_cache.get(key)
+        if entry is None:
+            from .matrix_base import _bank_pick
+            import jax.numpy as jnp
+            bank = None
+            if self._ensure_fused_bank():
+                bank = self._fused_bank_index.get(len(want))
+            if bank is not None and key in bank[0]:
+                idx, gfs, bms, dev = bank
+                i = idx[key]
+                entry = {"gf": gfs[i], "bitmat": bms[i],
+                         "bitmat_dev": _bank_pick(dev, i)}
+            else:
+                D = self._fused_decode_matrix(want,
+                                              frozenset(avail_rows))
+                Dc = D[:, list(avail_rows)]
+                bm = gf.generator_to_bitmatrix(Dc, self.w)
+                entry = {"gf": Dc, "bitmat": bm,
+                         "bitmat_dev": jnp.asarray(bm)}
+            if len(self._fused_cache) > 4096:
+                self._fused_cache.clear()
+            self._fused_cache[key] = entry
+        return entry
+
     def _apply_plan(self, inv: np.ndarray, stacked: np.ndarray) -> np.ndarray:
         if self.backend == "numpy":
             return np.stack([
@@ -340,7 +466,40 @@ class Shec(MatrixErasureCode):
         actually needs (default: every missing row) — the shingle plan
         only has to cover those, which is what makes sub-k local-repair
         reads work; rows neither available nor wanted come back as
-        zeros and must not be consumed."""
+        zeros and must not be consumed.
+
+        jax backend: ONE device program per signature — the plan's
+        inversion + window recompute precomposed into a [n, n] matrix
+        over the full-n chunk layout (uniform shapes, so every
+        signature shares one compiled program and the enumerable ones
+        ride a device-resident bank). numpy backend keeps the stepwise
+        host path, which doubles as the oracle."""
+        if self.backend == "jax":
+            return self._decode_batch_fused(avail_rows, chunks,
+                                            want_rows)
+        return self._decode_batch_host(avail_rows, chunks, want_rows)
+
+    def _decode_batch_fused(self, avail_rows: tuple, chunks,
+                            want_rows: tuple | None = None):
+        avail_rows = tuple(avail_rows)
+        k, m = self.k, self.m
+        n = k + m
+        avail = frozenset(avail_rows)
+        if want_rows is None:
+            want = frozenset(i for i in range(n) if i not in avail)
+        else:
+            want = frozenset(want_rows) - avail
+        import jax.numpy as jnp
+
+        from ..ops import xor_mm
+        from .matrix_base import _is_jax
+        entry = self._fused_entry(want, avail_rows)  # EIO if unrecov.
+        out = xor_mm.matrix_encode(entry["bitmat_dev"],
+                                   jnp.asarray(chunks), self.w)
+        return out if _is_jax(chunks) else np.asarray(out)
+
+    def _decode_batch_host(self, avail_rows: tuple, chunks: np.ndarray,
+                           want_rows: tuple | None = None) -> np.ndarray:
         k, m = self.k, self.m
         avail = frozenset(avail_rows)
         if want_rows is None:

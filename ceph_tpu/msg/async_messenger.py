@@ -411,7 +411,7 @@ class AsyncConnection(Connection):
                 pending = bool(self._unacked or self.out_q
                                or self._resend)
                 if pending and not self.msgr.policy_lossy \
-                        and self.peer_name is not None:
+                        and self._peer_dialable():
                     self.inbound = False
                     self._resend[0:0] = self._unacked
                     self._unacked.clear()

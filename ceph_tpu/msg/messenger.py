@@ -115,14 +115,15 @@ class Connection:
         self._ctrl_out: list = []     # reader-queued control frames
         # session identity for exactly-once delivery across reconnects
         # (the reference's connect_seq + in_seq exchange,
-        # src/msg/simple/Pipe.cc connect phase): the dialer mints a
-        # nonce per Connection incarnation; the acceptor tracks the
-        # last-delivered link_seq per (peer name, nonce) at the
-        # Messenger level — resent messages whose acks were lost are
-        # acked again but NOT re-dispatched.
+        # src/msg/simple/Pipe.cc connect phase): each Connection mints
+        # a nonce; the dialer's rides the BANNER, the acceptor's rides
+        # the BANNER_ACK, and BOTH sides track the last-delivered
+        # link_seq per peer nonce at the Messenger level — resent
+        # messages whose acks were lost are acked again but NOT
+        # re-dispatched, in either direction.
         self.conn_nonce = os.urandom(8).hex()
-        self._dedup_key = None       # acceptor: (peer_name, nonce)
-        self._in_seq = 0             # acceptor: last delivered link_seq
+        self._dedup_key = None       # the PEER's session nonce
+        self._in_seq = 0             # last delivered link_seq from peer
         self.peer_name = None
         self.auth_info = None        # verified cephx info (entity, caps)
         self.inbound = sock is not None   # accepted vs dialed
@@ -217,6 +218,13 @@ class Connection:
                     self._resend[0:0] = self._unacked
                     self._unacked.clear()
         return True
+
+    def _peer_dialable(self) -> bool:
+        """The peer advertised a REAL listening address we could
+        re-dial (a bind-less client advertises (\"\", 0) — dialing
+        that would spin forever)."""
+        return bool(self.peer_name is not None and self.peer_addr
+                    and self.peer_addr[0] and self.peer_addr[1])
 
     @property
     def _guarded_dialer_now(self) -> bool:
@@ -342,9 +350,16 @@ class Connection:
                 self._unacked.append((seq, msg))
             sock = self.sock
             if sock is None:
+                # same dual-queue purge as the OSError path: the reader's
+                # EOF handler may have already moved the pre-appended
+                # entry into _resend while the message also still sits
+                # at its queue head — leaving both would send it twice
                 with self.lock:
                     self._unacked = [(s, m) for s, m in self._unacked
                                      if s != seq]
+                    if resend is None:
+                        self._resend = [(s, m) for s, m in self._resend
+                                        if s != seq]
                 continue
             try:
                 sock.sendall(frame)
@@ -409,9 +424,9 @@ class Connection:
         # send would park those messages forever (the reference's
         # Pipe::fault requeues immediately for the same reason).
         if not self.closed and not self.msgr.policy_lossy \
-                and (not self.inbound or self.peer_name is not None):
-            # (an accepted conn whose peer never advertised an address
-            # has nowhere to re-dial — leave it parked)
+                and (not self.inbound or self._peer_dialable()):
+            # (an accepted conn whose peer never advertised a real
+            # address has nowhere to re-dial — leave it parked)
             if self.inbound:
                 # from here on this conn DIALS the advertised address:
                 # it must run the dialer side of the handshake (answer
@@ -461,8 +476,8 @@ class Connection:
             # in_seq exchange during the connect phase).
             nonce = msg[4] if len(msg) >= 5 else None
             if nonce is not None:
-                self._dedup_key = (repr(msg[2]), nonce)
-                self._in_seq = self.msgr._delivered_seq(self._dedup_key)
+                self._dedup_key = nonce
+                self._in_seq = self.msgr._delivered_seq(nonce)
             verifier = self.msgr.auth_verifier
             if verifier is not None:
                 authorizer = msg[3] if len(msg) >= 4 else None
@@ -485,11 +500,13 @@ class Connection:
                 self.auth_info = info
                 # mutual auth: prove we could read the ticket; the
                 # third element tells the dialer our last-delivered
-                # in_seq so it can trim already-delivered resends
+                # in_seq so it can trim already-delivered resends, the
+                # fourth is OUR session nonce so the dialer can dedup
+                # our messages if this conn later flips to re-dialing
                 try:
                     send_bytes(_encode(
                         ("BANNER_ACK", info.get("reply_proof"),
-                         self._in_seq)))
+                         self._in_seq, self.conn_nonce)))
                 except OSError:
                     return False
             else:
@@ -498,7 +515,8 @@ class Connection:
                 # decides whether a proof-less ack is acceptable)
                 try:
                     send_bytes(_encode(("BANNER_ACK", None,
-                                        self._in_seq)))
+                                        self._in_seq,
+                                        self.conn_nonce)))
                 except OSError:
                     return False
             self.peer_addr = EntityAddr(*msg[1])
@@ -525,7 +543,7 @@ class Connection:
             except OSError:
                 return False
             return True
-        if (isinstance(msg, tuple) and len(msg) in (2, 3)
+        if (isinstance(msg, tuple) and len(msg) in (2, 3, 4)
                 and msg[0] == "BANNER_ACK"):
             # dialer side: the service proved possession of the
             # session key (cephx mutual auth). The proof bytes are
@@ -543,13 +561,22 @@ class Connection:
             # third element: the acceptor's last-delivered in_seq for
             # our session nonce — everything at or below it was already
             # dispatched there, so drop it from the resend sets
-            if len(msg) == 3 and isinstance(msg[2], int) and msg[2] > 0:
+            if len(msg) >= 3 and isinstance(msg[2], int) and msg[2] > 0:
                 acked = msg[2]
                 with self.lock:
                     self._unacked = [(s, m) for s, m in self._unacked
                                      if s > acked]
                     self._resend = [(s, m) for s, m in self._resend
                                     if s > acked]
+            # fourth element: the acceptor's session nonce — arms OUR
+            # dedup of its messages (so if its conn later flips to
+            # re-dialing us, its resends are recognized). REPLACED on
+            # every ack: each reconnect lands on a NEW peer conn
+            # incarnation with a fresh nonce and restarted seqs, and a
+            # stale watermark would falsely drop its messages.
+            if len(msg) >= 4 and msg[3]:
+                self._dedup_key = msg[3]
+                self._in_seq = self.msgr._delivered_seq(msg[3])
             self.auth_confirmed = True
             self._auth_ready.set()
             return True
@@ -648,16 +675,15 @@ class Messenger:
         self._accept_thread: threading.Thread | None = None
         self._conns: dict = {}       # peer_addr -> Connection (outgoing)
         self._in_conns: list = []
-        # (peer_name, session nonce) -> last delivered link_seq;
-        # survives the per-socket Connection objects so reconnect
-        # resends dedup (the reference keeps in_seq on the long-lived
-        # Connection that successive Pipes attach to). Bounded per
-        # peer name: old sessions' nonces are pruned as new ones
-        # register (a pruned-but-live session degrades to
-        # at-least-once, never to loss).
+        # peer session nonce -> last delivered link_seq; survives the
+        # per-socket Connection objects so reconnect resends dedup
+        # (the reference keeps in_seq on the long-lived Connection that
+        # successive Pipes attach to). Bounded: oldest sessions are
+        # pruned as new ones register (a pruned-but-live session
+        # degrades to at-least-once, never to loss).
         self._delivered: dict = {}
-        self._delivered_order: dict = {}   # peer_name -> [nonce, ...]
-        self.DELIVERED_SESSIONS_PER_PEER = 8
+        self._delivered_order: list = []   # nonces, insertion order
+        self.DELIVERED_SESSIONS_MAX = 1024
         self._lock = threading.Lock()
         self._stopping = False
         self._rng = random.Random()
@@ -741,11 +767,11 @@ class Messenger:
     def _record_delivered(self, key, seq: int) -> None:
         with self._lock:
             if key not in self._delivered:
-                name, nonce = key
-                order = self._delivered_order.setdefault(name, [])
-                order.append(nonce)
-                while len(order) > self.DELIVERED_SESSIONS_PER_PEER:
-                    self._delivered.pop((name, order.pop(0)), None)
+                self._delivered_order.append(key)
+                while len(self._delivered_order) > \
+                        self.DELIVERED_SESSIONS_MAX:
+                    self._delivered.pop(self._delivered_order.pop(0),
+                                        None)
             if seq > self._delivered.get(key, 0):
                 self._delivered[key] = seq
 
