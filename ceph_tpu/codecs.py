@@ -90,7 +90,9 @@ from .crush.map import Bucket, CrushMap, Rule, Tunables  # noqa: E402
 register_dataclass(Tunables, "crush.Tunables")
 register_dataclass(Bucket, "crush.Bucket")
 register_dataclass(Rule, "crush.Rule")
-register_dataclass(CrushMap, "crush.CrushMap")
+# v2 appends choose_args (weight-sets); appended-with-default, so v1
+# decoders skip it and v1 payloads decode with an empty dict (compat 1)
+register_dataclass(CrushMap, "crush.CrushMap", version=2)
 
 # -- osd map ------------------------------------------------------------
 

@@ -44,9 +44,17 @@ class CompiledMap:
     btype: np.ndarray      # [NB] int64
     depth: int             # max descent depth (levels of buckets)
     max_devices: int
+    # choose_args substitution (crush.h crush_choose_arg): hash ids
+    # (position-independent) and positional weight-sets. Per-bucket
+    # position clamping (mapper.c:309-310) is materialized into wsets
+    # at compile time, so runtime only clamps to npos-1 globally.
+    # Without choose_args: ids == items, wsets == weights[:, None].
+    ids: np.ndarray = None     # [NB, S] int64 — values fed to the hash
+    wsets: np.ndarray = None   # [NB, P, S] int64 — weights per position
+    npos: int = 1              # P (max positions across buckets)
 
 
-def compile_map(cmap: CrushMap) -> CompiledMap:
+def compile_map(cmap: CrushMap, choose_args=None) -> CompiledMap:
     nb = cmap.max_buckets
     s = max(b.size for b in cmap.buckets.values())
     items = np.zeros((nb, s), dtype=np.int64)
@@ -63,6 +71,30 @@ def compile_map(cmap: CrushMap) -> CompiledMap:
         weights[idx, :b.size] = b.weights
         size[idx] = b.size
         btype[idx] = b.type
+    ids = items.copy()
+    npos = 1
+    if choose_args:
+        for bid, arg in choose_args.items():
+            if arg and arg.get("weight_set"):
+                npos = max(npos, len(arg["weight_set"]))
+    wsets = np.repeat(weights[:, None, :], npos, axis=1)
+    if choose_args:
+        for bid, arg in choose_args.items():
+            if not arg or bid not in cmap.buckets:
+                continue
+            idx = -1 - bid
+            bsz = cmap.buckets[bid].size
+            if arg.get("ids"):
+                ids[idx, :bsz] = np.asarray(arg["ids"], dtype=np.int64)
+            ws = arg.get("weight_set")
+            if ws:
+                for p, row in enumerate(ws):
+                    wsets[idx, p, :bsz] = np.asarray(row,
+                                                     dtype=np.int64)
+                # positions past the bucket's own count clamp to its
+                # last (mapper.c:309-310)
+                for p in range(len(ws), npos):
+                    wsets[idx, p, :bsz] = wsets[idx, len(ws) - 1, :bsz]
 
     def depth_of(bid, seen=frozenset()):
         if bid not in cmap.buckets:
@@ -77,19 +109,31 @@ def compile_map(cmap: CrushMap) -> CompiledMap:
 
     depth = max(depth_of(bid) for bid in cmap.buckets)
     return CompiledMap(items=items, weights=weights, size=size, btype=btype,
-                       depth=depth, max_devices=cmap.max_devices)
+                       depth=depth, max_devices=cmap.max_devices,
+                       ids=ids, wsets=wsets, npos=npos)
 
 
-def _straw2_choose(cm_items, cm_weights, cm_size, bucket_idx, x, r, xp):
-    """Vectorized bucket_straw2_choose (mapper.c:322-367).
+def _straw2_choose(arrays, bucket_idx, x, r, pos, xp):
+    """Vectorized bucket_straw2_choose (mapper.c:322-367) with
+    choose_args substitution: the hash consumes the (possibly
+    replaced) ids, the draw divides by the position's weight-set row.
 
-    bucket_idx, x, r: [...] int64 arrays -> chosen item [...] int64."""
+    bucket_idx, x, r: [...] int64 arrays -> chosen item [...] int64.
+    pos: int or [...] int64 — the weight-set position (outpos)."""
+    cm_items, cm_ids, cm_wsets, cm_size, _ = arrays
     items = cm_items[bucket_idx]          # [..., S]
-    weights = cm_weights[bucket_idx]      # [..., S]
+    ids = cm_ids[bucket_idx]              # [..., S]
+    npos = cm_wsets.shape[1]
+    if npos == 1:
+        weights = cm_wsets[bucket_idx, 0]
+    else:
+        p_eff = xp.clip(xp.asarray(pos, dtype=xp.int64), 0, npos - 1)
+        p_eff = xp.broadcast_to(p_eff, bucket_idx.shape)
+        weights = cm_wsets[bucket_idx, p_eff]   # [..., S]
     size = cm_size[bucket_idx]            # [...]
     u = hashing.hash32_3(
         x[..., None].astype(xp.uint32),
-        items.astype(xp.uint32),
+        ids.astype(xp.uint32),
         r[..., None].astype(xp.uint32), xp=xp).astype(xp.int64) & 0xFFFF
     lnv = crush_ln(u, xp=xp) - LN_MIN_OFFSET
     draw = straw2_draw_divide(lnv, xp.maximum(weights, 1), xp)
@@ -114,15 +158,20 @@ def _is_out(weight_vec, item, x, max_devices, xp):
     return oob | (~full & (zero | ~probabilistic_in))
 
 
-def _descend(cm: CompiledMap, arrays, root_idx, x, r, target_type, xp):
+def _descend(cm: CompiledMap, arrays, root_idx, x, r, target_type, xp,
+             pos=0):
     """Walk from root until an item of target_type is chosen.
+
+    pos: the weight-set position every straw2 draw in this descent
+    uses (choose_args; the C passes the same outpos down the whole
+    descent, mapper.c:512/722).
 
     Returns (item, ok, permanent): ok False on any failure; permanent True
     for the failures crush_choose_indep turns into CRUSH_ITEM_NONE without
     retrying (bad item id, wrong-type device, dangling bucket ref —
     mapper.c:724-751). Empty buckets and exhausted depth stay retryable
     (the C inner for(;;) just breaks, leaving the slot UNDEF)."""
-    items_a, weights_a, size_a, btype_a = arrays
+    items_a, ids_a, wsets_a, size_a, btype_a = arrays
     nb = items_a.shape[0]
     root = xp.asarray(root_idx, dtype=xp.int64)
     # invalid roots (e.g. -1-item where item was a device) are clipped and
@@ -136,7 +185,7 @@ def _descend(cm: CompiledMap, arrays, root_idx, x, r, target_type, xp):
     for _ in range(cm.depth):
         fail = fail | (~done & (size_a[cur] == 0))  # empty bucket: retryable
         done = done | fail
-        item = _straw2_choose(items_a, weights_a, size_a, cur, x, r, xp)
+        item = _straw2_choose(arrays, cur, x, r, pos, xp)
         is_dev = item >= 0
         bad_dev = is_dev & (item >= cm.max_devices)
         bad_bucket = ~is_dev & ((-1 - item) >= nb)
@@ -164,8 +213,9 @@ def _make_indep(cm: CompiledMap, out_size: int, numrep: int,
     import jax
     import jax.numpy as jnp
 
-    def run(items_a, weights_a, size_a, btype_a, xs, weight_vec, root_idx):
-        arrays = (items_a, weights_a, size_a, btype_a)
+    def run(items_a, ids_a, wsets_a, size_a, btype_a, xs, weight_vec,
+            root_idx):
+        arrays = (items_a, ids_a, wsets_a, size_a, btype_a)
         b = xs.shape[0]
         undef = jnp.int64(CRUSH_ITEM_UNDEF)
         none = jnp.int64(CRUSH_ITEM_NONE)
@@ -182,18 +232,21 @@ def _make_indep(cm: CompiledMap, out_size: int, numrep: int,
             # sequential.
             rr = jnp.broadcast_to((reps + numrep * ftotal)[None, :],
                                   (b, out_size))
+            # top-level indep descends use weight-set position 0 (the
+            # C passes its starting outpos, mapper.c:719-723)
             item, ok0, perm = _descend(cm, arrays, root_idx, xsb, rr,
-                                       target_type, jnp)
+                                       target_type, jnp, pos=0)
             leaf = None
             if chooseleaf:
                 # inner descent (crush_choose_indep recursion with left=1,
                 # outpos=rep; mapper.c:767-786): r = rep + parent_r +
-                # numrep * ftotal_inner
+                # numrep * ftotal_inner; weight-set position = rep
                 leaf = jnp.full((b, out_size), undef)
+                pos_leaf = jnp.broadcast_to(reps[None, :], (b, out_size))
                 for ft2 in range(recurse_tries):
                     r2 = rr + reps[None, :] + numrep * ft2
                     cand, lok, _ = _descend(cm, arrays, -1 - item, xsb, r2,
-                                            0, jnp)
+                                            0, jnp, pos=pos_leaf)
                     lok = lok & ~_is_out(weight_vec, cand, xsb,
                                          cm.max_devices, jnp)
                     take = (leaf == undef) & lok
@@ -252,9 +305,9 @@ def _make_firstn(cm: CompiledMap, result_max: int, numrep: int,
     import jax
     import jax.numpy as jnp
 
-    def run(items_a, weights_a, size_a, btype_a, xs, weight_vec,
+    def run(items_a, ids_a, wsets_a, size_a, btype_a, xs, weight_vec,
             root_idx):
-        arrays = (items_a, weights_a, size_a, btype_a)
+        arrays = (items_a, ids_a, wsets_a, size_a, btype_a)
         b = xs.shape[0]
         none = jnp.int64(CRUSH_ITEM_NONE)
         reps = jnp.arange(numrep, dtype=jnp.int64)
@@ -263,51 +316,77 @@ def _make_firstn(cm: CompiledMap, result_max: int, numrep: int,
         rr = jnp.broadcast_to(reps[None, :, None] + fts[None, None, :],
                               (b, numrep, tries))
         xb = jnp.broadcast_to(xs[:, None, None], (b, numrep, tries))
-        item, ok, perm = _descend(cm, arrays, root_idx, xb, rr,
-                                  target_type, jnp)
-        # perm (bad item id / bad type) => skip_rep: the rep is
-        # abandoned, not retried (mapper.c:514-536); other failures
-        # retry at the next ftotal
+        # firstn's weight-set position is the LIVE outpos at acceptance
+        # time (mapper.c:512), which the precompute can't know — so
+        # candidates are computed per position (npos is small; without
+        # choose_args there is exactly one) and the acceptance scan
+        # selects the outpos'th variant.
+        npos_eff = min(cm.npos, result_max) if cm.npos > 1 else 1
+
+        def cands_at(p):
+            item, ok, perm = _descend(cm, arrays, root_idx, xb, rr,
+                                      target_type, jnp, pos=p)
+            if chooseleaf:
+                # inner recursion: numrep=1 (stable), parent_r = sub_r
+                # (mapper.c:552-575), r_inner = sub_r + ftotal_inner;
+                # the recursion inherits the caller's outpos => same p
+                sub_r = rr if vary_r else jnp.zeros_like(rr)
+                if vary_r > 1:
+                    sub_r = rr >> (vary_r - 1)
+                f2 = jnp.arange(recurse_tries, dtype=jnp.int64)
+                r2 = sub_r[..., None] + f2[None, None, None, :]
+                x2 = jnp.broadcast_to(xb[..., None],
+                                      (b, numrep, tries, recurse_tries))
+                leafcand, lok, lperm = _descend(
+                    cm, arrays, -1 - item[..., None], x2, r2, 0, jnp,
+                    pos=p)
+                lok = lok & ~_is_out(weight_vec, leafcand, x2,
+                                     cm.max_devices, jnp)
+                return item, ok, perm, leafcand, lok, lperm
+            if target_type == 0:
+                okdev = ok & ~_is_out(weight_vec, item, xb,
+                                      cm.max_devices, jnp)
+            else:
+                # bucket-emitting rule: is_out applies to devices only
+                # (mapper.c:581-585 gates on itemtype == 0)
+                okdev = ok
+            return item, ok, perm, okdev
+
+        # stack per-position candidate sets along a trailing axis
+        per_pos = [cands_at(p) for p in range(npos_eff)]
+        stacked = [jnp.stack(parts, axis=-1)
+                   for parts in zip(*per_pos)]
         if chooseleaf:
-            # inner recursion: numrep=1 (stable), parent_r = sub_r
-            # (mapper.c:552-575), r_inner = sub_r + ftotal_inner
-            sub_r = rr if vary_r else jnp.zeros_like(rr)
-            if vary_r > 1:
-                sub_r = rr >> (vary_r - 1)
-            f2 = jnp.arange(recurse_tries, dtype=jnp.int64)
-            r2 = sub_r[..., None] + f2[None, None, None, :]
-            x2 = jnp.broadcast_to(xb[..., None],
-                                  (b, numrep, tries, recurse_tries))
-            leafcand, lok, lperm = _descend(
-                cm, arrays, -1 - item[..., None], x2, r2, 0, jnp)
-            lok = lok & ~_is_out(weight_vec, leafcand, x2,
-                                 cm.max_devices, jnp)
-        elif target_type == 0:
-            okdev = ok & ~_is_out(weight_vec, item, xb,
-                                  cm.max_devices, jnp)
+            item_s, ok_s, perm_s, leafcand_s, lok_s, lperm_s = stacked
         else:
-            # bucket-emitting rule: is_out applies to devices only
-            # (mapper.c:581-585 gates on itemtype == 0)
-            okdev = ok
+            item_s, ok_s, perm_s, okdev_s = stacked
 
         out = jnp.full((b, result_max), none)
         out2 = jnp.full((b, result_max), none)
         outpos = jnp.zeros((b,), dtype=jnp.int64)
         slots = jnp.arange(result_max, dtype=jnp.int64)
 
+        def sel_pos(arr, outpos, extra_dims):
+            """arr [B, ..., P] -> the outpos'th position variant."""
+            if npos_eff == 1:
+                return arr[..., 0]
+            idx = jnp.clip(outpos, 0, npos_eff - 1)
+            idx = idx.reshape((-1,) + (1,) * (extra_dims + 1))
+            return jnp.take_along_axis(arr, idx, axis=-1)[..., 0]
+
         def rep_body(rep, carry):
             out, out2, outpos = carry
-            cand = item[:, rep, :]               # [B, T]
+            cand = sel_pos(item_s[:, rep], outpos, 1)     # [B, T]
             # collision against the accepted prefix (it is fixed for
             # the duration of this rep's scan)
             collide = jnp.any(out[:, None, :] == cand[:, :, None],
                               axis=-1)           # [B, T]
             if chooseleaf:
-                lc = leafcand[:, rep, :, :]      # [B, T, T2]
+                lc = sel_pos(leafcand_s[:, rep], outpos, 2)  # [B,T,T2]
                 lcollide = jnp.any(
                     out2[:, None, None, :] == lc[..., None], axis=-1)
-                lacc = lok[:, rep, :, :] & ~lcollide
-                lbad = lperm[:, rep, :, :]
+                lacc = sel_pos(lok_s[:, rep], outpos, 2) & ~lcollide
+                lbad = sel_pos(lperm_s[:, rep], outpos, 2)
                 first_lacc = jnp.argmax(lacc, axis=-1)
                 any_lacc = jnp.any(lacc, axis=-1)
                 first_lbad = jnp.where(
@@ -317,10 +396,12 @@ def _make_firstn(cm: CompiledMap, result_max: int, numrep: int,
                 leaf_found = any_lacc & (first_lacc < first_lbad)
                 leaf_pick = jnp.take_along_axis(
                     lc, first_lacc[..., None], axis=-1)[..., 0]
-                acceptable = ok[:, rep, :] & ~collide & leaf_found
+                acceptable = sel_pos(ok_s[:, rep], outpos, 1) \
+                    & ~collide & leaf_found
             else:
-                acceptable = okdev[:, rep, :] & ~collide
-            bad = perm[:, rep, :]
+                acceptable = sel_pos(okdev_s[:, rep], outpos, 1) \
+                    & ~collide
+            bad = sel_pos(perm_s[:, rep], outpos, 1)
             first_acc = jnp.argmax(acceptable, axis=-1)
             any_acc = jnp.any(acceptable, axis=-1)
             first_bad = jnp.where(jnp.any(bad, axis=-1),
@@ -353,7 +434,8 @@ _KERNEL_CACHE: dict = {}
 
 def _indep_kernel(cm: CompiledMap, out_size, numrep, target_type, chooseleaf,
                   tries, recurse_tries):
-    key = ("indep", cm.items.tobytes(), cm.weights.tobytes(),
+    key = ("indep", cm.items.tobytes(), cm.ids.tobytes(),
+           cm.wsets.tobytes(), cm.npos,
            cm.size.tobytes(), cm.btype.tobytes(), cm.depth, cm.max_devices,
            out_size, numrep, target_type, chooseleaf, tries, recurse_tries)
     kernel = _KERNEL_CACHE.get(key)
@@ -368,7 +450,8 @@ def _indep_kernel(cm: CompiledMap, out_size, numrep, target_type, chooseleaf,
 
 def _firstn_kernel(cm: CompiledMap, result_max, numrep, target_type,
                    chooseleaf, tries, recurse_tries, vary_r):
-    key = ("firstn", cm.items.tobytes(), cm.weights.tobytes(),
+    key = ("firstn", cm.items.tobytes(), cm.ids.tobytes(),
+           cm.wsets.tobytes(), cm.npos,
            cm.size.tobytes(), cm.btype.tobytes(), cm.depth, cm.max_devices,
            result_max, numrep, target_type, chooseleaf, tries,
            recurse_tries, vary_r)
@@ -408,12 +491,16 @@ def _rule_shape(cmap: CrushMap, ruleno: int):
 
 
 def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
-                    weight=None, xs_sharding=None):
+                    weight=None, xs_sharding=None, choose_args=None):
     """Map a whole batch of inputs in one device program.
 
     xs: [B] int array of crush inputs (pg seeds). Returns [B, result_max]
     int64 (CRUSH_ITEM_NONE marks holes). Falls back to the scalar
     interpreter when the rule/map is outside the fast path.
+
+    choose_args: weight-set/ids substitution — an arg map dict
+    (bucket_id -> {"ids", "weight_set"}) or an int selecting one of
+    cmap.choose_args' sets (with default fallback).
 
     xs_sharding: optional jax sharding for the seed batch — a
     NamedSharding over a device mesh partitions the whole mapping sweep
@@ -425,12 +512,15 @@ def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
 
     shape = _rule_shape(cmap, ruleno)
     xs = np.asarray(xs)
+    if isinstance(choose_args, int):
+        choose_args = cmap.choose_args_get_with_fallback(choose_args)
 
     def scalar_fallback():
         from .mapper_ref import crush_do_rule
         out = np.full((len(xs), result_max), CRUSH_ITEM_NONE, dtype=np.int64)
         for i, x in enumerate(xs):
-            res = crush_do_rule(cmap, ruleno, int(x), result_max, weight)
+            res = crush_do_rule(cmap, ruleno, int(x), result_max, weight,
+                                choose_args=choose_args)
             out[i, :len(res)] = res
         return out
 
@@ -451,7 +541,7 @@ def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
         return scalar_fallback()
 
     try:
-        cm = compile_map(cmap)
+        cm = compile_map(cmap, choose_args)
     except ValueError:
         # malformed map (dangling refs, cycles): scalar interpreter
         # degrades per-slot instead of failing the whole sweep
@@ -487,7 +577,8 @@ def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
         xs_dev = jnp.asarray(xs, dtype=jnp.int64)
         if xs_sharding is not None:
             xs_dev = jax.device_put(xs_dev, xs_sharding)
-        out = kernel(jnp.asarray(cm.items), jnp.asarray(cm.weights),
+        out = kernel(jnp.asarray(cm.items), jnp.asarray(cm.ids),
+                     jnp.asarray(cm.wsets),
                      jnp.asarray(cm.size), jnp.asarray(cm.btype),
                      xs_dev,
                      jnp.asarray(weight, dtype=jnp.int64),

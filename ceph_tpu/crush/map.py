@@ -96,6 +96,11 @@ class Tunables:
     chooseleaf_stable: int = 1
 
 
+#: choose_args set id the mapping falls back to when a pool-specific
+#: set is absent (CrushWrapper::DEFAULT_CHOOSE_ARGS)
+DEFAULT_CHOOSE_ARGS = -1
+
+
 @dataclass
 class CrushMap:
     buckets: dict = field(default_factory=dict)      # id -> Bucket
@@ -104,6 +109,10 @@ class CrushMap:
     bucket_names: dict = field(default_factory=dict)  # name -> id
     type_names: dict = field(default_factory=dict)    # name -> type id
     device_classes: dict = field(default_factory=dict)  # device id -> class
+    # choose_args sets (crush.h:273-292 crush_choose_arg_map; the
+    # Luminous balancer's weight-set mechanism): set id -> {bucket_id
+    # -> {"ids": [int]|None, "weight_set": [[w 16.16]*size]*positions}}
+    choose_args: dict = field(default_factory=dict)
 
     @property
     def max_devices(self) -> int:
@@ -133,6 +142,79 @@ class CrushMap:
         if name is not None:
             self.bucket_names[name] = id
         return id
+
+    def choose_args_get_with_fallback(self, index) -> dict | None:
+        """CrushWrapper::choose_args_get_with_fallback — pool set if
+        present, else the default set, else no substitution."""
+        args = self.choose_args.get(index)
+        if args is None:
+            args = self.choose_args.get(DEFAULT_CHOOSE_ARGS)
+        return args
+
+    def create_choose_args(self, index: int, positions: int = 1) -> dict:
+        """CrushWrapper::create_choose_args: weight-sets seeded from
+        every straw2 bucket's base weights — the balancer then adjusts
+        copies without touching the base weights."""
+        args = self.choose_args.setdefault(index, {})
+        for bid, b in self.buckets.items():
+            if b.alg != "straw2" or bid in args:
+                continue
+            args[bid] = {"ids": None,
+                         "weight_set": [[int(w) for w in b.weights]
+                                        for _ in range(positions)]}
+        return args
+
+    def _parent_of(self, child_id: int) -> int | None:
+        for bid, b in self.buckets.items():
+            if child_id in [int(i) for i in b.items]:
+                return bid
+        return None
+
+    def choose_args_adjust_item_weight(self, index: int, bucket_id: int,
+                                       item: int, weights) -> None:
+        """Set item's weight in the bucket's weight-set and propagate
+        the bucket's new per-position totals into every ancestor's
+        weight-set (CrushWrapper::choose_args_adjust_item_weightf
+        walks the parents the same way): the balancer's write path.
+
+        weights: an int applies to EVERY position; a list sets one
+        weight per position (growing the weight-set as needed)."""
+        args = self.choose_args.setdefault(index, {})
+
+        def entry(bid, npos):
+            arg = args.setdefault(bid, {"ids": None,
+                                        "weight_set": None})
+            b = self.buckets[bid]
+            if arg["weight_set"] is None:
+                arg["weight_set"] = [[int(w) for w in b.weights]
+                                     for _ in range(npos)]
+            while len(arg["weight_set"]) < npos:
+                arg["weight_set"].append(list(arg["weight_set"][-1]))
+            return arg
+
+        b = self.buckets[bucket_id]
+        pos = list(b.items).index(item)
+        if isinstance(weights, int):
+            npos = len((args.get(bucket_id) or {}).get("weight_set")
+                       or [0])
+            weights = [weights] * max(npos, 1)
+        arg = entry(bucket_id, len(weights))
+        for p, w in enumerate(weights):
+            arg["weight_set"][p][pos] = int(w)
+        # ancestors: the adjusted bucket's per-position totals replace
+        # its weight in each parent's weight-set, recursively
+        child = bucket_id
+        while True:
+            parent = self._parent_of(child)
+            if parent is None:
+                break
+            totals = [sum(row) for row in args[child]["weight_set"]]
+            parg = entry(parent, len(totals))
+            cpos = [int(i) for i in self.buckets[parent].items
+                    ].index(child)
+            for p, t in enumerate(totals):
+                parg["weight_set"][p][cpos] = int(t)
+            child = parent
 
     def add_rule(self, rule: Rule) -> int:
         self.rules.append(rule)

@@ -99,14 +99,39 @@ def _bucket_list_choose(bucket, x, r):
     return int(bucket.items[0])
 
 
-def _bucket_straw2_choose(bucket, x, r):
+def _choose_arg_weights(bucket, arg, position):
+    """mapper.c:302-311 get_choose_arg_weights: positional weight-set
+    substitution (the Luminous balancer's mechanism) — N past the end
+    clamps to the last position."""
+    if arg is None:
+        return bucket.weights
+    ws = arg.get("weight_set")
+    if not ws:
+        return bucket.weights
+    if position >= len(ws):
+        position = len(ws) - 1
+    return ws[position]
+
+
+def _choose_arg_ids(bucket, arg):
+    # mapper.c:314-320: ids replace the item values fed to the HASH
+    # only; the returned item still comes from bucket.items
+    if arg is None:
+        return bucket.items
+    ids = arg.get("ids")
+    return ids if ids else bucket.items
+
+
+def _bucket_straw2_choose(bucket, x, r, arg=None, position=0):
     # mapper.c:322-367
+    weights = _choose_arg_weights(bucket, arg, position)
+    ids = _choose_arg_ids(bucket, arg)
     high = 0
     high_draw = 0
     for i in range(bucket.size):
-        wt = int(bucket.weights[i])
+        wt = int(weights[i])
         if wt:
-            u = _h3(x, int(bucket.items[i]), r) & 0xFFFF
+            u = _h3(x, int(ids[i]), r) & 0xFFFF
             lnv = int(crush_ln(np.int64(u))) - LN_MIN_OFFSET
             draw = int(straw2_draw_divide(lnv, wt))
         else:
@@ -117,7 +142,7 @@ def _bucket_straw2_choose(bucket, x, r):
     return int(bucket.items[high])
 
 
-def _bucket_choose(bucket, work, x, r):
+def _bucket_choose(bucket, work, x, r, arg=None, position=0):
     if bucket.size == 0:
         raise AssertionError("empty bucket")
     if bucket.alg == "uniform":
@@ -125,7 +150,8 @@ def _bucket_choose(bucket, work, x, r):
     if bucket.alg == "list":
         return _bucket_list_choose(bucket, x, r)
     if bucket.alg == "straw2":
-        return _bucket_straw2_choose(bucket, x, r)
+        # only straw2 honors choose_args (mapper.c:374-396)
+        return _bucket_straw2_choose(bucket, x, r, arg, position)
     raise NotImplementedError("bucket alg %r" % bucket.alg)
 
 
@@ -144,7 +170,7 @@ def _is_out(cmap, weight, item, x):
 def _choose_firstn(cmap, work, bucket, weight, x, numrep, type, out, outpos,
                    out_size, tries, recurse_tries, local_retries,
                    local_fallback_retries, recurse_to_leaf, vary_r, stable,
-                   out2, parent_r, max_devices=None):
+                   out2, parent_r, max_devices=None, choose_args=None):
     if max_devices is None:
         max_devices = cmap.max_devices
     # mapper.c:443-631 (control flow mirrors the do/while + goto structure)
@@ -170,7 +196,12 @@ def _choose_firstn(cmap, work, bucket, weight, x, numrep, type, out, outpos,
                             and flocal > local_fallback_retries):
                         item = _bucket_perm_choose(in_bucket, work, x, r)
                     else:
-                        item = _bucket_choose(in_bucket, work, x, r)
+                        # choose_args keyed by bucket id; position is
+                        # the CURRENT output slot (mapper.c:512)
+                        item = _bucket_choose(
+                            in_bucket, work, x, r,
+                            choose_args.get(in_bucket.id)
+                            if choose_args else None, outpos)
                     if item >= max_devices:
                         skip_rep = True
                         break
@@ -199,7 +230,7 @@ def _choose_firstn(cmap, work, bucket, weight, x, numrep, type, out, outpos,
                                     recurse_tries, 0, local_retries,
                                     local_fallback_retries, False, vary_r,
                                     stable, None, sub_r,
-                                    max_devices) <= outpos:
+                                    max_devices, choose_args) <= outpos:
                                 reject = True
                         else:
                             out2[outpos] = item
@@ -233,7 +264,7 @@ def _choose_firstn(cmap, work, bucket, weight, x, numrep, type, out, outpos,
 
 def _choose_indep(cmap, work, bucket, weight, x, left, numrep, type, out,
                   outpos, tries, recurse_tries, recurse_to_leaf, out2,
-                  parent_r, max_devices=None):
+                  parent_r, max_devices=None, choose_args=None):
     if max_devices is None:
         max_devices = cmap.max_devices
     # mapper.c:638-826
@@ -256,7 +287,12 @@ def _choose_indep(cmap, work, bucket, weight, x, left, numrep, type, out,
                     r += numrep * ftotal
                 if in_bucket.size == 0:
                     break
-                item = _bucket_choose(in_bucket, work, x, r)
+                # indep passes its STARTING outpos as the weight-set
+                # position, not rep (mapper.c:719-723)
+                item = _bucket_choose(
+                    in_bucket, work, x, r,
+                    choose_args.get(in_bucket.id) if choose_args
+                    else None, outpos)
                 if item >= max_devices or (item < 0
                                            and item not in cmap.buckets):
                     out[rep] = CRUSH_ITEM_NONE
@@ -286,7 +322,7 @@ def _choose_indep(cmap, work, bucket, weight, x, left, numrep, type, out,
                         _choose_indep(cmap, work, cmap.buckets[item], weight,
                                       x, 1, numrep, 0, out2, rep,
                                       recurse_tries, 0, False, None, r,
-                                      max_devices)
+                                      max_devices, choose_args)
                         if out2[rep] == CRUSH_ITEM_NONE:
                             break
                     else:
@@ -305,12 +341,36 @@ def _choose_indep(cmap, work, bucket, weight, x, left, numrep, type, out,
 
 
 def crush_do_rule(cmap: CrushMap, ruleno: int, x: int, result_max: int,
-                  weight=None) -> list[int]:
+                  weight=None, choose_args=None) -> list[int]:
     """Run rule ruleno for input x; returns the result vector.
 
-    weight: per-device reweight vector (16.16), defaults to all-in."""
+    weight: per-device reweight vector (16.16), defaults to all-in.
+    choose_args: {bucket_id: {"ids": [...]|None,
+    "weight_set": [[w,...] per position]|None}} — straw2 weight/id
+    substitution (crush.h crush_choose_arg_map; the balancer's
+    mechanism). Pass an int to select one of cmap.choose_args' sets."""
     if ruleno < 0 or ruleno >= len(cmap.rules):
         return []
+    if isinstance(choose_args, int):
+        choose_args = cmap.choose_args_get_with_fallback(choose_args)
+    if choose_args:
+        # validate sizes up front (the reference validates at decode):
+        # a short row would otherwise IndexError mid-draw
+        for bid, arg in choose_args.items():
+            if not arg or bid not in cmap.buckets:
+                continue
+            size = cmap.buckets[bid].size
+            ids = arg.get("ids")
+            if ids and len(ids) != size:
+                raise ValueError(
+                    "choose_args ids for bucket %d: %d entries, "
+                    "bucket has %d items" % (bid, len(ids), size))
+            for row in arg.get("weight_set") or []:
+                if len(row) != size:
+                    raise ValueError(
+                        "choose_args weight_set row for bucket %d: %d "
+                        "weights, bucket has %d items"
+                        % (bid, len(row), size))
     if weight is None:
         weight = [0x10000] * cmap.max_devices
     rule = cmap.rules[ruleno]
@@ -387,7 +447,8 @@ def crush_do_rule(cmap: CrushMap, ruleno: int, x: int, result_max: int,
                         sub_o, 0, result_max - osize, choose_tries,
                         recurse_tries, choose_local_retries,
                         choose_local_fallback_retries, recurse_to_leaf,
-                        vary_r, stable, sub_c, 0, max_devices)
+                        vary_r, stable, sub_c, 0, max_devices,
+                        choose_args)
                     o.extend(sub_o[:n])
                     c.extend(sub_c[:n])
                 else:
@@ -398,7 +459,8 @@ def crush_do_rule(cmap: CrushMap, ruleno: int, x: int, result_max: int,
                         cmap, work, bucket, weight, x, out_size, numrep,
                         type_arg, sub_o, 0, choose_tries,
                         choose_leaf_tries if choose_leaf_tries else 1,
-                        recurse_to_leaf, sub_c, 0, max_devices)
+                        recurse_to_leaf, sub_c, 0, max_devices,
+                        choose_args)
                     o.extend(sub_o)
                     c.extend(sub_c)
             w = c if recurse_to_leaf else o

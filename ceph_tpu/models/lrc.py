@@ -56,9 +56,10 @@ class Lrc(ErasureCode):
         self.chunk_count = 0
         self.data_chunk_count = 0
         self.rule_steps: list = [("chooseleaf", "host", 0)]
+        from .table_cache import TableCache
         self._fusable_cached: bool | None = None
         self._fused_gen: dict | None = None
-        self._fused_dec_cache: dict = {}
+        self._fused_dec_cache = TableCache()   # bounded LRU, locked
 
     # -- init --------------------------------------------------------------
 
@@ -88,6 +89,11 @@ class Lrc(ErasureCode):
                     errno.EINVAL,
                     "layer %r must be %d characters long"
                     % (layer.chunks_map, self.chunk_count))
+        # re-init with a new profile must drop the fused state (Shec's
+        # prepare() override guards the same path)
+        self._fusable_cached = None
+        self._fused_gen = None
+        self._fused_dec_cache.clear()
         # kml-generated parameters are not echoed back
         # (ErasureCodeLrc.cc init :547-553)
         if profile.get("l") and profile["l"] != self.DEFAULT_KML:
@@ -442,10 +448,7 @@ class Lrc(ErasureCode):
         bm = gf.generator_to_bitmatrix(Dc, w)
         entry = {"gf": Dc, "bitmat": bm, "bitmat_dev": jnp.asarray(bm),
                  "recovered": recovered}
-        if len(self._fused_dec_cache) > 1024:
-            self._fused_dec_cache.clear()
-        self._fused_dec_cache[key] = entry
-        return entry
+        return self._fused_dec_cache.put(key, entry)
 
     # -- batch API (fused single-program on the jax backend; per-layer
     # delegation to the inner codec's device path otherwise) --------------

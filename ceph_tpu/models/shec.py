@@ -72,10 +72,11 @@ class Shec(MatrixErasureCode):
 
     def __init__(self, backend: str = "jax", single: bool = False):
         super().__init__(backend)
+        from .table_cache import TableCache
         self.c = 0
         self.single = single
         self._plan_cache: dict = {}
-        self._fused_cache: dict = {}
+        self._fused_cache = TableCache()   # bounded LRU, thread-safe
         self._fused_bank_state: str | None = None
         self._fused_bank_index: dict | None = None
 
@@ -439,9 +440,7 @@ class Shec(MatrixErasureCode):
                 bm = gf.generator_to_bitmatrix(Dc, self.w)
                 entry = {"gf": Dc, "bitmat": bm,
                          "bitmat_dev": jnp.asarray(bm)}
-            if len(self._fused_cache) > 4096:
-                self._fused_cache.clear()
-            self._fused_cache[key] = entry
+            entry = self._fused_cache.put(key, entry)
         return entry
 
     def _apply_plan(self, inv: np.ndarray, stacked: np.ndarray) -> np.ndarray:

@@ -153,6 +153,10 @@ def _configure_symbols(L: ctypes.CDLL) -> None:
         ctypes.POINTER(ctypes.c_uint), ctypes.c_int,
         ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+    L.ec_crush_map_set_choose_args.restype = ctypes.c_int
+    L.ec_crush_map_set_choose_args.argtypes = [
+        ctypes.c_void_p, LL2, ctypes.c_int, LL2, LL2, LL2, LL2, LL2]
+    L.ec_crush_map_clear_choose_args.argtypes = [ctypes.c_void_p]
 
 
 # ---------------------------------------------------------------------------
@@ -272,8 +276,64 @@ def _flatten_map(cmap, L):
     return flat
 
 
+def _apply_choose_args(L, handle, cmap, choose_args) -> None:
+    """Install (or clear) a choose_args set on the C-side map handle.
+    Skipped when the handle already carries the same set (content crc),
+    so repeated bulk calls don't re-upload."""
+    import zlib
+
+    import numpy as np
+    if isinstance(choose_args, int):
+        choose_args = cmap.choose_args_get_with_fallback(choose_args)
+    if not choose_args:
+        if getattr(handle, "_cargs_crc", None) is not None:
+            L.ec_crush_map_clear_choose_args(handle.ptr)
+            handle._cargs_crc = None
+        return
+    crc = zlib.crc32(repr(sorted(
+        (bid, (arg or {}).get("ids"), (arg or {}).get("weight_set"))
+        for bid, arg in choose_args.items())).encode())
+    if getattr(handle, "_cargs_crc", None) == crc:
+        return
+    bids, ids_flat, ids_offs = [], [], [0]
+    ws_flat, ws_offs, ws_pos = [], [0], []
+    for bid in sorted(choose_args):
+        arg = choose_args[bid] or {}
+        if bid not in cmap.buckets:
+            continue
+        bids.append(bid)
+        ids = arg.get("ids")
+        if ids:
+            ids_flat.extend(int(i) for i in ids)
+        ids_offs.append(len(ids_flat))
+        ws = arg.get("weight_set")
+        if ws:
+            for row in ws:
+                ws_flat.extend(int(w) for w in row)
+            ws_pos.append(len(ws))
+        else:
+            ws_pos.append(0)
+        ws_offs.append(len(ws_flat))
+    LLp = ctypes.POINTER(ctypes.c_longlong)
+
+    def arr(v):
+        return np.asarray(v if v else [0], dtype=np.int64)
+
+    rc = L.ec_crush_map_set_choose_args(
+        handle.ptr,
+        arr(bids).ctypes.data_as(LLp), len(bids),
+        arr(ids_flat).ctypes.data_as(LLp),
+        arr(ids_offs).ctypes.data_as(LLp),
+        arr(ws_flat).ctypes.data_as(LLp),
+        arr(ws_offs).ctypes.data_as(LLp),
+        arr(ws_pos).ctypes.data_as(LLp))
+    if rc != 0:
+        raise NativeUnavailable("native crush rejected choose_args")
+    handle._cargs_crc = crc
+
+
 def crush_do_rule_native(cmap, ruleno: int, x: int, result_max: int,
-                         weight=None) -> list[int]:
+                         weight=None, choose_args=None) -> list[int]:
     """Run a CrushMap rule through the native mapper; same contract as
     ceph_tpu.crush.mapper_ref.crush_do_rule (bit-identical results).
     Raises NativeUnavailable for bucket algs/steps the native side
@@ -283,6 +343,7 @@ def crush_do_rule_native(cmap, ruleno: int, x: int, result_max: int,
     if ruleno < 0 or ruleno >= len(cmap.rules):
         return []
     flat = _flatten_map(cmap, L)
+    _apply_choose_args(L, flat["handle"], cmap, choose_args)
     a_steps = flat["rule_steps"][ruleno]
     if weight is None:
         weight = [0x10000] * cmap.max_devices
@@ -307,7 +368,7 @@ def crush_do_rule_native(cmap, ruleno: int, x: int, result_max: int,
 
 
 def crush_do_rule_batch_native(cmap, ruleno: int, xs, result_max: int,
-                               weight=None):
+                               weight=None, choose_args=None):
     """Bulk native mapping: all of `xs` in ONE C call (the
     ParallelPGMapper use case on the host side). Returns a list of
     per-x result lists, each bit-identical to crush_do_rule."""
@@ -316,6 +377,7 @@ def crush_do_rule_batch_native(cmap, ruleno: int, xs, result_max: int,
     if ruleno < 0 or ruleno >= len(cmap.rules):
         return [[] for _ in xs]
     flat = _flatten_map(cmap, L)
+    _apply_choose_args(L, flat["handle"], cmap, choose_args)
     a_steps = flat["rule_steps"][ruleno]
     if weight is None:
         weight = [0x10000] * cmap.max_devices

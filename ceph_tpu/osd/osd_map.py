@@ -262,8 +262,13 @@ class OSDMap:
         ruleno = pool.crush_rule
         osds: list[int] = []
         if 0 <= ruleno < len(self.crush.rules):
+            # pool id selects the choose_args set, falling back to the
+            # default set (OSDMap.cc passes the pool id as the
+            # choose_args index; the balancer writes per-pool or
+            # default weight-sets)
             osds = crush_do_rule(self.crush, ruleno, pps, pool.size,
-                                 self._weight_vector())
+                                 self._weight_vector(),
+                                 choose_args=pgid.pool)
         self._remove_nonexistent_osds(pool, osds)
         return osds, pps
 
@@ -465,7 +470,8 @@ class OSDMapMapping:
                                  dtype=np.int64)
                 mat = batched_do_rule(osdmap.crush, pool.crush_rule,
                                       seeds, pool.size,
-                                      osdmap._weight_vector())
+                                      osdmap._weight_vector(),
+                                      choose_args=pool_id)
                 raws = [[int(v) for v in row[:pool.size]] for row in mat]
             for i, pgid in enumerate(pgids):
                 if raws is not None:

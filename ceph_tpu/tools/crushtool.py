@@ -101,6 +101,8 @@ def compile_text(text: str) -> CrushMap:
             i += 1
         elif head == "rule":
             i = _parse_rule(m, lines, i)
+        elif head == "choose_args":
+            i = _parse_choose_args(m, lines, i)
         elif len(tok) == 3 and tok[2] == "{" and tok[0] in m.type_names:
             i = _parse_bucket(m, lines, i)
         else:
@@ -188,6 +190,80 @@ def _parse_rule(m: CrushMap, lines: list[str], i: int) -> int:
         raise CompileError("unterminated rule %r" % name)
     m.add_rule(Rule(steps=steps, name=name, type=rtype,
                     min_size=min_size, max_size=max_size))
+    return i + 1
+
+
+def _collect_bracketed(lines: list[str], i: int,
+                       toks: list[str]) -> tuple[list[str], int]:
+    """Accumulate logical lines until [ ] brackets balance (the
+    reference decompiles weight_set with one row per line)."""
+    while toks.count("[") != toks.count("]"):
+        i += 1
+        if i >= len(lines):
+            raise CompileError("unbalanced brackets in choose_args")
+        toks += lines[i].split()
+    return toks, i
+
+
+def _parse_choose_args(m: CrushMap, lines: list[str], i: int) -> int:
+    """choose_args <id> { { bucket_id <id> [weight_set [...]]
+    [ids [...]] } ... } — CrushCompiler::parse_choose_args grammar."""
+    tok = lines[i].split()
+    if len(tok) != 3 or tok[2] != "{":
+        raise CompileError("bad choose_args line: %r" % lines[i])
+    idx = int(tok[1])
+    args: dict = {}
+    i += 1
+    while i < len(lines) and lines[i] != "}":
+        if lines[i] != "{":
+            raise CompileError("expected '{' in choose_args, got %r"
+                               % lines[i])
+        i += 1
+        bid = None
+        ids = None
+        ws = None
+        while i < len(lines) and lines[i] != "}":
+            t = lines[i].split()
+            if t[0] == "bucket_id":
+                bid = int(t[1])
+            elif t[0] == "weight_set":
+                toks, i = _collect_bracketed(lines, i, t[1:])
+                ws = []
+                row = None
+                depth = 0
+                for tk in toks:
+                    if tk == "[":
+                        depth += 1
+                        if depth == 2:
+                            row = []
+                    elif tk == "]":
+                        if depth == 2:
+                            ws.append(row)
+                            row = None
+                        depth -= 1
+                    elif depth == 2:
+                        # %.6f text: |err| < 1e-6 * 0x10000 < 0.5, so
+                        # round() recovers the 16.16 value exactly
+                        row.append(int(round(float(tk) * 0x10000)))
+                    else:
+                        raise CompileError("bad weight_set token %r"
+                                           % tk)
+            elif t[0] == "ids":
+                toks, i = _collect_bracketed(lines, i, t[1:])
+                ids = [int(tk) for tk in toks if tk not in ("[", "]")]
+            else:
+                raise CompileError("bad choose_args entry line: %r"
+                                   % lines[i])
+            i += 1
+        if i >= len(lines):
+            raise CompileError("unterminated choose_args block")
+        i += 1  # inner '}'
+        if bid is None:
+            raise CompileError("choose_args entry missing bucket_id")
+        args[bid] = {"ids": ids, "weight_set": ws}
+    if i >= len(lines):
+        raise CompileError("unterminated choose_args")
+    m.choose_args[idx] = args
     return i + 1
 
 
@@ -292,6 +368,27 @@ def decompile(m: CrushMap) -> str:
             else:
                 raise CompileError("cannot decompile step %r" % (step,))
         out.append("}")
+    if m.choose_args:
+        out += ["", "# choose_args"]
+        for idx in sorted(m.choose_args):
+            out.append("choose_args %d {" % idx)
+            for bid in sorted(m.choose_args[idx]):
+                arg = m.choose_args[idx][bid] or {}
+                out.append("  {")
+                out.append("    bucket_id %d" % bid)
+                ws = arg.get("weight_set")
+                if ws:
+                    rows = " ".join(
+                        "[ %s ]" % " ".join("%.6f" % (w / 0x10000)
+                                            for w in row)
+                        for row in ws)
+                    out.append("    weight_set [ %s ]" % rows)
+                ids = arg.get("ids")
+                if ids:
+                    out.append("    ids [ %s ]"
+                               % " ".join(str(i) for i in ids))
+                out.append("  }")
+            out.append("}")
     out.append("")
     out.append("# end crush map")
     return "\n".join(out) + "\n"
@@ -317,6 +414,9 @@ def map_to_json(m: CrushMap) -> dict:
              "max_size": r.max_size,
              "steps": [list(s) for s in r.steps]}
             for r in m.rules],
+        "choose_args": {
+            str(idx): {str(bid): arg for bid, arg in args.items()}
+            for idx, args in m.choose_args.items()},
     }
 
 
@@ -334,6 +434,11 @@ def map_from_json(doc: dict) -> CrushMap:
         m.add_rule(Rule(steps=[tuple(s) for s in r["steps"]],
                         name=r["name"], type=r["type"],
                         min_size=r["min_size"], max_size=r["max_size"]))
+    for idx, args in doc.get("choose_args", {}).items():
+        m.choose_args[int(idx)] = {
+            int(bid): {"ids": arg.get("ids"),
+                       "weight_set": arg.get("weight_set")}
+            for bid, arg in args.items()}
     return m
 
 

@@ -42,6 +42,18 @@ Map* crush_map_build(
     int num_buckets,
     const int64_t* items, const int64_t* weights);
 void crush_map_free(Map* map);
+// choose_args (reference crush.h crush_choose_arg_map: the balancer's
+// weight-set / ids substitution, applied to straw2 draws). Stored on
+// the map; subsequent do_rule calls use it. For each of nargs buckets:
+// ids_offsets/ws_offsets index flat arrays (ids range empty = no ids
+// substitution); ws_positions[i] position rows of the bucket's size.
+// Returns 0, or -1 on malformed input (unknown bucket, size mismatch).
+int crush_map_set_choose_args(
+    Map* map, const int64_t* arg_bucket_ids, int nargs,
+    const int64_t* ids_flat, const int64_t* ids_offsets,
+    const int64_t* ws_flat, const int64_t* ws_offsets,
+    const int64_t* ws_positions);
+void crush_map_clear_choose_args(Map* map);
 int crush_do_rule_map(
     const Map& map,
     const int64_t* steps, int num_steps,

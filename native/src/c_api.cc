@@ -200,6 +200,25 @@ void ec_crush_map_destroy(void* map) {
   ectpu::crush_map_free((ectpu::Map*)map);
 }
 
+int ec_crush_map_set_choose_args(void* map,
+                                 const long long* arg_bucket_ids,
+                                 int nargs,
+                                 const long long* ids_flat,
+                                 const long long* ids_offsets,
+                                 const long long* ws_flat,
+                                 const long long* ws_offsets,
+                                 const long long* ws_positions) {
+  return ectpu::crush_map_set_choose_args(
+      (ectpu::Map*)map, (const int64_t*)arg_bucket_ids, nargs,
+      (const int64_t*)ids_flat, (const int64_t*)ids_offsets,
+      (const int64_t*)ws_flat, (const int64_t*)ws_offsets,
+      (const int64_t*)ws_positions);
+}
+
+void ec_crush_map_clear_choose_args(void* map) {
+  ectpu::crush_map_clear_choose_args((ectpu::Map*)map);
+}
+
 int ec_crush_do_rule_map(void* map, const long long* steps, int num_steps,
                          long long x, int result_max,
                          const unsigned* weight, int weight_len,

@@ -118,9 +118,18 @@ struct Bucket {
   size_t size() const { return items.size(); }
 };
 
+// choose_args substitution for one straw2 bucket (reference crush.h
+// crush_choose_arg): ids replace the values fed to the hash; wsets
+// replace the draw weights per output position (clamped to the last).
+struct ChooseArg {
+  std::vector<int64_t> ids;                 // empty = no substitution
+  std::vector<std::vector<int64_t>> wsets;  // [positions][size]
+};
+
 struct Map {
   std::unordered_map<int64_t, const Bucket*> by_id;
   std::vector<Bucket> buckets;
+  std::unordered_map<int64_t, ChooseArg> cargs;
   int64_t max_devices = 0;
 };
 
@@ -184,14 +193,26 @@ static int64_t bucket_list_choose(const Bucket& b, int64_t x, int64_t r) {
   return b.items[0];
 }
 
-static int64_t bucket_straw2_choose(const Bucket& b, int64_t x, int64_t r) {
+static int64_t bucket_straw2_choose(const Bucket& b, int64_t x, int64_t r,
+                                    const ChooseArg* arg, int position) {
+  // choose_args substitution (reference mapper.c:302-341)
+  const int64_t* weights = b.weights.data();
+  const int64_t* ids = b.items.data();
+  if (arg) {
+    if (!arg->wsets.empty()) {
+      size_t p = (size_t)position;
+      if (p >= arg->wsets.size()) p = arg->wsets.size() - 1;
+      weights = arg->wsets[p].data();
+    }
+    if (!arg->ids.empty()) ids = arg->ids.data();
+  }
   size_t high = 0;
   int64_t high_draw = 0;
   for (size_t i = 0; i < b.size(); ++i) {
-    int64_t wt = b.weights[i];
+    int64_t wt = weights[i];
     int64_t draw;
     if (wt) {
-      uint32_t u = crush_hash32_3((uint32_t)x, (uint32_t)b.items[i],
+      uint32_t u = crush_hash32_3((uint32_t)x, (uint32_t)ids[i],
                                   (uint32_t)r) & 0xFFFF;
       int64_t lnv = crush_ln(u) - kLnMinOffset;
       // div64_s64 truncation toward zero: lnv <= 0, wt > 0
@@ -208,11 +229,14 @@ static int64_t bucket_straw2_choose(const Bucket& b, int64_t x, int64_t r) {
 }
 
 static int64_t bucket_choose(const Bucket& b, Work& work, int64_t x,
-                             int64_t r) {
+                             int64_t r, const ChooseArg* arg,
+                             int position) {
   switch (b.alg) {
     case CRUSH_ALG_UNIFORM: return bucket_perm_choose(b, work, x, r);
     case CRUSH_ALG_LIST:    return bucket_list_choose(b, x, r);
-    case CRUSH_ALG_STRAW2:  return bucket_straw2_choose(b, x, r);
+    case CRUSH_ALG_STRAW2:
+      // only straw2 honors choose_args (mapper.c:374-396)
+      return bucket_straw2_choose(b, x, r, arg, position);
     default:                return kItemNone;
   }
 }
@@ -234,6 +258,11 @@ struct Params {
   const uint32_t* weight;
   int weight_len;
   int64_t max_devices;
+
+  const ChooseArg* arg_for(int64_t bucket_id) const {
+    auto it = map->cargs.find(bucket_id);
+    return it == map->cargs.end() ? nullptr : &it->second;
+  }
 };
 
 static int choose_firstn(const Params& P, Work& work, const Bucket& bucket,
@@ -266,7 +295,9 @@ static int choose_firstn(const Params& P, Work& work, const Bucket& bucket,
               flocal > local_fallback_retries) {
             item = bucket_perm_choose(*in_bucket, work, x, r);
           } else {
-            item = bucket_choose(*in_bucket, work, x, r);
+            // position = the CURRENT output slot (mapper.c:512)
+            item = bucket_choose(*in_bucket, work, x, r,
+                                 P.arg_for(in_bucket->id), outpos);
           }
           if (item >= P.max_devices) { skip_rep = true; break; }
           auto it = P.map->by_id.find(item);
@@ -357,7 +388,9 @@ static void choose_indep(const Params& P, Work& work, const Bucket& bucket,
           r += (int64_t)numrep * ftotal;
         }
         if (in_bucket->size() == 0) break;
-        int64_t item = bucket_choose(*in_bucket, work, x, r);
+        // indep passes its STARTING outpos (mapper.c:719-723)
+        int64_t item = bucket_choose(*in_bucket, work, x, r,
+                                     P.arg_for(in_bucket->id), outpos);
         auto it = item < 0 ? P.map->by_id.find(item)
                            : P.map->by_id.end();
         if (item >= P.max_devices ||
@@ -444,6 +477,44 @@ Map* crush_map_build(
 }
 
 void crush_map_free(Map* map) { delete map; }
+
+int crush_map_set_choose_args(
+    Map* map, const int64_t* arg_bucket_ids, int nargs,
+    const int64_t* ids_flat, const int64_t* ids_offsets,
+    const int64_t* ws_flat, const int64_t* ws_offsets,
+    const int64_t* ws_positions) {
+  if (!map) return -1;
+  std::unordered_map<int64_t, ChooseArg> cargs;
+  for (int i = 0; i < nargs; ++i) {
+    int64_t bid = arg_bucket_ids[i];
+    auto it = map->by_id.find(bid);
+    if (it == map->by_id.end()) return -1;
+    size_t bsize = it->second->size();
+    ChooseArg arg;
+    int64_t ib = ids_offsets[i], ie = ids_offsets[i + 1];
+    if (ie > ib) {
+      if ((size_t)(ie - ib) != bsize) return -1;
+      arg.ids.assign(ids_flat + ib, ids_flat + ie);
+    }
+    int64_t wb = ws_offsets[i], we = ws_offsets[i + 1];
+    int64_t positions = ws_positions[i];
+    if (we > wb) {
+      if (positions <= 0 ||
+          (size_t)(we - wb) != (size_t)positions * bsize) return -1;
+      for (int64_t p = 0; p < positions; ++p) {
+        arg.wsets.emplace_back(ws_flat + wb + p * bsize,
+                               ws_flat + wb + (p + 1) * bsize);
+      }
+    }
+    cargs.emplace(bid, std::move(arg));
+  }
+  map->cargs = std::move(cargs);
+  return 0;
+}
+
+void crush_map_clear_choose_args(Map* map) {
+  if (map) map->cargs.clear();
+}
 
 int crush_do_rule_map(
     const Map& map,

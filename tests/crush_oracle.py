@@ -84,3 +84,55 @@ def oracle_map_run(lib, leaf_alg, num_hosts, devs_per_host, dev_weights,
         tun.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
         res.ctypes.data_as(ctypes.POINTER(ctypes.c_int)), result_max)
     return list(res[:n]) if n >= 0 else None
+
+
+def _arm_cargs(lib):
+    import ctypes
+    if getattr(lib, "_cargs_armed", False):
+        return
+    lib.oracle_map_run_cargs.restype = ctypes.c_int
+    lib.oracle_map_run_cargs.argtypes = [
+        ctypes.c_int,                      # leaf_alg
+        ctypes.c_int, ctypes.c_int,        # num_hosts, devs_per_host
+        ctypes.POINTER(ctypes.c_uint),     # dev_weights
+        ctypes.c_int,                      # flat
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,  # rule_op, type, numrep
+        ctypes.c_int,                      # x
+        ctypes.POINTER(ctypes.c_uint), ctypes.c_int,  # reweight, len
+        ctypes.POINTER(ctypes.c_int),      # tunables[6]
+        ctypes.c_int,                      # positions
+        ctypes.POINTER(ctypes.c_int),      # cargs_mask
+        ctypes.POINTER(ctypes.c_uint),     # ws_flat
+        ctypes.POINTER(ctypes.c_int),      # ids_flat
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int,   # result, result_max
+    ]
+    lib._cargs_armed = True
+
+
+def oracle_map_run_cargs(lib, leaf_alg, num_hosts, devs_per_host,
+                         dev_weights, flat, rule_op, choose_type, numrep,
+                         x, reweight, tunables, result_max,
+                         positions, cargs_mask, ws_flat, ids_flat):
+    import ctypes
+
+    import numpy as np
+    _arm_cargs(lib)
+    dw = np.asarray(dev_weights, dtype=np.uint32)
+    rw = np.asarray(reweight, dtype=np.uint32)
+    tun = np.asarray(tunables, dtype=np.int32)
+    mask = np.asarray(cargs_mask, dtype=np.int32)
+    ws = np.asarray(ws_flat if len(ws_flat) else [0], dtype=np.uint32)
+    ids = np.asarray(ids_flat if len(ids_flat) else [0], dtype=np.int32)
+    res = np.zeros(result_max, dtype=np.int32)
+    n = lib.oracle_map_run_cargs(
+        leaf_alg, num_hosts, devs_per_host,
+        dw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint)),
+        flat, rule_op, choose_type, numrep, x,
+        rw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint)), len(rw),
+        tun.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        positions,
+        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        ws.ctypes.data_as(ctypes.POINTER(ctypes.c_uint)),
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        res.ctypes.data_as(ctypes.POINTER(ctypes.c_int)), result_max)
+    return list(res[:n]) if n >= 0 else None

@@ -126,3 +126,130 @@ out:
     crush_destroy(map);
     return ret;
 }
+
+/* Same topologies as oracle_map_run2, plus crush_choose_arg substitution
+ * (weight-sets / ids — the Luminous balancer mechanism).
+ *
+ * Bucket indexing: index 0 is the root (id -1), index 1+h is host h
+ * (id -2-h); flat maps have only index 0.
+ * cargs_mask[b]: bit0 = weight_set present, bit1 = ids present.
+ * ws_flat: concatenated, for each bucket WITH bit0 in index order,
+ *          positions * bucket_size weights (position-major).
+ * ids_flat: concatenated, for each bucket WITH bit1, bucket_size ids.
+ */
+int oracle_map_run_cargs(int leaf_alg,
+                         int num_hosts, int devs_per_host,
+                         unsigned *dev_weights, int flat,
+                         int rule_op, int choose_type, int numrep,
+                         int x,
+                         unsigned *reweight, int reweight_len,
+                         int *tun,
+                         int positions, int *cargs_mask,
+                         unsigned *ws_flat, int *ids_flat,
+                         int *result, int result_max)
+{
+    struct crush_map *map = crush_create();
+    if (!map) return -1;
+    map->choose_total_tries = tun[0];
+    map->choose_local_tries = tun[1];
+    map->choose_local_fallback_tries = tun[2];
+    map->chooseleaf_descend_once = tun[3];
+    map->chooseleaf_vary_r = tun[4];
+    map->chooseleaf_stable = tun[5];
+
+    int ndev = num_hosts * devs_per_host;
+    int nbuckets = flat ? 1 : 1 + num_hosts;
+    int ret = -1;
+    struct crush_choose_arg *args = NULL;
+    struct crush_weight_set *wsets = NULL;
+
+    if (flat) {
+        int *items = malloc(sizeof(int) * ndev);
+        int *weights = malloc(sizeof(int) * ndev);
+        for (int i = 0; i < ndev; i++) { items[i] = i; weights[i] = (int)dev_weights[i]; }
+        struct crush_bucket *b =
+            crush_make_bucket(map, leaf_alg, CRUSH_HASH_RJENKINS1, 1, ndev, items, weights);
+        free(items); free(weights);
+        if (!b) goto out;
+        int id;
+        if (crush_add_bucket(map, -1, b, &id) < 0) goto out;
+    } else {
+        int *host_ids = malloc(sizeof(int) * num_hosts);
+        int *host_weights = malloc(sizeof(int) * num_hosts);
+        for (int h = 0; h < num_hosts; h++) {
+            int *items = malloc(sizeof(int) * devs_per_host);
+            int *weights = malloc(sizeof(int) * devs_per_host);
+            unsigned sum = 0;
+            for (int i = 0; i < devs_per_host; i++) {
+                items[i] = h * devs_per_host + i;
+                weights[i] = (int)dev_weights[h * devs_per_host + i];
+                sum += dev_weights[h * devs_per_host + i];
+            }
+            struct crush_bucket *b =
+                crush_make_bucket(map, leaf_alg, CRUSH_HASH_RJENKINS1, 1,
+                                  devs_per_host, items, weights);
+            free(items); free(weights);
+            if (!b) goto out;
+            int id;
+            if (crush_add_bucket(map, -2 - h, b, &id) < 0) goto out;
+            host_ids[h] = id;
+            host_weights[h] = (int)sum;
+        }
+        struct crush_bucket *root =
+            crush_make_bucket(map, CRUSH_BUCKET_STRAW2, CRUSH_HASH_RJENKINS1, 2,
+                              num_hosts, host_ids, host_weights);
+        if (!root) goto out;
+        int id;
+        if (crush_add_bucket(map, -1, root, &id) < 0) goto out;
+        free(host_ids); free(host_weights);
+    }
+
+    {
+        struct crush_rule *rule = crush_make_rule(3, 0, 1, 1, result_max);
+        if (!rule) goto out;
+        crush_rule_set_step(rule, 0, CRUSH_RULE_TAKE, -1, 0);
+        crush_rule_set_step(rule, 1, rule_op, numrep, choose_type);
+        crush_rule_set_step(rule, 2, CRUSH_RULE_EMIT, 0, 0);
+        if (crush_add_rule(map, rule, 0) < 0) goto out;
+    }
+
+    crush_finalize(map);
+
+    /* build choose_args (size must equal max_buckets) */
+    args = calloc(map->max_buckets, sizeof(struct crush_choose_arg));
+    wsets = calloc(nbuckets * positions, sizeof(struct crush_weight_set));
+    {
+        unsigned *wp = ws_flat;
+        int *ip = ids_flat;
+        for (int b = 0; b < nbuckets; b++) {
+            int size = (b == 0) ? (flat ? ndev : num_hosts) : devs_per_host;
+            if (cargs_mask[b] & 1) {
+                for (int p = 0; p < positions; p++) {
+                    wsets[b * positions + p].weights = wp;
+                    wsets[b * positions + p].size = size;
+                    wp += size;
+                }
+                args[b].weight_set = &wsets[b * positions];
+                args[b].weight_set_size = positions;
+            }
+            if (cargs_mask[b] & 2) {
+                args[b].ids = ip;
+                args[b].ids_size = size;
+                ip += size;
+            }
+        }
+    }
+
+    {
+        size_t wsize = crush_work_size(map, result_max);
+        char *cwin = malloc(wsize + 3 * result_max * sizeof(int));
+        crush_init_workspace(map, cwin);
+        ret = crush_do_rule(map, 0, x, result, result_max,
+                            reweight, reweight_len, cwin, args);
+        free(cwin);
+    }
+out:
+    free(args); free(wsets);
+    crush_destroy(map);
+    return ret;
+}

@@ -434,3 +434,233 @@ def test_batched_firstn_bucket_target_ignores_device_reweight():
         ref = mapper_ref.crush_do_rule(m, 0, x, 2, list(reweight))
         mine = [int(v) for v in got[x] if v != CRUSH_ITEM_NONE]
         assert mine == ref, (x, mine, ref)
+
+
+# -- choose_args (weight-sets / ids) differential tests ------------------
+
+@pytest.mark.parametrize("op,steps_op,positions,with_ids", [
+    (OP_CHOOSE_INDEP, cmap_mod.RULE_CHOOSE_INDEP, 1, False),
+    (OP_CHOOSE_INDEP, cmap_mod.RULE_CHOOSE_INDEP, 3, True),
+    (OP_CHOOSE_FIRSTN, cmap_mod.RULE_CHOOSE_FIRSTN, 1, True),
+    (OP_CHOOSE_FIRSTN, cmap_mod.RULE_CHOOSE_FIRSTN, 3, False),
+])
+def test_choose_args_flat_vs_oracle(op, steps_op, positions, with_ids):
+    """Weight-set + ids substitution in a flat straw2 bucket must be
+    bit-equal to the reference's bucket_straw2_choose with
+    crush_choose_arg (mapper.c:302-341, 459-512)."""
+    lib = lib_or_skip()
+    rng = np.random.default_rng(21)
+    ndev = 10
+    weights = rng.integers(1, 4 * 0x10000, size=ndev, dtype=np.uint32)
+    reweight = np.full(ndev, 0x10000, dtype=np.uint32)
+    reweight[2] = 0x8000
+    m = make_flat(ndev, weights)
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1), (steps_op, 3, 0),
+                           (cmap_mod.RULE_EMIT,)]))
+    ws = rng.integers(0, 5 * 0x10000, size=(positions, ndev),
+                      dtype=np.uint32)
+    ws[:, 0] = 0x10000  # keep at least one nonzero weight everywhere
+    ids = (rng.permutation(ndev).astype(np.int32) + 100) if with_ids \
+        else None
+    cargs = {-1: {"weight_set": [[int(w) for w in row] for row in ws],
+                  "ids": [int(i) for i in ids] if ids is not None
+                  else None}}
+    mask = [1 | (2 if with_ids else 0)]
+    ws_flat = ws.reshape(-1)
+    ids_flat = ids if ids is not None else np.zeros(0, dtype=np.int32)
+    for x in range(80):
+        ref = crush_oracle.oracle_map_run_cargs(
+            lib, ALG_STRAW2, 1, ndev, weights, 1, op, 0, 3, x,
+            reweight, crush_tunables(m), 3,
+            positions, mask, ws_flat, ids_flat)
+        mine = mapper_ref.crush_do_rule(m, 0, x, 3, list(reweight),
+                                        choose_args=cargs)
+        assert mine == ref, (x, mine, ref)
+
+
+@pytest.mark.parametrize("op,steps_op", [
+    (OP_CHOOSELEAF_INDEP, cmap_mod.RULE_CHOOSELEAF_INDEP),
+    (OP_CHOOSELEAF_FIRSTN, cmap_mod.RULE_CHOOSELEAF_FIRSTN),
+])
+def test_choose_args_two_level_chooseleaf_vs_oracle(op, steps_op):
+    """Weight-sets on BOTH the root and the host buckets through a
+    chooseleaf descent (positions > 1 exercises the per-outpos weight
+    selection and its clamp)."""
+    lib = lib_or_skip()
+    rng = np.random.default_rng(22)
+    hosts, per, positions = 5, 4, 2
+    ndev = hosts * per
+    weights = rng.integers(1, 3 * 0x10000, size=ndev, dtype=np.uint32)
+    reweight = np.full(ndev, 0x10000, dtype=np.uint32)
+    reweight[7] = 0
+    m = make_two_level(hosts, per, weights)
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1), (steps_op, 4, 1),
+                           (cmap_mod.RULE_EMIT,)]))
+    # root weight-set (over hosts) + per-host weight-sets (over devs)
+    root_ws = rng.integers(0x8000, 4 * 0x10000, size=(positions, hosts),
+                           dtype=np.uint32)
+    host_ws = [rng.integers(0x4000, 3 * 0x10000, size=(positions, per),
+                            dtype=np.uint32) for _ in range(hosts)]
+    cargs = {-1: {"weight_set": [[int(w) for w in row]
+                                 for row in root_ws], "ids": None}}
+    for h in range(hosts):
+        cargs[-2 - h] = {"weight_set": [[int(w) for w in row]
+                                        for row in host_ws[h]],
+                         "ids": None}
+    mask = [1] * (1 + hosts)
+    ws_flat = np.concatenate([root_ws.reshape(-1)]
+                             + [hw.reshape(-1) for hw in host_ws])
+    ids_flat = np.zeros(0, dtype=np.int32)
+    for x in range(50):
+        ref = crush_oracle.oracle_map_run_cargs(
+            lib, ALG_STRAW2, hosts, per, weights, 0, op, 1, 4, x,
+            reweight, crush_tunables(m), 4,
+            positions, mask, ws_flat, ids_flat)
+        mine = mapper_ref.crush_do_rule(m, 0, x, 4, list(reweight),
+                                        choose_args=cargs)
+        assert mine == ref, (x, mine, ref)
+
+
+def test_choose_args_balancer_remap_without_base_weights():
+    """The balancer contract: adjusting a weight-set copy remaps PGs
+    while the bucket's base weights are untouched, and dropping the
+    weight-set restores the original mapping (CrushWrapper
+    create_choose_args / choose_args_adjust_item_weight roles)."""
+    rng = np.random.default_rng(23)
+    ndev = 8
+    weights = rng.integers(0x10000, 3 * 0x10000, size=ndev,
+                           dtype=np.uint32)
+    m = make_flat(ndev, weights)
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1),
+                           (cmap_mod.RULE_CHOOSE_FIRSTN, 3, 0),
+                           (cmap_mod.RULE_EMIT,)]))
+    base_weights = m.buckets[-1].weights.copy()
+    before = [mapper_ref.crush_do_rule(m, 0, x, 3) for x in range(100)]
+    m.create_choose_args(cmap_mod.DEFAULT_CHOOSE_ARGS, positions=1)
+    # nudge one overloaded device down hard in the weight-set copy
+    m.choose_args_adjust_item_weight(cmap_mod.DEFAULT_CHOOSE_ARGS,
+                                     -1, 0, 0x1000)
+    after = [mapper_ref.crush_do_rule(
+        m, 0, x, 3, choose_args=cmap_mod.DEFAULT_CHOOSE_ARGS)
+        for x in range(100)]
+    assert np.array_equal(m.buckets[-1].weights, base_weights)
+    assert before != after          # the weight-set change remapped
+    moved = sum(1 for b, a in zip(before, after) if b != a)
+    assert moved > 0
+    # osd 0 loses load under the new weight-set
+    cnt_before = sum(r.count(0) for r in before)
+    cnt_after = sum(r.count(0) for r in after)
+    assert cnt_after < cnt_before
+    # dropping the set restores the base mapping
+    m.choose_args.clear()
+    restored = [mapper_ref.crush_do_rule(
+        m, 0, x, 3, choose_args=cmap_mod.DEFAULT_CHOOSE_ARGS)
+        for x in range(100)]
+    assert restored == before
+
+
+@pytest.mark.parametrize("steps_op,positions,with_ids", [
+    (cmap_mod.RULE_CHOOSE_INDEP, 1, True),
+    (cmap_mod.RULE_CHOOSE_FIRSTN, 1, False),
+    (cmap_mod.RULE_CHOOSE_FIRSTN, 3, True),
+    (cmap_mod.RULE_CHOOSELEAF_INDEP, 2, False),
+    (cmap_mod.RULE_CHOOSELEAF_FIRSTN, 2, False),
+])
+def test_batched_choose_args_matches_scalar(steps_op, positions,
+                                            with_ids):
+    """The device kernels' choose_args path (hash-id substitution,
+    per-position weight-set tensor, live-outpos selection in firstn)
+    must be bit-equal to the scalar interpreter — which is itself
+    oracle-verified above."""
+    rng = np.random.default_rng(31)
+    chooseleaf = steps_op in (cmap_mod.RULE_CHOOSELEAF_INDEP,
+                              cmap_mod.RULE_CHOOSELEAF_FIRSTN)
+    if chooseleaf:
+        hosts, per = 5, 3
+        ndev = hosts * per
+        weights = rng.integers(0x8000, 3 * 0x10000, size=ndev,
+                               dtype=np.uint32)
+        m = make_two_level(hosts, per, weights)
+        buckets = [-1] + [-2 - h for h in range(hosts)]
+        ctype = 1
+    else:
+        ndev = 9
+        weights = rng.integers(0x8000, 3 * 0x10000, size=ndev,
+                               dtype=np.uint32)
+        m = make_flat(ndev, weights)
+        buckets = [-1]
+        ctype = 0
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1),
+                           (steps_op, 3, ctype),
+                           (cmap_mod.RULE_EMIT,)]))
+    cargs = {}
+    for bid in buckets:
+        bsz = m.buckets[bid].size
+        ws = rng.integers(0x2000, 4 * 0x10000, size=(positions, bsz))
+        ids = ([int(i) + 50 for i in
+                rng.permutation(bsz)] if with_ids and bid == -1
+               else None)
+        cargs[bid] = {"weight_set": [[int(w) for w in row]
+                                     for row in ws], "ids": ids}
+    reweight = np.full(ndev, 0x10000, dtype=np.int64)
+    reweight[1] = 0x9000
+    xs = np.arange(120)
+    got = batched.batched_do_rule(m, 0, xs, 3, list(reweight),
+                                  choose_args=cargs)
+    for i, x in enumerate(xs):
+        ref = mapper_ref.crush_do_rule(m, 0, int(x), 3, list(reweight),
+                                       choose_args=cargs)
+        mine = [v for v in got[i] if v != CRUSH_ITEM_NONE] \
+            if steps_op in (cmap_mod.RULE_CHOOSE_FIRSTN,
+                            cmap_mod.RULE_CHOOSELEAF_FIRSTN) else list(got[i])
+        if steps_op in (cmap_mod.RULE_CHOOSE_INDEP,
+                        cmap_mod.RULE_CHOOSELEAF_INDEP):
+            ref = ref + [CRUSH_ITEM_NONE] * (3 - len(ref))
+        assert mine == ref, (x, list(got[i]), ref)
+
+
+def test_choose_args_adjust_propagates_to_ancestors():
+    """choose_args_adjust_item_weight writes every position and
+    propagates the bucket's per-position totals into ancestor
+    weight-sets (CrushWrapper::choose_args_adjust_item_weightf walks
+    the parents) — draining a device must shed load at the ROOT draw
+    too, not just inside its host."""
+    rng = np.random.default_rng(61)
+    hosts, per = 3, 2
+    weights = np.full(hosts * per, 0x10000, dtype=np.uint32)
+    m = make_two_level(hosts, per, weights)
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1),
+                           (cmap_mod.RULE_CHOOSELEAF_FIRSTN, 2, 1),
+                           (cmap_mod.RULE_EMIT,)]))
+    m.create_choose_args(0, positions=2)
+    m.choose_args_adjust_item_weight(0, -2, 0, 0)   # drain osd.0
+    arg_host = m.choose_args[0][-2]
+    assert all(row[0] == 0 for row in arg_host["weight_set"])   # all positions
+    arg_root = m.choose_args[0][-1]
+    # host0's total dropped to per-1 devices' worth in the root's set
+    assert all(row[0] == 0x10000 for row in arg_root["weight_set"])
+    assert all(row[1] == 2 * 0x10000 for row in arg_root["weight_set"])
+
+
+def test_choose_args_bad_sizes_rejected():
+    rng = np.random.default_rng(62)
+    m = make_flat(4, np.full(4, 0x10000, dtype=np.uint32))
+    m.add_rule(Rule(steps=[(cmap_mod.RULE_TAKE, -1),
+                           (cmap_mod.RULE_CHOOSE_FIRSTN, 2, 0),
+                           (cmap_mod.RULE_EMIT,)]))
+    with pytest.raises(ValueError):
+        mapper_ref.crush_do_rule(m, 0, 1, 2, choose_args={
+            -1: {"ids": None, "weight_set": [[1, 2]]}})
+    with pytest.raises(ValueError):
+        mapper_ref.crush_do_rule(m, 0, 1, 2, choose_args={
+            -1: {"ids": [9, 9], "weight_set": None}})
+    # None entries are legal everywhere
+    assert mapper_ref.crush_do_rule(m, 0, 1, 2,
+                                    choose_args={-1: None})
+    from ceph_tpu import native
+    try:
+        native.lib()
+    except Exception:
+        pytest.skip("native lib unavailable")
+    assert native.crush_do_rule_native(m, 0, 1, 2,
+                                       choose_args={-1: None})

@@ -244,3 +244,72 @@ class TestOsdMapTool:
             [str(mapfile), "--mark-down", "3", "-o", str(mapfile)]) == 0
         assert osdmaptool.main([str(mapfile), "--test-map-pgs"]) == 0
         assert "total 32 pgs" in capsys.readouterr().out
+
+
+class TestChooseArgsTooling:
+    def test_crushtool_text_roundtrip_choose_args(self):
+        """compile -> decompile -> compile keeps choose_args exact
+        (weight-set %.6f text recovers 16.16 under round)."""
+        from ceph_tpu.tools import crushtool
+        from .test_crush import make_two_level
+        import numpy as np
+        rng = np.random.default_rng(51)
+        m = make_two_level(3, 2, rng.integers(
+            0x10000, 3 * 0x10000, size=6, dtype=np.uint32))
+        m.bucket_names.update({"host%d" % h: -2 - h for h in range(3)})
+        m.choose_args[0] = {
+            -1: {"ids": [11, 12, 13],
+                 "weight_set": [[0x18000, 0x10000, 0x2ABCD],
+                                [0x10000, 0x20000, 0x00001]]},
+            -2: {"ids": None, "weight_set": [[0x8000, 0x1777]]},
+        }
+        text = crushtool.decompile(m)
+        assert "choose_args 0 {" in text
+        m2 = crushtool.compile_text(text)
+        assert m2.choose_args == m.choose_args
+        # JSON container carries it too
+        doc = crushtool.map_to_json(m)
+        m3 = crushtool.map_from_json(doc)
+        assert m3.choose_args == m.choose_args
+
+    def test_choose_args_rides_the_wire_codec(self):
+        from ceph_tpu import codecs  # noqa: F401 — arms the registry
+        from ceph_tpu import encoding
+        from .test_crush import make_flat
+        import numpy as np
+        m = make_flat(4, np.full(4, 0x10000, dtype=np.uint32))
+        m.choose_args[-1] = {-1: {"ids": None,
+                                  "weight_set": [[1, 2, 3, 4]]}}
+        blob = encoding.encode_any(m)
+        m2 = encoding.decode_any(blob)
+        assert m2.choose_args == m.choose_args
+
+    def test_osdmap_pool_choose_args_index(self):
+        """OSDMap mapping selects the pool's choose_args set with
+        default fallback — a default weight-set remaps a pool's PGs
+        without touching base weights (the balancer flow end-to-end
+        through OSDMap)."""
+        import numpy as np
+        from ceph_tpu.crush import map as cmap_mod
+        from ceph_tpu.osd.osd_map import PGID
+        from .test_osd_map import build_map
+        m = build_map(num_hosts=3, osds_per_host=2)
+        pool_id = next(iter(m.pools))
+        pool = m.pools[pool_id]
+        before = {ps: m.pg_to_up_acting_osds(PGID(pool_id, ps))[0]
+                  for ps in range(pool.pg_num)}
+        base = {bid: b.weights.copy()
+                for bid, b in m.crush.buckets.items()}
+        m.crush.create_choose_args(cmap_mod.DEFAULT_CHOOSE_ARGS)
+        # zero out osd.0 in the weight-set of whichever bucket holds it
+        for bid, b in m.crush.buckets.items():
+            if 0 in list(b.items):
+                m.crush.choose_args_adjust_item_weight(
+                    cmap_mod.DEFAULT_CHOOSE_ARGS, bid, 0, 0)
+        after = {ps: m.pg_to_up_acting_osds(PGID(pool_id, ps))[0]
+                 for ps in range(pool.pg_num)}
+        for bid, b in m.crush.buckets.items():
+            assert np.array_equal(b.weights, base[bid])
+        assert any(0 in v for v in before.values())
+        assert not any(0 in v for v in after.values())
+        assert before != after

@@ -182,3 +182,55 @@ class TestRuleParity:
             ref = crush_do_rule(m, 0, x, 3)
             nat = native.crush_do_rule_native(m, 0, x, 3)
             assert ref == nat == [int(v) for v in jax_res[x][:len(ref)]]
+
+
+class TestNativeChooseArgs:
+    def test_choose_args_matches_scalar(self):
+        """Native weight-set/ids substitution vs the (oracle-verified)
+        scalar interpreter, including set switching and clearing on a
+        cached map handle."""
+        from ceph_tpu.crush import map as cmap_mod
+        rng = np.random.default_rng(41)
+        hosts, per = 4, 3
+        ndev = hosts * per
+        weights = rng.integers(0x8000, 3 * 0x10000, size=ndev,
+                               dtype=np.uint32)
+        m = make_two_level(hosts, per, weights)
+        m.add_rule(Rule(steps=[("take", -1),
+                               ("chooseleaf_firstn", 3, 1),
+                               ("emit",)]))
+        m.add_rule(Rule(steps=[("take", -1),
+                               ("chooseleaf_indep", 3, 1),
+                               ("emit",)]))
+        cargs = {-1: {"ids": [int(i) + 7 for i in
+                              rng.permutation(hosts)],
+                      "weight_set": [[int(w) for w in
+                                      rng.integers(0x4000, 4 * 0x10000,
+                                                   size=hosts)]
+                                     for _ in range(2)]}}
+        for h in range(hosts):
+            cargs[-2 - h] = {"ids": None,
+                             "weight_set": [[int(w) for w in
+                                             rng.integers(0x4000,
+                                                          2 * 0x10000,
+                                                          size=per)]]}
+        for ruleno in (0, 1):
+            for x in range(40):
+                ref = crush_do_rule(m, ruleno, x, 3, choose_args=cargs)
+                got = native.crush_do_rule_native(m, ruleno, x, 3,
+                                                  choose_args=cargs)
+                assert got == ref, (ruleno, x, got, ref)
+        # batch entry with args, then cleared (same cached handle)
+        xs = list(range(64))
+        batch = native.crush_do_rule_batch_native(m, 0, xs, 3,
+                                                  choose_args=cargs)
+        for x in xs:
+            assert batch[x] == crush_do_rule(m, 0, x, 3,
+                                             choose_args=cargs), x
+        plain = native.crush_do_rule_batch_native(m, 0, xs, 3)
+        for x in xs:
+            assert plain[x] == crush_do_rule(m, 0, x, 3), x
+        # stored-set selection by index with default fallback
+        m.choose_args[cmap_mod.DEFAULT_CHOOSE_ARGS] = cargs
+        by_idx = native.crush_do_rule_native(m, 0, 5, 3, choose_args=99)
+        assert by_idx == crush_do_rule(m, 0, 5, 3, choose_args=cargs)
