@@ -112,6 +112,10 @@ def _declare_defaults():
     o("ms_type", str, "simple", LEVEL_ADVANCED,
       "messenger transport: simple (thread-per-connection) | async "
       "(event-loop, the AsyncMessenger analog)")
+    o("cephx_sign_messages", bool, True, LEVEL_ADVANCED,
+      "HMAC-sign every post-auth frame with the connection's cephx "
+      "session key; a bad signature resets the connection "
+      "(CephxSessionHandler sign_message/check_message_signature)")
     # fault injection (dev-level, like options.cc:1250-3953)
     o("ms_inject_socket_failures", int, 0, LEVEL_DEV,
       "drop 1 in N messages at the messenger")

@@ -288,7 +288,7 @@ class AsyncConnection(Connection):
                 # the peer's dedup can identify it (exactly-once)
                 seq, msg = resend
                 try:
-                    frame = _encode(msg, seq)
+                    frame = self._encode_out(msg, seq)
                 except Exception:
                     import traceback
                     traceback.print_exc()
@@ -325,7 +325,7 @@ class AsyncConnection(Connection):
             self._delay_paid = False
             self.out_seq += 1
             try:
-                frame = _encode(msg, self.out_seq)
+                frame = self._encode_out(msg, self.out_seq)
             except Exception:
                 import traceback
                 traceback.print_exc()
@@ -360,16 +360,18 @@ class AsyncConnection(Connection):
             return
         self.auth_confirmed = False
         self._auth_ready.clear()
+        self.session_key = None
         self._sent_authorizer = authorizer
         self.sock = sock
         self._connecting = True
         self._ctrl = bytearray(_encode(
             ("BANNER", tuple(self.msgr.my_addr or ("", 0)),
-             self.msgr.name, authorizer, self.conn_nonce))) + self._ctrl
+             self.msgr.name, authorizer, self.conn_nonce,
+             self.msgr._sign_intent()))) + self._ctrl
         self._register_io()
 
     def _schedule_reconnect(self) -> None:
-        if self.closed:
+        if self.closed or self.msgr._stopping:
             return
         if self.msgr.policy_lossy:
             with self.lock:
@@ -393,6 +395,7 @@ class AsyncConnection(Connection):
                 sock.close()
             except OSError:
                 pass
+        self.session_key = None   # next socket re-proves itself
         self._inbuf = bytearray()
         self._ctrl = bytearray()
         self._cur = bytearray()
@@ -502,7 +505,7 @@ class AsyncConnection(Connection):
         buf = self._inbuf
         try:
             while len(buf) - off >= _HDR.size:
-                magic, length, link_seq = _HDR.unpack_from(buf, off)
+                magic, length, link_seq, sig = _HDR.unpack_from(buf, off)
                 if magic != _MAGIC:
                     self._teardown()
                     return
@@ -511,6 +514,10 @@ class AsyncConnection(Connection):
                 payload = bytes(buf[off + _HDR.size:
                                     off + _HDR.size + length])
                 off += _HDR.size + length
+                if not self._verify_frame(payload, link_seq, sig):
+                    # tampered/unsigned frame on a signing session
+                    self._teardown()
+                    return
                 was_confirmed = self.auth_confirmed
                 if not self._process_payload(payload,
                                              self._buffer_bytes,
@@ -581,11 +588,15 @@ class AsyncMessenger(Messenger):
             # auth acks ride _process_payload
 
     def send_message(self, msg, dest_addr) -> None:
-        if dest_addr is None:
+        # see Messenger.send_message: no fresh connections once
+        # shutdown has begun
+        if dest_addr is None or self._stopping:
             return
         dest_addr = EntityAddr(*dest_addr)
         msg.from_name = self.name
         with self._lock:
+            if self._stopping:
+                return
             conn = self._conns.get(dest_addr)
             if conn is None or conn.closed:
                 conn = AsyncConnection(self, dest_addr)
@@ -594,6 +605,13 @@ class AsyncMessenger(Messenger):
 
     def shutdown(self) -> None:
         self._stopping = True
+        with self._lock:
+            conns = list(self._conns.values()) + list(self._in_conns)
+            self._conns.clear()
+            self._in_conns.clear()
+        for conn in conns:
+            conn.close()
+        # a dispatch racing the sweep may have minted one more conn
         with self._lock:
             conns = list(self._conns.values()) + list(self._in_conns)
             self._conns.clear()

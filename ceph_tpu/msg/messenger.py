@@ -24,6 +24,8 @@ only) and anything but the handshake drops the connection.
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import os
 import random
 import socket
@@ -36,13 +38,30 @@ from .. import encoding
 __all__ = ["EntityAddr", "Dispatcher", "Messenger", "Connection"]
 
 _MAGIC = b"CTPU"
-# frame header: magic, payload length, link_seq. The per-connection
-# sequence rides the FRAME, not the message object: one message object
-# may be queued to several peers at once, and stamping a shared object
-# per-connection would race (a frame could carry another pipe's seq,
-# making the receiver's dedup drop later messages as duplicates).
-# seq 0 = control frame (handshake, acks) — unsequenced.
-_HDR = struct.Struct("<4sIQ")
+# frame header: magic, payload length, link_seq, signature. The
+# per-connection sequence rides the FRAME, not the message object: one
+# message object may be queued to several peers at once, and stamping a
+# shared object per-connection would race (a frame could carry another
+# pipe's seq, making the receiver's dedup drop later messages as
+# duplicates). seq 0 = control frame (handshake, acks) — unsequenced.
+# sig: truncated HMAC-SHA256 over (sender nonce, magic, len, seq,
+# payload) under the connection's cephx session key — the reference's
+# per-message signing (CephxSessionHandler::sign_message keeps a u64
+# signature in the footer the same way). The sender's SESSION NONCE in
+# the MAC binds direction: both directions share one session key, so
+# without it a MITM could reflect a signed frame back at its
+# originator. 0 = unsigned (pre-auth / signing off).
+_HDR = struct.Struct("<4sIQQ")
+
+
+def _frame_sig(key: bytes, sender_nonce: str, length: int, seq: int,
+               payload: bytes) -> int:
+    mac = hmac.new(key,
+                   (sender_nonce or "").encode()
+                   + _HDR.pack(_MAGIC, length, seq, 0) + payload,
+                   hashlib.sha256).digest()
+    sig = struct.unpack("<Q", mac[:8])[0]
+    return sig or 1   # 0 means "unsigned" on the wire
 
 
 class EntityAddr(tuple):
@@ -76,9 +95,12 @@ class Dispatcher:
         """Peer connection dropped (lossy) — state cleanup hook."""
 
 
-def _encode(msg, seq: int = 0) -> bytes:
+def _encode(msg, seq: int = 0, key: bytes | None = None,
+            nonce: str = "") -> bytes:
     payload = encoding.encode_any(msg)
-    return _HDR.pack(_MAGIC, len(payload), seq) + payload
+    sig = _frame_sig(key, nonce, len(payload), seq, payload) \
+        if key else 0
+    return _HDR.pack(_MAGIC, len(payload), seq, sig) + payload
 
 
 def _read_exact(sock, n: int) -> bytes | None:
@@ -126,6 +148,11 @@ class Connection:
         self._in_seq = 0             # last delivered link_seq from peer
         self.peer_name = None
         self.auth_info = None        # verified cephx info (entity, caps)
+        # per-message signing key: the cephx SESSION key, armed when
+        # the handshake lands (acceptor: verify_authorizer's info;
+        # dialer: msgr.session_key_fn at BANNER_ACK) and cleared on
+        # every pipe death — each socket re-proves itself
+        self.session_key: bytes | None = None
         self.inbound = sock is not None   # accepted vs dialed
         self.auth_confirmed = False  # dialer saw a valid BANNER_ACK
         self._sent_authorizer = None
@@ -180,13 +207,15 @@ class Connection:
             # re-proven before inbound traffic is trusted again
             self.auth_confirmed = False
             self._auth_ready.clear()
+            self.session_key = None
             # banner (the msgr protocol's handshake): advertise our
             # bound address so the acceptor can route replies back over
             # this same connection (Ceph learns the peer_addr during the
             # connect handshake; replies never dial the ephemeral port)
             sock.sendall(_encode(
                 ("BANNER", tuple(self.msgr.my_addr or ("", 0)),
-                 self.msgr.name, authorizer, self.conn_nonce)))
+                 self.msgr.name, authorizer, self.conn_nonce,
+                 self.msgr._sign_intent())))
             self._sent_authorizer = authorizer
             self.sock = sock
             self._start_reader()
@@ -218,6 +247,32 @@ class Connection:
                     self._resend[0:0] = self._unacked
                     self._unacked.clear()
         return True
+
+    def _send_key(self) -> bytes | None:
+        """Signing key for outgoing frames (None = unsigned)."""
+        if not self.msgr.sign_messages:
+            return None
+        return self.session_key
+
+    def _encode_out(self, msg, seq: int = 0) -> bytes:
+        """Outgoing frame, signed with OUR session nonce when armed
+        (the receiver verifies with its _dedup_key = our nonce)."""
+        key = self._send_key()
+        return _encode(msg, seq, key, self.conn_nonce if key else "")
+
+    def _verify_frame(self, payload: bytes, link_seq: int,
+                      sig: int) -> bool:
+        """Armed connections require a valid signature on EVERY inbound
+        frame — after the handshake no legitimate unsigned frame exists
+        on this socket (a reconnect is a new socket that re-arms). The
+        MAC covers the SENDER's nonce (our _dedup_key), so a frame we
+        signed ourselves cannot be reflected back at us."""
+        if self.session_key is None or not self.msgr.sign_messages:
+            return True
+        want = _frame_sig(self.session_key, self._dedup_key or "",
+                          len(payload), link_seq, payload)
+        return hmac.compare_digest(struct.pack("<Q", sig),
+                                   struct.pack("<Q", want))
 
     def _peer_dialable(self) -> bool:
         """The peer advertised a REAL listening address we could
@@ -263,11 +318,14 @@ class Connection:
     def _writer_loop(self) -> None:
         backoff = 0.01
         while True:
+            if self.msgr._stopping:
+                return
             with self.lock:
                 while not self.out_q and not self._resend \
-                        and not self._ctrl_out and not self.closed:
+                        and not self._ctrl_out and not self.closed \
+                        and not self.msgr._stopping:
                     self.cond.wait(0.5)
-                if self.closed:
+                if self.closed or self.msgr._stopping:
                     # close() is explicit teardown (mark_down/shutdown):
                     # exit NOW, queued or not — draining would mean
                     # re-dialing a peer we were just told to drop, and
@@ -329,7 +387,7 @@ class Connection:
                 self.out_seq += 1
                 seq = self.out_seq
             try:
-                frame = _encode(msg, seq)
+                frame = self._encode_out(msg, seq)
             except Exception:
                 # poison message (a field outside the closed encodable
                 # set): drop IT, not the writer thread — pickle used to
@@ -389,6 +447,7 @@ class Connection:
         except OSError:
             pass
         self.sock = None
+        self.session_key = None   # next socket re-proves itself
         if self.msgr.policy_lossy:
             with self.lock:
                 self.out_q.clear()
@@ -405,7 +464,7 @@ class Connection:
                 hdr = _read_exact(sock, _HDR.size)
                 if hdr is None:
                     break
-                magic, length, link_seq = _HDR.unpack(hdr)
+                magic, length, link_seq, sig = _HDR.unpack(hdr)
                 if magic != _MAGIC:
                     break
                 payload = _read_exact(sock, length)
@@ -413,11 +472,26 @@ class Connection:
                     break
             except OSError:
                 break
+            if not self._verify_frame(payload, link_seq, sig):
+                # tampered or unsigned frame on a signing session:
+                # FAULT the pipe (reconnect + resend, the reference's
+                # check_message_signature fault path) — close() would
+                # strand queued lossless traffic
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                break
             if not self._process_payload(payload, self._queue_ctrl,
                                          link_seq):
                 break
         if sock is self.sock:
             self.sock = None
+            # only the CURRENT pipe's death disarms signing — a stale
+            # reader unwinding after a reconnect must not clear the
+            # new handshake's key (that would silently disable
+            # verification for the fresh session)
+            self.session_key = None
         # the pipe died: anything sendall handed to the dying socket
         # is in _unacked with no MSGACK coming. A lossless connection
         # must requeue and reconnect NOW — waiting for the next fresh
@@ -464,7 +538,7 @@ class Connection:
                 self.close()
                 return False
             return True
-        if (isinstance(msg, tuple) and len(msg) in (3, 4, 5)
+        if (isinstance(msg, tuple) and len(msg) in (3, 4, 5, 6)
                 and msg[0] == "BANNER"):
             # acceptor side: adopt the peer's advertised listening
             # address and register so sends to it reuse this pipe.
@@ -498,15 +572,28 @@ class Connection:
                     self.close()
                     return False
                 self.auth_info = info
+                # arm per-message signing with the ticket's session key
+                self.session_key = info.get("session_key") \
+                    if isinstance(info, dict) else None
                 # mutual auth: prove we could read the ticket; the
                 # third element tells the dialer our last-delivered
                 # in_seq so it can trim already-delivered resends, the
                 # fourth is OUR session nonce so the dialer can dedup
                 # our messages if this conn later flips to re-dialing
+                signing = bool(self.session_key is not None
+                               and self.msgr.sign_messages)
+                # fail fast on a cephx_sign_messages mismatch: the
+                # peers would otherwise churn through reconnects with
+                # every frame rejected (the reference gates signing on
+                # a negotiated feature bit the same way)
+                peer_sign = msg[5] if len(msg) >= 6 else None
+                if peer_sign is not None and bool(peer_sign) != signing:
+                    self.close()
+                    return False
                 try:
                     send_bytes(_encode(
                         ("BANNER_ACK", info.get("reply_proof"),
-                         self._in_seq, self.conn_nonce)))
+                         self._in_seq, self.conn_nonce, signing)))
                 except OSError:
                     return False
             else:
@@ -516,7 +603,7 @@ class Connection:
                 try:
                     send_bytes(_encode(("BANNER_ACK", None,
                                         self._in_seq,
-                                        self.conn_nonce)))
+                                        self.conn_nonce, False)))
                 except OSError:
                     return False
             self.peer_addr = EntityAddr(*msg[1])
@@ -539,11 +626,12 @@ class Connection:
             try:
                 send_bytes(_encode(
                     ("BANNER", tuple(self.msgr.my_addr or ("", 0)),
-                     self.msgr.name, authorizer, self.conn_nonce)))
+                     self.msgr.name, authorizer, self.conn_nonce,
+                     self.msgr._sign_intent())))
             except OSError:
                 return False
             return True
-        if (isinstance(msg, tuple) and len(msg) in (2, 3, 4)
+        if (isinstance(msg, tuple) and len(msg) in (2, 3, 4, 5)
                 and msg[0] == "BANNER_ACK"):
             # dialer side: the service proved possession of the
             # session key (cephx mutual auth). The proof bytes are
@@ -577,6 +665,22 @@ class Connection:
             if len(msg) >= 4 and msg[3]:
                 self._dedup_key = msg[3]
                 self._in_seq = self.msgr._delivered_seq(msg[3])
+            # arm per-message signing: the dialer's copy of the session
+            # key comes from its ticket (session_key_fn hook)
+            fn = self.msgr.session_key_fn
+            if fn is not None:
+                try:
+                    self.session_key = fn()
+                except Exception:
+                    self.session_key = None
+            # fail fast on a cephx_sign_messages mismatch (see the
+            # acceptor-side check): the acceptor's flag rides the ack
+            signing = bool(self.session_key is not None
+                           and self.msgr.sign_messages)
+            peer_sign = bool(msg[4]) if len(msg) >= 5 else None
+            if peer_sign is not None and peer_sign != signing:
+                self.close()
+                return False
             self.auth_confirmed = True
             self._auth_ready.set()
             return True
@@ -617,7 +721,7 @@ class Connection:
             # MSGACK was lost in the reconnect): ack again, do NOT
             # re-deliver — exactly-once for the dispatchers
             try:
-                send_bytes(_encode(("MSGACK", seq)))
+                send_bytes(self._encode_out(("MSGACK", seq)))
             except OSError:
                 return False
             return True
@@ -628,7 +732,7 @@ class Connection:
                 self.msgr._record_delivered(self._dedup_key, seq)
             # ack AFTER dispatch: delivery, not receipt (at-least-once)
             try:
-                send_bytes(_encode(("MSGACK", seq)))
+                send_bytes(self._encode_out(("MSGACK", seq)))
             except OSError:
                 return False
         return True
@@ -655,7 +759,7 @@ class Messenger:
     def __init__(self, name, nonce: str = "", conf=None,
                  policy_lossy: bool = False,
                  authorizer_factory=None, auth_verifier=None,
-                 auth_confirm=None):
+                 auth_confirm=None, session_key_fn=None):
         self.name = name              # ("osd", 3) etc.
         self.conf = conf
         self.policy_lossy = policy_lossy
@@ -669,6 +773,18 @@ class Messenger:
         self.authorizer_factory = authorizer_factory
         self.auth_verifier = auth_verifier
         self.auth_confirm = auth_confirm
+        # session_key_fn() -> bytes: the dialer's copy of the cephx
+        # session key (from its service ticket), used to sign and
+        # verify post-auth frames (cephx_sign_messages); the acceptor's
+        # copy comes out of verify_authorizer's info dict.
+        self.session_key_fn = session_key_fn
+        self.sign_messages = True
+        if conf is not None:
+            try:
+                self.sign_messages = bool(
+                    conf.get_val("cephx_sign_messages"))
+            except KeyError:
+                pass
         self.dispatchers: list[Dispatcher] = []
         self.my_addr: EntityAddr | None = None
         self._server: socket.socket | None = None
@@ -731,6 +847,14 @@ class Messenger:
             self._in_conns.clear()
         for conn in conns:
             conn.close()
+        # a dispatch racing the sweep above may have minted one more
+        # connection before _stopping landed — sweep again
+        with self._lock:
+            conns = list(self._conns.values()) + list(self._in_conns)
+            self._conns.clear()
+            self._in_conns.clear()
+        for conn in conns:
+            conn.close()
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=2)
 
@@ -760,6 +884,13 @@ class Messenger:
             if existing is None or existing.closed:
                 self._conns[conn.peer_addr] = conn
 
+    def _sign_intent(self) -> bool:
+        """The flag a dialer advertises in its BANNER: will our side
+        sign post-auth frames? (Effective only when we can actually
+        obtain a session key.)"""
+        return bool(self.sign_messages
+                    and self.session_key_fn is not None)
+
     def _delivered_seq(self, key) -> int:
         with self._lock:
             return self._delivered.get(key, 0)
@@ -785,11 +916,18 @@ class Messenger:
     # -- send ----------------------------------------------------------
 
     def send_message(self, msg, dest_addr) -> None:
-        if dest_addr is None:
+        # a send racing shutdown must not mint a fresh connection: it
+        # would never be tracked (shutdown already swept _conns), and
+        # its writer would re-dial the dead peer's port forever — when
+        # a LATER process reuses that port, the zombie connects and
+        # floods it
+        if dest_addr is None or self._stopping:
             return
         dest_addr = EntityAddr(*dest_addr)
         msg.from_name = self.name
         with self._lock:
+            if self._stopping:
+                return
             conn = self._conns.get(dest_addr)
             if conn is None or conn.closed:
                 conn = Connection(self, dest_addr)

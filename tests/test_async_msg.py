@@ -217,7 +217,8 @@ class TestAsyncAuth:
             authorizer_factory=lambda challenge=None:
                 client.build_authorizer("osd", challenge),
             auth_confirm=lambda authorizer, proof: client.verify_reply(
-                authorizer["service"], proof, authorizer["nonce"]))
+                authorizer["service"], proof, authorizer["nonce"]),
+            session_key_fn=lambda: client.tickets["osd"]["session_key"])
         dialer.bind()
         dialer.start()
         try:

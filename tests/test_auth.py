@@ -167,7 +167,8 @@ class TestMessengerAuth:
         client_msgr = Messenger(
             ("client", 1),
             authorizer_factory=lambda challenge=None: client.build_authorizer(
-                "osd", challenge))
+                "osd", challenge),
+            session_key_fn=lambda: client.tickets["osd"]["session_key"])
         client_msgr.bind()
         client_msgr.start()
         try:
@@ -274,7 +275,8 @@ class TestMessengerAuth:
             authorizer_factory=lambda challenge=None: client.build_authorizer(
                 "osd", challenge),
             auth_confirm=lambda authorizer, proof: client.verify_reply(
-                authorizer["service"], proof, authorizer["nonce"]))
+                authorizer["service"], proof, authorizer["nonce"]),
+            session_key_fn=lambda: client.tickets["osd"]["session_key"])
         client_msgr.add_dispatcher_tail(Echo(client_msgr))
         client_msgr.bind()
         client_msgr.start()
@@ -371,3 +373,124 @@ class TestCryptoProviderSlot:
             assert blob == bytes(b ^ 0x42 for b in b"hi")
         finally:
             cephx.set_crypto_provider("stdlib")
+
+
+class TestMessageSigning:
+    """cephx per-message signing (CephxSessionHandler sign_message /
+    check_message_signature): post-auth frames carry a session-key HMAC
+    in the frame header; a tampered frame resets the connection."""
+
+    def _pair(self, msgr_cls, sign=True):
+        from ceph_tpu.common.config import Config
+        from ceph_tpu.msg.messenger import Dispatcher
+        kr, admin_secret, svc_secret, server = make_world()
+        client = CephxClient("client.admin", admin_secret)
+        ch = server.get_challenge("client.admin")
+        client.open_session(server.handle_request(
+            "client.admin", client.build_proof(ch)))
+        conf = Config({"cephx_sign_messages": sign})
+        got = []
+
+        class Sink(Dispatcher):
+            def ms_dispatch(self, msg):
+                got.append(msg)
+                return True
+
+        server_msgr = msgr_cls(
+            ("osd", 0), conf=conf,
+            auth_verifier=CephxServiceHandler("osd", svc_secret))
+        server_msgr.add_dispatcher_tail(Sink())
+        addr = server_msgr.bind()
+        server_msgr.start()
+        client_msgr = msgr_cls(
+            ("client", 1), conf=conf,
+            authorizer_factory=lambda challenge=None:
+                client.build_authorizer("osd", challenge),
+            session_key_fn=lambda:
+                client.tickets["osd"]["session_key"])
+        client_msgr.bind()
+        client_msgr.start()
+        return client_msgr, server_msgr, addr, got
+
+    def _wait(self, got, n, timeout=5.0):
+        deadline = time.time() + timeout
+        while len(got) < n and time.time() < deadline:
+            time.sleep(0.01)
+        return len(got) >= n
+
+    @pytest.mark.parametrize("transport", ["simple", "async"])
+    def test_signed_frames_deliver_and_carry_signatures(self, transport):
+        from ceph_tpu.msg.async_messenger import AsyncMessenger
+        from ceph_tpu.msg.message import MPing
+        from ceph_tpu.msg.messenger import Messenger
+        cls = Messenger if transport == "simple" else AsyncMessenger
+        client_msgr, server_msgr, addr, got = self._pair(cls)
+        try:
+            for i in range(5):
+                client_msgr.send_message(MPing(stamp=float(i)), addr)
+            assert self._wait(got, 5)
+            # both ends armed the session key
+            conn = client_msgr._conns[addr]
+            assert conn.session_key is not None
+            assert any(c.session_key is not None
+                       for c in server_msgr._in_conns)
+        finally:
+            client_msgr.shutdown()
+            server_msgr.shutdown()
+
+    def test_tampered_frame_resets_connection(self):
+        """Flip one payload byte on the wire: the receiver must drop
+        the connection, not dispatch the altered message."""
+        import socket as pysock
+
+        from ceph_tpu.msg import messenger as msg_mod
+        from ceph_tpu.msg.message import MPing
+        from ceph_tpu.msg.messenger import Messenger
+        client_msgr, server_msgr, addr, got = self._pair(Messenger)
+        try:
+            client_msgr.send_message(MPing(stamp=1.0), addr)
+            assert self._wait(got, 1)
+            conn = client_msgr._conns[addr]
+            assert conn.session_key is not None
+            # forge: craft a signed-looking frame with a WRONG sig by
+            # writing raw bytes on the client's socket
+            payload = msg_mod.encoding.encode_any(MPing(stamp=66.6))
+            bad = msg_mod._HDR.pack(msg_mod._MAGIC, len(payload), 7,
+                                    0xDEAD) + payload
+            conn.sock.sendall(bad)
+            time.sleep(0.5)
+            # the server faulted the pipe and never dispatched it
+            # (the connection may already have re-established — fault
+            # means reconnect + resend, not permanent closure)
+            assert all(m.stamp != 66.6 for m in got)
+            # the connection recovers (fault -> re-handshake -> resend)
+            client_msgr.send_message(MPing(stamp=2.0), addr)
+            assert self._wait(got, 2, timeout=10)
+            # an UNSIGNED frame (sig=0, the signature-stripping
+            # downgrade) is equally rejected on the armed session —
+            # _frame_sig maps a real 0 MAC to 1 so 0 is never valid
+            conn = client_msgr._conns[addr]
+            deadline = time.time() + 5
+            while conn.session_key is None and time.time() < deadline:
+                time.sleep(0.01)
+            payload2 = msg_mod.encoding.encode_any(MPing(stamp=77.7))
+            stripped = msg_mod._HDR.pack(msg_mod._MAGIC, len(payload2),
+                                         9, 0) + payload2
+            conn.sock.sendall(stripped)
+            time.sleep(0.5)
+            assert all(m.stamp != 77.7 for m in got)
+        finally:
+            client_msgr.shutdown()
+            server_msgr.shutdown()
+
+    def test_signing_off_interops(self):
+        from ceph_tpu.msg.message import MPing
+        from ceph_tpu.msg.messenger import Messenger
+        client_msgr, server_msgr, addr, got = self._pair(Messenger,
+                                                         sign=False)
+        try:
+            client_msgr.send_message(MPing(stamp=3.0), addr)
+            assert self._wait(got, 1)
+        finally:
+            client_msgr.shutdown()
+            server_msgr.shutdown()
