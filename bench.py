@@ -23,20 +23,33 @@ vs_baseline  against the in-repo numpy reference implementation.
 vs_native    against the AVX2 chunk-level native plugin (native/ —
              ISA-class: vpshufb nibble tables + vertical multi-output
              kernel), measured in the same run on this host.
-encode_path  which dispatch served encode_MBps ("xla" — the default,
-             measured at the HBM roofline — or "pallas" if explicitly
-             opted in via CEPH_TPU_PALLAS=1); xla_encode_MBps and
-             pallas_encode_MBps attribute both paths every run so a
-             dispatch regression is visible in the artifact itself.
-decode_MBps  randomized erasure patterns, a FRESH pattern per lane (the
-             reference tool randomizes/exhausts patterns,
-             ceph_erasure_code_benchmark.cc:254-327), exactly k
-             survivors handed over, every pattern's decode matrix its
+encode_path  always "xla": the Pallas kernel is retired (measured
+             postmortem in ceph_tpu/ops/pallas_gf.py — the XLA path
+             sits at ~0.95x of the HBM roofline and Mosaic cannot
+             express the efficient bitplane layouts).
+decode_MBps  SEALED fused decode: randomized erasure patterns, a FRESH
+             pattern per lane (the reference tool randomizes/exhausts
+             patterns, ceph_erasure_code_benchmark.cc:254-327), exactly
+             k survivors handed over, every pattern's decode matrix its
              own vmapped lane of ONE fused device program (the cross-op
-             coalescing shape the OSD batches concurrent ops into).
+             coalescing shape the OSD batches concurrent ops into) —
+             timed as a data-dependent CHAIN of executions ended by a
+             host read of the final result, so the tunnel's early
+             completion acks cannot shorten the timer. This is a
+             SERIALIZED LOWER BOUND (it forbids overlap and pays the
+             seal's round trip); the pipelined keys (decode_warm_MBps,
+             decode_dispatch_MBps, decode_MBps_e{1,2,3}) are steady-
+             state upper estimates measured the way the OSD pipeline
+             actually overlaps ops, and every emitted rate must pass
+             the in-bench HBM roofline gate (the r03 artifact published
+             a physically impossible 11.46 TB/s here; this round's
+             methodology makes that class of error fail the run).
+             crush_bulk_pgs_per_s is sealed the same way, in its own
+             process (the seal is a d2h, and one d2h permanently
+             degrades this tunnel's session).
              decode_dispatch_MBps is the same work issued one RPC per
              pattern — it prices the per-op dispatch path.
-             decode_MBps_e{1,2,3} split that by erasure count (-e 1..3).
+             decode_MBps_e{1,2,3} split by erasure count (-e 1..3).
 streaming_encode_MBps
              end-to-end H2D-inclusive number: DISTINCT host buffers
              every batch, double-buffered so transfer overlaps compute.
@@ -171,40 +184,225 @@ def _bench_extra_rows(jax, jnp, on_tpu: bool) -> dict:
     checks.append(lrc_gate)
     out["lrc_k4_m2_l3_decode_MBps"] = round(batch * 4 * n / t / 1e6, 1)
 
-    # row 5a: SHEC k=8 m=4 c=3 — encode timed device-side first; the
-    # decode is host-math (its plan pulls to host) so it runs with the
-    # deferred gates, after every pure-device timing
+    # row 5a: SHEC k=8 m=4 c=3 — encode AND decode are both
+    # device-resident now (round 4 fused the plan inversion + shingle
+    # parity recompute into one compact bitmatrix per signature), so
+    # BOTH time in the pure-device section; the bit-equality gate vs
+    # the host oracle defers with the rest
     shec = registry.factory("shec_tpu", {"technique": "multiple",
                                          "k": "8", "m": "4", "c": "3"})
     mbps, shec_data_dev, shec_n = enc_rate(shec, 8)
     out["shec_k8_m4_c3_encode_MBps"] = round(mbps, 1)
-
-    # ---- every pure-device timing is done: d2h is now allowed ----
-    for gate in checks:
-        gate()
-
-    par = shec.encode_batch(shec_data_dev)
-    fullh = np.concatenate([np.asarray(shec_data_dev),
-                            np.asarray(par)], axis=1)
+    shec_par = shec.encode_batch(shec_data_dev)
+    shec_full = jnp.concatenate([shec_data_dev, shec_par], axis=1)
     nn = shec.get_chunk_count()
-    erased = (2, 9)
-    avail = tuple(i for i in range(nn) if i not in erased)
-    chunks = fullh[:, list(avail), :]
-    t = _bench(lambda: shec.decode_batch(
-        avail, chunks, want_rows=tuple(range(nn))), iters)
-    dec = np.asarray(shec.decode_batch(avail, chunks,
-                                       want_rows=tuple(range(nn))))
-    if not np.array_equal(dec, fullh):
-        raise SystemExit("shec decode mismatch")
+    shec_erased = (2, 9)
+    shec_avail = tuple(i for i in range(nn) if i not in shec_erased)
+    shec_chunks = jnp.take(shec_full,
+                           jnp.asarray(shec_avail, dtype=jnp.int32),
+                           axis=1)
+    shec_want = tuple(range(nn))
+    t = _bench_dev(lambda: shec.decode_batch(
+        shec_avail, shec_chunks, want_rows=shec_want), iters)
     out["shec_k8_m4_c3_decode_MBps"] = round(
         batch * 8 * shec_n / t / 1e6, 1)
+    shec_dec_dev = shec.decode_batch(shec_avail, shec_chunks,
+                                     want_rows=shec_want)
 
-    # row 5b: batched CRUSH bulk remap vs the scalar interpreter
-    # (OSDMapMapping's job: recompute every PG after a map change)
-    from ceph_tpu.crush import map as cmap_mod
+    def shec_gate(shec=shec, shec_dec_dev=shec_dec_dev,
+                  shec_data_dev=shec_data_dev, shec_full=shec_full,
+                  shec_avail=shec_avail, shec_want=shec_want):
+        fullh = np.asarray(shec_full)
+        if not np.array_equal(np.asarray(shec_dec_dev), fullh):
+            raise SystemExit("shec fused decode mismatch")
+        # and vs the stepwise host oracle on one stripe
+        host = shec._decode_batch_host(
+            shec_avail, fullh[:1, list(shec_avail)],
+            want_rows=shec_want)
+        if not np.array_equal(np.asarray(shec_dec_dev)[:1],
+                              np.asarray(host)):
+            raise SystemExit("shec fused != host oracle")
+    checks.append(shec_gate)
+
+    # row 5b: batched CRUSH bulk remap (OSDMapMapping's job: recompute
+    # every PG after a map change). The device sweep is timed
+    # DEVICE-RESIDENT (no per-iteration d2h — the r03 artifact timed
+    # this post-session-poison through a host-blocking call and
+    # recorded 5.2k PGs/s for the one subsystem whose pitch is bulk
+    # device recomputation); the scalar-oracle equality gate defers.
     from ceph_tpu.crush import mapper_ref
     from ceph_tpu.crush.batched import batched_do_rule
+    m, reweight = _crush_bench_map()   # shared with the sealed worker
+    n_pgs = 65536 if on_tpu else 4096
+    xs = np.arange(n_pgs)
+    # the bulk device sweep is NOT timed in this session: pipelined
+    # timing reads 35M PGs/s through the tunnel's early completion
+    # acks while the sealed (data-dependent chain + host-read) truth
+    # is ~3.5k PGs/s — crush_bulk_pgs_per_s comes from the dedicated
+    # sealed subprocess (_crush_sealed_worker). Here we only produce
+    # one sweep's RESULT for the deferred scalar-oracle gate.
+    crush_got_dev = batched_do_rule(m, 0, xs, 5, reweight,
+                                    device_out=True)
+
+    def crush_gate(m=m, xs=xs, reweight=reweight,
+                   crush_got_dev=crush_got_dev, rng=rng, out=out):
+        got = np.asarray(crush_got_dev)
+        sample = rng.choice(len(xs), size=64, replace=False)
+        t0 = time.perf_counter()
+        for x in sample:
+            ref = mapper_ref.crush_do_rule(m, 0, int(x), 5,
+                                           list(reweight))
+            if list(got[int(x)]) != ref:
+                raise SystemExit(
+                    "batched CRUSH != scalar oracle at %d" % x)
+        t_scalar = (time.perf_counter() - t0) / len(sample)
+        out["crush_scalar_pgs_per_s"] = round(1.0 / t_scalar, 1)
+        # the native C++ bulk mapper as the honest CPU comparator
+        # (the reference's ParallelPGMapper runs compiled C the same
+        # way; the scalar Python rate alone would flatter the device)
+        try:
+            from ceph_tpu.native import crush_do_rule_batch_native
+            t0 = time.perf_counter()
+            nat = crush_do_rule_batch_native(m, 0, xs, 5,
+                                             list(reweight))
+            t_nat = time.perf_counter() - t0
+            if nat[int(sample[0])] != mapper_ref.crush_do_rule(
+                    m, 0, int(sample[0]), 5, list(reweight)):
+                raise SystemExit("native CRUSH != scalar oracle")
+            out["crush_native_pgs_per_s"] = round(len(xs) / t_nat, 1)
+        except SystemExit:
+            raise
+        except Exception:
+            pass   # native lib not built on this host
+    checks.append(crush_gate)
+
+    # gates are returned to the caller, which runs them AFTER the
+    # sealed fused-decode chain: every gate is a d2h, and the seal
+    # must be the session's first
+    return out, checks
+
+
+def _bench_cluster() -> dict:
+    """End-to-end OSD pipeline number (the rados-bench role,
+    src/common/obj_bencher.h write/read protocol at framework scale):
+    a MiniCluster EC pool takes concurrent client writes, then reads
+    everything back — aggregate MB/s through the FULL stack (client
+    objecter, messenger, PG pipeline, ECBackend, dispatcher-coalesced
+    device codec, object store). Also reports the tpu_dispatcher's
+    coalescing ratio (device dispatches per codec op; < 1 means
+    concurrent ops shared device programs). Runs LAST: it is
+    host/transport-bound by design and the session is post-d2h.
+
+    The pool's codec is the CPU (numpy) plugin: this row prices the
+    PIPELINE, and on the tunneled device every small per-op dispatch
+    would pay a 0.1-90 ms transport round trip — the codec device
+    rates are the other rows' job (on a PCIe-attached TPU the jax_tpu
+    plugin is the natural choice here). The dispatcher coalesces
+    either codec identically, so the coalescing ratio stays
+    meaningful."""
+    import threading
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_util import MiniCluster
+    out: dict = {}
+    c = MiniCluster(num_mons=1, num_osds=4)
+    c.start()
+    try:
+        client = c.client()
+        pool_id = c.create_ec_pool(
+            client, "bench-ec",
+            {"plugin": "jerasure", "technique": "reed_sol_van",
+             "k": "2", "m": "1", "w": "8"}, pg_num=8)
+        c.wait_clean(pool_id)
+        ioctx = client.open_ioctx("bench-ec")
+        obj_bytes = 1 << 18            # 256 KiB objects
+        n_objs, writers = 32, 8
+        payloads = {
+            "bench-%d" % i: np.random.default_rng(i).integers(
+                0, 256, size=obj_bytes, dtype=np.uint8).tobytes()
+            for i in range(n_objs)}
+
+        def write_range(ids):
+            for i in ids:
+                ioctx.write_full("bench-%d" % i, payloads["bench-%d" % i])
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(
+            target=write_range, args=(range(w, n_objs, writers),))
+            for w in range(writers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        t_write = time.perf_counter() - t0
+        out["cluster_ec_write_MBps"] = round(
+            n_objs * obj_bytes / t_write / 1e6, 1)
+
+        errs: list = []
+
+        def read_range(ids):
+            for i in ids:
+                if ioctx.read("bench-%d" % i) != \
+                        payloads["bench-%d" % i]:
+                    errs.append(i)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(
+            target=read_range, args=(range(w, n_objs, writers),))
+            for w in range(writers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        t_read = time.perf_counter() - t0
+        if errs:
+            raise SystemExit("cluster bench read mismatch: %s" % errs)
+        out["cluster_ec_read_MBps"] = round(
+            n_objs * obj_bytes / t_read / 1e6, 1)
+        ops = disp = 0
+        for osd in c.osds.values():
+            d = getattr(osd, "tpu_dispatcher", None)
+            if d is not None:
+                ops += d.stats["ops"]
+                disp += d.stats["dispatches"]
+        if ops:
+            out["cluster_dispatch_ops"] = ops
+            out["cluster_dispatches"] = disp
+            out["cluster_coalesce_ratio"] = round(disp / ops, 3)
+    finally:
+        c.stop()
+    return out
+
+
+#: v5e-1 HBM bandwidth ceiling with margin: no single-chip number can
+#: legitimately exceed it. The r03 artifact published 11.46 TB/s for
+#: the fused decode (a pipelining/completion artifact of the tunnel);
+#: this gate makes that class of error fail the RUN instead of
+#: shipping. MB/s units.
+ROOFLINE_MBPS = 1_300_000    # ~1.3 TB/s: > v5e HBM (~0.8) + headroom
+
+
+def _roofline_gate(doc: dict) -> None:
+    for key, val in doc.items():
+        if not isinstance(val, (int, float)):
+            continue
+        if key.endswith("_MBps") or key == "value":
+            if val > ROOFLINE_MBPS:
+                raise SystemExit(
+                    "roofline gate: %s = %.0f MB/s exceeds the "
+                    "single-chip HBM ceiling (%d) — timing artifact"
+                    % (key, val, ROOFLINE_MBPS))
+
+
+def _crush_bench_map():
+    """The exact map/rule/reweight the extra-rows crush timing uses
+    (same seed), shared with the sealed subprocess."""
+    import numpy as np
+
+    from ceph_tpu.crush import map as cmap_mod
     from ceph_tpu.crush.map import Rule
+    rng = np.random.default_rng(7070)
     hosts, per = 8, 4
     ndev = hosts * per
     weights = rng.integers(0x8000, 3 * 0x10000, size=ndev,
@@ -214,23 +412,63 @@ def _bench_extra_rows(jax, jnp, on_tpu: bool) -> dict:
                            (cmap_mod.RULE_CHOOSELEAF_INDEP, 5, 1),
                            (cmap_mod.RULE_EMIT,)]))
     reweight = np.full(ndev, 0x10000, dtype=np.int64)
-    reweight[3] = 0            # a remap-triggering weight change
+    reweight[3] = 0
+    return m, reweight
+
+
+def _crush_sealed_worker() -> None:
+    """Sealed bulk-CRUSH timing in its OWN process: a data-dependent
+    chain of device sweeps ended by a tiny host read, so the tunnel's
+    early completion acks cannot shorten the timer. Own process
+    because the seal is a d2h and one d2h permanently degrades the
+    session — the main worker spends its single pre-poison seal on
+    the fused-decode chain."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.crush.batched import batched_do_rule
+    m, reweight = _crush_bench_map()
+    on_tpu = jax.devices()[0].platform == "tpu"
     n_pgs = 65536 if on_tpu else 4096
     xs = np.arange(n_pgs)
-    t = _bench(lambda: batched_do_rule(m, 0, xs, 5, reweight), 3)
-    out["crush_bulk_pgs_per_s"] = round(n_pgs / t, 1)
-    got = batched_do_rule(m, 0, xs, 5, reweight)
-    sample = rng.choice(n_pgs, size=64, replace=False)
-    t0 = time.perf_counter()
-    for x in sample:
-        ref = mapper_ref.crush_do_rule(m, 0, int(x), 5, list(reweight))
-        if list(got[int(x)]) != ref:
-            raise SystemExit("batched CRUSH != scalar oracle at %d" % x)
-    t_scalar = (time.perf_counter() - t0) / len(sample)
-    out["crush_scalar_pgs_per_s"] = round(1.0 / t_scalar, 1)
-    out["crush_bulk_speedup"] = round(
-        out["crush_bulk_pgs_per_s"] / out["crush_scalar_pgs_per_s"], 1)
-    return out
+    out = batched_do_rule(m, 0, xs, 5, reweight, device_out=True)
+    jax.block_until_ready(out)          # compile + warm
+    chain = 4
+    best = None
+    for _ in range(2):
+        xs_d = jnp.asarray(xs)
+        t0 = time.perf_counter()
+        for _ in range(chain):
+            out = batched_do_rule(m, 0, xs_d, 5, reweight,
+                                  device_out=True)
+            # value-neutral data dependency: the next sweep's seeds
+            # consume this sweep's output, forcing serialization
+            xs_d = xs_d + (out[:, 0] ^ out[:, 0])
+        np.asarray(xs_d[:4])            # the seal
+        t = time.perf_counter() - t0
+        if best is None or t < best:
+            best = t
+    print(json.dumps({"crush_bulk_pgs_per_s":
+                      round(chain * n_pgs / best, 1),
+                      "device": jax.devices()[0].platform}))
+
+
+def _run_crush_sealed() -> dict:
+    """Spawn the sealed crush worker; {} on any failure."""
+    here = os.path.abspath(__file__)
+    try:
+        proc = subprocess.run(
+            [sys.executable, here, "--crush-worker"],
+            timeout=300, capture_output=True, text=True)
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if ln.startswith("{")), None)
+        if proc.returncode == 0 and line:
+            doc = json.loads(line)
+            doc.pop("device", None)
+            return doc
+    except Exception:
+        pass
+    return {}
 
 
 def _make_two_level_map(hosts: int, per: int, weights):
@@ -282,14 +520,11 @@ def run_bench() -> None:
 
     # encode, device-resident, through the production dispatch
     from ceph_tpu.ops import xor_mm
+    print("BENCH-STAGE encode", file=sys.stderr, flush=True)
     t_enc = _bench_dev(lambda: tpu.encode_batch(data_dev), ITERS)
     enc_mbps = bytes_per_call / t_enc / 1e6
-    encode_path = ("pallas" if xor_mm._pallas_enabled() else "xla")
-    xla_mbps = pallas_mbps = None
-    if encode_path == "xla":
-        xla_mbps = enc_mbps
-    else:
-        pallas_mbps = enc_mbps
+    encode_path = "xla"   # Pallas retired: ops/pallas_gf.py postmortem
+    xla_mbps = enc_mbps
     # decode: REAL reconstruction over RANDOMIZED erasure patterns — a
     # fresh pattern (cold decode table) per timed call, exactly k
     # survivors handed over (minimum_to_decode read semantics)
@@ -351,9 +586,11 @@ def run_bench() -> None:
     # EARLY, before the heavy staging / alternate-kernel sections, so
     # session-state drift in the remote transport cannot depress it.
     p0w, c0w = warm[0]
+    print("BENCH-STAGE warm-decode", file=sys.stderr, flush=True)
     t_dec_warm = _bench_dev(lambda: tpu.decode_batch(p0w, c0w), ITERS)
     dec_warm_mbps = bytes_per_call / t_dec_warm / 1e6
 
+    print("BENCH-STAGE dispatch-decode", file=sys.stderr, flush=True)
     mixed = stage(fresh_patterns(ITERS))
     t_disp = time_decode(mixed)
     dec_dispatch_mbps = bytes_per_call / t_disp / 1e6
@@ -361,19 +598,50 @@ def run_bench() -> None:
     # fused: every pattern's decode in ONE device program (the
     # cross-op coalescing shape the OSD batches concurrent ops into —
     # one dispatch for P erasure signatures, P decode matrices riding
-    # a vmapped lane dim). This is the device-truth decode number;
-    # the dispatch-path number above prices the per-op RPC overhead.
+    # a vmapped lane dim). NOT timed here: on this tunnel even a
+    # fully-blocked single execution reports early (the r03 artifact
+    # recorded 11.46 TB/s; a blocked retime still read 5.9 TB/s —
+    # block_until_ready acks before compute drains). The honest timing
+    # is a data-dependent CHAIN of fused executions sealed by a tiny
+    # host read that cannot complete early; its seal is a d2h, so it
+    # runs AFTER the last device-resident section (time_fused_chain is
+    # invoked right before the correctness gates).
+    print("BENCH-STAGE fused-decode", file=sys.stderr, flush=True)
     entries = [tpu._decode_entry(p) for p, _ in mixed]
     bitmats_dev = jnp.asarray(np.stack([e["bitmat"] for e in entries]))
     chunks_all = jnp.stack([c for _, c in mixed])   # [P, B, k, chunk]
     jax.block_until_ready(chunks_all)
-    t_dec = _bench_dev(
-        lambda: xor_mm.matrix_encode_multi(bitmats_dev, chunks_all, W),
-        max(ITERS // 4, 3))
-    t_dec /= len(mixed)            # per-pattern, same unit as dispatch
-    dec_mbps = bytes_per_call / t_dec / 1e6
     fused_dev = xor_mm.matrix_encode_multi(bitmats_dev, chunks_all, W)
 
+    # each step consumes the previous step's output: the chain cannot
+    # be overlapped or reordered, the device must run FUSED_CHAIN full
+    # fused decodes back to back
+    fused_step = jax.jit(lambda ch: jnp.bitwise_xor(
+        ch, xor_mm.matrix_encode_multi(bitmats_dev, ch, W)[:, :, :K, :]))
+    FUSED_CHAIN = 8
+
+    def time_fused_chain():
+        x = chunks_all
+        for _ in range(2):             # warmup/compile
+            x = fused_step(x)
+        jax.block_until_ready(x)
+        best = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            x = chunks_all
+            for _ in range(FUSED_CHAIN):
+                x = fused_step(x)
+            # the SEAL: 8 real bytes of the final chained result must
+            # land on the host before the timer stops — no
+            # completion-ack shortcut can fake that
+            np.asarray(x[0, 0, 0, :8])
+            t = time.perf_counter() - t0
+            if best is None or t < best:
+                best = t
+        return (FUSED_CHAIN * len(mixed) * bytes_per_call
+                / best / 1e6)
+
+    print("BENCH-STAGE per-e-decode", file=sys.stderr, flush=True)
     dec_e = {}
     per_e_iters = max(ITERS // 4, 2)
     for e in range(1, M + 1):
@@ -385,6 +653,7 @@ def run_bench() -> None:
     # buffered — the device_put of batch i+1 is issued before blocking
     # on batch i's encode so transfer and compute overlap. Before the
     # first d2h (h2d device_puts do not poison the session; d2h does).
+    print("BENCH-STAGE streaming", file=sys.stderr, flush=True)
     stream_batches = max(ITERS // 2, 4)
     hosts = [rng.integers(0, 256, size=(BATCH, K, n), dtype=np.uint8)
              for _ in range(stream_batches)]
@@ -413,44 +682,30 @@ def run_bench() -> None:
     # the first d2h, so they run here; their own correctness gates and
     # host-math rows are internally deferred (the extra rows end with
     # d2h, which is why everything after this point may be degraded)
+    print("BENCH-STAGE extra-rows", file=sys.stderr, flush=True)
     extra_rows: dict = {}
+    extra_checks: list = []
     try:
-        extra_rows = _bench_extra_rows(
+        extra_rows, extra_checks = _bench_extra_rows(
             jax, jnp, jax.devices()[0].platform == "tpu")
     except SystemExit:
         raise
     except Exception as e:
         extra_rows = {"extra_rows_error": str(e)[:200]}
 
-    # attribute the non-dispatched encode path too, so a dispatch
-    # regression shows up in the artifact itself (the r01->r02
-    # regression was invisible because only the dispatched number was
-    # recorded). After the extra rows: the Pallas kernel's pathological
-    # lowering can itself degrade the remote session, and by now the
-    # session is post-d2h anyway — this number attributes the PATH
-    # CHOICE, not a clean-room kernel rate.
-    try:
-        from ceph_tpu.ops import pallas_gf
-        if jax.devices()[0].platform == "tpu" and \
-                n % pallas_gf._TILE_N == 0:
-            bm = jnp.asarray(tpu._bitmat)
-            if encode_path == "xla":
-                t_p = _bench_dev(
-                    lambda: pallas_gf.matrix_encode8(bm, data_dev), 3)
-                pallas_mbps = bytes_per_call / t_p / 1e6
-            else:
-                t_x = _bench_dev(
-                    lambda: xor_mm.pack_element_bits(xor_mm.xor_matmul(
-                        bm, xor_mm.unpack_element_bits(data_dev, W)),
-                        W), 3)
-                xla_mbps = bytes_per_call / t_x / 1e6
-    except Exception:
-        pass
+    # the honest fused-decode rate: its seal is the run's FIRST d2h,
+    # so every other device-resident timing is already in hand
+    dec_mbps = time_fused_chain()
+
+    # extra-row correctness gates (device->host) — only after the seal
+    for gate in extra_checks:
+        gate()
 
     # correctness gates (BASELINE.md attaches them to every row) run
     # only NOW — the np.asarray d2h transfers below are the session
     # poison the note above is about, so every timed device-resident
     # number is already in hand
+    print("BENCH-STAGE gates-d2h", file=sys.stderr, flush=True)
     full_host = np.asarray(full_dev)
     decoded = np.asarray(
         jax.block_until_ready(tpu.decode_batch(*mixed[-1])))
@@ -516,10 +771,7 @@ def run_bench() -> None:
         "vs_baseline": round(value / cpu_mbps, 2),
         "encode_MBps": round(enc_mbps, 1),
         "encode_path": encode_path,
-        "xla_encode_MBps": (round(xla_mbps, 1)
-                            if xla_mbps is not None else None),
-        "pallas_encode_MBps": (round(pallas_mbps, 1)
-                               if pallas_mbps is not None else None),
+        "xla_encode_MBps": round(xla_mbps, 1),
         "decode_MBps": round(dec_mbps, 1),
         "decode_warm_MBps": round(dec_warm_mbps, 1),
         "decode_dispatch_MBps": round(dec_dispatch_mbps, 1),
@@ -532,11 +784,26 @@ def run_bench() -> None:
         "object_size": OBJ_SIZE,
         "device": jax.devices()[0].platform,
     }
+    # end-to-end cluster pipeline row (rados-bench role) — runs last,
+    # host/transport-bound by design
+    print("BENCH-STAGE cluster", file=sys.stderr, flush=True)
+    cluster_rows: dict = {}
+    try:
+        cluster_rows = _bench_cluster()
+    except SystemExit:
+        raise
+    except Exception as e:
+        cluster_rows = {"cluster_bench_error": str(e)[:200]}
+
     doc.update(dec_e)
     doc.update(native)
     doc.update(extra_rows)
+    doc.update(cluster_rows)
     if "native_cpu_MBps" in doc:
         doc["vs_native"] = round(value / doc["native_cpu_MBps"], 2)
+    # no emitted rate may exceed single-chip physics — a violation is
+    # a timing artifact and fails the run rather than shipping
+    _roofline_gate(doc)
     print(json.dumps(doc))
 
 
@@ -567,6 +834,15 @@ def _supervised() -> None:
             if best is None or doc.get("value", 0) > best.get("value", 0):
                 best = doc
     if best is not None:
+        # sealed bulk-CRUSH rate from its own fresh process (the seal
+        # d2h degrades whatever session runs it, so neither worker
+        # run can host it; see _crush_sealed_worker)
+        best.update(_run_crush_sealed())
+        if "crush_bulk_pgs_per_s" in best and \
+                best.get("crush_scalar_pgs_per_s"):
+            best["crush_bulk_speedup"] = round(
+                best["crush_bulk_pgs_per_s"]
+                / best["crush_scalar_pgs_per_s"], 1)
         print(json.dumps(best))
         return
     try:
@@ -585,7 +861,9 @@ def _supervised() -> None:
 
 
 if __name__ == "__main__":
-    if "--worker" in sys.argv:
+    if "--crush-worker" in sys.argv:
+        _crush_sealed_worker()
+    elif "--worker" in sys.argv:
         main()
     else:
         _supervised()

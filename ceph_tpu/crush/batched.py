@@ -491,12 +491,18 @@ def _rule_shape(cmap: CrushMap, ruleno: int):
 
 
 def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
-                    weight=None, xs_sharding=None, choose_args=None):
+                    weight=None, xs_sharding=None, choose_args=None,
+                    device_out: bool = False):
     """Map a whole batch of inputs in one device program.
 
     xs: [B] int array of crush inputs (pg seeds). Returns [B, result_max]
     int64 (CRUSH_ITEM_NONE marks holes). Falls back to the scalar
     interpreter when the rule/map is outside the fast path.
+
+    device_out: return the device array WITHOUT the device->host copy
+    (the caller pulls results when it wants them — benchmarks time the
+    device sweep itself, and on some transports a d2h mid-run degrades
+    the session).
 
     choose_args: weight-set/ids substitution — an arg map dict
     (bucket_id -> {"ids", "weight_set"}) or an int selecting one of
@@ -511,14 +517,24 @@ def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
     import jax.numpy as jnp
 
     shape = _rule_shape(cmap, ruleno)
-    xs = np.asarray(xs)
+    # a device-resident seed array stays on device: np.asarray would
+    # silently d2h it (and on some transports one d2h degrades the
+    # session) — the device path consumes it directly
+    xs_is_dev = type(xs).__module__.startswith("jax")
+    if not xs_is_dev:
+        xs = np.asarray(xs)
     if isinstance(choose_args, int):
         choose_args = cmap.choose_args_get_with_fallback(choose_args)
 
     def scalar_fallback():
+        # host path: a device seed array is pulled once (device_out
+        # callers still receive a host array here — the fast path was
+        # unavailable, so there is nothing device-resident to return)
         from .mapper_ref import crush_do_rule
-        out = np.full((len(xs), result_max), CRUSH_ITEM_NONE, dtype=np.int64)
-        for i, x in enumerate(xs):
+        xs_host = np.asarray(xs)
+        out = np.full((len(xs_host), result_max), CRUSH_ITEM_NONE,
+                      dtype=np.int64)
+        for i, x in enumerate(xs_host):
             res = crush_do_rule(cmap, ruleno, int(x), result_max, weight,
                                 choose_args=choose_args)
             out[i, :len(res)] = res
@@ -583,6 +599,13 @@ def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
                      xs_dev,
                      jnp.asarray(weight, dtype=jnp.int64),
                      -1 - shape["root"])
+    if device_out:
+        if out.shape[1] < result_max:
+            with jax.enable_x64():
+                out = jnp.pad(out,
+                              ((0, 0), (0, result_max - out.shape[1])),
+                              constant_values=CRUSH_ITEM_NONE)
+        return out
     res = np.asarray(out)
     if res.shape[1] < result_max:
         pad = np.full((len(xs), result_max - res.shape[1]), CRUSH_ITEM_NONE,

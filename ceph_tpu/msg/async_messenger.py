@@ -588,36 +588,17 @@ class AsyncMessenger(Messenger):
             # auth acks ride _process_payload
 
     def send_message(self, msg, dest_addr) -> None:
-        # see Messenger.send_message: no fresh connections once
-        # shutdown has begun
         if dest_addr is None or self._stopping:
             return
         dest_addr = EntityAddr(*dest_addr)
         msg.from_name = self.name
-        with self._lock:
-            if self._stopping:
-                return
-            conn = self._conns.get(dest_addr)
-            if conn is None or conn.closed:
-                conn = AsyncConnection(self, dest_addr)
-                self._conns[dest_addr] = conn
-        conn.send(msg)
+        conn = self._conn_for_send(dest_addr, AsyncConnection)
+        if conn is not None:
+            conn.send(msg)
 
     def shutdown(self) -> None:
         self._stopping = True
-        with self._lock:
-            conns = list(self._conns.values()) + list(self._in_conns)
-            self._conns.clear()
-            self._in_conns.clear()
-        for conn in conns:
-            conn.close()
-        # a dispatch racing the sweep may have minted one more conn
-        with self._lock:
-            conns = list(self._conns.values()) + list(self._in_conns)
-            self._conns.clear()
-            self._in_conns.clear()
-        for conn in conns:
-            conn.close()
+        self._sweep_conns()
         if self._started:
             self.center.stop()
         if self._server is not None:

@@ -709,27 +709,24 @@ class Connection:
         seq = link_seq or None
         msg.link_seq = seq
         if seq is not None and self._dedup_key is not None:
-            # refresh from the messenger-level watermark: the previous
-            # incarnation's reader may still have been mid-dispatch
-            # when this connection snapshotted _in_seq at BANNER time
-            cur = self.msgr._delivered_seq(self._dedup_key)
-            if cur > self._in_seq:
-                self._in_seq = cur
-        if (seq is not None and self._dedup_key is not None
-                and seq <= self._in_seq):
-            # resend of a message this session already dispatched (its
-            # MSGACK was lost in the reconnect): ack again, do NOT
-            # re-deliver — exactly-once for the dispatchers
-            try:
-                send_bytes(self._encode_out(("MSGACK", seq)))
-            except OSError:
-                return False
-            return True
+            # ATOMIC admission at the messenger-level watermark: check
+            # and record under one lock, BEFORE dispatch. Check-then-
+            # record-after-dispatch would leave a window where a stale
+            # reader mid-dispatch and the new pipe's reader both pass
+            # the check and double-dispatch the same seq. Recording at
+            # admission keeps exactly-once; at-least-once holds because
+            # the MSGACK still only goes out after the dispatch ran.
+            if not self.msgr._admit(self._dedup_key, seq):
+                # resend of an already-admitted message (its MSGACK was
+                # lost in the reconnect): ack again, do NOT re-deliver
+                try:
+                    send_bytes(self._encode_out(("MSGACK", seq)))
+                except OSError:
+                    return False
+                return True
+            self._in_seq = max(self._in_seq, seq)
         self.msgr._dispatch(msg)
         if seq is not None:
-            if self._dedup_key is not None and seq > self._in_seq:
-                self._in_seq = seq
-                self.msgr._record_delivered(self._dedup_key, seq)
             # ack AFTER dispatch: delivery, not receipt (at-least-once)
             try:
                 send_bytes(self._encode_out(("MSGACK", seq)))
@@ -837,24 +834,40 @@ class Messenger:
             with self._lock:
                 self._in_conns.append(conn)
 
+    def _sweep_conns(self) -> None:
+        """Close every tracked connection, twice: a dispatch racing the
+        first sweep may mint one more connection before _stopping
+        lands (shared by both transports' shutdowns)."""
+        for _ in range(2):
+            with self._lock:
+                conns = (list(self._conns.values())
+                         + list(self._in_conns))
+                self._conns.clear()
+                self._in_conns.clear()
+            for conn in conns:
+                conn.close()
+
+    def _conn_for_send(self, dest_addr, conn_cls):
+        """Existing (or freshly minted) connection for dest_addr; None
+        once shutdown has begun — a send racing shutdown must not mint
+        an untracked connection whose writer re-dials the dead peer's
+        port forever (when a later process reuses the port, the zombie
+        connects and floods it)."""
+        with self._lock:
+            if self._stopping:
+                return None
+            conn = self._conns.get(dest_addr)
+            if conn is None or conn.closed:
+                conn = conn_cls(self, dest_addr)
+                self._conns[dest_addr] = conn
+                conn.start()
+            return conn
+
     def shutdown(self) -> None:
         self._stopping = True
         if self._server is not None:
             self._server.close()
-        with self._lock:
-            conns = list(self._conns.values()) + list(self._in_conns)
-            self._conns.clear()
-            self._in_conns.clear()
-        for conn in conns:
-            conn.close()
-        # a dispatch racing the sweep above may have minted one more
-        # connection before _stopping landed — sweep again
-        with self._lock:
-            conns = list(self._conns.values()) + list(self._in_conns)
-            self._conns.clear()
-            self._in_conns.clear()
-        for conn in conns:
-            conn.close()
+        self._sweep_conns()
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=2)
 
@@ -895,7 +908,11 @@ class Messenger:
         with self._lock:
             return self._delivered.get(key, 0)
 
-    def _record_delivered(self, key, seq: int) -> None:
+    def _admit(self, key, seq: int) -> bool:
+        """Atomic dedup admission: True exactly once per (key, seq<=)
+        — the watermark check AND advance happen under one lock, so
+        two readers (a stale pipe's and the fresh one's) can never
+        both win the same seq."""
         with self._lock:
             if key not in self._delivered:
                 self._delivered_order.append(key)
@@ -903,8 +920,10 @@ class Messenger:
                         self.DELIVERED_SESSIONS_MAX:
                     self._delivered.pop(self._delivered_order.pop(0),
                                         None)
-            if seq > self._delivered.get(key, 0):
-                self._delivered[key] = seq
+            if seq <= self._delivered.get(key, 0):
+                return False
+            self._delivered[key] = seq
+            return True
 
     def _notify_reset(self, addr) -> None:
         for d in self.dispatchers:
@@ -916,24 +935,13 @@ class Messenger:
     # -- send ----------------------------------------------------------
 
     def send_message(self, msg, dest_addr) -> None:
-        # a send racing shutdown must not mint a fresh connection: it
-        # would never be tracked (shutdown already swept _conns), and
-        # its writer would re-dial the dead peer's port forever — when
-        # a LATER process reuses that port, the zombie connects and
-        # floods it
         if dest_addr is None or self._stopping:
             return
         dest_addr = EntityAddr(*dest_addr)
         msg.from_name = self.name
-        with self._lock:
-            if self._stopping:
-                return
-            conn = self._conns.get(dest_addr)
-            if conn is None or conn.closed:
-                conn = Connection(self, dest_addr)
-                self._conns[dest_addr] = conn
-                conn.start()
-        conn.send(msg)
+        conn = self._conn_for_send(dest_addr, Connection)
+        if conn is not None:
+            conn.send(msg)
 
     def mark_down(self, dest_addr) -> None:
         """Drop the connection (Messenger::mark_down)."""

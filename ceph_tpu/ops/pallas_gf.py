@@ -1,21 +1,39 @@
-"""Pallas TPU kernel for the GF(2^8) bitplane encode.
+"""Pallas GF(2^8) bitplane encode — RETIRED from production dispatch.
 
-The XLA path (ops.xor_mm) lowers unpack -> int8 einsum -> pack as
-separate fused ops; this kernel does the whole thing in one VMEM
-residency per tile: bytes are expanded to bitplanes, hit the MXU as an
-int8 matmul against the [m*8, k*8] generator bitmatrix, and fold back
-to parity bytes — no intermediate bit tensor ever round-trips to HBM.
+POSTMORTEM (the SURVEY §7 step 2c bet, settled in round 4). The plan
+was a fused kernel: unpack bytes to bitplanes, one int8 MXU matmul
+against the [m*8, k*8] generator bitmatrix, pack back — one VMEM
+residency per tile, no intermediate bit tensor in HBM. Three layouts
+were built and measured on v5e-1 (B=16, k=8, m=3, N=128 KiB):
 
-Layout matches ops.gf_ref / ops.xor_mm exactly (bit b of byte j lives
-at row k*8+b), so outputs are bit-identical to the reference path —
-asserted by the tests, which run the kernel in interpreter mode on CPU.
+1. Generator-major panels (this file's kernel): each grid cell runs
+   [m*8, k*8] @ [k*8, T]. Measured 0.4-2.5 GB/s across every tile
+   size from 512 B to 64 KiB — Mosaic lowers the tiny 24x64 matmul
+   and the major-dim (k,8,T)->(k*8,T) bitplane reshape poorly, and
+   per-cell overhead dominates.
+2. Transposed panels ([T, k*8] @ [k*8, m*8], so the MXU row dim is
+   the tile): requires collapsing MINOR dims (T,k,8)->(T,k*8); Mosaic
+   rejects it — "infer-vector-layout: unsupported shape cast" on
+   tpu.reshape vector<2048x8x8xi32> -> vector<2048x64xi32>.
+3. Two contracting dims ([T,k,8] x [k,8,m*8] over (k,8) — no reshape
+   at all): Mosaic rejects it — "'tpu.matmul' op Not implemented: lhs
+   contracting dims must be of size 1".
 
-Scope: w=8 (the flagship RS configuration). OPT-IN via
-CEPH_TPU_PALLAS=1: measured on v5e-1 the XLA path runs at the HBM
-roofline (~583 GB/s encode at the bench shape) while this kernel
-reaches only ~2.5 GB/s at any tile size — Mosaic lowers the tiny
-[m*8, k*8] bitplane matmul poorly — so production dispatch stays on
-XLA (see ops.xor_mm._pallas_enabled).
+Meanwhile the XLA path (ops.xor_mm.matrix_encode: unpack -> int8
+einsum -> pack, fused by XLA) measures 583-760 GB/s at the same
+shape — ~0.95x of the v5e HBM roofline (~0.8 TB/s). The op is
+bandwidth-bound, so even a perfect kernel could only TIE the XLA
+path; with the expressible layout 300-1000x slower and the efficient
+layouts unbuildable on current Mosaic, the kernel is retired: the
+CEPH_TPU_PALLAS production dispatch hook is removed and xor_mm always
+uses XLA. (Routing production through this kernel is what caused the
+round-1->2 encode regression, 329 -> 149 GB/s.)
+
+The kernel below is KEPT under interpreter-mode tests as a layout
+oracle: it documents the exact bitplane layout contract
+(bit b of byte j lives at row j*8+b, matching ops.gf_ref/ops.xor_mm)
+and would be the starting point if a future Mosaic supports minor-dim
+bitplane reshapes.
 """
 
 from __future__ import annotations

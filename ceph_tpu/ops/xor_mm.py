@@ -21,28 +21,9 @@ device program.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
-
-
-@functools.lru_cache(maxsize=1)
-def _pallas_enabled() -> bool:
-    """The fused Pallas kernel (ops.pallas_gf) is OPT-IN
-    (CEPH_TPU_PALLAS=1). Measured on the v5e-1 bench shape
-    (B=16, k=8, m=3, N=128KiB): the XLA einsum path encodes at
-    ~583 GB/s — the HBM roofline neighborhood for this kernel's
-    traffic — while the Pallas kernel reaches only ~2.5 GB/s at every
-    tile size from 512B to 64KiB (Mosaic lowers the tiny [24,64]
-    bitplane matmul poorly). Routing the default path through Pallas
-    is what caused the r01->r02 encode regression (329 -> 149 GB/s);
-    the kernel stays available for experimentation but never serves
-    production dispatch unless explicitly requested."""
-    if os.environ.get("CEPH_TPU_PALLAS", "0") != "1":
-        return False
-    from . import pallas_gf
-    return pallas_gf.available()
 
 
 def xor_matmul(bitmat: jax.Array, bits: jax.Array) -> jax.Array:
@@ -92,24 +73,10 @@ def matrix_encode(bitmat: jax.Array, data: jax.Array, w: int) -> jax.Array:
     bitmat is the [m*w, k*w] bitplane expansion of the generator
     (gf.generator_to_bitmatrix); passing it as data (not static) lets one
     compiled program serve every generator of the same shape — decode
-    matrices included. The w=8 3-D shape can opt into the fused Pallas
-    kernel (CEPH_TPU_PALLAS=1) when the chunk length tiles evenly; the
-    default is the XLA path, which measures at the HBM roofline.
+    matrices included. This XLA path measures at ~0.95x of the v5e HBM
+    roofline; the fused Pallas kernel was retired after three layouts
+    (see ops.pallas_gf's postmortem) could not come within 300x of it.
     """
-    if w == 8 and data.ndim == 3 and _pallas_enabled():
-        from . import pallas_gf
-        n = data.shape[-1]
-        pad = (-n) % pallas_gf._TILE_N
-        if pad == 0:
-            return pallas_gf.matrix_encode8(bitmat, data)
-        if n >= pallas_gf._TILE_N:
-            # ragged tail: zero-pad to the tile (zeros are the XOR
-            # identity, so the padded columns encode to zeros) and
-            # slice back — the whole w=8 shape family rides the fused
-            # kernel, not just exact multiples
-            padded = jnp.pad(data, ((0, 0), (0, 0), (0, pad)))
-            return pallas_gf.matrix_encode8(bitmat, padded)[..., :n]
-        # tiny chunks (< one tile): the XLA path wins
     bits = unpack_element_bits(data, w)
     out_bits = xor_matmul(bitmat, bits)
     return pack_element_bits(out_bits, w)
