@@ -111,16 +111,16 @@ def _bench_dev(fn, iters, reps=3):
     return best
 
 
-def _bench_extra_rows(jax, jnp, on_tpu: bool) -> dict:
+def _bench_extra_rows(jax, jnp, on_tpu: bool) -> "tuple[dict, list]":
     """BASELINE.md rows 3-5: cauchy_good packetsize sweep best-point,
-    LRC k=4,m=2,l=3 over the jax_tpu inner plugin, SHEC k=8,m=4,c=3,
-    and the batched-CRUSH bulk remap rate vs the scalar interpreter.
-    Every row keeps the correctness gate: device output equals the
-    numpy reference / scalar oracle for the same inputs — but the
-    gates' device->host transfers are DEFERRED until every timed
-    device section has run (a single d2h permanently degrades this
-    tunnel's dispatch path ~100x); the host-math rows (shec decode,
-    crush) go last for the same reason."""
+    LRC k=4,m=2,l=3 over the jax_tpu inner plugin, SHEC k=8,m=4,c=3
+    (encode AND fused decode, both device-resident), and the
+    batched-CRUSH oracle-gate material. Returns (rows, gates): every
+    row keeps its correctness gate — device output equals the numpy
+    reference / scalar oracle for the same inputs — but the gates are
+    returned UNRUN because each is a device->host transfer, and the
+    caller must run them only after the sealed fused-decode timing
+    (a single d2h permanently degrades this tunnel's session)."""
     import numpy as np
 
     from ceph_tpu import registry
@@ -314,7 +314,8 @@ def _bench_cluster() -> dict:
             client, "bench-ec",
             {"plugin": "jerasure", "technique": "reed_sol_van",
              "k": "2", "m": "1", "w": "8"}, pg_num=8)
-        c.wait_clean(pool_id)
+        if not c.wait_clean(pool_id):
+            raise RuntimeError("bench-ec pool never went clean")
         ioctx = client.open_ioctx("bench-ec")
         obj_bytes = 1 << 18            # 256 KiB objects
         n_objs, writers = 32, 8
@@ -387,7 +388,7 @@ def _roofline_gate(doc: dict) -> None:
     for key, val in doc.items():
         if not isinstance(val, (int, float)):
             continue
-        if key.endswith("_MBps") or key == "value":
+        if "_MBps" in key or key == "value":
             if val > ROOFLINE_MBPS:
                 raise SystemExit(
                     "roofline gate: %s = %.0f MB/s exceeds the "

@@ -30,7 +30,17 @@ def free_ports(n):
 
 
 def wait_until(fn, timeout=10.0, interval=0.02):
-    deadline = time.monotonic() + timeout
+    """Poll fn until truthy, with LOAD TOLERANCE: on this one-core box
+    a full-suite run starves daemon threads, and conditions that
+    resolve in milliseconds on an idle machine can take tens of
+    seconds. The effective deadline is min(max(timeout, 60), 6x) —
+    small timeouts scale 6x, mid-range ones reach the 60s flake floor,
+    large ones pass through unchanged — so callers' bounds keep their
+    proportions while load flakes become (at worst) slower reporting
+    of REAL failures, never slower successes (the poll returns the
+    moment fn() holds)."""
+    effective = min(max(timeout, 60.0), timeout * 6)
+    deadline = time.monotonic() + effective
     while time.monotonic() < deadline:
         if fn():
             return True

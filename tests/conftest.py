@@ -39,30 +39,9 @@ def pytest_sessionfinish(session, exitstatus):
             print(v)
 
 
-# -- heavy-test gating -------------------------------------------------
-# The default run (what CI / the driver executes: `pytest tests/ -x -q`)
-# skips tests marked `heavy` — long chaos/thrash scenarios whose value
-# is stress coverage, not regression signal — keeping it well under
-# 10 minutes. `pytest --heavy` (or CEPH_TPU_HEAVY=1) runs everything.
-
-def pytest_addoption(parser):
-    parser.addoption(
-        "--heavy", action="store_true", default=False,
-        help="also run tests marked 'heavy' (long chaos/thrash runs)")
-
-
-def pytest_configure(config):
-    config.addinivalue_line(
-        "markers", "heavy: long chaos/stress test, skipped by default "
-        "(enable with --heavy or CEPH_TPU_HEAVY=1)")
-
-
-def pytest_collection_modifyitems(config, items):
-    import pytest
-    if config.getoption("--heavy") or os.environ.get("CEPH_TPU_HEAVY"):
-        return
-    skip = pytest.mark.skip(
-        reason="heavy (run with --heavy or CEPH_TPU_HEAVY=1)")
-    for item in items:
-        if "heavy" in item.keywords:
-            item.add_marker(skip)
+# NOTE: an earlier revision carried a `heavy` marker + --heavy gating
+# here, but no test ever used it — the full suite (chaos/thrash runs
+# included) finishes in ~5 minutes, so nothing is worth hiding from
+# the default run. The infra was removed rather than kept as dead
+# code; reintroduce it only if a genuinely multi-minute scenario ever
+# lands.

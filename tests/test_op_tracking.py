@@ -147,19 +147,31 @@ class TestOsdIntegration:
             ioctx = client.open_ioctx("traced")
             ioctx.write_full("tobj", b"traced payload")
             assert ioctx.read("tobj") == b"traced payload"
-            hist = sum(
-                osd.op_tracker.dump_historic_ops()["num_ops"]
-                for osd in cluster.osds.values())
-            assert hist >= 2  # at least the write + the read
-            some_events = [
-                e["event"]
-                for osd in cluster.osds.values()
-                for o in osd.op_tracker.dump_historic_ops()["ops"]
-                for e in o["type_data"]["events"]]
-            assert "reached_pg" in some_events
-            spans = [s for osd in cluster.osds.values()
-                     for s in osd.tracer.dump()]
-            assert any(s["name"] == "osd_op" for s in spans)
-            assert any(s["name"] == "pg_do_op" for s in spans)
+            # event-driven: the client reply races the server-side
+            # history/span flush — wait for the tracker state instead
+            # of asserting it the instant the reply lands
+            from .cluster_util import wait_until
+
+            def hist_flushed():
+                return sum(
+                    osd.op_tracker.dump_historic_ops()["num_ops"]
+                    for osd in cluster.osds.values()) >= 2
+            assert wait_until(hist_flushed)  # the write + the read
+
+            def events_flushed():
+                return "reached_pg" in [
+                    e["event"]
+                    for osd in cluster.osds.values()
+                    for o in osd.op_tracker.dump_historic_ops()["ops"]
+                    for e in o["type_data"]["events"]]
+            assert wait_until(events_flushed)
+
+            def spans_flushed():
+                spans = [s for osd in cluster.osds.values()
+                         for s in osd.tracer.dump()]
+                return (any(s["name"] == "osd_op" for s in spans)
+                        and any(s["name"] == "pg_do_op"
+                                for s in spans))
+            assert wait_until(spans_flushed)
         finally:
             cluster.stop()
