@@ -17,7 +17,7 @@ import time
 from ..common import Context
 from ..common.throttle import Throttle
 from ..mon.mon_client import MonClient
-from ..msg.message import MOSDOp, MWatchNotifyAck
+from ..msg.message import MOSDOp, MWatchNotifyAck, OSD_READ_OPS
 from ..msg.async_messenger import create_messenger
 from ..msg.messenger import Dispatcher
 
@@ -119,6 +119,28 @@ class RadosClient(Dispatcher):
 
     # -- op submission (Objecter::op_submit collapsed) ------------------
 
+    # op kinds that never mutate; anything else makes the message a
+    # write for tier-overlay routing purposes (shared with the OSD so
+    # client routing and server handling can never disagree)
+    READ_KINDS = OSD_READ_OPS
+
+    def _resolve_overlay(self, pool_id: int, ops: list,
+                         ignore_overlay: bool) -> int:
+        """Cache-tier overlay redirect (Objecter::_calc_target,
+        src/osdc/Objecter.cc: reads target the pool's read_tier, writes
+        its write_tier, unless CEPH_OSD_FLAG_IGNORE_OVERLAY rides the
+        op — which is how flush/promote IO reaches the base pool)."""
+        if ignore_overlay:
+            return pool_id
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is None:
+            return pool_id
+        is_write = any(op[0] not in self.READ_KINDS for op in ops)
+        tgt = pool.write_tier if is_write else pool.read_tier
+        if tgt >= 0 and tgt in self.osdmap.pools:
+            return tgt
+        return pool_id
+
     def _target_for(self, pool_id: int, oid: str):
         m = self.osdmap
         raw_pg = m.object_to_pg(pool_id, oid)
@@ -129,7 +151,9 @@ class RadosClient(Dispatcher):
 
     def submit_op(self, pool_id: int, oid: str, ops: list,
                   timeout: float = 30.0, pgid=None,
-                  snapc=None, snap: int = 0):
+                  snapc=None, snap: int = 0,
+                  ignore_overlay: bool = False,
+                  flags: int = 0):
         """Send; resend on EAGAIN/timeout slices until deadline.
 
         pgid pins the target PG explicitly (PG-scoped ops like list);
@@ -158,7 +182,11 @@ class RadosClient(Dispatcher):
                     _, _, _, primary = \
                         self.osdmap.pg_to_up_acting_osds(pgid)
                 else:
-                    pgid, primary = self._target_for(pool_id, oid)
+                    # overlay resolves per attempt: a tier change in a
+                    # newer map must retarget the resend
+                    eff_pool = self._resolve_overlay(pool_id, ops,
+                                                     ignore_overlay)
+                    pgid, primary = self._target_for(eff_pool, oid)
                 if primary == -1:
                     time.sleep(min(backoff, remaining))
                     backoff = min(backoff * 2, 0.5)
@@ -174,7 +202,7 @@ class RadosClient(Dispatcher):
                            oid=oid, ops=ops,
                            map_epoch=self.osdmap.epoch,
                            snapc=snapc or (0, ()), snap=snap,
-                           session=self.session), addr)
+                           session=self.session, flags=flags), addr)
                 # wait a slice, then re-send (map may have changed)
                 if op.event.wait(min(remaining, 1.0)):
                     if op.result == -11:  # EAGAIN: wrong/unready primary
@@ -206,6 +234,12 @@ class IoCtx:
         self.pool_id = pool_id
         self._snapc = None            # self-managed SnapContext override
         self._read_snap = 0           # snap id reads resolve against
+        # CEPH_OSD_FLAG_IGNORE_OVERLAY analog: ops on this ioctx bypass
+        # any cache-tier overlay and hit the pool directly
+        self.ignore_overlay = False
+        # CEPH_OSD_FLAG_IGNORE_CACHE analog: the addressed PG runs the
+        # op locally even on a cache-tier pool (no promote/proxy)
+        self.ignore_cache = False
 
     def _pool(self):
         return self.client.osdmap.pools.get(self.pool_id) \
@@ -219,11 +253,14 @@ class IoCtx:
 
     def _op(self, oid: str, ops: list, timeout: float = 30.0,
             snap_override: int | None = None):
+        from ..msg.message import OSD_FLAG_IGNORE_CACHE
         result, data = self.client.submit_op(
             self.pool_id, oid, ops, timeout,
             snapc=self._write_snapc(),
             snap=self._read_snap if snap_override is None
-            else snap_override)
+            else snap_override,
+            ignore_overlay=self.ignore_overlay,
+            flags=OSD_FLAG_IGNORE_CACHE if self.ignore_cache else 0)
         if result < 0:
             raise RadosError(-result, "op on %r failed: %d"
                              % (oid, result))
@@ -368,6 +405,9 @@ class IoCtx:
     def set_xattr(self, oid: str, name: str, value: bytes) -> None:
         self._op(oid, [("setxattr", name, value)])
 
+    def rm_xattr(self, oid: str, name: str) -> None:
+        self._op(oid, [("rmxattr", name)])
+
     def omap_set(self, oid: str, kv: dict) -> None:
         self._op(oid, [("omap_set", kv)])
 
@@ -389,6 +429,27 @@ class IoCtx:
 
     def stat(self, oid: str) -> dict:
         return self._op(oid, [("stat",)])
+
+    def get_xattrs(self, oid: str) -> dict:
+        """All user xattrs (rados_getxattrs / CEPH_OSD_OP_GETXATTRS)."""
+        return self._op(oid, [("getxattrs",)])
+
+    def cache_flush(self, oid: str, timeout: float = 30.0) -> None:
+        """Write a dirty cache-tier object back to its base pool
+        (rados_cache_flush, CEPH_OSD_OP_CACHE_FLUSH). Target the cache
+        pool directly."""
+        self._op(oid, [("cache_flush",)], timeout)
+
+    def cache_try_flush(self, oid: str, timeout: float = 30.0) -> None:
+        """Non-blocking flavor: fails EBUSY instead of waiting for a
+        racing writer (CEPH_OSD_OP_CACHE_TRY_FLUSH)."""
+        self._op(oid, [("cache_try_flush",)], timeout)
+
+    def cache_evict(self, oid: str, timeout: float = 30.0) -> None:
+        """Drop a CLEAN object from the cache tier
+        (rados_cache_evict, CEPH_OSD_OP_CACHE_EVICT); EBUSY when dirty,
+        watched, or snapshotted."""
+        self._op(oid, [("cache_evict",)], timeout)
 
     def get_xattr(self, oid: str, name: str) -> bytes:
         return self._op(oid, [("getxattr", name)])

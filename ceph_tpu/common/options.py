@@ -88,6 +88,9 @@ def _declare_defaults():
     o("osd_op_queue_mclock_recovery_res", float, 0.0, LEVEL_ADVANCED)
     o("osd_op_queue_mclock_recovery_wgt", float, 1.0, LEVEL_ADVANCED)
     o("osd_op_queue_mclock_recovery_lim", float, 0.0, LEVEL_ADVANCED)
+    o("osd_agent_interval", float, 0.25, LEVEL_ADVANCED,
+      "seconds between tier-agent flush/evict passes "
+      "(osd_agent_delay_time role, scaled for in-process clusters)")
     o("osd_tpu_coalesce", bool, True, LEVEL_ADVANCED,
       "batch concurrent EC device calls sharing a codec/decode matrix "
       "into one dispatch (osd/tpu_dispatch.py)")

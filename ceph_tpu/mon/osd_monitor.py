@@ -206,6 +206,18 @@ class OSDMonitor:
                 return self._pool_rmsnap(cmd)
             if prefix == "osd pool selfmanaged-snap-create":
                 return self._selfmanaged_snap_create(cmd)
+            if prefix == "osd tier add":
+                return self._tier_add(cmd)
+            if prefix == "osd tier remove":
+                return self._tier_remove(cmd)
+            if prefix == "osd tier cache-mode":
+                return self._tier_cache_mode(cmd)
+            if prefix == "osd tier set-overlay":
+                return self._tier_set_overlay(cmd)
+            if prefix == "osd tier remove-overlay":
+                return self._tier_remove_overlay(cmd)
+            if prefix == "osd pool set":
+                return self._pool_set(cmd)
             if prefix == "osd pool selfmanaged-snap-remove":
                 pool = self._find_pool(cmd.get("pool", ""))
                 if pool is None:
@@ -220,8 +232,21 @@ class OSDMonitor:
 
     # -- snapshots (OSDMonitor pool snap commands) ---------------------
 
+    def _effective_pools(self) -> dict:
+        """Committed pools OVERLAID with the pending incremental:
+        consecutive commands in one propose window (tier add ->
+        cache-mode -> set-overlay) must each see their predecessors'
+        staged state, exactly as the reference's prepare_command reads
+        pending_inc-adjusted pools."""
+        pools = dict(self.osdmap.pools)
+        if self.pending is not None:
+            pools.update(self.pending.new_pools)
+            for pool_id in self.pending.old_pools:
+                pools.pop(pool_id, None)
+        return pools
+
     def _find_pool(self, name):
-        for pool in self.osdmap.pools.values():
+        for pool in self._effective_pools().values():
             if pool.name == name:
                 return pool
         return None
@@ -268,6 +293,149 @@ class OSDMonitor:
         staged.removed_snaps = list(staged.removed_snaps) + [snap_id]
         self.mon.propose_soon()
         return 0, "removed pool %s snap %s" % (pool.name, snap), snap_id
+
+    # -- cache tiering (OSDMonitor::prepare_command "osd tier ...",
+    # src/mon/OSDMonitor.cc tier add/remove/cache-mode/set-overlay) ----
+
+    CACHE_MODES = ("none", "writeback", "readproxy", "readonly",
+                   "forward")
+
+    # pool vars settable at runtime ("osd pool set"), name -> caster
+    POOL_VARS = {
+        "target_max_objects": int,
+        "target_max_bytes": int,
+        "cache_target_dirty_ratio": float,
+        "cache_target_full_ratio": float,
+        "cache_min_flush_age": int,
+        "cache_min_evict_age": int,
+        "hit_set_period": int,
+        "hit_set_count": int,
+        "hit_set_fpp": float,
+        "size": int,
+        "min_size": int,
+    }
+
+    def _tier_add(self, cmd: dict):
+        base = self._find_pool(cmd.get("pool", ""))
+        tier = self._find_pool(cmd.get("tierpool", ""))
+        if base is None or tier is None:
+            return -2, "pool does not exist", None
+        if base.pool_id == tier.pool_id:
+            # a self-tier would make every promote recurse into the
+            # pool it is promoting for and deadlock the tier threads
+            return -22, "a pool cannot be a tier of itself", None
+        if tier.is_erasure():
+            # cache pools must be replicated: the tier path needs
+            # synchronous local reads (same constraint as cls)
+            return -95, "tier pool must be replicated", None
+        if tier.is_tier() or tier.has_tiers():
+            return -16, "pool %s is already involved in tiering" \
+                % tier.name, None
+        if base.is_tier():
+            return -16, "pool %s is itself a tier" % base.name, None
+        staged_tier = self._pending_pool(tier)
+        staged_base = self._pending_pool(base)
+        staged_tier.tier_of = base.pool_id
+        staged_base.tiers = list(staged_base.tiers) + [tier.pool_id]
+        self.mon.propose_soon()
+        return 0, "pool %s is now a tier of %s" \
+            % (tier.name, base.name), None
+
+    def _tier_remove(self, cmd: dict):
+        base = self._find_pool(cmd.get("pool", ""))
+        tier = self._find_pool(cmd.get("tierpool", ""))
+        if base is None or tier is None:
+            return -2, "pool does not exist", None
+        if tier.tier_of != base.pool_id:
+            return -2, "pool %s is not a tier of %s" \
+                % (tier.name, base.name), None
+        if base.read_tier == tier.pool_id or \
+                base.write_tier == tier.pool_id:
+            return -16, "remove the overlay first", None
+        staged_tier = self._pending_pool(tier)
+        staged_base = self._pending_pool(base)
+        staged_tier.tier_of = -1
+        staged_tier.cache_mode = "none"
+        staged_base.tiers = [t for t in staged_base.tiers
+                             if t != tier.pool_id]
+        self.mon.propose_soon()
+        return 0, "pool %s is no longer a tier of %s" \
+            % (tier.name, base.name), None
+
+    def _apply_overlay(self, staged_base, tier) -> None:
+        """read_tier always points at the overlay; write_tier only when
+        the cache mode accepts writes (readonly caches let writes go
+        straight to the base pool)."""
+        staged_base.read_tier = tier.pool_id
+        staged_base.write_tier = (-1 if tier.cache_mode == "readonly"
+                                  else tier.pool_id)
+
+    def _tier_cache_mode(self, cmd: dict):
+        tier = self._find_pool(cmd.get("pool", ""))
+        if tier is None:
+            return -2, "pool does not exist", None
+        mode = cmd.get("mode", "")
+        if mode not in self.CACHE_MODES:
+            return -22, "invalid cache mode %r" % mode, None
+        if not tier.is_tier():
+            return -22, "pool %s is not a tier" % tier.name, None
+        base = self._effective_pools().get(tier.tier_of)
+        live = base is not None and base.read_tier == tier.pool_id
+        if mode == "none" and live:
+            # disabling the cache logic while clients still route
+            # through the overlay would strand writes in the tier pool
+            # forever (nothing promotes, nothing flushes)
+            return -16, "remove the overlay first", None
+        staged = self._pending_pool(tier)
+        staged.cache_mode = mode
+        if live:
+            # mode change on the live overlay retunes the redirect
+            self._apply_overlay(self._pending_pool(base), staged)
+        self.mon.propose_soon()
+        return 0, "set cache-mode for pool %s to %s" \
+            % (tier.name, mode), None
+
+    def _tier_set_overlay(self, cmd: dict):
+        base = self._find_pool(cmd.get("pool", ""))
+        tier = self._find_pool(cmd.get("overlaypool", ""))
+        if base is None or tier is None:
+            return -2, "pool does not exist", None
+        if tier.tier_of != base.pool_id:
+            return -22, "pool %s is not a tier of %s" \
+                % (tier.name, base.name), None
+        if tier.cache_mode == "none":
+            return -22, "set a cache-mode on %s first" % tier.name, None
+        self._apply_overlay(self._pending_pool(base), tier)
+        self.mon.propose_soon()
+        return 0, "overlay for %s is now %s" \
+            % (base.name, tier.name), None
+
+    def _tier_remove_overlay(self, cmd: dict):
+        base = self._find_pool(cmd.get("pool", ""))
+        if base is None:
+            return -2, "pool does not exist", None
+        staged = self._pending_pool(base)
+        staged.read_tier = -1
+        staged.write_tier = -1
+        self.mon.propose_soon()
+        return 0, "removed the overlay for %s" % base.name, None
+
+    def _pool_set(self, cmd: dict):
+        pool = self._find_pool(cmd.get("pool", ""))
+        if pool is None:
+            return -2, "pool %r does not exist" % cmd.get("pool"), None
+        var = cmd.get("var", "")
+        caster = self.POOL_VARS.get(var)
+        if caster is None:
+            return -22, "unsettable pool var %r" % var, None
+        try:
+            val = caster(cmd.get("val"))
+        except (TypeError, ValueError):
+            return -22, "invalid value %r for %s" % (cmd.get("val"),
+                                                     var), None
+        setattr(self._pending_pool(pool), var, val)
+        self.mon.propose_soon()
+        return 0, "set pool %s %s to %s" % (pool.name, var, val), None
 
     def _selfmanaged_snap_create(self, cmd: dict):
         """Allocate a self-managed snap id (the librados

@@ -80,6 +80,19 @@ class MOSDOp(Message):
     session: str = ""              # per-client nonce: the dedup key
                                    # survives client-id/tid reuse
                                    # across processes
+    flags: int = 0                 # OSD_FLAG_* (appended field)
+
+
+# CEPH_OSD_FLAG_IGNORE_CACHE (src/include/rados.h): run the op on the
+# addressed pool directly — no cache-tier promote/proxy interposition
+OSD_FLAG_IGNORE_CACHE = 1
+
+# The op kinds that never mutate (CEPH_OSD_OP_MODE_RD set). ONE shared
+# definition: the client's overlay routing, the PG's read/write split,
+# and the tier's promote decision must all agree on what a read is.
+OSD_READ_OPS = frozenset(("read", "stat", "getxattr", "getxattrs",
+                          "omap_get", "list", "list_snaps",
+                          "copy_get"))
 
 
 @dataclass

@@ -11,6 +11,7 @@ traffic classes on separate messengers (src/ceph_osd.cc:461-483).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
@@ -91,6 +92,11 @@ class OSDDaemon(Dispatcher):
             self.tpu_dispatcher = None
         self.hb_peers: dict = {}       # osd -> last reply stamp
         self.hb_pending: dict = {}     # osd -> first unacked ping stamp
+        # cache tiering: base-pool IO runs on dedicated threads with an
+        # internal RadosClient (the reference OSD's objecter), never on
+        # an op-shard worker (the base PG may live on THIS osd)
+        self._tier_pool = None
+        self._tier_client = None
         self.mgr_addr = None           # set when an mgr joins the cluster
         self._boot_sent_epoch = -1     # epoch of the last MOSDBoot sent
         self._boot_sent_at = 0.0       # for boot retransmit rate-limit
@@ -121,6 +127,7 @@ class OSDDaemon(Dispatcher):
         self.mon_client.sub_want()
         self._boot()
         self._hb_tick()
+        self._agent_tick()
 
     def _boot(self, epoch: int | None = None) -> None:
         # record the epoch of the map that PROMPTED this boot (the new
@@ -140,6 +147,13 @@ class OSDDaemon(Dispatcher):
         self.timer.shutdown()
         if self.tpu_dispatcher is not None:
             self.tpu_dispatcher.shutdown()
+        with self.lock:
+            tier_pool, self._tier_pool = self._tier_pool, None
+            tier_client, self._tier_client = self._tier_client, None
+        if tier_pool is not None:
+            tier_pool.shutdown(wait=False, cancel_futures=True)
+        if tier_client is not None:
+            tier_client.shutdown()
         self.op_wq.stop()
         self.finisher.stop()
         for msgr in (self.public_msgr, self.cluster_msgr, self.hb_msgr):
@@ -223,6 +237,74 @@ class OSDDaemon(Dispatcher):
         self.op_wq.queue(pg.pgid, pg.scrub, seq, deep, klass="scrub",
                          priority=self.recovery_op_priority)
         return True
+
+    # -- cache tiering plumbing ----------------------------------------
+
+    def tier_submit(self, fn, *args) -> None:
+        """Run blocking cross-pool tier IO on the dedicated tier
+        threads (lazily created; most OSDs never host a tier PG).
+        Work arriving after shutdown began is dropped — recreating the
+        pool post-teardown would leak threads past daemon stop."""
+        with self.lock:
+            if not self._running:
+                return
+            if self._tier_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._tier_pool = ThreadPoolExecutor(
+                    max_workers=2,
+                    thread_name_prefix="osd%d-tier" % self.whoami)
+            pool = self._tier_pool
+        pool.submit(self._tier_run, fn, *args)
+
+    @staticmethod
+    def _tier_run(fn, *args) -> None:
+        try:
+            fn(*args)
+        except Exception:
+            logging.getLogger("ceph_tpu.osd").exception(
+                "tier operation failed")
+
+    def tier_client(self):
+        """The OSD-internal RadosClient the tier path uses for base-
+        pool IO (the reference OSD's own Objecter)."""
+        with self.lock:
+            if not self._running:
+                raise RuntimeError("osd.%d shutting down" % self.whoami)
+            client = self._tier_client
+        if client is not None:
+            return client
+        from ..client.rados import RadosClient
+        fresh = RadosClient(self.monmap, client_id=100000 + self.whoami)
+        fresh.connect()
+        with self.lock:
+            if self._running and self._tier_client is None:
+                self._tier_client = fresh
+                fresh = None
+            client = self._tier_client
+        if fresh is not None:
+            fresh.shutdown()    # lost the creation race / shutting down
+        if client is None:
+            raise RuntimeError("osd.%d shutting down" % self.whoami)
+        return client
+
+    def _agent_tick(self) -> None:
+        """Periodic tier-agent pass over primary cache-tier PGs
+        (OSD::tick -> agent_entry role)."""
+        if not self._running:
+            return
+        with self.lock:
+            pgs = list(self.pgs.values())
+        for pg in pgs:
+            pool = pg.pool
+            if pool.is_tier() \
+                    and pool.cache_mode in ("writeback", "readproxy") \
+                    and pg.is_primary() and pg.peer_state == "active" \
+                    and (pool.target_max_objects > 0
+                         or pool.target_max_bytes > 0):
+                self.tier_submit(pg._tier().agent_scan)
+        self.timer.add_event_after(
+            self.ctx.conf.get_val("osd_agent_interval"),
+            self._agent_tick)
 
     def queue_recovery(self, pg) -> None:
         self.op_wq.queue(pg.pgid, pg.start_recovery,

@@ -86,6 +86,23 @@ class PGPool:
     snap_seq: int = 0
     snaps: dict = field(default_factory=dict)      # name -> snap id
     removed_snaps: list = field(default_factory=list)
+    # cache tiering (pg_pool_t tier fields, src/osd/osd_types.h:1230-1320:
+    # tier_of / tiers / read_tier / write_tier / cache_mode, hit_set and
+    # agent-target knobs)
+    tier_of: int = -1                  # base pool this pool caches for
+    tiers: list = field(default_factory=list)   # cache pools over us
+    read_tier: int = -1                # overlay: reads redirect here
+    write_tier: int = -1               # overlay: writes redirect here
+    cache_mode: str = "none"     # none|writeback|readproxy|readonly|forward
+    hit_set_count: int = 4
+    hit_set_period: int = 0            # seconds; 0 disables hit sets
+    hit_set_fpp: float = 0.05          # bloom false-positive target
+    target_max_objects: int = 0
+    target_max_bytes: int = 0
+    cache_target_dirty_ratio: float = 0.4
+    cache_target_full_ratio: float = 0.8
+    cache_min_flush_age: int = 0       # seconds
+    cache_min_evict_age: int = 0       # seconds
 
     def snap_context(self) -> tuple:
         """Pool-snap SnapContext for writes: (seq, ids descending)."""
@@ -111,6 +128,14 @@ class PGPool:
 
     def is_erasure(self) -> bool:
         return self.type == POOL_TYPE_ERASURE
+
+    def is_tier(self) -> bool:
+        """Is this pool a cache tier over another pool?
+        (pg_pool_t::is_tier)"""
+        return self.tier_of >= 0
+
+    def has_tiers(self) -> bool:
+        return bool(self.tiers)
 
     def raw_pg_to_pg(self, pgid: PGID) -> PGID:
         return PGID(pgid.pool,

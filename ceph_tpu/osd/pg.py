@@ -65,6 +65,19 @@ def is_clone_oid(oid) -> bool:
     return isinstance(oid, str) and "@" in oid
 
 
+def is_user_xattr(name: str) -> bool:
+    """Is this xattr CLIENT-visible? Internal bookkeeping attrs are
+    underscore-prefixed, and the EC hinfo is filtered by name exactly
+    like the reference (PrimaryLogPG GETXATTRS strips
+    ECUtil::get_hinfo_key()). One definition — getxattrs, copy_get,
+    resetxattrs, and the tier flush all share it."""
+    return not name.startswith("_") and name != "hinfo_key"
+
+
+def user_xattrs(attrs: dict) -> dict:
+    return {k: v for k, v in attrs.items() if is_user_xattr(k)}
+
+
 class PG:
     def __init__(self, daemon, pgid, pool):
         self.daemon = daemon
@@ -114,6 +127,7 @@ class PG:
         self.watchers: dict = {}      # oid -> {cookie: client addr}
         self._notifies: dict = {}     # notify_id -> state
         self._notify_seq = 0
+        self._tier_state = None       # PGTier, created on first use
         if pool.is_erasure():
             from .. import registry
             profile = daemon.ec_profile_for(pool)
@@ -389,6 +403,45 @@ class PG:
                 return alive >= k and self.is_primary()
             return self.is_primary()
 
+    # -- cache tiering -------------------------------------------------
+
+    def _tier(self):
+        """Per-PG cache-tier state (osd/tiering.py), lazily attached —
+        a pool becomes a tier via a map change after the PG exists.
+        Creation is locked: the agent timer thread and the op-shard
+        worker race here, and two PGTier instances would split the
+        atime/hit-set/inflight state between them."""
+        with self.lock:
+            if self._tier_state is None:
+                from .tiering import PGTier
+                self._tier_state = PGTier(self)
+            return self._tier_state
+
+    def submit_internal_write(self, oid, t: PGTransaction,
+                              logical_size, on_commit,
+                              deleting: bool = False) -> bool:
+        """Apply an OSD-internal mutation (promote install, dirty
+        clear, evict, hit-set archive) through the normal replicated
+        backend so replicas and the PG log stay consistent — the tier
+        machinery must never write the store behind the log's back.
+
+        Returns False WITHOUT submitting when this daemon is no longer
+        the active primary: deferred tier work (an agent pass queued
+        seconds ago) must not mint versions on a demoted primary's
+        stale chain — a zombie agent could otherwise delete an object
+        the NEW primary just rewrote."""
+        with self.lock:
+            if not self.is_primary() or self.peer_state != "active":
+                return False
+            self.last_version += 1
+            version = self.last_version
+        if not deleting:
+            t.setattr(oid, VERSION_ATTR, str(version).encode())
+            if logical_size is not None:
+                t.setattr(oid, "_size", str(logical_size).encode())
+        self.backend.submit_transaction(t, version, on_commit)
+        return True
+
     # -- client op execution (PrimaryLogPG::do_op collapsed) -----------
 
     def do_op(self, msg, reply_fn) -> None:
@@ -431,15 +484,28 @@ class PG:
                            else -1),
                     oid=msg.oid, map_epoch=self.map_epoch()))
             return
+        # cache-tier interposition (PrimaryLogPG::maybe_handle_cache):
+        # a tier-pool PG may promote, proxy, or answer the op itself —
+        # unless the client pinned the op to this pool (IGNORE_CACHE).
+        # The explicit cache control ops are tier ops by definition and
+        # ignore the flag.
+        from ..msg.message import OSD_FLAG_IGNORE_CACHE
+        if self.pool.is_tier() and self.pool.cache_mode != "none" \
+                and self.active_for_read():
+            op0 = msg.ops[0][0] if msg.ops else ""
+            if (not (getattr(msg, "flags", 0) & OSD_FLAG_IGNORE_CACHE)
+                    or op0 in ("cache_flush", "cache_try_flush",
+                               "cache_evict")):
+                if self._tier().maybe_handle(msg, reply_fn):
+                    return
         if any(op[0] == "call" for op in msg.ops):
             self._do_call_op(msg, reply_fn)
             return
         if msg.ops and msg.ops[0][0] in ("watch", "unwatch", "notify"):
             self._do_watch_ops(msg, reply_fn)
             return
-        reads = [op for op in msg.ops if op[0] in
-                 ("read", "stat", "getxattr", "omap_get", "list",
-                  "list_snaps")]
+        from ..msg.message import OSD_READ_OPS
+        reads = [op for op in msg.ops if op[0] in OSD_READ_OPS]
         if reads and len(reads) == len(msg.ops):
             self._do_read_ops(msg, reply_fn)
             return
@@ -594,6 +660,7 @@ class PG:
             oid = resolved
         elif self._is_whiteout(oid) and kind in ("read", "stat",
                                                  "getxattr",
+                                                 "getxattrs",
                                                  "omap_get"):
             reply_fn(-2, None)       # tombstone reads as absent
             return
@@ -611,6 +678,16 @@ class PG:
             except KeyError:
                 reply_fn(-2, None)
             return
+        if kind == "getxattrs":
+            # CEPH_OSD_OP_GETXATTRS: every USER xattr
+            cid = self.cid_of_shard(self.my_shard())
+            try:
+                attrs = self.store.getattrs(cid, oid)
+            except KeyError:
+                reply_fn(-2, None)
+                return
+            reply_fn(0, user_xattrs(attrs))
+            return
         if kind == "omap_get":
             cid = self.cid_of_shard(self.my_shard())
             try:
@@ -618,10 +695,16 @@ class PG:
             except KeyError:
                 reply_fn(-2, None)
             return
+        if kind == "copy_get":
+            self._do_copy_get(oid, reply_fn)
+            return
         if kind == "list":
+            from .tiering import HITSET_PREFIX
             cid = self.cid_of_shard(self.my_shard())
             reply_fn(0, [o for o in self.store.list_objects(cid)
-                         if o != META_OID and not is_clone_oid(o)])
+                         if o != META_OID and not is_clone_oid(o)
+                         and not (isinstance(o, str)
+                                  and o.startswith(HITSET_PREFIX))])
             return
         # read (off, len)
         size = self._object_size(oid)
@@ -639,6 +722,62 @@ class PG:
             reply_fn(0, b"")
             return
         self._ec_read_with_retry(oid, off, length, reply_fn)
+
+    def _do_copy_get(self, oid, reply_fn, tries: int = 0) -> None:
+        """CEPH_OSD_OP_COPY_GET (the promote/copy-from fetch,
+        src/osd/PrimaryLogPG.cc do_osd_ops COPY_GET): one op returning
+        a CONSISTENT (data, user xattrs, omap, version) snapshot.
+        Replicated pools read inline on the op-shard worker (writes
+        serialize there, so the view is atomic); EC pools read data
+        asynchronously, so the version is re-checked afterward and the
+        fetch retried if a write landed in between."""
+        size = self._object_size(oid)
+        if size is None or self._is_whiteout(oid):
+            reply_fn(-2, None)
+            return
+        v0 = self._object_version(oid)
+        cid = self.cid_of_shard(self.my_shard())
+        try:
+            attrs = user_xattrs(self.store.getattrs(cid, oid))
+        except KeyError:
+            attrs = {}
+        try:
+            omap = dict(self.store.omap_get(cid, oid))
+        except KeyError:
+            omap = {}
+        # the object's recent client reqids ride along (the reference
+        # COPY_GET's reqids field): after a promote, the cache PG can
+        # recognize a retransmit of a write the BASE pool already
+        # applied — without this, a lost reply + resend across a tier
+        # transition double-applies non-idempotent ops
+        with self.lock:
+            reqids = [(list(e.reqid), e.version)
+                      for e in self.pg_log.entries
+                      if e.oid == oid and e.reqid[0]]
+
+        def finish(data):
+            if data is None:
+                reply_fn(-5, None)
+                return
+            if self._object_version(oid) != v0:
+                if tries < 5:       # a write raced the async read
+                    self._do_copy_get(oid, reply_fn, tries + 1)
+                else:
+                    reply_fn(-11, None)   # EAGAIN: hot object
+                return
+            reply_fn(0, {"data": bytes(data), "attrs": attrs,
+                         "omap": omap, "version": v0,
+                         "reqids": reqids})
+
+        if size == 0:
+            finish(b"")
+        elif self.pool.is_erasure():
+            self.backend.objects_read(oid, 0, size, finish)
+        else:
+            try:
+                finish(self.store.read(self._head_cid(), oid))
+            except KeyError:
+                reply_fn(-2, None)
 
     def _ec_read_with_retry(self, oid, off, length, reply_fn,
                             attempt: int = 0) -> None:
@@ -1018,8 +1157,10 @@ class PG:
                 logical_size = op[1]
             elif kind == "remove":
                 ss = ss_inflight or self._load_snapset(oid)
-                if ss["clones"]:
-                    # live clones still reference the snapset: leave a
+                if ss["clones"] or self.pool.is_tier():
+                    # live clones still reference the snapset — and a
+                    # cache tier must REMEMBER deletions so the flush
+                    # propagates them to the base pool: leave a
                     # whiteout tombstone instead of erasing it
                     # (PrimaryLogPG whiteout semantics)
                     t.truncate(oid, 0)
@@ -1067,10 +1208,42 @@ class PG:
                 t.setattr(oid, op[1], op[2])
             elif kind == "rmxattr":
                 t.rmattr(oid, op[1])
+            elif kind == "resetxattrs":
+                # drop every USER xattr — persisted AND ones queued
+                # earlier in this same op vector (the metadata-
+                # replacement leg of a tier flush: copy-from
+                # semantics, the base must not keep attrs the cache
+                # deleted)
+                cid = self.cid_of_shard(self.my_shard())
+                try:
+                    names = set(self.store.getattrs(cid, oid))
+                except KeyError:
+                    names = set()
+                pending = t.op_map.get(oid)
+                if pending is not None:
+                    names.update(k for k, v in
+                                 pending.attr_updates.items()
+                                 if v is not None)
+                for name in names:
+                    if is_user_xattr(name):
+                        t.rmattr(oid, name)
             elif kind == "omap_set":
                 t.omap_setkeys(oid, op[1])
             elif kind == "omap_rm":
                 t.omap_rmkeys_op(oid, op[1])
+            elif kind == "omap_clear":
+                # CEPH_OSD_OP_OMAPCLEAR: persisted keys AND any queued
+                # by an earlier omap_set in this op vector
+                cid = self.cid_of_shard(self.my_shard())
+                try:
+                    keys = set(self.store.omap_get(cid, oid))
+                except KeyError:
+                    keys = set()
+                pending = t.op_map.get(oid)
+                if pending is not None:
+                    keys.update(pending.omap_updates)
+                if keys:
+                    t.omap_rmkeys_op(oid, sorted(keys))
             else:
                 reply_fn(-95, None)  # EOPNOTSUPP
                 return
@@ -1084,6 +1257,16 @@ class PG:
         if still_exists:
             t.setattr(oid, VERSION_ATTR, str(version).encode())
             t.setattr(oid, "_size", str(logical_size).encode())
+            if self.pool.is_tier() and \
+                    self.pool.cache_mode in ("writeback", "readproxy"):
+                # cache-tier dirty bit (object_info_t FLAG_DIRTY): the
+                # agent flushes this object back to the base pool.
+                # EVERY write message dirties — metadata-only ops
+                # (rmxattr, omap_rm) included, or a deleted attr would
+                # never flush and would resurrect from the base copy
+                from .tiering import DIRTY_ATTR
+                t.setattr(oid, DIRTY_ATTR, b"1")
+                self._tier().dirty_at.setdefault(oid, _time.monotonic())
         self.backend.submit_transaction(
             t, version, lambda: reply_fn(0, version),
             reqid=(getattr(msg, "session", ""), msg.tid))

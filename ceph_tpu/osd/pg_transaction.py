@@ -120,10 +120,20 @@ class PGTransaction:
         self._get(oid).attr_updates[name] = None
 
     def omap_setkeys(self, oid, kv: dict) -> None:
-        self._get(oid).omap_updates.update(kv)
+        # the builders are called in op-vector order; make the merged
+        # record order-independent by letting the LAST logical op per
+        # key win (a set cancels a queued rm of the same key — e.g.
+        # OMAPCLEAR followed by OMAPSETKEYS in one compound op)
+        op = self._get(oid)
+        op.omap_updates.update(kv)
+        if op.omap_rmkeys:
+            op.omap_rmkeys = [k for k in op.omap_rmkeys if k not in kv]
 
     def omap_rmkeys_op(self, oid, keys) -> None:
-        self._get(oid).omap_rmkeys.extend(keys)
+        op = self._get(oid)
+        op.omap_rmkeys.extend(keys)
+        for k in keys:
+            op.omap_updates.pop(k, None)
 
     # -- traversal -----------------------------------------------------
 

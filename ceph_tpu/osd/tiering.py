@@ -1,0 +1,697 @@
+"""Cache tiering: HitSet, promote/proxy, flush/evict, tier agent.
+
+Role of the reference's cache-tier machinery:
+
+  HitSet            src/osd/HitSet.{h,cc} (BloomHitSet over
+                    src/common/bloom_filter.hpp): per-PG bloom filters
+                    of recently-accessed objects, rolled every
+                    `hit_set_period` seconds and archived (up to
+                    `hit_set_count`) as PG-local objects the agent
+                    consults for eviction temperature.
+  maybe_handle_cache  PrimaryLogPG::maybe_handle_cache_detail
+                    (src/osd/PrimaryLogPG.cc:2169-2380): an op hitting
+                    a cache-tier PG for a non-resident object either
+                    PROMOTES it (copy-from the base pool, then replay
+                    the op locally), PROXIES it (serve from base
+                    without promoting), or forwards, per cache_mode.
+  flush / evict     PrimaryLogPG::start_flush / agent_maybe_evict
+                    (:8542,:8700): dirty objects are written back to
+                    the base pool (deletes propagate as removes), then
+                    marked clean; clean cold objects are dropped from
+                    the cache entirely.
+  TierAgentState    src/osd/TierAgentState.h: the background agent
+                    wakes periodically, estimates fullness/dirtyness
+                    against `target_max_objects`/`target_max_bytes`,
+                    and queues flushes and evictions.
+
+Threading: the op-shard worker must never block on cross-pool IO (the
+base pool's PGs may live on this same OSD), so every tier operation is
+a three-phase pipeline:
+
+  capture  (op-shard worker; serialized with client ops for the PG)
+  base IO  (the daemon's tier thread pool, via an internal RadosClient
+            submitting with ignore_overlay — the objecter's
+            CEPH_OSD_FLAG_IGNORE_OVERLAY analog)
+  install  (op-shard worker again; verifies nothing raced, applies an
+            internal replicated transaction, answers waiters)
+
+Simplifications vs the reference (documented contract): promotion and
+flush move the object HEAD (data + user xattrs + omap); snapshots taken
+while an object lives in the cache work normally inside the cache pool,
+and an object with clones or watchers refuses eviction with EBUSY
+instead of evicting per-clone.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import struct
+import threading
+import time
+from collections import deque
+
+from ..msg.message import OSD_READ_OPS as _READ_KINDS
+
+__all__ = ["HitSet", "PGTier", "DIRTY_ATTR", "HITSET_PREFIX"]
+
+DIRTY_ATTR = "_dirty"
+HITSET_PREFIX = "_hitset_"
+
+# how long a confirmed base-pool miss is believed before re-probing
+ABSENT_TTL = 1.0
+
+
+class HitSet:
+    """Bloom filter of object names (BloomHitSet,
+    src/osd/HitSet.h:300-420 over src/common/bloom_filter.hpp).
+
+    Sized from (target_size, fpp) with the standard optimal-bits
+    formula; k hash probes derive from one SHA-1 via the Kirsch-
+    Mitzenmacher double-hashing construction."""
+
+    def __init__(self, target_size: int = 1000, fpp: float = 0.05,
+                 nbits: int | None = None, k: int | None = None,
+                 data: bytes | None = None):
+        if nbits is None:
+            nbits = max(64, int(-target_size * math.log(max(fpp, 1e-9))
+                                / (math.log(2) ** 2)))
+        self.nbits = nbits
+        if k is None:
+            k = max(1, round(nbits / max(target_size, 1) * math.log(2)))
+        self.k = min(k, 16)
+        self.bits = bytearray((nbits + 7) // 8) if data is None \
+            else bytearray(data)
+        self.count = 0
+
+    def _probes(self, name: str):
+        d = hashlib.sha1(name.encode()).digest()
+        h1 = int.from_bytes(d[:8], "little")
+        h2 = int.from_bytes(d[8:16], "little") | 1
+        for i in range(self.k):
+            yield (h1 + i * h2) % self.nbits
+
+    def insert(self, name: str) -> None:
+        for p in self._probes(name):
+            self.bits[p >> 3] |= 1 << (p & 7)
+        self.count += 1
+
+    def contains(self, name: str) -> bool:
+        return all(self.bits[p >> 3] & (1 << (p & 7))
+                   for p in self._probes(name))
+
+    def encode(self) -> bytes:
+        return struct.pack("<IIQ", self.nbits, self.k, self.count) \
+            + bytes(self.bits)
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "HitSet":
+        nbits, k, count = struct.unpack_from("<IIQ", raw)
+        hs = cls(nbits=nbits, k=k, data=raw[16:])
+        hs.count = count
+        return hs
+
+
+class PGTier:
+    """Per-PG cache-tier state + logic, attached lazily to PGs whose
+    pool is a tier (pg_pool_t.tier_of >= 0)."""
+
+    def __init__(self, pg):
+        self.pg = pg
+        self.lock = threading.Lock()
+        self._promoting: dict = {}    # oid -> [waiter continuations]
+        self._absent: dict = {}       # oid -> confirmed-miss stamp
+        self._atime: dict = {}        # oid -> last access (monotonic)
+        self.dirty_at: dict = {}      # oid -> first-dirty stamp
+        self.hit_set: HitSet | None = None
+        self._hit_set_start = 0.0
+        self._archives: deque = deque()     # (name, HitSet), oldest first
+        self._archives_loaded = False
+        self._agent_busy = False
+        self._agent_inflight: set = set()
+        # proxied-WRITE dedup: the base pool sees the internal client's
+        # (session, tid), not the real client's, so the exactly-once
+        # guarantee must be re-established here — a retransmit of a
+        # proxied write must attach to (or replay) the first proxy, not
+        # spawn a second one (double-applied append otherwise)
+        from ..common.bounded import BoundedDict
+        self._proxy_done: BoundedDict = BoundedDict()
+        self._proxy_inflight: dict = {}   # (session, tid) -> [reply_fns]
+
+    # ------------------------------------------------------------------
+    # entry from PG.do_op
+
+    def maybe_handle(self, msg, reply_fn) -> bool:
+        """True = the tier path owns this op (parked, proxied, or
+        answered); False = run the normal local execution."""
+        pg = self.pg
+        pool = pg.pool
+        mode = pool.cache_mode
+        oid = msg.oid
+        op0 = msg.ops[0][0] if msg.ops else ""
+        if op0 in ("cache_flush", "cache_try_flush", "cache_evict"):
+            self._handle_cache_op(op0, msg, reply_fn)
+            return True
+        if op0 == "list" or not oid:
+            return False    # PG-scoped ops list THIS pool's contents
+        if isinstance(oid, str) and oid.startswith(HITSET_PREFIX):
+            return False              # internal objects: no tier games
+        is_write = any(op[0] not in _READ_KINDS for op in msg.ops)
+        if mode == "forward":
+            # drain mode: EVERYTHING forwards to the base, residency
+            # notwithstanding (how the reference drains a cache before
+            # dismantling it). Watch/notify cannot forward — the base
+            # PG would register the OSD's INTERNAL client as the
+            # watcher and notifies would never reach the real one
+            if any(op[0] in ("watch", "unwatch", "notify")
+                   for op in msg.ops):
+                reply_fn(-95, None)   # EOPNOTSUPP during drain
+                return True
+            if is_write:
+                self._start_proxy_write(msg, reply_fn)
+            else:
+                pg.daemon.tier_submit(self._do_proxy, msg, reply_fn)
+            return True
+        if mode == "readonly" and is_write:
+            # a readonly cache never accepts writes — not even for
+            # resident objects (they would shadow the base copy and be
+            # silently lost on evict)
+            reply_fn(-30, None)       # EROFS
+            return True
+        self._record_hit(oid)
+        if pg._object_size(oid) is not None:
+            return False              # resident (whiteouts included)
+        now = time.monotonic()
+        with self.lock:
+            stamp = self._absent.get(oid)
+            absent = stamp is not None and now - stamp < ABSENT_TTL
+            if stamp is not None and not absent:
+                del self._absent[oid]
+            if absent and is_write:
+                # the write is about to create it locally
+                self._absent.pop(oid, None)
+        if mode == "readproxy" and not is_write:
+            # non-resident read: serve from the base, no promote
+            pg.daemon.tier_submit(self._do_proxy, msg, reply_fn)
+            return True
+        # writeback (all ops), readproxy writes, readonly reads
+        if absent:
+            return False        # local miss is the true answer
+        self._start_promote(oid, msg, reply_fn)
+        return True
+
+    # ------------------------------------------------------------------
+    # promotion (PrimaryLogPG::promote_object)
+
+    def _start_promote(self, oid, msg, reply_fn) -> None:
+        pg = self.pg
+        rerun = lambda: pg.do_op(msg, reply_fn)   # noqa: E731
+        with self.lock:
+            waiters = self._promoting.get(oid)
+            if waiters is not None:
+                waiters.append(rerun)
+                return
+            self._promoting[oid] = [rerun]
+        pg.daemon.tier_submit(self._do_promote, oid)
+
+    def _do_promote(self, oid) -> None:
+        """Tier thread: fetch a CONSISTENT (data, xattrs, omap)
+        snapshot from the base pool in one COPY_GET op — three
+        separate reads could interleave with a base-pool writer and
+        install a torn object."""
+        pg = self.pg
+        base = pg.pool.tier_of
+        cl = pg.daemon.tier_client()
+        try:
+            r, snap = cl.submit_op(base, oid, [("copy_get",)],
+                                   ignore_overlay=True)
+            if r == -2:
+                fetched = None
+            elif r < 0:
+                raise OSError(-r, "promote copy_get failed")
+            else:
+                fetched = (bytes(snap["data"]), dict(snap["attrs"]),
+                           dict(snap["omap"]),
+                           list(snap.get("reqids") or []))
+        except Exception:
+            # transient base trouble: release the waiters after a
+            # beat — each re-entry re-promotes until the client's own
+            # deadline gives up
+            pg.daemon.timer.add_event_after(0.5, self._fail_promote, oid)
+            return
+        pg.daemon.op_wq.queue(pg.pgid, self._finish_promote, oid,
+                              fetched, klass="client",
+                              priority=pg.daemon.client_op_priority)
+
+    def _run_waiters(self, waiters) -> None:
+        """Re-enter parked ops through the op queue, NOT inline: the
+        caller may be a timer/finisher thread, and client-op execution
+        must stay serialized on the PG's op-shard worker."""
+        pg = self.pg
+        for w in waiters:
+            pg.daemon.op_wq.queue(pg.pgid, w, klass="client",
+                                  priority=pg.daemon.client_op_priority)
+
+    def _fail_promote(self, oid) -> None:
+        with self.lock:
+            waiters = self._promoting.pop(oid, [])
+        self._run_waiters(waiters)
+
+    def _finish_promote(self, oid, fetched) -> None:
+        """Op-shard worker: install the object if nothing raced, then
+        answer everyone who parked on the promote."""
+        pg = self.pg
+
+        def release():
+            with self.lock:
+                waiters = self._promoting.pop(oid, [])
+            self._run_waiters(waiters)
+
+        if fetched is None:
+            with self.lock:
+                self._absent[oid] = time.monotonic()
+            release()
+            return
+        if pg._object_size(oid) is not None:
+            release()                 # a racing write created it
+            return
+        data, xattrs, omap, reqids = fetched
+        from .pg import is_user_xattr
+        from .pg_transaction import PGTransaction
+        t = PGTransaction()
+        t.create(oid)
+        if data:
+            t.write(oid, 0, data)
+        for k, v in xattrs.items():
+            if is_user_xattr(k):
+                t.setattr(oid, k, v)
+        if omap:
+            t.omap_setkeys(oid, omap)
+        # adopt the base object's client reqids (finish_promote role):
+        # a retransmit of a write the BASE already applied must replay,
+        # not re-apply, now that this PG answers for the object
+        with pg.lock:
+            for reqid, version in reqids:
+                key = tuple(reqid)
+                if key not in pg._reqids:
+                    pg._reqids[key] = version
+        if not pg.submit_internal_write(oid, t, len(data), release):
+            release()   # demoted meanwhile: waiters retarget via EAGAIN
+
+    # ------------------------------------------------------------------
+    # proxying (PrimaryLogPG::do_proxy_read / do_proxy_write)
+
+    def _do_proxy(self, msg, reply_fn) -> None:
+        """Tier thread: forward the whole op vector to the base pool
+        and relay the answer."""
+        pg = self.pg
+        cl = pg.daemon.tier_client()
+        try:
+            r, data = cl.submit_op(
+                pg.pool.tier_of, msg.oid, msg.ops,
+                snapc=getattr(msg, "snapc", (0, ())),
+                snap=getattr(msg, "snap", 0), ignore_overlay=True)
+        except Exception:
+            r, data = -110, None      # ETIMEDOUT
+        reply_fn(r, data)
+
+    def _start_proxy_write(self, msg, reply_fn) -> None:
+        """Dedup admission for proxied writes (exactly-once): a
+        retransmitted (session, tid) joins the in-flight proxy or
+        replays its recorded outcome."""
+        key = (getattr(msg, "session", ""), msg.tid)
+        if not key[0]:
+            self.pg.daemon.tier_submit(self._do_proxy, msg, reply_fn)
+            return
+        with self.lock:
+            done = self._proxy_done.get(key)
+            if done is None:
+                fns = self._proxy_inflight.get(key)
+                if fns is not None:
+                    fns.append(reply_fn)
+                    return
+                self._proxy_inflight[key] = [reply_fn]
+        if done is not None:
+            reply_fn(*done)
+            return
+        self.pg.daemon.tier_submit(self._do_proxy_write, msg, key)
+
+    def _do_proxy_write(self, msg, key) -> None:
+        pg = self.pg
+        cl = pg.daemon.tier_client()
+        try:
+            r, data = cl.submit_op(
+                pg.pool.tier_of, msg.oid, msg.ops,
+                snapc=getattr(msg, "snapc", (0, ())),
+                ignore_overlay=True)
+        except Exception:
+            r, data = -110, None
+        with self.lock:
+            # recorded even on timeout: the base-side op MAY have
+            # applied, so a retransmit must get this answer rather
+            # than re-apply a non-idempotent write
+            self._proxy_done[key] = (r, data)
+            fns = self._proxy_inflight.pop(key, [])
+        for fn in fns:
+            fn(r, data)
+
+    # ------------------------------------------------------------------
+    # flush (PrimaryLogPG::start_flush): three phases
+
+    def _handle_cache_op(self, kind, msg, reply_fn) -> None:
+        pg = self.pg
+        if not pg.active_for_write():
+            with pg.lock:
+                pg.waiting_for_active.append(
+                    lambda: pg.do_op(msg, reply_fn))
+            return
+        if kind == "cache_evict":
+            self._evict(msg.oid, reply_fn)
+        else:
+            self._flush_capture(msg.oid, kind == "cache_try_flush",
+                                reply_fn)
+
+    def _flush_capture(self, oid, try_flush: bool, reply_fn) -> None:
+        """Op-shard worker: snapshot (version, bytes, attrs, omap)."""
+        pg = self.pg
+        if pg._object_size(oid) is None:
+            reply_fn(-2, None)
+            return
+        if pg.local_getattr(oid, DIRTY_ATTR) is None:
+            reply_fn(0, None)         # already clean
+            return
+        v0 = pg._object_version(oid)
+        whiteout = pg._is_whiteout(oid)
+        cid = pg.cid_of_shard(-1)
+        if whiteout:
+            captured = (v0, None, {}, {})
+        else:
+            from .pg import user_xattrs
+            try:
+                data = pg.store.read(cid, oid)
+            except KeyError:
+                data = b""
+            try:
+                attrs = user_xattrs(pg.store.getattrs(cid, oid))
+            except KeyError:
+                attrs = {}
+            try:
+                omap = pg.store.omap_get(cid, oid)
+            except KeyError:
+                omap = {}
+            captured = (v0, bytes(data), attrs, omap)
+        pg.daemon.tier_submit(self._do_flush_io, oid, captured,
+                              try_flush, reply_fn)
+
+    def _do_flush_io(self, oid, captured, try_flush, reply_fn) -> None:
+        """Tier thread: push the capture to the base pool."""
+        pg = self.pg
+        v0, data, attrs, omap = captured
+        cl = pg.daemon.tier_client()
+        base = pg.pool.tier_of
+        try:
+            if data is None:          # flushing a whiteout = delete
+                r, _ = cl.submit_op(base, oid, [("remove",)],
+                                    ignore_overlay=True)
+                if r < 0 and r != -2:
+                    raise OSError(-r, "flush delete failed")
+            else:
+                # full metadata REPLACEMENT (copy-from semantics):
+                # attrs/omap keys deleted in the cache must not
+                # survive in the base and resurrect on promote
+                ops = [("writefull", data), ("resetxattrs",),
+                       ("omap_clear",)]
+                ops += [("setxattr", k, v) for k, v in attrs.items()]
+                if omap:
+                    ops.append(("omap_set", omap))
+                r, _ = cl.submit_op(base, oid, ops,
+                                    ignore_overlay=True)
+                if r < 0:
+                    raise OSError(-r, "flush write failed")
+        except Exception:
+            reply_fn(-5, None)        # EIO: base pool unreachable
+            return
+        pg.daemon.op_wq.queue(pg.pgid, self._flush_finish, oid, v0,
+                              try_flush, reply_fn, klass="tier",
+                              priority=pg.daemon.recovery_op_priority)
+
+    def _flush_finish(self, oid, v0, try_flush, reply_fn) -> None:
+        """Op-shard worker: nothing raced? mark clean (or erase a
+        fully-flushed whiteout)."""
+        pg = self.pg
+        if pg._object_version(oid) != v0:
+            if try_flush:
+                reply_fn(-16, None)   # EBUSY: a writer raced us
+            else:
+                # blocking flavor: flush the NEW content
+                self._flush_capture(oid, False, reply_fn)
+            return
+        from .pg_transaction import PGTransaction
+        t = PGTransaction()
+        ss = pg._load_snapset(oid)
+        deleting = False
+        if pg._is_whiteout(oid) and not ss["clones"]:
+            t.remove(oid)             # tombstone fully propagated
+            deleting = True
+        else:
+            t.rmattr(oid, DIRTY_ATTR)
+
+        def done():
+            with self.lock:
+                self.dirty_at.pop(oid, None)
+                self._agent_inflight.discard(oid)
+            reply_fn(0, None)
+
+        if not pg.submit_internal_write(oid, t, None, done,
+                                        deleting=deleting):
+            reply_fn(-11, None)   # EAGAIN: no longer the primary
+
+    # ------------------------------------------------------------------
+    # evict (PrimaryLogPG::agent_maybe_evict / do CACHE_EVICT)
+
+    def _evict(self, oid, reply_fn) -> None:
+        """Op-shard worker: drop a clean, unwatched, snapless object."""
+        pg = self.pg
+        if pg._object_size(oid) is None:
+            reply_fn(-2, None)
+            return
+        busy = (pg.local_getattr(oid, DIRTY_ATTR) is not None
+                or pg.watchers.get(oid)
+                or pg._load_snapset(oid)["clones"])
+        if busy:
+            reply_fn(-16, None)       # EBUSY
+            return
+        from .pg_transaction import PGTransaction
+        t = PGTransaction()
+        t.remove(oid)
+
+        def done():
+            with self.lock:
+                self._atime.pop(oid, None)
+                self._agent_inflight.discard(oid)
+            reply_fn(0, None)
+
+        if not pg.submit_internal_write(oid, t, None, done,
+                                        deleting=True):
+            reply_fn(-11, None)   # EAGAIN: no longer the primary
+
+    # ------------------------------------------------------------------
+    # hit sets
+
+    def _record_hit(self, oid) -> None:
+        pg = self.pg
+        pool = pg.pool
+        now = time.monotonic()
+        with self.lock:
+            self._atime[oid] = now
+            if pool.hit_set_period <= 0:
+                return
+            rolled = None
+            if self.hit_set is None:
+                self.hit_set = self._fresh_hit_set()
+                self._hit_set_start = now
+            elif now - self._hit_set_start >= pool.hit_set_period:
+                rolled = self.hit_set
+                self.hit_set = self._fresh_hit_set()
+                self._hit_set_start = now
+            self.hit_set.insert(oid)
+        if rolled is not None:
+            self._archive_hit_set(rolled)
+
+    def _fresh_hit_set(self) -> HitSet:
+        pool = self.pg.pool
+        target = max(pool.target_max_objects // max(pool.pg_num, 1),
+                     64)
+        return HitSet(target_size=target, fpp=pool.hit_set_fpp)
+
+    def _archive_hit_set(self, hs: HitSet) -> None:
+        """Persist a rolled hit set as a PG-local replicated object and
+        trim the archive to hit_set_count (HitSet archive objects,
+        PrimaryLogPG::hit_set_persist). Names embed WALL-CLOCK time:
+        they must sort oldest-first across restarts and primary moves,
+        which a monotonic stamp cannot."""
+        pg = self.pg
+        name = "%s%020.6f" % (HITSET_PREFIX, time.time())
+        from .pg_transaction import PGTransaction
+        t = PGTransaction()
+        t.create(name)
+        t.write(name, 0, hs.encode())
+        if not pg.submit_internal_write(name, t, None, lambda: None):
+            return                    # demoted: archives stay volatile
+        doomed = []
+        with self.lock:
+            self._archives.append((name, hs))
+            keep = max(pg.pool.hit_set_count - 1, 0)
+            while len(self._archives) > keep:
+                doomed.append(self._archives.popleft()[0])
+        for old in doomed:
+            td = PGTransaction()
+            td.remove(old)
+            pg.submit_internal_write(old, td, None, lambda: None,
+                                     deleting=True)
+
+    def _load_archives(self) -> None:
+        """Lazy restart path: decode persisted archives from the
+        store."""
+        pg = self.pg
+        with self.lock:
+            if self._archives_loaded:
+                return
+            self._archives_loaded = True
+        cid = pg.cid_of_shard(-1)
+        found = []
+        for oid in pg.store.list_objects(cid):
+            if isinstance(oid, str) and oid.startswith(HITSET_PREFIX):
+                try:
+                    found.append((oid, HitSet.decode(
+                        pg.store.read(cid, oid))))
+                except Exception:
+                    continue
+        found.sort()                  # name embeds start stamp: oldest first
+        with self.lock:
+            known = {n for n, _ in self._archives}
+            fresh = [item for item in found if item[0] not in known]
+            self._archives = deque(fresh + list(self._archives))
+
+    def _is_warm(self, oid) -> bool:
+        with self.lock:
+            sets = ([self.hit_set] if self.hit_set is not None else []) \
+                + [hs for _, hs in self._archives]
+        return any(hs.contains(oid) for hs in sets)
+
+    # ------------------------------------------------------------------
+    # agent (TierAgentState + PrimaryLogPG::agent_work)
+
+    def agent_scan(self) -> None:
+        """Tier thread: estimate fullness, queue flushes/evictions.
+        Targets are per-PG shares of the pool-wide knobs (the
+        reference divides by pg_num the same way,
+        PrimaryLogPG::agent_choose_mode)."""
+        pg = self.pg
+        pool = pg.pool
+        if pool.target_max_objects <= 0 and pool.target_max_bytes <= 0:
+            return
+        with self.lock:
+            if self._agent_busy:
+                return
+            self._agent_busy = True
+        try:
+            self._load_archives()
+            from .pg import META_OID, is_clone_oid
+            cid = pg.cid_of_shard(-1)
+            objs = []
+            nbytes = 0
+            for oid in pg.store.list_objects(cid):
+                if oid == META_OID or is_clone_oid(oid) \
+                        or (isinstance(oid, str)
+                            and oid.startswith(HITSET_PREFIX)):
+                    continue
+                st = pg.store.stat(cid, oid)
+                if st is None:
+                    continue
+                dirty = pg.local_getattr(oid, DIRTY_ATTR) is not None
+                objs.append((oid, st["size"], dirty))
+                nbytes += st["size"]
+            pgn = max(pool.pg_num, 1)
+            max_obj = pool.target_max_objects / pgn \
+                if pool.target_max_objects else float("inf")
+            max_bytes = pool.target_max_bytes / pgn \
+                if pool.target_max_bytes else float("inf")
+            now = time.monotonic()
+            with self.lock:
+                atime = dict(self._atime)
+                dirty_at = dict(self.dirty_at)
+                inflight = set(self._agent_inflight)
+            # flush: dirty volume above target * dirty_ratio
+            dirty_objs = [(dirty_at.get(o, 0.0), o, sz)
+                          for o, sz, d in objs if d and o not in inflight]
+            dirty_objs.sort()         # oldest-dirty first
+            dirty_count = sum(1 for _, _, _ in dirty_objs)
+            dirty_bytes = sum(sz for _, _, sz in dirty_objs)
+            over_objs = dirty_count - pool.cache_target_dirty_ratio \
+                * max_obj if max_obj != float("inf") else -1
+            over_bytes = dirty_bytes - pool.cache_target_dirty_ratio \
+                * max_bytes if max_bytes != float("inf") else -1
+            for stamp, oid, sz in dirty_objs:
+                if over_objs <= 0 and over_bytes <= 0:
+                    break
+                if stamp and now - stamp < pool.cache_min_flush_age:
+                    continue
+                self._agent_queue_flush(oid)
+                over_objs -= 1
+                over_bytes -= sz
+            # evict: total volume above target * full_ratio
+            count = len(objs)
+            over_objs = count - pool.cache_target_full_ratio * max_obj \
+                if max_obj != float("inf") else -1
+            over_bytes = nbytes - pool.cache_target_full_ratio \
+                * max_bytes if max_bytes != float("inf") else -1
+            if over_objs > 0 or over_bytes > 0:
+                # clean objects, coldest first: not in any hit set,
+                # then oldest access
+                clean = [(self._is_warm(o), atime.get(o, 0.0), o, sz)
+                         for o, sz, d in objs
+                         if not d and o not in inflight]
+                clean.sort()
+                for warm, at, oid, sz in clean:
+                    if over_objs <= 0 and over_bytes <= 0:
+                        break
+                    if at and now - at < pool.cache_min_evict_age:
+                        continue
+                    self._agent_queue_evict(oid)
+                    over_objs -= 1
+                    over_bytes -= sz
+        finally:
+            with self.lock:
+                self._agent_busy = False
+
+    def _agent_queue_flush(self, oid) -> None:
+        pg = self.pg
+        with self.lock:
+            if oid in self._agent_inflight:
+                return
+            self._agent_inflight.add(oid)
+
+        def done(result, data):
+            with self.lock:
+                self._agent_inflight.discard(oid)
+
+        pg.daemon.op_wq.queue(pg.pgid, self._flush_capture, oid, True,
+                              done, klass="tier",
+                              priority=pg.daemon.recovery_op_priority)
+
+    def _agent_queue_evict(self, oid) -> None:
+        pg = self.pg
+        with self.lock:
+            if oid in self._agent_inflight:
+                return
+            self._agent_inflight.add(oid)
+
+        def done(result, data):
+            with self.lock:
+                self._agent_inflight.discard(oid)
+
+        pg.daemon.op_wq.queue(pg.pgid, self._evict, oid, done,
+                              klass="tier",
+                              priority=pg.daemon.recovery_op_priority)
+

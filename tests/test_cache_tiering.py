@@ -1,0 +1,418 @@
+"""Cache tiering end to end: overlay routing, promote/proxy, flush,
+evict, whiteouts, the tier agent, and hit sets.
+
+Mirrors the reference's tiering QA surface
+(src/test/librados/tier.cc: promote-on-read/write, flush/try-flush
+/evict semantics, whiteout deletes, agent behavior) against a
+replicated cache pool over an EC base pool — the canonical deployment
+the reference documents.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.osd.tiering import HITSET_PREFIX, HitSet
+
+from .cluster_util import MiniCluster, wait_until
+
+FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+        "mon_osd_down_out_interval": 1.0,
+        "paxos_propose_interval": 0.02,
+        "osd_agent_interval": 0.1}
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(num_mons=1, num_osds=5, conf_overrides=FAST).start()
+    yield c
+    c.stop()
+
+
+def mon_ok(client, cmd):
+    res, outs, data = client.mon_command(cmd)
+    assert res == 0, "%r: %s" % (cmd, outs)
+    return data
+
+
+def set_pool(client, pool, var, val):
+    mon_ok(client, {"prefix": "osd pool set", "pool": pool,
+                    "var": var, "val": val})
+
+
+def wait_map(cluster, client, pred, timeout=15):
+    """Wait until the client AND every OSD run a map satisfying pred
+    (tier behavior is judged by the OSD's copy of the pool)."""
+    def ok():
+        m = client.osdmap
+        if m is None or not pred(m):
+            client.mon_client.renew_subs()
+            return False
+        return all(pred(o.osdmap) for o in cluster.osds.values())
+    assert wait_until(ok, timeout), "map change never propagated"
+
+
+def make_tier(cluster, client, base_name, cache_name, mode,
+              base_profile=None, pg_num=4):
+    if base_profile is None:
+        base_id = cluster.create_replicated_pool(client, base_name,
+                                                 size=3, pg_num=pg_num)
+    else:
+        base_id = cluster.create_ec_pool(client, base_name,
+                                         base_profile, pg_num=pg_num)
+    cache_id = cluster.create_replicated_pool(client, cache_name,
+                                              size=3, pg_num=pg_num)
+    mon_ok(client, {"prefix": "osd tier add", "pool": base_name,
+                    "tierpool": cache_name})
+    mon_ok(client, {"prefix": "osd tier cache-mode", "pool": cache_name,
+                    "mode": mode})
+    mon_ok(client, {"prefix": "osd tier set-overlay", "pool": base_name,
+                    "overlaypool": cache_name})
+
+    def linked(m):
+        base = m.pools.get(base_id)
+        tier = m.pools.get(cache_id)
+        return (base is not None and tier is not None
+                and base.read_tier == cache_id
+                and tier.cache_mode == mode)
+    wait_map(cluster, client, linked)
+    return base_id, cache_id
+
+
+class TestTierMon:
+    """Monitor-side linkage + validation (OSDMonitor 'osd tier ...')."""
+
+    def test_lifecycle_and_validation(self, cluster):
+        client = cluster.client()
+        cluster.create_replicated_pool(client, "tm_base", pg_num=2)
+        cluster.create_replicated_pool(client, "tm_cache", pg_num=2)
+        cluster.create_ec_pool(client, "tm_ec",
+                               {"plugin": "jerasure", "technique": "reed_sol_van", "k": "2",
+                                "m": "1"}, pg_num=2)
+        # EC pools cannot be cache tiers
+        res, outs, _ = client.mon_command({
+            "prefix": "osd tier add", "pool": "tm_base",
+            "tierpool": "tm_ec"})
+        assert res == -95
+        # overlay before cache-mode is rejected
+        mon_ok(client, {"prefix": "osd tier add", "pool": "tm_base",
+                        "tierpool": "tm_cache"})
+        res, _, _ = client.mon_command({
+            "prefix": "osd tier set-overlay", "pool": "tm_base",
+            "overlaypool": "tm_cache"})
+        assert res == -22
+        mon_ok(client, {"prefix": "osd tier cache-mode",
+                        "pool": "tm_cache", "mode": "writeback"})
+        mon_ok(client, {"prefix": "osd tier set-overlay",
+                        "pool": "tm_base", "overlaypool": "tm_cache"})
+        # a pool can never tier over itself (promote would recurse)
+        res, _, _ = client.mon_command({
+            "prefix": "osd tier add", "pool": "tm_base",
+            "tierpool": "tm_base"})
+        assert res == -22
+        # a pool in a tier relationship can't join another
+        res, _, _ = client.mon_command({
+            "prefix": "osd tier add", "pool": "tm_ec",
+            "tierpool": "tm_cache"})
+        assert res == -16
+        # removal requires the overlay gone first
+        res, _, _ = client.mon_command({
+            "prefix": "osd tier remove", "pool": "tm_base",
+            "tierpool": "tm_cache"})
+        assert res == -16
+        mon_ok(client, {"prefix": "osd tier remove-overlay",
+                        "pool": "tm_base"})
+        mon_ok(client, {"prefix": "osd tier remove", "pool": "tm_base",
+                        "tierpool": "tm_cache"})
+
+        def unlinked(m):
+            for p in m.pools.values():
+                if p.name == "tm_cache":
+                    return not p.is_tier()
+            return False
+        wait_map(cluster, client, unlinked)
+
+
+@pytest.fixture(scope="module")
+def wb(cluster):
+    """Writeback tier: EC base 'wbbase' under replicated 'wbcache'."""
+    client = cluster.client()
+    base_id, cache_id = make_tier(
+        cluster, client, "wbbase", "wbcache", "writeback",
+        base_profile={"plugin": "jerasure", "technique": "reed_sol_van", "k": "2", "m": "1"})
+    overlay = client.open_ioctx("wbbase")       # routed via the tier
+    cache = client.open_ioctx("wbcache")        # the cache pool itself
+    cache.ignore_cache = True                   # inspect, don't promote
+    raw = client.open_ioctx("wbbase")
+    raw.ignore_overlay = True                   # the base pool, direct
+    return client, overlay, cache, raw, base_id, cache_id
+
+
+class TestWriteback:
+    def test_write_lands_in_cache_only(self, wb):
+        _, overlay, cache, raw, _, _ = wb
+        payload = b"tiered!" * 200
+        overlay.write_full("wb1", payload)
+        assert cache.read("wb1") == payload     # resident in the cache
+        with pytest.raises(RadosError):
+            raw.read("wb1")                     # base knows nothing yet
+        assert overlay.read("wb1") == payload   # overlay serves it
+
+    def test_flush_writes_back(self, wb):
+        _, overlay, cache, raw, _, _ = wb
+        payload = b"flush-me" * 128
+        overlay.write_full("wb_flush", payload)
+        cache.cache_flush("wb_flush")
+        assert raw.read("wb_flush") == payload  # base has it now
+        assert cache.read("wb_flush") == payload   # clean copy remains
+        # a clean object flushes as a no-op
+        cache.cache_flush("wb_flush")
+
+    def test_evict_then_promote(self, wb):
+        _, overlay, cache, raw, _, _ = wb
+        payload = b"evict-and-return" * 64
+        overlay.write_full("wb_ev", payload)
+        cache.cache_flush("wb_ev")
+        cache.cache_evict("wb_ev")
+        with pytest.raises(RadosError):
+            cache.stat("wb_ev")                 # gone from the cache
+        assert raw.read("wb_ev") == payload     # safe in the base
+        assert overlay.read("wb_ev") == payload  # read PROMOTES it back
+        assert wait_until(
+            lambda: _stat_ok(cache, "wb_ev"), timeout=5), \
+            "promote did not install the object in the cache"
+
+    def test_evict_dirty_is_busy(self, wb):
+        _, overlay, cache, _, _, _ = wb
+        overlay.write_full("wb_dirty", b"x" * 512)
+        with pytest.raises(RadosError) as ei:
+            cache.cache_evict("wb_dirty")
+        assert ei.value.errno == 16             # EBUSY
+        cache.cache_flush("wb_dirty")
+        cache.cache_evict("wb_dirty")
+
+    def test_delete_through_overlay(self, wb):
+        _, overlay, cache, raw, _, _ = wb
+        payload = b"doomed" * 100
+        overlay.write_full("wb_del", payload)
+        cache.cache_flush("wb_del")
+        assert raw.read("wb_del") == payload
+        overlay.remove("wb_del")
+        with pytest.raises(RadosError):
+            overlay.read("wb_del")              # whiteout hides the base
+        assert raw.read("wb_del") == payload    # base untouched so far
+        cache.cache_flush("wb_del")             # flush the deletion
+        with pytest.raises(RadosError):
+            raw.read("wb_del")                  # base delete propagated
+        with pytest.raises(RadosError):
+            cache.stat("wb_del")                # tombstone erased
+
+    def test_xattr_omap_survive_tier_cycle(self, wb):
+        _, overlay, cache, raw, _, _ = wb
+        overlay.write_full("wb_meta", b"payload" * 32)
+        overlay.set_xattr("wb_meta", "color", b"teal")
+        overlay.omap_set("wb_meta", {"k1": b"v1", "k2": b"v2"})
+        cache.cache_flush("wb_meta")
+        cache.cache_evict("wb_meta")
+        # base copy carries the metadata
+        assert raw.get_xattr("wb_meta", "color") == b"teal"
+        assert raw.omap_get("wb_meta") == {"k1": b"v1", "k2": b"v2"}
+        # promote restores everything into the cache
+        assert overlay.read("wb_meta") == b"payload" * 32
+        assert overlay.get_xattr("wb_meta", "color") == b"teal"
+        assert overlay.omap_get("wb_meta") == {"k1": b"v1",
+                                               "k2": b"v2"}
+
+    def test_metadata_deletion_survives_flush_cycle(self, wb):
+        """Attrs/omap keys DELETED in the cache must not survive in
+        the base and resurrect on the next promote (flush carries
+        copy-from replacement semantics, not merge)."""
+        _, overlay, cache, raw, _, _ = wb
+        overlay.write_full("wb_rmmeta", b"m" * 64)
+        overlay.set_xattr("wb_rmmeta", "keep", b"yes")
+        overlay.set_xattr("wb_rmmeta", "drop", b"doomed")
+        overlay.omap_set("wb_rmmeta", {"keep": b"1", "drop": b"2"})
+        cache.cache_flush("wb_rmmeta")
+        assert raw.get_xattr("wb_rmmeta", "drop") == b"doomed"
+        overlay.rm_xattr("wb_rmmeta", "drop")
+        overlay.omap_rm_keys("wb_rmmeta", ["drop"])
+        cache.cache_flush("wb_rmmeta")
+        cache.cache_evict("wb_rmmeta")
+        assert overlay.read("wb_rmmeta") == b"m" * 64   # promote back
+        assert overlay.get_xattr("wb_rmmeta", "keep") == b"yes"
+        assert overlay.get_xattr("wb_rmmeta", "drop") is None
+        assert "drop" not in overlay.get_xattrs("wb_rmmeta")
+        assert overlay.omap_get("wb_rmmeta") == {"keep": b"1"}
+
+    def test_cache_mode_none_needs_overlay_removed(self, wb):
+        client = wb[0]
+        res, outs, _ = client.mon_command({
+            "prefix": "osd tier cache-mode", "pool": "wbcache",
+            "mode": "none"})
+        assert res == -16, outs
+
+    def test_agent_flushes_and_evicts(self, cluster, wb):
+        client, overlay, cache, raw, _, cache_id = wb
+        set_pool(client, "wbcache", "target_max_objects", 8)
+        set_pool(client, "wbcache", "cache_target_dirty_ratio", 0.25)
+        set_pool(client, "wbcache", "cache_target_full_ratio", 0.5)
+        wait_map(cluster, client, lambda m: any(
+            p.name == "wbcache" and p.target_max_objects == 8
+            for p in m.pools.values()))
+        blobs = {("ag%02d" % i): (b"agent" + bytes([i])) * 64
+                 for i in range(16)}
+        for oid, blob in blobs.items():
+            overlay.write_full(oid, blob)
+        # agent must flush everything back to the base pool...
+        def all_in_base():
+            for oid, blob in blobs.items():
+                try:
+                    if raw.read(oid) != blob:
+                        return False
+                except RadosError:
+                    return False
+            return True
+        assert wait_until(all_in_base, timeout=30), \
+            "agent never flushed the dirty set"
+        # ...and evict down toward the full-ratio target
+        def shrunk():
+            return sum(1 for oid in blobs if _stat_ok(cache, oid)) <= 8
+        assert wait_until(shrunk, timeout=30), \
+            "agent never evicted clean objects"
+        # nothing was lost: overlay reads re-promote evicted objects
+        for oid, blob in blobs.items():
+            assert overlay.read(oid) == blob
+        set_pool(client, "wbcache", "target_max_objects", 0)
+
+    def test_hit_sets_roll_and_persist(self, cluster, wb):
+        client, overlay, cache, _, _, cache_id = wb
+        set_pool(client, "wbcache", "hit_set_period", 1)
+        wait_map(cluster, client, lambda m: any(
+            p.name == "wbcache" and p.hit_set_period == 1
+            for p in m.pools.values()))
+        overlay.write_full("hs_obj", b"hot" * 32)
+
+        def archived():
+            overlay.read("hs_obj")     # keep hitting across periods
+            for osd in cluster.osds.values():
+                for pg in osd.pgs.values():
+                    if pg.pgid.pool != cache_id:
+                        continue
+                    for o in pg.store.list_objects(
+                            pg.cid_of_shard(-1)):
+                        if isinstance(o, str) and \
+                                o.startswith(HITSET_PREFIX):
+                            return True
+            return False
+        assert wait_until(archived, timeout=15), \
+            "no hit-set archive was ever persisted"
+        set_pool(client, "wbcache", "hit_set_period", 0)
+
+
+class TestOtherModes:
+    def test_readproxy(self, cluster):
+        client = cluster.client()
+        base_id, cache_id = make_tier(cluster, client, "rpbase",
+                                      "rpcache", "readproxy")
+        overlay = client.open_ioctx("rpbase")
+        cache = client.open_ioctx("rpcache")
+        cache.ignore_cache = True
+        raw = client.open_ioctx("rpbase")
+        raw.ignore_overlay = True
+        payload = b"proxy-only" * 64
+        # seed the base pool directly
+        raw.write_full("rp1", payload)
+        # a read through the overlay is PROXIED, not promoted
+        assert overlay.read("rp1") == payload
+        assert not _stat_ok(cache, "rp1")
+        # a write through the overlay promotes + dirties
+        overlay.write_full("rp2", payload)
+        assert _stat_ok(cache, "rp2")
+        cache.cache_flush("rp2")
+        assert raw.read("rp2") == payload
+        # PG-scoped listing of the cache pool is never proxied: it
+        # reports the CACHE's residents, not the base pool's contents
+        plain_cache = client.open_ioctx("rpcache")
+        names = plain_cache.list_objects()
+        assert "rp2" in names and "rp1" not in names
+
+    def test_readonly(self, cluster):
+        client = cluster.client()
+        base_id, cache_id = make_tier(cluster, client, "robase",
+                                      "rocache", "readonly")
+        overlay = client.open_ioctx("robase")
+        cache = client.open_ioctx("rocache")
+        cache.ignore_cache = True
+        payload = b"read-cache" * 64
+        # writes bypass a readonly cache entirely (write_tier unset)
+        overlay.write_full("ro1", payload)
+        raw = client.open_ioctx("robase")
+        raw.ignore_overlay = True
+        assert raw.read("ro1") == payload
+        assert not _stat_ok(cache, "ro1")
+        # reads promote into the cache
+        assert overlay.read("ro1") == payload
+        assert wait_until(lambda: _stat_ok(cache, "ro1"), timeout=5)
+        # a write addressed to the readonly cache itself is refused —
+        # even for a RESIDENT object (it would shadow the base copy)
+        plain_cache = client.open_ioctx("rocache")
+        with pytest.raises(RadosError) as ei:
+            plain_cache.write_full("ro1", b"nope")
+        assert ei.value.errno == 30             # EROFS
+
+    def test_forward(self, cluster):
+        client = cluster.client()
+        base_id, cache_id = make_tier(cluster, client, "fwbase",
+                                      "fwcache", "forward")
+        overlay = client.open_ioctx("fwbase")
+        cache = client.open_ioctx("fwcache")
+        cache.ignore_cache = True
+        raw = client.open_ioctx("fwbase")
+        raw.ignore_overlay = True
+        payload = b"pass-through" * 64
+        overlay.write_full("fw1", payload)
+        assert raw.read("fw1") == payload       # went straight to base
+        assert not _stat_ok(cache, "fw1")       # cache stores nothing
+        assert overlay.read("fw1") == payload
+
+
+class TestCompoundOpOrdering:
+    def test_clear_ops_respect_in_vector_order(self, cluster):
+        """omap_clear / resetxattrs must also cancel keys queued
+        EARLIER in the same compound op (in-vector ordering), and keys
+        set AFTER them must survive."""
+        client = cluster.client()
+        cluster.create_replicated_pool(client, "ordpool", pg_num=2)
+        io = client.open_ioctx("ordpool")
+        io.write_full("o", b"x")
+        io._op("o", [("omap_set", {"early": b"1"}), ("omap_clear",),
+                     ("omap_set", {"late": b"2"})])
+        assert io.omap_get("o") == {"late": b"2"}
+        io._op("o", [("setxattr", "early", b"1"), ("resetxattrs",),
+                     ("setxattr", "late", b"2")])
+        attrs = io.get_xattrs("o")
+        assert attrs == {"late": b"2"}
+
+
+class TestHitSetUnit:
+    def test_bloom_membership_and_codec(self):
+        hs = HitSet(target_size=500, fpp=0.01)
+        names = ["obj%d" % i for i in range(300)]
+        for n in names:
+            hs.insert(n)
+        assert all(hs.contains(n) for n in names)
+        misses = sum(hs.contains("other%d" % i) for i in range(1000))
+        assert misses < 50              # ~1% fpp target, generous bound
+        back = HitSet.decode(hs.encode())
+        assert back.nbits == hs.nbits and back.k == hs.k
+        assert all(back.contains(n) for n in names)
+        assert back.count == hs.count
+
+
+def _stat_ok(ioctx, oid) -> bool:
+    try:
+        ioctx.stat(oid)
+        return True
+    except RadosError:
+        return False
