@@ -71,17 +71,31 @@ def _unpack_header(hdr: bytes):
     return size, order, meta
 
 
+def _journal_id(name: str) -> str:
+    return "rbd.%s" % name
+
+
 class RBD:
     """Pool-level image operations (librbd.h rbd_create/list/remove)."""
 
     @staticmethod
     def create(ioctx, name: str, size: int,
-               order: int = DEFAULT_ORDER) -> None:
+               order: int = DEFAULT_ORDER,
+               features: tuple = ()) -> None:
         if name in RBD.list(ioctx):
             raise ImageExists(name)
+        if "journaling" in features:
+            # the journal exists BEFORE the header advertises it: a
+            # crash in between leaves an orphan journal (harmless),
+            # never a journaled image without a journal (unopenable)
+            from ..services.journal import Journaler
+            j = Journaler(ioctx, _journal_id(name))
+            j.create()
+            j.register_client("")     # the master position
         ioctx.write_full(_header_oid(name),
                          _pack_header(size, order,
-                                      {"snaps": {}, "parent": None}))
+                                      {"snaps": {}, "parent": None,
+                                       "features": list(features)}))
         ioctx.omap_set(DIR_OID, {name: b"1"})
 
     @staticmethod
@@ -125,6 +139,14 @@ class RBD:
             except OSError as e:
                 if not _enoent(e):
                     raise
+        if "journaling" in img.meta.get("features", []):
+            from ..services.journal import Journaler
+            j = Journaler(ioctx, _journal_id(name))
+            try:
+                j.open()
+                j.remove()
+            except Exception:
+                pass              # a half-created journal is no blocker
         ioctx.remove(_header_oid(name))
         # targeted key removal: a read-modify-write of the whole
         # directory would erase concurrently created images
@@ -148,6 +170,79 @@ class Image:
         self._size, self.order, self.meta = _unpack_header(hdr)
         self.block_size = 1 << self.order
         self.layout = FileLayout(self.block_size, 1, self.block_size)
+        # journaling feature (librbd RBD_FEATURE_JOURNALING): every
+        # mutation appends an EventEntry to the image journal BEFORE
+        # applying, the master commit position advances after apply,
+        # and opening the image replays anything in between (the
+        # crash-recovery half of librbd::Journal::open)
+        self._journal = None
+        self._replaying = False
+        if "journaling" in self.meta.get("features", []):
+            from ..services.journal import JournalNotFound, Journaler
+            self._journal = Journaler(ioctx, _journal_id(name))
+            try:
+                self._journal.open()
+            except JournalNotFound:
+                # self-heal a lost/half-created journal rather than
+                # brick the image (any unjournaled tail is gone either
+                # way; a fresh journal restores the invariant)
+                self._journal.create()
+                self._journal.register_client("")
+            self._replay_pending()
+
+    # -- journaling (librbd journal/Types.h EventEntry) ----------------
+
+    def _replay_pending(self) -> None:
+        """Apply journaled events newer than the master commit
+        position — a crash between append and apply left them
+        un-applied (journal::Replay)."""
+        j = self._journal
+        done = j.committed("")
+        self._replaying = True
+        try:
+            for tid, tag, payload in j.iterate(done):
+                self._apply_event(encoding.decode_any(payload))
+                j.commit("", tid)
+        finally:
+            self._replaying = False
+        j.trim()
+
+    def _apply_event(self, ev: dict) -> None:
+        """Idempotent event application (journal/Replay.cc handlers —
+        AioWriteEvent, AioDiscardEvent, ResizeEvent, Snap*Event)."""
+        kind = ev["type"]
+        if kind == "write":
+            self.write(ev["offset"], ev["data"])
+        elif kind == "discard":
+            self.discard(ev["offset"], ev["length"])
+        elif kind == "resize":
+            self.resize(ev["size"])
+        elif kind == "snap_create":
+            if ev["name"] not in self.meta["snaps"]:
+                self.snap_create(ev["name"])
+        elif kind == "snap_remove":
+            if ev["name"] in self.meta["snaps"]:
+                self.snap_remove(ev["name"])
+        elif kind == "snap_rollback":
+            self.snap_rollback(ev["name"])
+
+    def _journal_event(self, ev: dict):
+        """Append the event pre-apply; returns the tid to commit
+        post-apply (None when journaling is off or we ARE the
+        replay)."""
+        if self._journal is None or self._replaying:
+            return None
+        return self._journal.append("rbd", encoding.encode_any(ev))
+
+    def _journal_commit(self, tid) -> None:
+        if tid is not None:
+            j = self._journal
+            j.commit("", tid)
+            # trim only at object-set boundaries: a set becomes
+            # removable every splay_width*entries_per_object entries,
+            # so per-write trims are pure round-trip overhead
+            if (tid + 1) % (j.splay_width * j.entries_per_object) == 0:
+                j.trim()
 
     def size(self) -> int:
         return self._size
@@ -178,10 +273,13 @@ class Image:
     def snap_create(self, snap_name: str) -> int:
         if snap_name in self.meta["snaps"]:
             raise ImageExists("%s@%s" % (self.name, snap_name))
+        jtid = self._journal_event({"type": "snap_create",
+                                    "name": snap_name})
         snap_id = self.ioctx.selfmanaged_snap_create()
         self.meta["snaps"][snap_name] = {"id": snap_id,
                                          "size": self._size}
         self._save_header()
+        self._journal_commit(jtid)
         return snap_id
 
     def snap_list(self) -> list:
@@ -191,17 +289,22 @@ class Image:
             key=lambda s: s["id"])
 
     def snap_remove(self, snap_name: str) -> None:
-        snap = self.meta["snaps"].pop(snap_name, None)
-        if snap is None:
+        if snap_name not in self.meta["snaps"]:
             raise ImageNotFound("%s@%s" % (self.name, snap_name))
+        jtid = self._journal_event({"type": "snap_remove",
+                                    "name": snap_name})
+        snap = self.meta["snaps"].pop(snap_name)
         self._save_header()
         # retire the id: OSDs trim the block clones it pinned
         self.ioctx.selfmanaged_snap_remove(snap["id"])
+        self._journal_commit(jtid)
 
     def snap_rollback(self, snap_name: str) -> None:
         snap = self.meta["snaps"].get(snap_name)
         if snap is None:
             raise ImageNotFound("%s@%s" % (self.name, snap_name))
+        jtid = self._journal_event({"type": "snap_rollback",
+                                    "name": snap_name})
         snap_id, snap_size = snap["id"], snap["size"]
         self._apply_snapc()
         parented = self.meta.get("parent") is not None
@@ -228,6 +331,7 @@ class Image:
         if self._size != snap_size:
             self._size = snap_size
             self._save_header()
+        self._journal_commit(jtid)
 
     # -- layering (clone reads / copy-up / flatten) --------------------
 
@@ -281,6 +385,8 @@ class Image:
 
     def write(self, offset: int, data: bytes) -> int:
         self._check_extent(offset, len(data))
+        jtid = self._journal_event({"type": "write", "offset": offset,
+                                    "data": bytes(data)})
         self._apply_snapc()
         parented = self.meta.get("parent") is not None
         for blk, blk_off, n, foff in self.layout.map_extent(
@@ -299,6 +405,7 @@ class Image:
             self.ioctx.write(oid,
                              data[foff - offset:foff - offset + n],
                              blk_off)
+        self._journal_commit(jtid)
         return len(data)
 
     def read(self, offset: int, length: int) -> bytes:
@@ -324,6 +431,8 @@ class Image:
         On a clone, discarded blocks are MASKED with zeros rather than
         removed, or the parent's bytes would resurface."""
         self._check_extent(offset, length)
+        jtid = self._journal_event({"type": "discard", "offset": offset,
+                                    "length": length})
         self._apply_snapc()
         parented = self.meta.get("parent") is not None
         for blk, blk_off, n, _ in self.layout.map_extent(offset, length):
@@ -343,8 +452,11 @@ class Image:
                             raise
                         self._copy_up(blk)
                 self.ioctx.write(oid, b"\0" * n, blk_off)
+        self._journal_commit(jtid)
 
     def resize(self, new_size: int) -> None:
+        jtid = self._journal_event({"type": "resize",
+                                    "size": new_size})
         self._apply_snapc()
         parented = self.meta.get("parent") is not None
         if new_size < self._size:
@@ -381,3 +493,4 @@ class Image:
                     oid, b"\0" * (self.block_size - tail_off), tail_off)
         self._size = new_size
         self._save_header()
+        self._journal_commit(jtid)

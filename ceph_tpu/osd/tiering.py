@@ -119,9 +119,12 @@ class PGTier:
     def __init__(self, pg):
         self.pg = pg
         self.lock = threading.Lock()
+        from ..common.bounded import BoundedDict
         self._promoting: dict = {}    # oid -> [waiter continuations]
-        self._absent: dict = {}       # oid -> confirmed-miss stamp
-        self._atime: dict = {}        # oid -> last access (monotonic)
+        # bounded: one-shot accesses must not accumulate forever on a
+        # long-lived cache PG fronting a large base pool
+        self._absent: BoundedDict = BoundedDict(4096)
+        self._atime: BoundedDict = BoundedDict(65536)
         self.dirty_at: dict = {}      # oid -> first-dirty stamp
         self.hit_set: HitSet | None = None
         self._hit_set_start = 0.0
@@ -134,7 +137,6 @@ class PGTier:
         # guarantee must be re-established here — a retransmit of a
         # proxied write must attach to (or replay) the first proxy, not
         # spawn a second one (double-applied append otherwise)
-        from ..common.bounded import BoundedDict
         self._proxy_done: BoundedDict = BoundedDict()
         self._proxy_inflight: dict = {}   # (session, tid) -> [reply_fns]
 

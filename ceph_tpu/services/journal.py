@@ -1,0 +1,290 @@
+"""Journaler: an append/replay/trim journal striped over RADOS objects.
+
+Role of the reference's src/journal/ library (Journaler.cc,
+JournalMetadata.cc, Entry.cc, ObjectRecorder.cc, JournalTrimmer.cc):
+
+  metadata object   `journal.<id>` — omap carries the journal's
+                    geometry ("meta": order, splay_width,
+                    entries_per_object) plus one record per registered
+                    client ("client.<id>": commit position). Clients
+                    are the master writer ("") and mirror peers;
+                    trimming may only pass the MINIMUM commit position
+                    over all of them (JournalMetadata::committed).
+  data objects      `journal_data.<id>.<objnum>` — entries are
+                    splayed across `splay_width` concurrent streams
+                    (ObjectRecorder), advancing to a fresh object set
+                    as objects fill. The reference advances sets when
+                    an object exceeds 2^order bytes; here the set
+                    advances every `entries_per_object` entries per
+                    stream — same role (bounded objects + splay) with
+                    a deterministic tid -> object mapping:
+                        object(tid) = (tid % w) + w * set(tid)
+                        set(tid)    = tid // (w * entries_per_object)
+  entry framing     Entry.cc: a preamble magic, the entry tid, the
+                    tag, the payload, and a CRC the replayer verifies
+                    (torn tail entries after a crash are dropped, not
+                    replayed as garbage).
+
+Single-writer contract: like the reference (which gates journaling
+behind librbd's exclusive lock), exactly one master Journaler appends
+at a time; readers/committers are unrestricted.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+from .. import encoding
+
+__all__ = ["Journaler", "JournalExists", "JournalNotFound"]
+
+ENTRY_MAGIC = b"JRNE"
+
+
+class JournalExists(Exception):
+    pass
+
+
+class JournalNotFound(Exception):
+    pass
+
+
+def _meta_oid(journal_id: str) -> str:
+    return "journal.%s" % journal_id
+
+
+def _data_oid(journal_id: str, objnum: int) -> str:
+    return "journal_data.%s.%d" % (journal_id, objnum)
+
+
+def _frame(tid: int, tag: str, payload: bytes) -> bytes:
+    tag_b = tag.encode()
+    body = struct.pack("<QII", tid, len(tag_b), len(payload)) \
+        + tag_b + payload
+    return ENTRY_MAGIC + struct.pack("<I", zlib.crc32(body)) + body
+
+
+def _unframe(buf: bytes, off: int):
+    """Parse one entry at off; returns (tid, tag, payload, next_off) or
+    None for a torn/corrupt tail (replay stops there, like the
+    reference treats a bad preamble as end-of-journal)."""
+    if off + 24 > len(buf) or buf[off:off + 4] != ENTRY_MAGIC:
+        return None
+    (crc,) = struct.unpack_from("<I", buf, off + 4)
+    tid, tag_len, pay_len = struct.unpack_from("<QII", buf, off + 8)
+    end = off + 24 + tag_len + pay_len
+    if end > len(buf):
+        return None
+    body = buf[off + 8:end]
+    if zlib.crc32(body) != crc:
+        return None
+    tag = buf[off + 24:off + 24 + tag_len].decode()
+    payload = buf[off + 24 + tag_len:end]
+    return tid, tag, payload, end
+
+
+class Journaler:
+    def __init__(self, ioctx, journal_id: str, order: int = 24,
+                 splay_width: int = 4, entries_per_object: int = 64):
+        self.ioctx = ioctx
+        self.journal_id = journal_id
+        self.order = order
+        self.splay_width = splay_width
+        self.entries_per_object = entries_per_object
+        self.next_tid = 0
+        self._open = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def create(self) -> None:
+        """Persist the metadata object (journal::Journaler::create).
+        A metadata object WITHOUT a "meta" omap key is a half-created
+        corpse (crash between write_full and omap_set): repair it
+        instead of raising, so the owning image never bricks."""
+        oid = _meta_oid(self.journal_id)
+        exists = True
+        try:
+            self.ioctx.stat(oid)
+        except OSError:
+            exists = False
+        if exists and "meta" in self.ioctx.omap_get(oid):
+            raise JournalExists(self.journal_id)
+        if not exists:
+            self.ioctx.write_full(oid, b"")
+        self.ioctx.omap_set(oid, {
+            "meta": encoding.encode_any({
+                "order": self.order,
+                "splay_width": self.splay_width,
+                "entries_per_object": self.entries_per_object,
+                "next_tid": 0})})
+        self._open = True
+
+    def open(self) -> None:
+        meta = self._load_meta()
+        self.order = meta["order"]
+        self.splay_width = meta["splay_width"]
+        self.entries_per_object = meta["entries_per_object"]
+        self.next_tid = meta["next_tid"]
+        self._open = True
+
+    def _load_meta(self) -> dict:
+        try:
+            omap = self.ioctx.omap_get(_meta_oid(self.journal_id))
+        except OSError:
+            raise JournalNotFound(self.journal_id)
+        raw = omap.get("meta")
+        if raw is None:
+            raise JournalNotFound(self.journal_id)
+        return encoding.decode_any(raw)
+
+    def _save_meta(self) -> None:
+        self.ioctx.omap_set(_meta_oid(self.journal_id), {
+            "meta": encoding.encode_any({
+                "order": self.order,
+                "splay_width": self.splay_width,
+                "entries_per_object": self.entries_per_object,
+                "next_tid": self.next_tid})})
+
+    @staticmethod
+    def exists(ioctx, journal_id: str) -> bool:
+        try:
+            ioctx.stat(_meta_oid(journal_id))
+            return True
+        except OSError:
+            return False
+
+    def remove(self) -> None:
+        """Delete every data object and the metadata object."""
+        per_set = self.splay_width * self.entries_per_object
+        last_set = self.next_tid // per_set
+        for objnum in range((last_set + 1) * self.splay_width):
+            try:
+                self.ioctx.remove(_data_oid(self.journal_id, objnum))
+            except OSError:
+                pass
+        try:
+            self.ioctx.remove(_meta_oid(self.journal_id))
+        except OSError:
+            pass
+        self._open = False
+
+    # -- geometry ------------------------------------------------------
+
+    def _object_of(self, tid: int) -> int:
+        per_set = self.splay_width * self.entries_per_object
+        return (tid % self.splay_width) \
+            + self.splay_width * (tid // per_set)
+
+    # -- clients (JournalMetadata register/commit) ---------------------
+
+    def register_client(self, client_id: str) -> None:
+        key = "client.%s" % client_id
+        oid = _meta_oid(self.journal_id)
+        omap = self.ioctx.omap_get(oid)
+        if key not in omap:
+            self.ioctx.omap_set(oid, {key: encoding.encode_any(
+                {"commit_tid": -1})})
+
+    def unregister_client(self, client_id: str) -> None:
+        self.ioctx.omap_rm_keys(_meta_oid(self.journal_id),
+                                ["client.%s" % client_id])
+
+    def clients(self) -> dict:
+        """client_id -> commit_tid (entries <= tid are consumed)."""
+        omap = self.ioctx.omap_get(_meta_oid(self.journal_id))
+        out = {}
+        for k, v in omap.items():
+            if k.startswith("client."):
+                out[k[len("client."):]] = \
+                    encoding.decode_any(v)["commit_tid"]
+        return out
+
+    def commit(self, client_id: str, tid: int) -> None:
+        """Advance a client's commit position (monotonic)."""
+        cur = self.committed(client_id)
+        if tid > cur:
+            self.ioctx.omap_set(_meta_oid(self.journal_id), {
+                "client.%s" % client_id:
+                    encoding.encode_any({"commit_tid": tid})})
+
+    def committed(self, client_id: str) -> int:
+        omap = self.ioctx.omap_get(_meta_oid(self.journal_id))
+        raw = omap.get("client.%s" % client_id)
+        return encoding.decode_any(raw)["commit_tid"] \
+            if raw is not None else -1
+
+    # -- append / replay / trim ----------------------------------------
+
+    def append(self, tag: str, payload: bytes) -> int:
+        assert self._open, "journal not open"
+        tid = self.next_tid
+        self.ioctx.append(_data_oid(self.journal_id,
+                                    self._object_of(tid)),
+                          _frame(tid, tag, payload))
+        self.next_tid = tid + 1
+        self._save_meta()
+        return tid
+
+    def iterate(self, from_tid: int = -1):
+        """Yield (tid, tag, payload) for every intact entry with
+        tid > from_tid, in tid order (JournalPlayer role). Sets hold
+        contiguous tid ranges, so reading starts at the set containing
+        from_tid+1 — a tailing mirror does not re-read the whole
+        journal every poll."""
+        entries = []
+        per_set = self.splay_width * self.entries_per_object
+        meta = self._load_meta()
+        if from_tid >= meta["next_tid"] - 1:
+            return []                 # nothing new: zero object reads
+        last_set = max(meta["next_tid"] - 1, 0) // per_set
+        first_set = max(from_tid + 1, 0) // per_set
+        for objnum in range(first_set * self.splay_width,
+                            (last_set + 1) * self.splay_width):
+            try:
+                buf = self.ioctx.read(_data_oid(self.journal_id,
+                                                objnum))
+            except OSError:
+                continue
+            off = 0
+            while True:
+                parsed = _unframe(buf, off)
+                if parsed is None:
+                    break
+                tid, tag, payload, off = parsed
+                if tid > from_tid:
+                    entries.append((tid, tag, payload))
+        entries.sort(key=lambda e: e[0])
+        return entries
+
+    def trim(self) -> int:
+        """Delete object sets every registered client has fully
+        committed (JournalTrimmer::trim_objects). Returns how many
+        data objects were removed."""
+        positions = self.clients()
+        if not positions:
+            return 0
+        floor = min(positions.values())
+        per_set = self.splay_width * self.entries_per_object
+        # a set s holds tids [s*per_set, (s+1)*per_set): removable when
+        # every tid below the NEXT set start is committed
+        removable_sets = (floor + 1) // per_set
+        # trim progress lives in its OWN omap key: a mirror peer trims
+        # the remote journal while the master keeps rewriting "meta",
+        # and the two must not clobber each other
+        oid = _meta_oid(self.journal_id)
+        omap = self.ioctx.omap_get(oid)
+        trimmed_before = int(omap.get("trimmed", b"0"))
+        removed = 0
+        for s in range(trimmed_before, removable_sets):
+            for i in range(self.splay_width):
+                try:
+                    self.ioctx.remove(_data_oid(
+                        self.journal_id, s * self.splay_width + i))
+                    removed += 1
+                except OSError:
+                    pass
+        if removable_sets > trimmed_before:
+            self.ioctx.omap_set(oid, {
+                "trimmed": str(removable_sets).encode()})
+        return removed

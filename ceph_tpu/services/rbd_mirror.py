@@ -1,0 +1,153 @@
+"""rbd-mirror: journal-based asynchronous image replication.
+
+Role of the reference's src/tools/rbd_mirror/ daemon:
+
+  ClusterWatcher / PoolReplayer   watch the remote pool for images
+                    with journaling enabled and spin up a replayer per
+                    image (PoolReplayer.cc role; here one polling loop
+                    covers the pool).
+  ImageReplayer::bootstrap        first sight of an image copies its
+                    current content into the local cluster
+                    (BootstrapRequest.cc / image_sync/ — a full sync),
+                    pinning the journal position observed BEFORE the
+                    copy began so events raced by the sync are
+                    replayed afterward (replay is idempotent).
+  ImageReplayer::replay           tail the REMOTE image journal from
+                    this peer's commit position, apply each event to
+                    the local image through the normal librbd surface,
+                    then advance the commit position — which lets the
+                    primary's JournalTrimmer retire fully-consumed
+                    journal objects.
+
+The peer registers in the remote journal as client
+"mirror.<peer_uuid>"; the master writer is client "". Promotion/
+demotion and the two-way split-brain machinery (tag ownership chains)
+are out of scope: images replicate one-way, primary -> secondary.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+
+from .. import encoding
+from ..client.rbd import RBD, Image, ImageNotFound, _journal_id
+from .journal import Journaler, JournalNotFound
+
+__all__ = ["RbdMirror"]
+
+
+class RbdMirror:
+    """One-way pool replayer: remote (primary) ioctx -> local
+    (secondary) ioctx."""
+
+    def __init__(self, local_ioctx, remote_ioctx,
+                 peer_uuid: str | None = None,
+                 interval: float = 0.1):
+        self.local = local_ioctx
+        self.remote = remote_ioctx
+        self.peer_uuid = peer_uuid or uuid.uuid4().hex[:12]
+        self.client_id = "mirror.%s" % self.peer_uuid
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # image -> replay status (the `rbd mirror image status` role)
+        self.status: dict = {}
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run,
+                                        name="rbd-mirror-%s"
+                                        % self.peer_uuid, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.replay_pool_once()
+            except Exception as e:
+                self.status["_pool"] = "error: %r" % (e,)
+            self._stop.wait(self.interval)
+
+    # -- replication ---------------------------------------------------
+
+    def mirrored_images(self) -> list[str]:
+        """Images on the PRIMARY with journaling enabled (pool-mode
+        mirroring: the feature bit opts the image in)."""
+        out = []
+        for name in RBD.list(self.remote):
+            try:
+                img = Image(self.remote, name)
+            except ImageNotFound:
+                continue
+            if "journaling" in img.meta.get("features", []):
+                out.append(name)
+        return out
+
+    def replay_pool_once(self) -> None:
+        for name in self.mirrored_images():
+            self.replay_image_once(name)
+
+    def replay_image_once(self, name: str) -> None:
+        try:
+            journal = Journaler(self.remote, _journal_id(name))
+            journal.open()
+        except JournalNotFound:
+            return
+        if self.client_id not in journal.clients():
+            journal.register_client(self.client_id)
+        try:
+            local_img = Image(self.local, name)
+        except ImageNotFound:
+            local_img = self._bootstrap(name, journal)
+            if local_img is None:
+                return
+        applied = 0
+        pos = journal.committed(self.client_id)
+        if pos >= journal.next_tid - 1:
+            # caught up: zero data-object reads on an idle image
+            self.status[name] = {"state": "replaying", "position": pos}
+            return
+        for tid, tag, payload in journal.iterate(pos):
+            self._apply(local_img, encoding.decode_any(payload))
+            journal.commit(self.client_id, tid)
+            applied += 1
+        if applied:
+            journal.trim()            # let the primary retire objects
+        self.status[name] = {"state": "replaying",
+                             "position": journal.committed(
+                                 self.client_id)}
+
+    def _bootstrap(self, name: str, journal: Journaler):
+        """Full image sync (BootstrapRequest role). The commit
+        position is pinned to the master's position observed BEFORE
+        the copy: events landing during the copy are replayed again
+        afterward, and replay is idempotent."""
+        pre_copy_pos = journal.committed("")
+        src = Image(self.remote, name)
+        try:
+            RBD.create(self.local, name, src.size(), order=src.order)
+        except Exception:
+            pass                      # raced another replayer
+        dst = Image(self.local, name)
+        step = src.block_size
+        for off in range(0, src.size(), step):
+            chunk = src.read(off, min(step, src.size() - off))
+            if chunk.strip(b"\0"):
+                dst.write(off, chunk)
+        journal.commit(self.client_id, pre_copy_pos)
+        self.status[name] = {"state": "bootstrapped",
+                             "position": pre_copy_pos}
+        return dst
+
+    @staticmethod
+    def _apply(img: Image, ev: dict) -> None:
+        """Event application through the normal librbd surface
+        (ImageReplayer -> journal/Replay handlers)."""
+        img._apply_event(ev)
