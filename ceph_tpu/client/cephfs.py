@@ -1,0 +1,289 @@
+"""CephFS client: a POSIX-ish filesystem over the MDS + data pool.
+
+Role of the reference's src/client/Client.cc (libcephfs) at framework
+scale: path operations resolve component-by-component through MDS
+lookups (Client::path_walk); metadata mutations are MClientRequests
+to the ACTIVE MDS (learned from the mdsmap, retried through failover);
+file DATA bypasses the MDS entirely — reads and writes stripe
+directly onto `<ino-hex>.<objno>` objects in the data pool via the
+file layout (Client::_read/_write -> Filer), then the size/mtime
+update lands at the MDS.
+
+Caps (coherent client caching) are consciously absent — every
+operation is uncached and serialized at the MDS, the reference's
+consistency floor. Paths are '/'-separated, absolute or relative to
+root."""
+
+from __future__ import annotations
+
+import errno
+import itertools
+import threading
+
+from ..mds.mds_daemon import ROOT_INO, data_oid
+from ..msg.message import MClientRequest
+from ..msg.messenger import Dispatcher
+
+__all__ = ["CephFS", "CephFSError"]
+
+
+class CephFSError(OSError):
+    pass
+
+
+class CephFS(Dispatcher):
+    """Mounted filesystem handle (libcephfs ceph_mount role)."""
+
+    def __init__(self, rados_client, timeout: float = 20.0):
+        self.client = rados_client
+        self.timeout = timeout
+        self._tids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._inflight: dict = {}     # tid -> [event, reply]
+        self.client.msgr.add_dispatcher_tail(self)
+        # learn the fs pools from the mdsmap
+        self.client.mon_client.sub_want()
+        m = self._mdsmap(wait_fs=True)
+        fs = m["fs"]
+        self.data_io = self.client.open_ioctx(fs["data_pool"])
+
+    # -- mdsmap / transport --------------------------------------------
+
+    def _mdsmap(self, wait_fs: bool = False) -> dict:
+        import time
+        deadline = time.monotonic() + self.timeout
+        while time.monotonic() < deadline:
+            m = self.client.mon_client.mdsmap
+            if m is not None and (not wait_fs or m.get("fs")):
+                return m
+            r, _, data = self.client.mon_command({"prefix": "mds stat"})
+            if r == 0 and data and (not wait_fs or data.get("fs")):
+                self.client.mon_client.mdsmap = data
+                return data
+            time.sleep(0.05)
+        raise CephFSError(errno.ETIMEDOUT, "no usable mdsmap")
+
+    def ms_dispatch(self, msg) -> bool:
+        if msg.get_type() != "MClientReply":
+            return False
+        with self._lock:
+            waiter = self._inflight.pop(msg.tid, None)
+        if waiter is not None:
+            waiter[1] = msg
+            waiter[0].set()
+        return True
+
+    def _request(self, op: str, args: dict):
+        """Send to the active MDS; retry through EAGAIN (standby
+        takeover in progress) and resolve a fresh map each attempt —
+        the Client::resend_unsafe_requests failover path."""
+        import time
+        tid = next(self._tids)
+        waiter = [threading.Event(), None]
+        with self._lock:
+            self._inflight[tid] = waiter
+        deadline = time.monotonic() + self.timeout
+        try:
+            while True:
+                if time.monotonic() >= deadline:
+                    raise CephFSError(errno.ETIMEDOUT,
+                                      "mds op %s timed out" % op)
+                m = self.client.mon_client.mdsmap
+                active = (m or {}).get("active")
+                if active is None:
+                    self.client.mon_client.renew_subs()
+                    time.sleep(0.05)
+                    continue
+                self.client.msgr.send_message(
+                    MClientRequest(tid=tid, op=op, args=args,
+                                   session=self.client.session,
+                                   reply_to=self.client.msgr.my_addr),
+                    tuple(active["addr"])
+                    if isinstance(active["addr"], list)
+                    else active["addr"])
+                if not waiter[0].wait(0.5):
+                    self.client.mon_client.renew_subs()
+                    continue          # resend (same tid: MDS dedups)
+                reply = waiter[1]
+                if reply.result == -errno.EAGAIN:
+                    # not active yet / demoted: re-resolve and retry
+                    waiter[0].clear()
+                    waiter[1] = None
+                    with self._lock:
+                        self._inflight[tid] = waiter
+                    self.client.mon_client.renew_subs()
+                    time.sleep(0.1)
+                    continue
+                if reply.result < 0:
+                    raise CephFSError(-reply.result,
+                                      "%s: %s" % (op, args))
+                return reply.data
+        finally:
+            with self._lock:
+                self._inflight.pop(tid, None)
+
+    # -- path resolution (Client::path_walk) ---------------------------
+
+    @staticmethod
+    def _split(path: str):
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            raise CephFSError(errno.EINVAL, "empty path")
+        return parts
+
+    def _resolve_dir(self, parts) -> int:
+        """Walk directory components from root; returns the dir ino.
+        A symlink mid-walk restarts the walk with its target spliced
+        in front of the remaining components."""
+        parts = list(parts)
+        ino = ROOT_INO
+        i = 0
+        while i < len(parts):
+            rec = self._request("lookup", {"dir": ino,
+                                           "name": parts[i]})
+            if rec["type"] == "symlink":
+                return self._resolve_dir(
+                    self._split(rec["target"]) + parts[i + 1:])
+            if rec["type"] != "dir":
+                raise CephFSError(errno.ENOTDIR, parts[i])
+            ino = rec["ino"]
+            i += 1
+        return ino
+
+    def _parent_of(self, path: str):
+        parts = self._split(path)
+        return self._resolve_dir(parts[:-1]), parts[-1]
+
+    def _file_rec(self, path: str, follow: bool = True) -> dict:
+        d, name = self._parent_of(path)
+        rec = self._request("lookup", {"dir": d, "name": name})
+        if follow and rec["type"] == "symlink":
+            return self._file_rec(rec["target"])
+        return rec
+
+    # -- namespace ops --------------------------------------------------
+
+    def mkdir(self, path: str) -> None:
+        d, name = self._parent_of(path)
+        self._request("mkdir", {"dir": d, "name": name})
+
+    def mkdirs(self, path: str) -> None:
+        parts = self._split(path)
+        for i in range(1, len(parts) + 1):
+            try:
+                self.mkdir("/".join(parts[:i]))
+            except CephFSError as e:
+                if e.errno != errno.EEXIST:
+                    raise
+
+    def create(self, path: str) -> dict:
+        d, name = self._parent_of(path)
+        return self._request("create", {"dir": d, "name": name})
+
+    def symlink(self, target: str, path: str) -> None:
+        d, name = self._parent_of(path)
+        self._request("symlink", {"dir": d, "name": name,
+                                  "target": target})
+
+    def readlink(self, path: str) -> str:
+        rec = self._file_rec(path, follow=False)
+        if rec["type"] != "symlink":
+            raise CephFSError(errno.EINVAL, path)
+        return rec["target"]
+
+    def listdir(self, path: str = "/") -> dict:
+        parts = [p for p in path.split("/") if p]
+        ino = self._resolve_dir(parts) if parts else ROOT_INO
+        return self._request("readdir", {"dir": ino})
+
+    def stat(self, path: str) -> dict:
+        return self._file_rec(path)
+
+    def unlink(self, path: str) -> None:
+        d, name = self._parent_of(path)
+        self._request("unlink", {"dir": d, "name": name})
+
+    def rmdir(self, path: str) -> None:
+        d, name = self._parent_of(path)
+        self._request("rmdir", {"dir": d, "name": name})
+
+    def rename(self, src: str, dst: str) -> None:
+        sd, sname = self._parent_of(src)
+        dd, dname = self._parent_of(dst)
+        self._request("rename", {"dir": sd, "name": sname,
+                                 "newdir": dd, "newname": dname})
+
+    # -- file IO (data pool direct; Filer/Striper role) ----------------
+
+    def write(self, path: str, data: bytes, offset: int = 0) -> int:
+        d, name = self._parent_of(path)
+        try:
+            rec = self._request("lookup", {"dir": d, "name": name})
+        except CephFSError as e:
+            if e.errno != errno.ENOENT:
+                raise
+            rec = self._request("create", {"dir": d, "name": name})
+        if rec["type"] != "file":
+            raise CephFSError(errno.EISDIR, path)
+        osize = rec.get("object_size", 1 << 22)
+        pos = offset
+        remaining = data
+        while remaining:
+            objno, obj_off = divmod(pos, osize)
+            n = min(osize - obj_off, len(remaining))
+            self.data_io.write(data_oid(rec["ino"], objno),
+                               remaining[:n], obj_off)
+            remaining = remaining[n:]
+            pos += n
+        new_size = max(rec["size"], offset + len(data))
+        if new_size != rec["size"]:
+            import time as _t
+            self._request("setattr", {"dir": d, "name": name,
+                                      "size": new_size,
+                                      "mtime": _t.time()})
+        return len(data)
+
+    def read(self, path: str, length: int = 0,
+             offset: int = 0) -> bytes:
+        rec = self._file_rec(path)
+        if rec["type"] != "file":
+            raise CephFSError(errno.EISDIR, path)
+        size = rec["size"]
+        if length == 0 or offset + length > size:
+            length = max(0, size - offset)
+        osize = rec.get("object_size", 1 << 22)
+        out = bytearray(length)
+        pos = offset
+        while pos < offset + length:
+            objno, obj_off = divmod(pos, osize)
+            n = min(osize - obj_off, offset + length - pos)
+            try:
+                piece = self.data_io.read(data_oid(rec["ino"], objno),
+                                          n, obj_off)
+            except OSError as e:
+                if e.errno != errno.ENOENT:
+                    raise
+                piece = b""           # sparse hole reads as zeros
+            out[pos - offset:pos - offset + len(piece)] = piece
+            pos += n
+        return bytes(out)
+
+    def truncate(self, path: str, size: int) -> None:
+        d, name = self._parent_of(path)
+        rec = self._request("lookup", {"dir": d, "name": name})
+        if rec["type"] != "file":
+            raise CephFSError(errno.EISDIR, path)
+        osize = rec.get("object_size", 1 << 22)
+        old_objs = -(-rec["size"] // osize) if rec["size"] else 0
+        keep_objs = -(-size // osize) if size else 0
+        for objno in range(keep_objs, old_objs):
+            try:
+                self.data_io.remove(data_oid(rec["ino"], objno))
+            except OSError:
+                pass
+        if size % osize and size < rec["size"]:
+            self.data_io.truncate(data_oid(rec["ino"],
+                                           size // osize),
+                                  size % osize)
+        self._request("setattr", {"dir": d, "name": name,
+                                  "size": size})

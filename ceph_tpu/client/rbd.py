@@ -86,11 +86,18 @@ class RBD:
             raise ImageExists(name)
         if "journaling" in features:
             # the journal exists BEFORE the header advertises it: a
-            # crash in between leaves an orphan journal (harmless),
-            # never a journaled image without a journal (unopenable)
-            from ..services.journal import Journaler
+            # crash in between leaves an orphan journal, never a
+            # journaled image without a journal (unopenable). An
+            # orphan found here (no image exists — the check above
+            # passed) is wiped so create stays crash-RETRYABLE
+            from ..services.journal import JournalExists, Journaler
             j = Journaler(ioctx, _journal_id(name))
-            j.create()
+            try:
+                j.create()
+            except JournalExists:
+                j.open()
+                j.remove()
+                j.create()
             j.register_client("")     # the master position
         ioctx.write_full(_header_oid(name),
                          _pack_header(size, order,
@@ -156,9 +163,10 @@ class RBD:
 class Image:
     """One open image (librbd Image): offset-addressed block IO."""
 
-    def __init__(self, ioctx, name: str):
+    def __init__(self, ioctx, name: str, read_only: bool = False):
         self.ioctx = ioctx
         self.name = name
+        self.read_only = read_only
         try:
             hdr = ioctx.read(_header_oid(name))
         except OSError as e:
@@ -177,7 +185,11 @@ class Image:
         # crash-recovery half of librbd::Journal::open)
         self._journal = None
         self._replaying = False
-        if "journaling" in self.meta.get("features", []):
+        if not read_only \
+                and "journaling" in self.meta.get("features", []):
+            # read_only opens (mirror daemons, inspectors) must NOT
+            # touch the journal: replay would make a remote READER a
+            # journal WRITER racing the primary's own apply path
             from ..services.journal import JournalNotFound, Journaler
             self._journal = Journaler(ioctx, _journal_id(name))
             try:

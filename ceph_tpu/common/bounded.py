@@ -15,6 +15,11 @@ class BoundedDict(dict):
         self.cap = cap
 
     def __setitem__(self, key, value):
+        # move-to-end on reassignment: eviction is then LRU-by-update,
+        # not FIFO-by-first-insertion — a constantly-refreshed entry
+        # (e.g. a hot object's atime) must never be the one evicted
+        if key in self:
+            super().__delitem__(key)
         super().__setitem__(key, value)
         while len(self) > self.cap:
             super().__delitem__(next(iter(self)))

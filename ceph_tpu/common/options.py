@@ -88,6 +88,11 @@ def _declare_defaults():
     o("osd_op_queue_mclock_recovery_res", float, 0.0, LEVEL_ADVANCED)
     o("osd_op_queue_mclock_recovery_wgt", float, 1.0, LEVEL_ADVANCED)
     o("osd_op_queue_mclock_recovery_lim", float, 0.0, LEVEL_ADVANCED)
+    o("mds_beacon_interval", float, 0.25, LEVEL_ADVANCED,
+      "seconds between MDS -> mon beacons (options.cc mds_beacon_interval, "
+      "scaled for in-process clusters)")
+    o("mds_beacon_grace", float, 1.5, LEVEL_ADVANCED,
+      "seconds without a beacon before the mon fails an active MDS")
     o("osd_agent_interval", float, 0.25, LEVEL_ADVANCED,
       "seconds between tier-agent flush/evict passes "
       "(osd_agent_delay_time role, scaled for in-process clusters)")

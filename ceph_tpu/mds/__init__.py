@@ -1,0 +1,3 @@
+from .mds_daemon import MDSDaemon
+
+__all__ = ["MDSDaemon"]

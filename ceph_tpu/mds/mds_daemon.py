@@ -1,0 +1,417 @@
+"""MDS: the CephFS metadata server.
+
+Role of the reference's src/mds/ (75k LoC) at framework scale. The
+on-RADOS metadata layout follows the reference's design:
+
+  dirfrags      each directory ino owns an object `dir.<ino>` in the
+                METADATA pool whose omap maps dentry name -> encoded
+                inode record (CDir/CDentry over omap,
+                src/mds/CDir.cc _omap_fetch/_omap_commit). Inodes are
+                embedded in their primary dentry exactly like the
+                reference's primary-link embedding (doc: "inodes are
+                stored in the dentry").
+  inode table   `mds_inotable` allocates ino numbers
+                (src/mds/InoTable.h role); root is ino 1.
+  MDS journal   every metadata mutation appends an EUpdate-style
+                event to a Journaler (`mds.<rank>` in the metadata
+                pool — src/mds/journal.cc EUpdate, MDLog) BEFORE the
+                omap apply; a newly-active MDS replays the
+                uncommitted tail idempotently (crash recovery /
+                failover takeover).
+  file data     lives in the DATA pool as `<ino-hex>.<objno>` objects
+                written directly by clients through the striper
+                layout (CephFS file layout, src/osdc/Filer role) —
+                the MDS never touches file bytes except to purge them
+                on unlink (PurgeQueue role).
+
+Liveness + rank: the daemon beacons to the monitor
+(MMDSBeacon/MDSMonitor); the mdsmap names ONE active MDS and
+standbys. A standby watches the mdsmap and takes over by replaying
+the shared journal. Capabilities (client caps / coherent client
+caching) are consciously reduced: metadata ops serialize at the
+active MDS and clients do uncached data IO — the consistency model
+of the reference with caps disabled.
+
+Client protocol: MClientRequest{op, args} -> MClientReply, with
+(session, tid) exactly-once dedup for the non-idempotent ops
+(rename/unlink), like the OSD's reqid dedup.
+"""
+
+from __future__ import annotations
+
+import errno
+import threading
+import time
+
+from .. import encoding
+from ..common import Context
+from ..common.bounded import BoundedDict
+from ..msg.async_messenger import create_messenger
+from ..msg.message import MClientReply, MMDSBeacon
+from ..msg.messenger import Dispatcher
+from ..mon.mon_client import MonClient
+from ..services.journal import JournalExists, Journaler
+
+__all__ = ["MDSDaemon", "ROOT_INO"]
+
+ROOT_INO = 1
+INOTABLE_OID = "mds_inotable"
+
+
+def dir_oid(ino: int) -> str:
+    return "dir.%x" % ino
+
+
+def data_oid(ino: int, objno: int) -> str:
+    """CephFS data object naming: <ino-hex>.<objno-hex>
+    (src/include/ceph_fs.h file layout)."""
+    return "%x.%08x" % (ino, objno)
+
+
+class MDSDaemon(Dispatcher):
+    def __init__(self, name: str, monmap: dict,
+                 ctx: Context | None = None):
+        self.name = name
+        self.ctx = ctx or Context(name="mds.%s" % name)
+        self.msgr = create_messenger(("mds", name), conf=self.ctx.conf)
+        self.monmap = dict(monmap)
+        self.mon_client = MonClient(monmap, self.msgr,
+                                    "mds.%s" % name)
+        self.state = "boot"            # boot | standby | active
+        self.lock = threading.RLock()
+        self._rados = None             # internal RadosClient
+        self.meta_io = None
+        self.data_io = None
+        self.journal: Journaler | None = None
+        self._next_ino = 0
+        self._replies: BoundedDict = BoundedDict()   # (session,tid)
+        self._running = False
+        self._beacon_token = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def init(self) -> None:
+        self.msgr.bind()
+        self.msgr.add_dispatcher_head(self)
+        self.msgr.start()
+        self._running = True
+        self.mon_client.mdsmap_callbacks.append(self._on_mdsmap)
+        self.mon_client.sub_want()
+        self._beacon()
+
+    def shutdown(self) -> None:
+        self._running = False
+        if self._beacon_token is not None:
+            self._beacon_token.cancel()
+        if self._rados is not None:
+            self._rados.shutdown()
+        self.msgr.shutdown()
+        self.ctx.shutdown()
+
+    def _beacon(self) -> None:
+        if not self._running:
+            return
+        self.msgr.send_message(
+            MMDSBeacon(name=self.name, addr=self.msgr.my_addr,
+                       state=self.state),
+            self.monmap[min(self.monmap)])
+        t = threading.Timer(
+            self.ctx.conf.get_val("mds_beacon_interval"), self._beacon)
+        t.daemon = True
+        t.start()
+        self._beacon_token = t
+
+    def _on_mdsmap(self, mdsmap: dict) -> None:
+        active = mdsmap.get("active")
+        am_active = active is not None and active["name"] == self.name
+        with self.lock:
+            if am_active and self.state != "active":
+                if mdsmap.get("fs"):
+                    self._become_active(mdsmap["fs"])
+            elif not am_active:
+                # demotion is immediate on seeing the map — requests
+                # already in flight answer EAGAIN from then on; real
+                # fencing of a PARTITIONED active (which never sees
+                # this map) is the mon's blocklist role, reduced here
+                self.state = "standby"
+
+    def _become_active(self, fs: dict) -> None:
+        """Take the rank: open the pools, replay the shared journal,
+        load the ino table (MDSRank::boot_start sequence —
+        replay -> reconnect -> active, minus caps)."""
+        from ..client.rados import RadosClient
+        if self._rados is None:
+            self._rados = RadosClient(
+                self.monmap, client_id=200000 + abs(hash(self.name))
+                % 10000)
+            self._rados.connect()
+        self.meta_io = self._rados.open_ioctx(fs["metadata_pool"])
+        self.data_io = self._rados.open_ioctx(fs["data_pool"])
+        self.journal = Journaler(self.meta_io, "mds.0")
+        try:
+            self.journal.create()
+            self.journal.register_client("")
+        except JournalExists:
+            self.journal.open()
+        # first activation plants the root dirfrag
+        try:
+            self.meta_io.stat(dir_oid(ROOT_INO))
+        except OSError:
+            self.meta_io.write_full(dir_oid(ROOT_INO), b"")
+            self.meta_io.write_full(INOTABLE_OID, b"")
+            self.meta_io.omap_set(INOTABLE_OID,
+                                  {"next_ino": b"2"})
+        # replay the uncommitted journal tail (failover/crash)
+        done = self.journal.committed("")
+        for tid, tag, payload in self.journal.iterate(done):
+            self._apply_event(encoding.decode_any(payload))
+            self.journal.commit("", tid)
+        self.journal.trim()
+        self._next_ino = int(self.meta_io.omap_get(
+            INOTABLE_OID)["next_ino"])
+        self.state = "active"
+
+    # -- ino table -----------------------------------------------------
+
+    def _alloc_ino(self) -> int:
+        ino = self._next_ino
+        self._next_ino += 1
+        self.meta_io.omap_set(INOTABLE_OID, {
+            "next_ino": str(self._next_ino).encode()})
+        return ino
+
+    # -- dirfrag access ------------------------------------------------
+
+    def _dentry(self, dir_ino: int, name: str):
+        try:
+            omap = self.meta_io.omap_get(dir_oid(dir_ino))
+        except OSError:
+            return None
+        raw = omap.get(name)
+        return encoding.decode_any(raw) if raw is not None else None
+
+    def _set_dentry(self, dir_ino: int, name: str, rec: dict) -> None:
+        self.meta_io.omap_set(dir_oid(dir_ino),
+                              {name: encoding.encode_any(rec)})
+
+    def _rm_dentry(self, dir_ino: int, name: str) -> None:
+        self.meta_io.omap_rm_keys(dir_oid(dir_ino), [name])
+
+    # -- dispatch ------------------------------------------------------
+
+    def ms_dispatch(self, msg) -> bool:
+        if msg.get_type() != "MClientRequest":
+            return False
+        dest = msg.reply_to or msg.from_addr
+        if self.state != "active":
+            self.msgr.send_message(
+                MClientReply(tid=msg.tid, result=-errno.EAGAIN), dest)
+            return True
+        key = (msg.session, msg.tid)
+        with self.lock:
+            cached = self._replies.get(key) if msg.session else None
+            if cached is None:
+                try:
+                    result, data = self._handle(msg.op, msg.args)
+                except OSError as e:
+                    result, data = -(e.errno or errno.EIO), None
+                except Exception:
+                    import logging
+                    logging.getLogger("ceph_tpu.mds").exception(
+                        "mds op %s failed", msg.op)
+                    result, data = -errno.EIO, None
+                cached = MClientReply(tid=msg.tid, result=result,
+                                      data=data)
+                if msg.session:
+                    self._replies[key] = cached
+        self.msgr.send_message(cached, dest)
+        return True
+
+    # -- op handlers (Server::handle_client_request dispatch) ----------
+
+    def _handle(self, op: str, args: dict):
+        fn = getattr(self, "_op_" + op, None)
+        if fn is None:
+            return -errno.ENOSYS, None
+        return fn(args)
+
+    def _journal_update(self, ev: dict) -> int:
+        return self.journal.append("mds", encoding.encode_any(ev))
+
+    def _commit(self, jtid: int) -> None:
+        self.journal.commit("", jtid)
+        per_set = self.journal.splay_width \
+            * self.journal.entries_per_object
+        if (jtid + 1) % per_set == 0:
+            self.journal.trim()
+
+    def _apply_event(self, ev: dict) -> None:
+        """Idempotent EUpdate application — both the live path (after
+        journaling) and replay go through here."""
+        op = ev["op"]
+        if op == "set_dentry":
+            self._set_dentry(ev["dir"], ev["name"], ev["rec"])
+            if ev.get("mkdir"):
+                try:
+                    self.meta_io.stat(dir_oid(ev["rec"]["ino"]))
+                except OSError:
+                    self.meta_io.write_full(
+                        dir_oid(ev["rec"]["ino"]), b"")
+            if ev["rec"]["ino"] >= self._next_ino:
+                self._next_ino = ev["rec"]["ino"] + 1
+                self.meta_io.omap_set(INOTABLE_OID, {
+                    "next_ino": str(self._next_ino).encode()})
+        elif op == "rm_dentry":
+            self._rm_dentry(ev["dir"], ev["name"])
+            if ev.get("rmdir_ino"):
+                try:
+                    self.meta_io.remove(dir_oid(ev["rmdir_ino"]))
+                except OSError:
+                    pass
+            if ev.get("purge"):
+                self._purge_data(ev["purge"]["ino"],
+                                 ev["purge"]["size"],
+                                 ev["purge"]["object_size"])
+        elif op == "rename":
+            rec = self._dentry(ev["dir"], ev["name"])
+            if rec is not None:
+                self._rm_dentry(ev["dir"], ev["name"])
+                self._set_dentry(ev["newdir"], ev["newname"], rec)
+
+    def _purge_data(self, ino: int, size: int,
+                    object_size: int) -> None:
+        """Unlink purges the file's data objects (PurgeQueue role)."""
+        nobj = max(1, -(-size // object_size)) if size else 0
+        for objno in range(nobj):
+            try:
+                self.data_io.remove(data_oid(ino, objno))
+            except OSError:
+                pass
+
+    # individual ops ---------------------------------------------------
+
+    DEFAULT_OBJECT_SIZE = 1 << 22      # 4 MiB (file layout default)
+
+    def _op_lookup(self, args):
+        rec = self._dentry(args["dir"], args["name"])
+        if rec is None:
+            return -errno.ENOENT, None
+        return 0, rec
+
+    def _op_readdir(self, args):
+        try:
+            omap = self.meta_io.omap_get(dir_oid(args["dir"]))
+        except OSError:
+            return -errno.ENOENT, None
+        return 0, {name: encoding.decode_any(raw)
+                   for name, raw in omap.items()}
+
+    def _op_mkdir(self, args):
+        if self._dentry(args["dir"], args["name"]) is not None:
+            return -errno.EEXIST, None
+        ino = self._alloc_ino()
+        rec = {"ino": ino, "type": "dir", "size": 0,
+               "mtime": time.time()}
+        jtid = self._journal_update({"op": "set_dentry",
+                                     "dir": args["dir"],
+                                     "name": args["name"], "rec": rec,
+                                     "mkdir": True})
+        self._apply_event({"op": "set_dentry", "dir": args["dir"],
+                           "name": args["name"], "rec": rec,
+                           "mkdir": True})
+        self._commit(jtid)
+        return 0, rec
+
+    def _op_create(self, args):
+        existing = self._dentry(args["dir"], args["name"])
+        if existing is not None:
+            if existing["type"] != "file":
+                return -errno.EISDIR, None
+            return 0, existing         # open-existing semantics
+        ino = self._alloc_ino()
+        rec = {"ino": ino, "type": "file", "size": 0,
+               "mtime": time.time(),
+               "object_size": self.DEFAULT_OBJECT_SIZE}
+        ev = {"op": "set_dentry", "dir": args["dir"],
+              "name": args["name"], "rec": rec}
+        jtid = self._journal_update(ev)
+        self._apply_event(ev)
+        self._commit(jtid)
+        return 0, rec
+
+    def _op_symlink(self, args):
+        if self._dentry(args["dir"], args["name"]) is not None:
+            return -errno.EEXIST, None
+        rec = {"ino": self._alloc_ino(), "type": "symlink",
+               "target": args["target"], "size": len(args["target"]),
+               "mtime": time.time()}
+        ev = {"op": "set_dentry", "dir": args["dir"],
+              "name": args["name"], "rec": rec}
+        jtid = self._journal_update(ev)
+        self._apply_event(ev)
+        self._commit(jtid)
+        return 0, rec
+
+    def _op_setattr(self, args):
+        rec = self._dentry(args["dir"], args["name"])
+        if rec is None:
+            return -errno.ENOENT, None
+        for k in ("size", "mtime"):
+            if k in args:
+                rec[k] = args[k]
+        ev = {"op": "set_dentry", "dir": args["dir"],
+              "name": args["name"], "rec": rec}
+        jtid = self._journal_update(ev)
+        self._apply_event(ev)
+        self._commit(jtid)
+        return 0, rec
+
+    def _op_unlink(self, args):
+        rec = self._dentry(args["dir"], args["name"])
+        if rec is None:
+            return -errno.ENOENT, None
+        if rec["type"] == "dir":
+            return -errno.EISDIR, None
+        ev = {"op": "rm_dentry", "dir": args["dir"],
+              "name": args["name"]}
+        if rec["type"] == "file":
+            ev["purge"] = {"ino": rec["ino"], "size": rec["size"],
+                           "object_size": rec.get(
+                               "object_size",
+                               self.DEFAULT_OBJECT_SIZE)}
+        jtid = self._journal_update(ev)
+        self._apply_event(ev)
+        self._commit(jtid)
+        return 0, None
+
+    def _op_rmdir(self, args):
+        rec = self._dentry(args["dir"], args["name"])
+        if rec is None:
+            return -errno.ENOENT, None
+        if rec["type"] != "dir":
+            return -errno.ENOTDIR, None
+        try:
+            if self.meta_io.omap_get(dir_oid(rec["ino"])):
+                return -errno.ENOTEMPTY, None
+        except OSError:
+            pass
+        ev = {"op": "rm_dentry", "dir": args["dir"],
+              "name": args["name"], "rmdir_ino": rec["ino"]}
+        jtid = self._journal_update(ev)
+        self._apply_event(ev)
+        self._commit(jtid)
+        return 0, None
+
+    def _op_rename(self, args):
+        rec = self._dentry(args["dir"], args["name"])
+        if rec is None:
+            return -errno.ENOENT, None
+        target = self._dentry(args["newdir"], args["newname"])
+        if target is not None and target["type"] == "dir":
+            return -errno.EISDIR, None
+        ev = {"op": "rename", "dir": args["dir"], "name": args["name"],
+              "newdir": args["newdir"], "newname": args["newname"]}
+        jtid = self._journal_update(ev)
+        self._apply_event(ev)
+        self._commit(jtid)
+        return 0, rec

@@ -1,0 +1,171 @@
+"""MDSMonitor: the monitor's mdsmap service.
+
+Role of the reference's MDSMonitor (src/mon/MDSMonitor.cc) at
+framework scale: MDS daemons announce themselves and prove liveness
+with beacons (MMDSBeacon, preprocess_beacon/prepare_beacon); the
+monitor elects one ACTIVE MDS and keeps the rest as standbys; a
+stale beacon (mds_beacon_grace) fails the active and promotes a
+standby into a NEW mdsmap epoch, which subscribers learn via MMDSMap
+pushes. The map itself is a plain dict (the MDSMap subset that
+matters here):
+
+    {"epoch": N,
+     "active": {"name": ..., "addr": ...} | None,
+     "standbys": [{"name": ..., "addr": ...}, ...],
+     "fs": {"metadata_pool": ..., "data_pool": ...} | None}
+
+The map rides the monitor's single paxos stream tagged "mdsmap"
+(Monitor._do_propose / _on_paxos_commit dispatch on the tag), so map
+changes survive monitor failover exactly like osdmap changes.
+
+`fs new` (the FSMonitor half of the reference's FSMap era) records
+which pools hold CephFS metadata/data; clients and MDS daemons read
+it from the map.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+
+__all__ = ["MDSMonitor"]
+
+DEFAULT_BEACON_GRACE = 2.0
+
+
+def _clone(m: dict) -> dict:
+    return copy.deepcopy(m)
+
+
+class MDSMonitor:
+    def __init__(self, mon):
+        self.mon = mon
+        self.mdsmap: dict = {"epoch": 0, "active": None,
+                             "standbys": [], "fs": None}
+        self.pending: dict | None = None
+        self._beacons: dict = {}      # name -> last beacon stamp
+        self._lock = threading.RLock()
+        grace = None
+        try:
+            grace = mon.ctx.conf.get_val("mds_beacon_grace")
+        except Exception:
+            pass
+        self.grace = grace if grace else DEFAULT_BEACON_GRACE
+
+    # -- pending / paxos plumbing (PaxosService contract) --------------
+
+    def _pend(self) -> dict:
+        if self.pending is None:
+            self.pending = _clone(self.mdsmap)
+            self.pending["epoch"] = self.mdsmap["epoch"] + 1
+        return self.pending
+
+    def have_pending(self) -> bool:
+        return self.pending is not None
+
+    def encode_pending(self) -> dict:
+        # swap under the lock: beacon handlers on messenger threads
+        # mutate the same pending dict, and paxos must never encode a
+        # map mid-mutation
+        with self._lock:
+            pend, self.pending = self.pending, None
+        return pend
+
+    def apply_committed(self, newmap: dict) -> None:
+        with self._lock:
+            if newmap["epoch"] <= self.mdsmap["epoch"]:
+                return
+            self.mdsmap = newmap
+        self.mon.publish_mdsmap()
+
+    # -- beacons -------------------------------------------------------
+
+    def handle_beacon(self, msg) -> None:
+        """First beaconing daemon becomes active; later ones are
+        standbys; every beacon refreshes the liveness stamp
+        (MDSMonitor::prepare_beacon)."""
+        with self._lock:
+            self._beacons[msg.name] = time.monotonic()
+            m = self.pending if self.pending is not None else self.mdsmap
+            addr = tuple(msg.addr) if isinstance(msg.addr, list) \
+                else msg.addr
+            known = []
+            if m["active"]:
+                known.append(m["active"]["name"])
+            known += [s["name"] for s in m["standbys"]]
+            if msg.name in known:
+                # a restarted daemon may come back on a new address
+                changed = False
+                for rec in ([m["active"]] if m["active"] else []) \
+                        + m["standbys"]:
+                    if rec["name"] == msg.name and \
+                            tuple(rec["addr"]) != tuple(addr):
+                        changed = True
+                if not changed:
+                    return
+                pend = self._pend()
+                for rec in ([pend["active"]] if pend["active"]
+                            else []) + pend["standbys"]:
+                    if rec["name"] == msg.name:
+                        rec["addr"] = addr
+                self.mon.propose_soon()
+                return
+            pend = self._pend()
+            rec = {"name": msg.name, "addr": addr}
+            if pend["active"] is None:
+                pend["active"] = rec
+            else:
+                pend["standbys"].append(rec)
+        self.mon.propose_soon()
+
+    def tick(self) -> None:
+        """Fail an active whose beacon went stale; promote a live
+        standby (MDSMonitor::tick -> maybe_replace_gid)."""
+        with self._lock:
+            m = self.mdsmap
+            now = time.monotonic()
+            active = m["active"]
+            if active is None or self.pending is not None:
+                return
+            # a name with NO stamp is one this monitor has never heard
+            # from — a fresh leader after failover, not a dead MDS:
+            # seed it as just-seen and give it a full grace period
+            # before judging (or a new leader would depose a healthy
+            # active on its very first tick)
+            for rec in [active] + m["standbys"]:
+                self._beacons.setdefault(rec["name"], now)
+            stamp = self._beacons[active["name"]]
+            if now - stamp <= self.grace:
+                return
+            pend = self._pend()
+            pend["active"] = None
+            # promote the freshest-beaconing standby
+            live = [s for s in pend["standbys"]
+                    if now - self._beacons.get(s["name"], 0.0)
+                    <= self.grace]
+            if live:
+                chosen = max(live, key=lambda s: self._beacons.get(
+                    s["name"], 0.0))
+                pend["standbys"] = [s for s in pend["standbys"]
+                                    if s["name"] != chosen["name"]]
+                pend["active"] = chosen
+        self.mon.propose_soon()
+
+    # -- commands ------------------------------------------------------
+
+    def handle_command(self, cmd: dict):
+        prefix = cmd.get("prefix", "")
+        if prefix == "fs new":
+            with self._lock:
+                pend = self._pend()
+                pend["fs"] = {"name": cmd.get("fs_name", "cephfs"),
+                              "metadata_pool": cmd["metadata_pool"],
+                              "data_pool": cmd["data_pool"]}
+            self.mon.propose_soon()
+            return 0, "created fs %s" % cmd.get("fs_name", "cephfs"), \
+                None
+        if prefix == "mds stat":
+            with self._lock:
+                return 0, "", _clone(self.mdsmap)
+        return -22, "unknown command %r" % prefix, None

@@ -29,7 +29,9 @@ class MonClient(Dispatcher):
         self._lock = threading.Lock()
         self._waiters: dict = {}     # tid -> [event, reply]
         self.osdmap = None
+        self.mdsmap: dict | None = None
         self.map_callbacks: list = []
+        self.mdsmap_callbacks: list = []
         self._map_event = threading.Event()
         self.auth_client = None      # CephxClient after authenticate()
         # per-client nonce so the monitor's retransmit dedup never
@@ -51,6 +53,16 @@ class MonClient(Dispatcher):
             return True
         if t == "MOSDMap":
             self._handle_osdmap(msg)
+            return True
+        if t == "MMDSMap":
+            if self.mdsmap is None or \
+                    msg.mdsmap["epoch"] > self.mdsmap["epoch"]:
+                self.mdsmap = msg.mdsmap
+                for cb in list(self.mdsmap_callbacks):
+                    try:
+                        cb(self.mdsmap)
+                    except Exception:
+                        pass
             return True
         return False
 

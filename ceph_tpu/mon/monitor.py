@@ -16,10 +16,11 @@ from .. import encoding
 from ..common import Context
 from ..common.lockdep import make_rlock
 from ..common.workqueue import SafeTimer
-from ..msg.message import (MMonCommandReply, MOSDMap)
+from ..msg.message import MMDSMap, MMonCommandReply, MOSDMap
 from ..msg.async_messenger import create_messenger
 from ..msg.messenger import Dispatcher
 from ..store.kv import MemDB
+from .mds_monitor import MDSMonitor
 from .osd_monitor import OSDMonitor
 from .paxos import Elector, Paxos
 
@@ -47,6 +48,7 @@ class Monitor(Dispatcher):
         self.elector = Elector(self)
         self.paxos = Paxos(self, self.store)
         self.osdmon = OSDMonitor(self)
+        self.mdsmon = MDSMonitor(self)
         self._lock = make_rlock("mon:%d" % rank)
         self._propose_pending = False
         self._subscribers: dict = {}        # addr -> last epoch sent
@@ -85,6 +87,7 @@ class Monitor(Dispatcher):
         self.paxos.tick()
         if self.is_leader():
             self.osdmon.tick()
+            self.mdsmon.tick()
         self.timer.add_event_after(0.25, self._tick)
 
     # -- roles ---------------------------------------------------------
@@ -142,22 +145,40 @@ class Monitor(Dispatcher):
         if self.osdmon.have_pending():
             value = self.osdmon.encode_pending()
             self.paxos.propose(value)
+            if self.mdsmon.have_pending():
+                self.propose_soon()   # next round carries the mdsmap
+        elif self.mdsmon.have_pending():
+            self.paxos.propose(encoding.encode_any(
+                ("mdsmap", self.mdsmon.encode_pending())))
 
     def _on_paxos_commit(self, version: int, value: bytes) -> None:
         service, payload = encoding.decode_any(value)
         if service == "osdmap":
             self.osdmon.apply_committed(payload)
+        elif service == "mdsmap":
+            self.mdsmon.apply_committed(payload)
 
     # -- full-state sync (paxos trim recovery; Monitor::sync role) -----
 
     def get_full_state(self) -> bytes:
-        return encoding.encode_any(self.osdmon.osdmap)
+        return encoding.encode_any({"osdmap": self.osdmon.osdmap,
+                                    "mdsmap": self.mdsmon.mdsmap})
 
     def set_full_state(self, blob: bytes) -> bool:
         try:
-            newmap = encoding.decode_any(blob)
+            state = encoding.decode_any(blob)
         except encoding.DecodeError:
             return False
+        if isinstance(state, dict) and "osdmap" in state:
+            newmap = state["osdmap"]
+            mdsmap = state.get("mdsmap")
+            if mdsmap and mdsmap["epoch"] > \
+                    self.mdsmon.mdsmap["epoch"]:
+                with self.mdsmon._lock:
+                    self.mdsmon.mdsmap = mdsmap
+                    self.mdsmon.pending = None
+        else:
+            newmap = state              # legacy bare-osdmap blob
         if not hasattr(newmap, "epoch"):
             return False
         if newmap.epoch > self.osdmon.osdmap.epoch:
@@ -179,6 +200,13 @@ class Monitor(Dispatcher):
             self.msgr.send_message(
                 MOSDMap(incrementals=[inc], epoch=inc.epoch), addr)
 
+    def publish_mdsmap(self) -> None:
+        with self._lock:
+            subs = list(self._subscribers)
+        m = self.mdsmon.mdsmap
+        for addr in subs:
+            self.msgr.send_message(MMDSMap(mdsmap=dict(m)), addr)
+
     # -- dispatch ------------------------------------------------------
 
     def ms_dispatch(self, msg) -> bool:
@@ -194,6 +222,12 @@ class Monitor(Dispatcher):
                 return True
             self.osdmon.handle_boot(msg)
             self._subscribe_addr(msg.public_addr or msg.from_addr)
+            return True
+        if t == "MMDSBeacon":
+            if self._forward_if_peon(msg):
+                return True
+            self.mdsmon.handle_beacon(msg)
+            self._subscribe_addr(msg.addr or msg.from_addr)
             return True
         if t == "MOSDFailure":
             if self._forward_if_peon(msg):
@@ -216,7 +250,10 @@ class Monitor(Dispatcher):
                 # commands are not idempotent (pool create, osd in):
                 # dedup retransmits by (requester, tid) and replay the
                 # original reply instead of re-executing
-                result, outs, data = self.osdmon.handle_command(msg.cmd)
+                prefix = msg.cmd.get("prefix", "")
+                svc = (self.mdsmon if prefix.startswith(("mds ", "fs "))
+                       else self.osdmon)
+                result, outs, data = svc.handle_command(msg.cmd)
                 cached = MMonCommandReply(tid=msg.tid, result=result,
                                           outs=outs, data=data)
                 with self._lock:
@@ -283,9 +320,12 @@ class Monitor(Dispatcher):
             return
         with self._lock:
             self._subscribers[tuple(addr)] = start_epoch
-        # immediately share the current full map
+        # immediately share the current full maps
         full = self.osdmon.osdmap
         if full.epoch > start_epoch:
             self.msgr.send_message(
                 MOSDMap(full_map=encoding.encode_any(full), epoch=full.epoch),
                 addr)
+        if self.mdsmon.mdsmap["epoch"] > 0:
+            self.msgr.send_message(
+                MMDSMap(mdsmap=dict(self.mdsmon.mdsmap)), addr)

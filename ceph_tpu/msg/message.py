@@ -30,6 +30,7 @@ __all__ = [
     "MOSDAlive", "MWatchNotify", "MWatchNotifyAck",
     "MMonCommand", "MMonCommandReply", "MMonSubscribe", "MMonPaxos",
     "MMonElection", "MAuth", "MAuthReply", "MMgrReport",
+    "MMDSBeacon", "MMDSMap", "MClientRequest", "MClientReply",
 ]
 
 _seq = itertools.count(1)
@@ -349,6 +350,44 @@ class MMgrReport(Message):
     daemon_name: str = ""
     perf: dict = field(default_factory=dict)
     metadata: dict = field(default_factory=dict)
+
+
+# -- mds / cephfs ------------------------------------------------------
+
+@dataclass
+class MMDSBeacon(Message):
+    """MDS -> mon liveness + desired state
+    (src/messages/MMDSBeacon.h)."""
+    name: str = ""
+    addr: object = None
+    state: str = "boot"            # boot | active | standby
+    epoch: int = 0                 # mdsmap epoch the sender has seen
+
+
+@dataclass
+class MMDSMap(Message):
+    """mdsmap push to subscribers (src/messages/MMDSMap.h)."""
+    mdsmap: dict = field(default_factory=dict)
+
+
+@dataclass
+class MClientRequest(Message):
+    """CephFS client -> MDS metadata op
+    (src/messages/MClientRequest.h); `op` selects the handler
+    (mkdir/create/lookup/readdir/...), `args` its operands."""
+    tid: int = 0
+    op: str = ""
+    args: dict = field(default_factory=dict)
+    session: str = ""              # exactly-once dedup nonce
+    reply_to: object = None
+
+
+@dataclass
+class MClientReply(Message):
+    """MDS -> client (src/messages/MClientReply.h)."""
+    tid: int = 0
+    result: int = 0
+    data: object = None
 
 
 # -- auth (cephx handshake, MAuth/MAuthReply) ---------------------------

@@ -94,6 +94,7 @@ class Journaler:
         self.entries_per_object = entries_per_object
         self.next_tid = 0
         self._open = False
+        self._commit_cache: dict = {}  # client_id -> last commit tid
 
     # -- lifecycle -----------------------------------------------------
 
@@ -201,12 +202,20 @@ class Journaler:
         return out
 
     def commit(self, client_id: str, tid: int) -> None:
-        """Advance a client's commit position (monotonic)."""
-        cur = self.committed(client_id)
+        """Advance a client's commit position (monotonic). Each client
+        id has ONE committer (the single-writer contract), so the last
+        position is cached in memory after the first read — per-entry
+        commits cost one omap write, not a full metadata read-back."""
+        cur = self._commit_cache.get(client_id)
+        if cur is None:
+            cur = self.committed(client_id)
         if tid > cur:
             self.ioctx.omap_set(_meta_oid(self.journal_id), {
                 "client.%s" % client_id:
                     encoding.encode_any({"commit_tid": tid})})
+            self._commit_cache[client_id] = tid
+        else:
+            self._commit_cache[client_id] = cur
 
     def committed(self, client_id: str) -> int:
         omap = self.ioctx.omap_get(_meta_oid(self.journal_id))

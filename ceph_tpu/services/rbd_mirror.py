@@ -83,7 +83,9 @@ class RbdMirror:
         out = []
         for name in RBD.list(self.remote):
             try:
-                img = Image(self.remote, name)
+                # read_only: a mirror must never replay (= write) the
+                # PRIMARY's journal while probing its feature bits
+                img = Image(self.remote, name, read_only=True)
             except ImageNotFound:
                 continue
             if "journaling" in img.meta.get("features", []):
@@ -130,7 +132,7 @@ class RbdMirror:
         the copy: events landing during the copy are replayed again
         afterward, and replay is idempotent."""
         pre_copy_pos = journal.committed("")
-        src = Image(self.remote, name)
+        src = Image(self.remote, name, read_only=True)
         try:
             RBD.create(self.local, name, src.size(), order=src.order)
         except Exception:

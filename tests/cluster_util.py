@@ -91,6 +91,23 @@ class MiniCluster:
         self.osds[osd_id] = osd
         return osd
 
+    def start_mds(self, name: str):
+        from ceph_tpu.mds import MDSDaemon
+        mds = MDSDaemon(name, self.monmap,
+                        Context(self.conf_overrides,
+                                name="mds.%s" % name))
+        mds.init()
+        if not hasattr(self, "mdss"):
+            self.mdss = {}
+        self.mdss[name] = mds
+        return mds
+
+    def stop_mds(self, name: str):
+        mds = getattr(self, "mdss", {}).pop(name, None)
+        if mds is not None:
+            mds.shutdown()
+        return mds
+
     def stop_osd(self, osd_id: int, hard: bool = True):
         """Kill an osd (thrasher kill_osd analog). Keeps the store so a
         revive keeps its data."""
@@ -186,6 +203,9 @@ class MiniCluster:
     def stop(self):
         for client in self.clients:
             client.shutdown()
+        for mds in list(getattr(self, "mdss", {}).values()):
+            mds.shutdown()
+        getattr(self, "mdss", {}).clear()
         for osd in list(self.osds.values()):
             osd.shutdown()
         self.osds.clear()

@@ -1,0 +1,184 @@
+"""CephFS: MDS daemon, mdsmap monitor service, client, failover.
+
+Mirrors the reference's fs QA surface (src/test/libcephfs/,
+qa/tasks/cephfs/): namespace operations, file IO through the data
+pool, metadata durability across MDS restart (journal replay), and
+standby takeover when the active MDS dies.
+"""
+
+from __future__ import annotations
+
+import errno
+
+import pytest
+
+from ceph_tpu.client.cephfs import CephFS, CephFSError
+
+from .cluster_util import MiniCluster, wait_until
+
+FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+        "mon_osd_down_out_interval": 1.0,
+        "paxos_propose_interval": 0.02,
+        "mds_beacon_interval": 0.1, "mds_beacon_grace": 0.8}
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(num_mons=1, num_osds=3, conf_overrides=FAST).start()
+    client = c.client()
+    c.create_replicated_pool(client, "cephfs_metadata", size=2,
+                             pg_num=4)
+    c.create_replicated_pool(client, "cephfs_data", size=2, pg_num=4)
+    res, outs, _ = client.mon_command({
+        "prefix": "fs new", "fs_name": "cephfs",
+        "metadata_pool": "cephfs_metadata",
+        "data_pool": "cephfs_data"})
+    assert res == 0, outs
+    c.start_mds("a")
+    c.start_mds("b")      # standby
+    assert wait_until(lambda: c.mdss["a"].state == "active"
+                      or c.mdss["b"].state == "active", timeout=15), \
+        "no MDS ever went active"
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def fs(cluster):
+    return CephFS(cluster.client())
+
+
+class TestNamespace:
+    def test_mkdir_readdir_stat(self, fs):
+        fs.mkdir("/home")
+        fs.mkdir("/home/alex")
+        fs.mkdirs("/var/log/app")     # recursive create
+        root = fs.listdir("/")
+        assert "home" in root and "var" in root
+        assert fs.stat("/home/alex")["type"] == "dir"
+        assert fs.listdir("/var/log") == {
+            "app": fs.stat("/var/log/app")}
+        with pytest.raises(CephFSError) as ei:
+            fs.mkdir("/home")
+        assert ei.value.errno == errno.EEXIST
+        with pytest.raises(CephFSError):
+            fs.stat("/no/such/path")
+
+    def test_file_write_read(self, fs):
+        fs.mkdir("/data")
+        payload = b"hello cephfs " * 1000
+        fs.write("/data/f1", payload)
+        assert fs.read("/data/f1") == payload
+        assert fs.stat("/data/f1")["size"] == len(payload)
+        # offset write extends; sparse gap reads as zeros
+        fs.write("/data/f1", b"tail", len(payload) + 100)
+        got = fs.read("/data/f1")
+        assert got[:len(payload)] == payload
+        assert got[len(payload):len(payload) + 100] == b"\0" * 100
+        assert got.endswith(b"tail")
+        # ranged read
+        assert fs.read("/data/f1", 5, 6) == payload[6:11]
+
+    def test_large_file_spans_objects(self, fs):
+        """Writes larger than object_size stripe across data objects
+        (the file-layout path)."""
+        blob = bytes(range(256)) * (5 * 4096)   # 5 MiB > 4 MiB objects
+        fs.write("/data/big", blob)
+        assert fs.read("/data/big") == blob
+        # the data pool really holds multiple objects for this ino
+        ino = fs.stat("/data/big")["ino"]
+        names = [o for o in fs.data_io.list_objects()
+                 if o.startswith("%x." % ino)]
+        assert len(names) >= 2
+
+    def test_truncate(self, fs):
+        fs.write("/data/trunc", b"x" * 10000)
+        fs.truncate("/data/trunc", 100)
+        assert fs.stat("/data/trunc")["size"] == 100
+        assert fs.read("/data/trunc") == b"x" * 100
+        fs.truncate("/data/trunc", 0)
+        assert fs.read("/data/trunc") == b""
+
+    def test_unlink_purges_data(self, fs):
+        fs.write("/data/doomed", b"y" * 8192)
+        ino = fs.stat("/data/doomed")["ino"]
+        fs.unlink("/data/doomed")
+        with pytest.raises(CephFSError):
+            fs.stat("/data/doomed")
+        def purged():
+            return not [o for o in fs.data_io.list_objects()
+                        if o.startswith("%x." % ino)]
+        assert wait_until(purged, timeout=5), \
+            "unlink left data objects behind"
+
+    def test_rename_and_rmdir(self, fs):
+        fs.mkdir("/mv")
+        fs.write("/mv/old", b"contents")
+        fs.rename("/mv/old", "/mv/new")
+        assert fs.read("/mv/new") == b"contents"
+        with pytest.raises(CephFSError):
+            fs.stat("/mv/old")
+        # rename across directories
+        fs.mkdir("/mv/sub")
+        fs.rename("/mv/new", "/mv/sub/moved")
+        assert fs.read("/mv/sub/moved") == b"contents"
+        # rmdir refuses non-empty, then succeeds
+        with pytest.raises(CephFSError) as ei:
+            fs.rmdir("/mv/sub")
+        assert ei.value.errno == errno.ENOTEMPTY
+        fs.unlink("/mv/sub/moved")
+        fs.rmdir("/mv/sub")
+        assert "sub" not in fs.listdir("/mv")
+
+    def test_symlink(self, fs):
+        fs.mkdir("/links")
+        fs.write("/links/real", b"linked!")
+        fs.symlink("/links/real", "/links/alias")
+        assert fs.readlink("/links/alias") == "/links/real"
+        assert fs.read("/links/alias") == b"linked!"
+        # symlinked DIRECTORY mid-path resolves
+        fs.symlink("/links", "/byway")
+        assert fs.read("/byway/real") == b"linked!"
+
+
+class TestDurabilityAndFailover:
+    def test_metadata_survives_mds_restart(self, cluster, fs):
+        fs.mkdir("/persist")
+        fs.write("/persist/file", b"durable" * 100)
+        active = "a" if cluster.mdss["a"].state == "active" else "b"
+        standby = "b" if active == "a" else "a"
+        # stop BOTH, restart one: state must come back from RADOS +
+        # journal replay alone
+        cluster.stop_mds(standby)
+        cluster.stop_mds(active)
+        mds = cluster.start_mds("c")
+        assert wait_until(lambda: mds.state == "active", timeout=15), \
+            "restarted MDS never took the rank"
+        assert fs.read("/persist/file") == b"durable" * 100
+        assert fs.stat("/persist")["type"] == "dir"
+        fs.write("/persist/after", b"new-epoch")
+        assert fs.read("/persist/after") == b"new-epoch"
+        cluster.start_mds("d")        # restore a standby for later
+
+    def test_standby_takeover_on_active_death(self, cluster, fs):
+        fs.write("/persist/ha", b"failover-safe")
+        names = list(cluster.mdss)
+        active = next(n for n in names
+                      if cluster.mdss[n].state == "active")
+        cluster.stop_mds(active)      # kill the active, no warning
+        def new_active():
+            return any(m.state == "active"
+                       for m in cluster.mdss.values())
+        assert wait_until(new_active, timeout=15), \
+            "standby was never promoted"
+        # the namespace survives and serves through the new active
+        assert fs.read("/persist/ha") == b"failover-safe"
+        fs.write("/persist/ha2", b"post-failover")
+        assert fs.read("/persist/ha2") == b"post-failover"
+
+    def test_mds_stat_command(self, cluster):
+        client = cluster.client()
+        res, _, data = client.mon_command({"prefix": "mds stat"})
+        assert res == 0
+        assert data["active"] is not None
+        assert data["fs"]["metadata_pool"] == "cephfs_metadata"
