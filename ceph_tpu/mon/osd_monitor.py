@@ -558,5 +558,13 @@ class OSDMonitor:
                 "size": p.size, "min_size": p.min_size,
                 "pg_num": p.pg_num,
                 "erasure_code_profile": p.erasure_code_profile,
+                # cache-tier fields (osd dump pg_pool_t dump subset)
+                "tier_of": p.tier_of, "tiers": list(p.tiers),
+                "read_tier": p.read_tier, "write_tier": p.write_tier,
+                "cache_mode": p.cache_mode,
+                "target_max_objects": p.target_max_objects,
+                "target_max_bytes": p.target_max_bytes,
+                "hit_set_period": p.hit_set_period,
+                "hit_set_count": p.hit_set_count,
             } for p in m.pools.values()],
         }

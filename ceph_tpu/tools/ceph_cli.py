@@ -173,6 +173,47 @@ def main(argv=None) -> int:
             res, outs, _ = client.mon_command(cmd)
             sys.stdout.write("%s\n" % (outs or "pool '%s' created" % name))
             return 0 if res == 0 else 1
+        if len(w) == 6 and w[:3] == ["osd", "pool", "set"]:
+            res, outs, _ = client.mon_command({
+                "prefix": "osd pool set", "pool": w[3], "var": w[4],
+                "val": w[5]})
+            sys.stdout.write("%s\n" % outs)
+            return 0 if res == 0 else 1
+        if w[:2] == ["osd", "tier"] and len(w) >= 4:
+            # osd tier add BASE CACHE | cache-mode CACHE MODE |
+            # set-overlay BASE CACHE | remove-overlay BASE |
+            # remove BASE CACHE
+            sub = w[2]
+            two_operand = {"add": "tierpool", "remove": "tierpool",
+                           "cache-mode": "mode",
+                           "set-overlay": "overlaypool"}
+            cmd = {"prefix": "osd tier %s" % sub}
+            if sub in two_operand:
+                if len(w) < 5:
+                    sys.stderr.write(
+                        "ceph: osd tier %s needs two operands\n" % sub)
+                    return 1
+                cmd.update({"pool": w[3], two_operand[sub]: w[4]})
+            elif sub == "remove-overlay":
+                cmd.update(pool=w[3])
+            else:
+                sys.stderr.write("ceph: unknown tier op %r\n" % sub)
+                return 1
+            res, outs, _ = client.mon_command(cmd)
+            sys.stdout.write("%s\n" % outs)
+            return 0 if res == 0 else 1
+        if len(w) == 5 and w[:2] == ["fs", "new"]:
+            res, outs, _ = client.mon_command({
+                "prefix": "fs new", "fs_name": w[2],
+                "metadata_pool": w[3], "data_pool": w[4]})
+            sys.stdout.write("%s\n" % outs)
+            return 0 if res == 0 else 1
+        if w == ["mds", "stat"] or w == ["fs", "status"]:
+            res, outs, data = client.mon_command(
+                {"prefix": "mds stat"})
+            sys.stdout.write(json.dumps(data, indent=1, default=str)
+                             + "\n")
+            return 0 if res == 0 else 1
         if len(w) == 3 and w[0] == "osd" and w[1] in ("out", "in",
                                                       "down"):
             raw_id = w[2]

@@ -1,0 +1,190 @@
+"""rbd: the block-image CLI.
+
+Counterpart of the reference's rbd tool (src/tools/rbd/, rbd.cc
+actions): create/ls/info/rm, snapshot management, clone + flatten,
+export/import to a local file, resize, and `rbd mirror pool status`
+over a running mirror daemon's journal state.
+
+Connects through a monmap file or --mon flags like the rados CLI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..client.rbd import RBD, Image, ImageExists, ImageNotFound
+from .rados_cli import connect
+
+
+def _size_arg(text: str) -> int:
+    """Accept 1024, 4K, 16M, 2G (rbd's size suffixes); exits with a
+    usage error on anything else (no tracebacks for '8MB')."""
+    raw = text
+    text = text.strip().upper()
+    mult = 1
+    for suffix, m in (("K", 1 << 10), ("M", 1 << 20), ("G", 1 << 30)):
+        if text.endswith(suffix):
+            text = text[:-1]
+            mult = m
+            break
+    try:
+        return int(text) * mult
+    except ValueError:
+        raise SystemExit("rbd: invalid size %r (use N, NK, NM, NG)"
+                         % raw)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="rbd",
+                                description="block image utility")
+    p.add_argument("--monmap")
+    p.add_argument("--mon", action="append")
+    p.add_argument("-p", "--pool", default="rbd")
+    p.add_argument("--size", default=None,
+                   help="image size (supports K/M/G suffixes)")
+    p.add_argument("--order", type=int, default=22)
+    p.add_argument("--journaling", action="store_true",
+                   help="enable the journaling feature (mirrorable)")
+    p.add_argument("words", nargs="+",
+                   help="create NAME | ls | info NAME | rm NAME | "
+                        "resize NAME | export NAME FILE | "
+                        "import FILE NAME | snap create/ls/rm/"
+                        "rollback NAME@SNAP | clone SRC@SNAP DST | "
+                        "flatten NAME | mirror pool status")
+    args = p.parse_args(argv)
+    client = connect(args)
+    try:
+        io = client.open_ioctx(args.pool)
+        w = args.words
+        if w[0] == "create" and len(w) == 2:
+            if args.size is None:
+                sys.stderr.write("rbd: create needs --size\n")
+                return 1
+            RBD.create(io, w[1], _size_arg(args.size),
+                       order=args.order,
+                       features=("journaling",) if args.journaling
+                       else ())
+            return 0
+        if w == ["ls"]:
+            for name in RBD.list(io):
+                sys.stdout.write(name + "\n")
+            return 0
+        if w[0] == "info" and len(w) == 2:
+            img = Image(io, w[1], read_only=True)
+            st = img.stat()
+            st["features"] = img.meta.get("features", [])
+            st["snapshots"] = [s["name"] for s in img.snap_list()]
+            sys.stdout.write(json.dumps(st, indent=1, default=str)
+                             + "\n")
+            return 0
+        if w[0] == "rm" and len(w) == 2:
+            RBD.remove(io, w[1])
+            return 0
+        if w[0] == "resize" and len(w) == 2:
+            if args.size is None:
+                sys.stderr.write("rbd: resize needs --size\n")
+                return 1
+            Image(io, w[1]).resize(_size_arg(args.size))
+            return 0
+        if w[0] == "export" and len(w) == 3:
+            img = Image(io, w[1], read_only=True)
+            with open(w[2], "wb") as f:
+                step = img.block_size
+                for off in range(0, img.size(), step):
+                    f.write(img.read(off, min(step,
+                                              img.size() - off)))
+            return 0
+        if w[0] == "import" and len(w) == 3:
+            import os
+            size = os.stat(w[1]).st_size
+            RBD.create(io, w[2], size, order=args.order,
+                       features=("journaling",) if args.journaling
+                       else ())
+            img = Image(io, w[2])
+            step = img.block_size
+            with open(w[1], "rb") as f:   # stream block-sized chunks
+                off = 0
+                while True:
+                    chunk = f.read(step)
+                    if not chunk:
+                        break
+                    if chunk.strip(b"\0"):
+                        img.write(off, chunk)
+                    off += len(chunk)
+            return 0
+        if w[0] == "snap" and len(w) == 3:
+            sub, spec = w[1], w[2]
+            if sub == "ls":
+                for s in Image(io, spec, read_only=True).snap_list():
+                    sys.stdout.write("%d\t%s\t%d\n"
+                                     % (s["id"], s["name"], s["size"]))
+                return 0
+            if "@" not in spec:
+                sys.stderr.write("rbd: snap %s needs IMAGE@SNAP\n"
+                                 % sub)
+                return 1
+            name, snap = spec.split("@", 1)
+            img = Image(io, name)
+            if sub == "create":
+                img.snap_create(snap)
+            elif sub == "rm":
+                img.snap_remove(snap)
+            elif sub == "rollback":
+                img.snap_rollback(snap)
+            else:
+                sys.stderr.write("rbd: unknown snap op %r\n" % sub)
+                return 1
+            return 0
+        if w[0] == "clone" and len(w) == 3:
+            src, dst = w[1], w[2]
+            if "@" not in src:
+                sys.stderr.write("rbd: clone needs SRC@SNAP\n")
+                return 1
+            parent, snap = src.split("@", 1)
+            RBD.clone(io, parent, snap, dst)
+            return 0
+        if w[0] == "flatten" and len(w) == 2:
+            Image(io, w[1]).flatten()
+            return 0
+        if w == ["mirror", "pool", "status"]:
+            # journal-derived status: per journaled image, the master
+            # and peer commit positions (rbd mirror pool status role)
+            from ..client.rbd import _journal_id
+            from ..services.journal import Journaler, JournalNotFound
+            out = {}
+            for name in RBD.list(io):
+                try:
+                    img = Image(io, name, read_only=True)
+                except ImageNotFound:
+                    continue
+                if "journaling" not in img.meta.get("features", []):
+                    continue
+                try:
+                    # one omap read serves both geometry and clients
+                    from .. import encoding as _enc
+                    omap = io.omap_get("journal.%s" % _journal_id(name))
+                    meta = _enc.decode_any(omap["meta"])
+                    out[name] = {
+                        "clients": {
+                            k[len("client."):]:
+                                _enc.decode_any(v)["commit_tid"]
+                            for k, v in omap.items()
+                            if k.startswith("client.")},
+                        "entries": meta["next_tid"]}
+                except (OSError, KeyError):
+                    out[name] = {"clients": {}, "entries": 0}
+            sys.stdout.write(json.dumps(out, indent=1) + "\n")
+            return 0
+        sys.stderr.write("rbd: unknown command %r\n" % " ".join(w))
+        return 1
+    except (ImageNotFound, ImageExists) as e:
+        sys.stderr.write("rbd: %s: %s\n" % (type(e).__name__, e))
+        return 2
+    finally:
+        client.shutdown()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

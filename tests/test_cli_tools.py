@@ -32,6 +32,13 @@ def ceph(monmap, *argv):
         capture_output=True, text=True, timeout=120, env=ENV, cwd=REPO)
 
 
+def rbd(monmap, *argv):
+    return subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.tools.rbd_cli",
+         "--monmap", monmap, *argv],
+        capture_output=True, text=True, timeout=120, env=ENV, cwd=REPO)
+
+
 @pytest.fixture(scope="module")
 def vstart_cluster(tmp_path_factory):
     tmp = tmp_path_factory.mktemp("vstart")
@@ -127,6 +134,75 @@ class TestRadosCli:
                 break
             time.sleep(0.3)
         assert r.stdout.strip() == "HEALTH_OK"
+
+    def test_ceph_cli_tiering_and_fs(self, vstart_cluster):
+        """`ceph osd tier ...`, `osd pool set`, `fs new`, `mds stat`
+        — the cache-tiering and CephFS admin surfaces."""
+        monmap, _ = vstart_cluster
+        for name in ("tierbase", "tiercache"):
+            r = ceph(monmap, "osd", "pool", "create", name,
+                     "--size", "2")
+            assert r.returncode == 0, r.stdout + r.stderr
+        r = ceph(monmap, "osd", "tier", "add", "tierbase", "tiercache")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert ceph(monmap, "osd", "tier", "cache-mode", "tiercache",
+                    "writeback").returncode == 0
+        assert ceph(monmap, "osd", "tier", "set-overlay", "tierbase",
+                    "tiercache").returncode == 0
+        assert ceph(monmap, "osd", "pool", "set", "tiercache",
+                    "target_max_objects", "64").returncode == 0
+        r = ceph(monmap, "osd", "dump")
+        dump = json.loads(r.stdout)
+        cache = next(p for p in dump["pools"]
+                     if p["pool_name"] == "tiercache")
+        assert cache["cache_mode"] == "writeback"
+        assert cache["target_max_objects"] == 64
+        assert ceph(monmap, "osd", "tier", "remove-overlay",
+                    "tierbase").returncode == 0
+        # fs new + mds stat (no MDS running: map exists, active None)
+        for name in ("fsmeta", "fsdata"):
+            assert ceph(monmap, "osd", "pool", "create", name,
+                        "--size", "2").returncode == 0
+        assert ceph(monmap, "fs", "new", "cephfs", "fsmeta",
+                    "fsdata").returncode == 0
+        r = ceph(monmap, "mds", "stat")
+        assert r.returncode == 0, r.stdout + r.stderr
+        stat = json.loads(r.stdout)
+        assert stat["fs"]["metadata_pool"] == "fsmeta"
+
+    def test_rbd_cli_image_lifecycle(self, vstart_cluster, tmp_path):
+        """`rbd create/ls/info/snap/export/import/rm` — the block
+        CLI end to end (src/tools/rbd/ role)."""
+        monmap, _ = vstart_cluster
+        r = ceph(monmap, "osd", "pool", "create", "rbd", "--size", "2")
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = rbd(monmap, "create", "disk0", "--size", "8M",
+                "--journaling")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "disk0" in rbd(monmap, "ls").stdout
+        info = json.loads(rbd(monmap, "info", "disk0").stdout)
+        assert info["size"] == 8 << 20
+        assert "journaling" in info["features"]
+        # import a payload as a second image, export it back
+        src = tmp_path / "disk.img"
+        src.write_bytes(b"block-device-bytes " * 5000)
+        assert rbd(monmap, "import", str(src),
+                   "disk1").returncode == 0
+        out = tmp_path / "out.img"
+        assert rbd(monmap, "export", "disk1",
+                   str(out)).returncode == 0
+        exported = out.read_bytes()
+        assert exported[:src.stat().st_size] == src.read_bytes()
+        # snapshots via the CLI
+        assert rbd(monmap, "snap", "create",
+                   "disk1@base").returncode == 0
+        assert "base" in rbd(monmap, "snap", "ls", "disk1").stdout
+        # mirror status surfaces the journaled image's positions
+        status = json.loads(rbd(monmap, "mirror", "pool",
+                                "status").stdout)
+        assert "disk0" in status and "" in status["disk0"]["clients"]
+        assert rbd(monmap, "rm", "disk0").returncode == 0
+        assert "disk0" not in rbd(monmap, "ls").stdout
 
     def test_ceph_daemon_admin_socket(self, vstart_cluster):
         """`ceph daemon <asok> <cmd>`: per-daemon introspection over
