@@ -38,6 +38,14 @@ class CephFS(Dispatcher):
         self.client = rados_client
         self.timeout = timeout
         self._tids = itertools.count(1)
+        # Per-MOUNT session for MDS exactly-once dedup: two CephFS
+        # mounts over one RadosClient each start tids at 1, so reusing
+        # the shared client session would let mount B's early ops be
+        # answered from mount A's cached replies (Client.cc gets a
+        # distinct client id per mount from the mon for the same
+        # reason).
+        import uuid
+        self.session = rados_client.session + "/" + uuid.uuid4().hex
         self._lock = threading.Lock()
         self._inflight: dict = {}     # tid -> [event, reply]
         self.client.msgr.add_dispatcher_tail(self)
@@ -66,6 +74,8 @@ class CephFS(Dispatcher):
     def ms_dispatch(self, msg) -> bool:
         if msg.get_type() != "MClientReply":
             return False
+        if msg.session != self.session:
+            return False              # another mount's reply
         with self._lock:
             waiter = self._inflight.pop(msg.tid, None)
         if waiter is not None:
@@ -96,7 +106,7 @@ class CephFS(Dispatcher):
                     continue
                 self.client.msgr.send_message(
                     MClientRequest(tid=tid, op=op, args=args,
-                                   session=self.client.session,
+                                   session=self.session,
                                    reply_to=self.client.msgr.my_addr),
                     tuple(active["addr"])
                     if isinstance(active["addr"], list)
@@ -131,35 +141,67 @@ class CephFS(Dispatcher):
             raise CephFSError(errno.EINVAL, "empty path")
         return parts
 
-    def _resolve_dir(self, parts) -> int:
-        """Walk directory components from root; returns the dir ino.
-        A symlink mid-walk restarts the walk with its target spliced
-        in front of the remaining components."""
+    _MAX_SYMLINKS = 40                # Client::path_walk link cap
+
+    def _follow(self, target: str, parent_ino: int, hops):
+        """Account one symlink hop (ELOOP past _MAX_SYMLINKS) and
+        resolve the splice base: the link's PARENT dir for a relative
+        target, root for an absolute one.  Returns (base_ino,
+        target_components)."""
+        hops[0] += 1
+        if hops[0] > self._MAX_SYMLINKS:
+            raise CephFSError(errno.ELOOP, target)
+        tparts = [p for p in target.split("/") if p]
+        if not tparts and not target.startswith("/"):
+            raise CephFSError(errno.ENOENT, "empty symlink target")
+        return (ROOT_INO if target.startswith("/") else parent_ino,
+                tparts)
+
+    def _walk(self, parts, ino: int = ROOT_INO, _hops=None) -> int:
+        """Walk directory components from `ino`; returns the dir ino.
+        A symlink mid-walk splices its target in front of the
+        remaining components, capped at _MAX_SYMLINKS total (matching
+        Client::path_walk)."""
+        if _hops is None:
+            _hops = [0]
         parts = list(parts)
-        ino = ROOT_INO
         i = 0
         while i < len(parts):
             rec = self._request("lookup", {"dir": ino,
                                            "name": parts[i]})
             if rec["type"] == "symlink":
-                return self._resolve_dir(
-                    self._split(rec["target"]) + parts[i + 1:])
+                ino, tparts = self._follow(rec["target"], ino, _hops)
+                parts = tparts + parts[i + 1:]
+                i = 0
+                continue
             if rec["type"] != "dir":
                 raise CephFSError(errno.ENOTDIR, parts[i])
             ino = rec["ino"]
             i += 1
         return ino
 
+    def _resolve_dir(self, parts) -> int:
+        return self._walk(parts)
+
     def _parent_of(self, path: str):
         parts = self._split(path)
-        return self._resolve_dir(parts[:-1]), parts[-1]
+        return self._walk(parts[:-1]), parts[-1]
 
     def _file_rec(self, path: str, follow: bool = True) -> dict:
-        d, name = self._parent_of(path)
-        rec = self._request("lookup", {"dir": d, "name": name})
-        if follow and rec["type"] == "symlink":
-            return self._file_rec(rec["target"])
-        return rec
+        parts = self._split(path)
+        hops = [0]
+        d = self._walk(parts[:-1], _hops=hops)
+        name = parts[-1]
+        while True:
+            rec = self._request("lookup", {"dir": d, "name": name})
+            if not (follow and rec["type"] == "symlink"):
+                return rec
+            base, tparts = self._follow(rec["target"], d, hops)
+            if not tparts:            # target "/": the root dir itself
+                return {"type": "dir", "ino": ROOT_INO,
+                        "size": 0, "mtime": 0.0}
+            d = self._walk(tparts[:-1], ino=base, _hops=hops)
+            name = tparts[-1]
 
     # -- namespace ops --------------------------------------------------
 
@@ -181,6 +223,8 @@ class CephFS(Dispatcher):
         return self._request("create", {"dir": d, "name": name})
 
     def symlink(self, target: str, path: str) -> None:
+        if not target:
+            raise CephFSError(errno.ENOENT, "empty symlink target")
         d, name = self._parent_of(path)
         self._request("symlink", {"dir": d, "name": name,
                                   "target": target})

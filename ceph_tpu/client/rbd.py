@@ -193,7 +193,7 @@ class Image:
             from ..services.journal import JournalNotFound, Journaler
             self._journal = Journaler(ioctx, _journal_id(name))
             try:
-                self._journal.open()
+                self._journal.open(for_append=True)
             except JournalNotFound:
                 # self-heal a lost/half-created journal rather than
                 # brick the image (any unjournaled tail is gone either

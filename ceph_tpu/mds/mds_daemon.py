@@ -152,7 +152,7 @@ class MDSDaemon(Dispatcher):
             self.journal.create()
             self.journal.register_client("")
         except JournalExists:
-            self.journal.open()
+            self.journal.open(for_append=True)
         # first activation plants the root dirfrag
         try:
             self.meta_io.stat(dir_oid(ROOT_INO))
@@ -205,7 +205,8 @@ class MDSDaemon(Dispatcher):
         dest = msg.reply_to or msg.from_addr
         if self.state != "active":
             self.msgr.send_message(
-                MClientReply(tid=msg.tid, result=-errno.EAGAIN), dest)
+                MClientReply(tid=msg.tid, result=-errno.EAGAIN,
+                             session=msg.session), dest)
             return True
         key = (msg.session, msg.tid)
         with self.lock:
@@ -221,7 +222,7 @@ class MDSDaemon(Dispatcher):
                         "mds op %s failed", msg.op)
                     result, data = -errno.EIO, None
                 cached = MClientReply(tid=msg.tid, result=result,
-                                      data=data)
+                                      data=data, session=msg.session)
                 if msg.session:
                     self._replies[key] = cached
         self.msgr.send_message(cached, dest)
@@ -263,20 +264,28 @@ class MDSDaemon(Dispatcher):
                     "next_ino": str(self._next_ino).encode()})
         elif op == "rm_dentry":
             self._rm_dentry(ev["dir"], ev["name"])
-            if ev.get("rmdir_ino"):
-                try:
-                    self.meta_io.remove(dir_oid(ev["rmdir_ino"]))
-                except OSError:
-                    pass
-            if ev.get("purge"):
-                self._purge_data(ev["purge"]["ino"],
-                                 ev["purge"]["size"],
-                                 ev["purge"]["object_size"])
+            self._apply_purge_hints(ev)
         elif op == "rename":
+            self._apply_purge_hints(ev)
             rec = self._dentry(ev["dir"], ev["name"])
             if rec is not None:
                 self._rm_dentry(ev["dir"], ev["name"])
                 self._set_dentry(ev["newdir"], ev["newname"], rec)
+
+    def _apply_purge_hints(self, ev: dict) -> None:
+        """Shared replay of an event's destruction side-effects: drop
+        an overwritten/removed dir's dirfrag object (rmdir_ino) and
+        purge a dead file inode's data objects (purge) — unlink and
+        rename route through the same PurgeQueue role."""
+        if ev.get("rmdir_ino"):
+            try:
+                self.meta_io.remove(dir_oid(ev["rmdir_ino"]))
+            except OSError:
+                pass
+        if ev.get("purge"):
+            self._purge_data(ev["purge"]["ino"],
+                             ev["purge"]["size"],
+                             ev["purge"]["object_size"])
 
     def _purge_data(self, ino: int, size: int,
                     object_size: int) -> None:
@@ -340,6 +349,8 @@ class MDSDaemon(Dispatcher):
         return 0, rec
 
     def _op_symlink(self, args):
+        if not args.get("target"):
+            return -errno.ENOENT, None   # authoritative empty-target check
         if self._dentry(args["dir"], args["name"]) is not None:
             return -errno.EEXIST, None
         rec = {"ino": self._alloc_ino(), "type": "symlink",
@@ -402,15 +413,66 @@ class MDSDaemon(Dispatcher):
         self._commit(jtid)
         return 0, None
 
+    def _in_subtree(self, root_ino: int, needle_ino: int) -> bool:
+        """True when needle_ino is root_ino or any dir beneath it
+        (there are no parent pointers, so walk down; subtrees are
+        small at this framework's scale)."""
+        stack = [root_ino]
+        while stack:
+            d = stack.pop()
+            if d == needle_ino:
+                return True
+            try:
+                omap = self.meta_io.omap_get(dir_oid(d))
+            except OSError:
+                continue
+            for raw in omap.values():
+                r = encoding.decode_any(raw)
+                if r["type"] == "dir":
+                    stack.append(r["ino"])
+        return False
+
     def _op_rename(self, args):
         rec = self._dentry(args["dir"], args["name"])
         if rec is None:
             return -errno.ENOENT, None
+        if (args["dir"] == args["newdir"]
+                and args["name"] == args["newname"]):
+            return 0, rec             # POSIX rename-to-self: no-op
+        if rec["type"] == "dir" and self._in_subtree(rec["ino"],
+                                                     args["newdir"]):
+            # destination inside the source's own subtree: the rename
+            # would orphan the subtree in a self-cycle (reference MDS
+            # rejects source-is-ancestor-of-dest with EINVAL)
+            return -errno.EINVAL, None
         target = self._dentry(args["newdir"], args["newname"])
+        rmdir_ino = None
         if target is not None and target["type"] == "dir":
-            return -errno.EISDIR, None
+            if rec["type"] != "dir":
+                return -errno.EISDIR, None    # non-dir over dir
+            try:
+                if self.meta_io.omap_get(dir_oid(target["ino"])):
+                    return -errno.ENOTEMPTY, None
+            except OSError:
+                pass
+            rmdir_ino = target["ino"]         # dir over EMPTY dir: ok
+        elif target is not None and rec["type"] == "dir":
+            return -errno.ENOTDIR, None       # dir over non-dir
         ev = {"op": "rename", "dir": args["dir"], "name": args["name"],
               "newdir": args["newdir"], "newname": args["newname"]}
+        if rmdir_ino is not None:
+            ev["rmdir_ino"] = rmdir_ino
+        if (target is not None and target["type"] == "file"
+                and target["ino"] != rec["ino"]):
+            # rename-over-file: the overwritten inode's data objects
+            # would otherwise leak in the data pool (unlink purges;
+            # rename must too — reference routes this through the
+            # same PurgeQueue)
+            ev["purge"] = {"ino": target["ino"],
+                           "size": target["size"],
+                           "object_size": target.get(
+                               "object_size",
+                               self.DEFAULT_OBJECT_SIZE)}
         jtid = self._journal_update(ev)
         self._apply_event(ev)
         self._commit(jtid)

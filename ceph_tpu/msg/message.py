@@ -384,10 +384,13 @@ class MClientRequest(Message):
 
 @dataclass
 class MClientReply(Message):
-    """MDS -> client (src/messages/MClientReply.h)."""
+    """MDS -> client (src/messages/MClientReply.h).  Echoes the
+    request's session so multiple mounts sharing one messenger can
+    each claim only their own replies (tids are per-mount)."""
     tid: int = 0
     result: int = 0
     data: object = None
+    session: str = ""
 
 
 # -- auth (cephx handshake, MAuth/MAuthReply) ---------------------------

@@ -39,7 +39,15 @@ Simplifications vs the reference (documented contract): promotion and
 flush move the object HEAD (data + user xattrs + omap); snapshots taken
 while an object lives in the cache work normally inside the cache pool,
 and an object with clones or watchers refuses eviction with EBUSY
-instead of evicting per-clone.
+instead of evicting per-clone.  Forward-mode proxied-write
+exactly-once state (_proxy_done/_proxy_inflight) is memory-only on
+the cache primary: after a cache-PG primary failover, a client
+retransmit of a write the base pool already applied can be re-proxied
+and double-applied (non-idempotent ops like append).  The reference's
+forward mode carried the same caveat and was deprecated for it —
+operators should drain the tier via flush before relying on forward
+mode across failovers (the promote path is not affected: it adopts
+durable base reqids).
 """
 
 from __future__ import annotations

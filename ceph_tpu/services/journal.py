@@ -95,6 +95,14 @@ class Journaler:
         self.next_tid = 0
         self._open = False
         self._commit_cache: dict = {}  # client_id -> last commit tid
+        # journals written by the reserve-before-write append() can
+        # never hold a tid past the meta floor; once that invariant is
+        # established (at create, or by one legacy tail scan) the
+        # writer-open scan is skipped forever
+        self._tail_scanned = True
+        # incarnation id: lets pollers (rbd-mirror idle cache) detect
+        # a journal that was deleted and recreated under the same name
+        self.nonce: str | None = None
 
     # -- lifecycle -----------------------------------------------------
 
@@ -113,20 +121,56 @@ class Journaler:
             raise JournalExists(self.journal_id)
         if not exists:
             self.ioctx.write_full(oid, b"")
+        import uuid
+        self.nonce = uuid.uuid4().hex
         self.ioctx.omap_set(oid, {
             "meta": encoding.encode_any({
                 "order": self.order,
                 "splay_width": self.splay_width,
                 "entries_per_object": self.entries_per_object,
-                "next_tid": 0})})
+                "next_tid": 0, "tail_scanned": True,
+                "nonce": self.nonce})})
         self._open = True
 
-    def open(self) -> None:
+    def open(self, for_append: bool = False) -> None:
         meta = self._load_meta()
         self.order = meta["order"]
         self.splay_width = meta["splay_width"]
         self.entries_per_object = meta["entries_per_object"]
         self.next_tid = meta["next_tid"]
+        self._tail_scanned = meta.get("tail_scanned", False)
+        self.nonce = meta.get("nonce")
+        if for_append and not self._tail_scanned:
+            # The metadata's next_tid is a *reservation floor*, not
+            # the truth: the reference's JournalPlayer derives the
+            # real end by scanning object tails (ObjectPlayer::fetch),
+            # because a crash can leave entries the metadata has not
+            # caught up to.  Scan the active object set and advance
+            # past any tid found so a restarted master never re-issues
+            # a tid that is already on disk with a different payload.
+            # Writer-only: a read-only peer (rbd-mirror poll) must
+            # neither pay 2*splay_width object reads per poll nor race
+            # the master's own "meta" omap writes.
+            per_set = self.splay_width * self.entries_per_object
+            cur_set = self.next_tid // per_set
+            for s in (cur_set, cur_set + 1):
+                for i in range(self.splay_width):
+                    objnum = s * self.splay_width + i
+                    try:
+                        buf = self.ioctx.read(
+                            _data_oid(self.journal_id, objnum))
+                    except OSError:
+                        continue
+                    off = 0
+                    while True:
+                        parsed = _unframe(buf, off)
+                        if parsed is None:
+                            break
+                        tid, _tag, _payload, off = parsed
+                        if tid >= self.next_tid:
+                            self.next_tid = tid + 1
+            self._tail_scanned = True
+            self._save_meta()         # records the repair marker too
         self._open = True
 
     def _load_meta(self) -> dict:
@@ -145,7 +189,9 @@ class Journaler:
                 "order": self.order,
                 "splay_width": self.splay_width,
                 "entries_per_object": self.entries_per_object,
-                "next_tid": self.next_tid})})
+                "next_tid": self.next_tid,
+                "tail_scanned": self._tail_scanned,
+                "nonce": self.nonce})})
 
     @staticmethod
     def exists(ioctx, journal_id: str) -> bool:
@@ -226,13 +272,18 @@ class Journaler:
     # -- append / replay / trim ----------------------------------------
 
     def append(self, tag: str, payload: bytes) -> int:
+        """Reserve the tid durably BEFORE writing the frame.  A crash
+        between the two leaves a hole at tid N (replay skips it; the
+        next writer uses N+1) — never two distinct entries sharing one
+        tid, which would silently desync any client whose commit
+        position already covered N."""
         assert self._open, "journal not open"
         tid = self.next_tid
+        self.next_tid = tid + 1
+        self._save_meta()
         self.ioctx.append(_data_oid(self.journal_id,
                                     self._object_of(tid)),
                           _frame(tid, tag, payload))
-        self.next_tid = tid + 1
-        self._save_meta()
         return tid
 
     def iterate(self, from_tid: int = -1):

@@ -53,6 +53,12 @@ class RbdMirror:
         self._thread: threading.Thread | None = None
         # image -> replay status (the `rbd mirror image status` role)
         self.status: dict = {}
+        # image -> (journal nonce, next_tid, pos) of the last
+        # zero-progress poll: a
+        # crashed primary can leave a reserved-but-unwritten tail tid
+        # (reserve-before-write append), which would otherwise defeat
+        # the caught-up fast path and re-read the object set forever
+        self._idle_cache: dict = {}
 
     # -- lifecycle -----------------------------------------------------
 
@@ -112,8 +118,12 @@ class RbdMirror:
                 return
         applied = 0
         pos = journal.committed(self.client_id)
-        if pos >= journal.next_tid - 1:
-            # caught up: zero data-object reads on an idle image
+        if (pos >= journal.next_tid - 1
+                or self._idle_cache.get(name) == (journal.nonce,
+                                                  journal.next_tid,
+                                                  pos)):
+            # caught up — or a tail hole with nothing new appended
+            # since the last fruitless poll: zero data-object reads
             self.status[name] = {"state": "replaying", "position": pos}
             return
         for tid, tag, payload in journal.iterate(pos):
@@ -121,7 +131,11 @@ class RbdMirror:
             journal.commit(self.client_id, tid)
             applied += 1
         if applied:
+            self._idle_cache.pop(name, None)
             journal.trim()            # let the primary retire objects
+        else:
+            self._idle_cache[name] = (journal.nonce,
+                                      journal.next_tid, pos)
         self.status[name] = {"state": "replaying",
                              "position": journal.committed(
                                  self.client_id)}

@@ -140,6 +140,118 @@ class TestNamespace:
         fs.symlink("/links", "/byway")
         assert fs.read("/byway/real") == b"linked!"
 
+    def test_relative_symlink(self, fs):
+        """A relative target resolves against the link's PARENT dir
+        (Client::path_walk), not against root."""
+        fs.mkdirs("/rel/deep")
+        fs.write("/rel/deep/data", b"found me")
+        fs.symlink("deep/data", "/rel/ptr")          # relative file
+        assert fs.read("/rel/ptr") == b"found me"
+        fs.symlink("deep", "/rel/dirptr")            # relative dir
+        assert fs.read("/rel/dirptr/data") == b"found me"
+        # relative link inside a subdir points within that subdir
+        fs.symlink("data", "/rel/deep/self")
+        assert fs.read("/rel/deep/self") == b"found me"
+
+    def test_symlink_cycle_is_eloop(self, fs):
+        fs.mkdir("/loop")
+        fs.symlink("/loop/b", "/loop/a")
+        fs.symlink("/loop/a", "/loop/b")
+        with pytest.raises(CephFSError) as ei:
+            fs.read("/loop/a")
+        assert ei.value.errno == errno.ELOOP
+        # mid-path cycle too (dir-position symlink)
+        with pytest.raises(CephFSError) as ei:
+            fs.stat("/loop/a/child")
+        assert ei.value.errno == errno.ELOOP
+
+    def test_rename_over_file_purges_target(self, fs):
+        """Renaming over an existing file must purge the overwritten
+        inode's data objects (unlink and rename share the PurgeQueue
+        role) — otherwise they leak in the data pool forever."""
+        from .cluster_util import wait_until
+        fs.mkdir("/rrov")
+        fs.write("/rrov/src", b"winner")
+        fs.write("/rrov/dst", b"z" * 8192)
+        doomed_ino = fs.stat("/rrov/dst")["ino"]
+        fs.rename("/rrov/src", "/rrov/dst")
+        assert fs.read("/rrov/dst") == b"winner"
+        def purged():
+            return not [o for o in fs.data_io.list_objects()
+                        if o.startswith("%x." % doomed_ino)]
+        assert wait_until(purged, timeout=5), \
+            "rename-over-file leaked the target's data objects"
+
+    def test_rename_dir_over_empty_dir(self, fs):
+        """POSIX: dir over EMPTY dir succeeds (target removed); over a
+        non-empty dir fails ENOTEMPTY."""
+        fs.mkdirs("/dod/src")
+        fs.write("/dod/src/payload", b"p")
+        fs.mkdir("/dod/empty")
+        fs.rename("/dod/src", "/dod/empty")
+        assert fs.read("/dod/empty/payload") == b"p"
+        assert "src" not in fs.listdir("/dod")
+        fs.mkdir("/dod/other")
+        with pytest.raises(CephFSError) as ei:
+            fs.rename("/dod/other", "/dod/empty")   # now non-empty
+        assert ei.value.errno == errno.ENOTEMPTY
+
+    def test_rename_into_own_subtree_is_einval(self, fs):
+        """Renaming a directory into its own subtree would orphan the
+        subtree in a self-cycle; the MDS rejects it (EINVAL)."""
+        fs.mkdirs("/cyc/a/x/y")
+        fs.write("/cyc/a/payload", b"p")
+        for dst in ("/cyc/a/x/y/a2", "/cyc/a/x/y"):   # deep + over-dir
+            with pytest.raises(CephFSError) as ei:
+                fs.rename("/cyc/a", dst)
+            assert ei.value.errno == errno.EINVAL
+        assert fs.read("/cyc/a/payload") == b"p"
+        assert "a" in fs.listdir("/cyc")
+
+    def test_rename_dir_over_file_is_enotdir(self, fs):
+        """POSIX: renaming a directory over a non-directory fails
+        ENOTDIR — and must NOT purge the file's data."""
+        fs.mkdir("/dof")
+        fs.mkdir("/dof/d")
+        fs.write("/dof/f", b"survives")
+        with pytest.raises(CephFSError) as ei:
+            fs.rename("/dof/d", "/dof/f")
+        assert ei.value.errno == errno.ENOTDIR
+        assert fs.read("/dof/f") == b"survives"
+
+    def test_degenerate_symlink_targets(self, fs):
+        fs.mkdir("/degen")
+        with pytest.raises(CephFSError) as ei:
+            fs.symlink("", "/degen/empty")
+        assert ei.value.errno == errno.ENOENT
+        # "/" is a valid target: resolves to the root directory
+        fs.symlink("/", "/degen/root")
+        assert fs.stat("/degen/root")["type"] == "dir"
+        assert "degen" in fs.listdir("/degen/root")
+
+    def test_rename_to_self_is_noop(self, fs):
+        """POSIX rename(p, p) succeeds and leaves the file intact —
+        in particular it must NOT purge the file's own data objects
+        (the destination dentry IS the source)."""
+        fs.mkdir("/selfmv")
+        fs.write("/selfmv/f", b"precious")
+        fs.rename("/selfmv/f", "/selfmv/f")
+        assert fs.read("/selfmv/f") == b"precious"
+
+    def test_two_mounts_share_no_dedup_state(self, cluster):
+        """Two CephFS mounts over ONE RadosClient must not collide in
+        the MDS (session, tid) exactly-once cache: each mount starts
+        tids at 1, so a shared session would answer mount B's early
+        ops from mount A's cached replies."""
+        client = cluster.client()
+        m1 = CephFS(client)
+        m2 = CephFS(client)
+        assert m1.session != m2.session
+        m1.mkdir("/dup_a")            # both ops run at tid 1
+        m2.mkdir("/dup_b")
+        root = m1.listdir("/")
+        assert "dup_a" in root and "dup_b" in root
+
 
 class TestDurabilityAndFailover:
     def test_metadata_survives_mds_restart(self, cluster, fs):

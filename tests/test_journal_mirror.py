@@ -96,6 +96,91 @@ class TestJournaler:
         j.commit("c", 5)              # stale position: ignored
         assert j.committed("c") == 10
 
+    def test_crash_after_reserve_leaves_hole_not_dup(self, ioctx):
+        """append() reserves the tid durably BEFORE writing the frame:
+        a crash between the two leaves a hole at that tid, never two
+        distinct entries sharing a tid (which would desync any client
+        whose commit position already covered it)."""
+        class CrashOnAppend:
+            def __init__(self, inner):
+                self.inner = inner
+                self.crash = False
+
+            def __getattr__(self, name):
+                return getattr(self.inner, name)
+
+            def append(self, *a, **kw):
+                if self.crash:
+                    self.crash = False
+                    raise RuntimeError("simulated crash")
+                return self.inner.append(*a, **kw)
+
+        wrapped = CrashOnAppend(ioctx)
+        j = Journaler(wrapped, "t5", splay_width=2,
+                      entries_per_object=4)
+        j.create()
+        assert j.append("t", b"one") == 0
+        wrapped.crash = True
+        with pytest.raises(RuntimeError):
+            j.append("t", b"two")     # tid 1 reserved, frame lost
+        # a restarted master continues past the reserved tid
+        j2 = Journaler(ioctx, "t5")
+        j2.open(for_append=True)
+        assert j2.append("t", b"three") == 2
+        got = j2.iterate()
+        assert [(t, p) for t, _, p in got] == [(0, b"one"),
+                                               (2, b"three")]
+
+    def test_open_scans_tail_past_stale_meta(self, ioctx):
+        """open() derives the true end by scanning object tails (the
+        JournalPlayer/ObjectPlayer contract): an entry on disk past
+        the metadata's next_tid must never have its tid re-issued."""
+        from ceph_tpu import encoding
+        from ceph_tpu.services.journal import _frame, _meta_oid
+        j = Journaler(ioctx, "t6", splay_width=2,
+                      entries_per_object=4)
+        j.create()
+        j.append("t", b"a")           # tid 0, meta next_tid=1
+        # simulate a journal written by pre-fix code: frame for tid 1
+        # on disk, metadata never caught up, and no repair marker
+        ioctx.append(_data_oid("t6", j._object_of(1)),
+                     _frame(1, "t", b"orphan"))
+        meta = encoding.decode_any(
+            ioctx.omap_get(_meta_oid("t6"))["meta"])
+        meta.pop("tail_scanned")
+        ioctx.omap_set(_meta_oid("t6"),
+                       {"meta": encoding.encode_any(meta)})
+        j2 = Journaler(ioctx, "t6")
+        j2.open(for_append=True)
+        assert j2.next_tid == 2       # scanned past the orphan
+        # a READ-ONLY open (mirror peer) neither scans nor repairs:
+        # it must not race the master's own "meta" omap writes
+        jro = Journaler(ioctx, "t6")
+        jro.open()
+        assert jro.next_tid == 2      # writer already repaired meta
+
+        # the repair is one-time: a later writer open skips the scan
+        class CountReads:
+            def __init__(self, inner):
+                self.inner = inner
+                self.reads = 0
+
+            def __getattr__(self, name):
+                return getattr(self.inner, name)
+
+            def read(self, *a, **kw):
+                self.reads += 1
+                return self.inner.read(*a, **kw)
+
+        counted = CountReads(ioctx)
+        j3 = Journaler(counted, "t6")
+        j3.open(for_append=True)
+        assert counted.reads == 0     # marker persisted: no re-scan
+        assert j2.append("t", b"b") == 2
+        got = j2.iterate()
+        assert [(t, p) for t, _, p in got] == \
+            [(0, b"a"), (1, b"orphan"), (2, b"b")]
+
 
 class TestRbdJournaling:
     def test_journaled_image_round_trip(self, ioctx):
