@@ -6,10 +6,12 @@ reports to the mgr, which aggregates them as DaemonState and exposes
 cluster state to pluggable Python modules (prometheus exporter,
 status/dashboard, restful). Here modules subclass MgrModule
 (mirroring src/pybind/mgr/mgr_module.py:33) and the bundled modules
-are `prometheus` (text exposition format) and `status`.
+are `prometheus` (text exposition format), `status`, and `balancer`
+(upmap mode, riding the batched device CRUSH sweep).
 """
 
 from .daemon_state import DaemonStateIndex  # noqa: F401
 from .mgr_daemon import MgrDaemon  # noqa: F401
 from .mgr_module import MgrModule  # noqa: F401
-from .modules import PrometheusModule, StatusModule  # noqa: F401
+from .modules import (BalancerModule, PrometheusModule,  # noqa: F401
+                      StatusModule)

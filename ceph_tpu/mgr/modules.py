@@ -1,8 +1,10 @@
-"""Bundled mgr modules: prometheus exporter + status.
+"""Bundled mgr modules: prometheus exporter, status, upmap balancer.
 
 Counterparts of the reference's src/pybind/mgr/prometheus (text
-exposition of cluster + per-daemon perf metrics, optionally over HTTP)
-and src/pybind/mgr/status (operator-facing summaries).
+exposition of cluster + per-daemon perf metrics, optionally over HTTP),
+src/pybind/mgr/status (operator-facing summaries), and
+src/pybind/mgr/balancer in upmap mode (periodic calc_pg_upmaps driven
+through mon commands).
 """
 
 from __future__ import annotations
@@ -12,7 +14,7 @@ import threading
 
 from .mgr_module import MgrModule
 
-__all__ = ["PrometheusModule", "StatusModule"]
+__all__ = ["PrometheusModule", "StatusModule", "BalancerModule"]
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -178,3 +180,131 @@ class StatusModule(MgrModule):
                        if osdmap.is_in(o)),
                    len(osdmap.pools))), ""
         return super().handle_command(cmd)
+
+
+class BalancerModule(MgrModule):
+    """Upmap-mode balancer (src/pybind/mgr/balancer/module.py role):
+    score the map, compute pg_upmap_items with the device-swept
+    optimizer, and drive the proposal through mon commands so every
+    client observes the flattened placement."""
+
+    COMMANDS = [
+        {"cmd": "balancer status", "desc": "mode + last optimization"},
+        {"cmd": "balancer eval", "desc": "score current distribution"},
+        {"cmd": "balancer optimize",
+         "desc": "compute + apply pg_upmap_items"},
+        {"cmd": "balancer on", "desc": "enable periodic optimization"},
+        {"cmd": "balancer off", "desc": "disable periodic optimization"},
+    ]
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self.name = "balancer"
+        self.mode = "upmap"
+        self.active = False
+        self.sleep_interval = 60.0
+        self.max_deviation_ratio = 0.05
+        self.max_changes_per_round = 10
+        self.last_optimize: dict = {}
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- scoring / optimization ---------------------------------------
+
+    def _eval(self, osdmap):
+        from ..osd.balancer import eval_distribution
+        return eval_distribution(osdmap)
+
+    def optimize_once(self) -> tuple[int, str]:
+        """One balancer round: compute a proposal against the current
+        map and apply it through the monitor.  Returns (#changes,
+        summary)."""
+        from ..osd.balancer import calc_pg_upmaps
+        osdmap = self.get("osd_map")
+        if osdmap is None:
+            return 0, "no osdmap yet"
+        res = calc_pg_upmaps(
+            osdmap, max_deviation=1.0,
+            max_deviation_ratio=self.max_deviation_ratio,
+            max_changes=self.max_changes_per_round)
+        mon = self.mgr.mon_client
+        applied = 0
+        for pgid in res.old_pg_upmap_items:
+            if pgid in res.new_pg_upmap_items:
+                continue              # re-added in the same proposal
+            r, _, _ = mon.command({"prefix": "osd rm-pg-upmap-items",
+                                   "pgid": [pgid.pool, pgid.ps]})
+            if r == 0:
+                applied += 1
+        for pgid, items in res.new_pg_upmap_items.items():
+            r, _, _ = mon.command({"prefix": "osd pg-upmap-items",
+                                   "pgid": [pgid.pool, pgid.ps],
+                                   "mappings": [list(p) for p in items]})
+            if r == 0:
+                applied += 1
+        summary = ("%d change(s) applied; deviation %.2f -> %.2f "
+                   "(%d device sweeps)"
+                   % (applied, res.start_deviation, res.end_deviation,
+                      res.sweeps))
+        self.last_optimize = {"applied": applied,
+                              "start_deviation": res.start_deviation,
+                              "end_deviation": res.end_deviation,
+                              "sweeps": res.sweeps}
+        return applied, summary
+
+    # -- commands ------------------------------------------------------
+
+    def handle_command(self, cmd):
+        prefix = cmd.get("prefix")
+        if prefix == "balancer status":
+            return 0, "", {"mode": self.mode, "active": self.active,
+                           "last_optimize": dict(self.last_optimize)}
+        if prefix == "balancer eval":
+            osdmap = self.get("osd_map")
+            if osdmap is None:
+                return -11, "", "no osdmap yet"
+            dist = self._eval(osdmap)
+            return 0, "", {"stddev": dist.stddev,
+                           "total_deviation": dist.total_deviation,
+                           "pg_counts": dict(dist.pg_counts)}
+        if prefix == "balancer optimize":
+            _, summary = self.optimize_once()
+            return 0, summary, ""
+        if prefix == "balancer on":
+            self.active = True
+            if self._thread is None:
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True)
+                self._thread.start()
+            self._wake.set()
+            return 0, "balancer on", ""
+        if prefix == "balancer off":
+            self.active = False
+            return 0, "balancer off", ""
+        return super().handle_command(cmd)
+
+    # -- periodic loop -------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if self.active:
+                try:
+                    self.optimize_once()
+                    self.set_health_checks({})
+                except Exception as e:
+                    # surface the failure: stamp it into the status
+                    # the operator reads and raise a health check —
+                    # a silently dead balancer looks exactly like a
+                    # balanced cluster otherwise
+                    self.last_optimize = {"error": repr(e)}
+                    self.set_health_checks({"BALANCER_FAILED": {
+                        "severity": "warning",
+                        "summary": "balancer round failed",
+                        "detail": [repr(e)]}})
+            self._wake.wait(self.sleep_interval)
+            self._wake.clear()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._wake.set()

@@ -196,6 +196,11 @@ class OSDMonitor:
                     [tuple(x) for x in cmd["mappings"]]
                 self.mon.propose_soon()
                 return 0, "", None
+            if prefix == "osd rm-pg-upmap-items":
+                pgid = PGID(*cmd["pgid"])
+                self._pend().old_pg_upmap_items.append(pgid)
+                self.mon.propose_soon()
+                return 0, "", None
             if prefix == "osd dump":
                 return 0, "", self._dump()
             if prefix == "osd getmap":

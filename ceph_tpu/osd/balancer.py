@@ -1,0 +1,377 @@
+"""Upmap balancer: flattens the PG distribution with pg_upmap_items.
+
+Role of the reference's OSDMap::calc_pg_upmaps
+(/root/reference/src/osd/OSDMap.cc:3763), OSDMap::try_pg_upmap (:3718),
+CrushWrapper::try_remap_rule / _choose_type_stack
+(/root/reference/src/crush/CrushWrapper.cc) and the mgr balancer
+module's upmap mode (/root/reference/src/pybind/mgr/balancer): compute
+per-OSD PG deviation from the CRUSH-weight target, then greedily
+evacuate the fullest OSDs by (a) dropping existing pg_upmap_items that
+land on them and (b) adding new items that remap one PG shard from an
+overfull to an underfull device — never violating the placement rule's
+failure-domain separation.
+
+TPU-first: the expensive part of every balancer round is the
+all-PG placement sweep, which the reference computes with
+ParallelPGMapper CPU threads.  Here each pool's whole PG range maps in
+ONE batched device CRUSH program (ceph_tpu.crush.batched via
+OSDMapMapping.update), so the sweep that runs once per accepted change
+rides the accelerator; the greedy bookkeeping between sweeps is cheap
+host code.
+
+Failure-domain validity: the reference re-walks the rule per candidate
+(_choose_type_stack) to pick a replacement inside a compatible bucket.
+This implementation instead proposes a replacement from the underfull
+list and then checks the resulting mapping is one the rule could have
+produced: every device lies under the rule's take root, and the number
+of distinct failure-domain buckets (the deepest typed choose step) does
+not decrease.  That invariant is what the reference's per-level walk
+ultimately guarantees; checking it directly is simpler and equally
+safe.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..crush.map import CRUSH_ITEM_NONE, CrushMap
+from .osd_map import PGID, Incremental, OSDMap, OSDMapMapping
+
+__all__ = ["calc_pg_upmaps", "eval_distribution", "BalancerResult",
+           "Distribution"]
+
+
+# ---------------------------------------------------------------------------
+# crush topology helpers
+
+
+def rule_take_roots(crush: CrushMap, ruleno: int) -> list[int]:
+    """Bucket/device ids named by the rule's take steps."""
+    if not (0 <= ruleno < len(crush.rules)):
+        return []
+    return [step[1] for step in crush.rules[ruleno].steps
+            if step[0] == "take"]
+
+
+def rule_failure_domain(crush: CrushMap, ruleno: int) -> int:
+    """The separation domain: the deepest non-device type named by a
+    choose/chooseleaf step (0 = no bucket-type separation, devices
+    only)."""
+    domain = 0
+    if not (0 <= ruleno < len(crush.rules)):
+        return domain
+    for step in crush.rules[ruleno].steps:
+        if step[0].startswith("choose") and len(step) >= 3 and \
+                step[2] > 0:
+            domain = step[2]
+    return domain
+
+
+def parent_index(crush: CrushMap) -> dict[int, int]:
+    """item id -> containing bucket id (CRUSH trees have one parent)."""
+    idx: dict[int, int] = {}
+    for bid, bucket in crush.buckets.items():
+        for item in bucket.items:
+            idx[int(item)] = bid
+    return idx
+
+
+def parent_of_type(crush: CrushMap, item: int, type_id: int,
+                   pindex: dict[int, int]) -> int | None:
+    """Walk up from item to its ancestor bucket of type_id
+    (CrushWrapper::get_parent_of_type)."""
+    cur = item
+    while True:
+        parent = pindex.get(cur)
+        if parent is None:
+            return None
+        if crush.buckets[parent].type == type_id:
+            return parent
+        cur = parent
+
+
+def subtree_devices(crush: CrushMap, root: int) -> set[int]:
+    """All device ids beneath root (root may itself be a device)."""
+    if root >= 0:
+        return {root}
+    out: set[int] = set()
+    stack = [root]
+    while stack:
+        bid = stack.pop()
+        bucket = crush.buckets.get(bid)
+        if bucket is None:
+            continue
+        for item in bucket.items:
+            item = int(item)
+            if item >= 0:
+                out.add(item)
+            else:
+                stack.append(item)
+    return out
+
+
+def rule_weight_osd_map(crush: CrushMap, ruleno: int) -> dict[int, float]:
+    """Per-device CRUSH weight reachable through the rule's take steps
+    (CrushWrapper::get_rule_weight_osd_map): the balancer's notion of
+    each OSD's fair share."""
+    out: dict[int, float] = {}
+    for root in rule_take_roots(crush, ruleno):
+        if root >= 0:
+            out[root] = out.get(root, 0.0) + 1.0
+            continue
+        stack = [root]
+        while stack:
+            bid = stack.pop()
+            bucket = crush.buckets.get(bid)
+            if bucket is None:
+                continue
+            for item, w in zip(bucket.items, bucket.weights):
+                item = int(item)
+                if item >= 0:
+                    out[item] = out.get(item, 0.0) + int(w) / 0x10000
+                else:
+                    stack.append(item)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# distribution evaluation (balancer eval / the verify re-sweep)
+
+
+@dataclass
+class Distribution:
+    pg_counts: dict[int, int]           # osd -> #up PG shards
+    targets: dict[int, float]           # osd -> fair share (pgs)
+    total_deviation: float
+    stddev: float
+
+    def deviation(self, osd: int) -> float:
+        return self.pg_counts.get(osd, 0) - self.targets.get(osd, 0.0)
+
+
+def _sweep(osdmap: OSDMap, pools: set[int] | None,
+           use_device: bool) -> dict[PGID, list[int]]:
+    """All-PG up mappings — one batched device CRUSH program per pool
+    (the ParallelPGMapper-analog step of every balancer round)."""
+    mapping = OSDMapMapping()
+    mapping.update(osdmap, batched=use_device)
+    out: dict[PGID, list[int]] = {}
+    for pgid, (up, _up_p, _acting, _acting_p) in mapping.by_pg.items():
+        if pools is not None and pgid.pool not in pools:
+            continue
+        out[pgid] = up
+    return out
+
+
+def _targets(osdmap: OSDMap,
+             pools: set[int] | None) -> tuple[dict[int, float], float]:
+    """Per-OSD fair share: (weights, pgs_per_weight).  Shared by the
+    scorer and the optimizer so `balancer eval` always agrees with the
+    deviations calc_pg_upmaps acted on."""
+    total_pgs = 0
+    weights: dict[int, float] = {}
+    weight_total = 0.0
+    for pool_id, pool in osdmap.pools.items():
+        if pools is not None and pool_id not in pools:
+            continue
+        total_pgs += pool.size * pool.pg_num
+        for osd, w in rule_weight_osd_map(osdmap.crush,
+                                          pool.crush_rule).items():
+            # only devices that are in (weight > 0) can hold data
+            if osd < osdmap.max_osd and osdmap.is_in(osd):
+                weights[osd] = weights.get(osd, 0.0) + w
+                weight_total += w
+    per_weight = total_pgs / weight_total if weight_total > 0 else 0.0
+    return weights, per_weight
+
+
+def eval_distribution(osdmap: OSDMap, pools: set[int] | None = None,
+                      use_device: bool = True) -> Distribution:
+    """Score the current map: per-OSD up-PG counts vs CRUSH-weight
+    targets (the `balancer eval` / OSDUtilizationDumper role)."""
+    by_pg = _sweep(osdmap, pools, use_device)
+    counts: dict[int, int] = {}
+    for up in by_pg.values():
+        for osd in up:
+            if osd != CRUSH_ITEM_NONE:
+                counts[osd] = counts.get(osd, 0) + 1
+    weights, per_weight = _targets(osdmap, pools)
+    targets: dict[int, float] = {}
+    for osd, w in weights.items():
+        targets[osd] = w * per_weight
+        counts.setdefault(osd, 0)
+    devs = [counts.get(o, 0) - t for o, t in targets.items()]
+    total_dev = float(sum(abs(d) for d in devs))
+    stddev = float(np.std(devs)) if devs else 0.0
+    return Distribution(counts, targets, total_dev, stddev)
+
+
+# ---------------------------------------------------------------------------
+# the optimizer
+
+
+@dataclass
+class BalancerResult:
+    num_changed: int = 0
+    start_deviation: float = 0.0
+    end_deviation: float = 0.0
+    sweeps: int = 0
+    # the proposal, Incremental-shaped
+    new_pg_upmap_items: dict[PGID, list] = field(default_factory=dict)
+    old_pg_upmap_items: list[PGID] = field(default_factory=list)
+
+    def apply_to(self, inc: Incremental) -> None:
+        inc.new_pg_upmap_items.update(self.new_pg_upmap_items)
+        for pgid in self.old_pg_upmap_items:
+            # a pgid dropped in one sweep and re-added in a later one
+            # must land as a SET, not a removal (apply_incremental
+            # processes removals last)
+            if pgid not in self.new_pg_upmap_items and \
+                    pgid not in inc.old_pg_upmap_items:
+                inc.old_pg_upmap_items.append(pgid)
+
+
+def _try_pg_upmap(osdmap: OSDMap, pgid: PGID, overfull: set[int],
+                  underfull: list[int]) -> list[tuple[int, int]] | None:
+    """Propose (src, dst) item pairs moving pgid's overfull shards to
+    underfull devices while preserving the rule's placement validity
+    (OSDMap::try_pg_upmap + CrushWrapper::try_remap_rule role)."""
+    pool = osdmap.pools.get(pgid.pool)
+    if pool is None:
+        return None
+    crush = osdmap.crush
+    ruleno = pool.crush_rule
+    orig, _pps = osdmap._pg_to_raw_osds(pool, pgid)
+    if not any(o in overfull for o in orig if o != CRUSH_ITEM_NONE):
+        return None
+    allowed: set[int] = set()
+    for root in rule_take_roots(crush, ruleno):
+        allowed |= subtree_devices(crush, root)
+    fd_type = rule_failure_domain(crush, ruleno)
+    pindex = parent_index(crush)
+
+    def domains(osds) -> list:
+        return [parent_of_type(crush, o, fd_type, pindex)
+                for o in osds if o != CRUSH_ITEM_NONE]
+
+    orig_domains = domains(orig)
+    out = list(orig)
+    used = {o for o in out if o != CRUSH_ITEM_NONE}
+    for i, osd in enumerate(out):
+        if osd == CRUSH_ITEM_NONE or osd not in overfull:
+            continue
+        for cand in underfull:
+            if cand in used or cand not in allowed:
+                continue
+            trial = list(out)
+            trial[i] = cand
+            if fd_type > 0:
+                # separation must not degrade: at least as many
+                # distinct failure-domain buckets as CRUSH produced
+                if len(set(domains(trial))) < len(set(orig_domains)):
+                    continue
+            out = trial
+            used.add(cand)
+            break
+    if out == orig:
+        return None
+    return [(orig[i], out[i]) for i in range(len(orig))
+            if orig[i] != out[i]]
+
+
+def calc_pg_upmaps(osdmap: OSDMap,
+                   max_deviation: float = 1.0,
+                   max_deviation_ratio: float = 0.0,
+                   max_changes: int = 10,
+                   pools: set[int] | None = None,
+                   use_device: bool = True) -> BalancerResult:
+    """Greedy upmap optimization, one accepted change per device
+    sweep, mirroring OSDMap::calc_pg_upmaps' restart loop.  Stops
+    when the fullest OSD sits within max_deviation PGs of its target
+    (and, when max_deviation_ratio > 0, additionally within that
+    ratio of the target).  Returns the proposal; the caller routes it
+    through the monitor ("osd pg-upmap-items" /
+    "osd rm-pg-upmap-items") or an Incremental."""
+    tmp = osdmap.clone()
+    res = BalancerResult()
+    remaining = max_changes
+    while remaining > 0:
+        by_pg = _sweep(tmp, pools, use_device)
+        res.sweeps += 1
+        pgs_by_osd: dict[int, list[PGID]] = {}
+        for pgid, up in sorted(by_pg.items(),
+                               key=lambda kv: (kv[0].pool, kv[0].ps)):
+            for osd in up:
+                if osd != CRUSH_ITEM_NONE:
+                    pgs_by_osd.setdefault(osd, []).append(pgid)
+        weights, per_weight = _targets(tmp, pools)
+        if per_weight <= 0:
+            break
+        deviations: dict[int, float] = {}
+        overfull: set[int] = set()
+        total_deviation = 0.0
+        for osd, w in weights.items():
+            pgs_by_osd.setdefault(osd, [])
+            dev = len(pgs_by_osd[osd]) - w * per_weight
+            deviations[osd] = dev
+            if dev >= 1.0:
+                overfull.add(osd)
+            total_deviation += abs(dev)
+        # devices carrying PGs but outside every rule's weight map
+        # (e.g. weight zeroed mid-flight) are maximally overfull
+        for osd, pgs in pgs_by_osd.items():
+            if osd not in deviations:
+                deviations[osd] = float(len(pgs))
+                if pgs:
+                    overfull.add(osd)
+                total_deviation += len(pgs)
+        if res.sweeps == 1:
+            res.start_deviation = total_deviation
+        res.end_deviation = total_deviation
+        underfull = [osd for osd, dev in
+                     sorted(deviations.items(),
+                            key=lambda kv: (kv[1], kv[0]))
+                     if dev < -0.999]
+        if not overfull or not underfull:
+            break
+        restart = False
+        for osd in sorted(deviations, key=lambda o: -deviations[o]):
+            dev = deviations[osd]
+            target = weights.get(osd, 0.0) * per_weight
+            if max_deviation_ratio > 0 and target > 0 and \
+                    dev / target < max_deviation_ratio:
+                break                  # fullest is within tolerance
+            if dev < max(1.0, max_deviation):
+                break
+            # 1) un-remap: drop existing items that land on this osd
+            for pgid in pgs_by_osd[osd]:
+                items = tmp.pg_upmap_items.get(pgid)
+                if items and any(dst == osd for _src, dst in items):
+                    tmp.pg_upmap_items.pop(pgid)
+                    res.new_pg_upmap_items.pop(pgid, None)
+                    res.old_pg_upmap_items.append(pgid)
+                    res.num_changed += 1
+                    restart = True
+                    break
+            if restart:
+                break
+            # 2) remap one PG shard off this osd
+            for pgid in pgs_by_osd[osd]:
+                if pgid in tmp.pg_upmap or pgid in tmp.pg_upmap_items:
+                    continue
+                pairs = _try_pg_upmap(tmp, pgid, overfull, underfull)
+                if pairs is None:
+                    continue
+                tmp.pg_upmap_items[pgid] = pairs
+                res.new_pg_upmap_items[pgid] = pairs
+                res.num_changed += 1
+                restart = True
+                break
+            if restart:
+                break
+        if not restart:
+            break                      # no further improvement found
+        remaining -= 1
+    return res

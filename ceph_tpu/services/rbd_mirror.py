@@ -53,12 +53,15 @@ class RbdMirror:
         self._thread: threading.Thread | None = None
         # image -> replay status (the `rbd mirror image status` role)
         self.status: dict = {}
-        # image -> (journal nonce, next_tid, pos) of the last
-        # zero-progress poll: a
+        # image -> ((journal nonce, next_tid, pos), cached_at): a
         # crashed primary can leave a reserved-but-unwritten tail tid
         # (reserve-before-write append), which would otherwise defeat
-        # the caught-up fast path and re-read the object set forever
+        # the caught-up fast path and re-read the object set forever.
+        # Entries EXPIRE (idle_verify_interval) so a frame whose write
+        # was merely in flight during the fruitless poll is picked up
+        # on the next verify instead of being suppressed forever.
         self._idle_cache: dict = {}
+        self.idle_verify_interval = 5.0
 
     # -- lifecycle -----------------------------------------------------
 
@@ -116,12 +119,15 @@ class RbdMirror:
             local_img = self._bootstrap(name, journal)
             if local_img is None:
                 return
+        import time as _time
         applied = 0
         pos = journal.committed(self.client_id)
+        idle_key = (journal.nonce, journal.next_tid, pos)
+        cached = self._idle_cache.get(name)
         if (pos >= journal.next_tid - 1
-                or self._idle_cache.get(name) == (journal.nonce,
-                                                  journal.next_tid,
-                                                  pos)):
+                or (cached is not None and cached[0] == idle_key
+                    and _time.monotonic() - cached[1]
+                    < self.idle_verify_interval)):
             # caught up — or a tail hole with nothing new appended
             # since the last fruitless poll: zero data-object reads
             self.status[name] = {"state": "replaying", "position": pos}
@@ -134,8 +140,7 @@ class RbdMirror:
             self._idle_cache.pop(name, None)
             journal.trim()            # let the primary retire objects
         else:
-            self._idle_cache[name] = (journal.nonce,
-                                      journal.next_tid, pos)
+            self._idle_cache[name] = (idle_key, _time.monotonic())
         self.status[name] = {"state": "replaying",
                              "position": journal.committed(
                                  self.client_id)}

@@ -169,52 +169,19 @@ def test_map_object(m: OSDMap, name: str, pool_id: int) -> str:
 
 
 def calc_pg_upmaps(m: OSDMap, pool_filter: int | None = None,
-                   max_changes: int = 10, max_deviation: int = 1):
-    """Greedy pg_upmap_items balancer.
-
-    Repeatedly moves one PG-shard from the most-loaded to the
-    least-loaded OSD (same failure domain not enforced — single-step
-    remaps only, like the reference's item-pair form). Returns a list of
-    (pgid, [(from, to), ...]) suggestions and mutates a clone internally
-    to keep counts honest.
-    """
-    work = m.clone()
-    changes: list[tuple[PGID, list[tuple[int, int]]]] = []
-    for _ in range(max_changes):
-        mapping = OSDMapMapping()
-        mapping.update(work, batched=False)
-        counts = np.zeros(work.max_osd, dtype=np.int64)
-        for pgid, (_, _, acting, _) in mapping.by_pg.items():
-            if pool_filter is not None and pgid.pool != pool_filter:
-                continue
-            for osd in acting:
-                if osd != CRUSH_ITEM_NONE and 0 <= osd < work.max_osd:
-                    counts[osd] += 1
-        in_osds = [o for o in range(work.max_osd)
-                   if work.is_in(o) and work.is_up(o)]
-        if not in_osds:
-            break
-        hi = max(in_osds, key=lambda o: counts[o])
-        lo = min(in_osds, key=lambda o: counts[o])
-        if counts[hi] - counts[lo] <= max_deviation:
-            break
-        moved = False
-        for pgid in mapping.get_osd_acting_pgs(hi):
-            if pool_filter is not None and pgid.pool != pool_filter:
-                continue
-            _, _, acting, _ = mapping.by_pg[pgid]
-            if lo in acting or pgid in work.pg_upmap_items:
-                continue
-            pairs = [(hi, lo)]
-            inc = Incremental(work.epoch + 1)
-            inc.new_pg_upmap_items[pgid] = pairs
-            work.apply_incremental(inc)
-            changes.append((pgid, pairs))
-            moved = True
-            break
-        if not moved:
-            break
-    return changes
+                   max_changes: int = 10,
+                   max_deviation: float = 1.0,
+                   use_device: bool = True):
+    """Compute a rebalance proposal with the real optimizer
+    (ceph_tpu.osd.balancer, the OSDMap::calc_pg_upmaps analog: CRUSH
+    weight targets, failure-domain-preserving remaps, one batched
+    device sweep per accepted change).  max_deviation is in PGs, like
+    the CLI flag always was.  Returns the BalancerResult."""
+    from ..osd.balancer import calc_pg_upmaps as _calc
+    pools = {pool_filter} if pool_filter is not None else None
+    return _calc(m, max_deviation=max_deviation,
+                 max_changes=max_changes, pools=pools,
+                 use_device=use_device)
 
 
 # ---------------------------------------------------------------------------
@@ -240,7 +207,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write pg-upmap-items rebalance commands")
     p.add_argument("--upmap-pool", type=int, default=None)
     p.add_argument("--upmap-max", type=int, default=10)
-    p.add_argument("--upmap-deviation", type=int, default=1)
+    p.add_argument("--upmap-deviation", type=float, default=1,
+                   help="stop when the fullest osd is within this "
+                        "many PGs of its target")
     p.add_argument("--mark-down", type=int, metavar="OSD", default=None)
     return p
 
@@ -292,15 +261,25 @@ def main(argv=None) -> int:
                 m, args.test_map_object, args.pool) + "\n")
             return 0
         if args.upmap:
-            changes = calc_pg_upmaps(
+            res = calc_pg_upmaps(
                 m, pool_filter=args.upmap_pool, max_changes=args.upmap_max,
-                max_deviation=args.upmap_deviation)
+                max_deviation=args.upmap_deviation,
+                use_device=args.batched)
             with open(args.upmap, "w") as f:
-                for pgid, pairs in changes:
+                for pgid in res.old_pg_upmap_items:
+                    if pgid in res.new_pg_upmap_items:
+                        continue
+                    f.write("ceph osd rm-pg-upmap-items %s\n" % pgid)
+                for pgid, pairs in sorted(
+                        res.new_pg_upmap_items.items(),
+                        key=lambda kv: (kv[0].pool, kv[0].ps)):
                     f.write("ceph osd pg-upmap-items %s %s\n"
                             % (pgid, " ".join("%d %d" % t for t in pairs)))
-            sys.stdout.write("osdmaptool: wrote %d upmap commands to %s\n"
-                             % (len(changes), args.upmap))
+            sys.stdout.write(
+                "osdmaptool: wrote %d upmap commands to %s "
+                "(deviation %.2f -> %.2f, %d sweeps)\n"
+                % (res.num_changed, args.upmap, res.start_deviation,
+                   res.end_deviation, res.sweeps))
             return 0
     except (ValueError, OSError, KeyError, json.JSONDecodeError) as e:
         sys.stderr.write("osdmaptool: %s\n" % e)

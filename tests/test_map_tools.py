@@ -212,12 +212,12 @@ class TestOsdMapTool:
         for _, (_, _, acting, _) in mapping.by_pg.items():
             for o in acting:
                 before[o] += 1
-        changes = osdmaptool.calc_pg_upmaps(m, max_changes=20)
-        assert changes  # an uneven 5-osd map always has something to move
-        for pgid, pairs in changes:
-            inc = osdmaptool.Incremental(m.epoch + 1)
-            inc.new_pg_upmap_items[pgid] = pairs
-            m.apply_incremental(inc)
+        res = osdmaptool.calc_pg_upmaps(m, max_changes=20,
+                                        use_device=False)
+        assert res.num_changed  # an uneven 5-osd map has moves to make
+        inc = osdmaptool.Incremental(m.epoch + 1)
+        res.apply_to(inc)
+        m.apply_incremental(inc)
         mapping.update(m, batched=False)
         after = np.zeros(m.max_osd, dtype=np.int64)
         for _, (_, _, acting, _) in mapping.by_pg.items():

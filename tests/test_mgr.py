@@ -145,3 +145,84 @@ class TestLiveMgr:
         assert rc == 0
         assert "3 up, 3 in" in out
         assert "HEALTH_OK" in out
+
+
+class TestBalancerModule:
+    def test_optimize_applies_through_mon(self, mgr_cluster):
+        """End-to-end balancer round: skew the map with hand-seeded
+        pg_upmap_items, run `balancer optimize`, and watch the
+        monitor-published map flatten — from the CLIENT's view, not
+        just the mgr's."""
+        from ceph_tpu.mgr import BalancerModule
+        from ceph_tpu.osd.balancer import eval_distribution
+        cluster, mgr = mgr_cluster
+        client = cluster.client()
+        cluster.create_replicated_pool(client, "baltest", size=2,
+                                       pg_num=32)
+        assert wait_until(
+            lambda: any(p.name == "baltest"
+                        for p in mgr.osdmap.pools.values()),
+            timeout=10)
+        pool_id = next(p.pool_id for p in mgr.osdmap.pools.values()
+                       if p.name == "baltest")
+        # seed skew: shove replicas from osd 1 onto osd 0
+        from ceph_tpu.osd.osd_map import OSDMapMapping
+        mapping = OSDMapMapping()
+        mapping.update(mgr.osdmap.clone(), batched=False)
+        seeded = 0
+        for pgid, (up, _, _, _) in sorted(
+                mapping.by_pg.items(),
+                key=lambda kv: (kv[0].pool, kv[0].ps)):
+            if pgid.pool != pool_id or seeded >= 8:
+                continue
+            if 1 in up and 0 not in up:
+                r, _, _ = client.mon_command({
+                    "prefix": "osd pg-upmap-items",
+                    "pgid": [pgid.pool, pgid.ps],
+                    "mappings": [[1, 0]]})
+                assert r == 0
+                seeded += 1
+        assert seeded >= 4
+        assert wait_until(
+            lambda: sum(1 for pg in mgr.osdmap.pg_upmap_items
+                        if pg.pool == pool_id) >= seeded, timeout=10)
+        before = eval_distribution(mgr.osdmap, pools={pool_id},
+                                   use_device=False)
+        assert before.deviation(0) >= 2
+        bal = mgr.register_module(BalancerModule)
+        bal.max_changes_per_round = 50
+        rc, out, _ = mgr.module_command({"prefix": "balancer optimize"})
+        assert rc == 0 and "applied" in out
+        # the proposal flowed through paxos: the CLIENT's subscribed
+        # map converges to a flatter distribution
+        def client_flattened():
+            m = client.osdmap
+            if m is None or m.epoch <= mgr.osdmap.epoch - 5:
+                return False
+            d = eval_distribution(m, pools={pool_id}, use_device=False)
+            return d.total_deviation < before.total_deviation and \
+                abs(d.deviation(0)) < before.deviation(0)
+        assert wait_until(client_flattened, timeout=15)
+        rc, _, data = mgr.module_command({"prefix": "balancer status"})
+        assert rc == 0 and data["last_optimize"]["applied"] > 0
+
+    def test_eval_command(self, mgr_cluster):
+        from ceph_tpu.mgr import BalancerModule
+        _, mgr = mgr_cluster
+        bal = mgr.modules.get("balancer") or \
+            mgr.register_module(BalancerModule)
+        rc, _, data = mgr.module_command({"prefix": "balancer eval"})
+        assert rc == 0
+        assert "stddev" in data and "pg_counts" in data
+
+    def test_on_off(self, mgr_cluster):
+        from ceph_tpu.mgr import BalancerModule
+        _, mgr = mgr_cluster
+        bal = mgr.modules.get("balancer") or \
+            mgr.register_module(BalancerModule)
+        rc, out, _ = mgr.module_command({"prefix": "balancer on"})
+        assert rc == 0 and bal.active
+        rc, _, data = mgr.module_command({"prefix": "balancer status"})
+        assert data["active"] is True
+        rc, out, _ = mgr.module_command({"prefix": "balancer off"})
+        assert rc == 0 and not bal.active
