@@ -13,3 +13,4 @@ from .keyring import KeyRing, generate_secret  # noqa: F401
 from .cephx import (  # noqa: F401
     AuthError, CephxClient, CephxServer, CephxServiceHandler,
     seal, unseal)
+from .caps import Caps, CapsError, parse_caps  # noqa: F401

@@ -1,0 +1,121 @@
+"""Capability grammar + enforcement.
+
+Role of the reference's OSDCap (/root/reference/src/osd/OSDCap.{h,cc})
+and MonCap (/root/reference/src/mon/MonCap.{h,cc}): parse entity cap
+strings from the keyring / auth database into grant lists and answer
+is_capable() on the hot paths — the OSD checks pool-scoped rwx per op,
+the monitor checks r/w/x per command.
+
+Grammar (the subset the framework enforces; the reference adds
+object_prefix, namespaces, profiles and network restrictions):
+
+    capspec   := grant (',' grant)*
+    grant     := 'allow' (('*'|[rwx]+) ('pool=' name)?
+                          | 'command' '"' prefix '"')
+
+'*' grants rwx everywhere.  A grant with pool=NAME matches only that
+pool; without, it matches every pool.  'allow command "<prefix>"'
+(MonCap command grants) admits exactly that mon command prefix.
+"""
+
+from __future__ import annotations
+
+__all__ = ["CapGrant", "Caps", "CapsError", "parse_caps"]
+
+
+class CapsError(ValueError):
+    pass
+
+
+class CapGrant:
+    __slots__ = ("perms", "pool", "command")
+
+    def __init__(self, perms: frozenset, pool: str | None = None,
+                 command: str | None = None):
+        self.perms = perms
+        self.pool = pool
+        self.command = command
+
+    def __repr__(self):
+        if self.command is not None:
+            return "allow command %r" % self.command
+        spec = "*" if self.perms == frozenset("rwx") else \
+            "".join(p for p in "rwx" if p in self.perms)
+        return "allow %s%s" % (spec,
+                               " pool=%s" % self.pool if self.pool
+                               else "")
+
+
+def parse_caps(spec: str) -> "Caps":
+    """Parse a capability string ('allow rwx pool=data, allow r')."""
+    grants: list[CapGrant] = []
+    spec = (spec or "").strip()
+    if not spec:
+        return Caps(grants)
+    for part in spec.split(","):
+        toks = part.strip().split()
+        if not toks:
+            continue
+        if toks[0] != "allow":
+            raise CapsError("grant must start with 'allow': %r" % part)
+        if len(toks) < 2:
+            raise CapsError("empty grant: %r" % part)
+        if toks[1] == "command":
+            cmd = part.strip()[len("allow command"):].strip()
+            if not (cmd.startswith('"') and cmd.endswith('"')
+                    and len(cmd) >= 2):
+                raise CapsError("command grant needs a quoted "
+                                "prefix: %r" % part)
+            grants.append(CapGrant(frozenset(), command=cmd[1:-1]))
+            continue
+        if toks[1] == "*":
+            perms = frozenset("rwx")
+        else:
+            if not set(toks[1]) <= set("rwx"):
+                raise CapsError("bad perms %r" % toks[1])
+            perms = frozenset(toks[1])
+        pool = None
+        for extra in toks[2:]:
+            if extra.startswith("pool="):
+                pool = extra[len("pool="):]
+            else:
+                raise CapsError("unknown grant qualifier %r" % extra)
+        grants.append(CapGrant(perms, pool=pool))
+    return Caps(grants)
+
+
+class Caps:
+    """A parsed grant list (OSDCap / MonCap role)."""
+
+    def __init__(self, grants: list[CapGrant]):
+        self.grants = grants
+
+    def is_capable(self, need: str, pool: str | None = None) -> bool:
+        """True when the union of matching grants covers every perm in
+        `need` (OSDCap::is_capable semantics: grants accumulate)."""
+        needed = set(need)
+        for g in self.grants:
+            if g.command is not None:
+                continue
+            if g.pool is not None and g.pool != pool:
+                continue
+            needed -= g.perms
+            if not needed:
+                return True
+        return not needed
+
+    def is_command_capable(self, prefix: str,
+                           need: str = "") -> bool:
+        """Mon command admission: an exact command grant matches, or
+        the r/w/x perms cover the command's class."""
+        for g in self.grants:
+            if g.command is not None and prefix == g.command:
+                return True
+        return self.is_capable(need) if need else False
+
+    def allows_anything(self) -> bool:
+        return any(g.perms or g.command is not None
+                   for g in self.grants)
+
+    def __repr__(self):
+        return ", ".join(repr(g) for g in self.grants) or "(none)"

@@ -154,6 +154,10 @@ class CephxServer:
             "session_key": session_key,
             "expires": now_t + self.ticket_ttl,
             "service": service,
+            # key version at issue: daemons compare against the
+            # authmap revocation watermark so a rekey/caps change
+            # invalidates live tickets before their TTL
+            "key_version": self.keyring.get_version(entity),
         }))
         return {"service": service,
                 "ticket": ticket,
@@ -260,4 +264,5 @@ class CephxServiceHandler:
         reply = hmac.new(ticket["session_key"], b"authorizer-reply" + nonce,
                          hashlib.sha256).digest()
         return {"entity": ticket["entity"], "caps": ticket["caps"],
+                "key_version": ticket.get("key_version", 1),
                 "session_key": ticket["session_key"], "reply_proof": reply}

@@ -24,18 +24,39 @@ class KeyRing:
     def __init__(self):
         self._keys: dict[str, str] = {}      # entity -> base64 secret
         self._caps: dict[str, dict] = {}     # entity -> {service: capspec}
+        # entity -> key version, bumped on rekey/caps change so issued
+        # tickets (which embed the version) can be revoked by version
+        # watermark (the AuthMonitor rotation mechanism)
+        self._versions: dict[str, int] = {}
 
     def add(self, entity: str, secret: str | None = None,
             caps: dict | None = None) -> str:
         secret = secret or generate_secret()
+        bump = entity in self._keys and self._keys[entity] != secret
         self._keys[entity] = secret
         if caps:
             self._caps[entity] = dict(caps)
+        if bump:
+            self.bump_version(entity)
+        else:
+            self._versions.setdefault(entity, 1)
         return secret
+
+    def set_caps(self, entity: str, caps: dict) -> None:
+        self._caps[entity] = dict(caps)
+        self.bump_version(entity)
+
+    def get_version(self, entity: str) -> int:
+        return self._versions.get(entity, 1)
+
+    def bump_version(self, entity: str) -> int:
+        self._versions[entity] = self._versions.get(entity, 1) + 1
+        return self._versions[entity]
 
     def remove(self, entity: str) -> None:
         self._keys.pop(entity, None)
         self._caps.pop(entity, None)
+        self._versions.pop(entity, None)
 
     def get(self, entity: str) -> str | None:
         return self._keys.get(entity)

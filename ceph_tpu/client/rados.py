@@ -42,8 +42,24 @@ class RadosClient(Dispatcher):
         self.ctx = ctx if ctx is not None else Context(
             name="client.%d" % client_id)
         self.client_id = client_id
+        # cephx: the authorizer factory closes over the session that
+        # connect(entity=..., secret=...) establishes; on auth-less
+        # clusters it stays None and the banner carries no authorizer
+        self.cephx = None
+
+        def _factory(challenge=None):
+            if self.cephx is None:
+                return None
+            return self.cephx.build_authorizer("osd", challenge)
+
+        def _key_fn():
+            return self.cephx.tickets["osd"]["session_key"] \
+                if self.cephx else None
+
         self.msgr = create_messenger(("client", client_id),
-                                     conf=self.ctx.conf)
+                                     conf=self.ctx.conf,
+                                     authorizer_factory=_factory,
+                                     session_key_fn=_key_fn)
         self.msgr.start()
         self.msgr.add_dispatcher_head(self)
         self.mon_client = MonClient(monmap, self.msgr,
@@ -61,7 +77,18 @@ class RadosClient(Dispatcher):
 
     # -- lifecycle -----------------------------------------------------
 
-    def connect(self, timeout: float = 10.0) -> None:
+    def connect(self, timeout: float = 10.0, entity: str | None = None,
+                secret: str | None = None) -> None:
+        if entity is not None:
+            # cephx first: the ticket arms the authorizer factory for
+            # every subsequent OSD dial, and registers this session's
+            # mon caps for the command path.  On an auth-less cluster
+            # the handshake returns a ticket-less client ("auth none")
+            # — leave cephx unarmed or every OSD dial would fail
+            # minting an authorizer it cannot build.
+            c = self.mon_client.authenticate(
+                entity, secret, service="osd", timeout=timeout)
+            self.cephx = c if c.tickets else None
         self.mon_client.sub_want()
         self.mon_client.wait_for_map(1, timeout)
 

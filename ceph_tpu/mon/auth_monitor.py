@@ -1,0 +1,224 @@
+"""Auth database state machine service.
+
+Role of the reference's AuthMonitor (/root/reference/src/mon/
+AuthMonitor.{h,cc}): the paxos service owning the cluster auth
+database — entity keys + caps, mutated through `ceph auth ...`
+commands, replicated to every monitor through paxos so any quorum
+member can serve the key server.
+
+Beyond key CRUD, this service owns REVOCATION: every entity carries a
+key version; tickets embed the version they were issued under
+(cephx.py); `auth rekey` / `auth caps` / `auth del` bump the entity's
+revocation watermark, and the watermark table (the "authmap") is
+pushed to subscribed daemons, which reject older tickets on their op
+paths.  The reference reaches the same end through rotating service
+secrets + ticket TTL; an explicit watermark makes revocation immediate
+rather than TTL-bounded.
+
+Commands (AuthMonitor::prepare_command):
+  auth add            {entity, caps?, key?}    EEXIST if present
+  auth get-or-create  {entity, caps?}          idempotent create
+  auth get            {entity}                 key + caps
+  auth print-key      {entity}                 just the key
+  auth list                                    whole database
+  auth caps           {entity, caps}           replace caps (revokes)
+  auth rekey          {entity}                 new key (revokes)
+  auth del            {entity}                 remove (revokes)
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .. import encoding
+from ..auth.caps import CapsError, parse_caps
+from ..auth.keyring import KeyRing, generate_secret
+
+__all__ = ["AuthMonitor"]
+
+
+class AuthMonitor:
+    def __init__(self, mon, keyring: KeyRing | None = None):
+        self.mon = mon
+        # the LIVE keyring the CephxServer issues tickets from; seeded
+        # from the bootstrap keyring (mon --keyring), then mutated only
+        # by committed paxos events so every mon converges
+        self.keyring = keyring if keyring is not None else KeyRing()
+        self.version = 0                # auth db version
+        # entity -> minimum acceptable ticket key_version
+        self.revoked: dict[str, int] = {}
+        self.pending: list[dict] | None = None   # event list
+        self._lock = threading.RLock()
+
+    # -- pending / paxos ----------------------------------------------
+
+    def _pend(self) -> list:
+        if self.pending is None:
+            self.pending = []
+        return self.pending
+
+    def have_pending(self) -> bool:
+        return bool(self.pending)
+
+    def encode_pending(self) -> bytes:
+        events, self.pending = self.pending, None
+        return encoding.encode_any(("authmap", {
+            "version": self.version + 1, "events": events}))
+
+    def apply_committed(self, payload: dict) -> None:
+        with self._lock:
+            if payload["version"] != self.version + 1:
+                return                 # stale replay
+            for ev in payload["events"]:
+                self._apply_event(ev)
+            self.version = payload["version"]
+        self.mon.publish_authmap()
+
+    def _apply_event(self, ev: dict) -> None:
+        op = ev["op"]
+        entity = ev.get("entity")
+        if op == "add":
+            self.keyring.add(entity, ev["key"], ev.get("caps"))
+            # a deleted-then-re-added entity must not inherit the old
+            # revocation: its version restarts at 1, so continue the
+            # version sequence ABOVE the watermark (old tickets stay
+            # dead, new ones clear the floor)
+            floor = self.revoked.get(entity)
+            if floor is not None and \
+                    self.keyring.get_version(entity) < floor:
+                self.keyring._versions[entity] = floor
+        elif op == "caps":
+            self.keyring.set_caps(entity, ev["caps"])
+            self.revoked[entity] = self.keyring.get_version(entity)
+        elif op == "rekey":
+            self.keyring.add(entity, ev["key"])
+            self.revoked[entity] = self.keyring.get_version(entity)
+        elif op == "del":
+            self.revoked[entity] = self.keyring.get_version(entity) + 1
+            self.keyring.remove(entity)
+
+    # -- state sync (Monitor::sync participation) ----------------------
+
+    def full_state(self) -> dict:
+        with self._lock:
+            return {"version": self.version,
+                    "keyring": self.keyring.emit(),
+                    "versions": {e: self.keyring.get_version(e)
+                                 for e in self.keyring.entities()},
+                    "revoked": dict(self.revoked)}
+
+    def set_full_state(self, state: dict) -> None:
+        with self._lock:
+            if state["version"] <= self.version:
+                return
+            self.keyring = KeyRing.parse(state["keyring"])
+            for e, v in state.get("versions", {}).items():
+                self.keyring._versions[e] = v
+            self.revoked = dict(state.get("revoked", {}))
+            self.version = state["version"]
+            self.pending = None
+            if self.mon.key_server is not None:
+                self.mon.key_server.keyring = self.keyring
+
+    def authmap(self) -> dict:
+        with self._lock:
+            return {"version": self.version,
+                    "revoked": dict(self.revoked)}
+
+    # -- commands ------------------------------------------------------
+
+    def _parse_caps_arg(self, caps: dict | None):
+        """Validate every cap string up front (bad grammar must fail
+        the command, not the enforcement path later)."""
+        caps = dict(caps or {})
+        for svc, spec in caps.items():
+            parse_caps(spec)
+        return caps
+
+    def _pending_add(self, entity: str) -> dict | None:
+        """An 'add' event proposed but not yet committed — commands
+        racing the paxos round must see it (EEXIST / idempotent
+        get-or-create), not double-create."""
+        for ev in self.pending or []:
+            if ev["op"] == "add" and ev["entity"] == entity:
+                return ev
+        return None
+
+    def handle_command(self, cmd: dict):
+        import errno
+        prefix = cmd.get("prefix", "")
+        entity = cmd.get("entity")
+        with self._lock:
+            try:
+                if prefix == "auth add":
+                    if self.keyring.get(entity) is not None or \
+                            self._pending_add(entity) is not None:
+                        return -errno.EEXIST, "entity %s exists" \
+                            % entity, None
+                    key = cmd.get("key") or generate_secret()
+                    self._pend().append({
+                        "op": "add", "entity": entity, "key": key,
+                        "caps": self._parse_caps_arg(cmd.get("caps"))})
+                    self.mon.propose_soon()
+                    return 0, "added key for %s" % entity, {"key": key}
+                if prefix == "auth get-or-create":
+                    existing = self.keyring.get(entity)
+                    if existing is not None:
+                        return 0, "", {
+                            "key": existing,
+                            "caps": self.keyring.get_caps(entity)}
+                    pend = self._pending_add(entity)
+                    if pend is not None:
+                        return 0, "", {"key": pend["key"],
+                                       "caps": dict(pend.get("caps")
+                                                    or {})}
+                    key = generate_secret()
+                    self._pend().append({
+                        "op": "add", "entity": entity, "key": key,
+                        "caps": self._parse_caps_arg(cmd.get("caps"))})
+                    self.mon.propose_soon()
+                    return 0, "", {"key": key,
+                                   "caps": dict(cmd.get("caps") or {})}
+                if prefix in ("auth get", "auth print-key"):
+                    key = self.keyring.get(entity)
+                    if key is None:
+                        return -errno.ENOENT, "no key for %s" \
+                            % entity, None
+                    if prefix == "auth print-key":
+                        return 0, key, None
+                    return 0, "", {"key": key,
+                                   "caps": self.keyring.get_caps(entity),
+                                   "version":
+                                       self.keyring.get_version(entity)}
+                if prefix == "auth list":
+                    return 0, self.keyring.emit(), {
+                        e: {"caps": self.keyring.get_caps(e)}
+                        for e in self.keyring.entities()}
+                if prefix == "auth caps":
+                    if self.keyring.get(entity) is None:
+                        return -errno.ENOENT, "no key for %s" \
+                            % entity, None
+                    self._pend().append({
+                        "op": "caps", "entity": entity,
+                        "caps": self._parse_caps_arg(cmd["caps"])})
+                    self.mon.propose_soon()
+                    return 0, "updated caps for %s" % entity, None
+                if prefix == "auth rekey":
+                    if self.keyring.get(entity) is None:
+                        return -errno.ENOENT, "no key for %s" \
+                            % entity, None
+                    key = generate_secret()
+                    self._pend().append({"op": "rekey",
+                                         "entity": entity, "key": key})
+                    self.mon.propose_soon()
+                    return 0, "rekeyed %s" % entity, {"key": key}
+                if prefix == "auth del":
+                    if self.keyring.get(entity) is None:
+                        return -errno.ENOENT, "no key for %s" \
+                            % entity, None
+                    self._pend().append({"op": "del", "entity": entity})
+                    self.mon.propose_soon()
+                    return 0, "deleted %s" % entity, None
+            except CapsError as e:
+                return -errno.EINVAL, str(e), None
+        return -errno.EINVAL, "unknown auth command %r" % prefix, None

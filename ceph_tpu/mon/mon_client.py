@@ -30,10 +30,12 @@ class MonClient(Dispatcher):
         self._waiters: dict = {}     # tid -> [event, reply]
         self.osdmap = None
         self.mdsmap: dict | None = None
+        self.authmap: dict | None = None   # {version, revoked}
         self.map_callbacks: list = []
         self.mdsmap_callbacks: list = []
         self._map_event = threading.Event()
         self.auth_client = None      # CephxClient after authenticate()
+        self._auth_creds = None      # (entity, secret, service) for re-auth
         # per-client nonce so the monitor's retransmit dedup never
         # matches a different client that reused our ephemeral port
         import uuid
@@ -53,6 +55,11 @@ class MonClient(Dispatcher):
             return True
         if t == "MOSDMap":
             self._handle_osdmap(msg)
+            return True
+        if t == "MAuthMap":
+            if self.authmap is None or \
+                    msg.authmap["version"] > self.authmap["version"]:
+                self.authmap = msg.authmap
             return True
         if t == "MMDSMap":
             if self.mdsmap is None or \
@@ -131,10 +138,22 @@ class MonClient(Dispatcher):
 
     def command(self, cmd: dict, timeout: float = 10.0):
         """Send a command; returns (result, outs, data). Leader
-        forwarding on the mon side handles non-leader targets."""
+        forwarding on the mon side handles non-leader targets.
+        An 'unauthenticated' EACCES after a mon failover (the session
+        table is per-mon) re-runs the cephx handshake once with the
+        stored credentials and retries (MonClient::_reopen_session)."""
         reply = self._send_and_wait(
             MMonCommand(cmd=cmd, reply_to=self.msgr.my_addr),
             timeout, "mon command %r" % cmd)
+        if reply.result == -13 and "unauthenticated" in \
+                (reply.outs or "") and self._auth_creds is not None:
+            try:
+                self.authenticate(*self._auth_creds)
+            except (PermissionError, TimeoutError):
+                return reply.result, reply.outs, reply.data
+            reply = self._send_and_wait(
+                MMonCommand(cmd=cmd, reply_to=self.msgr.my_addr),
+                timeout, "mon command %r" % cmd)
         return reply.result, reply.outs, reply.data
 
     def authenticate(self, entity: str, secret_b64: str,
@@ -142,26 +161,34 @@ class MonClient(Dispatcher):
         """cephx handshake with the monitor (MonClient::authenticate):
         challenge round, proof round, ticket install. Returns the
         CephxClient holding the session ticket; raises PermissionError
-        on a bad key."""
+        on a bad key.  The challenge is per-mon, so when _rotate_mon
+        splits the two rounds across monitors ('no challenge'), the
+        whole handshake retries once against the settled mon."""
         from ..auth import CephxClient
-        client = CephxClient(entity, secret_b64)
-        r1 = self._send_and_wait(
-            MAuth(entity=entity, service=service,
-                  reply_to=self.msgr.my_addr), timeout, "auth round")
-        if r1.result != 0:
-            raise PermissionError(r1.outs)
-        if not r1.challenge and r1.ticket is None:
-            self.auth_client = client   # auth none cluster
-            return client
-        r2 = self._send_and_wait(
-            MAuth(entity=entity, service=service,
-                  proof=client.build_proof(r1.challenge),
-                  reply_to=self.msgr.my_addr), timeout, "auth round")
-        if r2.result != 0 or r2.ticket is None:
+        self._auth_creds = (entity, secret_b64, service)
+        for attempt in (0, 1):
+            client = CephxClient(entity, secret_b64)
+            r1 = self._send_and_wait(
+                MAuth(entity=entity, service=service,
+                      reply_to=self.msgr.my_addr), timeout,
+                "auth round")
+            if r1.result != 0:
+                raise PermissionError(r1.outs)
+            if not r1.challenge and r1.ticket is None:
+                self.auth_client = client   # auth none cluster
+                return client
+            r2 = self._send_and_wait(
+                MAuth(entity=entity, service=service,
+                      proof=client.build_proof(r1.challenge),
+                      reply_to=self.msgr.my_addr), timeout,
+                "auth round")
+            if r2.result == 0 and r2.ticket is not None:
+                client.open_session(r2.ticket)
+                self.auth_client = client
+                return client
+            if attempt == 0 and "no challenge" in (r2.outs or ""):
+                continue                    # rounds split across mons
             raise PermissionError(r2.outs or "auth failed")
-        client.open_session(r2.ticket)
-        self.auth_client = client
-        return client
 
     def renew_subs(self, min_interval: float = 1.0) -> None:
         """Rate-limited subscription renewal at our CURRENT epoch (the

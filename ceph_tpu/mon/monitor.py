@@ -49,6 +49,16 @@ class Monitor(Dispatcher):
         self.paxos = Paxos(self, self.store)
         self.osdmon = OSDMonitor(self)
         self.mdsmon = MDSMonitor(self)
+        from .auth_monitor import AuthMonitor
+        from ..common.bounded import BoundedDict
+        self.authmon = AuthMonitor(self, keyring)
+        # session nonce -> {entity, caps(parsed), key_version}: peers
+        # that completed the cephx proof round; the MonCap enforcement
+        # table.  Keyed by the client's private session uuid, not an
+        # address — addresses are self-advertised and spoofable.
+        # Bounded like _cmd_replies: transient clients must not grow
+        # the table forever.
+        self._auth_sessions: BoundedDict = BoundedDict(1024)
         self._lock = make_rlock("mon:%d" % rank)
         self._propose_pending = False
         self._subscribers: dict = {}        # addr -> last epoch sent
@@ -58,6 +68,9 @@ class Monitor(Dispatcher):
         # cephx key server (src/auth/cephx/CephxKeyServer): present when
         # the cluster runs with auth enabled
         self.key_server = None
+        # mon-internal shared secret: attests peon->leader forwarded
+        # commands (the reference signs MForward the same way)
+        self._mon_secret = (service_secrets or {}).get("mon")
         if keyring is not None:
             from ..auth import CephxServer
             self.key_server = CephxServer(keyring, service_secrets or {})
@@ -145,11 +158,16 @@ class Monitor(Dispatcher):
         if self.osdmon.have_pending():
             value = self.osdmon.encode_pending()
             self.paxos.propose(value)
-            if self.mdsmon.have_pending():
-                self.propose_soon()   # next round carries the mdsmap
+            if self.mdsmon.have_pending() or \
+                    self.authmon.have_pending():
+                self.propose_soon()   # next round carries the rest
         elif self.mdsmon.have_pending():
             self.paxos.propose(encoding.encode_any(
                 ("mdsmap", self.mdsmon.encode_pending())))
+            if self.authmon.have_pending():
+                self.propose_soon()
+        elif self.authmon.have_pending():
+            self.paxos.propose(self.authmon.encode_pending())
 
     def _on_paxos_commit(self, version: int, value: bytes) -> None:
         service, payload = encoding.decode_any(value)
@@ -157,12 +175,16 @@ class Monitor(Dispatcher):
             self.osdmon.apply_committed(payload)
         elif service == "mdsmap":
             self.mdsmon.apply_committed(payload)
+        elif service == "authmap":
+            self.authmon.apply_committed(payload)
 
     # -- full-state sync (paxos trim recovery; Monitor::sync role) -----
 
     def get_full_state(self) -> bytes:
         return encoding.encode_any({"osdmap": self.osdmon.osdmap,
-                                    "mdsmap": self.mdsmon.mdsmap})
+                                    "mdsmap": self.mdsmon.mdsmap,
+                                    "authmap":
+                                        self.authmon.full_state()})
 
     def set_full_state(self, blob: bytes) -> bool:
         try:
@@ -177,6 +199,8 @@ class Monitor(Dispatcher):
                 with self.mdsmon._lock:
                     self.mdsmon.mdsmap = mdsmap
                     self.mdsmon.pending = None
+            if state.get("authmap"):
+                self.authmon.set_full_state(state["authmap"])
         else:
             newmap = state              # legacy bare-osdmap blob
         if not hasattr(newmap, "epoch"):
@@ -206,6 +230,14 @@ class Monitor(Dispatcher):
         m = self.mdsmon.mdsmap
         for addr in subs:
             self.msgr.send_message(MMDSMap(mdsmap=dict(m)), addr)
+
+    def publish_authmap(self) -> None:
+        from ..msg.message import MAuthMap
+        with self._lock:
+            subs = list(self._subscribers)
+        am = self.authmon.authmap()
+        for addr in subs:
+            self.msgr.send_message(MAuthMap(authmap=am), addr)
 
     # -- dispatch ------------------------------------------------------
 
@@ -239,6 +271,18 @@ class Monitor(Dispatcher):
                                  msg.start_epoch)
             return True
         if t == "MMonCommand":
+            # MonCap check at the mon the client authenticated with
+            # (the session table is local); the leader skips only for
+            # commands a quorum member attested with the mon secret
+            denied = self._check_mon_caps(msg)
+            if denied is not None:
+                self.msgr.send_message(
+                    MMonCommandReply(tid=msg.tid, result=denied[0],
+                                     outs=denied[1]),
+                    msg.reply_to or msg.from_addr)
+                return True
+            if self.key_server is not None and not self.is_leader():
+                msg.mon_proof = self._attest(msg)
             if self._forward_if_peon(msg):
                 return True
             dest = msg.reply_to or msg.from_addr
@@ -251,8 +295,12 @@ class Monitor(Dispatcher):
                 # dedup retransmits by (requester, tid) and replay the
                 # original reply instead of re-executing
                 prefix = msg.cmd.get("prefix", "")
-                svc = (self.mdsmon if prefix.startswith(("mds ", "fs "))
-                       else self.osdmon)
+                if prefix.startswith("auth "):
+                    svc = self.authmon
+                elif prefix.startswith(("mds ", "fs ")):
+                    svc = self.mdsmon
+                else:
+                    svc = self.osdmon
                 result, outs, data = svc.handle_command(msg.cmd)
                 cached = MMonCommandReply(tid=msg.tid, result=result,
                                           outs=outs, data=data)
@@ -267,6 +315,65 @@ class Monitor(Dispatcher):
             self._handle_auth(msg)
             return True
         return False
+
+    # mon command classes: what the MonCap check demands.  Reads need
+    # "r"; auth-database commands need "x" (sensitive, like the
+    # reference's mon profiles); everything else mutates cluster state
+    # and needs "w".
+    _READONLY_PREFIXES = frozenset((
+        "osd dump", "osd getmap", "mds stat", "osd status", "status",
+        "osd erasure-code-profile ls", "osd erasure-code-profile get"))
+
+    def _attest(self, msg) -> bytes:
+        """HMAC the (session, tid, prefix) triple with the mon shared
+        secret: the leader's proof that a quorum member already ran
+        the MonCap check on this command."""
+        import hashlib
+        import hmac as _hmac
+        if self._mon_secret is None:
+            return b""
+        body = ("%s|%d|%s" % (msg.session, msg.tid,
+                              msg.cmd.get("prefix", ""))).encode()
+        return _hmac.new(self._mon_secret, body,
+                         hashlib.sha256).digest()
+
+    def _check_mon_caps(self, msg):
+        """MonCap enforcement (src/mon/MonCap.cc is_capable): None =
+        allowed; otherwise the (EACCES, reason, None) reply triple.
+        Enforcement only arms on auth-enabled clusters (key_server).
+        Identity comes from the client's private session nonce —
+        recorded at cephx proof time — never from addresses."""
+        if self.key_server is None:
+            return None
+        import errno as _errno
+        import hmac as _hmac
+        prefix = msg.cmd.get("prefix", "")
+        if getattr(msg, "mon_proof", b"") and self._mon_secret \
+                is not None and _hmac.compare_digest(
+                    msg.mon_proof, self._attest(msg)):
+            return None               # peon-attested: already checked
+        sess = self._auth_sessions.get(msg.session or None)
+        if sess is None:
+            return (-_errno.EACCES, "access denied: unauthenticated",
+                    None)
+        # a rekey/caps change/del revokes the live session immediately
+        floor = self.authmon.revoked.get(sess["entity"], 0)
+        if sess["key_version"] < floor:
+            self._auth_sessions.pop(msg.session, None)
+            return (-_errno.EACCES,
+                    "access denied: key revoked for %s"
+                    % sess["entity"], None)
+        if prefix.startswith("auth "):
+            need = "x"
+        elif prefix in self._READONLY_PREFIXES:
+            need = "r"
+        else:
+            need = "w"
+        if not sess["caps"].is_command_capable(prefix, need):
+            return (-_errno.EACCES,
+                    "access denied: mon caps %r do not cover %r (%s)"
+                    % (sess.get("caps_spec", ""), prefix, need), None)
+        return None
 
     def _handle_auth(self, msg) -> None:
         """cephx two-round handshake (doc/dev/cephx_protocol.rst):
@@ -299,6 +406,21 @@ class Monitor(Dispatcher):
                 ticket = self.key_server.handle_request(
                     msg.entity, msg.proof, service=msg.service)
                 cached = MAuthReply(tid=msg.tid, result=0, ticket=ticket)
+                # the proof round authenticates this peer's SESSION:
+                # record entity + parsed mon caps + key version for
+                # the MMonCommand cap checks
+                from ..auth.caps import parse_caps
+                kr = self.authmon.keyring
+                spec = kr.get_caps(msg.entity).get("mon", "")
+                try:
+                    parsed = parse_caps(spec)
+                except Exception:
+                    parsed = parse_caps("")
+                with self._lock:
+                    self._auth_sessions[msg.session or None] = {
+                        "entity": msg.entity,
+                        "caps": parsed, "caps_spec": spec,
+                        "key_version": kr.get_version(msg.entity)}
             except AuthError as e:
                 cached = MAuthReply(tid=msg.tid, result=-_errno.EACCES,
                                     outs=str(e))
@@ -329,3 +451,7 @@ class Monitor(Dispatcher):
         if self.mdsmon.mdsmap["epoch"] > 0:
             self.msgr.send_message(
                 MMDSMap(mdsmap=dict(self.mdsmon.mdsmap)), addr)
+        if self.authmon.version > 0:
+            from ..msg.message import MAuthMap
+            self.msgr.send_message(
+                MAuthMap(authmap=self.authmon.authmap()), addr)

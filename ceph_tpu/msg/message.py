@@ -31,6 +31,7 @@ __all__ = [
     "MMonCommand", "MMonCommandReply", "MMonSubscribe", "MMonPaxos",
     "MMonElection", "MAuth", "MAuthReply", "MMgrReport",
     "MMDSBeacon", "MMDSMap", "MClientRequest", "MClientReply",
+    "MAuthMap",
 ]
 
 _seq = itertools.count(1)
@@ -324,6 +325,11 @@ class MMonCommand(Message):
     cmd: dict = field(default_factory=dict)
     reply_to: object = None
     session: str = ""       # per-client nonce: dedup key survives port reuse
+    # peon->leader forward attestation: HMAC(mon shared secret,
+    # session|tid|prefix).  The leader skips its own MonCap check only
+    # for commands a quorum member vouches for — self-advertised
+    # addresses are spoofable, this is not.
+    mon_proof: bytes = b""
 
 
 @dataclass
@@ -413,6 +419,16 @@ class MAuthReply(Message):
     challenge: bytes = b""
     ticket: object = None       # CephxServer.handle_request reply dict
     outs: str = ""
+
+
+@dataclass
+class MAuthMap(Message):
+    """Auth revocation-watermark push to subscribers: {version,
+    revoked: {entity: min acceptable ticket key_version}}.  Daemons
+    reject tickets below the watermark, making `auth rekey/caps/del`
+    revoke live sessions immediately (the reference bounds this by
+    service-key rotation + ticket TTL instead)."""
+    authmap: dict = field(default_factory=dict)
 
 
 # -- mon internal ------------------------------------------------------

@@ -637,8 +637,16 @@ class Connection:
             # session key (cephx mutual auth). The proof bytes are
             # peer-controlled: a confirm that chokes on them is a
             # failed confirmation, not a dead reader thread.
+            # A proof-LESS ack (msg[1] is None) means the acceptor
+            # runs without a verifier — e.g. the monitor, whose auth
+            # is the in-band MAuth protocol, not the banner. The
+            # connection then proceeds unauthenticated and unsigned
+            # (opportunistic, letting one messenger serve both the
+            # authless mon and cephx-guarded OSDs; the reference
+            # negotiates auth per service type the same way).
+            authless_acceptor = msg[1] is None
             confirm = self.msgr.auth_confirm
-            if confirm is not None:
+            if confirm is not None and not authless_acceptor:
                 try:
                     ok = confirm(self._sent_authorizer, msg[1])
                 except Exception:
@@ -668,7 +676,7 @@ class Connection:
             # arm per-message signing: the dialer's copy of the session
             # key comes from its ticket (session_key_fn hook)
             fn = self.msgr.session_key_fn
-            if fn is not None:
+            if fn is not None and not authless_acceptor:
                 try:
                     self.session_key = fn()
                 except Exception:
@@ -706,6 +714,10 @@ class Connection:
                                  if s > msg[1]]
             return True
         msg.from_addr = self.peer_addr
+        # verified cephx identity of this connection (entity, caps,
+        # key_version) rides to dispatchers so daemons enforce caps
+        # per op; never encoded (receive-side annotation only)
+        msg.auth_info = self.auth_info
         seq = link_seq or None
         msg.link_seq = seq
         if seq is not None and self._dedup_key is not None:

@@ -35,7 +35,8 @@ __all__ = ["OSDDaemon"]
 
 class OSDDaemon(Dispatcher):
     def __init__(self, whoami: int, monmap: dict,
-                 ctx: Context | None = None, store=None):
+                 ctx: Context | None = None, store=None,
+                 auth: dict | None = None):
         self.whoami = whoami
         self.ctx = ctx or Context(name="osd.%d" % whoami)
         conf = self.ctx.conf
@@ -46,8 +47,36 @@ class OSDDaemon(Dispatcher):
         # creator's finisher died with the old daemon, and callbacks
         # queued there black-hole (no commit acks => wedged writes)
         self.store._finisher = self.finisher
-        self.public_msgr = create_messenger(("osd", whoami), conf=conf)
-        self.cluster_msgr = create_messenger(("osd", whoami), conf=conf)
+        # cephx: when the cluster runs with auth, client + peer
+        # connections must present "osd"-service authorizers (the
+        # heartbeat messenger stays open, documented: heartbeats carry
+        # no data).  The authorizer factory closes over the cephx
+        # session established during init's in-band mon handshake.
+        self.auth = auth
+        self._cephx = None             # CephxClient after authenticate
+        verifier = None
+        factory = None
+        key_fn = None
+        if auth is not None:
+            from ..auth import CephxServiceHandler
+            verifier = CephxServiceHandler(
+                "osd", auth["service_secrets"]["osd"])
+
+            def factory(challenge=None):
+                if self._cephx is None:
+                    return None
+                return self._cephx.build_authorizer("osd", challenge)
+
+            def key_fn():
+                return self._cephx.tickets["osd"]["session_key"] \
+                    if self._cephx else None
+
+        self.public_msgr = create_messenger(
+            ("osd", whoami), conf=conf, auth_verifier=verifier,
+            authorizer_factory=factory, session_key_fn=key_fn)
+        self.cluster_msgr = create_messenger(
+            ("osd", whoami), conf=conf, auth_verifier=verifier,
+            authorizer_factory=factory, session_key_fn=key_fn)
         self.hb_msgr = create_messenger(("osd", whoami), conf=conf)
         self.monmap = dict(monmap)
         self.mon_client = MonClient(monmap, self.public_msgr,
@@ -124,6 +153,12 @@ class OSDDaemon(Dispatcher):
         self.timer.init()
         self._running = True
         self.mon_client.map_callbacks.append(self._on_osdmap)
+        if self.auth is not None:
+            # in-band cephx with the mon BEFORE any cluster dial: peer
+            # OSDs demand an authorizer minted from this ticket
+            self._cephx = self.mon_client.authenticate(
+                "osd.%d" % self.whoami, self.auth["secret"],
+                service="osd")
         self.mon_client.sub_want()
         self._boot()
         self._hb_tick()
@@ -417,7 +452,58 @@ class OSDDaemon(Dispatcher):
         "remove", "setxattr", "rmxattr", "omap_set", "omap_rm",
         "rollback", "call"))
 
+    def _check_op_caps(self, msg) -> str | None:
+        """OSDCap enforcement (src/osd/OSDCap.cc is_capable, called
+        from PrimaryLogPG::do_op's cap check): the connection's
+        verified ticket caps must cover the op's rwx needs on the
+        target pool, and the ticket's key version must clear the
+        authmap revocation watermark.  Returns a denial reason, or
+        None when allowed (always None on auth-less clusters)."""
+        if self.auth is None or msg.pgid is None:
+            return None               # pgid-less op: EAGAIN path below
+        info = getattr(msg, "auth_info", None)
+        if not info:
+            return "unauthenticated connection"
+        authmap = self.mon_client.authmap or {}
+        floor = authmap.get("revoked", {}).get(info["entity"], 0)
+        if info.get("key_version", 1) < floor:
+            return "key revoked for %s" % info["entity"]
+        caps = info.get("_parsed_caps")
+        if caps is None:
+            from ..auth.caps import parse_caps
+            try:
+                caps = parse_caps(info.get("caps") or "")
+            except Exception:
+                return "malformed caps"
+            info["_parsed_caps"] = caps   # per-connection cache
+        pgid = self._normalize_pgid(msg.pgid)
+        pool = self.osdmap.pools.get(pgid.pool)
+        pool_name = pool.name if pool is not None else None
+        need = set()
+        for op in msg.ops:
+            if not op:
+                continue
+            if op[0] == "call":
+                need.add("x")
+            elif op[0] in self.WRITE_OP_KINDS:
+                need.add("w")
+            else:
+                need.add("r")
+        if not caps.is_capable("".join(sorted(need)), pool_name):
+            return "caps %r do not cover %s on pool %r" % (
+                info.get("caps", ""), "".join(sorted(need)), pool_name)
+        return None
+
     def _enqueue_client_op(self, msg) -> None:
+        denial = self._check_op_caps(msg)
+        if denial is not None:
+            import errno as _errno
+            self.public_msgr.send_message(
+                MOSDOpReply(tid=msg.tid, result=-_errno.EACCES,
+                            data=denial.encode(),
+                            map_epoch=self.map_epoch()),
+                msg.from_addr)
+            return
         pg = self._get_pg(msg.pgid and self._normalize_pgid(msg.pgid))
         client_addr = msg.from_addr
         # retransmit dedup for non-idempotent ops (the client resends

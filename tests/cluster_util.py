@@ -49,8 +49,29 @@ def wait_until(fn, timeout=10.0, interval=0.02):
 
 
 class MiniCluster:
-    def __init__(self, num_mons=1, num_osds=3, conf_overrides=None):
+    def __init__(self, num_mons=1, num_osds=3, conf_overrides=None,
+                 auth=False):
         self.conf_overrides = dict(conf_overrides or {})
+        # cephx mode (vstart's CEPH_AUTH analog): a bootstrap keyring
+        # with client.admin + per-osd keys, one shared osd service
+        # secret; monitors get the keyring, osds + clients authorize
+        self.auth = auth
+        self.keyring = None
+        self.service_secrets = None
+        self.admin_secret = None
+        if auth:
+            import os as _os
+            from ceph_tpu.auth.keyring import KeyRing
+            self.keyring = KeyRing()
+            self.admin_secret = self.keyring.add(
+                "client.admin",
+                caps={"mon": "allow *", "osd": "allow *"})
+            for osd_id in range(num_osds):
+                self.keyring.add("osd.%d" % osd_id,
+                                 caps={"mon": "allow *",
+                                       "osd": "allow *"})
+            self.service_secrets = {"osd": _os.urandom(32),
+                                    "mon": _os.urandom(32)}
         # CEPH_TPU_MS_TYPE=async runs every cluster in the suite on the
         # event-loop transport (a second full-suite configuration for
         # the AsyncMessenger; explicit per-test ms_type still wins)
@@ -69,9 +90,17 @@ class MiniCluster:
 
     def start(self):
         for rank in self.monmap:
+            kwargs = {}
+            if self.auth:
+                from ceph_tpu.auth.keyring import KeyRing
+                # each mon gets its OWN keyring copy (paxos keeps
+                # them converged, like independent mon stores)
+                kr = KeyRing.parse(self.keyring.emit())
+                kwargs = {"keyring": kr,
+                          "service_secrets": self.service_secrets}
             mon = Monitor(rank, self.monmap,
                           Context(self.conf_overrides,
-                                  name="mon.%d" % rank))
+                                  name="mon.%d" % rank), **kwargs)
             mon.init()
             self.mons.append(mon)
         assert wait_until(
@@ -84,9 +113,14 @@ class MiniCluster:
         return self
 
     def start_osd(self, osd_id: int, store=None) -> OSDDaemon:
+        auth = None
+        if self.auth:
+            auth = {"secret": self.keyring.get("osd.%d" % osd_id),
+                    "service_secrets": self.service_secrets}
         osd = OSDDaemon(osd_id, self.monmap,
                         Context(self.conf_overrides,
-                                name="osd.%d" % osd_id), store=store)
+                                name="osd.%d" % osd_id), store=store,
+                        auth=auth)
         osd.init()
         self.osds[osd_id] = osd
         return osd
@@ -134,13 +168,16 @@ class MiniCluster:
     def osdmap_epoch(self) -> int:
         return self.leader().osdmon.osdmap.epoch
 
-    def client(self) -> RadosClient:
+    def client(self, entity: str | None = None,
+               secret: str | None = None) -> RadosClient:
         client = RadosClient(self.monmap,
                              Context(self.conf_overrides,
                                      name="client.%d"
                                      % len(self.clients)),
                              client_id=len(self.clients))
-        client.connect()
+        if self.auth and entity is None:
+            entity, secret = "client.admin", self.admin_secret
+        client.connect(entity=entity, secret=secret)
         self.clients.append(client)
         return client
 
