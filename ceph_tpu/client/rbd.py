@@ -10,6 +10,13 @@ the striping degenerate case stripe_count=1, like rbd's default layout.
 Sparse blocks read as zeros; discard removes whole blocks and
 zero-fills partials.
 
+Feature bits (librbd features): `journaling` (event journal +
+mirroring), `exclusive-lock` (single active writer arbitrated by
+cls_lock + watch/notify — ManagedLock/ExclusiveLock role, including
+the break-lock steal of a dead owner), and `object-map` (per-block
+state map maintained under the lock; `du` and fast-diff answer from
+the map without touching data objects — ObjectMap.cc role).
+
 Snapshots ride RADOS self-managed snaps (librbd's model): snap_create
 allocates a snap id from the monitor and image writes carry the
 image's own SnapContext, so block objects COW into clones; snap reads
@@ -38,6 +45,8 @@ __all__ = ["RBD", "Image", "ImageNotFound", "ImageExists"]
 
 DIR_OID = "rbd_directory"
 DEFAULT_ORDER = 22          # 4 MiB objects (rbd_default_order)
+KNOWN_FEATURES = frozenset(("journaling", "exclusive-lock",
+                            "object-map"))
 
 
 class ImageNotFound(Exception):
@@ -75,6 +84,205 @@ def _journal_id(name: str) -> str:
     return "rbd.%s" % name
 
 
+def _object_map_oid(name: str, snap_id: int | None = None) -> str:
+    base = "rbd_object_map.%s" % name
+    return base if snap_id is None else "%s.%d" % (base, snap_id)
+
+
+# object-map block states (src/librbd/ObjectMap.cc / cls_rbd object
+# map): EXISTS means "written since the last snapshot" (dirty), which
+# is what makes fast-diff a map scan instead of an object scan
+OBJECT_NONEXISTENT = 0
+OBJECT_EXISTS = 1
+OBJECT_EXISTS_CLEAN = 3
+
+
+class ExclusiveLock:
+    """Write-lock arbitration on the header object
+    (src/librbd/ManagedLock.cc + src/librbd/exclusive_lock/): an
+    advisory cls_lock held by the active writer, cooperative handoff
+    via watch/notify ("request_lock" asks the owner to release), and a
+    STEAL of an owner that no longer answers notifies — the analog of
+    ManagedLock.cc:810's break_lock path (the reference also
+    blacklists the dead client; here its lock cookie is broken, and
+    any zombie writes it might still send are unprotected exactly like
+    the reference before blacklisting landed)."""
+
+    LOCK_NAME = "rbd_lock"
+
+    def __init__(self, image: "Image"):
+        import uuid
+        self.img = image
+        self.cookie = "rbd-lock-%s" % uuid.uuid4().hex[:12]
+        self.owned = False
+
+    def _hdr(self) -> str:
+        return _header_oid(self.img.name)
+
+    def try_acquire(self) -> bool:
+        try:
+            self.img.ioctx.exec(
+                self._hdr(), "lock", "lock", encoding.encode_any({
+                    "name": self.LOCK_NAME, "cookie": self.cookie,
+                    "type": "exclusive", "duration": 0}))
+        except OSError as e:
+            if e.errno == _errno.EBUSY:
+                return False
+            raise
+        self.owned = True
+        self.img._on_lock_acquired()
+        return True
+
+    def acquire(self, timeout: float = 15.0) -> None:
+        import time
+        deadline = time.monotonic() + timeout
+        while True:
+            if self.try_acquire():
+                return
+            # ask the owner (watching the header) to hand over
+            res = self.img.ioctx.notify(
+                self._hdr(), encoding.encode_any({
+                    "type": "request_lock", "cookie": self.cookie}),
+                timeout=2.0)
+            owner_answered = any(
+                reply == b"released"
+                for reply in res.get("replies", {}).values())
+            if self.try_acquire():
+                return
+            if not owner_answered:
+                # no watcher claimed the lock: the owner is dead —
+                # break its cookie and take over
+                info = encoding.decode_any(self.img.ioctx.exec(
+                    self._hdr(), "lock", "get_info",
+                    encoding.encode_any({"name": self.LOCK_NAME})))
+                for cookie in list(info.get("lockers", {})):
+                    try:
+                        self.img.ioctx.exec(
+                            self._hdr(), "lock", "break_lock",
+                            encoding.encode_any({
+                                "name": self.LOCK_NAME,
+                                "cookie": cookie}))
+                    except OSError as e:
+                        if e.errno != _errno.ENOENT:
+                            raise
+                if self.try_acquire():
+                    return
+            if time.monotonic() >= deadline:
+                raise OSError(_errno.EBUSY,
+                              "could not acquire exclusive lock on %s"
+                              % self.img.name)
+            time.sleep(0.05)
+
+    def release(self) -> None:
+        if not self.owned:
+            return
+        self.owned = False
+        try:
+            self.img.ioctx.exec(
+                self._hdr(), "lock", "unlock", encoding.encode_any({
+                    "name": self.LOCK_NAME, "cookie": self.cookie}))
+        except OSError as e:
+            if e.errno != _errno.ENOENT:
+                raise                  # already broken/stolen: fine
+
+
+class ObjectMap:
+    """Per-block existence bitmap (src/librbd/ObjectMap.cc +
+    cls_rbd's object map): maintained under the exclusive lock, one
+    state byte per data block.  `du` and fast-diff read the map —
+    O(blocks) in memory — instead of stat-ing every data object."""
+
+    def __init__(self, image: "Image"):
+        self.img = image
+        self.states = None             # np.ndarray uint8
+
+    def _nblocks(self) -> int:
+        return -(-self.img.size() // self.img.block_size)
+
+    def load(self) -> None:
+        import numpy as np
+        n = self._nblocks()
+        try:
+            raw = self.img.ioctx.read(_object_map_oid(self.img.name))
+            arr = np.frombuffer(raw, dtype=np.uint8).copy()
+        except OSError as e:
+            if not _enoent(e):
+                raise
+            arr = np.zeros(0, dtype=np.uint8)
+        if arr.size < n:
+            arr = np.concatenate(
+                [arr, np.zeros(n - arr.size, dtype=np.uint8)])
+        self.states = arr[:n].copy()
+
+    def save(self) -> None:
+        self.img.ioctx.write_full(_object_map_oid(self.img.name),
+                                  self.states.tobytes())
+
+    def mark_exists(self, blocks) -> None:
+        dirty = False
+        for blk in blocks:
+            if blk < self.states.size and \
+                    self.states[blk] != OBJECT_EXISTS:
+                self.states[blk] = OBJECT_EXISTS
+                dirty = True
+        if dirty:
+            self.save()
+
+    def mark_absent(self, blocks) -> None:
+        dirty = False
+        for blk in blocks:
+            if blk < self.states.size and \
+                    self.states[blk] != OBJECT_NONEXISTENT:
+                self.states[blk] = OBJECT_NONEXISTENT
+                dirty = True
+        if dirty:
+            self.save()
+
+    def resize(self, new_nblocks: int) -> None:
+        import numpy as np
+        if new_nblocks < self.states.size:
+            self.states = self.states[:new_nblocks].copy()
+        elif new_nblocks > self.states.size:
+            self.states = np.concatenate(
+                [self.states,
+                 np.zeros(new_nblocks - self.states.size,
+                          dtype=np.uint8)])
+        self.save()
+
+    def snapshot(self, snap_id: int) -> None:
+        """snap_create: freeze a copy under the snap id, then demote
+        every EXISTS block to EXISTS_CLEAN — fast-diff's 'unchanged
+        since this snapshot' marker."""
+        self.img.ioctx.write_full(
+            _object_map_oid(self.img.name, snap_id),
+            self.states.tobytes())
+        self.states[self.states == OBJECT_EXISTS] = OBJECT_EXISTS_CLEAN
+        self.save()
+
+    def load_snap(self, snap_id: int):
+        import numpy as np
+        try:
+            raw = self.img.ioctx.read(
+                _object_map_oid(self.img.name, snap_id))
+            return np.frombuffer(raw, dtype=np.uint8).copy()
+        except OSError as e:
+            if _enoent(e):
+                return np.zeros(0, dtype=np.uint8)
+            raise
+
+    def used_bytes(self) -> int:
+        import numpy as np
+        size = self.img.size()
+        bs = self.img.block_size
+        present = self.states != OBJECT_NONEXISTENT
+        total = int(np.count_nonzero(present)) * bs
+        # the tail block may be partial
+        last = self.states.size - 1
+        if last >= 0 and present[last] and size - last * bs < bs:
+            total -= bs - (size - last * bs)
+        return total
+
+
 class RBD:
     """Pool-level image operations (librbd.h rbd_create/list/remove)."""
 
@@ -84,6 +292,14 @@ class RBD:
                features: tuple = ()) -> None:
         if name in RBD.list(ioctx):
             raise ImageExists(name)
+        unknown = set(features) - KNOWN_FEATURES
+        if unknown:
+            raise ValueError("unknown image feature(s): %s (known: %s)"
+                             % (sorted(unknown),
+                                sorted(KNOWN_FEATURES)))
+        if "object-map" in features and "exclusive-lock" not in features:
+            raise ValueError("object-map requires exclusive-lock "
+                             "(librbd feature dependency)")
         if "journaling" in features:
             # the journal exists BEFORE the header advertises it: a
             # crash in between leaves an orphan journal, never a
@@ -154,16 +370,45 @@ class RBD:
                 j.remove()
             except Exception:
                 pass              # a half-created journal is no blocker
+        if "object-map" in img.meta.get("features", []):
+            for snap in img.meta["snaps"].values():
+                try:
+                    ioctx.remove(_object_map_oid(name, snap["id"]))
+                except OSError as e:
+                    if not _enoent(e):
+                        raise
+            try:
+                ioctx.remove(_object_map_oid(name))
+            except OSError as e:
+                if not _enoent(e):
+                    raise
+        img.close()
         ioctx.remove(_header_oid(name))
         # targeted key removal: a read-modify-write of the whole
         # directory would erase concurrently created images
         ioctx.omap_rm_keys(DIR_OID, [name])
 
 
+def _serialized(fn):
+    """Mutating image ops hold the per-handle op lock; the
+    cooperative-handoff release takes the same lock, so the exclusive
+    lock can never be yanked out from under an op already past
+    _ensure_lock (exclusive_lock's pre-release op quiesce)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._op_lock:
+            return fn(self, *args, **kwargs)
+    return wrapper
+
+
 class Image:
     """One open image (librbd Image): offset-addressed block IO."""
 
     def __init__(self, ioctx, name: str, read_only: bool = False):
+        import threading
+        self._op_lock = threading.RLock()
         self.ioctx = ioctx
         self.name = name
         self.read_only = read_only
@@ -185,6 +430,36 @@ class Image:
         # crash-recovery half of librbd::Journal::open)
         self._journal = None
         self._replaying = False
+        # exclusive-lock + object-map features (librbd feature bits):
+        # the lock arbitrates the single active writer via cls_lock +
+        # watch/notify; the object map is maintained under it
+        features = self.meta.get("features", [])
+        self._lock = None
+        self._omap = None
+        self._watch_cookie = None
+        self._map_cb = None
+        if not read_only and "exclusive-lock" in features:
+            self._lock = ExclusiveLock(self)
+            self._watch_cookie = ioctx.watch(_header_oid(name),
+                                             self._header_notify)
+            # a PG primary change drops the watch server-side; without
+            # re-watching, a live owner goes notify-deaf and a
+            # contender's steal path breaks its lock (split brain).
+            # Re-assert the watch on every map change (the linger
+            # resend; rados.py documents it as the client's burden).
+            def _rewatch(_newmap):
+                if self._watch_cookie is None:
+                    return
+                try:
+                    self.ioctx._op(_header_oid(self.name),
+                                   [("watch", self._watch_cookie)])
+                except Exception:
+                    pass               # next map change retries
+            self._map_cb = _rewatch
+            ioctx.client.mon_client.map_callbacks.append(_rewatch)
+        if "object-map" in features:
+            self._omap = ObjectMap(self)
+            self._omap.load()
         if not read_only \
                 and "journaling" in self.meta.get("features", []):
             # read_only opens (mirror daemons, inspectors) must NOT
@@ -201,6 +476,141 @@ class Image:
                 self._journal.create()
                 self._journal.register_client("")
             self._replay_pending()
+
+    # -- exclusive lock / object map ----------------------------------
+
+    def _header_notify(self, notify_id, payload):
+        """Header watch callback: a contender's request_lock triggers
+        the cooperative handoff (exclusive_lock's
+        handle_request_lock) — release after in-flight ops (ops here
+        are synchronous, so immediately) and answer 'released'."""
+        try:
+            ev = encoding.decode_any(payload) if payload else {}
+        except encoding.DecodeError:
+            return None
+        if ev.get("type") == "request_lock" and self._lock is not None \
+                and self._lock.owned:
+            # the callback runs on the messenger reader thread: a
+            # synchronous unlock op here would deadlock waiting for
+            # its own reply.  Hand off to a thread — which waits for
+            # any in-flight op (op lock) before releasing — and
+            # answer now; the requester retries until the unlock
+            # lands.
+            import threading
+
+            def _handoff():
+                with self._op_lock:
+                    self._lock.release()
+
+            threading.Thread(target=_handoff, daemon=True).start()
+            return b"released"
+        return None
+
+    def _on_lock_acquired(self) -> None:
+        """A fresh owner must see the PREVIOUS owner's world: re-read
+        the header (size/snaps may have moved) and the object map."""
+        try:
+            hdr = self.ioctx.read(_header_oid(self.name))
+            self._size, self.order, self.meta = _unpack_header(hdr)
+        except OSError:
+            pass
+        if self._omap is not None:
+            self._omap.load()
+
+    def _ensure_lock(self) -> None:
+        if self.read_only:
+            # every mutating path runs through here: a read-only
+            # handle must never write data OR clobber the owner's
+            # object map with its stale copy
+            raise OSError(_errno.EROFS, self.name)
+        if self._lock is not None and not self._lock.owned:
+            self._lock.acquire()
+
+    def lock_owned(self) -> bool:
+        return self._lock is not None and self._lock.owned
+
+    def close(self) -> None:
+        if self._map_cb is not None:
+            try:
+                self.ioctx.client.mon_client.map_callbacks.remove(
+                    self._map_cb)
+            except ValueError:
+                pass
+            self._map_cb = None
+        if self._watch_cookie is not None:
+            try:
+                self.ioctx.unwatch(_header_oid(self.name),
+                                   self._watch_cookie)
+            except OSError:
+                pass
+            self._watch_cookie = None
+        if self._lock is not None:
+            self._lock.release()
+
+    def _omap_blocks(self, offset: int, length: int):
+        first = offset // self.block_size
+        last = (offset + length - 1) // self.block_size
+        return range(first, last + 1)
+
+    def du(self) -> int:
+        """Provisioned bytes actually stored (rbd du).  With an
+        object map this is a pure map scan — no object stats."""
+        if self._omap is not None:
+            return self._omap.used_bytes()
+        total = 0
+        nblocks = -(-self._size // self.block_size)
+        for blk in range(nblocks):
+            try:
+                self.ioctx.stat(_data_oid(self.name, blk))
+            except OSError as e:
+                if not _enoent(e):
+                    raise
+                continue
+            total += min(self.block_size,
+                         self._size - blk * self.block_size)
+        return total
+
+    def fast_diff(self, from_snap: str | None = None) -> list:
+        """Changed extents since from_snap (None = image creation),
+        computed from object maps alone (librbd fast-diff /
+        diff_iterate whole_object=true): returns
+        [(offset, length, exists_now)] per changed block."""
+        if self._omap is None:
+            raise OSError(_errno.EOPNOTSUPP,
+                          "fast-diff needs the object-map feature")
+        import numpy as np
+        cur = self._omap.states
+        if from_snap is None:
+            base = np.zeros(cur.size, dtype=np.uint8)
+            later_maps = []
+        else:
+            snap = self.meta["snaps"].get(from_snap)
+            if snap is None:
+                raise ImageNotFound("%s@%s" % (self.name, from_snap))
+            base = self._omap.load_snap(snap["id"])
+            # dirty bits in every snapshot AFTER from_snap also mark
+            # changes (a block can be rewritten then frozen clean by a
+            # later snap_create)
+            later_maps = [self._omap.load_snap(s["id"])
+                          for s in self.meta["snaps"].values()
+                          if s["id"] > snap["id"]]
+        bs = self.block_size
+
+        def fit(arr):
+            padded = np.zeros(cur.size, dtype=np.uint8)
+            m = min(cur.size, arr.size)
+            padded[:m] = arr[:m]
+            return padded
+
+        base = fit(base)
+        changed = cur == OBJECT_EXISTS        # dirty since last snap
+        for m in later_maps:
+            changed |= fit(m) == OBJECT_EXISTS
+        changed |= (base == OBJECT_NONEXISTENT) != \
+            (cur == OBJECT_NONEXISTENT)
+        return [(int(blk) * bs, min(bs, self._size - int(blk) * bs),
+                 bool(cur[blk] != OBJECT_NONEXISTENT))
+                for blk in np.nonzero(changed)[0]]
 
     # -- journaling (librbd journal/Types.h EventEntry) ----------------
 
@@ -282,15 +692,19 @@ class Image:
         seq, ids = self._image_snapc()
         self.ioctx.set_snap_context(seq, ids)
 
+    @_serialized
     def snap_create(self, snap_name: str) -> int:
         if snap_name in self.meta["snaps"]:
             raise ImageExists("%s@%s" % (self.name, snap_name))
+        self._ensure_lock()
         jtid = self._journal_event({"type": "snap_create",
                                     "name": snap_name})
         snap_id = self.ioctx.selfmanaged_snap_create()
         self.meta["snaps"][snap_name] = {"id": snap_id,
                                          "size": self._size}
         self._save_header()
+        if self._omap is not None:
+            self._omap.snapshot(snap_id)
         self._journal_commit(jtid)
         return snap_id
 
@@ -300,21 +714,32 @@ class Image:
              for n, s in self.meta["snaps"].items()),
             key=lambda s: s["id"])
 
+    @_serialized
     def snap_remove(self, snap_name: str) -> None:
         if snap_name not in self.meta["snaps"]:
             raise ImageNotFound("%s@%s" % (self.name, snap_name))
+        self._ensure_lock()
         jtid = self._journal_event({"type": "snap_remove",
                                     "name": snap_name})
         snap = self.meta["snaps"].pop(snap_name)
         self._save_header()
         # retire the id: OSDs trim the block clones it pinned
         self.ioctx.selfmanaged_snap_remove(snap["id"])
+        if self._omap is not None:
+            try:
+                self.ioctx.remove(_object_map_oid(self.name,
+                                                  snap["id"]))
+            except OSError as e:
+                if not _enoent(e):
+                    raise
         self._journal_commit(jtid)
 
+    @_serialized
     def snap_rollback(self, snap_name: str) -> None:
         snap = self.meta["snaps"].get(snap_name)
         if snap is None:
             raise ImageNotFound("%s@%s" % (self.name, snap_name))
+        self._ensure_lock()
         jtid = self._journal_event({"type": "snap_rollback",
                                     "name": snap_name})
         snap_id, snap_size = snap["id"], snap["size"]
@@ -343,6 +768,19 @@ class Image:
         if self._size != snap_size:
             self._size = snap_size
             self._save_header()
+        if self._omap is not None:
+            # the image content just became the snap's content: adopt
+            # the snap's map, with every present block dirty (it
+            # changed relative to whatever was there before)
+            import numpy as np
+            snapm = self._omap.load_snap(snap_id)
+            n = -(-self._size // self.block_size)
+            arr = np.zeros(n, dtype=np.uint8)
+            m = min(n, snapm.size)
+            arr[:m] = snapm[:m]
+            arr[arr == OBJECT_EXISTS_CLEAN] = OBJECT_EXISTS
+            self._omap.states = arr
+            self._omap.save()
         self._journal_commit(jtid)
 
     # -- layering (clone reads / copy-up / flatten) --------------------
@@ -369,11 +807,15 @@ class Image:
         data = self._parent_block(blk)
         if data:
             self.ioctx.write(_data_oid(self.name, blk), data, 0)
+            if self._omap is not None:
+                self._omap.mark_exists([blk])
 
+    @_serialized
     def flatten(self) -> None:
         """Copy every still-inherited block; drop the parent link."""
         if self.meta.get("parent") is None:
             return
+        self._ensure_lock()
         self._apply_snapc()
         nblocks = -(-self._size // self.block_size)
         for blk in range(nblocks):
@@ -387,6 +829,8 @@ class Image:
             data = self._parent_block(blk)
             if data:
                 self.ioctx.write(oid, data, 0)
+                if self._omap is not None:
+                    self._omap.mark_exists([blk])
         self.meta["parent"] = None
         self._save_header()
 
@@ -395,8 +839,18 @@ class Image:
             raise ValueError("extent %d~%d outside image size %d"
                              % (offset, length, self._size))
 
+    @_serialized
     def write(self, offset: int, data: bytes) -> int:
         self._check_extent(offset, len(data))
+        self._ensure_lock()
+        if self._omap is not None:
+            # object map goes EXISTS before the data write lands
+            # (ObjectMap's pre-update ordering: a map that lies
+            # "absent" about a written block corrupts fast-diff; one
+            # that lies "exists" about an absent block only costs a
+            # stat)
+            self._omap.mark_exists(self._omap_blocks(offset,
+                                                     len(data)))
         jtid = self._journal_event({"type": "write", "offset": offset,
                                     "data": bytes(data)})
         self._apply_snapc()
@@ -438,11 +892,13 @@ class Image:
             out[foff - offset:foff - offset + len(piece)] = piece
         return bytes(out)
 
+    @_serialized
     def discard(self, offset: int, length: int) -> None:
         """Free whole blocks; zero partial block edges (rbd_discard).
         On a clone, discarded blocks are MASKED with zeros rather than
         removed, or the parent's bytes would resurface."""
         self._check_extent(offset, length)
+        self._ensure_lock()
         jtid = self._journal_event({"type": "discard", "offset": offset,
                                     "length": length})
         self._apply_snapc()
@@ -455,7 +911,11 @@ class Image:
                 except OSError as e:
                     if not _enoent(e):
                         raise
+                if self._omap is not None:
+                    self._omap.mark_absent([blk])
             else:
+                if self._omap is not None:
+                    self._omap.mark_exists([blk])
                 if parented and (blk_off != 0 or n != self.block_size):
                     try:
                         self.ioctx.stat(oid)
@@ -466,7 +926,9 @@ class Image:
                 self.ioctx.write(oid, b"\0" * n, blk_off)
         self._journal_commit(jtid)
 
+    @_serialized
     def resize(self, new_size: int) -> None:
+        self._ensure_lock()
         jtid = self._journal_event({"type": "resize",
                                     "size": new_size})
         self._apply_snapc()
@@ -505,4 +967,6 @@ class Image:
                     oid, b"\0" * (self.block_size - tail_off), tail_off)
         self._size = new_size
         self._save_header()
+        if self._omap is not None:
+            self._omap.resize(-(-new_size // self.block_size))
         self._journal_commit(jtid)

@@ -47,12 +47,19 @@ def main(argv=None) -> int:
     p.add_argument("--order", type=int, default=22)
     p.add_argument("--journaling", action="store_true",
                    help="enable the journaling feature (mirrorable)")
+    p.add_argument("--features", default=None,
+                   help="comma list: exclusive-lock,object-map,"
+                        "journaling")
     p.add_argument("words", nargs="+",
                    help="create NAME | ls | info NAME | rm NAME | "
                         "resize NAME | export NAME FILE | "
                         "import FILE NAME | snap create/ls/rm/"
                         "rollback NAME@SNAP | clone SRC@SNAP DST | "
-                        "flatten NAME | mirror pool status")
+                        "flatten NAME | du NAME | "
+                        "diff NAME [--from-snap S] | "
+                        "mirror pool status")
+    p.add_argument("--from-snap", default=None,
+                   help="diff: starting snapshot")
     args = p.parse_args(argv)
     client = connect(args)
     try:
@@ -62,10 +69,26 @@ def main(argv=None) -> int:
             if args.size is None:
                 sys.stderr.write("rbd: create needs --size\n")
                 return 1
+            features = []
+            if args.journaling:
+                features.append("journaling")
+            if args.features:
+                features.extend(f.strip()
+                                for f in args.features.split(",")
+                                if f.strip())
             RBD.create(io, w[1], _size_arg(args.size),
-                       order=args.order,
-                       features=("journaling",) if args.journaling
-                       else ())
+                       order=args.order, features=tuple(features))
+            return 0
+        if w[0] == "du" and len(w) == 2:
+            img = Image(io, w[1], read_only=True)
+            used = img.du()
+            sys.stdout.write("%s\t%d\t%d\n" % (w[1], img.size(), used))
+            return 0
+        if w[0] == "diff" and len(w) == 2:
+            img = Image(io, w[1], read_only=True)
+            for off, length, exists in img.fast_diff(args.from_snap):
+                sys.stdout.write("%d\t%d\t%s\n" % (
+                    off, length, "data" if exists else "zero"))
             return 0
         if w == ["ls"]:
             for name in RBD.list(io):
@@ -86,7 +109,11 @@ def main(argv=None) -> int:
             if args.size is None:
                 sys.stderr.write("rbd: resize needs --size\n")
                 return 1
-            Image(io, w[1]).resize(_size_arg(args.size))
+            img = Image(io, w[1])
+            try:
+                img.resize(_size_arg(args.size))
+            finally:
+                img.close()      # drop the exclusive lock + watch
             return 0
         if w[0] == "export" and len(w) == 3:
             img = Image(io, w[1], read_only=True)
@@ -99,20 +126,29 @@ def main(argv=None) -> int:
         if w[0] == "import" and len(w) == 3:
             import os
             size = os.stat(w[1]).st_size
+            features = []
+            if args.journaling:
+                features.append("journaling")
+            if args.features:
+                features.extend(f.strip()
+                                for f in args.features.split(",")
+                                if f.strip())
             RBD.create(io, w[2], size, order=args.order,
-                       features=("journaling",) if args.journaling
-                       else ())
+                       features=tuple(features))
             img = Image(io, w[2])
-            step = img.block_size
-            with open(w[1], "rb") as f:   # stream block-sized chunks
-                off = 0
-                while True:
-                    chunk = f.read(step)
-                    if not chunk:
-                        break
-                    if chunk.strip(b"\0"):
-                        img.write(off, chunk)
-                    off += len(chunk)
+            try:
+                step = img.block_size
+                with open(w[1], "rb") as f:  # stream block-size chunks
+                    off = 0
+                    while True:
+                        chunk = f.read(step)
+                        if not chunk:
+                            break
+                        if chunk.strip(b"\0"):
+                            img.write(off, chunk)
+                        off += len(chunk)
+            finally:
+                img.close()
             return 0
         if w[0] == "snap" and len(w) == 3:
             sub, spec = w[1], w[2]
@@ -127,15 +163,18 @@ def main(argv=None) -> int:
                 return 1
             name, snap = spec.split("@", 1)
             img = Image(io, name)
-            if sub == "create":
-                img.snap_create(snap)
-            elif sub == "rm":
-                img.snap_remove(snap)
-            elif sub == "rollback":
-                img.snap_rollback(snap)
-            else:
-                sys.stderr.write("rbd: unknown snap op %r\n" % sub)
-                return 1
+            try:
+                if sub == "create":
+                    img.snap_create(snap)
+                elif sub == "rm":
+                    img.snap_remove(snap)
+                elif sub == "rollback":
+                    img.snap_rollback(snap)
+                else:
+                    sys.stderr.write("rbd: unknown snap op %r\n" % sub)
+                    return 1
+            finally:
+                img.close()
             return 0
         if w[0] == "clone" and len(w) == 3:
             src, dst = w[1], w[2]
@@ -146,7 +185,11 @@ def main(argv=None) -> int:
             RBD.clone(io, parent, snap, dst)
             return 0
         if w[0] == "flatten" and len(w) == 2:
-            Image(io, w[1]).flatten()
+            img = Image(io, w[1])
+            try:
+                img.flatten()
+            finally:
+                img.close()
             return 0
         if w == ["mirror", "pool", "status"]:
             # journal-derived status: per journaled image, the master

@@ -1,0 +1,216 @@
+"""RBD exclusive lock + object map + fast-diff.
+
+Mirrors the reference's librbd feature-bit QA
+(src/test/librbd/test_ObjectMap.cc, exclusive-lock contention suites,
+rbd du/diff workunits): two-writer contention with cooperative
+handoff, steal from a dead owner, object-map-backed du without object
+scans, and fast-diff across snapshots.
+"""
+
+from __future__ import annotations
+
+import errno
+
+import pytest
+
+from ceph_tpu.client.rbd import (Image, OBJECT_EXISTS,
+                                 OBJECT_EXISTS_CLEAN, RBD,
+                                 _object_map_oid)
+
+from .cluster_util import MiniCluster, wait_until
+
+FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+        "mon_osd_down_out_interval": 1.0, "paxos_propose_interval": 0.02}
+
+MiB = 1 << 20
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    cluster = MiniCluster(num_mons=1, num_osds=3,
+                          conf_overrides=FAST).start()
+    client = cluster.client()
+    cluster.create_replicated_pool(client, "rbdlock", size=2, pg_num=8)
+    ioctx = client.open_ioctx("rbdlock")
+    yield cluster, ioctx
+    cluster.stop()
+
+
+FEATURES = ("exclusive-lock", "object-map")
+
+
+class TestExclusiveLock:
+    def test_object_map_requires_lock(self, ctx):
+        _, io = ctx
+        with pytest.raises(ValueError):
+            RBD.create(io, "badfeat", 4 * MiB,
+                       features=("object-map",))
+
+    def test_two_writers_cooperative_handoff(self, ctx):
+        """librbd's contention model: the second writer's first write
+        asks the owner to release (watch/notify request_lock); the
+        owner hands over and the lock migrates."""
+        cluster, io = ctx
+        RBD.create(io, "contend", 8 * MiB, order=20, features=FEATURES)
+        a = Image(io, "contend")
+        a.write(0, b"A" * 4096)            # A acquires lazily
+        assert a.lock_owned()
+        # second handle from a SECOND client session
+        client2 = cluster.client()
+        io2 = client2.open_ioctx("rbdlock")
+        b = Image(io2, "contend")
+        assert not b.lock_owned()
+        b.write(4096, b"B" * 4096)         # triggers handoff
+        assert b.lock_owned()
+        assert wait_until(lambda: not a.lock_owned(), timeout=5), \
+            "old owner still thinks it holds the lock"
+        # and back: A writes again, lock migrates home
+        a.write(8192, b"C" * 4096)
+        assert a.lock_owned()
+        assert wait_until(lambda: not b.lock_owned(), timeout=5)
+        # both writers' data landed
+        assert a.read(0, 12288) == \
+            b"A" * 4096 + b"B" * 4096 + b"C" * 4096
+        a.close()
+        b.close()
+
+    def test_steal_from_dead_owner(self, ctx):
+        """ManagedLock.cc:810: an owner whose watch is gone (client
+        died without unlocking) answers no notify — the contender
+        breaks its lock and takes over."""
+        cluster, io = ctx
+        RBD.create(io, "deadlock", 4 * MiB, order=20,
+                   features=("exclusive-lock",))
+        a = Image(io, "deadlock")
+        a.write(0, b"X" * 1024)
+        assert a.lock_owned()
+        # kill the owner WITHOUT release: drop its watch so notifies
+        # go unanswered (the crashed-client shape)
+        io.unwatch("rbd_header.deadlock", a._watch_cookie)
+        a._watch_cookie = None
+        a._lock.owned = False          # the handle is dead, not racing
+        client2 = cluster.client()
+        io2 = client2.open_ioctx("rbdlock")
+        b = Image(io2, "deadlock")
+        b.write(1024, b"Y" * 1024)     # steals within its timeout
+        assert b.lock_owned()
+        assert b.read(0, 2048) == b"X" * 1024 + b"Y" * 1024
+        b.close()
+
+    def test_read_does_not_take_lock(self, ctx):
+        _, io = ctx
+        RBD.create(io, "rdonly", 4 * MiB, order=20, features=FEATURES)
+        img = Image(io, "rdonly")
+        img.read(0, 4096)
+        assert not img.lock_owned()
+        img.close()
+
+
+class TestObjectMap:
+    def test_du_without_object_scan(self, ctx):
+        """rbd du answers from the map: writes mark blocks, discard
+        clears them, and the map object really holds the states."""
+        _, io = ctx
+        RBD.create(io, "duimg", 8 * MiB, order=20, features=FEATURES)
+        img = Image(io, "duimg")
+        assert img.du() == 0
+        img.write(0, b"x" * (1 * MiB))           # 1 MiB = 1 block
+        img.write(3 * MiB, b"y" * 100)           # partial block
+        assert img.du() == 2 * MiB
+        img.discard(0, 1 * MiB)                  # whole-block discard
+        assert img.du() == 1 * MiB
+        # the persisted map matches
+        import numpy as np
+        raw = np.frombuffer(io.read(_object_map_oid("duimg")),
+                            dtype=np.uint8)
+        assert raw[0] == 0 and raw[3] == OBJECT_EXISTS
+        img.close()
+
+    def test_map_survives_reopen_and_handoff(self, ctx):
+        cluster, io = ctx
+        RBD.create(io, "persist", 8 * MiB, order=20, features=FEATURES)
+        img = Image(io, "persist")
+        img.write(2 * MiB, b"z" * 100)
+        img.close()
+        client2 = cluster.client()
+        io2 = client2.open_ioctx("rbdlock")
+        img2 = Image(io2, "persist")
+        assert img2.du() == 1 * MiB              # loaded, not recomputed
+        img2.close()
+
+    def test_fast_diff(self, ctx):
+        """diff from a snapshot is a pure map computation: changed
+        blocks since the snap, including clean-freezing at later
+        snaps and discards showing as exists=False."""
+        _, io = ctx
+        RBD.create(io, "diffimg", 8 * MiB, order=20, features=FEATURES)
+        img = Image(io, "diffimg")
+        img.write(0, b"a" * (1 * MiB))
+        img.write(2 * MiB, b"b" * (1 * MiB))
+        img.snap_create("s1")
+        # after the snap, existing blocks are frozen CLEAN
+        assert all(s in (0, OBJECT_EXISTS_CLEAN)
+                   for s in img._omap.states)
+        img.write(2 * MiB, b"B" * (1 * MiB))     # rewrite block 2
+        img.write(5 * MiB, b"c" * (1 * MiB))     # new block 5
+        img.discard(0, 1 * MiB)                  # drop block 0
+        diff = img.fast_diff("s1")
+        by_block = {off // MiB: exists for off, _ln, exists in diff}
+        assert by_block == {0: False, 2: True, 5: True}
+        # full-history diff (from image creation)
+        diff0 = {off // MiB for off, _ln, ex in img.fast_diff() if ex}
+        assert diff0 == {2, 5}
+        # a second snapshot freezes again; diff from s1 still sees the
+        # middle rewrite (dirty bit preserved in s2's frozen map)
+        img.snap_create("s2")
+        img.write(7 * MiB, b"d" * 100)
+        diff = img.fast_diff("s1")
+        blocks = {off // MiB for off, _ln, _ex in diff}
+        assert {2, 5, 7} <= blocks
+        img.close()
+
+    def test_fast_diff_needs_feature(self, ctx):
+        _, io = ctx
+        RBD.create(io, "nofeat", 4 * MiB, order=20)
+        img = Image(io, "nofeat")
+        with pytest.raises(OSError) as ei:
+            img.fast_diff()
+        assert ei.value.errno == errno.EOPNOTSUPP
+
+    def test_cli_du_and_diff(self, ctx, tmp_path, capsys):
+        cluster, io = ctx
+        from ceph_tpu.tools import rbd_cli
+        monmap_file = tmp_path / "monmap"
+        monmap_file.write_text("".join(
+            "%d %s:%d\n" % (r, a[0], a[1])
+            for r, a in cluster.monmap.items()))
+        base = ["--monmap", str(monmap_file), "-p", "rbdlock"]
+        assert rbd_cli.main(base + ["--size", "4M", "--order", "20",
+                                    "--features",
+                                    "exclusive-lock,object-map",
+                                    "create", "cliomap"]) == 0
+        img = Image(io, "cliomap")
+        img.write(0, b"q" * MiB)
+        img.snap_create("s")
+        img.write(1 * MiB, b"r" * 100)
+        img.close()
+        assert rbd_cli.main(base + ["du", "cliomap"]) == 0
+        out = capsys.readouterr().out
+        assert out.split("\t")[2].strip() == str(2 * MiB)
+        assert rbd_cli.main(base + ["--from-snap", "s",
+                                    "diff", "cliomap"]) == 0
+        out = capsys.readouterr().out
+        assert "%d\t%d\tdata" % (MiB, MiB) in out
+
+    def test_resize_trims_map(self, ctx):
+        _, io = ctx
+        RBD.create(io, "rsz", 8 * MiB, order=20, features=FEATURES)
+        img = Image(io, "rsz")
+        img.write(6 * MiB, b"t" * 100)
+        assert img.du() == 1 * MiB
+        img.resize(4 * MiB)
+        assert img.du() == 0                     # block 6 gone
+        img.resize(8 * MiB)
+        assert img.du() == 0                     # regrown blocks absent
+        assert img._omap.states.size == 8
+        img.close()
