@@ -1,0 +1,113 @@
+"""HBM-resident chunk tier: encode -> scrub -> reconstruct without
+re-crossing the host-device pipe.
+
+Checks the tier's contract against numpy oracles: parity matches the
+reference encode, device digests match the host digest twin, rebuilt
+shards are bit-exact, and the LRU bound holds.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ceph_tpu import registry
+from ceph_tpu.osd.hbm_tier import HbmChunkTier, host_digest
+
+K, M = 4, 2
+OBJ = 64 * 1024
+
+
+@pytest.fixture(scope="module")
+def codec():
+    return registry.factory("jax_tpu", {
+        "technique": "reed_sol_van", "k": str(K), "m": str(M),
+        "w": "8"})
+
+
+@pytest.fixture(scope="module")
+def ref_codec():
+    return registry.factory("jerasure", {
+        "technique": "reed_sol_van", "k": str(K), "m": str(M),
+        "w": "8"})
+
+
+def make_batch(codec, nobjs, seed=0):
+    n = codec.get_chunk_size(OBJ)
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=(nobjs, K, n), dtype=np.uint8)
+
+
+class TestHbmTier:
+    def test_encode_retains_and_matches_reference(self, codec,
+                                                  ref_codec):
+        tier = HbmChunkTier(codec)
+        data = make_batch(codec, 4)
+        names = ["o%d" % i for i in range(4)]
+        parity = np.asarray(tier.put_encode(names, data))
+        want = np.asarray(ref_codec.encode_batch(data))
+        assert np.array_equal(parity, want)
+        assert all(tier.resident(n) for n in names)
+        # the resident copy is the full chunk set
+        full = np.asarray(tier.get("o2"))
+        assert np.array_equal(full[:K], data[2])
+        assert np.array_equal(full[K:], want[2])
+
+    def test_deep_scrub_digests(self, codec):
+        tier = HbmChunkTier(codec)
+        data = make_batch(codec, 3, seed=1)
+        names = ["s%d" % i for i in range(3)]
+        tier.put_encode(names, data)
+        digs = tier.deep_scrub(names)
+        for i, name in enumerate(names):
+            full = np.asarray(tier.get(name))
+            assert np.array_equal(digs[name], host_digest(full)), name
+        # position sensitivity: swapping two bytes changes the digest
+        mut = np.asarray(tier.get("s0")).copy()
+        mut[0, 0], mut[0, 1] = mut[0, 1], mut[0, 0]
+        if mut[0, 0] != mut[0, 1]:
+            assert host_digest(mut)[0] != digs["s0"][0]
+
+    def test_reconstruct_lost_shards(self, codec):
+        tier = HbmChunkTier(codec)
+        data = make_batch(codec, 2, seed=2)
+        tier.put_encode(["r0", "r1"], data)
+        full = np.asarray(tier.get("r1"))
+        for lost in ((0,), (K,), (1, K + 1)):
+            rebuilt = np.asarray(tier.reconstruct("r1", lost))
+            for j, shard in enumerate(lost):
+                assert np.array_equal(rebuilt[j], full[shard]), \
+                    "shard %d mismatch" % shard
+
+    def test_reconstruct_batch_fused(self, codec):
+        """One fused program rebuilds a different lost shard per
+        object, bit-exact."""
+        tier = HbmChunkTier(codec)
+        nobjs = 6
+        data = make_batch(codec, nobjs, seed=5)
+        names = ["b%d" % i for i in range(nobjs)]
+        tier.put_encode(names, data)
+        lost = [(i * 2 + 1) % (K + M) for i in range(nobjs)]
+        rebuilt = np.asarray(tier.reconstruct_batch(names, lost))
+        for i, name in enumerate(names):
+            full = np.asarray(tier.get(name))
+            assert np.array_equal(rebuilt[i], full[lost[i]]), \
+                "object %d shard %d" % (i, lost[i])
+
+    def test_lru_eviction(self, codec):
+        tier = HbmChunkTier(codec, capacity_objects=3)
+        data = make_batch(codec, 5, seed=3)
+        tier.put_encode(["e%d" % i for i in range(5)], data)
+        assert tier.stats()["resident_objects"] == 3
+        assert not tier.resident("e0") and not tier.resident("e1")
+        assert tier.resident("e4")
+        with pytest.raises(KeyError):
+            tier.reconstruct("e0", (0,))
+
+    def test_drop(self, codec):
+        tier = HbmChunkTier(codec)
+        data = make_batch(codec, 1, seed=4)
+        tier.put_encode(["d0"], data)
+        tier.drop("d0")
+        assert not tier.resident("d0")
+        assert tier.stats()["resident_objects"] == 0
