@@ -441,6 +441,9 @@ class IoCtx:
     def omap_rm_keys(self, oid: str, keys) -> None:
         self._op(oid, [("omap_rm", list(keys))])
 
+    def omap_clear(self, oid: str) -> None:
+        self._op(oid, [("omap_clear",)])
+
     def exec(self, oid: str, cls: str, method: str,
              data: bytes = b"") -> bytes:
         """Invoke an in-OSD object-class method (rados_exec)."""

@@ -218,25 +218,29 @@ class ObjectMap:
         self.img.ioctx.write_full(_object_map_oid(self.img.name),
                                   self.states.tobytes())
 
-    def mark_exists(self, blocks) -> None:
+    def update(self, exists=(), absent=()) -> None:
+        """Batch state flip with at most ONE save: an op spanning many
+        blocks (discard, big write) must not rewrite the whole map per
+        block — that is O(blocks^2) bytes through the data pool."""
         dirty = False
-        for blk in blocks:
+        for blk in exists:
             if blk < self.states.size and \
                     self.states[blk] != OBJECT_EXISTS:
                 self.states[blk] = OBJECT_EXISTS
                 dirty = True
-        if dirty:
-            self.save()
-
-    def mark_absent(self, blocks) -> None:
-        dirty = False
-        for blk in blocks:
+        for blk in absent:
             if blk < self.states.size and \
                     self.states[blk] != OBJECT_NONEXISTENT:
                 self.states[blk] = OBJECT_NONEXISTENT
                 dirty = True
         if dirty:
             self.save()
+
+    def mark_exists(self, blocks) -> None:
+        self.update(exists=blocks)
+
+    def mark_absent(self, blocks) -> None:
+        self.update(absent=blocks)
 
     def resize(self, new_nblocks: int) -> None:
         import numpy as np
@@ -903,6 +907,11 @@ class Image:
                                     "length": length})
         self._apply_snapc()
         parented = self.meta.get("parent") is not None
+        # accumulate touched blocks and flip the object map ONCE at the
+        # end (as write() does): per-block mark+save was O(blocks^2)
+        # map bytes for a large discard
+        absent: list = []
+        exists: list = []
         for blk, blk_off, n, _ in self.layout.map_extent(offset, length):
             oid = _data_oid(self.name, blk)
             if blk_off == 0 and n == self.block_size and not parented:
@@ -911,11 +920,9 @@ class Image:
                 except OSError as e:
                     if not _enoent(e):
                         raise
-                if self._omap is not None:
-                    self._omap.mark_absent([blk])
+                absent.append(blk)
             else:
-                if self._omap is not None:
-                    self._omap.mark_exists([blk])
+                exists.append(blk)
                 if parented and (blk_off != 0 or n != self.block_size):
                     try:
                         self.ioctx.stat(oid)
@@ -924,6 +931,8 @@ class Image:
                             raise
                         self._copy_up(blk)
                 self.ioctx.write(oid, b"\0" * n, blk_off)
+        if self._omap is not None:
+            self._omap.update(exists=exists, absent=absent)
         self._journal_commit(jtid)
 
     @_serialized

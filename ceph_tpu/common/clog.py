@@ -1,0 +1,72 @@
+"""Cluster-log client channel.
+
+Role of the reference's LogClient/LogChannel (src/common/LogClient.h,
+the `clog` member every daemon logs operator-facing events through,
+e.g. ECBackend.cc:999's shard-read-error clog): a daemon-side channel
+that stamps entries and ships them to the monitor quorum as MLog
+messages.  The LogMonitor replicates them via paxos; `ceph log last`
+reads them back.
+
+Entries are fire-and-forget over the lossless messenger connections,
+broadcast to every monitor (peons forward to the leader, so a dead
+mon — even the old leader — never loses the event); (name, seq)
+dedups at the LogMonitor, so the fan-out can never duplicate a line.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from ..msg.message import MLog
+
+__all__ = ["ClogChannel", "PRIO_DEBUG", "PRIO_INFO", "PRIO_WARN",
+           "PRIO_ERROR"]
+
+PRIO_DEBUG = "DBG"
+PRIO_INFO = "INF"
+PRIO_WARN = "WRN"
+PRIO_ERROR = "ERR"
+
+
+class ClogChannel:
+    def __init__(self, msgr, monmap: dict, name: str,
+                 channel: str = "cluster"):
+        self.msgr = msgr
+        self.monmap = dict(monmap)
+        self.name = name              # "osd.3" etc.
+        self.channel = channel
+        self._seq = itertools.count(1)
+        self._lock = threading.Lock()
+        # local ring of what this daemon said (crash forensics even if
+        # the mon never saw it)
+        self.recent: list[dict] = []
+        self.RECENT_MAX = 100
+
+    def _submit(self, prio: str, message: str) -> dict:
+        entry = {"seq": next(self._seq), "stamp": time.time(),
+                 "name": self.name, "channel": self.channel,
+                 "prio": prio, "message": message}
+        with self._lock:
+            self.recent.append(entry)
+            del self.recent[:-self.RECENT_MAX]
+        msg = MLog(entries=[entry])
+        for rank in sorted(self.monmap):
+            try:
+                self.msgr.send_message(msg, self.monmap[rank])
+            except Exception:
+                pass   # the clog must never take the data path down
+        return entry
+
+    def debug(self, message: str) -> dict:
+        return self._submit(PRIO_DEBUG, message)
+
+    def info(self, message: str) -> dict:
+        return self._submit(PRIO_INFO, message)
+
+    def warn(self, message: str) -> dict:
+        return self._submit(PRIO_WARN, message)
+
+    def error(self, message: str) -> dict:
+        return self._submit(PRIO_ERROR, message)

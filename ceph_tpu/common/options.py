@@ -131,8 +131,25 @@ def _declare_defaults():
       "random extra delivery delay upper bound, seconds")
     o("objectstore_inject_read_err", bool, False, LEVEL_DEV,
       "make reads of marked objects return EIO")
+    o("objectstore_inject_eio", int, 0, LEVEL_DEV,
+      "object reads fail EIO for 1 in N objects (seeded hash "
+      "selection; store/faults.py FaultSet)")
+    o("objectstore_inject_bitrot", int, 0, LEVEL_DEV,
+      "object reads return silently flipped bytes for 1 in N objects")
+    o("objectstore_fault_seed", int, 0, LEVEL_DEV,
+      "seed for the deterministic store fault selection")
     o("osd_inject_failure_on_write", float, 0.0, LEVEL_DEV,
       "probability a sub-write is dropped before commit")
+    # scrub / repair
+    o("osd_scrub_auto_repair", bool, True, LEVEL_ADVANCED,
+      "scrub repairs inconsistencies it finds; False = detect only "
+      "(errors persist as OSD_SCRUB_ERRORS until 'pg repair'). "
+      "Default True keeps the historical always-repair behavior; the "
+      "reference defaults false and repairs only on command.")
+    # mon cluster log
+    o("mon_log_max", int, 500, LEVEL_ADVANCED,
+      "cluster log entries the LogMonitor keeps ('ceph log last' "
+      "window; mon_cluster_log_* role)")
     # filestore
     o("filestore_compression", str, "none", LEVEL_ADVANCED,
       "checkpoint blob compression: none|zlib|zstd|snappy|lz4")

@@ -148,6 +148,21 @@ class StatusModule(MgrModule):
         super().__init__(mgr)
         self.name = "status"
 
+    def _health_status(self) -> str:
+        """The mon's paxos-replicated HealthMonitor verdict — the one
+        source of truth — falling back to local module checks only
+        when the quorum is unreachable (mgr must still answer)."""
+        mon = self.mgr.mon_client
+        if mon is not None:
+            try:
+                res, _, data = mon.command({"prefix": "health"},
+                                           timeout=3.0)
+                if res == 0 and isinstance(data, dict):
+                    return data.get("status", "HEALTH_ERR")
+            except Exception:
+                pass
+        return "HEALTH_OK" if not self.get("health") else "HEALTH_WARN"
+
     def handle_command(self, cmd):
         prefix = cmd.get("prefix")
         osdmap = self.get("osd_map")
@@ -168,8 +183,7 @@ class StatusModule(MgrModule):
             return 0, "\n".join(lines), ""
         if prefix == "status":
             ups = sum(1 for o in range(osdmap.max_osd) if osdmap.is_up(o))
-            health = self.get("health")
-            state = "HEALTH_OK" if not health else "HEALTH_WARN"
+            state = self._health_status()
             return 0, (
                 "  health: %s\n  osdmap e%d: %d osds: %d up, %d in\n"
                 "  pools: %d"

@@ -1,0 +1,272 @@
+"""HealthMonitor: named, paxos-replicated cluster health checks.
+
+Role of the reference's HealthMonitor (src/mon/HealthMonitor.cc, with
+the PGMonitor-era map-derived checks folded in): the leader derives a
+map of NAMED checks and replicates it, so `ceph health` reads the same
+raised/cleared state from any quorum member, and a check raised before
+a leader failover is still raised after it — no CLI-side recomputation
+anywhere.
+
+Checks implemented (names follow the reference's health check ids):
+
+  OSD_DOWN          existing osds the osdmap marks down
+  PG_DEGRADED       PGs whose acting set is short of pool size
+                    (redundancy below target; derived mon-side from
+                    the osdmap exactly like the reference's pg state)
+  PG_UNDERSIZED     PGs whose acting set is below pool min_size
+                    (IO at risk, not just redundancy)
+  OSD_SCRUB_ERRORS  unrepaired scrub errors reported by primaries via
+                    MPGStats; REPLICATED so the count survives leader
+                    failover, cleared when a repair re-reports zero
+  POOL_FULL         a pool over its target_max_bytes/objects quota
+
+Raw pg stats stay leader-local (they churn with IO; replicating them
+would melt paxos) — only the DERIVED check map and the scrub-error
+watermarks ride proposals, and those change only on state transitions.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .. import encoding
+from ..osd.osd_map import CRUSH_ITEM_NONE, PGID
+
+__all__ = ["HealthMonitor"]
+
+SEV_RANK = {"warning": 1, "error": 2}
+
+
+class HealthMonitor:
+    def __init__(self, mon):
+        self.mon = mon
+        self.version = 0
+        self.checks: dict = {}         # name -> {severity, summary, detail}
+        self.scrub_errors: dict = {}   # str(pgid) -> unrepaired errors
+        self.pending: dict | None = None
+        self._lock = threading.RLock()
+        # leader-local raw stats (re-reported by primaries on their
+        # heartbeat cadence; a fresh leader refills within a tick)
+        self._pg_stats: dict = {}      # str(pgid) -> stats dict
+        self._stats_gen = 0
+        self._seen_epoch = -1
+        self._seen_gen = -1
+        # map-derived checks cached per osdmap epoch: the pg->osd
+        # CRUSH sweep is the expensive part and its inputs only change
+        # with the epoch, while stats reports arrive every second from
+        # every OSD — recomputing the sweep per report melted small
+        # hosts
+        self._map_checks_epoch = -1
+        self._map_checks: dict = {}
+
+    # -- pending / paxos plumbing (PaxosService contract) --------------
+
+    def have_pending(self) -> bool:
+        return self.pending is not None
+
+    def encode_pending(self) -> bytes:
+        with self._lock:
+            pend, self.pending = self.pending, None
+            return encoding.encode_any(
+                ("healthmap", {"version": self.version + 1,
+                               "checks": pend["checks"],
+                               "scrub_errors": pend["scrub_errors"]}))
+
+    def apply_committed(self, payload: dict) -> None:
+        with self._lock:
+            if payload["version"] != self.version + 1:
+                return
+            self.version = payload["version"]
+            self.checks = payload["checks"]
+            self.scrub_errors = payload["scrub_errors"]
+
+    def full_state(self) -> dict:
+        with self._lock:
+            return {"version": self.version,
+                    "checks": {k: dict(v) for k, v in
+                               self.checks.items()},
+                    "scrub_errors": dict(self.scrub_errors)}
+
+    def set_full_state(self, state: dict) -> None:
+        if not isinstance(state, dict) or "version" not in state:
+            return
+        with self._lock:
+            if state["version"] <= self.version:
+                return
+            self.version = state["version"]
+            self.checks = state.get("checks", {})
+            self.scrub_errors = state.get("scrub_errors", {})
+            self.pending = None
+
+    # -- stats intake ---------------------------------------------------
+
+    def handle_pg_stats(self, msg) -> None:
+        with self._lock:
+            for key, st in msg.pg_stats.items():
+                if isinstance(st, dict):
+                    self._pg_stats[key] = dict(st)
+            self._stats_gen += 1
+        self.recompute()
+
+    # -- derivation -----------------------------------------------------
+
+    def _effective(self) -> dict:
+        """Committed state overlaid with the staged pending proposal,
+        so consecutive recomputes in one propose window don't re-stage
+        the same transition (osdmon._effective_pools pattern)."""
+        if self.pending is not None:
+            return self.pending
+        return {"checks": self.checks, "scrub_errors": self.scrub_errors}
+
+    def tick(self) -> None:
+        """Leader: re-derive on osdmap or stats movement (called from
+        Monitor._tick; cheap no-op when nothing changed)."""
+        m = self.mon.osdmon.osdmap
+        with self._lock:
+            if m.epoch == self._seen_epoch and \
+                    self._stats_gen == self._seen_gen:
+                return
+            self._seen_epoch = m.epoch
+            self._seen_gen = self._stats_gen
+        self.recompute()
+
+    def _derive_map_checks(self, m) -> dict:
+        """Checks derivable from the osdmap alone (the CRUSH sweep)."""
+        checks: dict = {}
+        # OSD_DOWN
+        down = [o for o in range(m.max_osd)
+                if m.exists(o) and not m.is_up(o)]
+        if down:
+            checks["OSD_DOWN"] = {
+                "severity": "warning",
+                "summary": "%d osds down" % len(down),
+                "detail": ["osd.%d is down" % o for o in down]}
+        # OSD_OUT: up but weighted out (operator 'osd out' or the
+        # down->out timer) — data is rebalancing away from it
+        out = [o for o in range(m.max_osd)
+               if m.exists(o) and m.is_up(o) and not m.is_in(o)]
+        if out:
+            checks["OSD_OUT"] = {
+                "severity": "warning",
+                "summary": "%d osds out" % len(out),
+                "detail": ["osd.%d is out" % o for o in out]}
+        # PG_DEGRADED / PG_UNDERSIZED from the map's acting sets.
+        # Snapshot the pools dict: commits (apply_incremental) mutate
+        # it concurrently on the messenger thread, and iterating the
+        # live dict from the timer thread can raise mid-sweep.
+        degraded: list = []
+        undersized: list = []
+        for pool in list(m.pools.values()):
+            for ps in range(pool.pg_num):
+                pgid = PGID(pool.pool_id, ps)
+                try:
+                    _, _, acting, _ = m.pg_to_up_acting_osds(pgid)
+                except Exception:
+                    continue
+                alive = [o for o in acting if o != CRUSH_ITEM_NONE]
+                if len(alive) < pool.size:
+                    degraded.append(str(pgid))
+                if len(alive) < pool.min_size:
+                    undersized.append(str(pgid))
+        if degraded:
+            checks["PG_DEGRADED"] = {
+                "severity": "warning",
+                "summary": "%d pgs degraded" % len(degraded),
+                "detail": ["pg %s is degraded" % p
+                           for p in sorted(degraded)]}
+        if undersized:
+            checks["PG_UNDERSIZED"] = {
+                "severity": "error",
+                "summary": "%d pgs below min_size" % len(undersized),
+                "detail": ["pg %s is undersized" % p
+                           for p in sorted(undersized)]}
+        return checks
+
+    def recompute(self) -> None:
+        if not self.mon.is_leader():
+            return
+        m = self.mon.osdmon.osdmap
+        with self._lock:
+            if self._map_checks_epoch != m.epoch:
+                self._map_checks = self._derive_map_checks(m)
+                self._map_checks_epoch = m.epoch
+            checks = {k: dict(v) for k, v in self._map_checks.items()}
+        with self._lock:
+            eff = self._effective()
+            # OSD_SCRUB_ERRORS: start from the replicated watermarks,
+            # fold in fresh primary reports (a pg with no report since
+            # this leader took over KEEPS its raised state — that is
+            # the failover-survival property)
+            scrub = dict(eff["scrub_errors"])
+            for key, st in self._pg_stats.items():
+                n = int(st.get("scrub_errors", 0) or 0)
+                if n > 0:
+                    scrub[key] = n
+                else:
+                    scrub.pop(key, None)
+            total = sum(scrub.values())
+            if total:
+                checks["OSD_SCRUB_ERRORS"] = {
+                    "severity": "error",
+                    "summary": "%d scrub errors" % total,
+                    "detail": ["pg %s has %d unrepaired scrub errors"
+                               % (k, v) for k, v in sorted(scrub.items())]}
+            # POOL_FULL from aggregated primary reports; with no
+            # reports yet (fresh leader) carry the committed verdict
+            pool_bytes: dict = {}
+            pool_objs: dict = {}
+            for st in self._pg_stats.values():
+                pid = st.get("pool")
+                pool_bytes[pid] = pool_bytes.get(pid, 0) + \
+                    int(st.get("bytes", 0) or 0)
+                pool_objs[pid] = pool_objs.get(pid, 0) + \
+                    int(st.get("objects", 0) or 0)
+            full: list = []
+            for pool in list(m.pools.values()):
+                if pool.pool_id not in pool_bytes:
+                    continue
+                if (pool.target_max_bytes > 0 and
+                        pool_bytes[pool.pool_id] >=
+                        pool.target_max_bytes) or \
+                        (pool.target_max_objects > 0 and
+                         pool_objs[pool.pool_id] >=
+                         pool.target_max_objects):
+                    full.append(pool.name)
+            if full:
+                checks["POOL_FULL"] = {
+                    "severity": "warning",
+                    "summary": "%d pools at quota" % len(full),
+                    "detail": ["pool '%s' is full" % n
+                               for n in sorted(full)]}
+            elif not self._pg_stats and "POOL_FULL" in eff["checks"]:
+                checks["POOL_FULL"] = eff["checks"]["POOL_FULL"]
+            if checks == eff["checks"] and scrub == eff["scrub_errors"]:
+                return
+            self.pending = {"checks": checks, "scrub_errors": scrub}
+        self.mon.propose_soon()
+
+    # -- commands ------------------------------------------------------
+
+    @staticmethod
+    def status_of(checks: dict) -> str:
+        worst = max((SEV_RANK.get(c.get("severity"), 1)
+                     for c in checks.values()), default=0)
+        return {0: "HEALTH_OK", 1: "HEALTH_WARN",
+                2: "HEALTH_ERR"}[worst]
+
+    def handle_command(self, cmd: dict):
+        prefix = cmd.get("prefix", "")
+        if prefix in ("health", "health detail"):
+            with self._lock:
+                checks = {k: dict(v) for k, v in self.checks.items()}
+            status = self.status_of(checks)
+            lines = [status]
+            for name in sorted(checks):
+                c = checks[name]
+                lines.append("%s %s: %s" % (
+                    "[ERR]" if c.get("severity") == "error"
+                    else "[WRN]", name, c.get("summary", "")))
+                lines.extend("    %s" % d for d in c.get("detail", []))
+            return 0, "\n".join(lines), {"status": status,
+                                         "checks": checks}
+        return -22, "unknown command %r" % prefix, None

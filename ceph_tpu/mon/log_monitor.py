@@ -1,0 +1,144 @@
+"""LogMonitor: the paxos-replicated cluster log.
+
+Role of the reference's LogMonitor (src/mon/LogMonitor.cc): daemons
+submit log entries as MLog; the leader stages them in a pending batch,
+paxos replicates the batch, and every monitor keeps the same bounded
+tail — so `ceph log last` reads identical history from any quorum
+member and the log survives leader failover.
+
+Entries are dicts {seq, stamp, name, channel, prio, message}
+(common/clog.py stamps them).  (name, seq) is the dedup key: a
+daemon's retransmit, or the same MLog arriving at two mons around a
+failover, commits at most once.  The replicated watermark map
+{name: last committed seq} makes the dedup itself failover-safe.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .. import encoding
+
+__all__ = ["LogMonitor"]
+
+DEFAULT_MAX = 500
+
+
+class LogMonitor:
+    def __init__(self, mon):
+        self.mon = mon
+        self.version = 0
+        self.entries: list[dict] = []      # committed tail, oldest first
+        self.watermarks: dict = {}         # name -> last committed seq
+        self.pending: list[dict] | None = None
+        self._lock = threading.RLock()
+        try:
+            self.max_entries = int(mon.ctx.conf.get_val("mon_log_max"))
+        except Exception:
+            self.max_entries = DEFAULT_MAX
+
+    # -- pending / paxos plumbing (PaxosService contract) --------------
+
+    def have_pending(self) -> bool:
+        return bool(self.pending)
+
+    def encode_pending(self) -> bytes:
+        with self._lock:
+            pend, self.pending = self.pending, None
+            return encoding.encode_any(
+                ("logm", {"version": self.version + 1,
+                          "entries": pend or []}))
+
+    def apply_committed(self, payload: dict) -> None:
+        with self._lock:
+            if payload["version"] != self.version + 1:
+                return   # replay of an old version on a rejoining mon
+            self.version = payload["version"]
+            for entry in payload["entries"]:
+                name, seq = entry.get("name", ""), entry.get("seq", 0)
+                if seq <= self.watermarks.get(name, 0):
+                    continue
+                self.watermarks[name] = seq
+                self.entries.append(entry)
+            del self.entries[:-self.max_entries]
+
+    # -- submission (leader side) --------------------------------------
+
+    def handle_log(self, msg) -> None:
+        """Stage new entries; duplicates (vs committed watermarks AND
+        the already-staged batch) are dropped here so retransmits never
+        inflate proposals."""
+        staged = False
+        with self._lock:
+            pend = self.pending if self.pending is not None else []
+            staged_seqs = {(e.get("name", ""), e.get("seq", 0))
+                           for e in pend}
+            for entry in msg.entries:
+                if not isinstance(entry, dict):
+                    continue
+                name, seq = entry.get("name", ""), entry.get("seq", 0)
+                if seq <= self.watermarks.get(name, 0):
+                    continue
+                if (name, seq) in staged_seqs:
+                    continue
+                pend.append(dict(entry))
+                staged_seqs.add((name, seq))
+                staged = True
+            if staged:
+                self.pending = pend
+        if staged:
+            self.mon.propose_soon()
+
+    # -- full-state sync ----------------------------------------------
+
+    def full_state(self) -> dict:
+        with self._lock:
+            return {"version": self.version,
+                    "entries": [dict(e) for e in self.entries],
+                    "watermarks": dict(self.watermarks)}
+
+    def set_full_state(self, state: dict) -> None:
+        if not isinstance(state, dict) or "version" not in state:
+            return
+        with self._lock:
+            if state["version"] <= self.version:
+                return
+            self.version = state["version"]
+            self.entries = [dict(e) for e in state.get("entries", [])]
+            self.watermarks = dict(state.get("watermarks", {}))
+            self.pending = None
+
+    # -- commands ------------------------------------------------------
+
+    def handle_command(self, cmd: dict):
+        prefix = cmd.get("prefix", "")
+        if prefix == "log last":
+            try:
+                num = int(cmd.get("num") or 20)
+            except (TypeError, ValueError):
+                num = 20
+            with self._lock:
+                tail = [dict(e) for e in self.entries[-num:]]
+            outs = "\n".join(
+                "%s %s %s [%s] %s" % (
+                    e.get("stamp", 0.0), e.get("name", "?"),
+                    e.get("prio", "INF"), e.get("channel", "cluster"),
+                    e.get("message", "")) for e in tail)
+            return 0, outs, tail
+        if prefix == "log":
+            # operator-injected line ('ceph log <text>')
+            text = str(cmd.get("message", ""))
+            entry = {"seq": 0, "stamp": 0.0, "name": "mon",
+                     "channel": "cluster", "prio": "INF",
+                     "message": text}
+            import time as _time
+            with self._lock:
+                entry["seq"] = self.watermarks.get("mon", 0) + \
+                    len(self.pending or []) + 1
+                entry["stamp"] = _time.time()
+                pend = self.pending if self.pending is not None else []
+                pend.append(entry)
+                self.pending = pend
+            self.mon.propose_soon()
+            return 0, "logged", None
+        return -22, "unknown command %r" % prefix, None

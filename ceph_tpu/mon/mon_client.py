@@ -40,6 +40,15 @@ class MonClient(Dispatcher):
         # matches a different client that reused our ephemeral port
         import uuid
         self.session = uuid.uuid4().hex
+        # monitors legitimately ack banners without a cephx proof
+        # (their auth is in-band MAuth): register them so the
+        # messenger's downgrade defense doesn't cut mon connections
+        # dialed after we hold a service ticket
+        for addr in self.monmap.values():
+            try:
+                self.msgr.authless_peers.add(tuple(addr))
+            except AttributeError:
+                pass
         msgr.add_dispatcher_tail(self)
 
     # -- dispatch ------------------------------------------------------

@@ -20,6 +20,8 @@ from ..msg.message import MMDSMap, MMonCommandReply, MOSDMap
 from ..msg.async_messenger import create_messenger
 from ..msg.messenger import Dispatcher
 from ..store.kv import MemDB
+from .health_monitor import HealthMonitor
+from .log_monitor import LogMonitor
 from .mds_monitor import MDSMonitor
 from .osd_monitor import OSDMonitor
 from .paxos import Elector, Paxos
@@ -52,6 +54,18 @@ class Monitor(Dispatcher):
         from .auth_monitor import AuthMonitor
         from ..common.bounded import BoundedDict
         self.authmon = AuthMonitor(self, keyring)
+        self.healthmon = HealthMonitor(self)
+        self.logmon = LogMonitor(self)
+        # proposal order: the osdmap first (everything else derives
+        # from it), then the rest round-robin through propose_soon
+        self._paxos_services = [
+            (self.osdmon, self.osdmon.encode_pending),
+            (self.mdsmon, lambda: encoding.encode_any(
+                ("mdsmap", self.mdsmon.encode_pending()))),
+            (self.authmon, self.authmon.encode_pending),
+            (self.healthmon, self.healthmon.encode_pending),
+            (self.logmon, self.logmon.encode_pending),
+        ]
         # session nonce -> {entity, caps(parsed), key_version}: peers
         # that completed the cephx proof round; the MonCap enforcement
         # table.  Keyed by the client's private session uuid, not an
@@ -74,6 +88,15 @@ class Monitor(Dispatcher):
         if keyring is not None:
             from ..auth import CephxServer
             self.key_server = CephxServer(keyring, service_secrets or {})
+            if self._mon_secret is None and len(self.monmap) > 1:
+                # a multi-mon auth cluster without the mon shared
+                # secret would attest forwarded commands with b"" and
+                # every peon-forwarded command would silently die at
+                # the leader's cap check — refuse to boot broken
+                raise ValueError(
+                    "mon.%d: key server armed but service_secrets"
+                    "['mon'] is missing — peon-forwarded commands "
+                    "cannot be attested" % rank)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -101,6 +124,14 @@ class Monitor(Dispatcher):
         if self.is_leader():
             self.osdmon.tick()
             self.mdsmon.tick()
+            try:
+                # the health derivation reads maps that commits mutate
+                # concurrently; it must never be able to kill the tick
+                # chain (the reschedule below is the monitor's pulse)
+                self.healthmon.tick()
+            except Exception:
+                import traceback
+                traceback.print_exc()
         self.timer.add_event_after(0.25, self._tick)
 
     # -- roles ---------------------------------------------------------
@@ -155,19 +186,15 @@ class Monitor(Dispatcher):
             self._propose_pending = False
         if not self.is_leader():
             return  # peons' services forward to the leader instead
-        if self.osdmon.have_pending():
-            value = self.osdmon.encode_pending()
-            self.paxos.propose(value)
-            if self.mdsmon.have_pending() or \
-                    self.authmon.have_pending():
+        # one service's batch per paxos round, priority order; any
+        # still-pending service re-arms the pump for the next round
+        for svc, encode in self._paxos_services:
+            if not svc.have_pending():
+                continue
+            self.paxos.propose(encode())
+            if any(s.have_pending() for s, _ in self._paxos_services):
                 self.propose_soon()   # next round carries the rest
-        elif self.mdsmon.have_pending():
-            self.paxos.propose(encoding.encode_any(
-                ("mdsmap", self.mdsmon.encode_pending())))
-            if self.authmon.have_pending():
-                self.propose_soon()
-        elif self.authmon.have_pending():
-            self.paxos.propose(self.authmon.encode_pending())
+            return
 
     def _on_paxos_commit(self, version: int, value: bytes) -> None:
         service, payload = encoding.decode_any(value)
@@ -177,6 +204,10 @@ class Monitor(Dispatcher):
             self.mdsmon.apply_committed(payload)
         elif service == "authmap":
             self.authmon.apply_committed(payload)
+        elif service == "healthmap":
+            self.healthmon.apply_committed(payload)
+        elif service == "logm":
+            self.logmon.apply_committed(payload)
 
     # -- full-state sync (paxos trim recovery; Monitor::sync role) -----
 
@@ -184,7 +215,10 @@ class Monitor(Dispatcher):
         return encoding.encode_any({"osdmap": self.osdmon.osdmap,
                                     "mdsmap": self.mdsmon.mdsmap,
                                     "authmap":
-                                        self.authmon.full_state()})
+                                        self.authmon.full_state(),
+                                    "healthmap":
+                                        self.healthmon.full_state(),
+                                    "logm": self.logmon.full_state()})
 
     def set_full_state(self, blob: bytes) -> bool:
         try:
@@ -201,6 +235,10 @@ class Monitor(Dispatcher):
                     self.mdsmon.pending = None
             if state.get("authmap"):
                 self.authmon.set_full_state(state["authmap"])
+            if state.get("healthmap"):
+                self.healthmon.set_full_state(state["healthmap"])
+            if state.get("logm"):
+                self.logmon.set_full_state(state["logm"])
         else:
             newmap = state              # legacy bare-osdmap blob
         if not hasattr(newmap, "epoch"):
@@ -266,6 +304,16 @@ class Monitor(Dispatcher):
                 return True
             self.osdmon.handle_failure(msg)
             return True
+        if t == "MLog":
+            if self._forward_if_peon(msg):
+                return True
+            self.logmon.handle_log(msg)
+            return True
+        if t == "MPGStats":
+            if self._forward_if_peon(msg):
+                return True
+            self.healthmon.handle_pg_stats(msg)
+            return True
         if t == "MMonSubscribe":
             self._subscribe_addr(msg.reply_to or msg.from_addr,
                                  msg.start_epoch)
@@ -281,7 +329,11 @@ class Monitor(Dispatcher):
                                      outs=denied[1]),
                     msg.reply_to or msg.from_addr)
                 return True
-            if self.key_server is not None and not self.is_leader():
+            # attest only when the command will actually forward (the
+            # same condition _forward_if_peon uses) — a leaderless
+            # single mon handles it locally and needs no proof
+            if self.key_server is not None and not self.is_leader() \
+                    and self.leader_rank not in (None, self.rank):
                 msg.mon_proof = self._attest(msg)
             if self._forward_if_peon(msg):
                 return True
@@ -299,6 +351,10 @@ class Monitor(Dispatcher):
                     svc = self.authmon
                 elif prefix.startswith(("mds ", "fs ")):
                     svc = self.mdsmon
+                elif prefix.startswith("health"):
+                    svc = self.healthmon
+                elif prefix == "log" or prefix.startswith("log "):
+                    svc = self.logmon
                 else:
                     svc = self.osdmon
                 result, outs, data = svc.handle_command(msg.cmd)
@@ -322,16 +378,23 @@ class Monitor(Dispatcher):
     # and needs "w".
     _READONLY_PREFIXES = frozenset((
         "osd dump", "osd getmap", "mds stat", "osd status", "status",
-        "osd erasure-code-profile ls", "osd erasure-code-profile get"))
+        "osd erasure-code-profile ls", "osd erasure-code-profile get",
+        "health", "health detail", "log last"))
 
     def _attest(self, msg) -> bytes:
         """HMAC the (session, tid, prefix) triple with the mon shared
         secret: the leader's proof that a quorum member already ran
-        the MonCap check on this command."""
+        the MonCap check on this command.  A missing secret raises
+        instead of attesting with b'' — an empty proof reads as
+        "no attestation" at the leader, silently breaking every
+        peon-forwarded command (init refuses multi-mon boots without
+        the secret; this guards the remaining paths loudly)."""
         import hashlib
         import hmac as _hmac
         if self._mon_secret is None:
-            return b""
+            raise RuntimeError(
+                "mon.%d: cannot attest forwarded command: "
+                "service_secrets['mon'] was never provided" % self.rank)
         body = ("%s|%d|%s" % (msg.session, msg.tid,
                               msg.cmd.get("prefix", ""))).encode()
         return _hmac.new(self._mon_secret, body,

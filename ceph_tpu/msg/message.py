@@ -31,7 +31,7 @@ __all__ = [
     "MMonCommand", "MMonCommandReply", "MMonSubscribe", "MMonPaxos",
     "MMonElection", "MAuth", "MAuthReply", "MMgrReport",
     "MMDSBeacon", "MMDSMap", "MClientRequest", "MClientReply",
-    "MAuthMap",
+    "MAuthMap", "MLog", "MPGStats",
 ]
 
 _seq = itertools.count(1)
@@ -346,6 +346,29 @@ class MMonSubscribe(Message):
     what: str = "osdmap"
     start_epoch: int = 0
     reply_to: object = None
+
+
+# -- cluster log / health ----------------------------------------------
+
+@dataclass
+class MLog(Message):
+    """Daemon -> mon cluster-log submission (src/messages/MLog.h via
+    LogClient): entries end up in the paxos-replicated LogMonitor and
+    surface through 'ceph log last'.  Each entry is a dict
+    {seq, stamp, name, channel, prio, message}; (name, seq) is the
+    dedup key so retransmits never duplicate a line."""
+    entries: list = field(default_factory=list)
+
+
+@dataclass
+class MPGStats(Message):
+    """Primary OSD -> mon per-PG statistics (src/messages/MPGStats.h
+    role, folded onto the mgr-less mon): the HealthMonitor derives
+    OSD_SCRUB_ERRORS and POOL_FULL from these.  pg_stats maps
+    str(pgid) -> {pool, state, objects, bytes, scrub_errors}."""
+    osd_id: int = -1
+    pg_stats: dict = field(default_factory=dict)
+    epoch: int = 0
 
 
 # -- mgr ---------------------------------------------------------------

@@ -645,6 +645,19 @@ class Connection:
             # authless mon and cephx-guarded OSDs; the reference
             # negotiates auth per service type the same way).
             authless_acceptor = msg[1] is None
+            if authless_acceptor and self._sent_authorizer is not None \
+                    and tuple(self.peer_addr) not in \
+                    self.msgr.authless_peers:
+                # downgrade defense: we presented an authorizer and the
+                # peer is not a known authless service (monitors are
+                # registered in authless_peers by MonClient) — a
+                # proof-less ack here is attacker-forgeable (anyone
+                # accepting the TCP dial can send one) and would leave
+                # the connection unauthenticated AND unsigned while we
+                # believe we dialed a cephx-guarded daemon.  Fail the
+                # connection instead of proceeding downgraded.
+                self.close()
+                return False
             confirm = self.msgr.auth_confirm
             if confirm is not None and not authless_acceptor:
                 try:
@@ -787,6 +800,12 @@ class Messenger:
         # verify post-auth frames (cephx_sign_messages); the acceptor's
         # copy comes out of verify_authorizer's info dict.
         self.session_key_fn = session_key_fn
+        # peers legitimately allowed to ack our banner WITHOUT a proof
+        # (monitors: their auth is the in-band MAuth protocol, not the
+        # banner).  MonClient registers the monmap here; a proof-less
+        # ack from any OTHER address fails the connection (downgrade
+        # defense, see the BANNER_ACK handler).
+        self.authless_peers: set = set()
         self.sign_messages = True
         if conf is not None:
             try:

@@ -403,7 +403,13 @@ class ECBackend:
         return self.get_hinfo(oid).get_total_logical_size(self.sinfo)
 
     def handle_sub_read(self, msg, local: bool = False) -> None:
-        """Raw per-shard store read (:982-1012) — no decode here."""
+        """Raw per-shard store read (:982-1012) — no decode here.
+
+        Full-shard reads additionally verify the stored bytes against
+        the write-time hinfo crc (the reference's handle_sub_read crc
+        check): silent bit-rot becomes an EIO in the reply, so the
+        primary reconstructs around it exactly like a loud disk error
+        instead of decoding garbage into the client's buffer."""
         reply = MOSDECSubOpReadReply(
             pgid=self.pg.pgid, shard=msg.shard, from_osd=self.pg.whoami,
             tid=msg.tid)
@@ -411,6 +417,10 @@ class ECBackend:
             try:
                 data = self.pg.local_read_shard(msg.shard, oid,
                                                 chunk_off, chunk_len)
+                if chunk_off == 0 and not self._shard_crc_ok(
+                        oid, msg.shard, data):
+                    raise OSError(5, "shard %d of %r failed crc"
+                                  % (msg.shard, oid))
                 if chunk_len and len(data) < chunk_len:
                     # shard shorter than requested (e.g. mid-recovery):
                     # zero-pad so decode sees equal-length streams
@@ -419,6 +429,12 @@ class ECBackend:
                     (chunk_off, data))
             except (OSError, KeyError) as e:
                 reply.errors[oid] = getattr(e, "errno", None) or 5
+                # clog from the shard that failed (the reference's
+                # ECBackend.cc:999 "Error(s) ignored" clog role)
+                clog = getattr(self.pg.daemon, "clog", None)
+                if clog is not None:
+                    clog.error("pg %s: error reading shard %d of %r: "
+                               "%s" % (self.pg.pgid, msg.shard, oid, e))
         for name in msg.attrs_to_read:
             reply.attrs_read[name] = self.pg.local_getattr(
                 msg.to_read[0][0], name)
@@ -427,12 +443,30 @@ class ECBackend:
         else:
             self.pg.send_to_osd(msg.from_osd, reply)
 
+    def _shard_crc_ok(self, oid, shard: int, data: bytes) -> bool:
+        """True when the bytes are trustworthy: only a read covering
+        the WHOLE shard stream can be checked against the cumulative
+        hinfo crc (partial reads pass through unverified — deep scrub
+        owns those)."""
+        try:
+            h = self.get_hinfo(oid)
+        except Exception:
+            return True
+        if not h.has_chunk_hash() or h.get_total_chunk_size() == 0:
+            return True
+        if len(data) != h.get_total_chunk_size():
+            return True
+        import zlib
+        return (zlib.crc32(data) & 0xFFFFFFFF) == h.get_chunk_hash(shard)
+
     def handle_sub_read_reply(self, msg) -> None:
+        bad_oid = None
         with self.lock:
             read = self.inflight_reads.get(msg.tid)
             if read is None:
                 return
             if msg.errors:
+                bad_oid = read.oid
                 read.errors[msg.shard] = msg.errors
                 # error on a shard: try to substitute another shard
                 shards_avail = self.pg.acting_shards()
@@ -455,6 +489,15 @@ class ECBackend:
                     data = b"".join(b for _off, b in bufs)
                     read.shard_data[msg.shard] = data
                 resend = None
+        if bad_oid is not None:
+            # the bad shard is treated as missing for THIS read, and
+            # self-healed behind it: reconstruct from the survivors
+            # and rewrite it in place (l_osd_read_err/l_osd_repaired
+            # accounting; repair_shard dedups concurrent reads)
+            self.pg.daemon.perf.inc("read_err")
+            bad_osd = self.pg.acting_shards().get(msg.shard)
+            if bad_osd is not None and bad_osd != CRUSH_ITEM_NONE:
+                self.pg.repair_shard(bad_oid, msg.shard, bad_osd)
         if read is None:
             on_done(None)
             return

@@ -47,6 +47,12 @@ class OSDDaemon(Dispatcher):
         # creator's finisher died with the old daemon, and callbacks
         # queued there black-hole (no commit acks => wedged writes)
         self.store._finisher = self.finisher
+        # arm store fault injection from the objectstore_inject_*
+        # knobs (store/faults.py; a handed-over store keeps any marks
+        # the previous incarnation's tests planted)
+        faults = getattr(self.store, "faults", None)
+        if faults is not None:
+            faults.configure(conf)
         # cephx: when the cluster runs with auth, client + peer
         # connections must present "osd"-service authorizers (the
         # heartbeat messenger stays open, documented: heartbeats carry
@@ -135,8 +141,21 @@ class OSDDaemon(Dispatcher):
                      .add_u64_counter("op", "client operations")
                      .add_u64_counter("op_in_bytes", "client bytes written")
                      .add_time_avg("op_latency", "client op latency")
+                     .add_u64_counter("read_err",
+                                      "shard read errors (EIO/bad crc) "
+                                      "seen on the EC read path "
+                                      "(l_osd_read_err)")
+                     .add_u64_counter("repaired",
+                                      "shards rewritten by read-repair "
+                                      "or scrub repair (l_osd_repaired)")
                      .create_perf_counters())
         self.ctx.perf.add(self.perf)
+        # cluster log channel (the reference's clog): operator-facing
+        # events (shard EIO, scrub errors, repairs) go to the mon's
+        # replicated LogMonitor and surface via 'ceph log last'
+        from ..common.clog import ClogChannel
+        self.clog = ClogChannel(self.public_msgr, monmap,
+                                "osd.%d" % whoami)
         self._running = False
         self.stopped_pgs = False
 
@@ -164,18 +183,28 @@ class OSDDaemon(Dispatcher):
         self._hb_tick()
         self._agent_tick()
 
+    def _send_mon(self, msg) -> None:
+        """One-way control traffic (boot, failure reports, pg stats)
+        broadcast to EVERY monitor: peons forward to the leader and
+        the services are idempotent/deduping, so the message survives
+        any minority of dead mons — including the old leader.  A
+        single fixed target (the old monmap[min] behavior) wedged
+        reviving OSDs forever when exactly that mon was the one that
+        died."""
+        for rank in sorted(self.monmap):
+            self.public_msgr.send_message(msg, self.monmap[rank])
+
     def _boot(self, epoch: int | None = None) -> None:
         # record the epoch of the map that PROMPTED this boot (the new
         # map is not installed yet when called from _on_osdmap)
         self._boot_sent_epoch = self.map_epoch() if epoch is None \
             else epoch
         self._boot_sent_at = time.monotonic()
-        self.public_msgr.send_message(
+        self._send_mon(
             MOSDBoot(osd_id=self.whoami,
                      public_addr=self.public_msgr.my_addr,
                      cluster_addr=self.cluster_msgr.my_addr,
-                     hb_addr=self.hb_msgr.my_addr),
-            self.monmap[min(self.monmap)])
+                     hb_addr=self.hb_msgr.my_addr))
 
     def shutdown(self) -> None:
         self._running = False
@@ -253,10 +282,13 @@ class OSDDaemon(Dispatcher):
                 self.op_wq.queue(pgid, pg.on_map_change)
         return pg
 
-    def scrub_pg(self, pgid, deep: bool = False) -> bool:
+    def scrub_pg(self, pgid, deep: bool = False,
+                 repair: bool = False) -> bool:
         """Kick a (deep) scrub of one PG ('ceph pg scrub' /
         'ceph pg deep-scrub' surface); runs on the op queue at scrub
-        class priority."""
+        class priority.  repair=True is the 'ceph pg repair' spelling:
+        rebuild what the scrub flags even when osd_scrub_auto_repair
+        is off."""
         pg = self.pgs.get(pgid)
         if pg is None:
             return False
@@ -269,7 +301,8 @@ class OSDDaemon(Dispatcher):
             pg._scrub_seq = getattr(pg, "_scrub_seq", 0) + 1
             seq = pg._scrub_seq
             pg.scrub_stats = {"state": "queued"}
-        self.op_wq.queue(pg.pgid, pg.scrub, seq, deep, klass="scrub",
+        self.op_wq.queue(pg.pgid, pg.scrub, seq, deep, repair,
+                         klass="scrub",
                          priority=self.recovery_op_priority)
         return True
 
@@ -401,12 +434,15 @@ class OSDDaemon(Dispatcher):
                               "osd.%d no reply from osd.%d for %.2fs -> "
                               "reporting failure"
                               % (self.whoami, osd, now - first_unacked))
-                self.public_msgr.send_message(
+                self._send_mon(
                     MOSDFailure(reporter=self.whoami, target=osd,
                                 failed_for=now - first_unacked,
-                                epoch=self.map_epoch()),
-                    self.monmap[min(self.monmap)])
+                                epoch=self.map_epoch()))
                 self.hb_pending[osd] = now  # don't spam
+        # pg stats to the mon on the same cadence (MPGStats): primaries
+        # report scrub errors + rough usage so the HealthMonitor can
+        # derive OSD_SCRUB_ERRORS / POOL_FULL mon-side
+        self._report_pg_stats()
         # mgr perf report rides the heartbeat cadence (DaemonServer's
         # MMgrReport stream); mgr_addr is installed by the harness or
         # operator once an mgr exists
@@ -419,6 +455,29 @@ class OSDDaemon(Dispatcher):
                 self.mgr_addr)
         self.timer.add_event_after(
             conf.get_val("osd_heartbeat_interval"), self._hb_tick)
+
+    def _report_pg_stats(self) -> None:
+        """Primary PGs' stats to the mon (MPGStats).  Rate-limited to
+        1s and skipped entirely while nothing changed cheaply-visibly
+        would be nicer, but at framework scale the report is a few
+        dict copies; the mon dedups derived-state churn itself."""
+        now = time.monotonic()
+        if now - getattr(self, "_last_pg_report", 0.0) < 1.0:
+            return
+        self._last_pg_report = now
+        with self.lock:
+            pgs = [pg for pg in self.pgs.values() if pg.is_primary()]
+        stats = {}
+        for pg in pgs:
+            try:
+                stats[str(pg.pgid)] = pg.get_stats()
+            except Exception:
+                continue
+        if not stats:
+            return
+        from ..msg.message import MPGStats
+        self._send_mon(MPGStats(osd_id=self.whoami, pg_stats=stats,
+                                epoch=self.map_epoch()))
 
     # -- dispatch ------------------------------------------------------
 
@@ -450,6 +509,7 @@ class OSDDaemon(Dispatcher):
     WRITE_OP_KINDS = frozenset((
         "create", "write", "writefull", "append", "zero", "truncate",
         "remove", "setxattr", "rmxattr", "omap_set", "omap_rm",
+        "omap_clear", "resetxattrs", "watch", "unwatch", "notify",
         "rollback", "call"))
 
     def _check_op_caps(self, msg) -> str | None:
@@ -479,16 +539,22 @@ class OSDDaemon(Dispatcher):
         pgid = self._normalize_pgid(msg.pgid)
         pool = self.osdmap.pools.get(pgid.pool)
         pool_name = pool.name if pool is not None else None
+        from ..msg.message import OSD_READ_OPS
         need = set()
         for op in msg.ops:
             if not op:
                 continue
             if op[0] == "call":
                 need.add("x")
-            elif op[0] in self.WRITE_OP_KINDS:
-                need.add("w")
-            else:
+            elif op[0] in OSD_READ_OPS:
                 need.add("r")
+            else:
+                # fail CLOSED: every mutating op kind — and any kind
+                # this table has never heard of — demands 'w'.  The
+                # old shape defaulted unknown kinds to 'r', so a new
+                # op added to the PG without a matching entry here
+                # (omap_clear once) silently bypassed write caps.
+                need.add("w")
         if not caps.is_capable("".join(sorted(need)), pool_name):
             return "caps %r do not cover %s on pool %r" % (
                 info.get("caps", ""), "".join(sorted(need)), pool_name)

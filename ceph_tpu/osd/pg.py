@@ -96,8 +96,13 @@ class PG:
         self._pulling: dict = {}   # oid -> pull sent at (monotonic)
         self._deleted_log: dict = {}   # oid -> version it was deleted at
         self.scrub_stats: dict = {"state": "never"}
+        # unrepaired errors from the LAST completed scrub: reported to
+        # the mon in pg stats (MPGStats) and the input behind the
+        # OSD_SCRUB_ERRORS health check; cleared by a repairing scrub
+        self.scrub_errors = 0
         self._scrub_waiting: set = set()
         self._scrub_replies: dict = {}
+        self._repairing: set = set()   # (oid, shard) read-repairs live
         # peering (GetInfo/GetLog/GetMissing)
         self.peer_state = "idle"      # idle|peering|active|replica
         self._peer_seq = 0
@@ -1690,8 +1695,8 @@ class PG:
                 inv[oid] = (-1, 0, 0)   # unreadable shard: scrub error
         return inv
 
-    def scrub(self, seq: int | None = None,
-              deep: bool = False) -> dict | None:
+    def scrub(self, seq: int | None = None, deep: bool = False,
+              repair: bool = False) -> dict | None:
         """Primary-driven scrub: collect per-object (version, crc, size)
         from every acting peer, compare against the local copy, and
         push repairs for mismatches. Returns immediately; results land
@@ -1703,7 +1708,13 @@ class PG:
         deep=True on an EC pool additionally verifies every shard's
         stored crc against the write-time hinfo record and rebuilds
         divergent shards from the survivors (decode on the device) —
-        the integrity check a shallow EC scrub cannot do."""
+        the integrity check a shallow EC scrub cannot do.
+
+        Whether flagged inconsistencies are actually REPAIRED is
+        repair OR osd_scrub_auto_repair; with both off the scrub is
+        detect-only, errors persist in self.scrub_errors, and the
+        cluster raises OSD_SCRUB_ERRORS until a 'pg repair'
+        (scrub_pg(..., repair=True)) rebuilds the bad copies."""
         if not self.is_primary():
             return None
         shards = self.acting_shards()
@@ -1714,6 +1725,12 @@ class PG:
             elif seq != getattr(self, "_scrub_seq", 0):
                 return None  # a newer scrub_pg superseded this ticket
             self._scrub_deep = deep
+            try:
+                auto = self.daemon.ctx.conf.get_val(
+                    "osd_scrub_auto_repair")
+            except Exception:
+                auto = True
+            self._scrub_repair = repair or auto
             self._scrub_waiting = {
                 osd for shard, osd in shards.items()
                 if osd not in (CRUSH_ITEM_NONE, self.whoami)}
@@ -1778,6 +1795,7 @@ class PG:
         with self.lock:
             seq = getattr(self, "_scrub_seq", 0)
             deep = getattr(self, "_scrub_deep", False)
+            repair = getattr(self, "_scrub_repair", True)
             replies = {k: dict(v)
                        for k, v in self._scrub_replies.items()}
         local = self._scrub_inventory(
@@ -1797,7 +1815,7 @@ class PG:
                             and mine[0] == theirs[0]:
                         continue
                 errors += 1
-                if mine is not None and (
+                if repair and mine is not None and (
                         theirs is None or theirs[0] <= mine[0]):
                     self._push_object(oid, shard, peer_osd, force=True)
                     shallow_repaired.add((peer_osd, shard, oid))
@@ -1810,7 +1828,7 @@ class PG:
             def deep_worker(base_err=errors, base_rep=repaired,
                             nobj=len(local)):
                 d_err, d_rep = self._deep_scrub_ec(
-                    local, replies, shallow_repaired)
+                    local, replies, shallow_repaired, repair)
                 err, rep = base_err + d_err, base_rep + d_rep
                 with self.lock:
                     if seq != getattr(self, "_scrub_seq", 0):
@@ -1820,6 +1838,7 @@ class PG:
                         else "inconsistent",
                         "errors": err, "repaired": rep,
                         "objects": nobj, "deep": True}
+                self._scrub_epilogue(err, rep, deep=True)
 
             threading.Thread(target=deep_worker, name="deep-scrub",
                              daemon=True).start()
@@ -1839,9 +1858,29 @@ class PG:
                 # 'deep' flag terminate
                 stats["deep"] = True
             self.scrub_stats = stats
+        self._scrub_epilogue(errors, repaired, deep=deep)
+
+    def _scrub_epilogue(self, errors: int, repaired: int,
+                        deep: bool = False) -> None:
+        """Post-scrub accounting: persist the unrepaired count for the
+        pg-stats report (OSD_SCRUB_ERRORS input) and tell the operator
+        through the cluster log — the reference clogs scrub results
+        from PG::scrub_finish the same way."""
+        with self.lock:
+            self.scrub_errors = max(0, errors - repaired)
+        clog = getattr(self.daemon, "clog", None)
+        if clog is None:
+            return
+        what = "deep-scrub" if deep else "scrub"
+        if errors:
+            clog.error("pg %s %s: %d errors, %d repaired%s"
+                       % (self.pgid, what, errors, repaired,
+                          "" if errors == repaired
+                          else " — pg is INCONSISTENT, run pg repair"))
 
     def _deep_scrub_ec(self, local_inv: dict, replies: dict,
-                       already_repaired: set) -> tuple[int, int]:
+                       already_repaired: set,
+                       repair: bool = True) -> tuple[int, int]:
         """EC shard verification against the write-time hinfo crcs.
 
         Ground truth is the per-shard cumulative crc recorded at encode
@@ -1850,7 +1889,8 @@ class PG:
         corrupt data shard into "authoritative" bytes. A divergent
         shard is rebuilt from the OTHER shards (recover_object excludes
         the target), the rebuilt bytes are re-verified against the
-        hinfo crc, and only then force-pushed.
+        hinfo crc, and only then force-pushed.  repair=False counts
+        errors without rebuilding (detect-only deep scrub).
         """
         import zlib
 
@@ -1878,6 +1918,8 @@ class PG:
                 if have is not None and have[1] == want_crc:
                     continue
                 errors += 1
+                if not repair:
+                    continue    # detect-only pass: count, don't touch
                 done = threading.Event()
                 got: list = [None]
 
@@ -1903,7 +1945,82 @@ class PG:
                 else:
                     self.send_to_osd(osd, push)
                 repaired += 1
+                self.daemon.perf.inc("repaired")
         return errors, repaired
+
+    def get_stats(self) -> dict:
+        """Primary's per-PG stats row for the mon's MPGStats report:
+        the HealthMonitor derives OSD_SCRUB_ERRORS and POOL_FULL from
+        these.  bytes/objects are the PRIMARY SHARD's stored footprint
+        (for EC that is ~1/k of logical bytes — a quota knob, not an
+        accounting ledger)."""
+        cid = self.cid_of_shard(
+            self.my_shard() if self.pool.is_erasure() else -1)
+        nobj = nbytes = 0
+        try:
+            for oid in self.store.list_objects(cid):
+                if oid == META_OID:
+                    continue
+                st = self.store.stat(cid, oid)
+                if st is not None:
+                    nobj += 1
+                    nbytes += st.get("size", 0)
+        except Exception:
+            pass
+        with self.lock:
+            return {"pool": self.pgid.pool, "state": self.peer_state,
+                    "objects": nobj, "bytes": nbytes,
+                    "scrub_errors": self.scrub_errors}
+
+    def repair_shard(self, oid, shard: int, peer_osd: int) -> None:
+        """Read-path self-heal: a shard that served EIO or bad-crc
+        bytes during a client read is rebuilt from the survivors and
+        force-pushed back (the scrub-repair machinery, triggered by the
+        read instead of a scrub pass).  Deduped per (oid, shard) so a
+        burst of reads over one bad shard repairs it once."""
+        key = (oid, shard)
+        with self.lock:
+            if self.acting_primary != self.whoami:
+                return
+            if key in self._repairing:
+                return
+            self._repairing.add(key)
+        attrs, omap = self._gather_push_meta(oid)
+
+        def on_data(data):
+            with self.lock:
+                self._repairing.discard(key)
+            if data is None:
+                return     # not enough survivors right now; a scrub
+                           # or the next read retries
+            if self.pool.is_erasure():
+                # never launder: the rebuilt bytes must match the
+                # write-time hinfo crc before they overwrite anything
+                h = self.backend.get_hinfo(oid)
+                if h.has_chunk_hash():
+                    import zlib
+                    if (zlib.crc32(bytes(data)) & 0xFFFFFFFF) != \
+                            h.get_chunk_hash(shard):
+                        return
+            version = max(int(attrs.get(VERSION_ATTR, b"0") or 0),
+                          self._log_version_of(oid))
+            push = MOSDPGPush(
+                pgid=self.pgid, from_osd=self.whoami, shard=shard,
+                oid=oid, data=bytes(data), attrs=attrs, omap=omap,
+                version=version, map_epoch=self.map_epoch(),
+                force=True)
+            if peer_osd == self.whoami:
+                self.handle_push(push)
+            else:
+                self.send_to_osd(peer_osd, push)
+            self.daemon.perf.inc("repaired")
+            clog = getattr(self.daemon, "clog", None)
+            if clog is not None:
+                clog.info("pg %s: rewrote shard %d of %r on osd.%d "
+                          "after read error" % (self.pgid, shard, oid,
+                                                peer_osd))
+
+        self.backend.recover_object(oid, shard, on_data)
 
     def _authoritative_inventory(self) -> dict:
         """Union of all local shard inventories (primary's knowledge)."""

@@ -43,6 +43,7 @@ import zlib
 from .. import encoding
 from ..compressor import compress_if_worthwhile
 from ..compressor import create as compressor_create
+from .faults import FaultSet
 from .kv import FileDB
 from .object_store import ObjectStore, Transaction
 
@@ -227,7 +228,7 @@ class BlockStore(ObjectStore):
         self._blobs: dict = {}           # bid -> _Blob
         self._next_blob = 1
         self._deferred_seq = 1
-        self._read_errors: set = set()
+        self.faults = FaultSet()
         self.mounted = False
 
     # -- lifecycle -----------------------------------------------------
@@ -286,11 +287,11 @@ class BlockStore(ObjectStore):
 
     def inject_read_error(self, cid, oid) -> None:
         with self._lock:
-            self._read_errors.add((cid, oid))
+            self.faults.mark_eio(cid, oid)
 
     def clear_read_error(self, cid, oid) -> None:
         with self._lock:
-            self._read_errors.discard((cid, oid))
+            self.faults.clear_eio(cid, oid)
 
     # -- transaction apply ---------------------------------------------
 
@@ -338,9 +339,15 @@ class BlockStore(ObjectStore):
         else:
             cb()
 
+    _REMAP_KINDS = frozenset(("write", "zero", "truncate", "remove",
+                              "clone_data"))
+
     def _apply_op(self, op, batch, deferred) -> bool:
         """Returns True if the op wrote big (pre-commit-flush) data."""
         kind = op[0]
+        if kind in self._REMAP_KINDS:
+            # a rewrite heals explicit injected faults (FaultSet)
+            self.faults.on_write(op[1], op[2])
         if kind == "create_collection":
             ck = _ckey(op[1])
             self._colls[ck] = op[1]
@@ -669,8 +676,7 @@ class BlockStore(ObjectStore):
 
     def read(self, cid, oid, offset: int = 0, length: int = 0) -> bytes:
         with self._lock:
-            if (cid, oid) in self._read_errors:
-                raise OSError(5, "injected EIO on %r/%r" % (cid, oid))
+            self.faults.check_eio(cid, oid)
             onode = self._onodes.get(_okey(cid, oid))
             if onode is None:
                 raise KeyError("no object %r in %r" % (oid, cid))
@@ -688,7 +694,7 @@ class BlockStore(ObjectStore):
                 piece = self._blob_read(self._blobs[bid],
                                         boff + (s - loff), e - s)
                 out[s - offset:e - offset] = piece
-            return bytes(out)
+            return self.faults.corrupt(cid, oid, offset, bytes(out))
 
     def stat(self, cid, oid) -> dict | None:
         with self._lock:

@@ -22,6 +22,7 @@ import threading
 
 from .. import encoding
 from .block_store import _ckey, _okey
+from .faults import FaultSet
 from .kv import FileDB
 from .object_store import ObjectStore, Transaction
 
@@ -42,6 +43,7 @@ class KStore(ObjectStore):
         self._onodes: dict = {}       # okey -> {cid, oid, size, xattrs}
         self._pending: dict | None = None   # intra-txn stripe overlay
         self._pending_m: dict | None = None  # intra-txn omap overlay
+        self.faults = FaultSet()
         self.mounted = False
 
     # -- lifecycle -----------------------------------------------------
@@ -207,8 +209,14 @@ class KStore(ObjectStore):
                         keys.add(mkey)
         return sorted(keys)
 
+    _REMAP_KINDS = frozenset(("write", "zero", "truncate", "remove",
+                              "clone_data"))
+
     def _apply_op(self, op, batch) -> None:
         kind = op[0]
+        if kind in self._REMAP_KINDS:
+            # a rewrite heals explicit injected faults (FaultSet)
+            self.faults.on_write(op[1], op[2])
         if kind == "create_collection":
             ck = _ckey(op[1])
             self._colls[ck] = op[1]
@@ -315,8 +323,17 @@ class KStore(ObjectStore):
 
     # -- reads ---------------------------------------------------------
 
+    def inject_read_error(self, cid, oid) -> None:
+        with self._lock:
+            self.faults.mark_eio(cid, oid)
+
+    def clear_read_error(self, cid, oid) -> None:
+        with self._lock:
+            self.faults.clear_eio(cid, oid)
+
     def read(self, cid, oid, offset: int = 0, length: int = 0) -> bytes:
         with self._lock:
+            self.faults.check_eio(cid, oid)
             onode = self._get(cid, oid)
             if length == 0:
                 length = max(0, onode["size"] - offset)
@@ -333,7 +350,7 @@ class KStore(ObjectStore):
                 piece = stripe[soff:soff + n]
                 out += piece + b"\0" * (n - len(piece))
                 pos += n
-            return bytes(out)
+            return self.faults.corrupt(cid, oid, offset, bytes(out))
 
     def stat(self, cid, oid) -> dict | None:
         with self._lock:

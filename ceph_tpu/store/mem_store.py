@@ -7,13 +7,16 @@ under one lock; completions run inline or via a Finisher when provided
 (the reference queues them on the OSD's finishers so callbacks never run
 in the IO path's lock scope).
 
-Supports EIO injection on marked objects
-(objectstore_inject_read_err analog: mark via inject_read_error)."""
+Fault injection rides a FaultSet (store/faults.py): EIO and silent
+bitrot on marked or hash-selected objects (objectstore_inject_eio /
+objectstore_inject_bitrot knobs; inject_read_error kept as the
+historical EIO-mark spelling)."""
 
 from __future__ import annotations
 
 import threading
 
+from .faults import FaultSet
 from .object_store import Collection, ObjectStore, Transaction
 
 __all__ = ["MemStore"]
@@ -44,7 +47,7 @@ class MemStore(ObjectStore):
         self._lock = threading.RLock()
         self._colls: dict = {}
         self._finisher = finisher
-        self._read_errors: set = set()
+        self.faults = FaultSet()
         self.mounted = False
 
     # -- lifecycle -----------------------------------------------------
@@ -59,11 +62,11 @@ class MemStore(ObjectStore):
 
     def inject_read_error(self, cid, oid) -> None:
         with self._lock:
-            self._read_errors.add((cid, oid))
+            self.faults.mark_eio(cid, oid)
 
     def clear_read_error(self, cid, oid) -> None:
         with self._lock:
-            self._read_errors.discard((cid, oid))
+            self.faults.clear_eio(cid, oid)
 
     # -- mutation ------------------------------------------------------
 
@@ -97,8 +100,15 @@ class MemStore(ObjectStore):
             obj = coll.objects[oid] = _Object()
         return obj
 
+    # op kinds whose (cid, oid) rewrite clears explicit fault marks
+    # (see FaultSet.on_write: a repair rewrite heals the bad sector)
+    _REMAP_KINDS = frozenset(("write", "zero", "truncate", "remove",
+                              "clone_data"))
+
     def _apply(self, op: tuple) -> None:
         kind = op[0]
+        if kind in self._REMAP_KINDS:
+            self.faults.on_write(op[1], op[2])
         if kind == "create_collection":
             self._colls.setdefault(op[1], Collection(op[1]))
         elif kind == "remove_collection":
@@ -172,12 +182,12 @@ class MemStore(ObjectStore):
 
     def read(self, cid, oid, offset: int = 0, length: int = 0) -> bytes:
         with self._lock:
-            if (cid, oid) in self._read_errors:
-                raise OSError(5, "injected EIO on %r/%r" % (cid, oid))
+            self.faults.check_eio(cid, oid)
             obj = self._obj(cid, oid)
             if length == 0:
                 length = len(obj.data) - offset
-            return bytes(obj.data[offset:offset + length])
+            data = bytes(obj.data[offset:offset + length])
+            return self.faults.corrupt(cid, oid, offset, data)
 
     def stat(self, cid, oid) -> dict | None:
         with self._lock:

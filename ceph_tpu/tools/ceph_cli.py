@@ -30,14 +30,13 @@ def connect(args) -> RadosClient:
     return client
 
 
-def cluster_status(m) -> str:
+def cluster_status(m, health_status: str = "HEALTH_OK") -> str:
     exists = [o for o in range(m.max_osd) if m.exists(o)]
     ups = sum(1 for o in exists if m.is_up(o))
     ins = sum(1 for o in exists if m.is_in(o))
-    health = "HEALTH_OK" if ups == len(exists) == ins else "HEALTH_WARN"
     lines = [
         "  cluster:",
-        "    health: %s" % health,
+        "    health: %s" % health_status,
         "",
         "  services:",
         "    osd: %d osds: %d up, %d in" % (len(exists), ups, ins),
@@ -50,17 +49,17 @@ def cluster_status(m) -> str:
     return "\n".join(lines)
 
 
-def health(m) -> str:
-    problems = []
-    for o in range(m.max_osd):
-        if m.exists(o) and not m.is_up(o):
-            problems.append("osd.%d is down" % o)
-        elif m.exists(o) and not m.is_in(o):
-            problems.append("osd.%d is out" % o)
-    if not problems:
-        return "HEALTH_OK"
-    return "HEALTH_WARN %d osds down/out\n%s" % (
-        len(problems), "\n".join("    " + p for p in problems))
+def health(client, detail: bool = False) -> tuple[str, str]:
+    """(status, rendered text) from the monitor's paxos-replicated
+    HealthMonitor — the named-check service, NOT a CLI-side
+    recomputation from the map (which could disagree with what other
+    quorum members report and forgets checks like OSD_SCRUB_ERRORS
+    that no map carries)."""
+    res, outs, data = client.mon_command(
+        {"prefix": "health detail" if detail else "health"})
+    if res != 0 or not isinstance(data, dict):
+        return "HEALTH_ERR", "health service unavailable: %s" % outs
+    return data.get("status", "HEALTH_ERR"), outs
 
 
 def osd_tree(m) -> str:
@@ -117,7 +116,8 @@ def main(argv=None) -> int:
     p.add_argument("--monmap")
     p.add_argument("--mon", action="append")
     p.add_argument("words", nargs="+",
-                   help="command, e.g.: status | health | osd tree | "
+                   help="command, e.g.: status | health [detail] | "
+                        "log last [N] | osd tree | "
                         "osd pool ls | osd pool create NAME | "
                         "osd out/in/down ID | osd dump | "
                         "daemon ASOK CMD...")
@@ -134,12 +134,23 @@ def main(argv=None) -> int:
         w = args.words
         m = client.osdmap
         if w == ["status"] or w == ["-s"]:
-            sys.stdout.write(cluster_status(m) + "\n")
+            status, _ = health(client)
+            sys.stdout.write(cluster_status(m, status) + "\n")
             return 0
-        if w == ["health"]:
-            out = health(m)
+        if w in (["health"], ["health", "detail"]):
+            status, out = health(client, detail=len(w) == 2)
             sys.stdout.write(out + "\n")
-            return 0 if out == "HEALTH_OK" else 1
+            return 0 if status == "HEALTH_OK" else 1
+        if w[:2] == ["log", "last"]:
+            try:
+                num = int(w[2]) if len(w) > 2 else 20
+            except ValueError:
+                sys.stderr.write("ceph: invalid count %r\n" % w[2])
+                return 1
+            res, outs, _ = client.mon_command(
+                {"prefix": "log last", "num": num})
+            sys.stdout.write(outs + "\n")
+            return 0 if res == 0 else 1
         if w == ["osd", "tree"] or w == ["osd", "stat"]:
             sys.stdout.write(osd_tree(m) + "\n")
             return 0

@@ -126,6 +126,31 @@ class TestObjectMap:
         assert raw[0] == 0 and raw[3] == OBJECT_EXISTS
         img.close()
 
+    def test_discard_saves_map_once(self, ctx, monkeypatch):
+        """A discard spanning many blocks applies ONE object-map
+        update + save (write() already batched; per-block saves made
+        discard O(blocks^2) map bytes through the data pool)."""
+        from ceph_tpu.client import rbd as rbd_mod
+        _, io = ctx
+        RBD.create(io, "discimg", 8 * MiB, order=20, features=FEATURES)
+        img = Image(io, "discimg")
+        img.write(0, b"d" * (4 * MiB))           # 4 whole blocks
+        img.write(5 * MiB + 17, b"tail")         # partial block 5
+        calls: list = []
+        orig = rbd_mod.ObjectMap.save
+
+        def counting_save(self):
+            calls.append(1)
+            return orig(self)
+
+        monkeypatch.setattr(rbd_mod.ObjectMap, "save", counting_save)
+        # 4 full-block removes + 1 partial zero in one discard
+        img.discard(0, 5 * MiB + 100)
+        assert len(calls) == 1, "discard saved the map %d times" \
+            % len(calls)
+        assert img.du() == 1 * MiB               # only block 5 remains
+        img.close()
+
     def test_map_survives_reopen_and_handoff(self, ctx):
         cluster, io = ctx
         RBD.create(io, "persist", 8 * MiB, order=20, features=FEATURES)

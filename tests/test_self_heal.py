@@ -1,0 +1,411 @@
+"""Self-healing data path: fault injection, scrub/repair health loop,
+paxos-replicated Health/Log monitors.
+
+Models the reference's qa surface for this loop:
+qa/standalone/scrub/osd-scrub-repair.sh (deep-scrub finds injected
+corruption, `pg repair` fixes it, OSD_SCRUB_ERRORS raises/clears),
+qa/standalone/erasure-code/test-erasure-eio.sh (EIO shards are
+reconstructed around AND rewritten), the HealthMonitor/LogMonitor
+paxos services (src/mon/HealthMonitor.cc, LogMonitor.cc — checks
+survive mon leader failover; daemon clog reaches `ceph log last`),
+plus regression tests for the OSDCap fail-closed rule, the messenger
+auth-downgrade defense, and the mon-secret boot guard.
+"""
+
+from __future__ import annotations
+
+import errno
+import threading
+import time
+
+import pytest
+
+from .cluster_util import MiniCluster, wait_until
+
+FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+        "mon_osd_down_out_interval": 1.0, "paxos_propose_interval": 0.02}
+
+
+def health_checks(client):
+    """The replicated health service's verdict, via the command
+    surface every operator tool uses (NOT recomputed client-side)."""
+    res, _, data = client.mon_command({"prefix": "health"})
+    if res != 0 or not isinstance(data, dict):
+        return None, {}
+    return data.get("status"), data.get("checks", {})
+
+
+def ec_target(cluster, client, pool_name, oid):
+    """(pgid, acting, primary) for an EC object."""
+    m = client.osdmap
+    pool_id = client.pool_id(pool_name)
+    pgid = m.pools[pool_id].raw_pg_to_pg(m.object_to_pg(pool_id, oid))
+    _, _, acting, primary = m.pg_to_up_acting_osds(pgid)
+    return pgid, acting, primary
+
+
+class TestHealthChecksThrash:
+    def test_osd_down_degraded_raise_and_clear_across_mon_failover(self):
+        """The acceptance loop: stop an OSD -> OSD_DOWN + PG_DEGRADED
+        raise in the replicated HealthMonitor; kill the mon LEADER ->
+        the checks survive on the new leader (they ride paxos, not any
+        one mon's memory); revive the OSD -> checks clear."""
+        cluster = MiniCluster(num_mons=3, num_osds=3,
+                              conf_overrides=FAST).start()
+        try:
+            client = cluster.client()
+            cluster.create_replicated_pool(client, "healthpool",
+                                           size=3, pg_num=4)
+            ioctx = client.open_ioctx("healthpool")
+            for i in range(4):
+                ioctx.write_full("hobj-%d" % i, b"payload" * 64)
+            status, checks = None, {}
+
+            def ok_now():
+                nonlocal status, checks
+                status, checks = health_checks(client)
+                return status == "HEALTH_OK"
+            assert wait_until(ok_now, 30), (status, checks)
+
+            victim = sorted(cluster.osds)[-1]
+            store = cluster.stop_osd(victim)
+
+            def raised():
+                nonlocal status, checks
+                status, checks = health_checks(client)
+                return ("OSD_DOWN" in checks
+                        and "PG_DEGRADED" in checks
+                        and status != "HEALTH_OK")
+            assert wait_until(raised, 30), (status, checks)
+            assert "osd.%d is down" % victim in \
+                checks["OSD_DOWN"]["detail"]
+
+            # mon LEADER failover: the raised checks must survive
+            leader = cluster.leader()
+            cluster.mons.remove(leader)
+            leader.shutdown()
+            assert wait_until(
+                lambda: any(m.is_leader() for m in cluster.mons), 30), \
+                "no new mon leader after failover"
+            assert wait_until(raised, 30), \
+                "health checks lost across mon failover: %r" % (checks,)
+
+            # heal: revive + mark in -> checks clear on the NEW leader
+            cluster.revive_osd(victim, store=store)
+            client.mon_command({"prefix": "osd in", "id": victim})
+
+            def cleared():
+                nonlocal status, checks
+                status, checks = health_checks(client)
+                return (status == "HEALTH_OK"
+                        and "OSD_DOWN" not in checks
+                        and "PG_DEGRADED" not in checks
+                        and "OSD_OUT" not in checks)
+            assert wait_until(cleared, 60), (status, checks)
+        finally:
+            cluster.stop()
+
+
+class TestBitrotScrubRepairLoop:
+    def test_deep_scrub_raises_scrub_errors_then_pg_repair_clears(self):
+        """Satellite acceptance: inject bit-rot on one EC shard, deep
+        scrub (detect-only) -> OSD_SCRUB_ERRORS raises and the scrub
+        error lands in `ceph log last`; `pg repair` rebuilds the shard
+        from the survivors and the check clears."""
+        import numpy as np
+        conf = dict(FAST)
+        conf["osd_scrub_auto_repair"] = False   # reference semantics
+        cluster = MiniCluster(num_mons=1, num_osds=4,
+                              conf_overrides=conf).start()
+        try:
+            client = cluster.client()
+            cluster.create_ec_pool(client, "rotpool",
+                                   {"plugin": "jerasure",
+                                    "technique": "reed_sol_van",
+                                    "k": "2", "m": "1"}, pg_num=4)
+            ioctx = client.open_ioctx("rotpool")
+            payload = bytes(np.random.default_rng(11).integers(
+                0, 256, 8192, dtype=np.uint8))
+            ioctx.write_full("rotobj", payload)
+            pgid, acting, primary = ec_target(cluster, client,
+                                              "rotpool", "rotobj")
+            victim_shard = 1
+            victim = cluster.osds[acting[victim_shard]]
+            cid = ("pg", str(pgid), victim_shard)
+            good = victim.store.read(cid, "rotobj")
+            victim.store.faults.mark_bitrot(cid, "rotobj")
+            assert victim.store.read(cid, "rotobj") != good
+
+            osd = cluster.osds[primary]
+            pg = osd.pgs[pgid]
+            # detect-only deep scrub: flags, does NOT repair
+            assert osd.scrub_pg(pgid, deep=True)
+            assert wait_until(
+                lambda: pg.scrub_stats.get("deep")
+                and pg.scrub_stats.get("state") == "inconsistent", 20), \
+                pg.scrub_stats
+            assert pg.scrub_stats["errors"] == 1
+            assert pg.scrub_stats["repaired"] == 0
+            assert pg.scrub_errors == 1
+
+            # the health loop: primary reports stats -> mon raises
+            def scrub_errors_raised():
+                _, checks = health_checks(client)
+                return "OSD_SCRUB_ERRORS" in checks
+            assert wait_until(scrub_errors_raised, 30)
+
+            # the clog event reached the replicated LogMonitor
+            def clogged():
+                res, outs, entries = client.mon_command(
+                    {"prefix": "log last", "num": 50})
+                return res == 0 and any(
+                    "deep-scrub" in e.get("message", "")
+                    and str(pgid) in e.get("message", "")
+                    for e in entries or [])
+            assert wait_until(clogged, 30)
+
+            # pg repair rebuilds the shard from the survivors
+            assert osd.scrub_pg(pgid, deep=True, repair=True)
+            assert wait_until(
+                lambda: pg.scrub_stats.get("deep")
+                and pg.scrub_stats.get("state") == "clean"
+                and pg.scrub_stats.get("repaired", 0) >= 1, 30), \
+                pg.scrub_stats
+            assert pg.scrub_errors == 0
+            # the rewrite healed the injected fault (FaultSet.on_write)
+            # and restored the authoritative bytes
+            assert wait_until(
+                lambda: victim.store.read(cid, "rotobj") == good, 15)
+            assert ioctx.read("rotobj") == payload
+
+            def scrub_errors_cleared():
+                _, checks = health_checks(client)
+                return "OSD_SCRUB_ERRORS" not in checks
+            assert wait_until(scrub_errors_cleared, 30)
+        finally:
+            cluster.stop()
+
+
+class TestReadErrorRepair:
+    def test_eio_shard_read_reconstructs_counts_and_rewrites(self):
+        """An EIO shard during a client read is (1) reconstructed
+        around — the read succeeds, (2) counted in l_osd_read_err /
+        l_osd_repaired, (3) rewritten on disk by the read-repair push,
+        and (4) visible as a clog event."""
+        cluster = MiniCluster(num_mons=1, num_osds=4,
+                              conf_overrides=FAST).start()
+        try:
+            client = cluster.client()
+            cluster.create_ec_pool(client, "eiorepair",
+                                   {"plugin": "jerasure",
+                                    "technique": "reed_sol_van",
+                                    "k": "2", "m": "1"}, pg_num=4)
+            ioctx = client.open_ioctx("eiorepair")
+            payload = b"heal me " * 1024
+            ioctx.write_full("eobj", payload)
+            assert ioctx.read("eobj") == payload
+            pgid, acting, primary = ec_target(cluster, client,
+                                              "eiorepair", "eobj")
+            victim_shard = 0
+            victim = cluster.osds[acting[victim_shard]]
+            cid = ("pg", str(pgid), victim_shard)
+            good = victim.store.read(cid, "eobj")
+            victim.store.faults.mark_eio(cid, "eobj")
+            posd = cluster.osds[primary]
+            before_err = posd.perf.get("read_err")
+            before_rep = posd.perf.get("repaired")
+
+            # the degraded read succeeds (reconstruct around the shard)
+            deadline = time.monotonic() + 20
+            data = None
+            while time.monotonic() < deadline:
+                try:
+                    data = ioctx.read("eobj")
+                    if data == payload:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.2)
+            assert data == payload
+
+            # counters moved on the primary
+            assert wait_until(
+                lambda: posd.perf.get("read_err") > before_err, 10)
+            assert wait_until(
+                lambda: posd.perf.get("repaired") > before_rep, 20)
+            # the bad shard was rewritten in place (repair push clears
+            # the injected mark via FaultSet.on_write), so subsequent
+            # LOCAL reads of that shard serve good bytes again
+            assert wait_until(
+                lambda: victim.store.read(cid, "eobj") == good, 20)
+            # and the operator can see it: error + repair in the clog
+            def clogged():
+                res, outs, entries = client.mon_command(
+                    {"prefix": "log last", "num": 50})
+                msgs = [e.get("message", "") for e in entries or []]
+                return (res == 0
+                        and any("error reading shard" in m
+                                for m in msgs)
+                        and any("rewrote shard" in m for m in msgs))
+            assert wait_until(clogged, 30)
+        finally:
+            cluster.stop()
+
+
+class TestOSDCapFailClosed:
+    def test_omap_clear_requires_write_cap(self):
+        """Regression for the OSDCap bypass: omap_clear (and any op
+        kind the cap table does not recognize) demands 'w' — a client
+        with 'allow r' gets EACCES and mutates nothing."""
+        cluster = MiniCluster(num_mons=1, num_osds=3,
+                              conf_overrides=FAST, auth=True)
+        reader_secret = cluster.keyring.add(
+            "client.reader", caps={"mon": "allow r", "osd": "allow r"})
+        cluster.start()
+        try:
+            admin = cluster.client()
+            cluster.create_replicated_pool(admin, "capspool", size=2,
+                                           pg_num=4)
+            aio = admin.open_ioctx("capspool")
+            aio.write_full("guarded", b"bytes")
+            aio.omap_set("guarded", {"k": b"v"})
+
+            reader = None
+
+            def can_auth():
+                nonlocal reader
+                try:
+                    reader = cluster.client("client.reader",
+                                            reader_secret)
+                    return True
+                except PermissionError:
+                    return False
+            assert wait_until(can_auth, 15)
+            rio = reader.open_ioctx("capspool")
+            assert rio.read("guarded") == b"bytes"
+            assert rio.omap_get("guarded") == {"k": b"v"}
+            with pytest.raises(OSError) as ei:
+                rio.omap_clear("guarded")
+            assert ei.value.errno == errno.EACCES
+            with pytest.raises(OSError) as ei:
+                rio._op("guarded", [("resetxattrs",)])
+            assert ei.value.errno == errno.EACCES
+            # fail CLOSED: an op kind the table has never heard of is
+            # treated as a write, not a read
+            with pytest.raises(OSError) as ei:
+                rio._op("guarded", [("frobnicate",)])
+            assert ei.value.errno == errno.EACCES
+            # nothing was mutated
+            assert aio.omap_get("guarded") == {"k": b"v"}
+        finally:
+            cluster.stop()
+
+
+class TestAuthDowngradeDefense:
+    def test_proofless_ack_from_impersonator_rejected(self):
+        """An acceptor that cannot prove ticket possession (no
+        verifier — i.e. anyone who grabbed the TCP port) must NOT be
+        able to downgrade an auth-bearing dialer to an unauthenticated,
+        unsigned connection.  Monitors are exempt via authless_peers
+        (their auth is the in-band MAuth protocol)."""
+        from ceph_tpu.msg.message import MPing
+        from ceph_tpu.msg.messenger import Dispatcher, Messenger
+
+        got: list = []
+
+        class Sink(Dispatcher):
+            def ms_dispatch(self, m):
+                got.append(m)
+                return True
+
+        impersonator = Messenger(("osd", 99))   # NO auth verifier
+        impersonator.bind()
+        impersonator.start()
+        impersonator.add_dispatcher_tail(Sink())
+
+        def factory(challenge=None):
+            return {"has_challenge": True, "blob": "ticket-bytes"}
+
+        dialer = Messenger(("client", 1), authorizer_factory=factory,
+                           auth_confirm=lambda sent, proof: True)
+        dialer.bind()
+        dialer.start()
+        dialer2 = Messenger(("client", 2), authorizer_factory=factory,
+                            auth_confirm=lambda sent, proof: True)
+        dialer2.bind()
+        dialer2.start()
+        try:
+            dialer.send_message(MPing(stamp=1.0),
+                                impersonator.my_addr)
+            time.sleep(1.5)
+            assert not got, \
+                "proof-less acceptor received traffic from an " \
+                "auth-bearing dialer (downgrade)"
+            # a registered authless peer (the mon case) still works
+            dialer2.authless_peers.add(tuple(impersonator.my_addr))
+            dialer2.send_message(MPing(stamp=2.0),
+                                 impersonator.my_addr)
+            assert wait_until(lambda: len(got) > 0, 10), \
+                "registered authless peer was wrongly rejected"
+        finally:
+            dialer.shutdown()
+            dialer2.shutdown()
+            impersonator.shutdown()
+
+
+class TestMonSecretBootGuard:
+    def test_multi_mon_auth_without_mon_secret_refuses_boot(self):
+        """Regression for silent b'' attestation: a multi-mon cluster
+        with the key server armed but no mon shared secret would break
+        every peon-forwarded command — refuse to construct instead."""
+        import os as _os
+
+        from ceph_tpu.auth.keyring import KeyRing
+        from ceph_tpu.mon.monitor import Monitor
+        kr = KeyRing()
+        kr.add("client.admin", caps={"mon": "allow *"})
+        monmap3 = {0: ("127.0.0.1", 0), 1: ("127.0.0.1", 0),
+                   2: ("127.0.0.1", 0)}
+        with pytest.raises(ValueError):
+            Monitor(0, monmap3, keyring=kr,
+                    service_secrets={"osd": _os.urandom(32)})
+        # with the secret present it constructs fine
+        mon = Monitor(0, monmap3, keyring=kr,
+                      service_secrets={"osd": _os.urandom(32),
+                                       "mon": _os.urandom(32)})
+        assert mon._mon_secret is not None
+        # single-mon clusters never forward: legacy construction stays
+        # valid (test_auth.py relies on it)
+        mon1 = Monitor(0, {0: ("127.0.0.1", 0)}, keyring=kr,
+                       service_secrets={"osd": _os.urandom(32)})
+        assert mon1.key_server is not None
+
+
+class TestFaultSetDeterminism:
+    def test_conf_selection_is_seed_stable(self):
+        """The 1-in-N selection is a seeded hash: the same objects are
+        victims on every run (a lying disk lies consistently), and a
+        different seed picks a different victim set."""
+        from ceph_tpu.store.faults import FaultSet
+        f1 = FaultSet(seed=3, eio_one_in=4)
+        f2 = FaultSet(seed=3, eio_one_in=4)
+        f3 = FaultSet(seed=4, eio_one_in=4)
+
+        def victims(f):
+            out = set()
+            for i in range(64):
+                try:
+                    f.check_eio("c", "obj-%d" % i)
+                except OSError:
+                    out.add(i)
+            return out
+        v1, v2, v3 = victims(f1), victims(f2), victims(f3)
+        assert v1 == v2
+        assert v1, "1-in-4 over 64 objects selected nothing"
+        assert v1 != v3, "seed does not influence selection"
+        # bitrot is deterministic per object: same flip every read
+        f = FaultSet()
+        f.mark_bitrot("c", "o")
+        a = f.corrupt("c", "o", 0, b"x" * 100)
+        b = f.corrupt("c", "o", 0, b"x" * 100)
+        assert a == b != b"x" * 100
