@@ -150,6 +150,16 @@ def _declare_defaults():
     o("mon_log_max", int, 500, LEVEL_ADVANCED,
       "cluster log entries the LogMonitor keeps ('ceph log last' "
       "window; mon_cluster_log_* role)")
+    # bluestore / bluefs
+    o("store_fsck_on_umount", bool, True, LEVEL_ADVANCED,
+      "BlockStore.umount() cross-checks BlueFS extents, blob extents "
+      "and the free list for overlap/leak and raises on errors — every "
+      "store test doubles as an allocator check "
+      "(bluestore_fsck_on_umount role; the reference defaults false)")
+    o("bluefs_log_compact_threshold", int, 1 << 20, LEVEL_ADVANCED,
+      "BlueFS journal extent size; when the log outgrows it the file "
+      "table is compacted into a fresh extent "
+      "(bluefs_log_compact_min_size role)")
     # filestore
     o("filestore_compression", str, "none", LEVEL_ADVANCED,
       "checkpoint blob compression: none|zlib|zstd|snappy|lz4")

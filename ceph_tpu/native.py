@@ -119,6 +119,12 @@ def _configure_symbols(L: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p]
     for name in ("ec_tpu_batches_dispatched", "ec_tpu_requests_dispatched"):
         getattr(L, name).restype = ctypes.c_uint64
+    L.ec_gf_isa.restype = ctypes.c_char_p
+    L.ec_gf_isa.argtypes = []
+    L.ec_gf_set_isa.argtypes = [ctypes.c_char_p]
+    L.ec_gf_region_madd.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32,
+        ctypes.c_size_t, ctypes.c_int]
     LL = ctypes.POINTER(ctypes.c_longlong)
     L.ec_crush_do_rule.restype = ctypes.c_int
     L.ec_crush_do_rule.argtypes = [
@@ -157,6 +163,38 @@ def _configure_symbols(L: ctypes.CDLL) -> None:
     L.ec_crush_map_set_choose_args.argtypes = [
         ctypes.c_void_p, LL2, ctypes.c_int, LL2, LL2, LL2, LL2, LL2]
     L.ec_crush_map_clear_choose_args.argtypes = [ctypes.c_void_p]
+
+
+# ---------------------------------------------------------------------------
+# GF kernel SIMD dispatch (runtime cpuid selection in native/src/gf.cc)
+
+
+def gf_isa() -> str:
+    """The ISA the GF region kernels are currently dispatched to:
+    'avx2' | 'ssse3' | 'scalar'."""
+    return lib().ec_gf_isa().decode()
+
+
+def gf_set_isa(name: str) -> bool:
+    """Force a lower-or-equal kernel ISA (parity tests / triage);
+    False if unknown or unsupported on this host. Process-global."""
+    return lib().ec_gf_set_isa(name.encode()) == 0
+
+
+def gf_region_madd(dst, src, g: int, w: int = 8) -> None:
+    """dst[i] ^= g * src[i] through the dispatched native kernel.
+    dst/src are equal-length contiguous uint8 numpy arrays."""
+    import numpy as np
+    if not (isinstance(dst, np.ndarray) and dst.flags["C_CONTIGUOUS"]):
+        raise ValueError("dst must be a contiguous ndarray (mutated "
+                         "in place)")
+    src = np.ascontiguousarray(src)
+    if dst.nbytes != src.nbytes:
+        raise ValueError("dst/src length mismatch")
+    r = lib().ec_gf_region_madd(
+        dst.ctypes.data, src.ctypes.data, g, dst.nbytes, w)
+    if r != 0:
+        raise ValueError("gf_region_madd failed: %d" % r)
 
 
 # ---------------------------------------------------------------------------

@@ -114,6 +114,12 @@ class OSDDaemon(Dispatcher):
         self.tracer = Tracer(conf=conf)
         if self.ctx.admin_socket is not None:
             self.op_tracker.register_admin_commands(self.ctx.admin_socket)
+            # store-specific commands (BlockStore: 'bluefs stats',
+            # 'bluestore fsck' — the reference's asok surface)
+            register_store = getattr(self.store,
+                                     "register_admin_commands", None)
+            if register_store is not None:
+                register_store(self.ctx.admin_socket)
         self.timer = SafeTimer("osd%d-timer" % whoami)
         # cross-op EC device-call coalescing (osd/tpu_dispatch.py):
         # concurrent PG encodes sharing a codec ride one dispatch

@@ -12,9 +12,14 @@
                 metadata, per-chunk checksums, deferred small writes,
                 COW clones — the BlueStore analog
                 (src/os/bluestore/BlueStore.cc, doc/dev/bluestore.rst)
+  bluefs        BlueFS: the mini-filesystem embedded in BlockStore's
+                device — superblock + replayable journal + file table,
+                sharing the store's allocator; hosts the metadata KV
+                (src/os/bluestore/BlueFS.cc)
   k_store       KStore: everything-in-kv backend (stripe keys for
                 data, prefixed metadata) — src/os/kstore/KStore.cc
-  kv            KeyValueDB interface + MemDB + persistent FileDB
+  kv            KeyValueDB interface + MemDB + persistent FileDB +
+                BlueFSDB (WAL + sorted table hosted in BlueFS)
                 (src/kv/)
 """
 
@@ -22,8 +27,10 @@ from .object_store import ObjectStore, Transaction
 from .mem_store import MemStore
 from .file_store import FileStore
 from .block_store import BlockStore
+from .bluefs import BlueFS
 from .k_store import KStore
-from .kv import FileDB, KeyValueDB, MemDB
+from .kv import BlueFSDB, FileDB, KeyValueDB, MemDB
 
 __all__ = ["ObjectStore", "Transaction", "MemStore", "FileStore",
-           "BlockStore", "KStore", "KeyValueDB", "MemDB", "FileDB"]
+           "BlockStore", "BlueFS", "KStore", "KeyValueDB", "MemDB",
+           "FileDB", "BlueFSDB"]

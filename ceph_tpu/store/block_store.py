@@ -32,6 +32,14 @@ overwrites punch the cloned range and write new blobs, never touching
 shared bytes. Space from fully-unreferenced blobs returns to the
 allocator, whose free map is rebuilt from blob metadata at mount
 (fsck-on-mount style, like modern BlueStore's NCB allocation recovery).
+
+The metadata KV itself lives INSIDE the block device: BlueFSDB's WAL
+and sorted table are BlueFS files (store/bluefs.py) allocating from
+the same FreeList as the data blobs, so the store is one self-contained
+file — superblock at block 0, BlueFS journal, KV files, data blobs —
+and fsck() cross-checks all of their extents plus the free list for
+overlap and leak. Legacy stores with a `db/` sidecar FileDB migrate
+into the device on first mount (the sidecar disappears).
 """
 
 from __future__ import annotations
@@ -41,10 +49,12 @@ import threading
 import zlib
 
 from .. import encoding
+from ..common.options import SCHEMA
 from ..compressor import compress_if_worthwhile
 from ..compressor import create as compressor_create
+from .bluefs import BLOCK, BlueFS
 from .faults import FaultSet
-from .kv import FileDB
+from .kv import BlueFSDB
 from .object_store import ObjectStore, Transaction
 
 __all__ = ["BlockStore", "FreeList"]
@@ -71,8 +81,27 @@ class FreeList:
         if device_size:
             self._free.append([0, device_size])
 
-    def allocate(self, want: int, align: int = MIN_ALLOC) -> int:
+    def allocate(self, want: int, align: int = MIN_ALLOC,
+                 hint_high: bool = False) -> int:
+        """First-fit from the bottom; hint_high carves from the TOP of
+        free space instead — BlueFS allocates high so the metadata KV's
+        files never fragment the low region where blob data first-fits
+        (the role of BlueStore's bluefs allocation hinting)."""
         want = -(-want // align) * align
+        if hint_high:
+            for ext in reversed(self._free):
+                if ext[1] >= want:
+                    ext[1] -= want
+                    off = ext[0] + ext[1]
+                    if ext[1] == 0:
+                        self._free.remove(ext)
+                    return off
+            old = self.device_size
+            self.device_size += max(want, 4 * 1024 * 1024)
+            off = self.device_size - want
+            if off > old:
+                self.release(old, off - old)
+            return off
         for ext in self._free:
             if ext[1] >= want:
                 off = ext[0]
@@ -104,6 +133,16 @@ class FreeList:
                 self._free[i][0] + self._free[i][1] == self._free[i + 1][0]:
             self._free[i][1] += self._free[i + 1][1]
             del self._free[i + 1]
+
+    def ensure_device(self, end: int, align: int = MIN_ALLOC) -> None:
+        """Grow the device to cover [0, end), releasing the gap as
+        free space (mount rebuild: extents discovered in metadata may
+        sit past the rounded file size)."""
+        end = -(-end // align) * align
+        if end > self.device_size:
+            old = self.device_size
+            self.device_size = end
+            self.release(old, end - old)
 
     def mark_used(self, off: int, length: int) -> None:
         """Carve [off, off+len) out of the free map (mount rebuild)."""
@@ -208,19 +247,32 @@ class BlockStore(ObjectStore):
                  deferred_max: int = DEFERRED_MAX,
                  compression: str = "none",
                  compression_required_ratio: float = 0.875,
-                 finisher=None):
+                 finisher=None,
+                 fsck_on_umount: bool | None = None,
+                 bluefs_compact_threshold: int | None = None,
+                 kv_compact_threshold: int = 8 << 20):
         self.path = path
         self.block_path = os.path.join(path, "block")
         self.min_alloc = min_alloc
         self.csum_chunk = csum_chunk
         self.deferred_max = deferred_max
         self.block_sync = block_sync
+        self.kv_sync = kv_sync
         self._compressor = compressor_create(compression)
         self._required_ratio = compression_required_ratio
         self._decompressors: dict = {}
         self._finisher = finisher
         self._lock = threading.RLock()
-        self.db = FileDB(os.path.join(path, "db"), log_sync=kv_sync)
+        if fsck_on_umount is None:
+            fsck_on_umount = SCHEMA["store_fsck_on_umount"].default
+        self.fsck_on_umount = fsck_on_umount
+        if bluefs_compact_threshold is None:
+            bluefs_compact_threshold = \
+                SCHEMA["bluefs_log_compact_threshold"].default
+        self.bluefs_compact_threshold = bluefs_compact_threshold
+        self.kv_compact_threshold = kv_compact_threshold
+        self.db: BlueFSDB | None = None
+        self.bluefs: BlueFS | None = None
         self._fd: int | None = None
         self.allocator = FreeList()
         self._colls: dict = {}           # ckey -> cid
@@ -228,47 +280,148 @@ class BlockStore(ObjectStore):
         self._blobs: dict = {}           # bid -> _Blob
         self._next_blob = 1
         self._deferred_seq = 1
+        self._deferred_recs: dict = {}   # seq -> (poff, len) pending
         self.faults = FaultSet()
+        self.sync_hook = None            # crash-harness: fires per fsync
         self.mounted = False
 
     # -- lifecycle -----------------------------------------------------
 
+    def _device_sync(self, want_sync: bool = True) -> None:
+        """Every durability point on the device funnels through here,
+        so a crash harness can hook each sync and snapshot the image."""
+        if want_sync:
+            os.fsync(self._fd)
+        hook = self.sync_hook
+        if hook is not None:
+            hook()
+
+    def mkfs(self) -> None:
+        """Lay down a fresh self-contained device: superblock, BlueFS
+        journal, empty metadata KV — no db/ sidecar directory
+        (BlueStore mkfs). Mounting a virgin path does this implicitly."""
+        self.mount()
+        self.umount()
+
     def mount(self) -> None:
         os.makedirs(self.path, exist_ok=True)
-        self.db.open()
         self._fd = os.open(self.block_path, os.O_RDWR | os.O_CREAT, 0o644)
+        self._colls, self._onodes, self._blobs = {}, {}, {}
+        self._next_blob = 1
+        self._deferred_seq = 1
+        self._deferred_recs = {}
+        file_size = os.fstat(self._fd).st_size
+        device = -(-max(file_size, BLOCK) // MIN_ALLOC) * MIN_ALLOC
+        self.allocator = FreeList(device)
+        self.allocator.mark_used(0, BLOCK)     # the superblock block
+        self.bluefs = BlueFS(
+            self._fd, self.allocator, sync=self.kv_sync,
+            sync_fn=self._device_sync, faults=self.faults,
+            compact_threshold=self.bluefs_compact_threshold)
+        sidecar = os.path.join(self.path, "db")
+        if self.bluefs.has_superblock():
+            self.bluefs.mount()
+            self.db = BlueFSDB(
+                self.bluefs, log_sync=self.kv_sync,
+                compact_threshold=self.kv_compact_threshold).open()
+        elif os.path.isdir(sidecar):
+            # legacy sidecar-FileDB store: one-shot migration into the
+            # device (the sidecar directory disappears)
+            self._migrate_sidecar(sidecar)
+        else:
+            self.bluefs.mkfs()
+            self.db = BlueFSDB(
+                self.bluefs, log_sync=self.kv_sync,
+                compact_threshold=self.kv_compact_threshold).open()
         for key, raw in self.db.get_iterator("C"):
             self._colls[key] = encoding.decode_any(raw)
         for key, raw in self.db.get_iterator("O"):
             self._onodes[key] = _Onode.from_doc(encoding.decode_any(raw))
-        max_end = 0
         for key, raw in self.db.get_iterator("B"):
             blob = _Blob.from_doc(int(key), encoding.decode_any(raw))
             self._blobs[blob.bid] = blob
             self._next_blob = max(self._next_blob, blob.bid + 1)
-            max_end = max(max_end, blob.poff + blob.alen)
-        # fsck-style allocator rebuild: free = device minus live blobs.
-        # The device extent is the real file high-water mark, so holes
-        # left by deleted blobs (anywhere below it) come back as free
-        # space instead of being forgotten.
-        file_size = os.fstat(self._fd).st_size
-        device = -(-max(max_end, file_size) // MIN_ALLOC) * MIN_ALLOC
-        self.allocator = FreeList(device)
-        for blob in self._blobs.values():
+            # fsck-style allocator rebuild: free = device minus the
+            # superblock, BlueFS extents (marked at bluefs mount), and
+            # live blobs — holes left by deleted blobs come back free
+            self.allocator.ensure_device(blob.poff + blob.alen)
             self.allocator.mark_used(blob.poff, blob.alen)
         # replay outstanding deferred writes (idempotent: absolute offs)
         for key, raw in self.db.get_iterator("D"):
             rec = encoding.decode_any(raw)
             os.pwrite(self._fd, rec["data"], rec["poff"])
-            self._deferred_seq = max(self._deferred_seq,
-                                     int(key) + 1)
+            self._deferred_seq = max(self._deferred_seq, int(key) + 1)
+            self._deferred_recs[int(key)] = (rec["poff"],
+                                             len(rec["data"]))
         self.mounted = True
+
+    def _migrate_sidecar(self, sidecar: str) -> None:
+        """Swallow a pre-BlueFS store: the sidecar FileDB's contents
+        move into a freshly-mkfs'd in-device KV, a blob squatting on
+        the superblock block is relocated, and the sidecar directory
+        is removed. One-shot; the next mount takes the normal path."""
+        import shutil
+
+        from .kv import FileDB
+        old = FileDB(sidecar, log_sync=False).open()
+        # prime the allocator with every legacy blob so BlueFS and the
+        # relocation below only allocate from genuinely free space
+        blob_docs: dict[str, dict] = {}
+        for key, raw in old.get_iterator("B"):
+            doc = encoding.decode_any(raw)
+            blob_docs[key] = doc
+            self.allocator.ensure_device(doc["poff"] + doc["alen"])
+            self.allocator.mark_used(doc["poff"], doc["alen"])
+        remaps: list[tuple[int, int, int]] = []   # (old, len, new)
+        for doc in blob_docs.values():
+            if doc["poff"] >= BLOCK:
+                continue
+            # legacy stores allocated from offset 0: move the blob off
+            # the superblock block
+            stored = os.pread(self._fd, doc["clen"], doc["poff"])
+            if len(stored) < doc["clen"]:
+                stored += b"\0" * (doc["clen"] - len(stored))
+            new_off = self.allocator.allocate(doc["alen"], MIN_ALLOC)
+            os.pwrite(self._fd, stored, new_off)
+            remaps.append((doc["poff"], doc["alen"], new_off))
+            old_end = doc["poff"] + doc["alen"]
+            if old_end > BLOCK:    # keep block 0 reserved
+                self.allocator.release(BLOCK, old_end - BLOCK)
+            doc["poff"] = new_off
+        self.bluefs.mkfs()
+        self.db = BlueFSDB(
+            self.bluefs, log_sync=self.kv_sync,
+            compact_threshold=self.kv_compact_threshold).open()
+        batch = self.db.get_transaction()
+        for prefix in sorted(old._data):
+            for key, val in old.get_iterator(prefix):
+                if prefix == "B":
+                    val = encoding.encode_any(blob_docs[key])
+                elif prefix == "D":
+                    rec = encoding.decode_any(val)
+                    for ooff, oln, noff in remaps:
+                        if ooff <= rec["poff"] < ooff + oln:
+                            rec["poff"] += noff - ooff
+                            val = encoding.encode_any(rec)
+                            break
+                batch.set(prefix, key, val)
+        self.db.submit_transaction(batch)
+        self.db.compact()
+        old._log.close()           # no parting checkpoint: dir dies now
+        shutil.rmtree(sidecar)
 
     def umount(self) -> None:
         if not self.mounted:
             return
         self.sync()
+        if self.fsck_on_umount:
+            errs = self.fsck()
+            if errs:
+                raise RuntimeError(
+                    "fsck on umount found %d error(s): %s"
+                    % (len(errs), "; ".join(errs[:8])))
         self.db.close()
+        self.bluefs.umount()
         if self._fd is not None:
             os.close(self._fd)
             self._fd = None
@@ -278,10 +431,11 @@ class BlockStore(ObjectStore):
         """Make the block file durable and retire the deferred records
         it now covers (BlueStore _deferred_submit + kv cleanup)."""
         with self._lock:
-            os.fsync(self._fd)
+            self._device_sync()
             batch = self.db.get_transaction()
             batch.rmkeys_by_prefix("D")
             self.db.submit_transaction(batch)
+            self._deferred_recs.clear()
 
     # -- fault injection (scrub/thrash parity with MemStore) ----------
 
@@ -314,7 +468,7 @@ class BlockStore(ObjectStore):
                 # op itself mutates nothing before raising
                 self._pending_deferred = None
                 if flush_before_commit and self.block_sync:
-                    os.fsync(self._fd)
+                    self._device_sync()
                 self.db.submit_transaction(batch)
                 for poff, data in deferred:
                     os.pwrite(self._fd, data, poff)
@@ -323,7 +477,7 @@ class BlockStore(ObjectStore):
             # big-write bytes must be on disk before the kv commit that
             # references them survives a crash
             if flush_before_commit and self.block_sync:
-                os.fsync(self._fd)
+                self._device_sync()
             self.db.submit_transaction(batch)
             # deferred bytes apply AFTER their kv record is durable
             for poff, data in deferred:
@@ -505,6 +659,15 @@ class BlockStore(ObjectStore):
                 pend[:] = [d for d in pend
                            if d[0] + len(d[1]) <= blob.poff
                            or d[0] >= blob.poff + blob.alen]
+            # and retire OUTSTANDING deferred records targeting the
+            # freed range — without this, mount replay would scribble
+            # stale bytes over whatever the allocator hands the space
+            # to next (the deferred-replay-vs-realloc crash bug)
+            for seq, (dpoff, dlen) in list(self._deferred_recs.items()):
+                if dpoff + dlen > blob.poff and \
+                        dpoff < blob.poff + blob.alen:
+                    batch.rmkey("D", "%016d" % seq)
+                    del self._deferred_recs[seq]
         else:
             self._put_blob(blob, batch)
 
@@ -573,6 +736,7 @@ class BlockStore(ObjectStore):
                 self._deferred_seq += 1
                 batch.set("D", "%016d" % seq, encoding.encode_any(
                     {"poff": blob.poff + woff, "data": data}))
+                self._deferred_recs[seq] = (blob.poff + woff, len(data))
                 deferred.append([blob.poff + woff, data])
                 onode.size = max(onode.size, off + len(data))
                 self._put_onode(onode, batch)
@@ -780,6 +944,102 @@ class BlockStore(ObjectStore):
             batch.rmkey("M", mkey)
             batch.set("M", dkey + mkey[len(skey):], raw)
 
+    # -- fsck ----------------------------------------------------------
+
+    def fsck(self) -> list[str]:
+        """Cross-check every byte-owner on the device — superblock,
+        BlueFS journal, BlueFS files, data blobs, and the free list —
+        for overlap and leak, plus metadata invariants (blob refcounts
+        vs onode extents, csum coverage, deferred-record targets,
+        omap orphans). Returns a list of error strings; [] is clean
+        (BlueStore _fsck at framework scale)."""
+        errs: list[str] = []
+        with self._lock:
+            used: list[tuple[int, int, str]] = [(0, BLOCK, "superblock")]
+            if self.bluefs is not None and self.bluefs.mounted:
+                used += self.bluefs.used_extents()
+            for bid, blob in self._blobs.items():
+                used.append((blob.poff, blob.alen, "blob:%d" % bid))
+            spans = used + [(off, ln, "free")
+                            for off, ln in self.allocator._free]
+            spans.sort()
+            pos = 0
+            prev = ("", 0, "start")
+            for off, ln, who in spans:
+                if off < pos:
+                    errs.append("extent overlap: %s [0x%x,+0x%x) vs "
+                                "%s" % (who, off, ln, prev[2]))
+                elif off > pos:
+                    errs.append("leaked space: [0x%x,+0x%x) owned by "
+                                "nobody" % (pos, off - pos))
+                pos = max(pos, off + ln)
+                prev = (off, ln, who)
+            if pos < self.allocator.device_size:
+                errs.append("leaked space: [0x%x,+0x%x) at device tail"
+                            % (pos, self.allocator.device_size - pos))
+            elif pos > self.allocator.device_size:
+                errs.append("extent past device end: 0x%x > 0x%x"
+                            % (pos, self.allocator.device_size))
+            # blob refcounts vs the extents that reference them
+            refs: dict[int, int] = {}
+            for okey, onode in self._onodes.items():
+                for loff, elen, bid, boff in onode.extents:
+                    refs[bid] = refs.get(bid, 0) + 1
+                    blob = self._blobs.get(bid)
+                    if blob is None:
+                        errs.append("onode %s references missing blob "
+                                    "%d" % (okey[:16], bid))
+                        continue
+                    if boff + elen > blob.raw:
+                        errs.append("onode %s extent past blob %d raw "
+                                    "end" % (okey[:16], bid))
+                    if loff + elen > onode.size:
+                        errs.append("onode %s extent past object size"
+                                    % okey[:16])
+            for bid, blob in self._blobs.items():
+                want = refs.get(bid, 0)
+                if blob.refs != want:
+                    errs.append("blob %d refcount %d != %d referencing "
+                                "extents" % (bid, blob.refs, want))
+                nchunks = -(-blob.clen // self.csum_chunk) \
+                    if blob.clen else 0
+                if len(blob.csums) != nchunks:
+                    errs.append("blob %d has %d csums for %d chunks"
+                                % (bid, len(blob.csums), nchunks))
+            # outstanding deferred records must target live blob space
+            for key, raw in self.db.get_iterator("D"):
+                rec = encoding.decode_any(raw)
+                dpoff, dlen = rec["poff"], len(rec["data"])
+                if not any(b.poff <= dpoff and
+                           dpoff + dlen <= b.poff + b.alen
+                           for b in self._blobs.values()):
+                    errs.append("deferred record %s targets "
+                                "[0x%x,+0x%x) outside any blob"
+                                % (key, dpoff, dlen))
+            # omap rows must belong to a live onode
+            for mkey, _ in self.db.get_iterator("M"):
+                okey = mkey.split(":", 1)[0]
+                if okey not in self._onodes:
+                    errs.append("orphan omap row under %s" % okey[:16])
+        return errs
+
+    # -- admin socket (bluefs stats / fsck) ----------------------------
+
+    def register_admin_commands(self, asok) -> None:
+        asok.register("bluefs stats", lambda args: self.bluefs_stats(),
+                      "BlueFS layout, usage and l_bluefs_* counters")
+        asok.register("bluestore fsck",
+                      lambda args: {"errors": self.fsck()},
+                      "cross-check extents, blobs and the free list")
+
+    def bluefs_stats(self) -> dict:
+        with self._lock:
+            return {
+                "bluefs": self.bluefs.stats(),
+                "perf": self.bluefs.perf.dump(),
+                "store": self.stats(),
+            }
+
     # -- introspection (tests / objectstore tool) ----------------------
 
     def stats(self) -> dict:
@@ -789,4 +1049,8 @@ class BlockStore(ObjectStore):
                 "free_bytes": self.allocator.free_bytes(),
                 "blobs": len(self._blobs),
                 "onodes": len(self._onodes),
+                "bluefs_used_bytes":
+                    self.bluefs.used_bytes()
+                    if self.bluefs is not None and self.bluefs.mounted
+                    else 0,
             }

@@ -1,0 +1,445 @@
+"""BlueFS — the mini-filesystem embedded in BlockStore's block device.
+
+Role of the reference's BlueFS (src/os/bluestore/BlueFS.{h,cc}, design
+per doc/dev/bluestore.rst): a minimal log-structured filesystem living
+INSIDE the managed block device, sharing the store's allocator, whose
+only job is to host the metadata KV (RocksDB there, BlueFSDB here).
+With it, BlockStore is self-contained: one file on the host holds the
+superblock, the BlueFS journal, the KV's WAL + sorted tables, and the
+object data blobs — one allocator accounts for every byte, and fsck
+can cross-check all of them for overlap and leak.
+
+Layout and crash story:
+
+  superblock   block 0, rewritten in one aligned block write (the
+               reference's bluefs_super_t): magic + crc-guarded doc
+               naming the journal extent. The ONLY fixed location.
+  journal      one allocator extent of crc-framed records, each an op
+               list replayed at mount to rebuild the file table
+               (op_file_update / op_dir_link analogs). When the log
+               outgrows its extent the table is compacted: snapshot
+               into a fresh extent, superblock repointed, old extent
+               freed — and the old journal stays valid until the
+               superblock write lands, so a crash at any point replays
+               a consistent table (BlueFS _compact_log_sync).
+  files        flat namespace (the KV's db.wal / db.sst); extents come
+               from the SHARED FreeList, data writes are block-aligned
+               (O_DIRECT-style: appends rewrite the tail block whole).
+
+Durability rule: extents are never released to the allocator before
+the journal record dropping them is durable — otherwise a reallocated
+extent could be overwritten by a writer whose crash-replay still
+claims the space (the overlap class fsck exists to catch).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+from .. import encoding
+from ..common.perf_counters import PerfCountersBuilder
+from .wal import frame, parse_frames
+
+__all__ = ["BlueFS", "BlueFSWriter", "BLOCK", "SUPER_MAGIC"]
+
+BLOCK = 4096                      # alignment unit == bluestore min_alloc
+SUPER_MAGIC = b"ECTPUBFS"         # 8-byte superblock magic
+_SUPER_HDR = struct.Struct("<II")  # payload length, crc
+
+
+def _align(n: int) -> int:
+    return -(-n // BLOCK) * BLOCK
+
+
+class _BFile:
+    """One BlueFS file: logical size + ordered extent list (the
+    reference's bluefs_fnode_t at framework scale)."""
+
+    __slots__ = ("name", "size", "extents", "dirty")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.size = 0
+        self.extents: list[list[int]] = []   # [off, len], device space
+        self.dirty = True                    # not yet journaled
+
+    def capacity(self) -> int:
+        return sum(ln for _, ln in self.extents)
+
+
+class BlueFSWriter:
+    """Append-only handle; bytes buffer in memory until fsync lands
+    them (data write + journaled size/extent update + one device sync).
+    """
+
+    __slots__ = ("fs", "name", "_buf")
+
+    def __init__(self, fs: "BlueFS", name: str):
+        self.fs = fs
+        self.name = name
+        self._buf = bytearray()
+
+    def append(self, data) -> None:
+        self._buf += data
+
+    def tell(self) -> int:
+        return self.fs._files[self.name].size + len(self._buf)
+
+    def fsync(self) -> None:
+        self.fs._flush_writer(self)
+
+
+class BlueFS:
+    TRIP_COMPACT_MID = "bluefs_journal_compact_mid"
+
+    def __init__(self, fd: int, allocator, sync: bool = True,
+                 sync_fn=None, compact_threshold: int = 1 << 20,
+                 faults=None):
+        self._fd = fd
+        self.alloc = allocator
+        self.sync = sync
+        self._sync_fn = sync_fn          # callable(force: bool) | None
+        self.compact_threshold = compact_threshold
+        self.faults = faults
+        self._files: dict[str, _BFile] = {}
+        self.journal_extent: list[int] | None = None   # [off, cap]
+        self._journal_used = 0
+        self._super_seq = 0
+        self.mounted = False
+        self.perf = (
+            PerfCountersBuilder("bluefs")
+            .add_u64_counter("l_bluefs_journal_bytes")
+            .add_u64_counter("l_bluefs_journal_compactions")
+            .add_u64_counter("l_bluefs_bytes_written")
+            .add_u64_counter("l_bluefs_bytes_read")
+            .add_u64_counter("l_bluefs_renames")
+            .add_u64_counter("l_bluefs_unlinks")
+            .add_u64("l_bluefs_num_files")
+            .add_u64("l_bluefs_used_bytes")
+            .add_u64("l_bluefs_log_bytes")
+            .create_perf_counters())
+
+    # -- device sync ---------------------------------------------------
+
+    def _sync(self) -> None:
+        if self._sync_fn is not None:
+            self._sync_fn(self.sync)
+        elif self.sync:
+            os.fsync(self._fd)
+
+    # -- superblock ----------------------------------------------------
+
+    def _read_super(self) -> dict | None:
+        try:
+            blk = os.pread(self._fd, BLOCK, 0)
+        except OSError:
+            return None
+        if len(blk) < len(SUPER_MAGIC) + _SUPER_HDR.size or \
+                not blk.startswith(SUPER_MAGIC):
+            return None
+        length, crc = _SUPER_HDR.unpack_from(blk, len(SUPER_MAGIC))
+        start = len(SUPER_MAGIC) + _SUPER_HDR.size
+        payload = blk[start:start + length]
+        if len(payload) < length or zlib.crc32(payload) != crc:
+            return None
+        try:
+            return encoding.decode_any(payload)
+        except Exception:
+            return None
+
+    def _write_super(self) -> None:
+        doc = {"version": 1, "block_size": BLOCK,
+               "journal": list(self.journal_extent),
+               "seq": self._super_seq}
+        payload = encoding.encode_any(doc)
+        blk = (SUPER_MAGIC
+               + _SUPER_HDR.pack(len(payload), zlib.crc32(payload))
+               + payload)
+        if len(blk) > BLOCK:
+            raise RuntimeError("bluefs superblock overflow")
+        os.pwrite(self._fd, blk.ljust(BLOCK, b"\0"), 0)
+        self._sync()
+
+    def has_superblock(self) -> bool:
+        return self._read_super() is not None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def mkfs(self) -> None:
+        # the journal extent starts small and is resized adaptively at
+        # compaction; compact_threshold is the outgrow TRIGGER, not the
+        # size — a fresh store must not pin a megabyte of device
+        cap = _align(min(max(self.compact_threshold, 4 * BLOCK),
+                         16 * BLOCK))
+        off = self.alloc.allocate(cap, BLOCK, hint_high=True)
+        self.journal_extent = [off, cap]
+        self._journal_used = 0
+        self._files = {}
+        self._super_seq = 1
+        self._write_super()
+        self.mounted = True
+        self._gauges()
+
+    def mount(self) -> None:
+        doc = self._read_super()
+        if doc is None:
+            raise RuntimeError("no bluefs superblock on device")
+        self.journal_extent = [int(doc["journal"][0]),
+                               int(doc["journal"][1])]
+        self._super_seq = int(doc.get("seq", 1))
+        joff, jcap = self.journal_extent
+        self.alloc.ensure_device(joff + jcap)
+        self.alloc.mark_used(joff, jcap)
+        raw = os.pread(self._fd, jcap, joff)
+        blobs, valid_end = parse_frames(raw)
+        self._files = {}
+        for blob in blobs:
+            for op in encoding.decode_any(blob):
+                self._replay_op(op)
+        self._journal_used = valid_end
+        for f in self._files.values():
+            f.dirty = False
+            for off, ln in f.extents:
+                self.alloc.ensure_device(off + ln)
+                self.alloc.mark_used(off, ln)
+        self.mounted = True
+        self._gauges()
+
+    def umount(self) -> None:
+        self.mounted = False
+
+    def _replay_op(self, op) -> None:
+        kind = op[0]
+        if kind == "update":
+            _, name, size, extents = op
+            f = self._files.get(name)
+            if f is None:
+                f = self._files[name] = _BFile(name)
+            f.size = int(size)
+            f.extents = [[int(o), int(n)] for o, n in extents]
+        elif kind == "rename":
+            _, old, new = op
+            f = self._files.pop(old, None)
+            if f is not None:           # tolerant: compaction snapshot
+                f.name = new            # may already hold the new name
+                self._files[new] = f
+        elif kind == "unlink":
+            self._files.pop(op[1], None)
+        else:
+            raise RuntimeError("bluefs journal: unknown op %r" % kind)
+
+    # -- journal -------------------------------------------------------
+
+    def _journal_append(self, ops) -> None:
+        buf = frame(encoding.encode_any(ops))
+        if self._journal_used + len(buf) > self.journal_extent[1] or \
+                self._journal_used > self.compact_threshold:
+            # the log outgrew its extent (or the configured threshold):
+            # compact, then the op (already reflected in the snapshot)
+            # appends as an idempotent echo
+            self._compact_journal(need=len(buf))
+        os.pwrite(self._fd, buf,
+                  self.journal_extent[0] + self._journal_used)
+        self._journal_used += len(buf)
+        self.perf.inc("l_bluefs_journal_bytes", len(buf))
+        self.perf.set("l_bluefs_log_bytes", self._journal_used)
+        self._sync()
+
+    def compact_journal(self) -> None:
+        self._compact_journal()
+
+    def _compact_journal(self, need: int = 0) -> None:
+        ops = [("update", name, f.size,
+                [list(e) for e in f.extents])
+               for name, f in sorted(self._files.items())]
+        buf = frame(encoding.encode_any(ops))
+        cap = _align(max((len(buf) + need) * 2, 16 * BLOCK))
+        off = self.alloc.allocate(cap, BLOCK, hint_high=True)
+        try:
+            os.pwrite(self._fd, buf, off)
+            self._sync()                 # snapshot durable BEFORE the
+            if self.faults is not None:  # superblock points at it
+                self.faults.check_trip(self.TRIP_COMPACT_MID)
+            old = self.journal_extent
+            self.journal_extent = [off, cap]
+            self._journal_used = len(buf)
+            self._super_seq += 1
+            self._write_super()
+        except BaseException:
+            # mid-compaction failure (injected EIO / crash rehearsal):
+            # the superblock still points at the old journal, so the
+            # new extent is garbage — hand it back, state unchanged
+            self.alloc.release(off, cap)
+            raise
+        # old journal released only now, with the new superblock durable
+        self.alloc.release(old[0], old[1])
+        self.perf.inc("l_bluefs_journal_compactions")
+        self.perf.set("l_bluefs_log_bytes", self._journal_used)
+
+    def dump_journal(self) -> list:
+        """Decode every valid journal record (bluefs-log-dump)."""
+        joff, jcap = self.journal_extent
+        raw = os.pread(self._fd, jcap, joff)
+        blobs, _ = parse_frames(raw)
+        return [encoding.decode_any(b) for b in blobs]
+
+    # -- extent I/O ----------------------------------------------------
+
+    def _map_extents(self, extents, loff: int, length: int):
+        """Yield (device_off, len) pieces covering logical range."""
+        pos = 0
+        end = loff + length
+        for off, ln in extents:
+            seg_start, seg_end = pos, pos + ln
+            s = max(seg_start, loff)
+            e = min(seg_end, end)
+            if s < e:
+                yield off + (s - seg_start), e - s
+            pos = seg_end
+            if pos >= end:
+                break
+
+    def _pread_extents(self, f: _BFile, loff: int, length: int) -> bytes:
+        out = bytearray()
+        for doff, ln in self._map_extents(f.extents, loff, length):
+            piece = os.pread(self._fd, ln, doff)
+            if len(piece) < ln:          # allocated but never written
+                piece += b"\0" * (ln - len(piece))
+            out += piece
+        if len(out) < length:
+            out += b"\0" * (length - len(out))
+        return bytes(out)
+
+    def _pwrite_extents(self, f: _BFile, loff: int, data: bytes) -> None:
+        pos = 0
+        for doff, ln in self._map_extents(f.extents, loff, len(data)):
+            os.pwrite(self._fd, data[pos:pos + ln], doff)
+            pos += ln
+        if pos < len(data):
+            raise RuntimeError("bluefs write past allocated capacity")
+
+    # -- file API ------------------------------------------------------
+
+    def exists(self, name: str) -> bool:
+        return name in self._files
+
+    def stat(self, name: str) -> int:
+        return self._files[name].size
+
+    def listdir(self) -> list[str]:
+        return sorted(self._files)
+
+    def open_for_write(self, name: str, append: bool = True) \
+            -> BlueFSWriter:
+        f = self._files.get(name)
+        if f is None:
+            f = self._files[name] = _BFile(name)
+        elif not append:
+            # truncate: journal the drop FIRST, release extents after —
+            # a reallocated extent must never be claimed by a stale
+            # crash-replay of this file
+            old_extents = f.extents
+            f.extents = []
+            f.size = 0
+            f.dirty = False
+            self._journal_append([("update", name, 0, [])])
+            for off, ln in old_extents:
+                self.alloc.release(off, ln)
+            self._gauges()
+        return BlueFSWriter(self, name)
+
+    def read_file(self, name: str, off: int = 0,
+                  length: int | None = None) -> bytes:
+        f = self._files[name]
+        if length is None:
+            length = max(0, f.size - off)
+        length = max(0, min(length, f.size - off))
+        data = self._pread_extents(f, off, length)
+        self.perf.inc("l_bluefs_bytes_read", len(data))
+        return data
+
+    def rename(self, old: str, new: str) -> None:
+        f = self._files.pop(old)
+        victim = self._files.get(new)
+        f.name = new
+        self._files[new] = f
+        self._journal_append([("rename", old, new)])
+        if victim is not None:
+            for off, ln in victim.extents:
+                self.alloc.release(off, ln)
+        self.perf.inc("l_bluefs_renames")
+        self._gauges()
+
+    def unlink(self, name: str) -> None:
+        f = self._files.pop(name)
+        self._journal_append([("unlink", name)])
+        for off, ln in f.extents:
+            self.alloc.release(off, ln)
+        self.perf.inc("l_bluefs_unlinks")
+        self._gauges()
+
+    def _flush_writer(self, w: BlueFSWriter) -> None:
+        f = self._files.get(w.name)
+        if f is None:
+            raise RuntimeError("bluefs file %r unlinked under writer"
+                               % w.name)
+        data = bytes(w._buf)
+        del w._buf[:]
+        if data:
+            start = f.size
+            astart = start - start % BLOCK
+            tail = (self._pread_extents(f, astart, start - astart)
+                    if start % BLOCK else b"")
+            end = start + len(data)
+            cap = f.capacity()
+            if end > cap:
+                add = _align(end - cap)
+                off = self.alloc.allocate(add, BLOCK, hint_high=True)
+                if f.extents and \
+                        f.extents[-1][0] + f.extents[-1][1] == off:
+                    f.extents[-1][1] += add
+                else:
+                    f.extents.append([off, add])
+            payload = tail + data
+            self._pwrite_extents(f, astart, payload)
+            f.size = end
+            self.perf.inc("l_bluefs_bytes_written", len(payload))
+        elif not f.dirty:
+            self._sync()
+            return
+        f.dirty = False
+        self._journal_append([
+            ("update", f.name, f.size, [list(e) for e in f.extents])])
+        self._gauges()
+
+    # -- introspection -------------------------------------------------
+
+    def used_extents(self) -> list[tuple[int, int, str]]:
+        out = [(self.journal_extent[0], self.journal_extent[1],
+                "bluefs:journal")]
+        for name, f in self._files.items():
+            for off, ln in f.extents:
+                out.append((off, ln, "bluefs:%s" % name))
+        return out
+
+    def used_bytes(self) -> int:
+        return self.journal_extent[1] + sum(
+            f.capacity() for f in self._files.values())
+
+    def _gauges(self) -> None:
+        self.perf.set("l_bluefs_num_files", len(self._files))
+        self.perf.set("l_bluefs_used_bytes", self.used_bytes())
+
+    def stats(self) -> dict:
+        return {
+            "journal_offset": self.journal_extent[0],
+            "journal_capacity": self.journal_extent[1],
+            "journal_used": self._journal_used,
+            "superblock_seq": self._super_seq,
+            "files": {name: {"size": f.size,
+                             "extents": [list(e) for e in f.extents]}
+                      for name, f in sorted(self._files.items())},
+            "used_bytes": self.used_bytes(),
+        }

@@ -43,6 +43,7 @@ class FaultSet:
         self.bitrot_one_in = bitrot_one_in
         self._eio: set = set()        # explicit (cid, oid) EIO marks
         self._bitrot: set = set()     # explicit (cid, oid) bitrot marks
+        self._trips: dict = {}        # trip point -> remaining count
 
     def configure(self, conf) -> None:
         """Adopt the objectstore_inject_* knobs from a Context conf
@@ -82,6 +83,24 @@ class FaultSet:
         key = (cid, oid)
         self._eio.discard(key)
         self._bitrot.discard(key)
+
+    # -- trip points (write-path EIO at named code sites) --------------
+
+    def arm_trip(self, point: str, count: int = 1) -> None:
+        """The next `count` passages of the named code site raise EIO —
+        the device failing mid-operation (e.g. mid BlueFS journal
+        compaction), not just on reads. Sites declare themselves by
+        calling check_trip()."""
+        self._trips[point] = count
+
+    def check_trip(self, point: str) -> None:
+        n = self._trips.get(point, 0)
+        if n > 0:
+            if n == 1:
+                del self._trips[point]
+            else:
+                self._trips[point] = n - 1
+            raise OSError(5, "injected EIO at %s" % point)
 
     # -- selection -----------------------------------------------------
 

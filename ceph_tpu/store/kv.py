@@ -1,4 +1,4 @@
-"""KeyValueDB interface + MemDB + FileDB.
+"""KeyValueDB interface + MemDB + FileDB + BlueFSDB.
 
 Role of the reference's src/kv/ (KeyValueDB.h over RocksDB/LevelDB/
 MemDB): ordered string-keyed store with prefixed namespaces and atomic
@@ -7,6 +7,9 @@ metadata. MemDB is the in-memory backend (reference src/kv/MemDB.cc);
 FileDB is the persistent backend standing in for the RocksDB wrapper:
 a write-ahead log of batches replayed over a compacted snapshot, the
 same LSM-style durability contract (log first, compact later).
+BlueFSDB is the same contract with its WAL and sorted table hosted as
+BlueFS files INSIDE the block device (the RocksDB-on-BlueFS shape of
+real BlueStore) — BlockStore's default metadata store.
 """
 
 from __future__ import annotations
@@ -16,9 +19,9 @@ import os
 import threading
 
 from .. import encoding
-from .wal import FramedLog, write_atomic
+from .wal import FramedLog, frame, parse_frames, write_atomic
 
-__all__ = ["KeyValueDB", "MemDB", "FileDB"]
+__all__ = ["KeyValueDB", "MemDB", "FileDB", "BlueFSDB"]
 
 
 class _Batch:
@@ -148,3 +151,84 @@ class FileDB(MemDB):
         with self._lock:
             write_atomic(self.snap_path, encoding.encode_any(self._data))
             self._log.restart()
+
+
+class BlueFSDB(MemDB):
+    """Durable KeyValueDB hosted inside BlueFS (no host directory).
+
+    Files (the RocksDB-on-BlueFS analog at framework scale):
+
+      db.wal   crc-framed batch log; every submit appends one frame
+               and fsyncs through BlueFS (journal update + one device
+               sync). Replay applies frames over the table; a torn
+               tail is rewritten away.
+      db.sst   compacted whole-map snapshot. compact() writes db.sst.tmp,
+               fsyncs, renames over db.sst (journal-atomic), then resets
+               the WAL. A crash between rename and reset replays the old
+               WAL over the new table — batch ops are idempotent, so
+               the double-apply converges.
+    """
+
+    WAL = "db.wal"
+    TABLE = "db.sst"
+    TMP = "db.sst.tmp"
+
+    def __init__(self, bfs, log_sync: bool = True,
+                 compact_threshold: int = 8 << 20):
+        super().__init__()
+        self.bfs = bfs
+        self.log_sync = log_sync
+        self.compact_threshold = compact_threshold
+        self._writer = None
+        self._opened = False
+
+    def open(self) -> "BlueFSDB":
+        if self.bfs.exists(self.TMP):
+            # crashed mid-compaction before the rename: garbage
+            self.bfs.unlink(self.TMP)
+        if self.bfs.exists(self.TABLE):
+            data = encoding.decode_any(self.bfs.read_file(self.TABLE))
+            for prefix, ns in data.items():
+                self._data[prefix] = dict(ns)
+                self._keys[prefix] = sorted(ns)
+        if self.bfs.exists(self.WAL):
+            raw = self.bfs.read_file(self.WAL)
+            blobs, valid_end = parse_frames(raw)
+            for blob in blobs:
+                batch = _Batch()
+                batch.ops = encoding.decode_any(blob)
+                super().submit_transaction(batch)
+            if valid_end < len(raw):
+                # torn tail: rewrite the log back to the last valid
+                # frame so post-recovery appends stay replayable
+                w = self.bfs.open_for_write(self.WAL, append=False)
+                w.append(raw[:valid_end])
+                w.fsync()
+        self._writer = self.bfs.open_for_write(self.WAL)
+        self._opened = True
+        return self
+
+    def close(self) -> None:
+        if self._opened:
+            self.compact()
+            self._writer = None
+            self._opened = False
+
+    def submit_transaction(self, batch: _Batch) -> None:
+        if not self._opened:
+            raise RuntimeError("BlueFSDB not opened")
+        with self._lock:
+            self._writer.append(frame(encoding.encode_any(batch.ops)))
+            self._writer.fsync()
+            super().submit_transaction(batch)
+        if self.bfs.stat(self.WAL) >= self.compact_threshold:
+            self.compact()
+
+    def compact(self) -> None:
+        with self._lock:
+            w = self.bfs.open_for_write(self.TMP, append=False)
+            w.append(encoding.encode_any(self._data))
+            w.fsync()
+            self.bfs.rename(self.TMP, self.TABLE)
+            self._writer = self.bfs.open_for_write(self.WAL,
+                                                   append=False)

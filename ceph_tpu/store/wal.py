@@ -19,10 +19,34 @@ import os
 import struct
 import zlib
 
-__all__ = ["FramedLog", "write_atomic", "fsync_dir"]
+__all__ = ["FramedLog", "write_atomic", "fsync_dir", "frame",
+           "parse_frames"]
 
 _FRAME = struct.Struct("<III")    # magic, length, crc
 _MAGIC = 0x0CEF57A2
+
+
+def frame(blob: bytes) -> bytes:
+    """One framed record: header + payload (the append unit)."""
+    return _FRAME.pack(_MAGIC, len(blob), zlib.crc32(blob)) + blob
+
+
+def parse_frames(buf: bytes) -> tuple[list[bytes], int]:
+    """Walk framed records in `buf`; returns (payloads, valid_end).
+    Stops at the first torn/corrupt frame — everything past valid_end
+    is recovery garbage the caller must not trust."""
+    blobs: list[bytes] = []
+    pos = 0
+    while pos + _FRAME.size <= len(buf):
+        magic, length, crc = _FRAME.unpack_from(buf, pos)
+        if magic != _MAGIC:
+            break
+        blob = buf[pos + _FRAME.size:pos + _FRAME.size + length]
+        if len(blob) < length or zlib.crc32(blob) != crc:
+            break
+        blobs.append(blob)
+        pos += _FRAME.size + length
+    return blobs, pos
 
 
 def write_atomic(path: str, blob: bytes) -> None:

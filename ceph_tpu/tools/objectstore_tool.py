@@ -15,6 +15,14 @@ individual objects.
       --op remove --pgid PG
       --op get-bytes --pgid PG --oid OID --file OUT
       --op set-bytes --pgid PG --oid OID --file IN
+      --op fsck            [--store bluestore]
+      --op bluefs-export --file OUTDIR
+      --op bluefs-log-dump
+
+fsck cross-checks BlueFS extents, blob extents and the free list for
+overlap/leak (exit 1 on errors); bluefs-export copies the embedded
+KV's files out of the device; bluefs-log-dump prints the superblock
+and every journal record (the reference tool's same-named ops).
 
 The export payload is a versioned-encoding document, so it survives
 format evolution the same way the wire does (the reference exports
@@ -24,12 +32,15 @@ through the same encode/decode layer its disks use).
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import sys
 
 from .. import encoding
 
 __all__ = ["open_store", "list_pgs", "list_objects", "export_pg",
-           "import_pg", "remove_pg", "main"]
+           "import_pg", "remove_pg", "fsck", "bluefs_export",
+           "bluefs_log_dump", "main"]
 
 EXPORT_VERSION = 1
 
@@ -159,6 +170,36 @@ def remove_pg(store, pgid: str) -> int:
     return len(colls)
 
 
+def _require_bluestore(store):
+    from ..store.block_store import BlockStore
+    if not isinstance(store, BlockStore):
+        raise SystemExit("this op needs --store bluestore")
+    return store
+
+
+def fsck(store) -> list[str]:
+    return _require_bluestore(store).fsck()
+
+
+def bluefs_export(store, outdir: str) -> list[str]:
+    """Copy every BlueFS-hosted file (the embedded KV's WAL and
+    sorted table) out of the device into a host directory."""
+    bfs = _require_bluestore(store).bluefs
+    os.makedirs(outdir, exist_ok=True)
+    names = bfs.listdir()
+    for name in names:
+        with open(os.path.join(outdir, name), "wb") as f:
+            f.write(bfs.read_file(name))
+    return names
+
+
+def bluefs_log_dump(store) -> dict:
+    """Superblock + every decoded BlueFS journal record."""
+    bfs = _require_bluestore(store).bluefs
+    return {"superblock": bfs._read_super(),
+            "records": bfs.dump_journal()}
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="objectstore-tool",
                                 description=__doc__.split("\n")[0])
@@ -167,7 +208,8 @@ def main(argv=None) -> int:
                    choices=["filestore", "bluestore"])
     p.add_argument("--op", required=True,
                    choices=["list", "list-pgs", "export", "import",
-                            "remove", "get-bytes", "set-bytes"])
+                            "remove", "get-bytes", "set-bytes",
+                            "fsck", "bluefs-export", "bluefs-log-dump"])
     p.add_argument("--pgid")
     p.add_argument("--oid")
     p.add_argument("--file")
@@ -176,6 +218,29 @@ def main(argv=None) -> int:
 
     store = open_store(args.data_path, args.store)
     try:
+        if args.op == "fsck":
+            errs = fsck(store)
+            for err in errs:
+                print("fsck error: %s" % err)
+            print("fsck %s: %d error(s)"
+                  % ("FAILED" if errs else "clean", len(errs)))
+            return 1 if errs else 0
+        if args.op == "bluefs-export":
+            if not args.file:
+                raise SystemExit("bluefs-export needs --file OUTDIR")
+            names = bluefs_export(store, args.file)
+            for name in names:
+                print(name)
+            print("exported %d bluefs file(s) to %s"
+                  % (len(names), args.file))
+            return 0
+        if args.op == "bluefs-log-dump":
+            doc = bluefs_log_dump(store)
+            print(json.dumps({"superblock": doc["superblock"]},
+                             default=repr))
+            for i, rec in enumerate(doc["records"]):
+                print("%6d %s" % (i, json.dumps(rec, default=repr)))
+            return 0
         if args.op == "list-pgs":
             for pg in list_pgs(store):
                 print(pg)

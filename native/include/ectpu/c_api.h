@@ -65,6 +65,18 @@ int ec_codec_decode_chunks(void* codec, const int* avail_rows, int navail,
                            const uint8_t* chunks, size_t blocksize,
                            uint8_t* out);
 
+// --- GF kernel SIMD dispatch (runtime cpuid selection) ---------------
+// Active kernel ISA: "avx2" | "ssse3" | "scalar".
+const char* ec_gf_isa(void);
+// Force a (lower-or-equal) ISA; returns 0 on success, -1 if unknown or
+// unsupported on this host. Process-global — parity tests restore it.
+int ec_gf_set_isa(const char* name);
+// dst[i] ^= g * src[i] over n bytes of w-bit elements, through the
+// dispatched kernel (the unit the parity test drives directly).
+// Returns 0 or -errno (invalid w / n not a multiple of w/8).
+int ec_gf_region_madd(uint8_t* dst, const uint8_t* src, uint32_t g,
+                      size_t n, int w);
+
 #ifdef __cplusplus
 }  // extern "C"
 #endif

@@ -21,6 +21,15 @@ namespace ectpu {
 // w -> primitive polynomial (with the leading x^w term present).
 uint64_t gf_poly(int w);
 
+// --- runtime SIMD dispatch ------------------------------------------------
+// The region kernels carry AVX2/SSSE3/scalar variants selected at load
+// by cpuid (one binary runs everywhere); ECTPU_GF_ISA=scalar|ssse3|avx2
+// pins the choice at load, gf_isa_set() re-pins at runtime (clamped to
+// what the host supports — forcing UP is refused). All variants are
+// bit-identical; forcing scalar exists for parity tests and triage.
+const char* gf_isa_name();
+bool gf_isa_set(const char* name);
+
 // Scalar field ops (any w in 2..32).
 uint32_t gf_mult(uint32_t a, uint32_t b, int w);
 uint32_t gf_inv(uint32_t a, int w);

@@ -6,6 +6,7 @@
 #include <sstream>
 
 #include "ectpu/crush.h"
+#include "ectpu/gf.h"
 #include "ectpu/registry.h"
 
 namespace {
@@ -249,6 +250,22 @@ unsigned ec_crush_hash32_2(unsigned a, unsigned b) {
 }
 unsigned ec_crush_hash32_3(unsigned a, unsigned b, unsigned c) {
   return ectpu::crush_hash32_3(a, b, c);
+}
+
+const char* ec_gf_isa(void) { return ectpu::gf_isa_name(); }
+
+int ec_gf_set_isa(const char* name) {
+  return ectpu::gf_isa_set(name) ? 0 : -1;
+}
+
+int ec_gf_region_madd(uint8_t* dst, const uint8_t* src, uint32_t g,
+                      size_t n, int w) {
+  try {
+    ectpu::gf_region_madd(dst, src, g, n, w);
+    return 0;
+  } catch (const std::exception&) {
+    return -EINVAL;
+  }
 }
 
 }  // extern "C"

@@ -4,18 +4,82 @@
 
 #include <algorithm>
 #include <cassert>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <mutex>
 #include <stdexcept>
 
-#if defined(__AVX2__)
+// Runtime SIMD dispatch: the vector kernels are compiled with per-
+// function target attributes (no -march required at build time) and
+// selected at load via cpuid (__builtin_cpu_supports), so ONE binary
+// carries AVX2 + SSSE3 + scalar paths and runs the best one the host
+// has — the shape of gf-complete's runtime SIMD selection, replacing
+// the old compile-time `#if defined(__AVX2__)` guards.
+#if defined(__x86_64__) || defined(__i386__)
+#define ECTPU_X86 1
 #include <immintrin.h>
-#elif defined(__SSSE3__)
-#include <tmmintrin.h>
 #endif
 
 namespace ectpu {
+
+namespace {
+
+enum GfIsaLevel { kIsaScalar = 0, kIsaSsse3 = 1, kIsaAvx2 = 2 };
+
+struct IsaState {
+  GfIsaLevel max;   // what the host supports
+  GfIsaLevel cur;   // what the kernels use (forcible downward)
+};
+
+bool parse_isa(const char* name, GfIsaLevel* out) {
+  if (!name) return false;
+  if (!strcmp(name, "scalar")) { *out = kIsaScalar; return true; }
+  if (!strcmp(name, "ssse3")) { *out = kIsaSsse3; return true; }
+  if (!strcmp(name, "avx2")) { *out = kIsaAvx2; return true; }
+  return false;
+}
+
+GfIsaLevel detect_isa() {
+#if ECTPU_X86
+  if (__builtin_cpu_supports("avx2")) return kIsaAvx2;
+  if (__builtin_cpu_supports("ssse3")) return kIsaSsse3;
+#endif
+  return kIsaScalar;
+}
+
+IsaState& isa_state() {
+  static IsaState s = [] {
+    IsaState t;
+    t.max = detect_isa();
+    t.cur = t.max;
+    // ECTPU_GF_ISA=scalar|ssse3|avx2 pins the dispatch at load
+    // (parity testing / perf triage); clamped to what the host has
+    GfIsaLevel want;
+    if (parse_isa(std::getenv("ECTPU_GF_ISA"), &want) && want <= t.max)
+      t.cur = want;
+    return t;
+  }();
+  return s;
+}
+
+}  // namespace
+
+const char* gf_isa_name() {
+  switch (isa_state().cur) {
+    case kIsaAvx2: return "avx2";
+    case kIsaSsse3: return "ssse3";
+    default: return "scalar";
+  }
+}
+
+bool gf_isa_set(const char* name) {
+  GfIsaLevel want;
+  if (!parse_isa(name, &want)) return false;
+  if (want > isa_state().max) return false;   // cannot force UP
+  isa_state().cur = want;
+  return true;
+}
 
 uint64_t gf_poly(int w) {
   switch (w) {
@@ -122,12 +186,36 @@ static const Gf8Tables& gf8() {
   return t;
 }
 
-static void gf8_region_madd(uint8_t* dst, const uint8_t* src, uint8_t g,
-                            size_t n) {
-  if (g == 0) return;
+static void gf8_region_madd_scalar(uint8_t* dst, const uint8_t* src,
+                                   uint8_t g, size_t n, size_t i) {
+  const uint8_t* row = gf8().mul[g];
+  for (; i < n; ++i) dst[i] ^= row[src[i]];
+}
+
+#if ECTPU_X86
+__attribute__((target("ssse3"))) static void gf8_region_madd_ssse3(
+    uint8_t* dst, const uint8_t* src, uint8_t g, size_t n) {
   const Gf8Tables& t = gf8();
   size_t i = 0;
-#if defined(__AVX2__)
+  __m128i tlo128 = _mm_loadu_si128((const __m128i*)t.lo[g]);
+  __m128i thi128 = _mm_loadu_si128((const __m128i*)t.hi[g]);
+  __m128i mask128 = _mm_set1_epi8(0x0f);
+  for (; i + 16 <= n; i += 16) {
+    __m128i s = _mm_loadu_si128((const __m128i*)(src + i));
+    __m128i d = _mm_loadu_si128((const __m128i*)(dst + i));
+    __m128i l = _mm_shuffle_epi8(tlo128, _mm_and_si128(s, mask128));
+    __m128i h = _mm_shuffle_epi8(
+        thi128, _mm_and_si128(_mm_srli_epi64(s, 4), mask128));
+    d = _mm_xor_si128(d, _mm_xor_si128(l, h));
+    _mm_storeu_si128((__m128i*)(dst + i), d);
+  }
+  gf8_region_madd_scalar(dst, src, g, n, i);
+}
+
+__attribute__((target("avx2"))) static void gf8_region_madd_avx2(
+    uint8_t* dst, const uint8_t* src, uint8_t g, size_t n) {
+  const Gf8Tables& t = gf8();
+  size_t i = 0;
   // ISA-L-style nibble-split vpshufb: 32 products per iteration
   // (reference analog: src/erasure-code/isa gf_vect_mad AVX2 kernels)
   __m256i tlo = _mm256_broadcastsi128_si256(
@@ -160,23 +248,21 @@ static void gf8_region_madd(uint8_t* dst, const uint8_t* src, uint8_t g,
     d = _mm256_xor_si256(d, _mm256_xor_si256(l, h));
     _mm256_storeu_si256((__m256i*)(dst + i), d);
   }
+  gf8_region_madd_scalar(dst, src, g, n, i);
+}
+#endif  // ECTPU_X86
+
+static void gf8_region_madd(uint8_t* dst, const uint8_t* src, uint8_t g,
+                            size_t n) {
+  if (g == 0) return;
+  switch (isa_state().cur) {
+#if ECTPU_X86
+    case kIsaAvx2: gf8_region_madd_avx2(dst, src, g, n); return;
+    case kIsaSsse3: gf8_region_madd_ssse3(dst, src, g, n); return;
 #endif
-#if defined(__SSSE3__)
-  __m128i tlo128 = _mm_loadu_si128((const __m128i*)t.lo[g]);
-  __m128i thi128 = _mm_loadu_si128((const __m128i*)t.hi[g]);
-  __m128i mask128 = _mm_set1_epi8(0x0f);
-  for (; i + 16 <= n; i += 16) {
-    __m128i s = _mm_loadu_si128((const __m128i*)(src + i));
-    __m128i d = _mm_loadu_si128((const __m128i*)(dst + i));
-    __m128i l = _mm_shuffle_epi8(tlo128, _mm_and_si128(s, mask128));
-    __m128i h = _mm_shuffle_epi8(
-        thi128, _mm_and_si128(_mm_srli_epi64(s, 4), mask128));
-    d = _mm_xor_si128(d, _mm_xor_si128(l, h));
-    _mm_storeu_si128((__m128i*)(dst + i), d);
+    default: break;
   }
-#endif
-  const uint8_t* row = t.mul[g];
-  for (; i < n; ++i) dst[i] ^= row[src[i]];
+  gf8_region_madd_scalar(dst, src, g, n, 0);
 }
 
 // ---------------------------------------------------------------------------
@@ -257,19 +343,8 @@ static void gf32_region_madd(uint8_t* dst8, const uint8_t* src8, uint32_t g,
   }
 }
 
-void xor_region(uint8_t* dst, const uint8_t* src, size_t n) {
-  size_t i = 0;
-#if defined(__AVX2__)
-  for (; i + 64 <= n; i += 64) {
-    __m256i a0 = _mm256_loadu_si256((const __m256i*)(dst + i));
-    __m256i b0 = _mm256_loadu_si256((const __m256i*)(src + i));
-    __m256i a1 = _mm256_loadu_si256((const __m256i*)(dst + i + 32));
-    __m256i b1 = _mm256_loadu_si256((const __m256i*)(src + i + 32));
-    _mm256_storeu_si256((__m256i*)(dst + i), _mm256_xor_si256(a0, b0));
-    _mm256_storeu_si256((__m256i*)(dst + i + 32),
-                        _mm256_xor_si256(a1, b1));
-  }
-#endif
+static void xor_region_scalar(uint8_t* dst, const uint8_t* src, size_t n,
+                              size_t i) {
   for (; i + 8 <= n; i += 8) {
     uint64_t a, b;
     memcpy(&a, dst + i, 8);
@@ -280,10 +355,37 @@ void xor_region(uint8_t* dst, const uint8_t* src, size_t n) {
   for (; i < n; ++i) dst[i] ^= src[i];
 }
 
-void gf8_apply_matrix(const uint32_t* mat, int rows, int k,
-                      const uint8_t* const* src, uint8_t* const* dst,
-                      size_t n) {
-#if defined(__AVX2__)
+#if ECTPU_X86
+__attribute__((target("avx2"))) static void xor_region_avx2(
+    uint8_t* dst, const uint8_t* src, size_t n) {
+  size_t i = 0;
+  for (; i + 64 <= n; i += 64) {
+    __m256i a0 = _mm256_loadu_si256((const __m256i*)(dst + i));
+    __m256i b0 = _mm256_loadu_si256((const __m256i*)(src + i));
+    __m256i a1 = _mm256_loadu_si256((const __m256i*)(dst + i + 32));
+    __m256i b1 = _mm256_loadu_si256((const __m256i*)(src + i + 32));
+    _mm256_storeu_si256((__m256i*)(dst + i), _mm256_xor_si256(a0, b0));
+    _mm256_storeu_si256((__m256i*)(dst + i + 32),
+                        _mm256_xor_si256(a1, b1));
+  }
+  xor_region_scalar(dst, src, n, i);
+}
+#endif  // ECTPU_X86
+
+void xor_region(uint8_t* dst, const uint8_t* src, size_t n) {
+#if ECTPU_X86
+  if (isa_state().cur >= kIsaAvx2) {
+    xor_region_avx2(dst, src, n);
+    return;
+  }
+#endif
+  xor_region_scalar(dst, src, n, 0);
+}
+
+#if ECTPU_X86
+__attribute__((target("avx2"))) static void gf8_apply_matrix_avx2(
+    const uint32_t* mat, int rows, int k, const uint8_t* const* src,
+    uint8_t* const* dst, size_t n) {
   // Row groups of 4 bound the register set (8 accumulators + 2 source
   // + mask + 2 hot tables); tables are pre-broadcast per group so the
   // inner loop is pure load/shuffle/xor. Each 64-byte position reads
@@ -291,7 +393,7 @@ void gf8_apply_matrix(const uint32_t* mat, int rows, int k,
   // loop inversion that turns ~9x memory amplification into ~1.4x.
   constexpr int kGroup = 4;
   constexpr int kMaxK = 32;
-  if (k <= kMaxK) {
+  {
     const Gf8Tables& t = gf8();
     const __m256i mask = _mm256_set1_epi8(0x0f);
     const size_t body = n & ~(size_t)63;
@@ -350,11 +452,23 @@ void gf8_apply_matrix(const uint32_t* mat, int rows, int k,
     if (body < n) {
       for (int r = 0; r < rows; ++r) {
         memset(dst[r] + body, 0, n - body);
-        for (int j = 0; j < k; ++j)
-          gf8_region_madd(dst[r] + body, src[j] + body,
-                          (uint8_t)mat[(size_t)r * k + j], n - body);
+        for (int j = 0; j < k; ++j) {
+          uint8_t c = (uint8_t)mat[(size_t)r * k + j];
+          if (c) gf8_region_madd_avx2(dst[r] + body, src[j] + body,
+                                      c, n - body);
+        }
       }
     }
+  }
+}
+#endif  // ECTPU_X86
+
+void gf8_apply_matrix(const uint32_t* mat, int rows, int k,
+                      const uint8_t* const* src, uint8_t* const* dst,
+                      size_t n) {
+#if ECTPU_X86
+  if (isa_state().cur >= kIsaAvx2 && k <= 32) {
+    gf8_apply_matrix_avx2(mat, rows, k, src, dst, n);
     return;
   }
 #endif

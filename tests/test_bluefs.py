@@ -1,0 +1,472 @@
+"""BlueFS + BlueFSDB: the in-device metadata stack.
+
+The VERDICT #7 'done' gates: a fresh BlockStore is ONE self-contained
+file (no db/ sidecar), a legacy sidecar store migrates on mount, fsck
+cross-checks every extent owner against the free list, and the
+kill-at-every-sync-point harness proves crash consistency — the device
+image is snapshotted at EACH durability point (block fsync, BlueFS
+journal sync, KV WAL sync, journal/KV compaction) and every snapshot
+must mount, pass fsck clean, and read back every acknowledged write."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from ceph_tpu.store.block_store import BlockStore, FreeList
+from ceph_tpu.store.bluefs import BLOCK, BlueFS
+from ceph_tpu.store.kv import BlueFSDB
+from ceph_tpu.store.object_store import Transaction
+
+
+def make_store(path, **kw):
+    kw.setdefault("block_sync", False)
+    kw.setdefault("kv_sync", False)
+    st = BlockStore(str(path), **kw)
+    st.mount()
+    return st
+
+
+def crash(st):
+    """Simulate a hard crash: drop the store without any flush path."""
+    os.close(st._fd)
+    st._fd = None
+    st.mounted = False
+
+
+class TestBlueFSUnit:
+    def _fs(self, tmp_path, **kw):
+        fd = os.open(str(tmp_path / "dev"), os.O_RDWR | os.O_CREAT)
+        alloc = FreeList(BLOCK)
+        alloc.mark_used(0, BLOCK)
+        fs = BlueFS(fd, alloc, **kw)
+        fs.mkfs()
+        return fd, alloc, fs
+
+    def test_write_read_roundtrip_and_replay(self, tmp_path):
+        fd, alloc, fs = self._fs(tmp_path)
+        w = fs.open_for_write("wal")
+        w.append(b"hello ")
+        w.append(b"world")
+        w.fsync()
+        assert fs.read_file("wal") == b"hello world"
+        # appends after fsync rewrite the tail block correctly
+        w.append(b"!" * 9000)       # crosses a block boundary
+        w.fsync()
+        assert fs.read_file("wal") == b"hello world" + b"!" * 9000
+        # remount from the device alone
+        fs2 = BlueFS(fd, self._fresh_alloc(alloc), sync=False)
+        fs2.mount()
+        assert fs2.read_file("wal") == b"hello world" + b"!" * 9000
+        os.close(fd)
+
+    def _fresh_alloc(self, old):
+        alloc = FreeList(old.device_size)
+        alloc.mark_used(0, BLOCK)
+        return alloc
+
+    def test_rename_unlink_listdir(self, tmp_path):
+        fd, alloc, fs = self._fs(tmp_path)
+        for name in ("a", "b"):
+            w = fs.open_for_write(name)
+            w.append(name.encode() * 100)
+            w.fsync()
+        fs.rename("a", "c")
+        assert fs.listdir() == ["b", "c"]
+        assert fs.read_file("c") == b"a" * 100
+        free_before = alloc.free_bytes()
+        fs.unlink("b")
+        assert alloc.free_bytes() > free_before   # extents returned
+        fs2 = BlueFS(fd, self._fresh_alloc(alloc), sync=False)
+        fs2.mount()
+        assert fs2.listdir() == ["c"]
+        os.close(fd)
+
+    def test_journal_compaction_survives_remount(self, tmp_path):
+        fd, alloc, fs = self._fs(tmp_path, compact_threshold=2 * BLOCK)
+        w = fs.open_for_write("f")
+        for i in range(200):          # many small syncs outgrow the log
+            w.append(b"x" * 50)
+            w.fsync()
+        assert fs.perf.get("l_bluefs_journal_compactions") > 0
+        fs2 = BlueFS(fd, self._fresh_alloc(alloc), sync=False)
+        fs2.mount()
+        assert fs2.read_file("f") == b"x" * (200 * 50)
+        os.close(fd)
+
+    def test_torn_journal_tail_ignored(self, tmp_path):
+        fd, alloc, fs = self._fs(tmp_path)
+        w = fs.open_for_write("f")
+        w.append(b"durable")
+        w.fsync()
+        # scribble garbage at the journal write position (a torn frame)
+        joff, _ = fs.journal_extent
+        os.pwrite(fd, b"\xde\xad\xbe\xef" * 8, joff + fs._journal_used)
+        fs2 = BlueFS(fd, self._fresh_alloc(alloc), sync=False)
+        fs2.mount()
+        assert fs2.read_file("f") == b"durable"
+        os.close(fd)
+
+
+class TestBlueFSDB:
+    def test_batches_survive_remount_via_wal_and_sst(self, tmp_path):
+        st = make_store(tmp_path)
+        bfs = st.bluefs
+        db = st.db
+        b = db.get_transaction()
+        b.set("X", "k1", b"v1")
+        b.set("X", "k2", b"v2")
+        db.submit_transaction(b)
+        assert db.get("X", "k1") == b"v1"
+        assert bfs.exists(BlueFSDB.WAL)
+        db.compact()                  # lands in db.sst, resets the WAL
+        assert bfs.stat(BlueFSDB.WAL) == 0
+        b = db.get_transaction()
+        b.rmkey("X", "k2")
+        b.set("X", "k3", b"v3")
+        db.submit_transaction(b)      # rides the fresh WAL
+        st.umount()
+        st2 = make_store(tmp_path)
+        assert st2.db.get("X", "k1") == b"v1"
+        assert st2.db.get("X", "k2") is None
+        assert st2.db.get("X", "k3") == b"v3"
+        st2.umount()
+
+
+class TestSelfContained:
+    def test_mkfs_creates_no_sidecar(self, tmp_path):
+        st = BlockStore(str(tmp_path / "osd"))
+        st.mkfs()
+        assert sorted(os.listdir(tmp_path / "osd")) == ["block"]
+        st.mount()
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 0, b"payload" * 100)
+        st.queue_transaction(t)
+        assert sorted(os.listdir(tmp_path / "osd")) == ["block"]
+        assert st.fsck() == []
+        st.umount()
+        assert sorted(os.listdir(tmp_path / "osd")) == ["block"]
+
+    def test_legacy_sidecar_migrates_on_mount(self, tmp_path):
+        """A pre-BlueFS store (FileDB sidecar + blob at offset 0 where
+        the superblock now lives) is swallowed on first mount: blob
+        relocated, KV moved into the device, sidecar removed."""
+        import zlib
+
+        from ceph_tpu import encoding
+        from ceph_tpu.store.block_store import _ckey, _okey
+        from ceph_tpu.store.kv import FileDB
+        p = tmp_path / "osd"
+        p.mkdir()
+        data = b"legacy-bytes" * 2000
+        fd = os.open(str(p / "block"), os.O_RDWR | os.O_CREAT, 0o644)
+        os.pwrite(fd, data, 0)        # legacy blob squats on block 0
+        os.close(fd)
+        db = FileDB(str(p / "db"), log_sync=False).open()
+        b = db.get_transaction()
+        b.set("C", _ckey("c"), encoding.encode_any("c"))
+        b.set("O", _okey("c", "o"), encoding.encode_any(
+            {"cid": "c", "oid": "o", "size": len(data),
+             "extents": [[0, len(data), 1, 0]], "xattrs": {"a": b"1"}}))
+        alen = -(-len(data) // 4096) * 4096
+        b.set("B", "1", encoding.encode_any(
+            {"poff": 0, "alen": alen, "clen": len(data),
+             "raw": len(data), "comp": None, "refs": 1,
+             "csums": [zlib.crc32(data[i:i + 4096]) & 0xFFFFFFFF
+                       for i in range(0, len(data), 4096)]}))
+        b.set("M", _okey("c", "o") + ":" + encoding.encode_any("k").hex(),
+              encoding.encode_any(b"v"))
+        db.submit_transaction(b)
+        db.close()
+        assert (p / "db").is_dir()
+
+        st = make_store(p)
+        assert not (p / "db").exists()          # sidecar gone
+        assert st.read("c", "o") == data        # via the relocated blob
+        assert st.getattr("c", "o", "a") == b"1"
+        assert st.omap_get("c", "o") == {"k": b"v"}
+        assert next(iter(st._blobs.values())).poff >= BLOCK
+        assert st.fsck() == []
+        st.umount()
+        st2 = make_store(p)                     # second mount: normal
+        assert st2.read("c", "o") == data
+        st2.umount()
+
+
+class TestFsck:
+    def test_detects_overlap_and_leak(self, tmp_path):
+        st = make_store(tmp_path)
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 0, b"z" * 50000)
+        st.queue_transaction(t)
+        assert st.fsck() == []
+        # hand-corrupt: claim allocated space nobody owns -> leak
+        st.allocator.allocate(8192)
+        errs = st.fsck()
+        assert any("leak" in e for e in errs)
+        # and an overlap: point a blob into the BlueFS journal
+        blob = next(iter(st._blobs.values()))
+        blob.poff = st.bluefs.journal_extent[0]
+        assert any("overlap" in e for e in st.fsck())
+        st.fsck_on_umount = False     # store is deliberately broken
+        st.umount()
+
+    def test_detects_bad_refcount(self, tmp_path):
+        st = make_store(tmp_path)
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 0, b"z" * 50000)
+        st.queue_transaction(t)
+        next(iter(st._blobs.values())).refs = 7
+        assert any("refcount" in e for e in st.fsck())
+        st.fsck_on_umount = False
+        st.umount()
+
+    def test_umount_runs_fsck_by_default(self, tmp_path):
+        st = make_store(tmp_path)
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 0, b"z" * 50000)
+        st.queue_transaction(t)
+        st.allocator.allocate(8192)   # leak
+        with pytest.raises(RuntimeError, match="fsck on umount"):
+            st.umount()
+
+
+class TestFaultInjection:
+    def test_eio_mid_journal_compaction_leaves_fsck_clean(
+            self, tmp_path):
+        """Satellite: EIO injected mid-journal-compaction (after the
+        new log is written, before the superblock repoints) must leave
+        a consistent store — live fsck clean, crash + remount clean,
+        outstanding deferred records still replayable."""
+        st = make_store(tmp_path, block_sync=True, kv_sync=True,
+                        bluefs_compact_threshold=4 * BLOCK)
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 0, b"A" * 65536)
+        st.queue_transaction(t)
+        t = Transaction()
+        t.write("c", "o", 100, b"deferred-bytes")   # D record pending
+        st.queue_transaction(t)
+        st.faults.arm_trip(BlueFS.TRIP_COMPACT_MID)
+        with pytest.raises(OSError) as ei:
+            st.bluefs.compact_journal()
+        assert ei.value.errno == 5
+        assert st.fsck() == []        # new-extent garbage handed back
+        # wipe the deferred bytes from the device: only kv replay can
+        # restore them after the crash
+        os.pwrite(st._fd, b"A" * 14, st._blobs[1].poff + 100)
+        crash(st)
+        st2 = make_store(tmp_path)
+        want = bytearray(b"A" * 65536)
+        want[100:114] = b"deferred-bytes"
+        assert st2.read("c", "o") == bytes(want)
+        assert st2.fsck() == []
+        # and the next organic compaction (trip disarmed) succeeds
+        st2.bluefs.compact_journal()
+        assert st2.fsck() == []
+        st2.umount()
+
+    def test_organic_compaction_failure_surfaces_then_recovers(
+            self, tmp_path):
+        st = make_store(tmp_path, block_sync=True, kv_sync=True,
+                        bluefs_compact_threshold=2 * BLOCK)
+        t = Transaction()
+        t.create_collection("c")
+        st.queue_transaction(t)
+        st.faults.arm_trip(BlueFS.TRIP_COMPACT_MID)
+        tripped = False
+        for i in range(300):          # WAL churn forces a compaction
+            t = Transaction()
+            t.write("c", "o%d" % (i % 4), 0, b"v%04d" % i)
+            try:
+                st.queue_transaction(t)
+            except OSError:
+                tripped = True
+                break
+        assert tripped
+        assert st.fsck() == []
+        for i in range(50):           # trip disarmed: writes continue
+            t = Transaction()
+            t.write("c", "o%d" % (i % 4), 0, b"w%04d" % i)
+            st.queue_transaction(t)
+        st.umount()                   # fsck-on-umount passes
+
+    def test_deferred_record_dies_with_its_blob(self, tmp_path):
+        """The deferred-replay-vs-realloc fix: a pending deferred
+        record whose blob is freed must be retired, or mount replay
+        scribbles stale bytes over whoever got the space next."""
+        st = make_store(tmp_path, block_sync=True, kv_sync=True)
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "victim", 0, b"V" * 65536)
+        st.queue_transaction(t)
+        t = Transaction()
+        t.write("c", "victim", 200, b"stale-deferred")  # D pending
+        st.queue_transaction(t)
+        t = Transaction()
+        t.remove("c", "victim")       # frees the blob, D must die too
+        st.queue_transaction(t)
+        t = Transaction()
+        t.write("c", "heir", 0, b"H" * 65536)   # reuses the space
+        st.queue_transaction(t)
+        assert st.fsck() == []
+        crash(st)
+        st2 = make_store(tmp_path)    # replay must NOT scribble heir
+        assert st2.read("c", "heir") == b"H" * 65536
+        assert st2.fsck() == []
+        st2.umount()
+
+
+class _CrashHarness:
+    """Kill-at-every-sync-point: the sync hook snapshots the device
+    image + the acknowledged store state at EVERY durability point;
+    each snapshot is then mounted fresh and must fsck clean and read
+    back every acknowledged object (the in-flight object may hold its
+    old value, its new value, or be absent — never anything else)."""
+
+    def __init__(self, store, block_path):
+        self.block_path = block_path
+        self.snapshots = []           # (image, acked, inflight)
+        self.acked: dict = {}         # oid -> bytes
+        self.inflight: tuple | None = None   # (oid, old, new)
+        store.sync_hook = self._on_sync
+
+    def _on_sync(self):
+        with open(self.block_path, "rb") as f:
+            image = f.read()
+        self.snapshots.append((image, dict(self.acked), self.inflight))
+
+    def apply(self, store, oid: str, value: bytes, offset: int = 0):
+        old = self.acked.get(oid)
+        if offset:
+            new = bytearray(old or b"")
+            if len(new) < offset + len(value):
+                new += b"\0" * (offset + len(value) - len(new))
+            new[offset:offset + len(value)] = value
+            new = bytes(new)
+        else:
+            new = value
+        self.inflight = (oid, old, new)
+        t = Transaction()
+        t.write("c", oid, offset, value)
+        store.queue_transaction(t)    # returning == acknowledged
+        self.acked[oid] = new
+        self.inflight = None
+
+    def verify_all(self, tmp_path):
+        assert self.snapshots, "no sync points captured"
+        for i, (image, acked, inflight) in enumerate(self.snapshots):
+            p = tmp_path / ("replay%d" % i)
+            p.mkdir()
+            with open(p / "block", "wb") as f:
+                f.write(image)
+            st = BlockStore(str(p), block_sync=False, kv_sync=False)
+            st.mount()
+            errs = st.fsck()
+            assert errs == [], "sync point %d: fsck %s" % (i, errs)
+            for oid, want in acked.items():
+                if inflight is not None and oid == inflight[0]:
+                    continue          # judged below
+                got = st.read("c", oid)
+                assert got == want, \
+                    "sync point %d: acked %r diverged" % (i, oid)
+            if inflight is not None:
+                oid, old, new = inflight
+                try:
+                    got = st.read("c", oid)
+                except KeyError:
+                    got = None        # not yet committed: fine
+                assert got in (old, new, None), \
+                    "sync point %d: in-flight %r torn" % (i, oid)
+            st.umount()
+
+
+@pytest.mark.parametrize("compaction", ["quiet", "forced"])
+def test_kill_at_every_sync_point(tmp_path, compaction):
+    """The acceptance gate: truncate-free crash simulation at each
+    BlueFS journal / KV WAL / block sync, fsck-clean and read-back
+    equality at every replay point. The 'forced' variant shrinks both
+    compaction thresholds so BlueFS journal compaction AND KV WAL
+    compaction happen inside the workload window."""
+    work = tmp_path / "work"
+    work.mkdir()
+    kw = {"block_sync": True, "kv_sync": True}
+    if compaction == "forced":
+        # triggers, not sizes: the journal compacts every ~8 appends,
+        # the KV WAL every ~2 batches — both machines run repeatedly
+        # inside the workload window
+        kw["bluefs_compact_threshold"] = 512
+        kw["kv_compact_threshold"] = BLOCK
+    st = BlockStore(str(work), **kw)
+    st.mount()
+    t = Transaction()
+    t.create_collection("c")
+    st.queue_transaction(t)
+    h = _CrashHarness(st, str(work / "block"))
+    h.acked = {}
+    nseeds = 28 if compaction == "forced" else 16
+    rng_payload = [bytes([seed]) * (3000 + seed * 37)
+                   for seed in range(nseeds)]
+    for seed, payload in enumerate(rng_payload):
+        h.apply(st, "big%d" % (seed % 5), payload)        # big lane
+        if seed % 3 == 0:
+            h.apply(st, "big%d" % (seed % 5),
+                    b"<p%02d>" % seed, offset=64)          # deferred
+    if compaction == "forced":
+        # both compaction machines really ran inside the window, so
+        # their sync points are among the snapshots being replayed
+        assert st.bluefs.perf.get("l_bluefs_journal_compactions") > 0
+        assert st.bluefs.exists("db.sst")   # KV WAL compacted too
+    st.sync_hook = None
+    st.umount()
+    h.verify_all(tmp_path)
+    assert len(h.snapshots) > 20      # the harness really saw syncs
+
+
+class TestAdminSocket:
+    def test_bluefs_stats_command(self, tmp_path):
+        from ceph_tpu.common.admin_socket import AdminSocket
+        st = make_store(tmp_path / "osd")
+        t = Transaction()
+        t.create_collection("c")
+        t.write("c", "o", 0, b"x" * 20000)
+        st.queue_transaction(t)
+        asok = AdminSocket(str(tmp_path / "a.sock"))
+        st.register_admin_commands(asok)
+        reply = asok.execute("bluefs stats")
+        assert reply["bluefs"]["journal_capacity"] > 0
+        assert "db.wal" in reply["bluefs"]["files"]
+        assert reply["perf"]["l_bluefs_journal_bytes"] > 0
+        assert reply["store"]["bluefs_used_bytes"] > 0
+        assert asok.execute("bluestore fsck") == {"errors": []}
+        st.umount()
+
+
+class TestObjectstoreTool:
+    def test_fsck_export_logdump_cli(self, tmp_path, capsys):
+        from ceph_tpu.tools import objectstore_tool as ost
+        st = make_store(tmp_path / "osd")
+        t = Transaction()
+        cid = ("pg", "1.0", -1)
+        t.create_collection(cid)
+        t.write(cid, "alpha", 0, b"alpha-bytes")
+        st.queue_transaction(t)
+        st.umount()
+        base = ["--data-path", str(tmp_path / "osd"),
+                "--store", "bluestore"]
+        assert ost.main(base + ["--op", "fsck"]) == 0
+        out = capsys.readouterr().out
+        assert "fsck clean" in out
+        outdir = tmp_path / "bluefs-out"
+        assert ost.main(base + ["--op", "bluefs-export",
+                                "--file", str(outdir)]) == 0
+        assert sorted(os.listdir(outdir)) == ["db.sst", "db.wal"]
+        assert (outdir / "db.sst").stat().st_size > 0
+        assert ost.main(base + ["--op", "bluefs-log-dump"]) == 0
+        out = capsys.readouterr().out
+        assert "superblock" in out and "db.wal" in out

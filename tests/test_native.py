@@ -188,3 +188,65 @@ class TestTPUBridge:
             assert bs == len(chunks[0])
         finally:
             native.uninstall_dispatcher()
+
+
+class TestSimdDispatch:
+    """VERDICT #9: runtime cpuid dispatch replaces the compile-time
+    `#if defined(__AVX2__)` guards — one binary carries AVX2 + SSSE3 +
+    scalar region kernels, every variant bit-identical."""
+
+    def test_detected_isa_is_named(self):
+        assert native.gf_isa() in ("avx2", "ssse3", "scalar")
+
+    def test_cannot_force_up_or_unknown(self):
+        default = native.gf_isa()
+        try:
+            assert not native.gf_set_isa("avx512")   # unknown name
+            if default != "avx2":
+                # the host tops out below avx2: forcing UP must refuse
+                assert not native.gf_set_isa("avx2")
+        finally:
+            native.gf_set_isa(default)
+
+    def test_forced_scalar_matches_vector_region_madd(self):
+        import numpy as np
+        default = native.gf_isa()
+        rng = np.random.default_rng(3)
+        # deliberately unaligned length: exercises the 64/32/16-wide
+        # bodies AND every tail path
+        src = rng.integers(0, 256, size=100003, dtype=np.uint8)
+        base = rng.integers(0, 256, size=100003, dtype=np.uint8)
+        results = {}
+        try:
+            for isa in ("scalar", "ssse3", "avx2"):
+                if not native.gf_set_isa(isa):
+                    continue        # host doesn't have it
+                assert native.gf_isa() == isa
+                for g in (1, 2, 0x53, 0xFF):
+                    dst = base.copy()
+                    native.gf_region_madd(dst, src, g, w=8)
+                    results.setdefault(g, {})[isa] = dst
+        finally:
+            native.gf_set_isa(default)
+        assert results and all("scalar" in r for r in results.values())
+        for g, per_isa in results.items():
+            for isa, dst in per_isa.items():
+                assert np.array_equal(dst, per_isa["scalar"]), \
+                    "g=%#x isa=%s diverges from scalar" % (g, isa)
+
+    def test_forced_scalar_matches_vector_full_codec(self):
+        """The whole encode/decode path, scalar vs best-available."""
+        default = native.gf_isa()
+        prof = {"technique": "reed_sol_van", "k": "5", "m": "3",
+                "w": "8"}
+        data = bytes(range(256)) * 41
+        try:
+            assert native.gf_set_isa("scalar")
+            enc_scalar = _mk(dict(prof)).encode(data)
+            native.gf_set_isa(default)
+            enc_vec = _mk(dict(prof)).encode(data)
+        finally:
+            native.gf_set_isa(default)
+        assert set(enc_scalar) == set(enc_vec)
+        for i in enc_scalar:
+            assert enc_scalar[i] == enc_vec[i], "chunk %d differs" % i
