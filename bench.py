@@ -60,6 +60,13 @@ h2d_raw_MBps pure host->device copy bandwidth over the SAME buffers and
              ranges ~30 MB/s to ~1.5 GB/s run to run; a real
              PCIe-attached TPU is ~10 GB/s).
 
+--trace adds a `trace_breakdown` row: per-phase {h2d, compute, d2h,
+dispatch_queue} device-time attribution measured through the
+production TpuDispatcher + common.tracer.device_segments
+instrumentation (the same code path the OSD's op spans and l_tpu_*
+counters ride), smoke-gated so segment sums can never exceed the wall
+time they decompose.
+
 Trustworthiness protocol (VERDICT #2): every headline row is timed
 over REPEATS (>= 3) INTERLEAVED repeats — rep 1 of all rows before
 rep 2 of any — so transport drift lands in the recorded per-row
@@ -345,7 +352,11 @@ def _bench_cluster() -> dict:
         os.path.abspath(__file__)), "tests"))
     from cluster_util import MiniCluster
     out: dict = {}
-    c = MiniCluster(num_mons=1, num_osds=4)
+    # tracing off for this row: it prices the PIPELINE and must stay
+    # methodology-constant with earlier rounds (the --trace breakdown
+    # row measures the instrumented path separately)
+    c = MiniCluster(num_mons=1, num_osds=4,
+                    conf_overrides={"osd_tracing": False})
     c.start()
     try:
         client = c.client()
@@ -413,6 +424,52 @@ def _bench_cluster() -> dict:
     finally:
         c.stop()
     return out
+
+
+def _trace_breakdown(codec, data_host) -> dict:
+    """--trace: the per-phase device-time attribution row (ISSUE:
+    observability).  Runs encodes through the PRODUCTION TpuDispatcher
+    with tracing armed, so the {h2d, compute, d2h, dispatch_queue}
+    numbers come from the same common.tracer.device_segments
+    instrumentation the OSD's spans and l_tpu_* counters use — not a
+    bench-only approximation.  Smoke-gates segment sums against wall
+    time (a segment sum exceeding the wall it decomposes is a timing
+    artifact and fails the run)."""
+    from ceph_tpu.common.tracer import SpanCollector
+    from ceph_tpu.osd.tpu_dispatch import TpuDispatcher
+
+    tracer = SpanCollector()
+    tracer.enabled = True
+    disp = TpuDispatcher(max_batch=4, max_delay=0.0005, tracer=tracer)
+    try:
+        reps = 5
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            root = tracer.start_trace("bench_encode")
+            disp.encode(codec, data_host, trace=root)
+            root.finish()
+        wall = (time.perf_counter() - t0) / reps
+        perf = disp.perf
+        seg = {
+            "h2d_s": perf.avg("l_tpu_h2d"),
+            "compute_s": perf.avg("l_tpu_compute"),
+            "d2h_s": perf.avg("l_tpu_d2h"),
+            "dispatch_queue_s": perf.avg("l_tpu_dispatch_queue"),
+        }
+        # smoke assertion: the segments decompose one dispatch's wall
+        # time — their sum can never exceed it (small slack for clock
+        # granularity on sub-ms segments)
+        total = sum(seg.values())
+        if total > wall * 1.05 + 1e-4:
+            raise SystemExit(
+                "--trace gate: segment sum %.6fs exceeds wall %.6fs — "
+                "device-time attribution is broken" % (total, wall))
+        seg["wall_s"] = wall
+        seg["spans"] = len(tracer.dump())
+        return {k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in seg.items()}
+    finally:
+        disp.shutdown()
 
 
 #: v5e-1 HBM bandwidth ceiling with margin: no single-chip number can
@@ -1042,6 +1099,19 @@ def run_bench() -> None:
     except Exception as e:
         cluster_rows = {"cluster_bench_error": str(e)[:200]}
 
+    # --trace: per-phase {h2d, compute, d2h, dispatch_queue} breakdown
+    # through the production dispatcher instrumentation (runs after the
+    # seal — its reads are d2h and the timed sections are in hand)
+    if "--trace" in sys.argv:
+        print("BENCH-STAGE trace-breakdown", file=sys.stderr,
+              flush=True)
+        try:
+            doc["trace_breakdown"] = _trace_breakdown(tpu, data_host)
+        except SystemExit:
+            raise
+        except Exception as e:
+            doc["trace_breakdown"] = {"error": str(e)[:200]}
+
     doc.update(dec_e)
     doc.update(native)
     doc.update(extra_rows)
@@ -1064,9 +1134,11 @@ def _supervised() -> None:
     backend, labeled as such."""
     here = os.path.abspath(__file__)
     best = None
+    extra = ["--trace"] if "--trace" in sys.argv else []
     for _ in range(2):
         try:
-            proc = subprocess.run([sys.executable, here, "--worker"],
+            proc = subprocess.run([sys.executable, here, "--worker"]
+                                  + extra,
                                   timeout=700, capture_output=True,
                                   text=True)
         except subprocess.TimeoutExpired:
@@ -1096,7 +1168,8 @@ def _supervised() -> None:
         print(json.dumps(best))
         return
     try:
-        proc = subprocess.run([sys.executable, here, "--worker", "--cpu"],
+        proc = subprocess.run([sys.executable, here, "--worker", "--cpu"]
+                              + extra,
                               timeout=900, capture_output=True, text=True)
         line = next((ln for ln in proc.stdout.splitlines()
                      if ln.startswith("{")), None)

@@ -16,6 +16,7 @@ import time
 
 from ..common import Context
 from ..common.throttle import Throttle
+from ..common.tracer import SpanCollector, trace_ctx
 from ..mon.mon_client import MonClient
 from ..msg.message import MOSDOp, MWatchNotifyAck, OSD_READ_OPS
 from ..msg.async_messenger import create_messenger
@@ -74,6 +75,11 @@ class RadosClient(Dispatcher):
         # when client ids and tid counters restart across processes
         import uuid
         self.session = uuid.uuid4().hex
+        # op tracing (ZTracer client role): the root span of every
+        # traced op starts HERE, and its context rides the MOSDOp
+        # envelope so OSD-side spans stitch under it
+        self.tracer = SpanCollector(conf=self.ctx.conf,
+                                    endpoint="client.%d" % client_id)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -196,6 +202,10 @@ class RadosClient(Dispatcher):
         # semantics)
         tid = next(self._tids)
         op = _InflightOp(tid)
+        span = self.tracer.start_trace("client_op")
+        span.keyval("oid", oid)
+        span.keyval("op", ",".join(o[0] for o in ops if o))
+        ms_span = None
         self._throttle.get()
         with self._lock:
             self._inflight[tid] = op
@@ -224,12 +234,20 @@ class RadosClient(Dispatcher):
                 if addr is None:
                     time.sleep(min(backoff, remaining))
                     continue
+                # one messenger span per attempt: send -> reply (the
+                # OSD's osd_op span nests under it via the envelope)
+                if ms_span is not None:
+                    ms_span.finish()
+                ms_span = span.child("messenger")
+                ms_span.keyval("osd", primary)
+                t_id, p_id = trace_ctx(ms_span)
                 self.msgr.send_message(
                     MOSDOp(client_id=self.client_id, tid=tid, pgid=pgid,
                            oid=oid, ops=ops,
                            map_epoch=self.osdmap.epoch,
                            snapc=snapc or (0, ()), snap=snap,
-                           session=self.session, flags=flags), addr)
+                           session=self.session, flags=flags,
+                           trace_id=t_id, parent_span=p_id), addr)
                 # wait a slice, then re-send (map may have changed)
                 if op.event.wait(min(remaining, 1.0)):
                     if op.result == -11:  # EAGAIN: wrong/unready primary
@@ -240,6 +258,7 @@ class RadosClient(Dispatcher):
                         time.sleep(min(backoff, 0.2))
                         backoff = min(backoff * 2, 0.5)
                         continue
+                    span.keyval("result", op.result)
                     return op.result, op.data
                 with self._lock:
                     self._inflight[tid] = op   # re-arm for the resend
@@ -248,6 +267,9 @@ class RadosClient(Dispatcher):
                 # mon's push was lost on a lossy link
                 self.mon_client.renew_subs()
         finally:
+            if ms_span is not None:
+                ms_span.finish()
+            span.finish()
             with self._lock:
                 self._inflight.pop(tid, None)
             self._throttle.put()

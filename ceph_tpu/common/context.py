@@ -37,6 +37,13 @@ class Context:
         sock = AdminSocket(path)
         sock.register("perf dump", lambda args: self.perf.perf_dump(),
                       "dump perf counters")
+        sock.register("perf schema",
+                      lambda args: self.perf.perf_schema(),
+                      "counter kinds + histogram bucket bounds")
+        sock.register("perf reset",
+                      lambda args: {"reset": self.perf.perf_reset(
+                          args.get("key") or args.get("logger"))},
+                      "zero perf counters (optionally one logger)")
         sock.register("config get",
                       lambda args: {args["key"]:
                                     self.conf.get_val(args["key"])},

@@ -111,7 +111,17 @@ def _declare_defaults():
       "age after which an in-flight op counts as a slow request")
     # tracing (TracepointProvider/blkin gating)
     o("trace_enable", bool, False, LEVEL_ADVANCED,
-      "collect zipkin-style spans on the op path")
+      "collect zipkin-style spans on the op path (legacy utils.trace "
+      "gate; the op-path SpanCollector rides osd_tracing)")
+    o("osd_tracing", bool, True, LEVEL_ADVANCED,
+      "collect ZTracer-style op spans end to end (client -> messenger "
+      "-> op queue -> PG -> per-shard sub-ops -> store -> TPU device); "
+      "default on at framework scale, false = the zero-allocation "
+      "NULL_SPAN fast path")
+    o("osd_tracing_sample", int, 1, LEVEL_ADVANCED,
+      "trace 1 in N root ops (hot-path sampling knob; 1 = every op)")
+    o("osd_tracing_max_spans", int, 8192, LEVEL_ADVANCED,
+      "per-daemon bounded span ring capacity (oldest spans drop)")
     # mon
     o("mon_osd_down_out_interval", float, 2.0, LEVEL_ADVANCED,
       "seconds after down before an osd is marked out")

@@ -117,6 +117,30 @@ class PerfCounters:
                     out[name] = c.value
             return out
 
+    def schema(self) -> dict:
+        """Counter kinds + histogram bucket bounds (the 'perf schema'
+        admin command payload; perf_counters.h's schema dump role —
+        'perf dump' alone can't tell a gauge from a counter or name
+        the bucket edges)."""
+        with self._lock:
+            out = {}
+            for name, c in self._counters.items():
+                entry: dict = {"type": c.kind}
+                if c.kind == HISTOGRAM:
+                    entry["buckets"] = list(_HIST_BUCKETS)
+                out[name] = entry
+            return out
+
+    def reset(self) -> None:
+        """Zero every counter (the 'perf reset' before/after-
+        measurement surface)."""
+        with self._lock:
+            for c in self._counters.values():
+                c.value = 0
+                c.count = 0
+                if c.buckets is not None:
+                    c.buckets = [0] * len(c.buckets)
+
 
 class PerfCountersBuilder:
     """add_* then create_perf_counters (perf_counters.h builder idiom)."""
@@ -168,3 +192,18 @@ class PerfCountersCollection:
     def perf_dump(self) -> dict:
         with self._lock:
             return {name: pc.dump() for name, pc in self._loggers.items()}
+
+    def perf_schema(self) -> dict:
+        with self._lock:
+            return {name: pc.schema()
+                    for name, pc in self._loggers.items()}
+
+    def perf_reset(self, logger: str | None = None) -> list[str]:
+        """Reset one named logger, or every logger; returns the names
+        that were reset."""
+        with self._lock:
+            targets = [pc for name, pc in self._loggers.items()
+                       if logger is None or name == logger]
+        for pc in targets:
+            pc.reset()
+        return sorted(pc.name for pc in targets)

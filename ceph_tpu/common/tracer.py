@@ -1,0 +1,351 @@
+"""End-to-end op tracing: the ZTracer/blkin analog.
+
+Role of the reference's ZTracer::Trace + blkin integration
+(src/common/zipkin_trace.h; spans threaded through the EC write path at
+ECBackend.cc:1978-1983, one child span per shard) plus the
+TracepointProvider config gating (src/common/TracepointProvider.h:
+tracing is zero-cost until an option turns it on).
+
+Pieces:
+
+  Span           one named monotonic-clock interval with parent/child
+                 links, keyval annotations and point events.  trace_id
+                 ties spans of ONE logical op together across daemons;
+                 (trace_id, parent_span) ride message envelopes so the
+                 receiving daemon's spans stitch under the sender's.
+  NULL_SPAN      the shared no-op span: the disabled-tracing fast path
+                 (instrumented code pays one truthiness check).
+  SpanCollector  per-daemon bounded span ring, config-gated on
+                 `osd_tracing` with an `osd_tracing_sample` 1-in-N knob
+                 for hot paths; serves `dump_tracing` / `trace reset`
+                 over the admin socket.
+  trace_ctx      (trace_id, parent_span_id) for a message envelope.
+  device_segments  the one device-call shape everyone shares: run a
+                 codec call split into h2d / compute / d2h segments
+                 (TpuDispatcher device spans and bench.py --trace both
+                 ride it, so the bench breakdown and the production
+                 spans measure the same thing).
+  render_tree    the `ceph trace tree` renderer: stitched cross-daemon
+                 span tree with per-span self-times.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+__all__ = ["Span", "NULL_SPAN", "SpanCollector", "trace_ctx",
+           "device_segments", "render_tree"]
+
+# span ids must be unique ACROSS daemons for one trace (shards' spans
+# from different OSDs land in one tree): a per-process random high part
+# over a process-local counter keeps multi-process traces collision-free
+_ids = itertools.count(1)
+_ID_BASE = (int.from_bytes(os.urandom(3), "big") | 1) << 40
+
+
+def _next_id() -> int:
+    return _ID_BASE | next(_ids)
+
+
+class Span:
+    """One span: a named interval with keyvals, events and lineage."""
+
+    __slots__ = ("collector", "name", "endpoint", "trace_id", "span_id",
+                 "parent_id", "start", "start_wall", "end", "keyvals",
+                 "events")
+
+    def __init__(self, collector, name, endpoint="", trace_id=None,
+                 parent_id=None):
+        self.collector = collector
+        self.name = name
+        self.endpoint = endpoint
+        self.span_id = _next_id()
+        self.trace_id = trace_id if trace_id else self.span_id
+        self.parent_id = parent_id
+        self.start = time.monotonic()
+        self.start_wall = time.time()
+        self.end: float | None = None
+        self.keyvals: dict = {}
+        self.events: list[tuple[float, str]] = []
+
+    def valid(self) -> bool:
+        return True
+
+    def child(self, name: str) -> "Span":
+        return Span(self.collector, name, self.endpoint,
+                    trace_id=self.trace_id, parent_id=self.span_id)
+
+    def child_interval(self, name: str, start: float, end: float,
+                       **keyvals) -> "Span":
+        """Record an already-measured interval as a finished child
+        (monotonic stamps) — how the dispatcher back-fills queue-delay
+        and device-segment spans it could only time, not wrap."""
+        s = self.child(name)
+        s.start_wall = s.start_wall - (s.start - start)
+        s.start = start
+        s.keyvals.update(keyvals)
+        s.end = end
+        s.collector._record(s)
+        return s
+
+    def keyval(self, key: str, value) -> None:
+        self.keyvals[key] = value
+
+    def event(self, name: str) -> None:
+        self.events.append((time.monotonic(), name))
+
+    def finish(self) -> None:
+        if self.end is None:
+            self.end = time.monotonic()
+            self.collector._record(self)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.finish()
+
+    def dump(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_id": self.parent_id, "name": self.name,
+                "endpoint": self.endpoint, "start": self.start,
+                "start_wall": self.start_wall,
+                "duration": (self.end if self.end is not None
+                             else time.monotonic()) - self.start,
+                "keyvals": dict(self.keyvals),
+                "events": list(self.events)}
+
+
+class _NullSpan:
+    """Shared no-op span: the disabled-tracing fast path."""
+
+    __slots__ = ()
+    trace_id = 0
+    span_id = 0
+
+    def valid(self) -> bool:
+        return False
+
+    def child(self, name: str) -> "_NullSpan":
+        return self
+
+    def child_interval(self, name, start, end, **kv) -> "_NullSpan":
+        return self
+
+    def keyval(self, key: str, value) -> None:
+        pass
+
+    def event(self, name: str) -> None:
+        pass
+
+    def finish(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+def trace_ctx(span) -> tuple[int, int]:
+    """(trace_id, parent_span_id) for a message envelope; (0, 0) rides
+    when tracing is off, and a receiver seeing trace_id 0 stays null."""
+    return (span.trace_id, span.span_id)
+
+
+class SpanCollector:
+    """Per-daemon bounded span store, `osd_tracing`-gated.
+
+    Pass a Config to have enablement + the sampling knob follow
+    `osd_tracing` / `osd_tracing_sample` (hot-toggling included via the
+    config observer); without one, toggle `.enabled` directly.
+    """
+
+    def __init__(self, capacity: int = 8192, conf=None,
+                 endpoint: str = ""):
+        self.endpoint = endpoint
+        self.enabled = False
+        self.sample = 1
+        self._sample_ctr = itertools.count()
+        self._lock = threading.Lock()
+        if conf is not None:
+            try:
+                capacity = int(conf.get_val("osd_tracing_max_spans"))
+                self.enabled = bool(conf.get_val("osd_tracing"))
+                self.sample = max(1, int(
+                    conf.get_val("osd_tracing_sample")))
+            except KeyError:
+                pass  # options not in the schema: stay disabled
+            else:
+                collector = self
+
+                class _Obs:  # md_config_obs_t contract
+                    def get_tracked_keys(self):
+                        return ("osd_tracing", "osd_tracing_sample")
+
+                    def handle_conf_change(self, cfg, changed):
+                        collector.enabled = bool(
+                            cfg.get_val("osd_tracing"))
+                        collector.sample = max(1, int(
+                            cfg.get_val("osd_tracing_sample")))
+
+                conf.add_observer(_Obs())
+        self.capacity = capacity
+        self._spans: deque[Span] = deque(maxlen=capacity)
+
+    # -- span minting --------------------------------------------------
+
+    def start_trace(self, name: str, endpoint: str | None = None):
+        """Root span (sampling applies here), or NULL_SPAN."""
+        if not self.enabled:
+            return NULL_SPAN
+        if self.sample > 1 and next(self._sample_ctr) % self.sample:
+            return NULL_SPAN
+        return Span(self, name,
+                    self.endpoint if endpoint is None else endpoint)
+
+    def continue_trace(self, name: str, trace_id: int, parent_id: int,
+                       endpoint: str | None = None):
+        """Stitch onto a trace context from a message envelope; the
+        sampling decision was the root's — a nonzero trace_id means the
+        originator chose to trace this op."""
+        if not self.enabled or not trace_id:
+            return NULL_SPAN
+        return Span(self, name,
+                    self.endpoint if endpoint is None else endpoint,
+                    trace_id=trace_id, parent_id=parent_id or None)
+
+    # -- storage -------------------------------------------------------
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    def dump(self, trace_id: int | None = None) -> list[dict]:
+        with self._lock:
+            spans = list(self._spans)
+        return [s.dump() for s in spans
+                if trace_id is None or s.trace_id == trace_id]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    # -- admin socket surface ------------------------------------------
+
+    def register_admin_commands(self, asok) -> None:
+        def _dump(args: dict) -> dict:
+            tid = args.get("trace_id") or args.get("key")
+            tid = int(tid, 0) if isinstance(tid, str) else tid
+            spans = self.dump(tid)
+            return {"enabled": self.enabled, "sample": self.sample,
+                    "num_spans": len(spans), "spans": spans}
+
+        asok.register("dump_tracing", _dump,
+                      "dump collected op spans (optional trace_id)")
+        asok.register("trace reset",
+                      lambda args: (self.clear(), {"reset": True})[1],
+                      "drop all collected spans")
+
+
+# -- shared device-call segmentation -----------------------------------
+
+def device_segments(fn, batch):
+    """Run fn(batch) as an explicit h2d -> compute -> d2h sequence and
+    time each leg.  Returns (host ndarray result, {"h2d", "compute",
+    "d2h"} seconds).  The TpuDispatcher's device spans and bench.py
+    --trace both use this, so the artifact breakdown and production
+    spans measure the identical call shape.  Falls back to one
+    unsegmented call (all time under "compute") when jax is absent."""
+    t0 = time.perf_counter()
+    try:
+        import jax
+        import jax.numpy as jnp
+    except Exception:
+        out = np.asarray(fn(batch))
+        return out, {"h2d": 0.0, "compute": time.perf_counter() - t0,
+                     "d2h": 0.0}
+    dev = jax.block_until_ready(jnp.asarray(batch))
+    t1 = time.perf_counter()
+    out_dev = jax.block_until_ready(fn(dev))
+    t2 = time.perf_counter()
+    out = np.asarray(out_dev)
+    t3 = time.perf_counter()
+    return out, {"h2d": t1 - t0, "compute": t2 - t1, "d2h": t3 - t2}
+
+
+# -- tree rendering (the `ceph trace tree` surface) --------------------
+
+def _fmt_dur(seconds: float) -> str:
+    if seconds >= 1.0:
+        return "%.3fs" % seconds
+    if seconds >= 1e-3:
+        return "%.2fms" % (seconds * 1e3)
+    return "%.0fus" % (seconds * 1e6)
+
+
+def render_tree(spans: list[dict], trace_id: int | None = None) -> str:
+    """Render stitched spans (possibly gathered from several daemons'
+    dump_tracing) as an indented tree with self-times.  Spans whose
+    parent is not in the set render as roots — a partial gather still
+    produces a readable forest.  Within one daemon children sort by
+    monotonic start; across daemons by wall stamp (monotonic clocks
+    don't compare across processes)."""
+    if trace_id is not None:
+        spans = [s for s in spans if s.get("trace_id") == trace_id]
+    if not spans:
+        return "(no spans)"
+    by_id = {s["span_id"]: s for s in spans}
+    children: dict = {}
+    roots: list = []
+    for s in spans:
+        parent = s.get("parent_id")
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+
+    def order(kids: list) -> list:
+        endpoints = {k.get("endpoint") for k in kids}
+        if len(endpoints) > 1:
+            return sorted(kids, key=lambda s: s.get("start_wall", 0.0))
+        return sorted(kids, key=lambda s: s.get("start", 0.0))
+
+    lines: list[str] = []
+    traces = sorted({s.get("trace_id") for s in spans})
+    endpoints = sorted({s.get("endpoint", "") for s in spans})
+    lines.append("trace%s %s  (%d spans, %d endpoint(s): %s)"
+                 % ("s" if len(traces) > 1 else "",
+                    ", ".join(str(t) for t in traces), len(spans),
+                    len(endpoints), ", ".join(e or "?"
+                                              for e in endpoints)))
+
+    def walk(s: dict, depth: int) -> None:
+        kids = order(children.get(s["span_id"], []))
+        dur = s.get("duration", 0.0)
+        self_t = max(0.0, dur - sum(k.get("duration", 0.0)
+                                    for k in kids))
+        kv = s.get("keyvals") or {}
+        kv_txt = ("  {%s}" % ", ".join(
+            "%s=%s" % (k, v) for k, v in sorted(kv.items()))) if kv \
+            else ""
+        lines.append("%s%s @%s  %s (self %s)%s"
+                     % ("  " * depth + ("- " if depth else ""),
+                        s["name"], s.get("endpoint") or "?",
+                        _fmt_dur(dur), _fmt_dur(self_t), kv_txt))
+        for k in kids:
+            walk(k, depth + 1)
+
+    for root in order(roots):
+        walk(root, 1)
+    return "\n".join(lines)

@@ -77,12 +77,35 @@ class PrometheusModule(MgrModule):
         emit("ceph_health_detail", len(health),
              help_="number of active health checks")
         # per-daemon perf counters (reference: perf_counters as
-        # ceph_<daemon-type>_<counter>{ceph_daemon=...})
+        # ceph_<daemon-type>_<counter>{ceph_daemon=...}); this includes
+        # the l_bluefs_* and l_tpu_* groups the OSDs register
         for daemon, perf in sorted(self.get("perf_counters").items()):
             dtype = daemon.split(".", 1)[0]
             for group, counters in perf.items():
                 for cname, val in counters.items():
                     if isinstance(val, dict):
+                        if "buckets" in val:
+                            # histogram: prometheus classic shape —
+                            # cumulative le-labeled buckets + sum/count
+                            base = _metric_name("ceph", dtype, group,
+                                                cname)
+                            cum = 0
+                            buckets = val["buckets"]
+                            for i, n in enumerate(buckets):
+                                cum += n
+                                le = ("+Inf"
+                                      if i == len(buckets) - 1
+                                      else str(1 << (i + 1)))
+                                emit(base + "_bucket", cum,
+                                     {"ceph_daemon": daemon, "le": le},
+                                     mtype="counter")
+                            emit(base + "_sum", val.get("sum", 0),
+                                 {"ceph_daemon": daemon},
+                                 mtype="counter")
+                            emit(base + "_count", val.get("count", 0),
+                                 {"ceph_daemon": daemon},
+                                 mtype="counter")
+                            continue
                         # avg/time counters: export sum+count
                         for sub in ("sum", "avgcount"):
                             if sub in val:

@@ -19,6 +19,9 @@ Checks implemented (names follow the reference's health check ids):
                     MPGStats; REPLICATED so the count survives leader
                     failover, cleared when a repair re-reports zero
   POOL_FULL         a pool over its target_max_bytes/objects quota
+  OSD_SLOW_OPS      OpTracker slow-request counts riding the MPGStats
+                    report (the reference's "N slow ops" health check);
+                    clears when the ops drain and the osd re-reports 0
 
 Raw pg stats stay leader-local (they churn with IO; replicating them
 would melt paxos) — only the DERIVED check map and the scrub-error
@@ -48,6 +51,8 @@ class HealthMonitor:
         # leader-local raw stats (re-reported by primaries on their
         # heartbeat cadence; a fresh leader refills within a tick)
         self._pg_stats: dict = {}      # str(pgid) -> stats dict
+        self._slow_ops: dict = {}      # osd id -> slow-request count
+        self._reported_osds: set = set()   # osds heard from (this mon)
         self._stats_gen = 0
         self._seen_epoch = -1
         self._seen_gen = -1
@@ -105,6 +110,12 @@ class HealthMonitor:
             for key, st in msg.pg_stats.items():
                 if isinstance(st, dict):
                     self._pg_stats[key] = dict(st)
+            self._reported_osds.add(msg.osd_id)
+            n = int(getattr(msg, "slow_ops", 0) or 0)
+            if n > 0:
+                self._slow_ops[msg.osd_id] = n
+            else:
+                self._slow_ops.pop(msg.osd_id, None)
             self._stats_gen += 1
         self.recompute()
 
@@ -240,6 +251,21 @@ class HealthMonitor:
                                for n in sorted(full)]}
             elif not self._pg_stats and "POOL_FULL" in eff["checks"]:
                 checks["POOL_FULL"] = eff["checks"]["POOL_FULL"]
+            # OSD_SLOW_OPS from the per-osd slow-request counts riding
+            # MPGStats; with no reports yet (fresh leader) carry the
+            # committed verdict until the osds re-report
+            slow_total = sum(self._slow_ops.values())
+            if slow_total:
+                checks["OSD_SLOW_OPS"] = {
+                    "severity": "warning",
+                    "summary": "%d slow ops on %d osd(s)"
+                               % (slow_total, len(self._slow_ops)),
+                    "detail": ["osd.%d has %d slow requests" % (o, n)
+                               for o, n in sorted(
+                                   self._slow_ops.items())]}
+            elif not self._reported_osds \
+                    and "OSD_SLOW_OPS" in eff["checks"]:
+                checks["OSD_SLOW_OPS"] = eff["checks"]["OSD_SLOW_OPS"]
             if checks == eff["checks"] and scrub == eff["scrub_errors"]:
                 return
             self.pending = {"checks": checks, "scrub_errors": scrub}

@@ -83,6 +83,11 @@ class MOSDOp(Message):
                                    # survives client-id/tid reuse
                                    # across processes
     flags: int = 0                 # OSD_FLAG_* (appended field)
+    # tracing context (ZTracer envelope role, appended fields): the
+    # client's trace id + the span the OSD's spans nest under; 0 = op
+    # not traced
+    trace_id: int = 0
+    parent_span: int = 0
 
 
 # CEPH_OSD_FLAG_IGNORE_CACHE (src/include/rados.h): run the op on the
@@ -121,6 +126,8 @@ class MOSDECSubOpWrite(Message):
     backfill: bool = False
     map_epoch: int = 0
     instance: str = ""             # sender-incarnation nonce (dedup)
+    trace_id: int = 0              # tracing envelope (appended): the
+    parent_span: int = 0           # primary's per-shard child span
 
 
 @dataclass
@@ -143,6 +150,8 @@ class MOSDECSubOpRead(Message):
     to_read: list = field(default_factory=list)   # [(oid, off, len, flags)]
     attrs_to_read: list = field(default_factory=list)
     map_epoch: int = 0
+    trace_id: int = 0              # tracing envelope (appended): the
+    parent_span: int = 0           # primary's per-shard read span
 
 
 @dataclass
@@ -168,6 +177,8 @@ class MOSDRepOp(Message):
     txn_ops: list = field(default_factory=list)
     map_epoch: int = 0
     instance: str = ""             # sender-incarnation nonce (dedup)
+    trace_id: int = 0              # tracing envelope (appended): the
+    parent_span: int = 0           # primary's per-peer rep-op span
 
 
 @dataclass
@@ -369,6 +380,9 @@ class MPGStats(Message):
     osd_id: int = -1
     pg_stats: dict = field(default_factory=dict)
     epoch: int = 0
+    # OpTracker slow-request count (appended field): the HealthMonitor
+    # derives OSD_SLOW_OPS from it, clearing when the ops drain
+    slow_ops: int = 0
 
 
 # -- mgr ---------------------------------------------------------------

@@ -36,6 +36,7 @@ import numpy as np
 from ..common.bounded import BoundedDict
 from ..common.interval_set import ExtentMap, IntervalSet
 from ..common.lockdep import make_rlock
+from ..common.tracer import NULL_SPAN, trace_ctx
 from ..msg.message import (MOSDECSubOpRead, MOSDECSubOpReadReply,
                            MOSDECSubOpWrite, MOSDECSubOpWriteReply)
 from ..store.object_store import Transaction
@@ -47,11 +48,14 @@ __all__ = ["ECBackend"]
 
 
 class _InflightWrite:
-    def __init__(self, tid, pg_txn, at_version, on_commit):
+    def __init__(self, tid, pg_txn, at_version, on_commit,
+                 trace=NULL_SPAN):
         self.tid = tid
         self.pg_txn = pg_txn
         self.at_version = at_version
         self.on_commit = on_commit
+        self.trace = trace            # the client op's span (or null)
+        self.sub_spans: dict = {}     # shard -> per-shard sub-write span
         self.plan = None
         self.pin = None
         self.must_read: dict = {}     # oid -> IntervalSet
@@ -62,12 +66,15 @@ class _InflightWrite:
 
 
 class _InflightRead:
-    def __init__(self, tid, oid, off, length, on_done):
+    def __init__(self, tid, oid, off, length, on_done,
+                 trace=NULL_SPAN):
         self.tid = tid
         self.oid = oid
         self.off = off
         self.length = length
         self.on_done = on_done
+        self.trace = trace
+        self.sub_spans: dict = {}     # shard -> per-shard sub-read span
         self.raw_shards_cb = None     # recovery: wants raw shard streams
         self.shard_data: dict = {}    # shard -> bytes
         self.want_shards: set = set()
@@ -122,9 +129,12 @@ class ECBackend:
     # =================================================================
 
     def submit_transaction(self, pg_txn, at_version: int,
-                           on_commit, reqid: tuple = ("", 0)) -> int:
+                           on_commit, reqid: tuple = ("", 0),
+                           trace=NULL_SPAN) -> int:
         tid = next(self._tids)
-        op = _InflightWrite(tid, pg_txn, at_version, on_commit)
+        op = _InflightWrite(tid, pg_txn, at_version, on_commit,
+                            trace=trace if trace is not None
+                            else NULL_SPAN)
         op.reqid = reqid
         with self.lock:
             self.waiting_state.append(op)
@@ -195,11 +205,17 @@ class ECBackend:
                 partial[oid] = self.cache.get_remaining_extents_for_rmw(
                     oid, to_read)
             shards = self.pg.acting_shards()     # shard -> osd (may hole)
+            # encode under its own span so the dispatcher's tpu_queue /
+            # tpu_device segments nest beneath it (ECBackend.cc:1857's
+            # try_reads_to_commit is where the codec runs)
+            enc_span = op.trace.child("ec_encode")
             txns, written = ec_transaction.generate_transactions(
                 op.plan, self.codec, self.sinfo, partial,
                 list(range(self.n)), self.pg.cid_of_shard,
                 dispatcher=getattr(self.pg.daemon, "tpu_dispatcher",
-                                   None))
+                                   None),
+                trace=enc_span)
+            enc_span.finish()
             for oid, wmap in written.items():
                 self.cache.present_rmw_update(oid, wmap)
             op.pending_commits = {s for s, osd in shards.items()
@@ -212,12 +228,18 @@ class ECBackend:
         for shard, osd in shards.items():
             if osd == CRUSH_ITEM_NONE:
                 continue
+            # one child span per shard sub-write (ECBackend.cc:1978-83)
+            sub_span = op.trace.child("sub_write(shard=%d)" % shard)
+            sub_span.keyval("osd", osd)
+            op.sub_spans[shard] = sub_span
+            t_id, p_id = trace_ctx(sub_span)
             msg = MOSDECSubOpWrite(
                 pgid=self.pg.pgid, shard=shard, from_osd=self.pg.whoami,
                 tid=op.tid, at_version=op.at_version,
                 log_entries=log_entry,
                 txn_ops=txns[shard].ops, map_epoch=self.pg.map_epoch(),
-                instance=self.instance)
+                instance=self.instance, trace_id=t_id,
+                parent_span=p_id)
             op.sub_msgs[shard] = (osd, msg)
             if osd == self.pg.whoami:
                 self.handle_sub_write(msg, local=True)
@@ -265,6 +287,10 @@ class ECBackend:
                 self.waiting_commit.remove(op)
             self.cache.release_write_pin(op.pin)
             on_commit = op.on_commit
+            spans = list(op.sub_spans.values())
+            op.sub_spans = {}
+        for span in spans:   # shards dropped mid-interval finish here
+            span.finish()
         if on_commit:
             on_commit()
         self.check_ops()
@@ -294,8 +320,16 @@ class ECBackend:
                 else:
                     self.pg.send_to_osd(msg.from_osd, reply)
             return
+        # replica-side span, stitched under the primary's per-shard
+        # child via the envelope context (covers store apply + commit)
+        span = self.pg.daemon.tracer.continue_trace(
+            "ec_sub_write", getattr(msg, "trace_id", 0),
+            getattr(msg, "parent_span", 0))
+        span.keyval("shard", msg.shard)
+        span.keyval("tid", msg.tid)
         txn = Transaction()
         txn.ops = list(msg.txn_ops)
+        txn.trace = span             # store-level spans nest under it
         # log keys ride the same store transaction as the shard data
         self.pg.log_operation(msg.log_entries, msg.at_version,
                               msg.shard, txn=txn)
@@ -312,6 +346,7 @@ class ECBackend:
                 self._sub_seen[key] = True
                 for oid in touched:
                     self.hinfo_cache.pop(oid, None)
+            span.finish()
             reply = MOSDECSubOpWriteReply(
                 pgid=self.pg.pgid, shard=msg.shard,
                 from_osd=self.pg.whoami, tid=msg.tid,
@@ -327,12 +362,16 @@ class ECBackend:
 
     def handle_sub_write_reply(self, msg) -> None:
         target = None
+        span = None
         with self.lock:
             for op in self.waiting_commit:
                 if op.tid == msg.tid:
                     op.pending_commits.discard(msg.shard)
+                    span = op.sub_spans.pop(msg.shard, None)
                     target = op
                     break
+        if span is not None:
+            span.finish()
         if target is not None:
             self._try_finish_rmw(target)
 
@@ -340,16 +379,17 @@ class ECBackend:
     # read path
     # =================================================================
 
-    def objects_read(self, oid, off: int, length: int, on_done) -> None:
+    def objects_read(self, oid, off: int, length: int, on_done,
+                     trace=NULL_SPAN) -> None:
         """Async logical read [off, off+length) -> on_done(bytes|None).
 
         Sub-reads the covering chunk range from the available shards
         (data shards when whole, any k when degraded), decodes if any
         data shard is missing, slices the requested range."""
-        self._start_read(oid, off, length, on_done)
+        self._start_read(oid, off, length, on_done, trace=trace)
 
     def _start_read(self, oid, off, length, on_done,
-                    internal: bool = False) -> None:
+                    internal: bool = False, trace=NULL_SPAN) -> None:
         size = self._object_logical_size(oid)
         if size == 0:
             on_done(b"" if not internal else None)
@@ -382,7 +422,9 @@ class ECBackend:
             return
 
         tid = next(self._tids)
-        read = _InflightRead(tid, oid, off, end - off, on_done)
+        read = _InflightRead(tid, oid, off, end - off, on_done,
+                             trace=trace if trace is not None
+                             else NULL_SPAN)
         read.want_shards = set(to_read)
         read.chunk_off = chunk_off
         read.chunk_len = chunk_len
@@ -390,10 +432,17 @@ class ECBackend:
             self.inflight_reads[tid] = read
         for shard in to_read:
             osd = shards_avail[shard]
+            # one child span per shard sub-read, mirroring the write
+            # side's per-shard children
+            sub_span = read.trace.child("sub_read(shard=%d)" % shard)
+            sub_span.keyval("osd", osd)
+            read.sub_spans[shard] = sub_span
+            t_id, p_id = trace_ctx(sub_span)
             msg = MOSDECSubOpRead(
                 pgid=self.pg.pgid, shard=shard, from_osd=self.pg.whoami,
                 tid=tid, to_read=[(oid, chunk_off, chunk_len, 0)],
-                map_epoch=self.pg.map_epoch())
+                map_epoch=self.pg.map_epoch(), trace_id=t_id,
+                parent_span=p_id)
             if osd == self.pg.whoami:
                 self.handle_sub_read(msg, local=True)
             else:
@@ -410,6 +459,10 @@ class ECBackend:
         check): silent bit-rot becomes an EIO in the reply, so the
         primary reconstructs around it exactly like a loud disk error
         instead of decoding garbage into the client's buffer."""
+        span = self.pg.daemon.tracer.continue_trace(
+            "ec_sub_read", getattr(msg, "trace_id", 0),
+            getattr(msg, "parent_span", 0))
+        span.keyval("shard", msg.shard)
         reply = MOSDECSubOpReadReply(
             pgid=self.pg.pgid, shard=msg.shard, from_osd=self.pg.whoami,
             tid=msg.tid)
@@ -438,6 +491,7 @@ class ECBackend:
         for name in msg.attrs_to_read:
             reply.attrs_read[name] = self.pg.local_getattr(
                 msg.to_read[0][0], name)
+        span.finish()
         if local:
             self.handle_sub_read_reply(reply)
         else:
@@ -461,10 +515,12 @@ class ECBackend:
 
     def handle_sub_read_reply(self, msg) -> None:
         bad_oid = None
+        done_span = None
         with self.lock:
             read = self.inflight_reads.get(msg.tid)
             if read is None:
                 return
+            done_span = read.sub_spans.pop(msg.shard, None)
             if msg.errors:
                 bad_oid = read.oid
                 read.errors[msg.shard] = msg.errors
@@ -489,6 +545,10 @@ class ECBackend:
                     data = b"".join(b for _off, b in bufs)
                     read.shard_data[msg.shard] = data
                 resend = None
+        if done_span is not None:
+            if msg.errors:
+                done_span.keyval("error", True)
+            done_span.finish()
         if bad_oid is not None:
             # the bad shard is treated as missing for THIS read, and
             # self-healed behind it: reconstruct from the survivors
@@ -503,11 +563,18 @@ class ECBackend:
             return
         if msg.errors and resend is not None:
             sub, osd = resend
+            sub_span = read.trace.child("sub_read(shard=%d)" % sub)
+            sub_span.keyval("osd", osd)
+            sub_span.keyval("substituted_for", msg.shard)
+            with self.lock:
+                read.sub_spans[sub] = sub_span
+            t_id, p_id = trace_ctx(sub_span)
             m = MOSDECSubOpRead(
                 pgid=self.pg.pgid, shard=sub, from_osd=self.pg.whoami,
                 tid=msg.tid,
                 to_read=[(read.oid, read.chunk_off, read.chunk_len, 0)],
-                map_epoch=self.pg.map_epoch())
+                map_epoch=self.pg.map_epoch(), trace_id=t_id,
+                parent_span=p_id)
             if osd == self.pg.whoami:
                 self.handle_sub_read(m, local=True)
             else:
@@ -523,18 +590,25 @@ class ECBackend:
             if set(read.shard_data) != read.want_shards:
                 return
             self.inflight_reads.pop(tid)
+        for span in read.sub_spans.values():
+            span.finish()        # stragglers (substituted-away shards)
+        read.sub_spans = {}
         if read.raw_shards_cb is not None:
             read.raw_shards_cb(dict(read.shard_data))
             return
         # reassemble: decode the chunk streams back to logical bytes
+        dec_span = read.trace.child("ec_decode")
         try:
             out = ec_util.decode_concat(
                 self.sinfo, self.codec, dict(read.shard_data),
                 dispatcher=getattr(self.pg.daemon, "tpu_dispatcher",
-                                   None))
+                                   None),
+                trace=dec_span)
         except Exception:
+            dec_span.finish()
             read.on_done(None)
             return
+        dec_span.finish()
         stripe_off = self.sinfo.aligned_chunk_offset_to_logical_offset(
             read.chunk_off)
         start = read.off - stripe_off

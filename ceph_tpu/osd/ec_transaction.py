@@ -119,7 +119,8 @@ def generate_transactions(plan: WritePlan, codec,
                           sinfo: ec_util.StripeInfo,
                           partial_extents: dict,
                           shards: list,
-                          cid_of, dispatcher=None) -> tuple[dict, dict]:
+                          cid_of, dispatcher=None,
+                          trace=None) -> tuple[dict, dict]:
     """Build {shard: Transaction} from the plan + readback data.
 
     partial_extents: oid -> ExtentMap with the to_read stripes filled
@@ -184,7 +185,8 @@ def generate_transactions(plan: WritePlan, codec,
                         buf[lo - off:hi - off] = data[lo - uoff:hi - uoff]
 
                 encoded = ec_util.encode(sinfo, codec, buf,
-                                         dispatcher=dispatcher)
+                                         dispatcher=dispatcher,
+                                         trace=trace)
                 chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(off)
                 for shard in range(n):
                     if shard in txns:

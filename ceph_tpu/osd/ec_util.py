@@ -78,7 +78,7 @@ class StripeInfo:
 
 
 def encode(sinfo: StripeInfo, codec, data, want=None,
-           dispatcher=None) -> dict:
+           dispatcher=None, trace=None) -> dict:
     """Encode a stripe-aligned payload -> {shard: chunk bytes}.
 
     data: bytes/uint8 array whose length is a multiple of stripe_width.
@@ -102,7 +102,7 @@ def encode(sinfo: StripeInfo, codec, data, want=None,
     # [S, k, chunk]: stripes become the device batch dimension
     batch = arr.reshape(stripes, k, sinfo.chunk_size)
     if dispatcher is not None:
-        parity = np.asarray(dispatcher.encode(codec, batch))
+        parity = np.asarray(dispatcher.encode(codec, batch, trace=trace))
     else:
         parity = np.asarray(codec.encode_batch(batch))
     out = {}
@@ -116,7 +116,7 @@ def encode(sinfo: StripeInfo, codec, data, want=None,
 
 
 def decode(sinfo: StripeInfo, codec, to_decode: dict,
-           want=None, dispatcher=None) -> dict:
+           want=None, dispatcher=None, trace=None) -> dict:
     """Reconstruct shards from per-shard chunk streams.
 
     to_decode: {shard: bytes of >= 1 chunks, equal lengths}. Returns
@@ -191,7 +191,8 @@ def decode(sinfo: StripeInfo, codec, to_decode: dict,
                 % (len(use), k))
         stacked = np.stack([logical[i] for i in use], axis=1)  # [S,k,chunk]
         if dispatcher is not None:
-            full = np.asarray(dispatcher.decode(codec, use, stacked))
+            full = np.asarray(dispatcher.decode(codec, use, stacked,
+                                                trace=trace))
         else:
             full = np.asarray(codec.decode_batch(use, stacked))  # [S,n,chunk]
     out = {}
@@ -207,12 +208,13 @@ def decode(sinfo: StripeInfo, codec, to_decode: dict,
 
 
 def decode_concat(sinfo: StripeInfo, codec, to_decode: dict,
-                  dispatcher=None) -> bytes:
+                  dispatcher=None, trace=None) -> bytes:
     """Reconstruct and concatenate the data shards back into the logical
     payload (the read-path finish, ECUtil.cc:46-99)."""
     k = codec.get_data_chunk_count()
     want = {codec.chunk_index(i) for i in range(k)}
-    shards = decode(sinfo, codec, to_decode, want, dispatcher=dispatcher)
+    shards = decode(sinfo, codec, to_decode, want, dispatcher=dispatcher,
+                    trace=trace)
     total = len(next(iter(shards.values())))
     stripes = total // sinfo.chunk_size
     stacked = np.stack(

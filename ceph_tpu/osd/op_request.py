@@ -8,6 +8,11 @@ tracker holds all in-flight ops plus a bounded history of completed
 ones, served over the admin socket as `dump_ops_in_flight` /
 `dump_historic_ops` — and flags ops older than the complaint time the
 way the OSD's "slow request" warnings do.
+
+Clocks: every duration/age/complaint decision runs on time.monotonic()
+(a wall-clock step must not fabricate or mask slow requests); the
+wall-clock `initiated_at` is kept for DISPLAY only, and event stamps
+render as wall times derived from the monotonic deltas.
 """
 
 from __future__ import annotations
@@ -26,13 +31,14 @@ class OpRequest:
     def __init__(self, description: str, tracker: "OpTracker | None" = None):
         self.id = next(_ids)
         self.description = description
-        self.initiated_at = time.time()
-        self.events: list[tuple[float, str]] = []
-        self.done_at: float | None = None
+        self.initiated_at = time.time()        # wall clock, display only
+        self.initiated_mono = time.monotonic()  # the timing anchor
+        self.events: list[tuple[float, str]] = []  # (monotonic, name)
+        self.done_at: float | None = None      # monotonic
         self._tracker = tracker
 
     def mark_event(self, name: str) -> None:
-        self.events.append((time.time(), name))
+        self.events.append((time.monotonic(), name))
 
     def mark_started(self) -> None:
         self.mark_event("started")
@@ -41,25 +47,29 @@ class OpRequest:
         self.mark_event("commit_sent")
 
     def mark_done(self) -> None:
-        self.done_at = time.time()
+        self.done_at = time.monotonic()
         self.mark_event("done")
         if self._tracker is not None:
             self._tracker.unregister_inflight_op(self)
 
     @property
     def duration(self) -> float:
-        end = self.done_at if self.done_at is not None else time.time()
-        return end - self.initiated_at
+        end = self.done_at if self.done_at is not None \
+            else time.monotonic()
+        return end - self.initiated_mono
+
+    def _to_wall(self, mono_ts: float) -> float:
+        return self.initiated_at + (mono_ts - self.initiated_mono)
 
     def dump(self) -> dict:
         return {
             "id": self.id,
             "description": self.description,
             "initiated_at": self.initiated_at,
-            "age": time.time() - self.initiated_at,
+            "age": time.monotonic() - self.initiated_mono,
             "duration": self.duration,
             "type_data": {
-                "events": [{"time": ts, "event": name}
+                "events": [{"time": self._to_wall(ts), "event": name}
                            for ts, name in self.events],
             },
         }
@@ -97,7 +107,7 @@ class OpTracker:
             self._prune_locked()
 
     def _prune_locked(self) -> None:
-        now = time.time()
+        now = time.monotonic()
         while len(self._history) > self.history_size:
             self._history.popleft()
         while self._history and (self._history[0].done_at or now) \
@@ -124,11 +134,19 @@ class OpTracker:
 
     def get_slow_ops(self, now: float | None = None) -> list[dict]:
         """Ops in flight longer than the complaint time (the OSD's
-        'slow request' warning source)."""
-        now = time.time() if now is None else now
+        'slow request' warning source; now is monotonic)."""
+        now = time.monotonic() if now is None else now
         with self._lock:
             return [op.dump() for op in self._inflight.values()
-                    if now - op.initiated_at > self.complaint_time]
+                    if now - op.initiated_mono > self.complaint_time]
+
+    def slow_ops_count(self, now: float | None = None) -> int:
+        """Cheap slow-request count (the MPGStats -> OSD_SLOW_OPS
+        health feed: no dump dicts on the heartbeat path)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return sum(1 for op in self._inflight.values()
+                       if now - op.initiated_mono > self.complaint_time)
 
     def register_admin_commands(self, asok) -> None:
         asok.register("dump_ops_in_flight",

@@ -24,7 +24,7 @@ from ..msg.async_messenger import create_messenger
 from ..msg.messenger import Dispatcher
 from ..store.mem_store import MemStore
 from ..common.lockdep import make_rlock
-from ..utils.trace import Tracer
+from ..common.tracer import SpanCollector
 from .op_queue import QosShardedOpWQ, make_op_queue
 from .op_request import OpTracker
 from .osd_map import OSDMap
@@ -110,10 +110,14 @@ class OSDDaemon(Dispatcher):
             history_size=conf.get_val("osd_op_history_size"),
             history_duration=conf.get_val("osd_op_history_duration"),
             complaint_time=conf.get_val("osd_op_complaint_time"))
-        # zipkin/blkin-style spans, config-gated (trace_enable)
-        self.tracer = Tracer(conf=conf)
+        # ZTracer-style span collector, config-gated (osd_tracing with
+        # an osd_tracing_sample hot-path knob); spans stitch across
+        # daemons via the message-envelope (trace_id, parent_span)
+        self.tracer = SpanCollector(conf=conf,
+                                    endpoint="osd.%d" % whoami)
         if self.ctx.admin_socket is not None:
             self.op_tracker.register_admin_commands(self.ctx.admin_socket)
+            self.tracer.register_admin_commands(self.ctx.admin_socket)
             # store-specific commands (BlockStore: 'bluefs stats',
             # 'bluestore fsck' — the reference's asok surface)
             register_store = getattr(self.store,
@@ -128,7 +132,11 @@ class OSDDaemon(Dispatcher):
             self.tpu_dispatcher = TpuDispatcher(
                 max_batch=conf.get_val("osd_tpu_coalesce_max_batch"),
                 max_delay=conf.get_val(
-                    "osd_tpu_coalesce_max_delay_ms") / 1e3)
+                    "osd_tpu_coalesce_max_delay_ms") / 1e3,
+                tracer=self.tracer)
+            # l_tpu_* device-segment counters ride the daemon's perf
+            # collection (mgr report -> prometheus)
+            self.ctx.perf.add(self.tpu_dispatcher.perf)
         else:
             self.tpu_dispatcher = None
         self.hb_peers: dict = {}       # osd -> last reply stamp
@@ -154,6 +162,17 @@ class OSDDaemon(Dispatcher):
                      .add_u64_counter("repaired",
                                       "shards rewritten by read-repair "
                                       "or scrub repair (l_osd_repaired)")
+                     # span-derived per-phase op timing (the tracing
+                     # spine's aggregate view; always on — a tinc is
+                     # cheap even when span objects are not minted)
+                     .add_time_avg("l_osd_op_trace_queue",
+                                   "op wait in the sharded op queue")
+                     .add_time_avg("l_osd_op_trace_pg",
+                                   "pg do_op planning/submit time")
+                     .add_time_avg("l_osd_op_trace_total",
+                                   "client op end-to-end on this osd")
+                     .add_histogram("l_osd_op_trace_us",
+                                    "op latency histogram, microseconds")
                      .create_perf_counters())
         self.ctx.perf.add(self.perf)
         # cluster log channel (the reference's clog): operator-facing
@@ -169,6 +188,13 @@ class OSDDaemon(Dispatcher):
 
     def init(self) -> None:
         self.store.mount()
+        # BlockStore: the l_bluefs_* counters exist only after mount;
+        # register them so 'perf dump'/'perf schema', the mgr report,
+        # and PrometheusModule all carry them
+        bluefs = getattr(self.store, "bluefs", None)
+        if bluefs is not None and getattr(bluefs, "perf", None) \
+                is not None:
+            self.ctx.perf.add(bluefs.perf)
         for msgr in (self.public_msgr, self.cluster_msgr, self.hb_msgr):
             msgr.bind()
             msgr.add_dispatcher_head(self)
@@ -479,11 +505,17 @@ class OSDDaemon(Dispatcher):
                 stats[str(pg.pgid)] = pg.get_stats()
             except Exception:
                 continue
-        if not stats:
+        # slow-request count rides the same report (OSD_SLOW_OPS feed);
+        # it must go out even with no primary-PG stats so a wedged op
+        # on a just-demoted primary still surfaces
+        slow = self.op_tracker.slow_ops_count()
+        if not stats and not slow \
+                and not getattr(self, "_slow_reported", False):
             return
+        self._slow_reported = slow > 0
         from ..msg.message import MPGStats
         self._send_mon(MPGStats(osd_id=self.whoami, pg_stats=stats,
-                                epoch=self.map_epoch()))
+                                epoch=self.map_epoch(), slow_ops=slow))
 
     # -- dispatch ------------------------------------------------------
 
@@ -605,9 +637,18 @@ class OSDDaemon(Dispatcher):
         op = self.op_tracker.create_request(
             "osd_op(tid=%s pg=%s %s)" % (msg.tid, msg.pgid,
                                          getattr(msg, "op", "?")))
-        span = self.tracer.start_trace("osd_op", "osd.%d" % self.whoami)
+        # stitch under the client's trace when the envelope carries a
+        # context; a context-less op (old client, tracing off there)
+        # still gets an OSD-rooted trace subject to local sampling
+        span = self.tracer.continue_trace(
+            "osd_op", getattr(msg, "trace_id", 0),
+            getattr(msg, "parent_span", 0))
+        if not span.valid():
+            span = self.tracer.start_trace("osd_op")
         span.keyval("tid", msg.tid)
         span.keyval("pg", str(msg.pgid))
+        msg.trace = span   # receive-side annotation: the PG and the
+        #                    backends hang their spans off it
 
         replied = [False]
 
@@ -628,6 +669,9 @@ class OSDDaemon(Dispatcher):
                     else:
                         self._op_replies[dedup_key] = (result, data)
             self.perf.tinc("op_latency", op.duration)
+            self.perf.tinc("l_osd_op_trace_total", op.duration)
+            self.perf.hinc("l_osd_op_trace_us",
+                           max(0, int(op.duration * 1e6)))
             op.mark_commit_sent()
             self.public_msgr.send_message(
                 MOSDOpReply(tid=msg.tid, result=result, data=data,
@@ -641,8 +685,12 @@ class OSDDaemon(Dispatcher):
             reply(-11, None)
             return
         op.mark_event("queued_for_pg")
+        q0 = time.monotonic()
 
         def run(m, r):
+            t_run = time.monotonic()
+            self.perf.tinc("l_osd_op_trace_queue", t_run - q0)
+            span.child_interval("op_queue", q0, t_run)
             op.mark_event("reached_pg")
             op.mark_started()
             try:
@@ -654,6 +702,9 @@ class OSDDaemon(Dispatcher):
                 op.mark_event("exception")
                 reply(-5, None)
                 raise
+            finally:
+                self.perf.tinc("l_osd_op_trace_pg",
+                               time.monotonic() - t_run)
 
         self.op_wq.queue(pg.pgid, run, msg, reply,
                          klass="client",

@@ -726,7 +726,8 @@ class PG:
         if length == 0:
             reply_fn(0, b"")
             return
-        self._ec_read_with_retry(oid, off, length, reply_fn)
+        self._ec_read_with_retry(oid, off, length, reply_fn,
+                                 trace=getattr(msg, "trace", None))
 
     def _do_copy_get(self, oid, reply_fn, tries: int = 0) -> None:
         """CEPH_OSD_OP_COPY_GET (the promote/copy-from fetch,
@@ -785,7 +786,7 @@ class PG:
                 reply_fn(-2, None)
 
     def _ec_read_with_retry(self, oid, off, length, reply_fn,
-                            attempt: int = 0) -> None:
+                            attempt: int = 0, trace=None) -> None:
         """Reconstruction shortages are usually TRANSIENT (a shard
         mid-recovery is excluded from reads until its push commits):
         retry briefly before failing, like the reference holds ops on
@@ -796,10 +797,11 @@ class PG:
             elif attempt < 20:
                 self.daemon.timer.add_event_after(
                     0.5, self._ec_read_with_retry, oid, off, length,
-                    reply_fn, attempt + 1)
+                    reply_fn, attempt + 1, trace)
             else:
                 reply_fn(-5, None)
-        self.backend.objects_read(oid, off, length, on_data)
+        self.backend.objects_read(oid, off, length, on_data,
+                                  trace=trace)
 
     def _object_size(self, oid):
         if self.pool.is_erasure():
@@ -1108,7 +1110,9 @@ class PG:
             if size == 0:
                 on_data(b"")
             else:
-                self.backend.objects_read(roid, 0, size, on_data)
+                self.backend.objects_read(
+                    roid, 0, size, on_data,
+                    trace=getattr(msg, "trace", None))
 
         read_next(0)
 
@@ -1274,7 +1278,8 @@ class PG:
                 self._tier().dirty_at.setdefault(oid, _time.monotonic())
         self.backend.submit_transaction(
             t, version, lambda: reply_fn(0, version),
-            reqid=(getattr(msg, "session", ""), msg.tid))
+            reqid=(getattr(msg, "session", ""), msg.tid),
+            trace=getattr(msg, "trace", None))
 
     # -- peering: GetInfo / GetLog / GetMissing ------------------------
 

@@ -14,6 +14,7 @@ import threading
 
 from ..common.bounded import BoundedDict
 from ..common.lockdep import make_rlock
+from ..common.tracer import NULL_SPAN, trace_ctx
 from ..msg.message import MOSDRepOp, MOSDRepOpReply
 from ..store.object_store import Transaction
 
@@ -26,6 +27,7 @@ class _Inflight:
         self.on_commit = on_commit
         self.waiting_on = set(waiting_on)
         self.msg = None               # the MOSDRepOp, for retransmit
+        self.sub_spans: dict = {}     # osd -> per-peer rep-op span
 
 
 class ReplicatedBackend:
@@ -50,20 +52,28 @@ class ReplicatedBackend:
     # -- write ---------------------------------------------------------
 
     def submit_transaction(self, pg_txn, at_version: int,
-                           on_commit, reqid: tuple = ("", 0)) -> int:
+                           on_commit, reqid: tuple = ("", 0),
+                           trace=NULL_SPAN) -> int:
         tid = next(self._tids)
+        if trace is None:
+            trace = NULL_SPAN
         txn = self._physical_txn(pg_txn)
         peers = [o for o in self.pg.acting_osds() if o >= 0]
         log_entries = self.pg.mint_log_entries(pg_txn.op_map, at_version,
                                                reqid)
         op = _Inflight(tid, on_commit, peers)
+        t_id, p_id = trace_ctx(trace)
         op.msg = MOSDRepOp(pgid=self.pg.pgid, from_osd=self.pg.whoami,
                            tid=tid, at_version=at_version,
                            log_entries=log_entries, txn_ops=txn.ops,
                            map_epoch=self.pg.map_epoch(),
-                           instance=self.instance)
+                           instance=self.instance, trace_id=t_id,
+                           parent_span=p_id)
         with self.lock:
             self.inflight[tid] = op
+            for osd in peers:
+                span = trace.child("rep_op(osd=%d)" % osd)
+                op.sub_spans[osd] = span
         for osd in peers:
             if osd == self.pg.whoami:
                 self.handle_rep_op(op.msg, local=True)
@@ -91,6 +101,9 @@ class ReplicatedBackend:
             waiting = set(op.waiting_on)
             msg = op.msg
         if done is not None:
+            for span in done.sub_spans.values():
+                span.finish()
+            done.sub_spans = {}
             if done.on_commit:
                 done.on_commit()
             return
@@ -161,13 +174,21 @@ class ReplicatedBackend:
                 on_commit()
             return
 
+        # replica-side span, stitched from the envelope context
+        span = self.pg.daemon.tracer.continue_trace(
+            "rep_apply", getattr(msg, "trace_id", 0),
+            getattr(msg, "parent_span", 0))
+        span.keyval("tid", msg.tid)
+
         def commit_and_ack():
             with self.lock:
                 self._seen[key] = True
+            span.finish()
             on_commit()
 
         txn = Transaction()
         txn.ops = list(msg.txn_ops)
+        txn.trace = span             # store-level spans nest under it
         # log keys ride the same store transaction as the data
         self.pg.log_operation(msg.log_entries, msg.at_version, -1,
                               txn=txn)
@@ -180,17 +201,30 @@ class ReplicatedBackend:
             if op is None:
                 return
             op.waiting_on.discard(msg.from_osd)
+            span = op.sub_spans.pop(msg.from_osd, None)
             if op.waiting_on:
+                if span is not None:
+                    span.finish()
                 return
             self.inflight.pop(msg.tid, None)
+            leftovers = list(op.sub_spans.values())
+            op.sub_spans = {}
+        if span is not None:
+            span.finish()
+        for s in leftovers:
+            s.finish()
         if op.on_commit:
             op.on_commit()
 
     # -- read ----------------------------------------------------------
 
-    def objects_read(self, oid, off: int, length: int, on_done) -> None:
+    def objects_read(self, oid, off: int, length: int, on_done,
+                     trace=NULL_SPAN) -> None:
+        if trace is None:
+            trace = NULL_SPAN
         try:
-            data = self.pg.local_read_shard(-1, oid, off, length)
+            with trace.child("local_read"):
+                data = self.pg.local_read_shard(-1, oid, off, length)
         except (OSError, KeyError):
             on_done(None)
             return

@@ -25,17 +25,22 @@ import time
 
 import numpy as np
 
+from ..common.perf_counters import PerfCountersBuilder
+from ..common.tracer import NULL_SPAN, device_segments
+
 __all__ = ["TpuDispatcher"]
 
 
 class _Pending:
-    __slots__ = ("batch", "event", "out", "error")
+    __slots__ = ("batch", "event", "out", "error", "trace", "t_submit")
 
-    def __init__(self, batch):
+    def __init__(self, batch, trace=NULL_SPAN):
         self.batch = batch
         self.event = threading.Event()
         self.out = None
         self.error = None
+        self.trace = trace if trace is not None else NULL_SPAN
+        self.t_submit = time.monotonic()
 
 
 class TpuDispatcher:
@@ -43,15 +48,42 @@ class TpuDispatcher:
 
     Key = (codec identity, kind, per-stripe shape): ops whose batches
     stack along axis 0 into one well-formed [S_total, k, chunk] call.
+
+    Observability: with a tracer whose collection is enabled, each
+    submitter's span grows a queue-delay child plus a device span split
+    into h2d / compute / d2h segments (measured once per fused dispatch
+    and mirrored under every participating op — the ZTracer device-
+    attribution role), and the l_tpu_* PerfCounters aggregate the same
+    segments.  With tracing disabled the dispatch path is byte-for-byte
+    the old one: no extra device syncs, no span allocation.
     """
 
-    def __init__(self, max_batch: int = 8, max_delay: float = 0.002):
+    def __init__(self, max_batch: int = 8, max_delay: float = 0.002,
+                 tracer=None):
         self.max_batch = max_batch
         self.max_delay = max_delay
+        self.tracer = tracer
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
         self.queues: dict = {}     # key -> (fn, [_Pending])
         self.stats = {"ops": 0, "dispatches": 0, "coalesced": 0}
+        # l_tpu_* counters: device-segment attribution (exported via
+        # the daemon's PerfCountersCollection -> mgr -> prometheus)
+        self.perf = (PerfCountersBuilder("osd_tpu")
+                     .add_time_avg("l_tpu_h2d",
+                                   "host->device transfer time")
+                     .add_time_avg("l_tpu_compute",
+                                   "device compute (block_until_ready)")
+                     .add_time_avg("l_tpu_d2h",
+                                   "device->host transfer time")
+                     .add_time_avg("l_tpu_dispatch_queue",
+                                   "op wait in the coalescing queue")
+                     .add_u64_counter("l_tpu_ops", "codec ops submitted")
+                     .add_u64_counter("l_tpu_dispatches",
+                                      "device programs dispatched")
+                     .add_u64_counter("l_tpu_coalesced",
+                                      "ops that shared a dispatch")
+                     .create_perf_counters())
         self._stop = False
         self._thread = threading.Thread(
             target=self._run, name="tpu-dispatch", daemon=True)
@@ -85,14 +117,15 @@ class TpuDispatcher:
             pass
         return key
 
-    def encode(self, codec, batch: np.ndarray) -> np.ndarray:
+    def encode(self, codec, batch: np.ndarray,
+               trace=NULL_SPAN) -> np.ndarray:
         """codec.encode_batch(batch), coalesced across submitters."""
         key = (self._codec_key(codec), "enc", batch.shape[1:],
                str(batch.dtype))
-        return self._submit(key, codec.encode_batch, batch)
+        return self._submit(key, codec.encode_batch, batch, trace)
 
     def decode(self, codec, avail_rows: tuple,
-               chunks: np.ndarray) -> np.ndarray:
+               chunks: np.ndarray, trace=NULL_SPAN) -> np.ndarray:
         """codec.decode_batch for one erasure signature, coalesced with
         ops sharing the same signature (same decode matrix)."""
         avail_rows = tuple(avail_rows)
@@ -100,7 +133,7 @@ class TpuDispatcher:
                chunks.shape[1:], str(chunks.dtype))
         return self._submit(
             key, lambda stacked: codec.decode_batch(avail_rows, stacked),
-            chunks)
+            chunks, trace)
 
     def shutdown(self) -> None:
         with self.cv:
@@ -110,8 +143,8 @@ class TpuDispatcher:
 
     # -- internals -----------------------------------------------------
 
-    def _submit(self, key, fn, batch):
-        p = _Pending(np.asarray(batch))
+    def _submit(self, key, fn, batch, trace=NULL_SPAN):
+        p = _Pending(np.asarray(batch), trace)
         with self.cv:
             q = self.queues.get(key)
             if q is None:
@@ -156,6 +189,9 @@ class TpuDispatcher:
                     deadline = time.monotonic() + self.max_delay
                 self.cv.wait(self.max_delay)
 
+    def _instrumenting(self) -> bool:
+        return self.tracer is not None and self.tracer.enabled
+
     def _run(self):
         while True:
             group = self._take_group()
@@ -163,22 +199,60 @@ class TpuDispatcher:
                 return
             fn, pend = group
             self.stats["dispatches"] += 1
+            self.perf.inc("l_tpu_dispatches")
+            self.perf.inc("l_tpu_ops", len(pend))
             if len(pend) > 1:
                 self.stats["coalesced"] += len(pend)
+                self.perf.inc("l_tpu_coalesced", len(pend))
+            instrument = self._instrumenting()
+            t_start = time.monotonic()
             try:
+                stacked = pend[0].batch if len(pend) == 1 \
+                    else np.concatenate([p.batch for p in pend])
+                if instrument:
+                    # explicit h2d/compute/d2h segmentation (two extra
+                    # device syncs — the disabled path never pays them)
+                    out, seg = device_segments(fn, stacked)
+                else:
+                    out = np.asarray(fn(stacked))
+                    seg = None
                 if len(pend) == 1:
-                    out = np.asarray(fn(pend[0].batch))
                     pend[0].out = out
                 else:
-                    stacked = np.concatenate([p.batch for p in pend])
-                    out = np.asarray(fn(stacked))
                     off = 0
                     for p in pend:
                         s = p.batch.shape[0]
                         p.out = out[off:off + s]
                         off += s
+                if seg is not None:
+                    self._account(pend, seg, t_start)
             except BaseException as e:   # deliver, don't kill the loop
                 for p in pend:
                     p.error = e
             for p in pend:
                 p.event.set()
+
+    def _account(self, pend, seg, t_start: float) -> None:
+        """Fold one dispatch's measured segments into the l_tpu_*
+        counters and back-fill queue/device spans under every
+        participating op's trace (the segments are shared: a fused
+        dispatch ran once for all of them)."""
+        t_end = time.monotonic()
+        self.perf.tinc("l_tpu_h2d", seg["h2d"])
+        self.perf.tinc("l_tpu_compute", seg["compute"])
+        self.perf.tinc("l_tpu_d2h", seg["d2h"])
+        t1 = t_start + seg["h2d"]
+        t2 = t1 + seg["compute"]
+        for p in pend:
+            self.perf.tinc("l_tpu_dispatch_queue",
+                           max(0.0, t_start - p.t_submit))
+            if not p.trace.valid():
+                continue
+            p.trace.child_interval("tpu_queue", p.t_submit, t_start)
+            dev = p.trace.child_interval(
+                "tpu_device", t_start, t_end,
+                batch=int(sum(q.batch.shape[0] for q in pend)),
+                coalesced=len(pend))
+            dev.child_interval("h2d", t_start, t1)
+            dev.child_interval("compute", t1, t2)
+            dev.child_interval("d2h", t2, t2 + seg["d2h"])

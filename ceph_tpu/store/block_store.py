@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time as _time
 import zlib
 
 from .. import encoding
@@ -452,6 +453,12 @@ class BlockStore(ObjectStore):
     def queue_transaction(self, txn: Transaction) -> None:
         if not self.mounted:
             raise RuntimeError("BlockStore not mounted")
+        # tracing: a txn carrying a span (set by the PG backends) gets
+        # store-phase children — device flush (the BlueFS-managed
+        # fsync), the WAL/KV commit, and the deferred byte apply — the
+        # reference's bluestore tracepoints role
+        trace = getattr(txn, "trace", None)
+        traced = trace is not None and trace.valid()
         with self._lock:
             batch = self.db.get_transaction()
             deferred: list[list] = []     # [poff, data] pending
@@ -476,12 +483,23 @@ class BlockStore(ObjectStore):
             self._pending_deferred = None
             # big-write bytes must be on disk before the kv commit that
             # references them survives a crash
+            t0 = _time.monotonic() if traced else 0.0
             if flush_before_commit and self.block_sync:
                 self._device_sync()
+            t1 = _time.monotonic() if traced else 0.0
             self.db.submit_transaction(batch)
+            t2 = _time.monotonic() if traced else 0.0
             # deferred bytes apply AFTER their kv record is durable
             for poff, data in deferred:
                 os.pwrite(self._fd, data, poff)
+            if traced:
+                t3 = _time.monotonic()
+                if flush_before_commit and self.block_sync:
+                    trace.child_interval("bluefs_fsync", t0, t1)
+                trace.child_interval("wal_append", t1, t2)
+                if deferred:
+                    trace.child_interval("deferred_apply", t2, t3,
+                                         records=len(deferred))
         for cb in txn.on_commit:
             self._complete(cb)
         for cb in txn.on_applied:

@@ -15,6 +15,7 @@ historical EIO-mark spelling)."""
 from __future__ import annotations
 
 import threading
+import time
 
 from .faults import FaultSet
 from .object_store import Collection, ObjectStore, Transaction
@@ -71,9 +72,18 @@ class MemStore(ObjectStore):
     # -- mutation ------------------------------------------------------
 
     def queue_transaction(self, txn: Transaction) -> None:
+        # tracing: a txn carrying a span (set by the PG backends) gets
+        # a store_apply child — the in-memory analog of BlockStore's
+        # wal_append/bluefs_fsync/deferred_apply phase spans
+        trace = getattr(txn, "trace", None)
+        t0 = time.monotonic() if trace is not None \
+            and trace.valid() else None
         with self._lock:
             for op in txn.ops:
                 self._apply(op)
+        if t0 is not None:
+            trace.child_interval("store_apply", t0, time.monotonic(),
+                                 ops=len(txn.ops))
         for cb in txn.on_applied:
             self._complete(cb)
         for cb in txn.on_commit:

@@ -73,6 +73,39 @@ def osd_tree(m) -> str:
     return "\n".join(lines)
 
 
+def trace_tree_command(words: list[str], asoks: list[str]) -> int:
+    """`ceph trace tree <trace_id> --asok A [--asok B ...]`: gather
+    `dump_tracing` spans from each named daemon admin socket, stitch
+    them by trace id, and render the cross-daemon span tree with
+    self-times (the ZTracer-analog operator view)."""
+    from ..common.admin_socket import AdminSocketClient
+    from ..common.tracer import render_tree
+    if not words:
+        sys.stderr.write("ceph: trace tree needs a trace id\n")
+        return 1
+    try:
+        trace_id = int(words[0], 0)
+    except ValueError:
+        sys.stderr.write("ceph: invalid trace id %r\n" % words[0])
+        return 1
+    if not asoks:
+        sys.stderr.write("ceph: trace tree needs at least one "
+                         "--asok <path>\n")
+        return 1
+    spans: list = []
+    for path in asoks:
+        try:
+            reply = AdminSocketClient(path).do_request(
+                "dump_tracing", trace_id=trace_id)
+        except (OSError, ValueError) as e:
+            sys.stderr.write("ceph: %s: %s\n" % (path, e))
+            return 1
+        if isinstance(reply, dict):
+            spans.extend(reply.get("spans") or [])
+    sys.stdout.write(render_tree(spans, trace_id=trace_id) + "\n")
+    return 0
+
+
 def daemon_command(words: list[str]) -> int:
     """`ceph daemon <asok-path> <command...>`: talk straight to one
     daemon's unix admin socket (perf dump, dump_ops_in_flight,
@@ -115,12 +148,15 @@ def main(argv=None) -> int:
                                 description="cluster admin utility")
     p.add_argument("--monmap")
     p.add_argument("--mon", action="append")
+    p.add_argument("--asok", action="append",
+                   help="daemon admin socket(s) for trace tree")
     p.add_argument("words", nargs="+",
                    help="command, e.g.: status | health [detail] | "
                         "log last [N] | osd tree | "
                         "osd pool ls | osd pool create NAME | "
                         "osd out/in/down ID | osd dump | "
-                        "daemon ASOK CMD...")
+                        "daemon ASOK CMD... | "
+                        "trace tree TRACE_ID --asok PATH...")
     p.add_argument("-s", "--size", type=int, default=None)
     p.add_argument("--pg-num", type=int, default=8)
     p.add_argument("--erasure", action="store_true")
@@ -129,6 +165,8 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if args.words and args.words[0] == "daemon":
         return daemon_command(args.words[1:])   # no mon connection
+    if args.words[:2] == ["trace", "tree"]:
+        return trace_tree_command(args.words[2:], args.asok or [])
     client = connect(args)
     try:
         w = args.words

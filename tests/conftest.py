@@ -31,6 +31,14 @@ from ceph_tpu.common import lockdep  # noqa: E402
 lockdep.enable()
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`: register the marker so stress-scale
+    # tests (span-volume) are excluded there without unknown-mark noise
+    config.addinivalue_line(
+        "markers",
+        "slow: stress-scale tests excluded from the tier-1 run")
+
+
 def pytest_sessionfinish(session, exitstatus):
     if lockdep.violations:
         print("\nLOCKDEP: %d lock-order violation(s) detected:"

@@ -54,7 +54,7 @@ class TestOpTracker:
         a = t.create_request("fast")
         a.mark_done()
         b = t.create_request("slow")
-        b.initiated_at -= 3.0   # pretend it took 3s
+        b.initiated_mono -= 3.0   # pretend it took 3s (monotonic anchor)
         b.mark_done()
         ops = t.dump_historic_ops_by_duration()["ops"]
         assert ops[0]["description"] == "slow"
