@@ -352,11 +352,14 @@ def _bench_cluster() -> dict:
         os.path.abspath(__file__)), "tests"))
     from cluster_util import MiniCluster
     out: dict = {}
-    # tracing off for this row: it prices the PIPELINE and must stay
-    # methodology-constant with earlier rounds (the --trace breakdown
-    # row measures the instrumented path separately)
+    # tracing AND telemetry reporting off for this row: it prices the
+    # PIPELINE and must stay methodology-constant with earlier rounds
+    # (the --trace breakdown row measures the instrumented path
+    # separately; mgr_stats_period=0 pins the MMgrReport stream off
+    # the same way osd_tracing=False pins the span path)
     c = MiniCluster(num_mons=1, num_osds=4,
-                    conf_overrides={"osd_tracing": False})
+                    conf_overrides={"osd_tracing": False,
+                                    "mgr_stats_period": 0.0})
     c.start()
     try:
         client = c.client()
@@ -412,15 +415,19 @@ def _bench_cluster() -> dict:
         out["cluster_ec_read_MBps"] = round(
             n_objs * obj_bytes / t_read / 1e6, 1)
         ops = disp = 0
-        for osd in c.osds.values():
+        telemetry = {}
+        for osd_id, osd in sorted(c.osds.items()):
             d = getattr(osd, "tpu_dispatcher", None)
             if d is not None:
                 ops += d.stats["ops"]
                 disp += d.stats["dispatches"]
+                telemetry["osd.%d" % osd_id] = d.telemetry()
         if ops:
             out["cluster_dispatch_ops"] = ops
             out["cluster_dispatches"] = disp
             out["cluster_coalesce_ratio"] = round(disp / ops, 3)
+        if telemetry:
+            out["cluster_device_telemetry"] = telemetry
     finally:
         c.stop()
     return out
@@ -470,6 +477,39 @@ def _trace_breakdown(codec, data_host) -> dict:
                 for k, v in seg.items()}
     finally:
         disp.shutdown()
+
+
+def perf_snapshot(codecs: dict | None = None,
+                  extra: dict | None = None) -> dict:
+    """Per-round perf-counter + device-telemetry snapshot embedded in
+    the BENCH (and, via __graft_entry__, MULTICHIP) artifacts so a
+    codec-level swing like the historical r4->r5 SHEC/Cauchy one is
+    attributable POST HOC (ROADMAP #2 leftover): device identity and
+    count, software versions, and per-codec decode-table cache hit
+    rates — a cold table cache means that round paid matrix inversions
+    and fresh XLA compiles a warm round didn't, which is exactly the
+    state the old artifacts never recorded.  Deliberately d2h-free:
+    safe to take before the sealed sections."""
+    import jax
+    snap: dict = {
+        "unix_time": round(time.time(), 1),
+        "platform": jax.devices()[0].platform,
+        "device_count": len(jax.devices()),
+        "devices": [str(d) for d in jax.devices()][:8],
+        "jax_version": jax.__version__,
+        "numpy_version": np.__version__,
+    }
+    for name, codec in (codecs or {}).items():
+        stats_fn = getattr(codec, "table_cache_stats", None)
+        if stats_fn is None:
+            continue
+        try:
+            snap.setdefault("table_cache", {})[name] = stats_fn()
+        except Exception:
+            pass
+    if extra:
+        snap.update(extra)
+    return snap
 
 
 #: v5e-1 HBM bandwidth ceiling with margin: no single-chip number can
@@ -1065,6 +1105,15 @@ def run_bench() -> None:
     except Exception:
         pass  # native lib not built on this host: report null
 
+    # per-round attribution snapshot (ROADMAP #2): taken AFTER every
+    # timed section so the table-cache numbers reflect what this
+    # round's decodes actually hit
+    snapshot = perf_snapshot(
+        codecs={"rs_k8_m3_jax": tpu},
+        extra={"row_window_seconds":
+               {name: [round(t, 6) for t in ts]
+                for name, ts in win.items()}})
+
     doc = {
         "metric": "ec_encode_decode_MBps_rs_k8_m3_w8",
         "value": round(value, 1),
@@ -1087,6 +1136,7 @@ def run_bench() -> None:
         "batch": BATCH,
         "object_size": OBJ_SIZE,
         "device": jax.devices()[0].platform,
+        "perf_snapshot": snapshot,
     }
     # end-to-end cluster pipeline row (rados-bench role) — runs last,
     # host/transport-bound by design

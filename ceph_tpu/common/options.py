@@ -122,6 +122,22 @@ def _declare_defaults():
       "trace 1 in N root ops (hot-path sampling knob; 1 = every op)")
     o("osd_tracing_max_spans", int, 8192, LEVEL_ADVANCED,
       "per-daemon bounded span ring capacity (oldest spans drop)")
+    # mgr telemetry (the MMgrReport stream + the mgr-side aggregation)
+    o("mgr_stats_period", float, 0.5, LEVEL_BASIC,
+      "seconds between a daemon's MMgrReport perf/telemetry reports "
+      "to the mgr (options.cc mgr_stats_period, scaled for in-process "
+      "clusters); 0 disables reporting entirely — the bench cluster "
+      "row pins this like osd_tracing=False for methodology constancy")
+    o("mgr_stats_stale_after", float, 10.0, LEVEL_ADVANCED,
+      "seconds without a report before a daemon's series age out of "
+      "the mgr's aggregation and the prometheus exposition "
+      "(DaemonStateIndex staleness window)")
+    o("mgr_metrics_history", int, 128, LEVEL_ADVANCED,
+      "timestamped perf snapshots the MetricsAggregator retains per "
+      "daemon (the rate/percentile derivation ring)")
+    o("mgr_metrics_window", float, 5.0, LEVEL_ADVANCED,
+      "default lookback window (seconds) for derived rates — "
+      "`ceph iostat`, per-daemon op rates, device MB/s gauges")
     # mon
     o("mon_osd_down_out_interval", float, 2.0, LEVEL_ADVANCED,
       "seconds after down before an osd is marked out")

@@ -85,6 +85,17 @@ class MDSDaemon(Dispatcher):
         self.journal: Journaler | None = None
         self._next_ino = 0
         self._replies: BoundedDict = BoundedDict()   # (session,tid)
+        # mgr telemetry: l_mds_* counters + the MMgrReport stream
+        from ..common.perf_counters import PerfCountersBuilder
+        self.perf = (PerfCountersBuilder("mds")
+                     .add_u64_counter("request",
+                                      "client metadata requests")
+                     .add_time_avg("request_latency",
+                                   "client request handling time")
+                     .create_perf_counters())
+        self.ctx.perf.add(self.perf)
+        self.mgr_addr = None
+        self._last_mgr_report = 0.0
         self._running = False
         self._beacon_token = None
 
@@ -115,11 +126,37 @@ class MDSDaemon(Dispatcher):
             MMDSBeacon(name=self.name, addr=self.msgr.my_addr,
                        state=self.state),
             self.monmap[min(self.monmap)])
+        try:
+            # telemetry is best-effort: it must never kill the beacon
+            # chain (the mon fails an MDS that stops beaconing)
+            self._mgr_report()
+        except Exception:
+            pass
         t = threading.Timer(
             self.ctx.conf.get_val("mds_beacon_interval"), self._beacon)
         t.daemon = True
         t.start()
         self._beacon_token = t
+
+    def _mgr_report(self) -> None:
+        """MDS leg of the cluster telemetry stream, rate-limited to
+        the mgr_stats_period cadence (0 = off)."""
+        if self.mgr_addr is None:
+            return
+        import time as _time
+        period = self.ctx.conf.get_val("mgr_stats_period")
+        now = _time.monotonic()
+        if period <= 0 or now - self._last_mgr_report < period:
+            return
+        self._last_mgr_report = now
+        from ..msg.message import MMgrReport
+        self.msgr.send_message(
+            MMgrReport(daemon_name="mds.%s" % self.name,
+                       daemon_type="mds",
+                       perf=self.ctx.perf.perf_dump(),
+                       metadata={"state": self.state},
+                       perf_schema=self.ctx.perf.perf_schema()),
+            self.mgr_addr)
 
     def _on_mdsmap(self, mdsmap: dict) -> None:
         active = mdsmap.get("active")
@@ -212,6 +249,9 @@ class MDSDaemon(Dispatcher):
         with self.lock:
             cached = self._replies.get(key) if msg.session else None
             if cached is None:
+                self.perf.inc("request")
+                import time as _time
+                t0 = _time.monotonic()
                 try:
                     result, data = self._handle(msg.op, msg.args)
                 except OSError as e:
@@ -221,6 +261,8 @@ class MDSDaemon(Dispatcher):
                     logging.getLogger("ceph_tpu.mds").exception(
                         "mds op %s failed", msg.op)
                     result, data = -errno.EIO, None
+                self.perf.tinc("request_latency",
+                               _time.monotonic() - t0)
                 cached = MClientReply(tid=msg.tid, result=result,
                                       data=data, session=msg.session)
                 if msg.session:

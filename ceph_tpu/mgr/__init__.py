@@ -11,6 +11,7 @@ are `prometheus` (text exposition format), `status`, and `balancer`
 """
 
 from .daemon_state import DaemonStateIndex  # noqa: F401
+from .metrics import MetricsAggregator  # noqa: F401
 from .mgr_daemon import MgrDaemon  # noqa: F401
 from .mgr_module import MgrModule  # noqa: F401
 from .modules import (BalancerModule, PrometheusModule,  # noqa: F401

@@ -1,0 +1,389 @@
+"""Cluster telemetry aggregation: the mgr's time-series store.
+
+Role of the reference's DaemonPerfCounters + MgrStatMonitor
+(/root/reference/src/mgr/DaemonState.h, src/mon/MgrStatMonitor.cc):
+every daemon streams timestamped perf-counter snapshots via MMgrReport;
+this module keeps a bounded ring of them per daemon and DERIVES the
+numbers operators actually read — rates (counter deltas / Δt),
+time-averaged latencies (Δsum / Δcount), percentiles from histogram
+bucket fills — plus the cluster accounting surfaces built on top:
+`ceph df` (per-pool stored/raw-used against store capacity, EC k+m/k
+overhead included), `ceph osd perf` (per-OSD commit/apply latency
+analogs from the trace time-avgs), and the `ceph iostat` rolling view
+(cluster read/write ops/s and MB/s).
+
+A counter alone can't tell a gauge from a monotonic counter or name
+its histogram's bucket edges, so reports carry the sender's perf
+SCHEMA alongside the dump; percentile interpolation uses the sender's
+bounds, falling back to the power-of-two defaults every PerfCounters
+histogram uses today.
+
+Staleness: a daemon that stops reporting ages out of every derived
+view after `stale_after` — rates, df, iostat and the prometheus
+exposition all read through `fresh_daemons`, so a dead OSD's last
+values are never exported forever.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from ..common.perf_counters import _HIST_BUCKETS
+
+__all__ = ["MetricsAggregator"]
+
+
+class _Series:
+    __slots__ = ("snaps", "status", "pg_stats", "schema", "last_ts",
+                 "daemon_type")
+
+    def __init__(self, history: int):
+        self.snaps: deque = deque(maxlen=history)   # (ts, perf dict)
+        self.status: dict = {}
+        self.pg_stats: dict = {}       # str(pgid) -> stats row
+        self.schema: dict = {}         # group -> {counter: {type,...}}
+        self.last_ts = 0.0
+        self.daemon_type = ""
+
+
+def _counter_value(val):
+    """The monotonic scalar a rate derives from: plain numbers pass
+    through; avg/time dicts contribute their sum."""
+    if isinstance(val, dict):
+        return val.get("sum", 0)
+    return val
+
+
+class MetricsAggregator:
+    def __init__(self, history: int = 128, stale_after: float = 10.0,
+                 window: float = 5.0):
+        self.history = history
+        self.stale_after = stale_after
+        self.window = window
+        self._lock = threading.Lock()
+        self._series: dict[str, _Series] = {}
+        # free-form value series (balancer sweep timings, ...): the
+        # measured-feedback store ROADMAP #4 closes its loop through
+        self._values: dict[str, deque] = {}
+
+    # -- ingest --------------------------------------------------------
+
+    def record(self, daemon: str, perf: dict, status: dict | None = None,
+               pg_stats: dict | None = None, schema: dict | None = None,
+               daemon_type: str = "", now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            s = self._series.get(daemon)
+            if s is None:
+                s = self._series[daemon] = _Series(self.history)
+            s.snaps.append((now, perf))
+            if status is not None:
+                s.status = dict(status)
+            if pg_stats is not None:
+                s.pg_stats = dict(pg_stats)
+            if schema:
+                s.schema = dict(schema)
+            if daemon_type:
+                s.daemon_type = daemon_type
+            s.last_ts = now
+
+    def record_value(self, key: str, value: float,
+                     now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            dq = self._values.get(key)
+            if dq is None:
+                dq = self._values[key] = deque(maxlen=self.history)
+            dq.append((now, float(value)))
+
+    def values(self, key: str) -> list[float]:
+        with self._lock:
+            return [v for _, v in self._values.get(key, ())]
+
+    def value_keys(self) -> list[str]:
+        with self._lock:
+            return sorted(self._values)
+
+    def remove(self, daemon: str) -> None:
+        with self._lock:
+            self._series.pop(daemon, None)
+
+    def prune(self, now: float | None = None) -> list[str]:
+        """Drop series whose daemon stopped reporting long ago (10x the
+        staleness window — stale daemons are merely hidden, pruned ones
+        are forgotten).  Returns what was dropped."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            dead = [n for n, s in self._series.items()
+                    if now - s.last_ts > 10 * self.stale_after]
+            for n in dead:
+                del self._series[n]
+        return dead
+
+    # -- introspection -------------------------------------------------
+
+    def daemons(self, include_stale: bool = False,
+                now: float | None = None) -> list[str]:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return sorted(
+                n for n, s in self._series.items()
+                if include_stale or now - s.last_ts <= self.stale_after)
+
+    fresh_daemons = daemons
+
+    def latest(self, daemon: str) -> dict:
+        with self._lock:
+            s = self._series.get(daemon)
+            return dict(s.snaps[-1][1]) if s and s.snaps else {}
+
+    def status(self, daemon: str) -> dict:
+        with self._lock:
+            s = self._series.get(daemon)
+            return dict(s.status) if s else {}
+
+    def schema(self, daemon: str) -> dict:
+        with self._lock:
+            s = self._series.get(daemon)
+            return dict(s.schema) if s else {}
+
+    def _window_pair(self, daemon: str, window: float | None,
+                     now: float | None):
+        """(oldest-in-window, newest) snapshots, or None when fewer
+        than two samples land inside the window."""
+        window = self.window if window is None else window
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            s = self._series.get(daemon)
+            if s is None or len(s.snaps) < 2:
+                return None
+            if now - s.last_ts > self.stale_after:
+                return None            # dead daemons derive nothing
+            snaps = [sn for sn in s.snaps if now - sn[0] <= window]
+        if len(snaps) < 2:
+            return None
+        return snaps[0], snaps[-1]
+
+    @staticmethod
+    def _lookup(perf: dict, group: str, counter: str):
+        return perf.get(group, {}).get(counter)
+
+    # -- derivations ---------------------------------------------------
+
+    def rate(self, daemon: str, group: str, counter: str,
+             window: float | None = None,
+             now: float | None = None) -> float:
+        """Counter delta / Δt over the lookback window (ops/s,
+        bytes/s).  0.0 when the daemon is stale, unknown, or the
+        window holds fewer than two snapshots."""
+        pair = self._window_pair(daemon, window, now)
+        if pair is None:
+            return 0.0
+        (t0, p0), (t1, p1) = pair
+        if t1 <= t0:
+            return 0.0
+        v0 = _counter_value(self._lookup(p0, group, counter))
+        v1 = _counter_value(self._lookup(p1, group, counter))
+        if v0 is None or v1 is None:
+            return 0.0
+        return max(0.0, (v1 - v0) / (t1 - t0))
+
+    def time_avg(self, daemon: str, group: str, counter: str,
+                 window: float | None = None,
+                 now: float | None = None) -> float:
+        """Windowed average of a time_avg/u64_avg counter:
+        Δsum / Δcount over the lookback — the RECENT latency, not the
+        since-boot average a raw dump gives.  Falls back to the
+        lifetime average when the window shows no new samples."""
+        pair = self._window_pair(daemon, window, now)
+        if pair is None:
+            val = self._lookup(self.latest(daemon), group, counter)
+            if isinstance(val, dict) and val.get("avgcount"):
+                return val["sum"] / val["avgcount"]
+            return 0.0
+        (_, p0), (_, p1) = pair
+        v0 = self._lookup(p0, group, counter)
+        v1 = self._lookup(p1, group, counter)
+        if not isinstance(v0, dict) or not isinstance(v1, dict):
+            return 0.0
+        dc = v1.get("avgcount", 0) - v0.get("avgcount", 0)
+        if dc <= 0:
+            if v1.get("avgcount"):
+                return v1["sum"] / v1["avgcount"]
+            return 0.0
+        return (v1.get("sum", 0.0) - v0.get("sum", 0.0)) / dc
+
+    def _bucket_bounds(self, daemon: str, group: str,
+                       counter: str) -> list:
+        sch = self.schema(daemon).get(group, {}).get(counter, {})
+        return list(sch.get("buckets") or _HIST_BUCKETS)
+
+    def percentiles(self, daemon: str, group: str, counter: str,
+                    qs=(0.5, 0.95, 0.99), window: float | None = None,
+                    now: float | None = None) -> dict:
+        """{q: value} interpolated from histogram bucket fills.  With a
+        window, the fills are the DELTA between the window's endpoints
+        (recent distribution); otherwise the latest cumulative fills.
+
+        Bucket i covers (bound[i-1], bound[i]] (bucket 0 starts at 0);
+        the overflow bucket reports its lower bound.  Within a bucket
+        the mass is assumed uniform, so q lands at
+        lo + (hi - lo) * (rank - cum_below) / bucket_count."""
+        pair = self._window_pair(daemon, window, now) \
+            if window is not None else None
+        if pair is not None:
+            (_, p0), (_, p1) = pair
+            h0 = self._lookup(p0, group, counter) or {}
+            h1 = self._lookup(p1, group, counter) or {}
+            b0 = h0.get("buckets") or []
+            b1 = h1.get("buckets") or []
+            if len(b0) == len(b1):
+                buckets = [a - b for a, b in zip(b1, b0)]
+            else:
+                buckets = list(b1)
+        else:
+            h1 = self._lookup(self.latest(daemon), group, counter) or {}
+            buckets = list(h1.get("buckets") or [])
+        total = sum(buckets)
+        if total <= 0:
+            return {q: 0.0 for q in qs}
+        bounds = self._bucket_bounds(daemon, group, counter)
+        out = {}
+        for q in qs:
+            rank = q * total
+            cum = 0.0
+            val = float(bounds[-1])
+            for i, n in enumerate(buckets):
+                if n <= 0:
+                    continue
+                if cum + n >= rank:
+                    if i >= len(bounds):        # overflow bucket
+                        val = float(bounds[-1])
+                    else:
+                        lo = 0.0 if i == 0 else float(bounds[i - 1])
+                        hi = float(bounds[i])
+                        val = lo + (hi - lo) * max(0.0, rank - cum) / n
+                    break
+                cum += n
+            out[q] = val
+        return out
+
+    def cluster_rate(self, group: str, counter: str,
+                     window: float | None = None,
+                     now: float | None = None) -> float:
+        now = time.monotonic() if now is None else now
+        return sum(self.rate(d, group, counter, window, now)
+                   for d in self.daemons(now=now))
+
+    # -- operator surfaces ---------------------------------------------
+
+    def iostat(self, window: float | None = None,
+               now: float | None = None) -> dict:
+        """Cluster IO rates over the lookback (the `ceph iostat` row):
+        read/write ops/s and MB/s summed over every fresh OSD."""
+        now = time.monotonic() if now is None else now
+        rd_ops = self.cluster_rate("osd", "op_r", window, now)
+        wr_ops = self.cluster_rate("osd", "op_w", window, now)
+        rd_b = self.cluster_rate("osd", "op_out_bytes", window, now)
+        wr_b = self.cluster_rate("osd", "op_in_bytes", window, now)
+        return {"read_op_per_sec": round(rd_ops, 2),
+                "write_op_per_sec": round(wr_ops, 2),
+                "read_MBps": round(rd_b / 1e6, 3),
+                "write_MBps": round(wr_b / 1e6, 3)}
+
+    def osd_perf(self, window: float | None = None,
+                 now: float | None = None) -> dict:
+        """Per-OSD latency table (the `ceph osd perf` surface):
+        commit latency from the end-to-end client-op time-avg, apply
+        latency from the PG-execution time-avg — both derived from
+        the tracing spine's always-on counters, in milliseconds."""
+        now = time.monotonic() if now is None else now
+        out = {}
+        for d in self.daemons(now=now):
+            if not d.startswith("osd."):
+                continue
+            commit = self.time_avg(d, "osd", "l_osd_op_trace_total",
+                                   window, now)
+            apply_ = self.time_avg(d, "osd", "l_osd_op_trace_pg",
+                                   window, now)
+            out[d] = {"commit_latency_ms": round(commit * 1e3, 3),
+                      "apply_latency_ms": round(apply_ * 1e3, 3)}
+        return out
+
+    def df(self, osdmap, now: float | None = None) -> dict:
+        """`ceph df`: per-pool objects / stored / raw-used against the
+        cluster's store capacity.  Pool rows fold the primary-PG stats
+        rows the OSDs ship in their reports (newest report wins per
+        PG); `stored` is the logical byte count (EC primary-shard
+        footprint x k), `raw_used` the on-device total including
+        replication (x size) or EC overhead (x (k+m)/k)."""
+        now = time.monotonic() if now is None else now
+        # newest row per PG across reporters (a PG whose primary moved
+        # may be reported by two OSDs; trust the later report)
+        rows: dict[str, tuple] = {}
+        with self._lock:
+            for s in self._series.values():
+                if now - s.last_ts > self.stale_after:
+                    continue
+                for pg, row in s.pg_stats.items():
+                    prev = rows.get(pg)
+                    if prev is None or s.last_ts > prev[0]:
+                        rows[pg] = (s.last_ts, row)
+        pools: dict = {}
+        for pg, (_, row) in rows.items():
+            pool_id = row.get("pool")
+            p = pools.setdefault(pool_id, {
+                "objects": 0, "stored": 0, "raw_used": 0,
+                "pgs": 0, "name": str(pool_id)})
+            p["pgs"] += 1
+            p["objects"] += row.get("objects", 0)
+            shard_bytes = row.get("bytes", 0)
+            k = m = size = None
+            if osdmap is not None:
+                pool = osdmap.pools.get(pool_id)
+                if pool is not None:
+                    p["name"] = pool.name
+                    size = pool.size
+                    if pool.is_erasure():
+                        prof = osdmap.ec_profiles.get(
+                            pool.erasure_code_profile, {})
+                        try:
+                            k = int(prof.get("k", 0)) or None
+                            m = int(prof.get("m", 0))
+                        except (TypeError, ValueError):
+                            k = m = None
+            if k:
+                # EC: the primary shard stores ~1/k of the logical
+                # bytes; every one of the k+m shards is the same size
+                p["stored"] += shard_bytes * k
+                p["raw_used"] += shard_bytes * (k + (m or 0))
+            else:
+                p["stored"] += shard_bytes
+                p["raw_used"] += shard_bytes * (size or 1)
+        total = used = 0
+        for d in self.daemons(now=now):
+            st = self.status(d).get("statfs") or {}
+            total += st.get("total", 0)
+            used += st.get("used", 0)
+        for p in pools.values():
+            p["percent_used"] = round(p["raw_used"] / total, 9) \
+                if total else 0.0
+        return {"pools": pools,
+                "total_bytes": total, "used_bytes": used,
+                "avail_bytes": max(0, total - used)}
+
+    # -- bulk dump (the mgr's `counter dump` asok payload) -------------
+
+    def counter_dump(self, now: float | None = None) -> dict:
+        now = time.monotonic() if now is None else now
+        out = {}
+        for d in self.daemons(now=now):
+            out[d] = {"perf": self.latest(d),
+                      "status": self.status(d)}
+        return out
+
+    def counter_schema(self, now: float | None = None) -> dict:
+        now = time.monotonic() if now is None else now
+        return {d: self.schema(d) for d in self.daemons(now=now)}

@@ -23,16 +23,30 @@ __all__ = ["MgrDaemon"]
 class MgrDaemon(Dispatcher):
     def __init__(self, monmap: dict, ctx: Context | None = None):
         self.ctx = ctx or Context(name="mgr")
-        self.msgr = create_messenger(("mgr", 0), conf=self.ctx.conf)
+        conf = self.ctx.conf
+        self.name = self.ctx.name if "." in self.ctx.name else "mgr.0"
+        self.msgr = create_messenger(("mgr", 0), conf=conf)
         self.monmap = dict(monmap)
         self.mon_client: MonClient | None = None
         from .daemon_state import DaemonStateIndex
-        self.daemon_state = DaemonStateIndex()
+        from .metrics import MetricsAggregator
+        stale = conf.get_val("mgr_stats_stale_after")
+        self.daemon_state = DaemonStateIndex(stale_after=stale)
+        # the telemetry store: bounded per-daemon snapshot rings the
+        # rate/percentile/df derivations read (mgr/metrics.py)
+        self.metrics = MetricsAggregator(
+            history=conf.get_val("mgr_metrics_history"),
+            stale_after=stale,
+            window=conf.get_val("mgr_metrics_window"))
         self.modules: dict[str, object] = {}
         self.health: dict[str, dict] = {}     # module -> checks
         self._lock = threading.Lock()
         self.osdmap = None
         self._running = False
+        from ..common.workqueue import SafeTimer
+        self.timer = SafeTimer("mgr-timer")
+        if self.ctx.admin_socket is not None:
+            self.register_admin_commands(self.ctx.admin_socket)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -43,10 +57,13 @@ class MgrDaemon(Dispatcher):
         self.mon_client = MonClient(self.monmap, self.msgr, "mgr")
         self.mon_client.map_callbacks.append(self._on_osdmap)
         self.mon_client.sub_want()
+        self.timer.init()
         self._running = True
+        self._self_report_tick()
 
     def shutdown(self) -> None:
         self._running = False
+        self.timer.shutdown()
         for mod in self.modules.values():
             try:
                 mod.shutdown()
@@ -54,6 +71,52 @@ class MgrDaemon(Dispatcher):
                 pass
         self.msgr.shutdown()
         self.ctx.shutdown()
+
+    def _self_report_tick(self) -> None:
+        """The mgr reports on ITSELF through the same pipeline every
+        other daemon uses (no loopback message needed), and prunes
+        long-dead series while it's at it."""
+        if not self._running:
+            return
+        period = self.ctx.conf.get_val("mgr_stats_period")
+        try:
+            if period > 0:
+                self.daemon_state.report(self.name,
+                                         self.ctx.perf.perf_dump(),
+                                         {"addr": str(self.addr)})
+                self.metrics.record(self.name,
+                                    self.ctx.perf.perf_dump(),
+                                    schema=self.ctx.perf.perf_schema(),
+                                    daemon_type="mgr")
+            self.metrics.prune()
+        finally:
+            self.timer.add_event_after(max(period, 0.5),
+                                       self._self_report_tick)
+
+    # -- admin socket (counter dump / df / osd perf / iostat) ----------
+
+    def register_admin_commands(self, asok) -> None:
+        """The operator surface `tools/ceph_cli.py` drives: aggregated
+        cluster counters and the df/perf/iostat views."""
+        asok.register(
+            "counter dump",
+            lambda args: self.metrics.counter_dump(),
+            "latest perf snapshot + telemetry status per fresh daemon")
+        asok.register(
+            "counter schema",
+            lambda args: self.metrics.counter_schema(),
+            "per-daemon counter kinds + histogram bucket bounds")
+        asok.register("df", lambda args: self.metrics.df(self.osdmap),
+                      "per-pool stored/raw-used vs store capacity")
+        asok.register("osd perf",
+                      lambda args: self.metrics.osd_perf(),
+                      "per-osd commit/apply latency (ms)")
+        asok.register(
+            "iostat",
+            lambda args: self.metrics.iostat(
+                window=float(args["window"])
+                if args.get("window") else None),
+            "cluster read/write ops/s and MB/s over the window")
 
     @property
     def addr(self):
@@ -101,6 +164,10 @@ class MgrDaemon(Dispatcher):
             return self.daemon_state.names(include_stale=False)
         if data_name == "perf_counters":
             return self.daemon_state.all_perf()
+        if data_name == "metrics":
+            return self.metrics
+        if data_name == "df":
+            return self.metrics.df(self.osdmap)
         if data_name == "health":
             with self._lock:
                 merged: dict = {}
@@ -126,6 +193,14 @@ class MgrDaemon(Dispatcher):
         if msg.get_type() == "MMgrReport":
             self.daemon_state.report(msg.daemon_name, msg.perf,
                                      msg.metadata)
+            # the telemetry store keeps the timestamped history the
+            # derived rates/percentiles and df accounting read
+            self.metrics.record(
+                msg.daemon_name, msg.perf,
+                status=getattr(msg, "status", None) or None,
+                pg_stats=getattr(msg, "pg_stats", None),
+                schema=getattr(msg, "perf_schema", None) or None,
+                daemon_type=getattr(msg, "daemon_type", ""))
             self._notify_all("perf_schema", msg.daemon_name)
             return True
         return False

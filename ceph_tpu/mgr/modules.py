@@ -76,9 +76,77 @@ class PrometheusModule(MgrModule):
         health = self.get("health")
         emit("ceph_health_detail", len(health),
              help_="number of active health checks")
+        # cluster accounting (`ceph df` series): per-pool stored /
+        # raw-used / objects with pool labels, plus the capacity totals
+        metrics = self.get("metrics")
+        if metrics is not None:
+            df = metrics.df(osdmap)
+            emit("ceph_cluster_total_bytes", df["total_bytes"],
+                 help_="summed store capacity of fresh daemons")
+            emit("ceph_cluster_used_bytes", df["used_bytes"])
+            for pool_id, row in sorted(df["pools"].items(),
+                                       key=lambda kv: str(kv[0])):
+                labels = {"pool_id": pool_id, "name": row["name"]}
+                emit("ceph_pool_objects", row["objects"], labels)
+                emit("ceph_pool_stored_bytes", row["stored"], labels)
+                emit("ceph_pool_raw_used_bytes", row["raw_used"],
+                     labels)
+                emit("ceph_pool_percent_used", row["percent_used"],
+                     labels)
+            # cluster IO rates (the iostat view) + per-daemon derived
+            # op rates — the aggregated series, not raw counters
+            io = metrics.iostat()
+            emit("ceph_cluster_read_op_per_sec",
+                 io["read_op_per_sec"])
+            emit("ceph_cluster_write_op_per_sec",
+                 io["write_op_per_sec"])
+            emit("ceph_cluster_read_MBps", io["read_MBps"])
+            emit("ceph_cluster_write_MBps", io["write_MBps"])
+            for daemon in metrics.daemons():
+                lbl = {"ceph_daemon": daemon}
+                for ctr, name in (("op_r", "ceph_osd_op_r_rate"),
+                                  ("op_w", "ceph_osd_op_w_rate")):
+                    r = metrics.rate(daemon, "osd", ctr)
+                    if daemon.startswith("osd."):
+                        emit(name, r, lbl)
+                # device-utilization gauges from the report's status
+                # bag: HBM residency, dispatch queue depth, rolling
+                # per-codec throughput with codec labels
+                status = metrics.status(daemon)
+                tpu = status.get("tpu") or {}
+                if tpu:
+                    emit("ceph_tpu_dispatch_queue_depth",
+                         tpu.get("queue_depth", 0), lbl)
+                    emit("ceph_tpu_coalesce_ratio",
+                         tpu.get("coalesce_ratio", 1.0), lbl)
+                    for codec, row in sorted(
+                            (tpu.get("codecs") or {}).items()):
+                        clbl = dict(lbl, codec=codec)
+                        emit("ceph_tpu_codec_encode_MBps",
+                             row.get("enc_MBps", 0.0), clbl)
+                        emit("ceph_tpu_codec_decode_MBps",
+                             row.get("dec_MBps", 0.0), clbl)
+                hbm = status.get("hbm") or {}
+                if hbm:
+                    emit("ceph_osd_hbm_resident_objects",
+                         hbm.get("resident_objects", 0), lbl)
+                    emit("ceph_osd_hbm_resident_bytes",
+                         hbm.get("resident_bytes", 0), lbl)
+            # balancer sweep timings (ROADMAP #4's measured-feedback
+            # series), exported with a backend label
+            for key in metrics.value_keys():
+                if not key.startswith("balancer_sweep_"):
+                    continue
+                vals = metrics.values(key)
+                if vals:
+                    emit("ceph_balancer_sweep_seconds", vals[-1],
+                         {"backend": key[len("balancer_sweep_"):]})
         # per-daemon perf counters (reference: perf_counters as
         # ceph_<daemon-type>_<counter>{ceph_daemon=...}); this includes
-        # the l_bluefs_* and l_tpu_* groups the OSDs register
+        # the l_bluefs_* and l_tpu_* groups the OSDs register.
+        # Staleness contract: all_perf()/daemons() exclude daemons
+        # beyond stale_after, so a dead daemon's series VANISH from
+        # this exposition instead of flatlining at their last value
         for daemon, perf in sorted(self.get("perf_counters").items()):
             dtype = daemon.split(".", 1)[0]
             for group, counters in perf.items():
@@ -243,6 +311,16 @@ class BalancerModule(MgrModule):
         self.max_deviation_ratio = 0.05
         self.max_changes_per_round = 10
         self.last_optimize: dict = {}
+        # measured-speed backend selection (ROADMAP #4): wall-time
+        # samples per sweep backend; once both sides have
+        # min_speed_samples, use_device follows the measured medians
+        # instead of a static assumption.  Timings also land in the
+        # mgr's telemetry store (balancer_sweep_{native,device}).
+        self.sweep_samples: dict[str, list[float]] = {
+            "native": [], "device": []}
+        self.min_speed_samples = 2
+        self.max_speed_samples = 16
+        self.use_device: bool | None = None   # None = not decided yet
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -251,20 +329,89 @@ class BalancerModule(MgrModule):
 
     def _eval(self, osdmap):
         from ..osd.balancer import eval_distribution
-        return eval_distribution(osdmap)
+        # score with the measured-fastest backend once one is chosen;
+        # if the device path is unavailable (no device, broken env)
+        # the native sweep answers instead of the command dying
+        use_device = True if self.use_device is None \
+            else self.use_device
+        try:
+            return eval_distribution(osdmap, use_device=use_device)
+        except Exception:
+            if not use_device:
+                raise
+            return eval_distribution(osdmap, use_device=False)
+
+    @staticmethod
+    def _median(xs):
+        s = sorted(xs)
+        n = len(s)
+        return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+    def _record_sweep(self, backend: str, seconds: float) -> None:
+        samples = self.sweep_samples[backend]
+        samples.append(seconds)
+        del samples[:-self.max_speed_samples]
+        metrics = getattr(self.mgr, "metrics", None)
+        if metrics is not None and seconds != float("inf"):
+            metrics.record_value("balancer_sweep_%s" % backend,
+                                 seconds)
+
+    def pick_backend(self, osdmap) -> bool:
+        """Choose the sweep backend from MEASURED wall-times: probe
+        whichever backend still lacks samples (one timed sweep each),
+        then return use_device = device median < native median.  The
+        probe cost is one extra all-PG sweep per undersampled backend
+        — paid at most min_speed_samples times per mgr lifetime.
+        A backend whose probe RAISES (no device, broken jax env) is
+        recorded as infinitely slow: the working backend wins instead
+        of the round dying — measured selection doubles as a
+        availability fallback."""
+        from ..osd.balancer import measure_sweep
+        for backend in ("native", "device"):
+            while len(self.sweep_samples[backend]) < \
+                    self.min_speed_samples:
+                try:
+                    dt = measure_sweep(
+                        osdmap, use_device=(backend == "device"))
+                except Exception:
+                    dt = float("inf")
+                self._record_sweep(backend, dt)
+        self.use_device = (
+            self._median(self.sweep_samples["device"])
+            < self._median(self.sweep_samples["native"]))
+        return self.use_device
+
+    def sweep_medians(self) -> dict:
+        def med(s):
+            if not s:
+                return None
+            m = self._median(s)
+            return round(m, 6) if m != float("inf") else "unusable"
+        return {b: med(s) for b, s in self.sweep_samples.items()}
 
     def optimize_once(self) -> tuple[int, str]:
         """One balancer round: compute a proposal against the current
         map and apply it through the monitor.  Returns (#changes,
         summary)."""
+        import time as _time
+
         from ..osd.balancer import calc_pg_upmaps
         osdmap = self.get("osd_map")
         if osdmap is None:
             return 0, "no osdmap yet"
+        use_device = self.pick_backend(osdmap)
+        t0 = _time.perf_counter()
         res = calc_pg_upmaps(
             osdmap, max_deviation=1.0,
             max_deviation_ratio=self.max_deviation_ratio,
-            max_changes=self.max_changes_per_round)
+            max_changes=self.max_changes_per_round,
+            use_device=use_device)
+        elapsed = _time.perf_counter() - t0
+        if res.sweeps > 0:
+            # each real round refreshes the chosen backend's series:
+            # the decision keeps tracking the hardware it runs on
+            self._record_sweep("device" if use_device else "native",
+                               elapsed / res.sweeps)
         mon = self.mgr.mon_client
         applied = 0
         for pgid in res.old_pg_upmap_items:
@@ -280,14 +427,17 @@ class BalancerModule(MgrModule):
                                    "mappings": [list(p) for p in items]})
             if r == 0:
                 applied += 1
+        backend = "device" if use_device else "native"
         summary = ("%d change(s) applied; deviation %.2f -> %.2f "
-                   "(%d device sweeps)"
+                   "(%d %s sweeps)"
                    % (applied, res.start_deviation, res.end_deviation,
-                      res.sweeps))
+                      res.sweeps, backend))
         self.last_optimize = {"applied": applied,
                               "start_deviation": res.start_deviation,
                               "end_deviation": res.end_deviation,
-                              "sweeps": res.sweeps}
+                              "sweeps": res.sweeps,
+                              "backend": backend,
+                              "sweep_medians": self.sweep_medians()}
         return applied, summary
 
     # -- commands ------------------------------------------------------
@@ -296,6 +446,8 @@ class BalancerModule(MgrModule):
         prefix = cmd.get("prefix")
         if prefix == "balancer status":
             return 0, "", {"mode": self.mode, "active": self.active,
+                           "use_device": self.use_device,
+                           "sweep_medians": self.sweep_medians(),
                            "last_optimize": dict(self.last_optimize)}
         if prefix == "balancer eval":
             osdmap = self.get("osd_map")

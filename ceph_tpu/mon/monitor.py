@@ -82,6 +82,19 @@ class Monitor(Dispatcher):
         # cephx key server (src/auth/cephx/CephxKeyServer): present when
         # the cluster runs with auth enabled
         self.key_server = None
+        # mgr telemetry: l_mon_* counters + the MMgrReport stream
+        # (mgr_addr installed by the harness once an mgr exists)
+        from ..common.perf_counters import PerfCountersBuilder
+        self.perf = (PerfCountersBuilder("mon")
+                     .add_u64_counter("paxos_commits",
+                                      "values committed through paxos")
+                     .add_u64_counter("commands",
+                                      "MMonCommand requests handled")
+                     .add_u64("quorum_size", "current quorum size")
+                     .create_perf_counters())
+        self.ctx.perf.add(self.perf)
+        self.mgr_addr = None
+        self._last_mgr_report = 0.0
         # mon-internal shared secret: attests peon->leader forwarded
         # commands (the reference signs MForward the same way)
         self._mon_secret = (service_secrets or {}).get("mon")
@@ -132,7 +145,34 @@ class Monitor(Dispatcher):
             except Exception:
                 import traceback
                 traceback.print_exc()
+        try:
+            # telemetry is best-effort: it must never be able to kill
+            # the tick chain (the monitor's pulse)
+            self._mgr_report()
+        except Exception:
+            pass
         self.timer.add_event_after(0.25, self._tick)
+
+    def _mgr_report(self) -> None:
+        """Mon leg of the cluster telemetry stream: perf dump +
+        schema to the mgr on the mgr_stats_period cadence (0 = off)."""
+        if self.mgr_addr is None:
+            return
+        period = self.ctx.conf.get_val("mgr_stats_period")
+        now = time.monotonic()
+        if period <= 0 or now - self._last_mgr_report < period:
+            return
+        self._last_mgr_report = now
+        self.perf.set("quorum_size", len(self.quorum))
+        from ..msg.message import MMgrReport
+        self.msgr.send_message(
+            MMgrReport(daemon_name="mon.%d" % self.rank,
+                       daemon_type="mon",
+                       perf=self.ctx.perf.perf_dump(),
+                       metadata={"rank": self.rank,
+                                 "state": self.state},
+                       perf_schema=self.ctx.perf.perf_schema()),
+            self.mgr_addr)
 
     # -- roles ---------------------------------------------------------
 
@@ -197,6 +237,7 @@ class Monitor(Dispatcher):
             return
 
     def _on_paxos_commit(self, version: int, value: bytes) -> None:
+        self.perf.inc("paxos_commits")
         service, payload = encoding.decode_any(value)
         if service == "osdmap":
             self.osdmon.apply_committed(payload)
@@ -319,6 +360,7 @@ class Monitor(Dispatcher):
                                  msg.start_epoch)
             return True
         if t == "MMonCommand":
+            self.perf.inc("commands")
             # MonCap check at the mon the client authenticated with
             # (the session table is local); the leader skips only for
             # commands a quorum member attested with the mon secret

@@ -389,10 +389,22 @@ class MPGStats(Message):
 
 @dataclass
 class MMgrReport(Message):
-    """Daemon -> mgr perf-counter report (src/messages/MMgrReport.h)."""
+    """Daemon -> mgr perf-counter report (src/messages/MMgrReport.h).
+
+    Appended fields (compatible evolution) carry the full telemetry
+    payload: `status` is the gauge bag (store statfs, TPU dispatcher
+    utilization, HBM residency), `pg_stats` the primary-PG rows the
+    mgr's `ceph df` accounting folds (the MgrStatMonitor leg of the
+    reference's stats path), and `perf_schema` the counter kinds +
+    histogram bucket bounds so the aggregator can derive rates and
+    percentiles without guessing a counter's type."""
     daemon_name: str = ""
     perf: dict = field(default_factory=dict)
     metadata: dict = field(default_factory=dict)
+    daemon_type: str = ""          # osd | mon | mds | mgr | rgw
+    status: dict = field(default_factory=dict)
+    pg_stats: dict = field(default_factory=dict)
+    perf_schema: dict = field(default_factory=dict)
 
 
 # -- mds / cephfs ------------------------------------------------------

@@ -40,7 +40,7 @@ from ..crush.map import CRUSH_ITEM_NONE, CrushMap
 from .osd_map import PGID, Incremental, OSDMap, OSDMapMapping
 
 __all__ = ["calc_pg_upmaps", "eval_distribution", "BalancerResult",
-           "Distribution"]
+           "Distribution", "measure_sweep"]
 
 
 # ---------------------------------------------------------------------------
@@ -163,6 +163,19 @@ def _sweep(osdmap: OSDMap, pools: set[int] | None,
             continue
         out[pgid] = up
     return out
+
+
+def measure_sweep(osdmap: OSDMap, use_device: bool,
+                  pools: set[int] | None = None) -> float:
+    """Wall-time of one all-PG placement sweep on the named backend
+    (device = batched CRUSH program, native = the host mapper).  The
+    mgr balancer's measured-speed backend selection (ROADMAP #4)
+    feeds on these instead of assuming the device always wins — on a
+    single chip behind a slow transport the host sweep often does."""
+    import time as _time
+    t0 = _time.perf_counter()
+    _sweep(osdmap, pools, use_device)
+    return _time.perf_counter() - t0
 
 
 def _targets(osdmap: OSDMap,

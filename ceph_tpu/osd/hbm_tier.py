@@ -91,6 +91,22 @@ class HbmChunkTier:
         self._lock = threading.Lock()
         self._objs: dict = {}          # name -> (_Batch, row index)
         self._order: list = []         # LRU, oldest first
+        self._obj_bytes = 0            # per-object [k+m, n] footprint
+        # residency/utilization gauges (telemetry pipeline: the OSD
+        # report's status bag + an optional ctx.perf registration)
+        from ..common.perf_counters import PerfCountersBuilder
+        self.perf = (PerfCountersBuilder("osd_hbm")
+                     .add_u64("l_hbm_resident_objects",
+                              "objects resident in HBM")
+                     .add_u64("l_hbm_resident_bytes",
+                              "HBM bytes held by resident chunks")
+                     .add_u64_counter("l_hbm_hits",
+                                      "consumer reads served resident")
+                     .add_u64_counter("l_hbm_misses",
+                                      "lookups that missed residency")
+                     .add_u64_counter("l_hbm_evictions",
+                                      "objects evicted over capacity")
+                     .create_perf_counters())
 
     # -- residency -----------------------------------------------------
 
@@ -113,6 +129,12 @@ class HbmChunkTier:
     def _evict_over_capacity(self) -> None:
         while len(self._objs) > self.capacity and self._order:
             self._drop_locked(self._order[0])
+            self.perf.inc("l_hbm_evictions")
+
+    def _update_gauges_locked(self) -> None:
+        self.perf.set("l_hbm_resident_objects", len(self._objs))
+        self.perf.set("l_hbm_resident_bytes",
+                      len(self._objs) * self._obj_bytes)
 
     def put_encode(self, names: list, data_host: np.ndarray):
         """THE one H2D: upload a batch of objects' data chunks
@@ -125,12 +147,14 @@ class HbmChunkTier:
         full = jnp.concatenate([data_dev, parity], axis=1)
         batch = _Batch(full, len(names))
         with self._lock:
+            self._obj_bytes = int(full.shape[1]) * int(full.shape[2])
             for i, name in enumerate(names):
                 if name in self._objs:
                     self._drop_locked(name)
                 self._objs[name] = (batch, i)
                 self._touch(name)
                 self._evict_over_capacity()
+            self._update_gauges_locked()
         return parity
 
     def _gather(self, names: list):
@@ -161,13 +185,16 @@ class HbmChunkTier:
         with self._lock:
             ent = self._objs.get(name)
             if ent is None:
+                self.perf.inc("l_hbm_misses")
                 return None
             self._touch(name)
+            self.perf.inc("l_hbm_hits")
             return ent[0].arr[ent[1]]
 
     def drop(self, name) -> None:
         with self._lock:
             self._drop_locked(name)
+            self._update_gauges_locked()
 
     # -- consumers (all read the RESIDENT copy) ------------------------
 
@@ -250,4 +277,9 @@ class HbmChunkTier:
     def stats(self) -> dict:
         with self._lock:
             return {"resident_objects": len(self._objs),
-                    "capacity": self.capacity}
+                    "resident_bytes":
+                        len(self._objs) * self._obj_bytes,
+                    "capacity": self.capacity,
+                    "hits": self.perf.get("l_hbm_hits"),
+                    "misses": self.perf.get("l_hbm_misses"),
+                    "evictions": self.perf.get("l_hbm_evictions")}

@@ -139,6 +139,10 @@ class OSDDaemon(Dispatcher):
             self.ctx.perf.add(self.tpu_dispatcher.perf)
         else:
             self.tpu_dispatcher = None
+        # HBM-resident chunk tier (osd/hbm_tier.py): not wired into the
+        # data path yet (ROADMAP #1); when a harness attaches one, its
+        # residency gauges ride the telemetry report automatically
+        self.hbm_tier = None
         self.hb_peers: dict = {}       # osd -> last reply stamp
         self.hb_pending: dict = {}     # osd -> first unacked ping stamp
         # cache tiering: base-pool IO runs on dedicated threads with an
@@ -153,7 +157,11 @@ class OSDDaemon(Dispatcher):
         from ..common.perf_counters import PerfCountersBuilder
         self.perf = (PerfCountersBuilder("osd")
                      .add_u64_counter("op", "client operations")
+                     .add_u64_counter("op_r", "client read operations")
+                     .add_u64_counter("op_w", "client write operations")
                      .add_u64_counter("op_in_bytes", "client bytes written")
+                     .add_u64_counter("op_out_bytes",
+                                      "client bytes read back")
                      .add_time_avg("op_latency", "client op latency")
                      .add_u64_counter("read_err",
                                       "shard read errors (EIO/bad crc) "
@@ -214,6 +222,7 @@ class OSDDaemon(Dispatcher):
         self._boot()
         self._hb_tick()
         self._agent_tick()
+        self._mgr_report_tick()
 
     def _send_mon(self, msg) -> None:
         """One-way control traffic (boot, failure reports, pg stats)
@@ -475,18 +484,75 @@ class OSDDaemon(Dispatcher):
         # report scrub errors + rough usage so the HealthMonitor can
         # derive OSD_SCRUB_ERRORS / POOL_FULL mon-side
         self._report_pg_stats()
-        # mgr perf report rides the heartbeat cadence (DaemonServer's
-        # MMgrReport stream); mgr_addr is installed by the harness or
-        # operator once an mgr exists
-        if self.mgr_addr is not None:
-            from ..msg.message import MMgrReport
-            self.public_msgr.send_message(
-                MMgrReport(daemon_name="osd.%d" % self.whoami,
-                           perf=self.ctx.perf.perf_dump(),
-                           metadata={"id": self.whoami}),
-                self.mgr_addr)
         self.timer.add_event_after(
             conf.get_val("osd_heartbeat_interval"), self._hb_tick)
+
+    def _mgr_report_tick(self) -> None:
+        """The mgr telemetry stream (DaemonServer's MMgrReport role)
+        on its OWN cadence — mgr_stats_period, decoupled from the
+        heartbeat so operators can tune (or pin off, period=0) the
+        report volume without touching failure detection.  Each report
+        carries the full perf dump + schema, the store statfs and
+        device-utilization gauges, and the primary-PG stat rows the
+        mgr's `ceph df` accounting folds."""
+        if not self._running:
+            return
+        period = self.ctx.conf.get_val("mgr_stats_period")
+        if period <= 0:
+            # reporting pinned off; poll cheaply for a config change
+            self.timer.add_event_after(1.0, self._mgr_report_tick)
+            return
+        try:
+            if self.mgr_addr is not None:
+                from ..msg.message import MMgrReport
+                self.public_msgr.send_message(
+                    MMgrReport(daemon_name="osd.%d" % self.whoami,
+                               daemon_type="osd",
+                               perf=self.ctx.perf.perf_dump(),
+                               metadata={"id": self.whoami},
+                               status=self._telemetry_status(),
+                               pg_stats=self._collect_pg_stats(),
+                               perf_schema=self.ctx.perf.perf_schema()),
+                    self.mgr_addr)
+        finally:
+            # a failed report must never kill the tick chain — the
+            # stream self-heals on the next period
+            self.timer.add_event_after(period, self._mgr_report_tick)
+
+    def _telemetry_status(self) -> dict:
+        """The gauge bag riding MMgrReport.status: store capacity
+        truth plus device-utilization (dispatch queue depth,
+        coalescing, rolling per-codec MB/s, HBM residency)."""
+        status: dict = {}
+        try:
+            status["statfs"] = self.store.statfs()
+        except Exception:
+            pass
+        if self.tpu_dispatcher is not None:
+            try:
+                status["tpu"] = self.tpu_dispatcher.telemetry()
+            except Exception:
+                pass
+        tier = getattr(self, "hbm_tier", None)
+        if tier is not None:
+            try:
+                status["hbm"] = tier.stats()
+            except Exception:
+                pass
+        return status
+
+    def _collect_pg_stats(self) -> dict:
+        """Primary PGs' stat rows (shared by the mon MPGStats report
+        and the mgr telemetry report)."""
+        with self.lock:
+            pgs = [pg for pg in self.pgs.values() if pg.is_primary()]
+        stats = {}
+        for pg in pgs:
+            try:
+                stats[str(pg.pgid)] = pg.get_stats()
+            except Exception:
+                continue
+        return stats
 
     def _report_pg_stats(self) -> None:
         """Primary PGs' stats to the mon (MPGStats).  Rate-limited to
@@ -497,14 +563,7 @@ class OSDDaemon(Dispatcher):
         if now - getattr(self, "_last_pg_report", 0.0) < 1.0:
             return
         self._last_pg_report = now
-        with self.lock:
-            pgs = [pg for pg in self.pgs.values() if pg.is_primary()]
-        stats = {}
-        for pg in pgs:
-            try:
-                stats[str(pg.pgid)] = pg.get_stats()
-            except Exception:
-                continue
+        stats = self._collect_pg_stats()
         # slow-request count rides the same report (OSD_SLOW_OPS feed);
         # it must go out even with no primary-PG stats so a wedged op
         # on a just-demoted primary still surfaces
@@ -653,8 +712,13 @@ class OSDDaemon(Dispatcher):
         replied = [False]
 
         self.perf.inc("op")
-        self.perf.inc("op_in_bytes",
-                      len(getattr(msg, "data", b"") or b""))
+        # read/write split + real payload accounting: the op's byte
+        # operands ARE the write payload (MOSDOp carries no top-level
+        # data field — the old getattr(msg, "data") read always 0)
+        in_bytes = sum(len(arg) for op_t in msg.ops for arg in op_t
+                       if isinstance(arg, (bytes, bytearray)))
+        self.perf.inc("op_w" if mutating else "op_r")
+        self.perf.inc("op_in_bytes", in_bytes)
 
         def reply(result, data):
             if replied[0]:
@@ -668,6 +732,12 @@ class OSDDaemon(Dispatcher):
                         self._op_replies.pop(dedup_key, None)
                     else:
                         self._op_replies[dedup_key] = (result, data)
+            if isinstance(data, (bytes, bytearray)):
+                self.perf.inc("op_out_bytes", len(data))
+            elif isinstance(data, list):
+                self.perf.inc("op_out_bytes", sum(
+                    len(d) for d in data
+                    if isinstance(d, (bytes, bytearray))))
             self.perf.tinc("op_latency", op.duration)
             self.perf.tinc("l_osd_op_trace_total", op.duration)
             self.perf.hinc("l_osd_op_trace_us",

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 
 import numpy as np
 
@@ -67,6 +68,11 @@ class TpuDispatcher:
         self.cv = threading.Condition(self.lock)
         self.queues: dict = {}     # key -> (fn, [_Pending])
         self.stats = {"ops": 0, "dispatches": 0, "coalesced": 0}
+        # per-codec throughput ledger: label -> {enc/dec bytes + a
+        # bounded (t, bytes) window for the rolling-MB/s gauges the
+        # telemetry report exports with codec labels}
+        self.codec_stats: dict = {}
+        self._telemetry_window = 10.0
         # l_tpu_* counters: device-segment attribution (exported via
         # the daemon's PerfCountersCollection -> mgr -> prometheus)
         self.perf = (PerfCountersBuilder("osd_tpu")
@@ -83,6 +89,12 @@ class TpuDispatcher:
                                       "device programs dispatched")
                      .add_u64_counter("l_tpu_coalesced",
                                       "ops that shared a dispatch")
+                     .add_u64("l_tpu_queue_depth",
+                              "ops waiting in the coalescing queues")
+                     .add_u64_counter("l_tpu_enc_bytes",
+                                      "bytes through device encode")
+                     .add_u64_counter("l_tpu_dec_bytes",
+                                      "bytes through device decode")
                      .create_perf_counters())
         self._stop = False
         self._thread = threading.Thread(
@@ -117,11 +129,47 @@ class TpuDispatcher:
             pass
         return key
 
+    @staticmethod
+    def _codec_label(codec):
+        """Stable human label for per-codec telemetry series
+        (prometheus codec= label): class name + layout params."""
+        cached = getattr(codec, "_dispatch_label", None)
+        if cached is not None:
+            return cached
+        label = type(codec).__name__
+        try:
+            k = codec.get_data_chunk_count()
+            m = codec.get_chunk_count() - k
+            label = "%s_k%dm%d" % (label, k, m)
+        except Exception:
+            pass
+        try:
+            codec._dispatch_label = label
+        except AttributeError:
+            pass
+        return label
+
+    def _account_codec(self, codec, kind: str, nbytes: int) -> None:
+        now = time.monotonic()
+        with self.lock:
+            row = self.codec_stats.setdefault(
+                self._codec_label(codec),
+                {"enc_bytes": 0, "dec_bytes": 0, "window": deque()})
+            row[kind + "_bytes"] += nbytes
+            w = row["window"]
+            w.append((now, kind, nbytes))
+            cutoff = now - self._telemetry_window
+            while w and w[0][0] < cutoff:
+                w.popleft()
+        self.perf.inc("l_tpu_%s_bytes" % kind, nbytes)
+
     def encode(self, codec, batch: np.ndarray,
                trace=NULL_SPAN) -> np.ndarray:
         """codec.encode_batch(batch), coalesced across submitters."""
         key = (self._codec_key(codec), "enc", batch.shape[1:],
                str(batch.dtype))
+        self._account_codec(codec, "enc",
+                            getattr(batch, "nbytes", 0))
         return self._submit(key, codec.encode_batch, batch, trace)
 
     def decode(self, codec, avail_rows: tuple,
@@ -131,9 +179,45 @@ class TpuDispatcher:
         avail_rows = tuple(avail_rows)
         key = (self._codec_key(codec), "dec", avail_rows,
                chunks.shape[1:], str(chunks.dtype))
+        self._account_codec(codec, "dec",
+                            getattr(chunks, "nbytes", 0))
         return self._submit(
             key, lambda stacked: codec.decode_batch(avail_rows, stacked),
             chunks, trace)
+
+    def telemetry(self) -> dict:
+        """The device-utilization gauge bag the OSD ships in its mgr
+        report: live queue depth, lifetime coalescing ratio, and
+        rolling per-codec encode/decode MB/s (bytes through the
+        dispatcher over the last telemetry window)."""
+        now = time.monotonic()
+        with self.lock:
+            depth = sum(len(pend) for _, pend in self.queues.values())
+            ops = self.stats["ops"]
+            disp = self.stats["dispatches"]
+            codecs = {}
+            cutoff = now - self._telemetry_window
+            for label, row in self.codec_stats.items():
+                enc_b = dec_b = 0
+                for t, kind, nb in row["window"]:
+                    if t < cutoff:
+                        continue
+                    if kind == "enc":
+                        enc_b += nb
+                    else:
+                        dec_b += nb
+                codecs[label] = {
+                    "enc_bytes": row["enc_bytes"],
+                    "dec_bytes": row["dec_bytes"],
+                    "enc_MBps": round(
+                        enc_b / self._telemetry_window / 1e6, 3),
+                    "dec_MBps": round(
+                        dec_b / self._telemetry_window / 1e6, 3)}
+        self.perf.set("l_tpu_queue_depth", depth)
+        return {"queue_depth": depth,
+                "ops": ops, "dispatches": disp,
+                "coalesce_ratio": round(disp / ops, 3) if ops else 1.0,
+                "codecs": codecs}
 
     def shutdown(self) -> None:
         with self.cv:
@@ -151,7 +235,9 @@ class TpuDispatcher:
                 q = self.queues[key] = (fn, [])
             q[1].append(p)
             self.stats["ops"] += 1
+            depth = sum(len(pend) for _, pend in self.queues.values())
             self.cv.notify_all()
+        self.perf.set("l_tpu_queue_depth", depth)
         if not p.event.wait(timeout=120):
             raise TimeoutError("tpu dispatcher wedged")
         if p.error is not None:

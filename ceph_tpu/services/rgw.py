@@ -316,6 +316,18 @@ class RGWServer:
                  credentials: dict | None = None):
         self.store = _Store(ioctx)
         self.credentials = dict(credentials or {})
+        self._ioctx = ioctx
+        # mgr telemetry: l_rgw_* counters (RGWServer has no messenger
+        # of its own — start_mgr_reports borrows the rados client's)
+        from ..common.perf_counters import PerfCountersBuilder
+        self.perf = (PerfCountersBuilder("rgw")
+                     .add_u64_counter("req", "HTTP requests served")
+                     .add_u64_counter("failed_req",
+                                      "requests answered >= 400")
+                     .add_u64_counter("get_b", "bytes served by GET")
+                     .add_u64_counter("put_b", "bytes taken by PUT")
+                     .create_perf_counters())
+        self._mgr_timer: threading.Timer | None = None
         gw = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -325,6 +337,7 @@ class RGWServer:
                 pass
 
             def _dispatch(self, method):
+                gw.perf.inc("req")
                 try:
                     gw._check_auth(method, self)
                     status, headers, body = gw._route(method, self)
@@ -336,6 +349,14 @@ class RGWServer:
                     body = S3Error(500, "InternalError",
                                    str(e)).body()
                     headers = {"Content-Type": "application/xml"}
+                if status >= 400:
+                    gw.perf.inc("failed_req")
+                elif method == "GET":
+                    gw.perf.inc("get_b", len(body))
+                elif method == "PUT":
+                    gw.perf.inc(
+                        "put_b",
+                        int(self.headers.get("Content-Length") or 0))
                 self.send_response(status)
                 for k, v in headers.items():
                     self.send_header(k, v)
@@ -372,8 +393,43 @@ class RGWServer:
         return self
 
     def stop(self) -> None:
+        if self._mgr_timer is not None:
+            self._mgr_timer.cancel()
+            self._mgr_timer = None
         self.httpd.shutdown()
         self.httpd.server_close()
+
+    # -- mgr telemetry -------------------------------------------------
+
+    def start_mgr_reports(self, mgr_addr, name: str = "rgw.0",
+                          period: float | None = None) -> None:
+        """RGW leg of the cluster telemetry stream: ship the l_rgw_*
+        counters to the mgr on the mgr_stats_period cadence, riding
+        the backing rados client's messenger (the gateway is an HTTP
+        front, not a cluster daemon with its own messenger)."""
+        client = self._ioctx.client
+        if period is None:
+            period = client.ctx.conf.get_val("mgr_stats_period") \
+                if getattr(client, "ctx", None) is not None else 0.5
+        if period <= 0:
+            return
+
+        def tick():
+            from ..msg.message import MMgrReport
+            try:
+                client.msgr.send_message(
+                    MMgrReport(daemon_name=name, daemon_type="rgw",
+                               perf={"rgw": self.perf.dump()},
+                               metadata={"addr": str(self.addr)},
+                               perf_schema={"rgw": self.perf.schema()}),
+                    mgr_addr)
+            except Exception:
+                return               # messenger gone: stop reporting
+            self._mgr_timer = threading.Timer(period, tick)
+            self._mgr_timer.daemon = True
+            self._mgr_timer.start()
+
+        tick()
 
     # -- auth ----------------------------------------------------------
 

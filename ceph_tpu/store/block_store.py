@@ -1060,6 +1060,17 @@ class BlockStore(ObjectStore):
 
     # -- introspection (tests / objectstore tool) ----------------------
 
+    def statfs(self) -> dict:
+        """Allocator-accurate usage: the managed device's size vs its
+        free map (used includes BlueFS metadata — that space is as
+        gone as blob space, and `ceph df` percent-used must reflect
+        the device truth)."""
+        with self._lock:
+            total = self.allocator.device_size
+            free = self.allocator.free_bytes()
+        return {"total": total, "used": total - free,
+                "available": free}
+
     def stats(self) -> dict:
         with self._lock:
             return {

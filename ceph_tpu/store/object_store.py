@@ -101,9 +101,32 @@ class Collection:
 class ObjectStore:
     """Backend interface (the subset the data path exercises)."""
 
+    #: nominal capacity for backends without a real device bound
+    #: (MemStore/FileStore/KStore) — `ceph df` percent-used needs a
+    #: denominator; BlockStore overrides statfs with the device size
+    capacity_bytes = 4 << 30
+
     def mount(self) -> None: ...
 
     def umount(self) -> None: ...
+
+    def statfs(self) -> dict:
+        """Store-level usage (ObjectStore::statfs): {total, used,
+        available} bytes.  Generic implementation walks collections
+        and sums object footprints; device-bound backends override
+        with allocator-accurate numbers."""
+        used = 0
+        try:
+            for cid in self.list_collections():
+                for oid in self.list_objects(cid):
+                    st = self.stat(cid, oid)
+                    if st is not None:
+                        used += st.get("size", 0)
+        except Exception:
+            pass
+        total = max(self.capacity_bytes, used)
+        return {"total": total, "used": used,
+                "available": total - used}
 
     def queue_transaction(self, txn: Transaction) -> None:
         raise NotImplementedError

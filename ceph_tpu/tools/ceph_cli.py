@@ -106,6 +106,106 @@ def trace_tree_command(words: list[str], asoks: list[str]) -> int:
     return 0
 
 
+def _mgr_asok(asoks: list[str], what: str):
+    """The mgr admin socket the telemetry CLI surfaces read; the
+    first --asok is the mgr's."""
+    from ..common.admin_socket import AdminSocketClient
+    if not asoks:
+        sys.stderr.write("ceph: %s needs --asok <mgr-asok-path>\n"
+                         % what)
+        return None
+    return AdminSocketClient(asoks[0])
+
+
+def _fmt_bytes(n) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return "%.1f %s" % (n, unit) if unit != "B" \
+                else "%d B" % n
+        n /= 1024.0
+    return "%d" % n
+
+
+def df_command(asoks: list[str]) -> int:
+    """`ceph df --asok MGR`: per-pool stored/raw-used vs capacity
+    from the mgr's telemetry aggregation."""
+    client = _mgr_asok(asoks, "df")
+    if client is None:
+        return 1
+    try:
+        reply = client.do_request("df")
+    except (OSError, ValueError) as e:
+        sys.stderr.write("ceph df: %s\n" % e)
+        return 1
+    if not isinstance(reply, dict) or "pools" not in reply:
+        sys.stderr.write("ceph df: bad reply %r\n" % (reply,))
+        return 1
+    out = ["RAW STORAGE:",
+           "  total: %s  used: %s  avail: %s"
+           % (_fmt_bytes(reply["total_bytes"]),
+              _fmt_bytes(reply["used_bytes"]),
+              _fmt_bytes(reply["avail_bytes"])),
+           "",
+           "POOLS:",
+           "  %-16s %8s %12s %12s %8s"
+           % ("NAME", "OBJECTS", "STORED", "RAW USED", "%USED")]
+    for pool_id, row in sorted(reply["pools"].items(),
+                               key=lambda kv: str(kv[0])):
+        out.append("  %-16s %8d %12s %12s %7.2f%%"
+                   % (row.get("name", pool_id), row.get("objects", 0),
+                      _fmt_bytes(row.get("stored", 0)),
+                      _fmt_bytes(row.get("raw_used", 0)),
+                      100.0 * row.get("percent_used", 0.0)))
+    sys.stdout.write("\n".join(out) + "\n")
+    return 0
+
+
+def osd_perf_command(asoks: list[str]) -> int:
+    """`ceph osd perf --asok MGR`: per-OSD commit/apply latency."""
+    client = _mgr_asok(asoks, "osd perf")
+    if client is None:
+        return 1
+    try:
+        reply = client.do_request("osd perf")
+    except (OSError, ValueError) as e:
+        sys.stderr.write("ceph osd perf: %s\n" % e)
+        return 1
+    out = ["%-10s %18s %18s"
+           % ("osd", "commit_latency(ms)", "apply_latency(ms)")]
+    for name, row in sorted((reply or {}).items()):
+        out.append("%-10s %18.3f %18.3f"
+                   % (name, row.get("commit_latency_ms", 0.0),
+                      row.get("apply_latency_ms", 0.0)))
+    sys.stdout.write("\n".join(out) + "\n")
+    return 0
+
+
+def iostat_command(asoks: list[str], period: float, count: int) -> int:
+    """`ceph iostat --asok MGR [--period N] [--count M]`: rolling
+    cluster read/write ops/s and MB/s rows."""
+    import time as _time
+    client = _mgr_asok(asoks, "iostat")
+    if client is None:
+        return 1
+    sys.stdout.write("%10s %10s %10s %10s\n"
+                     % ("rd_op/s", "wr_op/s", "rd_MB/s", "wr_MB/s"))
+    for i in range(max(count, 1)):
+        try:
+            row = client.do_request("iostat", window=period)
+        except (OSError, ValueError) as e:
+            sys.stderr.write("ceph iostat: %s\n" % e)
+            return 1
+        sys.stdout.write("%10.2f %10.2f %10.3f %10.3f\n"
+                         % (row.get("read_op_per_sec", 0.0),
+                            row.get("write_op_per_sec", 0.0),
+                            row.get("read_MBps", 0.0),
+                            row.get("write_MBps", 0.0)))
+        sys.stdout.flush()
+        if i + 1 < count:
+            _time.sleep(period)
+    return 0
+
+
 def daemon_command(words: list[str]) -> int:
     """`ceph daemon <asok-path> <command...>`: talk straight to one
     daemon's unix admin socket (perf dump, dump_ops_in_flight,
@@ -149,14 +249,21 @@ def main(argv=None) -> int:
     p.add_argument("--monmap")
     p.add_argument("--mon", action="append")
     p.add_argument("--asok", action="append",
-                   help="daemon admin socket(s) for trace tree")
+                   help="daemon admin socket(s) for trace tree / "
+                        "df / osd perf / iostat (mgr asok)")
     p.add_argument("words", nargs="+",
                    help="command, e.g.: status | health [detail] | "
                         "log last [N] | osd tree | "
                         "osd pool ls | osd pool create NAME | "
                         "osd out/in/down ID | osd dump | "
+                        "df --asok MGR | osd perf --asok MGR | "
+                        "iostat --asok MGR [--period N --count M] | "
                         "daemon ASOK CMD... | "
                         "trace tree TRACE_ID --asok PATH...")
+    p.add_argument("--period", type=float, default=1.0,
+                   help="iostat sampling window/interval, seconds")
+    p.add_argument("--count", type=int, default=1,
+                   help="iostat rows to print")
     p.add_argument("-s", "--size", type=int, default=None)
     p.add_argument("--pg-num", type=int, default=8)
     p.add_argument("--erasure", action="store_true")
@@ -167,6 +274,14 @@ def main(argv=None) -> int:
         return daemon_command(args.words[1:])   # no mon connection
     if args.words[:2] == ["trace", "tree"]:
         return trace_tree_command(args.words[2:], args.asok or [])
+    # telemetry surfaces: served by the mgr's admin socket, no mon
+    # connection needed
+    if args.words == ["df"]:
+        return df_command(args.asok or [])
+    if args.words == ["osd", "perf"]:
+        return osd_perf_command(args.asok or [])
+    if args.words == ["iostat"]:
+        return iostat_command(args.asok or [], args.period, args.count)
     client = connect(args)
     try:
         w = args.words

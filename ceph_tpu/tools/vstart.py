@@ -142,10 +142,18 @@ def main(argv=None) -> int:
 
     mgr = None
     if args.mgr:
-        mgr = MgrDaemon(monmap, Context(overrides, name="mgr"))
+        mgr_ctx = Context(overrides, name="mgr.x")
+        if args.asok_dir:
+            # the mgr asok is the `ceph df` / `osd perf` / `iostat` /
+            # `counter dump` operator surface
+            mgr_ctx.init_admin_socket(
+                os.path.join(args.asok_dir, "mgr.asok"))
+        mgr = MgrDaemon(monmap, mgr_ctx)
         mgr.init()
         for osd in osds:
             osd.mgr_addr = mgr.addr
+        for mon in mons:
+            mon.mgr_addr = mgr.addr
         sys.stdout.write("vstart: mgr up at %s\n" % (mgr.addr,))
 
     sys.stdout.write("vstart: cluster ready (monmap: %s)\n"

@@ -85,6 +85,8 @@ class MiniCluster:
         self.osds: dict[int, OSDDaemon] = {}
         self.clients = []
         self.num_osds = num_osds
+        self.mgr = None
+        self._mgr_asok_dir = None
 
     # -- lifecycle -----------------------------------------------------
 
@@ -122,14 +124,46 @@ class MiniCluster:
                                 name="osd.%d" % osd_id), store=store,
                         auth=auth)
         osd.init()
+        if self.mgr is not None:
+            osd.mgr_addr = self.mgr.addr
         self.osds[osd_id] = osd
         return osd
+
+    def start_mgr(self, modules=(), asok: bool = True):
+        """Boot an MgrDaemon and wire every daemon's telemetry stream
+        (mgr_addr) to it — osds, mons, mdss, and any started later.
+        With asok=True the mgr also serves its admin socket (the
+        `ceph df` / `osd perf` / `iostat` / `counter dump` surface)."""
+        from ceph_tpu.mgr import MgrDaemon
+        ctx = Context(self.conf_overrides, name="mgr.x")
+        if asok:
+            import tempfile
+            self._mgr_asok_dir = tempfile.mkdtemp(prefix="ceph-mgr-")
+            ctx.init_admin_socket(self._mgr_asok_dir + "/mgr.asok")
+        self.mgr = MgrDaemon(self.monmap, ctx)
+        self.mgr.init()
+        for cls in modules:
+            self.mgr.register_module(cls)
+        for osd in self.osds.values():
+            osd.mgr_addr = self.mgr.addr
+        for mon in self.mons:
+            mon.mgr_addr = self.mgr.addr
+        for mds in getattr(self, "mdss", {}).values():
+            mds.mgr_addr = self.mgr.addr
+        return self.mgr
+
+    @property
+    def mgr_asok(self) -> str | None:
+        return self._mgr_asok_dir + "/mgr.asok" \
+            if self._mgr_asok_dir else None
 
     def start_mds(self, name: str):
         from ceph_tpu.mds import MDSDaemon
         mds = MDSDaemon(name, self.monmap,
                         Context(self.conf_overrides,
                                 name="mds.%s" % name))
+        if self.mgr is not None:
+            mds.mgr_addr = self.mgr.addr
         mds.init()
         if not hasattr(self, "mdss"):
             self.mdss = {}
@@ -243,6 +277,13 @@ class MiniCluster:
         for mds in list(getattr(self, "mdss", {}).values()):
             mds.shutdown()
         getattr(self, "mdss", {}).clear()
+        if self.mgr is not None:
+            self.mgr.shutdown()
+            self.mgr = None
+        if self._mgr_asok_dir is not None:
+            import shutil
+            shutil.rmtree(self._mgr_asok_dir, ignore_errors=True)
+            self._mgr_asok_dir = None
         for osd in list(self.osds.values()):
             osd.shutdown()
         self.osds.clear()

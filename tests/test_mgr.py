@@ -82,8 +82,11 @@ def mgr_cluster():
     io = client.open_ioctx("mgrd")
     for i in range(5):
         io.write_full("obj%d" % i, b"x" * 1000)
+    # the mgr self-reports through the same pipeline, so count only
+    # the OSD reporters
     assert wait_until(
-        lambda: len(mgr.daemon_state.names(include_stale=False)) == 3,
+        lambda: sum(n.startswith("osd.") for n in
+                    mgr.daemon_state.names(include_stale=False)) == 3,
         timeout=10), "osd reports never arrived"
     assert wait_until(lambda: mgr.osdmap is not None, timeout=10)
     yield cluster, mgr

@@ -1,0 +1,625 @@
+"""Cluster telemetry pipeline (ISSUE 5).
+
+Unit coverage: MetricsAggregator rate / time-avg / percentile
+derivation against synthetic snapshots with exact expected values, df
+accounting math (replicated x size, EC x (k+m)/k), staleness aging,
+and the balancer's measured-speed backend selection.
+
+Live coverage (MiniCluster + MgrDaemon): every OSD/mon reports on the
+mgr_stats_period cadence; `ceph df` totals agree with store-level
+usage under EC write load; `ceph iostat` shows load and decays to ~0;
+`ceph osd perf` carries real latencies; a dead daemon's series age
+out of the Prometheus exposition; the mgr asok serves
+`counter dump`/`counter schema`/`df`/`osd perf`/`iostat` and the
+ceph_cli subcommands render them; a balancer run records measured
+native and device sweep timings and selects the faster backend.
+"""
+
+from __future__ import annotations
+
+import time
+import types
+
+import pytest
+
+from ceph_tpu.common.perf_counters import _HIST_BUCKETS
+from ceph_tpu.mgr import MetricsAggregator
+
+from .cluster_util import MiniCluster, wait_until
+
+FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+        "mon_osd_down_out_interval": 1.0,
+        "paxos_propose_interval": 0.02,
+        "mgr_stats_period": 0.2,
+        "mgr_stats_stale_after": 1.5,
+        "mgr_metrics_window": 3.0}
+
+
+# ---------------------------------------------------------------------------
+# unit: derivations over synthetic snapshots
+
+
+class TestRateDerivation:
+    def test_counter_rate_exact(self):
+        agg = MetricsAggregator(stale_after=100.0, window=100.0)
+        agg.record("osd.0", {"osd": {"op": 100, "op_in_bytes": 0}},
+                   now=0.0)
+        agg.record("osd.0", {"osd": {"op": 400, "op_in_bytes": 2000}},
+                   now=2.0)
+        assert agg.rate("osd.0", "osd", "op", now=2.0) == 150.0
+        assert agg.rate("osd.0", "osd", "op_in_bytes",
+                        now=2.0) == 1000.0
+        # unknown counter / daemon derive 0, never raise
+        assert agg.rate("osd.0", "osd", "nope", now=2.0) == 0.0
+        assert agg.rate("osd.9", "osd", "op", now=2.0) == 0.0
+
+    def test_rate_respects_window(self):
+        agg = MetricsAggregator(stale_after=1000.0, window=100.0)
+        agg.record("osd.0", {"osd": {"op": 0}}, now=0.0)
+        agg.record("osd.0", {"osd": {"op": 1000}}, now=10.0)
+        agg.record("osd.0", {"osd": {"op": 1000}}, now=11.0)
+        agg.record("osd.0", {"osd": {"op": 1000}}, now=12.0)
+        # the narrow window sees only the post-burst plateau
+        assert agg.rate("osd.0", "osd", "op", window=2.5,
+                        now=12.0) == 0.0
+        assert agg.rate("osd.0", "osd", "op", window=100.0,
+                        now=12.0) > 0
+
+    def test_stale_daemon_derives_nothing(self):
+        agg = MetricsAggregator(stale_after=5.0, window=1000.0)
+        agg.record("osd.0", {"osd": {"op": 0}}, now=0.0)
+        agg.record("osd.0", {"osd": {"op": 100}}, now=1.0)
+        assert agg.rate("osd.0", "osd", "op", now=2.0) == 100.0
+        assert agg.rate("osd.0", "osd", "op", now=50.0) == 0.0
+        assert agg.daemons(now=2.0) == ["osd.0"]
+        assert agg.daemons(now=50.0) == []
+        assert agg.daemons(include_stale=True, now=50.0) == ["osd.0"]
+
+    def test_time_avg_windowed_vs_lifetime(self):
+        agg = MetricsAggregator(stale_after=100.0, window=100.0)
+        agg.record("osd.0", {"osd": {"lat": {"avgcount": 10,
+                                             "sum": 1.0}}}, now=0.0)
+        agg.record("osd.0", {"osd": {"lat": {"avgcount": 20,
+                                             "sum": 3.0}}}, now=1.0)
+        # windowed: (3.0 - 1.0) / (20 - 10) = 0.2 (recent), not the
+        # lifetime 3.0/20 = 0.15
+        assert agg.time_avg("osd.0", "osd", "lat",
+                            now=1.0) == pytest.approx(0.2)
+        # no new samples in the window -> lifetime average fallback
+        agg.record("osd.0", {"osd": {"lat": {"avgcount": 20,
+                                             "sum": 3.0}}}, now=2.0)
+        assert agg.time_avg("osd.0", "osd", "lat", window=1.5,
+                            now=2.0) == pytest.approx(0.15)
+
+    def test_prune_forgets_long_dead(self):
+        agg = MetricsAggregator(stale_after=1.0)
+        agg.record("osd.0", {"osd": {}}, now=0.0)
+        assert agg.prune(now=5.0) == []        # stale but remembered
+        assert agg.prune(now=50.0) == ["osd.0"]
+        assert agg.daemons(include_stale=True, now=50.0) == []
+
+
+class TestPercentiles:
+    def _agg_with_hist(self, fills: dict):
+        """fills: bucket index -> count, riding default power-of-two
+        bounds (bucket i covers (bound[i-1], bound[i]], bucket 0 from
+        0; the trailing bucket is overflow)."""
+        buckets = [0] * (len(_HIST_BUCKETS) + 1)
+        for i, n in fills.items():
+            buckets[i] = n
+        agg = MetricsAggregator(stale_after=100.0)
+        agg.record("osd.0", {"osd": {"h": {
+            "count": sum(buckets), "sum": 0,
+            "buckets": buckets}}}, now=0.0)
+        return agg
+
+    def test_single_bucket_interpolation(self):
+        # 100 samples in bucket 2 = (4, 8]: uniform-mass interpolation
+        agg = self._agg_with_hist({2: 100})
+        p = agg.percentiles("osd.0", "osd", "h", qs=(0.5, 0.99),
+                            now=0.0)
+        assert p[0.5] == pytest.approx(4 + 4 * 0.5)     # 6.0
+        assert p[0.99] == pytest.approx(4 + 4 * 0.99)   # 7.96
+
+    def test_two_bucket_split(self):
+        # 50 in (0,2], 50 in (2,4]
+        agg = self._agg_with_hist({0: 50, 1: 50})
+        p = agg.percentiles("osd.0", "osd", "h",
+                            qs=(0.5, 0.95), now=0.0)
+        assert p[0.5] == pytest.approx(2.0)
+        assert p[0.95] == pytest.approx(2 + 2 * (95 - 50) / 50)  # 3.8
+
+    def test_overflow_bucket_reports_top_bound(self):
+        agg = self._agg_with_hist({len(_HIST_BUCKETS): 10})
+        p = agg.percentiles("osd.0", "osd", "h", qs=(0.5,), now=0.0)
+        assert p[0.5] == float(_HIST_BUCKETS[-1])
+
+    def test_empty_histogram(self):
+        agg = self._agg_with_hist({})
+        assert agg.percentiles("osd.0", "osd", "h",
+                               now=0.0) == {0.5: 0.0, 0.95: 0.0,
+                                            0.99: 0.0}
+
+    def test_windowed_delta_percentile(self):
+        """With a window the fills are the DELTA between endpoints:
+        the early slow samples must not pollute the recent view."""
+        agg = MetricsAggregator(stale_after=100.0)
+        slow = [0] * (len(_HIST_BUCKETS) + 1)
+        slow[10] = 100                         # (512, 1024]
+        agg.record("osd.0", {"osd": {"h": {"buckets": list(slow)}}},
+                   now=0.0)
+        both = list(slow)
+        both[0] = 100                          # plus 100 fast in (0,2]
+        agg.record("osd.0", {"osd": {"h": {"buckets": both}}},
+                   now=1.0)
+        p = agg.percentiles("osd.0", "osd", "h", qs=(0.99,),
+                            window=10.0, now=1.0)
+        assert p[0.99] <= 2.0                  # only the fast delta
+
+    def test_real_perf_counters_round_trip(self):
+        """hinc -> dump -> record -> percentile stays inside the
+        sample's bucket bounds."""
+        from ceph_tpu.common.perf_counters import PerfCountersBuilder
+        pc = (PerfCountersBuilder("osd")
+              .add_histogram("h").create_perf_counters())
+        for v in (3, 3, 3, 100, 100):
+            pc.hinc("h", v)
+        agg = MetricsAggregator(stale_after=100.0)
+        agg.record("osd.0", {"osd": pc.dump()}, now=0.0)
+        p = agg.percentiles("osd.0", "osd", "h", qs=(0.5,), now=0.0)
+        assert 2.0 < p[0.5] <= 4.0             # 3 lives in (2, 4]
+
+
+class TestDfMath:
+    def _osdmap(self):
+        from ceph_tpu.osd.osd_map import OSDMap, PGPool
+        m = OSDMap()
+        m.pools[1] = PGPool(1, "repl", size=3, pg_num=4)
+        m.pools[2] = PGPool(2, "ec", type=3, size=3, pg_num=4,
+                            erasure_code_profile="p")
+        m.ec_profiles["p"] = {"k": "2", "m": "1"}
+        return m
+
+    def test_replicated_and_ec_accounting(self):
+        agg = MetricsAggregator(stale_after=100.0)
+        agg.record("osd.0", {},
+                   status={"statfs": {"total": 10 ** 9,
+                                      "used": 5000}},
+                   pg_stats={"1.0": {"pool": 1, "objects": 3,
+                                     "bytes": 1000},
+                             "2.0": {"pool": 2, "objects": 2,
+                                     "bytes": 500}},
+                   now=0.0)
+        agg.record("osd.1", {},
+                   status={"statfs": {"total": 10 ** 9,
+                                      "used": 7000}},
+                   pg_stats={"1.1": {"pool": 1, "objects": 1,
+                                     "bytes": 2000}},
+                   now=0.0)
+        df = agg.df(self._osdmap(), now=0.0)
+        repl = df["pools"][1]
+        assert repl["name"] == "repl"
+        assert repl["objects"] == 4
+        assert repl["stored"] == 3000
+        assert repl["raw_used"] == 9000        # x size 3
+        ec = df["pools"][2]
+        assert ec["stored"] == 1000            # shard x k (2)
+        assert ec["raw_used"] == 1500          # shard x (k+m) (3)
+        assert df["total_bytes"] == 2 * 10 ** 9
+        assert df["used_bytes"] == 12000
+        assert ec["percent_used"] == pytest.approx(1500 / 2e9)
+
+    def test_newest_report_wins_per_pg(self):
+        """A PG whose primary moved is reported by two OSDs for a
+        while; df must not double count it."""
+        agg = MetricsAggregator(stale_after=100.0)
+        agg.record("osd.0", {}, pg_stats={
+            "1.0": {"pool": 1, "objects": 5, "bytes": 100}}, now=0.0)
+        agg.record("osd.1", {}, pg_stats={
+            "1.0": {"pool": 1, "objects": 7, "bytes": 200}}, now=1.0)
+        df = agg.df(self._osdmap(), now=1.0)
+        assert df["pools"][1]["objects"] == 7
+        assert df["pools"][1]["stored"] == 200
+
+    def test_stale_reporter_excluded(self):
+        agg = MetricsAggregator(stale_after=1.0)
+        agg.record("osd.0", {},
+                   status={"statfs": {"total": 100, "used": 10}},
+                   pg_stats={"1.0": {"pool": 1, "objects": 1,
+                                     "bytes": 50}}, now=0.0)
+        df = agg.df(self._osdmap(), now=0.5)
+        assert df["pools"] and df["total_bytes"] == 100
+        df = agg.df(self._osdmap(), now=10.0)
+        assert df["pools"] == {} and df["total_bytes"] == 0
+
+
+class TestBalancerBackendSelection:
+    def _module(self):
+        from ceph_tpu.mgr.modules import BalancerModule
+        mgr = types.SimpleNamespace(metrics=MetricsAggregator())
+        return BalancerModule(mgr), mgr
+
+    def test_medians_pick_the_faster_backend(self):
+        bal, mgr = self._module()
+        bal.sweep_samples["native"] = [0.010, 0.012, 0.011]
+        bal.sweep_samples["device"] = [0.500, 0.700, 0.600]
+        assert bal.pick_backend(None) is False     # native wins
+        bal.sweep_samples["device"] = [0.001, 0.002, 0.003]
+        assert bal.pick_backend(None) is True      # device wins
+        med = bal.sweep_medians()
+        assert med["native"] == pytest.approx(0.011)
+        assert med["device"] == pytest.approx(0.002)
+
+    def test_probe_measures_and_records(self):
+        """With no samples, pick_backend times one real sweep per
+        backend and lands the timings in the telemetry store."""
+        from ceph_tpu.osd.osd_map import OSDMap, PGPool
+        from ceph_tpu.crush.map import CrushMap, weight_fixed
+        m = OSDMap()
+        m.set_max_osd(3)
+        cm = CrushMap()
+        cm.type_names.update({"osd": 0, "root": 1})
+        cm.add_bucket("straw2", 1, [0, 1, 2],
+                      [weight_fixed(1.0)] * 3, name="default")
+        cm.add_simple_rule("r", "default")
+        m.crush = cm
+        for o in range(3):
+            m.osd_exists[o] = True
+            m.osd_up[o] = True
+            m.osd_weight[o] = 0x10000
+        m.pools[1] = PGPool(1, "p", size=2, pg_num=4, crush_rule=0)
+        bal, mgr = self._module()
+        bal.min_speed_samples = 1
+        bal.pick_backend(m)
+        assert len(bal.sweep_samples["native"]) == 1
+        assert len(bal.sweep_samples["device"]) == 1
+        assert mgr.metrics.values("balancer_sweep_native")
+        # the device probe either measured (timing recorded) or is
+        # marked unusable in this environment (inf sample) — never a
+        # crashed round
+        assert mgr.metrics.values("balancer_sweep_device") or \
+            bal.sweep_samples["device"][0] == float("inf")
+        assert isinstance(bal.use_device, bool)
+
+
+class TestDeviceGauges:
+    def test_dispatcher_telemetry(self):
+        import numpy as np
+
+        from ceph_tpu.osd.tpu_dispatch import TpuDispatcher
+
+        class Codec:
+            def encode_batch(self, b):
+                return b
+
+            def get_data_chunk_count(self):
+                return 2
+
+            def get_chunk_count(self):
+                return 3
+
+        d = TpuDispatcher(max_batch=4, max_delay=0.0005)
+        try:
+            batch = np.zeros((2, 2, 4096), dtype=np.uint8)
+            for _ in range(3):
+                d.encode(Codec(), batch)
+            t = d.telemetry()
+            assert t["ops"] == 3 and t["dispatches"] >= 1
+            assert 0 < t["coalesce_ratio"] <= 1.0
+            row = t["codecs"]["Codec_k2m1"]
+            assert row["enc_bytes"] == 3 * batch.nbytes
+            assert row["enc_MBps"] > 0
+            dump = d.perf.dump()
+            assert dump["l_tpu_enc_bytes"] == 3 * batch.nbytes
+            assert "l_tpu_queue_depth" in dump
+        finally:
+            d.shutdown()
+
+    def test_hbm_tier_gauges(self):
+        import numpy as np
+
+        from ceph_tpu import registry
+        from ceph_tpu.osd.hbm_tier import HbmChunkTier
+        codec = registry.factory(
+            "jax_tpu", {"technique": "reed_sol_van", "k": "2",
+                        "m": "1", "w": "8"})
+        n = codec.get_chunk_size(4096)
+        tier = HbmChunkTier(codec, capacity_objects=2)
+        data = np.zeros((2, 2, n), dtype=np.uint8)
+        tier.put_encode(["a", "b"], data)
+        st = tier.stats()
+        assert st["resident_objects"] == 2
+        assert st["resident_bytes"] == 2 * 3 * n
+        assert tier.get("a") is not None and tier.get("zz") is None
+        st = tier.stats()
+        assert st["hits"] == 1 and st["misses"] == 1
+        # over-capacity insert evicts LRU
+        tier.put_encode(["c"], np.zeros((1, 2, n), dtype=np.uint8))
+        assert tier.stats()["evictions"] >= 1
+        dump = tier.perf.dump()
+        assert dump["l_hbm_resident_objects"] == \
+            tier.stats()["resident_objects"]
+
+
+class TestBenchSnapshot:
+    def test_perf_snapshot_shape(self):
+        import bench
+
+        from ceph_tpu import registry
+        codec = registry.factory(
+            "jax_tpu", {"technique": "reed_sol_van", "k": "2",
+                        "m": "1", "w": "8"})
+        snap = bench.perf_snapshot(codecs={"rs": codec},
+                                   extra={"round": 6})
+        assert snap["platform"] in ("cpu", "tpu")
+        assert snap["device_count"] >= 1
+        assert "jax_version" in snap and snap["round"] == 6
+        assert "rs" in snap.get("table_cache", {})
+        tc = snap["table_cache"]["rs"]
+        assert {"hits", "misses"} <= set(tc)
+
+
+# ---------------------------------------------------------------------------
+# live cluster: the full pipeline
+
+
+OBJ = 1 << 14          # 16 KiB objects
+N_OBJS = 12
+
+
+@pytest.fixture(scope="module")
+def telemetry_cluster():
+    cluster = MiniCluster(num_mons=1, num_osds=3,
+                          conf_overrides=FAST).start()
+    mgr = cluster.start_mgr()
+    client = cluster.client()
+    pool_id = cluster.create_ec_pool(
+        client, "teledata",
+        {"plugin": "jerasure", "technique": "reed_sol_van",
+         "k": "2", "m": "1", "w": "8"}, pg_num=8)
+    assert cluster.wait_clean(pool_id)
+    io = client.open_ioctx("teledata")
+    payload = b"\xab" * OBJ
+    for i in range(N_OBJS):
+        io.write_full("obj%d" % i, payload)
+    assert wait_until(
+        lambda: {"osd.0", "osd.1", "osd.2"} <=
+        set(mgr.metrics.daemons()), timeout=10), \
+        "osd telemetry reports never arrived"
+    assert wait_until(lambda: mgr.osdmap is not None, timeout=10)
+    yield cluster, mgr, client, io
+    cluster.stop()
+
+
+class TestLiveTelemetry:
+    def test_df_matches_store_usage(self, telemetry_cluster):
+        cluster, mgr, client, io = telemetry_cluster
+
+        # every object landed: 2 data + 1 parity shard, each OBJ/2
+        expect_stored = N_OBJS * OBJ
+        expect_raw = N_OBJS * OBJ * 3 // 2     # (k+m)/k overhead
+
+        def df_settled():
+            df = mgr.metrics.df(mgr.osdmap)
+            row = next((r for r in df["pools"].values()
+                        if r["name"] == "teledata"), None)
+            return row is not None and \
+                row["objects"] >= N_OBJS and \
+                row["stored"] >= expect_stored
+        assert wait_until(df_settled, timeout=15), \
+            mgr.metrics.df(mgr.osdmap)
+
+        df = mgr.metrics.df(mgr.osdmap)
+        row = next(r for r in df["pools"].values()
+                   if r["name"] == "teledata")
+        # stored is the logical byte count; EC raw-used includes the
+        # (k+m)/k parity overhead
+        assert row["stored"] == pytest.approx(expect_stored, rel=0.02)
+        assert row["raw_used"] == pytest.approx(expect_raw, rel=0.02)
+        # cross-check against ACTUAL store usage: what the three mem
+        # stores hold (pg meta rides the tolerance)
+        store_used = sum(osd.store.statfs()["used"]
+                         for osd in cluster.osds.values())
+        total_raw = sum(r["raw_used"] for r in df["pools"].values())
+        assert total_raw == pytest.approx(store_used,
+                                          rel=0.10, abs=64 << 10)
+        # and the mgr-side capacity totals come from the same statfs
+        assert df["used_bytes"] == pytest.approx(store_used,
+                                                 rel=0.10,
+                                                 abs=64 << 10)
+        assert 0 < row["percent_used"] < 1
+
+    def test_iostat_under_load_then_idle(self, telemetry_cluster):
+        cluster, mgr, client, io = telemetry_cluster
+        stop = [False]
+
+        def pound():
+            i = 0
+            while not stop[0]:
+                io.write_full("io-load", b"\xcd" * OBJ)
+                i += 1
+        import threading
+        t = threading.Thread(target=pound, daemon=True)
+        t.start()
+        try:
+            assert wait_until(
+                lambda: mgr.metrics.iostat(
+                    window=2.0)["write_op_per_sec"] > 0,
+                timeout=10), mgr.metrics.iostat()
+            busy = mgr.metrics.iostat(window=2.0)
+            assert busy["write_MBps"] > 0
+        finally:
+            stop[0] = True
+            t.join()
+
+        # rates decay to ~0 once the load stops and the window rolls
+        def idle():
+            row = mgr.metrics.iostat(window=1.0)
+            return row["write_op_per_sec"] < 0.5 and \
+                row["write_MBps"] < 0.05
+        assert wait_until(idle, timeout=15), mgr.metrics.iostat()
+
+    def test_osd_perf_reports_latencies(self, telemetry_cluster):
+        cluster, mgr, client, io = telemetry_cluster
+        for i in range(4):
+            io.write_full("perfobj%d" % i, b"\x01" * OBJ)
+
+        def has_latency():
+            table = mgr.metrics.osd_perf(window=60.0)
+            return any(r["commit_latency_ms"] > 0
+                       for r in table.values())
+        assert wait_until(has_latency, timeout=10), \
+            mgr.metrics.osd_perf(window=60.0)
+        table = mgr.metrics.osd_perf(window=60.0)
+        assert set(table) <= {"osd.0", "osd.1", "osd.2"}
+        for row in table.values():
+            assert row["commit_latency_ms"] >= row["apply_latency_ms"]
+
+    def test_reports_carry_status_schema_and_mon(self,
+                                                telemetry_cluster):
+        cluster, mgr, client, io = telemetry_cluster
+        st = mgr.metrics.status("osd.0")
+        assert st.get("statfs", {}).get("total", 0) > 0
+        assert "tpu" in st        # dispatcher gauges ride the report
+        sch = mgr.metrics.schema("osd.0")
+        assert sch.get("osd", {}).get(
+            "l_osd_op_trace_us", {}).get("type") == "histogram"
+        assert sch["osd"]["l_osd_op_trace_us"]["buckets"]
+        # the mon leg: paxos/commands counters stream the same way
+        assert wait_until(
+            lambda: "mon.0" in mgr.metrics.daemons(), timeout=10)
+        assert wait_until(
+            lambda: mgr.metrics.latest("mon.0").get("mon", {}).get(
+                "paxos_commits", 0) > 0, timeout=10)
+
+    def test_derived_op_rate_and_percentiles_live(self,
+                                                  telemetry_cluster):
+        cluster, mgr, client, io = telemetry_cluster
+        for i in range(8):
+            io.write_full("rateobj%d" % i, b"\x02" * OBJ)
+
+        def moving():
+            return mgr.metrics.cluster_rate("osd", "op_w",
+                                            window=3.0) > 0
+        assert wait_until(moving, timeout=10)
+        # the op-latency histogram accumulated samples -> percentiles
+        # are derivable and ordered
+        primary = max(
+            mgr.metrics.daemons(),
+            key=lambda d: (mgr.metrics.latest(d).get("osd", {})
+                           .get("op_w", 0) or 0)
+            if d.startswith("osd.") else -1)
+        p = mgr.metrics.percentiles(primary, "osd",
+                                    "l_osd_op_trace_us")
+        assert p[0.5] <= p[0.95] <= p[0.99]
+        assert p[0.99] > 0
+
+    def test_mgr_asok_counter_dump_and_views(self, telemetry_cluster):
+        cluster, mgr, client, io = telemetry_cluster
+        from ceph_tpu.common.admin_socket import AdminSocketClient
+        asok = AdminSocketClient(cluster.mgr_asok)
+        dump = asok.do_request("counter dump")
+        assert any(d.startswith("osd.") for d in dump)
+        osd0 = dump["osd.0"]
+        assert "op" in osd0["perf"]["osd"]
+        assert "statfs" in osd0["status"]
+        schema = asok.do_request("counter schema")
+        assert schema["osd.0"]["osd"]["op"]["type"] == "u64_counter"
+        df = asok.do_request("df")
+        assert "pools" in df and df["total_bytes"] > 0
+        perf = asok.do_request("osd perf")
+        assert "osd.0" in perf
+        io_row = asok.do_request("iostat", window=5.0)
+        assert {"read_op_per_sec", "write_MBps"} <= set(io_row)
+
+    def test_cli_df_osd_perf_iostat(self, telemetry_cluster, capsys):
+        cluster, mgr, client, io = telemetry_cluster
+        from ceph_tpu.tools import ceph_cli
+        assert ceph_cli.main(["--asok", cluster.mgr_asok, "df"]) == 0
+        out = capsys.readouterr().out
+        assert "RAW STORAGE" in out and "teledata" in out
+        assert ceph_cli.main(
+            ["--asok", cluster.mgr_asok, "osd", "perf"]) == 0
+        out = capsys.readouterr().out
+        assert "commit_latency(ms)" in out and "osd.0" in out
+        assert ceph_cli.main(
+            ["--asok", cluster.mgr_asok, "iostat",
+             "--period", "0.2", "--count", "2"]) == 0
+        out = capsys.readouterr().out
+        assert out.count("\n") == 3      # header + 2 rows
+        # missing asok is a usage error, not a crash
+        assert ceph_cli.main(["df"]) == 1
+
+    def test_prometheus_pool_and_rate_series(self, telemetry_cluster):
+        cluster, mgr, client, io = telemetry_cluster
+        from ceph_tpu.mgr import PrometheusModule
+        prom = mgr.modules.get("prometheus") or \
+            mgr.register_module(PrometheusModule)
+        text = prom.render()
+        assert 'ceph_pool_stored_bytes{name="teledata"' in text
+        assert 'ceph_pool_raw_used_bytes{name="teledata"' in text
+        assert "ceph_cluster_total_bytes" in text
+        assert "ceph_cluster_write_op_per_sec" in text
+        assert 'ceph_osd_op_w_rate{ceph_daemon="osd.0"}' in text
+        assert 'ceph_tpu_dispatch_queue_depth{ceph_daemon="osd.0"}' \
+            in text
+        assert "ceph_tpu_codec_encode_MBps" in text   # codec label leg
+
+    def test_balancer_records_and_selects_backend(self,
+                                                  telemetry_cluster):
+        cluster, mgr, client, io = telemetry_cluster
+        from ceph_tpu.mgr import BalancerModule
+        bal = mgr.modules.get("balancer") or \
+            mgr.register_module(BalancerModule)
+        rc, out, _ = mgr.module_command({"prefix": "balancer optimize"})
+        assert rc == 0
+        # both backends were measured, the decision came from the
+        # medians, and the timings landed in the telemetry store
+        assert len(bal.sweep_samples["native"]) >= \
+            bal.min_speed_samples
+        assert len(bal.sweep_samples["device"]) >= \
+            bal.min_speed_samples
+        assert isinstance(bal.use_device, bool)
+        med = bal.sweep_medians()
+        assert med["native"] is not None and med["device"] is not None
+        nat = bal._median(bal.sweep_samples["native"])
+        dev = bal._median(bal.sweep_samples["device"])
+        faster = "device" if dev < nat else "native"
+        assert bal.use_device == (faster == "device")
+        assert bal.last_optimize["backend"] == faster
+        assert mgr.metrics.values("balancer_sweep_native")
+        # device timings recorded when the backend works here;
+        # otherwise it was measured-as-unusable (inf) and skipped
+        assert mgr.metrics.values("balancer_sweep_device") or \
+            dev == float("inf")
+        rc, _, data = mgr.module_command({"prefix": "balancer status"})
+        assert rc == 0 and data["use_device"] == bal.use_device
+
+    def test_stale_daemon_ages_out_of_prometheus(self,
+                                                 telemetry_cluster):
+        """Acceptance: a dead daemon's series DISAPPEAR from the
+        exposition after stale_after instead of flatlining forever.
+        Runs last in the class — it kills osd.2."""
+        cluster, mgr, client, io = telemetry_cluster
+        from ceph_tpu.mgr import PrometheusModule
+        prom = mgr.modules.get("prometheus") or \
+            mgr.register_module(PrometheusModule)
+        assert wait_until(
+            lambda: 'ceph_osd_osd_op{ceph_daemon="osd.2"}'
+            in prom.render(), timeout=10)
+        store = cluster.stop_osd(2)
+        assert store is not None
+
+        def aged_out():
+            text = prom.render()
+            # perf/derived series vanish (the osdmap-level up/in
+            # gauges legitimately keep exporting the down state)
+            return 'ceph_osd_osd_op{ceph_daemon="osd.2"}' \
+                not in text and \
+                'ceph_osd_op_w_rate{ceph_daemon="osd.2"}' \
+                not in text and \
+                "osd.2" not in mgr.metrics.daemons()
+        assert wait_until(aged_out, timeout=15)
+        # the survivors keep reporting
+        assert 'ceph_osd_osd_op{ceph_daemon="osd.0"}' \
+            in prom.render()
