@@ -103,6 +103,27 @@ def _declare_defaults():
       "max ops fused into one device dispatch")
     o("osd_tpu_coalesce_max_delay_ms", float, 1.0, LEVEL_ADVANCED,
       "max milliseconds an op waits for batch-mates before dispatch")
+    o("osd_tpu_pipeline_depth", int, 2, LEVEL_ADVANCED,
+      "fused batches in flight per dispatcher pipeline stage: h2d of "
+      "batch n+1 overlaps compute of n and d2h of n-1 "
+      "(osd/tpu_dispatch.py staging ring); 1 = the legacy synchronous "
+      "coalesce-then-block loop")
+    o("osd_hbm_tier_enable", bool, True, LEVEL_ADVANCED,
+      "retain EC encode results device-resident in the HbmChunkTier "
+      "keyed by (pg, object): scrub-repair rebuilds and recovery "
+      "reconstruction read the resident copy instead of re-crossing "
+      "PCIe (osd/hbm_tier.py; ROADMAP direction A)")
+    o("osd_hbm_tier_capacity", int, 64, LEVEL_ADVANCED,
+      "objects the HBM chunk tier keeps resident; inserts beyond it "
+      "evict LRU (an evicted object pays h2d again on its next "
+      "repair/recovery, exactly like any cache)")
+    o("osd_hbm_tier_serve_reads", bool, False, LEVEL_ADVANCED,
+      "serve whole-object EC client reads from the resident copy "
+      "(zero sub-reads, zero decode). Default off: residency masks "
+      "store-level fault injection and removes the sub_read/ec_decode "
+      "spans observability tooling keys on, so reads-from-HBM is an "
+      "explicit opt-in (scrub/recovery residency hits ride "
+      "osd_hbm_tier_enable alone)")
     o("osd_op_history_size", int, 20, LEVEL_ADVANCED,
       "completed ops kept for dump_historic_ops")
     o("osd_op_history_duration", float, 600.0, LEVEL_ADVANCED,

@@ -120,7 +120,8 @@ def generate_transactions(plan: WritePlan, codec,
                           partial_extents: dict,
                           shards: list,
                           cid_of, dispatcher=None,
-                          trace=None) -> tuple[dict, dict]:
+                          trace=None, tier=None,
+                          tier_prefix=None) -> tuple[dict, dict]:
     """Build {shard: Transaction} from the plan + readback data.
 
     partial_extents: oid -> ExtentMap with the to_read stripes filled
@@ -128,12 +129,23 @@ def generate_transactions(plan: WritePlan, codec,
     collection. Returns (transactions, written) where written maps
     oid -> ExtentMap of the logical bytes this op wrote (fed back into
     the ExtentCache, mirroring generate_transactions' `written` out-param).
+
+    tier/tier_prefix wire the HbmChunkTier: EVERY mutation of an
+    object first invalidates its resident entry (a stale resident copy
+    must never serve a later scrub/recovery/read), and a whole-object
+    write re-adopts the encode device-side through the dispatcher
+    pipeline — partial RMWs stay host-planned and simply leave the
+    object non-resident until its next full write.
     """
     txns = {shard: Transaction() for shard in shards}
     written: dict = {}
     n = codec.get_chunk_count()
 
     for oid, op in plan.t.safe_create_traverse():
+        tier_key = None
+        if tier is not None:
+            tier_key = (tier_prefix, oid)
+            tier.drop(tier_key)        # any mutation invalidates
         hinfo = plan.hash_infos[oid]
 
         if op.deletes_first():
@@ -157,7 +169,17 @@ def generate_transactions(plan: WritePlan, codec,
             pex = partial_extents.get(oid)
             wmap = written.setdefault(oid, {})
             appends = {}
-            for off, length in will_write:
+            extents = list(will_write)
+            # residency: only a single extent covering the whole
+            # (projected) object is adopted — its encode IS the full
+            # chunk set, so the resident copy can serve any later
+            # scrub digest, shard rebuild or whole-object read
+            whole_object = (
+                tier_key is not None and len(extents) == 1
+                and extents[0][0] == 0 and extents[0][1] > 0
+                and extents[0][1] ==
+                hinfo.get_projected_total_logical_size(sinfo))
+            for off, length in extents:
                 # assemble the logical bytes for this extent: readback
                 # stripes overlaid with the op's buffer updates,
                 # zero-filled elsewhere
@@ -184,9 +206,11 @@ def generate_transactions(plan: WritePlan, codec,
                     if lo < hi:
                         buf[lo - off:hi - off] = data[lo - uoff:hi - uoff]
 
-                encoded = ec_util.encode(sinfo, codec, buf,
-                                         dispatcher=dispatcher,
-                                         trace=trace)
+                encoded = ec_util.encode(
+                    sinfo, codec, buf, dispatcher=dispatcher,
+                    trace=trace,
+                    resident=(tier, tier_key) if whole_object
+                    else None)
                 chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(off)
                 for shard in range(n):
                     if shard in txns:

@@ -78,7 +78,7 @@ class StripeInfo:
 
 
 def encode(sinfo: StripeInfo, codec, data, want=None,
-           dispatcher=None, trace=None) -> dict:
+           dispatcher=None, trace=None, resident=None) -> dict:
     """Encode a stripe-aligned payload -> {shard: chunk bytes}.
 
     data: bytes/uint8 array whose length is a multiple of stripe_width.
@@ -86,6 +86,12 @@ def encode(sinfo: StripeInfo, codec, data, want=None,
     per-stripe loop). Returns every shard unless `want` restricts it.
     With a dispatcher (osd/tpu_dispatch.py), concurrent callers sharing
     this codec coalesce into one fused device call.
+
+    resident=(tier, key) retains the encode device-side in the
+    HbmChunkTier: through the dispatcher the pipeline adopts the
+    STAGED device arrays (zero extra transfers); without one the tier
+    adopts the host arrays itself (that h2d is then the object's one
+    crossing).
     """
     arr = np.frombuffer(data, dtype=np.uint8) if isinstance(
         data, (bytes, bytearray, memoryview)) else \
@@ -102,9 +108,16 @@ def encode(sinfo: StripeInfo, codec, data, want=None,
     # [S, k, chunk]: stripes become the device batch dimension
     batch = arr.reshape(stripes, k, sinfo.chunk_size)
     if dispatcher is not None:
-        parity = np.asarray(dispatcher.encode(codec, batch, trace=trace))
+        parity = np.asarray(dispatcher.encode(codec, batch, trace=trace,
+                                              resident=resident))
     else:
         parity = np.asarray(codec.encode_batch(batch))
+        if resident is not None:
+            tier, key = resident
+            try:
+                tier.adopt_encode(key, batch, parity, codec)
+            except Exception:
+                pass   # the tier is a cache: adoption never fails a write
     out = {}
     for i in range(n):
         idx = codec.chunk_index(i)

@@ -10,9 +10,21 @@ shards; PGBackend::be_deep_scrub re-reads and re-digests); here the
 host pays one H2D per object lifetime and tiny D2H for results
 (digests are 8 bytes/chunk; recovery returns only the rebuilt shard).
 
+Wired into the OSD (osd_daemon.py, osd_hbm_tier_enable): the
+TpuDispatcher's pipeline ADOPTS each encode's staged data + computed
+parity device-side (adopt_encode — zero extra transfers), keyed by
+(pg, object); ECBackend recovery reconstruction, scrub repair
+rebuilds, and (opt-in) repeat client reads then hit the resident copy
+instead of re-crossing PCIe. Entries carry their codec, so one
+OSD-wide tier serves every EC pool the daemon hosts. Any mutation of
+an object invalidates its entry; a PG interval change (new acting
+set) drops the whole PG's entries — a stale resident copy must never
+survive a primaryship hand-off.
+
 Capacity is bounded (HBM is small): inserts evict LRU objects — an
 evicted object simply pays H2D again on its next op, exactly like any
-cache.
+cache.  Residency/utilization rides the l_hbm_* counters (telemetry
+report + the `hbm status` asok command).
 
 Digest: a vectorized Fletcher-style pair (sum, index-weighted sum)
 over the chunk bytes, both mod 2^32.  Scrub only ever compares
@@ -73,25 +85,29 @@ class _Batch:
     gather (dozens of transport round trips on a tunneled device);
     per-batch arrays make it one take per batch."""
 
-    __slots__ = ("arr", "live")
+    __slots__ = ("arr", "live", "codec", "obj_bytes")
 
-    def __init__(self, arr, live: int):
+    def __init__(self, arr, live: int, codec=None, obj_bytes: int = 0):
         self.arr = arr
         self.live = live
+        self.codec = codec
+        self.obj_bytes = obj_bytes
 
 
 class HbmChunkTier:
     """Keyed store of device-resident chunk arrays [k+m, chunk] with
-    fused device programs for the consumers."""
+    fused device programs for the consumers.  `codec` is the default
+    for put_encode; entries adopted from the dispatcher carry their
+    own codec, so one tier serves heterogeneous pools."""
 
-    def __init__(self, codec, capacity_objects: int = 64):
+    def __init__(self, codec=None, capacity_objects: int = 64):
         _init_device_digest()
         self.codec = codec
         self.capacity = capacity_objects
         self._lock = threading.Lock()
         self._objs: dict = {}          # name -> (_Batch, row index)
         self._order: list = []         # LRU, oldest first
-        self._obj_bytes = 0            # per-object [k+m, n] footprint
+        self._resident_bytes = 0
         # residency/utilization gauges (telemetry pipeline: the OSD
         # report's status bag + an optional ctx.perf registration)
         from ..common.perf_counters import PerfCountersBuilder
@@ -106,6 +122,9 @@ class HbmChunkTier:
                                       "lookups that missed residency")
                      .add_u64_counter("l_hbm_evictions",
                                       "objects evicted over capacity")
+                     .add_u64_counter("l_hbm_adopted",
+                                      "encodes adopted device-side "
+                                      "from the dispatcher pipeline")
                      .create_perf_counters())
 
     # -- residency -----------------------------------------------------
@@ -119,6 +138,7 @@ class HbmChunkTier:
         ent = self._objs.pop(name, None)
         if ent is not None:
             ent[0].live -= 1
+            self._resident_bytes -= ent[0].obj_bytes
             # HBM frees at batch granularity: the array goes when its
             # LAST object is evicted (documented coarseness)
             if ent[0].live <= 0:
@@ -133,29 +153,60 @@ class HbmChunkTier:
 
     def _update_gauges_locked(self) -> None:
         self.perf.set("l_hbm_resident_objects", len(self._objs))
-        self.perf.set("l_hbm_resident_bytes",
-                      len(self._objs) * self._obj_bytes)
+        self.perf.set("l_hbm_resident_bytes", self._resident_bytes)
 
-    def put_encode(self, names: list, data_host: np.ndarray):
+    def _insert_locked(self, name, batch: _Batch, row: int) -> None:
+        if name in self._objs:
+            self._drop_locked(name)
+        self._objs[name] = (batch, row)
+        self._resident_bytes += batch.obj_bytes
+        self._touch(name)
+        self._evict_over_capacity()
+
+    def put_encode(self, names: list, data_host: np.ndarray,
+                   codec=None):
         """THE one H2D: upload a batch of objects' data chunks
         [batch, k, n], encode parity on device, and retain the full
         [batch, k+m, n] array resident.  Returns the device parity
         [batch, m, n] (callers usually leave it on device)."""
         import jax.numpy as jnp
+        codec = codec if codec is not None else self.codec
         data_dev = jnp.asarray(data_host)       # single transfer
-        parity = self.codec.encode_batch(data_dev)
+        parity = codec.encode_batch(data_dev)
         full = jnp.concatenate([data_dev, parity], axis=1)
-        batch = _Batch(full, len(names))
+        obj_bytes = int(full.shape[1]) * int(full.shape[2])
+        batch = _Batch(full, len(names), codec, obj_bytes)
         with self._lock:
-            self._obj_bytes = int(full.shape[1]) * int(full.shape[2])
             for i, name in enumerate(names):
-                if name in self._objs:
-                    self._drop_locked(name)
-                self._objs[name] = (batch, i)
-                self._touch(name)
-                self._evict_over_capacity()
+                self._insert_locked(name, batch, i)
             self._update_gauges_locked()
         return parity
+
+    def adopt_encode(self, name, data_rows, parity_rows, codec) -> None:
+        """Adopt one object's ALREADY-STAGED encode from the dispatcher
+        pipeline: data_rows [S, k, chunk] (the staged h2d input) and
+        parity_rows [S, m, chunk] (the compute output) are device
+        arrays, so residency costs zero extra transfers — this is how
+        "the data crosses the pipe once" becomes true on the production
+        write path rather than only in the bench harness.  Host arrays
+        are accepted too (the no-jax dispatcher path): adoption is then
+        itself the one h2d.
+
+        Stored layout matches put_encode: [k+m, S*chunk] — shard i's
+        whole chunk stream is row i."""
+        import jax.numpy as jnp
+        data_dev = jnp.asarray(data_rows)
+        parity_dev = jnp.asarray(parity_rows)
+        # [S, k+m, chunk] -> [k+m, S, chunk] -> [k+m, S*chunk]
+        full = jnp.concatenate([data_dev, parity_dev], axis=1)
+        full = jnp.transpose(full, (1, 0, 2)).reshape(
+            full.shape[1], -1)
+        obj_bytes = int(full.shape[0]) * int(full.shape[1])
+        batch = _Batch(full[None], 1, codec, obj_bytes)
+        with self._lock:
+            self._insert_locked(name, batch, 0)
+            self._update_gauges_locked()
+        self.perf.inc("l_hbm_adopted")
 
     def _gather(self, names: list):
         """Stack the named objects' chunk arrays [len, k+m, n] in name
@@ -191,10 +242,31 @@ class HbmChunkTier:
             self.perf.inc("l_hbm_hits")
             return ent[0].arr[ent[1]]
 
+    def codec_of(self, name):
+        """The codec an entry was encoded with (None when absent)."""
+        with self._lock:
+            ent = self._objs.get(name)
+            return None if ent is None else (ent[0].codec or self.codec)
+
     def drop(self, name) -> None:
         with self._lock:
             self._drop_locked(name)
             self._update_gauges_locked()
+
+    def drop_prefix(self, prefix) -> int:
+        """Invalidate every entry whose tuple key starts with `prefix`
+        (the PG interval-change hook: a primaryship hand-off must drop
+        the PG's residency — another primary may have written since).
+        Returns the number of entries dropped."""
+        with self._lock:
+            victims = [name for name in self._objs
+                       if isinstance(name, tuple) and name
+                       and name[0] == prefix]
+            for name in victims:
+                self._drop_locked(name)
+            if victims:
+                self._update_gauges_locked()
+        return len(victims)
 
     # -- consumers (all read the RESIDENT copy) ------------------------
 
@@ -203,17 +275,30 @@ class HbmChunkTier:
 
     def deep_scrub(self, names: list, device_out: bool = False):
         """Per-chunk digests of every named resident object, computed
-        on device in one fused call; only the digests (8 bytes/chunk)
-        cross back.  Returns {name: uint64[k+m]} — or, with
-        device_out, the raw device (s, ws) pair so callers batching
-        several consumers can defer every host read to the end
-        (finalize_digests turns the pair into the dict)."""
+        on device in one fused call per chunk shape; only the digests
+        (8 bytes/chunk) cross back.  Returns {name: uint64[k+m]} — or,
+        with device_out, the raw device (s, ws) pair so callers
+        batching several consumers can defer every host read to the
+        end (finalize_digests turns the pair into the dict; device_out
+        requires a homogeneous shape across names)."""
         with self._lock:
-            stacked = self._gather(names)
-        s, ws = self._digests(stacked)
+            by_shape: dict = {}
+            for name in names:
+                ent = self._objs[name]
+                shape = tuple(ent[0].arr.shape[1:])
+                by_shape.setdefault(shape, []).append(name)
+            gathered = [(group, self._gather(group))
+                        for group in by_shape.values()]
         if device_out:
-            return s, ws
-        return self.finalize_digests(names, s, ws)
+            if len(gathered) != 1:
+                raise ValueError("device_out needs one chunk shape, "
+                                 "got %d" % len(gathered))
+            return self._digests(gathered[0][1])
+        out: dict = {}
+        for group, stacked in gathered:
+            s, ws = self._digests(stacked)
+            out.update(self.finalize_digests(group, s, ws))
+        return out
 
     @staticmethod
     def finalize_digests(names: list, s, ws) -> dict:
@@ -228,17 +313,23 @@ class HbmChunkTier:
         phase priced out).  Returns the device array of rebuilt rows
         [len(lost), n]."""
         import jax.numpy as jnp
-        obj = self.get(name)
-        if obj is None:
-            raise KeyError(name)
-        nn = self.codec.get_chunk_count()
+        with self._lock:
+            ent = self._objs.get(name)
+            if ent is None:
+                self.perf.inc("l_hbm_misses")
+                raise KeyError(name)
+            self._touch(name)
+            self.perf.inc("l_hbm_hits")
+            obj = ent[0].arr[ent[1]]
+            codec = ent[0].codec or self.codec
+        nn = codec.get_chunk_count()
         avail = tuple(i for i in range(nn) if i not in lost_shards)
-        k = self.codec.get_data_chunk_count()
+        k = codec.get_data_chunk_count()
         survivors = jnp.take(obj[None],
                              jnp.asarray(avail[:k], dtype=jnp.int32),
                              axis=1)
         # decode_batch maps k survivors -> all k+m rows; keep the lost
-        all_rows = self.codec.decode_batch(avail[:k], survivors)
+        all_rows = codec.decode_batch(avail[:k], survivors)
         return jnp.take(all_rows[0],
                         jnp.asarray(lost_shards, dtype=jnp.int32),
                         axis=0)
@@ -247,19 +338,22 @@ class HbmChunkTier:
         """One fused device program rebuilding one lost shard per
         named object — per-lane decode matrices over the RESIDENT
         survivors (the shape the OSD coalesces concurrent recovery
-        ops into).  Returns the device array [len(names), n]."""
+        ops into).  Requires one codec/shape across names.  Returns
+        the device array [len(names), n]."""
         import jax.numpy as jnp
+
         from ..ops import xor_mm
-        nn = self.codec.get_chunk_count()
-        k = self.codec.get_data_chunk_count()
         with self._lock:
+            codec = self._objs[names[0]][0].codec or self.codec
             stacked = self._gather(names)
+        nn = codec.get_chunk_count()
+        k = codec.get_data_chunk_count()
         bitmats = []
         avail_idx = []
         lost_pos = []
         for lost in lost_per_name:
             avail = tuple(i for i in range(nn) if i != lost)[:k]
-            entry = self.codec._decode_entry(avail)
+            entry = codec._decode_entry(avail)
             bitmats.append(entry["bitmat"])
             avail_idx.append(avail)
             lost_pos.append(lost)
@@ -269,17 +363,21 @@ class HbmChunkTier:
                                         axis=1)
         out = xor_mm.matrix_encode_multi(bitmats_dev,
                                          survivors[:, None],
-                                         self.codec.w)[:, 0]
+                                         codec.w)[:, 0]
         lp = jnp.asarray(np.asarray(lost_pos, dtype=np.int32))
         return jnp.take_along_axis(out, lp[:, None, None],
                                    axis=1)[:, 0]
 
     def stats(self) -> dict:
         with self._lock:
+            hits = self.perf.get("l_hbm_hits")
+            misses = self.perf.get("l_hbm_misses")
             return {"resident_objects": len(self._objs),
-                    "resident_bytes":
-                        len(self._objs) * self._obj_bytes,
+                    "resident_bytes": self._resident_bytes,
                     "capacity": self.capacity,
-                    "hits": self.perf.get("l_hbm_hits"),
-                    "misses": self.perf.get("l_hbm_misses"),
+                    "hits": hits,
+                    "misses": misses,
+                    "hit_rate": round(hits / (hits + misses), 3)
+                    if hits + misses else 0.0,
+                    "adopted": self.perf.get("l_hbm_adopted"),
                     "evictions": self.perf.get("l_hbm_evictions")}

@@ -133,16 +133,45 @@ class OSDDaemon(Dispatcher):
                 max_batch=conf.get_val("osd_tpu_coalesce_max_batch"),
                 max_delay=conf.get_val(
                     "osd_tpu_coalesce_max_delay_ms") / 1e3,
-                tracer=self.tracer)
+                tracer=self.tracer,
+                pipeline_depth=conf.get_val("osd_tpu_pipeline_depth"))
             # l_tpu_* device-segment counters ride the daemon's perf
             # collection (mgr report -> prometheus)
             self.ctx.perf.add(self.tpu_dispatcher.perf)
         else:
             self.tpu_dispatcher = None
-        # HBM-resident chunk tier (osd/hbm_tier.py): not wired into the
-        # data path yet (ROADMAP #1); when a harness attaches one, its
-        # residency gauges ride the telemetry report automatically
+        # HBM-resident chunk tier (osd/hbm_tier.py, ROADMAP direction
+        # A): the dispatcher pipeline adopts each EC encode's staged
+        # data + parity device-side keyed by (pg, object); scrub-repair
+        # rebuilds and recovery reconstruction read the resident copy
+        # instead of re-crossing PCIe. Gated on jax being importable —
+        # the tier is pure device residency and has no host fallback.
         self.hbm_tier = None
+        if conf.get_val("osd_hbm_tier_enable"):
+            try:
+                from .hbm_tier import HbmChunkTier
+                self.hbm_tier = HbmChunkTier(
+                    capacity_objects=conf.get_val(
+                        "osd_hbm_tier_capacity"))
+                self.ctx.perf.add(self.hbm_tier.perf)
+            except Exception:
+                self.hbm_tier = None
+        self.hbm_serve_reads = conf.get_val("osd_hbm_tier_serve_reads")
+        if self.ctx.admin_socket is not None:
+            # residency + pipeline introspection (`ceph daemon osd.N
+            # hbm status` / `dispatch status`)
+            self.ctx.admin_socket.register(
+                "hbm status",
+                lambda args: (self.hbm_tier.stats()
+                              if self.hbm_tier is not None
+                              else {"enabled": False}),
+                "HBM chunk-tier residency, hit rate and evictions")
+            self.ctx.admin_socket.register(
+                "dispatch status",
+                lambda args: (self.tpu_dispatcher.dispatch_status()
+                              if self.tpu_dispatcher is not None
+                              else {"enabled": False}),
+                "TPU dispatcher pipeline ring occupancy + coalescing")
         self.hb_peers: dict = {}       # osd -> last reply stamp
         self.hb_pending: dict = {}     # osd -> first unacked ping stamp
         # cache tiering: base-pool IO runs on dedicated threads with an

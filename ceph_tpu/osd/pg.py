@@ -382,6 +382,13 @@ class PG:
                 self.missing.clear()
                 self._missing_src.clear()
                 self._missing_waiters.clear()
+        if changed:
+            # a new interval invalidates this PG's HBM residency: the
+            # resident copies were the OLD primary's view, and another
+            # primary may have written while we were not it
+            tier = getattr(self.daemon, "hbm_tier", None)
+            if tier is not None:
+                tier.drop_prefix(str(self.pgid))
         if changed and self.is_primary():
             self.daemon.queue_recovery(self)
         if not self.is_primary():

@@ -1,4 +1,5 @@
-"""Cross-op device-call coalescing for the OSD's EC hot path.
+"""Cross-op device-call coalescing + overlapped pipeline for the OSD's
+EC hot path.
 
 Role: the twin of the native bridge (native/src/tpu_bridge.cc) inside
 the Python OSD. The reference's ECBackend enters the codec once per op
@@ -10,16 +11,47 @@ signature) CONCATENATE along the stripe axis and ride ONE device
 program — N dispatches become ceil(N / max_batch), and on a remote
 transport N round-trips collapse the same way.
 
-The dispatcher presents a synchronous facade (submitters block until
-their slice of the fused result lands), so the EC pipeline's ordering
-guarantees are untouched — only the device traffic is batched.
+The dispatcher is an overlapped depth-N pipeline (ROADMAP direction A:
+the TPU historically spent >99% of streaming wall-clock waiting on the
+host because every dispatch serialized h2d -> compute -> d2h):
+
+    collector ──> [h2d stage] ──> [compute stage] ──> [d2h stage]
+                 stage batch n+1    run batch n       drain batch n-1
+
+Each stage runs on its own thread; the bounded queues between them ARE
+the staging ring (at most `pipeline_depth` fused batches in flight per
+stage). While batch n computes, batch n+1's host->device transfer is
+already in progress and batch n-1's results are draining back — the
+transfer wall hides behind compute, which is the whole point. Decode
+dispatches additionally pre-stage their decode table (matrix inversion
++ bitmatrix upload) in the h2d stage, so a fresh erasure signature's
+table cost overlaps the previous batch's compute instead of serializing
+in front of its own.
+
+The device input buffer staged by the h2d stage is dispatcher-private,
+so for jax-backed codecs the compute stage donates it to the device
+program (jax.jit donate_argnums) — HBM holds one buffer per stage
+instead of two, and submitters' HOST arrays are never donated (no
+use-after-donate is possible from the caller's side). Donation is
+skipped when the dispatch adopts its results into the HbmChunkTier
+(adoption needs the staged input alive after compute) and on backends
+that cannot honor it.
+
+Facades: submit_async()/encode_async()/decode_async() return futures;
+encode()/decode() keep the original blocking surface, so the EC
+pipeline's ordering guarantees are untouched — only the device traffic
+is batched and overlapped. Errors propagate strictly per batch: a
+failed stage fails ONLY that fused batch's submitters; batches behind
+it keep flowing.
 
 Knobs ride the options schema: osd_tpu_coalesce (default on),
-osd_tpu_coalesce_max_batch, osd_tpu_coalesce_max_delay_ms.
+osd_tpu_coalesce_max_batch, osd_tpu_coalesce_max_delay_ms,
+osd_tpu_pipeline_depth (1 = the legacy synchronous loop).
 """
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from collections import deque
@@ -33,37 +65,111 @@ __all__ = ["TpuDispatcher"]
 
 
 class _Pending:
-    __slots__ = ("batch", "event", "out", "error", "trace", "t_submit")
+    """One submitter's slot in a fused dispatch — and the future the
+    async API hands back (result()/done()/exception())."""
 
-    def __init__(self, batch, trace=NULL_SPAN):
+    __slots__ = ("batch", "event", "out", "error", "trace", "t_submit",
+                 "resident")
+
+    def __init__(self, batch, trace=NULL_SPAN, resident=None):
         self.batch = batch
         self.event = threading.Event()
         self.out = None
         self.error = None
         self.trace = trace if trace is not None else NULL_SPAN
         self.t_submit = time.monotonic()
+        self.resident = resident     # (tier, key, codec) adoption ask
+
+    # -- future surface ------------------------------------------------
+
+    def done(self) -> bool:
+        return self.event.is_set()
+
+    def exception(self):
+        return self.error if self.event.is_set() else None
+
+    def result(self, timeout: float = 120.0):
+        if not self.event.wait(timeout=timeout):
+            raise TimeoutError("tpu dispatcher wedged")
+        if self.error is not None:
+            raise self.error
+        return self.out
+
+
+class _Dispatch:
+    """One fused device program moving through the pipeline stages."""
+
+    __slots__ = ("key", "fn", "pend", "kind", "prefetch", "stacked",
+                 "dev", "out_dev", "t_take", "seg")
+
+    def __init__(self, key, fn, pend, kind, prefetch=None):
+        self.key = key
+        self.fn = fn
+        self.pend = pend
+        self.kind = kind             # "enc" | "dec" | other
+        self.prefetch = prefetch     # () -> None decode-table staging
+        self.stacked = None          # host ndarray (kept for fallback)
+        self.dev = None              # staged device input
+        self.out_dev = None          # device output
+        self.t_take = time.monotonic()
+        self.seg = {}                # stage -> (t_start, t_end)
+
+
+class _JaxDevOps:
+    """Explicit h2d / compute / d2h legs on a jax device. Each leg
+    blocks — in its OWN pipeline thread, which is what lets leg X of
+    batch n overlap leg Y of batch m."""
+
+    def h2d(self, host):
+        import jax
+        return jax.block_until_ready(jax.device_put(host))
+
+    def run(self, fn, dev):
+        import jax
+        return jax.block_until_ready(fn(dev))
+
+    def d2h(self, out):
+        return np.asarray(out)
+
+
+class _HostDevOps:
+    """No-jax fallback: the stages degenerate to a plain call (the
+    fake-device tests substitute their own instrumented ops here)."""
+
+    def h2d(self, host):
+        return host
+
+    def run(self, fn, x):
+        return fn(x)
+
+    def d2h(self, out):
+        return np.asarray(out)
 
 
 class TpuDispatcher:
-    """Coalesces same-key codec calls into single device dispatches.
+    """Coalesces same-key codec calls into single device dispatches and
+    overlaps consecutive dispatches' h2d / compute / d2h legs.
 
     Key = (codec identity, kind, per-stripe shape): ops whose batches
     stack along axis 0 into one well-formed [S_total, k, chunk] call.
 
     Observability: with a tracer whose collection is enabled, each
     submitter's span grows a queue-delay child plus a device span split
-    into h2d / compute / d2h segments (measured once per fused dispatch
-    and mirrored under every participating op — the ZTracer device-
-    attribution role), and the l_tpu_* PerfCounters aggregate the same
-    segments.  With tracing disabled the dispatch path is byte-for-byte
-    the old one: no extra device syncs, no span allocation.
+    into h2d / compute / d2h segments. In pipelined mode the segments
+    are the MEASURED stage intervals (monotonic stamps), so spans from
+    consecutive dispatches visibly overlap — the regression evidence
+    bench.py gates on. The l_tpu_* PerfCounters aggregate the same
+    segments. With pipelining off and tracing off the dispatch path is
+    byte-for-byte the historical one: no extra device syncs, no span
+    allocation.
     """
 
     def __init__(self, max_batch: int = 8, max_delay: float = 0.002,
-                 tracer=None):
+                 tracer=None, pipeline_depth: int = 2):
         self.max_batch = max_batch
         self.max_delay = max_delay
         self.tracer = tracer
+        self.pipeline_depth = max(1, int(pipeline_depth))
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
         self.queues: dict = {}     # key -> (fn, [_Pending])
@@ -95,11 +201,54 @@ class TpuDispatcher:
                                       "bytes through device encode")
                      .add_u64_counter("l_tpu_dec_bytes",
                                       "bytes through device decode")
+                     .add_u64_counter("l_tpu_donated",
+                                      "dispatches whose staged input "
+                                      "was donated to the program")
                      .create_perf_counters())
+        # device leg implementations (tests substitute a fake here)
+        self._jax = self._probe_jax()
+        self._devops = _JaxDevOps() if self._jax else _HostDevOps()
+        self._donate_fns: dict = {}   # key -> jitted donating fn | False
+        self._donate_ok = self._probe_donation()
         self._stop = False
+        self._threads: list = []
+        if self.pipeline_depth > 1:
+            # the staging ring: bounded hand-off queues between stages.
+            # depth bounds how many fused batches are in flight per
+            # stage; the collector blocks when the ring is full.
+            self._q_h2d: queue.Queue = queue.Queue(self.pipeline_depth)
+            self._q_compute: queue.Queue = queue.Queue(
+                self.pipeline_depth)
+            self._q_d2h: queue.Queue = queue.Queue(self.pipeline_depth)
+            for name, fn in (("tpu-h2d", self._h2d_loop),
+                             ("tpu-compute", self._compute_loop),
+                             ("tpu-d2h", self._d2h_loop)):
+                t = threading.Thread(target=fn, name=name, daemon=True)
+                t.start()
+                self._threads.append(t)
         self._thread = threading.Thread(
             target=self._run, name="tpu-dispatch", daemon=True)
         self._thread.start()
+        self._threads.append(self._thread)
+
+    @staticmethod
+    def _probe_jax() -> bool:
+        try:
+            import jax  # noqa: F401
+            return True
+        except Exception:
+            return False
+
+    def _probe_donation(self) -> bool:
+        """Donation is only honored on real accelerators; the CPU
+        backend ignores it (with a warning per compile), so don't ask."""
+        if not self._jax:
+            return False
+        try:
+            import jax
+            return jax.devices()[0].platform not in ("cpu",)
+        except Exception:
+            return False
 
     # -- public API ----------------------------------------------------
 
@@ -163,27 +312,61 @@ class TpuDispatcher:
                 w.popleft()
         self.perf.inc("l_tpu_%s_bytes" % kind, nbytes)
 
-    def encode(self, codec, batch: np.ndarray,
-               trace=NULL_SPAN) -> np.ndarray:
-        """codec.encode_batch(batch), coalesced across submitters."""
+    def encode_async(self, codec, batch: np.ndarray, trace=NULL_SPAN,
+                     resident=None) -> _Pending:
+        """Async codec.encode_batch(batch): returns a future whose
+        result() is the parity array. resident=(tier, key) asks the
+        pipeline to adopt the staged data + computed parity into the
+        HbmChunkTier device-side (zero extra transfers)."""
         key = (self._codec_key(codec), "enc", batch.shape[1:],
                str(batch.dtype))
         self._account_codec(codec, "enc",
                             getattr(batch, "nbytes", 0))
-        return self._submit(key, codec.encode_batch, batch, trace)
+        if resident is not None:
+            resident = (resident[0], resident[1], codec)
+        return self._submit_async(key, codec.encode_batch, batch, trace,
+                                  kind="enc", resident=resident)
 
-    def decode(self, codec, avail_rows: tuple,
-               chunks: np.ndarray, trace=NULL_SPAN) -> np.ndarray:
-        """codec.decode_batch for one erasure signature, coalesced with
-        ops sharing the same signature (same decode matrix)."""
+    def decode_async(self, codec, avail_rows: tuple,
+                     chunks: np.ndarray, trace=NULL_SPAN) -> _Pending:
+        """Async codec.decode_batch for one erasure signature; the
+        decode table (inversion + device upload) is pre-staged in the
+        pipeline's h2d stage so a fresh signature's table cost overlaps
+        the previous dispatch's compute."""
         avail_rows = tuple(avail_rows)
         key = (self._codec_key(codec), "dec", avail_rows,
                chunks.shape[1:], str(chunks.dtype))
         self._account_codec(codec, "dec",
                             getattr(chunks, "nbytes", 0))
-        return self._submit(
+        prefetch = None
+        entry_fn = getattr(codec, "_decode_entry", None)
+        if entry_fn is not None:
+            def prefetch(avail=avail_rows, entry_fn=entry_fn):
+                entry = entry_fn(avail)
+                if self._jax and isinstance(entry, dict) \
+                        and "bitmat" in entry \
+                        and "bitmat_dev" not in entry:
+                    import jax.numpy as jnp
+                    entry.setdefault("bitmat_dev",
+                                     jnp.asarray(entry["bitmat"]))
+        return self._submit_async(
             key, lambda stacked: codec.decode_batch(avail_rows, stacked),
-            chunks, trace)
+            chunks, trace, kind="dec", prefetch=prefetch)
+
+    def encode(self, codec, batch: np.ndarray, trace=NULL_SPAN,
+               resident=None) -> np.ndarray:
+        """codec.encode_batch(batch), coalesced across submitters —
+        the blocking facade over encode_async (EC pipeline ordering
+        untouched)."""
+        return self.encode_async(codec, batch, trace,
+                                 resident=resident).result()
+
+    def decode(self, codec, avail_rows: tuple,
+               chunks: np.ndarray, trace=NULL_SPAN) -> np.ndarray:
+        """codec.decode_batch for one erasure signature, coalesced with
+        ops sharing the same signature (same decode matrix)."""
+        return self.decode_async(codec, avail_rows, chunks,
+                                 trace).result()
 
     def telemetry(self) -> dict:
         """The device-utilization gauge bag the OSD ships in its mgr
@@ -192,7 +375,7 @@ class TpuDispatcher:
         dispatcher over the last telemetry window)."""
         now = time.monotonic()
         with self.lock:
-            depth = sum(len(pend) for _, pend in self.queues.values())
+            depth = sum(len(e[1]) for e in self.queues.values())
             ops = self.stats["ops"]
             disp = self.stats["dispatches"]
             codecs = {}
@@ -219,30 +402,55 @@ class TpuDispatcher:
                 "coalesce_ratio": round(disp / ops, 3) if ops else 1.0,
                 "codecs": codecs}
 
+    def dispatch_status(self) -> dict:
+        """The `dispatch status` asok payload: pipeline shape, ring
+        occupancy per stage, and the coalescing ledger."""
+        ring = {"staging": 0, "computing": 0, "draining": 0}
+        if self.pipeline_depth > 1:
+            ring = {"staging": self._q_h2d.qsize(),
+                    "computing": self._q_compute.qsize(),
+                    "draining": self._q_d2h.qsize()}
+        tel = self.telemetry()
+        return {"pipeline_depth": self.pipeline_depth,
+                "overlapped": self.pipeline_depth > 1,
+                "ring": ring,
+                "queue_depth": tel["queue_depth"],
+                "ops": tel["ops"],
+                "dispatches": tel["dispatches"],
+                "coalesce_ratio": tel["coalesce_ratio"],
+                "donated_dispatches": self.perf.get("l_tpu_donated"),
+                "segments_s": {
+                    "h2d_avg": self.perf.avg("l_tpu_h2d"),
+                    "compute_avg": self.perf.avg("l_tpu_compute"),
+                    "d2h_avg": self.perf.avg("l_tpu_d2h"),
+                    "queue_avg": self.perf.avg("l_tpu_dispatch_queue")}}
+
     def shutdown(self) -> None:
         with self.cv:
             self._stop = True
             self.cv.notify_all()
-        self._thread.join(timeout=5)
+        if self.pipeline_depth > 1:
+            # sentinels flush the stage threads in order
+            self._q_h2d.put(None)
+        for t in self._threads:
+            t.join(timeout=5)
 
     # -- internals -----------------------------------------------------
 
-    def _submit(self, key, fn, batch, trace=NULL_SPAN):
-        p = _Pending(np.asarray(batch), trace)
+    def _submit_async(self, key, fn, batch, trace=NULL_SPAN,
+                      kind: str = "enc", prefetch=None,
+                      resident=None) -> _Pending:
+        p = _Pending(np.asarray(batch), trace, resident=resident)
         with self.cv:
             q = self.queues.get(key)
             if q is None:
-                q = self.queues[key] = (fn, [])
+                q = self.queues[key] = (fn, [], kind, prefetch)
             q[1].append(p)
             self.stats["ops"] += 1
-            depth = sum(len(pend) for _, pend in self.queues.values())
+            depth = sum(len(e[1]) for e in self.queues.values())
             self.cv.notify_all()
         self.perf.set("l_tpu_queue_depth", depth)
-        if not p.event.wait(timeout=120):
-            raise TimeoutError("tpu dispatcher wedged")
-        if p.error is not None:
-            raise p.error
-        return p.out
+        return p
 
     def _take_group(self):
         """Pick the fullest queue; wait up to max_delay for stragglers
@@ -253,10 +461,11 @@ class TpuDispatcher:
                 if self._stop:
                     return None
                 best_key, best = None, None
-                for key, (fn, pend) in self.queues.items():
+                for key, entry in self.queues.items():
+                    pend = entry[1]
                     if pend and (best is None or
                                  len(pend) > len(best[1])):
-                        best_key, best = key, (fn, pend)
+                        best_key, best = key, entry
                 if best is None:
                     deadline = None
                     self.cv.wait(0.5)
@@ -264,13 +473,13 @@ class TpuDispatcher:
                 if len(best[1]) >= self.max_batch or (
                         deadline is not None
                         and time.monotonic() >= deadline):
-                    fn, pend = best
+                    fn, pend, kind, prefetch = best
                     take = pend[:self.max_batch]
                     del pend[:len(take)]
                     if not pend:
                         self.queues.pop(best_key, None)
                     deadline = None
-                    return fn, take
+                    return _Dispatch(best_key, fn, take, kind, prefetch)
                 if deadline is None:
                     deadline = time.monotonic() + self.max_delay
                 self.cv.wait(self.max_delay)
@@ -279,66 +488,210 @@ class TpuDispatcher:
         return self.tracer is not None and self.tracer.enabled
 
     def _run(self):
+        """Collector: group submitters into fused dispatches and feed
+        the pipeline (or, depth 1, run the legacy synchronous loop)."""
         while True:
-            group = self._take_group()
-            if group is None:
+            d = self._take_group()
+            if d is None:
                 return
-            fn, pend = group
             self.stats["dispatches"] += 1
             self.perf.inc("l_tpu_dispatches")
-            self.perf.inc("l_tpu_ops", len(pend))
-            if len(pend) > 1:
-                self.stats["coalesced"] += len(pend)
-                self.perf.inc("l_tpu_coalesced", len(pend))
-            instrument = self._instrumenting()
-            t_start = time.monotonic()
+            self.perf.inc("l_tpu_ops", len(d.pend))
+            if len(d.pend) > 1:
+                self.stats["coalesced"] += len(d.pend)
+                self.perf.inc("l_tpu_coalesced", len(d.pend))
+            if self.pipeline_depth > 1:
+                # blocks when the staging ring is full: that back-
+                # pressure IS the depth-N bound
+                self._q_h2d.put(d)
+            else:
+                self._dispatch_inline(d)
+
+    # -- legacy (depth-1) synchronous path ------------------------------
+
+    def _dispatch_inline(self, d: _Dispatch) -> None:
+        instrument = self._instrumenting()
+        t_start = time.monotonic()
+        try:
+            stacked = d.pend[0].batch if len(d.pend) == 1 \
+                else np.concatenate([p.batch for p in d.pend])
+            if instrument:
+                # explicit h2d/compute/d2h segmentation (two extra
+                # device syncs — the disabled path never pays them)
+                out, seg = device_segments(d.fn, stacked)
+            else:
+                out = np.asarray(d.fn(stacked))
+                seg = None
+            self._slice_results(d, out)
+            self._adopt_residents(d, stacked, out)
+            if seg is not None:
+                t1 = t_start + seg["h2d"]
+                t2 = t1 + seg["compute"]
+                d.seg = {"h2d": (t_start, t1), "compute": (t1, t2),
+                         "d2h": (t2, t2 + seg["d2h"])}
+                self._account(d)
+        except BaseException as e:   # deliver, don't kill the loop
+            for p in d.pend:
+                p.error = e
+        for p in d.pend:
+            p.event.set()
+
+    # -- pipelined stages ----------------------------------------------
+
+    def _fail(self, d: _Dispatch, e: BaseException) -> None:
+        """Strict per-batch error propagation: the failed stage fails
+        ONLY this fused batch's submitters; later batches proceed."""
+        for p in d.pend:
+            p.error = e
+            p.event.set()
+
+    def _h2d_loop(self) -> None:
+        while True:
+            d = self._q_h2d.get()
+            if d is None:
+                self._q_compute.put(None)
+                return
             try:
-                stacked = pend[0].batch if len(pend) == 1 \
-                    else np.concatenate([p.batch for p in pend])
-                if instrument:
-                    # explicit h2d/compute/d2h segmentation (two extra
-                    # device syncs — the disabled path never pays them)
-                    out, seg = device_segments(fn, stacked)
-                else:
-                    out = np.asarray(fn(stacked))
-                    seg = None
-                if len(pend) == 1:
-                    pend[0].out = out
-                else:
-                    off = 0
-                    for p in pend:
-                        s = p.batch.shape[0]
-                        p.out = out[off:off + s]
-                        off += s
-                if seg is not None:
-                    self._account(pend, seg, t_start)
-            except BaseException as e:   # deliver, don't kill the loop
-                for p in pend:
-                    p.error = e
-            for p in pend:
+                t0 = time.monotonic()
+                d.stacked = d.pend[0].batch if len(d.pend) == 1 \
+                    else np.concatenate([p.batch for p in d.pend])
+                d.dev = self._devops.h2d(d.stacked)
+                if d.prefetch is not None:
+                    # decode-table staging rides the h2d stage: the
+                    # inversion + bitmatrix upload of THIS dispatch
+                    # overlap the PREVIOUS dispatch's compute
+                    d.prefetch()
+                d.seg["h2d"] = (t0, time.monotonic())
+            except BaseException as e:
+                self._fail(d, e)
+                continue
+            self._q_compute.put(d)
+
+    def _compute_loop(self) -> None:
+        while True:
+            d = self._q_compute.get()
+            if d is None:
+                self._q_d2h.put(None)
+                return
+            try:
+                t0 = time.monotonic()
+                d.out_dev = self._run_compute(d)
+                d.seg["compute"] = (t0, time.monotonic())
+            except BaseException as e:
+                self._fail(d, e)
+                continue
+            self._q_d2h.put(d)
+
+    def _d2h_loop(self) -> None:
+        while True:
+            d = self._q_d2h.get()
+            if d is None:
+                return
+            try:
+                t0 = time.monotonic()
+                out = self._devops.d2h(d.out_dev)
+                d.seg["d2h"] = (t0, time.monotonic())
+                self._slice_results(d, out)
+                self._adopt_residents(d, d.dev, d.out_dev)
+                self._account(d)
+            except BaseException as e:
+                self._fail(d, e)
+                continue
+            for p in d.pend:
                 p.event.set()
 
-    def _account(self, pend, seg, t_start: float) -> None:
-        """Fold one dispatch's measured segments into the l_tpu_*
-        counters and back-fill queue/device spans under every
+    def _run_compute(self, d: _Dispatch):
+        """Run the fused program, donating the staged input when safe.
+
+        The staged buffer is dispatcher-private (h2d made a fresh device
+        copy; submitters only ever hold their host arrays), so donation
+        can never invalidate caller-visible data. It is skipped when the
+        dispatch adopts into the HBM tier — adoption reads the staged
+        input after compute."""
+        wants_adopt = any(p.resident is not None for p in d.pend)
+        # encode only: an encode fn is one trace per (codec, shape),
+        # but a decode fn closes over its erasure signature — jitting
+        # it per signature would pay a fresh trace/compile for every
+        # new pattern, exactly the cost the table bank exists to avoid
+        if self._donate_ok and d.kind == "enc" and not wants_adopt:
+            dfn = self._donate_fns.get(d.key)
+            if dfn is None:
+                import jax
+                if len(self._donate_fns) >= 256:
+                    # bounded: distinct (codec, kind, shape, signature)
+                    # keys grow without limit on a long-lived OSD
+                    self._donate_fns.clear()
+                dfn = self._donate_fns.setdefault(
+                    d.key, jax.jit(d.fn, donate_argnums=(0,)))
+            if dfn is not False:
+                try:
+                    out = self._devops.run(dfn, d.dev)
+                    self.perf.inc("l_tpu_donated")
+                    return out
+                except BaseException:
+                    # not traceable / donation rejected: remember, and
+                    # re-stage (the donated buffer may be gone) for the
+                    # plain call
+                    self._donate_fns[d.key] = False
+                    d.dev = self._devops.h2d(d.stacked)
+        return self._devops.run(d.fn, d.dev)
+
+    def _slice_results(self, d: _Dispatch, out) -> None:
+        if len(d.pend) == 1:
+            d.pend[0].out = out
+            return
+        off = 0
+        for p in d.pend:
+            s = p.batch.shape[0]
+            p.out = out[off:off + s]
+            off += s
+
+    def _adopt_residents(self, d: _Dispatch, data_src, parity_src
+                         ) -> None:
+        """Hand the staged data rows + computed parity rows to the HBM
+        tier for any submitter that asked — the arrays are already
+        device-side in pipelined mode, so residency costs ZERO extra
+        transfers. Adoption failures never fail the submitter (the tier
+        is a cache)."""
+        off = 0
+        for p in d.pend:
+            s = p.batch.shape[0]
+            if p.resident is not None:
+                tier, key, codec = p.resident
+                try:
+                    tier.adopt_encode(key, data_src[off:off + s],
+                                      parity_src[off:off + s], codec)
+                except Exception:
+                    pass
+            off += s
+
+    def _account(self, d: _Dispatch) -> None:
+        """Fold one dispatch's measured stage intervals into the
+        l_tpu_* counters and back-fill queue/device spans under every
         participating op's trace (the segments are shared: a fused
-        dispatch ran once for all of them)."""
-        t_end = time.monotonic()
-        self.perf.tinc("l_tpu_h2d", seg["h2d"])
-        self.perf.tinc("l_tpu_compute", seg["compute"])
-        self.perf.tinc("l_tpu_d2h", seg["d2h"])
-        t1 = t_start + seg["h2d"]
-        t2 = t1 + seg["compute"]
-        for p in pend:
+        dispatch ran once for all of them). In pipelined mode the
+        intervals are REAL wall stamps, so spans from consecutive
+        dispatches overlap — that overlap is the proof the pipeline
+        works, and bench.py gates on it."""
+        seg = d.seg
+        if not seg:
+            return
+        h0, h1 = seg.get("h2d", (d.t_take, d.t_take))
+        c0, c1 = seg.get("compute", (h1, h1))
+        d0, d1 = seg.get("d2h", (c1, c1))
+        self.perf.tinc("l_tpu_h2d", h1 - h0)
+        self.perf.tinc("l_tpu_compute", c1 - c0)
+        self.perf.tinc("l_tpu_d2h", d1 - d0)
+        for p in d.pend:
             self.perf.tinc("l_tpu_dispatch_queue",
-                           max(0.0, t_start - p.t_submit))
+                           max(0.0, d.t_take - p.t_submit))
             if not p.trace.valid():
                 continue
-            p.trace.child_interval("tpu_queue", p.t_submit, t_start)
+            p.trace.child_interval("tpu_queue", p.t_submit, d.t_take)
             dev = p.trace.child_interval(
-                "tpu_device", t_start, t_end,
-                batch=int(sum(q.batch.shape[0] for q in pend)),
-                coalesced=len(pend))
-            dev.child_interval("h2d", t_start, t1)
-            dev.child_interval("compute", t1, t2)
-            dev.child_interval("d2h", t2, t2 + seg["d2h"])
+                "tpu_device", h0, d1,
+                batch=int(sum(q.batch.shape[0] for q in d.pend)),
+                coalesced=len(d.pend))
+            dev.child_interval("h2d", h0, h1)
+            dev.child_interval("compute", c0, c1)
+            dev.child_interval("d2h", d0, d1)

@@ -185,15 +185,29 @@ class TestDispatcherTracing:
             disp.shutdown()
 
     def test_disabled_tracer_no_spans_no_segments(self):
+        """Disabled tracing mints ZERO spans on every path.  The
+        depth-1 (legacy synchronous) path additionally measures no
+        segments — its no-extra-device-syncs contract; the pipelined
+        path gets stage intervals for free (the stages block per leg
+        anyway), so its counters MAY advance, but spans still must
+        not."""
         from ceph_tpu.osd.tpu_dispatch import TpuDispatcher
         tracer = SpanCollector()          # disabled
-        disp = TpuDispatcher(tracer=tracer)
+        disp = TpuDispatcher(tracer=tracer, pipeline_depth=1)
         try:
             out = disp.encode(_XorCodec(),
                               np.zeros((1, 2, 4), dtype=np.uint8))
             assert out.shape == (1, 2, 4)
             assert tracer.dump() == []
             assert disp.perf.dump()["l_tpu_compute"]["avgcount"] == 0
+        finally:
+            disp.shutdown()
+        disp = TpuDispatcher(tracer=tracer)   # pipelined default
+        try:
+            out = disp.encode(_XorCodec(),
+                              np.zeros((1, 2, 4), dtype=np.uint8))
+            assert out.shape == (1, 2, 4)
+            assert tracer.dump() == []        # still no span objects
         finally:
             disp.shutdown()
 
