@@ -99,7 +99,12 @@ dispatch_queue} device-time attribution measured through the
 production TpuDispatcher + common.tracer.device_segments
 instrumentation (the same code path the OSD's op spans and l_tpu_*
 counters ride), smoke-gated so segment sums can never exceed the wall
-time they decompose.
+time they decompose.  The row also carries `stall_attribution` — the
+dispatch-profile verdict plus {collector_idle, h2d_blocked,
+compute_busy, d2h_blocked} fractions from the stage profiler.  Every
+run additionally prices the DeviceProfiler itself (profiler_overhead
+row): profiler-on streaming must land within 3% of profiler-off or
+the run FAILS — the observability layer may not tax the data path.
 
 Trustworthiness protocol (VERDICT #2): every headline row is timed
 over REPEATS (>= 3) INTERLEAVED repeats — rep 1 of all rows before
@@ -393,6 +398,7 @@ def _bench_cluster() -> dict:
     # the same way osd_tracing=False pins the span path)
     c = MiniCluster(num_mons=1, num_osds=4,
                     conf_overrides={"osd_tracing": False,
+                                    "osd_profiler": False,
                                     "mgr_stats_period": 0.0})
     c.start()
     try:
@@ -507,10 +513,64 @@ def _trace_breakdown(codec, data_host) -> dict:
                 "device-time attribution is broken" % (total, wall))
         seg["wall_s"] = wall
         seg["spans"] = len(tracer.dump())
-        return {k: (round(v, 6) if isinstance(v, float) else v)
-                for k, v in seg.items()}
+        out = {k: (round(v, 6) if isinstance(v, float) else v)
+               for k, v in seg.items()}
+        # stall attribution from the dispatcher's profile window: the
+        # four numbers an operator reads first when asking "which
+        # stage is the wall" (busy time of the device stages, idle/
+        # blocked time of their neighbors), plus the verdict itself
+        prof = disp.dispatch_profile()
+        stages = prof["stages"]
+        out["stall_attribution"] = {
+            "verdict": prof["verdict"],
+            "collector_idle": stages["collector"]["idle_frac"],
+            "h2d_blocked": stages["h2d"]["blocked_frac"],
+            "compute_busy": stages["compute"]["busy_frac"],
+            "d2h_blocked": stages["d2h"]["blocked_frac"],
+        }
+        return out
     finally:
         disp.shutdown()
+
+
+def _profiler_overhead_gate(codec, data_host) -> dict:
+    """Streaming encodes through the production dispatcher with the
+    device profiler ON must land within 3% of the identical run with
+    it OFF — the profiler's promise is an off-path of one attribute
+    check, and this prices that promise every bench run.  On/off
+    windows are interleaved (rep 1 of both before rep 2 of either) so
+    a transport mood swing shows as spread, not as a fake regression;
+    the medians decide."""
+    from ceph_tpu.common.profiler import PROFILER
+    from ceph_tpu.osd.tpu_dispatch import TpuDispatcher
+
+    disp = TpuDispatcher(max_batch=4, max_delay=0.0005)
+    reps, batches = 3, 8
+    times: dict = {True: [], False: []}
+    prev = PROFILER.enabled
+    try:
+        for enabled in (True, False):       # warm both paths
+            PROFILER.enabled = enabled
+            disp.encode(codec, data_host)
+        for _ in range(reps):
+            for enabled in (True, False):
+                PROFILER.enabled = enabled
+                t0 = time.perf_counter()
+                for _ in range(batches):
+                    disp.encode(codec, data_host)
+                times[enabled].append(time.perf_counter() - t0)
+    finally:
+        PROFILER.enabled = prev
+        disp.shutdown()
+    t_on, t_off = _median(times[True]), _median(times[False])
+    ratio = (t_off / t_on) if t_on > 0 else 1.0    # on-rate / off-rate
+    if ratio < 0.97:
+        raise SystemExit(
+            "profiler overhead gate: profiler-on streaming runs at "
+            "%.1f%% of profiler-off (floor 97%%) — the profiler is on "
+            "the hot path" % (ratio * 100))
+    return {"on_s": round(t_on, 6), "off_s": round(t_off, 6),
+            "on_vs_off": round(ratio, 4)}
 
 
 def _union_length(intervals) -> float:
@@ -1409,6 +1469,12 @@ def run_bench() -> None:
         raise
     except Exception as e:
         cluster_rows = {"cluster_bench_error": str(e)[:200]}
+
+    # profiler overhead gate: prices the DeviceProfiler's off-path
+    # promise on every run (profiler-on streaming within 3% of
+    # profiler-off, SystemExit otherwise)
+    print("BENCH-STAGE profiler-overhead", file=sys.stderr, flush=True)
+    doc["profiler_overhead"] = _profiler_overhead_gate(tpu, data_host)
 
     # --trace: per-phase {h2d, compute, d2h, dispatch_queue} breakdown
     # through the production dispatcher instrumentation (runs after the

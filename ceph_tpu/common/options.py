@@ -130,6 +130,28 @@ def _declare_defaults():
       "seconds a completed op stays in history")
     o("osd_op_complaint_time", float, 30.0, LEVEL_ADVANCED,
       "age after which an in-flight op counts as a slow request")
+    o("osd_op_history_slow_size", int, 20, LEVEL_ADVANCED,
+      "N slowest completed ops retained by the flight recorder "
+      "(osd_op_history_slow_op_size role: the `dump_historic_ops` "
+      "slowest_ops ring, kept beside the most-recent ring)")
+    # device-runtime profiler (common/profiler.py)
+    o("osd_profiler", bool, True, LEVEL_ADVANCED,
+      "device-runtime profiler: per-(kernel, shape-signature) "
+      "jit compile/cache-hit accounting, device-memory ledger, "
+      "recompile-storm detection. Off = one attribute check per "
+      "wrapped call (the bench cluster row pins this False like "
+      "osd_tracing for methodology constancy)")
+    o("osd_profiler_recompile_window", float, 60.0, LEVEL_ADVANCED,
+      "sliding window (seconds) for the recompile-storm detector")
+    o("osd_profiler_recompile_threshold", int, 24, LEVEL_ADVANCED,
+      "compiles of ONE kernel within the window that raise "
+      "DEVICE_RECOMPILE_STORM (per-kernel, so legitimate warm-up "
+      "compiles spread across kernels never trip it)")
+    o("osd_hbm_nearfull_ratio", float, 0.85, LEVEL_ADVANCED,
+      "HBM chunk-tier occupancy (resident/capacity) above which the "
+      "OSD reports device-memory pressure and the monitor raises "
+      "DEVICE_MEM_NEARFULL (mon_osd_nearfull_ratio analog for the "
+      "device tier)")
     # tracing (TracepointProvider/blkin gating)
     o("trace_enable", bool, False, LEVEL_ADVANCED,
       "collect zipkin-style spans on the op path (legacy utils.trace "

@@ -286,7 +286,8 @@ def _make_indep(cm: CompiledMap, out_size: int, numrep: int,
         result = jnp.where(out == undef, jnp.int64(CRUSH_ITEM_NONE), result)
         return result
 
-    return jax.jit(run)
+    from ..common.profiler import PROFILER
+    return PROFILER.wrap_jit("crush.indep", jax.jit(run))
 
 
 def _make_firstn(cm: CompiledMap, result_max: int, numrep: int,
@@ -426,7 +427,8 @@ def _make_firstn(cm: CompiledMap, result_max: int, numrep: int,
             0, numrep, rep_body, (out, out2, outpos))
         return out2 if chooseleaf else out
 
-    return jax.jit(run)
+    from ..common.profiler import PROFILER
+    return PROFILER.wrap_jit("crush.firstn", jax.jit(run))
 
 
 _KERNEL_CACHE: dict = {}

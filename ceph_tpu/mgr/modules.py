@@ -132,6 +132,40 @@ class PrometheusModule(MgrModule):
                          hbm.get("resident_objects", 0), lbl)
                     emit("ceph_osd_hbm_resident_bytes",
                          hbm.get("resident_bytes", 0), lbl)
+                    # chunk-tier residency series (the `hbm status`
+                    # asok payload, exported per daemon)
+                    emit("ceph_hbm_resident_objects",
+                         hbm.get("resident_objects", 0), lbl)
+                    emit("ceph_hbm_resident_bytes",
+                         hbm.get("resident_bytes", 0), lbl)
+                    emit("ceph_hbm_capacity_objects",
+                         hbm.get("capacity", 0), lbl)
+                    emit("ceph_hbm_occupancy_ratio",
+                         hbm.get("occupancy", 0.0), lbl)
+                    emit("ceph_hbm_hit_rate",
+                         hbm.get("hit_rate", 0.0), lbl)
+                    emit("ceph_hbm_evictions",
+                         hbm.get("evictions", 0), lbl,
+                         mtype="counter")
+                # pipeline stall-attribution series from the
+                # dispatcher's profile window: time-averaged ring
+                # occupancy per stage queue and busy/idle/blocked wall
+                # seconds per stage (the `dispatch profile` verdict's
+                # raw inputs, so dashboards can recompute it)
+                dispatch = status.get("dispatch") or {}
+                profile = dispatch.get("profile") or {}
+                for stage, occ in sorted(
+                        (profile.get("queue_occupancy_avg")
+                         or {}).items()):
+                    emit("ceph_tpu_stage_ring_occupancy", occ,
+                         dict(lbl, stage=stage))
+                for stage, row in sorted(
+                        (profile.get("stages") or {}).items()):
+                    slbl = dict(lbl, stage=stage)
+                    for state in ("busy", "idle", "blocked"):
+                        emit("ceph_tpu_stage_%s_seconds" % state,
+                             row.get(state + "_s", 0.0), slbl,
+                             mtype="counter")
             # balancer sweep timings (ROADMAP #4's measured-feedback
             # series), exported with a backend label
             for key in metrics.value_keys():

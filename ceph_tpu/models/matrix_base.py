@@ -43,7 +43,10 @@ def _bank_pick(bank, i: int):
     global _bank_pick_fn
     if _bank_pick_fn is None:
         import jax
-        _bank_pick_fn = jax.jit(lambda b, j: b[j])
+
+        from ..common.profiler import PROFILER
+        _bank_pick_fn = PROFILER.wrap_jit(
+            "matrix_base.bank_pick", jax.jit(lambda b, j: b[j]))
     import jax.numpy as jnp
     return _bank_pick_fn(bank, jnp.asarray(i, dtype=jnp.int32))
 

@@ -74,7 +74,21 @@ class TableCache:
             while len(self._entries) > self.capacity:
                 self._entries.popitem(last=False)
                 self.evictions += 1
+            self._sync_mem_locked()
             return entry
+
+    def _sync_mem_locked(self) -> None:
+        """Publish the cached tables' footprint to the device-memory
+        ledger (bitmatrix + decode matrix bytes per entry; the device
+        copy mirrors the bitmatrix, so this tracks the HBM cost too)."""
+        total = 0
+        for entry in self._entries.values():
+            if isinstance(entry, dict):
+                for field in ("bitmat", "mat"):
+                    arr = entry.get(field)
+                    total += int(getattr(arr, "nbytes", 0) or 0)
+        from ..common.profiler import PROFILER
+        PROFILER.mem_set("decode_tables", total)
 
     def values(self):
         with self._lock:
@@ -85,6 +99,7 @@ class TableCache:
         with self._lock:
             self._entries.clear()
             self.hits = self.misses = self.evictions = 0
+            self._sync_mem_locked()
 
     def stats(self) -> dict:
         with self._lock:

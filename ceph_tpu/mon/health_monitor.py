@@ -22,6 +22,13 @@ Checks implemented (names follow the reference's health check ids):
   OSD_SLOW_OPS      OpTracker slow-request counts riding the MPGStats
                     report (the reference's "N slow ops" health check);
                     clears when the ops drain and the osd re-reports 0
+  DEVICE_RECOMPILE_STORM  a device kernel recompiled more than the
+                    storm threshold inside the detection window (shape
+                    churn defeating the jit trace cache); rides the
+                    same MPGStats report and clears when the osd
+                    re-reports a calm window
+  DEVICE_MEM_NEARFULL  an osd's HBM chunk tier crossed the nearfull
+                    occupancy ratio — eviction pressure is imminent
 
 Raw pg stats stay leader-local (they churn with IO; replicating them
 would melt paxos) — only the DERIVED check map and the scrub-error
@@ -52,6 +59,8 @@ class HealthMonitor:
         # heartbeat cadence; a fresh leader refills within a tick)
         self._pg_stats: dict = {}      # str(pgid) -> stats dict
         self._slow_ops: dict = {}      # osd id -> slow-request count
+        self._recompiles: dict = {}    # osd id -> in-window recompiles
+        self._nearfull: dict = {}      # osd id -> HBM occupancy ratio
         self._reported_osds: set = set()   # osds heard from (this mon)
         self._stats_gen = 0
         self._seen_epoch = -1
@@ -116,6 +125,18 @@ class HealthMonitor:
                 self._slow_ops[msg.osd_id] = n
             else:
                 self._slow_ops.pop(msg.osd_id, None)
+            # device-runtime profiler feeds (set-or-clear like slow_ops:
+            # a calm report retires the raised state)
+            r = int(getattr(msg, "recompiles", 0) or 0)
+            if r > 0:
+                self._recompiles[msg.osd_id] = r
+            else:
+                self._recompiles.pop(msg.osd_id, None)
+            occ = float(getattr(msg, "mem_nearfull", 0.0) or 0.0)
+            if occ > 0:
+                self._nearfull[msg.osd_id] = occ
+            else:
+                self._nearfull.pop(msg.osd_id, None)
             self._stats_gen += 1
         self.recompute()
 
@@ -266,6 +287,38 @@ class HealthMonitor:
             elif not self._reported_osds \
                     and "OSD_SLOW_OPS" in eff["checks"]:
                 checks["OSD_SLOW_OPS"] = eff["checks"]["OSD_SLOW_OPS"]
+            # DEVICE_RECOMPILE_STORM: an osd's jit cache is thrashing —
+            # some kernel recompiled more than the threshold inside the
+            # detection window (shape churn defeating the trace cache)
+            if self._recompiles:
+                checks["DEVICE_RECOMPILE_STORM"] = {
+                    "severity": "warning",
+                    "summary": "%d osd(s) recompiling device kernels"
+                               % len(self._recompiles),
+                    "detail": ["osd.%d recompiled a kernel %d times in "
+                               "the detection window" % (o, n)
+                               for o, n in sorted(
+                                   self._recompiles.items())]}
+            elif not self._reported_osds \
+                    and "DEVICE_RECOMPILE_STORM" in eff["checks"]:
+                checks["DEVICE_RECOMPILE_STORM"] = \
+                    eff["checks"]["DEVICE_RECOMPILE_STORM"]
+            # DEVICE_MEM_NEARFULL: HBM chunk tier over the nearfull
+            # ratio — eviction pressure is imminent and reads will fall
+            # back to the host path
+            if self._nearfull:
+                checks["DEVICE_MEM_NEARFULL"] = {
+                    "severity": "warning",
+                    "summary": "%d osd(s) near device-memory capacity"
+                               % len(self._nearfull),
+                    "detail": ["osd.%d HBM tier is %d%% full"
+                               % (o, round(occ * 100))
+                               for o, occ in sorted(
+                                   self._nearfull.items())]}
+            elif not self._reported_osds \
+                    and "DEVICE_MEM_NEARFULL" in eff["checks"]:
+                checks["DEVICE_MEM_NEARFULL"] = \
+                    eff["checks"]["DEVICE_MEM_NEARFULL"]
             if checks == eff["checks"] and scrub == eff["scrub_errors"]:
                 return
             self.pending = {"checks": checks, "scrub_errors": scrub}

@@ -383,6 +383,13 @@ class MPGStats(Message):
     # OpTracker slow-request count (appended field): the HealthMonitor
     # derives OSD_SLOW_OPS from it, clearing when the ops drain
     slow_ops: int = 0
+    # device-runtime profiler feeds (appended fields, same evolution
+    # pattern): in-window jit recompile count of the worst kernel when
+    # it crosses the storm threshold (DEVICE_RECOMPILE_STORM), and the
+    # HBM tier occupancy ratio when it crosses osd_hbm_nearfull_ratio
+    # (DEVICE_MEM_NEARFULL); both 0 when healthy
+    recompiles: int = 0
+    mem_nearfull: float = 0.0
 
 
 # -- mgr ---------------------------------------------------------------

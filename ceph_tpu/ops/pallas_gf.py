@@ -113,3 +113,9 @@ def matrix_encode8(bitmat: jax.Array, data: jax.Array,
         ),
         interpret=interpret,
     )(bitmat.astype(jnp.uint8), data)
+
+
+from ..common.profiler import PROFILER  # noqa: E402
+
+matrix_encode8 = PROFILER.wrap_jit("pallas_gf.matrix_encode8",
+                                   matrix_encode8)

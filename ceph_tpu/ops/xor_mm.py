@@ -25,6 +25,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..common.profiler import PROFILER
+
 
 def xor_matmul(bitmat: jax.Array, bits: jax.Array) -> jax.Array:
     """out[..., r, f] = XOR_c bitmat[r, c] & bits[..., c, f].
@@ -82,6 +84,9 @@ def matrix_encode(bitmat: jax.Array, data: jax.Array, w: int) -> jax.Array:
     return pack_element_bits(out_bits, w)
 
 
+matrix_encode = PROFILER.wrap_jit("xor_mm.matrix_encode", matrix_encode)
+
+
 @functools.partial(jax.jit, static_argnames=("w",))
 def matrix_encode_multi(bitmats: jax.Array, data: jax.Array,
                         w: int) -> jax.Array:
@@ -95,6 +100,10 @@ def matrix_encode_multi(bitmats: jax.Array, data: jax.Array,
     round-trips into one, and on-device the lanes fill the MXU batch
     dimension."""
     return jax.vmap(lambda bm, d: matrix_encode(bm, d, w))(bitmats, data)
+
+
+matrix_encode_multi = PROFILER.wrap_jit("xor_mm.matrix_encode_multi",
+                                        matrix_encode_multi)
 
 
 @functools.partial(jax.jit, static_argnames=("w", "packetsize"))
@@ -121,3 +130,7 @@ def bitmatrix_encode(bitmat: jax.Array, data: jax.Array, w: int,
     byts = byts.reshape(*lead, s, m, w, p)
     byts = jnp.moveaxis(byts, -4, -3)                # [..., m, s, w, p]
     return byts.reshape(*lead, m, n)
+
+
+bitmatrix_encode = PROFILER.wrap_jit("xor_mm.bitmatrix_encode",
+                                     bitmatrix_encode)

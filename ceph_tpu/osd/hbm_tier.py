@@ -74,7 +74,8 @@ def _init_device_digest():
         s = x.sum(axis=-1, dtype=jnp.uint32)
         ws = (x * w).sum(axis=-1, dtype=jnp.uint32)
         return s, ws
-    _device_digest = digest
+    from ..common.profiler import PROFILER
+    _device_digest = PROFILER.wrap_jit("hbm_tier.digest", digest)
 
 
 class _Batch:
@@ -154,6 +155,10 @@ class HbmChunkTier:
     def _update_gauges_locked(self) -> None:
         self.perf.set("l_hbm_resident_objects", len(self._objs))
         self.perf.set("l_hbm_resident_bytes", self._resident_bytes)
+        # device-memory ledger: tier residency is the dominant HBM
+        # category, so every gauge refresh updates the profiler too
+        from ..common.profiler import PROFILER
+        PROFILER.mem_set("hbm_tier", self._resident_bytes)
 
     def _insert_locked(self, name, batch: _Batch, row: int) -> None:
         if name in self._objs:
@@ -375,9 +380,19 @@ class HbmChunkTier:
             return {"resident_objects": len(self._objs),
                     "resident_bytes": self._resident_bytes,
                     "capacity": self.capacity,
+                    "occupancy": round(len(self._objs) / self.capacity,
+                                       4) if self.capacity else 0.0,
                     "hits": hits,
                     "misses": misses,
                     "hit_rate": round(hits / (hits + misses), 3)
                     if hits + misses else 0.0,
                     "adopted": self.perf.get("l_hbm_adopted"),
                     "evictions": self.perf.get("l_hbm_evictions")}
+
+    def occupancy(self) -> float:
+        """Occupancy ratio for the DEVICE_MEM_NEARFULL feed (objects
+        over capacity — the eviction trigger is object-count, so the
+        pressure signal keys on the same axis)."""
+        with self._lock:
+            return len(self._objs) / self.capacity \
+                if self.capacity else 0.0

@@ -36,6 +36,15 @@ class OpRequest:
         self.events: list[tuple[float, str]] = []  # (monotonic, name)
         self.done_at: float | None = None      # monotonic
         self._tracker = tracker
+        # flight-recorder trace snapshot: the op's span tree, captured
+        # at completion so the history retains it after the live
+        # SpanCollector ring rolls over
+        self.trace_id: int | None = None
+        self.trace_spans: list[dict] | None = None
+
+    def set_trace(self, trace_id: int, spans: list[dict]) -> None:
+        self.trace_id = trace_id
+        self.trace_spans = spans
 
     def mark_event(self, name: str) -> None:
         self.events.append((time.monotonic(), name))
@@ -62,7 +71,7 @@ class OpRequest:
         return self.initiated_at + (mono_ts - self.initiated_mono)
 
     def dump(self) -> dict:
-        return {
+        doc = {
             "id": self.id,
             "description": self.description,
             "initiated_at": self.initiated_at,
@@ -73,6 +82,10 @@ class OpRequest:
                            for ts, name in self.events],
             },
         }
+        if self.trace_spans is not None:
+            doc["type_data"]["trace"] = {"trace_id": self.trace_id,
+                                         "spans": self.trace_spans}
+        return doc
 
 
 class OpTracker:
@@ -85,13 +98,19 @@ class OpTracker:
 
     def __init__(self, history_size: int = 20,
                  history_duration: float = 600.0,
-                 complaint_time: float = 30.0):
+                 complaint_time: float = 30.0,
+                 slow_size: int = 20):
         self.history_size = history_size
         self.history_duration = history_duration
         self.complaint_time = complaint_time
+        self.slow_size = slow_size
         self._lock = threading.Lock()
         self._inflight: dict[int, OpRequest] = {}
         self._history: deque[OpRequest] = deque()
+        # flight recorder: the N SLOWEST completed ops, kept sorted
+        # slowest-first — a fast op burst cannot flush the one 3s
+        # outlier the operator is hunting out of the recent ring
+        self._slowest: list[OpRequest] = []
 
     def create_request(self, description: str) -> OpRequest:
         op = OpRequest(description, tracker=self)
@@ -104,6 +123,11 @@ class OpTracker:
         with self._lock:
             self._inflight.pop(op.id, None)
             self._history.append(op)
+            if self.slow_size > 0:
+                self._slowest.append(op)
+                self._slowest.sort(key=lambda o: o.duration,
+                                   reverse=True)
+                del self._slowest[self.slow_size:]
             self._prune_locked()
 
     def _prune_locked(self) -> None:
@@ -113,6 +137,9 @@ class OpTracker:
         while self._history and (self._history[0].done_at or now) \
                 < now - self.history_duration:
             self._history.popleft()
+        cutoff = now - self.history_duration
+        self._slowest = [o for o in self._slowest
+                         if (o.done_at or now) >= cutoff]
 
     # -- introspection (admin socket surface) ---------------------------
 
@@ -125,12 +152,24 @@ class OpTracker:
         with self._lock:
             self._prune_locked()
             ops = [op.dump() for op in self._history]
-        return {"num_ops": len(ops), "ops": ops}
+            slowest = [op.dump() for op in self._slowest]
+        return {"num_ops": len(ops), "ops": ops,
+                "num_slowest": len(slowest), "slowest_ops": slowest}
 
     def dump_historic_ops_by_duration(self) -> dict:
-        doc = self.dump_historic_ops()
-        doc["ops"].sort(key=lambda o: o["duration"], reverse=True)
-        return doc
+        """Slowest-first view spanning BOTH flight-recorder rings: the
+        slowest ring contributes outliers the recent ring already
+        dropped; recent ops not (yet) in the slowest ring still rank."""
+        with self._lock:
+            self._prune_locked()
+            seen: set[int] = set()
+            merged = []
+            for op in list(self._slowest) + list(self._history):
+                if op.id not in seen:
+                    seen.add(op.id)
+                    merged.append(op.dump())
+        merged.sort(key=lambda o: o["duration"], reverse=True)
+        return {"num_ops": len(merged), "ops": merged}
 
     def get_slow_ops(self, now: float | None = None) -> list[dict]:
         """Ops in flight longer than the complaint time (the OSD's

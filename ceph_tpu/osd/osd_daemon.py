@@ -105,11 +105,18 @@ class OSDDaemon(Dispatcher):
                 lambda: make_op_queue(conf), self.ctx.hbmap)
         self.client_op_priority = conf.get_val("osd_client_op_priority")
         self.recovery_op_priority = conf.get_val("osd_recovery_op_priority")
-        # per-op event history + slow-request detection (OpTracker)
+        # per-op event history + slow-request detection (OpTracker);
+        # slow_size is the flight recorder's N-slowest ring
         self.op_tracker = OpTracker(
             history_size=conf.get_val("osd_op_history_size"),
             history_duration=conf.get_val("osd_op_history_duration"),
-            complaint_time=conf.get_val("osd_op_complaint_time"))
+            complaint_time=conf.get_val("osd_op_complaint_time"),
+            slow_size=conf.get_val("osd_op_history_slow_size"))
+        # device-runtime profiler (common/profiler.py): process-global
+        # by design (module-level jit sites have no daemon home), so
+        # configure() just applies this daemon's knobs
+        from ..common.profiler import PROFILER
+        PROFILER.configure(conf)
         # ZTracer-style span collector, config-gated (osd_tracing with
         # an osd_tracing_sample hot-path knob); spans stitch across
         # daemons via the message-envelope (trace_id, parent_span)
@@ -172,6 +179,25 @@ class OSDDaemon(Dispatcher):
                               if self.tpu_dispatcher is not None
                               else {"enabled": False}),
                 "TPU dispatcher pipeline ring occupancy + coalescing")
+            # device-runtime profiler surface: stall-attribution
+            # verdict, jit registry, device-memory ledger
+            self.ctx.admin_socket.register(
+                "dispatch profile",
+                lambda args: (self.tpu_dispatcher.dispatch_profile()
+                              if self.tpu_dispatcher is not None
+                              else {"enabled": False}),
+                "pipeline stall attribution (busy/idle/blocked per "
+                "stage + bound-stage verdict)")
+            self.ctx.admin_socket.register(
+                "profile dump",
+                lambda args: self._profile_dump(),
+                "device-runtime profiler: jit compiles/cache hits, "
+                "device-memory ledger, dispatch stall attribution")
+            self.ctx.admin_socket.register(
+                "profile reset",
+                lambda args: self._profile_reset(),
+                "reset the device-runtime profiler's registries and "
+                "restart the stall-attribution window")
         self.hb_peers: dict = {}       # osd -> last reply stamp
         self.hb_pending: dict = {}     # osd -> first unacked ping stamp
         # cache tiering: base-pool IO runs on dedicated threads with an
@@ -548,6 +574,28 @@ class OSDDaemon(Dispatcher):
             # stream self-heals on the next period
             self.timer.add_event_after(period, self._mgr_report_tick)
 
+    def _profile_dump(self) -> dict:
+        """The `profile dump` asok payload: every profiler leg in one
+        document (what `ceph_cli daemon osd.N profile dump` renders)."""
+        from ..common.profiler import PROFILER
+        doc = PROFILER.dump()
+        if self.tpu_dispatcher is not None:
+            doc["dispatch"] = self.tpu_dispatcher.dispatch_profile()
+        tier = getattr(self, "hbm_tier", None)
+        if tier is not None:
+            try:
+                doc["hbm"] = tier.stats()
+            except Exception:
+                pass
+        return doc
+
+    def _profile_reset(self) -> dict:
+        from ..common.profiler import PROFILER
+        PROFILER.reset()
+        if self.tpu_dispatcher is not None:
+            self.tpu_dispatcher.profile_reset()
+        return {"reset": True}
+
     def _telemetry_status(self) -> dict:
         """The gauge bag riding MMgrReport.status: store capacity
         truth plus device-utilization (dispatch queue depth,
@@ -560,6 +608,13 @@ class OSDDaemon(Dispatcher):
         if self.tpu_dispatcher is not None:
             try:
                 status["tpu"] = self.tpu_dispatcher.telemetry()
+            except Exception:
+                pass
+            try:
+                # ring occupancy + stall attribution for the mgr's
+                # prometheus exposition (ceph_tpu_stage_* series)
+                status["dispatch"] = \
+                    self.tpu_dispatcher.dispatch_status()
             except Exception:
                 pass
         tier = getattr(self, "hbm_tier", None)
@@ -597,13 +652,36 @@ class OSDDaemon(Dispatcher):
         # it must go out even with no primary-PG stats so a wedged op
         # on a just-demoted primary still surfaces
         slow = self.op_tracker.slow_ops_count()
-        if not stats and not slow \
-                and not getattr(self, "_slow_reported", False):
+        # device-runtime health feeds ride the same report: in-window
+        # recompile count (DEVICE_RECOMPILE_STORM) and HBM tier
+        # occupancy (DEVICE_MEM_NEARFULL)
+        recompiles = 0
+        from ..common.profiler import PROFILER
+        if PROFILER.enabled:
+            try:
+                recompiles = PROFILER.storm_count()
+            except Exception:
+                pass
+        nearfull = 0.0
+        tier = getattr(self, "hbm_tier", None)
+        if tier is not None:
+            try:
+                occ = tier.occupancy()
+                if occ >= self.ctx.conf.get_val(
+                        "osd_hbm_nearfull_ratio"):
+                    nearfull = occ
+            except Exception:
+                pass
+        alerting = slow or recompiles or nearfull
+        if not stats and not alerting \
+                and not getattr(self, "_alert_reported", False):
             return
-        self._slow_reported = slow > 0
+        self._alert_reported = bool(alerting)
         from ..msg.message import MPGStats
         self._send_mon(MPGStats(osd_id=self.whoami, pg_stats=stats,
-                                epoch=self.map_epoch(), slow_ops=slow))
+                                epoch=self.map_epoch(), slow_ops=slow,
+                                recompiles=recompiles,
+                                mem_nearfull=nearfull))
 
     # -- dispatch ------------------------------------------------------
 
@@ -775,9 +853,20 @@ class OSDDaemon(Dispatcher):
             self.public_msgr.send_message(
                 MOSDOpReply(tid=msg.tid, result=result, data=data,
                             map_epoch=self.map_epoch()), client_addr)
-            op.mark_done()
             span.keyval("result", result)
             span.finish()
+            # flight recorder: snapshot the finished trace tree onto
+            # the op BEFORE mark_done files it into history — the
+            # historic dump keeps the cross-daemon tree even after the
+            # live span ring rolls over
+            if span.valid():
+                try:
+                    op.set_trace(span.trace_id,
+                                 self.tracer.dump(
+                                     trace_id=span.trace_id))
+                except Exception:
+                    pass
+            op.mark_done()
 
         if pg is None:
             op.mark_event("no_pg")

@@ -59,9 +59,15 @@ from collections import deque
 import numpy as np
 
 from ..common.perf_counters import PerfCountersBuilder
+from ..common.profiler import PROFILER
 from ..common.tracer import NULL_SPAN, device_segments
 
 __all__ = ["TpuDispatcher"]
+
+# pipeline stages in flow order; the three device stages carry the
+# bound-stage verdict, the collector carries the starvation verdict
+_STAGES = ("collector", "h2d", "compute", "d2h")
+_STATES = ("busy", "idle", "blocked")
 
 
 class _Pending:
@@ -100,7 +106,7 @@ class _Dispatch:
     """One fused device program moving through the pipeline stages."""
 
     __slots__ = ("key", "fn", "pend", "kind", "prefetch", "stacked",
-                 "dev", "out_dev", "t_take", "seg")
+                 "dev", "out_dev", "t_take", "seg", "mem_bytes")
 
     def __init__(self, key, fn, pend, kind, prefetch=None):
         self.key = key
@@ -113,6 +119,7 @@ class _Dispatch:
         self.out_dev = None          # device output
         self.t_take = time.monotonic()
         self.seg = {}                # stage -> (t_start, t_end)
+        self.mem_bytes = 0           # staged bytes on the mem ledger
 
 
 class _JaxDevOps:
@@ -144,6 +151,87 @@ class _HostDevOps:
 
     def d2h(self, out):
         return np.asarray(out)
+
+
+class _StageProf:
+    """Per-stage wall-clock state machine: every instant a stage thread
+    is in exactly one of busy (doing its leg's work) / idle (waiting on
+    its upstream ring) / blocked (waiting to push downstream).  enter()
+    folds the elapsed interval into the outgoing state's bucket;
+    snapshot() is non-destructive and folds the in-progress interval
+    in, so attribution is exact even mid-long-op."""
+
+    __slots__ = ("lock", "acc", "state", "since")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.acc = {s: 0.0 for s in _STATES}
+        self.state = "idle"
+        self.since = time.monotonic()
+
+    def enter(self, state: str, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self.lock:
+            self.acc[self.state] += max(0.0, now - self.since)
+            self.state = state
+            self.since = now
+
+    def credit(self, state: str, seconds: float) -> None:
+        """Direct accrual without a state switch (the depth-1 inline
+        path, which runs every leg on the collector thread)."""
+        with self.lock:
+            self.acc[state] += max(0.0, seconds)
+
+    def snapshot(self, now: float | None = None) -> dict:
+        now = time.monotonic() if now is None else now
+        with self.lock:
+            acc = dict(self.acc)
+            acc[self.state] += max(0.0, now - self.since)
+        return acc
+
+    def reset(self, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self.lock:
+            for s in self.acc:
+                self.acc[s] = 0.0
+            self.since = now
+
+
+class _RingQueue(queue.Queue):
+    """Bounded stage ring with an occupancy time-integral: each mutation
+    advances integral(qsize dt), so integral/wall is the ring's average
+    occupancy over the profile window — the queue-theory complement to
+    the stage state machine (a persistently full staging ring + an idle
+    compute stage reads 'h2d-bound' before anyone eyeballs thread
+    stacks).  _put/_get run under queue.Queue's own mutex."""
+
+    def __init__(self, maxsize: int):
+        super().__init__(maxsize)
+        self._occ_integral = 0.0
+        self._occ_t_last = time.monotonic()
+
+    def _advance_locked(self, now: float) -> None:
+        self._occ_integral += len(self.queue) \
+            * max(0.0, now - self._occ_t_last)
+        self._occ_t_last = now
+
+    def _put(self, item) -> None:
+        self._advance_locked(time.monotonic())
+        super()._put(item)
+
+    def _get(self):
+        self._advance_locked(time.monotonic())
+        return super()._get()
+
+    def occupancy_integral(self) -> float:
+        with self.mutex:
+            self._advance_locked(time.monotonic())
+            return self._occ_integral
+
+    def occupancy_reset(self) -> None:
+        with self.mutex:
+            self._occ_integral = 0.0
+            self._occ_t_last = time.monotonic()
 
 
 class TpuDispatcher:
@@ -203,23 +291,35 @@ class TpuDispatcher:
                                       "bytes through device decode")
                      .add_u64_counter("l_tpu_donated",
                                       "dispatches whose staged input "
-                                      "was donated to the program")
-                     .create_perf_counters())
+                                      "was donated to the program"))
+        # stall-attribution counters: cumulative per-stage wall time in
+        # each state, synced from the _StageProf machines on telemetry
+        # ticks so they ride MMgrReport -> mgr -> prometheus
+        for stage in _STAGES:
+            for state in _STATES:
+                self.perf.add_time(
+                    "l_tpu_stage_%s_%s" % (stage, state),
+                    "%s stage wall seconds %s" % (stage, state))
+        self.perf = self.perf.create_perf_counters()
         # device leg implementations (tests substitute a fake here)
         self._jax = self._probe_jax()
         self._devops = _JaxDevOps() if self._jax else _HostDevOps()
         self._donate_fns: dict = {}   # key -> jitted donating fn | False
         self._donate_ok = self._probe_donation()
+        # stall attribution: one state machine per pipeline stage plus
+        # the profile window anchor (profile_reset() restarts both)
+        self._stage_prof = {s: _StageProf() for s in _STAGES}
+        self._profile_t0 = time.monotonic()
         self._stop = False
         self._threads: list = []
         if self.pipeline_depth > 1:
             # the staging ring: bounded hand-off queues between stages.
             # depth bounds how many fused batches are in flight per
             # stage; the collector blocks when the ring is full.
-            self._q_h2d: queue.Queue = queue.Queue(self.pipeline_depth)
-            self._q_compute: queue.Queue = queue.Queue(
+            self._q_h2d: queue.Queue = _RingQueue(self.pipeline_depth)
+            self._q_compute: queue.Queue = _RingQueue(
                 self.pipeline_depth)
-            self._q_d2h: queue.Queue = queue.Queue(self.pipeline_depth)
+            self._q_d2h: queue.Queue = _RingQueue(self.pipeline_depth)
             for name, fn in (("tpu-h2d", self._h2d_loop),
                              ("tpu-compute", self._compute_loop),
                              ("tpu-d2h", self._d2h_loop)):
@@ -423,7 +523,72 @@ class TpuDispatcher:
                     "h2d_avg": self.perf.avg("l_tpu_h2d"),
                     "compute_avg": self.perf.avg("l_tpu_compute"),
                     "d2h_avg": self.perf.avg("l_tpu_d2h"),
-                    "queue_avg": self.perf.avg("l_tpu_dispatch_queue")}}
+                    "queue_avg": self.perf.avg("l_tpu_dispatch_queue")},
+                "profile": self.dispatch_profile()}
+
+    def dispatch_profile(self) -> dict:
+        """Stall attribution over the current profile window: per-stage
+        busy/idle/blocked wall seconds and fractions, ring occupancy
+        time-averages, and a one-line verdict.
+
+        The verdict logic: the device stage with the highest busy
+        fraction is the wall ("h2d-bound 71%") — unless no stage is
+        busy even half the window AND the collector out-idles it, in
+        which case the device isn't the problem, the feed is
+        ("collector-starved 88%": submitters aren't producing work)."""
+        now = time.monotonic()
+        wall = max(1e-9, now - self._profile_t0)
+        stages = {}
+        for name, prof in self._stage_prof.items():
+            acc = prof.snapshot(now)
+            row = {}
+            for state in _STATES:
+                row[state + "_s"] = round(acc[state], 6)
+                row[state + "_frac"] = round(
+                    min(1.0, acc[state] / wall), 4)
+            stages[name] = row
+            # cumulative counters ride MMgrReport with the next tick
+            for state in _STATES:
+                self.perf.set("l_tpu_stage_%s_%s" % (name, state),
+                              acc[state])
+        occupancy = {"staging": 0.0, "computing": 0.0, "draining": 0.0}
+        if self.pipeline_depth > 1:
+            occupancy = {
+                "staging": round(
+                    self._q_h2d.occupancy_integral() / wall, 4),
+                "computing": round(
+                    self._q_compute.occupancy_integral() / wall, 4),
+                "draining": round(
+                    self._q_d2h.occupancy_integral() / wall, 4)}
+        device = ("h2d", "compute", "d2h")
+        bound = max(device, key=lambda s: stages[s]["busy_frac"])
+        attribution = stages[bound]["busy_frac"]
+        collector_idle = stages["collector"]["idle_frac"]
+        if attribution < 0.5 and collector_idle > attribution:
+            bound = "collector"
+            attribution = collector_idle
+            verdict = "collector-starved %d%%" \
+                % round(collector_idle * 100)
+        else:
+            verdict = "%s-bound %d%%" % (bound,
+                                         round(attribution * 100))
+        return {"window_s": round(wall, 6),
+                "verdict": verdict,
+                "bound": bound,
+                "attribution": attribution,
+                "stages": stages,
+                "queue_occupancy_avg": occupancy}
+
+    def profile_reset(self) -> None:
+        """Restart the attribution window (asok `profile reset`)."""
+        now = time.monotonic()
+        for prof in self._stage_prof.values():
+            prof.reset(now)
+        self._profile_t0 = now
+        if self.pipeline_depth > 1:
+            self._q_h2d.occupancy_reset()
+            self._q_compute.occupancy_reset()
+            self._q_d2h.occupancy_reset()
 
     def shutdown(self) -> None:
         with self.cv:
@@ -490,10 +655,15 @@ class TpuDispatcher:
     def _run(self):
         """Collector: group submitters into fused dispatches and feed
         the pipeline (or, depth 1, run the legacy synchronous loop)."""
+        prof = self._stage_prof["collector"]
         while True:
+            # idle = waiting for submitters (or stragglers): a starved
+            # collector is the "upstream can't feed the device" verdict
+            prof.enter("idle")
             d = self._take_group()
             if d is None:
                 return
+            prof.enter("busy")
             self.stats["dispatches"] += 1
             self.perf.inc("l_tpu_dispatches")
             self.perf.inc("l_tpu_ops", len(d.pend))
@@ -503,6 +673,7 @@ class TpuDispatcher:
             if self.pipeline_depth > 1:
                 # blocks when the staging ring is full: that back-
                 # pressure IS the depth-N bound
+                prof.enter("blocked")
                 self._q_h2d.put(d)
             else:
                 self._dispatch_inline(d)
@@ -530,6 +701,13 @@ class TpuDispatcher:
                 d.seg = {"h2d": (t_start, t1), "compute": (t1, t2),
                          "d2h": (t2, t2 + seg["d2h"])}
                 self._account(d)
+                # depth-1 runs every leg on the collector thread; the
+                # per-stage machines never switch state, so credit the
+                # measured segments directly (attribution still works
+                # on the legacy synchronous path when instrumented)
+                for stage in ("h2d", "compute", "d2h"):
+                    a, b = d.seg[stage]
+                    self._stage_prof[stage].credit("busy", b - a)
         except BaseException as e:   # deliver, don't kill the loop
             for p in d.pend:
                 p.error = e
@@ -541,21 +719,29 @@ class TpuDispatcher:
     def _fail(self, d: _Dispatch, e: BaseException) -> None:
         """Strict per-batch error propagation: the failed stage fails
         ONLY this fused batch's submitters; later batches proceed."""
+        if d.mem_bytes:
+            PROFILER.mem_sub("staging_ring", d.mem_bytes)
+            d.mem_bytes = 0
         for p in d.pend:
             p.error = e
             p.event.set()
 
     def _h2d_loop(self) -> None:
+        prof = self._stage_prof["h2d"]
         while True:
+            prof.enter("idle")
             d = self._q_h2d.get()
             if d is None:
                 self._q_compute.put(None)
                 return
+            prof.enter("busy")
             try:
                 t0 = time.monotonic()
                 d.stacked = d.pend[0].batch if len(d.pend) == 1 \
                     else np.concatenate([p.batch for p in d.pend])
                 d.dev = self._devops.h2d(d.stacked)
+                d.mem_bytes = int(getattr(d.stacked, "nbytes", 0))
+                PROFILER.mem_add("staging_ring", d.mem_bytes)
                 if d.prefetch is not None:
                     # decode-table staging rides the h2d stage: the
                     # inversion + bitmatrix upload of THIS dispatch
@@ -565,14 +751,18 @@ class TpuDispatcher:
             except BaseException as e:
                 self._fail(d, e)
                 continue
+            prof.enter("blocked")
             self._q_compute.put(d)
 
     def _compute_loop(self) -> None:
+        prof = self._stage_prof["compute"]
         while True:
+            prof.enter("idle")
             d = self._q_compute.get()
             if d is None:
                 self._q_d2h.put(None)
                 return
+            prof.enter("busy")
             try:
                 t0 = time.monotonic()
                 d.out_dev = self._run_compute(d)
@@ -580,13 +770,17 @@ class TpuDispatcher:
             except BaseException as e:
                 self._fail(d, e)
                 continue
+            prof.enter("blocked")
             self._q_d2h.put(d)
 
     def _d2h_loop(self) -> None:
+        prof = self._stage_prof["d2h"]
         while True:
+            prof.enter("idle")
             d = self._q_d2h.get()
             if d is None:
                 return
+            prof.enter("busy")
             try:
                 t0 = time.monotonic()
                 out = self._devops.d2h(d.out_dev)
@@ -597,6 +791,10 @@ class TpuDispatcher:
             except BaseException as e:
                 self._fail(d, e)
                 continue
+            finally:
+                if d.mem_bytes:
+                    PROFILER.mem_sub("staging_ring", d.mem_bytes)
+                    d.mem_bytes = 0
             for p in d.pend:
                 p.event.set()
 
@@ -615,6 +813,7 @@ class TpuDispatcher:
         # new pattern, exactly the cost the table bank exists to avoid
         if self._donate_ok and d.kind == "enc" and not wants_adopt:
             dfn = self._donate_fns.get(d.key)
+            fresh_trace = dfn is None
             if dfn is None:
                 import jax
                 if len(self._donate_fns) >= 256:
@@ -625,7 +824,21 @@ class TpuDispatcher:
                     d.key, jax.jit(d.fn, donate_argnums=(0,)))
             if dfn is not False:
                 try:
-                    out = self._devops.run(dfn, d.dev)
+                    nbytes = int(getattr(d.dev, "nbytes", 0))
+                    PROFILER.mem_add("donated_buffers", nbytes)
+                    try:
+                        t0 = time.perf_counter()
+                        out = self._devops.run(dfn, d.dev)
+                        if fresh_trace and PROFILER.enabled:
+                            # first run of a fresh donate fn IS its
+                            # trace+compile; register the event so the
+                            # storm detector sees dispatcher churn too
+                            PROFILER.record_compile(
+                                "tpu_dispatch.donate",
+                                ("key", hash(d.key)),
+                                time.perf_counter() - t0)
+                    finally:
+                        PROFILER.mem_sub("donated_buffers", nbytes)
                     self.perf.inc("l_tpu_donated")
                     return out
                 except BaseException:

@@ -61,6 +61,8 @@ def encode_sharded(codec, data, mesh):
         parity = xor_mm.matrix_encode(bm, x, codec.w)
         return jax.lax.with_sharding_constraint(parity, out_sharding)
 
+    from ..common.profiler import PROFILER
+    step = PROFILER.wrap_jit("mesh.encode_sharded", step)
     return step(bitmat, jnp.asarray(data))
 
 
@@ -90,4 +92,6 @@ def decode_sharded(codec, avail_rows, chunks, mesh):
         full = xor_mm.matrix_encode(bm, x, codec.w)
         return jax.lax.with_sharding_constraint(full, out_sharding)
 
+    from ..common.profiler import PROFILER
+    step = PROFILER.wrap_jit("mesh.decode_sharded", step)
     return step(bitmat, jnp.asarray(chunks))

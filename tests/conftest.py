@@ -30,6 +30,24 @@ from ceph_tpu.common import lockdep  # noqa: E402
 
 lockdep.enable()
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _quiesce_device_profiler():
+    """Drop leaked jit-compile events between tests.
+
+    In production every OSD is its own process, so the process-global
+    PROFILER only ever sees one daemon's kernels. The test suite runs
+    hundreds of shape-varied codec tests in ONE process; their
+    perfectly legitimate compiles pool in the shared storm window and
+    any cluster started later reports DEVICE_RECOMPILE_STORM, turning
+    unrelated HEALTH_OK assertions flaky. Reset rebases the window
+    (live mem bytes are kept — they are residency, not statistics)."""
+    from ceph_tpu.common.profiler import PROFILER
+    PROFILER.reset()
+    yield
+
 
 def pytest_configure(config):
     # tier-1 runs `-m 'not slow'`: register the marker so stress-scale

@@ -238,6 +238,33 @@ class TestRadosCli:
         assert r.returncode == 1
         assert "error" in json.loads(r.stdout)
 
+    def test_ceph_daemon_profiler_surface(self, vstart_cluster):
+        """`profile dump` / `profile reset` / `dispatch profile`: the
+        device-runtime profiler's admin-socket commands."""
+        monmap, asok_dir = vstart_cluster
+        asok = os.path.join(asok_dir, "osd.1.asok")
+        assert os.path.exists(asok), os.listdir(asok_dir)
+        r = ceph(monmap, "daemon", asok, "profile", "dump")
+        assert r.returncode == 0, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert "kernels" in doc and "memory" in doc
+        assert "recompile_storm" in doc
+        assert "total_bytes" in doc["memory"]
+        r = ceph(monmap, "daemon", asok, "dispatch", "profile")
+        assert r.returncode == 0, r.stdout + r.stderr
+        prof = json.loads(r.stdout)
+        assert "verdict" in prof and "stages" in prof
+        assert set(prof["stages"]) == {"collector", "h2d", "compute",
+                                       "d2h"}
+        r = ceph(monmap, "daemon", asok, "profile", "reset")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert json.loads(r.stdout).get("reset") is True
+        # historic-ops dump carries both flight-recorder rings
+        r = ceph(monmap, "daemon", asok, "dump_historic_ops")
+        assert r.returncode == 0, r.stdout + r.stderr
+        hist = json.loads(r.stdout)
+        assert "slowest_ops" in hist and "num_slowest" in hist
+
     def test_bench_write_then_seq(self, vstart_cluster):
         monmap, _ = vstart_cluster
         assert rados(monmap, "mkpool", "benchpool").returncode == 0

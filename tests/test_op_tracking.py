@@ -175,3 +175,112 @@ class TestOsdIntegration:
             assert wait_until(spans_flushed)
         finally:
             cluster.stop()
+
+class TestFlightRecorder:
+    """Historic-ops flight recorder: the slowest-N ring survives fast
+    bursts, and completed ops retain their trace trees past the point
+    the live span ring would have rolled them out."""
+
+    def test_slowest_ring_survives_fast_burst(self):
+        t = OpTracker(history_size=5, slow_size=3)
+        outlier = t.create_request("outlier")
+        outlier.initiated_mono -= 3.0
+        outlier.mark_done()
+        for i in range(12):
+            t.create_request("fast%d" % i).mark_done()
+        hist = t.dump_historic_ops()
+        # the recent ring flushed the outlier ...
+        assert hist["num_ops"] == 5
+        assert all(o["description"].startswith("fast")
+                   for o in hist["ops"])
+        # ... but the slowest ring kept it, slowest-first
+        assert hist["num_slowest"] >= 1
+        assert hist["slowest_ops"][0]["description"] == "outlier"
+        assert hist["slowest_ops"][0]["duration"] >= 3.0
+
+    def test_slowest_ring_bounded_and_sorted(self):
+        t = OpTracker(slow_size=3)
+        for i in range(6):
+            op = t.create_request("op%d" % i)
+            op.initiated_mono -= i * 0.5
+            op.mark_done()
+        slowest = t.dump_historic_ops()["slowest_ops"]
+        assert len(slowest) == 3
+        durs = [o["duration"] for o in slowest]
+        assert durs == sorted(durs, reverse=True)
+        assert slowest[0]["description"] == "op5"
+
+    def test_by_duration_merges_both_rings(self):
+        """An outlier only the slowest ring still holds ranks first in
+        dump_historic_ops_by_duration, deduped against the recent
+        ring."""
+        t = OpTracker(history_size=2, slow_size=2)
+        slow = t.create_request("slowest")
+        slow.initiated_mono -= 5.0
+        slow.mark_done()
+        for i in range(4):
+            t.create_request("quick%d" % i).mark_done()
+        doc = t.dump_historic_ops_by_duration()
+        assert doc["ops"][0]["description"] == "slowest"
+        ids = [o["id"] for o in doc["ops"]]
+        assert len(ids) == len(set(ids))    # dedup by op id
+
+    def test_trace_snapshot_retained_in_dump(self):
+        t = OpTracker()
+        op = t.create_request("traced")
+        op.set_trace(77, [{"name": "osd_op", "span_id": 1},
+                          {"name": "pg_do_op", "span_id": 2,
+                           "parent_id": 1}])
+        op.mark_done()
+        doc = t.dump_historic_ops()["ops"][0]
+        trace = doc["type_data"]["trace"]
+        assert trace["trace_id"] == 77
+        assert [s["name"] for s in trace["spans"]] == \
+            ["osd_op", "pg_do_op"]
+        # untraced ops carry no trace key at all
+        t.create_request("plain").mark_done()
+        plain = t.dump_historic_ops()["ops"][-1]
+        assert "trace" not in plain["type_data"]
+
+    def test_cluster_op_retains_trace_tree_past_completion(self):
+        """End-to-end: a traced client write's historic-op entry keeps
+        its span tree AFTER completion, even once the live tracer ring
+        has rolled over — the flight-recorder acceptance path."""
+        from .cluster_util import MiniCluster, wait_until
+        FAST = {"osd_heartbeat_interval": 0.1,
+                "osd_heartbeat_grace": 0.6,
+                "mon_osd_down_out_interval": 1.0,
+                "paxos_propose_interval": 0.02,
+                "trace_enable": True}
+        cluster = MiniCluster(num_mons=1, num_osds=3,
+                              conf_overrides=FAST).start()
+        try:
+            client = cluster.client()
+            cluster.create_replicated_pool(client, "flightrec", size=2,
+                                           pg_num=4)
+            ioctx = client.open_ioctx("flightrec")
+            ioctx.write_full("fr", b"flight recorder payload")
+            assert ioctx.read("fr") == b"flight recorder payload"
+
+            def traced_hist_ops():
+                return [
+                    o for osd in cluster.osds.values()
+                    for o in osd.op_tracker.dump_historic_ops()["ops"]
+                    if "trace" in o["type_data"]]
+            assert wait_until(lambda: len(traced_hist_ops()) >= 1)
+            doc = traced_hist_ops()[0]
+            trace = doc["type_data"]["trace"]
+            assert trace["trace_id"] is not None
+            names = [s["name"] for s in trace["spans"]]
+            assert "osd_op" in names
+            # spans in the snapshot all belong to THIS op's trace
+            assert {s["trace_id"] for s in trace["spans"]} == \
+                {trace["trace_id"]}
+            # flood the live rings: the retained snapshot must not care
+            for osd in cluster.osds.values():
+                for i in range(osd.tracer.capacity + 8):
+                    osd.tracer.start_trace("filler%d" % i).finish()
+            still = traced_hist_ops()[0]["type_data"]["trace"]
+            assert [s["name"] for s in still["spans"]] == names
+        finally:
+            cluster.stop()

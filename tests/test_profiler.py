@@ -1,0 +1,297 @@
+"""Device-runtime profiler (common/profiler.py) + its health plumbing.
+
+Unit coverage for the DeviceProfiler registry (shape-signature
+compile/hit accounting, recompile-storm detection, the device-memory
+ledger) and cluster round trips for the two health checks it feeds:
+DEVICE_RECOMPILE_STORM (shape churn -> MPGStats -> mon) and
+DEVICE_MEM_NEARFULL (HBM tier occupancy over osd_hbm_nearfull_ratio).
+Also the perf-schema drift walk: every counter a daemon dumps at
+runtime must be declared in `perf schema` with a valid kind.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.profiler import DeviceProfiler, PROFILER
+from ceph_tpu.common.perf_counters import (
+    U64, U64_COUNTER, TIME, TIME_AVG, U64_AVG, HISTOGRAM)
+
+FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+        "mon_osd_down_out_interval": 1.0,
+        "paxos_propose_interval": 0.02}
+
+
+class TestWrapJit:
+    def test_fresh_signature_is_compile_then_cache_hits(self):
+        p = DeviceProfiler()
+        calls = []
+        fn = p.wrap_jit("t.k", lambda x: calls.append(x) or x.sum())
+        a = np.zeros((2, 4), np.uint8)
+        fn(a)
+        fn(a)
+        fn(np.ones((2, 4), np.uint8))   # same shape+dtype: still a hit
+        k = p.dump()["kernels"]["t.k"]
+        assert k["compiles"] == 1
+        assert k["cache_hits"] == 2
+        assert k["num_signatures"] == 1
+        assert k["compile_wall_s"] >= 0
+        assert len(calls) == 3          # the wrapped fn always runs
+
+    def test_distinct_shapes_are_distinct_signatures(self):
+        p = DeviceProfiler()
+        fn = p.wrap_jit("t.k", lambda x: x)
+        for n in (1, 2, 3):
+            fn(np.zeros(n, np.uint8))
+        fn(np.zeros(2, np.uint8))       # revisit: hit, not compile
+        k = p.dump()["kernels"]["t.k"]
+        assert k["compiles"] == 3
+        assert k["cache_hits"] == 1
+        assert k["num_signatures"] == 3
+
+    def test_scalars_and_kwargs_participate_in_signature(self):
+        p = DeviceProfiler()
+        fn = p.wrap_jit("t.k", lambda x, n=0: x)
+        a = np.zeros(4, np.uint8)
+        fn(a, n=1)
+        fn(a, n=2)                      # static arg changed: recompile
+        fn(a, n=1)                      # seen: hit
+        k = p.dump()["kernels"]["t.k"]
+        assert k["compiles"] == 2 and k["cache_hits"] == 1
+
+    def test_disabled_profiler_records_nothing(self):
+        p = DeviceProfiler()
+        p.enabled = False
+        fn = p.wrap_jit("t.k", lambda x: x * 2)
+        out = fn(np.full(3, 7, np.uint8))
+        assert (out == 14).all()        # transparent passthrough
+        assert p.dump()["kernels"] == {}
+        p.mem_add("hbm_tier", 100)
+        assert p.mem_dump()["total_bytes"] == 0
+
+
+class TestStormDetector:
+    def test_storm_trips_at_threshold_within_window(self):
+        p = DeviceProfiler(recompile_window=60.0, recompile_threshold=3)
+        fn = p.wrap_jit("churny", lambda x: x)
+        for n in range(1, 5):
+            fn(np.zeros(n, np.uint8))
+        rep = p.storm_report()
+        assert rep["storming"] and rep["kernel"] == "churny"
+        assert rep["count"] == 4
+        assert p.storm_count() == 4
+
+    def test_calm_kernel_below_threshold(self):
+        p = DeviceProfiler(recompile_threshold=10)
+        fn = p.wrap_jit("calm", lambda x: x)
+        for n in range(1, 4):
+            fn(np.zeros(n, np.uint8))
+        assert not p.storm_report()["storming"]
+        assert p.storm_count() == 0
+
+    def test_per_kernel_thresholding(self):
+        """The storm verdict names the WORST kernel; a stable kernel's
+        single compile never pools with another kernel's churn."""
+        p = DeviceProfiler(recompile_threshold=3)
+        churn = p.wrap_jit("churny", lambda x: x)
+        stable = p.wrap_jit("stable", lambda x: x)
+        stable(np.zeros(8, np.uint8))
+        for n in range(1, 5):
+            churn(np.zeros(n, np.uint8))
+        rep = p.storm_report()
+        assert rep["kernel"] == "churny" and rep["count"] == 4
+
+    def test_events_outside_window_expire(self):
+        p = DeviceProfiler(recompile_window=0.5, recompile_threshold=2)
+        p.record_compile("old", ("sig",), 0.0)
+        import time
+        rep = p.storm_report(now=time.monotonic() + 1.0)
+        assert rep["count"] == 0 and not rep["storming"]
+
+    def test_reset_clears_registry_and_events(self):
+        p = DeviceProfiler(recompile_threshold=1)
+        fn = p.wrap_jit("k", lambda x: x)
+        fn(np.zeros(2, np.uint8))
+        assert p.storm_count() >= 1
+        p.reset()
+        assert p.storm_count() == 0
+        assert p.dump()["kernels"] == {}
+
+
+class TestMemLedger:
+    def test_add_sub_and_high_watermark(self):
+        p = DeviceProfiler()
+        p.mem_add("staging_ring", 100)
+        p.mem_add("staging_ring", 50)
+        p.mem_sub("staging_ring", 120)
+        d = p.mem_dump()["staging_ring"]
+        assert d["bytes"] == 30 and d["high_watermark"] == 150
+
+    def test_sub_floors_at_zero(self):
+        p = DeviceProfiler()
+        p.mem_add("donated_buffers", 10)
+        p.mem_sub("donated_buffers", 999)
+        assert p.mem_dump()["donated_buffers"]["bytes"] == 0
+
+    def test_set_is_a_gauge(self):
+        p = DeviceProfiler()
+        p.mem_set("decode_tables", 400)
+        p.mem_set("decode_tables", 100)
+        d = p.mem_dump()["decode_tables"]
+        assert d["bytes"] == 100 and d["high_watermark"] == 400
+
+    def test_total_sums_categories(self):
+        p = DeviceProfiler()
+        p.mem_set("hbm_tier", 70)
+        p.mem_set("decode_tables", 30)
+        assert p.mem_dump()["total_bytes"] == 100
+
+    def test_reset_keeps_live_bytes_rebases_watermark(self):
+        """Live bytes are real residency, not statistics: `profile
+        reset` must not zero them, only rebase the watermark."""
+        p = DeviceProfiler()
+        p.mem_add("hbm_tier", 500)
+        p.mem_sub("hbm_tier", 300)
+        p.reset()
+        d = p.mem_dump()["hbm_tier"]
+        assert d["bytes"] == 200 and d["high_watermark"] == 200
+
+
+class TestDumpShape:
+    def test_dump_carries_every_section(self):
+        p = DeviceProfiler()
+        fn = p.wrap_jit("k", lambda x: x)
+        fn(np.zeros(2, np.uint8))
+        p.mem_add("hbm_tier", 1)
+        doc = p.dump()
+        assert doc["enabled"] is True
+        assert set(doc) == {"enabled", "kernels", "recompile_storm",
+                            "memory"}
+        sig = doc["kernels"]["k"]["signatures"][0]
+        assert {"sig", "compiles", "compile_wall_s",
+                "cache_hits"} <= set(sig)
+
+
+def _health_checks(client):
+    res, _, data = client.mon_command({"prefix": "health"})
+    assert res == 0
+    return data["checks"]
+
+
+class TestRecompileStormHealth:
+    def test_shape_churn_raises_and_clears_storm_check(self):
+        """Forced shape churn on a registered kernel trips
+        DEVICE_RECOMPILE_STORM in `ceph health` via the MPGStats feed,
+        and a calm window (profile reset) retires it."""
+        from .cluster_util import MiniCluster, wait_until
+        conf = dict(FAST, osd_profiler_recompile_threshold=4,
+                    osd_profiler_recompile_window=60.0)
+        prev = (PROFILER.enabled, PROFILER.recompile_window,
+                PROFILER.recompile_threshold)
+        cluster = MiniCluster(num_mons=1, num_osds=3,
+                              conf_overrides=conf).start()
+        try:
+            client = cluster.client()
+            # clean slate: compiles from earlier tests in this process
+            # must not pre-trip the window
+            PROFILER.reset()
+            churn = PROFILER.wrap_jit("test.storm_kernel", lambda x: x)
+            for n in range(1, 8):       # 7 fresh shapes >> threshold 4
+                churn(np.zeros(n, np.uint8))
+            assert PROFILER.storm_count() >= 4
+            assert wait_until(
+                lambda: "DEVICE_RECOMPILE_STORM"
+                in _health_checks(client), timeout=20)
+            check = _health_checks(client)["DEVICE_RECOMPILE_STORM"]
+            assert check["severity"] == "warning"
+            assert any("osd." in d and "recompiled" in d
+                       for d in check["detail"])
+            # calm window: reset the registry; the osds re-report 0 and
+            # the mon retires the check
+            PROFILER.reset()
+            assert wait_until(
+                lambda: "DEVICE_RECOMPILE_STORM"
+                not in _health_checks(client), timeout=20)
+        finally:
+            PROFILER.reset()
+            (PROFILER.enabled, PROFILER.recompile_window,
+             PROFILER.recompile_threshold) = prev
+            cluster.stop()
+
+
+class TestMemNearfullHealth:
+    def test_hbm_tier_pressure_raises_and_clears_nearfull(self):
+        """Filling the HBM chunk tier past osd_hbm_nearfull_ratio
+        raises DEVICE_MEM_NEARFULL; dropping residency clears it."""
+        from .cluster_util import MiniCluster, wait_until
+        conf = dict(FAST, osd_hbm_tier_capacity=8)
+        cluster = MiniCluster(num_mons=1, num_osds=3,
+                              conf_overrides=conf).start()
+        try:
+            client = cluster.client()
+            tier = cluster.osds[0].hbm_tier
+            if tier is None:
+                pytest.skip("hbm tier unavailable in this environment")
+            data = np.zeros((1, 2, 128), np.uint8)
+            parity = np.zeros((1, 1, 128), np.uint8)
+            for i in range(8):
+                tier.adopt_encode("nf-%d" % i, data, parity, None)
+            assert tier.occupancy() >= 0.85
+            assert wait_until(
+                lambda: "DEVICE_MEM_NEARFULL"
+                in _health_checks(client), timeout=20)
+            check = _health_checks(client)["DEVICE_MEM_NEARFULL"]
+            assert check["severity"] == "warning"
+            assert any("osd.0" in d and "full" in d
+                       for d in check["detail"])
+            for i in range(8):
+                tier.drop("nf-%d" % i)
+            assert tier.occupancy() == 0.0
+            assert wait_until(
+                lambda: "DEVICE_MEM_NEARFULL"
+                not in _health_checks(client), timeout=20)
+        finally:
+            cluster.stop()
+
+
+class TestPerfSchemaDrift:
+    VALID_KINDS = {U64, U64_COUNTER, TIME, TIME_AVG, U64_AVG,
+                   HISTOGRAM}
+
+    def test_every_runtime_counter_is_in_schema_with_valid_kind(self):
+        """Walk every PerfCounters logger a live OSD dumps after real
+        IO: each counter must appear in `perf schema` under the same
+        logger with one of the declared kinds — a counter registered
+        outside the builder (or a kind typo) fails here instead of
+        silently rendering wrong in the mgr exposition."""
+        from .cluster_util import MiniCluster
+        cluster = MiniCluster(num_mons=1, num_osds=3,
+                              conf_overrides=FAST).start()
+        try:
+            client = cluster.client()
+            cluster.create_replicated_pool(client, "schemadrift",
+                                           size=2, pg_num=4)
+            ioctx = client.open_ioctx("schemadrift")
+            for i in range(4):
+                ioctx.write_full("o%d" % i, b"x" * 4096)
+                assert ioctx.read("o%d" % i) == b"x" * 4096
+            for osd_id, osd in cluster.osds.items():
+                dump = osd.ctx.perf.perf_dump()
+                schema = osd.ctx.perf.perf_schema()
+                assert dump, "osd.%d dumps no loggers" % osd_id
+                for logger, counters in dump.items():
+                    assert logger in schema, logger
+                    for name in counters:
+                        assert name in schema[logger], (logger, name)
+                        kind = schema[logger][name]["type"]
+                        assert kind in self.VALID_KINDS, \
+                            (logger, name, kind)
+                # the new stage counters are part of the walk
+                tpu = [lg for lg in dump if "tpu" in lg]
+                if tpu:
+                    assert any(
+                        "l_tpu_stage_h2d_busy" in dump[lg]
+                        for lg in tpu)
+        finally:
+            cluster.stop()

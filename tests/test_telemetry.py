@@ -564,6 +564,16 @@ class TestLiveTelemetry:
         assert 'ceph_tpu_dispatch_queue_depth{ceph_daemon="osd.0"}' \
             in text
         assert "ceph_tpu_codec_encode_MBps" in text   # codec label leg
+        # stall-attribution series from the dispatch profile window
+        assert ('ceph_tpu_stage_ring_occupancy{ceph_daemon="osd.0",'
+                'stage="staging"}') in text
+        assert ('ceph_tpu_stage_busy_seconds{ceph_daemon="osd.0",'
+                'stage="compute"}') in text
+        assert ('ceph_tpu_stage_idle_seconds{ceph_daemon="osd.0",'
+                'stage="collector"}') in text
+        # hbm chunk-tier residency series
+        assert "ceph_hbm_occupancy_ratio" in text
+        assert "ceph_hbm_capacity_objects" in text
 
     def test_balancer_records_and_selects_backend(self,
                                                   telemetry_cluster):
