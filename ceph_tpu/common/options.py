@@ -103,6 +103,12 @@ def _declare_defaults():
       "max ops fused into one device dispatch")
     o("osd_tpu_coalesce_max_delay_ms", float, 1.0, LEVEL_ADVANCED,
       "max milliseconds an op waits for batch-mates before dispatch")
+    o("osd_device_index", int, -1, LEVEL_ADVANCED,
+      "home device for this OSD's dispatcher/HBM tier pipeline "
+      "(parallel/placement.py; ROADMAP direction D): an index into "
+      "jax.local_devices() (modulo the device count); -1 = round-robin "
+      "by osd id, so an 8-OSD MiniCluster on an 8-chip mesh lands one "
+      "OSD per chip without per-daemon conf")
     o("osd_tpu_pipeline_depth", int, 2, LEVEL_ADVANCED,
       "fused batches in flight per dispatcher pipeline stage: h2d of "
       "batch n+1 overlaps compute of n and d2h of n-1 "

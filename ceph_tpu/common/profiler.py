@@ -44,16 +44,37 @@ MEM_CATEGORIES = ("hbm_tier", "staging_ring", "donated_buffers",
                   "decode_tables")
 
 
+def _placement_token(a):
+    """Device/sharding component of an array's signature.  jax keys its
+    trace cache on committed placement and sharding as well as shape:
+    the same (shape, dtype) on a second device is a fresh compile, and
+    folding it into one signature would report false cache hits on one
+    side and phantom recompile storms on the other.  Host arrays (no
+    `.sharding`) contribute nothing, keeping their signatures stable."""
+    sh = getattr(a, "sharding", None)
+    if sh is None:
+        return None
+    try:
+        devs = sorted("%s:%d" % (d.platform, d.id) for d in a.devices())
+        token = ",".join(devs) if len(devs) <= 8 else "%dxdev" % len(devs)
+        return (type(sh).__name__, str(getattr(sh, "spec", "")), token)
+    except Exception:
+        return type(sh).__name__
+
+
 def _shape_sig(args, kwargs):
-    """Cheap shape signature: (shape, dtype) per array-like argument,
-    repr-type for scalars/statics.  Two calls with the same signature
-    hit the same jit trace-cache entry; a fresh signature is (to first
-    order) a fresh trace/compile — which is exactly the event the
-    storm detector wants, without hooking XLA internals."""
+    """Cheap shape signature: (shape, dtype[, placement]) per
+    array-like argument, repr-type for scalars/statics.  Two calls with
+    the same signature hit the same jit trace-cache entry; a fresh
+    signature is (to first order) a fresh trace/compile — which is
+    exactly the event the storm detector wants, without hooking XLA
+    internals."""
     def one(a):
         shape = getattr(a, "shape", None)
         if shape is not None:
-            return (tuple(shape), str(getattr(a, "dtype", "")))
+            sig = (tuple(shape), str(getattr(a, "dtype", "")))
+            placement = _placement_token(a)
+            return sig if placement is None else sig + (placement,)
         if isinstance(a, (int, float, bool, str, bytes, type(None))):
             return a
         return type(a).__name__

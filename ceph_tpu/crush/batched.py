@@ -35,6 +35,18 @@ from .map import (CRUSH_ITEM_NONE, CRUSH_ITEM_UNDEF, CrushMap,
 S64_MIN = -(1 << 63)
 
 
+def _enable_x64():
+    """`jax.enable_x64()` with a fallback to the jax.experimental spelling
+    (the top-level alias comes and goes across jax releases; without the
+    shim the whole device CRUSH path dies on AttributeError)."""
+    import jax
+    try:
+        return jax.enable_x64()
+    except AttributeError:
+        from jax.experimental import enable_x64
+        return enable_x64()
+
+
 @dataclass(frozen=True)
 class CompiledMap:
     """Dense array form of a straw2 CrushMap for device execution."""
@@ -435,11 +447,12 @@ _KERNEL_CACHE: dict = {}
 
 
 def _indep_kernel(cm: CompiledMap, out_size, numrep, target_type, chooseleaf,
-                  tries, recurse_tries):
+                  tries, recurse_tries, placement=None):
     key = ("indep", cm.items.tobytes(), cm.ids.tobytes(),
            cm.wsets.tobytes(), cm.npos,
            cm.size.tobytes(), cm.btype.tobytes(), cm.depth, cm.max_devices,
-           out_size, numrep, target_type, chooseleaf, tries, recurse_tries)
+           out_size, numrep, target_type, chooseleaf, tries, recurse_tries,
+           placement)
     kernel = _KERNEL_CACHE.get(key)
     if kernel is None:
         kernel = _make_indep(cm, out_size, numrep, target_type, chooseleaf,
@@ -451,12 +464,13 @@ def _indep_kernel(cm: CompiledMap, out_size, numrep, target_type, chooseleaf,
 
 
 def _firstn_kernel(cm: CompiledMap, result_max, numrep, target_type,
-                   chooseleaf, tries, recurse_tries, vary_r):
+                   chooseleaf, tries, recurse_tries, vary_r,
+                   placement=None):
     key = ("firstn", cm.items.tobytes(), cm.ids.tobytes(),
            cm.wsets.tobytes(), cm.npos,
            cm.size.tobytes(), cm.btype.tobytes(), cm.depth, cm.max_devices,
            result_max, numrep, target_type, chooseleaf, tries,
-           recurse_tries, vary_r)
+           recurse_tries, vary_r, placement)
     kernel = _KERNEL_CACHE.get(key)
     if kernel is None:
         kernel = _make_firstn(cm, result_max, numrep, target_type,
@@ -494,7 +508,7 @@ def _rule_shape(cmap: CrushMap, ruleno: int):
 
 def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
                     weight=None, xs_sharding=None, choose_args=None,
-                    device_out: bool = False):
+                    device_out: bool = False, tables_sharding=None):
     """Map a whole batch of inputs in one device program.
 
     xs: [B] int array of crush inputs (pg seeds). Returns [B, result_max]
@@ -514,6 +528,12 @@ def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
     NamedSharding over a device mesh partitions the whole mapping sweep
     across chips (each seed's placement is independent, so no
     collectives are inserted).
+
+    tables_sharding: optional sharding for the compiled CRUSH tables
+    and weight vector — `NamedSharding(mesh, P())` replicates them to
+    every mesh device (the SNIPPETS [1]-[3] sharded-data/replicated-
+    params split), so each chip maps its seed shard against a local
+    table copy.  `mesh_do_rule` is the convenience wrapper.
     """
     import jax
     import jax.numpy as jnp
@@ -574,6 +594,12 @@ def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
     if weight is None:
         weight = np.full(cm.max_devices, 0x10000, dtype=np.int64)
 
+    # compiled kernels are cached per placement as well as geometry: a
+    # mesh-sharded sweep must not be served (or counted) as the
+    # single-device sweep's compile-cache entry
+    placement = None
+    if xs_sharding is not None or tables_sharding is not None:
+        placement = (repr(xs_sharding), repr(tables_sharding))
     if firstn:
         # recurse_tries per do_rule (mapper.c:1014-1020):
         # choose_leaf_tries, else 1 under chooseleaf_descend_once,
@@ -586,24 +612,31 @@ def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
             recurse_tries = tries
         kernel = _firstn_kernel(cm, result_max, numrep, shape["type"],
                                 chooseleaf, tries, recurse_tries,
-                                t.chooseleaf_vary_r)
+                                t.chooseleaf_vary_r, placement)
     else:
         recurse_tries = shape["leaf_tries"] or 1
         kernel = _indep_kernel(cm, out_size, numrep, shape["type"],
-                               chooseleaf, tries, recurse_tries)
-    with jax.enable_x64():
+                               chooseleaf, tries, recurse_tries,
+                               placement)
+    with _enable_x64():
         xs_dev = jnp.asarray(xs, dtype=jnp.int64)
         if xs_sharding is not None:
             xs_dev = jax.device_put(xs_dev, xs_sharding)
-        out = kernel(jnp.asarray(cm.items), jnp.asarray(cm.ids),
-                     jnp.asarray(cm.wsets),
-                     jnp.asarray(cm.size), jnp.asarray(cm.btype),
-                     xs_dev,
-                     jnp.asarray(weight, dtype=jnp.int64),
-                     -1 - shape["root"])
+        tables = (jnp.asarray(cm.items), jnp.asarray(cm.ids),
+                  jnp.asarray(cm.wsets),
+                  jnp.asarray(cm.size), jnp.asarray(cm.btype))
+        wvec = jnp.asarray(weight, dtype=jnp.int64)
+        if tables_sharding is not None:
+            # replicate the CRUSH tables to every mesh device up front
+            # (P() = no partitioning): each chip draws against a local
+            # copy instead of GSPMD re-deciding placement per call
+            tables = tuple(jax.device_put(tb, tables_sharding)
+                           for tb in tables)
+            wvec = jax.device_put(wvec, tables_sharding)
+        out = kernel(*tables, xs_dev, wvec, -1 - shape["root"])
     if device_out:
         if out.shape[1] < result_max:
-            with jax.enable_x64():
+            with _enable_x64():
                 out = jnp.pad(out,
                               ((0, 0), (0, result_max - out.shape[1])),
                               constant_values=CRUSH_ITEM_NONE)
@@ -614,3 +647,55 @@ def batched_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
                       dtype=np.int64)
         res = np.concatenate([res, pad], axis=1)
     return res
+
+
+def make_batch_mesh(n_devices: int | None = None):
+    """Flat 1-axis ('batch',) mesh over the first n local devices —
+    the cluster-sweep shape (one PG shard per chip), as opposed to
+    parallel.mesh.make_mesh's 2D codec mesh."""
+    import jax
+    from jax.sharding import Mesh
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    return Mesh(np.array(devices[:n_devices]), ("batch",))
+
+
+def mesh_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
+                 weight=None, mesh=None, choose_args=None):
+    """Mesh-sharded bulk mapping: the PG seed batch partitions along a
+    flat ('batch',) mesh axis while the compiled CRUSH tables (and the
+    reweight vector) replicate to every chip — the sharded-data /
+    replicated-params split of SNIPPETS [1]-[3].  Each seed maps
+    independently, so no collectives are inserted and the result is
+    bit-identical to batched_do_rule on one device (the balancer's
+    native-oracle parity gate rides on this).
+
+    Seeds are padded (by repeating the last seed) up to a multiple of
+    the mesh size — NamedSharding needs an even split — and the pad
+    rows are trimmed from the result.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if mesh is None:
+        mesh = make_batch_mesh()
+    if len(mesh.axis_names) != 1:
+        raise ValueError("mesh_do_rule wants a flat 1-axis mesh, got "
+                         "axes %r" % (mesh.axis_names,))
+    axis = mesh.axis_names[0]
+    n_shards = int(mesh.devices.size)
+    xs = np.asarray(xs)
+    n = len(xs)
+    if n == 0 or n_shards <= 1:
+        return batched_do_rule(cmap, ruleno, xs, result_max, weight,
+                               choose_args=choose_args)
+    pad = (-n) % n_shards
+    if pad:
+        xs = np.concatenate([xs, np.repeat(xs[-1:], pad)])
+    out = batched_do_rule(
+        cmap, ruleno, xs, result_max, weight,
+        xs_sharding=NamedSharding(mesh, P(axis)),
+        choose_args=choose_args,
+        tables_sharding=NamedSharding(mesh, P()))
+    return out[:n]

@@ -166,6 +166,31 @@ class PrometheusModule(MgrModule):
                         emit("ceph_tpu_stage_%s_seconds" % state,
                              row.get(state + "_s", 0.0), slbl,
                              mtype="counter")
+                # mesh-native per-device series (direction D): each
+                # OSD's dispatcher/HBM tier is pinned to one chip
+                # (parallel/placement.py), so a {device=...} label
+                # turns the per-daemon gauges into a per-chip view —
+                # dispatch rate, chunk-tier residency and stage
+                # busy-fraction straight off the home device
+                device = (tpu.get("device") or hbm.get("device")
+                          or dispatch.get("device"))
+                if device:
+                    dlbl = dict(lbl, device=device)
+                    rate = sum(row.get("enc_MBps", 0.0)
+                               + row.get("dec_MBps", 0.0)
+                               for row in (tpu.get("codecs")
+                                           or {}).values())
+                    emit("ceph_tpu_device_dispatch_MBps", rate, dlbl)
+                    emit("ceph_tpu_device_hbm_resident_bytes",
+                         hbm.get("resident_bytes", 0), dlbl)
+                    for stage, row in sorted(
+                            (profile.get("stages") or {}).items()):
+                        tot = sum(row.get(s + "_s", 0.0) for s in
+                                  ("busy", "idle", "blocked"))
+                        emit("ceph_tpu_device_stage_busy_frac",
+                             (row.get("busy_s", 0.0) / tot)
+                             if tot > 0 else 0.0,
+                             dict(dlbl, stage=stage))
             # balancer sweep timings (ROADMAP #4's measured-feedback
             # series), exported with a backend label
             for key in metrics.value_keys():
@@ -345,16 +370,21 @@ class BalancerModule(MgrModule):
         self.max_deviation_ratio = 0.05
         self.max_changes_per_round = 10
         self.last_optimize: dict = {}
-        # measured-speed backend selection (ROADMAP #4): wall-time
-        # samples per sweep backend; once both sides have
-        # min_speed_samples, use_device follows the measured medians
+        # measured-speed backend selection (ROADMAP #4 + direction D):
+        # wall-time samples per sweep backend; once every backend has
+        # min_speed_samples, the choice follows the measured medians
         # instead of a static assumption.  Timings also land in the
-        # mgr's telemetry store (balancer_sweep_{native,device}).
+        # mgr's telemetry store (balancer_sweep_{native,device,mesh}).
+        # "mesh" is the PG batch sharded across every local chip
+        # (crush.batched.mesh_do_rule) — it pays collective overhead,
+        # so on small maps or one chip the other backends usually win
+        # and the measurement keeps it honest.
         self.sweep_samples: dict[str, list[float]] = {
-            "native": [], "device": []}
+            "native": [], "device": [], "mesh": []}
         self.min_speed_samples = 2
         self.max_speed_samples = 16
-        self.use_device: bool | None = None   # None = not decided yet
+        self.backend: str | None = None       # None = not decided yet
+        self.use_device: bool | None = None   # backend == "device"
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -364,14 +394,15 @@ class BalancerModule(MgrModule):
     def _eval(self, osdmap):
         from ..osd.balancer import eval_distribution
         # score with the measured-fastest backend once one is chosen;
-        # if the device path is unavailable (no device, broken env)
-        # the native sweep answers instead of the command dying
-        use_device = True if self.use_device is None \
-            else self.use_device
+        # if the accelerator path is unavailable (no device, broken
+        # env) the native sweep answers instead of the command dying
+        backend = self.backend if self.backend is not None else "device"
         try:
-            return eval_distribution(osdmap, use_device=use_device)
+            return eval_distribution(
+                osdmap, use_device=(backend == "device"),
+                use_mesh=(backend == "mesh"))
         except Exception:
-            if not use_device:
+            if backend == "native":
                 raise
             return eval_distribution(osdmap, use_device=False)
 
@@ -390,30 +421,37 @@ class BalancerModule(MgrModule):
             metrics.record_value("balancer_sweep_%s" % backend,
                                  seconds)
 
-    def pick_backend(self, osdmap) -> bool:
+    def pick_backend(self, osdmap) -> str:
         """Choose the sweep backend from MEASURED wall-times: probe
         whichever backend still lacks samples (one timed sweep each),
-        then return use_device = device median < native median.  The
-        probe cost is one extra all-PG sweep per undersampled backend
-        — paid at most min_speed_samples times per mgr lifetime.
+        then return the backend with the lowest median — "native"
+        (host mapper), "device" (one-chip batched CRUSH program) or
+        "mesh" (PG batch sharded across every local chip).  The probe
+        cost is one extra all-PG sweep per undersampled backend —
+        paid at most min_speed_samples times per mgr lifetime.
         A backend whose probe RAISES (no device, broken jax env) is
-        recorded as infinitely slow: the working backend wins instead
-        of the round dying — measured selection doubles as a
+        recorded as infinitely slow: a working backend wins instead
+        of the round dying — measured selection doubles as an
         availability fallback."""
         from ..osd.balancer import measure_sweep
-        for backend in ("native", "device"):
+        for backend in ("native", "device", "mesh"):
             while len(self.sweep_samples[backend]) < \
                     self.min_speed_samples:
                 try:
                     dt = measure_sweep(
-                        osdmap, use_device=(backend == "device"))
+                        osdmap, use_device=(backend == "device"),
+                        use_mesh=(backend == "mesh"))
                 except Exception:
                     dt = float("inf")
                 self._record_sweep(backend, dt)
-        self.use_device = (
-            self._median(self.sweep_samples["device"])
-            < self._median(self.sweep_samples["native"]))
-        return self.use_device
+        best = "native"
+        for backend in ("device", "mesh"):
+            if self._median(self.sweep_samples[backend]) < \
+                    self._median(self.sweep_samples[best]):
+                best = backend
+        self.backend = best
+        self.use_device = (best == "device")
+        return best
 
     def sweep_medians(self) -> dict:
         def med(s):
@@ -433,19 +471,19 @@ class BalancerModule(MgrModule):
         osdmap = self.get("osd_map")
         if osdmap is None:
             return 0, "no osdmap yet"
-        use_device = self.pick_backend(osdmap)
+        backend = self.pick_backend(osdmap)
         t0 = _time.perf_counter()
         res = calc_pg_upmaps(
             osdmap, max_deviation=1.0,
             max_deviation_ratio=self.max_deviation_ratio,
             max_changes=self.max_changes_per_round,
-            use_device=use_device)
+            use_device=(backend == "device"),
+            use_mesh=(backend == "mesh"))
         elapsed = _time.perf_counter() - t0
         if res.sweeps > 0:
             # each real round refreshes the chosen backend's series:
             # the decision keeps tracking the hardware it runs on
-            self._record_sweep("device" if use_device else "native",
-                               elapsed / res.sweeps)
+            self._record_sweep(backend, elapsed / res.sweeps)
         mon = self.mgr.mon_client
         applied = 0
         for pgid in res.old_pg_upmap_items:
@@ -461,7 +499,6 @@ class BalancerModule(MgrModule):
                                    "mappings": [list(p) for p in items]})
             if r == 0:
                 applied += 1
-        backend = "device" if use_device else "native"
         summary = ("%d change(s) applied; deviation %.2f -> %.2f "
                    "(%d %s sweeps)"
                    % (applied, res.start_deviation, res.end_deviation,
@@ -480,6 +517,7 @@ class BalancerModule(MgrModule):
         prefix = cmd.get("prefix")
         if prefix == "balancer status":
             return 0, "", {"mode": self.mode, "active": self.active,
+                           "backend": self.backend,
                            "use_device": self.use_device,
                            "sweep_medians": self.sweep_medians(),
                            "last_optimize": dict(self.last_optimize)}

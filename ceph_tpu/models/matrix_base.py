@@ -28,7 +28,8 @@ import numpy as np
 from ..ops import gf, gf_ref
 from ..utils import profile as profile_util
 from .base import ErasureCode, ErasureCodeError
-from .table_cache import TableCache, xor_parity_rows, xor_recover
+from .table_cache import (TableCache, device_entry_key, xor_parity_rows,
+                          xor_recover)
 
 LARGEST_VECTOR_WORDSIZE = 16  # reference SIMD word (ErasureCodeJerasure.cc:31)
 
@@ -73,6 +74,7 @@ class GeneratorCodec(ErasureCode):
         self.coding: np.ndarray | None = None   # [m, k] GF generator
         self._bitmat: np.ndarray | None = None  # [m*w, k*w] encode bitmatrix
         self._bitmat_dev = None
+        self._bitmat_dev_by: dict = {}  # device key -> committed copy
         self._decode_cache = TableCache()
         self._xor_rows: list[int] = []  # parity rows that are plain XORs
         self.xor_fast_hits = 0
@@ -135,6 +137,7 @@ class GeneratorCodec(ErasureCode):
             raise ErasureCodeError(errno.EINVAL, str(e))
         self._bitmat = gf.generator_to_bitmatrix(self.coding, self.w)
         self._bitmat_dev = None
+        self._bitmat_dev_by = {}
         self._decode_cache.clear()
         self.xor_fast_hits = 0
         self._xor_rows = xor_parity_rows(self._bitmat, self.k, self.w)
@@ -143,25 +146,45 @@ class GeneratorCodec(ErasureCode):
         self._bank_host = None
         self._bank_dev = None
 
-    def _device_bitmat(self):
-        if self._bitmat_dev is None:
+    def _device_bitmat(self, device=None):
+        if device is None:
+            if self._bitmat_dev is None:
+                import jax.numpy as jnp
+                self._bitmat_dev = jnp.asarray(self._bitmat)
+            return self._bitmat_dev
+        key = device_entry_key(device)
+        dev = self._bitmat_dev_by.get(key)
+        if dev is None:
+            import jax
             import jax.numpy as jnp
-            self._bitmat_dev = jnp.asarray(self._bitmat)
-        return self._bitmat_dev
+            dev = self._bitmat_dev_by.setdefault(
+                key, jax.device_put(jnp.asarray(self._bitmat), device))
+        return dev
 
-    def _as_device(self, bitmat, entry: dict | None = None):
+    def _as_device(self, bitmat, entry: dict | None = None, device=None):
         """Device copy of a bitmatrix, cached on the encode path or inside
-        the decode-cache entry (so a repeated erasure signature reuses the
-        already-transferred constant — no scan, no re-upload)."""
+        the decode-cache entry — keyed per HOME device (table_cache
+        .device_entry_key), so a repeated erasure signature reuses the
+        already-transferred constant on ITS chip and a second pinned
+        device never consumes (or clobbers) the first device's copy."""
         if bitmat is self._bitmat:
-            return self._device_bitmat()
+            return self._device_bitmat(device)
         import jax.numpy as jnp
         if entry is not None:
-            dev = entry.get("bitmat_dev")
+            key = device_entry_key(device)
+            dev = entry.get(key)
             if dev is None:
-                dev = entry.setdefault("bitmat_dev", jnp.asarray(bitmat))
+                bm = jnp.asarray(bitmat)
+                if device is not None:
+                    import jax
+                    bm = jax.device_put(bm, device)
+                dev = entry.setdefault(key, bm)
             return dev
-        return jnp.asarray(bitmat)
+        bm = jnp.asarray(bitmat)
+        if device is not None:
+            import jax
+            bm = jax.device_put(bm, device)
+        return bm
 
     def _full_decode_matrix(self, avail_rows: tuple) -> np.ndarray:
         """[k+m, k] GF matrix mapping k available chunks -> all chunks."""
@@ -353,7 +376,8 @@ class MatrixErasureCode(GeneratorCodec):
         import jax.numpy as jnp
         from ..ops import xor_mm
         out = xor_mm.matrix_encode(
-            self._as_device(bitmat, entry), jnp.asarray(data), self.w)
+            self._as_device(bitmat, entry, _committed_device(data)),
+            jnp.asarray(data), self.w)
         return out if _is_jax(data) else np.asarray(out)
 
 
@@ -415,10 +439,32 @@ class BitmatrixErasureCode(GeneratorCodec):
         import jax.numpy as jnp
         from ..ops import xor_mm
         out = xor_mm.bitmatrix_encode(
-            self._as_device(bitmat, entry), jnp.asarray(data), self.w,
-            self.packetsize)
+            self._as_device(bitmat, entry, _committed_device(data)),
+            jnp.asarray(data), self.w, self.packetsize)
         return out if _is_jax(data) else np.asarray(out)
 
 
 def _is_jax(x) -> bool:
     return type(x).__module__.startswith("jax")
+
+
+def _committed_device(x):
+    """Home device of a committed single-device jax array — the pinned
+    dispatcher's h2d stage commits staged batches to its home chip, and
+    the codec constants must follow or XLA rejects the mixed-placement
+    call.  None for host arrays, uncommitted placements, multi-device
+    shardings, and the implicit default device (where the legacy
+    un-keyed constants already live)."""
+    if not _is_jax(x):
+        return None
+    try:
+        if not getattr(x, "committed", False):
+            return None
+        devs = x.devices()
+        if len(devs) != 1:
+            return None
+        dev = next(iter(devs))
+        import jax
+        return None if dev == jax.devices()[0] else dev
+    except Exception:
+        return None

@@ -25,12 +25,28 @@ from collections import OrderedDict
 
 import numpy as np
 
-__all__ = ["TableCache", "xor_parity_rows", "xor_recover"]
+__all__ = ["TableCache", "device_entry_key", "xor_parity_rows",
+           "xor_recover"]
 
 # The reference sizes its cache for the largest supported (k,m)=(12,4)
 # pattern space (ErasureCodeIsaTableCache.cc); 4096 covers C(16,4) and
 # keeps the host-side footprint bounded.
 DEFAULT_CAPACITY = 4096
+
+
+def device_entry_key(device) -> str:
+    """Entry-dict key under which the device-resident copy of a decode
+    bitmatrix lives for `device`.  The bare "bitmat_dev" key is the
+    implicit default device; a pinned home device (one OSD per chip)
+    gets its own "bitmat_dev@<platform>:<id>" slot, so two dispatchers
+    sharing one cached table each stage their own on-chip copy instead
+    of the second silently consuming the first device's array."""
+    if device is None:
+        return "bitmat_dev"
+    try:
+        return "bitmat_dev@%s:%d" % (device.platform, device.id)
+    except Exception:
+        return "bitmat_dev@%s" % (device,)
 
 
 class TableCache:

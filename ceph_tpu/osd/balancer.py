@@ -152,11 +152,15 @@ class Distribution:
 
 
 def _sweep(osdmap: OSDMap, pools: set[int] | None,
-           use_device: bool) -> dict[PGID, list[int]]:
+           use_device: bool,
+           use_mesh: bool = False) -> dict[PGID, list[int]]:
     """All-PG up mappings — one batched device CRUSH program per pool
-    (the ParallelPGMapper-analog step of every balancer round)."""
+    (the ParallelPGMapper-analog step of every balancer round).  With
+    use_mesh the PG batch is sharded across every local chip
+    (crush.batched.mesh_do_rule) instead of running on one device."""
     mapping = OSDMapMapping()
-    mapping.update(osdmap, batched=use_device)
+    mapping.update(osdmap, batched=use_device or use_mesh,
+                   mesh=True if use_mesh else None)
     out: dict[PGID, list[int]] = {}
     for pgid, (up, _up_p, _acting, _acting_p) in mapping.by_pg.items():
         if pools is not None and pgid.pool not in pools:
@@ -166,15 +170,18 @@ def _sweep(osdmap: OSDMap, pools: set[int] | None,
 
 
 def measure_sweep(osdmap: OSDMap, use_device: bool,
-                  pools: set[int] | None = None) -> float:
+                  pools: set[int] | None = None,
+                  use_mesh: bool = False) -> float:
     """Wall-time of one all-PG placement sweep on the named backend
-    (device = batched CRUSH program, native = the host mapper).  The
-    mgr balancer's measured-speed backend selection (ROADMAP #4)
-    feeds on these instead of assuming the device always wins — on a
-    single chip behind a slow transport the host sweep often does."""
+    (mesh = PG batch sharded across local chips, device = batched
+    CRUSH program on one chip, native = the host mapper).  The mgr
+    balancer's measured-speed backend selection (ROADMAP #4) feeds on
+    these instead of assuming the device always wins — on a single
+    chip behind a slow transport the host sweep often does, and on a
+    small map the mesh's collective overhead can lose to one chip."""
     import time as _time
     t0 = _time.perf_counter()
-    _sweep(osdmap, pools, use_device)
+    _sweep(osdmap, pools, use_device, use_mesh=use_mesh)
     return _time.perf_counter() - t0
 
 
@@ -201,10 +208,11 @@ def _targets(osdmap: OSDMap,
 
 
 def eval_distribution(osdmap: OSDMap, pools: set[int] | None = None,
-                      use_device: bool = True) -> Distribution:
+                      use_device: bool = True,
+                      use_mesh: bool = False) -> Distribution:
     """Score the current map: per-OSD up-PG counts vs CRUSH-weight
     targets (the `balancer eval` / OSDUtilizationDumper role)."""
-    by_pg = _sweep(osdmap, pools, use_device)
+    by_pg = _sweep(osdmap, pools, use_device, use_mesh=use_mesh)
     counts: dict[int, int] = {}
     for up in by_pg.values():
         for osd in up:
@@ -299,7 +307,8 @@ def calc_pg_upmaps(osdmap: OSDMap,
                    max_deviation_ratio: float = 0.0,
                    max_changes: int = 10,
                    pools: set[int] | None = None,
-                   use_device: bool = True) -> BalancerResult:
+                   use_device: bool = True,
+                   use_mesh: bool = False) -> BalancerResult:
     """Greedy upmap optimization, one accepted change per device
     sweep, mirroring OSDMap::calc_pg_upmaps' restart loop.  Stops
     when the fullest OSD sits within max_deviation PGs of its target
@@ -311,7 +320,7 @@ def calc_pg_upmaps(osdmap: OSDMap,
     res = BalancerResult()
     remaining = max_changes
     while remaining > 0:
-        by_pg = _sweep(tmp, pools, use_device)
+        by_pg = _sweep(tmp, pools, use_device, use_mesh=use_mesh)
         res.sweeps += 1
         pgs_by_osd: dict[int, list[PGID]] = {}
         for pgid, up in sorted(by_pg.items(),

@@ -743,6 +743,22 @@ class ECBackend:
         read.chunk_len = chunk_total
 
         def finish(shard_data: dict):
+            # cross-chip leg (ROADMAP direction D): with more than
+            # one local device the survivor chunk streams shard
+            # across the mesh and reconstruct in place, guarded by a
+            # psum checksum — the survivors never gather onto the
+            # primary's chip.  Any mesh failure (checksum trip,
+            # single device, locality codec) falls back to the
+            # host-buffered decode below, which still holds the
+            # bytes as received.
+            try:
+                rebuilt = ec_util.recover_cross_chip(
+                    self.sinfo, self.codec, shard_data, target_shard)
+            except Exception:
+                rebuilt = None
+            if rebuilt is not None:
+                on_done(rebuilt)
+                return
             try:
                 decoded = ec_util.decode(self.sinfo, self.codec,
                                          shard_data,

@@ -25,7 +25,8 @@ import numpy as np
 
 from ..errors import ErasureCodeError
 
-__all__ = ["StripeInfo", "encode", "decode", "HashInfo"]
+__all__ = ["StripeInfo", "encode", "decode", "recover_cross_chip",
+           "HashInfo"]
 
 CHUNK_ALIGNMENT = 64
 
@@ -218,6 +219,64 @@ def decode(sinfo: StripeInfo, codec, to_decode: dict,
         else:
             out[idx] = np.ascontiguousarray(full[:, i, :]).reshape(-1)
     return out
+
+
+def recover_cross_chip(sinfo: StripeInfo, codec, to_decode: dict,
+                       target_shard: int, mesh=None,
+                       expected_sum=None):
+    """Mesh-path recovery (ROADMAP direction D): reconstruct ONE
+    missing shard with the survivor chunk streams sharded across the
+    local device mesh (parallel.mesh.recover_sharded) instead of
+    gathered onto the primary's chip.  A psum checksum over the mesh
+    verifies the device-resident survivors against their host sum and
+    raises MeshChecksumError on mismatch.
+
+    Returns the target shard's bytes, or None when the mesh path does
+    not apply (single device, locality codec, non-matrix codec, or a
+    survivor set that isn't exactly k matrix rows) — the caller falls
+    back to decode().
+    """
+    if getattr(codec, "DECODE_BATCH_ANY", False) or \
+            not hasattr(codec, "_decode_entry"):
+        return None
+    if mesh is None:
+        try:
+            import jax
+            if len(jax.devices()) < 2:
+                return None
+        except Exception:
+            return None
+    to_decode = {
+        shard: (np.frombuffer(v, dtype=np.uint8)
+                if isinstance(v, (bytes, bytearray, memoryview))
+                else np.asarray(v, dtype=np.uint8).reshape(-1))
+        for shard, v in to_decode.items()}
+    lengths = {v.size for v in to_decode.values()}
+    if len(lengths) != 1:
+        raise ErasureCodeError(22,
+                               "chunks have unequal lengths %s" % lengths)
+    total = lengths.pop()
+    if total == 0 or total % sinfo.chunk_size != 0:
+        return None
+    k = codec.get_data_chunk_count()
+    n = codec.get_chunk_count()
+    if target_shard in to_decode:
+        return np.ascontiguousarray(
+            to_decode[target_shard]).tobytes()
+    stripes = total // sinfo.chunk_size
+    inv = {codec.chunk_index(i): i for i in range(n)}
+    logical = {inv[shard]: buf.reshape(stripes, sinfo.chunk_size)
+               for shard, buf in to_decode.items()}
+    use = tuple(sorted(logical))[:k]
+    if len(use) < k:
+        raise ErasureCodeError(
+            5, "not enough chunks to decode (%d < %d)"
+            % (len(use), k))
+    stacked = np.stack([logical[i] for i in use], axis=1)  # [S,k,chunk]
+    from ..parallel.mesh import recover_sharded
+    row = recover_sharded(codec, use, stacked, inv[target_shard],
+                          mesh=mesh, expected_sum=expected_sum)
+    return np.ascontiguousarray(row).reshape(-1).tobytes()
 
 
 def decode_concat(sinfo: StripeInfo, codec, to_decode: dict,

@@ -101,10 +101,18 @@ class HbmChunkTier:
     for put_encode; entries adopted from the dispatcher carry their
     own codec, so one tier serves heterogeneous pools."""
 
-    def __init__(self, codec=None, capacity_objects: int = 64):
+    def __init__(self, codec=None, capacity_objects: int = 64,
+                 device=None):
         _init_device_digest()
         self.codec = codec
         self.capacity = capacity_objects
+        # home device (parallel/placement.py): uploads commit here and
+        # residency is accounted under a per-device ledger category, so
+        # N tiers on N chips never fight over one global gauge
+        self.device = device
+        from ..parallel.placement import device_label
+        self._mem_category = "hbm_tier" if device is None \
+            else "hbm_tier[%s]" % device_label(device)
         self._lock = threading.Lock()
         self._objs: dict = {}          # name -> (_Batch, row index)
         self._order: list = []         # LRU, oldest first
@@ -158,7 +166,7 @@ class HbmChunkTier:
         # device-memory ledger: tier residency is the dominant HBM
         # category, so every gauge refresh updates the profiler too
         from ..common.profiler import PROFILER
-        PROFILER.mem_set("hbm_tier", self._resident_bytes)
+        PROFILER.mem_set(self._mem_category, self._resident_bytes)
 
     def _insert_locked(self, name, batch: _Batch, row: int) -> None:
         if name in self._objs:
@@ -176,7 +184,11 @@ class HbmChunkTier:
         [batch, m, n] (callers usually leave it on device)."""
         import jax.numpy as jnp
         codec = codec if codec is not None else self.codec
-        data_dev = jnp.asarray(data_host)       # single transfer
+        if self.device is not None:
+            import jax
+            data_dev = jax.device_put(data_host, self.device)
+        else:
+            data_dev = jnp.asarray(data_host)   # single transfer
         parity = codec.encode_batch(data_dev)
         full = jnp.concatenate([data_dev, parity], axis=1)
         obj_bytes = int(full.shape[1]) * int(full.shape[2])
@@ -200,8 +212,16 @@ class HbmChunkTier:
         Stored layout matches put_encode: [k+m, S*chunk] — shard i's
         whole chunk stream is row i."""
         import jax.numpy as jnp
-        data_dev = jnp.asarray(data_rows)
-        parity_dev = jnp.asarray(parity_rows)
+        if self.device is not None and not (
+                type(data_rows).__module__.startswith("jax")):
+            # host-array adoption (no-jax dispatcher path): the one h2d
+            # goes straight to the home device
+            import jax
+            data_dev = jax.device_put(data_rows, self.device)
+            parity_dev = jax.device_put(parity_rows, self.device)
+        else:
+            data_dev = jnp.asarray(data_rows)
+            parity_dev = jnp.asarray(parity_rows)
         # [S, k+m, chunk] -> [k+m, S, chunk] -> [k+m, S*chunk]
         full = jnp.concatenate([data_dev, parity_dev], axis=1)
         full = jnp.transpose(full, (1, 0, 2)).reshape(
@@ -374,10 +394,12 @@ class HbmChunkTier:
                                    axis=1)[:, 0]
 
     def stats(self) -> dict:
+        from ..parallel.placement import device_label
         with self._lock:
             hits = self.perf.get("l_hbm_hits")
             misses = self.perf.get("l_hbm_misses")
-            return {"resident_objects": len(self._objs),
+            return {"device": device_label(self.device),
+                    "resident_objects": len(self._objs),
                     "resident_bytes": self._resident_bytes,
                     "capacity": self.capacity,
                     "occupancy": round(len(self._objs) / self.capacity,

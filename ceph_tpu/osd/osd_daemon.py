@@ -134,6 +134,16 @@ class OSDDaemon(Dispatcher):
         self.timer = SafeTimer("osd%d-timer" % whoami)
         # cross-op EC device-call coalescing (osd/tpu_dispatch.py):
         # concurrent PG encodes sharing a codec ride one dispatch
+        # mesh-native placement (parallel/placement.py, direction D):
+        # resolve this OSD's home device once — the dispatcher
+        # pipeline and the HBM chunk tier both pin to it, so N
+        # daemons land one-per-chip with no global device lock
+        from ..parallel.placement import PLACEMENT
+        try:
+            self.home_device = PLACEMENT.resolve(
+                whoami, conf.get_val("osd_device_index"))
+        except Exception:
+            self.home_device = None
         if conf.get_val("osd_tpu_coalesce"):
             from .tpu_dispatch import TpuDispatcher
             self.tpu_dispatcher = TpuDispatcher(
@@ -141,7 +151,8 @@ class OSDDaemon(Dispatcher):
                 max_delay=conf.get_val(
                     "osd_tpu_coalesce_max_delay_ms") / 1e3,
                 tracer=self.tracer,
-                pipeline_depth=conf.get_val("osd_tpu_pipeline_depth"))
+                pipeline_depth=conf.get_val("osd_tpu_pipeline_depth"),
+                device=self.home_device)
             # l_tpu_* device-segment counters ride the daemon's perf
             # collection (mgr report -> prometheus)
             self.ctx.perf.add(self.tpu_dispatcher.perf)
@@ -159,7 +170,8 @@ class OSDDaemon(Dispatcher):
                 from .hbm_tier import HbmChunkTier
                 self.hbm_tier = HbmChunkTier(
                     capacity_objects=conf.get_val(
-                        "osd_hbm_tier_capacity"))
+                        "osd_hbm_tier_capacity"),
+                    device=self.home_device)
                 self.ctx.perf.add(self.hbm_tier.perf)
             except Exception:
                 self.hbm_tier = None
@@ -198,6 +210,11 @@ class OSDDaemon(Dispatcher):
                 lambda args: self._profile_reset(),
                 "reset the device-runtime profiler's registries and "
                 "restart the stall-attribution window")
+            self.ctx.admin_socket.register(
+                "mesh status",
+                lambda args: self._mesh_status(),
+                "device placement: local mesh, this OSD's home "
+                "device, and every placement-registry assignment")
         self.hb_peers: dict = {}       # osd -> last reply stamp
         self.hb_pending: dict = {}     # osd -> first unacked ping stamp
         # cache tiering: base-pool IO runs on dedicated threads with an
@@ -595,6 +612,23 @@ class OSDDaemon(Dispatcher):
         if self.tpu_dispatcher is not None:
             self.tpu_dispatcher.profile_reset()
         return {"reset": True}
+
+    def _mesh_status(self) -> dict:
+        """The `mesh status` asok payload: the local device mesh, this
+        OSD's resolved home device, and the whole placement registry
+        (every co-resident daemon's assignment)."""
+        from ..parallel.placement import PLACEMENT, device_label
+        doc = PLACEMENT.assignments()
+        doc["whoami"] = self.whoami
+        doc["home_device"] = device_label(
+            getattr(self, "home_device", None))
+        if self.tpu_dispatcher is not None:
+            doc["dispatcher_device"] = device_label(
+                self.tpu_dispatcher.device)
+        tier = getattr(self, "hbm_tier", None)
+        if tier is not None:
+            doc["hbm_tier_device"] = device_label(tier.device)
+        return doc
 
     def _telemetry_status(self) -> dict:
         """The gauge bag riding MMgrReport.status: store capacity

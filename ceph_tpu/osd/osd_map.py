@@ -480,23 +480,38 @@ class OSDMapMapping:
         self.by_pg: dict[PGID, tuple] = {}
         self.by_osd: dict[int, list] = {}
 
-    def update(self, osdmap: OSDMap, batched: bool = True) -> None:
+    def update(self, osdmap: OSDMap, batched: bool = True,
+               mesh=None) -> None:
         """Recompute every pool's PG mappings. With batched=True the
         CRUSH step for each pool's whole PG range runs as one device
-        call (ceph_tpu.crush.batched.batched_do_rule)."""
+        call (ceph_tpu.crush.batched.batched_do_rule); with mesh set
+        (True for the default local-device mesh, or an explicit 1-axis
+        jax Mesh) the PG batch is additionally sharded across chips
+        (ceph_tpu.crush.batched.mesh_do_rule)."""
         self.by_pg.clear()
         self.by_osd = {o: [] for o in range(osdmap.max_osd)}
+        mesh_obj = None
+        if mesh is not None and mesh is not False:
+            from ..crush.batched import make_batch_mesh
+            mesh_obj = make_batch_mesh() if mesh is True else mesh
         for pool_id, pool in osdmap.pools.items():
             pgids = [PGID(pool_id, ps) for ps in range(pool.pg_num)]
             raws = None
             if batched and 0 <= pool.crush_rule < len(osdmap.crush.rules):
-                from ..crush.batched import batched_do_rule
+                from ..crush.batched import batched_do_rule, mesh_do_rule
                 seeds = np.array([pool.raw_pg_to_pps(p) for p in pgids],
                                  dtype=np.int64)
-                mat = batched_do_rule(osdmap.crush, pool.crush_rule,
-                                      seeds, pool.size,
-                                      osdmap._weight_vector(),
-                                      choose_args=pool_id)
+                if mesh_obj is not None:
+                    mat = mesh_do_rule(osdmap.crush, pool.crush_rule,
+                                       seeds, pool.size,
+                                       osdmap._weight_vector(),
+                                       mesh=mesh_obj,
+                                       choose_args=pool_id)
+                else:
+                    mat = batched_do_rule(osdmap.crush, pool.crush_rule,
+                                          seeds, pool.size,
+                                          osdmap._weight_vector(),
+                                          choose_args=pool_id)
                 raws = [[int(v) for v in row[:pool.size]] for row in mat]
             for i, pgid in enumerate(pgids):
                 if raws is not None:

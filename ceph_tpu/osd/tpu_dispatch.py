@@ -125,11 +125,22 @@ class _Dispatch:
 class _JaxDevOps:
     """Explicit h2d / compute / d2h legs on a jax device. Each leg
     blocks — in its OWN pipeline thread, which is what lets leg X of
-    batch n overlap leg Y of batch m."""
+    batch n overlap leg Y of batch m.
+
+    `device` is the dispatcher's home device (parallel/placement.py):
+    h2d commits the staged buffer there explicitly, so N dispatchers
+    pinned to N chips stage and compute concurrently instead of
+    funnelling through jax's implicit default device. None keeps the
+    historical un-pinned behavior."""
+
+    def __init__(self, device=None):
+        self.device = device
 
     def h2d(self, host):
         import jax
-        return jax.block_until_ready(jax.device_put(host))
+        if self.device is None:
+            return jax.block_until_ready(jax.device_put(host))
+        return jax.block_until_ready(jax.device_put(host, self.device))
 
     def run(self, fn, dev):
         import jax
@@ -253,10 +264,11 @@ class TpuDispatcher:
     """
 
     def __init__(self, max_batch: int = 8, max_delay: float = 0.002,
-                 tracer=None, pipeline_depth: int = 2):
+                 tracer=None, pipeline_depth: int = 2, device=None):
         self.max_batch = max_batch
         self.max_delay = max_delay
         self.tracer = tracer
+        self.device = device        # home device (None = implicit default)
         self.pipeline_depth = max(1, int(pipeline_depth))
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
@@ -303,7 +315,8 @@ class TpuDispatcher:
         self.perf = self.perf.create_perf_counters()
         # device leg implementations (tests substitute a fake here)
         self._jax = self._probe_jax()
-        self._devops = _JaxDevOps() if self._jax else _HostDevOps()
+        self._devops = _JaxDevOps(self.device) if self._jax \
+            else _HostDevOps()
         self._donate_fns: dict = {}   # key -> jitted donating fn | False
         self._donate_ok = self._probe_donation()
         # stall attribution: one state machine per pipeline stage plus
@@ -341,12 +354,16 @@ class TpuDispatcher:
 
     def _probe_donation(self) -> bool:
         """Donation is only honored on real accelerators; the CPU
-        backend ignores it (with a warning per compile), so don't ask."""
+        backend ignores it (with a warning per compile), so don't ask.
+        The probe checks the PINNED device's platform — a mixed host
+        could pin one OSD to an accelerator and another to cpu."""
         if not self._jax:
             return False
         try:
             import jax
-            return jax.devices()[0].platform not in ("cpu",)
+            dev = self.device if self.device is not None \
+                else jax.devices()[0]
+            return dev.platform not in ("cpu",)
         except Exception:
             return False
 
@@ -444,11 +461,19 @@ class TpuDispatcher:
             def prefetch(avail=avail_rows, entry_fn=entry_fn):
                 entry = entry_fn(avail)
                 if self._jax and isinstance(entry, dict) \
-                        and "bitmat" in entry \
-                        and "bitmat_dev" not in entry:
-                    import jax.numpy as jnp
-                    entry.setdefault("bitmat_dev",
-                                     jnp.asarray(entry["bitmat"]))
+                        and "bitmat" in entry:
+                    # the device copy is keyed per HOME device: a
+                    # second pinned dispatcher must stage its own copy,
+                    # not consume (or clobber) the first device's
+                    from ..models.table_cache import device_entry_key
+                    devkey = device_entry_key(self.device)
+                    if devkey not in entry:
+                        import jax
+                        import jax.numpy as jnp
+                        bm = jnp.asarray(entry["bitmat"])
+                        if self.device is not None:
+                            bm = jax.device_put(bm, self.device)
+                        entry.setdefault(devkey, bm)
         return self._submit_async(
             key, lambda stacked: codec.decode_batch(avail_rows, stacked),
             chunks, trace, kind="dec", prefetch=prefetch)
@@ -497,7 +522,9 @@ class TpuDispatcher:
                     "dec_MBps": round(
                         dec_b / self._telemetry_window / 1e6, 3)}
         self.perf.set("l_tpu_queue_depth", depth)
+        from ..parallel.placement import device_label
         return {"queue_depth": depth,
+                "device": device_label(self.device),
                 "ops": ops, "dispatches": disp,
                 "coalesce_ratio": round(disp / ops, 3) if ops else 1.0,
                 "codecs": codecs}
@@ -513,6 +540,7 @@ class TpuDispatcher:
         tel = self.telemetry()
         return {"pipeline_depth": self.pipeline_depth,
                 "overlapped": self.pipeline_depth > 1,
+                "device": tel["device"],
                 "ring": ring,
                 "queue_depth": tel["queue_depth"],
                 "ops": tel["ops"],

@@ -1,1 +1,2 @@
 from .mesh import make_mesh, encode_sharded  # noqa: F401
+from .placement import PLACEMENT, DevicePlacement, device_label  # noqa: F401

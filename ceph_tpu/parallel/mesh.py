@@ -95,3 +95,63 @@ def decode_sharded(codec, avail_rows, chunks, mesh):
     from ..common.profiler import PROFILER
     step = PROFILER.wrap_jit("mesh.decode_sharded", step)
     return step(bitmat, jnp.asarray(chunks))
+
+
+class MeshChecksumError(RuntimeError):
+    """The psum checksum of the device-resident survivor chunks
+    disagrees with the host sum taken when they were received: the
+    bytes that reached the mesh are not the bytes the primary got."""
+
+
+def recover_sharded(codec, avail_rows, chunks, target_row, mesh=None,
+                    expected_sum=None):
+    """Cross-chip recovery: reconstruct one missing row from k
+    survivor chunk streams WITHOUT gathering them to the primary's
+    device.
+
+    chunks: [S, k, N] host survivors (rows ordered as avail_rows).
+    The batch is sharded over (stripe, block), a psum checksum over
+    the mesh is compared against `expected_sum` (host modular uint32
+    sum of the survivors, computed here when not supplied), and the
+    reconstruction runs via decode_sharded on the already-sharded
+    buffers.  Returns the target row [S, N] as host uint8; raises
+    MeshChecksumError when the checksum trips (the survivors were
+    corrupted between receive and device residency).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if mesh is None:
+        mesh = make_mesh()
+    chunks = np.asarray(chunks, dtype=np.uint8)
+    if expected_sum is None:
+        expected_sum = int(chunks.astype(np.uint64).sum()) % (1 << 32)
+    stripe, block = mesh.axis_names
+    s_ax = mesh.shape[stripe]
+    b_ax = mesh.shape[block]
+    s, _k, n = chunks.shape
+    # pad to shardable multiples; zero stripes/columns decode to
+    # zeros (the code is linear and byte columns are independent)
+    # and are trimmed below
+    padded = np.pad(chunks, ((0, (-s) % s_ax), (0, 0),
+                             (0, (-n) % b_ax)))
+    sharding = NamedSharding(mesh, P(stripe, None, block))
+    dev = jax.device_put(jnp.asarray(padded), sharding)
+
+    def _partial(x):
+        return jax.lax.psum(jnp.sum(x.astype(jnp.uint32)),
+                            (stripe, block))
+
+    total = shard_map(_partial, mesh=mesh,
+                      in_specs=P(stripe, None, block),
+                      out_specs=P())(dev)
+    got = int(np.asarray(total)) % (1 << 32)
+    if got != expected_sum % (1 << 32):
+        raise MeshChecksumError(
+            "mesh recovery checksum mismatch: device psum %d != "
+            "host sum %d" % (got, expected_sum % (1 << 32)))
+    full = decode_sharded(codec, avail_rows, dev, mesh)
+    out = np.asarray(full)[:s, target_row, :n]
+    return np.ascontiguousarray(out).astype(np.uint8)

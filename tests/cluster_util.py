@@ -119,8 +119,14 @@ class MiniCluster:
         if self.auth:
             auth = {"secret": self.keyring.get("osd.%d" % osd_id),
                     "service_secrets": self.service_secrets}
+        # mesh-native placement: one OSD per chip, round-robin over
+        # jax.local_devices() (the conftest fake mesh exposes 8 CPU
+        # devices, so an 8-OSD MiniCluster lands one per device).
+        # A caller's explicit osd_device_index override wins.
+        conf = dict(self.conf_overrides)
+        conf.setdefault("osd_device_index", osd_id)
         osd = OSDDaemon(osd_id, self.monmap,
-                        Context(self.conf_overrides,
+                        Context(conf,
                                 name="osd.%d" % osd_id), store=store,
                         auth=auth)
         osd.init()

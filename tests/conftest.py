@@ -4,6 +4,14 @@ Tests never require TPU hardware; sharding logic is validated on a
 virtual 8-device CPU platform (the driver separately dry-runs the
 multi-chip path via __graft_entry__.dryrun_multichip).
 
+This IS the CPU-CI fake-mesh recipe (README "Mesh-native cluster"):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+Under it the whole suite runs mesh-native — MiniCluster assigns
+osd_device_index round-robin, so every OSD's dispatcher/HBM tier pins
+to its own fake device, exactly the one-OSD-per-chip deployment shape.
+
 Note: this image pre-imports jax at interpreter startup with the platform
 pinned, so JAX_PLATFORMS env alone is not enough — use config.update
 before any backend initialization.

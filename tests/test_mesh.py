@@ -140,3 +140,245 @@ def test_mesh_sharded_bulk_crush_equals_scalar_oracle():
     for x in xs:
         ref = mapper_ref.crush_do_rule(m, 0, int(x), 5, list(reweight))
         assert list(got[x]) == ref, x
+
+
+# ---------------------------------------------------------------------------
+# mesh-native cluster: placement, pinned pipelines, balancer, recovery
+
+
+def _toy_osdmap(num_osds=6, pg_num=32):
+    from ceph_tpu.crush.map import CrushMap, weight_fixed
+    from ceph_tpu.osd.osd_map import OSDMap, PGPool
+    m = OSDMap()
+    m.set_max_osd(num_osds)
+    cm = CrushMap()
+    cm.type_names.update({"osd": 0, "host": 1, "root": 2})
+    hosts = num_osds // 2
+    for h in range(hosts):
+        cm.add_bucket("straw2", 1, [2 * h, 2 * h + 1],
+                      [weight_fixed(1.0)] * 2, name="host%d" % h)
+    cm.add_bucket("straw2", 2, [-1 - h for h in range(hosts)],
+                  [weight_fixed(2.0)] * hosts, name="default")
+    cm.add_simple_rule("r", "default")
+    m.crush = cm
+    for o in range(num_osds):
+        m.osd_exists[o] = True
+        m.osd_up[o] = True
+        m.osd_weight[o] = 0x10000
+    m.pools[1] = PGPool(1, "p", size=3, pg_num=pg_num, crush_rule=0)
+    m.pools[2] = PGPool(2, "q", size=2, pg_num=pg_num // 2,
+                        crush_rule=0)
+    return m
+
+
+def test_placement_registry_round_robin():
+    """One OSD per chip with zero per-daemon conf: the default
+    osd_device_index=-1 round-robins by osd id over the fake mesh."""
+    import jax
+
+    from ceph_tpu.parallel.placement import (DevicePlacement,
+                                             device_label)
+    reg = DevicePlacement()
+    devs = jax.devices()
+    for osd in range(10):
+        dev = reg.resolve(osd)
+        assert dev is devs[osd % len(devs)]
+    # explicit index wins (modulo the device count)
+    assert reg.resolve(99, device_index=3) is devs[3]
+    doc = reg.assignments()
+    assert doc["num_devices"] == len(devs)
+    assert doc["osds"]["0"]["device"] == device_label(devs[0])
+    assert doc["osds"]["9"]["device"] == device_label(devs[9 % 8])
+
+
+def test_pinned_dispatchers_concurrent_disjoint_buffers(codec, payload):
+    """Two dispatchers pinned to distinct devices drive concurrently:
+    results bit-equal to the host reference, and each pipeline's
+    device buffers (the HBM-tier residents it adopts) live ONLY on
+    its home device — no shared default-device staging."""
+    import threading
+
+    import jax
+
+    from ceph_tpu.osd.hbm_tier import HbmChunkTier
+    from ceph_tpu.osd.tpu_dispatch import TpuDispatcher
+
+    dev_a, dev_b = jax.devices()[2], jax.devices()[5]
+    ref = np.asarray(codec.encode_batch(payload))
+    results = {}
+
+    def drive(name, dev):
+        disp = TpuDispatcher(max_delay=0.001, device=dev)
+        tier = HbmChunkTier(capacity_objects=8, device=dev)
+        try:
+            for i in range(4):
+                out = np.asarray(disp.encode(
+                    codec, payload,
+                    resident=(tier, ("pg", "%s-%d" % (name, i)))))
+            results[name] = (out, tier)
+        finally:
+            disp.shutdown()
+
+    t_a = threading.Thread(target=drive, args=("a", dev_a))
+    t_b = threading.Thread(target=drive, args=("b", dev_b))
+    t_a.start()
+    t_b.start()
+    t_a.join()
+    t_b.join()
+    out_a, tier_a = results["a"]
+    out_b, tier_b = results["b"]
+    assert np.array_equal(out_a, ref)
+    assert np.array_equal(out_b, ref)
+    # residency is disjoint per home device
+    devs_a = {d for batch, _row in tier_a._objs.values()
+              for d in batch.arr.devices()}
+    devs_b = {d for batch, _row in tier_b._objs.values()
+              for d in batch.arr.devices()}
+    assert devs_a == {dev_a}, devs_a
+    assert devs_b == {dev_b}, devs_b
+
+
+def test_mesh_balancer_sweep_matches_native_exactly():
+    """The sharded all-PG sweep (direction D / carried item 5) must be
+    bit-identical to the native mapper — same PG -> OSD mapping for
+    every PG of every pool, straight through OSDMapMapping.update."""
+    from ceph_tpu.osd.balancer import _sweep
+    from ceph_tpu.osd.osd_map import OSDMapMapping
+
+    m = _toy_osdmap()
+    native = _sweep(m, None, use_device=False)
+    mesh = _sweep(m, None, use_device=False, use_mesh=True)
+    assert mesh == native
+    # and the full mapping document (up/acting/primaries) agrees too
+    a, b = OSDMapMapping(), OSDMapMapping()
+    a.update(m, batched=False)
+    b.update(m, batched=True, mesh=True)
+    assert a.by_pg == b.by_pg
+
+
+def test_balancer_module_measures_mesh_backend():
+    """pick_backend probes all three backends and records medians the
+    operator can read back (`balancer status`)."""
+    import types
+
+    from ceph_tpu.mgr.modules import BalancerModule
+
+    bal = BalancerModule(types.SimpleNamespace(metrics=None))
+    bal.min_speed_samples = 1
+    m = _toy_osdmap(pg_num=16)
+    best = bal.pick_backend(m)
+    assert best in ("native", "device", "mesh")
+    for backend in ("native", "device", "mesh"):
+        assert len(bal.sweep_samples[backend]) == 1
+    meds = bal.sweep_medians()
+    assert set(meds) == {"native", "device", "mesh"}
+
+
+def test_cross_chip_recovery_byte_equality(codec):
+    """recover_object's survivor fallback shape: reconstruct one
+    missing shard via the mesh (sharded survivors + psum checksum),
+    byte-identical to the host decode."""
+    from ceph_tpu.osd import ec_util
+
+    sinfo = ec_util.StripeInfo(K, K * 256)
+    rng = np.random.default_rng(7)
+    payload = rng.integers(0, 256, size=8 * K * 256,
+                           dtype=np.uint8).tobytes()
+    shards = ec_util.encode(sinfo, codec, payload)
+    for target, lost2 in ((5, 2), (0, 4), (3, 1)):
+        survivors = {s: v for s, v in shards.items()
+                     if s not in (target, lost2)}
+        use = tuple(sorted(survivors))[:K]
+        survivors = {s: survivors[s] for s in use}
+        got = ec_util.recover_cross_chip(sinfo, codec, survivors,
+                                         target)
+        want = np.asarray(
+            ec_util.decode(sinfo, codec, survivors,
+                           want={target})[target],
+            dtype=np.uint8).tobytes()
+        assert got == want, (target, lost2)
+
+
+def test_cross_chip_recovery_checksum_trips_on_corruption(codec):
+    """The psum checksum over the mesh must trip when the survivor
+    bytes are corrupted after the host reference sum was taken —
+    the device-resident inputs no longer match what was received."""
+    from ceph_tpu.parallel.mesh import MeshChecksumError, \
+        recover_sharded
+
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, size=(8, K, 256), dtype=np.uint8)
+    parity = np.asarray(codec.encode_batch(data))
+    full = np.concatenate([data, parity], axis=1)
+    avail = (0, 1, 3, 4)
+    chunks = full[:, list(avail), :].copy()
+    expected = int(chunks.astype(np.uint64).sum()) % (1 << 32)
+    # clean run reconstructs row 2 exactly
+    out = recover_sharded(codec, avail, chunks, 2,
+                          expected_sum=expected)
+    assert np.array_equal(out, full[:, 2, :])
+    # inject corruption AFTER the expected checksum was computed
+    chunks[3, 1, 17] ^= 0xFF
+    with pytest.raises(MeshChecksumError):
+        recover_sharded(codec, avail, chunks, 2,
+                        expected_sum=expected)
+
+
+def test_straggler_keeps_other_devices_within_spread(codec, payload):
+    """Slowing ONE pinned pipeline's h2d hop must not drag the other
+    devices down: their throughput stays within the spread they
+    showed healthy (no cross-pipeline serialization)."""
+    import threading
+    import time
+
+    import jax
+
+    from ceph_tpu.osd.tpu_dispatch import TpuDispatcher
+
+    devs = jax.devices()[:4]
+    ops, delay = 5, 0.008
+    disps = [TpuDispatcher(max_delay=delay, device=d) for d in devs]
+    try:
+        for d in disps:
+            np.asarray(d.encode(codec, payload))   # warm
+
+        def sweep():
+            rates = {}
+
+            def drive(i):
+                t0 = time.perf_counter()
+                for _ in range(ops):
+                    np.asarray(disps[i].encode(codec, payload))
+                rates[i] = ops / (time.perf_counter() - t0)
+
+            threads = [threading.Thread(target=drive, args=(i,))
+                       for i in range(len(disps))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return rates
+
+        healthy = [sweep() for _ in range(2)]
+        others_healthy = [r[i] for r in healthy for i in (0, 1, 2)]
+        orig_h2d = disps[3]._devops.h2d
+
+        def slow_h2d(host):
+            time.sleep(3 * delay)
+            return orig_h2d(host)
+
+        disps[3]._devops.h2d = slow_h2d
+        try:
+            slowed = sweep()
+        finally:
+            disps[3]._devops.h2d = orig_h2d
+    finally:
+        for d in disps:
+            d.shutdown()
+    # the straggler itself is measurably slower...
+    assert slowed[3] < min(r[3] for r in healthy)
+    # ...but the others hold their healthy pace (within their spread)
+    spread = max(others_healthy) - min(others_healthy)
+    others_slowed = [slowed[i] for i in (0, 1, 2)]
+    floor = min(others_healthy) - max(spread, 0.2 * min(others_healthy))
+    assert min(others_slowed) >= floor, (slowed, healthy)

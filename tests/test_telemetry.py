@@ -243,12 +243,19 @@ class TestBalancerBackendSelection:
         bal, mgr = self._module()
         bal.sweep_samples["native"] = [0.010, 0.012, 0.011]
         bal.sweep_samples["device"] = [0.500, 0.700, 0.600]
-        assert bal.pick_backend(None) is False     # native wins
+        bal.sweep_samples["mesh"] = [0.900, 0.800, 0.850]
+        assert bal.pick_backend(None) == "native"
+        assert bal.use_device is False
         bal.sweep_samples["device"] = [0.001, 0.002, 0.003]
-        assert bal.pick_backend(None) is True      # device wins
+        assert bal.pick_backend(None) == "device"
+        assert bal.use_device is True
+        bal.sweep_samples["mesh"] = [0.0001, 0.0002, 0.0003]
+        assert bal.pick_backend(None) == "mesh"
+        assert bal.use_device is False
         med = bal.sweep_medians()
         assert med["native"] == pytest.approx(0.011)
         assert med["device"] == pytest.approx(0.002)
+        assert med["mesh"] == pytest.approx(0.0002)
 
     def test_probe_measures_and_records(self):
         """With no samples, pick_backend times one real sweep per
@@ -273,12 +280,16 @@ class TestBalancerBackendSelection:
         bal.pick_backend(m)
         assert len(bal.sweep_samples["native"]) == 1
         assert len(bal.sweep_samples["device"]) == 1
+        assert len(bal.sweep_samples["mesh"]) == 1
         assert mgr.metrics.values("balancer_sweep_native")
-        # the device probe either measured (timing recorded) or is
-        # marked unusable in this environment (inf sample) — never a
-        # crashed round
+        # the device/mesh probes either measured (timing recorded) or
+        # are marked unusable in this environment (inf sample) —
+        # never a crashed round
         assert mgr.metrics.values("balancer_sweep_device") or \
             bal.sweep_samples["device"][0] == float("inf")
+        assert mgr.metrics.values("balancer_sweep_mesh") or \
+            bal.sweep_samples["mesh"][0] == float("inf")
+        assert bal.backend in ("native", "device", "mesh")
         assert isinstance(bal.use_device, bool)
 
 
@@ -583,27 +594,31 @@ class TestLiveTelemetry:
             mgr.register_module(BalancerModule)
         rc, out, _ = mgr.module_command({"prefix": "balancer optimize"})
         assert rc == 0
-        # both backends were measured, the decision came from the
+        # every backend was measured, the decision came from the
         # medians, and the timings landed in the telemetry store
-        assert len(bal.sweep_samples["native"]) >= \
-            bal.min_speed_samples
-        assert len(bal.sweep_samples["device"]) >= \
-            bal.min_speed_samples
+        for backend in ("native", "device", "mesh"):
+            assert len(bal.sweep_samples[backend]) >= \
+                bal.min_speed_samples
         assert isinstance(bal.use_device, bool)
         med = bal.sweep_medians()
         assert med["native"] is not None and med["device"] is not None
-        nat = bal._median(bal.sweep_samples["native"])
-        dev = bal._median(bal.sweep_samples["device"])
-        faster = "device" if dev < nat else "native"
-        assert bal.use_device == (faster == "device")
-        assert bal.last_optimize["backend"] == faster
+        medians = {b: bal._median(bal.sweep_samples[b])
+                   for b in ("native", "device", "mesh")}
+        fastest = min(("native", "device", "mesh"),
+                      key=lambda b: (medians[b],
+                                     ("native", "device",
+                                      "mesh").index(b)))
+        assert bal.backend == fastest
+        assert bal.use_device == (fastest == "device")
+        assert bal.last_optimize["backend"] == fastest
         assert mgr.metrics.values("balancer_sweep_native")
         # device timings recorded when the backend works here;
         # otherwise it was measured-as-unusable (inf) and skipped
         assert mgr.metrics.values("balancer_sweep_device") or \
-            dev == float("inf")
+            medians["device"] == float("inf")
         rc, _, data = mgr.module_command({"prefix": "balancer status"})
         assert rc == 0 and data["use_device"] == bal.use_device
+        assert data["backend"] == bal.backend
 
     def test_stale_daemon_ages_out_of_prometheus(self,
                                                  telemetry_cluster):
