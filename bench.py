@@ -367,6 +367,127 @@ def _bench_extra_rows(jax, jnp, on_tpu: bool) -> "tuple[dict, list]":
     return out, checks
 
 
+def _bench_fused_row() -> dict:
+    """Fused write transform vs the separate path (direction F).
+
+    fused:    ONE jitted program — per-chunk digests + entropy probe +
+              bit-plane compress decision + EC encode + per-shard crcs
+              — then the single d2h of parity/digests/container.
+    separate: what the classic write path costs for the same batch —
+              device EC encode, d2h of the parity, host zlib.crc32 per
+              shard stream (the hinfo chain), and a host compression
+              attempt (the same bit-plane container, numpy twin).
+
+    Interleaved REPEATS windows (medians published, spread recorded).
+    Both rows end in their d2h, so this runs AFTER the sealed
+    device-resident sections. Correctness gates vs host oracles
+    (zlib/crc32c/xxh32/container twin) always run; the >= 1.15x
+    speedup gate is HARD on a real accelerator and advisory on the
+    CPU fallback — the GF(2) crc tree is shaped for the vector units
+    fusion targets, and a host-XLA loss there prices the wrong
+    machine."""
+    import zlib
+
+    import jax
+
+    from ceph_tpu import registry
+    from ceph_tpu.osd import fused_transform as ft
+
+    codec = registry.factory("jax_tpu", {"technique": "reed_sol_van",
+                                         "k": str(K), "m": str(M)})
+    if not ft.fused_supported(codec):
+        return {}
+    on_tpu = jax.devices()[0].platform == "tpu"
+    rng = np.random.default_rng(11)
+    S, chunk = (16, 1 << 16) if on_tpu else (8, 1 << 14)
+    # low-entropy batch: the probe accepts and the compress stage does
+    # real work on every call (the decision path being priced)
+    batch = rng.integers(0, 4, size=(S, K, chunk), dtype=np.uint8)
+    vol = S * K * chunk
+    iters = 4 if on_tpu else 2
+
+    def fused_once():
+        out = ft.run_fused(codec, batch, mode="compress")
+        return jax.device_get(out)            # the one d2h
+
+    def separate_once():
+        parity = np.asarray(codec.encode_batch(batch))   # d2h
+        allr = np.concatenate([batch, parity], axis=1)
+        crcs = [zlib.crc32(np.ascontiguousarray(
+            allr[:, i, :]).tobytes()) & 0xFFFFFFFF
+            for i in range(allr.shape[1])]
+        body, _ = ft.bitplane_compress_host(batch.tobytes())
+        return crcs, len(body)
+
+    def _once(fn):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        return (time.perf_counter() - t0) / iters
+
+    host = fused_once()                       # warm/compile both paths
+    sep_crcs, sep_len = separate_once()
+
+    # correctness before timing: the fused outputs against the host
+    # oracles the separate path IS
+    r = ft.result_from_host(host, S, K, chunk, "compress")
+    if not bool(host["do_compress"]) or r.comp_len != sep_len:
+        raise SystemExit("fused bench gate: device compress decision "
+                         "diverged from the host twin")
+    flat = np.asarray(r.stored).reshape(-1)[:r.comp_len].tobytes()
+    twin, padded = ft.bitplane_compress_host(batch.tobytes())
+    if flat != twin:
+        raise SystemExit("fused bench gate: device container != host "
+                         "bit-plane twin")
+    if ft.bitplane_decompress(flat, padded)[:vol] != batch.tobytes():
+        raise SystemExit("fused bench gate: container does not "
+                         "round-trip")
+    stored_np = np.asarray(r.stored)
+    all_rows = np.concatenate([stored_np, np.asarray(r.parity)], axis=1)
+    for i in range(K + M):
+        want = zlib.crc32(np.ascontiguousarray(
+            all_rows[:, i, :]).tobytes()) & 0xFFFFFFFF
+        if r.shard_crcs[i] != want:
+            raise SystemExit("fused bench gate: device shard crc %d "
+                             "mismatch" % i)
+    for s, i in ((0, 0), (S - 1, K - 1)):
+        raw = batch[s, i].tobytes()
+        if int(host["chunk_crc32c"][s, i]) != ft.crc32c_host(raw) or \
+                int(host["chunk_xxh32"][s, i]) != ft.xxh32_host(raw):
+            raise SystemExit("fused bench gate: device chunk digest "
+                             "mismatch at (%d, %d)" % (s, i))
+
+    win = _interleave_rows([
+        ("fused", lambda: _once(fused_once)),
+        ("separate", lambda: _once(separate_once)),
+    ])
+    fused_mbps = vol / _median(win["fused"]) / 1e6
+    sep_mbps = vol / _median(win["separate"]) / 1e6
+    ratio = fused_mbps / sep_mbps
+
+    def _stats(times):
+        rates = [vol / t / 1e6 for t in times]
+        return {"median_MBps": round(_median(rates), 1),
+                "spread_MBps": round(max(rates) - min(rates), 1),
+                "samples_MBps": [round(x, 1) for x in rates]}
+
+    if on_tpu and ratio < 1.15:
+        raise SystemExit(
+            "fused bench gate: fused %.1f MB/s < 1.15 x separate "
+            "%.1f MB/s (ratio %.3f) — fusion is not paying for itself"
+            % (fused_mbps, sep_mbps, ratio))
+    return {
+        "fused_MBps": round(fused_mbps, 1),
+        "fused_separate_MBps": round(sep_mbps, 1),
+        "fused_vs_separate": round(ratio, 3),
+        "fused_gate": ("hard_pass" if on_tpu
+                       else "advisory_cpu (crc tree is TPU-shaped)"),
+        "fused_comp_ratio": round(r.comp_len / vol, 4),
+        "fused_row_stats": {"fused": _stats(win["fused"]),
+                            "separate": _stats(win["separate"])},
+    }
+
+
 def _bench_cluster() -> dict:
     """End-to-end OSD pipeline number (the rados-bench role,
     src/common/obj_bencher.h write/read protocol at framework scale):
@@ -1647,6 +1768,18 @@ def run_bench() -> None:
     except Exception as e:
         cluster_rows = {"cluster_bench_error": str(e)[:200]}
 
+    # fused write transform vs the separate path (direction F) — both
+    # rows end in d2h, so post-seal like the cluster row; correctness
+    # gates vs host oracles always, speedup gate hard on accelerators
+    print("BENCH-STAGE fused-row", file=sys.stderr, flush=True)
+    fused_rows: dict = {}
+    try:
+        fused_rows = _bench_fused_row()
+    except SystemExit:
+        raise
+    except Exception as e:
+        fused_rows = {"fused_bench_error": str(e)[:200]}
+
     # profiler overhead gate: prices the DeviceProfiler's off-path
     # promise on every run (profiler-on streaming within 3% of
     # profiler-off, SystemExit otherwise)
@@ -1670,6 +1803,7 @@ def run_bench() -> None:
     doc.update(native)
     doc.update(extra_rows)
     doc.update(cluster_rows)
+    doc.update(fused_rows)
     if "native_cpu_MBps" in doc:
         doc["vs_native"] = round(value / doc["native_cpu_MBps"], 2)
     # no emitted rate may exceed single-chip physics — a violation is

@@ -130,6 +130,27 @@ def _declare_defaults():
       "spans observability tooling keys on, so reads-from-HBM is an "
       "explicit opt-in (scrub/recovery residency hits ride "
       "osd_hbm_tier_enable alone)")
+    # fused write transform (osd/fused_transform.py, ROADMAP
+    # direction F): one jitted program per staged batch computes
+    # shard crcs + chunk digests + compressibility probe +
+    # bit-plane compression + EC encode — one h2d, one d2h
+    o("osd_fused_transform", bool, True, LEVEL_ADVANCED,
+      "route whole-object EC writes through the fused device "
+      "transform (digest + probe + compress + encode in one jitted "
+      "program). Off = the classic host-hash + separate-encode path")
+    o("osd_fused_compression_mode", str, "none", LEVEL_ADVANCED,
+      "inline device compression for fused writes: 'none' stores "
+      "raw (digests + encode still fused); 'bitplane' lets the "
+      "device decide compress-vs-store per object from the entropy "
+      "probe and the required ratio")
+    o("osd_fused_required_ratio", float, 0.875, LEVEL_ADVANCED,
+      "stored/raw ratio the device compression must beat for a "
+      "fused write to store the compressed stream (compressor "
+      "required_ratio analog, decided on device)")
+    o("osd_fused_probe_entropy_max", float, 7.0, LEVEL_ADVANCED,
+      "byte-entropy (bits/byte) above which the fused probe "
+      "declares the object incompressible and stores raw without "
+      "attempting bit-plane compression")
     o("osd_op_history_size", int, 20, LEVEL_ADVANCED,
       "completed ops kept for dump_historic_ops")
     o("osd_op_history_duration", float, 600.0, LEVEL_ADVANCED,

@@ -272,14 +272,17 @@ def device_segments(fn, batch):
         import jax
         import jax.numpy as jnp
     except Exception:
-        out = np.asarray(fn(batch))
+        out = fn(batch)
+        out = out if isinstance(out, dict) else np.asarray(out)
         return out, {"h2d": 0.0, "compute": time.perf_counter() - t0,
                      "d2h": 0.0}
     dev = jax.block_until_ready(jnp.asarray(batch))
     t1 = time.perf_counter()
     out_dev = jax.block_until_ready(fn(dev))
     t2 = time.perf_counter()
-    out = np.asarray(out_dev)
+    # fused programs return an output dict; drain it in ONE device_get
+    out = jax.device_get(out_dev) if isinstance(out_dev, dict) \
+        else np.asarray(out_dev)
     t3 = time.perf_counter()
     return out, {"h2d": t1 - t0, "compute": t2 - t1, "d2h": t3 - t2}
 

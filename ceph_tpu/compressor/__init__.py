@@ -9,13 +9,16 @@ registration, version gating) and the `Compressor::create` alias
 resolution + BlueStore compression-mode policy
 (none/passive/aggressive/force, Compressor.h `CompressionMode`).
 
-Algorithms: zlib (stdlib) and zstd (zstandard package) always work in
-this image; snappy and lz4 register but fail to load with ENOENT when
+Algorithms: zlib (stdlib) always works; zstd/snappy/lz4 are probed at
+import (`plugins.HAVE_*`) and register but fail to load with ENOENT when
 their host libraries are absent — the same observable behavior as a
-missing libceph_snappy.so in the reference.
+missing libceph_snappy.so in the reference. `available(name)` is the
+non-raising probe callers use to degrade cleanly. `jax_device` is the
+device-side bit-plane compressor riding the fused write transform
+(osd/fused_transform.py).
 """
 
 from .base import Compressor, CompressorError, MODE_AGGRESSIVE  # noqa: F401
 from .base import MODE_FORCE, MODE_NONE, MODE_PASSIVE  # noqa: F401
-from .registry import CompressionPluginRegistry, create  # noqa: F401
+from .registry import CompressionPluginRegistry, create, available  # noqa: F401
 from .base import should_compress, compress_if_worthwhile  # noqa: F401

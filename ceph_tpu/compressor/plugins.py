@@ -10,9 +10,27 @@ a missing plugin .so in the reference.
 from __future__ import annotations
 
 import errno as _errno
+import importlib.util as _importlib_util
+import struct as _struct
 import zlib as _zlib
 
 from .base import Compressor, CompressorError
+
+
+def _probe(modname: str) -> bool:
+    """Import-time availability probe for a host library. find_spec is
+    the dlopen-existence check: it never executes the module, so a
+    missing package degrades to `available() == False` instead of an
+    ImportError at first use (the tier-1 environment lacks zstandard)."""
+    try:
+        return _importlib_util.find_spec(modname) is not None
+    except (ImportError, ValueError):
+        return False
+
+
+HAVE_ZSTD = _probe("zstandard")
+HAVE_SNAPPY = _probe("snappy")
+HAVE_LZ4 = _probe("lz4")
 
 
 class ZlibCompressor(Compressor):
@@ -41,6 +59,8 @@ class ZstdCompressor(Compressor):
     name = "zstd"
 
     def __init__(self, level: int = 1):
+        if not HAVE_ZSTD:
+            raise ImportError("zstandard module not present")
         import zstandard
         self._mod = zstandard
         self.level = level
@@ -62,6 +82,8 @@ class SnappyCompressor(Compressor):
     name = "snappy"
 
     def __init__(self):
+        if not HAVE_SNAPPY:
+            raise ImportError("snappy module not present")
         import snappy
         self._mod = snappy
 
@@ -75,10 +97,49 @@ class SnappyCompressor(Compressor):
             raise CompressorError(_errno.EIO, "snappy decompress: %s" % e)
 
 
+class JaxDeviceCompressor(Compressor):
+    """Bit-plane compressor from the fused write transform
+    (osd/fused_transform.py). The OSD write path runs this stage inside
+    the one jitted device program; the plugin exposes the same
+    container standalone through the registry (`plugin=jax_device`), so
+    pool options and tooling can name the algorithm like any other.
+
+    Self-contained frame: 8-byte header (<II: raw_len, padded_len) +
+    the bit-plane container — the fused path instead carries
+    raw_len/padded_len in the object's HashInfo comp_info."""
+
+    name = "jax_device"
+
+    def __init__(self):
+        from ..osd import fused_transform
+        self._ft = fused_transform
+
+    def compress(self, data: bytes) -> bytes:
+        data = bytes(data)
+        body, padded = self._ft.bitplane_compress_host(data)
+        return _struct.pack("<II", len(data), padded) + body
+
+    def decompress(self, data: bytes) -> bytes:
+        data = bytes(data)
+        try:
+            raw_len, padded = _struct.unpack_from("<II", data, 0)
+            if padded % 64 or padded < raw_len:
+                raise ValueError("bad frame header")
+            out = self._ft.bitplane_decompress(data[8:], padded)
+            if len(out) < raw_len:
+                raise ValueError("short frame")
+            return out[:raw_len]
+        except (ValueError, _struct.error) as e:
+            raise CompressorError(
+                _errno.EIO, "jax_device decompress: %s" % e)
+
+
 class Lz4Compressor(Compressor):
     name = "lz4"
 
     def __init__(self):
+        if not HAVE_LZ4:
+            raise ImportError("lz4 module not present")
         import lz4.block
         self._mod = lz4.block
 

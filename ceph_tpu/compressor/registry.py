@@ -47,6 +47,7 @@ def _builtin_loaders():
         "zstd": probe(plugins.ZstdCompressor),
         "snappy": probe(plugins.SnappyCompressor),
         "lz4": probe(plugins.Lz4Compressor),
+        "jax_device": probe(plugins.JaxDeviceCompressor),
     }
 
 
@@ -101,6 +102,24 @@ class CompressionPluginRegistry:
         with self.lock:
             plugin = self.load(name)
         return plugin.factory()
+
+    def available(self, name: str) -> bool:
+        """Non-raising availability probe: True when the plugin's host
+        library is present and the plugin loads. Lets callers (pool
+        option validation, tests) degrade instead of erroring when the
+        environment lacks a library (e.g. zstandard)."""
+        try:
+            self.load(name)
+            return True
+        except CompressorError:
+            return False
+
+
+def available(name: str) -> bool:
+    """Module-level availability probe (registry singleton)."""
+    if not name or name == "none":
+        return True
+    return CompressionPluginRegistry.instance().available(name)
 
 
 def create(name: str) -> Compressor | None:

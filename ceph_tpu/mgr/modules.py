@@ -126,6 +126,28 @@ class PrometheusModule(MgrModule):
                              row.get("enc_MBps", 0.0), clbl)
                         emit("ceph_tpu_codec_decode_MBps",
                              row.get("dec_MBps", 0.0), clbl)
+                    # fused write-transform series (direction F):
+                    # dispatch/byte totals, on-device compression
+                    # decisions, achieved stored/raw ratio
+                    fused = tpu.get("fused") or {}
+                    if fused:
+                        emit("ceph_tpu_fused_dispatches",
+                             fused.get("dispatches", 0), lbl,
+                             mtype="counter")
+                        emit("ceph_tpu_fused_bytes_in",
+                             fused.get("bytes_in", 0), lbl,
+                             mtype="counter")
+                        emit("ceph_tpu_fused_bytes_out",
+                             fused.get("bytes_out", 0), lbl,
+                             mtype="counter")
+                        emit("ceph_tpu_fused_compressed",
+                             fused.get("compressed", 0), lbl,
+                             mtype="counter")
+                        emit("ceph_tpu_fused_probe_rejects",
+                             fused.get("probe_rejects", 0), lbl,
+                             mtype="counter")
+                        emit("ceph_tpu_fused_ratio",
+                             fused.get("ratio_avg", 1.0), lbl)
                 hbm = status.get("hbm") or {}
                 if hbm:
                     emit("ceph_osd_hbm_resident_objects",

@@ -219,7 +219,14 @@ class ECBackend:
                 # (pg, oid): scrub/recovery (and opt-in repeat reads)
                 # then never re-cross the host-device pipe
                 tier=getattr(self.pg.daemon, "hbm_tier", None),
-                tier_prefix=str(self.pg.pgid))
+                tier_prefix=str(self.pg.pgid),
+                # fused write transform config (osd_fused_transform /
+                # osd_fused_compression_mode options via the daemon)
+                fused_mode=getattr(self.pg.daemon, "fused_mode", None),
+                fused_required_ratio=getattr(
+                    self.pg.daemon, "fused_required_ratio", 0.875),
+                fused_entropy_max=getattr(
+                    self.pg.daemon, "fused_entropy_max", 7.0))
             enc_span.finish()
             for oid, wmap in written.items():
                 self.cache.present_rmw_update(oid, wmap)
@@ -405,12 +412,20 @@ class ECBackend:
         if off >= end:
             on_done(b"")
             return
-        stripe_off, stripe_len = self.sinfo.offset_len_to_stripe_bounds(
-            (off, end - off))
-        chunk_off = self.sinfo.aligned_logical_offset_to_chunk_offset(
-            stripe_off)
-        chunk_len = self.sinfo.aligned_logical_offset_to_chunk_offset(
-            stripe_len)
+        comp = getattr(self.get_hinfo(oid), "comp_info", None)
+        if comp is not None:
+            # compressed stored stream (fused write transform):
+            # logical offsets don't map to stored chunk offsets — read
+            # the WHOLE stored stream; completion decompresses + slices
+            chunk_off = 0
+            chunk_len = self.get_hinfo(oid).get_total_chunk_size()
+        else:
+            stripe_off, stripe_len = \
+                self.sinfo.offset_len_to_stripe_bounds((off, end - off))
+            chunk_off = self.sinfo.aligned_logical_offset_to_chunk_offset(
+                stripe_off)
+            chunk_len = self.sinfo.aligned_logical_offset_to_chunk_offset(
+                stripe_len)
 
         # opt-in residency read: a resident (pg, oid) entry holds the
         # committed full chunk set, so the read is one tiny d2h of the
@@ -498,6 +513,17 @@ class ECBackend:
                 full[:self.k].reshape(self.k, stripes,
                                       self.sinfo.chunk_size)
                 .transpose(1, 0, 2)).reshape(-1)
+            comp = getattr(self.get_hinfo(oid), "comp_info", None)
+            if comp is not None:
+                # resident rows hold the compressed container: inflate
+                from . import fused_transform
+                raw = fused_transform.bitplane_decompress(
+                    logical[:int(comp["comp_len"])].tobytes(),
+                    int(comp["padded_len"]))
+                logical = np.frombuffer(
+                    raw, dtype=np.uint8)[:self.get_hinfo(oid)
+                                         .get_total_logical_size(
+                                             self.sinfo)]
         except Exception:
             return False
         if end > logical.size:
@@ -688,6 +714,22 @@ class ECBackend:
             read.on_done(None)
             return
         dec_span.finish()
+        comp = getattr(self.get_hinfo(read.oid), "comp_info", None)
+        if comp is not None:
+            # the decoded stream is the compressed container (fused
+            # write transform): inflate it back to the logical bytes
+            from . import fused_transform
+            try:
+                out = fused_transform.bitplane_decompress(
+                    out[:int(comp["comp_len"])],
+                    int(comp["padded_len"]))
+            except Exception:
+                read.on_done(None)
+                return
+            out = out[:self.get_hinfo(read.oid)
+                      .get_total_logical_size(self.sinfo)]
+            read.on_done(out[read.off:read.off + read.length])
+            return
         stripe_off = self.sinfo.aligned_chunk_offset_to_logical_offset(
             read.chunk_off)
         start = read.off - stripe_off
@@ -703,9 +745,16 @@ class ECBackend:
         continue_recovery_op reshaped: read the full chunk streams from
         the available shards, decode-all (ONE batched device call),
         hand the target shard's bytes + attrs to on_done(shard_bytes)."""
-        size = self._object_logical_size(oid)
-        chunk_total = self.sinfo.aligned_logical_offset_to_chunk_offset(
-            self.sinfo.logical_to_next_stripe_offset(size))
+        h = self.get_hinfo(oid)
+        if getattr(h, "comp_info", None) is not None:
+            # compressed object: the shard streams on disk are the
+            # STORED (compressed) length, not the logical-derived one
+            chunk_total = h.get_total_chunk_size()
+        else:
+            size = self._object_logical_size(oid)
+            chunk_total = \
+                self.sinfo.aligned_logical_offset_to_chunk_offset(
+                    self.sinfo.logical_to_next_stripe_offset(size))
         if chunk_total == 0:
             on_done(b"")
             return

@@ -62,6 +62,18 @@ def get_write_plan(sinfo: ec_util.StripeInfo, t, get_hinfo) -> WritePlan:
 
         will_write = plan.will_write.setdefault(oid, IntervalSet())
 
+        # a COMPRESSED object (fused write transform, hinfo.comp_info)
+        # cannot be partially overwritten in place: logical offsets
+        # don't map to stored chunk offsets.  Any mutation becomes a
+        # full-object RMW — read the whole object back (the read path
+        # decompresses), overlay, rewrite whole
+        if getattr(hinfo, "comp_info", None) is not None \
+                and not op.deletes_first() and projected_size > 0 \
+                and (op.buffer_updates or op.truncate is not None):
+            plan.to_read.setdefault(oid, IntervalSet()).union_insert(
+                0, projected_size)
+            will_write.union_insert(0, projected_size)
+
         # unaligned truncate-down: rewrite the boundary stripe
         if op.truncate is not None and op.truncate[0] < projected_size:
             trunc = op.truncate[0]
@@ -121,7 +133,11 @@ def generate_transactions(plan: WritePlan, codec,
                           shards: list,
                           cid_of, dispatcher=None,
                           trace=None, tier=None,
-                          tier_prefix=None) -> tuple[dict, dict]:
+                          tier_prefix=None,
+                          fused_mode: str | None = None,
+                          fused_required_ratio: float = 0.875,
+                          fused_entropy_max: float = 7.0
+                          ) -> tuple[dict, dict]:
     """Build {shard: Transaction} from the plan + readback data.
 
     partial_extents: oid -> ExtentMap with the to_read stripes filled
@@ -136,10 +152,20 @@ def generate_transactions(plan: WritePlan, codec,
     write re-adopts the encode device-side through the dispatcher
     pipeline — partial RMWs stay host-planned and simply leave the
     object non-resident until its next full write.
+
+    fused_mode routes whole-object writes through the fused write
+    transform (ec_util.encode_fused: digests + compress decision + EC
+    encode in one device program): "store" fuses digests+encode,
+    "compress" additionally lets the device compress the stored
+    stream; None/"off" keeps the classic encode.  Partial RMWs and
+    ops carrying a truncate always take the classic path.
     """
     txns = {shard: Transaction() for shard in shards}
     written: dict = {}
     n = codec.get_chunk_count()
+    fused_ok = (fused_mode not in (None, "", "off")
+                and dispatcher is not None
+                and dispatcher.fused_supported(codec))
 
     for oid, op in plan.t.safe_create_traverse():
         tier_key = None
@@ -175,10 +201,17 @@ def generate_transactions(plan: WritePlan, codec,
             # chunk set, so the resident copy can serve any later
             # scrub digest, shard rebuild or whole-object read
             whole_object = (
-                tier_key is not None and len(extents) == 1
+                len(extents) == 1
                 and extents[0][0] == 0 and extents[0][1] > 0
                 and extents[0][1] ==
                 hinfo.get_projected_total_logical_size(sinfo))
+            # fused write transform: whole-object writes without a
+            # truncate ride the single device program (a truncate's
+            # chunk arithmetic runs in logical space and must not cut
+            # a freshly compressed stream)
+            use_fused = (fused_ok and whole_object
+                         and op.truncate is None)
+            fused_res = None
             for off, length in extents:
                 # assemble the logical bytes for this extent: readback
                 # stripes overlaid with the op's buffer updates,
@@ -206,11 +239,20 @@ def generate_transactions(plan: WritePlan, codec,
                     if lo < hi:
                         buf[lo - off:hi - off] = data[lo - uoff:hi - uoff]
 
-                encoded = ec_util.encode(
-                    sinfo, codec, buf, dispatcher=dispatcher,
-                    trace=trace,
-                    resident=(tier, tier_key) if whole_object
-                    else None)
+                res = (tier, tier_key) \
+                    if whole_object and tier_key is not None else None
+                if use_fused:
+                    encoded, fused_res = ec_util.encode_fused(
+                        sinfo, codec, buf, dispatcher=dispatcher,
+                        trace=trace, resident=res,
+                        mode="compress" if fused_mode == "compress"
+                        else "store",
+                        required_ratio=fused_required_ratio,
+                        entropy_max_bits=fused_entropy_max)
+                else:
+                    encoded = ec_util.encode(
+                        sinfo, codec, buf, dispatcher=dispatcher,
+                        trace=trace, resident=res)
                 chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(off)
                 for shard in range(n):
                     if shard in txns:
@@ -221,10 +263,30 @@ def generate_transactions(plan: WritePlan, codec,
 
             # hinfo chains crcs only for pure appends (overwrites
             # invalidate the chunk hash, as in the reference's
-            # overwrite path)
+            # overwrite path).  A fused write replaces the hinfo
+            # wholesale with the DEVICE-computed shard crcs — zero
+            # host hashing on the whole-object write path
             old_size = hinfo.get_total_chunk_size()
-            pure_append = all(off >= old_size for off in appends)
-            if pure_append:
+            if fused_res is not None:
+                stored_chunk = fused_res.used_stripes * sinfo.chunk_size
+                comp = None
+                if fused_res.compressed:
+                    from .fused_transform import COMP_ALG
+                    comp = {"alg": COMP_ALG,
+                            "orig_chunk_size":
+                                sinfo.aligned_logical_offset_to_chunk_offset(
+                                    extents[0][1]),
+                            "comp_len": fused_res.comp_len,
+                            "padded_len": fused_res.padded_len}
+                hinfo.set_device_hashes(fused_res.shard_crcs,
+                                        stored_chunk, comp_info=comp)
+                # clamp every shard file to the stored stream: a
+                # rewrite of a previously-longer (or previously-raw)
+                # object must not leave a stale tail behind the
+                # (possibly shorter) compressed container
+                for shard, txn in txns.items():
+                    txn.truncate(cid_of(shard), oid, stored_chunk)
+            elif all(off >= old_size for off in appends):
                 for chunk_off in sorted(appends):
                     hinfo.append(chunk_off, appends[chunk_off])
             else:
@@ -232,6 +294,7 @@ def generate_transactions(plan: WritePlan, codec,
                 hinfo.total_chunk_size = max(
                     hinfo.total_chunk_size,
                     hinfo.projected_total_chunk_size)
+                hinfo.comp_info = None   # the object is raw again
 
         # shard truncate to the projected size
         if op.truncate is not None:

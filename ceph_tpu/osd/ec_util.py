@@ -25,8 +25,8 @@ import numpy as np
 
 from ..errors import ErasureCodeError
 
-__all__ = ["StripeInfo", "encode", "decode", "recover_cross_chip",
-           "HashInfo"]
+__all__ = ["StripeInfo", "encode", "encode_fused", "decode",
+           "recover_cross_chip", "HashInfo"]
 
 CHUNK_ALIGNMENT = 64
 
@@ -127,6 +127,75 @@ def encode(sinfo: StripeInfo, codec, data, want=None,
         src = batch[:, i, :] if i < k else parity[:, i - k, :]
         out[idx] = np.ascontiguousarray(src).reshape(-1)
     return out
+
+
+def encode_fused(sinfo: StripeInfo, codec, data, want=None,
+                 dispatcher=None, trace=None, resident=None,
+                 mode: str = "store", required_ratio: float = 0.875,
+                 entropy_max_bits: float = 7.0) -> tuple:
+    """Whole-object write through the fused device transform: per-chunk
+    digests, the compressibility probe + compress-vs-store decision,
+    and the EC encode run as ONE device program — one h2d of the raw
+    payload, one fused program, one d2h of parity + digests (+ the
+    compressed payload when the device chose to compress).
+
+    Returns (shard_map, FusedResult).  shard_map is {shard: chunk
+    stream} of what must LAND ON DISK — the compressed container's
+    stripes when mode="compress" and the probe accepted, the raw
+    stripes otherwise.  The FusedResult carries the device-computed
+    per-shard crcs (HashInfo.set_device_hashes), the per-chunk
+    crc32c/xxh32 digests, and the compression verdict the caller
+    records in the hinfo xattr.
+
+    resident=(tier, key) adopts the STORED rows + shard crcs into the
+    HbmChunkTier (scrub-from-digest), exactly like encode()'s resident
+    contract.
+    """
+    from . import fused_transform
+    arr = np.frombuffer(data, dtype=np.uint8) if isinstance(
+        data, (bytes, bytearray, memoryview)) else \
+        np.asarray(data, dtype=np.uint8).reshape(-1)
+    if arr.size % sinfo.stripe_width != 0:
+        raise ErasureCodeError(
+            22, "payload %d not stripe aligned (width %d)"
+            % (arr.size, sinfo.stripe_width))
+    if arr.size == 0:
+        return {}, None
+    k = codec.get_data_chunk_count()
+    n = codec.get_chunk_count()
+    stripes = arr.size // sinfo.stripe_width
+    batch = arr.reshape(stripes, k, sinfo.chunk_size)
+    if dispatcher is not None:
+        r = dispatcher.fused_write(
+            codec, batch, mode=mode, required_ratio=required_ratio,
+            entropy_max_bits=entropy_max_bits, trace=trace,
+            resident=resident)
+    else:
+        out = fused_transform.run_fused(
+            codec, batch, mode=mode, required_ratio=required_ratio,
+            entropy_max_bits=entropy_max_bits)
+        r = fused_transform.finish_fused(out, stripes, k,
+                                         sinfo.chunk_size, mode)
+        if resident is not None:
+            tier, key = resident
+            try:
+                rows = r.stored if r.stored is not None else batch
+                tier.adopt_encode(
+                    key, rows, r.parity, codec,
+                    digests=np.asarray(r.shard_crcs, dtype=np.uint32))
+            except Exception:
+                pass   # the tier is a cache: adoption never fails
+    rows = r.stored if r.stored is not None else batch
+    parity = np.asarray(r.parity)
+    shard_map = {}
+    for i in range(n):
+        idx = codec.chunk_index(i)
+        if want is not None and idx not in want:
+            continue
+        src = rows[:, i, :] if i < k else parity[:, i - k, :]
+        shard_map[idx] = np.ascontiguousarray(
+            np.asarray(src)).reshape(-1)
+    return shard_map, r
 
 
 def decode(sinfo: StripeInfo, codec, to_decode: dict,
@@ -301,12 +370,21 @@ class HashInfo:
     append() must be called with stripe-aligned same-length per-shard
     appends; the crc chains so any historical corruption is detectable
     on deep scrub.
+
+    The fused write transform (osd/fused_transform.py) bypasses the
+    host crc chain entirely: set_device_hashes() accepts the
+    device-computed per-shard crcs wholesale for a full-object write,
+    and comp_info records the on-device compression of the stored
+    stream ({"alg", "orig_chunk_size", "comp_len", "padded_len"}) —
+    when set, total_chunk_size is the STORED (compressed) per-shard
+    stream length while logical sizes derive from orig_chunk_size.
     """
 
     def __init__(self, num_chunks: int = 0):
         self.total_chunk_size = 0
         self.cumulative_shard_hashes = [0] * num_chunks
         self.projected_total_chunk_size = 0
+        self.comp_info: dict | None = None
 
     def has_chunk_hash(self) -> bool:
         return bool(self.cumulative_shard_hashes)
@@ -324,6 +402,19 @@ class HashInfo:
                     data, self.cumulative_shard_hashes[shard]) & 0xFFFFFFFF
         self.total_chunk_size += size
 
+    def set_device_hashes(self, shard_crcs, total_chunk_size: int,
+                          comp_info: dict | None = None) -> None:
+        """Accept device-computed cumulative shard crcs wholesale (the
+        fused write transform's output) — valid only as a FULL-object
+        (re)write, which is exactly when the fused path runs.  Zero
+        host hashing: the crcs were computed beside the encode on
+        device.  comp_info records (or, None, clears) the stored
+        stream's compression."""
+        self.cumulative_shard_hashes = [int(c) & 0xFFFFFFFF
+                                        for c in shard_crcs]
+        self.total_chunk_size = int(total_chunk_size)
+        self.comp_info = dict(comp_info) if comp_info else None
+
     def get_chunk_hash(self, shard: int) -> int:
         return self.cumulative_shard_hashes[shard]
 
@@ -331,8 +422,9 @@ class HashInfo:
         return self.total_chunk_size
 
     def get_total_logical_size(self, sinfo: StripeInfo) -> int:
-        return self.total_chunk_size * (sinfo.stripe_width //
-                                        sinfo.chunk_size)
+        base = self.comp_info["orig_chunk_size"] \
+            if self.comp_info is not None else self.total_chunk_size
+        return base * (sinfo.stripe_width // sinfo.chunk_size)
 
     def get_projected_total_logical_size(self, sinfo: StripeInfo) -> int:
         return self.projected_total_chunk_size * (sinfo.stripe_width //
@@ -348,16 +440,28 @@ class HashInfo:
         self.total_chunk_size = 0
         self.cumulative_shard_hashes = [0] * len(
             self.cumulative_shard_hashes)
+        self.comp_info = None
 
     def to_dict(self) -> dict:
-        return {"total_chunk_size": self.total_chunk_size,
-                "cumulative_shard_hashes": list(
-                    self.cumulative_shard_hashes)}
+        d = {"total_chunk_size": self.total_chunk_size,
+             "cumulative_shard_hashes": list(
+                 self.cumulative_shard_hashes)}
+        if self.comp_info is not None:
+            # only compressed objects carry the key: hinfo xattrs
+            # written before the fused transform stay byte-identical
+            d["comp_info"] = dict(self.comp_info)
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "HashInfo":
         h = cls(len(d["cumulative_shard_hashes"]))
         h.total_chunk_size = d["total_chunk_size"]
         h.cumulative_shard_hashes = list(d["cumulative_shard_hashes"])
-        h.projected_total_chunk_size = h.total_chunk_size
+        h.comp_info = dict(d["comp_info"]) if d.get("comp_info") \
+            else None
+        # projections live in LOGICAL space: a compressed object's
+        # projected size derives from the raw-equivalent chunk size
+        h.projected_total_chunk_size = \
+            h.comp_info["orig_chunk_size"] if h.comp_info is not None \
+            else h.total_chunk_size
         return h

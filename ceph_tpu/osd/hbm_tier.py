@@ -86,13 +86,19 @@ class _Batch:
     gather (dozens of transport round trips on a tunneled device);
     per-batch arrays make it one take per batch."""
 
-    __slots__ = ("arr", "live", "codec", "obj_bytes")
+    __slots__ = ("arr", "live", "codec", "obj_bytes", "digests")
 
-    def __init__(self, arr, live: int, codec=None, obj_bytes: int = 0):
+    def __init__(self, arr, live: int, codec=None, obj_bytes: int = 0,
+                 digests=None):
         self.arr = arr
         self.live = live
         self.codec = codec
         self.obj_bytes = obj_bytes
+        # per-object per-shard crc32 (zlib poly) rows [B, k+m], computed
+        # ON DEVICE by the fused write transform and adopted beside the
+        # chunks: deep-scrub of a resident object verifies against these
+        # without hashing a single byte on the host
+        self.digests = digests
 
 
 class HbmChunkTier:
@@ -199,7 +205,8 @@ class HbmChunkTier:
             self._update_gauges_locked()
         return parity
 
-    def adopt_encode(self, name, data_rows, parity_rows, codec) -> None:
+    def adopt_encode(self, name, data_rows, parity_rows, codec,
+                     digests=None) -> None:
         """Adopt one object's ALREADY-STAGED encode from the dispatcher
         pipeline: data_rows [S, k, chunk] (the staged h2d input) and
         parity_rows [S, m, chunk] (the compute output) are device
@@ -210,7 +217,12 @@ class HbmChunkTier:
         itself the one h2d.
 
         Stored layout matches put_encode: [k+m, S*chunk] — shard i's
-        whole chunk stream is row i."""
+        whole chunk stream is row i.
+
+        digests, when given, is the fused transform's device-computed
+        per-shard crc32 list (k+m entries, zlib poly over each shard's
+        stored stream) — retained beside the rows for scrub-from-digest
+        (shard_digests)."""
         import jax.numpy as jnp
         if self.device is not None and not (
                 type(data_rows).__module__.startswith("jax")):
@@ -227,7 +239,9 @@ class HbmChunkTier:
         full = jnp.transpose(full, (1, 0, 2)).reshape(
             full.shape[1], -1)
         obj_bytes = int(full.shape[0]) * int(full.shape[1])
-        batch = _Batch(full[None], 1, codec, obj_bytes)
+        dig = None if digests is None else np.asarray(
+            digests, dtype=np.uint32)[None]
+        batch = _Batch(full[None], 1, codec, obj_bytes, dig)
         with self._lock:
             self._insert_locked(name, batch, 0)
             self._update_gauges_locked()
@@ -272,6 +286,20 @@ class HbmChunkTier:
         with self._lock:
             ent = self._objs.get(name)
             return None if ent is None else (ent[0].codec or self.codec)
+
+    def shard_digests(self, name):
+        """Device-computed per-shard crc32 row for a resident object
+        (uint32[k+m], zlib poly over each shard's stored stream), or
+        None when the entry was adopted without digests.  This is the
+        scrub-from-digest surface: a deep scrub that finds one here
+        verifies the object with ZERO host hashing."""
+        with self._lock:
+            ent = self._objs.get(name)
+            if ent is None or ent[0].digests is None:
+                return None
+            self._touch(name)
+            self.perf.inc("l_hbm_hits")
+            return np.asarray(ent[0].digests[ent[1]])
 
     def drop(self, name) -> None:
         with self._lock:
@@ -409,6 +437,9 @@ class HbmChunkTier:
                     "hit_rate": round(hits / (hits + misses), 3)
                     if hits + misses else 0.0,
                     "adopted": self.perf.get("l_hbm_adopted"),
+                    "digested": sum(
+                        1 for ent in self._objs.values()
+                        if ent[0].digests is not None),
                     "evictions": self.perf.get("l_hbm_evictions")}
 
     def occupancy(self) -> float:

@@ -176,6 +176,25 @@ class OSDDaemon(Dispatcher):
             except Exception:
                 self.hbm_tier = None
         self.hbm_serve_reads = conf.get_val("osd_hbm_tier_serve_reads")
+        # fused write transform (osd/fused_transform.py, direction F):
+        # ec_backend reads these via getattr, so a missing option
+        # degrades to the classic path rather than failing startup
+        try:
+            if not conf.get_val("osd_fused_transform"):
+                self.fused_mode = "off"
+            elif conf.get_val("osd_fused_compression_mode") in (
+                    "", "none", None):
+                self.fused_mode = "store"
+            else:
+                self.fused_mode = "compress"
+            self.fused_required_ratio = float(
+                conf.get_val("osd_fused_required_ratio"))
+            self.fused_entropy_max = float(
+                conf.get_val("osd_fused_probe_entropy_max"))
+        except Exception:
+            self.fused_mode = "off"
+            self.fused_required_ratio = 0.875
+            self.fused_entropy_max = 7.0
         if self.ctx.admin_socket is not None:
             # residency + pipeline introspection (`ceph daemon osd.N
             # hbm status` / `dispatch status`)

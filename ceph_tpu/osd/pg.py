@@ -55,6 +55,15 @@ SNAPSET_ATTR = "_ss"
 WHITEOUT_ATTR = "_whiteout"
 
 
+def host_crc32(data) -> int:
+    """Host-side shard hashing for scrub inventories — the fallback
+    when an object is not HBM-resident with device digests.  Module-
+    level (not inlined) so tests can assert the fused scrub-from-digest
+    path never hashes a byte on the host."""
+    import zlib
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
 def clone_name(oid, cloneid: int) -> str:
     """Clone objects live beside the head as '<oid>@<cloneid>'
     (the ghobject snap id at framework scale)."""
@@ -1691,21 +1700,61 @@ class PG:
     # -- scrub (PG_STATE_SCRUBBING; PrimaryLogPG scrub + repair) --------
 
     def _scrub_inventory(self, shard: int) -> dict:
-        """oid -> (version, crc32(data), size) for one shard."""
-        import zlib
+        """oid -> (version, crc32(data), size) for one shard.
+
+        HBM-resident objects carrying fused-write device digests are
+        verified with ZERO host hashing: the on-disk bytes are still
+        read (silent disk bitrot must stay catchable — the write-time
+        digest only says what the bytes SHOULD be), but their crc is
+        computed on device (fused_transform.device_crc32) and the
+        resident digest is the expected side, so the host never walks
+        a crc loop for them.  Only non-resident objects fall back to
+        host_crc32()."""
         cid = self.cid_of_shard(shard)
+        tier = getattr(self.daemon, "hbm_tier", None)
         inv = {}
         for oid in self.store.list_objects(cid):
             if oid == META_OID:
                 continue   # per-OSD durable log, not replicated data
             try:
+                dig = None if tier is None or shard < 0 else \
+                    self._digest_from_tier(tier, shard, oid)
                 data = self.store.read(cid, oid)
                 raw = self.store.getattr(cid, oid, VERSION_ATTR)
+                if dig is not None:
+                    from . import fused_transform
+                    disk_crc = fused_transform.device_crc32(
+                        data, device=getattr(self.daemon,
+                                             "home_device", None))
+                    inv[oid] = (int(raw) if raw else 0, disk_crc,
+                                len(data))
+                    continue
                 inv[oid] = (int(raw) if raw else 0,
-                            zlib.crc32(data), len(data))
+                            host_crc32(data), len(data))
             except (KeyError, OSError):
                 inv[oid] = (-1, 0, 0)   # unreadable shard: scrub error
         return inv
+
+    def _digest_from_tier(self, tier, shard: int, oid) -> int | None:
+        """Device-computed crc for one resident shard, or None (not
+        resident / adopted without digests / unknown shard row)."""
+        try:
+            key = (str(self.pgid), oid)
+            row = tier.shard_digests(key)
+            if row is None:
+                return None
+            codec = tier.codec_of(key)
+            phys = shard
+            if codec is not None:
+                for i in range(codec.get_chunk_count()):
+                    if codec.chunk_index(i) == shard:
+                        phys = i
+                        break
+            if phys >= len(row):
+                return None
+            return int(row[phys])
+        except Exception:
+            return None
 
     def scrub(self, seq: int | None = None, deep: bool = False,
               repair: bool = False) -> dict | None:

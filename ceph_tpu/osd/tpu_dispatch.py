@@ -147,6 +147,12 @@ class _JaxDevOps:
         return jax.block_until_ready(fn(dev))
 
     def d2h(self, out):
+        if isinstance(out, dict):
+            # fused-transform output dict: ONE device_get drains parity
+            # + digests + compressed payload together (the fused path's
+            # single d2h)
+            import jax
+            return jax.device_get(out)
         return np.asarray(out)
 
 
@@ -161,6 +167,8 @@ class _HostDevOps:
         return fn(x)
 
     def d2h(self, out):
+        if isinstance(out, dict):
+            return {k: np.asarray(v) for k, v in out.items()}
         return np.asarray(out)
 
 
@@ -303,7 +311,24 @@ class TpuDispatcher:
                                       "bytes through device decode")
                      .add_u64_counter("l_tpu_donated",
                                       "dispatches whose staged input "
-                                      "was donated to the program"))
+                                      "was donated to the program")
+                     .add_u64_counter("l_tpu_fused_dispatches",
+                                      "fused write-transform programs "
+                                      "dispatched")
+                     .add_u64_counter("l_tpu_fused_bytes_in",
+                                      "raw bytes into the fused write "
+                                      "transform")
+                     .add_u64_counter("l_tpu_fused_bytes_out",
+                                      "stored+parity bytes out of the "
+                                      "fused transform")
+                     .add_u64_counter("l_tpu_fused_compressed",
+                                      "fused writes stored compressed")
+                     .add_u64_counter("l_tpu_fused_probe_rejects",
+                                      "fused writes whose entropy probe "
+                                      "rejected compression")
+                     .add_u64_avg("l_tpu_fused_ratio_milli",
+                                  "stored/raw size ratio per fused "
+                                  "write (x1000)"))
         # stall-attribution counters: cumulative per-stage wall time in
         # each state, synced from the _StageProf machines on telemetry
         # ticks so they ride MMgrReport -> mgr -> prometheus
@@ -319,6 +344,11 @@ class TpuDispatcher:
             else _HostDevOps()
         self._donate_fns: dict = {}   # key -> jitted donating fn | False
         self._donate_ok = self._probe_donation()
+        # fused write-transform ledger (dispatch_status "fused" section)
+        self._fused_seq = 0
+        self.fused_stats = {"dispatches": 0, "bytes_in": 0,
+                            "bytes_out": 0, "compressed": 0,
+                            "probe_rejects": 0, "ratio_milli_sum": 0}
         # stall attribution: one state machine per pipeline stage plus
         # the profile window anchor (profile_reset() restarts both)
         self._stage_prof = {s: _StageProf() for s in _STAGES}
@@ -493,6 +523,64 @@ class TpuDispatcher:
         return self.decode_async(codec, avail_rows, chunks,
                                  trace).result()
 
+    def fused_supported(self, codec) -> bool:
+        """Whether whole-object writes through this codec can ride the
+        fused write transform (jax backend + matrix codec)."""
+        from . import fused_transform
+        return self._jax and fused_transform.fused_supported(codec)
+
+    def fused_write_async(self, codec, batch: np.ndarray,
+                          mode: str = "store",
+                          required_ratio: float = 0.875,
+                          entropy_max_bits: float = 7.0,
+                          trace=NULL_SPAN, resident=None) -> _Pending:
+        """Async fused write transform over one whole-object batch:
+        digests + compressibility decision + EC encode in ONE device
+        program (one h2d, one program, one d2h).
+
+        Fused dispatches never coalesce across submitters — the
+        compression decision and the per-shard crc chains are
+        per OBJECT — but consecutive fused writes still overlap
+        through the h2d/compute/d2h pipeline stages. The future's
+        result() is the fused host output dict (the caller builds a
+        FusedResult via fused_transform.result_from_host)."""
+        from . import fused_transform
+        batch = np.asarray(batch)
+        self._account_codec(codec, "enc", getattr(batch, "nbytes", 0))
+        donate = self._donate_ok and (mode == "compress"
+                                      or resident is None)
+
+        def fn(dev, _codec=codec, _mode=mode, _rr=required_ratio,
+               _em=entropy_max_bits, _donate=donate):
+            return fused_transform.run_fused(
+                _codec, dev, mode=_mode, required_ratio=_rr,
+                entropy_max_bits=_em, device=self.device,
+                data_dev=dev if not isinstance(dev, np.ndarray)
+                else None, donate=_donate)
+
+        with self.lock:
+            self._fused_seq += 1
+            seq = self._fused_seq
+        key = (self._codec_key(codec), "fused", mode, seq)
+        if resident is not None:
+            resident = (resident[0], resident[1], codec)
+        return self._submit_async(key, fn, batch, trace, kind="fused",
+                                  resident=resident)
+
+    def fused_write(self, codec, batch: np.ndarray, mode: str = "store",
+                    required_ratio: float = 0.875,
+                    entropy_max_bits: float = 7.0,
+                    trace=NULL_SPAN, resident=None):
+        """Blocking facade over fused_write_async -> FusedResult."""
+        from . import fused_transform
+        batch = np.asarray(batch)
+        S, k, chunk = batch.shape
+        host = self.fused_write_async(
+            codec, batch, mode=mode, required_ratio=required_ratio,
+            entropy_max_bits=entropy_max_bits, trace=trace,
+            resident=resident).result()
+        return fused_transform.result_from_host(host, S, k, chunk, mode)
+
     def telemetry(self) -> dict:
         """The device-utilization gauge bag the OSD ships in its mgr
         report: live queue depth, lifetime coalescing ratio, and
@@ -527,7 +615,19 @@ class TpuDispatcher:
                 "device": device_label(self.device),
                 "ops": ops, "dispatches": disp,
                 "coalesce_ratio": round(disp / ops, 3) if ops else 1.0,
+                "fused": self._fused_summary(),
                 "codecs": codecs}
+
+    def _fused_summary(self) -> dict:
+        """The fused-write ledger: dispatch count, bytes through the
+        fused program, compress decisions and the mean stored/raw
+        ratio. Rides telemetry() (mgr report) and `dispatch status`."""
+        with self.lock:
+            st = dict(self.fused_stats)
+        ratio_sum = st.pop("ratio_milli_sum")
+        n = st["dispatches"]
+        st["ratio_avg"] = round(ratio_sum / n / 1000.0, 4) if n else 1.0
+        return st
 
     def dispatch_status(self) -> dict:
         """The `dispatch status` asok payload: pipeline shape, ring
@@ -547,6 +647,7 @@ class TpuDispatcher:
                 "dispatches": tel["dispatches"],
                 "coalesce_ratio": tel["coalesce_ratio"],
                 "donated_dispatches": self.perf.get("l_tpu_donated"),
+                "fused": tel["fused"],
                 "segments_s": {
                     "h2d_avg": self.perf.avg("l_tpu_h2d"),
                     "compute_avg": self.perf.avg("l_tpu_compute"),
@@ -719,10 +820,16 @@ class TpuDispatcher:
                 # device syncs — the disabled path never pays them)
                 out, seg = device_segments(d.fn, stacked)
             else:
-                out = np.asarray(d.fn(stacked))
+                out = d.fn(stacked)
+                # fused programs return an output dict: drain it in one
+                # transfer instead of np-coercing it
+                out = self._devops.d2h(out) if isinstance(out, dict) \
+                    else np.asarray(out)
                 seg = None
             self._slice_results(d, out)
             self._adopt_residents(d, stacked, out)
+            if d.kind == "fused":
+                self._account_fused(d)
             if seg is not None:
                 t1 = t_start + seg["h2d"]
                 t2 = t1 + seg["compute"]
@@ -816,6 +923,8 @@ class TpuDispatcher:
                 self._slice_results(d, out)
                 self._adopt_residents(d, d.dev, d.out_dev)
                 self._account(d)
+                if d.kind == "fused":
+                    self._account_fused(d)
             except BaseException as e:
                 self._fail(d, e)
                 continue
@@ -894,6 +1003,37 @@ class TpuDispatcher:
         device-side in pipelined mode, so residency costs ZERO extra
         transfers. Adoption failures never fail the submitter (the tier
         is a cache)."""
+        if d.kind == "fused":
+            # one submitter per fused dispatch (the key is unique):
+            # adopt what was actually STORED — the compressed rows when
+            # the device chose to compress, the staged raw rows when it
+            # chose store — and keep the device-computed shard crcs
+            # beside them for scrub-from-digest
+            p = d.pend[0]
+            if p.resident is None or not isinstance(p.out, dict):
+                return
+            tier, key, codec = p.resident
+            host = p.out
+            out = parity_src if isinstance(parity_src, dict) else host
+            try:
+                if "do_compress" in host:
+                    # compress-mode runs adopt from the program's
+                    # stored buffer (== raw when the device chose
+                    # store): the staged input may have been DONATED
+                    # to the fused program and must not be read
+                    used = int(host["used_stripes"])
+                    rows, par = out["stored"][:used], \
+                        out["parity"][:used]
+                else:
+                    rows = data_src
+                    par = out["parity"][:data_src.shape[0]]
+                tier.adopt_encode(
+                    key, rows, par, codec,
+                    digests=np.asarray(host["shard_crcs"],
+                                       dtype=np.uint32))
+            except Exception:
+                pass
+            return
         off = 0
         for p in d.pend:
             s = p.batch.shape[0]
@@ -936,3 +1076,40 @@ class TpuDispatcher:
             dev.child_interval("h2d", h0, h1)
             dev.child_interval("compute", c0, c1)
             dev.child_interval("d2h", d0, d1)
+
+    def _account_fused(self, d: _Dispatch) -> None:
+        """Fold one fused write's outcome into the l_tpu_fused_*
+        counters and the fused_stats bag (the `dispatch status` fused
+        section + the ceph_tpu_fused_* Prometheus series)."""
+        p = d.pend[0]
+        host = p.out
+        if not isinstance(host, dict):
+            return
+        raw = int(getattr(p.batch, "nbytes", 0))
+        compressed = bool(host.get("do_compress", False))
+        stored = int(host["comp_len"]) if compressed else raw
+        par = host.get("parity")
+        m_chunk = int(par.shape[1]) * int(par.shape[2]) \
+            if par is not None and getattr(par, "ndim", 0) == 3 else 0
+        stripes = int(host["used_stripes"]) if "used_stripes" in host \
+            else (raw // (p.batch.shape[1] * p.batch.shape[2])
+                  if raw else 0)
+        out_bytes = stored + stripes * m_chunk
+        probe_reject = "probe_ok" in host and not bool(host["probe_ok"])
+        ratio_milli = (stored * 1000) // raw if raw else 1000
+        self.perf.inc("l_tpu_fused_dispatches")
+        self.perf.inc("l_tpu_fused_bytes_in", raw)
+        self.perf.inc("l_tpu_fused_bytes_out", out_bytes)
+        if compressed:
+            self.perf.inc("l_tpu_fused_compressed")
+        if probe_reject:
+            self.perf.inc("l_tpu_fused_probe_rejects")
+        self.perf.tinc("l_tpu_fused_ratio_milli", ratio_milli)
+        with self.lock:
+            st = self.fused_stats
+            st["dispatches"] += 1
+            st["bytes_in"] += raw
+            st["bytes_out"] += out_bytes
+            st["compressed"] += int(compressed)
+            st["probe_rejects"] += int(probe_reject)
+            st["ratio_milli_sum"] += ratio_milli

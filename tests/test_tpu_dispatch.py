@@ -161,7 +161,12 @@ class TestOsdIntegration:
                 "mon_osd_down_out_interval": 1.0,
                 "paxos_propose_interval": 0.02,
                 "osd_tpu_coalesce_max_delay_ms": 15.0,
-                "osd_tpu_coalesce_max_batch": 8}
+                "osd_tpu_coalesce_max_batch": 8,
+                # this row prices the classic coalescing queue; the
+                # fused write transform never coalesces (per-object
+                # compress decision + crc chains) and is priced in
+                # test_fused_transform
+                "osd_fused_transform": False}
         cluster = MiniCluster(num_mons=1, num_osds=3,
                               conf_overrides=FAST).start()
         try:
