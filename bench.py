@@ -520,7 +520,8 @@ def _bench_cluster() -> dict:
     c = MiniCluster(num_mons=1, num_osds=4,
                     conf_overrides={"osd_tracing": False,
                                     "osd_profiler": False,
-                                    "mgr_stats_period": 0.0})
+                                    "mgr_stats_period": 0.0,
+                                    "mgr_progress": False})
     c.start()
     try:
         client = c.client()
@@ -1283,11 +1284,229 @@ def run_multichip_scaling(n_devices: int = 8, rounds: int = 3,
     return doc
 
 
+def run_convergence(out_path: str | None = None) -> dict:
+    """Time-to-HEALTH_OK artifact (ROADMAP direction G, measurement
+    leg): a MiniCluster runs an osd-out/in cycle under light client
+    load and the run measures how long the cluster takes to reconverge
+    — fault injected, osd auto-marked out, recovery drains the
+    degraded objects, the osd revives and is marked back in, backfill
+    drains the misplaced objects, health returns to HEALTH_OK.
+
+    The observability stack under test narrates the whole cycle: the
+    mgr ProgressModule opens "Rebalancing after osd.N marked out/in"
+    events off osdmap diffs and folds aggregated PG stats into
+    monotone completion fractions; the mon EventMonitor journals the
+    osdmap/health/progress transitions.  Published fields:
+    time_to_health_ok_s (fault -> final HEALTH_OK), pgs_remapped,
+    bytes_backfilled (summed l_osd_{recovery,backfill}_bytes deltas),
+    recovery_MBps, and the per-event progress timeline.
+
+    HARD GATES (SystemExit): the cluster must reach HEALTH_OK, every
+    progress event's fraction history must be monotone nondecreasing
+    and reach 1.0, and no progress event may still be active at the
+    end — a bar that never completes after reconvergence is exactly
+    the stuck-progress bug class this module exists to surface."""
+    import threading
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_util import MiniCluster, wait_until
+
+    from ceph_tpu.mgr.progress import ProgressModule
+    from ceph_tpu.osd.osd_map import PGID
+
+    doc: dict = {"metric": "time_to_health_ok_s", "unit": "s"}
+    c = MiniCluster(num_mons=1, num_osds=4,
+                    conf_overrides={"osd_tracing": False,
+                                    "osd_profiler": False,
+                                    # fast fault detection + auto-out
+                                    # so the cycle fits a bench run
+                                    "osd_heartbeat_interval": 0.1,
+                                    "osd_heartbeat_grace": 0.6,
+                                    "mon_osd_down_out_interval": 1.0,
+                                    "paxos_propose_interval": 0.02,
+                                    # the progress module feeds off the
+                                    # aggregated MMgrReport stream
+                                    "mgr_stats_period": 0.25})
+    c.start()
+    stop_load = threading.Event()
+    try:
+        mgr = c.start_mgr(modules=(ProgressModule,))
+        progress = mgr.modules["progress"]
+        client = c.client()
+        pool_id = c.create_replicated_pool(client, "conv", size=3,
+                                           pg_num=8)
+        if not c.wait_clean(pool_id):
+            raise SystemExit("convergence: pool never went clean")
+        ioctx = client.open_ioctx("conv")
+        obj_bytes = 1 << 16              # 64 KiB objects
+        n_objs = 24
+        payload = np.random.default_rng(7).integers(
+            0, 256, size=obj_bytes, dtype=np.uint8).tobytes()
+        for i in range(n_objs):
+            ioctx.write_full("conv-%d" % i, payload)
+
+        # light foreground load for the whole cycle (the reference
+        # convergence runs measure recovery UNDER io, not quiesced)
+        def writer():
+            i = 0
+            while not stop_load.is_set():
+                try:
+                    ioctx.write_full("conv-%d" % (i % n_objs), payload)
+                except Exception:
+                    pass
+                i += 1
+                stop_load.wait(0.05)
+        load = threading.Thread(target=writer, name="conv-load",
+                                daemon=True)
+        load.start()
+
+        def pg_up_sets():
+            m = c.leader().osdmon.osdmap
+            pool = m.pools[pool_id]
+            return {ps: tuple(m.pg_to_up_acting_osds(
+                PGID(pool_id, ps))[0]) for ps in range(pool.pg_num)}
+
+        def perf_totals():
+            tot = {}
+            for osd_id, osd in c.osds.items():
+                tot[osd_id] = sum(
+                    osd.perf.get(k) for k in
+                    ("l_osd_recovery_bytes", "l_osd_backfill_bytes"))
+            return tot
+
+        def health():
+            _, outs, _ = client.mon_command({"prefix": "health"})
+            return (outs or "").split("\n")[0]
+
+        up_before = pg_up_sets()
+        perf_before = perf_totals()
+
+        # -- fault: the thrasher's own kill action (journals itself
+        # into the event journal); the mon marks the victim down then
+        # auto-out
+        from tests.thrasher import Thrasher
+        th = Thrasher(c, seed=0xC0, min_in=2)
+        t_fault = time.monotonic()
+        victim = th.kill_one()
+        if victim is None:
+            raise SystemExit("convergence: thrasher found no victim")
+        if not wait_until(lambda: not c.leader().osdmon.osdmap
+                          .is_in(victim), timeout=30):
+            raise SystemExit("convergence: osd.%d never marked out"
+                             % victim)
+        doc["time_to_marked_out_s"] = round(
+            time.monotonic() - t_fault, 3)
+        up_after_out = pg_up_sets()
+        doc["pgs_remapped"] = sum(
+            1 for ps, up in up_after_out.items()
+            if up != up_before[ps])
+
+        def recovered():
+            return all(len(pg.missing) == 0 and not pg.peer_missing
+                       and not pg.backfilling
+                       for osd in c.osds.values()
+                       for pg in osd.pgs.values())
+        if not wait_until(recovered, timeout=60):
+            raise SystemExit("convergence: degraded objects never "
+                             "drained after osd-out")
+        doc["time_to_recovered_s"] = round(
+            time.monotonic() - t_fault, 3)
+
+        # -- heal: thrasher revive (re-marks in); backfill moves PGs
+        # home
+        th.revive_one()
+        if not wait_until(lambda: (c.leader().osdmon.osdmap
+                                   .is_in(victim)
+                                   and c.all_osds_up()), timeout=30):
+            raise SystemExit("convergence: osd.%d never came back "
+                             "up+in" % victim)
+        if not wait_until(
+                lambda: recovered() and c.wait_clean(pool_id, 0.5)
+                and health() == "HEALTH_OK", timeout=90):
+            raise SystemExit("convergence: cluster never reached "
+                             "HEALTH_OK (health=%r)" % health())
+        doc["time_to_health_ok_s"] = round(
+            time.monotonic() - t_fault, 3)
+        stop_load.set()
+        load.join(timeout=5)
+
+        # recovery volume: counter deltas survive the revive because
+        # the revived daemon restarts at zero and its baseline was
+        # taken pre-fault (missing entries count from zero)
+        perf_after = perf_totals()
+        doc["bytes_backfilled"] = sum(
+            v - perf_before.get(k, 0) if k in perf_before and
+            v >= perf_before[k] else v
+            for k, v in perf_after.items())
+        doc["recovery_MBps"] = round(
+            doc["bytes_backfilled"] / 1e6
+            / max(doc["time_to_health_ok_s"], 1e-9), 3)
+
+        # progress events must ALL have retired by HEALTH_OK — give
+        # the mgr a couple of report periods to observe the drain
+        if not wait_until(lambda: not progress.active_events(),
+                          timeout=30):
+            raise SystemExit(
+                "convergence gate: progress events still active after "
+                "HEALTH_OK: %s" % progress.active_events())
+        timeline = []
+        for ev in progress.completed_events():
+            hist = [f for _, f in ev["history"]]
+            if any(b < a for a, b in zip(hist, hist[1:])):
+                raise SystemExit(
+                    "convergence gate: event %s fraction regressed: %s"
+                    % (ev["id"], hist))
+            if not hist or hist[-1] < 1.0:
+                raise SystemExit(
+                    "convergence gate: event %s never reached 1.0: %s"
+                    % (ev["id"], hist[-5:]))
+            t0 = ev["history"][0][0]
+            timeline.append({
+                "id": ev["id"], "message": ev["message"],
+                "duration_s": ev.get("duration"),
+                "fractions": [[round(t - t0, 3), round(f, 4)]
+                              for t, f in ev["history"]]})
+        if not timeline:
+            raise SystemExit("convergence gate: the osd-out/in cycle "
+                             "opened no progress events")
+        doc["progress_events"] = timeline
+
+        # the journal's narration of the same cycle, for the artifact
+        # reader: what the thrash DID and how the cluster REACTED
+        _, _, tail = client.mon_command(
+            {"prefix": "events last", "num": 200})
+        doc["event_journal"] = [
+            {"seq": e.get("seq"), "type": e.get("type"),
+             "source": e.get("source"), "message": e.get("message")}
+            for e in (tail or [])
+            if e.get("type") in ("osdmap", "health", "progress",
+                                 "thrash")]
+        doc["value"] = doc["time_to_health_ok_s"]
+    finally:
+        stop_load.set()
+        c.stop()
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "CONVERGENCE_r01.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({k: v for k, v in doc.items()
+                      if k not in ("progress_events",
+                                   "event_journal")}))
+    return doc
+
+
 def main() -> None:
     import jax
 
     if "--cpu" in sys.argv:
         jax.config.update("jax_platforms", "cpu")
+    if "--convergence" in sys.argv:
+        run_convergence()
+        return
     run_bench()
 
 
@@ -1876,6 +2095,9 @@ if __name__ == "__main__":
         _crush_sealed_worker()
     elif "--resident-worker" in sys.argv:
         _resident_worker()
+    elif "--convergence" in sys.argv:
+        # cluster-convergence artifact: no device rows, no supervisor
+        run_convergence()
     elif "--worker" in sys.argv:
         main()
     else:

@@ -208,6 +208,15 @@ def _declare_defaults():
     o("mgr_metrics_window", float, 5.0, LEVEL_ADVANCED,
       "default lookback window (seconds) for derived rates — "
       "`ceph iostat`, per-daemon op rates, device MB/s gauges")
+    o("mgr_progress", bool, True, LEVEL_BASIC,
+      "mgr progress module: narrate recovery/backfill convergence as "
+      "progress events ('Rebalancing after osd.N marked out') with a "
+      "monotone completion fraction and ETA; False pins the module "
+      "off (the bench cluster row pins this beside osd_tracing for "
+      "methodology constancy)")
+    o("mgr_progress_max_completed", int, 32, LEVEL_ADVANCED,
+      "completed progress events retained in the bounded ring "
+      "(progress module mgr_progress history window)")
     # mon
     o("mon_osd_down_out_interval", float, 2.0, LEVEL_ADVANCED,
       "seconds after down before an osd is marked out")
@@ -246,6 +255,11 @@ def _declare_defaults():
     o("mon_log_max", int, 500, LEVEL_ADVANCED,
       "cluster log entries the LogMonitor keeps ('ceph log last' "
       "window; mon_cluster_log_* role)")
+    o("mon_events_max", int, 500, LEVEL_ADVANCED,
+      "structured cluster events the EventMonitor keeps ('ceph "
+      "events last' / 'ceph events watch' window: health "
+      "transitions, osdmap changes, progress open/close, thrash "
+      "actions)")
     # bluestore / bluefs
     o("store_fsck_on_umount", bool, True, LEVEL_ADVANCED,
       "BlockStore.umount() cross-checks BlueFS extents, blob extents "

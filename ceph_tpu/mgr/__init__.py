@@ -6,8 +6,9 @@ reports to the mgr, which aggregates them as DaemonState and exposes
 cluster state to pluggable Python modules (prometheus exporter,
 status/dashboard, restful). Here modules subclass MgrModule
 (mirroring src/pybind/mgr/mgr_module.py:33) and the bundled modules
-are `prometheus` (text exposition format), `status`, and `balancer`
-(upmap mode, riding the batched device CRUSH sweep).
+are `prometheus` (text exposition format), `status`, `balancer`
+(upmap mode, riding the batched device CRUSH sweep), and `progress`
+(recovery-convergence narration).
 """
 
 from .daemon_state import DaemonStateIndex  # noqa: F401
@@ -16,3 +17,4 @@ from .mgr_daemon import MgrDaemon  # noqa: F401
 from .mgr_module import MgrModule  # noqa: F401
 from .modules import (BalancerModule, PrometheusModule,  # noqa: F401
                       StatusModule)
+from .progress import ProgressModule  # noqa: F401

@@ -293,6 +293,53 @@ class MetricsAggregator:
                 "read_MBps": round(rd_b / 1e6, 3),
                 "write_MBps": round(wr_b / 1e6, 3)}
 
+    def pg_summary(self, now: float | None = None) -> dict:
+        """Recovery-convergence view of the reported PG stats rows:
+        cluster degraded/misplaced object totals plus the per-PG rows
+        (newest report wins per PG, same fold as df()).  Feeds the
+        mgr progress module's completion fractions and the
+        ceph_pg_degraded/misplaced Prometheus series."""
+        now = time.monotonic() if now is None else now
+        rows: dict[str, tuple] = {}
+        with self._lock:
+            for s in self._series.values():
+                if now - s.last_ts > self.stale_after:
+                    continue
+                for pg, row in s.pg_stats.items():
+                    prev = rows.get(pg)
+                    if prev is None or s.last_ts > prev[0]:
+                        rows[pg] = (s.last_ts, row)
+        degraded = misplaced = 0
+        pgs: dict[str, dict] = {}
+        for pg, (_, row) in rows.items():
+            d = int(row.get("degraded_objects", 0) or 0)
+            m = int(row.get("misplaced_objects", 0) or 0)
+            degraded += d
+            misplaced += m
+            pgs[pg] = {"state": row.get("state", "?"),
+                       "degraded_objects": d,
+                       "misplaced_objects": m}
+        return {"degraded_objects": degraded,
+                "misplaced_objects": misplaced,
+                "pgs": pgs}
+
+    def recovery_io(self, window: float | None = None,
+                    now: float | None = None) -> dict:
+        """Cluster recovery/backfill rates over the lookback (the
+        recovery-io line under `ceph -s` client io): push ops/s and
+        MB/s summed over every fresh OSD, both lanes."""
+        now = time.monotonic() if now is None else now
+        ops = (self.cluster_rate("osd", "l_osd_recovery_ops",
+                                 window, now)
+               + self.cluster_rate("osd", "l_osd_backfill_ops",
+                                   window, now))
+        byts = (self.cluster_rate("osd", "l_osd_recovery_bytes",
+                                  window, now)
+                + self.cluster_rate("osd", "l_osd_backfill_bytes",
+                                    window, now))
+        return {"recovery_op_per_sec": round(ops, 2),
+                "recovery_MBps": round(byts / 1e6, 3)}
+
     def osd_perf(self, window: float | None = None,
                  now: float | None = None) -> dict:
         """Per-OSD latency table (the `ceph osd perf` surface):

@@ -23,6 +23,13 @@ def _metric_name(*parts: str) -> str:
     return _NAME_RE.sub("_", "_".join(p for p in parts if p)).lower()
 
 
+def _escape_label(value) -> str:
+    """Exposition-format label value escaping (backslash, quote,
+    newline — the three characters the text format reserves)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 class PrometheusModule(MgrModule):
     """Text exposition format renderer (+ optional stdlib HTTP server)."""
 
@@ -38,18 +45,28 @@ class PrometheusModule(MgrModule):
     # -- rendering -----------------------------------------------------
 
     def render(self) -> str:
-        out: list[str] = []
+        # grouped exposition: samples accumulate per metric name so
+        # every name is emitted ONCE with its HELP/TYPE followed by a
+        # contiguous sample block — the format the prometheus parser
+        # (and tests/test_progress.py's exposition lint) demands; the
+        # old per-emit interleaving scattered same-name series across
+        # the per-daemon loop
+        groups: dict[str, dict] = {}
 
         def emit(name: str, value, labels: dict | None = None,
                  mtype: str = "gauge", help_: str = ""):
-            if help_:
-                out.append("# HELP %s %s" % (name, help_))
-                out.append("# TYPE %s %s" % (name, mtype))
+            g = groups.get(name)
+            if g is None:
+                g = groups[name] = {"type": mtype, "help": help_,
+                                    "samples": []}
+            elif help_ and not g["help"]:
+                g["help"] = help_
             lbl = ""
             if labels:
                 lbl = "{%s}" % ",".join(
-                    '%s="%s"' % (k, v) for k, v in sorted(labels.items()))
-            out.append("%s%s %s" % (name, lbl, float(value)))
+                    '%s="%s"' % (k, _escape_label(v))
+                    for k, v in sorted(labels.items()))
+            g["samples"].append("%s%s %s" % (name, lbl, float(value)))
 
         osdmap = self.get("osd_map")
         if osdmap is not None:
@@ -222,6 +239,35 @@ class PrometheusModule(MgrModule):
                 if vals:
                     emit("ceph_balancer_sweep_seconds", vals[-1],
                          {"backend": key[len("balancer_sweep_"):]})
+            # recovery-convergence series: cluster push-byte rate +
+            # per-PG degraded/misplaced counts from the reported stats
+            recov = metrics.recovery_io()
+            emit("ceph_recovery_bytes_rate",
+                 recov["recovery_MBps"] * 1e6,
+                 help_="recovery+backfill push bytes per second")
+            pgsum = metrics.pg_summary()
+            for pg, row in sorted(pgsum["pgs"].items()):
+                plbl = {"pgid": pg}
+                emit("ceph_pg_degraded_objects",
+                     row["degraded_objects"], plbl,
+                     help_="object copies a current acting member "
+                           "is known to lack")
+                emit("ceph_pg_misplaced_objects",
+                     row["misplaced_objects"], plbl,
+                     help_="object copies still backfilling onto a "
+                           "new acting member")
+        # active progress events (mgr progress module): completed
+        # events are deliberately absent, so their series leave the
+        # exposition the moment convergence finishes (same ageout
+        # discipline as stale daemons)
+        progress = self.mgr.modules.get("progress")
+        if progress is not None and \
+                hasattr(progress, "active_events"):
+            for ev in progress.active_events():
+                emit("ceph_progress_event_fraction", ev["fraction"],
+                     {"event_id": ev["id"]},
+                     help_="completion fraction of an active "
+                           "progress event")
         # per-daemon perf counters (reference: perf_counters as
         # ceph_<daemon-type>_<counter>{ceph_daemon=...}); this includes
         # the l_bluefs_* and l_tpu_* groups the OSDs register.
@@ -265,6 +311,12 @@ class PrometheusModule(MgrModule):
                     elif isinstance(val, (int, float)):
                         emit(_metric_name("ceph", dtype, group, cname),
                              val, {"ceph_daemon": daemon})
+        out: list[str] = []
+        for name, g in groups.items():
+            out.append("# HELP %s %s"
+                       % (name, g["help"] or name.replace("_", " ")))
+            out.append("# TYPE %s %s" % (name, g["type"]))
+            out.extend(g["samples"])
         return "\n".join(out) + "\n"
 
     def handle_command(self, cmd):
@@ -356,7 +408,7 @@ class StatusModule(MgrModule):
         if prefix == "status":
             ups = sum(1 for o in range(osdmap.max_osd) if osdmap.is_up(o))
             state = self._health_status()
-            return 0, (
+            out = (
                 "  health: %s\n  osdmap e%d: %d osds: %d up, %d in\n"
                 "  pools: %d"
                 % (state, osdmap.epoch, sum(
@@ -364,7 +416,27 @@ class StatusModule(MgrModule):
                    ups,
                    sum(1 for o in range(osdmap.max_osd)
                        if osdmap.is_in(o)),
-                   len(osdmap.pools))), ""
+                   len(osdmap.pools)))
+            # client vs recovery io (the `ceph -s` io: block)
+            metrics = self.get("metrics")
+            if metrics is not None:
+                io = metrics.iostat()
+                recov = metrics.recovery_io()
+                out += (
+                    "\n  io:\n    client: %.1f MB/s rd, %.1f MB/s wr"
+                    "\n    recovery: %.1f MB/s, %.0f op/s"
+                    % (io["read_MBps"], io["write_MBps"],
+                       recov["recovery_MBps"],
+                       recov["recovery_op_per_sec"]))
+            # active progress bars (mgr progress module narration)
+            progress = self.mgr.modules.get("progress")
+            if progress is not None and \
+                    hasattr(progress, "render_bars"):
+                bars = progress.render_bars()
+                if bars:
+                    out += "\n  progress:\n    " + \
+                        "\n    ".join(bars)
+            return 0, out, ""
         return super().handle_command(cmd)
 
 

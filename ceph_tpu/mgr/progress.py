@@ -1,0 +1,308 @@
+"""ProgressModule: the mgr progress module (pybind/mgr/progress).
+
+Narrates recovery/backfill convergence: watches osdmap epochs for
+topology changes (an OSD marked out/in, a pool created/resized) and
+opens a progress event per change ("Rebalancing after osd.2 marked
+out"); each aggregated PG-stats round folds the cluster's
+degraded+misplaced object count into a MONOTONE completion fraction
+(1 - bad/peak_bad, never decreasing) with a rate-based ETA; completed
+events retire into a bounded ring.  The module raises and clears
+NOTHING — health stays the HealthMonitor's job; this one narrates.
+
+Open/update/close transitions are journaled into the mon's
+EventMonitor ("events append") from a dedicated worker thread — a mon
+command awaits its reply on the same connection the notify() that
+triggered it arrived on, so posting inline would deadlock the mgr's
+dispatch loop.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import OrderedDict, deque
+
+from .mgr_module import MgrModule
+
+__all__ = ["ProgressModule"]
+
+#: fraction history samples kept per event (the convergence artifact's
+#: per-event timeline; oldest drop)
+HISTORY_MAX = 512
+#: consecutive zero-bad observations before an event completes (one
+#: report of 0 mid-storm must not close the event early)
+ZERO_STREAK = 2
+#: an event whose change never produced any degraded/misplaced objects
+#: (empty pool resized) completes after this many idle seconds
+IDLE_GRACE = 2.0
+#: ETA lookback: the rate is fraction-progress over at least this span
+ETA_SPAN = 0.5
+
+
+class ProgressModule(MgrModule):
+    COMMANDS = [
+        {"cmd": "progress",
+         "desc": "active + recently completed progress events"},
+    ]
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self.name = "progress"
+        conf = mgr.ctx.conf
+        try:
+            self.enabled = bool(conf.get_val("mgr_progress"))
+        except Exception:
+            self.enabled = True
+        try:
+            maxc = int(conf.get_val("mgr_progress_max_completed"))
+        except Exception:
+            maxc = 32
+        self._lock = threading.RLock()
+        self._events: OrderedDict[str, dict] = OrderedDict()
+        self.completed: deque = deque(maxlen=max(1, maxc))
+        self._next_id = 1
+        self._map_snap: dict | None = None
+        self._journal_q: queue.Queue = queue.Queue()
+        self._journal_thread: threading.Thread | None = None
+        self._shutdown = False
+
+    # -- event lifecycle -----------------------------------------------
+
+    def _open_event(self, message: str, now: float | None = None) -> dict:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            ev_id = "ev-%d" % self._next_id
+            self._next_id += 1
+            ev = {"id": ev_id, "message": message,
+                  "stamp": time.time(), "started": now,
+                  "fraction": 0.0, "eta": None,
+                  "baseline": 0, "seen_bad": False,
+                  "zero_streak": 0, "quarters_logged": 0,
+                  "history": [(now, 0.0)]}
+            self._events[ev_id] = ev
+        self._journal("progress", "progress open [%s] %s"
+                      % (ev_id, message),
+                      {"event_id": ev_id, "phase": "open"})
+        return ev
+
+    def update(self, now: float | None = None) -> None:
+        """Fold the latest aggregated PG stats into every active
+        event's fraction/ETA; retire converged events."""
+        now = time.monotonic() if now is None else now
+        try:
+            summary = self.get("metrics").pg_summary()
+        except Exception:
+            return
+        bad = (summary["degraded_objects"]
+               + summary["misplaced_objects"])
+        peering = any(row.get("state") == "peering"
+                      for row in summary["pgs"].values())
+        closed, journal = [], []
+        with self._lock:
+            for ev in list(self._events.values()):
+                self._update_one(ev, bad, peering, now, journal)
+                if ev["fraction"] >= 1.0:
+                    ev["finished"] = time.time()
+                    ev["duration"] = round(now - ev["started"], 3)
+                    self._events.pop(ev["id"])
+                    self.completed.append(ev)
+                    closed.append(ev)
+        for evtype, msg, data in journal:
+            self._journal(evtype, msg, data)
+        for ev in closed:
+            self._journal("progress", "progress close [%s] %s (%.1fs)"
+                          % (ev["id"], ev["message"], ev["duration"]),
+                          {"event_id": ev["id"], "phase": "close",
+                           "duration": ev["duration"]})
+
+    def _update_one(self, ev: dict, bad: int, peering: bool,
+                    now: float, journal: list) -> None:
+        """One event's monotone fraction + ETA from the cluster
+        degraded+misplaced count. Caller holds the lock."""
+        if bad > ev["baseline"]:
+            ev["baseline"] = bad
+        if bad > 0:
+            ev["seen_bad"] = True
+            ev["zero_streak"] = 0
+        else:
+            ev["zero_streak"] += 1
+        frac = ev["fraction"]
+        if ev["baseline"] > 0:
+            # monotone: a later re-peer that re-raises bad never walks
+            # the bar backwards (it raises baseline instead)
+            frac = max(frac, 1.0 - bad / ev["baseline"])
+        done = False
+        if bad == 0 and not peering:
+            if ev["seen_bad"]:
+                done = ev["zero_streak"] >= ZERO_STREAK
+            else:
+                # the change moved nothing (empty pool, no remap):
+                # complete after the idle grace
+                done = (ev["zero_streak"] >= ZERO_STREAK
+                        and now - ev["started"] >= IDLE_GRACE)
+        if done:
+            frac = 1.0
+        elif frac >= 1.0:
+            # bad hit 0 but the streak/peering gate holds: stay just
+            # under until convergence is confirmed
+            frac = max(ev["fraction"], 0.99)
+        ev["fraction"] = frac
+        ev["history"].append((now, frac))
+        del ev["history"][:-HISTORY_MAX]
+        ev["eta"] = self._eta(ev, now) if not done else 0.0
+        quarter = int(frac * 4)
+        if 0 < quarter < 4 and quarter > ev["quarters_logged"]:
+            ev["quarters_logged"] = quarter
+            journal.append((
+                "progress", "progress update [%s] %d%% %s"
+                % (ev["id"], int(frac * 100), ev["message"]),
+                {"event_id": ev["id"], "phase": "update",
+                 "fraction": round(frac, 4)}))
+
+    @staticmethod
+    def _eta(ev: dict, now: float) -> float | None:
+        """Seconds to completion from the recent fraction slope; None
+        while there is no measurable forward progress."""
+        frac = ev["fraction"]
+        anchor = None
+        # newest sample at least ETA_SPAN old: recent slope, not the
+        # whole-event average
+        for t0, f0 in ev["history"]:
+            if now - t0 >= ETA_SPAN:
+                anchor = (t0, f0)
+            else:
+                break
+        if anchor is None:
+            return None
+        t0, f0 = anchor
+        rate = (frac - f0) / (now - t0)
+        if rate <= 1e-9:
+            return None
+        return round((1.0 - frac) / rate, 3)
+
+    # -- osdmap diffing -------------------------------------------------
+
+    @staticmethod
+    def _snapshot(osdmap) -> dict:
+        in_osds, up_osds = set(), set()
+        for o in range(osdmap.max_osd):
+            if not osdmap.exists(o):
+                continue
+            if osdmap.is_in(o):
+                in_osds.add(o)
+            if osdmap.is_up(o):
+                up_osds.add(o)
+        pools = {pid: (getattr(p, "pg_num", 0), getattr(p, "size", 0),
+                       getattr(p, "name", str(pid)))
+                 for pid, p in osdmap.pools.items()}
+        return {"in": in_osds, "up": up_osds, "pools": pools}
+
+    def _on_osdmap(self, osdmap) -> None:
+        if osdmap is None:
+            return
+        snap = self._snapshot(osdmap)
+        prev, self._map_snap = self._map_snap, snap
+        if prev is None:
+            return   # first map: boot topology is not a change
+        for osd in sorted(prev["in"] - snap["in"]):
+            self._open_event("Rebalancing after osd.%d marked out"
+                             % osd)
+        for osd in sorted(snap["in"] - prev["in"]):
+            self._open_event("Rebalancing after osd.%d marked in"
+                             % osd)
+        for pid, cur in snap["pools"].items():
+            old = prev["pools"].get(pid)
+            if old is not None and (old[0], old[1]) != (cur[0], cur[1]):
+                self._open_event("Rebalancing after pool '%s' resized"
+                                 % cur[2])
+
+    # -- module hooks ---------------------------------------------------
+
+    def notify(self, notify_type: str, notify_id) -> None:
+        if not self.enabled:
+            return
+        if notify_type == "osd_map":
+            self._on_osdmap(self.get("osd_map"))
+            self.update()
+        elif notify_type == "perf_schema":
+            self.update()
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        if self._journal_thread is not None:
+            self._journal_q.put(None)
+
+    # -- operator surfaces ----------------------------------------------
+
+    def active_events(self) -> list[dict]:
+        """Snapshot of the active events (StatusModule bars, the
+        Prometheus ceph_progress_event_fraction series — completed
+        events deliberately absent so their series age out)."""
+        with self._lock:
+            return [{"id": ev["id"], "message": ev["message"],
+                     "fraction": ev["fraction"], "eta": ev["eta"]}
+                    for ev in self._events.values()]
+
+    def completed_events(self) -> list[dict]:
+        with self._lock:
+            return [dict(ev) for ev in self.completed]
+
+    def render_bars(self, width: int = 10) -> list[str]:
+        lines = []
+        for ev in self.active_events():
+            filled = int(ev["fraction"] * width)
+            if filled >= width:
+                bar = "=" * width
+            else:
+                bar = "=" * filled + ">" + "." * (width - filled - 1)
+            eta = (", ETA %.1fs" % ev["eta"]
+                   if ev["eta"] is not None else "")
+            lines.append("[%s] %d%% %s%s"
+                         % (bar, int(ev["fraction"] * 100),
+                            ev["message"], eta))
+        return lines
+
+    def handle_command(self, cmd: dict):
+        if cmd.get("prefix", "") == "progress":
+            bars = self.render_bars()
+            done = ["[complete] %s (%.1fs)"
+                    % (ev["message"], ev.get("duration", 0.0))
+                    for ev in self.completed_events()]
+            out = "\n".join(bars + done) or "no active progress events"
+            return 0, out, ""
+        return super().handle_command(cmd)
+
+    # -- event-journal posting ------------------------------------------
+
+    def _journal(self, evtype: str, message: str,
+                 data: dict | None = None) -> None:
+        """Queue a journal entry for the worker thread.  notify() runs
+        on the mon-connection dispatch thread; a mon command would
+        await its reply on that same connection — hence the hop."""
+        if self._shutdown:
+            return
+        self._journal_q.put((evtype, message, data or {}))
+        if self._journal_thread is None or \
+                not self._journal_thread.is_alive():
+            self._journal_thread = threading.Thread(
+                target=self._journal_loop,
+                name="mgr-progress-journal", daemon=True)
+            self._journal_thread.start()
+
+    def _journal_loop(self) -> None:
+        while not self._shutdown:
+            item = self._journal_q.get()
+            if item is None:
+                return
+            evtype, message, data = item
+            mon = self.mgr.mon_client
+            if mon is None:
+                continue
+            try:
+                mon.command({"prefix": "events append",
+                             "type": evtype, "source": self.name,
+                             "message": message, "data": data},
+                            timeout=3.0)
+            except Exception:
+                pass   # journal narration never wedges the module

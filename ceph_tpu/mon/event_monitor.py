@@ -1,0 +1,143 @@
+"""EventMonitor: the paxos-replicated structured cluster event journal.
+
+The `ceph -w` analog, living beside LogMonitor: while the cluster log
+carries free-text daemon lines, this journal carries TYPED cluster
+events — health transitions, osdmap changes (osd marked out/in,
+down/up, pool create/resize), progress-event open/update/close from
+the mgr progress module, and thrash-harness fault injections — so an
+operator (or the convergence artifact) can replay "what happened, in
+order" across a recovery storm.
+
+Entries are dicts {seq, stamp, type, source, message, data}.  seq is a
+GLOBAL monotone counter assigned at commit time: paxos delivers the
+same payload order to every monitor, so every quorum member assigns
+identical seqs and `ceph events watch --count` can poll with a seq
+floor from any mon.  Retransmitted mon commands are already deduped by
+the Monitor's (requester, tid) reply cache, so a writable
+"events append" needs no extra dedup here.
+
+Queryable via `ceph events last N` and streamed via
+`ceph events watch --count N` (the CLI polls with `since`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import encoding
+
+__all__ = ["EventMonitor"]
+
+DEFAULT_MAX = 500
+
+
+class EventMonitor:
+    def __init__(self, mon):
+        self.mon = mon
+        self.version = 0
+        self.entries: list[dict] = []      # committed tail, oldest first
+        self.next_seq = 1                  # replicated global counter
+        self.pending: list[dict] | None = None
+        self._lock = threading.RLock()
+        try:
+            self.max_entries = int(
+                mon.ctx.conf.get_val("mon_events_max"))
+        except Exception:
+            self.max_entries = DEFAULT_MAX
+
+    # -- pending / paxos plumbing (PaxosService contract) --------------
+
+    def have_pending(self) -> bool:
+        return bool(self.pending)
+
+    def encode_pending(self) -> bytes:
+        with self._lock:
+            pend, self.pending = self.pending, None
+            return encoding.encode_any(
+                ("eventj", {"version": self.version + 1,
+                            "entries": pend or []}))
+
+    def apply_committed(self, payload: dict) -> None:
+        with self._lock:
+            if payload["version"] != self.version + 1:
+                return   # replay of an old version on a rejoining mon
+            self.version = payload["version"]
+            for entry in payload["entries"]:
+                entry = dict(entry)
+                entry["seq"] = self.next_seq
+                self.next_seq += 1
+                self.entries.append(entry)
+            del self.entries[:-self.max_entries]
+
+    # -- submission (leader side) --------------------------------------
+
+    def submit(self, evtype: str, message: str, source: str = "mon",
+               stamp: float | None = None, data: dict | None = None,
+               ) -> None:
+        """Stage one event for the next proposal (leader side; peons
+        reach this through the forwarded MMonCommand path)."""
+        entry = {"stamp": time.time() if stamp is None else stamp,
+                 "type": str(evtype), "source": str(source),
+                 "message": str(message), "data": dict(data or {})}
+        with self._lock:
+            pend = self.pending if self.pending is not None else []
+            pend.append(entry)
+            self.pending = pend
+        self.mon.propose_soon()
+
+    # -- full-state sync ----------------------------------------------
+
+    def full_state(self) -> dict:
+        with self._lock:
+            return {"version": self.version,
+                    "next_seq": self.next_seq,
+                    "entries": [dict(e) for e in self.entries]}
+
+    def set_full_state(self, state: dict) -> None:
+        if not isinstance(state, dict) or "version" not in state:
+            return
+        with self._lock:
+            if state["version"] <= self.version:
+                return
+            self.version = state["version"]
+            self.next_seq = int(state.get("next_seq", 1))
+            self.entries = [dict(e) for e in state.get("entries", [])]
+            self.pending = None
+
+    # -- commands ------------------------------------------------------
+
+    @staticmethod
+    def _render(e: dict) -> str:
+        return "%6d %s %s [%s] %s" % (
+            e.get("seq", 0), e.get("stamp", 0.0),
+            e.get("source", "?"), e.get("type", "event"),
+            e.get("message", ""))
+
+    def handle_command(self, cmd: dict):
+        prefix = cmd.get("prefix", "")
+        if prefix in ("events last", "events watch"):
+            try:
+                num = int(cmd.get("num") or 20)
+            except (TypeError, ValueError):
+                num = 20
+            try:
+                since = int(cmd.get("since") or 0)
+            except (TypeError, ValueError):
+                since = 0
+            with self._lock:
+                tail = [dict(e) for e in self.entries
+                        if e.get("seq", 0) > since][-num:]
+            outs = "\n".join(self._render(e) for e in tail)
+            return 0, outs, tail
+        if prefix == "events append":
+            # remote submission path (mgr progress module, thrash
+            # harness): forwarded to the leader like any writable
+            # command, deduped by the (requester, tid) reply cache
+            self.submit(str(cmd.get("type") or "event"),
+                        str(cmd.get("message") or ""),
+                        source=str(cmd.get("source") or "client"),
+                        data=cmd.get("data")
+                        if isinstance(cmd.get("data"), dict) else None)
+            return 0, "appended", None
+        return -22, "unknown command %r" % prefix, None

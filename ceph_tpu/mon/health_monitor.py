@@ -91,8 +91,21 @@ class HealthMonitor:
             if payload["version"] != self.version + 1:
                 return
             self.version = payload["version"]
+            old_status = self.status_of(self.checks)
             self.checks = payload["checks"]
             self.scrub_errors = payload["scrub_errors"]
+            new_status = self.status_of(self.checks)
+            new_checks = sorted(self.checks)
+        # journal the transition (leader only, outside the lock: the
+        # submit stages an eventmon batch + propose_soon)
+        if new_status != old_status and self.mon.is_leader():
+            self.mon.eventmon.submit(
+                "health", "%s -> %s%s"
+                % (old_status, new_status,
+                   " (%s)" % ", ".join(new_checks) if new_checks
+                   else ""),
+                data={"old": old_status, "new": new_status,
+                      "checks": new_checks})
 
     def full_state(self) -> dict:
         with self._lock:

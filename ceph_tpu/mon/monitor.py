@@ -21,6 +21,7 @@ from ..msg.async_messenger import create_messenger
 from ..msg.messenger import Dispatcher
 from ..store.kv import MemDB
 from .health_monitor import HealthMonitor
+from .event_monitor import EventMonitor
 from .log_monitor import LogMonitor
 from .mds_monitor import MDSMonitor
 from .osd_monitor import OSDMonitor
@@ -56,6 +57,7 @@ class Monitor(Dispatcher):
         self.authmon = AuthMonitor(self, keyring)
         self.healthmon = HealthMonitor(self)
         self.logmon = LogMonitor(self)
+        self.eventmon = EventMonitor(self)
         # proposal order: the osdmap first (everything else derives
         # from it), then the rest round-robin through propose_soon
         self._paxos_services = [
@@ -65,6 +67,7 @@ class Monitor(Dispatcher):
             (self.authmon, self.authmon.encode_pending),
             (self.healthmon, self.healthmon.encode_pending),
             (self.logmon, self.logmon.encode_pending),
+            (self.eventmon, self.eventmon.encode_pending),
         ]
         # session nonce -> {entity, caps(parsed), key_version}: peers
         # that completed the cephx proof round; the MonCap enforcement
@@ -249,6 +252,8 @@ class Monitor(Dispatcher):
             self.healthmon.apply_committed(payload)
         elif service == "logm":
             self.logmon.apply_committed(payload)
+        elif service == "eventj":
+            self.eventmon.apply_committed(payload)
 
     # -- full-state sync (paxos trim recovery; Monitor::sync role) -----
 
@@ -259,7 +264,9 @@ class Monitor(Dispatcher):
                                         self.authmon.full_state(),
                                     "healthmap":
                                         self.healthmon.full_state(),
-                                    "logm": self.logmon.full_state()})
+                                    "logm": self.logmon.full_state(),
+                                    "eventj":
+                                        self.eventmon.full_state()})
 
     def set_full_state(self, blob: bytes) -> bool:
         try:
@@ -280,6 +287,8 @@ class Monitor(Dispatcher):
                 self.healthmon.set_full_state(state["healthmap"])
             if state.get("logm"):
                 self.logmon.set_full_state(state["logm"])
+            if state.get("eventj"):
+                self.eventmon.set_full_state(state["eventj"])
         else:
             newmap = state              # legacy bare-osdmap blob
         if not hasattr(newmap, "epoch"):
@@ -397,6 +406,8 @@ class Monitor(Dispatcher):
                     svc = self.healthmon
                 elif prefix == "log" or prefix.startswith("log "):
                     svc = self.logmon
+                elif prefix.startswith("events"):
+                    svc = self.eventmon
                 else:
                     svc = self.osdmon
                 result, outs, data = svc.handle_command(msg.cmd)
@@ -421,7 +432,8 @@ class Monitor(Dispatcher):
     _READONLY_PREFIXES = frozenset((
         "osd dump", "osd getmap", "mds stat", "osd status", "status",
         "osd erasure-code-profile ls", "osd erasure-code-profile get",
-        "health", "health detail", "log last"))
+        "health", "health detail", "log last", "events last",
+        "events watch"))
 
     def _attest(self, msg) -> bytes:
         """HMAC the (session, tid, prefix) triple with the mon shared

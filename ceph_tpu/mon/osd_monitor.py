@@ -69,8 +69,48 @@ class OSDMonitor:
             for osd in inc.new_up:
                 self.down_stamps.pop(osd, None)
                 self.failure_reports.pop(osd, None)
+            changes = self._describe_inc(inc)
             self.osdmap.apply_incremental(inc)
         self.mon.publish_osdmap(inc)
+        # journal the epoch change (leader only — every mon commits
+        # this incremental, but only the leader may stage journal
+        # entries, or peons pile up pending batches they never propose)
+        if self.mon.is_leader():
+            self.mon.eventmon.submit(
+                "osdmap", "osdmap e%d: %s"
+                % (inc.epoch, "; ".join(changes) or "map updated"),
+                data={"epoch": inc.epoch, "changes": changes})
+
+    def _describe_inc(self, inc: Incremental) -> list[str]:
+        """Human-readable deltas for the event journal, computed
+        BEFORE apply (out/in needs the previous weight). Caller holds
+        the lock."""
+        changes: list[str] = []
+        for osd, w in sorted(inc.new_weight.items()):
+            was_in = (osd < len(self.osdmap.osd_weight)
+                      and self.osdmap.osd_weight[osd] > 0)
+            if w == 0 and was_in:
+                changes.append("osd.%d marked out" % osd)
+            elif w > 0 and not was_in:
+                changes.append("osd.%d marked in" % osd)
+            else:
+                changes.append("osd.%d reweighted" % osd)
+        for osd in sorted(inc.new_down):
+            changes.append("osd.%d down" % osd)
+        for osd in sorted(inc.new_up):
+            changes.append("osd.%d boot" % osd)
+        for pid, pool in sorted(inc.new_pools.items()):
+            name = getattr(pool, "name", str(pid))
+            if pid not in self.osdmap.pools:
+                changes.append("pool '%s' created" % name)
+            elif getattr(pool, "pg_num", None) != \
+                    getattr(self.osdmap.pools[pid], "pg_num", None):
+                changes.append("pool '%s' resized" % name)
+            else:
+                changes.append("pool '%s' updated" % name)
+        for pid in inc.old_pools:
+            changes.append("pool %d removed" % pid)
+        return changes
 
     # -- boot / failure ------------------------------------------------
 

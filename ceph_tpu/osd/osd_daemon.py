@@ -261,6 +261,21 @@ class OSDDaemon(Dispatcher):
                      .add_u64_counter("repaired",
                                       "shards rewritten by read-repair "
                                       "or scrub repair (l_osd_repaired)")
+                     # recovery/backfill accounting (OSD.cc
+                     # l_osd_recovery_ops/_bytes, l_osd_backfill):
+                     # incremented per pushed shard on the recovery
+                     # lane (peer re-reported missing) vs the backfill
+                     # lane (inventory reconcile after remap)
+                     .add_u64_counter("l_osd_recovery_ops",
+                                      "recovery push operations "
+                                      "completed")
+                     .add_u64_counter("l_osd_recovery_bytes",
+                                      "bytes pushed by recovery")
+                     .add_u64_counter("l_osd_backfill_ops",
+                                      "backfill push operations "
+                                      "completed")
+                     .add_u64_counter("l_osd_backfill_bytes",
+                                      "bytes pushed by backfill")
                      # span-derived per-phase op timing (the tracing
                      # spine's aggregate view; always on — a tinc is
                      # cheap even when span objects are not minted)

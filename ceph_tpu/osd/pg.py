@@ -125,6 +125,12 @@ class PG:
         # object missing serves STALE bytes until its recovery push is
         # acked — EC reads must reconstruct around it, not from it
         self.peer_missing: dict = {}  # oid -> set(osd)
+        # backfill lane bookkeeping (pg_stat_t misplaced role): shards
+        # being copied to a NEW acting member after a remap — data is
+        # still fully readable elsewhere, so these count as misplaced,
+        # not degraded, and deliberately do NOT feed the EC
+        # read-routing that peer_missing drives
+        self.backfilling: dict = {}   # oid -> set(osd)
         self._push_retrying: set = set()   # (oid, peer) retry chains
         # reqid -> version, rebuilt from the log: the failover-safe
         # client-retransmit dedup (pg_log_entry_t::reqid role)
@@ -1331,6 +1337,7 @@ class PG:
             # a new interval recomputes who is missing what: replicas
             # re-report after activation (handle_log missing notify)
             self.peer_missing.clear()
+            self.backfilling.clear()
             targets = {osd for osd in set(self.up) | set(self.acting)
                        if osd not in (CRUSH_ITEM_NONE, self.whoami)}
             self._peer_wait = set(targets)
@@ -1431,6 +1438,11 @@ class PG:
                         peers.discard(msg.from_osd)
                         if not peers:
                             self.peer_missing.pop(oid, None)
+                    backf = self.backfilling.get(oid)
+                    if backf is not None:
+                        backf.discard(msg.from_osd)
+                        if not backf:
+                            self.backfilling.pop(oid, None)
             return
         if getattr(msg, "kind", "info") == "missing":
             shards = self.acting_shards()
@@ -2029,9 +2041,21 @@ class PG:
         except Exception:
             pass
         with self.lock:
+            # pg_stat_t degraded/misplaced: degraded = object copies
+            # a current acting member is known to lack (our own
+            # missing set + every peer's); misplaced = copies still
+            # being backfilled onto a new acting member (fully
+            # readable elsewhere). These ride MPGStats/MMgrReport
+            # into the mgr's pg_summary and the progress module.
+            degraded = (len(self.missing)
+                        + sum(len(s)
+                              for s in self.peer_missing.values()))
+            misplaced = sum(len(s) for s in self.backfilling.values())
             return {"pool": self.pgid.pool, "state": self.peer_state,
                     "objects": nobj, "bytes": nbytes,
-                    "scrub_errors": self.scrub_errors}
+                    "scrub_errors": self.scrub_errors,
+                    "degraded_objects": degraded,
+                    "misplaced_objects": misplaced}
 
     def repair_shard(self, oid, shard: int, peer_osd: int) -> None:
         """Read-path self-heal: a shard that served EIO or bad-crc
@@ -2119,7 +2143,13 @@ class PG:
                     txn.remove(self.cid_of_shard(-1), oid)
                 self.store.queue_transaction(txn)
                 continue
-            self._push_object(oid, shard, peer_osd)
+            # inventory reconcile = the backfill lane: the peer is a
+            # (possibly new) acting member being brought up to the
+            # authoritative set after a remap — its objects are
+            # misplaced, not degraded
+            with self.lock:
+                self.backfilling.setdefault(oid, set()).add(peer_osd)
+            self._push_object(oid, shard, peer_osd, lane="backfill")
         if peer_osd == self.whoami:
             return
         # The peer may be AHEAD of us: a revived primary that missed
@@ -2195,7 +2225,8 @@ class PG:
         return attrs, omap
 
     def _push_object(self, oid, shard: int, peer_osd: int,
-                     force: bool = False, attempt: int = 0) -> None:
+                     force: bool = False, attempt: int = 0,
+                     lane: str = "recovery") -> None:
         attrs, omap = self._gather_push_meta(oid)
 
         def on_data(data):
@@ -2215,7 +2246,7 @@ class PG:
                 if attempt < 40:
                     self.daemon.timer.add_event_after(
                         delay, self._retry_push, oid, shard, peer_osd,
-                        attempt + 1)
+                        attempt + 1, lane)
                 else:
                     with self.lock:
                         self._push_retrying.discard(key)
@@ -2227,6 +2258,7 @@ class PG:
             # would never satisfy the replica's missing gate
             version = max(int(attrs.get(VERSION_ATTR, b"0") or 0),
                           self._log_version_of(oid))
+            self._count_push(lane, len(data))
             msg = MOSDPGPush(
                 pgid=self.pgid, from_osd=self.whoami, shard=shard,
                 oid=oid, data=data, attrs=attrs, omap=omap,
@@ -2239,6 +2271,19 @@ class PG:
 
         self.backend.recover_object(oid, shard, on_data)
 
+    def _count_push(self, lane: str, nbytes: int) -> None:
+        """l_osd_recovery_*/l_osd_backfill_* accounting, per completed
+        push (best-effort: scrub harnesses run PGs against daemon
+        stubs without the full counter set)."""
+        perf = getattr(self.daemon, "perf", None)
+        if perf is None:
+            return
+        try:
+            perf.inc("l_osd_%s_ops" % lane)
+            perf.inc("l_osd_%s_bytes" % lane, nbytes)
+        except KeyError:
+            pass
+
     def _log_version_of(self, oid) -> int:
         """Latest log version touching oid (0 when not in the log)."""
         with self.lock:
@@ -2248,13 +2293,15 @@ class PG:
         return 0
 
     def _retry_push(self, oid, shard: int, peer_osd: int,
-                    attempt: int = 1) -> None:
+                    attempt: int = 1, lane: str = "recovery") -> None:
         with self.lock:
             self._push_retrying.discard((oid, peer_osd))
             if self.acting_primary != self.whoami or \
-                    oid not in self.peer_missing:
+                    (oid not in self.peer_missing
+                     and oid not in self.backfilling):
                 return
-        self._push_object(oid, shard, peer_osd, attempt=attempt)
+        self._push_object(oid, shard, peer_osd, attempt=attempt,
+                          lane=lane)
 
     def handle_push(self, msg) -> None:
         """Apply a recovery push to the local shard store."""
@@ -2293,6 +2340,11 @@ class PG:
                         peers.discard(self.whoami)
                         if not peers:
                             self.peer_missing.pop(msg.oid, None)
+                    backf = self.backfilling.get(msg.oid)
+                    if backf is not None:
+                        backf.discard(self.whoami)
+                        if not backf:
+                            self.backfilling.pop(msg.oid, None)
             else:
                 self.send_to_osd(msg.from_osd, MOSDPGNotify(
                     pgid=self.pgid, from_osd=self.whoami,

@@ -253,7 +253,8 @@ def main(argv=None) -> int:
                         "df / osd perf / iostat (mgr asok)")
     p.add_argument("words", nargs="+",
                    help="command, e.g.: status | health [detail] | "
-                        "log last [N] | osd tree | "
+                        "log last [N] | events last [N] | "
+                        "events watch --count N | osd tree | "
                         "osd pool ls | osd pool create NAME | "
                         "osd out/in/down ID | osd dump | "
                         "df --asok MGR | osd perf --asok MGR | "
@@ -304,6 +305,47 @@ def main(argv=None) -> int:
                 {"prefix": "log last", "num": num})
             sys.stdout.write(outs + "\n")
             return 0 if res == 0 else 1
+        if w[:2] == ["events", "last"]:
+            try:
+                num = int(w[2]) if len(w) > 2 else 20
+            except ValueError:
+                sys.stderr.write("ceph: invalid count %r\n" % w[2])
+                return 1
+            res, outs, _ = client.mon_command(
+                {"prefix": "events last", "num": num})
+            sys.stdout.write(outs + "\n")
+            return 0 if res == 0 else 1
+        if w[:2] == ["events", "watch"]:
+            # the `ceph -w` analog: poll the journal with a seq floor
+            # until --count NEW events have streamed (bounded by
+            # design — tests and operators both need it to return)
+            import time as _time
+            res, _, tail = client.mon_command(
+                {"prefix": "events last", "num": 1})
+            if res != 0:
+                return 1
+            since = tail[-1]["seq"] if tail else 0
+            printed = 0
+            deadline = _time.monotonic() + 60.0
+            while printed < args.count:
+                if _time.monotonic() > deadline:
+                    sys.stderr.write("ceph: events watch timed out\n")
+                    return 1
+                res, outs, data = client.mon_command(
+                    {"prefix": "events watch", "num": 1000,
+                     "since": since})
+                if res != 0:
+                    return 1
+                for line, e in zip((outs or "").split("\n"),
+                                   data or []):
+                    since = max(since, e.get("seq", since))
+                    sys.stdout.write(line + "\n")
+                    printed += 1
+                    if printed >= args.count:
+                        break
+                if printed < args.count:
+                    _time.sleep(min(args.period, 0.25))
+            return 0
         if w == ["osd", "tree"] or w == ["osd", "stat"]:
             sys.stdout.write(osd_tree(m) + "\n")
             return 0

@@ -1,0 +1,457 @@
+"""Recovery-convergence observability tests.
+
+Covers the mgr progress module (pybind/mgr/progress analog: osdmap
+diffs open events, aggregated PG stats drive a MONOTONE completion
+fraction with a rate-based ETA, completed events retire into a
+bounded ring), the mon event journal (`ceph events last/watch`), the
+new Prometheus recovery series with their ageout discipline, and an
+exposition-format lint over the full rendered page.
+"""
+
+import re
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.mgr import PrometheusModule, StatusModule
+from ceph_tpu.mgr.modules import _escape_label
+from ceph_tpu.mgr.progress import IDLE_GRACE, ProgressModule
+
+from .cluster_util import MiniCluster, wait_until
+
+FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+        "mon_osd_down_out_interval": 1.0,
+        "paxos_propose_interval": 0.02,
+        "mgr_stats_period": 0.25}
+
+
+# -- unit scaffolding: a module with no mgr/network behind it ----------
+
+class _Conf:
+    def get_val(self, key):
+        raise KeyError(key)
+
+
+class _Ctx:
+    conf = _Conf()
+
+
+class _FakeMgr:
+    ctx = _Ctx()
+    mon_client = None
+
+
+def _module() -> ProgressModule:
+    mod = ProgressModule(_FakeMgr())
+    mod._journal = lambda *a, **k: None   # unit tests: no mon to post to
+    return mod
+
+
+class _FakeMap:
+    def __init__(self, max_osd, in_set, up_set, pools=None):
+        self.max_osd = max_osd
+        self._in = set(in_set)
+        self._up = set(up_set)
+        self.pools = pools or {}
+
+    def exists(self, o):
+        return True
+
+    def is_in(self, o):
+        return o in self._in
+
+    def is_up(self, o):
+        return o in self._up
+
+
+class TestFractionOracle:
+    def test_monotone_fraction_from_pg_stat_deltas(self):
+        """Exact oracle: fraction = max(prev, 1 - bad/peak_bad), and a
+        mid-recovery re-peer that re-raises bad must raise the
+        BASELINE, never walk the bar backwards."""
+        mod = _module()
+        ev = mod._open_event("Rebalancing after osd.3 marked out",
+                             now=0.0)
+        feed = [  # (t, bad, want_fraction)
+            (0.5, 12, 0.0),       # peak damage -> baseline 12
+            (1.0, 9, 0.25),
+            (1.5, 6, 0.5),
+            (2.0, 16, 0.5),       # re-peer: baseline -> 16, bar holds
+            (2.5, 8, 0.5),        # 1 - 8/16
+            (3.0, 4, 0.75),
+            (3.5, 0, 0.99),       # first zero: capped, not yet done
+            (4.0, 0, 1.0),        # second zero: converged
+        ]
+        for t, bad, want in feed:
+            mod._update_one(ev, bad, False, t, [])
+            assert ev["fraction"] == pytest.approx(want), (t, bad)
+        hist = [f for _, f in ev["history"]]
+        assert hist == sorted(hist), "fraction history regressed"
+        assert hist[-1] == 1.0
+
+    def test_peering_holds_completion(self):
+        mod = _module()
+        ev = mod._open_event("x", now=0.0)
+        mod._update_one(ev, 4, False, 0.5, [])
+        mod._update_one(ev, 0, True, 1.0, [])
+        assert ev["fraction"] == 0.99     # zero bad, but still peering
+        mod._update_one(ev, 0, True, 1.5, [])
+        assert ev["fraction"] == 0.99
+        mod._update_one(ev, 0, False, 2.0, [])
+        assert ev["fraction"] == 1.0
+
+    def test_no_damage_event_completes_after_idle_grace(self):
+        """A change that moved nothing (empty pool resized) completes
+        after the idle grace instead of hanging at 0% forever."""
+        mod = _module()
+        ev = mod._open_event("resize", now=0.0)
+        mod._update_one(ev, 0, False, 0.5, [])
+        mod._update_one(ev, 0, False, 1.0, [])
+        assert ev["fraction"] < 1.0       # streak ok, grace not elapsed
+        mod._update_one(ev, 0, False, IDLE_GRACE + 0.1, [])
+        assert ev["fraction"] == 1.0
+
+    def test_update_folds_degraded_plus_misplaced(self):
+        """The end-to-end derivation: update() reads the aggregator's
+        pg_summary and folds degraded+misplaced into the fraction,
+        retiring the event at convergence."""
+        mod = _module()
+        summaries = iter([
+            {"degraded_objects": 6, "misplaced_objects": 2, "pgs": {}},
+            {"degraded_objects": 2, "misplaced_objects": 2, "pgs": {}},
+            {"degraded_objects": 0, "misplaced_objects": 0, "pgs": {}},
+            {"degraded_objects": 0, "misplaced_objects": 0, "pgs": {}},
+        ])
+
+        class _Metrics:
+            @staticmethod
+            def pg_summary():
+                return next(summaries)
+
+        mod.get = lambda name: _Metrics()
+        ev = mod._open_event("x", now=0.0)
+        mod.update(now=0.5)
+        assert ev["fraction"] == 0.0          # baseline 8
+        mod.update(now=1.0)
+        assert ev["fraction"] == 0.5          # 1 - 4/8
+        mod.update(now=1.5)
+        assert ev["fraction"] == 0.99
+        mod.update(now=2.0)
+        assert not mod.active_events()
+        done = mod.completed[-1]
+        assert done["fraction"] == 1.0
+        assert done["duration"] == 2.0
+
+    def test_eta_from_recent_slope(self):
+        mod = _module()
+        ev = mod._open_event("x", now=0.0)
+        mod._update_one(ev, 20, False, 0.0, [])
+        mod._update_one(ev, 10, False, 1.0, [])
+        assert ev["fraction"] == 0.5
+        # half done in 1s at a steady rate -> 1s left
+        assert ev["eta"] == pytest.approx(1.0, abs=0.05)
+
+    def test_eta_none_without_progress(self):
+        mod = _module()
+        ev = mod._open_event("x", now=0.0)
+        mod._update_one(ev, 10, False, 0.0, [])
+        mod._update_one(ev, 10, False, 1.0, [])
+        assert ev["eta"] is None
+
+    def test_completed_ring_retention(self):
+        mod = _module()
+        assert mod.completed.maxlen == 32     # conf default
+
+        class _Metrics:
+            @staticmethod
+            def pg_summary():
+                return {"degraded_objects": 0, "misplaced_objects": 0,
+                        "pgs": {}}
+
+        mod.get = lambda name: _Metrics()
+        for i in range(40):
+            mod._open_event("ev %d" % i, now=0.0)
+        mod.update(now=100.0)
+        mod.update(now=100.5)     # second clean round past the grace
+        assert not mod.active_events()
+        assert len(mod.completed) == mod.completed.maxlen == 32
+        # the bounded ring keeps the NEWEST completions
+        assert mod.completed[-1]["message"] == "ev 39"
+        assert mod.completed[0]["message"] == "ev 8"
+
+    def test_osdmap_diff_opens_events(self):
+        mod = _module()
+        mod._on_osdmap(_FakeMap(4, {0, 1, 2, 3}, {0, 1, 2, 3}))
+        assert mod.active_events() == []      # boot map: no change
+        mod._on_osdmap(_FakeMap(4, {0, 1, 3}, {0, 1, 3}))
+        msgs = [ev["message"] for ev in mod.active_events()]
+        assert msgs == ["Rebalancing after osd.2 marked out"]
+        mod._on_osdmap(_FakeMap(4, {0, 1, 2, 3}, {0, 1, 2, 3}))
+        msgs = [ev["message"] for ev in mod.active_events()]
+        assert "Rebalancing after osd.2 marked in" in msgs
+
+    def test_render_bars_format(self):
+        mod = _module()
+        ev = mod._open_event("Rebalancing after osd.2 marked out",
+                             now=0.0)
+        ev["fraction"], ev["eta"] = 0.42, 3.1
+        assert mod.render_bars() == [
+            "[====>.....] 42% Rebalancing after osd.2 marked out"
+            ", ETA 3.1s"]
+        ev["fraction"], ev["eta"] = 1.0, None
+        assert mod.render_bars() == [
+            "[==========] 100% Rebalancing after osd.2 marked out"]
+
+
+# -- live cluster: the osd-out lifecycle end to end --------------------
+
+@pytest.fixture(scope="module")
+def conv_cluster():
+    cluster = MiniCluster(num_mons=1, num_osds=4,
+                          conf_overrides=FAST).start()
+    mgr = cluster.start_mgr(modules=(ProgressModule, StatusModule,
+                                     PrometheusModule))
+    client = cluster.client()
+    pool_id = cluster.create_replicated_pool(client, "convp", size=3,
+                                             pg_num=8)
+    assert cluster.wait_clean(pool_id)
+    io = client.open_ioctx("convp")
+    for i in range(16):
+        io.write_full("obj%d" % i, b"q" * 4096)
+    assert wait_until(lambda: mgr.osdmap is not None, timeout=10)
+    yield cluster, mgr, client, pool_id
+    cluster.stop()
+
+
+class TestProgressLive:
+    def test_osd_out_event_lifecycle(self, conv_cluster):
+        """osd out -> event opens -> recovery drains -> event retires
+        at 1.0 with a monotone history (the ISSUE's core sequence)."""
+        cluster, mgr, client, pool_id = conv_cluster
+        progress = mgr.modules["progress"]
+        victim = max(cluster.osds)
+        store = cluster.stop_osd(victim)
+        try:
+            assert wait_until(
+                lambda: not cluster.leader().osdmon.osdmap
+                .is_in(victim), timeout=30), "osd never marked out"
+            needle = "osd.%d marked out" % victim
+            assert wait_until(
+                lambda: any(needle in ev["message"] for ev in
+                            progress.active_events()
+                            + progress.completed_events()),
+                timeout=15), "no progress event opened"
+
+            def completed_out():
+                return [ev for ev in progress.completed_events()
+                        if needle in ev["message"]]
+            assert wait_until(lambda: bool(completed_out()),
+                              timeout=60), \
+                "event never completed: %s" % progress.active_events()
+            ev = completed_out()[0]
+            hist = [f for _, f in ev["history"]]
+            assert all(b >= a for a, b in zip(hist, hist[1:])), hist
+            assert hist[-1] == 1.0
+            assert ev["fraction"] == 1.0
+            assert ev["duration"] > 0
+        finally:
+            cluster.revive_osd(victim, store=store)
+            client.mon_command({"prefix": "osd in", "id": victim})
+            assert wait_until(cluster.all_osds_up, timeout=30)
+        # the revive opens a marked-in event; everything must retire
+        # once the cluster is clean again
+        assert wait_until(lambda: not progress.active_events(),
+                          timeout=60), progress.active_events()
+
+    def test_journal_interleaves_osdmap_and_progress(self, conv_cluster):
+        """The mon event journal carries BOTH the osdmap change and
+        the mgr's progress narration of it, in seq order."""
+        _, _, client, _ = conv_cluster
+
+        def entries():
+            res, _, tail = client.mon_command(
+                {"prefix": "events last", "num": 500})
+            assert res == 0
+            return tail or []
+
+        assert wait_until(
+            lambda: {"osdmap", "progress"} <=
+            {e["type"] for e in entries()}, timeout=15)
+        tail = entries()
+        out_seq = min(e["seq"] for e in tail if e["type"] == "osdmap"
+                      and "marked out" in e["message"])
+        prog = [e for e in tail if e["type"] == "progress"
+                and "marked out" in e["message"]]
+        assert prog, tail
+        # cause before effect: the map change journals before the
+        # progress events narrating it
+        assert all(e["seq"] > out_seq for e in prog)
+        seqs = [e["seq"] for e in tail]
+        assert seqs == sorted(seqs)
+
+    def test_status_shows_recovery_io_and_progress(self, conv_cluster):
+        _, mgr, _, _ = conv_cluster
+        progress = mgr.modules["progress"]
+        ev = progress._open_event("status bar probe")
+        ev["fraction"], ev["eta"] = 0.5, 2.0
+        try:
+            rc, out, _ = mgr.module_command({"prefix": "status"})
+        finally:
+            with progress._lock:
+                progress._events.pop(ev["id"], None)
+        assert rc == 0
+        assert "client:" in out and "recovery:" in out
+        assert "progress:" in out
+        # a concurrent update() may recompute the ETA; the bar itself
+        # is deterministic (monotone fraction holds at 50%)
+        assert "[=====>....] 50% status bar probe" in out
+
+    def test_progress_command(self, conv_cluster):
+        _, mgr, _, _ = conv_cluster
+        rc, out, _ = mgr.module_command({"prefix": "progress"})
+        assert rc == 0
+        # after the lifecycle test the completed ring narrates it
+        assert "[complete]" in out or "no active progress" in out
+
+    def test_prometheus_series_appear_then_age_out(self, conv_cluster):
+        cluster, mgr, _, _ = conv_cluster
+        prom = mgr.modules["prometheus"]
+        progress = mgr.modules["progress"]
+        assert wait_until(
+            lambda: mgr.metrics.pg_summary()["pgs"], timeout=15), \
+            "pg stats never reached the aggregator"
+        text = prom.render()
+        assert "ceph_recovery_bytes_rate" in text
+        assert "ceph_pg_degraded_objects{" in text
+        assert "ceph_pg_misplaced_objects{" in text
+        # an active event exports its fraction ...
+        ev = progress._open_event("synthetic export probe")
+        ev_id = ev["id"]
+        try:
+            text = prom.render()
+            assert ('ceph_progress_event_fraction{event_id="%s"}'
+                    % ev_id) in text
+        finally:
+            # ... and the series leaves the exposition the moment the
+            # event completes (the ageout discipline)
+            with progress._lock:
+                progress._events.pop(ev_id, None)
+                progress.completed.append(ev)
+        text = prom.render()
+        assert 'event_id="%s"' % ev_id not in text
+
+
+class TestEventsCLI:
+    def test_events_last(self, conv_cluster, capsys):
+        cluster, _, _, _ = conv_cluster
+        from ceph_tpu.tools import ceph_cli
+        mon_addr = "%s:%d" % cluster.monmap[0]
+        assert ceph_cli.main(
+            ["--mon", mon_addr, "events", "last", "50"]) == 0
+        out = capsys.readouterr().out
+        assert "[osdmap]" in out      # pool create / osd out traffic
+
+    def test_events_watch_streams_new_events(self, conv_cluster,
+                                             capsys):
+        cluster, _, client, _ = conv_cluster
+        from ceph_tpu.tools import ceph_cli
+        mon_addr = "%s:%d" % cluster.monmap[0]
+        result = {}
+
+        def watch():
+            result["rc"] = ceph_cli.main(
+                ["--mon", mon_addr, "--count", "2", "--period", "0.1",
+                 "events", "watch"])
+
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        time.sleep(0.5)               # watcher takes its seq floor
+        for i in range(2):
+            res, outs, _ = client.mon_command(
+                {"prefix": "events append", "type": "test",
+                 "message": "watch probe %d" % i, "data": {}})
+            assert res == 0 and outs == "appended"
+        t.join(timeout=90)
+        assert not t.is_alive(), "events watch never returned"
+        assert result["rc"] == 0
+        out = capsys.readouterr().out
+        assert "watch probe" in out
+
+
+# -- exposition lint ---------------------------------------------------
+
+_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:\\.|[^"\\])*"'
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(\{%s(?:,%s)*\})?'
+    r' (?:[-+0-9.eE]+|nan|inf|-inf)$' % (_LABEL, _LABEL))
+
+
+def _lint_exposition(text: str) -> None:
+    """The format contract a prometheus scraper holds us to: every
+    series name announced by exactly one HELP and one TYPE line, its
+    samples contiguous under them, every sample line parseable (a raw
+    newline in a label value breaks this), no duplicate samples."""
+    helps: dict = {}
+    types: dict = {}
+    seen = set()
+    current = None
+    finished = set()
+    for ln in text.split("\n"):
+        if not ln:
+            continue
+        if ln.startswith("# HELP "):
+            name = ln.split(" ", 3)[2]
+            assert name not in helps, "duplicate HELP %s" % name
+            assert name not in finished, \
+                "name %s re-opened after its block closed" % name
+            if current is not None:
+                finished.add(current)
+            helps[name] = True
+            current = name
+        elif ln.startswith("# TYPE "):
+            parts = ln.split(" ")
+            name, mtype = parts[2], parts[3]
+            assert name == current, "TYPE %s outside its block" % name
+            assert name not in types, "duplicate TYPE %s" % name
+            assert mtype in ("gauge", "counter", "histogram",
+                             "summary", "untyped"), mtype
+            types[name] = mtype
+        else:
+            m = _SAMPLE_RE.match(ln)
+            assert m, "unparseable sample line: %r" % ln
+            name = m.group(1)
+            assert name == current, \
+                "sample %s outside its contiguous block" % name
+            key = (name, m.group(2) or "")
+            assert key not in seen, "duplicate sample %r" % (key,)
+            seen.add(key)
+    sampled = {n for n, _ in seen}
+    assert sampled, "empty exposition"
+    missing_help = sampled - set(helps)
+    missing_type = sampled - set(types)
+    assert not missing_help, "samples without HELP: %s" % missing_help
+    assert not missing_type, "samples without TYPE: %s" % missing_type
+
+
+class TestExpositionLint:
+    def test_escape_label(self):
+        assert _escape_label('a"b') == 'a\\"b'
+        assert _escape_label("a\nb") == "a\\nb"
+        assert _escape_label("a\\b") == "a\\\\b"
+
+    def test_rendered_page_passes_lint(self, conv_cluster):
+        """Lint the FULL live page, with a label-hostile PG id fed
+        through the aggregator so the escaping path is on the page."""
+        _, mgr, _, _ = conv_cluster
+        mgr.metrics.record(
+            "osd.99", {"osd": {}},
+            pg_stats={'9.0"\nq\\': {"state": "active",
+                                    "degraded_objects": 1,
+                                    "misplaced_objects": 0}},
+            daemon_type="osd")
+        prom = mgr.modules["prometheus"]
+        text = prom.render()
+        assert 'pgid="9.0\\"\\nq\\\\"' in text
+        _lint_exposition(text)

@@ -103,6 +103,31 @@ class TestThrashReplicated:
             assert not missing, \
                 "%d acked objects lost after thrash (e.g. %s); log=%s" \
                 % (len(missing), missing[:5], thrasher.log)
+            # the cluster event journal interleaves what the thrasher
+            # DID (kill/revive) with how the cluster REACTED (osdmap
+            # down/out epochs, health transitions)
+            def journaled():
+                _, _, events = client.mon_command(
+                    {"prefix": "events last", "num": 500})
+                types = {e.get("type") for e in events or []}
+                return "thrash" in types and "osdmap" in types
+            assert wait_until(journaled, timeout=15), \
+                "thrash/osdmap events never reached the journal"
+            _, _, events = client.mon_command(
+                {"prefix": "events last", "num": 500})
+            thrash_seqs = [e["seq"] for e in events
+                           if e.get("type") == "thrash"]
+            assert thrash_seqs, "no thrash events journaled"
+            # at least one cluster-reaction event committed AFTER the
+            # first injected fault: the journal shows cause before
+            # effect, in one ordered stream
+            reaction = [e["seq"] for e in events
+                        if e.get("type") in ("osdmap", "health")
+                        and e["seq"] > thrash_seqs[0]]
+            assert reaction, \
+                "no cluster reaction interleaved after the first " \
+                "fault: %s" % [(e.get("seq"), e.get("type"))
+                               for e in events]
         finally:
             cluster.stop()
 

@@ -38,6 +38,23 @@ class Thrasher:
     def _alive(self) -> list[int]:
         return sorted(set(self.cluster.osds) - set(self.dead))
 
+    def _journal(self, action: str, osd_id: int) -> None:
+        """Record the injected fault in the mon's cluster event
+        journal, so `ceph events last` interleaves what the thrasher
+        DID with how the cluster REACTED (down/out epochs, health
+        transitions). Best-effort: journaling must never change the
+        thrash behavior itself."""
+        try:
+            leader = self.cluster.leader()
+            eventmon = getattr(leader, "eventmon", None)
+            if eventmon is not None:
+                eventmon.submit(
+                    "thrash", "thrasher: %s osd.%d" % (action, osd_id),
+                    source="thrasher",
+                    data={"action": action, "osd": osd_id})
+        except Exception:
+            pass
+
     def kill_one(self) -> int | None:
         alive = self._alive()
         if len(alive) <= self.min_in:
@@ -46,6 +63,7 @@ class Thrasher:
         store = self.cluster.stop_osd(victim)
         self.dead[victim] = store
         self.log.append(("kill", victim))
+        self._journal("kill", victim)
         return victim
 
     def revive_one(self) -> int | None:
@@ -63,6 +81,7 @@ class Thrasher:
             except Exception:
                 pass
         self.log.append(("revive", osd_id))
+        self._journal("revive", osd_id)
         return osd_id
 
     # -- loop ----------------------------------------------------------
