@@ -1499,6 +1499,222 @@ def run_convergence(out_path: str | None = None) -> dict:
     return doc
 
 
+def run_recovery(out_path: str | None = None) -> dict:
+    """Repair-bandwidth artifact (ROADMAP direction C): the msr
+    product-matrix codec's beta-fraction rebuild vs classic RS k=8,m=3
+    full-survivor decode.
+
+    Two legs:
+
+      1. Codec leg (device): encode a batch with msr k=8,m=7, rebuild
+         one chunk from d=14 helper fractions on device, and verify the
+         reconstruction BIT-IDENTICAL against the host gf_ref oracle
+         (repair_oracle). Publishes bytes-moved-per-logical-byte for
+         both codecs and their ratio, plus repair throughput.
+      2. Cluster leg (MiniCluster): an msr pool takes a bit-rotted
+         shard through the scrub-repair loop; the published measured
+         ratio comes from the l_osd_repair_bytes_{shipped,saved}
+         counters, and degraded-read p99 from the mgr aggregator's
+         l_osd_op_trace_us histogram percentiles.
+
+    HARD GATES (SystemExit): the device rebuild must match the host
+    oracle bit-for-bit, and the traffic ratio must be < 1.0 (the whole
+    point of the codec); the cluster leg must heal the shard and its
+    counter-measured ratio must also be < 1.0."""
+    import threading
+
+    import jax
+
+    from ceph_tpu import registry
+
+    doc: dict = {"metric": "repair_traffic_ratio_vs_rs", "unit": "x"}
+
+    # -- codec leg ----------------------------------------------------
+    msr = registry.factory("msr_tpu", {"technique": "msr", "k": "8",
+                                       "m": "7", "w": "8"})
+    rs = registry.factory("jax_tpu", {"technique": "reed_sol_van",
+                                      "k": "8", "m": "3", "w": "8"})
+    obj = OBJ_SIZE
+    chunk_msr = msr.get_chunk_size(obj)
+    chunk_rs = rs.get_chunk_size(obj)
+    sub = msr.repair_sub_size(chunk_msr)
+    d = msr.repair_helper_count()
+    # bytes crossing the network per rebuilt chunk, normalised per
+    # logical byte so the two codecs' different alignments cancel
+    moved_msr = d * sub / obj
+    moved_rs = rs.k * chunk_rs / obj
+    ratio = moved_msr / moved_rs
+    doc["msr"] = {"k": msr.k, "m": msr.m, "alpha": msr.alpha, "d": d,
+                  "chunk_bytes": chunk_msr, "fraction_bytes": sub,
+                  "moved_per_logical": round(moved_msr, 4)}
+    doc["rs"] = {"k": rs.k, "m": rs.m, "chunk_bytes": chunk_rs,
+                 "moved_per_logical": round(moved_rs, 4)}
+    doc["traffic_ratio"] = round(ratio, 4)
+    if ratio >= 1.0:
+        raise SystemExit("recovery gate: msr moves %.3fx the bytes of "
+                         "a full RS decode" % ratio)
+
+    stripes = 8
+    rng = np.random.default_rng(13)
+    data = rng.integers(0, 256, size=(stripes, msr.k, chunk_msr),
+                        dtype=np.uint8)
+    parity = np.asarray(msr.encode_batch(data), dtype=np.uint8)
+    rows = {msr.chunk_index(i): data[:, i]
+            for i in range(msr.k)}
+    rows.update({msr.chunk_index(msr.k + j): parity[:, j]
+                 for j in range(msr.m)})
+    target = msr.chunk_index(2)
+    helpers = tuple(sorted(msr.minimum_to_repair(
+        target, set(rows) - {target})))
+    stacked = np.stack([rows[h] for h in helpers], axis=1)
+
+    import jax.numpy as jnp
+    fr_dev = [jax.block_until_ready(msr.repair_fraction_batch(
+        target, jnp.asarray(rows[h]))) for h in helpers]
+    frac_dev = jnp.stack(fr_dev, axis=1)
+    rebuilt = np.asarray(jax.block_until_ready(
+        msr.repair_combine_batch(target, helpers, frac_dev)),
+        dtype=np.uint8)
+    for s in range(stripes):
+        oracle = msr.repair_oracle(
+            target, helpers, {h: rows[h][s] for h in helpers})
+        if not np.array_equal(rebuilt[s], oracle):
+            raise SystemExit("recovery gate: device rebuild of stripe "
+                             "%d diverges from the host oracle" % s)
+    if not np.array_equal(rebuilt, rows[target]):
+        raise SystemExit("recovery gate: rebuilt chunk != original")
+    doc["oracle_bit_identical"] = True
+
+    # repair throughput: fractions + combine, timed over repeats
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fr = [msr.repair_fraction_batch(target, jnp.asarray(rows[h]))
+              for h in helpers]
+        out = msr.repair_combine_batch(target, helpers,
+                                       jnp.stack(fr, axis=1))
+        jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    doc["repair_MBps"] = round(
+        reps * stripes * chunk_msr / 1e6 / max(dt, 1e-9), 3)
+    # baseline: RS full decode of the same logical volume
+    rs_data = rng.integers(0, 256, size=(stripes, rs.k, chunk_rs),
+                           dtype=np.uint8)
+    avail = tuple(range(rs.k))
+    jax.block_until_ready(rs.decode_batch(avail, jnp.asarray(rs_data)))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        jax.block_until_ready(
+            rs.decode_batch(avail, jnp.asarray(rs_data)))
+    dt = time.perf_counter() - t0
+    doc["rs_decode_MBps"] = round(
+        reps * stripes * chunk_rs / 1e6 / max(dt, 1e-9), 3)
+
+    # -- cluster leg --------------------------------------------------
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_util import MiniCluster, wait_until
+
+    c = MiniCluster(num_mons=1, num_osds=5,
+                    conf_overrides={"osd_tracing": False,
+                                    "osd_profiler": False,
+                                    # route the rebuild through the
+                                    # helper-fraction path, not the
+                                    # resident fast path
+                                    "osd_hbm_tier_enable": False,
+                                    "osd_heartbeat_interval": 0.1,
+                                    "osd_heartbeat_grace": 0.6,
+                                    "paxos_propose_interval": 0.02,
+                                    "mgr_stats_period": 0.25})
+    c.start()
+    try:
+        mgr = c.start_mgr()
+        client = c.client()
+        c.create_ec_pool(client, "repairpool",
+                         {"plugin": "msr", "technique": "msr",
+                          "k": "3", "m": "2"}, pg_num=4)
+        ioctx = client.open_ioctx("repairpool")
+        payload = rng.integers(0, 256, 1 << 16,
+                               dtype=np.uint8).tobytes()
+        n_objs = 8
+        for i in range(n_objs):
+            ioctx.write_full("rep-%d" % i, payload)
+
+        m = client.osdmap
+        pool_id = client.pool_id("repairpool")
+        from ceph_tpu.osd.osd_map import PGID
+        healed = 0
+        for i in range(n_objs):
+            oid = "rep-%d" % i
+            pgid = m.pools[pool_id].raw_pg_to_pg(
+                m.object_to_pg(pool_id, oid))
+            _, _, acting, primary = m.pg_to_up_acting_osds(pgid)
+            victim = c.osds[acting[1]]
+            cid = ("pg", str(pgid), 1)
+            good = victim.store.read(cid, oid)
+            victim.store.faults.mark_bitrot(cid, oid)
+            osd = c.osds[primary]
+            if not osd.scrub_pg(pgid, deep=True, repair=True):
+                continue
+            pg = osd.pgs[pgid]
+            if wait_until(lambda: pg.scrub_stats.get("state") == "clean"
+                          and victim.store.read(cid, oid) == good, 30):
+                healed += 1
+        if healed == 0:
+            raise SystemExit("recovery gate: cluster leg healed no "
+                             "bit-rotted shards")
+        doc["cluster_shards_healed"] = healed
+
+        read_b = shipped = saved = 0
+        for osd in c.osds.values():
+            read_b += osd.perf.get("l_osd_repair_bytes_read")
+            shipped += osd.perf.get("l_osd_repair_bytes_shipped")
+            saved += osd.perf.get("l_osd_repair_bytes_saved")
+        if shipped == 0 or shipped + saved == 0:
+            raise SystemExit("recovery gate: repair counters never "
+                             "moved (repair path not taken)")
+        measured = shipped / (shipped + saved)
+        doc["cluster_counters"] = {"repair_bytes_read": read_b,
+                                   "repair_bytes_shipped": shipped,
+                                   "repair_bytes_saved": saved}
+        doc["cluster_measured_ratio"] = round(measured, 4)
+        if measured >= 1.0:
+            raise SystemExit("recovery gate: measured cluster ratio "
+                             "%.3f is not < 1.0" % measured)
+
+        # degraded reads: down one OSD, read every object through the
+        # reconstructing path, pull p99 from the mgr histogram series
+        down = acting[2]
+        c.stop_osd(down)
+        assert wait_until(lambda: not c.leader().osdmon.osdmap
+                          .is_up(down), timeout=30)
+        for i in range(n_objs):
+            for _ in range(4):
+                assert ioctx.read("rep-%d" % i) == payload
+        time.sleep(1.0)   # one mgr report period past the reads
+        p99 = 0.0
+        for daemon in mgr.metrics.daemons():
+            if not daemon.startswith("osd."):
+                continue
+            q = mgr.metrics.percentiles(daemon, "osd",
+                                        "l_osd_op_trace_us", (0.99,))
+            p99 = max(p99, q.get(0.99, 0.0))
+        doc["degraded_read_p99_ms"] = round(p99 / 1e3, 3)
+    finally:
+        c.stop()
+
+    doc["value"] = doc["traffic_ratio"]
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "RECOVERY_r01.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(doc))
+    return doc
+
+
 def main() -> None:
     import jax
 
@@ -1506,6 +1722,9 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
     if "--convergence" in sys.argv:
         run_convergence()
+        return
+    if "--recovery" in sys.argv:
+        run_recovery()
         return
     run_bench()
 
@@ -2098,6 +2317,9 @@ if __name__ == "__main__":
     elif "--convergence" in sys.argv:
         # cluster-convergence artifact: no device rows, no supervisor
         run_convergence()
+    elif "--recovery" in sys.argv:
+        # repair-bandwidth artifact: gates + cluster leg, no supervisor
+        run_recovery()
     elif "--worker" in sys.argv:
         main()
     else:

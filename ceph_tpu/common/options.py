@@ -151,6 +151,19 @@ def _declare_defaults():
       "byte-entropy (bits/byte) above which the fused probe "
       "declares the object incompressible and stores raw without "
       "attempting bit-plane compression")
+    # repair-bandwidth-optimal recovery (models/msr.py +
+    # osd/ec_backend.py, ROADMAP direction C)
+    o("osd_ec_repair_enable", bool, True, LEVEL_ADVANCED,
+      "rebuild single lost EC shards from beta-fraction helper reads "
+      "when the pool's codec is a regenerating code (plugin=msr): "
+      "each of d helpers computes and ships chunk/alpha bytes instead "
+      "of the primary pulling k whole chunks. Off = always the "
+      "classic full-survivor decode. The product-matrix MSR knobs are "
+      "derived from k, not free parameters: sub-packetization "
+      "alpha = k-1, repair degree d = 2(k-1) (needs m >= k-1 so d "
+      "helpers exist beside the target), per-helper fraction "
+      "beta = chunk/alpha — so a repair moves d*chunk/alpha = "
+      "2*chunk bytes vs k*chunk for a decode")
     o("osd_op_history_size", int, 20, LEVEL_ADVANCED,
       "completed ops kept for dump_historic_ops")
     o("osd_op_history_duration", float, 600.0, LEVEL_ADVANCED,

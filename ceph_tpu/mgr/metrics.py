@@ -340,6 +340,31 @@ class MetricsAggregator:
         return {"recovery_op_per_sec": round(ops, 2),
                 "recovery_MBps": round(byts / 1e6, 3)}
 
+    def repair_io(self, window: float | None = None,
+                  now: float | None = None) -> dict:
+        """Regenerating-code repair traffic (ROADMAP direction C):
+        rates of the three l_osd_repair_bytes_* lanes plus the
+        cumulative recovery-traffic ratio shipped/(shipped+saved) —
+        1.0 means every rebuild moved full survivor chunks; msr's
+        beta-fraction reads pull it toward d/(k*alpha)."""
+        now = time.monotonic() if now is None else now
+        out = {}
+        for lane in ("read", "shipped", "saved"):
+            byts = self.cluster_rate(
+                "osd", "l_osd_repair_bytes_" + lane, window, now)
+            out["repair_%s_MBps" % lane] = round(byts / 1e6, 3)
+        shipped = saved = 0
+        for d in self.daemons(now=now):
+            p = self.latest(d)
+            shipped += _counter_value(self._lookup(
+                p, "osd", "l_osd_repair_bytes_shipped")) or 0
+            saved += _counter_value(self._lookup(
+                p, "osd", "l_osd_repair_bytes_saved")) or 0
+        moved = shipped + saved
+        out["repair_traffic_ratio"] = \
+            round(shipped / moved, 4) if moved else 1.0
+        return out
+
     def osd_perf(self, window: float | None = None,
                  now: float | None = None) -> dict:
         """Per-OSD latency table (the `ceph osd perf` surface):

@@ -119,6 +119,13 @@ class PrometheusModule(MgrModule):
                  io["write_op_per_sec"])
             emit("ceph_cluster_read_MBps", io["read_MBps"])
             emit("ceph_cluster_write_MBps", io["write_MBps"])
+            # regenerating-code repair traffic (direction C): the
+            # cluster ratio gauge plus per-daemon counter totals below
+            rep = metrics.repair_io()
+            emit("ceph_osd_repair_traffic_ratio",
+                 rep["repair_traffic_ratio"],
+                 help_="cumulative repair bytes shipped / (shipped + "
+                       "saved): 1.0 = full-survivor decode traffic")
             for daemon in metrics.daemons():
                 lbl = {"ceph_daemon": daemon}
                 for ctr, name in (("op_r", "ceph_osd_op_r_rate"),
@@ -126,6 +133,13 @@ class PrometheusModule(MgrModule):
                     r = metrics.rate(daemon, "osd", ctr)
                     if daemon.startswith("osd."):
                         emit(name, r, lbl)
+                if daemon.startswith("osd."):
+                    perf = metrics.latest(daemon).get("osd", {})
+                    for lane in ("read", "shipped", "saved"):
+                        v = perf.get("l_osd_repair_bytes_" + lane)
+                        if v is not None:
+                            emit("ceph_osd_repair_%s_bytes" % lane,
+                                 v, lbl, mtype="counter")
                 # device-utilization gauges from the report's status
                 # bag: HBM residency, dispatch queue depth, rolling
                 # per-codec throughput with codec labels

@@ -1,2 +1,2 @@
 from .base import ErasureCode, ErasureCodeError  # noqa: F401
-from . import rs  # noqa: F401
+from . import msr, rs  # noqa: F401

@@ -24,7 +24,8 @@ from dataclasses import dataclass, field
 __all__ = [
     "Message", "MPing", "MPingReply", "MOSDOp", "MOSDOpReply",
     "MOSDECSubOpWrite", "MOSDECSubOpWriteReply", "MOSDECSubOpRead",
-    "MOSDECSubOpReadReply", "MOSDRepOp", "MOSDRepOpReply", "MOSDPGPush",
+    "MOSDECSubOpReadReply", "MOSDECSubOpRepairRead",
+    "MOSDECSubOpRepairReadReply", "MOSDRepOp", "MOSDRepOpReply", "MOSDPGPush",
     "MOSDPGPull", "MOSDPGScan", "MOSDPGQuery", "MOSDPGNotify",
     "MOSDPGLog", "MOSDMap", "MOSDBoot", "MOSDFailure",
     "MOSDAlive", "MWatchNotify", "MWatchNotifyAck",
@@ -163,6 +164,38 @@ class MOSDECSubOpReadReply(Message):
     buffers_read: dict = field(default_factory=dict)  # oid -> [(off, bytes)]
     attrs_read: dict = field(default_factory=dict)
     errors: dict = field(default_factory=dict)        # oid -> errno
+
+
+@dataclass
+class MOSDECSubOpRepairRead(Message):
+    """Primary -> helper: ship the beta-fraction repair symbols of one
+    object's shard for a regenerating-code rebuild (the sub-op variant
+    that carries fractions, not chunks — repair traffic is
+    chunk/alpha per helper instead of a full chunk)."""
+    pgid: object = None
+    shard: int = 0                 # helper shard asked for its fraction
+    from_osd: int = 0
+    tid: int = 0
+    oid: str = ""
+    target_shard: int = -1         # shard being rebuilt
+    chunk_len: int = 0             # full shard stream length expected
+    map_epoch: int = 0
+    trace_id: int = 0              # tracing envelope: the primary's
+    parent_span: int = 0           # per-helper repair-read span
+
+
+@dataclass
+class MOSDECSubOpRepairReadReply(Message):
+    """Helper -> primary: the computed fraction stream (or an errno
+    when the shard read/verify failed and the primary should
+    substitute another helper)."""
+    pgid: object = None
+    shard: int = 0
+    from_osd: int = 0
+    tid: int = 0
+    oid: str = ""
+    fraction: bytes = b""
+    error: int = 0
 
 
 # -- replicated sub-ops ------------------------------------------------

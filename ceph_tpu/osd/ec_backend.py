@@ -38,6 +38,8 @@ from ..common.interval_set import ExtentMap, IntervalSet
 from ..common.lockdep import make_rlock
 from ..common.tracer import NULL_SPAN, trace_ctx
 from ..msg.message import (MOSDECSubOpRead, MOSDECSubOpReadReply,
+                           MOSDECSubOpRepairRead,
+                           MOSDECSubOpRepairReadReply,
                            MOSDECSubOpWrite, MOSDECSubOpWriteReply)
 from ..store.object_store import Transaction
 from . import ec_transaction, ec_util
@@ -83,6 +85,24 @@ class _InflightRead:
         self.errors: dict = {}
 
 
+class _InflightRepair:
+    """One regenerating-code rebuild: d helper fraction reads in
+    flight, with helper substitution on error and an ordered fallback
+    to the full-survivor decode."""
+
+    def __init__(self, tid, oid, target_shard, chunk_total, on_done,
+                 fallback):
+        self.tid = tid
+        self.oid = oid
+        self.target_shard = target_shard
+        self.chunk_total = chunk_total
+        self.on_done = on_done
+        self.fallback = fallback      # () -> None: survivor decode
+        self.helpers: set = set()     # current helper set (d shards)
+        self.tried: set = set()       # every helper ever asked
+        self.fractions: dict = {}     # shard -> fraction bytes
+
+
 class ECBackend:
     def __init__(self, pg, codec, stripe_width: int):
         self.pg = pg                  # owning PG (listener interface)
@@ -97,6 +117,7 @@ class ECBackend:
         self.waiting_reads: list[_InflightWrite] = []
         self.waiting_commit: list[_InflightWrite] = []
         self.inflight_reads: dict = {}
+        self.inflight_repairs: dict = {}
         self.hinfo_cache: dict = {}
         import uuid
         self.instance = uuid.uuid4().hex  # incarnation nonce (dedup)
@@ -546,15 +567,45 @@ class ECBackend:
         if row is None:
             return None
         try:
-            # reconstruct() accounts the hit (or KeyError + miss)
-            rebuilt = np.asarray(tier.reconstruct(key, (row,)),
-                                 dtype=np.uint8)[0]
+            if getattr(self.codec, "alpha", 1) > 1:
+                # sub-symbol codec (msr): the resident rows are chunk
+                # STREAMS, but the codeword boundary is the per-stripe
+                # chunk — reshape to [S, n, chunk] and decode per
+                # stripe on device (tier.reconstruct's whole-stream
+                # rows are only valid for byte-linear codecs)
+                rebuilt = self._tier_reconstruct_striped(tier, key, row)
+            else:
+                # reconstruct() accounts the hit (or KeyError + miss)
+                rebuilt = np.asarray(tier.reconstruct(key, (row,)),
+                                     dtype=np.uint8)[0]
         except Exception:
             return None
         data = rebuilt.tobytes()
         if len(data) != chunk_total:
             return None   # stale shape (e.g. truncate raced): miss
         return data
+
+    def _tier_reconstruct_striped(self, tier, key, row: int):
+        """Stripe-aware resident rebuild for sub-symbol codecs: view
+        the resident [n, total] streams as [S, n, chunk] stripes and
+        decode_batch over them (still zero host reads of chunk data —
+        the reshape and decode run on the already-resident buffers)."""
+        import jax.numpy as jnp
+        full_dev = tier.get(key)      # counts the hit/miss itself
+        if full_dev is None:
+            raise KeyError(key)
+        total = int(full_dev.shape[1])
+        if total % self.sinfo.chunk_size:
+            raise ValueError("stream not chunk-aligned")
+        stripes = total // self.sinfo.chunk_size
+        arr = jnp.asarray(full_dev).reshape(
+            self.n, stripes, self.sinfo.chunk_size).transpose(1, 0, 2)
+        avail = tuple(r for r in range(self.n) if r != row)[:self.k]
+        survivors = jnp.take(arr, jnp.asarray(avail, dtype=jnp.int32),
+                             axis=1)
+        all_rows = self.codec.decode_batch(avail, survivors)
+        return np.ascontiguousarray(
+            np.asarray(all_rows, dtype=np.uint8)[:, row, :]).reshape(-1)
 
     def handle_sub_read(self, msg, local: bool = False) -> None:
         """Raw per-shard store read (:982-1012) — no decode here.
@@ -768,6 +819,20 @@ class ECBackend:
         if resident is not None:
             on_done(resident)
             return
+        # repair-bandwidth-optimal path (ROADMAP direction C): when the
+        # codec advertises fraction repair, helpers compute and ship
+        # only beta-fraction symbols (chunk/alpha bytes each) and the
+        # primary reconstructs on device — d*chunk/alpha total traffic
+        # instead of k*chunk. Fewer than d live helpers (or any combine
+        # failure) falls back to the full-survivor decode below.
+        if self._try_repair(oid, target_shard, chunk_total, on_done):
+            return
+        self._recover_survivors(oid, target_shard, chunk_total, on_done)
+
+    def _recover_survivors(self, oid, target_shard: int,
+                           chunk_total: int, on_done) -> None:
+        """Full-survivor recovery: read k whole chunk streams and
+        decode (the classic path; also the repair path's fallback)."""
         shards_avail = self.pg.acting_shards()
         stale = self.pg.osds_missing_object(oid)
         avail = {s for s, osd in shards_avail.items()
@@ -832,3 +897,191 @@ class ECBackend:
                 self.handle_sub_read(msg, local=True)
             else:
                 self.pg.send_to_osd(osd, msg)
+
+    # =================================================================
+    # regenerating-code repair (beta-fraction helper reads)
+    # =================================================================
+
+    def _count_repair(self, which: str, nbytes: int) -> None:
+        """l_osd_repair_bytes_* accounting (best-effort like
+        pg._count_push: harnesses run against daemon stubs without the
+        full counter set)."""
+        perf = getattr(self.pg.daemon, "perf", None)
+        if perf is None:
+            return
+        try:
+            perf.inc("l_osd_repair_bytes_%s" % which, nbytes)
+        except KeyError:
+            pass
+
+    def _repair_helpers_avail(self, oid, target_shard: int) -> tuple:
+        shards_avail = self.pg.acting_shards()
+        stale = self.pg.osds_missing_object(oid)
+        avail = {s for s, osd in shards_avail.items()
+                 if osd != CRUSH_ITEM_NONE and s != target_shard
+                 and osd not in stale}
+        return shards_avail, avail
+
+    def _try_repair(self, oid, target_shard: int, chunk_total: int,
+                    on_done) -> bool:
+        """Launch a beta-fraction repair when the codec supports it and
+        enough helpers are live. Returns False (caller degrades to the
+        full-survivor decode) otherwise."""
+        codec = self.codec
+        if not getattr(codec, "supports_repair", lambda: False)():
+            return False
+        try:
+            if not self.pg.daemon.ctx.conf.get_val(
+                    "osd_ec_repair_enable"):
+                return False
+        except (AttributeError, KeyError):
+            pass
+        if chunk_total % self.sinfo.chunk_size:
+            return False
+        shards_avail, avail = self._repair_helpers_avail(oid,
+                                                         target_shard)
+        try:
+            helpers = codec.minimum_to_repair(target_shard, avail)
+        except Exception:
+            return False   # fewer than d live helpers
+        tid = next(self._tids)
+        rep = _InflightRepair(
+            tid, oid, target_shard, chunk_total, on_done,
+            fallback=lambda: self._recover_survivors(
+                oid, target_shard, chunk_total, on_done))
+        rep.helpers = set(helpers)
+        rep.tried = set(helpers)
+        with self.lock:
+            self.inflight_repairs[tid] = rep
+        for shard in sorted(helpers):
+            self._send_repair_read(rep, shard, shards_avail)
+        return True
+
+    def _send_repair_read(self, rep, shard: int,
+                          shards_avail: dict) -> None:
+        msg = MOSDECSubOpRepairRead(
+            pgid=self.pg.pgid, shard=shard, from_osd=self.pg.whoami,
+            tid=rep.tid, oid=rep.oid, target_shard=rep.target_shard,
+            chunk_len=rep.chunk_total, map_epoch=self.pg.map_epoch())
+        osd = shards_avail.get(shard)
+        if osd == self.pg.whoami:
+            self.handle_repair_read(msg, local=True)
+        else:
+            self.pg.send_to_osd(osd, msg)
+
+    def handle_repair_read(self, msg, local: bool = False) -> None:
+        """Helper side: read own shard stream, verify its crc, project
+        it to the beta fraction ON THIS OSD's device, ship only that.
+        Any failure becomes an errno reply so the primary substitutes
+        another helper (repair bytes are counted only on success, so a
+        failed helper never inflates the traffic accounting)."""
+        reply = MOSDECSubOpRepairReadReply(
+            pgid=self.pg.pgid, shard=msg.shard,
+            from_osd=self.pg.whoami, tid=msg.tid, oid=msg.oid)
+        try:
+            data = self.pg.local_read_shard(msg.shard, msg.oid, 0,
+                                            msg.chunk_len)
+            if not self._shard_crc_ok(msg.oid, msg.shard, data):
+                raise OSError(5, "shard %d of %r failed crc"
+                              % (msg.shard, msg.oid))
+            if msg.chunk_len and len(data) < msg.chunk_len:
+                data = data + b"\0" * (msg.chunk_len - len(data))
+            reply.fraction = ec_util.repair_fraction(
+                self.sinfo, self.codec, msg.target_shard, data,
+                dispatcher=getattr(self.pg.daemon, "tpu_dispatcher",
+                                   None))
+            self._count_repair("read", len(data))
+            self._count_repair("shipped", len(reply.fraction))
+        except Exception as e:
+            reply.error = getattr(e, "errno", None) or 5
+            clog = getattr(self.pg.daemon, "clog", None)
+            if clog is not None:
+                clog.error("pg %s: repair fraction of shard %d of %r "
+                           "failed: %s" % (self.pg.pgid, msg.shard,
+                                           msg.oid, e))
+        if local:
+            self.handle_repair_read_reply(reply)
+        else:
+            self.pg.send_to_osd(msg.from_osd, reply)
+
+    def handle_repair_read_reply(self, msg) -> None:
+        """Primary side: collect fractions; on a helper error
+        substitute an untried helper (any d survivors work for the
+        product-matrix construction) or abandon to the full-survivor
+        decode; combine when all d fractions are in."""
+        fallback = None
+        resend = None
+        done = None
+        bad = False
+        with self.lock:
+            rep = self.inflight_repairs.get(msg.tid)
+            if rep is None:
+                return
+            if msg.error:
+                bad = msg.shard in rep.helpers
+                rep.helpers.discard(msg.shard)
+                rep.fractions.pop(msg.shard, None)
+                shards_avail, avail = self._repair_helpers_avail(
+                    rep.oid, rep.target_shard)
+                candidates = avail - rep.tried
+                if candidates:
+                    sub = min(candidates)
+                    rep.helpers.add(sub)
+                    rep.tried.add(sub)
+                    resend = (rep, sub, shards_avail)
+                else:
+                    self.inflight_repairs.pop(msg.tid, None)
+                    fallback = rep.fallback
+            else:
+                # accept only an awaited, not-yet-delivered fraction:
+                # a duplicate delivery must not double-collect
+                if msg.shard in rep.helpers and \
+                        msg.shard not in rep.fractions:
+                    rep.fractions[msg.shard] = msg.fraction
+                if set(rep.fractions) == rep.helpers and \
+                        len(rep.fractions) == \
+                        self.codec.repair_helper_count():
+                    self.inflight_repairs.pop(msg.tid, None)
+                    done = rep
+        if bad:
+            # same self-heal as the read path: the helper's shard
+            # failed its crc/read — rewrite it behind this rebuild
+            self.pg.daemon.perf.inc("read_err")
+            bad_osd = self.pg.acting_shards().get(msg.shard)
+            if bad_osd is not None and bad_osd != CRUSH_ITEM_NONE:
+                self.pg.repair_shard(msg.oid, msg.shard, bad_osd)
+        if fallback is not None:
+            fallback()
+            return
+        if resend is not None:
+            rep, sub, shards_avail = resend
+            self._send_repair_read(rep, sub, shards_avail)
+            return
+        if done is not None:
+            self._finish_repair(done)
+
+    def _finish_repair(self, rep) -> None:
+        """All d fractions in: combine on device — mesh psum path
+        first (parallel.mesh.repair_sharded), then the dispatcher/host
+        combine; any failure degrades to the full-survivor decode."""
+        out = None
+        try:
+            out = ec_util.repair_cross_chip(
+                self.sinfo, self.codec, rep.target_shard,
+                dict(rep.fractions))
+        except Exception:
+            out = None
+        if out is None:
+            try:
+                out = ec_util.repair_combine(
+                    self.sinfo, self.codec, rep.target_shard,
+                    dict(rep.fractions),
+                    dispatcher=getattr(self.pg.daemon,
+                                       "tpu_dispatcher", None))
+            except Exception:
+                rep.fallback()
+                return
+        shipped = sum(len(v) for v in rep.fractions.values())
+        self._count_repair(
+            "saved", max(0, self.k * rep.chunk_total - shipped))
+        rep.on_done(out)

@@ -26,7 +26,8 @@ import numpy as np
 from ..errors import ErasureCodeError
 
 __all__ = ["StripeInfo", "encode", "encode_fused", "decode",
-           "recover_cross_chip", "HashInfo"]
+           "recover_cross_chip", "repair_fraction", "repair_combine",
+           "repair_cross_chip", "HashInfo"]
 
 CHUNK_ALIGNMENT = 64
 
@@ -308,6 +309,13 @@ def recover_cross_chip(sinfo: StripeInfo, codec, to_decode: dict,
     if getattr(codec, "DECODE_BATCH_ANY", False) or \
             not hasattr(codec, "_decode_entry"):
         return None
+    if getattr(codec, "alpha", 1) > 1:
+        # sub-symbol codecs (msr): the decode bitmatrix acts on
+        # sub-symbol rows, not chunk rows, so the chunk-shaped
+        # recover_sharded program does not apply — their mesh leg is
+        # repair_cross_chip (beta-fraction combine), and full-survivor
+        # decode falls back to the dispatcher/host path
+        return None
     if mesh is None:
         try:
             import jax
@@ -346,6 +354,104 @@ def recover_cross_chip(sinfo: StripeInfo, codec, to_decode: dict,
     row = recover_sharded(codec, use, stacked, inv[target_shard],
                           mesh=mesh, expected_sum=expected_sum)
     return np.ascontiguousarray(row).reshape(-1).tobytes()
+
+
+def repair_fraction(sinfo: StripeInfo, codec, target_shard: int,
+                    chunk_stream, dispatcher=None, trace=None) -> bytes:
+    """Helper-side beta projection for regenerating repair: one
+    surviving shard's chunk stream -> the fraction stream it ships to
+    the primary rebuilding `target_shard` (chunk/alpha bytes per
+    chunk).  Batched across stripes in one device call; with a
+    dispatcher the projection rides the staged pipeline on the
+    helper's own pinned device."""
+    arr = np.frombuffer(chunk_stream, dtype=np.uint8) if isinstance(
+        chunk_stream, (bytes, bytearray, memoryview)) else \
+        np.asarray(chunk_stream, dtype=np.uint8).reshape(-1)
+    if arr.size == 0 or arr.size % sinfo.chunk_size != 0:
+        raise ErasureCodeError(
+            22, "chunk stream %d not chunk aligned (%d)"
+            % (arr.size, sinfo.chunk_size))
+    stripes = arr.size // sinfo.chunk_size
+    batch = arr.reshape(stripes, sinfo.chunk_size)
+    if dispatcher is not None:
+        frac = np.asarray(dispatcher.repair_fraction(
+            codec, target_shard, batch, trace=trace))
+    else:
+        frac = np.asarray(codec.repair_fraction_batch(
+            target_shard, batch))
+    return np.ascontiguousarray(frac).reshape(-1).tobytes()
+
+
+def _stack_fractions(sinfo: StripeInfo, codec, fractions: dict):
+    """{helper shard: fraction stream} -> (helpers tuple, [S, d, sub])."""
+    d = codec.repair_helper_count()
+    if len(fractions) != d:
+        raise ErasureCodeError(
+            5, "repair combine needs %d fractions, got %d"
+            % (d, len(fractions)))
+    helpers = tuple(sorted(fractions))
+    bufs = {
+        h: (np.frombuffer(v, dtype=np.uint8)
+            if isinstance(v, (bytes, bytearray, memoryview))
+            else np.asarray(v, dtype=np.uint8).reshape(-1))
+        for h, v in fractions.items()}
+    lengths = {v.size for v in bufs.values()}
+    if len(lengths) != 1:
+        raise ErasureCodeError(
+            22, "fractions have unequal lengths %s" % lengths)
+    total = lengths.pop()
+    sub = codec.repair_sub_size(sinfo.chunk_size)
+    if total == 0 or total % sub != 0:
+        raise ErasureCodeError(
+            22, "fraction stream %d not sub-symbol aligned (%d)"
+            % (total, sub))
+    stripes = total // sub
+    stacked = np.stack([bufs[h].reshape(stripes, sub)
+                        for h in helpers], axis=1)  # [S, d, sub]
+    return helpers, stacked
+
+
+def repair_combine(sinfo: StripeInfo, codec, target_shard: int,
+                   fractions: dict, dispatcher=None,
+                   trace=None) -> bytes:
+    """Primary-side combine: the d helper fraction streams -> the
+    rebuilt target shard's chunk stream (dispatcher/host path)."""
+    helpers, stacked = _stack_fractions(sinfo, codec, fractions)
+    if dispatcher is not None:
+        out = np.asarray(dispatcher.repair_combine(
+            codec, target_shard, helpers, stacked, trace=trace))
+    else:
+        out = np.asarray(codec.repair_combine_batch(
+            target_shard, helpers, stacked))
+    return np.ascontiguousarray(out).reshape(-1).tobytes()
+
+
+def repair_cross_chip(sinfo: StripeInfo, codec, target_shard: int,
+                      fractions: dict, mesh=None, expected_sum=None):
+    """Mesh-path repair combine (the repair analog of
+    recover_cross_chip): the stacked beta-fractions are sharded across
+    the local device mesh, psum-checksummed against their host sum,
+    and combined there (parallel.mesh.repair_sharded) — a rebuild
+    storm never gathers full survivors anywhere.
+
+    Returns the rebuilt shard's bytes, or None when the mesh path does
+    not apply (single device, codec without fraction repair) — the
+    caller falls back to repair_combine()."""
+    if not getattr(codec, "supports_repair", lambda: False)() or \
+            not hasattr(codec, "_combine_entry"):
+        return None
+    if mesh is None:
+        try:
+            import jax
+            if len(jax.devices()) < 2:
+                return None
+        except Exception:
+            return None
+    helpers, stacked = _stack_fractions(sinfo, codec, fractions)
+    from ..parallel.mesh import repair_sharded
+    out = repair_sharded(codec, target_shard, helpers, stacked,
+                         mesh=mesh, expected_sum=expected_sum)
+    return np.ascontiguousarray(out).reshape(-1).tobytes()
 
 
 def decode_concat(sinfo: StripeInfo, codec, to_decode: dict,

@@ -276,6 +276,21 @@ class OSDDaemon(Dispatcher):
                                       "completed")
                      .add_u64_counter("l_osd_backfill_bytes",
                                       "bytes pushed by backfill")
+                     # regenerating-code repair accounting (ROADMAP
+                     # direction C): helper-side bytes read from disk
+                     # and beta-fraction bytes shipped to the primary,
+                     # primary-side bytes of survivor traffic AVOIDED
+                     # vs a full k-chunk decode — the recovery-traffic
+                     # ratio gauge derives from shipped/(shipped+saved)
+                     .add_u64_counter("l_osd_repair_bytes_read",
+                                      "shard bytes read by repair "
+                                      "fraction requests (helper side)")
+                     .add_u64_counter("l_osd_repair_bytes_shipped",
+                                      "beta-fraction bytes shipped to "
+                                      "the rebuilding primary")
+                     .add_u64_counter("l_osd_repair_bytes_saved",
+                                      "survivor bytes NOT moved vs a "
+                                      "full k-chunk decode")
                      # span-derived per-phase op timing (the tracing
                      # spine's aggregate view; always on — a tinc is
                      # cheap even when span objects are not minted)
@@ -771,6 +786,7 @@ class OSDDaemon(Dispatcher):
             return True
         if t in ("MOSDECSubOpWrite", "MOSDECSubOpWriteReply",
                  "MOSDECSubOpRead", "MOSDECSubOpReadReply",
+                 "MOSDECSubOpRepairRead", "MOSDECSubOpRepairReadReply",
                  "MOSDRepOp", "MOSDRepOpReply", "MOSDPGScan",
                  "MOSDPGPush", "MOSDPGPull", "MOSDPGQuery",
                  "MOSDPGNotify", "MOSDPGLog", "MWatchNotifyAck"):
@@ -989,6 +1005,10 @@ class OSDDaemon(Dispatcher):
                 backend.handle_sub_read(msg)
             elif t == "MOSDECSubOpReadReply":
                 backend.handle_sub_read_reply(msg)
+            elif t == "MOSDECSubOpRepairRead":
+                backend.handle_repair_read(msg)
+            elif t == "MOSDECSubOpRepairReadReply":
+                backend.handle_repair_read_reply(msg)
             elif t == "MOSDRepOp":
                 backend.handle_rep_op(msg)
             elif t == "MOSDRepOpReply":
@@ -1008,10 +1028,13 @@ class OSDDaemon(Dispatcher):
             elif t == "MWatchNotifyAck":
                 pg.handle_notify_ack(msg)
 
-        # recovery data movement (push/pull/scan) must ride the recovery
-        # class or QoS settings have no effect on actual backfill traffic
+        # recovery data movement (push/pull/scan — and the regenerating
+        # repair fraction reads, which only exist to rebuild a shard)
+        # must ride the recovery class or QoS settings have no effect
+        # on actual backfill traffic
         if t in ("MOSDPGPush", "MOSDPGScan", "MOSDPGPull",
-                 "MOSDPGQuery", "MOSDPGNotify", "MOSDPGLog"):
+                 "MOSDPGQuery", "MOSDPGNotify", "MOSDPGLog",
+                 "MOSDECSubOpRepairRead", "MOSDECSubOpRepairReadReply"):
             self.op_wq.queue(msg.pgid, run, klass="recovery",
                              priority=self.recovery_op_priority)
         else:

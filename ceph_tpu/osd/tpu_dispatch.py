@@ -508,6 +508,81 @@ class TpuDispatcher:
             key, lambda stacked: codec.decode_batch(avail_rows, stacked),
             chunks, trace, kind="dec", prefetch=prefetch)
 
+    def _stage_entry(self, entry: dict) -> None:
+        """Stage a TableCache entry's bitmatrix onto this dispatcher's
+        home device (same per-device keying as the decode prefetch)."""
+        if not (self._jax and isinstance(entry, dict)
+                and "bitmat" in entry):
+            return
+        from ..models.table_cache import device_entry_key
+        devkey = device_entry_key(self.device)
+        if devkey not in entry:
+            import jax
+            import jax.numpy as jnp
+            bm = jnp.asarray(entry["bitmat"])
+            if self.device is not None:
+                bm = jax.device_put(bm, self.device)
+            entry.setdefault(devkey, bm)
+
+    def repair_fraction_async(self, codec, target: int,
+                              chunks: np.ndarray,
+                              trace=NULL_SPAN) -> _Pending:
+        """Async codec.repair_fraction_batch: the helper-side beta
+        projection of [B, chunk] survivor streams into [B, chunk/alpha]
+        repair fractions for rebuilding `target`. The [1, alpha]
+        projection matrix is pre-staged like a decode table; repair
+        work accounts as decode-direction codec traffic."""
+        key = (self._codec_key(codec), "rfrac", target,
+               chunks.shape[1:], str(chunks.dtype))
+        self._account_codec(codec, "dec",
+                            getattr(chunks, "nbytes", 0))
+        prefetch = None
+        entry_fn = getattr(codec, "_fraction_entry", None)
+        if entry_fn is not None:
+            def prefetch(target=target, entry_fn=entry_fn):
+                self._stage_entry(entry_fn(target))
+        return self._submit_async(
+            key,
+            lambda stacked: codec.repair_fraction_batch(target, stacked),
+            chunks, trace, kind="dec", prefetch=prefetch)
+
+    def repair_combine_async(self, codec, target: int, helpers: tuple,
+                             fractions: np.ndarray,
+                             trace=NULL_SPAN) -> _Pending:
+        """Async codec.repair_combine_batch: [B, d, sub] stacked helper
+        fractions (rows in `helpers` order) -> rebuilt [B, chunk]
+        target chunks, with the per-(target, helper-set) combine matrix
+        pre-staged in the h2d stage."""
+        helpers = tuple(helpers)
+        key = (self._codec_key(codec), "rcomb", target, helpers,
+               fractions.shape[1:], str(fractions.dtype))
+        self._account_codec(codec, "dec",
+                            getattr(fractions, "nbytes", 0))
+        prefetch = None
+        entry_fn = getattr(codec, "_combine_entry", None)
+        if entry_fn is not None:
+            def prefetch(target=target, helpers=helpers,
+                         entry_fn=entry_fn):
+                self._stage_entry(entry_fn(target, helpers))
+        return self._submit_async(
+            key,
+            lambda stacked: codec.repair_combine_batch(
+                target, helpers, stacked),
+            fractions, trace, kind="dec", prefetch=prefetch)
+
+    def repair_fraction(self, codec, target: int, chunks: np.ndarray,
+                        trace=NULL_SPAN) -> np.ndarray:
+        """Blocking facade over repair_fraction_async."""
+        return self.repair_fraction_async(codec, target, chunks,
+                                          trace).result()
+
+    def repair_combine(self, codec, target: int, helpers: tuple,
+                       fractions: np.ndarray,
+                       trace=NULL_SPAN) -> np.ndarray:
+        """Blocking facade over repair_combine_async."""
+        return self.repair_combine_async(codec, target, helpers,
+                                         fractions, trace).result()
+
     def encode(self, codec, batch: np.ndarray, trace=NULL_SPAN,
                resident=None) -> np.ndarray:
         """codec.encode_batch(batch), coalesced across submitters —

@@ -155,3 +155,67 @@ def recover_sharded(codec, avail_rows, chunks, target_row, mesh=None,
     full = decode_sharded(codec, avail_rows, dev, mesh)
     out = np.asarray(full)[:s, target_row, :n]
     return np.ascontiguousarray(out).astype(np.uint8)
+
+
+def repair_sharded(codec, target, helpers, fractions, mesh=None,
+                   expected_sum=None):
+    """Mesh combine of MSR helper repair fractions (the repair analog
+    of recover_sharded): [S, d, sub] stacked beta-fractions (rows in
+    `helpers` order) -> rebuilt target chunks [S, d*sub/2] WITHOUT
+    gathering full survivors anywhere.
+
+    Same trust boundary as recover_sharded: a psum checksum of the
+    device-resident fractions is compared against `expected_sum` (host
+    modular uint32 sum, computed here when not supplied) before the
+    combine matrix is applied sharded over (stripe, block). Raises
+    MeshChecksumError on mismatch. Combine is linear per byte column,
+    so zero-padded stripes/columns are trimmed after.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if mesh is None:
+        mesh = make_mesh()
+    fractions = np.asarray(fractions, dtype=np.uint8)
+    if expected_sum is None:
+        expected_sum = int(fractions.astype(np.uint64).sum()) % (1 << 32)
+    stripe, block = mesh.axis_names
+    s_ax = mesh.shape[stripe]
+    b_ax = mesh.shape[block]
+    s, _d, sub = fractions.shape
+    padded = np.pad(fractions, ((0, (-s) % s_ax), (0, 0),
+                                (0, (-sub) % b_ax)))
+    sharding = NamedSharding(mesh, P(stripe, None, block))
+    dev = jax.device_put(jnp.asarray(padded), sharding)
+
+    def _partial(x):
+        return jax.lax.psum(jnp.sum(x.astype(jnp.uint32)),
+                            (stripe, block))
+
+    total = shard_map(_partial, mesh=mesh,
+                      in_specs=P(stripe, None, block),
+                      out_specs=P())(dev)
+    got = int(np.asarray(total)) % (1 << 32)
+    if got != expected_sum % (1 << 32):
+        raise MeshChecksumError(
+            "mesh repair checksum mismatch: device psum %d != "
+            "host sum %d" % (got, expected_sum % (1 << 32)))
+
+    from ..ops import xor_mm
+    entry = codec._combine_entry(target, tuple(helpers))
+    bitmat = jnp.asarray(entry["bitmat"])
+    out_sharding = NamedSharding(mesh, P(stripe, None, block))
+
+    @jax.jit
+    def step(bm, x):
+        x = jax.lax.with_sharding_constraint(x, sharding)
+        rebuilt = xor_mm.matrix_encode(bm, x, codec.w)
+        return jax.lax.with_sharding_constraint(rebuilt, out_sharding)
+
+    from ..common.profiler import PROFILER
+    step = PROFILER.wrap_jit("mesh.repair_sharded", step)
+    full = np.asarray(step(bitmat, dev))
+    out = full[:s, :, :sub].reshape(s, -1)
+    return np.ascontiguousarray(out).astype(np.uint8)

@@ -102,6 +102,13 @@ def _isa_techniques():
     }
 
 
+def _msr_techniques():
+    from .models import msr
+    return {
+        "msr": msr.MsrProductMatrix,
+    }
+
+
 def _shec_techniques():
     from .models import shec
     return {
@@ -120,6 +127,10 @@ _BUILTIN_LOADERS = {
                                      default_technique="multiple"),
     "shec_tpu": lambda: _TechniquePlugin(_shec_techniques(), "jax",
                                          default_technique="multiple"),
+    "msr": lambda: _TechniquePlugin(_msr_techniques(), "numpy",
+                                    default_technique="msr"),
+    "msr_tpu": lambda: _TechniquePlugin(_msr_techniques(), "jax",
+                                        default_technique="msr"),
     "lrc": lambda: _LrcPlugin("numpy"),
     "lrc_tpu": lambda: _LrcPlugin("jax"),
     "example": lambda: _ExamplePlugin(),

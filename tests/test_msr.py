@@ -1,0 +1,411 @@
+"""Product-matrix MSR regenerating codec (repair-bandwidth-optimal
+recovery, ROADMAP direction C).
+
+Three layers under test: the codec construction itself (systematic
+roundtrip, beta-fraction repair bit-identical to the host oracle, the
+jax/numpy backend parity), the dispatcher/mesh repair legs, and the
+cluster repair path (helper fractions over sub-ops, fallback ordering,
+the no-double-count accounting contract).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from ceph_tpu import registry
+from ceph_tpu.models.base import ErasureCodeError
+from ceph_tpu.osd import ec_util
+from .cluster_util import MiniCluster, wait_until
+
+K, M = 4, 3          # alpha = 3, d = 6, n = 7
+FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+        "mon_osd_down_out_interval": 1.0,
+        "paxos_propose_interval": 0.02,
+        # the cluster tests target the HELPER-FRACTION rebuild, not
+        # the resident fast path
+        "osd_hbm_tier_enable": False}
+
+
+def _profile(k=K, m=M):
+    return {"technique": "msr", "k": str(k), "m": str(m), "w": "8"}
+
+
+@pytest.fixture(scope="module")
+def codec():
+    return registry.factory("msr_tpu", _profile())
+
+
+@pytest.fixture(scope="module")
+def host_codec():
+    return registry.factory("msr", _profile())
+
+
+def _stripes(codec, n=None, seed=3, stripes=4):
+    n = n or codec.get_chunk_size(1 << 16)
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, size=(stripes, codec.k, n),
+                        dtype=np.uint8)
+    parity = np.asarray(codec.encode_batch(data), dtype=np.uint8)
+    rows = {codec.chunk_index(i): data[:, i] for i in range(codec.k)}
+    rows.update({codec.chunk_index(codec.k + j): parity[:, j]
+                 for j in range(codec.m)})
+    return data, rows
+
+
+class TestCodec:
+    def test_registry_and_geometry(self, codec):
+        assert codec.technique == "msr"
+        assert codec.alpha == K - 1
+        assert codec.d == 2 * (K - 1)
+        assert codec.supports_repair()
+        assert codec.repair_fraction() == pytest.approx(1 / (K - 1))
+        assert codec.repair_helper_count() == codec.d
+        # alignment guarantees every chunk splits into alpha sub-rows
+        assert codec.get_chunk_size(1 << 16) % codec.alpha == 0
+
+    def test_profile_validation(self):
+        with pytest.raises(ErasureCodeError):
+            registry.factory("msr", {"technique": "msr", "k": "4",
+                                     "m": "2", "w": "8"})  # m < k-1
+        with pytest.raises(ErasureCodeError):
+            registry.factory("msr", {"technique": "msr", "k": "2",
+                                     "m": "2", "w": "8"})  # k < 3
+        with pytest.raises(ErasureCodeError):
+            registry.factory("msr", {"technique": "msr", "k": "4",
+                                     "m": "3", "w": "16"})  # w != 8
+
+    def test_decode_roundtrip_any_k_survivors(self, codec):
+        import itertools
+        data, rows = _stripes(codec)
+        n = codec.get_chunk_count()
+        logical = {i: rows[codec.chunk_index(i)] for i in range(n)}
+        for avail in itertools.islice(
+                itertools.combinations(range(n), codec.k), 6):
+            chunks = np.stack([logical[i] for i in avail], axis=1)
+            out = np.asarray(codec.decode_batch(avail, chunks),
+                             dtype=np.uint8)
+            for i in range(n):
+                assert np.array_equal(out[:, i], logical[i]), \
+                    (avail, i)
+
+    def test_repair_bit_identical_to_oracle(self, codec):
+        data, rows = _stripes(codec)
+        # one data target and one parity target
+        for target in (codec.chunk_index(1),
+                       codec.chunk_index(codec.k + 1)):
+            helpers = tuple(sorted(codec.minimum_to_repair(
+                target, set(rows) - {target})))
+            assert len(helpers) == codec.d
+            fracs = np.stack(
+                [np.asarray(codec.repair_fraction_batch(
+                    target, rows[h]), dtype=np.uint8)
+                 for h in helpers], axis=1)
+            # each fraction is 1/alpha of the chunk
+            assert fracs.shape[2] * codec.alpha == rows[target].shape[1]
+            rebuilt = np.asarray(codec.repair_combine_batch(
+                target, helpers, fracs), dtype=np.uint8)
+            assert np.array_equal(rebuilt, rows[target])
+            for s in range(data.shape[0]):
+                oracle = codec.repair_oracle(
+                    target, helpers, {h: rows[h][s] for h in helpers})
+                assert np.array_equal(rebuilt[s], oracle)
+
+    def test_jax_numpy_backend_parity(self, codec, host_codec):
+        data, rows = _stripes(codec)
+        target = codec.chunk_index(0)
+        helpers = tuple(sorted(codec.minimum_to_repair(
+            target, set(rows) - {target})))
+        for h in helpers[:2]:
+            a = np.asarray(codec.repair_fraction_batch(target, rows[h]))
+            b = np.asarray(host_codec.repair_fraction_batch(
+                target, rows[h]))
+            assert np.array_equal(a, b)
+
+    def test_minimum_to_repair_needs_d(self, codec):
+        avail = set(range(codec.d))      # d shards, one is the target
+        with pytest.raises(ErasureCodeError):
+            codec.minimum_to_repair(0, avail)
+        avail.add(codec.d)
+        assert len(codec.minimum_to_repair(0, avail)) == codec.d
+
+    def test_traffic_is_below_full_decode(self, codec):
+        chunk = codec.get_chunk_size(1 << 16)
+        moved = codec.d * codec.repair_sub_size(chunk)
+        assert moved < codec.k * chunk
+
+
+class TestRepairLegs:
+    def test_dispatcher_repair_matches_host(self, codec):
+        from ceph_tpu.osd.tpu_dispatch import TpuDispatcher
+        data, rows = _stripes(codec)
+        target = codec.chunk_index(2)
+        helpers = tuple(sorted(codec.minimum_to_repair(
+            target, set(rows) - {target})))
+        disp = TpuDispatcher(max_delay=0.002)
+        try:
+            fracs = np.stack(
+                [np.asarray(disp.repair_fraction(codec, target,
+                                                 rows[h]))
+                 for h in helpers], axis=1)
+            rebuilt = np.asarray(disp.repair_combine(
+                codec, target, helpers, fracs))
+        finally:
+            disp.shutdown()
+        assert np.array_equal(rebuilt, rows[target])
+
+    def test_mesh_repair_sharded_and_checksum(self, codec):
+        from ceph_tpu.parallel.mesh import MeshChecksumError, \
+            make_mesh, repair_sharded
+        data, rows = _stripes(codec, stripes=8)
+        target = codec.chunk_index(1)
+        helpers = tuple(sorted(codec.minimum_to_repair(
+            target, set(rows) - {target})))
+        fracs = np.stack(
+            [np.asarray(codec.repair_fraction_batch(target, rows[h]),
+                        dtype=np.uint8) for h in helpers], axis=1)
+        m = make_mesh(8)
+        out = repair_sharded(codec, target, helpers, fracs, mesh=m)
+        assert np.array_equal(
+            out, rows[target].reshape(rows[target].shape[0], -1))
+        expected = int(fracs.astype(np.uint64).sum()) % (1 << 32)
+        fracs[2, 1, 5] ^= 0xFF
+        with pytest.raises(MeshChecksumError):
+            repair_sharded(codec, target, helpers, fracs, mesh=m,
+                           expected_sum=expected)
+
+    def test_ec_util_repair_roundtrip(self, codec):
+        sinfo = ec_util.StripeInfo(codec.get_data_chunk_count(),
+                                   codec.get_chunk_size(1 << 16) *
+                                   codec.get_data_chunk_count())
+        data, rows = _stripes(codec, n=sinfo.chunk_size)
+        target = codec.chunk_index(0)
+        helpers = tuple(sorted(codec.minimum_to_repair(
+            target, set(rows) - {target})))
+        fractions = {
+            h: ec_util.repair_fraction(
+                sinfo, codec, target, rows[h].reshape(-1).tobytes())
+            for h in helpers}
+        sub = codec.repair_sub_size(sinfo.chunk_size)
+        assert all(len(v) == data.shape[0] * sub
+                   for v in fractions.values())
+        out = ec_util.repair_combine(sinfo, codec, target, fractions)
+        assert out == rows[target].reshape(-1).tobytes()
+        mesh_out = ec_util.repair_cross_chip(sinfo, codec, target,
+                                             fractions)
+        assert mesh_out == out
+
+    def test_recover_cross_chip_gated_for_sub_symbol_codecs(self,
+                                                            codec):
+        # whole-chunk mesh decode reshapes chunk rows; for alpha > 1
+        # that would shred the sub-symbol layout — must decline
+        sinfo = ec_util.StripeInfo(codec.get_data_chunk_count(),
+                                   codec.get_chunk_size(1 << 16) *
+                                   codec.get_data_chunk_count())
+        data, rows = _stripes(codec, n=sinfo.chunk_size)
+        shard_data = {codec.chunk_index(i):
+                      rows[codec.chunk_index(i)].reshape(-1).tobytes()
+                      for i in range(codec.k)}
+        assert ec_util.recover_cross_chip(
+            sinfo, codec, shard_data, codec.chunk_index(codec.k)) \
+            is None
+
+
+def _ec_target(cluster, client, pool_name, oid):
+    m = client.osdmap
+    pool_id = client.pool_id(pool_name)
+    pgid = m.pools[pool_id].raw_pg_to_pg(m.object_to_pg(pool_id, oid))
+    _, _, acting, primary = m.pg_to_up_acting_osds(pgid)
+    return pgid, acting, primary
+
+
+def _repair_counters(cluster):
+    out = {"read": 0, "shipped": 0, "saved": 0}
+    for osd in cluster.osds.values():
+        for lane in out:
+            out[lane] += osd.perf.get("l_osd_repair_bytes_" + lane)
+    return out
+
+
+def _recover(pg, oid, shard, timeout=30.0):
+    done = threading.Event()
+    got: list = [None]
+
+    def on_done(data):
+        got[0] = data
+        done.set()
+
+    pg.backend.recover_object(oid, shard, on_done)
+    assert done.wait(timeout), "recover_object never completed"
+    return got[0]
+
+
+class TestClusterRepair:
+    def test_beta_fraction_repair_heals_bitrot(self):
+        """The full loop at cluster level: bit-rot one shard, scrub
+        repair rebuilds it through d helper fractions, the counters
+        show fraction traffic (shipped = read/alpha, saved > 0)."""
+        cluster = MiniCluster(num_mons=1, num_osds=5,
+                              conf_overrides=FAST).start()
+        try:
+            client = cluster.client()
+            cluster.create_ec_pool(client, "msrheal",
+                                   {"plugin": "msr", "technique": "msr",
+                                    "k": "3", "m": "2"}, pg_num=4)
+            ioctx = client.open_ioctx("msrheal")
+            payload = bytes(np.random.default_rng(5).integers(
+                0, 256, 40000, dtype=np.uint8))
+            ioctx.write_full("mobj", payload)
+            pgid, acting, primary = _ec_target(cluster, client,
+                                               "msrheal", "mobj")
+            victim = cluster.osds[acting[1]]
+            cid = ("pg", str(pgid), 1)
+            good = victim.store.read(cid, "mobj")
+            victim.store.faults.mark_bitrot(cid, "mobj")
+
+            osd = cluster.osds[primary]
+            pg = osd.pgs[pgid]
+            assert osd.scrub_pg(pgid, deep=True, repair=True)
+            assert wait_until(
+                lambda: pg.scrub_stats.get("state") == "clean"
+                and pg.scrub_stats.get("repaired", 0) >= 1, 30), \
+                pg.scrub_stats
+            assert wait_until(
+                lambda: victim.store.read(cid, "mobj") == good, 15)
+            assert ioctx.read("mobj") == payload
+
+            ctr = _repair_counters(cluster)
+            alpha = 2                      # k=3
+            assert ctr["shipped"] > 0
+            assert ctr["read"] == ctr["shipped"] * alpha
+            assert ctr["saved"] > 0
+        finally:
+            cluster.stop()
+
+    def test_helper_eio_substitutes_without_double_count(self):
+        """A helper whose store EIOs mid-repair is replaced by an
+        untried survivor; repair bytes are counted once per SUCCESSFUL
+        fraction only — the failed helper inflates nothing."""
+        cluster = MiniCluster(num_mons=1, num_osds=6,
+                              conf_overrides=FAST).start()
+        try:
+            client = cluster.client()
+            # k=3, m=3: n=6, d=4, and 5 survivors leave one spare
+            cluster.create_ec_pool(client, "msreio",
+                                   {"plugin": "msr", "technique": "msr",
+                                    "k": "3", "m": "3"}, pg_num=4)
+            ioctx = client.open_ioctx("msreio")
+            payload = bytes(np.random.default_rng(6).integers(
+                0, 256, 30000, dtype=np.uint8))
+            ioctx.write_full("eobj", payload)
+            pgid, acting, primary = _ec_target(cluster, client,
+                                               "msreio", "eobj")
+            target_shard = 5               # rebuild the last shard
+            # EIO the LOWEST survivor shard: minimum_to_repair picks
+            # the d lowest, so this helper is guaranteed to be asked
+            bad_shard = 0
+            bad = cluster.osds[acting[bad_shard]]
+            bad_cid = ("pg", str(pgid), bad_shard)
+            bad.store.faults.mark_eio(bad_cid, "eobj")
+
+            osd = cluster.osds[primary]
+            pg = osd.pgs[pgid]
+            good = cluster.osds[acting[target_shard]].store.read(
+                ("pg", str(pgid), target_shard), "eobj")
+            before = _repair_counters(cluster)
+            out = _recover(pg, "eobj", target_shard)
+            assert out == good, "substituted repair diverged"
+
+            # the reply-path self-heal rewrites the EIO'd shard
+            # asynchronously (pg.repair_shard); wait for the repair
+            # machinery to go quiet before auditing the counters
+            assert wait_until(
+                lambda: all(not o.pgs[pgid].backend.inflight_repairs
+                            for o in cluster.osds.values()
+                            if pgid in o.pgs), 20)
+            ctr = _repair_counters(cluster)
+            d, alpha = 4, 2
+            chunk_total = len(good)
+            sub = chunk_total // alpha
+            reads = (ctr["read"] - before["read"]) // chunk_total
+            ships = (ctr["shipped"] - before["shipped"]) // sub
+            # every successful fraction counted EXACTLY once in both
+            # lanes (the EIO'd helper contributed zero), and at least
+            # one full d-helper round completed
+            assert reads == ships >= d, ctr
+            assert (ctr["read"] - before["read"]) % chunk_total == 0
+            assert (ctr["shipped"] - before["shipped"]) % sub == 0
+        finally:
+            cluster.stop()
+
+    def test_fewer_than_d_helpers_falls_back_to_survivor_decode(self):
+        """k=3, m=2: n=5 and d=4, so losing TWO OSDs leaves only 3
+        survivors — below the repair degree (losing one leaves exactly
+        d, which repair handles). recover_object must degrade to the
+        classic full-survivor decode (shipping no fractions) yet still
+        rebuild the lost shard exactly."""
+        cluster = MiniCluster(num_mons=1, num_osds=5,
+                              conf_overrides=FAST).start()
+        try:
+            client = cluster.client()
+            cluster.create_ec_pool(client, "msrfall",
+                                   {"plugin": "msr", "technique": "msr",
+                                    "k": "3", "m": "2"}, pg_num=4)
+            ioctx = client.open_ioctx("msrfall")
+            payload = bytes(np.random.default_rng(8).integers(
+                0, 256, 30000, dtype=np.uint8))
+            ioctx.write_full("fobj", payload)
+            pgid, acting, primary = _ec_target(cluster, client,
+                                               "msrfall", "fobj")
+            down = [s for s in range(5) if acting[s] != primary][:2]
+            down_osds = [acting[s] for s in down]
+            target_shard = down[0]
+            good = cluster.osds[down_osds[0]].store.read(
+                ("pg", str(pgid), target_shard), "fobj")
+            before = _repair_counters(cluster)
+            for o in down_osds:
+                cluster.stop_osd(o)
+            assert wait_until(
+                lambda: all(not cluster.leader().osdmon.osdmap
+                            .is_up(o) for o in down_osds), 30)
+            osd = cluster.osds[primary]
+
+            def peered():
+                pg = osd.pgs.get(pgid)
+                return pg is not None and not (
+                    set(down_osds) &
+                    set(pg.acting_shards().values()))
+            assert wait_until(peered, 30)
+            pg = osd.pgs[pgid]
+            out = _recover(pg, "fobj", target_shard)
+            assert out == good, "survivor-decode fallback diverged"
+            ctr = _repair_counters(cluster)
+            assert ctr["shipped"] == before["shipped"], \
+                "fractions shipped despite < d live helpers"
+        finally:
+            cluster.stop()
+
+    def test_repair_messages_roundtrip_encoding(self):
+        """The new repair sub-op envelopes survive the wire codec (the
+        corpus keeps the frozen bytes; this guards live roundtrip
+        including payload fields)."""
+        from ceph_tpu import encoding
+        from ceph_tpu.msg.message import (MOSDECSubOpRepairRead,
+                                          MOSDECSubOpRepairReadReply)
+        from ceph_tpu.osd.osd_map import PGID
+        req = MOSDECSubOpRepairRead(
+            pgid=PGID(3, 7), shard=2, from_osd=4, tid=99, oid="obj-x",
+            target_shard=5, chunk_len=12288, map_epoch=11,
+            trace_id=123, parent_span=7)
+        blob = encoding.encode_any(req)
+        back = encoding.decode_any(blob)
+        assert back.pgid == req.pgid and back.shard == 2
+        assert back.target_shard == 5 and back.chunk_len == 12288
+        rep = MOSDECSubOpRepairReadReply(
+            pgid=PGID(3, 7), shard=2, from_osd=1, tid=99, oid="obj-x",
+            fraction=b"\x01\x02\x03\x04", error=0)
+        back = encoding.decode_any(encoding.encode_any(rep))
+        assert back.fraction == b"\x01\x02\x03\x04"
+        assert back.error == 0
