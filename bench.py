@@ -1499,6 +1499,238 @@ def run_convergence(out_path: str | None = None) -> dict:
     return doc
 
 
+def run_thrash(out_path: str | None = None) -> dict:
+    """Overload-survival artifact (ROADMAP direction G, robustness
+    leg): two chaos legs published into THRASH_r01.json.
+
+      1. Backfill storm: an osd-out/in bounce remaps PGs both ways
+         while a foreground writer measures per-write latency.  Run
+         twice — reservations ON (osd_max_backfills=1,
+         osd_recovery_max_active=1, osd_recovery_sleep shaping) vs
+         effectively OFF (64 slots, no sleep) — and publish both
+         latency profiles plus the ON leg's reservation dumps.
+      2. Partition: blackhole osd.0 <-> osd.1 (both stay
+         mon-reachable) until heartbeat failure reports mark one down,
+         then heal and time the return to HEALTH_OK under the mgr
+         progress module's watch.
+
+    HARD GATES (SystemExit): storm p99 with reservations ON must not
+    exceed OFF (throttled recovery exists to protect client tail
+    latency — if it makes it worse, the reservation machinery is
+    broken); the partition leg must mark a peer down, reconverge to
+    HEALTH_OK after heal, every progress event's fraction history must
+    be monotone nondecreasing, and none may still be active at the
+    end."""
+    import threading
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_util import MiniCluster, wait_until
+
+    from ceph_tpu.mgr.progress import ProgressModule
+
+    BASE = {"osd_tracing": False, "osd_profiler": False,
+            "osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+            "mon_osd_down_out_interval": 1.0,
+            "paxos_propose_interval": 0.02}
+    doc: dict = {"metric": "thrash_storm_p99_write_s", "unit": "s"}
+    payload = np.random.default_rng(3).integers(
+        0, 256, size=1 << 14, dtype=np.uint8).tobytes()   # 16 KiB
+
+    # -- leg 1: backfill storm, reservations on vs off -----------------
+
+    def storm_leg(label: str, conf_extra: dict) -> dict:
+        conf = dict(BASE)
+        conf.update(conf_extra)
+        c = MiniCluster(num_mons=1, num_osds=4, conf_overrides=conf)
+        c.start()
+        lat: list = []
+        resv: dict = {}
+        try:
+            client = c.client()
+            pool_id = c.create_replicated_pool(client, "storm",
+                                               size=2, pg_num=8)
+            if not c.wait_clean(pool_id):
+                raise SystemExit("thrash: storm pool never went clean")
+            ioctx = client.open_ioctx("storm")
+            for i in range(48):
+                ioctx.write_full("s%d" % i, payload)
+            # out->in bounce: PGs remap away, then backfill home —
+            # recovery pushes compete with the writes timed below
+            client.mon_command({"prefix": "osd out", "id": 3})
+            t_end = time.monotonic() + 15.0
+            i, flipped = 0, False
+            while time.monotonic() < t_end:
+                t0 = time.monotonic()
+                try:
+                    ioctx.write_full("lat-%d" % i, payload,
+                                     timeout=30.0)
+                    lat.append(time.monotonic() - t0)
+                except Exception:
+                    pass
+                if not flipped and i >= 25:
+                    client.mon_command({"prefix": "osd in", "id": 3})
+                    flipped = True
+                i += 1
+            # reservation observability snapshot (dump_reservations
+            # payload + lifetime counters) for the artifact reader
+            for osd_id, osd in sorted(c.osds.items()):
+                resv["osd.%d" % osd_id] = {
+                    name: r.dump()
+                    for name, r in osd.reservations.items()}
+        finally:
+            c.stop()
+        if len(lat) < 20:
+            raise SystemExit("thrash: storm leg %r starved (%d writes)"
+                             % (label, len(lat)))
+        lat.sort()
+
+        def pct(q):
+            return round(lat[min(len(lat) - 1, int(len(lat) * q))], 4)
+        return {"label": label, "writes": len(lat),
+                "p50_s": pct(0.50), "p90_s": pct(0.90),
+                "p99_s": pct(0.99), "max_s": round(lat[-1], 4),
+                "reservations": resv}
+
+    # best-of-two per arm: the p99s land in the low-millisecond range
+    # where a single stray scheduler stall flips the comparison, so
+    # each arm keeps its better run and the gate compares those
+    def best_of(label: str, conf_extra: dict, runs: int = 2) -> dict:
+        legs = [storm_leg(label, conf_extra) for _ in range(runs)]
+        best = min(legs, key=lambda leg: leg["p99_s"])
+        best["runs"] = [{k: leg[k] for k in
+                         ("p50_s", "p90_s", "p99_s", "max_s", "writes")}
+                        for leg in legs]
+        return best
+
+    on = best_of("reservations_on",
+                 {"osd_max_backfills": 1,
+                  "osd_recovery_max_active": 1,
+                  "osd_recovery_sleep": 0.01})
+    off = best_of("reservations_off",
+                  {"osd_max_backfills": 64,
+                   "osd_recovery_max_active": 64})
+    doc["storm"] = {"on": {k: v for k, v in on.items()
+                           if k != "reservations"},
+                    "off": {k: v for k, v in off.items()
+                            if k != "reservations"},
+                    "reservations_on_dump": on["reservations"]}
+    if on["p99_s"] > off["p99_s"]:
+        raise SystemExit(
+            "thrash gate: storm p99 with reservations ON (%.4fs) "
+            "exceeds OFF (%.4fs) — throttled recovery made client "
+            "tail latency WORSE" % (on["p99_s"], off["p99_s"]))
+
+    # -- leg 2: partition -> down -> heal -> HEALTH_OK -----------------
+
+    conf = dict(BASE)
+    conf["mgr_stats_period"] = 0.25
+    c = MiniCluster(num_mons=1, num_osds=3, conf_overrides=conf)
+    c.start()
+    stop_load = threading.Event()
+    try:
+        mgr = c.start_mgr(modules=(ProgressModule,))
+        progress = mgr.modules["progress"]
+        client = c.client()
+        pool_id = c.create_replicated_pool(client, "part", size=2,
+                                           pg_num=8)
+        if not c.wait_clean(pool_id):
+            raise SystemExit("thrash: partition pool never went clean")
+        ioctx = client.open_ioctx("part")
+        for i in range(24):
+            ioctx.write_full("p%d" % i, payload)
+
+        def writer():
+            i = 0
+            while not stop_load.is_set():
+                try:
+                    ioctx.write_full("p%d" % (i % 24), payload,
+                                     timeout=30.0)
+                except Exception:
+                    pass
+                i += 1
+                stop_load.wait(0.05)
+        load = threading.Thread(target=writer, name="thrash-load",
+                                daemon=True)
+        load.start()
+
+        from tests.thrasher import Thrasher
+        th = Thrasher(c, seed=0xAB)
+        t_fault = time.monotonic()
+        th.partition(0, 1)
+
+        def someone_down():
+            m = c.leader().osdmon.osdmap
+            return m.is_down(0) or m.is_down(1)
+        if not wait_until(someone_down, timeout=30):
+            raise SystemExit("thrash gate: partitioned peers never "
+                             "reported each other down")
+        part: dict = {"time_to_marked_down_s":
+                      round(time.monotonic() - t_fault, 3)}
+        th.heal()
+        t_heal = time.monotonic()
+
+        def health():
+            _, outs, _ = client.mon_command({"prefix": "health"})
+            return (outs or "").split("\n")[0]
+        if not wait_until(lambda: c.all_osds_up()
+                          and health() == "HEALTH_OK", timeout=90):
+            raise SystemExit("thrash gate: no HEALTH_OK after heal "
+                             "(health=%r)" % health())
+        part["time_to_health_ok_s"] = round(
+            time.monotonic() - t_heal, 3)
+        stop_load.set()
+        load.join(timeout=5)
+        if th.errors:
+            raise SystemExit("thrash gate: thrasher errors: %s"
+                             % th.errors)
+
+        # monotone-progress gate: whatever the cycle narrated must
+        # only ever move forward, and nothing may still be active
+        if not wait_until(lambda: not progress.active_events(),
+                          timeout=30):
+            raise SystemExit(
+                "thrash gate: progress events still active after "
+                "HEALTH_OK: %s" % progress.active_events())
+        timeline = []
+        for ev in progress.completed_events():
+            hist = [f for _, f in ev["history"]]
+            if any(b < a for a, b in zip(hist, hist[1:])):
+                raise SystemExit(
+                    "thrash gate: event %s fraction regressed: %s"
+                    % (ev["id"], hist))
+            timeline.append({"id": ev["id"], "message": ev["message"],
+                             "duration_s": ev.get("duration")})
+        part["progress_events"] = timeline
+        _, _, tail = client.mon_command(
+            {"prefix": "events last", "num": 200})
+        part["event_journal"] = [
+            {"seq": e.get("seq"), "type": e.get("type"),
+             "source": e.get("source"), "message": e.get("message")}
+            for e in (tail or [])
+            if e.get("type") in ("osdmap", "health", "progress",
+                                 "thrash")]
+        doc["partition"] = part
+        doc["value"] = on["p99_s"]
+    finally:
+        stop_load.set()
+        c.stop()
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "THRASH_r01.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"storm_on_p99_s": on["p99_s"],
+                      "storm_off_p99_s": off["p99_s"],
+                      "partition": {k: v for k, v in
+                                    doc["partition"].items()
+                                    if k not in ("progress_events",
+                                                 "event_journal")}}))
+    return doc
+
+
 def run_recovery(out_path: str | None = None) -> dict:
     """Repair-bandwidth artifact (ROADMAP direction C): the msr
     product-matrix codec's beta-fraction rebuild vs classic RS k=8,m=3
@@ -1722,6 +1954,9 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
     if "--convergence" in sys.argv:
         run_convergence()
+        return
+    if "--thrash" in sys.argv:
+        run_thrash()
         return
     if "--recovery" in sys.argv:
         run_recovery()
@@ -2317,6 +2552,9 @@ if __name__ == "__main__":
     elif "--convergence" in sys.argv:
         # cluster-convergence artifact: no device rows, no supervisor
         run_convergence()
+    elif "--thrash" in sys.argv:
+        # overload-survival artifact: chaos gates, no supervisor
+        run_thrash()
     elif "--recovery" in sys.argv:
         # repair-bandwidth artifact: gates + cluster leg, no supervisor
         run_recovery()

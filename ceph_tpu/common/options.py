@@ -292,7 +292,41 @@ def _declare_defaults():
       "(bluestore_compression_required_ratio analog)")
     # throttles
     o("objecter_inflight_ops", int, 1024, LEVEL_ADVANCED)
-    o("osd_client_message_cap", int, 256, LEVEL_ADVANCED)
+    o("osd_client_message_cap", int, 256, LEVEL_ADVANCED,
+      "max undispatched+inflight client messages a public messenger "
+      "admits before the reader stops pulling frames off the socket "
+      "(dispatch-side Throttle -> TCP backpressure; "
+      "Messenger::Policy throttler_messages role)")
+    o("osd_client_message_size_cap", int, 256 << 20, LEVEL_ADVANCED,
+      "max bytes of undispatched+inflight client message payload "
+      "before the reader blocks (throttler_bytes role); 0 = unlimited")
+    # recovery/backfill reservations (AsyncReserver slots)
+    o("osd_max_backfills", int, 1, LEVEL_ADVANCED,
+      "backfill reservations one OSD grants concurrently, local "
+      "(primary) and remote (replica) sides each "
+      "(options.cc osd_max_backfills)")
+    o("osd_recovery_max_active", int, 3, LEVEL_ADVANCED,
+      "log-based recovery reservations one OSD grants concurrently "
+      "(osd_recovery_max_active role, counted in PGs not ops at "
+      "framework scale)")
+    o("osd_recovery_sleep", float, 0.0, LEVEL_ADVANCED,
+      "baseline delay (seconds) injected before each recovery/backfill "
+      "push through a BackoffThrottle: the effective sleep scales from "
+      "this value toward 10x as concurrent pushes approach the "
+      "reservation slot budget; 0 disables shaping")
+    # cluster full-ratio ladder (mon-side thresholds against each
+    # OSD's reported statfs utilization)
+    o("mon_osd_nearfull_ratio", float, 0.85, LEVEL_ADVANCED,
+      "store utilization above which an OSD raises OSD_NEARFULL "
+      "(warning only)")
+    o("mon_osd_backfillfull_ratio", float, 0.90, LEVEL_ADVANCED,
+      "store utilization above which an OSD refuses NEW remote "
+      "backfill reservations (PGs targeting it stall in "
+      "backfill_toofull)")
+    o("mon_osd_full_ratio", float, 0.95, LEVEL_ADVANCED,
+      "store utilization above which the OSD rejects client writes "
+      "with ENOSPC at admission (reads still served) and recovery "
+      "into it pauses")
 
 
 _declare_defaults()

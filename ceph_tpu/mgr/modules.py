@@ -140,6 +140,27 @@ class PrometheusModule(MgrModule):
                         if v is not None:
                             emit("ceph_osd_repair_%s_bytes" % lane,
                                  v, lbl, mtype="counter")
+                    # overload-protection series: reservation slot
+                    # occupancy (recovery/backfill admission) and
+                    # client-dispatch throttle stall time
+                    for lane, name, mtype in (
+                            ("l_osd_reservation_granted",
+                             "ceph_osd_reservation_granted", "gauge"),
+                            ("l_osd_reservation_waiting",
+                             "ceph_osd_reservation_waiting", "gauge"),
+                            ("l_osd_reservation_preempted",
+                             "ceph_osd_reservation_preempted",
+                             "counter")):
+                        v = perf.get(lane)
+                        if v is not None:
+                            emit(name, v, lbl, mtype=mtype)
+                    tw = perf.get("l_osd_throttle_wait")
+                    if isinstance(tw, dict):
+                        emit("ceph_osd_throttle_wait_seconds",
+                             tw.get("sum", 0.0), lbl, mtype="counter",
+                             help_="cumulative seconds client "
+                                   "connections stalled in the "
+                                   "dispatch throttle")
                 # device-utilization gauges from the report's status
                 # bag: HBM residency, dispatch queue depth, rolling
                 # per-codec throughput with codec labels
@@ -270,6 +291,11 @@ class PrometheusModule(MgrModule):
                      row["misplaced_objects"], plbl,
                      help_="object copies still backfilling onto a "
                            "new acting member")
+                emit("ceph_pg_backfill_toofull",
+                     1 if "backfill_toofull"
+                     in (row.get("state") or "") else 0, plbl,
+                     help_="1 while the pg's backfill is parked "
+                           "because a target osd is backfillfull")
         # active progress events (mgr progress module): completed
         # events are deliberately absent, so their series leave the
         # exposition the moment convergence finishes (same ageout

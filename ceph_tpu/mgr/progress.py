@@ -63,6 +63,7 @@ class ProgressModule(MgrModule):
         self.completed: deque = deque(maxlen=max(1, maxc))
         self._next_id = 1
         self._map_snap: dict | None = None
+        self._toofull: set = set()   # pgids parked backfill_toofull
         self._journal_q: queue.Queue = queue.Queue()
         self._journal_thread: threading.Thread | None = None
         self._shutdown = False
@@ -98,7 +99,28 @@ class ProgressModule(MgrModule):
                + summary["misplaced_objects"])
         peering = any(row.get("state") == "peering"
                       for row in summary["pgs"].values())
+        # narrate backfill_toofull transitions: the fraction freezing
+        # is the symptom; this journal line names the cause
+        toofull = {pg for pg, row in summary["pgs"].items()
+                   if "backfill_toofull" in (row.get("state") or "")}
         closed, journal = [], []
+        with self._lock:
+            if toofull != self._toofull:
+                if toofull:
+                    journal.append((
+                        "progress",
+                        "progress stalled: %d pg(s) backfill_toofull "
+                        "(%s) — backfill target over the "
+                        "backfillfull ratio"
+                        % (len(toofull), ", ".join(sorted(toofull))),
+                        {"phase": "stall",
+                         "pgs": sorted(toofull)}))
+                else:
+                    journal.append((
+                        "progress",
+                        "progress resumed: backfill_toofull cleared",
+                        {"phase": "resume"}))
+                self._toofull = toofull
         with self._lock:
             for ev in list(self._events.values()):
                 self._update_one(ev, bad, peering, now, journal)
@@ -266,6 +288,11 @@ class ProgressModule(MgrModule):
     def handle_command(self, cmd: dict):
         if cmd.get("prefix", "") == "progress":
             bars = self.render_bars()
+            with self._lock:
+                stalled = sorted(self._toofull)
+            if stalled:
+                bars.append("[stalled] %d pg(s) backfill_toofull: %s"
+                            % (len(stalled), ", ".join(stalled)))
             done = ["[complete] %s (%.1fs)"
                     % (ev["message"], ev.get("duration", 0.0))
                     for ev in self.completed_events()]

@@ -29,6 +29,14 @@ Checks implemented (names follow the reference's health check ids):
                     re-reports a calm window
   DEVICE_MEM_NEARFULL  an osd's HBM chunk tier crossed the nearfull
                     occupancy ratio — eviction pressure is imminent
+  OSD_NEARFULL      store utilisation over mon_osd_nearfull_ratio —
+                    plan capacity now
+  OSD_BACKFILLFULL  utilisation over mon_osd_backfillfull_ratio — the
+                    osd refuses backfill reservations (backfill into
+                    it would push it to full)
+  OSD_FULL          utilisation over mon_osd_full_ratio — the osd
+                    rejects client writes with ENOSPC; reads still
+                    served
 
 Raw pg stats stay leader-local (they churn with IO; replicating them
 would melt paxos) — only the DERIVED check map and the scrub-error
@@ -61,6 +69,7 @@ class HealthMonitor:
         self._slow_ops: dict = {}      # osd id -> slow-request count
         self._recompiles: dict = {}    # osd id -> in-window recompiles
         self._nearfull: dict = {}      # osd id -> HBM occupancy ratio
+        self._used_ratio: dict = {}    # osd id -> store used/total
         self._reported_osds: set = set()   # osds heard from (this mon)
         self._stats_gen = 0
         self._seen_epoch = -1
@@ -150,6 +159,11 @@ class HealthMonitor:
                 self._nearfull[msg.osd_id] = occ
             else:
                 self._nearfull.pop(msg.osd_id, None)
+            u = float(getattr(msg, "used_ratio", 0.0) or 0.0)
+            if u > 0:
+                self._used_ratio[msg.osd_id] = u
+            else:
+                self._used_ratio.pop(msg.osd_id, None)
             self._stats_gen += 1
         self.recompute()
 
@@ -332,6 +346,44 @@ class HealthMonitor:
                     and "DEVICE_MEM_NEARFULL" in eff["checks"]:
                 checks["DEVICE_MEM_NEARFULL"] = \
                     eff["checks"]["DEVICE_MEM_NEARFULL"]
+            # OSD_NEARFULL / OSD_BACKFILLFULL / OSD_FULL: store
+            # utilisation ranked against the full-ratio ladder.  Each
+            # osd lands in the HIGHEST tier it crosses (a full osd is
+            # not also listed as nearfull — the reference's
+            # get_full_osd_counts behaves the same way)
+            conf = self.mon.ctx.conf
+            ratios = (conf.get_val("mon_osd_nearfull_ratio"),
+                      conf.get_val("mon_osd_backfillfull_ratio"),
+                      conf.get_val("mon_osd_full_ratio"))
+            tiers: dict = {"OSD_NEARFULL": [], "OSD_BACKFILLFULL": [],
+                           "OSD_FULL": []}
+            for o, u in sorted(self._used_ratio.items()):
+                if u >= ratios[2]:
+                    tiers["OSD_FULL"].append((o, u))
+                elif u >= ratios[1]:
+                    tiers["OSD_BACKFILLFULL"].append((o, u))
+                elif u >= ratios[0]:
+                    tiers["OSD_NEARFULL"].append((o, u))
+            full_msgs = {
+                "OSD_NEARFULL": ("warning", "%d nearfull osd(s)",
+                                 "osd.%d is %d%% full (nearfull)"),
+                "OSD_BACKFILLFULL": (
+                    "warning", "%d backfillfull osd(s)",
+                    "osd.%d is %d%% full (backfill reservations "
+                    "refused)"),
+                "OSD_FULL": ("error", "%d full osd(s)",
+                             "osd.%d is %d%% full (writes blocked)"),
+            }
+            for name, osds in tiers.items():
+                sev, summary, detail = full_msgs[name]
+                if osds:
+                    checks[name] = {
+                        "severity": sev,
+                        "summary": summary % len(osds),
+                        "detail": [detail % (o, round(u * 100))
+                                   for o, u in osds]}
+                elif not self._reported_osds and name in eff["checks"]:
+                    checks[name] = eff["checks"][name]
             if checks == eff["checks"] and scrub == eff["scrub_errors"]:
                 return
             self.pending = {"checks": checks, "scrub_errors": scrub}

@@ -42,6 +42,8 @@ class OSDMonitor:
         self.failure_reports: dict[int, dict] = {}  # target -> reporter->ts
         self.down_stamps: dict[int, float] = {}
         self._boot_epoch: dict[int, int] = {}   # osd -> epoch of last boot
+        self._auto_outed: set = set()   # weighted out by the timer, not
+        #                                 an operator: re-in on boot
         self._lock = threading.RLock()
         self._next_pool_id = 1
 
@@ -128,6 +130,13 @@ class OSDMonitor:
             # (OSDMonitor up_from/boot-epoch accounting)
             self.failure_reports.pop(msg.osd_id, None)
             self._boot_epoch[msg.osd_id] = self.osdmap.epoch + 1
+            # an osd the down->out TIMER weighted out comes back in on
+            # boot (mon_osd_auto_mark_auto_out_in): a healed partition
+            # or restart must converge without an operator 'osd in';
+            # an operator-issued out is deliberate and stays
+            if msg.osd_id in self._auto_outed:
+                self._auto_outed.discard(msg.osd_id)
+                inc.new_weight[msg.osd_id] = 0x10000
             if msg.osd_id >= self.osdmap.max_osd and \
                     (inc.new_max_osd or 0) <= msg.osd_id:
                 inc.new_max_osd = msg.osd_id + 1
@@ -194,6 +203,7 @@ class OSDMonitor:
                     continue
                 if now - since >= grace and self.osdmap.is_in(osd):
                     self._pend().new_weight[osd] = 0
+                    self._auto_outed.add(osd)
                     self.mon.ctx.dout("mon", 1,
                                       "osd.%d down too long -> out" % osd)
         if self.pending is not None:
@@ -218,10 +228,13 @@ class OSDMonitor:
             if prefix == "osd pool create":
                 return self._pool_create(cmd)
             if prefix == "osd out":
+                # operator intent: never auto-reverse on boot
+                self._auto_outed.discard(int(cmd["id"]))
                 self._pend().new_weight[int(cmd["id"])] = 0
                 self.mon.propose_soon()
                 return 0, "marked out osd.%s" % cmd["id"], None
             if prefix == "osd in":
+                self._auto_outed.discard(int(cmd["id"]))
                 self._pend().new_weight[int(cmd["id"])] = 0x10000
                 self.mon.propose_soon()
                 return 0, "marked in osd.%s" % cmd["id"], None

@@ -32,7 +32,7 @@ __all__ = [
     "MMonCommand", "MMonCommandReply", "MMonSubscribe", "MMonPaxos",
     "MMonElection", "MAuth", "MAuthReply", "MMgrReport",
     "MMDSBeacon", "MMDSMap", "MClientRequest", "MClientReply",
-    "MAuthMap", "MLog", "MPGStats",
+    "MAuthMap", "MLog", "MPGStats", "MBackfillReserve",
 ]
 
 _seq = itertools.count(1)
@@ -262,6 +262,27 @@ class MOSDPGPull(Message):
     map_epoch: int = 0
 
 
+@dataclass
+class MBackfillReserve(Message):
+    """Recovery/backfill reservation round-trip between a primary and
+    its replicas (src/messages/MBackfillReserve.h +
+    MRecoveryReserve.h folded into one type, selected by `lane`).
+    The primary sends op=request after winning its LOCAL slot; each
+    replica answers grant (remote slot held) or reject (slots busy, or
+    the replica is backfillfull for lane=backfill).  op=release frees
+    the remote slot on completion/interval change."""
+    pgid: object = None
+    from_osd: int = 0
+    lane: str = "backfill"         # backfill | recovery
+    op: str = "request"            # request | grant | reject | release
+    priority: int = 0
+    map_epoch: int = 0
+    # reject cause (appended field): "toofull" = replica refuses the
+    # lane on fullness grounds (primary parks in backfill_toofull),
+    # "preempted" = a higher-priority PG evicted the remote slot
+    reason: str = ""
+
+
 # -- peering (GetInfo/GetLog/GetMissing rounds) ------------------------
 
 @dataclass
@@ -423,6 +444,11 @@ class MPGStats(Message):
     # (DEVICE_MEM_NEARFULL); both 0 when healthy
     recompiles: int = 0
     mem_nearfull: float = 0.0
+    # store-occupancy fraction from statfs (appended field): the
+    # HealthMonitor ranks it against mon_osd_{nearfull,backfillfull,
+    # full}_ratio for the OSD_NEARFULL/OSD_BACKFILLFULL/OSD_FULL
+    # ladder; 0.0 when the store can't report capacity
+    used_ratio: float = 0.0
 
 
 # -- mgr ---------------------------------------------------------------

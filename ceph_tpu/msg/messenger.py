@@ -726,6 +726,17 @@ class Connection:
                 self._unacked = [(s, m) for s, m in self._unacked
                                  if s > msg[1]]
             return True
+        # partition chaos (tests/thrasher.py): a blackholed peer's
+        # message FAULTS the pipe — socket down, no MSGACK — so the
+        # sender's lossless machinery keeps it in _unacked and
+        # redelivers on the post-heal reconnect, exactly like a real
+        # network partition healing
+        if self.msgr.blocked_peers:
+            name = getattr(msg, "from_name", None)
+            if name is not None \
+                    and tuple(name) in self.msgr.blocked_peers:
+                self.close()
+                return False
         msg.from_addr = self.peer_addr
         # verified cephx identity of this connection (entity, caps,
         # key_version) rides to dispatchers so daemons enforce caps
@@ -750,7 +761,13 @@ class Connection:
                     return False
                 return True
             self._in_seq = max(self._in_seq, seq)
+        release = self._throttle_admit(msg, len(payload))
         self.msgr._dispatch(msg)
+        if release is not None \
+                and not getattr(msg, "_throttle_adopted", False):
+            # the daemon did not adopt the budget hand-off (early
+            # reject, dedup drop, non-op message): release here
+            release()
         if seq is not None:
             # ack AFTER dispatch: delivery, not receipt (at-least-once)
             try:
@@ -758,6 +775,57 @@ class Connection:
             except OSError:
                 return False
         return True
+
+    def _throttle_admit(self, msg, cost: int):
+        """Blocking dispatch-throttle acquisition for CLIENT messages
+        (None when admission control is off or the sender is a
+        daemon).  Blocking HERE is the mechanism: while this reader is
+        parked, no further frames are read off the socket, the kernel
+        buffer fills, and the over-budget client stalls in its own
+        sendall (TCP backpressure) instead of growing our op queue.
+        Returns an idempotent release closure, also attached as
+        msg.throttle_release so the daemon can adopt the budget and
+        hold it until the op actually replies."""
+        armed = self.msgr.dispatch_throttle
+        name = getattr(msg, "from_name", None)
+        if armed is None or not name or name[0] != "client":
+            return None
+        msgs_t, bytes_t, wait_cb = armed
+        from ..common.throttle import ThrottleTimeout
+        t0 = time.monotonic()
+        held_msg = False
+        while True:
+            if self.closed or self.msgr._stopping:
+                # teardown raced the wait: drop the admission, the
+                # frame dies with the pipe
+                if held_msg:
+                    msgs_t.put(1)
+                return None
+            try:
+                if not held_msg:
+                    msgs_t.get(1, timeout=0.5)
+                    held_msg = True
+                bytes_t.get(cost, timeout=0.5)
+                break
+            except ThrottleTimeout:
+                continue   # re-check teardown, keep waiting
+        waited = time.monotonic() - t0
+        if waited > 0.001 and wait_cb is not None:
+            try:
+                wait_cb(waited)
+            except Exception:
+                pass
+        done = [False]
+
+        def release():
+            if done[0]:
+                return
+            done[0] = True
+            msgs_t.put(1)
+            bytes_t.put(cost)
+
+        msg.throttle_release = release
+        return release
 
     def close(self) -> None:
         with self.lock:
@@ -831,6 +899,14 @@ class Messenger:
         self._lock = threading.Lock()
         self._stopping = False
         self._rng = random.Random()
+        # dispatch-side admission control (osd_client_message_cap /
+        # osd_client_message_size_cap, the reference's
+        # DispatchQueue throttles): armed by enable_dispatch_throttle
+        self.dispatch_throttle = None   # (msgs, bytes, wait_cb)
+        # directional blackhole for partition chaos: inbound messages
+        # whose from_name is listed here fault the pipe instead of
+        # dispatching (tests/thrasher.py partition/heal)
+        self.blocked_peers: set = set()
 
     # -- lifecycle -----------------------------------------------------
 
@@ -901,6 +977,34 @@ class Messenger:
         self._sweep_conns()
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=2)
+
+    # -- admission control / partition injection -----------------------
+
+    def enable_dispatch_throttle(self, msg_cap: int, size_cap: int,
+                                 wait_cb=None) -> None:
+        """Arm dispatch-side admission control: CLIENT messages hold a
+        unit of the count budget and their frame bytes of the size
+        budget from just-before-dispatch until the daemon replies (or
+        dispatch returns, when the daemon doesn't adopt the release).
+        An over-budget connection blocks in its reader — the kernel
+        socket buffer fills and the client feels TCP backpressure —
+        instead of ballooning the op queue.  wait_cb(seconds) observes
+        every blocked acquisition (the throttle wait PerfCounter)."""
+        from ..common.throttle import Throttle
+        self.dispatch_throttle = (
+            Throttle("%s-dispatch-msgs" % (self.name,),
+                     int(msg_cap or 0)),
+            Throttle("%s-dispatch-bytes" % (self.name,),
+                     int(size_cap or 0)),
+            wait_cb)
+
+    def block_peer(self, name) -> None:
+        """Blackhole inbound traffic FROM this entity name (directional
+        partition half; the thrasher blocks both directions)."""
+        self.blocked_peers.add(tuple(name))
+
+    def unblock_peer(self, name) -> None:
+        self.blocked_peers.discard(tuple(name))
 
     # -- dispatch ------------------------------------------------------
 

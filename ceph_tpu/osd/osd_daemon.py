@@ -16,6 +16,8 @@ import threading
 import time
 
 from ..common import Context
+from ..common.reserver import AsyncReserver
+from ..common.throttle import BackoffThrottle
 from ..common.workqueue import Finisher, SafeTimer, ShardedThreadPool
 from ..mon.mon_client import MonClient
 from ..msg.message import (MOSDBoot, MOSDFailure, MOSDOpReply, MPing,
@@ -112,6 +114,40 @@ class OSDDaemon(Dispatcher):
             history_duration=conf.get_val("osd_op_history_duration"),
             complaint_time=conf.get_val("osd_op_complaint_time"),
             slow_size=conf.get_val("osd_op_history_slow_size"))
+        # recovery/backfill reservation slots (the reference OSDService's
+        # local_reserver/remote_reserver pairs): a primary must win its
+        # LOCAL slot and every replica's REMOTE slot before its pushes
+        # may enter the recovery op class (osd/pg.py reservation round)
+        max_backfills = conf.get_val("osd_max_backfills")
+        max_recovery = conf.get_val("osd_recovery_max_active")
+        self.reservations = {
+            "local_recovery": AsyncReserver("local_recovery",
+                                            max_recovery),
+            "remote_recovery": AsyncReserver("remote_recovery",
+                                             max_recovery),
+            "local_backfill": AsyncReserver("local_backfill",
+                                            max_backfills),
+            "remote_backfill": AsyncReserver("remote_backfill",
+                                             max_backfills),
+        }
+        # osd_recovery_sleep delay shaping: pushes acquire a unit for
+        # the duration of the push, and BackoffThrottle injects an
+        # occupancy-scaled sleep — the closer concurrent pushes sit to
+        # the recovery budget, the longer each one yields to client IO
+        sleep = conf.get_val("osd_recovery_sleep")
+        self.recovery_throttle = BackoffThrottle(
+            "osd%d-recovery-sleep" % whoami,
+            max_=max(1, max_recovery),
+            low_threshold=0.0, high_threshold=1.0,
+            low_delay=sleep * 0.1, high_delay=sleep) \
+            if sleep > 0 else None
+        # full-ratio ladder thresholds (mon_osd_*_ratio; the mon ranks
+        # the reported used_ratio against the same options)
+        self._full_ratios = (
+            conf.get_val("mon_osd_nearfull_ratio"),
+            conf.get_val("mon_osd_backfillfull_ratio"),
+            conf.get_val("mon_osd_full_ratio"))
+        self._used_stat_cache = (0.0, -1e9)   # (ratio, stamp)
         # device-runtime profiler (common/profiler.py): process-global
         # by design (module-level jit sites have no daemon home), so
         # configure() just applies this daemon's knobs
@@ -234,6 +270,12 @@ class OSDDaemon(Dispatcher):
                 lambda args: self._mesh_status(),
                 "device placement: local mesh, this OSD's home "
                 "device, and every placement-registry assignment")
+            self.ctx.admin_socket.register(
+                "dump_reservations",
+                lambda args: {name: r.dump()
+                              for name, r in self.reservations.items()},
+                "recovery/backfill reservation slots: granted holders "
+                "+ priority-ordered waiters per reserver")
         self.hb_peers: dict = {}       # osd -> last reply stamp
         self.hb_pending: dict = {}     # osd -> first unacked ping stamp
         # cache tiering: base-pool IO runs on dedicated threads with an
@@ -291,6 +333,25 @@ class OSDDaemon(Dispatcher):
                      .add_u64_counter("l_osd_repair_bytes_saved",
                                       "survivor bytes NOT moved vs a "
                                       "full k-chunk decode")
+                     # reservation observability (dump_reservations
+                     # asok / prometheus ceph_osd_reservation_*):
+                     # granted + preempted are lifetime totals across
+                     # the four reservers, waiting is the current
+                     # queue depth — synced from the reservers at
+                     # report time (_sync_reservation_perf)
+                     .add_u64("l_osd_reservation_granted",
+                              "reservation grants (lifetime, all "
+                              "reservers)")
+                     .add_u64("l_osd_reservation_waiting",
+                              "reservation requests currently queued")
+                     .add_u64("l_osd_reservation_preempted",
+                              "reservation holders preempted by "
+                              "higher priority (lifetime)")
+                     # dispatch-side admission control: cumulative time
+                     # client connections spent blocked on the message
+                     # count/size throttles (TCP backpressure)
+                     .add_time_avg("l_osd_throttle_wait",
+                                   "client dispatch throttle wait")
                      # span-derived per-phase op timing (the tracing
                      # spine's aggregate view; always on — a tinc is
                      # cheap even when span objects are not minted)
@@ -304,6 +365,16 @@ class OSDDaemon(Dispatcher):
                                     "op latency histogram, microseconds")
                      .create_perf_counters())
         self.ctx.perf.add(self.perf)
+        # messenger admission control (tentpole leg 3): over-budget
+        # client connections block in the reader — TCP backpressure —
+        # instead of ballooning the op queue.  Public messenger only:
+        # cluster/heartbeat traffic must never be throttled behind
+        # client bytes.
+        self.public_msgr.enable_dispatch_throttle(
+            conf.get_val("osd_client_message_cap"),
+            conf.get_val("osd_client_message_size_cap"),
+            wait_cb=lambda dt: self.perf.tinc(
+                "l_osd_throttle_wait", dt))
         # cluster log channel (the reference's clog): operator-facing
         # events (shard EIO, scrub errors, repairs) go to the mon's
         # replicated LogMonitor and surface via 'ceph log last'
@@ -708,6 +779,55 @@ class OSDDaemon(Dispatcher):
                 pass
         return status
 
+    # -- fullness ladder ----------------------------------------------
+
+    def used_ratio(self) -> float:
+        """Store occupancy fraction from statfs, cached ~0.5s — the
+        full/backfillfull gates sit on the client-op and reservation
+        hot paths and must not statfs per op."""
+        now = time.monotonic()
+        ratio, stamp = self._used_stat_cache
+        if now - stamp < 0.5:
+            return ratio
+        try:
+            st = self.store.statfs()
+            total = st.get("total", 0)
+            ratio = (st.get("used", 0) / total) if total else 0.0
+        except Exception:
+            ratio = 0.0
+        self._used_stat_cache = (ratio, now)
+        return ratio
+
+    def is_nearfull(self) -> bool:
+        return self.used_ratio() >= self._full_ratios[0]
+
+    def is_backfillfull(self) -> bool:
+        return self.used_ratio() >= self._full_ratios[1]
+
+    def is_full(self) -> bool:
+        return self.used_ratio() >= self._full_ratios[2]
+
+    def reserve_refusal(self, lane: str) -> str | None:
+        """Fullness veto on incoming remote-reservation requests: a
+        backfillfull OSD refuses new backfill (the primary parks in
+        backfill_toofull), and recovery into a FULL osd pauses until
+        it drains.  None = no objection."""
+        if lane == "backfill" and self.is_backfillfull():
+            return "toofull"
+        if lane == "recovery" and self.is_full():
+            return "toofull"
+        return None
+
+    def _sync_reservation_perf(self) -> None:
+        granted = waiting = preempted = 0
+        for r in self.reservations.values():
+            granted += r.granted_total
+            waiting += r.num_waiting()
+            preempted += r.preempted_total
+        self.perf.set("l_osd_reservation_granted", granted)
+        self.perf.set("l_osd_reservation_waiting", waiting)
+        self.perf.set("l_osd_reservation_preempted", preempted)
+
     def _collect_pg_stats(self) -> dict:
         """Primary PGs' stat rows (shared by the mon MPGStats report
         and the mgr telemetry report)."""
@@ -755,7 +875,14 @@ class OSDDaemon(Dispatcher):
                     nearfull = occ
             except Exception:
                 pass
-        alerting = slow or recompiles or nearfull
+        # store occupancy rides every report too: the HealthMonitor
+        # ranks it against the mon_osd_*_ratio ladder (OSD_NEARFULL /
+        # OSD_BACKFILLFULL / OSD_FULL) — an over-threshold ratio keeps
+        # reports flowing via the alert latch so the check can CLEAR
+        used = self.used_ratio()
+        self._sync_reservation_perf()
+        alerting = slow or recompiles or nearfull \
+            or used >= self._full_ratios[0]
         if not stats and not alerting \
                 and not getattr(self, "_alert_reported", False):
             return
@@ -764,7 +891,8 @@ class OSDDaemon(Dispatcher):
         self._send_mon(MPGStats(osd_id=self.whoami, pg_stats=stats,
                                 epoch=self.map_epoch(), slow_ops=slow,
                                 recompiles=recompiles,
-                                mem_nearfull=nearfull))
+                                mem_nearfull=nearfull,
+                                used_ratio=used))
 
     # -- dispatch ------------------------------------------------------
 
@@ -789,7 +917,8 @@ class OSDDaemon(Dispatcher):
                  "MOSDECSubOpRepairRead", "MOSDECSubOpRepairReadReply",
                  "MOSDRepOp", "MOSDRepOpReply", "MOSDPGScan",
                  "MOSDPGPush", "MOSDPGPull", "MOSDPGQuery",
-                 "MOSDPGNotify", "MOSDPGLog", "MWatchNotifyAck"):
+                 "MOSDPGNotify", "MOSDPGLog", "MWatchNotifyAck",
+                 "MBackfillReserve"):
             self._enqueue_sub_op(msg)
             return True
         return False
@@ -799,6 +928,14 @@ class OSDDaemon(Dispatcher):
         "remove", "setxattr", "rmxattr", "omap_set", "omap_rm",
         "omap_clear", "resetxattrs", "watch", "unwatch", "notify",
         "rollback", "call"))
+
+    #: mutating ops still admitted on a FULL osd: they free space (or
+    #: add none), and rejecting them would wedge a full cluster full
+    #: forever (the reference admits deletes on a full pool the same
+    #: way)
+    FULL_EXEMPT_OP_KINDS = frozenset((
+        "remove", "rmxattr", "omap_rm", "omap_clear", "truncate",
+        "zero", "unwatch"))
 
     def _check_op_caps(self, msg) -> str | None:
         """OSDCap enforcement (src/osd/OSDCap.cc is_capable, called
@@ -866,6 +1003,21 @@ class OSDDaemon(Dispatcher):
         # one replays its recorded reply (PG log reqid dedup role)
         mutating = any(op and op[0] in self.WRITE_OP_KINDS
                        for op in msg.ops)
+        # full-ratio protection: a FULL osd rejects writes at admission
+        # with ENOSPC — reads keep flowing (the data is still there)
+        # and space-freeing ops stay admitted so the operator can dig
+        # the cluster out
+        if mutating and self.is_full() and \
+                any(op and op[0] in self.WRITE_OP_KINDS
+                    and op[0] not in self.FULL_EXEMPT_OP_KINDS
+                    for op in msg.ops):
+            import errno as _errno
+            self.public_msgr.send_message(
+                MOSDOpReply(tid=msg.tid, result=-_errno.ENOSPC,
+                            data=b"osd full",
+                            map_epoch=self.map_epoch()),
+                client_addr)
+            return
         dedup_key = ((getattr(msg, "session", "") or msg.client_id,
                       msg.tid) if mutating else None)
         if dedup_key is not None:
@@ -901,6 +1053,12 @@ class OSDDaemon(Dispatcher):
         #                    backends hang their spans off it
 
         replied = [False]
+        # dispatch-throttle hand-off: the messenger attached an
+        # idempotent release closure and would put the units back right
+        # after ms_dispatch returns — adopting moves the release to the
+        # REPLY, so queued-but-unserved ops keep holding their budget
+        # (that occupancy is exactly what backpressures the reader)
+        throttle_release = getattr(msg, "throttle_release", None)
 
         self.perf.inc("op")
         # read/write split + real payload accounting: the op's byte
@@ -915,6 +1073,8 @@ class OSDDaemon(Dispatcher):
             if replied[0]:
                 return
             replied[0] = True
+            if throttle_release is not None:
+                throttle_release()
             if dedup_key is not None:
                 with self.lock:
                     if result == -11:
@@ -978,6 +1138,8 @@ class OSDDaemon(Dispatcher):
                 self.perf.tinc("l_osd_op_trace_pg",
                                time.monotonic() - t_run)
 
+        if throttle_release is not None:
+            msg._throttle_adopted = True
         self.op_wq.queue(pg.pgid, run, msg, reply,
                          klass="client",
                          priority=self.client_op_priority,
@@ -1027,6 +1189,8 @@ class OSDDaemon(Dispatcher):
                 pg.handle_log(msg)
             elif t == "MWatchNotifyAck":
                 pg.handle_notify_ack(msg)
+            elif t == "MBackfillReserve":
+                pg.handle_reserve(msg)
 
         # recovery data movement (push/pull/scan — and the regenerating
         # repair fraction reads, which only exist to rebuild a shard)
@@ -1034,7 +1198,8 @@ class OSDDaemon(Dispatcher):
         # on actual backfill traffic
         if t in ("MOSDPGPush", "MOSDPGScan", "MOSDPGPull",
                  "MOSDPGQuery", "MOSDPGNotify", "MOSDPGLog",
-                 "MOSDECSubOpRepairRead", "MOSDECSubOpRepairReadReply"):
+                 "MOSDECSubOpRepairRead", "MOSDECSubOpRepairReadReply",
+                 "MBackfillReserve"):
             self.op_wq.queue(msg.pgid, run, klass="recovery",
                              priority=self.recovery_op_priority)
         else:

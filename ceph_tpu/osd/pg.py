@@ -37,9 +37,9 @@ import time as _time
 
 from .. import encoding
 from ..common.lockdep import make_rlock
-from ..msg.message import (MOSDPGLog, MOSDPGNotify, MOSDPGPull,
-                           MOSDPGPush, MOSDPGQuery, MOSDPGScan,
-                           MWatchNotify)
+from ..msg.message import (MBackfillReserve, MOSDPGLog, MOSDPGNotify,
+                           MOSDPGPull, MOSDPGPush, MOSDPGQuery,
+                           MOSDPGScan, MWatchNotify)
 from ..store.object_store import Transaction
 from .ec_backend import ECBackend
 from .osd_map import CRUSH_ITEM_NONE, POOL_TYPE_ERASURE
@@ -53,6 +53,11 @@ VERSION_ATTR = "_v"
 META_OID = "__pg_meta__"
 SNAPSET_ATTR = "_ss"
 WHITEOUT_ATTR = "_whiteout"
+
+# reservation priorities (the reference's OSD_RECOVERY_PRIORITY
+# ladder, collapsed to two rungs): degraded-object recovery preempts
+# routine backfill in the AsyncReservers, never the other way around
+_RESV_PRIO = {"recovery": 180, "backfill": 90}
 
 
 def host_crc32(data) -> int:
@@ -132,6 +137,20 @@ class PG:
         # read-routing that peer_missing drives
         self.backfilling: dict = {}   # oid -> set(osd)
         self._push_retrying: set = set()   # (oid, peer) retry chains
+        # recovery/backfill reservation state machine (the reference's
+        # PG recovery-reservation states, common/reserver.py slots):
+        # per lane, idle -> local_wait -> remote_wait -> granted, with
+        # "toofull" parking a fullness-rejected round.  Pushes queue in
+        # _resv_pending while ungranted and drain onto the recovery op
+        # class once the local slot AND every replica's remote slot are
+        # held.  _resv_remote_keys is the REPLICA side: primaries whose
+        # requests we hold/queue remote slots for (cancelled on
+        # interval change so a dead primary cannot leak our slots).
+        self._resv_state = {"recovery": "idle", "backfill": "idle"}
+        self._resv_pending = {"recovery": [], "backfill": []}
+        self._resv_want = {"recovery": set(), "backfill": set()}
+        self._resv_have = {"recovery": set(), "backfill": set()}
+        self._resv_remote_keys: set = set()   # (lane, primary_osd)
         # reqid -> version, rebuilt from the log: the failover-safe
         # client-retransmit dedup (pg_log_entry_t::reqid role)
         from ..common.bounded import BoundedDict
@@ -398,6 +417,11 @@ class PG:
                 self._missing_src.clear()
                 self._missing_waiters.clear()
         if changed:
+            # a new interval invalidates every reservation this PG
+            # holds or waits on, in BOTH roles: the primary's round
+            # restarts against the new acting set, and remote slots we
+            # granted a (possibly dead) primary must not leak
+            self._release_reservations()
             # a new interval invalidates this PG's HBM residency: the
             # resident copies were the OLD primary's view, and another
             # primary may have written while we were not it
@@ -1443,6 +1467,8 @@ class PG:
                         backf.discard(msg.from_osd)
                         if not backf:
                             self.backfilling.pop(oid, None)
+            # a drained lane gives its reservation slots back
+            self._maybe_release_reservations()
             return
         if getattr(msg, "kind", "info") == "missing":
             shards = self.acting_shards()
@@ -2051,7 +2077,21 @@ class PG:
                         + sum(len(s)
                               for s in self.peer_missing.values()))
             misplaced = sum(len(s) for s in self.backfilling.values())
-            return {"pool": self.pgid.pool, "state": self.peer_state,
+            # reservation visibility (recovery_wait/backfill_wait/
+            # backfill_toofull PG states): suffixes on the ACTIVE state
+            # only — "peering" stays exact for the progress module
+            state = self.peer_state
+            if state == "active":
+                for lane in ("recovery", "backfill"):
+                    s = self._resv_state[lane]
+                    if s in ("local_wait", "remote_wait"):
+                        state += "+%s_wait" % lane
+                    elif s == "toofull":
+                        state += "+%s_toofull" % lane
+                    elif s == "granted":
+                        state += ("+recovering" if lane == "recovery"
+                                  else "+backfilling")
+            return {"pool": self.pgid.pool, "state": state,
                     "objects": nobj, "bytes": nbytes,
                     "scrub_errors": self.scrub_errors,
                     "degraded_objects": degraded,
@@ -2227,9 +2267,30 @@ class PG:
     def _push_object(self, oid, shard: int, peer_osd: int,
                      force: bool = False, attempt: int = 0,
                      lane: str = "recovery") -> None:
+        # reservation gate (osd_max_backfills/osd_recovery_max_active):
+        # a push may only run while this PG holds its lane's local AND
+        # remote slots — otherwise it parks in _resv_pending and the
+        # reservation round starts.  force (scrub/read repair) bypasses:
+        # those are corrective rewrites of data already counted present.
+        if not force and not self._holds_reservation(lane):
+            entry = (oid, shard, peer_osd, attempt)
+            with self.lock:
+                if entry not in self._resv_pending[lane]:
+                    self._resv_pending[lane].append(entry)
+            self._request_reservations(lane)
+            return
+        # osd_recovery_sleep delay shaping (BackoffThrottle): the unit
+        # is held for the push's lifetime, so concurrent pushes raise
+        # occupancy and every subsequent get() sleeps longer
+        throttle = None if force else getattr(
+            self.daemon, "recovery_throttle", None)
+        if throttle is not None:
+            throttle.get(1)
         attrs, omap = self._gather_push_meta(oid)
 
         def on_data(data):
+            if throttle is not None:
+                throttle.put(1)
             if data is None:
                 # reconstruction failed (mid-churn shortage): retry
                 # while the peer still owes this object, or its
@@ -2303,6 +2364,234 @@ class PG:
         self._push_object(oid, shard, peer_osd, attempt=attempt,
                           lane=lane)
 
+    # -- recovery/backfill reservations --------------------------------
+
+    def _reservers(self):
+        """The daemon's four AsyncReservers, or None on the stub
+        daemons scrub/unit harnesses run PGs against — a None here
+        turns the whole reservation machinery into a pass-through."""
+        return getattr(self.daemon, "reservations", None)
+
+    def _holds_reservation(self, lane: str) -> bool:
+        if self._reservers() is None:
+            return True
+        with self.lock:
+            return self._resv_state[lane] == "granted"
+
+    def _request_reservations(self, lane: str) -> None:
+        """Start the reservation round: queue for the LOCAL slot; the
+        grant callback fans out to the replicas' remote slots."""
+        reservers = self._reservers()
+        if reservers is None:
+            return
+        with self.lock:
+            if self._resv_state[lane] not in ("idle", "toofull"):
+                return            # a round is already in flight
+            self._resv_state[lane] = "local_wait"
+            interval = self.interval
+        reservers["local_" + lane].request_reservation(
+            (str(self.pgid), lane),
+            lambda: self._local_reservation_granted(lane, interval),
+            _RESV_PRIO[lane],
+            on_preempt=lambda: self._reservation_preempted(
+                lane, interval))
+
+    def _local_reservation_granted(self, lane: str,
+                                   interval: int) -> None:
+        peers = None
+        with self.lock:
+            if interval == self.interval \
+                    and self._resv_state[lane] == "local_wait":
+                peers = {o for o in set(self.acting) | set(self.up)
+                         if o != self.whoami and o != CRUSH_ITEM_NONE}
+                self._resv_want[lane] = set(peers)
+                self._resv_have[lane] = set()
+                self._resv_state[lane] = ("remote_wait" if peers
+                                          else "granted")
+        if peers is None:
+            # the interval moved while we queued: give the slot back
+            self._reservers()["local_" + lane].cancel_reservation(
+                (str(self.pgid), lane))
+            return
+        if not peers:
+            self._drain_reserved_pushes(lane)
+            return
+        for osd in peers:
+            self.send_to_osd(osd, MBackfillReserve(
+                pgid=self.pgid, from_osd=self.whoami, lane=lane,
+                op="request", priority=_RESV_PRIO[lane],
+                map_epoch=self.map_epoch()))
+
+    def _reservation_preempted(self, lane: str, interval: int) -> None:
+        """A higher-priority PG evicted our LOCAL slot: back out of the
+        whole round (remote holds included) and re-queue behind it."""
+        self._release_reservation(lane, keep_pending=True)
+        self._schedule_resv_retry(lane, 0.5)
+
+    def handle_reserve(self, msg) -> None:
+        """MBackfillReserve dispatch: request/release land on the
+        replica role, grant/reject on the requesting primary."""
+        lane = msg.lane
+        if msg.op == "request":
+            self._handle_reserve_request(msg)
+        elif msg.op == "release":
+            reservers = self._reservers()
+            if reservers is not None:
+                reservers["remote_" + lane].cancel_reservation(
+                    (str(self.pgid), lane, msg.from_osd))
+            with self.lock:
+                self._resv_remote_keys.discard((lane, msg.from_osd))
+        else:                      # grant | reject
+            self._handle_reserve_reply(msg)
+
+    def _handle_reserve_request(self, msg) -> None:
+        lane = msg.lane
+
+        def answer(op, reason=""):
+            self.send_to_osd(msg.from_osd, MBackfillReserve(
+                pgid=self.pgid, from_osd=self.whoami, lane=lane,
+                op=op, priority=msg.priority,
+                map_epoch=self.map_epoch(), reason=reason))
+
+        # fullness veto BEFORE slot accounting: a backfillfull replica
+        # refuses backfill outright, a full one refuses recovery — the
+        # primary parks in *_toofull and retries after the drain
+        check = getattr(self.daemon, "reserve_refusal", None)
+        refusal = check(lane) if check is not None else None
+        if refusal:
+            answer("reject", refusal)
+            return
+        reservers = self._reservers()
+        if reservers is None:
+            answer("grant")
+            return
+        with self.lock:
+            self._resv_remote_keys.add((lane, msg.from_osd))
+        reservers["remote_" + lane].request_reservation(
+            (str(self.pgid), lane, msg.from_osd),
+            lambda: answer("grant"), msg.priority,
+            on_preempt=lambda: answer("reject", "preempted"))
+
+    def _handle_reserve_reply(self, msg) -> None:
+        lane = msg.lane
+        granted = False
+        with self.lock:
+            if self._resv_state[lane] != "remote_wait":
+                return             # stale reply from a released round
+            if msg.op == "grant":
+                self._resv_have[lane].add(msg.from_osd)
+                granted = self._resv_have[lane] >= \
+                    self._resv_want[lane]
+                if granted:
+                    self._resv_state[lane] = "granted"
+        if msg.op == "grant":
+            if granted:
+                self._drain_reserved_pushes(lane)
+            return
+        # reject: back out completely so the replicas that DID grant
+        # are not pinned behind us, then park — toofull waits for the
+        # replica to drain, a preempted/busy one retries sooner
+        toofull = getattr(msg, "reason", "") == "toofull"
+        self._release_reservation(
+            lane, keep_pending=True,
+            parked="toofull" if toofull else "idle")
+        self._schedule_resv_retry(lane, 5.0 if toofull else 1.0)
+
+    def _drain_reserved_pushes(self, lane: str) -> None:
+        """Every slot is held: the parked pushes enter the op queue —
+        RECOVERY class, so dmclock keeps client ops at their share."""
+        with self.lock:
+            pending = self._resv_pending[lane]
+            self._resv_pending[lane] = []
+        wq = getattr(self.daemon, "op_wq", None)
+        prio = getattr(self.daemon, "recovery_op_priority", 10)
+        for oid, shard, peer, attempt in pending:
+            if wq is not None:
+                wq.queue(self.pgid, self._push_object, oid, shard,
+                         peer, False, attempt, lane,
+                         klass="recovery", priority=prio)
+            else:
+                self._push_object(oid, shard, peer, False, attempt,
+                                  lane)
+
+    def _release_reservation(self, lane: str, keep_pending=False,
+                             parked: str = "idle") -> None:
+        """Drop the local slot and every remote hold/request for this
+        lane (completion, rejection backout, preemption, interval
+        change — every exit from the round goes through here)."""
+        reservers = self._reservers()
+        if reservers is None:
+            return
+        with self.lock:
+            state = self._resv_state[lane]
+            self._resv_state[lane] = parked
+            want, self._resv_want[lane] = self._resv_want[lane], set()
+            self._resv_have[lane] = set()
+            if not keep_pending:
+                self._resv_pending[lane] = []
+        if state in ("local_wait", "remote_wait", "granted"):
+            reservers["local_" + lane].cancel_reservation(
+                (str(self.pgid), lane))
+            for osd in want:
+                self.send_to_osd(osd, MBackfillReserve(
+                    pgid=self.pgid, from_osd=self.whoami, lane=lane,
+                    op="release", map_epoch=self.map_epoch()))
+
+    def _release_reservations(self) -> None:
+        """Interval change: both primary-side rounds restart and every
+        remote slot we granted a (possibly gone) primary is freed."""
+        for lane in ("recovery", "backfill"):
+            self._release_reservation(lane)
+        reservers = self._reservers()
+        if reservers is None:
+            return
+        with self.lock:
+            remote, self._resv_remote_keys = \
+                self._resv_remote_keys, set()
+        for lane, primary in remote:
+            reservers["remote_" + lane].cancel_reservation(
+                (str(self.pgid), lane, primary))
+
+    def _maybe_release_reservations(self) -> None:
+        """Completion detection: a drained lane (no peer owes objects,
+        nothing parked) gives its slots back immediately — holding a
+        backfill slot through an idle period starves other PGs."""
+        if self._reservers() is None:
+            return
+        with self.lock:
+            rec = (self._resv_state["recovery"] != "idle"
+                   and not self.peer_missing
+                   and not self._resv_pending["recovery"])
+            bf = (self._resv_state["backfill"] != "idle"
+                  and not self.backfilling
+                  and not self._resv_pending["backfill"])
+        if rec:
+            self._release_reservation("recovery")
+        if bf:
+            self._release_reservation("backfill")
+
+    def _schedule_resv_retry(self, lane: str, delay: float) -> None:
+        with self.lock:
+            interval = self.interval
+        timer = getattr(self.daemon, "timer", None)
+        if timer is not None:
+            timer.add_event_after(delay, self._resv_retry, lane,
+                                  interval)
+
+    def _resv_retry(self, lane: str, interval: int) -> None:
+        with self.lock:
+            if interval != self.interval \
+                    or self.acting_primary != self.whoami:
+                return
+            if self._resv_state[lane] not in ("idle", "toofull"):
+                return
+            has_work = bool(self._resv_pending[lane])
+        if has_work:
+            # _request_reservations re-enters from idle/toofull
+            with self.lock:
+                self._resv_state[lane] = "idle"
+            self._request_reservations(lane)
+
     def handle_push(self, msg) -> None:
         """Apply a recovery push to the local shard store."""
         cid = self.cid_of_shard(
@@ -2345,6 +2634,7 @@ class PG:
                         backf.discard(self.whoami)
                         if not backf:
                             self.backfilling.pop(msg.oid, None)
+                self._maybe_release_reservations()
             else:
                 self.send_to_osd(msg.from_osd, MOSDPGNotify(
                     pgid=self.pgid, from_osd=self.whoami,

@@ -1,0 +1,152 @@
+"""AsyncReserver unit tests: slot accounting, priority preemption,
+release-on-cancel (the interval-change path), and the dump surface
+the `dump_reservations` asok serves."""
+
+from ceph_tpu.common.reserver import AsyncReserver
+
+
+def make(max_allowed=1, name="t"):
+    return AsyncReserver(name, max_allowed)
+
+
+class TestSlotAccounting:
+    def test_grants_up_to_max_then_queues(self):
+        r = make(max_allowed=2)
+        granted = []
+        for i in range(4):
+            r.request_reservation("pg%d" % i,
+                                  lambda i=i: granted.append(i),
+                                  prio=10)
+        assert granted == [0, 1]
+        assert r.num_granted() == 2
+        assert r.num_waiting() == 2
+        # a release hands the slot to the queue head, FIFO within prio
+        assert r.cancel_reservation("pg0")
+        assert granted == [0, 1, 2]
+        assert r.num_granted() == 2
+        assert r.num_waiting() == 1
+
+    def test_grant_fires_immediately_when_slot_free(self):
+        r = make(max_allowed=1)
+        fired = []
+        r.request_reservation("a", lambda: fired.append("a"))
+        assert fired == ["a"]
+        assert r.has_reservation("a")
+
+    def test_duplicate_request_is_ignored(self):
+        r = make(max_allowed=1)
+        fired = []
+        r.request_reservation("a", lambda: fired.append("grant"))
+        r.request_reservation("a", lambda: fired.append("dup"))
+        assert fired == ["grant"]
+        # queued duplicates too
+        r.request_reservation("b", lambda: fired.append("b"))
+        r.request_reservation("b", lambda: fired.append("b-dup"))
+        assert r.num_waiting() == 1
+
+    def test_cancel_of_queued_request_withdraws_it(self):
+        r = make(max_allowed=1)
+        fired = []
+        r.request_reservation("a", lambda: fired.append("a"))
+        r.request_reservation("b", lambda: fired.append("b"))
+        assert r.cancel_reservation("b")
+        assert r.num_waiting() == 0
+        # and the slot was never disturbed
+        assert r.has_reservation("a")
+        assert fired == ["a"]
+
+    def test_cancel_unknown_item_returns_false(self):
+        r = make()
+        assert not r.cancel_reservation("ghost")
+
+    def test_higher_priority_queue_served_first(self):
+        r = make(max_allowed=1)
+        order = []
+        r.request_reservation("holder", lambda: order.append("h"),
+                              prio=200)
+        r.request_reservation("low-wait", lambda: order.append("lo"),
+                              prio=90)
+        r.request_reservation("hi-wait", lambda: order.append("hi"),
+                              prio=95)
+        assert order == ["h"]
+        # on release the higher-priority bucket drains first
+        r.cancel_reservation("holder")
+        assert order == ["h", "hi"]
+        r.cancel_reservation("hi-wait")
+        assert order == ["h", "hi", "lo"]
+
+    def test_set_max_zero_parks_everything(self):
+        r = make(max_allowed=2)
+        r.request_reservation("a", lambda: None)
+        r.set_max(0)
+        # existing grants stay (ceph semantics: shrinking max never
+        # revokes), but new requests queue
+        fired = []
+        r.request_reservation("b", lambda: fired.append("b"))
+        assert fired == []
+        assert r.num_waiting() == 1
+        r.set_max(2)
+        assert fired == ["b"]
+
+
+class TestPreemption:
+    def test_strictly_higher_priority_preempts_lowest_holder(self):
+        r = make(max_allowed=2)
+        events = []
+        r.request_reservation(
+            "backfill-pg", lambda: events.append("bf-grant"), prio=90,
+            on_preempt=lambda: events.append("bf-preempt"))
+        r.request_reservation(
+            "backfill-pg2", lambda: events.append("bf2-grant"), prio=92,
+            on_preempt=lambda: events.append("bf2-preempt"))
+        r.request_reservation(
+            "recovery-pg", lambda: events.append("rec-grant"), prio=180)
+        # the LOWEST-priority holder (prio 90) was evicted
+        assert events == ["bf-grant", "bf2-grant", "bf-preempt",
+                          "rec-grant"]
+        assert not r.has_reservation("backfill-pg")
+        assert r.has_reservation("recovery-pg")
+        assert r.has_reservation("backfill-pg2")
+        assert r.preempted_total == 1
+        assert r.granted_total == 3
+
+    def test_equal_priority_does_not_preempt(self):
+        r = make(max_allowed=1)
+        events = []
+        r.request_reservation("a", lambda: events.append("a"), prio=90,
+                              on_preempt=lambda: events.append("a-pre"))
+        r.request_reservation("b", lambda: events.append("b"), prio=90)
+        assert events == ["a"]
+        assert r.num_waiting() == 1
+
+    def test_preempted_item_can_rerequest(self):
+        r = make(max_allowed=1)
+        events = []
+        r.request_reservation("victim", lambda: events.append("v"),
+                              prio=90,
+                              on_preempt=lambda: events.append("v-pre"))
+        r.request_reservation("bully", lambda: events.append("bully"),
+                              prio=180)
+        assert events == ["v", "v-pre", "bully"]
+        # the preempted PG retries (its _reservation_preempted path)
+        r.request_reservation("victim", lambda: events.append("v2"),
+                              prio=90)
+        assert r.num_waiting() == 1
+        r.cancel_reservation("bully")
+        assert events[-1] == "v2"
+
+
+class TestDump:
+    def test_dump_shape_and_counters(self):
+        r = make(max_allowed=1, name="local_backfill")
+        r.request_reservation("1.0", lambda: None, prio=90)
+        r.request_reservation("1.1", lambda: None, prio=90)
+        r.request_reservation("2.0", lambda: None, prio=95)
+        d = r.dump()
+        assert d["max_allowed"] == 1
+        # prio-95 preempted the prio-90 holder
+        assert [g["item"] for g in d["granted"]] == ["2.0"]
+        # waiting listed highest priority first
+        assert [w["item"] for w in d["waiting"]] == ["1.1"]
+        assert d["granted_total"] == 2
+        assert d["preempted_total"] == 1

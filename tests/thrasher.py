@@ -21,14 +21,19 @@ __all__ = ["Thrasher"]
 
 class Thrasher:
     def __init__(self, cluster, seed: int = 0, min_in: int = 2,
-                 interval: float = 0.5, revive_delay: float = 0.8):
+                 interval: float = 0.5, revive_delay: float = 0.8,
+                 partition_prob: float = 0.0,
+                 mon_thrash_prob: float = 0.0):
         self.cluster = cluster
         self.rng = random.Random(seed)
         self.min_in = min_in
         self.interval = interval
         self.revive_delay = revive_delay
+        self.partition_prob = partition_prob
+        self.mon_thrash_prob = mon_thrash_prob
         self.dead: dict[int, object] = {}     # osd_id -> store
-        self.log: list[tuple[str, int]] = []
+        self.partitions: set[tuple[int, int]] = set()  # (a, b) pairs
+        self.log: list[tuple] = []
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.errors: list[str] = []
@@ -38,22 +43,24 @@ class Thrasher:
     def _alive(self) -> list[int]:
         return sorted(set(self.cluster.osds) - set(self.dead))
 
-    def _journal(self, action: str, osd_id: int) -> None:
+    def _journal(self, action: str, what: str, **data) -> None:
         """Record the injected fault in the mon's cluster event
         journal, so `ceph events last` interleaves what the thrasher
         DID with how the cluster REACTED (down/out epochs, health
-        transitions). Best-effort: journaling must never change the
-        thrash behavior itself."""
+        transitions). Journaling must never change the thrash behavior
+        itself, but a failure to journal is a FINDING (a dead event
+        path mid-thrash), so it lands in self.errors instead of being
+        swallowed."""
         try:
             leader = self.cluster.leader()
             eventmon = getattr(leader, "eventmon", None)
             if eventmon is not None:
                 eventmon.submit(
-                    "thrash", "thrasher: %s osd.%d" % (action, osd_id),
+                    "thrash", "thrasher: %s %s" % (action, what),
                     source="thrasher",
-                    data={"action": action, "osd": osd_id})
-        except Exception:
-            pass
+                    data=dict(data, action=action))
+        except Exception as e:
+            self.errors.append("journal(%s %s): %r" % (action, what, e))
 
     def kill_one(self) -> int | None:
         alive = self._alive()
@@ -63,7 +70,7 @@ class Thrasher:
         store = self.cluster.stop_osd(victim)
         self.dead[victim] = store
         self.log.append(("kill", victim))
-        self._journal("kill", victim)
+        self._journal("kill", "osd.%d" % victim, osd=victim)
         return victim
 
     def revive_one(self) -> int | None:
@@ -72,23 +79,132 @@ class Thrasher:
         osd_id = self.rng.choice(sorted(self.dead))
         store = self.dead.pop(osd_id)
         self.cluster.revive_osd(osd_id, store=store)
+        # a revived daemon boots with fresh messengers: re-apply any
+        # standing partition it is party to, or the blackhole would
+        # silently evaporate on the revived side
+        for a, b in self.partitions:
+            if osd_id in (a, b):
+                self._set_blocked(osd_id, b if osd_id == a else a, True)
         # an auto-marked-out osd needs an explicit "in" (ceph_manager
-        # revive_osd does the same)
+        # revive_osd does the same); a command that keeps failing even
+        # with retries is a real finding — record it, don't swallow it
         client = self.cluster.clients[0] if self.cluster.clients else None
         if client is not None:
-            try:
-                client.mon_command({"prefix": "osd in", "id": osd_id})
-            except Exception:
-                pass
+            for attempt in range(3):
+                try:
+                    client.mon_command({"prefix": "osd in",
+                                        "id": osd_id})
+                    break
+                except Exception as e:
+                    if attempt == 2:
+                        self.errors.append(
+                            "revive osd.%d: 'osd in' failed: %r"
+                            % (osd_id, e))
+                    else:
+                        time.sleep(0.3)
         self.log.append(("revive", osd_id))
-        self._journal("revive", osd_id)
+        self._journal("revive", "osd.%d" % osd_id, osd=osd_id)
         return osd_id
+
+    # -- network partitions (blackhole both directions) ----------------
+
+    def _set_blocked(self, victim: int, peer: int, blocked: bool) -> None:
+        """(Un)blackhole frames FROM osd.peer on every transport of
+        osd.victim (public / cluster / heartbeat)."""
+        daemon = self.cluster.osds.get(victim)
+        if daemon is None:
+            return
+        for msgr in (daemon.public_msgr, daemon.cluster_msgr,
+                     daemon.hb_msgr):
+            if blocked:
+                msgr.block_peer(("osd", peer))
+            else:
+                msgr.unblock_peer(("osd", peer))
+
+    def partition(self, a: int, b: int) -> None:
+        """Blackhole osd.a <-> osd.b: each side's messengers kill any
+        pipe delivering a frame from the other, so heartbeats stop
+        flowing and the peers report each other down (MOSDFailure)
+        while both stay mon-reachable — the classic partial-partition
+        failure the reference thrashes with iptables DROP rules."""
+        self._set_blocked(a, b, True)
+        self._set_blocked(b, a, True)
+        self.partitions.add((min(a, b), max(a, b)))
+        self.log.append(("partition", a, b))
+        self._journal("partition", "osd.%d <-> osd.%d" % (a, b),
+                      a=a, b=b)
+
+    def heal(self) -> None:
+        """Lift every standing partition (both directions); the
+        messengers' lossless resend machinery redelivers whatever was
+        blackholed once the pipes reconnect."""
+        while self.partitions:
+            a, b = self.partitions.pop()
+            self._set_blocked(a, b, False)
+            self._set_blocked(b, a, False)
+            self.log.append(("heal", a, b))
+            self._journal("heal", "osd.%d <-> osd.%d" % (a, b),
+                          a=a, b=b)
+
+    # -- mon thrash (MonitorThrasher kill/revive) ----------------------
+
+    def thrash_mon(self) -> int | None:
+        """Kill the paxos LEADER and boot a state-empty replacement in
+        its place: the survivors re-elect among themselves, and the
+        rejoining mon catches up through the paxos full-state sync.
+        Needs >= 3 mons so quorum survives the kill."""
+        mons = self.cluster.mons
+        if len(mons) < 3:
+            return None
+        leader = next((m for m in mons if m.is_leader()), None)
+        if leader is None:
+            return None
+        rank, idx = leader.rank, mons.index(leader)
+        self.log.append(("mon_kill", rank))
+        self._journal("mon kill", "mon.%d (leader)" % rank, mon=rank)
+        leader.shutdown()
+        # let the survivors elect before the empty-stated rank is back
+        # on the wire (mirrors a real restart's crash->reboot gap)
+        wait_until(lambda: any(m.is_leader() for m in mons
+                               if m is not leader), timeout=30)
+        from ceph_tpu.common import Context
+        from ceph_tpu.mon import Monitor
+        kwargs = {}
+        if getattr(self.cluster, "auth", False):
+            from ceph_tpu.auth.keyring import KeyRing
+            kwargs = {"keyring":
+                      KeyRing.parse(self.cluster.keyring.emit()),
+                      "service_secrets": self.cluster.service_secrets}
+        mon = Monitor(rank, self.cluster.monmap,
+                      Context(self.cluster.conf_overrides,
+                              name="mon.%d" % rank), **kwargs)
+        mon.init()
+        if self.cluster.mgr is not None:
+            mon.mgr_addr = self.cluster.mgr.addr
+        mons[idx] = mon
+        self.log.append(("mon_revive", rank))
+        self._journal("mon revive", "mon.%d" % rank, mon=rank)
+        return rank
 
     # -- loop ----------------------------------------------------------
 
     def _run(self) -> None:
         try:
             while not self._stop.is_set():
+                # rare chaos riders first (off by default): a mon
+                # leader bounce, or a partition toggle
+                if self.mon_thrash_prob and \
+                        self.rng.random() < self.mon_thrash_prob:
+                    self.thrash_mon()
+                if self.partition_prob and \
+                        self.rng.random() < self.partition_prob:
+                    if self.partitions:
+                        self.heal()
+                    else:
+                        alive = self._alive()
+                        if len(alive) >= 2:
+                            a, b = self.rng.sample(alive, 2)
+                            self.partition(a, b)
                 # weighted choice mirroring the reference's thrasher:
                 # mostly kill/revive churn
                 if self.dead and (len(self._alive()) <= self.min_in
@@ -111,6 +227,7 @@ class Thrasher:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        self.heal()
         while self.dead:
             self.revive_one()
         assert wait_until(self.cluster.all_osds_up, timeout=timeout), \
