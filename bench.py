@@ -1947,6 +1947,146 @@ def run_recovery(out_path: str | None = None) -> dict:
     return doc
 
 
+def run_attribution(out_path: str | None = None) -> dict:
+    """Attribution-fidelity artifact (ROADMAP direction B): the
+    per-client perf-query engine's accounting vs the OSDs' own
+    op_in_bytes ground truth.
+
+    Three legs against one MiniCluster:
+
+      1. Byte fidelity: 8 clients with known unequal write weights
+         drive a replicated pool; the bytes attributed by the engines'
+         (client, pool) tables are compared against the summed
+         l_osd_op_in_bytes delta over the same interval.
+      2. Ranking: the generator knows which client was heaviest; both
+         the raw engine sum and the mgr module's merged
+         top_clients() view must rank it first.
+      3. Key churn: a dedicated max_keys=32 query on a live OSD takes
+         320 distinct client sessions; the table must stay bounded
+         with every displacement counted.
+
+    HARD GATES (SystemExit): attributed bytes >= 95% of the
+    op_in_bytes delta; the known-heaviest client ranks first in both
+    views; the churn table never exceeds its bound and evictions
+    account for every displaced key."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_util import MiniCluster, wait_until
+
+    from ceph_tpu.mgr import PerfQueryModule
+
+    doc: dict = {"metric": "attributed_byte_fraction",
+                 "unit": "fraction"}
+    c = MiniCluster(num_mons=1, num_osds=3,
+                    conf_overrides={"osd_tracing": False,
+                                    "osd_profiler": False,
+                                    "osd_heartbeat_interval": 0.1,
+                                    "osd_heartbeat_grace": 0.6,
+                                    "paxos_propose_interval": 0.02,
+                                    "mgr_stats_period": 0.25})
+    c.start()
+    try:
+        mgr = c.start_mgr(modules=(PerfQueryModule,))
+        admin = c.client()
+        pool_id = c.create_replicated_pool(admin, "attrpool",
+                                           size=2, pg_num=8)
+        if not c.wait_clean(pool_id):
+            raise SystemExit("attribution gate: pool never went clean")
+        if not wait_until(lambda: all(o.perf_query.active
+                                      for o in c.osds.values()),
+                          timeout=20):
+            raise SystemExit("attribution gate: default perf queries "
+                             "never reached the OSD engines")
+
+        # -- byte-fidelity + ranking leg ------------------------------
+        base = sum(o.perf.get("op_in_bytes") for o in c.osds.values())
+        weights = [2, 3, 4, 5, 6, 8, 10, 24]    # ops per client
+        payload = b"a" * 8192
+        clients = [c.client() for _ in weights]
+        for w, cl in zip(weights, clients):
+            io = cl.open_ioctx("attrpool")
+            for i in range(w):
+                io.write_full("att-%d-%d" % (cl.client_id, i), payload)
+        heavy = clients[-1]
+        heavy_prefix = "client.%d:" % heavy.client_id
+        delta = sum(o.perf.get("op_in_bytes")
+                    for o in c.osds.values()) - base
+
+        per_client: dict[str, int] = {}
+        for osd in c.osds.values():
+            for dump in osd.perf_query.dump().values():
+                if dump["key_by"] != ["client", "pool"]:
+                    continue
+                for row in dump["keys"]:
+                    per_client[row["k"][0]] = (
+                        per_client.get(row["k"][0], 0)
+                        + row["wr_bytes"] + row["rd_bytes"])
+        attributed = sum(per_client.values())
+        frac = attributed / max(delta, 1)
+        doc["op_in_bytes_delta"] = delta
+        doc["attributed_bytes"] = attributed
+        doc["attributed_fraction"] = round(frac, 4)
+        doc["per_client_bytes"] = {k: per_client[k]
+                                   for k in sorted(per_client)}
+        if frac < 0.95:
+            raise SystemExit("attribution gate: engines attributed "
+                             "only %.1f%% of op_in_bytes"
+                             % (frac * 100))
+
+        ranking = sorted(per_client, key=lambda k: -per_client[k])
+        doc["engine_ranking"] = ranking
+        if not ranking or not ranking[0].startswith(heavy_prefix):
+            raise SystemExit("attribution gate: engine ranking top is "
+                             "%r, expected the known-heaviest %s*"
+                             % (ranking[:1], heavy_prefix))
+        mod = mgr.modules["perf_query"]
+
+        def mgr_agrees():
+            top = mod.top_clients(n=3, window=60.0)
+            return bool(top) and top[0]["client"].startswith(
+                heavy_prefix)
+        if not wait_until(mgr_agrees, timeout=15, interval=0.3):
+            raise SystemExit("attribution gate: mgr top_clients never "
+                             "ranked the known-heaviest client first")
+        doc["mgr_top_clients"] = mod.top_clients(n=3, window=60.0)
+
+        # -- key-churn leg --------------------------------------------
+        import types as _types
+        eng = c.osds[0].perf_query
+        eng.add_query(99, {"key_by": ["client"], "max_keys": 32})
+        for i in range(320):
+            eng.account(_types.SimpleNamespace(
+                client_id=1000 + i, session="%032x" % i,
+                oid="churn", ops=[("write_full", b"x")]),
+                "attrpool", "1.0", False, 64, 0, 0.001)
+        q = eng._queries[99]
+        doc["churn"] = {"accounted": 320, "max_keys": 32,
+                        "table_size": len(q.table),
+                        "evictions": q.evictions}
+        if len(q.table) > 32 or q.evictions != 320 - 32:
+            raise SystemExit("attribution gate: churn table size %d / "
+                             "evictions %d (want <=32 / 288)"
+                             % (len(q.table), q.evictions))
+        for qd in eng.dump().values():
+            if len(qd["keys"]) > 256:
+                raise SystemExit("attribution gate: a query table "
+                                 "escaped its max_keys bound")
+        eng.remove_query(99)
+    finally:
+        c.stop()
+
+    doc["value"] = doc["attributed_fraction"]
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "ATTRIBUTION_r01.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(doc))
+    return doc
+
+
 def main() -> None:
     import jax
 
@@ -1960,6 +2100,9 @@ def main() -> None:
         return
     if "--recovery" in sys.argv:
         run_recovery()
+        return
+    if "--attribution" in sys.argv:
+        run_attribution()
         return
     run_bench()
 
@@ -2558,6 +2701,10 @@ if __name__ == "__main__":
     elif "--recovery" in sys.argv:
         # repair-bandwidth artifact: gates + cluster leg, no supervisor
         run_recovery()
+    elif "--attribution" in sys.argv:
+        # attribution-fidelity artifact: gates + cluster leg, no
+        # supervisor (no device rows)
+        run_attribution()
     elif "--worker" in sys.argv:
         main()
     else:

@@ -205,6 +205,31 @@ def _declare_defaults():
       "trace 1 in N root ops (hot-path sampling knob; 1 = every op)")
     o("osd_tracing_max_spans", int, 8192, LEVEL_ADVANCED,
       "per-daemon bounded span ring capacity (oldest spans drop)")
+    # per-principal perf queries (osd/perf_query.py + mgr/perf_query.py)
+    o("osd_perf_query_max_keys", int, 256, LEVEL_ADVANCED,
+      "bound on distinct keys one OSD-side perf query accumulates; "
+      "beyond it the least-recently-updated key is evicted, so a "
+      "million clients cannot grow OSD memory past the table "
+      "(osd_perf_query top-K table role)")
+    o("osd_perf_query_key_age", float, 30.0, LEVEL_ADVANCED,
+      "seconds a perf-query key may sit idle before the OSD drops it "
+      "(a disconnected client's key stops riding MMgrReport)")
+    o("mgr_perf_query_client_age", float, 10.0, LEVEL_ADVANCED,
+      "seconds without fresh samples before a client/pool key ages "
+      "out of the mgr's merged iotop views and the prometheus page")
+    o("mgr_perf_query_prom_top_n", int, 10, LEVEL_ADVANCED,
+      "labeled per-client series exported to prometheus: only the "
+      "top-N keys by op rate get ceph_client_* series, so exposition "
+      "cardinality stays capped by construction")
+    o("mgr_slo_pool_targets", str, "", LEVEL_ADVANCED,
+      "per-pool latency SLOs as 'pool:latency_ms:objective' entries "
+      "separated by commas (e.g. 'rbd:50:0.99,cold:200:0.95'): ops "
+      "slower than latency_ms count as violations; when the rolling "
+      "violation fraction exceeds 1-objective the burn ratio passes "
+      "1.0 and POOL_SLO_VIOLATION raises")
+    o("mgr_slo_window", float, 10.0, LEVEL_ADVANCED,
+      "rolling window (seconds) over which the per-pool SLO "
+      "violation fraction is computed")
     # mgr telemetry (the MMgrReport stream + the mgr-side aggregation)
     o("mgr_stats_period", float, 0.5, LEVEL_BASIC,
       "seconds between a daemon's MMgrReport perf/telemetry reports "

@@ -7,8 +7,9 @@ cluster state to pluggable Python modules (prometheus exporter,
 status/dashboard, restful). Here modules subclass MgrModule
 (mirroring src/pybind/mgr/mgr_module.py:33) and the bundled modules
 are `prometheus` (text exposition format), `status`, `balancer`
-(upmap mode, riding the batched device CRUSH sweep), and `progress`
-(recovery-convergence narration).
+(upmap mode, riding the batched device CRUSH sweep), `progress`
+(recovery-convergence narration), and `perf_query` (per-client/
+per-pool attribution + latency-SLO burn alerts).
 """
 
 from .daemon_state import DaemonStateIndex  # noqa: F401
@@ -17,4 +18,5 @@ from .mgr_daemon import MgrDaemon  # noqa: F401
 from .mgr_module import MgrModule  # noqa: F401
 from .modules import (BalancerModule, PrometheusModule,  # noqa: F401
                       StatusModule)
+from .perf_query import PerfQueryModule  # noqa: F401
 from .progress import ProgressModule  # noqa: F401

@@ -37,7 +37,7 @@ __all__ = ["MetricsAggregator"]
 
 class _Series:
     __slots__ = ("snaps", "status", "pg_stats", "schema", "last_ts",
-                 "daemon_type")
+                 "daemon_type", "pq_snaps")
 
     def __init__(self, history: int):
         self.snaps: deque = deque(maxlen=history)   # (ts, perf dict)
@@ -46,6 +46,10 @@ class _Series:
         self.schema: dict = {}         # group -> {counter: {type,...}}
         self.last_ts = 0.0
         self.daemon_type = ""
+        # (ts, perf_query payload) ring: the OSD's per-principal key
+        # tables, windowed the same way perf snapshots are so the
+        # perf_query module can diff endpoints into rates
+        self.pq_snaps: deque = deque(maxlen=history)
 
 
 def _counter_value(val):
@@ -72,7 +76,8 @@ class MetricsAggregator:
 
     def record(self, daemon: str, perf: dict, status: dict | None = None,
                pg_stats: dict | None = None, schema: dict | None = None,
-               daemon_type: str = "", now: float | None = None) -> None:
+               daemon_type: str = "", now: float | None = None,
+               perf_query: dict | None = None) -> None:
         now = time.monotonic() if now is None else now
         with self._lock:
             s = self._series.get(daemon)
@@ -87,6 +92,11 @@ class MetricsAggregator:
                 s.schema = dict(schema)
             if daemon_type:
                 s.daemon_type = daemon_type
+            if perf_query is not None:
+                # {} is a real observation ("no live queries / no
+                # keys"), not a gap — recording it lets vanished
+                # clients age out of the windowed views
+                s.pq_snaps.append((now, perf_query))
             s.last_ts = now
 
     def record_value(self, key: str, value: float,
@@ -149,10 +159,11 @@ class MetricsAggregator:
             s = self._series.get(daemon)
             return dict(s.schema) if s else {}
 
-    def _window_pair(self, daemon: str, window: float | None,
-                     now: float | None):
-        """(oldest-in-window, newest) snapshots, or None when fewer
-        than two samples land inside the window."""
+    def _window_snaps(self, daemon: str, window: float | None,
+                      now: float | None) -> list | None:
+        """Every snapshot inside the lookback window, oldest first, or
+        None when fewer than two land inside it (or the daemon is
+        stale/unknown)."""
         window = self.window if window is None else window
         now = time.monotonic() if now is None else now
         with self._lock:
@@ -164,7 +175,40 @@ class MetricsAggregator:
             snaps = [sn for sn in s.snaps if now - sn[0] <= window]
         if len(snaps) < 2:
             return None
+        return snaps
+
+    def _window_pair(self, daemon: str, window: float | None,
+                     now: float | None):
+        """(oldest-in-window, newest) snapshots, or None when fewer
+        than two samples land inside the window."""
+        snaps = self._window_snaps(daemon, window, now)
+        if snaps is None:
+            return None
         return snaps[0], snaps[-1]
+
+    def perf_query_window(self, daemon: str,
+                          window: float | None = None,
+                          now: float | None = None):
+        """(oldest-in-window, newest) (ts, perf_query payload) pairs
+        for the per-principal views, or None — same staleness and
+        window rules as the perf snapshots."""
+        window = self.window if window is None else window
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            s = self._series.get(daemon)
+            if s is None or len(s.pq_snaps) < 2:
+                return None
+            if now - s.last_ts > self.stale_after:
+                return None
+            snaps = [sn for sn in s.pq_snaps if now - sn[0] <= window]
+        if len(snaps) < 2:
+            return None
+        return snaps[0], snaps[-1]
+
+    def perf_query_latest(self, daemon: str) -> dict:
+        with self._lock:
+            s = self._series.get(daemon)
+            return dict(s.pq_snaps[-1][1]) if s and s.pq_snaps else {}
 
     @staticmethod
     def _lookup(perf: dict, group: str, counter: str):
@@ -177,16 +221,30 @@ class MetricsAggregator:
              now: float | None = None) -> float:
         """Counter delta / Δt over the lookback window (ops/s,
         bytes/s).  0.0 when the daemon is stale, unknown, or the
-        window holds fewer than two snapshots."""
-        pair = self._window_pair(daemon, window, now)
-        if pair is None:
+        window holds fewer than two snapshots.
+
+        Counter-reset handling: a restarted daemon's counters restart
+        from zero, so a naive endpoint delta goes NEGATIVE across the
+        bounce.  The window restarts at the last snapshot where the
+        value stepped backwards — the derivation covers only the
+        post-reset segment, and a reset landing on the newest snapshot
+        derives nothing until a second post-reset sample arrives."""
+        snaps = self._window_snaps(daemon, window, now)
+        if snaps is None:
             return 0.0
-        (t0, p0), (t1, p1) = pair
+        vals = []
+        for ts, p in snaps:
+            v = _counter_value(self._lookup(p, group, counter))
+            if v is not None:
+                vals.append((ts, v))
+        if len(vals) < 2:
+            return 0.0
+        start = 0
+        for i in range(1, len(vals)):
+            if vals[i][1] < vals[i - 1][1]:
+                start = i              # reset: fresh window from here
+        (t0, v0), (t1, v1) = vals[start], vals[-1]
         if t1 <= t0:
-            return 0.0
-        v0 = _counter_value(self._lookup(p0, group, counter))
-        v1 = _counter_value(self._lookup(p1, group, counter))
-        if v0 is None or v1 is None:
             return 0.0
         return max(0.0, (v1 - v0) / (t1 - t0))
 
@@ -209,11 +267,18 @@ class MetricsAggregator:
         if not isinstance(v0, dict) or not isinstance(v1, dict):
             return 0.0
         dc = v1.get("avgcount", 0) - v0.get("avgcount", 0)
-        if dc <= 0:
+        ds = v1.get("sum", 0.0) - v0.get("sum", 0.0)
+        if dc <= 0 or ds < 0:
+            # dc < 0 or ds < 0 is a counter reset (daemon bounced):
+            # the new daemon's lifetime IS the fresh window, so its
+            # since-boot average is the windowed answer — and a
+            # negative Δsum with positive Δcount (bounced daemon
+            # already past the old sample count) must never surface
+            # as a negative latency
             if v1.get("avgcount"):
                 return v1["sum"] / v1["avgcount"]
             return 0.0
-        return (v1.get("sum", 0.0) - v0.get("sum", 0.0)) / dc
+        return ds / dc
 
     def _bucket_bounds(self, daemon: str, group: str,
                        counter: str) -> list:
@@ -241,6 +306,11 @@ class MetricsAggregator:
             b1 = h1.get("buckets") or []
             if len(b0) == len(b1):
                 buckets = [a - b for a, b in zip(b1, b0)]
+                if any(n < 0 for n in buckets):
+                    # counter reset mid-window (daemon bounced): the
+                    # cumulative fills restarted, so the newest fills
+                    # ARE the fresh-window distribution
+                    buckets = list(b1)
             else:
                 buckets = list(b1)
         else:

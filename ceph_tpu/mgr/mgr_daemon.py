@@ -117,6 +117,55 @@ class MgrDaemon(Dispatcher):
                 window=float(args["window"])
                 if args.get("window") else None),
             "cluster read/write ops/s and MB/s over the window")
+        # per-principal attribution surfaces (mgr/perf_query.py); the
+        # module registers lazily so the hooks look it up per call
+        asok.register(
+            "iotop",
+            lambda args: self._perf_query_asok(
+                "iotop",
+                window=float(args["window"])
+                if args.get("window") else None,
+                count=int(args.get("count") or 20)),
+            "top clients by ops/s, MB/s and p99 latency")
+        asok.register(
+            "slo status",
+            lambda args: self._perf_query_asok("slo_status"),
+            "per-pool latency SLO violation fractions + burn ratios")
+        asok.register(
+            "perf query",
+            self._perf_query_control,
+            "add/rm/ls dynamic per-principal OSD perf queries")
+
+    def _perf_query_asok(self, method: str, **kwargs):
+        mod = self.modules.get("perf_query")
+        if mod is None:
+            return {"error": "perf_query module not enabled"}
+        return getattr(mod, method)(**kwargs)
+
+    def _perf_query_control(self, args: dict):
+        mod = self.modules.get("perf_query")
+        if mod is None:
+            return {"error": "perf_query module not enabled"}
+        op = args.get("op", "ls")
+        if op == "add":
+            spec = {}
+            kb = args.get("key_by")
+            if kb:
+                spec["key_by"] = ([s.strip() for s in kb.split(",")
+                                   if s.strip()]
+                                  if isinstance(kb, str) else list(kb))
+            for k in ("pool", "object_prefix"):
+                if args.get(k):
+                    spec[k] = args[k]
+            if args.get("max_keys"):
+                spec["max_keys"] = int(args["max_keys"])
+            return {"query_id": mod.add_query(spec), "spec": spec}
+        if op in ("rm", "remove"):
+            qid = int(args["query_id"])
+            return {"removed": mod.remove_query(qid), "query_id": qid}
+        if op == "ls":
+            return {"queries": mod.list_queries()}
+        return {"error": "unknown op %r (want add|rm|ls)" % op}
 
     @property
     def addr(self):
@@ -200,8 +249,19 @@ class MgrDaemon(Dispatcher):
                 status=getattr(msg, "status", None) or None,
                 pg_stats=getattr(msg, "pg_stats", None),
                 schema=getattr(msg, "perf_schema", None) or None,
-                daemon_type=getattr(msg, "daemon_type", ""))
+                daemon_type=getattr(msg, "daemon_type", ""),
+                perf_query=(getattr(msg, "perf_query", None)
+                            if getattr(msg, "daemon_type", "") == "osd"
+                            else None))
             self._notify_all("perf_schema", msg.daemon_name)
+            return True
+        if msg.get_type() == "MOSDPerfQueryReply":
+            mod = self.modules.get("perf_query")
+            if mod is not None:
+                try:
+                    mod.handle_query_reply(msg)
+                except Exception:
+                    pass
             return True
         return False
 

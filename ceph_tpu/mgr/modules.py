@@ -308,6 +308,37 @@ class PrometheusModule(MgrModule):
                      {"event_id": ev["id"]},
                      help_="completion fraction of an active "
                            "progress event")
+        # per-client attribution (mgr/perf_query.py): only the bounded
+        # top-N rows are exported — client labels are unbounded-
+        # cardinality input, and a stale client's series leave the page
+        # with the module's ageout (same discipline as progress events
+        # and stale daemons).  Labels pass through _escape_label, so
+        # hostile client/pool names (quotes, backslashes, newlines)
+        # stay inside the exposition grammar.
+        pq = self.mgr.modules.get("perf_query")
+        if pq is not None and hasattr(pq, "top_clients"):
+            for row in pq.top_clients(n=getattr(pq, "prom_top_n", 10)):
+                clbl = {"client": row["client"], "pool": row["pool"]}
+                emit("ceph_client_op_rate", row["ops_rate"], clbl,
+                     help_="attributed ops/s of a top-N client on a "
+                           "pool (bounded-cardinality export)")
+                emit("ceph_client_byte_rate", row["MBps"] * 1e6, clbl,
+                     help_="attributed bytes/s of a top-N client on a "
+                           "pool")
+                emit("ceph_client_p99_latency_seconds",
+                     row["p99_ms"] / 1e3, clbl,
+                     help_="attributed p99 op latency of a top-N "
+                           "client on a pool")
+            if hasattr(pq, "slo_status"):
+                slo = pq.slo_status()
+                for pool, r in sorted(slo.get("pools", {}).items()):
+                    plbl = {"pool": pool}
+                    emit("ceph_pool_slo_burn_ratio",
+                         r.get("burn_ratio", 0.0), plbl,
+                         help_="SLO violation fraction / error budget; "
+                               ">1.0 raises POOL_SLO_VIOLATION")
+                    emit("ceph_pool_slo_violation_fraction",
+                         r.get("violation_fraction", 0.0), plbl)
         # per-daemon perf counters (reference: perf_counters as
         # ceph_<daemon-type>_<counter>{ceph_daemon=...}); this includes
         # the l_bluefs_* and l_tpu_* groups the OSDs register.
@@ -468,6 +499,16 @@ class StatusModule(MgrModule):
                     % (io["read_MBps"], io["write_MBps"],
                        recov["recovery_MBps"],
                        recov["recovery_op_per_sec"]))
+            # per-client attribution teaser (the full table is
+            # `ceph iotop`): top-3 by ops/s, beside io:/progress:
+            pq = self.mgr.modules.get("perf_query")
+            if pq is not None and hasattr(pq, "top_clients"):
+                top = pq.top_clients(n=3)
+                if top:
+                    out += "\n  top clients:\n    " + "\n    ".join(
+                        "%s (%s): %.1f op/s, %.1f MB/s"
+                        % (r["client"], r["pool"], r["ops_rate"],
+                           r["MBps"]) for r in top)
             # active progress bars (mgr progress module narration)
             progress = self.mgr.modules.get("progress")
             if progress is not None and \

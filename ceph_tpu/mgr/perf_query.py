@@ -1,0 +1,548 @@
+"""PerfQueryModule: cluster-wide per-client/per-pool attribution.
+
+The mgr half of the dynamic perf-query pipeline (the reference's
+OSDPerfMetricQuery + `rbd perf image iotop` flow): this module owns
+the cluster's query subscription table, broadcasts it to every up OSD
+(MOSDPerfQuery, re-broadcast on each osdmap change so late-booting
+OSDs catch up — the OSD-side add is idempotent), and merges the
+per-OSD key tables riding MMgrReport.perf_query into cluster-wide
+views: top clients by ops/s, MB/s and p99 (`ceph iotop`), per-pool
+latency distributions, and the per-pool SLO burn ratios behind
+POOL_SLO_VIOLATION.
+
+Ageout is two-layered: the OSD drops keys idle past
+osd_perf_query_key_age, and the mgr additionally hides keys that
+showed no samples within mgr_perf_query_client_age — a vanished
+client leaves the iotop view and the Prometheus page without any
+operator action, exactly like a stale daemon's series.
+
+SLO burn: `mgr_slo_pool_targets` entries 'pool:latency_ms:objective'
+declare "objective of ops must finish under latency_ms".  The rolling
+violation fraction comes from the pool-keyed query's windowed latency
+histogram; burn = fraction / (1 - objective), so burn > 1.0 means the
+pool is violating its SLO and POOL_SLO_VIOLATION raises (on the mgr's
+own health AND on the mon, posted from a worker thread — notify()
+runs on the mon-connection dispatch thread, where an inline
+mon.command would deadlock, the progress module's journal lesson).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from .mgr_module import MgrModule
+
+__all__ = ["PerfQueryModule"]
+
+#: counters a key row carries (osd/perf_query.py _KeyStats.dump)
+_ROW_COUNTERS = ("ops", "rd_ops", "wr_ops", "rd_bytes", "wr_bytes",
+                 "lat_sum", "lat_count")
+
+
+def _parse_slo_targets(raw: str) -> dict:
+    """'pool:latency_ms:objective,...' -> {pool: (threshold_s,
+    objective)}; malformed entries are skipped, never fatal."""
+    out = {}
+    for entry in (raw or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.rsplit(":", 2)
+        if len(parts) != 3:
+            continue
+        pool, lat_ms, objective = parts
+        try:
+            lat_s = float(lat_ms) / 1e3
+            obj = float(objective)
+        except ValueError:
+            continue
+        if not pool or lat_s <= 0 or not 0.0 < obj < 1.0:
+            continue
+        out[pool] = (lat_s, obj)
+    return out
+
+
+def _hist_percentile(buckets: list, bounds: list, q: float) -> float:
+    """q-quantile (upper-bound interpolated) of a bucket-fill
+    histogram, in the bounds' unit; 0.0 on an empty histogram."""
+    total = sum(buckets)
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    cum = 0.0
+    for i, n in enumerate(buckets):
+        if n <= 0:
+            continue
+        if cum + n >= rank:
+            if i >= len(bounds):
+                return float(bounds[-1])
+            lo = 0.0 if i == 0 else float(bounds[i - 1])
+            hi = float(bounds[i])
+            return lo + (hi - lo) * max(0.0, rank - cum) / n
+        cum += n
+    return float(bounds[-1])
+
+
+class PerfQueryModule(MgrModule):
+    COMMANDS = [
+        {"cmd": "iotop",
+         "desc": "top clients by ops/s, MB/s and p99 latency"},
+        {"cmd": "osd perf query",
+         "desc": "add/rm/ls dynamic per-principal OSD perf queries"},
+        {"cmd": "slo status",
+         "desc": "per-pool latency SLO violation fractions + burn"},
+    ]
+
+    #: health check name (mirrors the PR-9 checks' naming)
+    SLO_CHECK = "POOL_SLO_VIOLATION"
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self.name = "perf_query"
+        conf = mgr.ctx.conf
+        self.client_age = self._conf(conf, "mgr_perf_query_client_age",
+                                     10.0, float)
+        self.prom_top_n = self._conf(conf, "mgr_perf_query_prom_top_n",
+                                     10, int)
+        self.slo_window = self._conf(conf, "mgr_slo_window", 10.0,
+                                     float)
+        self.slo_targets = _parse_slo_targets(
+            self._conf(conf, "mgr_slo_pool_targets", "", str))
+        self._lock = threading.RLock()
+        self._queries: dict[int, dict] = {}    # qid -> spec
+        self._next_qid = 1
+        self._last_reply: dict | None = None   # newest MOSDPerfQueryReply
+        self._last_active: dict[tuple, float] = {}   # (qid, key) -> mono
+        self._slo_state: dict[str, dict] = {}  # pool -> status row
+        self._slo_alerting = False             # posted state at the mon
+        self._post_q: queue.Queue = queue.Queue()
+        self._post_thread: threading.Thread | None = None
+        self._shutdown = False
+        # default subscriptions: the (client, pool) table every iotop/
+        # top-clients view reads, and the pool-keyed table the SLO burn
+        # distribution reads.  Broadcast happens on the first osd_map
+        # notify (the mgr may not have a map yet).
+        self.add_query({"key_by": ["client", "pool"]})
+        self.add_query({"key_by": ["pool"]})
+
+    @staticmethod
+    def _conf(conf, name, default, cast):
+        try:
+            return cast(conf.get_val(name))
+        except Exception:
+            return default
+
+    # -- subscription control ------------------------------------------
+
+    def add_query(self, spec: dict) -> int:
+        with self._lock:
+            qid = self._next_qid
+            self._next_qid += 1
+            self._queries[qid] = dict(spec or {})
+        self._broadcast("add", qid, spec or {})
+        return qid
+
+    def remove_query(self, qid: int) -> bool:
+        with self._lock:
+            found = self._queries.pop(int(qid), None) is not None
+        if found:
+            self._broadcast("remove", int(qid), {})
+        return found
+
+    def list_queries(self) -> dict:
+        with self._lock:
+            return {str(qid): dict(spec)
+                    for qid, spec in self._queries.items()}
+
+    def _broadcast(self, op: str, qid: int, spec: dict,
+                   osds: list | None = None) -> None:
+        """Send a control op to every up OSD (or the given subset).
+        Fire-and-forget: the OSD-side add is idempotent and the table
+        re-syncs on the next osdmap change, so a lost frame heals."""
+        osdmap = self.get("osd_map")
+        if osdmap is None:
+            return
+        from ..msg.message import MOSDPerfQuery
+        targets = osds if osds is not None else osdmap.get_up_osds()
+        for osd in targets:
+            addrs = osdmap.get_addr(osd)
+            addr = (addrs.get("public")
+                    if isinstance(addrs, dict) else addrs)
+            if addr is None:
+                continue
+            try:
+                self.mgr.msgr.send_message(
+                    MOSDPerfQuery(op=op, query_id=qid,
+                                  spec=dict(spec)), addr)
+            except Exception:
+                pass
+
+    def _sync_queries(self) -> None:
+        """Re-broadcast the whole subscription table (osdmap changed:
+        an OSD may have booted with an empty engine)."""
+        with self._lock:
+            table = list(self._queries.items())
+        for qid, spec in table:
+            self._broadcast("add", qid, spec)
+
+    def handle_query_reply(self, msg) -> None:
+        """MOSDPerfQueryReply sink (mgr_daemon routes it here): keeps
+        the newest ack for the ls surface / debugging."""
+        with self._lock:
+            self._last_reply = {"from": msg.from_name,
+                                "query_id": msg.query_id,
+                                "result": msg.result,
+                                "queries": dict(msg.queries or {})}
+
+    # -- merged views ---------------------------------------------------
+
+    def _find_qid(self, key_by: list) -> int | None:
+        with self._lock:
+            for qid, spec in self._queries.items():
+                if list(spec.get("key_by") or []) == list(key_by):
+                    return qid
+        return None
+
+    def views(self, window: float | None = None,
+              now: float | None = None) -> dict:
+        """Cluster-wide per-key rates: every fresh OSD's windowed
+        perf-query delta, summed per key.  An OSD bounce (counters
+        restarted) contributes its post-reset values as a fresh
+        window — same counter-reset rule MetricsAggregator.rate uses.
+
+        Returns {qid: {"key_by": [...], "rows": {key_tuple: {rates +
+        latency aggregates}}}} with stale keys (no samples within
+        mgr_perf_query_client_age) filtered out."""
+        metrics = self.get("metrics")
+        now = time.monotonic() if now is None else now
+        merged: dict[int, dict] = {}
+        bounds_us: dict[int, list] = {}
+        for d in metrics.fresh_daemons(now=now):
+            if not d.startswith("osd."):
+                continue
+            pair = metrics.perf_query_window(d, window, now)
+            if pair is None:
+                continue
+            (t0, q0), (t1, q1) = pair
+            dt = t1 - t0
+            if dt <= 0:
+                continue
+            for qid_s, dump1 in (q1 or {}).items():
+                try:
+                    qid = int(qid_s)
+                except (TypeError, ValueError):
+                    continue
+                dump0 = (q0 or {}).get(qid_s) or {}
+                old_rows = {tuple(r["k"]): r
+                            for r in dump0.get("keys", [])}
+                view = merged.setdefault(qid, {})
+                if dump1.get("buckets_us"):
+                    bounds_us[qid] = dump1["buckets_us"]
+                for row in dump1.get("keys", []):
+                    key = tuple(row["k"])
+                    old = old_rows.get(key)
+                    deltas = {}
+                    reset = old is not None and \
+                        row["ops"] < old.get("ops", 0)
+                    for c in _ROW_COUNTERS:
+                        base = 0 if (old is None or reset) \
+                            else old.get(c, 0)
+                        deltas[c] = max(0, row.get(c, 0) - base)
+                    h1 = row.get("lat_hist") or []
+                    h0 = [] if (old is None or reset) \
+                        else (old.get("lat_hist") or [])
+                    if len(h0) == len(h1):
+                        dh = [a - b for a, b in zip(h1, h0)]
+                        if any(n < 0 for n in dh):
+                            dh = list(h1)
+                    else:
+                        dh = list(h1)
+                    agg = view.get(key)
+                    if agg is None:
+                        agg = view[key] = {
+                            "ops_rate": 0.0, "rd_ops_rate": 0.0,
+                            "wr_ops_rate": 0.0, "rd_Bps": 0.0,
+                            "wr_Bps": 0.0, "lat_sum": 0.0,
+                            "lat_count": 0, "lat_hist": None}
+                    agg["ops_rate"] += deltas["ops"] / dt
+                    agg["rd_ops_rate"] += deltas["rd_ops"] / dt
+                    agg["wr_ops_rate"] += deltas["wr_ops"] / dt
+                    agg["rd_Bps"] += deltas["rd_bytes"] / dt
+                    agg["wr_Bps"] += deltas["wr_bytes"] / dt
+                    agg["lat_sum"] += deltas["lat_sum"]
+                    agg["lat_count"] += deltas["lat_count"]
+                    if agg["lat_hist"] is None:
+                        agg["lat_hist"] = list(dh)
+                    elif len(agg["lat_hist"]) == len(dh):
+                        agg["lat_hist"] = [
+                            a + b for a, b in zip(agg["lat_hist"], dh)]
+                    if deltas["ops"] > 0:
+                        with self._lock:
+                            self._last_active[(qid, key)] = now
+        # stale-client ageout: a key with no fresh samples within
+        # client_age leaves every merged view (and with it the
+        # status line and the prometheus page)
+        out: dict[int, dict] = {}
+        with self._lock:
+            specs = {qid: dict(spec)
+                     for qid, spec in self._queries.items()}
+            for qid, view in merged.items():
+                rows = {}
+                for key, agg in view.items():
+                    seen = self._last_active.get((qid, key), 0.0)
+                    if now - seen > self.client_age:
+                        continue
+                    rows[key] = agg
+                out[qid] = {
+                    "key_by": list((specs.get(qid) or {})
+                                   .get("key_by") or []),
+                    "buckets_us": bounds_us.get(qid) or [],
+                    "rows": rows}
+            # bound the activity map: forget entries past the age
+            dead = [k for k, ts in self._last_active.items()
+                    if now - ts > 10 * self.client_age]
+            for k in dead:
+                del self._last_active[k]
+        return out
+
+    def top_clients(self, n: int = 10, window: float | None = None,
+                    now: float | None = None) -> list[dict]:
+        """Top-N (client, pool) rows by ops/s — the iotop body, the
+        status line's `top clients:`, and the Prometheus top-N all
+        read this."""
+        qid = self._find_qid(["client", "pool"])
+        if qid is None:
+            return []
+        view = self.views(window, now).get(qid)
+        if not view:
+            return []
+        bounds = view.get("buckets_us") or []
+        rows = []
+        for key, agg in view["rows"].items():
+            client = key[0] if len(key) > 0 else "?"
+            pool = key[1] if len(key) > 1 else "?"
+            lat_ms = (agg["lat_sum"] / agg["lat_count"] * 1e3
+                      if agg["lat_count"] else 0.0)
+            p99_ms = 0.0
+            if bounds and agg["lat_hist"]:
+                p99_ms = _hist_percentile(agg["lat_hist"], bounds,
+                                          0.99) / 1e3
+            rows.append({
+                "client": client, "pool": pool,
+                "ops_rate": round(agg["ops_rate"], 2),
+                "rd_ops_rate": round(agg["rd_ops_rate"], 2),
+                "wr_ops_rate": round(agg["wr_ops_rate"], 2),
+                "MBps": round((agg["rd_Bps"] + agg["wr_Bps"]) / 1e6,
+                              3),
+                "rd_MBps": round(agg["rd_Bps"] / 1e6, 3),
+                "wr_MBps": round(agg["wr_Bps"] / 1e6, 3),
+                "avg_lat_ms": round(lat_ms, 3),
+                "p99_ms": round(p99_ms, 3)})
+        rows.sort(key=lambda r: (-r["ops_rate"], r["client"]))
+        return rows[:max(0, n)]
+
+    def iotop(self, window: float | None = None,
+              count: int = 20) -> dict:
+        """The `ceph iotop` asok payload."""
+        return {"clients": self.top_clients(n=count, window=window)}
+
+    def pool_views(self, window: float | None = None,
+                   now: float | None = None) -> dict:
+        """Per-pool windowed latency aggregates from the pool-keyed
+        query: {pool: {rates, lat_hist, buckets_us}}."""
+        qid = self._find_qid(["pool"])
+        if qid is None:
+            return {}
+        view = self.views(window, now).get(qid)
+        if not view:
+            return {}
+        bounds = view.get("buckets_us") or []
+        out = {}
+        for key, agg in view["rows"].items():
+            pool = key[0] if key else "?"
+            out[pool] = dict(agg, buckets_us=bounds)
+        return out
+
+    # -- SLO burn -------------------------------------------------------
+
+    def evaluate_slo(self, now: float | None = None) -> dict:
+        """Recompute per-pool violation fractions + burn ratios over
+        the SLO window; raise/clear POOL_SLO_VIOLATION on the mgr's
+        health and (on transitions) at the mon."""
+        now = time.monotonic() if now is None else now
+        if not self.slo_targets:
+            return {}
+        pools = self.pool_views(window=self.slo_window, now=now)
+        state: dict[str, dict] = {}
+        violating: list[str] = []
+        for pool, (thresh_s, objective) in self.slo_targets.items():
+            agg = pools.get(pool)
+            row = {"threshold_ms": round(thresh_s * 1e3, 3),
+                   "objective": objective, "samples": 0,
+                   "violation_fraction": 0.0, "burn_ratio": 0.0}
+            if agg is not None and agg.get("lat_hist") and \
+                    agg.get("buckets_us"):
+                hist = agg["lat_hist"]
+                bounds = agg["buckets_us"]
+                total = sum(hist)
+                if total > 0:
+                    thresh_us = thresh_s * 1e6
+                    # a bucket counts as violating when even its LOWER
+                    # bound clears the threshold — partial buckets
+                    # stay on the compliant side (no false alarms
+                    # from bucket granularity)
+                    over = sum(
+                        n for i, n in enumerate(hist)
+                        if (bounds[i - 1] if 0 < i <= len(bounds)
+                            else (bounds[-1] if i > 0 else 0))
+                        >= thresh_us)
+                    frac = over / total
+                    row["samples"] = total
+                    row["violation_fraction"] = round(frac, 6)
+                    row["burn_ratio"] = round(
+                        frac / max(1e-9, 1.0 - objective), 4)
+            if row["burn_ratio"] > 1.0:
+                violating.append(pool)
+            state[pool] = row
+        with self._lock:
+            self._slo_state = state
+            was_alerting = self._slo_alerting
+            self._slo_alerting = bool(violating)
+        checks = {}
+        if violating:
+            detail = [
+                "pool '%s': %.1f%% of ops over %.0fms (objective "
+                "%.2f%%, burn %.2fx)"
+                % (p, 100 * state[p]["violation_fraction"],
+                   state[p]["threshold_ms"],
+                   100 * state[p]["objective"],
+                   state[p]["burn_ratio"])
+                for p in sorted(violating)]
+            checks[self.SLO_CHECK] = {
+                "severity": "warning",
+                "summary": "%d pool(s) violating their latency SLO"
+                           % len(violating),
+                "detail": detail}
+        self.set_health_checks(checks)
+        if bool(violating) != was_alerting:
+            self._post_slo(sorted(violating), state)
+        return state
+
+    def slo_status(self) -> dict:
+        with self._lock:
+            return {"targets": {p: {"threshold_ms": t * 1e3,
+                                    "objective": o}
+                                for p, (t, o)
+                                in self.slo_targets.items()},
+                    "pools": {p: dict(r)
+                              for p, r in self._slo_state.items()},
+                    "alerting": self._slo_alerting}
+
+    def _post_slo(self, violating: list, state: dict) -> None:
+        """Queue the mon-side raise/clear for the worker thread —
+        notify() runs on the mon-connection dispatch thread where an
+        inline mon.command would deadlock (progress-journal pattern)."""
+        if self._shutdown:
+            return
+        detail = ["pool '%s' burn %.2fx"
+                  % (p, state[p]["burn_ratio"]) for p in violating]
+        self._post_q.put({"prefix": "health slo-report",
+                          "reporter": self.mgr.name,
+                          "violating": violating, "detail": detail})
+        if self._post_thread is None or \
+                not self._post_thread.is_alive():
+            self._post_thread = threading.Thread(
+                target=self._post_loop,
+                name="mgr-perf-query-slo", daemon=True)
+            self._post_thread.start()
+
+    def _post_loop(self) -> None:
+        while not self._shutdown:
+            item = self._post_q.get()
+            if item is None:
+                return
+            mon = self.mgr.mon_client
+            if mon is None:
+                continue
+            try:
+                mon.command(item, timeout=3.0)
+            except Exception:
+                pass   # the mgr-local check already raised; the mon
+                #        copy heals on the next transition
+
+    # -- module hooks ---------------------------------------------------
+
+    def notify(self, notify_type: str, notify_id) -> None:
+        if notify_type == "osd_map":
+            self._sync_queries()
+        elif notify_type == "perf_schema":
+            try:
+                self.evaluate_slo()
+            except Exception:
+                pass
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        if self._post_thread is not None:
+            self._post_q.put(None)
+
+    # -- operator surfaces ----------------------------------------------
+
+    def render_iotop(self, window: float | None = None,
+                     count: int = 20) -> str:
+        rows = self.top_clients(n=count, window=window)
+        out = ["%-24s %-12s %9s %9s %9s %9s %9s"
+               % ("CLIENT", "POOL", "op/s", "rd_op/s", "wr_op/s",
+                  "MB/s", "p99_ms")]
+        for r in rows:
+            out.append("%-24s %-12s %9.2f %9.2f %9.2f %9.3f %9.3f"
+                       % (r["client"], r["pool"], r["ops_rate"],
+                          r["rd_ops_rate"], r["wr_ops_rate"],
+                          r["MBps"], r["p99_ms"]))
+        if len(out) == 1:
+            out.append("(no attributed client activity in window)")
+        return "\n".join(out)
+
+    def handle_command(self, cmd: dict):
+        prefix = cmd.get("prefix", "")
+        if prefix == "iotop":
+            window = cmd.get("window")
+            return 0, self.render_iotop(
+                window=float(window) if window else None,
+                count=int(cmd.get("count") or 20)), ""
+        if prefix == "slo status":
+            import json
+            return 0, json.dumps(self.slo_status(), indent=1,
+                                 sort_keys=True), ""
+        if prefix.startswith("osd perf query"):
+            sub = prefix[len("osd perf query"):].strip() or \
+                str(cmd.get("op", ""))
+            if sub == "add":
+                spec = {}
+                if cmd.get("key_by"):
+                    kb = cmd["key_by"]
+                    spec["key_by"] = ([s.strip() for s in kb.split(",")
+                                       if s.strip()]
+                                      if isinstance(kb, str) else
+                                      list(kb))
+                for k in ("pool", "object_prefix", "max_keys"):
+                    if cmd.get(k):
+                        spec[k] = cmd[k]
+                qid = self.add_query(spec)
+                return 0, "added query %d: %r" % (qid, spec), ""
+            if sub in ("rm", "remove"):
+                try:
+                    qid = int(cmd.get("query_id"))
+                except (TypeError, ValueError):
+                    return -22, "", "osd perf query rm needs query_id"
+                if self.remove_query(qid):
+                    return 0, "removed query %d" % qid, ""
+                return -2, "", "no query %d" % qid
+            if sub == "ls":
+                import json
+                return 0, json.dumps(self.list_queries(), indent=1,
+                                     sort_keys=True), ""
+            return -22, "", "usage: osd perf query add|rm|ls"
+        return super().handle_command(cmd)

@@ -71,6 +71,10 @@ class HealthMonitor:
         self._nearfull: dict = {}      # osd id -> HBM occupancy ratio
         self._used_ratio: dict = {}    # osd id -> store used/total
         self._reported_osds: set = set()   # osds heard from (this mon)
+        # latest mgr SLO verdict ("health slo-report"); None until the
+        # first report reaches THIS mon — a fresh leader carries the
+        # committed POOL_SLO_VIOLATION until the mgr re-reports
+        self._slo_report: dict | None = None
         self._stats_gen = 0
         self._seen_epoch = -1
         self._seen_gen = -1
@@ -384,6 +388,23 @@ class HealthMonitor:
                                    for o, u in osds]}
                 elif not self._reported_osds and name in eff["checks"]:
                     checks[name] = eff["checks"][name]
+            # POOL_SLO_VIOLATION from the mgr's burn-rate verdicts
+            # (mgr/perf_query.py posts "health slo-report" on every
+            # raise/clear transition); same carry-until-first-report
+            # failover rule, keyed on the mgr's report rather than the
+            # osds'
+            if self._slo_report is not None:
+                violating = list(self._slo_report.get("violating", []))
+                if violating:
+                    checks["POOL_SLO_VIOLATION"] = {
+                        "severity": "warning",
+                        "summary": "%d pool(s) violating their latency "
+                                   "SLO" % len(violating),
+                        "detail": list(self._slo_report.get(
+                            "detail", []))}
+            elif "POOL_SLO_VIOLATION" in eff["checks"]:
+                checks["POOL_SLO_VIOLATION"] = \
+                    eff["checks"]["POOL_SLO_VIOLATION"]
             if checks == eff["checks"] and scrub == eff["scrub_errors"]:
                 return
             self.pending = {"checks": checks, "scrub_errors": scrub}
@@ -400,6 +421,14 @@ class HealthMonitor:
 
     def handle_command(self, cmd: dict):
         prefix = cmd.get("prefix", "")
+        if prefix == "health slo-report":
+            with self._lock:
+                self._slo_report = {
+                    "reporter": cmd.get("reporter", ""),
+                    "violating": list(cmd.get("violating", []) or []),
+                    "detail": list(cmd.get("detail", []) or [])}
+            self.recompute()
+            return 0, "", {"ack": True}
         if prefix in ("health", "health detail"):
             with self._lock:
                 checks = {k: dict(v) for k, v in self.checks.items()}

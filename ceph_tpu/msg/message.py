@@ -33,6 +33,7 @@ __all__ = [
     "MMonElection", "MAuth", "MAuthReply", "MMgrReport",
     "MMDSBeacon", "MMDSMap", "MClientRequest", "MClientReply",
     "MAuthMap", "MLog", "MPGStats", "MBackfillReserve",
+    "MOSDPerfQuery", "MOSDPerfQueryReply",
 ]
 
 _seq = itertools.count(1)
@@ -471,6 +472,30 @@ class MMgrReport(Message):
     status: dict = field(default_factory=dict)
     pg_stats: dict = field(default_factory=dict)
     perf_schema: dict = field(default_factory=dict)
+    # per-principal perf-query results (appended field, same
+    # compatible-evolution pattern): query_id -> dumped key table from
+    # the OSD's PerfQueryEngine; {} when no queries are subscribed
+    perf_query: dict = field(default_factory=dict)
+
+
+@dataclass
+class MOSDPerfQuery(Message):
+    """mgr -> OSD dynamic perf-query subscription control
+    (src/messages/MOSDPerfQuery.h role + the mgr's OSDPerfMetricQuery
+    add/remove flow): `op` is add | remove | list; `spec` carries the
+    query's key_by columns, filters, and key-table bound."""
+    op: str = "add"
+    query_id: int = 0
+    spec: dict = field(default_factory=dict)
+
+
+@dataclass
+class MOSDPerfQueryReply(Message):
+    """OSD -> mgr ack for a perf-query control op; `queries` echoes
+    the OSD's live subscription table for `op=list`."""
+    query_id: int = 0
+    result: int = 0
+    queries: dict = field(default_factory=dict)
 
 
 # -- mds / cephfs ------------------------------------------------------

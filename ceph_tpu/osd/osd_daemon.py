@@ -276,6 +276,12 @@ class OSDDaemon(Dispatcher):
                               for name, r in self.reservations.items()},
                 "recovery/backfill reservation slots: granted holders "
                 "+ priority-ordered waiters per reserver")
+            self.ctx.admin_socket.register(
+                "perf query dump",
+                lambda args: {"queries": self.perf_query.list_queries(),
+                              "results": self.perf_query.dump()},
+                "live perf-query subscriptions + per-key tables "
+                "(ops/bytes/latency per client/pool/pg key)")
         self.hb_peers: dict = {}       # osd -> last reply stamp
         self.hb_pending: dict = {}     # osd -> first unacked ping stamp
         # cache tiering: base-pool IO runs on dedicated threads with an
@@ -363,8 +369,28 @@ class OSDDaemon(Dispatcher):
                                    "client op end-to-end on this osd")
                      .add_histogram("l_osd_op_trace_us",
                                     "op latency histogram, microseconds")
+                     # dynamic per-principal perf queries
+                     # (osd/perf_query.py): live subscription + key
+                     # table gauges, lifetime sample/eviction totals
+                     .add_u64("l_osd_pq_queries",
+                              "perf queries currently subscribed")
+                     .add_u64("l_osd_pq_keys",
+                              "live perf-query keys across all "
+                              "subscriptions (bounded by "
+                              "osd_perf_query_max_keys per query)")
+                     .add_u64_counter("l_osd_pq_samples",
+                                      "client ops accounted into at "
+                                      "least one perf query")
+                     .add_u64_counter("l_osd_pq_evictions",
+                                      "perf-query keys LRU-evicted at "
+                                      "the table bound")
                      .create_perf_counters())
         self.ctx.perf.add(self.perf)
+        # per-principal perf-query engine (osd/perf_query.py): the
+        # mgr subscribes queries via MOSDPerfQuery; pg.do_op wraps
+        # reply callables through it when any query is live
+        from .perf_query import PerfQueryEngine
+        self.perf_query = PerfQueryEngine(conf=conf, perf=self.perf)
         # messenger admission control (tentpole leg 3): over-budget
         # client connections block in the reader — TCP backpressure —
         # instead of ballooning the op queue.  Public messenger only:
@@ -704,7 +730,10 @@ class OSDDaemon(Dispatcher):
                                metadata={"id": self.whoami},
                                status=self._telemetry_status(),
                                pg_stats=self._collect_pg_stats(),
-                               perf_schema=self.ctx.perf.perf_schema()),
+                               perf_schema=self.ctx.perf.perf_schema(),
+                               perf_query=(self.perf_query.dump()
+                                           if self.perf_query.active
+                                           else {})),
                     self.mgr_addr)
         finally:
             # a failed report must never kill the tick chain — the
@@ -912,6 +941,9 @@ class OSDDaemon(Dispatcher):
         if t == "MOSDOp":
             self._enqueue_client_op(msg)
             return True
+        if t == "MOSDPerfQuery":
+            self._handle_perf_query(msg)
+            return True
         if t in ("MOSDECSubOpWrite", "MOSDECSubOpWriteReply",
                  "MOSDECSubOpRead", "MOSDECSubOpReadReply",
                  "MOSDECSubOpRepairRead", "MOSDECSubOpRepairReadReply",
@@ -922,6 +954,24 @@ class OSDDaemon(Dispatcher):
             self._enqueue_sub_op(msg)
             return True
         return False
+
+    def _handle_perf_query(self, msg) -> None:
+        """mgr -> OSD perf-query subscription control
+        (MOSDPerfQuery add/remove/list)."""
+        from ..msg.message import MOSDPerfQueryReply
+        result = 0
+        if msg.op == "add":
+            self.perf_query.add_query(msg.query_id, msg.spec)
+        elif msg.op == "remove":
+            if not self.perf_query.remove_query(msg.query_id):
+                result = -2            # ENOENT
+        queries = (self.perf_query.list_queries()
+                   if msg.op == "list" else {})
+        if msg.from_addr is not None:
+            self.public_msgr.send_message(
+                MOSDPerfQueryReply(query_id=msg.query_id,
+                                   result=result, queries=queries),
+                msg.from_addr)
 
     WRITE_OP_KINDS = frozenset((
         "create", "write", "writefull", "append", "zero", "truncate",
@@ -1039,6 +1089,10 @@ class OSDDaemon(Dispatcher):
         op = self.op_tracker.create_request(
             "osd_op(tid=%s pg=%s %s)" % (msg.tid, msg.pgid,
                                          getattr(msg, "op", "?")))
+        # perf-query latency anchor: attribution measures from the
+        # op_request's initiation, not from whenever pg.do_op first
+        # ran — queue wait is part of what the client experienced
+        msg._pq_start = op.initiated_mono
         # stitch under the client's trace when the envelope carries a
         # context; a context-less op (old client, tracing off there)
         # still gets an OSD-rooted trace subject to local sampling

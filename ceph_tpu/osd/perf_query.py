@@ -1,0 +1,305 @@
+"""Dynamic per-principal perf queries (OSD side).
+
+Role of the reference's OSD perf-query machinery
+(src/osd/osd_perf_counters.{h,cc} + the mgr's OSDPerfMetricQuery
+flow behind `rbd perf image iotop`): the mgr subscribes dynamic
+queries on every OSD; each query names the columns ops are keyed by
+(client session/id, pool, pg, object prefix) and the OSD accumulates
+ops / bytes / read-write split / latency sum+count+histogram per key
+on the op completion path.  Results ride the existing MMgrReport
+cadence; the mgr merges them cluster-wide (mgr/perf_query.py).
+
+The key table is BOUNDED: at most `osd_perf_query_max_keys` live keys
+per query, least-recently-updated evicted first, and keys idle past
+`osd_perf_query_key_age` are dropped at dump time — a million
+distinct clients cost a million evictions, never a million table
+rows.  Eviction counts are part of the dump so the mgr can tell
+"quiet cluster" from "table churning".
+
+Client keys are (client_id, session-nonce): a client that reconnects
+with a fresh session nonce but a recycled client_id starts a FRESH
+key — merging across the nonce would attribute a dead process's ops
+to its successor.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+__all__ = ["PerfQueryEngine", "PQ_LAT_BUCKETS_US", "KEY_COLUMNS"]
+
+#: latency histogram bucket upper bounds, microseconds, power-of-two:
+#: bucket i counts samples <= 2^(i+1) us; one overflow bucket last.
+#: 24 edges -> ~16.8 s ceiling, plenty past any complaint time.
+PQ_LAT_BUCKETS_US = tuple(1 << i for i in range(1, 25))
+
+#: the columns a query may key by, in canonical order
+KEY_COLUMNS = ("client", "pool", "pg", "object_prefix")
+
+
+def _client_label(msg) -> str:
+    """client.<id>:<session-prefix> — the session nonce keeps two
+    incarnations of a recycled client_id apart (attribution
+    integrity), the prefix keeps labels short."""
+    session = getattr(msg, "session", "") or ""
+    return "client.%d:%s" % (getattr(msg, "client_id", 0), session[:8])
+
+
+class _KeyStats:
+    __slots__ = ("ops", "rd_ops", "wr_ops", "rd_bytes", "wr_bytes",
+                 "lat_sum", "lat_count", "lat_hist", "last_t", "first_t")
+
+    def __init__(self, now: float):
+        self.ops = 0
+        self.rd_ops = 0
+        self.wr_ops = 0
+        self.rd_bytes = 0
+        self.wr_bytes = 0
+        self.lat_sum = 0.0
+        self.lat_count = 0
+        self.lat_hist = [0] * (len(PQ_LAT_BUCKETS_US) + 1)
+        self.first_t = now
+        self.last_t = now
+
+    def add(self, is_read: bool, in_bytes: int, out_bytes: int,
+            latency: float, now: float) -> None:
+        self.ops += 1
+        if is_read:
+            self.rd_ops += 1
+            self.rd_bytes += out_bytes
+        else:
+            self.wr_ops += 1
+            self.wr_bytes += in_bytes
+        self.lat_sum += latency
+        self.lat_count += 1
+        us = int(latency * 1e6)
+        for i, edge in enumerate(PQ_LAT_BUCKETS_US):
+            if us <= edge:
+                self.lat_hist[i] += 1
+                break
+        else:
+            self.lat_hist[-1] += 1
+        self.last_t = now
+
+    def dump(self) -> dict:
+        return {"ops": self.ops, "rd_ops": self.rd_ops,
+                "wr_ops": self.wr_ops, "rd_bytes": self.rd_bytes,
+                "wr_bytes": self.wr_bytes,
+                "lat_sum": round(self.lat_sum, 9),
+                "lat_count": self.lat_count,
+                "lat_hist": list(self.lat_hist)}
+
+
+class _Query:
+    __slots__ = ("query_id", "key_by", "pool", "object_prefix",
+                 "max_keys", "table", "evictions")
+
+    def __init__(self, query_id: int, spec: dict, default_max: int):
+        self.query_id = query_id
+        key_by = spec.get("key_by") or ["client", "pool"]
+        # canonical column order regardless of request order
+        self.key_by = tuple(c for c in KEY_COLUMNS if c in key_by)
+        if not self.key_by:
+            self.key_by = ("client", "pool")
+        self.pool = spec.get("pool") or None
+        self.object_prefix = spec.get("object_prefix") or None
+        self.max_keys = int(spec.get("max_keys") or default_max)
+        # LRU by last update: OrderedDict with move_to_end on touch
+        self.table: OrderedDict[tuple, _KeyStats] = OrderedDict()
+        self.evictions = 0
+
+    def spec(self) -> dict:
+        return {"key_by": list(self.key_by), "pool": self.pool,
+                "object_prefix": self.object_prefix,
+                "max_keys": self.max_keys}
+
+    def key_for(self, msg, pool_name: str, pgid) -> tuple | None:
+        """The key tuple this op lands on; None = filtered out."""
+        if self.pool is not None and pool_name != self.pool:
+            return None
+        if self.object_prefix is not None and \
+                not str(msg.oid).startswith(self.object_prefix):
+            return None
+        parts = []
+        for col in self.key_by:
+            if col == "client":
+                parts.append(_client_label(msg))
+            elif col == "pool":
+                parts.append(pool_name)
+            elif col == "pg":
+                parts.append(str(pgid))
+            elif col == "object_prefix":
+                parts.append(str(self.object_prefix or ""))
+        return tuple(parts)
+
+    def account(self, key: tuple, is_read: bool, in_bytes: int,
+                out_bytes: int, latency: float, now: float) -> int:
+        """Returns how many keys were evicted making room (the
+        least-recently-updated go first past the bound)."""
+        st = self.table.get(key)
+        if st is None:
+            st = self.table[key] = _KeyStats(now)
+        else:
+            self.table.move_to_end(key)
+        st.add(is_read, in_bytes, out_bytes, latency, now)
+        evicted = 0
+        while len(self.table) > self.max_keys:
+            self.table.popitem(last=False)
+            self.evictions += 1
+            evicted += 1
+        return evicted
+
+    def prune(self, now: float, key_age: float) -> None:
+        """Drop keys idle past key_age (ageout is NOT an eviction —
+        the client left; nothing was displaced)."""
+        dead = [k for k, st in self.table.items()
+                if now - st.last_t > key_age]
+        for k in dead:
+            del self.table[k]
+
+    def dump(self) -> dict:
+        return {"key_by": list(self.key_by),
+                "buckets_us": list(PQ_LAT_BUCKETS_US),
+                "evictions": self.evictions,
+                "keys": [{"k": list(key), **st.dump()}
+                         for key, st in self.table.items()]}
+
+
+class PerfQueryEngine:
+    """The OSD's live subscription table + op-path accounting.
+
+    `wrap_reply` is the single hook point: pg.do_op wraps the reply
+    callable once per op (guarded by msg._pq_wrapped against do_op
+    re-entry via missing-object parking / waiting_for_active), so
+    accounting runs at op COMPLETION with the latency the client saw.
+    When no queries are subscribed, `active` is False and the op path
+    pays one attribute check — nothing else.
+    """
+
+    def __init__(self, conf=None, perf=None):
+        self._lock = threading.Lock()
+        self._queries: dict[int, _Query] = {}
+        self.perf = perf
+        self.default_max_keys = 256
+        self.key_age = 30.0
+        if conf is not None:
+            try:
+                self.default_max_keys = int(
+                    conf.get_val("osd_perf_query_max_keys"))
+            except Exception:
+                pass
+            try:
+                self.key_age = float(
+                    conf.get_val("osd_perf_query_key_age"))
+            except Exception:
+                pass
+
+    @property
+    def active(self) -> bool:
+        return bool(self._queries)
+
+    # -- subscription control (MOSDPerfQuery add/remove/list) ----------
+
+    def add_query(self, query_id: int, spec: dict) -> None:
+        """Idempotent: the mgr re-broadcasts its subscription table on
+        every osdmap change (so a late-booting OSD catches up), and a
+        re-add with the SAME spec must not reset an accumulating
+        table."""
+        qid = int(query_id)
+        q = _Query(qid, spec or {}, self.default_max_keys)
+        with self._lock:
+            cur = self._queries.get(qid)
+            if cur is not None and cur.spec() == q.spec():
+                return
+            self._queries[qid] = q
+        self._update_gauges()
+
+    def remove_query(self, query_id: int) -> bool:
+        with self._lock:
+            found = self._queries.pop(int(query_id), None) is not None
+        self._update_gauges()
+        return found
+
+    def list_queries(self) -> dict:
+        # str keys: the table rides MOSDPerfQueryReply and asok JSON,
+        # where int dict keys would not round-trip
+        with self._lock:
+            return {str(qid): q.spec()
+                    for qid, q in self._queries.items()}
+
+    # -- op-path accounting --------------------------------------------
+
+    def wrap_reply(self, msg, reply_fn, pool_name: str, pgid):
+        """Completion-path hook: returns a reply callable that
+        accounts the op into every matching query, then forwards."""
+        from ..msg.message import OSD_READ_OPS
+        start = getattr(msg, "_pq_start", None)
+        if start is None:
+            start = time.monotonic()
+        ops = list(getattr(msg, "ops", ()) or ())
+        is_read = bool(ops) and all(op[0] in OSD_READ_OPS for op in ops)
+        in_bytes = sum(len(arg) for op_t in ops for arg in op_t
+                       if isinstance(arg, (bytes, bytearray)))
+
+        def wrapped(result, data):
+            now = time.monotonic()
+            out_bytes = 0
+            if isinstance(data, (bytes, bytearray)):
+                out_bytes = len(data)
+            elif isinstance(data, list):
+                out_bytes = sum(len(d) for d in data
+                                if isinstance(d, (bytes, bytearray)))
+            self.account(msg, pool_name, pgid, is_read, in_bytes,
+                         out_bytes, now - start, now)
+            reply_fn(result, data)
+
+        return wrapped
+
+    def account(self, msg, pool_name: str, pgid, is_read: bool,
+                in_bytes: int, out_bytes: int, latency: float,
+                now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        sampled, evicted = False, 0
+        with self._lock:
+            for q in self._queries.values():
+                key = q.key_for(msg, pool_name, pgid)
+                if key is None:
+                    continue
+                evicted += q.account(key, is_read, in_bytes,
+                                     out_bytes, latency, now)
+                sampled = True
+        if self.perf is not None:
+            if sampled:
+                self.perf.inc("l_osd_pq_samples")
+            if evicted:
+                self.perf.inc("l_osd_pq_evictions", evicted)
+        self._update_gauges()
+
+    # -- report-path dump ----------------------------------------------
+
+    def dump(self, now: float | None = None) -> dict:
+        """The MMgrReport perf_query payload: {query_id: table dump}.
+        Idle keys are pruned here, on the report cadence, so a
+        vanished client's key stops shipping within key_age."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            for q in self._queries.values():
+                q.prune(now, self.key_age)
+            out = {str(qid): q.dump()
+                   for qid, q in self._queries.items()}
+        self._update_gauges()
+        return out
+
+    def _update_gauges(self) -> None:
+        if self.perf is None:
+            return
+        with self._lock:
+            nq = len(self._queries)
+            nk = sum(len(q.table) for q in self._queries.values())
+        try:
+            self.perf.set("l_osd_pq_queries", nq)
+            self.perf.set("l_osd_pq_keys", nk)
+        except Exception:
+            pass

@@ -496,6 +496,18 @@ class PG:
     # -- client op execution (PrimaryLogPG::do_op collapsed) -----------
 
     def do_op(self, msg, reply_fn) -> None:
+        # per-principal attribution (osd/perf_query.py): wrap the
+        # reply ONCE per op — do_op re-enters through missing-object
+        # parking and waiting_for_active with the same msg+reply_fn,
+        # and a second wrap would double-count the op
+        pq = getattr(self.daemon, "perf_query", None)
+        if pq is not None and pq.active \
+                and not getattr(msg, "_pq_wrapped", False):
+            msg._pq_wrapped = True
+            reply_fn = pq.wrap_reply(
+                msg, reply_fn,
+                getattr(self.pool, "name", str(self.pgid.pool)),
+                self.pgid)
         if not self.is_primary():
             reply_fn(-11, None)  # EAGAIN: wrong primary / not peered
             return

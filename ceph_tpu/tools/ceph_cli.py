@@ -206,6 +206,101 @@ def iostat_command(asoks: list[str], period: float, count: int) -> int:
     return 0
 
 
+def iotop_command(asoks: list[str], period: float, count: int,
+                  rows: int = 20) -> int:
+    """`ceph iotop --asok MGR [--period N] [--count M]`: top clients
+    by attributed ops/s, MB/s and p99 latency, one table per round
+    (the per-principal sibling of `ceph iostat`)."""
+    import time as _time
+    client = _mgr_asok(asoks, "iotop")
+    if client is None:
+        return 1
+    for i in range(max(count, 1)):
+        try:
+            reply = client.do_request("iotop", window=period,
+                                      count=rows)
+        except (OSError, ValueError) as e:
+            sys.stderr.write("ceph iotop: %s\n" % e)
+            return 1
+        if not isinstance(reply, dict) or "clients" not in reply:
+            sys.stderr.write("ceph iotop: bad reply %r\n" % (reply,))
+            return 1
+        out = ["%-24s %-12s %9s %9s %9s %9s %9s"
+               % ("CLIENT", "POOL", "op/s", "rd_op/s", "wr_op/s",
+                  "MB/s", "p99_ms")]
+        for r in reply["clients"]:
+            out.append("%-24s %-12s %9.2f %9.2f %9.2f %9.3f %9.3f"
+                       % (r.get("client", "?"), r.get("pool", "?"),
+                          r.get("ops_rate", 0.0),
+                          r.get("rd_ops_rate", 0.0),
+                          r.get("wr_ops_rate", 0.0),
+                          r.get("MBps", 0.0), r.get("p99_ms", 0.0)))
+        if len(out) == 1:
+            out.append("(no attributed client activity in window)")
+        sys.stdout.write("\n".join(out) + "\n")
+        sys.stdout.flush()
+        if i + 1 < count:
+            _time.sleep(period)
+    return 0
+
+
+def perf_query_command(words: list[str], asoks: list[str],
+                       args) -> int:
+    """`ceph osd perf query add|rm|ls ... --asok MGR`: manage the
+    mgr's dynamic per-principal OSD query subscriptions."""
+    client = _mgr_asok(asoks, "osd perf query")
+    if client is None:
+        return 1
+    if not words or words[0] not in ("add", "rm", "remove", "ls"):
+        sys.stderr.write("ceph: osd perf query add|rm|ls\n")
+        return 1
+    op = words[0]
+    req: dict = {"op": "rm" if op == "remove" else op}
+    if op == "add":
+        # positionals after 'add' are key columns, e.g.
+        #   osd perf query add client pool --pool data
+        if words[1:]:
+            req["key_by"] = ",".join(words[1:])
+        if getattr(args, "pool", None):
+            req["pool"] = args.pool
+        if getattr(args, "object_prefix", None):
+            req["object_prefix"] = args.object_prefix
+    elif op in ("rm", "remove"):
+        if len(words) < 2:
+            sys.stderr.write("ceph: osd perf query rm needs a "
+                             "query id\n")
+            return 1
+        try:
+            req["query_id"] = int(words[1])
+        except ValueError:
+            sys.stderr.write("ceph: invalid query id %r\n" % words[1])
+            return 1
+    try:
+        reply = client.do_request("perf query", **req)
+    except (OSError, ValueError) as e:
+        sys.stderr.write("ceph osd perf query: %s\n" % e)
+        return 1
+    sys.stdout.write(json.dumps(reply, indent=1, default=str) + "\n")
+    return 0 if not (isinstance(reply, dict) and "error" in reply) \
+        else 1
+
+
+def slo_status_command(asoks: list[str]) -> int:
+    """`ceph slo status --asok MGR`: per-pool SLO violation fractions
+    and burn ratios."""
+    client = _mgr_asok(asoks, "slo status")
+    if client is None:
+        return 1
+    try:
+        reply = client.do_request("slo status")
+    except (OSError, ValueError) as e:
+        sys.stderr.write("ceph slo status: %s\n" % e)
+        return 1
+    sys.stdout.write(json.dumps(reply, indent=1, default=str) + "\n")
+    return 0 if not (isinstance(reply, dict) and "error" in reply) \
+        else 1
+
+
 def daemon_command(words: list[str]) -> int:
     """`ceph daemon <asok-path> <command...>`: talk straight to one
     daemon's unix admin socket (perf dump, dump_ops_in_flight,
@@ -259,12 +354,21 @@ def main(argv=None) -> int:
                         "osd out/in/down ID | osd dump | "
                         "df --asok MGR | osd perf --asok MGR | "
                         "iostat --asok MGR [--period N --count M] | "
+                        "iotop --asok MGR [--period N --count M] | "
+                        "osd perf query add|rm|ls --asok MGR | "
+                        "slo status --asok MGR | "
                         "daemon ASOK CMD... | "
                         "trace tree TRACE_ID --asok PATH...")
     p.add_argument("--period", type=float, default=1.0,
                    help="iostat sampling window/interval, seconds")
     p.add_argument("--count", type=int, default=1,
                    help="iostat rows to print")
+    p.add_argument("--pool", default=None,
+                   help="pool filter for `osd perf query add`")
+    p.add_argument("--object-prefix", dest="object_prefix",
+                   default=None,
+                   help="object-name prefix filter for "
+                        "`osd perf query add`")
     p.add_argument("-s", "--size", type=int, default=None)
     p.add_argument("--pg-num", type=int, default=8)
     p.add_argument("--erasure", action="store_true")
@@ -279,10 +383,18 @@ def main(argv=None) -> int:
     # connection needed
     if args.words == ["df"]:
         return df_command(args.asok or [])
+    # NOTE: checked before the bare ["osd", "perf"] route below
+    if args.words[:3] == ["osd", "perf", "query"]:
+        return perf_query_command(args.words[3:], args.asok or [],
+                                  args)
     if args.words == ["osd", "perf"]:
         return osd_perf_command(args.asok or [])
     if args.words == ["iostat"]:
         return iostat_command(args.asok or [], args.period, args.count)
+    if args.words == ["iotop"]:
+        return iotop_command(args.asok or [], args.period, args.count)
+    if args.words == ["slo", "status"]:
+        return slo_status_command(args.asok or [])
     client = connect(args)
     try:
         w = args.words

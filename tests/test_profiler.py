@@ -293,5 +293,13 @@ class TestPerfSchemaDrift:
                     assert any(
                         "l_tpu_stage_h2d_busy" in dump[lg]
                         for lg in tpu)
+                # the perf-query counters registered through the same
+                # builder are part of the walk too
+                osd_group = dump.get("osd", {})
+                for pq_ctr in ("l_osd_pq_queries", "l_osd_pq_keys",
+                               "l_osd_pq_samples",
+                               "l_osd_pq_evictions"):
+                    assert pq_ctr in osd_group, pq_ctr
+                    assert pq_ctr in schema["osd"], pq_ctr
         finally:
             cluster.stop()
