@@ -1132,6 +1132,15 @@ def run_multichip_scaling(n_devices: int = 8, rounds: int = 3,
     Gate: aggregate <= 1.5x the single median means the pipelines
     serialized — the run fails (SystemExit), same contract as the
     overlap/consistency gates in run_bench.
+
+    The rateless leg (direction J) re-runs the straggler experiment
+    through the micro-batch work-stealing queue
+    (parallel/rateless.py): encode must be bit-identical to the
+    fixed-shard oracle, ONE hard-stalled chip of D may cost at most
+    1.5/D of the aggregate (proportional degradation — idle devices
+    steal the straggler's queue share), and a mid-batch chip kill
+    must drain its in-flight micro-batches back to the queue and
+    seal bit-identically on the survivors.  All three are HARD gates.
     """
     import threading
 
@@ -1260,6 +1269,97 @@ def run_multichip_scaling(n_devices: int = 8, rounds: int = 3,
             _median(others) >= spread_floor),
     }
 
+    # -- rateless work-stealing leg (direction J) ---------------------
+    # the micro-batch queue dispatcher over the same devices: oracle
+    # bit-identity, then the proportional-degradation gate — one chip
+    # of D stalled hard may cost at most 1.5/D of the aggregate,
+    # because idle devices steal the straggler's share of the queue
+    # instead of waiting for it — then a mid-batch chip kill that must
+    # complete bit-identically on the survivors (drain + blacklist)
+    from ceph_tpu.parallel.rateless import (DeviceFaultSet,
+                                            RatelessDispatcher)
+    inj = DeviceFaultSet(seed=1)
+    rl = RatelessDispatcher(devices=devices, injector=inj,
+                            name="bench-rateless")
+    oracle = np.asarray(codec.encode_batch(batch))
+    try:
+        got = np.asarray(rl.encode(codec, batch))
+        oracle_ok = bool(np.array_equal(got, oracle))
+        if gate and not oracle_ok:
+            raise SystemExit(
+                "rateless gate: work-stealing encode diverged from "
+                "the fixed-shard oracle")
+
+        def rl_round(count):
+            t0 = time.perf_counter()
+            for _ in range(count):
+                np.asarray(rl.encode(codec, batch))
+            return count * nbytes / (time.perf_counter() - t0) / 1e6
+
+        rl_round(2)                               # warm the jits
+        rl_rounds, rl_ops = max(rounds, 5), 2 * ops
+        rl_healthy = [rl_round(rl_ops) for _ in range(rl_rounds)]
+        healthy_med = _median(rl_healthy)
+        # wedge ONE chip hard: a stall far past any EWMA deadline and
+        # longer than the whole leg, so the straggler's micro-batch is
+        # speculatively re-dispatched once (bounded penalty, lands in
+        # one round) and the sleeper never returns to the queue — the
+        # survivors own the aggregate, which is exactly the
+        # proportional-degradation claim the gate checks on medians
+        inj.stall_ms(n - 1, max(3000.0, 60.0 * delay * 1e3))
+        try:
+            rl_slow = [rl_round(rl_ops) for _ in range(rl_rounds)]
+        finally:
+            inj.clear_all()
+        slow_med = _median(rl_slow)
+        rl_stat = rl.status()
+        degradation_floor = round(1.0 - 1.5 / n, 3)
+        rateless_row = {
+            "healthy_MBps": round(healthy_med, 2),
+            "one_slow_chip_MBps": round(slow_med, 2),
+            "rateless_degradation": round(slow_med / healthy_med, 3)
+            if healthy_med else None,
+            "degradation_floor": degradation_floor,
+            "oracle_bit_identical": oracle_ok,
+            "stolen_total": rl_stat.get("stolen_total", 0),
+            "redispatch_total": rl_stat.get("redispatch_total", 0),
+            "duplicate_total": rl_stat.get("duplicate_total", 0),
+            "blacklist_total": rl_stat.get("blacklist_total", 0),
+        }
+        if gate and n >= 4 and healthy_med \
+                and slow_med < healthy_med * (1.0 - 1.5 / n):
+            raise SystemExit(
+                "rateless gate: one slow chip of %d cost %.1f%% of "
+                "the aggregate (floor: %.1f%%) — the queue is not "
+                "absorbing the straggler"
+                % (n, 100.0 * (1.0 - slow_med / healthy_med),
+                   100.0 * 1.5 / n))
+
+        # chaos: kill an ACTIVE chip MID-BATCH (its in-flight
+        # micro-batches drain back to the queue), the batch must still
+        # seal bit-identically on the survivors and the mesh must
+        # report the degradation (DEVICE_DEGRADED's feed)
+        inj.kill(0)
+        try:
+            survivors = np.asarray(rl.encode(codec, batch))
+            chaos_ok = bool(np.array_equal(survivors, oracle))
+            rateless_row["chaos_kill_bit_identical"] = chaos_ok
+            # the kill surfaces when the chip next pulls the queue —
+            # give the blacklist a moment to land before reading it
+            deadline = time.perf_counter() + 2.0
+            while rl.degraded() < 1 \
+                    and time.perf_counter() < deadline:
+                np.asarray(rl.encode(codec, batch))
+            rateless_row["chaos_degraded_devices"] = rl.degraded()
+            if gate and not chaos_ok:
+                raise SystemExit(
+                    "rateless gate: mid-batch chip kill corrupted "
+                    "the encode on the survivors")
+        finally:
+            inj.revive(0)
+    finally:
+        rl.shutdown()
+
     doc = {
         "n_devices": n,
         "devices": [device_label(d) for d in devices],
@@ -1275,6 +1375,7 @@ def run_multichip_scaling(n_devices: int = 8, rounds: int = 3,
         if single_median else None,
         "per_device": per_device,
         "straggler_degradation": straggler_row,
+        "rateless": rateless_row,
     }
     if gate and agg_median <= 1.5 * single_median:
         raise SystemExit(

@@ -114,6 +114,29 @@ def _declare_defaults():
       "batch n+1 overlaps compute of n and d2h of n-1 "
       "(osd/tpu_dispatch.py staging ring); 1 = the legacy synchronous "
       "coalesce-then-block loop")
+    o("osd_mesh_rateless", bool, True, LEVEL_ADVANCED,
+      "route bulk mesh encode/decode/repair-combine jobs through the "
+      "rateless micro-batch work queue (parallel/rateless.py; ROADMAP "
+      "direction J): idle devices steal micro-batches so a slow chip "
+      "takes fewer instead of gating the batch; off = the fixed-shard "
+      "mesh paths")
+    o("osd_mesh_microbatch_factor", int, 4, LEVEL_ADVANCED,
+      "micro-batches per device a bulk mesh job over-decomposes into "
+      "(queue length = factor * n_devices): higher = finer-grained "
+      "stealing and smoother straggler degradation, at more dispatch "
+      "overhead per job")
+    o("osd_mesh_microbatch_timeout_ms", float, 0.0, LEVEL_ADVANCED,
+      "fixed per-micro-batch deadline before speculative re-dispatch; "
+      "0 (default) derives the deadline from the executing device's "
+      "rolling latency EWMA (osd_mesh_* deadline multiplier, "
+      "parallel/rateless.py)")
+    o("osd_mesh_blacklist_strikes", int, 3, LEVEL_ADVANCED,
+      "consecutive timeouts/errors that move a device from healthy to "
+      "the blacklist (probation re-admits it after an exponential "
+      "backoff with one canary micro-batch)")
+    o("osd_mesh_probation_base_ms", float, 50.0, LEVEL_ADVANCED,
+      "base blacklist backoff; doubles per blacklist episode up to a "
+      "bounded max before the probation canary is attempted")
     o("osd_hbm_tier_enable", bool, True, LEVEL_ADVANCED,
       "retain EC encode results device-resident in the HbmChunkTier "
       "keyed by (pg, object): scrub-repair rebuilds and recovery "

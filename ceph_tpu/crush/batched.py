@@ -674,11 +674,28 @@ def mesh_do_rule(cmap: CrushMap, ruleno: int, xs, result_max: int,
     Seeds are padded (by repeating the last seed) up to a multiple of
     the mesh size — NamedSharding needs an even split — and the pad
     rows are trimmed from the result.
+
+    With the rateless work queue up (parallel/rateless.py, ROADMAP
+    direction J) and no explicit mesh, the sweep rides the queue
+    instead of fixed NamedSharding shards: seed micro-batches are
+    pulled by idle devices, so a slow chip takes fewer seeds instead
+    of gating the whole sweep.  Each seed still maps independently
+    through the same compiled kernel, so the result stays
+    bit-identical to the fixed-shard (and scalar-oracle) path.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     if mesh is None:
+        from ..parallel import rateless as _rl
+        disp = _rl.get_dispatcher()
+        xs_arr = np.asarray(xs)
+        if disp is not None and len(xs_arr) > 1:
+            return disp.map_batch(
+                lambda sub: batched_do_rule(
+                    cmap, ruleno, sub, result_max, weight,
+                    choose_args=choose_args),
+                xs_arr)
         mesh = make_batch_mesh()
     if len(mesh.axis_names) != 1:
         raise ValueError("mesh_do_rule wants a flat 1-axis mesh, got "

@@ -265,6 +265,34 @@ class PrometheusModule(MgrModule):
                              (row.get("busy_s", 0.0) / tot)
                              if tot > 0 else 0.0,
                              dict(dlbl, stage=stage))
+                # rateless mesh dispatch series (direction J): the
+                # work-stealing queue's per-device health — 1 healthy,
+                # 0.5 probation, 0 blacklisted — plus the aggregate
+                # speculation and blacklist counters
+                mesh = status.get("mesh") or {}
+                if mesh:
+                    score = {"healthy": 1.0, "probation": 0.5,
+                             "blacklisted": 0.0}
+                    for row in mesh.get("devices") or []:
+                        emit("ceph_tpu_device_health",
+                             score.get(row.get("state"), 0.0),
+                             dict(lbl, device=row.get("device", "?")),
+                             help_="mesh device health: 1 healthy, "
+                                   "0.5 probation, 0 blacklisted")
+                    emit("ceph_tpu_mesh_redispatch_total",
+                         mesh.get("redispatch_total", 0), lbl,
+                         mtype="counter",
+                         help_="speculative micro-batch re-dispatches "
+                               "triggered by deadline overruns")
+                    emit("ceph_tpu_mesh_blacklist",
+                         mesh.get("blacklisted", 0), lbl,
+                         help_="devices currently blacklisted from "
+                               "the mesh work queue")
+                    emit("ceph_tpu_mesh_queue_depth",
+                         mesh.get("queue_depth", 0), lbl)
+                    emit("ceph_tpu_mesh_stolen_total",
+                         mesh.get("stolen_total", 0), lbl,
+                         mtype="counter")
             # balancer sweep timings (ROADMAP #4's measured-feedback
             # series), exported with a backend label
             for key in metrics.value_keys():

@@ -29,6 +29,11 @@ Checks implemented (names follow the reference's health check ids):
                     re-reports a calm window
   DEVICE_MEM_NEARFULL  an osd's HBM chunk tier crossed the nearfull
                     occupancy ratio — eviction pressure is imminent
+  DEVICE_DEGRADED   an osd's rateless mesh dispatcher blacklisted one
+                    or more devices — work still completes on the
+                    survivors, but aggregate throughput is degraded;
+                    clears when probation re-admits the chip and the
+                    osd re-reports zero
   OSD_NEARFULL      store utilisation over mon_osd_nearfull_ratio —
                     plan capacity now
   OSD_BACKFILLFULL  utilisation over mon_osd_backfillfull_ratio — the
@@ -69,6 +74,7 @@ class HealthMonitor:
         self._slow_ops: dict = {}      # osd id -> slow-request count
         self._recompiles: dict = {}    # osd id -> in-window recompiles
         self._nearfull: dict = {}      # osd id -> HBM occupancy ratio
+        self._degraded: dict = {}      # osd id -> blacklisted devices
         self._used_ratio: dict = {}    # osd id -> store used/total
         self._reported_osds: set = set()   # osds heard from (this mon)
         # latest mgr SLO verdict ("health slo-report"); None until the
@@ -163,6 +169,11 @@ class HealthMonitor:
                 self._nearfull[msg.osd_id] = occ
             else:
                 self._nearfull.pop(msg.osd_id, None)
+            dd = int(getattr(msg, "devices_degraded", 0) or 0)
+            if dd > 0:
+                self._degraded[msg.osd_id] = dd
+            else:
+                self._degraded.pop(msg.osd_id, None)
             u = float(getattr(msg, "used_ratio", 0.0) or 0.0)
             if u > 0:
                 self._used_ratio[msg.osd_id] = u
@@ -350,6 +361,25 @@ class HealthMonitor:
                     and "DEVICE_MEM_NEARFULL" in eff["checks"]:
                 checks["DEVICE_MEM_NEARFULL"] = \
                     eff["checks"]["DEVICE_MEM_NEARFULL"]
+            # DEVICE_DEGRADED: the rateless mesh dispatch layer has
+            # blacklisted one or more of an osd's devices — bulk
+            # encode/decode/repair jobs complete on the surviving
+            # chips (degraded, not failed) until probation re-admits
+            # them; a calm report (0 blacklisted) retires the check
+            if self._degraded:
+                checks["DEVICE_DEGRADED"] = {
+                    "severity": "warning",
+                    "summary": "%d osd(s) running with blacklisted "
+                               "mesh devices"
+                               % len(self._degraded),
+                    "detail": ["osd.%d has %d device(s) blacklisted "
+                               "from the mesh work queue" % (o, n)
+                               for o, n in sorted(
+                                   self._degraded.items())]}
+            elif not self._reported_osds \
+                    and "DEVICE_DEGRADED" in eff["checks"]:
+                checks["DEVICE_DEGRADED"] = \
+                    eff["checks"]["DEVICE_DEGRADED"]
             # OSD_NEARFULL / OSD_BACKFILLFULL / OSD_FULL: store
             # utilisation ranked against the full-ratio ladder.  Each
             # osd lands in the HIGHEST tier it crosses (a full osd is

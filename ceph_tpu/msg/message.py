@@ -450,6 +450,11 @@ class MPGStats(Message):
     # full}_ratio for the OSD_NEARFULL/OSD_BACKFILLFULL/OSD_FULL
     # ladder; 0.0 when the store can't report capacity
     used_ratio: float = 0.0
+    # blacklisted mesh devices (appended field): the rateless dispatch
+    # layer's currently-blacklisted chip count (parallel/rateless.py);
+    # the HealthMonitor raises DEVICE_DEGRADED while > 0 and clears it
+    # when probation re-admits the chips
+    devices_degraded: int = 0
 
 
 # -- mgr ---------------------------------------------------------------

@@ -350,6 +350,26 @@ def recover_cross_chip(sinfo: StripeInfo, codec, to_decode: dict,
             5, "not enough chunks to decode (%d < %d)"
             % (len(use), k))
     stacked = np.stack([logical[i] for i in use], axis=1)  # [S,k,chunk]
+    # rateless path first (ROADMAP direction J): the survivor batch is
+    # over-decomposed into micro-batches on the shared device work
+    # queue, so one slow or dead chip takes fewer micro-batches
+    # instead of gating the whole reconstruction.  Same trust boundary
+    # as the fixed-shard path: the bytes about to hit the mesh are
+    # checksummed against the host sum taken at receive time.
+    from ..parallel import rateless as _rl
+    disp = _rl.get_dispatcher() if mesh is None else None
+    if disp is not None:
+        if expected_sum is not None:
+            got = int(stacked.astype(np.uint64).sum()) % (1 << 32)
+            if got != expected_sum % (1 << 32):
+                from ..parallel.mesh import MeshChecksumError
+                raise MeshChecksumError(
+                    "rateless recovery checksum mismatch: survivor "
+                    "sum %d != expected %d"
+                    % (got, expected_sum % (1 << 32)))
+        full = disp.decode(codec, use, stacked)
+        return np.ascontiguousarray(
+            full[:, inv[target_shard], :]).reshape(-1).tobytes()
     from ..parallel.mesh import recover_sharded
     row = recover_sharded(codec, use, stacked, inv[target_shard],
                           mesh=mesh, expected_sum=expected_sum)
@@ -448,6 +468,23 @@ def repair_cross_chip(sinfo: StripeInfo, codec, target_shard: int,
         except Exception:
             return None
     helpers, stacked = _stack_fractions(sinfo, codec, fractions)
+    # rateless path first (direction J): beta-fraction combine rides
+    # the shared micro-batch queue; a straggling chip degrades the
+    # combine proportionally instead of gating it
+    from ..parallel import rateless as _rl
+    disp = _rl.get_dispatcher() if mesh is None else None
+    if disp is not None:
+        if expected_sum is not None:
+            got = int(stacked.astype(np.uint64).sum()) % (1 << 32)
+            if got != expected_sum % (1 << 32):
+                from ..parallel.mesh import MeshChecksumError
+                raise MeshChecksumError(
+                    "rateless repair checksum mismatch: fraction "
+                    "sum %d != expected %d"
+                    % (got, expected_sum % (1 << 32)))
+        out = disp.repair_combine(codec, target_shard, helpers,
+                                  stacked)
+        return np.ascontiguousarray(out).reshape(-1).tobytes()
     from ..parallel.mesh import repair_sharded
     out = repair_sharded(codec, target_shard, helpers, stacked,
                          mesh=mesh, expected_sum=expected_sum)

@@ -180,6 +180,16 @@ class OSDDaemon(Dispatcher):
                 whoami, conf.get_val("osd_device_index"))
         except Exception:
             self.home_device = None
+        # rateless mesh dispatch (parallel/rateless.py, direction J):
+        # honour the conf gate so a daemon started with
+        # osd_mesh_rateless=false never pulls the process-global
+        # work-stealing dispatcher into its decode paths
+        try:
+            from ..parallel import rateless
+            rateless.set_enabled(
+                bool(conf.get_val("osd_mesh_rateless")))
+        except Exception:
+            pass
         if conf.get_val("osd_tpu_coalesce"):
             from .tpu_dispatch import TpuDispatcher
             self.tpu_dispatcher = TpuDispatcher(
@@ -777,6 +787,15 @@ class OSDDaemon(Dispatcher):
         tier = getattr(self, "hbm_tier", None)
         if tier is not None:
             doc["hbm_tier_device"] = device_label(tier.device)
+        try:
+            from ..parallel import rateless
+            disp = rateless.get_dispatcher(create=False)
+            if disp is not None:
+                # per-device health table: ewma_ms / inflight / stolen /
+                # redispatched / blacklisted / probation per chip
+                doc["rateless"] = disp.status()
+        except Exception:
+            pass
         return doc
 
     def _telemetry_status(self) -> dict:
@@ -806,6 +825,13 @@ class OSDDaemon(Dispatcher):
                 status["hbm"] = tier.stats()
             except Exception:
                 pass
+        try:
+            from ..parallel import rateless
+            disp = rateless.get_dispatcher(create=False)
+            if disp is not None:
+                status["mesh"] = disp.status()
+        except Exception:
+            pass
         return status
 
     # -- fullness ladder ----------------------------------------------
@@ -909,8 +935,19 @@ class OSDDaemon(Dispatcher):
         # OSD_BACKFILLFULL / OSD_FULL) — an over-threshold ratio keeps
         # reports flowing via the alert latch so the check can CLEAR
         used = self.used_ratio()
+        # blacklisted mesh devices ride the report too (DEVICE_DEGRADED);
+        # the alert latch keeps reports flowing after probation re-admits
+        # the chip so the mon sees the zero and clears the check
+        degraded = 0
+        try:
+            from ..parallel import rateless
+            disp = rateless.get_dispatcher(create=False)
+            if disp is not None:
+                degraded = disp.degraded()
+        except Exception:
+            pass
         self._sync_reservation_perf()
-        alerting = slow or recompiles or nearfull \
+        alerting = slow or recompiles or nearfull or degraded \
             or used >= self._full_ratios[0]
         if not stats and not alerting \
                 and not getattr(self, "_alert_reported", False):
@@ -921,7 +958,8 @@ class OSDDaemon(Dispatcher):
                                 epoch=self.map_epoch(), slow_ops=slow,
                                 recompiles=recompiles,
                                 mem_nearfull=nearfull,
-                                used_ratio=used))
+                                used_ratio=used,
+                                devices_degraded=degraded))
 
     # -- dispatch ------------------------------------------------------
 

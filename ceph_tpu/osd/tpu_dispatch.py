@@ -338,6 +338,13 @@ class TpuDispatcher:
                     "l_tpu_stage_%s_%s" % (stage, state),
                     "%s stage wall seconds %s" % (stage, state))
         self.perf = self.perf.create_perf_counters()
+        # rolling dispatch-wall EWMA (submit -> results landed): the
+        # straggler-wait heuristic in _take_group scales its coalesce
+        # window from THIS instead of always burning the full
+        # max_delay, so the window tracks what a dispatch actually
+        # costs on this device (ROADMAP direction J satellite)
+        self._lat_ewma: float | None = None
+        self._lat_alpha = 0.25
         # device leg implementations (tests substitute a fake here)
         self._jax = self._probe_jax()
         self._devops = _JaxDevOps(self.device) if self._jax \
@@ -721,6 +728,10 @@ class TpuDispatcher:
                 "ops": tel["ops"],
                 "dispatches": tel["dispatches"],
                 "coalesce_ratio": tel["coalesce_ratio"],
+                "lat_ewma_ms": round(self._lat_ewma * 1e3, 3)
+                if self._lat_ewma is not None else None,
+                "coalesce_wait_ms": round(
+                    self._coalesce_wait() * 1e3, 3),
                 "donated_dispatches": self.perf.get("l_tpu_donated"),
                 "fused": tel["fused"],
                 "segments_s": {
@@ -821,9 +832,35 @@ class TpuDispatcher:
         self.perf.set("l_tpu_queue_depth", depth)
         return p
 
+    def _note_dispatch_wall(self, wall: float) -> None:
+        """Fold one dispatch's submit->results wall into the latency
+        EWMA the coalesce window scales from."""
+        if wall <= 0:
+            return
+        # single-writer per stage thread; a torn read in the window
+        # heuristic only mis-sizes one wait, so no lock (and the
+        # collector calls _coalesce_wait while HOLDING self.cv's lock)
+        prev = self._lat_ewma
+        self._lat_ewma = wall if prev is None \
+            else (1.0 - self._lat_alpha) * prev \
+            + self._lat_alpha * wall
+
+    def _coalesce_wait(self) -> float:
+        """Adaptive straggler wait: half the rolling dispatch-wall
+        EWMA, floored at max_delay/8 and CAPPED at max_delay — a fast
+        device stops burning the full fixed window on every dispatch,
+        and a known-slow device can never stretch the window beyond
+        the configured max (the pre-EWMA failure mode: one wedged
+        h2d inflating every subsequent coalesce wait)."""
+        ewma = self._lat_ewma
+        if ewma is None:
+            return self.max_delay
+        return min(self.max_delay,
+                   max(self.max_delay / 8.0, 0.5 * ewma))
+
     def _take_group(self):
-        """Pick the fullest queue; wait up to max_delay for stragglers
-        unless it is already at max_batch."""
+        """Pick the fullest queue; wait up to the EWMA-scaled coalesce
+        window for stragglers unless it is already at max_batch."""
         deadline = None
         while True:
             with self.cv:
@@ -849,9 +886,10 @@ class TpuDispatcher:
                         self.queues.pop(best_key, None)
                     deadline = None
                     return _Dispatch(best_key, fn, take, kind, prefetch)
+                wait = self._coalesce_wait()
                 if deadline is None:
-                    deadline = time.monotonic() + self.max_delay
-                self.cv.wait(self.max_delay)
+                    deadline = time.monotonic() + wait
+                self.cv.wait(wait)
 
     def _instrumenting(self) -> bool:
         return self.tracer is not None and self.tracer.enabled
@@ -921,6 +959,8 @@ class TpuDispatcher:
         except BaseException as e:   # deliver, don't kill the loop
             for p in d.pend:
                 p.error = e
+        self._note_dispatch_wall(
+            time.monotonic() - min(p.t_submit for p in d.pend))
         for p in d.pend:
             p.event.set()
 
@@ -1007,6 +1047,8 @@ class TpuDispatcher:
                 if d.mem_bytes:
                     PROFILER.mem_sub("staging_ring", d.mem_bytes)
                     d.mem_bytes = 0
+            self._note_dispatch_wall(
+                time.monotonic() - min(p.t_submit for p in d.pend))
             for p in d.pend:
                 p.event.set()
 

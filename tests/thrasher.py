@@ -23,7 +23,8 @@ class Thrasher:
     def __init__(self, cluster, seed: int = 0, min_in: int = 2,
                  interval: float = 0.5, revive_delay: float = 0.8,
                  partition_prob: float = 0.0,
-                 mon_thrash_prob: float = 0.0):
+                 mon_thrash_prob: float = 0.0,
+                 device_thrash_prob: float = 0.0):
         self.cluster = cluster
         self.rng = random.Random(seed)
         self.min_in = min_in
@@ -31,7 +32,9 @@ class Thrasher:
         self.revive_delay = revive_delay
         self.partition_prob = partition_prob
         self.mon_thrash_prob = mon_thrash_prob
+        self.device_thrash_prob = device_thrash_prob
         self.dead: dict[int, object] = {}     # osd_id -> store
+        self.dead_devices: set[int] = set()   # injector-killed chips
         self.partitions: set[tuple[int, int]] = set()  # (a, b) pairs
         self.log: list[tuple] = []
         self._stop = threading.Event()
@@ -146,6 +149,62 @@ class Thrasher:
             self._journal("heal", "osd.%d <-> osd.%d" % (a, b),
                           a=a, b=b)
 
+    # -- device chaos (rateless mesh fault injector) --------------------
+
+    def _mesh_devices(self) -> int:
+        """Chip count of the process-global rateless dispatcher, 0 when
+        the mesh path is inactive (single device / disabled)."""
+        from ceph_tpu.parallel import rateless
+        disp = rateless.get_dispatcher(create=False)
+        return len(disp.devices) if disp is not None else 0
+
+    def kill_device(self, idx: int | None = None) -> int | None:
+        """Injector-kill one mesh chip: every micro-batch it pulls
+        raises DeviceKilled, the dispatcher drains its in-flight work
+        back to the queue and blacklists it, and the mesh degrades to
+        the survivors (DEVICE_DEGRADED on the mon). Always leaves at
+        least one chip alive — an all-dead mesh only has the host
+        fallback, which is survival, not the degradation under test."""
+        n = self._mesh_devices()
+        if n == 0 or len(self.dead_devices) >= n - 1:
+            return None
+        if idx is None:
+            alive = [i for i in range(n) if i not in self.dead_devices]
+            idx = self.rng.choice(alive)
+        elif idx in self.dead_devices:
+            return None
+        from ceph_tpu.parallel.rateless import DEVICE_FAULTS
+        DEVICE_FAULTS.kill(idx)
+        self.dead_devices.add(idx)
+        self.log.append(("device_kill", idx))
+        self._journal("device kill", "device %d" % idx, device=idx)
+        return idx
+
+    def revive_device(self, idx: int | None = None) -> int | None:
+        """Lift the injector kill; the chip re-enters through the
+        blacklist->probation->canary path, not straight to healthy."""
+        if not self.dead_devices:
+            return None
+        if idx is None:
+            idx = self.rng.choice(sorted(self.dead_devices))
+        elif idx not in self.dead_devices:
+            return None
+        from ceph_tpu.parallel.rateless import DEVICE_FAULTS
+        DEVICE_FAULTS.revive(idx)
+        self.dead_devices.discard(idx)
+        self.log.append(("device_revive", idx))
+        self._journal("device revive", "device %d" % idx, device=idx)
+        return idx
+
+    def stall_device(self, idx: int, ms: float) -> None:
+        """Slow one chip without killing it — the straggler case the
+        speculative re-dispatch deadline exists for."""
+        from ceph_tpu.parallel.rateless import DEVICE_FAULTS
+        DEVICE_FAULTS.stall_ms(idx, ms)
+        self.log.append(("device_stall", idx, ms))
+        self._journal("device stall", "device %d (%.0fms)" % (idx, ms),
+                      device=idx, ms=ms)
+
     # -- mon thrash (MonitorThrasher kill/revive) ----------------------
 
     def thrash_mon(self) -> int | None:
@@ -205,6 +264,12 @@ class Thrasher:
                         if len(alive) >= 2:
                             a, b = self.rng.sample(alive, 2)
                             self.partition(a, b)
+                if self.device_thrash_prob and \
+                        self.rng.random() < self.device_thrash_prob:
+                    if self.dead_devices and self.rng.random() < 0.6:
+                        self.revive_device()
+                    else:
+                        self.kill_device()
                 # weighted choice mirroring the reference's thrasher:
                 # mostly kill/revive churn
                 if self.dead and (len(self._alive()) <= self.min_in
@@ -228,6 +293,8 @@ class Thrasher:
         if self._thread is not None:
             self._thread.join(timeout=10)
         self.heal()
+        while self.dead_devices:
+            self.revive_device()
         while self.dead:
             self.revive_one()
         assert wait_until(self.cluster.all_osds_up, timeout=timeout), \
