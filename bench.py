@@ -118,6 +118,7 @@ error behind the r4->r5 SHEC/Cauchy swings).
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import subprocess
@@ -521,7 +522,13 @@ def _bench_cluster() -> dict:
                     conf_overrides={"osd_tracing": False,
                                     "osd_profiler": False,
                                     "mgr_stats_period": 0.0,
-                                    "mgr_progress": False})
+                                    "mgr_progress": False,
+                                    # pin the op-queue discipline: this
+                                    # row predates mclock_opclass and
+                                    # must stay methodology-constant
+                                    # with earlier rounds (--qos prices
+                                    # the dmClock path separately)
+                                    "osd_op_queue": "wpq"})
     c.start()
     try:
         client = c.client()
@@ -2188,6 +2195,403 @@ def run_attribution(out_path: str | None = None) -> dict:
     return doc
 
 
+def _harness_brief(stats: dict) -> dict:
+    """The artifact keeps the decision-relevant slice of a harness run,
+    not the full recorder dump."""
+    lat = next(iter(stats["latency"].values()), {})
+    out = {"sessions": stats["sessions"],
+           "submitted": stats["submitted"],
+           "completed": stats["completed"],
+           "errors": stats["errors"],
+           "offered_rate": round(stats["offered_rate"], 1),
+           "drained": stats["drained"],
+           "p50_s": lat.get("p50_s"),
+           "p99_s": lat.get("p99_s"),
+           "max_s": lat.get("max_s")}
+    if "exact_p99_s" in stats:
+        out["exact_p99_s"] = round(stats["exact_p99_s"], 6)
+    if "resent" in stats:
+        out["resent"] = stats["resent"]
+    if "peak_inflight" in stats:
+        out["peak_inflight"] = stats["peak_inflight"]
+    return out
+
+
+def run_qos(out_path: str | None = None) -> dict:
+    """QoS artifact (ROADMAP direction B -> E): the dmClock brain under
+    the open-loop workload subsystem.
+
+    Three legs:
+
+      1. Isolation: a gold pool's paced closed-loop probe stream is
+         measured quiet, then under an open-loop best-effort
+         storm+flood (bursty MMPP storms on a steady Poisson flood)
+         with per-pool QoS off, then with gold qos_reservation above
+         its offered rate and best-effort qos_limit 6x below the
+         flood's offered rate.
+      2. Scale attribution: 1000 distinct open-loop sessions over ONE
+         messenger; the PR-15 perf-query engines must attribute >= 95%
+         of the OSDs' own op_in_bytes delta and see every session as
+         its own principal.
+      3. Feedback oracle: bit-exact dmClock tag advances on a fake
+         clock, then the two-OSD asymmetric-warmup experiment — with
+         delta/rho feedback the class gets ~its GLOBAL reservation
+         across both OSDs and service shifts to the under-served one.
+
+    HARD GATES (SystemExit): gold p99 with QoS on under storm+flood
+    <= 1.1x its quiet baseline while best-effort completions drop below
+    0.6x their unthrottled run; >= 1000 distinct sessions attributed
+    with >= 95% byte fidelity; tag math bit-exact; feedback run serves
+    <= 1/1.6 of the no-feedback run globally with the starved OSD
+    carrying >= 40%."""
+    import threading
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_util import MiniCluster, wait_until
+
+    from ceph_tpu.mgr import PerfQueryModule
+    from ceph_tpu.osd.op_queue import MClockOpClassQueue
+    from ceph_tpu.workload import (AsyncRadosDriver, BurstyArrivals,
+                                   DmClockFeedback, PoissonArrivals,
+                                   UniformPopularity, WorkloadHarness,
+                                   rados_write)
+
+    doc: dict = {"metric": "qos_gold_p99_ratio", "unit": "ratio"}
+    # Thread-per-daemon simulator: a probe round trip is ~6 thread
+    # handoffs, and CPython's default 5ms switch interval lets any
+    # CPU-holding thread (the flood generator) delay each handoff by
+    # up to 5ms — pure interpreter preemption latency that no OSD-side
+    # scheduler can remove. 0.5ms is this harness's kernel-preemption
+    # knob; restored on exit.
+    old_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.0005)
+    fast = {"osd_tracing": False, "osd_profiler": False,
+            "osd_heartbeat_interval": 0.25, "osd_heartbeat_grace": 2.0,
+            "paxos_propose_interval": 0.02,
+            # open loop: inflight must be able to grow past the
+            # defaults without the messenger backpressuring the test
+            "osd_client_message_cap": 100000,
+            "objecter_inflight_ops": 100000}
+
+    # -- leg 1: per-pool isolation under storm+flood ------------------
+    c = MiniCluster(num_mons=1, num_osds=2,
+                    conf_overrides=dict(fast,
+                                        osd_op_queue="mclock_opclass",
+                                        mgr_stats_period=0.0))
+    c.start()
+    try:
+        admin = c.client()
+        gold_id = c.create_replicated_pool(admin, "gold", size=2,
+                                           pg_num=8)
+        be_id = c.create_replicated_pool(admin, "besteff", size=2,
+                                         pg_num=8)
+        if not (c.wait_clean(gold_id) and c.wait_clean(be_id)):
+            raise SystemExit("qos gate: pools never went clean")
+
+        # gold is measured CLOSED-loop (sequential paced round trips,
+        # exact order statistics — the rados-bench protocol): the gate
+        # prices the OSD-side queueing dmClock controls, not the load
+        # generator's own wakeup jitter under the flood (open-loop
+        # lateness from the SHARED-process generator threads is real
+        # for the flood but contaminates a 1.1x gate on gold). The
+        # open-loop harness is itself gated at 1000 sessions in leg 2.
+        def probe(n=400, pace=0.003):
+            io = admin.open_ioctx("gold")
+            lats = []
+            for i in range(n):
+                t0 = time.perf_counter()
+                io.write_full("probe-%04d" % (i % 64), b"p" * 512)
+                lats.append(time.perf_counter() - t0)
+                time.sleep(pace)
+            lats.sort()
+            return {"n": n, "p50_s": round(lats[n // 2], 6),
+                    "p99_s": round(
+                        lats[min(int(n * 0.99), n - 1)], 6),
+                    "max_s": round(lats[-1], 6)}
+
+        def flood_arm(seed, dur, drain):
+            """Best-effort storm+flood in a thread: steady Poisson
+            flood plus bursty MMPP storms, ~24 ops/s offered — 6x the
+            throttled budget the ON arm grants the class. The flood
+            overwhelms the LIMIT, not the interpreter: in-process,
+            every offered op costs generator+messenger Python time
+            that shows up in the gold tail no matter how the OSD
+            schedules, so the offered rate stays as low as the
+            contrast allows."""
+            slot: dict = {}
+
+            def go():
+                cl = c.client()
+                h = WorkloadHarness(
+                    cl, "besteff",
+                    rados_write(obj_prefix="f", size=512),
+                    num_sessions=12,
+                    arrival_factory=lambda i: (
+                        PoissonArrivals(2.5, seed=seed + i)
+                        if i < 8 else BurstyArrivals(
+                            0.5, burst_factor=8.0, on_s=0.3,
+                            off_s=0.9, idle_factor=0.0, seed=seed + i)),
+                    popularity=UniformPopularity(64, seed=2),
+                    klass="besteff", seed=seed + 5000,
+                    # nothing here is LOST, it's parked: the ON arm
+                    # limits this class to 8/s, so a short resend
+                    # timer would duplicate-storm the very queue
+                    # under measurement
+                    driver=AsyncRadosDriver(cl, resend_every=30.0))
+                slot["stats"] = h.run(duration=dur, drain_timeout=drain)
+            t = threading.Thread(target=go)
+            t.start()
+            return t, slot
+
+        # quiet baseline (min-p99 over four passes absorbs host
+        # scheduler stalls the same way codec rows take min-time
+        # windows)
+        quiet = [probe() for _ in range(4)]
+        quiet_p99 = min(p["p99_s"] for p in quiet)
+
+        # storm+flood with NO pool QoS (the contrast arm — must run
+        # before any QoS is set: zeroed profiles don't un-apply, and
+        # with no per-pool classes gold FIFOs behind the flood in the
+        # shared base "client" class)
+        t, slot = flood_arm(3, dur=12.0, drain=30.0)
+        time.sleep(0.5)
+        off = [probe() for _ in range(4)]
+        t.join(timeout=120.0)
+        be_off = slot["stats"]
+        if not be_off["drained"]:
+            raise SystemExit("qos gate: unthrottled flood never "
+                             "drained: %r" % _harness_brief(be_off))
+
+        # per-pool QoS on: gold reserved above its offered rate,
+        # best-effort limited far below the flood's
+        for pool, var, val in (("gold", "qos_reservation", 200.0),
+                               ("gold", "qos_weight", 100.0),
+                               ("besteff", "qos_weight", 10.0),
+                               ("besteff", "qos_limit", 4.0)):
+            rc, _, _ = admin.mon_command(
+                {"prefix": "osd pool set", "pool": pool,
+                 "var": var, "val": str(val)})
+            if rc != 0:
+                raise SystemExit("qos gate: pool set %s/%s failed"
+                                 % (pool, var))
+
+        def applied():
+            return all(
+                o._pool_qos_applied.get("gold") == (200.0, 100.0, 0.0)
+                and o._pool_qos_applied.get("besteff")
+                == (0.0, 10.0, 4.0)
+                for o in c.osds.values())
+        if not wait_until(applied, timeout=20, interval=0.2):
+            raise SystemExit("qos gate: pool QoS never reached the "
+                             "OSD shard queues")
+
+        t, slot = flood_arm(4, dur=12.0, drain=2.0)
+        time.sleep(0.5)
+        on = [probe() for _ in range(4)]
+        t.join(timeout=120.0)
+        be_on = slot["stats"]
+        on_p99 = min(p["p99_s"] for p in on)
+
+        dump = c.osds[0]._dump_op_queue()
+        doc["isolation"] = {
+            "discipline": dump["discipline"],
+            "pool_profiles": dump["pool_profiles"],
+            "gold_probe_quiet": quiet,
+            "gold_probe_storm_qos_off": off,
+            "gold_probe_storm_qos_on": on,
+            "be_storm_qos_off": _harness_brief(be_off),
+            "be_storm_qos_on": _harness_brief(be_on),
+            "gold_p99_quiet_s": quiet_p99,
+            "gold_p99_storm_off_s": min(p["p99_s"] for p in off),
+            "gold_p99_storm_on_s": on_p99,
+            "p99_ratio_on_vs_quiet": round(on_p99 / quiet_p99, 4),
+            "be_completed_off": be_off["completed"],
+            "be_completed_on": be_on["completed"],
+            "be_throughput_ratio": round(
+                be_on["completed"] / max(be_off["completed"], 1), 4),
+        }
+        print(json.dumps(doc["isolation"]), file=sys.stderr)
+        if dump["discipline"] != "mclock_opclass":
+            raise SystemExit("qos gate: op queue discipline is %r, "
+                             "not mclock_opclass" % dump["discipline"])
+        if on_p99 > 1.1 * quiet_p99:
+            raise SystemExit(
+                "qos gate: gold p99 under storm+flood %.6fs > 1.1x "
+                "quiet baseline %.6fs" % (on_p99, quiet_p99))
+        if be_on["completed"] >= 0.6 * be_off["completed"]:
+            raise SystemExit(
+                "qos gate: best-effort completed %d with the limit on "
+                ">= 0.6x its unthrottled %d — the limit never bit"
+                % (be_on["completed"], be_off["completed"]))
+    finally:
+        c.stop()
+
+    # -- leg 2: 1000-session attribution at scale ---------------------
+    c2 = MiniCluster(num_mons=1, num_osds=2,
+                     conf_overrides=dict(fast, mgr_stats_period=0.25,
+                                         osd_perf_query_max_keys=4096))
+    c2.start()
+    try:
+        c2.start_mgr(modules=(PerfQueryModule,))
+        admin = c2.client()
+        pool_id = c2.create_replicated_pool(admin, "scalepool",
+                                            size=2, pg_num=8)
+        if not c2.wait_clean(pool_id):
+            raise SystemExit("qos gate: scalepool never went clean")
+        if not wait_until(lambda: all(o.perf_query.active
+                                      for o in c2.osds.values()),
+                          timeout=20):
+            raise SystemExit("qos gate: default perf queries never "
+                             "reached the OSD engines")
+        base = sum(o.perf.get("op_in_bytes") for o in c2.osds.values())
+        cl = c2.client()
+        # every principal must appear INSIDE the window: a 0.5/s
+        # Poisson session skips a 4s window with p = e^-2, which would
+        # silently drop ~135 of the 1000 principals before attribution
+        # even starts. So each session opens with one deterministic
+        # census op staggered across the first 2s, then free-runs its
+        # Poisson stream shifted behind it.
+        def census_then_poisson(i):
+            t0 = 0.2 + (i % 500) * 0.004
+            return itertools.chain(
+                [t0], (t0 + t for t in PoissonArrivals(0.5, seed=i)))
+        h = WorkloadHarness(
+            cl, "scalepool", rados_write(obj_prefix="sc", size=4096),
+            num_sessions=1000,
+            arrival_factory=census_then_poisson,
+            popularity=UniformPopularity(128, seed=5), seed=77)
+        st = h.run(duration=4.0, drain_timeout=90.0)
+        if not st["drained"] or st["errors"]:
+            raise SystemExit("qos gate: scale harness unhealthy: %r"
+                             % _harness_brief(st))
+        delta = sum(o.perf.get("op_in_bytes")
+                    for o in c2.osds.values()) - base
+
+        prefix = "client.%d:" % cl.client_id
+        per_label: dict[str, int] = {}
+        for osd in c2.osds.values():
+            for dump in osd.perf_query.dump().values():
+                if dump["key_by"] != ["client", "pool"]:
+                    continue
+                for row in dump["keys"]:
+                    per_label[row["k"][0]] = (
+                        per_label.get(row["k"][0], 0)
+                        + row["wr_bytes"] + row["rd_bytes"])
+        distinct = {k for k in per_label if k.startswith(prefix)}
+        attributed = sum(per_label.values())
+        frac = attributed / max(delta, 1)
+        doc["scale"] = dict(_harness_brief(st),
+                            peak_inflight=st["peak_inflight"],
+                            distinct_sessions_attributed=len(distinct),
+                            op_in_bytes_delta=delta,
+                            attributed_bytes=attributed,
+                            attributed_fraction=round(frac, 4))
+        if len(distinct) < 1000:
+            raise SystemExit("qos gate: only %d of 1000 sessions "
+                             "attributed as distinct principals"
+                             % len(distinct))
+        if frac < 0.95:
+            raise SystemExit("qos gate: engines attributed only "
+                             "%.1f%% of op_in_bytes at scale"
+                             % (frac * 100))
+    finally:
+        c2.stop()
+
+    # -- leg 3: dmClock feedback oracle (fake clock, bit-exact) -------
+    class _Clk:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clk = _Clk()
+    q = MClockOpClassQueue({"gold": (8.0, 128.0, 16.0)},
+                           min_cost=4096, clock=clk)
+    q.enqueue("gold", 63, 4096, "a")
+    q.enqueue("gold", 63, 8192, "b", delta=3.0, rho=2.0)
+    cls = q._classes["gold"]
+    tags = (cls.r_tag, cls.p_tag, cls.l_tag)
+    # scale 2 + (delta 3, rho 2): r=(2+2)/8, p=(3+2)/128, l=(3+2)/16
+    if tags != (0.5, 0.0390625, 0.3125):
+        raise SystemExit("qos gate: tag math not bit-exact: %r" %
+                         (tags,))
+
+    RES = 8.0
+
+    def drive(with_feedback, duration=2.0):
+        clks = (_Clk(), _Clk())
+        queues = tuple(
+            MClockOpClassQueue({"gold": (RES, 1.0, RES)},
+                               clock=clks[i]) for i in range(2))
+        fb = DmClockFeedback()
+
+        def send(osd):
+            d, r = fb.stamp(osd) if with_feedback else (0.0, 0.0)
+            queues[osd].enqueue("gold", 63, 4096, "op",
+                                delta=d, rho=r)
+
+        send(0)                      # OSD 0 alone serves the warmup
+        while clks[0].t < 0.5:
+            if queues[0].dequeue() is not None:
+                fb.observe(0, queues[0].last_dequeue[1])
+                send(0)
+            clks[0].t += 0.01
+        clks[1].t = clks[0].t
+        warm_end = clks[0].t
+        served = [0, 0]
+        if queues[1].empty():
+            send(1)
+        while clks[0].t < warm_end + duration:
+            for osd in (0, 1):
+                if queues[osd].dequeue() is not None:
+                    fb.observe(osd, queues[osd].last_dequeue[1])
+                    served[osd] += 1
+                    send(osd)
+                clks[osd].t += 0.01
+        return served
+
+    fb_served = drive(True)
+    raw_served = drive(False)
+    doc["feedback_oracle"] = {
+        "reservation_ops_per_s": RES,
+        "window_s": 2.0,
+        "served_no_feedback": raw_served,
+        "served_with_feedback": fb_served,
+        "global_target_ops": RES * 2.0,
+        "tag_math": "bit-exact",
+    }
+    if sum(raw_served) <= 1.6 * sum(fb_served):
+        raise SystemExit("qos gate: feedback run served %d vs raw %d "
+                         "— per-OSD reservations never collapsed to "
+                         "the global one" % (sum(fb_served),
+                                             sum(raw_served)))
+    if abs(sum(fb_served) - RES * 2.0) > 3:
+        raise SystemExit("qos gate: feedback global service %d not ~ "
+                         "the %d-op reservation" % (sum(fb_served),
+                                                    int(RES * 2.0)))
+    if fb_served[1] < 0.4 * sum(fb_served) or \
+            fb_served[1] < fb_served[0] - 2:
+        raise SystemExit("qos gate: under-served OSD carried only %r "
+                         "— service never shifted" % (fb_served,))
+
+    # a failed gate raises SystemExit and takes the process with it,
+    # so the only path that needs the switch interval restored is this
+    # one
+    sys.setswitchinterval(old_switch)
+    doc["value"] = doc["isolation"]["p99_ratio_on_vs_quiet"]
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "QOS_r01.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(doc))
+    return doc
+
+
 def main() -> None:
     import jax
 
@@ -2204,6 +2608,9 @@ def main() -> None:
         return
     if "--attribution" in sys.argv:
         run_attribution()
+        return
+    if "--qos" in sys.argv:
+        run_qos()
         return
     run_bench()
 
@@ -2806,6 +3213,10 @@ if __name__ == "__main__":
         # attribution-fidelity artifact: gates + cluster leg, no
         # supervisor (no device rows)
         run_attribution()
+    elif "--qos" in sys.argv:
+        # qos-isolation artifact: gates + cluster legs, no supervisor
+        # (no device rows)
+        run_qos()
     elif "--worker" in sys.argv:
         main()
     else:

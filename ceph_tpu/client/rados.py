@@ -75,6 +75,12 @@ class RadosClient(Dispatcher):
         # when client ids and tid counters restart across processes
         import uuid
         self.session = uuid.uuid4().hex
+        # dmclock distributed feedback (optional): an object with
+        # stamp(osd) -> (delta, rho) and observe(osd, phase) — when
+        # armed, every MOSDOp carries the service this client received
+        # cluster-wide since its previous op to that OSD, so each OSD's
+        # queue compensates for work its peers already served
+        self.qos_feedback = None
         # op tracing (ZTracer client role): the root span of every
         # traced op starts HERE, and its context rides the MOSDOp
         # envelope so OSD-side spans stitch under it
@@ -127,6 +133,11 @@ class RadosClient(Dispatcher):
             with self._lock:
                 op = self._inflight.pop(msg.tid, None)
             if op is not None:
+                if self.qos_feedback is not None:
+                    src = getattr(msg, "from_name", None)
+                    self.qos_feedback.observe(
+                        src[1] if src else -1,
+                        getattr(msg, "qos_phase", ""))
                 op.result = msg.result
                 op.data = msg.data
                 op.event.set()
@@ -241,13 +252,17 @@ class RadosClient(Dispatcher):
                 ms_span = span.child("messenger")
                 ms_span.keyval("osd", primary)
                 t_id, p_id = trace_ctx(ms_span)
+                qd = qr = 0.0
+                if self.qos_feedback is not None:
+                    qd, qr = self.qos_feedback.stamp(primary)
                 self.msgr.send_message(
                     MOSDOp(client_id=self.client_id, tid=tid, pgid=pgid,
                            oid=oid, ops=ops,
                            map_epoch=self.osdmap.epoch,
                            snapc=snapc or (0, ()), snap=snap,
                            session=self.session, flags=flags,
-                           trace_id=t_id, parent_span=p_id), addr)
+                           trace_id=t_id, parent_span=p_id,
+                           qos_delta=qd, qos_rho=qr), addr)
                 # wait a slice, then re-send (map may have changed)
                 if op.event.wait(min(remaining, 1.0)):
                     if op.result == -11:  # EAGAIN: wrong/unready primary

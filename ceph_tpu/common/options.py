@@ -88,6 +88,12 @@ def _declare_defaults():
     o("osd_op_queue_mclock_recovery_res", float, 0.0, LEVEL_ADVANCED)
     o("osd_op_queue_mclock_recovery_wgt", float, 1.0, LEVEL_ADVANCED)
     o("osd_op_queue_mclock_recovery_lim", float, 0.0, LEVEL_ADVANCED)
+    o("osd_op_queue_mclock_scrub_res", float, 0.0, LEVEL_ADVANCED)
+    o("osd_op_queue_mclock_scrub_wgt", float, 1.0, LEVEL_ADVANCED)
+    o("osd_op_queue_mclock_scrub_lim", float, 0.0, LEVEL_ADVANCED)
+    o("osd_op_queue_mclock_snaptrim_res", float, 0.0, LEVEL_ADVANCED)
+    o("osd_op_queue_mclock_snaptrim_wgt", float, 1.0, LEVEL_ADVANCED)
+    o("osd_op_queue_mclock_snaptrim_lim", float, 0.0, LEVEL_ADVANCED)
     o("mds_beacon_interval", float, 0.25, LEVEL_ADVANCED,
       "seconds between MDS -> mon beacons (options.cc mds_beacon_interval, "
       "scaled for in-process clusters)")
@@ -253,6 +259,22 @@ def _declare_defaults():
     o("mgr_slo_window", float, 10.0, LEVEL_ADVANCED,
       "rolling window (seconds) over which the per-pool SLO "
       "violation fraction is computed")
+    # adaptive QoS: mgr bumps a burning pool's dmclock reservation
+    o("mgr_qos_adaptive", bool, False, LEVEL_ADVANCED,
+      "when a pool's SLO burn ratio exceeds 1.0, post 'osd pool set "
+      "<pool> qos_reservation' raising its dmclock reservation so the "
+      "op queues shift capacity toward the burning pool")
+    o("mgr_qos_adapt_min_res", float, 50.0, LEVEL_ADVANCED,
+      "floor (ops/s) for an adaptively-granted pool reservation")
+    o("mgr_qos_adapt_factor", float, 1.5, LEVEL_ADVANCED,
+      "multiplicative bump applied to the current reservation each "
+      "time the pool is still burning after the cooldown")
+    o("mgr_qos_adapt_max_res", float, 10000.0, LEVEL_ADVANCED,
+      "ceiling (ops/s) on adaptive reservations, so a miscalibrated "
+      "SLO cannot starve every other class")
+    o("mgr_qos_adapt_cooldown", float, 5.0, LEVEL_ADVANCED,
+      "seconds between adaptive reservation bumps for one pool (the "
+      "previous bump must propagate via osdmap before re-judging)")
     # mgr telemetry (the MMgrReport stream + the mgr-side aggregation)
     o("mgr_stats_period", float, 0.5, LEVEL_BASIC,
       "seconds between a daemon's MMgrReport perf/telemetry reports "

@@ -293,6 +293,27 @@ class PrometheusModule(MgrModule):
                     emit("ceph_tpu_mesh_stolen_total",
                          mesh.get("stolen_total", 0), lbl,
                          mtype="counter")
+                # dmclock QoS op-queue series: one row per op class,
+                # per-pool classes spell "client:<pool>" — split so the
+                # pool rides its own label (cardinality is bounded:
+                # only pools with a QoS profile get their own class)
+                for klass, row in sorted(
+                        (status.get("op_queue") or {}).items()):
+                    base, _, qpool = klass.partition(":")
+                    qlbl = dict(lbl, **{"class": base, "pool": qpool})
+                    emit("ceph_osd_qos_queue_depth",
+                         row.get("depth", 0), qlbl,
+                         help_="ops waiting in this dmclock class "
+                               "across the OSD's shards")
+                    emit("ceph_osd_qos_served_total",
+                         row.get("served", 0), qlbl, mtype="counter",
+                         help_="ops dequeued from this dmclock class")
+                    emit("ceph_osd_qos_throttle_wait_seconds",
+                         row.get("throttle_wait_s", 0.0), qlbl,
+                         mtype="counter",
+                         help_="cumulative worker idle time charged "
+                               "to this class's limit/reservation "
+                               "throttling")
             # balancer sweep timings (ROADMAP #4's measured-feedback
             # series), exported with a backend label
             for key in metrics.value_keys():
@@ -537,6 +558,21 @@ class StatusModule(MgrModule):
                         "%s (%s): %.1f op/s, %.1f MB/s"
                         % (r["client"], r["pool"], r["ops_rate"],
                            r["MBps"]) for r in top)
+            # active per-pool QoS profiles (dmclock reservations riding
+            # the osdmap) — adaptive grants from the SLO loop show the
+            # same way operator-set ones do
+            qos_lines = []
+            for pool in sorted(osdmap.pools.values(),
+                               key=lambda p: p.pool_id):
+                if getattr(pool, "has_qos", lambda: False)():
+                    qos_lines.append(
+                        "%s: res %.0f op/s, wgt %.0f, lim %s"
+                        % (pool.name, pool.qos_reservation,
+                           pool.qos_weight or 500.0,
+                           ("%.0f op/s" % pool.qos_limit)
+                           if pool.qos_limit > 0 else "none"))
+            if qos_lines:
+                out += "\n  qos:\n    " + "\n    ".join(qos_lines)
             # active progress bars (mgr progress module narration)
             progress = self.mgr.modules.get("progress")
             if progress is not None and \

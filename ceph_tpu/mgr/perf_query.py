@@ -110,6 +110,21 @@ class PerfQueryModule(MgrModule):
                                      float)
         self.slo_targets = _parse_slo_targets(
             self._conf(conf, "mgr_slo_pool_targets", "", str))
+        # adaptive QoS: burn > 1.0 -> bump the pool's dmclock
+        # reservation ('osd pool set qos_reservation') so the OSD op
+        # queues shift capacity toward the burning pool
+        self.qos_adaptive = self._conf(conf, "mgr_qos_adaptive",
+                                       False, bool)
+        self.qos_adapt_min = self._conf(conf, "mgr_qos_adapt_min_res",
+                                        50.0, float)
+        self.qos_adapt_factor = self._conf(conf, "mgr_qos_adapt_factor",
+                                           1.5, float)
+        self.qos_adapt_max = self._conf(conf, "mgr_qos_adapt_max_res",
+                                        10000.0, float)
+        self.qos_adapt_cooldown = self._conf(
+            conf, "mgr_qos_adapt_cooldown", 5.0, float)
+        self._qos_last_bump: dict[str, float] = {}   # pool -> mono
+        self._qos_granted: dict[str, float] = {}     # pool -> res posted
         self._lock = threading.RLock()
         self._queries: dict[int, dict] = {}    # qid -> spec
         self._next_qid = 1
@@ -428,7 +443,43 @@ class PerfQueryModule(MgrModule):
         self.set_health_checks(checks)
         if bool(violating) != was_alerting:
             self._post_slo(sorted(violating), state)
+        if self.qos_adaptive and violating:
+            self._qos_adapt(sorted(violating), now)
         return state
+
+    def _qos_adapt(self, violating: list, now: float) -> None:
+        """SLO-driven reservation loop: each still-burning pool gets a
+        multiplicative reservation bump (floored at adapt_min, capped
+        at adapt_max), rate-limited by the cooldown so the previous
+        grant can propagate through the osdmap before re-judging."""
+        osdmap = self.get("osd_map")
+        for pool in violating:
+            if now - self._qos_last_bump.get(pool, -1e9) < \
+                    self.qos_adapt_cooldown:
+                continue
+            cur = self._qos_granted.get(pool, 0.0)
+            if osdmap is not None:
+                for p in osdmap.pools.values():
+                    if p.name == pool:
+                        cur = max(cur,
+                                  getattr(p, "qos_reservation", 0.0))
+                        break
+            new = min(max(self.qos_adapt_min,
+                          cur * self.qos_adapt_factor),
+                      self.qos_adapt_max)
+            if new <= cur:
+                continue   # already at the ceiling
+            self._qos_last_bump[pool] = now
+            self._qos_granted[pool] = new
+            self._post_q.put({"prefix": "osd pool set", "pool": pool,
+                              "var": "qos_reservation",
+                              "val": str(new)})
+            self._ensure_post_thread()
+
+    def qos_adapt_status(self) -> dict:
+        with self._lock:
+            return {"adaptive": self.qos_adaptive,
+                    "granted": dict(self._qos_granted)}
 
     def slo_status(self) -> dict:
         with self._lock:
@@ -451,6 +502,11 @@ class PerfQueryModule(MgrModule):
         self._post_q.put({"prefix": "health slo-report",
                           "reporter": self.mgr.name,
                           "violating": violating, "detail": detail})
+        self._ensure_post_thread()
+
+    def _ensure_post_thread(self) -> None:
+        if self._shutdown:
+            return
         if self._post_thread is None or \
                 not self._post_thread.is_alive():
             self._post_thread = threading.Thread(

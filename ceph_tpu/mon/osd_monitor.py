@@ -371,6 +371,10 @@ class OSDMonitor:
         "hit_set_fpp": float,
         "size": int,
         "min_size": int,
+        # dmclock QoS profile (rides the osdmap to every OSD op queue)
+        "qos_reservation": float,
+        "qos_weight": float,
+        "qos_limit": float,
     }
 
     def _tier_add(self, cmd: dict):

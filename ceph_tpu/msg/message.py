@@ -90,6 +90,12 @@ class MOSDOp(Message):
     # not traced
     trace_id: int = 0
     parent_span: int = 0
+    # dmclock feedback (appended fields): service this client received
+    # cluster-wide since its previous op to the target OSD, in op-queue
+    # min_cost units — delta counts every completion, rho only
+    # reservation-phase ones (Gulati et al., the distributed half)
+    qos_delta: float = 0.0
+    qos_rho: float = 0.0
 
 
 # CEPH_OSD_FLAG_IGNORE_CACHE (src/include/rados.h): run the op on the
@@ -110,6 +116,10 @@ class MOSDOpReply(Message):
     result: int = 0
     data: object = None
     map_epoch: int = 0
+    # dmclock phase that served the op (appended field): "" before the
+    # QoS queue saw it, else strict|reservation|proportional — clients
+    # accumulate rho from reservation-phase completions only
+    qos_phase: str = ""
 
 
 # -- EC sub-ops (src/osd/ECMsgTypes.h via MOSDECSubOp*) ----------------

@@ -20,6 +20,25 @@ Renditions of the reference's OSD op-queue disciplines, selected by the
 `QosShardedOpWQ` is the ShardedOpWQ shape (hash key -> shard, one
 worker per shard preserving per-PG ordering) with one of these queues
 inside each shard.
+
+dmClock extensions (the *distributed* half, Gulati et al. OSDI'10):
+
+  per-pool classes    a pool with a QoS profile (pg_pool_t
+                      qos_reservation/qos_weight/qos_limit riding the
+                      osdmap) splits its client ops into a dedicated
+                      "client:<pool>" class per shard, so one pool's
+                      reservation cannot be consumed by another's
+                      flood; reservation/limit rates are divided by
+                      the shard count (each shard runs its own tags).
+  delta/rho feedback  clients stamp each op with the service they
+                      received cluster-wide since their previous op to
+                      THIS osd (delta = all completions, rho =
+                      reservation-phase completions, both in min_cost
+                      units).  Tags advance by (rho+cost)/r and
+                      (delta+cost)/w instead of cost/r and cost/w, so
+                      every OSD prices the work its peers already did
+                      and a client's reservation holds globally rather
+                      than per-server.
 """
 
 from __future__ import annotations
@@ -36,7 +55,8 @@ __all__ = ["OpQueue", "WeightedPriorityQueue", "MClockOpClassQueue",
 class OpQueue:
     """Discipline contract (src/common/OpQueue.h)."""
 
-    def enqueue(self, klass: str, priority: int, cost: int, item) -> None:
+    def enqueue(self, klass: str, priority: int, cost: int, item,
+                delta: float = 0.0, rho: float = 0.0) -> None:
         raise NotImplementedError
 
     def enqueue_strict(self, klass: str, priority: int, item) -> None:
@@ -50,6 +70,20 @@ class OpQueue:
         """Seconds until a throttled head becomes eligible (None = no
         throttled work)."""
         return None
+
+    def set_class_info(self, klass: str, reservation: float,
+                       weight: float, limit: float) -> bool:
+        """Install/replace a class QoS profile; False if the discipline
+        has no per-class rates (wpq)."""
+        return False
+
+    def note_throttled(self, seconds: float,
+                       now: float | None = None) -> None:
+        """Attribute worker idle-wait to the classes it throttled."""
+
+    def class_stats(self) -> dict:
+        """{class: {depth, served, throttle_wait_s}} for observability."""
+        return {}
 
     def empty(self) -> bool:
         raise NotImplementedError
@@ -75,13 +109,16 @@ class WeightedPriorityQueue(OpQueue):
         self._buckets: "OrderedDict[int, deque]" = OrderedDict()
         self._deficit: dict[int, float] = {}
         self._size = 0
+        self._kdepth: dict[str, int] = {}
+        self._kserved: dict[str, int] = {}
 
-    def enqueue(self, klass, priority, cost, item):
+    def enqueue(self, klass, priority, cost, item, delta=0.0, rho=0.0):
         b = self._buckets.get(priority)
         if b is None:
             b = self._buckets[priority] = deque()
             self._deficit.setdefault(priority, 0.0)
-        b.append((max(cost, 0), item))
+        b.append((max(cost, 0), klass, item))
+        self._kdepth[klass] = self._kdepth.get(klass, 0) + 1
         self._size += 1
 
     def enqueue_strict(self, klass, priority, item):
@@ -91,21 +128,31 @@ class WeightedPriorityQueue(OpQueue):
         if band is None:
             band = self._strict[priority] = deque()
             bisect.insort(self._strict_prios, priority)
-        band.append(item)
+        band.append((klass, item))
+        self._kdepth[klass] = self._kdepth.get(klass, 0) + 1
         self._size += 1
 
     def _cost_units(self, cost: int) -> float:
         return max(cost, self.min_cost) / self.min_cost
 
+    def _count_served(self, klass: str) -> None:
+        d = self._kdepth.get(klass, 1) - 1
+        if d <= 0:
+            self._kdepth.pop(klass, None)
+        else:
+            self._kdepth[klass] = d
+        self._kserved[klass] = self._kserved.get(klass, 0) + 1
+
     def dequeue(self, now=None):
         if self._strict_prios:
             prio = self._strict_prios[-1]
             band = self._strict[prio]
-            item = band.popleft()
+            klass, item = band.popleft()
             if not band:
                 del self._strict[prio]
                 self._strict_prios.pop()
             self._size -= 1
+            self._count_served(klass)
             return item
         # Deficit round robin: a bucket at the front keeps serving while
         # its deficit covers the head's cost, then earns `priority` more
@@ -116,18 +163,27 @@ class WeightedPriorityQueue(OpQueue):
         while self._buckets:
             priority, bucket = next(iter(self._buckets.items()))
             if self._deficit[priority] >= self._cost_units(bucket[0][0]):
-                cost, item = bucket.popleft()
+                cost, klass, item = bucket.popleft()
                 self._deficit[priority] -= self._cost_units(cost)
                 self._size -= 1
                 if not bucket:
                     del self._buckets[priority]
                     del self._deficit[priority]
+                self._count_served(klass)
                 return item
             # quantum floor of 1: a zero/negative priority must still
             # make progress or the shard worker spins forever on it
             self._deficit[priority] += max(priority, 1)
             self._buckets.move_to_end(priority)
         return None
+
+    def class_stats(self):
+        out = {}
+        for klass in set(self._kdepth) | set(self._kserved):
+            out[klass] = {"depth": self._kdepth.get(klass, 0),
+                          "served": self._kserved.get(klass, 0),
+                          "throttle_wait_s": 0.0}
+        return out
 
     def empty(self) -> bool:
         return self._size == 0
@@ -138,7 +194,7 @@ class WeightedPriorityQueue(OpQueue):
 
 class _MClass:
     __slots__ = ("reservation", "weight", "limit", "q",
-                 "r_tag", "p_tag", "l_tag")
+                 "r_tag", "p_tag", "l_tag", "served", "throttled_s")
 
     def __init__(self, reservation: float, weight: float, limit: float):
         self.reservation = reservation
@@ -151,6 +207,8 @@ class _MClass:
         self.r_tag: float | None = None
         self.p_tag: float | None = None
         self.l_tag: float | None = None
+        self.served = 0
+        self.throttled_s = 0.0
 
 
 class MClockOpClassQueue(OpQueue):
@@ -172,33 +230,63 @@ class MClockOpClassQueue(OpQueue):
     }
 
     def __init__(self, client_info: dict | None = None,
-                 min_cost: int = 4096):
+                 min_cost: int = 4096, clock=None):
         self.info = dict(self.DEFAULT_INFO)
         if client_info:
             self.info.update(client_info)
         self.min_cost = min_cost
+        # injectable for bit-exact tag-math tests on a fake clock
+        self._clock = clock if clock is not None else time.monotonic
         self._classes: dict[str, _MClass] = {}
         self._strict: deque = deque()
+        self._strict_served = 0
         self._size = 0
+        # (klass, phase) of the most recent dequeue; phase is one of
+        # "strict" | "reservation" | "proportional" — servers stamp it
+        # on the reply so clients can accumulate dmclock rho
+        self.last_dequeue: tuple[str, str] | None = None
+
+    def _lookup_info(self, klass: str) -> tuple:
+        """Exact class, else its base before ':' (a per-pool class
+        "client:gold" with no explicit profile inherits "client")."""
+        got = self.info.get(klass)
+        if got is not None:
+            return got
+        if ":" in klass:
+            got = self.info.get(klass.split(":", 1)[0])
+            if got is not None:
+                return got
+        return (0.0, 1.0, 0.0)
 
     def _class(self, klass: str) -> _MClass:
         c = self._classes.get(klass)
         if c is None:
-            res, wgt, lim = self.info.get(klass, (0.0, 1.0, 0.0))
+            res, wgt, lim = self._lookup_info(klass)
             c = self._classes[klass] = _MClass(res, wgt, lim)
         return c
 
+    def set_class_info(self, klass, reservation, weight, limit) -> bool:
+        self.info[klass] = (reservation, weight, limit)
+        c = self._classes.get(klass)
+        if c is not None:
+            # live rate change applies from the next enqueue; queued
+            # ops keep the tags they were priced at
+            c.reservation = reservation
+            c.weight = weight
+            c.limit = limit
+        return True
+
     @staticmethod
-    def _next_tag(prev: float | None, rate: float, scale: float,
+    def _next_tag(prev: float | None, rate: float, units: float,
                   now: float) -> float:
-        """max(now, prev + scale/rate); a fresh/long-idle class tags at
+        """max(now, prev + units/rate); a fresh/long-idle class tags at
         now so its first op is immediately eligible."""
         if prev is None:
             return now
-        return max(now, prev + scale / rate)
+        return max(now, prev + units / rate)
 
-    def enqueue(self, klass, priority, cost, item):
-        now = time.monotonic()
+    def enqueue(self, klass, priority, cost, item, delta=0.0, rho=0.0):
+        now = self._clock()
         c = self._class(klass)
         # normalize byte cost into units so weights stay the dominant
         # signal (raw bytes would advance a 1MB client op's tag by
@@ -215,15 +303,19 @@ class MClockOpClassQueue(OpQueue):
                 prev = getattr(c, attr)
                 if prev is not None and prev > now:
                     setattr(c, attr, now)
+        # dmClock: delta/rho are min_cost units of service this
+        # principal received cluster-wide since its previous op to this
+        # server; pricing them into the advance makes each tag reflect
+        # global service, so an OSD that served less pulls ahead
         if c.reservation > 0:
-            r = self._next_tag(c.r_tag, c.reservation, scale, now)
+            r = self._next_tag(c.r_tag, c.reservation, rho + scale, now)
             c.r_tag = r
         else:
             r = float("inf")
-        p = self._next_tag(c.p_tag, c.weight, scale, now)
+        p = self._next_tag(c.p_tag, c.weight, delta + scale, now)
         c.p_tag = p
         if c.limit > 0:
-            lim = self._next_tag(c.l_tag, c.limit, scale, now)
+            lim = self._next_tag(c.l_tag, c.limit, delta + scale, now)
             c.l_tag = lim
         else:
             lim = 0.0
@@ -231,40 +323,69 @@ class MClockOpClassQueue(OpQueue):
         self._size += 1
 
     def enqueue_strict(self, klass, priority, item):
-        self._strict.append(item)
+        self._strict.append((klass, item))
         self._size += 1
 
     def dequeue(self, now=None):
         if self._strict:
             self._size -= 1
-            return self._strict.popleft()
-        now = time.monotonic() if now is None else now
+            self._strict_served += 1
+            klass, item = self._strict.popleft()
+            self.last_dequeue = (klass, "strict")
+            return item
+        now = self._clock() if now is None else now
         # reservation phase
         best = None
+        phase = "reservation"
         for klass, c in self._classes.items():
             if c.q and c.q[0][0] <= now:
                 if best is None or c.q[0][0] < best[0]:
-                    best = (c.q[0][0], c)
+                    best = (c.q[0][0], klass, c)
         if best is None:
             # proportional phase (limit-gated)
+            phase = "proportional"
             for klass, c in self._classes.items():
                 if c.q and c.q[0][2] <= now:
                     if best is None or c.q[0][1] < best[0]:
-                        best = (c.q[0][1], c)
+                        best = (c.q[0][1], klass, c)
         if best is not None:
-            _, _, _, item = best[1].q.popleft()
+            _, klass, c = best
+            _, _, _, item = c.q.popleft()
+            c.served += 1
             self._size -= 1
+            self.last_dequeue = (klass, phase)
             return item
         return None
 
     def next_ready_in(self, now=None):
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         # a head op becomes serviceable at the earlier of its
         # reservation tag and its limit tag (dequeue serves the
         # r-phase first), so the wait must take min over both
         waits = [min(c.q[0][0], c.q[0][2]) - now
                  for c in self._classes.values() if c.q]
         return max(0.0, min(waits)) if waits else None
+
+    def note_throttled(self, seconds, now=None):
+        """Attribute `seconds` of worker idle-wait to every class whose
+        head op is ineligible — its limit (or unmet reservation) is
+        what kept the worker sleeping."""
+        now = self._clock() if now is None else now
+        for c in self._classes.values():
+            if c.q and min(c.q[0][0], c.q[0][2]) > now:
+                c.throttled_s += seconds
+
+    def class_stats(self):
+        out = {}
+        for klass, c in self._classes.items():
+            if c.q or c.served or c.throttled_s:
+                out[klass] = {"depth": len(c.q), "served": c.served,
+                              "throttle_wait_s": c.throttled_s}
+        if self._strict or self._strict_served:
+            out["strict"] = {"depth": len(self._strict),
+                             "served": self._strict_served,
+                             "throttle_wait_s": 0.0}
+        return out
 
     def empty(self) -> bool:
         return self._size == 0
@@ -280,7 +401,7 @@ def make_op_queue(conf=None) -> OpQueue | None:
         return WeightedPriorityQueue()
     if name == "mclock_opclass":
         info = {}
-        for klass in ("client", "recovery"):
+        for klass in ("client", "recovery", "scrub", "snaptrim"):
             info[klass] = (
                 conf.get_val("osd_op_queue_mclock_%s_res" % klass),
                 conf.get_val("osd_op_queue_mclock_%s_wgt" % klass),
@@ -312,9 +433,44 @@ class QosShardedOpWQ:
             s.start()
 
     def queue(self, key, fn, *args, klass: str = "client",
-              priority: int = 63, cost: int = 0) -> None:
+              priority: int = 63, cost: int = 0, delta: float = 0.0,
+              rho: float = 0.0, qos_obj=None) -> None:
+        # qos_obj (usually the op message) gets `_qos_phase` stamped at
+        # dequeue time so the reply can tell the client which dmclock
+        # phase served it
         self._shards[hash(key) % self.num_shards].enqueue(
-            klass, priority, cost, (fn, args))
+            klass, priority, cost, (fn, args, qos_obj), delta, rho)
+
+    def set_pool_qos(self, pool: str, reservation: float, weight: float,
+                     limit: float) -> bool:
+        """Split the pool's client ops into a dedicated per-shard class.
+
+        Reservation/limit arrive as whole-OSD op rates; each shard runs
+        independent tags, so the rates are divided across shards
+        (weight is relative and needs no scaling)."""
+        n = max(1, self.num_shards)
+        ok = False
+        for s in self._shards:
+            with s._cond:
+                ok = s.opq.set_class_info("client:%s" % pool,
+                                          reservation / n, weight,
+                                          limit / n) or ok
+                s._cond.notify_all()
+        return ok
+
+    def dump(self) -> dict:
+        """Per-class stats merged across shards (asok dump_op_queue)."""
+        out: dict = {}
+        for s in self._shards:
+            with s._cond:
+                stats = s.opq.class_stats()
+            for klass, st in stats.items():
+                agg = out.setdefault(klass, {"depth": 0, "served": 0,
+                                             "throttle_wait_s": 0.0})
+                agg["depth"] += st["depth"]
+                agg["served"] += st["served"]
+                agg["throttle_wait_s"] += st["throttle_wait_s"]
+        return out
 
     def drain(self) -> None:
         for s in self._shards:
@@ -344,10 +500,20 @@ class _QosShard:
                                         name=self.name, daemon=True)
         self._thread.start()
 
-    def enqueue(self, klass, priority, cost, item) -> None:
+    def enqueue(self, klass, priority, cost, item,
+                delta: float = 0.0, rho: float = 0.0) -> None:
         with self._cond:
-            self.opq.enqueue(klass, priority, cost, item)
+            self.opq.enqueue(klass, priority, cost, item, delta, rho)
             self._cond.notify()
+
+    def _stamp_phase(self, item) -> None:
+        # must run under the lock, right after the dequeue that set
+        # last_dequeue — another worker pass would overwrite it
+        qos_obj = item[2] if len(item) > 2 else None
+        if qos_obj is not None:
+            ld = getattr(self.opq, "last_dequeue", None)
+            if ld is not None:
+                qos_obj._qos_phase = ld[1]
 
     def _worker(self) -> None:
         handle = self._hbmap.add(self.name, self._grace) \
@@ -368,18 +534,25 @@ class _QosShard:
                                 handle.remove()
                             return
                         self._inflight += 1
+                        self._stamp_phase(item)
                         break
                     item = self.opq.dequeue()
                     if item is not None:
                         self._inflight += 1
+                        self._stamp_phase(item)
                         break
                     wait = self.opq.next_ready_in()
-                    self._cond.wait(min(wait, self._wait_cap)
-                                    if wait is not None
-                                    else self._wait_cap)
+                    if wait is not None:
+                        # head(s) exist but are throttled: sleep and
+                        # charge the wait to the classes that caused it
+                        t0 = time.monotonic()
+                        self._cond.wait(min(wait, self._wait_cap))
+                        self.opq.note_throttled(time.monotonic() - t0)
+                    else:
+                        self._cond.wait(self._wait_cap)
             if handle:
                 handle.renew()
-            fn, args = item
+            fn, args = item[0], item[1]
             try:
                 fn(*args)
             except Exception:

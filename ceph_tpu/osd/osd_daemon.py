@@ -105,6 +105,9 @@ class OSDDaemon(Dispatcher):
             self.op_wq = QosShardedOpWQ(
                 "osd%d-op" % whoami, conf.get_val("osd_op_num_shards"),
                 lambda: make_op_queue(conf), self.ctx.hbmap)
+        # pool -> (res, wgt, lim) profiles already pushed into the
+        # shards, so map churn doesn't re-post unchanged rates
+        self._pool_qos_applied: dict = {}
         self.client_op_priority = conf.get_val("osd_client_op_priority")
         self.recovery_op_priority = conf.get_val("osd_recovery_op_priority")
         # per-op event history + slow-request detection (OpTracker);
@@ -292,6 +295,11 @@ class OSDDaemon(Dispatcher):
                               "results": self.perf_query.dump()},
                 "live perf-query subscriptions + per-key tables "
                 "(ops/bytes/latency per client/pool/pg key)")
+            self.ctx.admin_socket.register(
+                "dump_op_queue",
+                lambda args: self._dump_op_queue(),
+                "QoS op-queue state: per-class/per-pool depth, served "
+                "and limit-throttle wait merged across shards")
         self.hb_peers: dict = {}       # osd -> last reply stamp
         self.hb_pending: dict = {}     # osd -> first unacked ping stamp
         # cache tiering: base-pool IO runs on dedicated threads with an
@@ -521,9 +529,45 @@ class OSDDaemon(Dispatcher):
         with self.lock:
             self.osdmap = newmap
             pgs = list(self.pgs.values())
+        self._apply_pool_qos(newmap)
         for pg in pgs:
             self.op_wq.queue(pg.pgid, pg.on_map_change)
         self._scan_for_new_pgs()
+
+    def _apply_pool_qos(self, m) -> None:
+        """Push pool dmclock profiles from the osdmap into every op
+        shard: a pool with a profile gets its own "client:<name>"
+        class so another pool's flood cannot consume its reservation."""
+        if not isinstance(self.op_wq, QosShardedOpWQ):
+            return
+        for pool in m.pools.values():
+            if not getattr(pool, "has_qos", lambda: False)():
+                continue
+            prof = (pool.qos_reservation, pool.qos_weight or 500.0,
+                    pool.qos_limit)
+            if self._pool_qos_applied.get(pool.name) == prof:
+                continue
+            if self.op_wq.set_pool_qos(pool.name, *prof):
+                self._pool_qos_applied[pool.name] = prof
+
+    def _qos_class_for(self, pool) -> str:
+        """Op class for a client op: per-pool when the pool carries a
+        QoS profile (bounded cardinality — one extra class per
+        profiled pool), plain "client" otherwise."""
+        if pool is not None and getattr(pool, "has_qos",
+                                        lambda: False)():
+            return "client:%s" % pool.name
+        return "client"
+
+    def _dump_op_queue(self) -> dict:
+        if isinstance(self.op_wq, QosShardedOpWQ):
+            classes = self.op_wq.dump()
+        else:
+            classes = {}
+        return {"discipline": self.ctx.conf.get_val("osd_op_queue"),
+                "num_shards": self.ctx.conf.get_val("osd_op_num_shards"),
+                "classes": classes,
+                "pool_profiles": dict(self._pool_qos_applied)}
 
     def _scan_for_new_pgs(self) -> None:
         """Instantiate PGs this OSD is acting in (load_pgs analog)."""
@@ -830,6 +874,11 @@ class OSDDaemon(Dispatcher):
             disp = rateless.get_dispatcher(create=False)
             if disp is not None:
                 status["mesh"] = disp.status()
+        except Exception:
+            pass
+        try:
+            if isinstance(self.op_wq, QosShardedOpWQ):
+                status["op_queue"] = self.op_wq.dump()
         except Exception:
             pass
         return status
@@ -1186,9 +1235,13 @@ class OSDDaemon(Dispatcher):
             self.perf.hinc("l_osd_op_trace_us",
                            max(0, int(op.duration * 1e6)))
             op.mark_commit_sent()
+            # dmclock phase stamp (set by the QoS shard at dequeue):
+            # reservation-phase completions feed the client's rho
             self.public_msgr.send_message(
                 MOSDOpReply(tid=msg.tid, result=result, data=data,
-                            map_epoch=self.map_epoch()), client_addr)
+                            map_epoch=self.map_epoch(),
+                            qos_phase=getattr(msg, "_qos_phase", "")),
+                client_addr)
             span.keyval("result", result)
             span.finish()
             # flight recorder: snapshot the finished trace tree onto
@@ -1233,9 +1286,12 @@ class OSDDaemon(Dispatcher):
         if throttle_release is not None:
             msg._throttle_adopted = True
         self.op_wq.queue(pg.pgid, run, msg, reply,
-                         klass="client",
+                         klass=self._qos_class_for(pg.pool),
                          priority=self.client_op_priority,
-                         cost=len(getattr(msg, "data", b"") or b""))
+                         cost=in_bytes,
+                         delta=getattr(msg, "qos_delta", 0.0),
+                         rho=getattr(msg, "qos_rho", 0.0),
+                         qos_obj=msg)
 
     def _normalize_pgid(self, raw_pgid):
         pool = self.osdmap.pools.get(raw_pgid.pool)

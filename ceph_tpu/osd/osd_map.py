@@ -103,6 +103,15 @@ class PGPool:
     cache_target_full_ratio: float = 0.8
     cache_min_flush_age: int = 0       # seconds
     cache_min_evict_age: int = 0       # seconds
+    # dmclock QoS profile (rides the osdmap into every OSD's op-queue
+    # shards as a dedicated "client:<pool>" class; 0/0/0 = no profile)
+    qos_reservation: float = 0.0       # ops/s reserved cluster-wide
+    qos_weight: float = 0.0            # relative share; 0 = inherit
+    qos_limit: float = 0.0             # ops/s cap; 0 = unlimited
+
+    def has_qos(self) -> bool:
+        return (self.qos_reservation > 0 or self.qos_weight > 0
+                or self.qos_limit > 0)
 
     def snap_context(self) -> tuple:
         """Pool-snap SnapContext for writes: (seq, ids descending)."""

@@ -386,7 +386,12 @@ class PG:
             self.pool = newpool
             if fresh:
                 self._trimmed_snaps.update(fresh)
-                self.trim_snaps(fresh)
+                # trim runs as its own snaptrim-class work item: under
+                # mclock it is paced by the snaptrim rates instead of
+                # riding the map-change op's class
+                self.daemon.op_wq.queue(self.pgid, self.trim_snaps,
+                                        fresh, klass="snaptrim",
+                                        priority=1)
         up, upp, acting, actp = m.pg_to_up_acting_osds(self.pgid)
         with self.lock:
             changed = acting != self.acting or actp != self.acting_primary

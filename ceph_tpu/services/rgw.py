@@ -81,12 +81,31 @@ class _Store:
         except OSError:
             return []
 
-    def create_bucket(self, bucket: str) -> None:
+    def create_bucket(self, bucket: str, acl: str = "private") -> None:
         with self._lock:
             if bucket in self.list_buckets():
                 raise S3Error(409, "BucketAlreadyExists", bucket)
             self.ioctx.write_full(_index_oid(bucket), b"")
-            self.ioctx.omap_set(ROSTER_OID, {bucket: b"1"})
+            self.ioctx.omap_set(ROSTER_OID, {
+                bucket: encoding.encode_any({"acl": acl})})
+
+    def bucket_acl(self, bucket: str) -> str:
+        """Canned ACL stored in the roster row; rosters written before
+        ACLs existed hold b"1" and read as private."""
+        try:
+            raw = self.ioctx.omap_get(ROSTER_OID)[bucket]
+        except (OSError, KeyError):
+            raise S3Error(404, "NoSuchBucket", bucket)
+        try:
+            return encoding.decode_any(raw).get("acl", "private")
+        except Exception:
+            return "private"
+
+    def set_bucket_acl(self, bucket: str, acl: str) -> None:
+        with self._lock:
+            self._require_bucket(bucket)
+            self.ioctx.omap_set(ROSTER_OID, {
+                bucket: encoding.encode_any({"acl": acl})})
 
     def _require_bucket(self, bucket: str) -> None:
         if bucket not in self.list_buckets():
@@ -328,6 +347,9 @@ class RGWServer:
                      .add_u64_counter("put_b", "bytes taken by PUT")
                      .create_perf_counters())
         self._mgr_timer: threading.Timer | None = None
+        # Swift front session tokens (X-Auth-Token -> account); the
+        # reference's rgw swift front keeps these in its token cache
+        self._swift_tokens: dict[str, str] = {}
         gw = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -339,8 +361,15 @@ class RGWServer:
             def _dispatch(self, method):
                 gw.perf.inc("req")
                 try:
-                    gw._check_auth(method, self)
-                    status, headers, body = gw._route(method, self)
+                    path = urlsplit(self.path).path
+                    if path == "/auth/v1.0" or \
+                            path.startswith("/swift/"):
+                        # Swift front: token auth + text errors
+                        status, headers, body = gw._swift(method, self)
+                    else:
+                        principal = gw._check_auth(method, self)
+                        status, headers, body = gw._route(
+                            method, self, principal)
                 except S3Error as e:
                     status, body = e.status, e.body()
                     headers = {"Content-Type": "application/xml"}
@@ -433,12 +462,20 @@ class RGWServer:
 
     # -- auth ----------------------------------------------------------
 
-    def _check_auth(self, method, req) -> None:
+    def _check_auth(self, method, req) -> str | None:
+        """Verify the AWS v2 signature when present.
+
+        Returns the authenticated access key, or None for an anonymous
+        request — anonymous is no longer rejected here; per-route
+        canned-ACL checks (_authorize) decide what it may touch.  A
+        PRESENT but bad signature still fails closed."""
         if not self.credentials:
-            return
+            return None
         auth = req.headers.get("Authorization", "")
+        if not auth:
+            return None
         if not auth.startswith("AWS "):
-            raise S3Error(403, "AccessDenied", "missing AWS auth")
+            raise S3Error(403, "AccessDenied", "malformed auth")
         try:
             access, sig = auth[4:].split(":", 1)
         except ValueError:
@@ -451,6 +488,172 @@ class RGWServer:
         want = _sign_v2(secret, string_to_sign(method, path, hdrs))
         if not hmac.compare_digest(sig, want):
             raise S3Error(403, "SignatureDoesNotMatch", "")
+        return access
+
+    #: canned ACLs both fronts understand (rgw_acl.cc's canned set,
+    #: minus the ownership-transfer ones a single-tenant gateway
+    #: cannot express)
+    CANNED_ACLS = ("private", "public-read", "public-read-write")
+
+    def _authorize(self, principal, bucket, want: str) -> None:
+        """Gate one op: want is 'read' | 'write' | 'owner'.
+
+        Authenticated principals own everything (single-tenant);
+        anonymous requests pass only where the bucket's canned ACL
+        grants them, and never at the service/owner level."""
+        if not self.credentials or principal is not None:
+            return
+        if want == "owner" or not bucket:
+            raise S3Error(403, "AccessDenied", "authentication required")
+        acl = self.store.bucket_acl(bucket)
+        if want == "read" and acl in ("public-read",
+                                      "public-read-write"):
+            return
+        if want == "write" and acl == "public-read-write":
+            return
+        raise S3Error(403, "AccessDenied", "anonymous vs %s acl" % acl)
+
+    # -- Swift front (rgw_rest_swift.cc role) --------------------------
+    #
+    # TempAuth-style handshake: GET /auth/v1.0 with X-Auth-User /
+    # X-Auth-Key returns X-Auth-Token + X-Storage-Url; the data API
+    # lives under /swift/v1/<container>[/<object>]. Containers and S3
+    # buckets are the same namespace (one roster, one index), so ACLs
+    # set on either front gate anonymous access on both.
+
+    def _swift(self, method, req):
+        try:
+            return self._swift_route(method, req)
+        except S3Error as e:
+            # Swift speaks plain-text errors, not S3's XML envelope
+            return e.status, {"Content-Type": "text/plain"}, \
+                ("%s: %s\n" % (e.code, e.message)).encode()
+
+    def _swift_principal(self, req) -> str | None:
+        if not self.credentials:
+            return "anonymous-ok"       # auth off: everything passes
+        return self._swift_tokens.get(
+            req.headers.get("X-Auth-Token", ""))
+
+    @staticmethod
+    def _swift_acl_from(req, default: str = "private") -> str | None:
+        """Map Swift container ACL headers onto the canned set:
+        X-Container-Read '.r:*' -> public-read, plus X-Container-Write
+        '.r:*'/'*' -> public-read-write. Returns None when neither
+        header is present (POST must not clobber an unrelated ACL)."""
+        read_hdr = req.headers.get("X-Container-Read")
+        write_hdr = req.headers.get("X-Container-Write")
+        if read_hdr is None and write_hdr is None:
+            return None
+        public_read = ".r:*" in (read_hdr or "")
+        public_write = ".r:*" in (write_hdr or "") or \
+            (write_hdr or "").strip() == "*"
+        if public_write:
+            return "public-read-write"
+        if public_read:
+            return "public-read"
+        return default
+
+    def _swift_route(self, method, req):
+        split = urlsplit(req.path)
+        path = unquote(split.path)
+        if path == "/auth/v1.0":
+            user = req.headers.get("X-Auth-User", "")
+            key = req.headers.get("X-Auth-Key", "")
+            if self.credentials and \
+                    self.credentials.get(user) != key:
+                raise S3Error(401, "Unauthorized", "bad credentials")
+            token = "AUTH_tk" + uuid.uuid4().hex
+            self._swift_tokens[token] = user or "anonymous"
+            url = "http://%s:%d/swift/v1" % (self.addr[0],
+                                             self.addr[1])
+            return 200, {"X-Auth-Token": token,
+                         "X-Storage-Token": token,
+                         "X-Storage-Url": url}, b""
+        if not (path == "/swift/v1" or path.startswith("/swift/v1/")):
+            raise S3Error(404, "NotFound", path)
+        rest = path[len("/swift/v1"):].lstrip("/")
+        cparts = rest.split("/", 1) if rest else []
+        container = cparts[0] if cparts else ""
+        obj = cparts[1] if len(cparts) > 1 else ""
+        query = parse_qs(split.query, keep_blank_values=True)
+        principal = self._swift_principal(req)
+        if not container:               # account level
+            if method in ("GET", "HEAD"):
+                self._authorize(principal, None, "owner")
+                names = self.store.list_buckets()
+                body = ("".join(n + "\n" for n in names)).encode() \
+                    if method == "GET" else b""
+                return (200 if names and method == "GET" else 204), \
+                    {"Content-Type": "text/plain",
+                     "X-Account-Container-Count": str(len(names))}, \
+                    body
+            raise S3Error(405, "MethodNotAllowed", method)
+        if not obj:                     # container level
+            if method == "PUT":
+                self._authorize(principal, None, "owner")
+                acl = self._swift_acl_from(req) or "private"
+                try:
+                    self.store.create_bucket(container, acl)
+                    return 201, {}, b""
+                except S3Error as e:
+                    if e.code != "BucketAlreadyExists":
+                        raise
+                    if self._swift_acl_from(req) is not None:
+                        self.store.set_bucket_acl(container, acl)
+                    return 202, {}, b""
+            if method == "POST":
+                self._authorize(principal, container, "owner")
+                acl = self._swift_acl_from(req)
+                if acl is not None:
+                    self.store.set_bucket_acl(container, acl)
+                return 204, {}, b""
+            if method == "DELETE":
+                self._authorize(principal, container, "owner")
+                self.store.delete_bucket(container)
+                return 204, {}, b""
+            if method == "GET":
+                self._authorize(principal, container, "read")
+                prefix = (query.get("prefix") or [""])[0]
+                entries = self.store.list_objects(container, prefix)
+                body = "".join(e["key"] + "\n"
+                               for e in entries).encode()
+                return (200 if entries else 204), \
+                    {"Content-Type": "text/plain"}, body
+            if method == "HEAD":
+                self._authorize(principal, container, "read")
+                entries = self.store.list_objects(container)
+                acl = self.store.bucket_acl(container)
+                hdrs = {"X-Container-Object-Count":
+                        str(len(entries))}
+                if acl in ("public-read", "public-read-write"):
+                    hdrs["X-Container-Read"] = ".r:*"
+                if acl == "public-read-write":
+                    hdrs["X-Container-Write"] = ".r:*"
+                return 204, hdrs, b""
+            raise S3Error(405, "MethodNotAllowed", method)
+        # object level
+        if method == "PUT":
+            self._authorize(principal, container, "write")
+            data = self._read_body(req)
+            etag = self.store.put_object(container, obj, data)
+            gw_hdrs = {"Etag": etag}
+            return 201, gw_hdrs, b""
+        if method == "GET":
+            self._authorize(principal, container, "read")
+            data, meta = self.store.get_object(container, obj)
+            return 200, {"Content-Type": "binary/octet-stream",
+                         "Etag": meta["etag"]}, data
+        if method == "HEAD":
+            self._authorize(principal, container, "read")
+            meta = self.store.head_object(container, obj)
+            return 200, {"Content-Length-Real": str(meta["size"]),
+                         "Etag": meta["etag"]}, b""
+        if method == "DELETE":
+            self._authorize(principal, container, "write")
+            self.store.delete_object(container, obj)
+            return 204, {}, b""
+        raise S3Error(405, "MethodNotAllowed", method)
 
     # -- routing -------------------------------------------------------
 
@@ -462,7 +665,14 @@ class RGWServer:
             raise S3Error(400, "InvalidArgument", "Content-Length")
         return req.rfile.read(length) if length > 0 else b""
 
-    def _route(self, method, req):
+    def _canned_acl_from(self, req, default: str = "private") -> str:
+        acl = req.headers.get("x-amz-acl", "") or default
+        if acl not in self.CANNED_ACLS:
+            raise S3Error(400, "InvalidArgument",
+                          "unsupported canned acl %r" % acl)
+        return acl
+
+    def _route(self, method, req, principal=None):
         split = urlsplit(req.path)
         parts = unquote(split.path).lstrip("/").split("/", 1)
         bucket = parts[0]
@@ -472,20 +682,45 @@ class RGWServer:
         query = parse_qs(split.query, keep_blank_values=True)
         if not bucket:
             if method == "GET":
+                self._authorize(principal, None, "owner")
                 return self._list_buckets()
             raise S3Error(405, "MethodNotAllowed", method)
         if not key:
+            if "acl" in query:
+                # bucket ACL subresource: owner-only on both verbs
+                self._authorize(principal, bucket, "owner")
+                if method == "PUT":
+                    self.store.set_bucket_acl(
+                        bucket, self._canned_acl_from(req))
+                    return 200, {}, b""
+                if method == "GET":
+                    acl = self.store.bucket_acl(bucket)
+                    body = ("<?xml version=\"1.0\" encoding=\"UTF-8\""
+                            "?><AccessControlPolicy><Canned>%s"
+                            "</Canned></AccessControlPolicy>"
+                            % escape(acl)).encode()
+                    return 200, {"Content-Type": "application/xml"}, \
+                        body
+                raise S3Error(405, "MethodNotAllowed", method)
             if method == "PUT":
-                self.store.create_bucket(bucket)
+                self._authorize(principal, None, "owner")
+                self.store.create_bucket(bucket,
+                                         self._canned_acl_from(req))
                 return 200, {"Location": "/" + bucket}, b""
             if method == "DELETE":
+                self._authorize(principal, bucket, "owner")
                 self.store.delete_bucket(bucket)
                 return 204, {}, b""
             if method == "GET":
+                self._authorize(principal, bucket, "read")
                 if "uploads" in query:
                     return self._list_uploads(bucket)
                 return self._list_objects(bucket, query)
             raise S3Error(405, "MethodNotAllowed", method)
+        if method in ("PUT", "POST", "DELETE"):
+            self._authorize(principal, bucket, "write")
+        else:
+            self._authorize(principal, bucket, "read")
         if method == "POST":
             # drain the body up front: on a keep-alive connection an
             # unread body corrupts the next request's parse

@@ -1,0 +1,40 @@
+"""Open-loop workload generation (the paper's million-client traffic
+model; CBT / `rados bench` / COSBench role collapsed into a library).
+
+The defining property is *open-loop* arrivals: each session draws its
+request times from an arrival process (Poisson, bursty, diurnal) fixed
+in advance, and latency is measured from the SCHEDULED arrival — not
+from when a previous completion freed a slot. A closed-loop generator
+silently stops applying load exactly when the system is slow, hiding
+the queueing it caused (coordinated omission); an open-loop one keeps
+the offered rate honest and lets queue delay show up in the recorded
+percentiles.
+
+Pieces:
+
+- :mod:`arrivals`   — Poisson / bursty (MMPP) / diurnal / fixed
+- :mod:`popularity` — Zipf object popularity (CDF + bisect)
+- :mod:`recorder`   — 2^n-microsecond latency histograms per class
+- :mod:`feedback`   — dmClock delta/rho client-side accounting
+- :mod:`driver`     — async mini-objecter (callback completions)
+- :mod:`profiles`   — RADOS read/write/mixed, RBD, RGW S3 / Swift
+- :mod:`harness`    — WorkloadHarness: sessions x arrivals -> driver
+"""
+
+from .arrivals import (BurstyArrivals, DiurnalArrivals, FixedArrivals,
+                       PoissonArrivals)
+from .driver import AsyncRadosDriver
+from .feedback import DmClockFeedback
+from .harness import WorkloadHarness
+from .popularity import UniformPopularity, ZipfPopularity
+from .profiles import (ProfileSpec, rados_mixed, rados_read,
+                       rados_write, rbd_profile, rgw_s3, rgw_swift)
+from .recorder import LatencyRecorder
+
+__all__ = [
+    "PoissonArrivals", "BurstyArrivals", "DiurnalArrivals",
+    "FixedArrivals", "ZipfPopularity", "UniformPopularity",
+    "LatencyRecorder", "DmClockFeedback", "AsyncRadosDriver",
+    "WorkloadHarness", "ProfileSpec", "rados_read", "rados_write",
+    "rados_mixed", "rbd_profile", "rgw_s3", "rgw_swift",
+]

@@ -1,0 +1,173 @@
+"""Async mini-objecter: callback completions over a RadosClient's
+messenger.
+
+RadosClient.submit_op is synchronous — one blocked thread per op —
+which caps a generator at a few hundred concurrent ops. The harness
+needs thousands of distinct SESSIONS with open-loop arrivals, so this
+driver keeps its own inflight table keyed by tid and completes ops
+from the dispatch thread via callbacks: one thread, unbounded
+concurrency.
+
+It piggybacks on an existing client: same messenger (so cephx,
+throttles and the mon subscription keep working), same tid counter (so
+(client, tid) stays unique and OSD-side dedup still recognizes our
+resends), but its OWN dispatcher registered at the head — replies to
+our tids never reach the client's table, and everything else falls
+through untouched. Each op carries the SESSION the caller supplies,
+which is how one process impersonates a million principals: the OSD's
+perf-query attribution keys on (client, session), not on the TCP
+connection.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..msg.message import MOSDOp
+
+_EAGAIN = -11
+
+
+class _Pending:
+    __slots__ = ("tid", "pool_id", "oid", "ops", "session", "key",
+                 "scheduled", "cb", "sent_at", "retry_at", "resends",
+                 "flags")
+
+    def __init__(self, tid, pool_id, oid, ops, session, key,
+                 scheduled, cb, flags):
+        self.tid = tid
+        self.pool_id = pool_id
+        self.oid = oid
+        self.ops = ops
+        self.session = session
+        self.key = key
+        self.scheduled = scheduled
+        self.cb = cb
+        self.sent_at = 0.0
+        self.retry_at = 0.0
+        self.resends = 0
+        self.flags = flags
+
+
+class AsyncRadosDriver:
+    """submit() never blocks; completions arrive on the messenger's
+    dispatch thread as cb(pending, result, data, now)."""
+
+    def __init__(self, client, feedback=None,
+                 resend_every: float = 1.0):
+        self.client = client
+        self.feedback = feedback
+        self.resend_every = resend_every
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._inflight: dict[int, _Pending] = {}
+        self.peak_inflight = 0
+        self.sent = 0
+        self.resent = 0
+        self.completed = 0
+        client.msgr.add_dispatcher_head(self)
+
+    # -- dispatch (runs on the messenger thread) -----------------------
+
+    def ms_dispatch(self, msg) -> bool:
+        if msg.get_type() != "MOSDOpReply":
+            return False
+        with self._lock:
+            p = self._inflight.get(msg.tid)
+            if p is None:
+                return False           # the client's op, not ours
+            if msg.result == _EAGAIN:
+                # wrong/unready primary: back off, tick() resends
+                p.retry_at = time.monotonic() + 0.1
+                return True
+            del self._inflight[msg.tid]
+            self.completed += 1
+            if not self._inflight:
+                self._idle.notify_all()
+        if self.feedback is not None:
+            src = getattr(msg, "from_name", None)
+            self.feedback.observe(src[1] if src else -1,
+                                  getattr(msg, "qos_phase", ""))
+        p.cb(p, msg.result, msg.data, time.monotonic())
+        return True
+
+    # -- submission ----------------------------------------------------
+
+    def submit(self, pool_id: int, oid: str, ops: list, session: str,
+               key: str, scheduled: float, cb, flags: int = 0) -> int:
+        tid = next(self.client._tids)
+        p = _Pending(tid, pool_id, oid, ops, session, key,
+                     scheduled, cb, flags)
+        with self._lock:
+            self._inflight[tid] = p
+            if len(self._inflight) > self.peak_inflight:
+                self.peak_inflight = len(self._inflight)
+        self._send(p)
+        self.sent += 1
+        return tid
+
+    def _send(self, p: _Pending) -> None:
+        c = self.client
+        try:
+            pgid, primary = c._target_for(p.pool_id, p.oid)
+        except Exception:
+            primary = -1
+        now = time.monotonic()
+        if primary == -1:
+            p.retry_at = now + 0.1     # no primary yet: tick() retries
+            return
+        addrs = c.osdmap.get_addr(primary)
+        addr = addrs.get("public") if isinstance(addrs, dict) else addrs
+        if addr is None:
+            p.retry_at = now + 0.1
+            return
+        qd = qr = 0.0
+        if self.feedback is not None:
+            qd, qr = self.feedback.stamp(primary)
+        p.sent_at = now
+        # exponential backoff, capped: the resend timer exists for
+        # LOST ops. An op the server is deliberately holding (dmclock
+        # limit, throttle) never replies either — without backoff a
+        # parked backlog of N ops becomes a standing N msg/s duplicate
+        # storm that perturbs the very experiment throttling it.
+        p.retry_at = now + min(
+            self.resend_every * (2.0 ** p.resends), 30.0)
+        c.msgr.send_message(
+            MOSDOp(client_id=c.client_id, tid=p.tid, pgid=pgid,
+                   oid=p.oid, ops=p.ops, map_epoch=c.osdmap.epoch,
+                   session=p.session, flags=p.flags,
+                   qos_delta=qd, qos_rho=qr), addr)
+
+    # -- maintenance ---------------------------------------------------
+
+    def tick(self, now: float | None = None) -> int:
+        """Resend scan (Objecter::tick role): anything unanswered past
+        its retry deadline goes out again with the SAME tid, so the
+        OSD's reqid dedup absorbs duplicates."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            due = [p for p in self._inflight.values()
+                   if p.retry_at and now >= p.retry_at]
+        for p in due:
+            self.resent += 1
+            p.resends += 1
+            self._send(p)
+        return len(due)
+
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Wait for every outstanding op to complete; ticks while
+        waiting so stragglers keep being resent."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._idle:
+                if not self._inflight:
+                    return True
+                if time.monotonic() >= deadline:
+                    return False
+                self._idle.wait(0.1)
+            self.tick()

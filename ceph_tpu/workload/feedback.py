@@ -1,0 +1,71 @@
+"""Client half of dmClock's distributed feedback (Gulati et al.,
+OSDI'10 section 3.2).
+
+Each OSD runs its tag queue independently; what makes the aggregate
+converge to the GLOBAL reservation/weight targets is the client
+stamping every request with how much service it received CLUSTER-WIDE
+since its previous request to that same server:
+
+- delta: completions from OTHER servers since the last op sent to
+  this one (drives the weight/proportional and limit tags), and
+- rho:   the subset of those served in the RESERVATION phase (drives
+  the reservation tag).
+
+The serving OSD's own completions are excluded: the queue already
+prices the op itself into the tag advance ((rho + cost)/rate), so
+with a single server delta = rho = 0 and the formulas reduce exactly
+to single-server mClock at the configured rate — counting own service
+twice would halve every client's effective reservation.
+
+A server seeing a large delta knows its peers already served this
+client plenty and advances the client's tags further (deprioritizing
+it locally); an idle server sees delta ~ 0 and keeps the client hot.
+That asymmetry is exactly what shifts service toward under-served
+OSDs with no server-to-server chatter at all.
+
+Units are whole completions (min_cost quanta are applied server-side
+from the op's cost); the reply's qos_phase tells us which phase served
+each op, closing the rho loop.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class DmClockFeedback:
+    """Plugs into RadosClient.qos_feedback / AsyncRadosDriver:
+    stamp(osd) -> (delta, rho) on send, observe(osd, phase) on reply."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0.0          # completions, cluster-wide
+        self._res_total = 0.0      # ... served in reservation phase
+        # osd -> [own_total, own_res]: completions THIS osd served us
+        self._own: dict[int, list] = {}
+        # osd -> (total, res, own_total, own_res) at our last send
+        self._last: dict[int, tuple] = {}
+
+    def observe(self, osd: int, phase: str) -> None:
+        with self._lock:
+            self._total += 1.0
+            own = self._own.setdefault(osd, [0.0, 0.0])
+            own[0] += 1.0
+            if phase == "reservation":
+                self._res_total += 1.0
+                own[1] += 1.0
+
+    def stamp(self, osd: int) -> tuple[float, float]:
+        with self._lock:
+            own = self._own.get(osd, [0.0, 0.0])
+            pt, pr, pot, por = self._last.get(osd, (0.0,) * 4)
+            # service from OTHERS = global growth minus this osd's own
+            delta = (self._total - pt) - (own[0] - pot)
+            rho = (self._res_total - pr) - (own[1] - por)
+            self._last[osd] = (self._total, self._res_total,
+                               own[0], own[1])
+            return delta, rho
+
+    def totals(self) -> tuple[float, float]:
+        with self._lock:
+            return self._total, self._res_total

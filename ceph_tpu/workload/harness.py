@@ -1,0 +1,236 @@
+"""WorkloadHarness: N client sessions multiplexed over one messenger.
+
+One process, one RadosClient, one TCP mesh — but every session carries
+its own nonce in the MOSDOp envelope, so the OSD's perf-query
+attribution (PR-15) sees N distinct principals exactly as if N real
+clients had connected. That is what makes "a million clients" a
+laptop-sized experiment instead of a datacenter one.
+
+The run loop is a heap-merge of per-session arrival schedules:
+
+    (arrival offset, session) <- heap;  wait until its time;  submit
+
+Submission never waits for completions (open-loop): if the cluster
+falls behind, inflight grows and the latency recorder — which clocks
+every op from its SCHEDULED arrival — shows the queueing honestly.
+Clock and sleep are injectable so the tier-1 smoke test can run a
+fixed schedule deterministically.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import http.client
+import queue
+import random
+import threading
+import time
+
+from .driver import AsyncRadosDriver
+from .recorder import LatencyRecorder
+
+
+class _Session:
+    __slots__ = ("idx", "nonce", "rng", "arrivals")
+
+    def __init__(self, idx: int, nonce: str, rng: random.Random,
+                 arrivals):
+        self.idx = idx
+        self.nonce = nonce
+        self.rng = rng
+        self.arrivals = arrivals
+
+
+def session_nonce(idx: int, seed: int = 0) -> str:
+    """Deterministic, distinct-in-the-first-8-chars nonce: attribution
+    keys on session[:8], so the index goes first and a seed-derived
+    tail keeps full nonces unique across harness instances."""
+    tail = hashlib.md5(b"wl:%d:%d" % (seed, idx)).hexdigest()[:24]
+    return "%08x%s" % (idx, tail)
+
+
+class WorkloadHarness:
+    def __init__(self, client, pool: str, profile, num_sessions: int,
+                 arrival_factory, popularity, recorder=None,
+                 feedback=None, klass: str = "client", seed: int = 0,
+                 clock=time.monotonic, sleep=time.sleep,
+                 http_addr=None, http_headers=None,
+                 http_workers: int = 8, driver=None):
+        """arrival_factory(session_idx) -> iterable of arrival offsets.
+        For RADOS-kind profiles ops ride `driver` (an AsyncRadosDriver,
+        created on demand over `client`); HTTP-kind profiles need
+        `http_addr` = (host, port) of a gateway."""
+        self.client = client
+        self.pool_id = client.pool_id(pool) if pool else -1
+        self.profile = profile
+        self.popularity = popularity
+        self.recorder = recorder if recorder is not None \
+            else LatencyRecorder()
+        self.klass = klass
+        self.clock = clock
+        self.sleep = sleep
+        self.http_addr = http_addr
+        self.http_headers = dict(http_headers or {})
+        self.http_workers = http_workers
+        if profile.kind == "rados":
+            self.driver = driver if driver is not None else \
+                AsyncRadosDriver(client, feedback=feedback)
+        else:
+            self.driver = driver
+        self.sessions = [
+            _Session(i, session_nonce(i, seed),
+                     random.Random((seed << 20) ^ i),
+                     iter(arrival_factory(i)))
+            for i in range(num_sessions)]
+        self._key = "%s/%s" % (profile.name, klass)
+        self._lock = threading.Lock()
+        self.submitted = 0
+        self.completed = 0
+        self.errors = 0
+        self.bytes_offered = 0
+        self._t0 = 0.0
+        self._httpq: queue.Queue | None = None
+        self._http_threads: list[threading.Thread] = []
+
+    # -- completions ---------------------------------------------------
+
+    def _on_done(self, pending, result, data, _now) -> None:
+        lat = self.clock() - pending.scheduled
+        if result < 0:
+            self.recorder.record_error(self._key)
+            with self._lock:
+                self.errors += 1
+        else:
+            self.recorder.record(self._key, max(lat, 0.0))
+        with self._lock:
+            self.completed += 1
+
+    # -- http leg ------------------------------------------------------
+
+    def _http_worker(self) -> None:
+        conn = None
+        while True:
+            task = self._httpq.get()
+            if task is None:
+                if conn is not None:
+                    conn.close()
+                return
+            item, scheduled = task
+            try:
+                if conn is None:
+                    conn = http.client.HTTPConnection(*self.http_addr)
+                conn.request(item.method, item.path, body=item.body,
+                             headers=dict(self.http_headers,
+                                          **item.headers))
+                resp = conn.getresponse()
+                resp.read()
+                ok = resp.status < 400
+            except Exception:
+                ok = False
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                conn = None
+            lat = self.clock() - scheduled
+            if ok:
+                self.recorder.record(self._key, max(lat, 0.0))
+            else:
+                self.recorder.record_error(self._key)
+            with self._lock:
+                self.completed += 1
+                if not ok:
+                    self.errors += 1
+
+    def _start_http(self) -> None:
+        self._httpq = queue.Queue()
+        for _ in range(self.http_workers):
+            t = threading.Thread(target=self._http_worker,
+                                 daemon=True)
+            t.start()
+            self._http_threads.append(t)
+
+    def _stop_http(self) -> None:
+        for _ in self._http_threads:
+            self._httpq.put(None)
+        for t in self._http_threads:
+            t.join(timeout=10.0)
+        self._http_threads = []
+
+    # -- run loop ------------------------------------------------------
+
+    def _submit(self, sess: _Session, scheduled: float) -> None:
+        item = self.profile.build(sess.rng, self.popularity)
+        with self._lock:
+            self.submitted += 1
+            self.bytes_offered += item.nbytes
+        if item.kind == "rados":
+            self.driver.submit(self.pool_id, item.oid, item.ops,
+                               sess.nonce, self._key, scheduled,
+                               self._on_done)
+        else:
+            self._httpq.put((item, scheduled))
+
+    def run(self, duration: float | None = None,
+            max_ops: int | None = None,
+            drain_timeout: float = 30.0) -> dict:
+        """Play the merged schedule until `duration` (offset seconds)
+        or `max_ops` submissions, then drain and report."""
+        if self.profile.kind == "http":
+            if self.http_addr is None:
+                raise ValueError("http profile needs http_addr")
+            self._start_http()
+        heap = []
+        for s in self.sessions:
+            off = next(s.arrivals, None)
+            if off is not None:
+                heapq.heappush(heap, (off, s.idx))
+        self._t0 = self.clock()
+        try:
+            while heap:
+                off, idx = heapq.heappop(heap)
+                if duration is not None and off > duration:
+                    break
+                if max_ops is not None and self.submitted >= max_ops:
+                    break
+                target = self._t0 + off
+                while True:
+                    now = self.clock()
+                    if now >= target:
+                        break
+                    if self.driver is not None:
+                        self.driver.tick()
+                    self.sleep(min(target - now, 0.05))
+                sess = self.sessions[idx]
+                self._submit(sess, target)
+                nxt = next(sess.arrivals, None)
+                if nxt is not None:
+                    heapq.heappush(heap, (nxt, idx))
+        finally:
+            drained = True
+            if self.driver is not None:
+                drained = self.driver.drain(drain_timeout)
+            if self._http_threads:
+                self._stop_http()
+        return self.stats(drained=drained)
+
+    def stats(self, drained: bool = True) -> dict:
+        elapsed = max(self.clock() - self._t0, 1e-9)
+        out = {
+            "profile": self.profile.name,
+            "klass": self.klass,
+            "sessions": len(self.sessions),
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "errors": self.errors,
+            "bytes_offered": self.bytes_offered,
+            "duration_s": elapsed,
+            "offered_rate": self.submitted / elapsed,
+            "drained": drained,
+            "latency": self.recorder.summary(),
+        }
+        if self.driver is not None:
+            out["peak_inflight"] = self.driver.peak_inflight
+            out["resent"] = self.driver.resent
+        return out

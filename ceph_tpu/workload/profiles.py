@@ -1,0 +1,127 @@
+"""Workload profiles: what each scheduled arrival actually does.
+
+A profile is a pure generator — given the per-session RNG and the
+popularity sampler it returns one WorkItem (RADOS ops, or an HTTP
+request for the gateway fronts). It holds no sockets and no state, so
+the same profile object is shared by every session.
+
+Catalog (the shapes the paper's evaluation sweeps):
+
+- rados_read / rados_write / rados_mixed  — raw object IO
+- rbd_profile  — block-device IO: random offsets inside a virtual
+  image, mapped to `rbd_data.<image>.%016x` chunk objects exactly like
+  the librbd striper, so hot-chunk skew matches real RBD traffic
+- rgw_s3 / rgw_swift — gateway HTTP traffic for either front
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Callable
+
+
+@dataclass
+class WorkItem:
+    kind: str                  # "rados" | "http"
+    nbytes: int = 0
+    # rados
+    oid: str = ""
+    ops: list = field(default_factory=list)
+    # http
+    method: str = "GET"
+    path: str = ""
+    body: bytes = b""
+    headers: dict = field(default_factory=dict)
+
+
+@dataclass
+class ProfileSpec:
+    name: str
+    kind: str                  # "rados" | "http"
+    build: Callable            # (rng, popularity) -> WorkItem
+
+
+def _payload(rng: random.Random, size: int) -> bytes:
+    # one random byte repeated: cheap to build, still defeats
+    # dedup-by-zero shortcuts in the object store
+    return bytes([rng.randrange(256)]) * size
+
+
+def rados_read(obj_prefix: str = "wl", size: int = 4096) -> ProfileSpec:
+    def build(rng, pop):
+        oid = "%s.%08d" % (obj_prefix, pop.sample(rng))
+        return WorkItem(kind="rados", oid=oid,
+                        ops=[("read", 0, size)], nbytes=size)
+    return ProfileSpec("rados-read", "rados", build)
+
+
+def rados_write(obj_prefix: str = "wl",
+                size: int = 4096) -> ProfileSpec:
+    def build(rng, pop):
+        oid = "%s.%08d" % (obj_prefix, pop.sample(rng))
+        return WorkItem(kind="rados", oid=oid,
+                        ops=[("writefull", _payload(rng, size))],
+                        nbytes=size)
+    return ProfileSpec("rados-write", "rados", build)
+
+
+def rados_mixed(obj_prefix: str = "wl", size: int = 4096,
+                read_fraction: float = 0.7) -> ProfileSpec:
+    def build(rng, pop):
+        oid = "%s.%08d" % (obj_prefix, pop.sample(rng))
+        if rng.random() < read_fraction:
+            return WorkItem(kind="rados", oid=oid,
+                            ops=[("read", 0, size)], nbytes=size)
+        return WorkItem(kind="rados", oid=oid,
+                        ops=[("writefull", _payload(rng, size))],
+                        nbytes=size)
+    return ProfileSpec("rados-mixed", "rados", build)
+
+
+def rbd_profile(image: str = "wlimg", image_size: int = 1 << 26,
+                order: int = 22, io_size: int = 4096,
+                read_fraction: float = 0.5) -> ProfileSpec:
+    """Block-style IO: popularity picks the CHUNK (so hot-chunk skew is
+    Zipf like real VM images), the offset inside it is uniform. One IO
+    never spans chunks — same constraint the striper enforces."""
+    chunk = 1 << order
+    nchunks = max(1, image_size // chunk)
+
+    def build(rng, pop):
+        block = pop.sample(rng) % nchunks
+        oid = "rbd_data.%s.%016x" % (image, block)
+        off = rng.randrange(max(1, chunk - io_size))
+        if rng.random() < read_fraction:
+            ops = [("read", off, io_size)]
+        else:
+            ops = [("write", off, _payload(rng, io_size))]
+        return WorkItem(kind="rados", oid=oid, ops=ops,
+                        nbytes=io_size)
+    return ProfileSpec("rbd", "rados", build)
+
+
+def rgw_s3(bucket: str = "wlbkt", size: int = 4096,
+           read_fraction: float = 0.7) -> ProfileSpec:
+    def build(rng, pop):
+        key = "o%08d" % pop.sample(rng)
+        path = "/%s/%s" % (bucket, key)
+        if rng.random() < read_fraction:
+            return WorkItem(kind="http", method="GET", path=path,
+                            nbytes=size)
+        return WorkItem(kind="http", method="PUT", path=path,
+                        body=_payload(rng, size), nbytes=size)
+    return ProfileSpec("rgw-s3", "http", build)
+
+
+def rgw_swift(container: str = "wlbkt", size: int = 4096,
+              read_fraction: float = 0.7) -> ProfileSpec:
+    def build(rng, pop):
+        key = "o%08d" % pop.sample(rng)
+        path = "/swift/v1/%s/%s" % (container, key)
+        if rng.random() < read_fraction:
+            return WorkItem(kind="http", method="GET", path=path,
+                            nbytes=size)
+        return WorkItem(kind="http", method="PUT", path=path,
+                        body=_payload(rng, size), nbytes=size)
+    return ProfileSpec("rgw-swift", "http", build)

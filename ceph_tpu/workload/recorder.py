@@ -1,0 +1,93 @@
+"""Latency recording: per-key power-of-two-microsecond histograms.
+
+Same bucketing the OSD's perf histograms use (2^n us): constant memory
+per key no matter how many samples, and percentile error bounded by
+one octave. Keys are free-form strings — the harness uses
+"<profile>/<class>" so gold and best-effort latencies never mix.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_NBUCKETS = 64        # 2^63 us ~ 292k years: effectively unbounded
+
+
+class _Hist:
+    __slots__ = ("buckets", "count", "total_s", "max_s", "errors")
+
+    def __init__(self):
+        self.buckets = [0] * _NBUCKETS
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self.errors = 0
+
+
+def _bucket_of(us: int) -> int:
+    return min(max(us, 1).bit_length() - 1, _NBUCKETS - 1)
+
+
+class LatencyRecorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hists: dict[str, _Hist] = {}
+
+    def record(self, key: str, seconds: float) -> None:
+        us = int(seconds * 1e6)
+        with self._lock:
+            h = self._hists.setdefault(key, _Hist())
+            h.buckets[_bucket_of(us)] += 1
+            h.count += 1
+            h.total_s += seconds
+            if seconds > h.max_s:
+                h.max_s = seconds
+
+    def record_error(self, key: str) -> None:
+        with self._lock:
+            self._hists.setdefault(key, _Hist()).errors += 1
+
+    def percentile(self, key: str, p: float) -> float:
+        """p in (0, 1]; returns the UPPER bound of the bucket holding
+        the p-th sample (conservative: never understates latency)."""
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None or h.count == 0:
+                return 0.0
+            want = max(1, int(p * h.count + 0.999999))
+            seen = 0
+            for i, n in enumerate(h.buckets):
+                seen += n
+                if seen >= want:
+                    return (2 ** (i + 1)) / 1e6
+        return h.max_s
+
+    def summary(self) -> dict:
+        out = {}
+        with self._lock:
+            keys = list(self._hists)
+        for key in keys:
+            h = self._hists[key]
+            out[key] = {
+                "count": h.count,
+                "errors": h.errors,
+                "mean_s": (h.total_s / h.count) if h.count else 0.0,
+                "p50_s": self.percentile(key, 0.50),
+                "p95_s": self.percentile(key, 0.95),
+                "p99_s": self.percentile(key, 0.99),
+                "max_s": h.max_s,
+            }
+        return out
+
+    def merge(self, other: "LatencyRecorder") -> None:
+        with other._lock:
+            items = [(k, h) for k, h in other._hists.items()]
+        with self._lock:
+            for key, h in items:
+                mine = self._hists.setdefault(key, _Hist())
+                mine.buckets = [a + b for a, b in
+                                zip(mine.buckets, h.buckets)]
+                mine.count += h.count
+                mine.total_s += h.total_s
+                mine.max_s = max(mine.max_s, h.max_s)
+                mine.errors += h.errors

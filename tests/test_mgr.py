@@ -88,7 +88,14 @@ def mgr_cluster():
         lambda: sum(n.startswith("osd.") for n in
                     mgr.daemon_state.names(include_stale=False)) == 3,
         timeout=10), "osd reports never arrived"
-    assert wait_until(lambda: mgr.osdmap is not None, timeout=10)
+    # ... and for the mgr's SUBSCRIBED map to catch up to all three
+    # boots: under a loaded host the first delivered epoch can predate
+    # the last osd's mark-up, and prometheus renders from this cache
+    assert wait_until(
+        lambda: mgr.osdmap is not None
+        and sum(mgr.osdmap.is_up(o)
+                for o in range(mgr.osdmap.max_osd)) == 3,
+        timeout=10)
     yield cluster, mgr
     mgr.shutdown()
     cluster.stop()

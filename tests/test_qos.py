@@ -1,0 +1,340 @@
+"""dmClock QoS brain: bit-exact tag math on a fake clock, per-pool
+class profiles, delta/rho distributed feedback (the two-OSD oracle),
+pool-option propagation into every shard's queue, and the mgr's
+SLO-driven adaptive reservation loop."""
+
+from __future__ import annotations
+
+import pytest
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.mgr.modules import StatusModule
+from ceph_tpu.mgr.perf_query import PerfQueryModule
+from ceph_tpu.osd.op_queue import (MClockOpClassQueue, QosShardedOpWQ,
+                                   WeightedPriorityQueue,
+                                   make_op_queue)
+from ceph_tpu.workload import DmClockFeedback
+
+from .cluster_util import MiniCluster, wait_until
+
+FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+        "mon_osd_down_out_interval": 1.0,
+        "paxos_propose_interval": 0.02}
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# Rates chosen to be exact binary fractions so every expected tag is a
+# bit-exact float, not an approximation: res=8 -> 1/8 per unit, etc.
+GOLD = {"gold": (8.0, 128.0, 16.0)}
+
+
+class TestTagMathOracle:
+    def test_bit_exact_tag_advances(self):
+        clk = FakeClock()
+        q = MClockOpClassQueue(GOLD, min_cost=4096, clock=clk)
+        q.enqueue("gold", 63, 4096, "a")          # scale 1, first op
+        c = q._classes["gold"]
+        assert (c.r_tag, c.p_tag, c.l_tag) == (0.0, 0.0, 0.0)
+        # scale 2 + (delta=3, rho=2):
+        #   r += (2+2)/8,  p += (3+2)/128,  l += (3+2)/16
+        q.enqueue("gold", 63, 8192, "b", delta=3.0, rho=2.0)
+        assert (c.r_tag, c.p_tag, c.l_tag) == (0.5, 0.0390625, 0.3125)
+        q.enqueue("gold", 63, 4096, "c")          # scale 1, no feedback
+        assert c.r_tag == 0.5 + 0.125
+        assert c.p_tag == 0.0390625 + 0.0078125
+        assert c.l_tag == 0.3125 + 0.0625
+
+    def test_reservation_phase_beats_weight(self):
+        clk = FakeClock()
+        q = MClockOpClassQueue({"gold": (8.0, 1.0, 0.0),
+                                "be": (0.0, 10000.0, 0.0)}, clock=clk)
+        q.enqueue("be", 63, 4096, "be-op")
+        q.enqueue("gold", 63, 4096, "gold-op")
+        # gold's overdue reservation wins despite be's huge weight
+        assert q.dequeue() == "gold-op"
+        assert q.last_dequeue == ("gold", "reservation")
+        assert q.dequeue() == "be-op"
+        assert q.last_dequeue == ("be", "proportional")
+
+    def test_limit_throttles_and_next_ready_in(self):
+        clk = FakeClock()
+        q = MClockOpClassQueue({"gold": (0.0, 128.0, 8.0)}, clock=clk)
+        q.enqueue("gold", 63, 4096, "a")          # l-tag 0.0
+        q.enqueue("gold", 63, 4096, "b")          # l-tag 0.125
+        assert q.dequeue() == "a"
+        assert q.dequeue() is None                # b limit-gated
+        assert q.next_ready_in() == 0.125
+        q.note_throttled(0.05)
+        assert q.class_stats()["gold"]["throttle_wait_s"] == 0.05
+        clk.advance(0.125)
+        assert q.dequeue() == "b"
+        assert q.next_ready_in() is None
+
+    def test_idle_class_tag_clamp_on_reactivation(self):
+        """A class whose tags ran far ahead (big rho on an unlimited
+        class: served instantly via the proportional phase) must not be
+        exiled when it comes back later — tags clamp to now and pace
+        forward from there."""
+        clk = FakeClock()
+        q = MClockOpClassQueue({"gold": (8.0, 128.0, 8.0)}, clock=clk)
+        q.enqueue("gold", 63, 4096, "a")
+        assert q.dequeue() == "a"
+        # a huge feedback burst runs every tag ~10s into the future
+        q.enqueue("gold", 63, 4096, "b", delta=80.0, rho=80.0)
+        c = q._classes["gold"]
+        assert c.r_tag == 10.125 and c.l_tag == 10.125
+        assert q.dequeue() is None                # gated at t=0
+        assert q.dequeue(now=10.2) == "b"         # drained much later
+        clk.advance(0.5)                          # real clock: t=0.5
+        q.enqueue("gold", 63, 4096, "c")          # clamp 10.125 -> 0.5
+        assert c.r_tag == 0.5 + 0.125
+        assert c.l_tag == 0.5 + 0.125
+        assert q.dequeue() is None                # paced, not exiled
+        clk.advance(0.125)
+        assert q.dequeue() == "c"
+        assert q.last_dequeue == ("gold", "reservation")
+
+    def test_per_pool_class_falls_back_to_base(self):
+        q = MClockOpClassQueue({"client": (4.0, 64.0, 0.0)},
+                               clock=FakeClock())
+        assert q._lookup_info("client:gold") == (4.0, 64.0, 0.0)
+        q.set_class_info("client:gold", 8.0, 256.0, 16.0)
+        assert q._lookup_info("client:gold") == (8.0, 256.0, 16.0)
+        assert q._lookup_info("client:other") == (4.0, 64.0, 0.0)
+        assert q._lookup_info("mystery") == (0.0, 1.0, 0.0)
+
+    def test_set_class_info_applies_live(self):
+        clk = FakeClock()
+        q = MClockOpClassQueue({"gold": (0.0, 128.0, 8.0)}, clock=clk)
+        q.enqueue("gold", 63, 4096, "a")
+        q.enqueue("gold", 63, 4096, "b")
+        assert q.dequeue() == "a" and q.dequeue() is None
+        q.set_class_info("gold", 0.0, 128.0, 0.0)  # lift the limit
+        q.enqueue("gold", 63, 4096, "c")           # priced limit-free
+        # b keeps its old gate; c is behind b in FIFO order, so the
+        # class still waits for b's tag — queued ops keep their price
+        assert q.dequeue() is None
+        clk.advance(0.125)
+        assert q.dequeue() == "b" and q.dequeue() == "c"
+
+
+class TestTwoOsdFeedbackOracle:
+    """The acceptance oracle: with delta/rho feedback a globally
+    reserved class gets ~its reservation ACROSS both OSDs (not per
+    OSD), and the OSD that served none of the warmup picks up at least
+    its fair share afterward — service shifts toward the under-served
+    server with zero server-to-server communication."""
+
+    RES = 8.0
+
+    def _drive(self, with_feedback: bool, duration: float = 2.0):
+        clks = (FakeClock(), FakeClock())
+        queues = tuple(
+            MClockOpClassQueue({"gold": (self.RES, 1.0, self.RES)},
+                               clock=clks[i]) for i in range(2))
+        fb = DmClockFeedback()
+
+        def send(osd):
+            d, r = fb.stamp(osd) if with_feedback else (0.0, 0.0)
+            queues[osd].enqueue("gold", 63, 4096, "op",
+                                delta=d, rho=r)
+
+        # warmup: OSD 0 alone serves 0.5s of the stream
+        send(0)
+        while clks[0].t < 0.5:
+            if queues[0].dequeue() is not None:
+                fb.observe(0, queues[0].last_dequeue[1])
+                send(0)
+            clks[0].advance(0.01)
+        clks[1].t = clks[0].t
+        warm_end = clks[0].t
+        served = [0, 0]
+        if queues[1].empty():
+            send(1)
+        while clks[0].t < warm_end + duration:
+            for osd in (0, 1):
+                if queues[osd].dequeue() is not None:
+                    fb.observe(osd, queues[osd].last_dequeue[1])
+                    served[osd] += 1
+                    send(osd)
+                clks[osd].advance(0.01)
+        return served
+
+    def test_feedback_enforces_global_reservation(self):
+        fb_served = self._drive(with_feedback=True)
+        raw_served = self._drive(with_feedback=False)
+        # without feedback each OSD grants the full reservation: ~2x
+        assert sum(raw_served) > 1.6 * sum(fb_served)
+        # with feedback the GLOBAL rate ~ the reservation (8/s x 2s)
+        assert abs(sum(fb_served) - self.RES * 2.0) <= 3
+        # and the warmup-starved OSD 1 now carries >= ~half the load
+        assert fb_served[1] >= 0.4 * sum(fb_served)
+        assert fb_served[1] >= fb_served[0] - 2
+
+
+class TestWpqStats:
+    def test_class_stats_counters(self):
+        q = WeightedPriorityQueue()
+        q.enqueue("client", 63, 4096, "a")
+        q.enqueue("recovery", 10, 4096, "b")
+        st = q.class_stats()
+        assert st["client"]["depth"] == 1
+        assert st["recovery"]["depth"] == 1
+        for _ in range(2):
+            q.dequeue()
+        st = q.class_stats()
+        assert st["client"]["served"] == 1 and \
+            st["client"]["depth"] == 0
+        assert st["recovery"]["served"] == 1
+
+
+class TestQosShardedWQ:
+    def test_set_pool_qos_divides_rates_across_shards(self):
+        wq = QosShardedOpWQ("t", 2, lambda: MClockOpClassQueue(),
+                            None)
+        try:
+            assert wq.set_pool_qos("gold", 100.0, 500.0, 200.0)
+            for shard in wq._shards:
+                assert shard.opq.info["client:gold"] == \
+                    (50.0, 500.0, 100.0)
+        finally:
+            wq.stop()
+
+    def test_phase_is_stamped_on_qos_obj(self):
+        class Obj:
+            pass
+
+        wq = QosShardedOpWQ("t", 1, lambda: MClockOpClassQueue(
+            {"client": (100.0, 500.0, 0.0)}), None)
+        wq.start()
+        try:
+            objs = [Obj() for _ in range(3)]
+            done = []
+            for o in objs:
+                wq.queue(1, done.append, o, klass="client",
+                         cost=4096, qos_obj=o)
+            assert wait_until(lambda: len(done) == 3, timeout=5)
+            phases = {getattr(o, "_qos_phase", None) for o in objs}
+            assert phases <= {"reservation", "proportional"}
+            assert None not in phases
+        finally:
+            wq.stop()
+
+
+class TestMakeOpQueue:
+    def test_all_four_classes_wired(self):
+        over = {"osd_op_queue": "mclock_opclass"}
+        for klass, (r, w, li) in (("client", (50, 400, 0)),
+                                  ("recovery", (5, 2, 10)),
+                                  ("scrub", (1, 3, 6)),
+                                  ("snaptrim", (2, 4, 8))):
+            over["osd_op_queue_mclock_%s_res" % klass] = r
+            over["osd_op_queue_mclock_%s_wgt" % klass] = w
+            over["osd_op_queue_mclock_%s_lim" % klass] = li
+        q = make_op_queue(Config(over))
+        assert isinstance(q, MClockOpClassQueue)
+        assert q.info["client"] == (50, 400, 0)
+        assert q.info["recovery"] == (5, 2, 10)
+        assert q.info["scrub"] == (1, 3, 6)
+        assert q.info["snaptrim"] == (2, 4, 8)
+
+
+# -- live cluster: pool options -> shard queues -> mgr loop ------------
+
+@pytest.fixture(scope="module")
+def qos_cluster():
+    cluster = MiniCluster(
+        num_mons=1, num_osds=2,
+        conf_overrides=dict(
+            FAST, osd_op_queue="mclock_opclass",
+            mgr_qos_adaptive=True,
+            mgr_qos_adapt_min_res=64.0,
+            mgr_qos_adapt_cooldown=0.2,
+            mgr_slo_window=2.0,
+            # impossible latency target: every op on slopool violates,
+            # burn = 1/(1-0.5) = 2.0 > 1.0 -> the adaptive loop fires
+            mgr_slo_pool_targets="slopool:0.0001:0.5")).start()
+    mgr = cluster.start_mgr(modules=(PerfQueryModule, StatusModule))
+    client = cluster.client()
+    pool_id = cluster.create_replicated_pool(client, "goldpool",
+                                             size=2, pg_num=8)
+    assert cluster.wait_clean(pool_id)
+    yield cluster, mgr, client, pool_id
+    cluster.stop()
+
+
+class TestPoolQosPropagation:
+    def test_pool_set_reaches_every_shard(self, qos_cluster):
+        cluster, _, client, pool_id = qos_cluster
+        for var, val in (("qos_reservation", 128.0),
+                         ("qos_weight", 600.0),
+                         ("qos_limit", 512.0)):
+            rc, _, _ = client.mon_command(
+                {"prefix": "osd pool set", "pool": "goldpool",
+                 "var": var, "val": str(val)})
+            assert rc == 0
+
+        def applied():
+            for osd in cluster.osds.values():
+                prof = osd._pool_qos_applied.get("goldpool")
+                if prof != (128.0, 600.0, 512.0):
+                    return False
+                nsh = len(osd.op_wq._shards)
+                for shard in osd.op_wq._shards:
+                    if shard.opq.info.get("client:goldpool") != \
+                            (128.0 / nsh, 600.0, 512.0 / nsh):
+                        return False
+            return True
+        assert wait_until(applied, timeout=15, interval=0.2)
+
+        # ops now ride the per-pool class, visible in dump_op_queue
+        io = client.open_ioctx("goldpool")
+        for i in range(8):
+            io.write_full("q-%d" % i, b"x" * 512)
+
+        def classed():
+            return any(
+                "client:goldpool" in osd.op_wq.dump()
+                for osd in cluster.osds.values())
+        assert wait_until(classed, timeout=10, interval=0.2)
+        dump = next(o for o in cluster.osds.values()
+                    if "client:goldpool" in o.op_wq.dump()) \
+            ._dump_op_queue()
+        assert dump["discipline"] == "mclock_opclass"
+        assert dump["pool_profiles"]["goldpool"] == \
+            (128.0, 600.0, 512.0)
+
+
+class TestAdaptiveReservation:
+    def test_slo_burn_bumps_pool_reservation(self, qos_cluster):
+        """Mgr loop: a pool burning >1.0 of its SLO gets its
+        qos_reservation raised through the mon, which lands back on
+        the OSDs' shard queues."""
+        cluster, mgr, client, _ = qos_cluster
+        slo_pool_id = cluster.create_replicated_pool(
+            client, "slopool", size=2, pg_num=4)
+        assert cluster.wait_clean(slo_pool_id)
+        mod = mgr.modules["perf_query"]
+        io = client.open_ioctx("slopool")
+
+        def bumped():
+            for i in range(6):
+                io.write_full("slo-%d" % i, b"y" * 2048)
+            granted = mod.qos_adapt_status()["granted"]
+            return granted.get("slopool", 0.0) >= 64.0
+        assert wait_until(bumped, timeout=30, interval=0.3)
+
+        def propagated():
+            pool = client.osdmap.pools.get(slo_pool_id)
+            return pool is not None and pool.qos_reservation >= 64.0
+        assert wait_until(propagated, timeout=15, interval=0.2)

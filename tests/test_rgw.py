@@ -234,6 +234,165 @@ class TestRangeGet:
         assert status == 416
 
 
+def swift_request(gw_server, method, path, body=b"", headers=None):
+    conn = http.client.HTTPConnection(*gw_server.addr)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+@pytest.fixture(scope="module")
+def swift_token(gw):
+    status, hdrs, _ = swift_request(
+        gw, "GET", "/auth/v1.0",
+        headers={"X-Auth-User": ACCESS, "X-Auth-Key": SECRET})
+    assert status == 200
+    return hdrs["X-Auth-Token"]
+
+
+class TestSwiftFront:
+    def test_auth_handshake(self, gw):
+        status, hdrs, _ = swift_request(
+            gw, "GET", "/auth/v1.0",
+            headers={"X-Auth-User": ACCESS, "X-Auth-Key": SECRET})
+        assert status == 200
+        assert hdrs["X-Auth-Token"].startswith("AUTH_tk")
+        assert "/swift/v1" in hdrs["X-Storage-Url"]
+
+    def test_bad_credentials_401(self, gw):
+        status, _, _ = swift_request(
+            gw, "GET", "/auth/v1.0",
+            headers={"X-Auth-User": ACCESS, "X-Auth-Key": "wrong"})
+        assert status == 401
+
+    def test_container_and_object_flow(self, gw, swift_token):
+        tok = {"X-Auth-Token": swift_token}
+        status, _, _ = swift_request(gw, "PUT", "/swift/v1/swc",
+                                     headers=tok)
+        assert status == 201
+        # re-PUT of an existing container is 202, not an error
+        status, _, _ = swift_request(gw, "PUT", "/swift/v1/swc",
+                                     headers=tok)
+        assert status == 202
+        status, hdrs, _ = swift_request(
+            gw, "PUT", "/swift/v1/swc/hello.txt", body=b"swift bytes",
+            headers=tok)
+        assert status == 201 and hdrs["Etag"]
+        status, _, body = swift_request(
+            gw, "GET", "/swift/v1/swc/hello.txt", headers=tok)
+        assert status == 200 and body == b"swift bytes"
+        status, _, body = swift_request(gw, "GET", "/swift/v1/swc",
+                                        headers=tok)
+        assert status == 200 and b"hello.txt" in body
+        status, hdrs, _ = swift_request(gw, "HEAD", "/swift/v1/swc",
+                                        headers=tok)
+        assert status == 204
+        assert hdrs["X-Container-Object-Count"] == "1"
+        status, _, _ = swift_request(
+            gw, "DELETE", "/swift/v1/swc/hello.txt", headers=tok)
+        assert status == 204
+        status, _, _ = swift_request(gw, "DELETE", "/swift/v1/swc",
+                                     headers=tok)
+        assert status == 204
+
+    def test_account_listing(self, gw, swift_token):
+        tok = {"X-Auth-Token": swift_token}
+        swift_request(gw, "PUT", "/swift/v1/swacct", headers=tok)
+        status, _, body = swift_request(gw, "GET", "/swift/v1",
+                                        headers=tok)
+        assert status == 200 and b"swacct" in body
+        swift_request(gw, "DELETE", "/swift/v1/swacct", headers=tok)
+
+    def test_unauthenticated_swift_denied(self, gw):
+        status, _, _ = swift_request(gw, "PUT", "/swift/v1/anon")
+        assert status == 403
+        status, _, _ = swift_request(gw, "GET", "/swift/v1")
+        assert status == 403
+
+
+class TestCrossFrontACLs:
+    """Canned ACLs gate anonymous access identically on both fronts:
+    containers and buckets share one roster, one ACL store."""
+
+    def test_s3_acl_opens_swift_anonymous_read(self, gw, swift_token):
+        request(gw, "PUT", "/xfront",
+                headers={"x-amz-acl": "public-read"})
+        request(gw, "PUT", "/xfront/pub.txt", body=b"open data")
+        # anonymous Swift GET sees the S3-created public bucket
+        status, _, body = swift_request(
+            gw, "GET", "/swift/v1/xfront/pub.txt")
+        assert status == 200 and body == b"open data"
+        # but anonymous write is still denied (public-read only)
+        status, _, _ = swift_request(
+            gw, "PUT", "/swift/v1/xfront/evil", body=b"x")
+        assert status == 403
+        request(gw, "DELETE", "/xfront/pub.txt")
+        request(gw, "DELETE", "/xfront")
+
+    def test_swift_acl_opens_s3_anonymous_read(self, gw, swift_token):
+        tok = {"X-Auth-Token": swift_token}
+        swift_request(gw, "PUT", "/swift/v1/xf2",
+                      headers=dict(tok, **{"X-Container-Read": ".r:*"}))
+        swift_request(gw, "PUT", "/swift/v1/xf2/o", body=b"shared",
+                      headers=tok)
+        status, _, body = request(gw, "GET", "/xf2/o", sign=False)
+        assert status == 200 and body == b"shared"
+        # anonymous S3 PUT denied on a read-only container
+        status, _, _ = request(gw, "PUT", "/xf2/w", body=b"x",
+                               sign=False)
+        assert status == 403
+        swift_request(gw, "DELETE", "/swift/v1/xf2/o", headers=tok)
+        swift_request(gw, "DELETE", "/swift/v1/xf2", headers=tok)
+
+    def test_public_read_write_allows_anonymous_put(self, gw,
+                                                    swift_token):
+        tok = {"X-Auth-Token": swift_token}
+        swift_request(
+            gw, "PUT", "/swift/v1/xf3",
+            headers=dict(tok, **{"X-Container-Write": ".r:*",
+                                 "X-Container-Read": ".r:*"}))
+        status, _, _ = request(gw, "PUT", "/xf3/anon-obj", body=b"w",
+                               sign=False)
+        assert status == 200
+        status, _, body = swift_request(gw, "GET",
+                                        "/swift/v1/xf3/anon-obj")
+        assert status == 200 and body == b"w"
+        swift_request(gw, "DELETE", "/swift/v1/xf3/anon-obj",
+                      headers=tok)
+        swift_request(gw, "DELETE", "/swift/v1/xf3", headers=tok)
+
+    def test_acl_update_via_post_and_subresource(self, gw, swift_token):
+        tok = {"X-Auth-Token": swift_token}
+        request(gw, "PUT", "/xf4")       # default private
+        status, _, _ = request(gw, "GET", "/xf4/nope", sign=False)
+        assert status == 403
+        # Swift POST flips it to public-read
+        status, _, _ = swift_request(
+            gw, "POST", "/swift/v1/xf4",
+            headers=dict(tok, **{"X-Container-Read": ".r:*"}))
+        assert status == 204
+        status, _, body = request(gw, "GET", "/xf4?acl")
+        assert status == 200 and b"public-read" in body
+        # S3 ?acl subresource flips it back
+        status, _, _ = request(gw, "PUT", "/xf4?acl",
+                               headers={"x-amz-acl": "private"})
+        assert status == 200
+        status, _, _ = request(gw, "GET", "/xf4?acl", sign=False)
+        assert status == 403             # acl read is owner-only
+        status, hdrs, _ = swift_request(gw, "HEAD", "/swift/v1/xf4",
+                                        headers=tok)
+        assert "X-Container-Read" not in hdrs
+        request(gw, "DELETE", "/xf4")
+
+    def test_bogus_canned_acl_rejected(self, gw):
+        status, _, body = request(
+            gw, "PUT", "/xf5", headers={"x-amz-acl": "authenticated-read"})
+        assert status == 400 and b"InvalidArgument" in body
+
+
 class TestMultipartEdgeCases:
     def test_etag_before_partnumber_order_accepted(self, gw):
         """AWS's own CompleteMultipartUpload request syntax puts ETag

@@ -1,0 +1,251 @@
+"""Open-loop workload subsystem: arrival processes, Zipf popularity,
+latency recording, dmClock feedback accounting, and the harness
+end-to-end over a live mini-cluster (deterministic smoke in tier-1,
+scale soak behind -m slow)."""
+
+from __future__ import annotations
+
+import itertools
+
+import pytest
+
+from ceph_tpu.mgr.modules import StatusModule
+from ceph_tpu.mgr.perf_query import PerfQueryModule
+from ceph_tpu.workload import (BurstyArrivals, DiurnalArrivals,
+                               DmClockFeedback, FixedArrivals,
+                               LatencyRecorder, PoissonArrivals,
+                               UniformPopularity, WorkloadHarness,
+                               ZipfPopularity, rados_mixed,
+                               rados_read, rados_write, rbd_profile,
+                               rgw_s3)
+from ceph_tpu.workload.harness import session_nonce
+
+from .cluster_util import MiniCluster, wait_until
+
+FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+        "mon_osd_down_out_interval": 1.0,
+        "paxos_propose_interval": 0.02}
+
+
+# -- arrival processes -------------------------------------------------
+
+class TestArrivals:
+    def test_poisson_rate_and_determinism(self):
+        a = list(itertools.islice(iter(PoissonArrivals(100.0, seed=7)),
+                                  500))
+        b = list(itertools.islice(iter(PoissonArrivals(100.0, seed=7)),
+                                  500))
+        assert a == b                       # seeded => replayable
+        assert all(y >= x for x, y in zip(a, a[1:]))
+        # 500 arrivals at 100/s should land near t=5s
+        assert 3.0 < a[-1] < 8.0
+
+    def test_bursty_alternates_density(self):
+        a = list(itertools.islice(
+            iter(BurstyArrivals(20.0, burst_factor=20.0, on_s=0.2,
+                                off_s=1.0, idle_factor=0.0, seed=3)),
+            200))
+        assert all(y >= x for x, y in zip(a, a[1:]))
+        gaps = [y - x for x, y in zip(a, a[1:])]
+        # with idle_factor=0 every arrival is in an ON window: tight
+        # clusters separated by long OFF silences
+        assert max(gaps) > 10 * sorted(gaps)[len(gaps) // 2]
+
+    def test_diurnal_waves(self):
+        # take two full periods
+        a = list(itertools.takewhile(
+            lambda t: t < 4.0,
+            iter(DiurnalArrivals(200.0, amplitude=1.0,
+                                 period_s=2.0, seed=5))))
+        peak = sum(1 for t in a if 0.25 < t % 2.0 < 0.75)    # crest
+        trough = sum(1 for t in a if 1.25 < t % 2.0 < 1.75)  # null
+        assert peak > 3 * max(trough, 1)
+
+    def test_fixed_schedule_is_literal(self):
+        assert list(iter(FixedArrivals([0.0, 0.1, 0.5]))) == \
+            [0.0, 0.1, 0.5]
+        with pytest.raises(ValueError):
+            FixedArrivals([0.2, 0.1])
+
+
+class TestPopularity:
+    def test_zipf_skew(self):
+        z = ZipfPopularity(10_000, alpha=1.1, seed=1)
+        draws = [z.sample() for _ in range(5000)]
+        top10 = sum(1 for d in draws if d < 10)
+        mid10 = sum(1 for d in draws if 5000 <= d < 5010)
+        assert top10 > 20 * max(mid10, 1)
+        assert z.hot_set(0.5) < 10_000 // 50
+
+    def test_uniform_is_flat(self):
+        u = UniformPopularity(100, seed=2)
+        draws = [u.sample() for _ in range(5000)]
+        assert max(draws) >= 95 and min(draws) <= 4
+
+
+class TestRecorder:
+    def test_percentiles_conservative(self):
+        r = LatencyRecorder()
+        for _ in range(99):
+            r.record("k", 0.001)            # 1000us -> bucket 2^9
+        r.record("k", 0.5)                  # one big outlier
+        s = r.summary()["k"]
+        assert s["count"] == 100
+        assert 0.001 <= s["p50_s"] <= 0.003  # upper bucket bound
+        assert s["p99_s"] >= 0.001
+        assert r.percentile("k", 1.0) >= 0.5
+        assert s["max_s"] == 0.5
+
+    def test_merge_and_errors(self):
+        a, b = LatencyRecorder(), LatencyRecorder()
+        a.record("x", 0.01)
+        b.record("x", 0.02)
+        b.record_error("x")
+        a.merge(b)
+        s = a.summary()["x"]
+        assert s["count"] == 2 and s["errors"] == 1
+
+
+class TestFeedback:
+    def test_delta_rho_counts_other_servers_only(self):
+        f = DmClockFeedback()
+        assert f.stamp(0) == (0.0, 0.0)
+        f.observe(0, "reservation")
+        f.observe(1, "proportional")
+        f.observe(2, "reservation")
+        # osd0 sees the OTHERS' service (osd1 + osd2), not its own
+        assert f.stamp(0) == (2.0, 1.0)
+        # immediately again: nothing new
+        assert f.stamp(0) == (0.0, 0.0)
+        # osd1 never stamped before: full history minus its own op
+        assert f.stamp(1) == (2.0, 2.0)
+        f.observe(0, "proportional")
+        f.observe(1, "reservation")
+        # for osd0: only osd1's new completion counts
+        assert f.stamp(0) == (1.0, 1.0)
+
+    def test_single_server_degenerates_to_zero(self):
+        """One server serving everything: delta = rho = 0 on every
+        stamp, so the queue's (rho + cost)/rate advance is exactly
+        single-server mClock — no double counting."""
+        f = DmClockFeedback()
+        for _ in range(10):
+            f.observe(3, "reservation")
+            assert f.stamp(3) == (0.0, 0.0)
+
+
+class TestSessionNonce:
+    def test_distinct_first8_and_deterministic(self):
+        nonces = [session_nonce(i, seed=9) for i in range(1000)]
+        assert len({n[:8] for n in nonces}) == 1000
+        assert len({len(n) for n in nonces}) == 1
+        assert nonces[5] == session_nonce(5, seed=9)
+        assert nonces[5] != session_nonce(5, seed=10)
+
+
+class TestProfiles:
+    def test_catalog_shapes(self):
+        import random
+        rng = random.Random(0)
+        pop = ZipfPopularity(100, seed=0)
+        for spec in (rados_read(), rados_write(), rados_mixed(),
+                     rbd_profile()):
+            item = spec.build(rng, pop)
+            assert item.kind == "rados" and item.oid and item.ops
+        item = rgw_s3().build(rng, pop)
+        assert item.kind == "http" and item.path.startswith("/wlbkt/")
+        rbd = rbd_profile(image="img").build(rng, pop)
+        assert rbd.oid.startswith("rbd_data.img.")
+
+
+# -- live cluster ------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def wl_cluster():
+    cluster = MiniCluster(num_mons=1, num_osds=2,
+                          conf_overrides=FAST).start()
+    mgr = cluster.start_mgr(modules=(PerfQueryModule, StatusModule))
+    client = cluster.client()
+    pool_id = cluster.create_replicated_pool(client, "wlpool",
+                                             size=2, pg_num=8)
+    assert cluster.wait_clean(pool_id)
+    yield cluster, mgr, client
+    cluster.stop()
+
+
+class TestHarnessSmoke:
+    """Tier-1 deterministic smoke: fixed schedule, seeded RNG — the
+    arrival times, object choices and session nonces are bit-identical
+    run to run; only the measured latencies vary."""
+
+    def test_fixed_schedule_completes(self, wl_cluster):
+        _, _, client = wl_cluster
+        io = client.open_ioctx("wlpool")
+        for i in range(32):                        # reads need targets
+            io.write_full("smoke.%08d" % i, b"s" * 512)
+        sched = [i * 0.01 for i in range(6)]       # 6 ops/session
+        h = WorkloadHarness(
+            client, "wlpool", rados_mixed(obj_prefix="smoke", size=512),
+            num_sessions=8,
+            arrival_factory=lambda i: FixedArrivals(sched),
+            popularity=ZipfPopularity(32, seed=1), seed=42)
+        stats = h.run(drain_timeout=20.0)
+        assert stats["submitted"] == 48
+        assert stats["completed"] == 48
+        assert stats["errors"] == 0
+        assert stats["drained"]
+        key = "rados-mixed/client"
+        assert stats["latency"][key]["count"] == 48
+        assert stats["latency"][key]["p99_s"] > 0
+
+    def test_sessions_attributed_distinctly(self, wl_cluster):
+        """The OSD perf-query key tables see one principal per harness
+        session, not one per TCP connection."""
+        cluster, _, client = wl_cluster
+        n = 12
+        h = WorkloadHarness(
+            client, "wlpool", rados_write(obj_prefix="attr", size=256),
+            num_sessions=n,
+            arrival_factory=lambda i: FixedArrivals([0.0, 0.005]),
+            popularity=UniformPopularity(16, seed=3), seed=7)
+        stats = h.run(drain_timeout=20.0)
+        assert stats["completed"] == 2 * n
+        wanted = {"client.%d:%s" % (client.client_id,
+                                    session_nonce(i, seed=7)[:8])
+                  for i in range(n)}
+
+        def attributed():
+            seen = set()
+            for osd in cluster.osds.values():
+                for table in osd.perf_query.dump().values():
+                    if "client" not in table["key_by"]:
+                        continue
+                    col = table["key_by"].index("client")
+                    for row in table["keys"]:
+                        seen.add(row["k"][col])
+            return wanted <= seen
+        assert wait_until(attributed, timeout=15, interval=0.3)
+
+
+@pytest.mark.slow
+class TestHarnessSoak:
+    def test_thousand_session_open_loop(self, wl_cluster):
+        """Scale leg: 1000 distinct sessions, Poisson arrivals, open
+        loop. Bounded inflight growth and full drain prove the driver
+        really is async (1000 blocked threads would never fit)."""
+        _, _, client = wl_cluster
+        io = client.open_ioctx("wlpool")
+        for i in range(256):                       # reads need targets
+            io.write_full("soak.%08d" % i, b"s" * 512)
+        h = WorkloadHarness(
+            client, "wlpool", rados_mixed(obj_prefix="soak", size=512),
+            num_sessions=1000,
+            arrival_factory=lambda i: PoissonArrivals(1.0, seed=i),
+            popularity=ZipfPopularity(256, alpha=1.1, seed=11),
+            seed=99)
+        stats = h.run(duration=3.0, drain_timeout=60.0)
+        assert stats["sessions"] == 1000
+        assert stats["submitted"] > 1500      # ~1000/s offered x 3s
+        assert stats["drained"]
+        assert stats["completed"] == stats["submitted"]
+        assert stats["errors"] == 0
