@@ -2592,6 +2592,306 @@ def run_qos(out_path: str | None = None) -> dict:
     return doc
 
 
+def run_scaleobs(out_path: str | None = None) -> dict:
+    """Datacenter-scale telemetry artifact (ISSUE 18): ~2000 synthetic
+    daemons speak the delta-encoded MMgrReport protocol through the
+    REAL mgr ingest path — wire encode, sharded ingest, DaemonStateIndex
+    fold, TSDB record, MMgrReportAck return leg — on one MiniCluster.
+
+    Legs:
+
+      1. Scale fan: 2000 reporters on one client messenger, first
+         round full + schema, steady-state rounds delta-only.  Every
+         daemon must land in the daemon index AND the TSDB; the mgr's
+         folded state must equal the sender's own full dump bit-for-bit.
+      2. Memory ceiling: the aggregator's tracked-byte ledger is
+         sampled after every round and must never exceed
+         mgr_metrics_mem_budget.
+      3. Wire win: steady-state delta perf payloads (real
+         encoding.encode_any bytes) vs the full-dump baseline.
+      4. Rate fidelity: one aggregator fed the same series twice at
+         identical timestamps — once via folded deltas, once via full
+         dumps — must derive bit-equal rates.
+      5. Bounded exposition: a 500-series cap over a 2000-daemon page;
+         every family stays capped, the spill lands in overflow
+         buckets and ceph_mgr_series_dropped_total.
+      6. Ingest health: MGR_INGEST_LAG + MGR_MEM_BUDGET_FULL raise on
+         the live mon, survive a health-monitor restart via
+         carry-until-first-report, and clear on drain.
+
+    HARD GATES (SystemExit) on every leg above."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_util import MiniCluster, wait_until
+
+    from ceph_tpu import encoding
+    from ceph_tpu.common.telemetry import DeltaReporter
+    from ceph_tpu.mgr import PrometheusModule
+    from ceph_tpu.mgr.daemon_state import DaemonStateIndex
+    from ceph_tpu.mgr.metrics import MetricsAggregator
+    from ceph_tpu.msg.message import MMgrReport
+
+    N_DAEMONS = 2000
+    ROUNDS = 6
+    N_COUNTERS = 24
+    SERIES_CAP = 500
+    SCHEMA = {"synth": dict(
+        {"c%d" % i: {"type": 10} for i in range(N_COUNTERS)},
+        lat={"type": 5})}
+
+    doc: dict = {"metric": "steady_state_report_byte_ratio",
+                 "unit": "fraction", "daemons": N_DAEMONS,
+                 "rounds": ROUNDS}
+
+    c = MiniCluster(num_mons=1, num_osds=1,
+                    conf_overrides={"mgr_stats_period": 0.25,
+                                    "osd_heartbeat_interval": 0.5,
+                                    "mgr_ingest_shards": 4,
+                                    "mgr_prom_series_cap": SERIES_CAP})
+    c.start()
+    try:
+        mgr = c.start_mgr(modules=(PrometheusModule,))
+        if not wait_until(lambda: mgr.osdmap is not None, timeout=15):
+            raise SystemExit("scaleobs gate: mgr never saw an osdmap")
+        budget = mgr.metrics.mem_budget
+        doc["mem_budget_bytes"] = budget
+
+        # -- the reporter fan: one shared messenger, acks routed home --
+        fan = c.client()
+        reporters = {"synth.%d" % i: DeltaReporter()
+                     for i in range(N_DAEMONS)}
+        state = {name: {"synth": dict(
+            {"c%d" % j: (i * 7 + j) % 100
+             for j in range(N_COUNTERS)},
+            lat={"sum": 0.25 * i, "avgcount": i})}
+            for i, name in enumerate(reporters)}
+
+        class _AckRouter:
+            def ms_dispatch(self, msg) -> bool:
+                if not isinstance(msg, tuple) \
+                        and msg.get_type() == "MMgrReportAck":
+                    r = reporters.get(msg.daemon_name)
+                    if r is not None:
+                        r.ack(msg.ack_seq, resync=msg.resync)
+                        return True
+                return False
+        fan.msgr.add_dispatcher_head(_AckRouter())
+        mgr_addr = mgr.msgr.my_addr
+
+        full_bytes = delta_bytes = 0
+        full_n = delta_n = 0
+        budget_samples = []
+
+        def send_round(rnd: int) -> None:
+            nonlocal full_bytes, delta_bytes, full_n, delta_n
+            for i, (name, r) in enumerate(reporters.items()):
+                if rnd > 0:
+                    g = state[name]["synth"]
+                    for k in range(3):     # 3 of 24 counters move
+                        g["c%d" % ((rnd * 3 + k + i) % N_COUNTERS)] \
+                            += 1 + (i % 5)
+                # fresh snapshot per report, like a daemon's
+                # perf_dump(): the reporter keeps the dict it was
+                # handed as the future delta base
+                rep = r.prepare(
+                    {g: dict(cs) for g, cs in state[name].items()},
+                    SCHEMA)
+                wire = len(encoding.encode_any(rep["perf"]))
+                if rep["delta_base"] < 0:
+                    full_bytes += wire
+                    full_n += 1
+                elif rnd >= 2:             # steady state only
+                    delta_bytes += wire
+                    delta_n += 1
+                fan.msgr.send_message(
+                    MMgrReport(daemon_name=name, perf=rep["perf"],
+                               daemon_type="osd",
+                               perf_schema=rep["schema"],
+                               report_seq=rep["seq"],
+                               incarnation=rep["incarnation"],
+                               schema_hash=rep["schema_hash"],
+                               delta_base=rep["delta_base"]),
+                    mgr_addr)
+
+        def all_acked() -> bool:
+            return all(r.status()["delta_capable"]
+                       and r.status()["acked_seq"]
+                       == r.status()["seq"]
+                       for r in reporters.values())
+
+        for rnd in range(ROUNDS):
+            send_round(rnd)
+            if not wait_until(all_acked, timeout=120, interval=0.25):
+                lag = sum(1 for r in reporters.values()
+                          if not r.status()["delta_capable"])
+                raise SystemExit("scaleobs gate: round %d never fully "
+                                 "acked (%d reporters not delta-"
+                                 "capable)" % (rnd, lag))
+            tracked = mgr.metrics.tracked_bytes()
+            budget_samples.append(tracked)
+            if tracked > budget:
+                raise SystemExit("scaleobs gate: tracked %d bytes "
+                                 "escaped the %d budget on round %d"
+                                 % (tracked, budget, rnd))
+
+        # -- leg 1: every daemon ingested AND visible ------------------
+        seen_idx = [n for n in mgr.daemon_state.names()
+                    if n.startswith("synth.")]
+        seen_tsdb = [n for n in mgr.metrics.daemons(include_stale=True)
+                     if n.startswith("synth.")]
+        doc["ingested_daemons"] = len(seen_idx)
+        doc["tsdb_daemons"] = len(seen_tsdb)
+        if len(seen_idx) < N_DAEMONS or len(seen_tsdb) < N_DAEMONS:
+            raise SystemExit("scaleobs gate: %d/%d daemons in the "
+                             "index, %d in the TSDB (want %d)"
+                             % (len(seen_idx), N_DAEMONS,
+                                len(seen_tsdb), N_DAEMONS))
+        for i in range(0, N_DAEMONS, 97):
+            name = "synth.%d" % i
+            if mgr.daemon_state.get_perf(name) != state[name]:
+                raise SystemExit("scaleobs gate: folded state for %s "
+                                 "diverged from the sender's full "
+                                 "dump" % name)
+        st = mgr.ingest_status()
+        doc["ingest"] = {"reports": st["reports"],
+                         "delta_reports": st["delta_reports"],
+                         "full_reports": st["full_reports"],
+                         "delta_hit_ratio": st["delta_hit_ratio"],
+                         "resyncs": st["resyncs"],
+                         "lag_p99_ms": st["lag_p99_ms"]}
+        doc["mem"] = {"budget": budget,
+                      "peak_tracked": max(budget_samples),
+                      "peak_occupancy": round(
+                          max(budget_samples) / budget, 4),
+                      "samples": len(budget_samples)}
+
+        # -- leg 3: the wire win ---------------------------------------
+        ratio = (delta_bytes / delta_n) / (full_bytes / full_n)
+        doc["wire"] = {
+            "full_report_bytes_avg": round(full_bytes / full_n, 1),
+            "delta_report_bytes_avg": round(delta_bytes / delta_n, 1),
+            "steady_state_ratio": round(ratio, 4),
+            "schema_bytes_once": len(encoding.encode_any(SCHEMA)),
+            "schema_shipments_per_daemon": 1}
+        if ratio > 0.2:
+            raise SystemExit("scaleobs gate: steady-state delta "
+                             "reports are %.1f%% of a full dump "
+                             "(budget: 20%%)" % (ratio * 100))
+
+        # -- leg 4: delta-path rates bit-equal to full-path ------------
+        agg = MetricsAggregator(shards=1, stale_after=1e9)
+        idx = DaemonStateIndex()
+        rr = DeltaReporter()
+        cur = {"synth": {"c0": 0, "c1": 1000}}
+        for tick in range(12):
+            cur = {"synth": {"c0": cur["synth"]["c0"] + 17,
+                             "c1": cur["synth"]["c1"] + 3}}
+            rep = rr.prepare(cur, SCHEMA)
+            folded, resync, _ = idx.ingest(
+                "pair.delta", rep["perf"], seq=rep["seq"],
+                incarnation=rep["incarnation"],
+                schema_hash=rep["schema_hash"],
+                delta_base=rep["delta_base"],
+                has_schema=bool(rep["schema"]))
+            rr.ack(rep["seq"], resync)
+            now = 100.0 + tick * 5.0
+            agg.record("pair.delta", folded, now=now)
+            agg.record("pair.full", cur, now=now)
+        now = 100.0 + 11 * 5.0
+        mismatches = [
+            ctr for ctr in ("c0", "c1") for win in (10.0, 30.0, None)
+            if agg.rate("pair.delta", "synth", ctr,
+                        window=win, now=now)
+            != agg.rate("pair.full", "synth", ctr,
+                        window=win, now=now)]
+        doc["rate_fidelity"] = {"counters": 2, "windows": 3,
+                                "bit_equal": not mismatches}
+        if mismatches:
+            raise SystemExit("scaleobs gate: delta-path rates "
+                             "diverged from full-path on %r"
+                             % mismatches)
+
+        # -- leg 5: bounded exposition ---------------------------------
+        from cluster_util import lint_exposition
+        prom = mgr.modules["prometheus"]
+        text = prom.render()
+        lint_exposition(text)
+        fams: dict = {}
+        overflowed = set()
+        for ln in text.splitlines():
+            if ln.startswith("#") or not ln.strip():
+                continue
+            fam = ln.split("{")[0].split(" ")[0]
+            if 'overflow="true"' in ln:
+                overflowed.add(fam)
+            else:
+                fams[fam] = fams.get(fam, 0) + 1
+        worst = max(fams, key=fams.get)
+        dropped = sum(prom._dropped.values())
+        doc["exposition"] = {"families": len(fams),
+                             "worst_family": worst,
+                             "worst_family_series": fams[worst],
+                             "series_cap": SERIES_CAP,
+                             "overflowed_families": len(overflowed),
+                             "series_dropped_total": dropped}
+        if fams[worst] > SERIES_CAP:
+            raise SystemExit("scaleobs gate: family %s rendered %d "
+                             "series past the %d cap"
+                             % (worst, fams[worst], SERIES_CAP))
+        if not overflowed or dropped <= 0 \
+                or "ceph_mgr_series_dropped_total" not in text:
+            raise SystemExit("scaleobs gate: a 2000-daemon page under "
+                             "a %d cap dropped nothing" % SERIES_CAP)
+
+        # -- leg 6: health raise / carry / clear -----------------------
+        admin = c.client()
+        for _ in range(64):
+            mgr._lag_samples.append((time.monotonic(), 30.0))
+        mgr.metrics.mem_budget = 1
+
+        def raised() -> bool:
+            mgr._lag_samples.append((time.monotonic(), 30.0))
+            _, _, data = admin.mon_command({"prefix": "health"})
+            return "MGR_INGEST_LAG" in data["checks"] \
+                and "MGR_MEM_BUDGET_FULL" in data["checks"]
+        if not wait_until(raised, timeout=30, interval=0.2):
+            raise SystemExit("scaleobs gate: ingest health checks "
+                             "never reached the mon")
+        hm = c.leader().healthmon
+        hm._ingest_report = None      # fresh monitor, no report yet
+        hm.recompute()
+        _, _, data = admin.mon_command({"prefix": "health"})
+        if "MGR_INGEST_LAG" not in data["checks"] \
+                or "MGR_MEM_BUDGET_FULL" not in data["checks"]:
+            raise SystemExit("scaleobs gate: committed checks did not "
+                             "carry across a health-monitor restart")
+        mgr._lag_samples.clear()
+        mgr.metrics.mem_budget = budget
+
+        def cleared() -> bool:
+            _, _, data = admin.mon_command({"prefix": "health"})
+            return "MGR_INGEST_LAG" not in data["checks"] \
+                and "MGR_MEM_BUDGET_FULL" not in data["checks"]
+        if not wait_until(cleared, timeout=30, interval=0.3):
+            raise SystemExit("scaleobs gate: ingest health checks "
+                             "never cleared after the drain")
+        doc["health"] = {"raised": True, "carried": True,
+                         "cleared": True}
+    finally:
+        c.stop()
+
+    doc["value"] = doc["wire"]["steady_state_ratio"]
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "SCALEOBS_r01.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(doc))
+    return doc
+
+
 def main() -> None:
     import jax
 
@@ -2611,6 +2911,9 @@ def main() -> None:
         return
     if "--qos" in sys.argv:
         run_qos()
+        return
+    if "--scaleobs" in sys.argv:
+        run_scaleobs()
         return
     run_bench()
 
@@ -3217,6 +3520,10 @@ if __name__ == "__main__":
         # qos-isolation artifact: gates + cluster legs, no supervisor
         # (no device rows)
         run_qos()
+    elif "--scaleobs" in sys.argv:
+        # telemetry-at-scale artifact: gates + cluster legs, no
+        # supervisor (no device rows)
+        run_scaleobs()
     elif "--worker" in sys.argv:
         main()
     else:

@@ -291,6 +291,32 @@ def _declare_defaults():
     o("mgr_metrics_window", float, 5.0, LEVEL_ADVANCED,
       "default lookback window (seconds) for derived rates — "
       "`ceph iostat`, per-daemon op rates, device MB/s gauges")
+    o("mgr_metrics_mem_budget", int, 64 << 20, LEVEL_ADVANCED,
+      "hard byte budget for the mgr's whole telemetry store (raw "
+      "rings + rollup tiers + status/pg/pq payloads, byte-accounted "
+      "per daemon); exceeding a shard's slice squeezes then evicts "
+      "the coldest series first")
+    o("mgr_metrics_tiers", str, "5:24,60:30,600:18", LEVEL_ADVANCED,
+      "downsampling rollup tiers as 'bucket_seconds:buckets_kept' "
+      "pairs — each tier keeps per-counter min/max/sum/count and the "
+      "last histogram fills so derived rates/percentiles read "
+      "transparently past the raw ring")
+    o("mgr_ingest_shards", int, 4, LEVEL_ADVANCED,
+      "ingest worker shards MMgrReport handling is hashed onto by "
+      "daemon name (lock per shard, batched fold); 0 folds reports "
+      "inline on the dispatch thread (the legacy single-threaded "
+      "path)")
+    o("mgr_ingest_lag_warn", float, 2.0, LEVEL_ADVANCED,
+      "seconds of ingest lag p99 (report enqueue -> folded) above "
+      "which the mgr raises MGR_INGEST_LAG")
+    o("mgr_metrics_budget_full_ratio", float, 0.95, LEVEL_ADVANCED,
+      "tracked-bytes / mem-budget occupancy at or above which the "
+      "mgr raises MGR_MEM_BUDGET_FULL (eviction pressure is actively "
+      "squeezing fresh series)")
+    o("mgr_prom_series_cap", int, 2000, LEVEL_ADVANCED,
+      "per-metric sample cap on the prometheus exposition: excess "
+      "labeled series fold into one {overflow=\"true\"} bucket and "
+      "count into ceph_mgr_series_dropped_total")
     o("mgr_progress", bool, True, LEVEL_BASIC,
       "mgr progress module: narrate recovery/backfill convergence as "
       "progress events ('Rebalancing after osd.N marked out') with a "

@@ -1,0 +1,180 @@
+"""Delta-encoded mgr telemetry: the sender half of the MMgrReport
+delta protocol.
+
+Role of the reference's DaemonServer/MgrClient session state
+(/root/reference/src/mgr/MgrClient.cc): every reporting daemon used to
+re-ship its FULL perf dump + FULL schema every mgr_stats_period, which
+is O(counters) wire bytes per daemon per period — fine for a dozen
+daemons, ruinous for thousands.  A `DeltaReporter` instead stamps each
+report with a (incarnation, seq) identity plus a schema hash, and after
+the first acknowledged full report ships only the counters whose values
+changed since the last report the mgr ACKNOWLEDGED:
+
+  sender                      mgr
+    report seq=1 full+schema --->  ingest, remember (inc, 1)
+    <---------------------- ack 1  promote snapshot 1 to delta base
+    report seq=2 delta(base=1) ->  fold into state-as-of-1
+    ...
+
+The delta base is always an ACKED snapshot, so a lost report or lost
+ack can only make the next delta a superset of what the mgr is missing
+— never a gap.  The mgr requests a full resync (ack with resync=True)
+on first contact, on a delta whose base it never ingested (seq gap
+across a mgr restart), or on a schema-hash mismatch; the sender then
+falls back to a full report + schema.  Old senders that never learned
+the protocol keep shipping full reports with seq=0 and the mgr ingests
+them unchanged — the appended MMgrReport fields default to exactly
+that legacy shape.
+
+Schema travels only on the first report and on hash change (for
+gauges/counters the schema is immutable after construction, so in
+steady state ZERO schema bytes ride the stream) — the hash rides every
+report so the mgr can detect a stale schema without the payload.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import os
+
+__all__ = ["DeltaReporter", "schema_hash", "perf_delta", "fold_delta",
+           "approx_perf_bytes"]
+
+_incarnation_salt = itertools.count(1)
+
+
+def schema_hash(schema: dict) -> str:
+    """Stable short hash of a perf schema ({group: {counter: {type,
+    buckets?}}}) — equal schemas hash equal regardless of dict
+    insertion order."""
+    h = hashlib.sha1()
+    for group in sorted(schema):
+        h.update(group.encode())
+        counters = schema[group]
+        for name in sorted(counters):
+            ent = counters[name]
+            h.update(name.encode())
+            h.update(repr(sorted(ent.items())
+                          if isinstance(ent, dict) else ent).encode())
+    return h.hexdigest()[:16]
+
+
+def perf_delta(base: dict, perf: dict) -> dict:
+    """Counters in `perf` whose values differ from `base` (group ->
+    {counter: value}).  Equality is by value — avg dicts and histogram
+    fill lists compare structurally, so an idle counter costs zero
+    wire bytes."""
+    out: dict = {}
+    for group, counters in perf.items():
+        bg = base.get(group)
+        if bg is None:
+            out[group] = counters
+            continue
+        changed = {c: v for c, v in counters.items() if bg.get(c) != v}
+        if changed:
+            out[group] = changed
+    return out
+
+
+def fold_delta(base: dict, delta: dict) -> dict:
+    """Apply a `perf_delta` payload on top of a full perf state,
+    returning a NEW dict (unchanged counter values are shared by
+    reference with `base` — the delta stream's memory dividend)."""
+    out = {g: dict(c) for g, c in base.items()}
+    for group, counters in delta.items():
+        out.setdefault(group, {}).update(counters)
+    return out
+
+
+def approx_perf_bytes(perf: dict) -> int:
+    """Cheap size estimate of a perf payload (the aggregator's byte
+    accounting and the ingest bytes/s counter both use it; exact wire
+    bytes would mean encoding every report twice)."""
+    n = 64
+    for group, counters in perf.items():
+        n += len(group) + 56
+        for c, v in counters.items():
+            n += len(c)
+            if isinstance(v, dict):
+                b = v.get("buckets")
+                n += 96 + (8 * len(b) if b else 0)
+            else:
+                n += 48
+    return n
+
+
+class DeltaReporter:
+    """Per-daemon sender state for the delta protocol.  NOT
+    thread-safe on its own — each daemon calls prepare() from its one
+    report loop and ack() from its dispatch thread, so the tiny
+    critical sections are guarded by the caller being idempotent:
+    ack() only ever advances/clears state."""
+
+    def __init__(self, max_outstanding: int = 32):
+        # incarnation distinguishes a restarted daemon reusing a name:
+        # the mgr must never fold a new process's delta onto the old
+        # process's state
+        self.incarnation = "%s-%d" % (os.urandom(6).hex(),
+                                      next(_incarnation_salt))
+        self.seq = 0
+        self.max_outstanding = max_outstanding
+        self._acked_seq = -1
+        self._acked_perf: dict | None = None      # the delta base
+        self._acked_hash = ""
+        self._outstanding: dict[int, tuple] = {}  # seq -> (perf, hash)
+        self._sent_schema_hash = ""
+
+    # -- sender side ---------------------------------------------------
+
+    def prepare(self, perf: dict, schema: dict) -> dict:
+        """Build the wire fields for one report: {'seq', 'incarnation',
+        'schema_hash', 'delta_base', 'perf', 'schema'} where 'schema'
+        is {} whenever the mgr already acked this schema hash and
+        'perf' holds only changed counters whenever an acked base
+        exists."""
+        self.seq += 1
+        h = schema_hash(schema)
+        if self._acked_perf is not None and h == self._acked_hash:
+            payload = perf_delta(self._acked_perf, perf)
+            base = self._acked_seq
+        else:
+            payload = perf
+            base = -1
+        # schema rides only on first report / hash change (satellite:
+        # the legacy full-report path stops re-shipping it every period
+        # too); a lost schema heals through the mgr's resync request,
+        # which clears _sent_schema_hash below
+        send_schema = h != self._sent_schema_hash
+        self._sent_schema_hash = h
+        self._outstanding[self.seq] = (perf, h)
+        while len(self._outstanding) > self.max_outstanding:
+            self._outstanding.pop(min(self._outstanding))
+        return {"seq": self.seq, "incarnation": self.incarnation,
+                "schema_hash": h, "delta_base": base,
+                "perf": payload, "schema": schema if send_schema else {}}
+
+    def ack(self, seq: int, resync: bool = False) -> None:
+        """Mgr acknowledged `seq`.  resync=True means the mgr wants a
+        full report + schema next period (first contact, seq gap, or
+        schema mismatch)."""
+        if resync:
+            self._acked_seq = -1
+            self._acked_perf = None
+            self._acked_hash = ""
+            self._sent_schema_hash = ""
+            return
+        ent = self._outstanding.get(seq)
+        if ent is None or seq <= self._acked_seq:
+            return
+        perf, h = ent
+        self._acked_seq = seq
+        self._acked_perf = perf
+        self._acked_hash = h
+        for s in [s for s in self._outstanding if s <= seq]:
+            del self._outstanding[s]
+
+    def status(self) -> dict:
+        return {"incarnation": self.incarnation, "seq": self.seq,
+                "acked_seq": self._acked_seq,
+                "delta_capable": self._acked_perf is not None}

@@ -96,6 +96,9 @@ class MDSDaemon(Dispatcher):
         self.ctx.perf.add(self.perf)
         self.mgr_addr = None
         self._last_mgr_report = 0.0
+        # delta-encoded telemetry stream (common/telemetry.py)
+        from ..common.telemetry import DeltaReporter
+        self._mgr_reporter = DeltaReporter()
         self._running = False
         self._beacon_token = None
 
@@ -150,12 +153,18 @@ class MDSDaemon(Dispatcher):
             return
         self._last_mgr_report = now
         from ..msg.message import MMgrReport
+        rep = self._mgr_reporter.prepare(self.ctx.perf.perf_dump(),
+                                         self.ctx.perf.perf_schema())
         self.msgr.send_message(
             MMgrReport(daemon_name="mds.%s" % self.name,
                        daemon_type="mds",
-                       perf=self.ctx.perf.perf_dump(),
+                       perf=rep["perf"],
                        metadata={"state": self.state},
-                       perf_schema=self.ctx.perf.perf_schema()),
+                       perf_schema=rep["schema"],
+                       report_seq=rep["seq"],
+                       incarnation=rep["incarnation"],
+                       schema_hash=rep["schema_hash"],
+                       delta_base=rep["delta_base"]),
             self.mgr_addr)
 
     def _on_mdsmap(self, mdsmap: dict) -> None:
@@ -237,6 +246,9 @@ class MDSDaemon(Dispatcher):
     # -- dispatch ------------------------------------------------------
 
     def ms_dispatch(self, msg) -> bool:
+        if msg.get_type() == "MMgrReportAck":
+            self._mgr_reporter.ack(msg.ack_seq, resync=msg.resync)
+            return True
         if msg.get_type() != "MClientRequest":
             return False
         dest = msg.reply_to or msg.from_addr

@@ -4,6 +4,16 @@ Rendition of the reference's DaemonState/DaemonStateIndex
 (/root/reference/src/mgr/DaemonState.h): the mgr's view of every
 reporting daemon — metadata plus the latest perf-counter dump, with
 staleness tracking so a dead daemon's metrics age out of reports.
+
+Delta protocol (ISSUE 18): `ingest()` is the mgr half of the
+delta-encoded MMgrReport stream (common/telemetry.py holds the sender
+half).  Per daemon it tracks (incarnation, seq, schema_hash) and keeps
+the FOLDED full perf state deltas apply onto; a delta whose base this
+index never ingested (first contact, mgr restart, seq gap past the
+sender's acked base) or whose schema hash doesn't match the schema on
+file yields resync=True, which the mgr returns to the sender in the
+MMgrReportAck.  Legacy senders (report_seq=0) bypass the protocol
+entirely and ingest exactly as before.
 """
 
 from __future__ import annotations
@@ -11,17 +21,23 @@ from __future__ import annotations
 import threading
 import time
 
+from ..common.telemetry import fold_delta
+
 __all__ = ["DaemonStateIndex"]
 
 
 class _DaemonState:
-    __slots__ = ("name", "metadata", "perf", "last_report")
+    __slots__ = ("name", "metadata", "perf", "last_report",
+                 "seq", "incarnation", "schema_hash")
 
     def __init__(self, name: str):
         self.name = name
         self.metadata: dict = {}
         self.perf: dict = {}
         self.last_report = 0.0
+        self.seq = 0              # last ingested report seq (0=legacy)
+        self.incarnation = ""     # sender process identity
+        self.schema_hash = ""     # hash of the schema on file
 
 
 class DaemonStateIndex:
@@ -32,6 +48,8 @@ class DaemonStateIndex:
 
     def report(self, name: str, perf: dict,
                metadata: dict | None = None) -> None:
+        """Legacy full-report ingest (also the mgr's own loopback-free
+        self-report path)."""
         with self._lock:
             d = self._daemons.get(name)
             if d is None:
@@ -40,6 +58,68 @@ class DaemonStateIndex:
             if metadata:
                 d.metadata.update(metadata)
             d.last_report = time.monotonic()
+
+    def ingest(self, name: str, perf: dict,
+               metadata: dict | None = None, seq: int = 0,
+               incarnation: str = "", schema_hash: str = "",
+               delta_base: int = -1, has_schema: bool = False):
+        """Fold one MMgrReport into the index.
+
+        Returns (full_perf | None, resync, kind):
+          full_perf  the daemon's complete folded perf state to feed
+                     the metrics aggregator, or None when the report
+                     could not be applied
+          resync     True when the sender must fall back to a full
+                     report + schema (returned on the ack)
+          kind       'legacy' | 'full' | 'delta' | 'stale' | 'resync'
+        """
+        now = time.monotonic()
+        with self._lock:
+            d = self._daemons.get(name)
+            if d is None:
+                d = self._daemons[name] = _DaemonState(name)
+            if metadata:
+                d.metadata.update(metadata)
+            if seq <= 0:
+                # legacy sender: full perf every period, no protocol
+                d.perf = dict(perf)
+                d.seq = 0
+                d.last_report = now
+                return d.perf, False, "legacy"
+            if seq <= d.seq and incarnation == d.incarnation:
+                # dup/reordered delivery: state already reflects a
+                # report at least this new — folding it again would
+                # regress seq (and, for a delta, double-apply)
+                return None, False, "stale"
+            if delta_base < 0:
+                # full report: accept wholesale; ask for the schema if
+                # the sender's hash moved past the one on file and the
+                # payload didn't carry it
+                d.perf = dict(perf)
+                d.seq = seq
+                d.incarnation = incarnation
+                d.last_report = now
+                if has_schema:
+                    d.schema_hash = schema_hash
+                    return d.perf, False, "full"
+                resync = bool(schema_hash) \
+                    and schema_hash != d.schema_hash
+                return d.perf, resync, "full"
+            # delta report
+            if incarnation != d.incarnation or d.seq < delta_base \
+                    or not d.perf:
+                # first contact / mgr restarted / base never ingested:
+                # nothing to fold onto — drop and request a resync
+                return None, True, "resync"
+            if schema_hash and schema_hash != d.schema_hash \
+                    and not has_schema:
+                return None, True, "resync"
+            d.perf = fold_delta(d.perf, perf)
+            d.seq = seq
+            d.last_report = now
+            if has_schema:
+                d.schema_hash = schema_hash
+            return d.perf, False, "delta"
 
     def remove(self, name: str) -> None:
         with self._lock:

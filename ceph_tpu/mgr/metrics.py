@@ -22,34 +22,162 @@ Staleness: a daemon that stops reporting ages out of every derived
 view after `stale_after` — rates, df, iostat and the prometheus
 exposition all read through `fresh_daemons`, so a dead OSD's last
 values are never exported forever.
+
+Datacenter scale (ISSUE 18): the store is a downsampling TSDB.  Each
+daemon keeps a short RAW ring of full snapshots plus rollup TIERS
+(default 5s → 60s → 10min buckets).  A rollup bucket carries, per
+counter, min/max/sum/count for plain gauges/counters, the last
+sum/avgcount pair for averages, and the last cumulative histogram
+fills (cumulative fills ARE the merged fill — endpoint diffs recover
+any sub-range).  Derivations read transparently across tiers: the
+window's points are the union of raw snapshots and rollup bucket
+endpoints, deduped by timestamp with raw winning — on fresh data the
+merged timeline IS the raw ring, so the answers stay bit-equal to the
+raw-only derivation.
+
+Memory: everything lives under one hard `mem_budget`, split across N
+lock-sharded sub-stores (hashed by daemon name, aligned with the mgr's
+ingest shards so concurrent folds never contend).  Every snapshot and
+bucket is byte-accounted on the way in; when a shard exceeds its slice
+the COLDEST series (oldest last_ts) is first squeezed (raw ring and
+rollups trimmed to their newest entries) and then dropped entirely —
+fresh, hot daemons are evicted last, and an evicted daemon reappears
+with its next report.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+import zlib
 from collections import deque
 
 from ..common.perf_counters import _HIST_BUCKETS
+from ..common.telemetry import approx_perf_bytes
 
-__all__ = ["MetricsAggregator"]
+__all__ = ["MetricsAggregator", "DEFAULT_TIERS", "parse_tiers"]
+
+#: rollup tier spec: (bucket seconds, buckets retained) — 2min of 5s
+#: buckets, 30min of 60s buckets, 3h of 10min buckets
+DEFAULT_TIERS = ((5.0, 24), (60.0, 30), (600.0, 18))
+
+
+def parse_tiers(spec: str):
+    """'5:24,60:30,600:18' -> ((5.0, 24), (60.0, 30), (600.0, 18));
+    empty/invalid specs fall back to the defaults."""
+    out = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            res, _, keep = part.partition(":")
+            out.append((float(res), max(1, int(keep))))
+        except ValueError:
+            return DEFAULT_TIERS
+    return tuple(out) or DEFAULT_TIERS
+
+
+class _Bucket:
+    """One rollup bucket: per-counter aggregates over [t0, t1].
+
+    data maps (group, counter) -> tagged tuple:
+      ("s", min, max, sum, n, last)          plain gauges/counters
+      ("a", sum, avgcount)                   avg/time counters (last)
+      ("h", fills, sum, count)               histograms (last cumulative
+                                             fills — the merged fill)
+      ("o", value)                           anything else
+    """
+    __slots__ = ("key", "t0", "t1", "count", "data", "nbytes")
+
+    def __init__(self, key: int, now: float):
+        self.key = key
+        self.t0 = now
+        self.t1 = now
+        self.count = 0
+        self.data: dict = {}
+        self.nbytes = 64
+
+    def get(self, group: str, counter: str):
+        """Reconstruct the bucket-endpoint value a derivation reads —
+        the same shape a raw snapshot holds for that counter."""
+        e = self.data.get((group, counter))
+        if e is None:
+            return None
+        tag = e[0]
+        if tag == "s":
+            return e[5]
+        if tag == "a":
+            return {"sum": e[1], "avgcount": e[2]}
+        if tag == "h":
+            return {"buckets": list(e[1]), "sum": e[2], "count": e[3]}
+        return e[1]
+
+    def fold(self, perf: dict) -> int:
+        """Fold one full snapshot; returns the bucket's byte delta."""
+        data = self.data
+        cost = 64
+        for group, counters in perf.items():
+            for cname, v in counters.items():
+                k = (group, cname)
+                if isinstance(v, dict):
+                    if "buckets" in v:
+                        fills = v["buckets"]
+                        data[k] = ("h", fills, v.get("sum", 0),
+                                   v.get("count", 0))
+                        cost += 80 + 8 * len(fills)
+                    else:
+                        data[k] = ("a", v.get("sum", 0),
+                                   v.get("avgcount", 0))
+                        cost += 72
+                elif isinstance(v, (int, float)):
+                    e = data.get(k)
+                    if e is not None and e[0] == "s":
+                        data[k] = ("s", min(e[1], v), max(e[2], v),
+                                   e[3] + v, e[4] + 1, v)
+                    else:
+                        data[k] = ("s", v, v, v, 1, v)
+                    cost += 88
+                else:
+                    data[k] = ("o", v)
+                    cost += 56
+        self.count += 1
+        delta = cost - self.nbytes
+        self.nbytes = cost
+        return delta
 
 
 class _Series:
     __slots__ = ("snaps", "status", "pg_stats", "schema", "last_ts",
-                 "daemon_type", "pq_snaps")
+                 "daemon_type", "pq_snaps", "tiers", "nbytes",
+                 "aux_bytes")
 
-    def __init__(self, history: int):
-        self.snaps: deque = deque(maxlen=history)   # (ts, perf dict)
+    def __init__(self, tier_spec):
+        self.snaps: deque = deque()    # (ts, perf dict, nbytes)
         self.status: dict = {}
         self.pg_stats: dict = {}       # str(pgid) -> stats row
         self.schema: dict = {}         # group -> {counter: {type,...}}
         self.last_ts = 0.0
         self.daemon_type = ""
-        # (ts, perf_query payload) ring: the OSD's per-principal key
-        # tables, windowed the same way perf snapshots are so the
-        # perf_query module can diff endpoints into rates
-        self.pq_snaps: deque = deque(maxlen=history)
+        # (ts, perf_query payload, nbytes) ring: the OSD's
+        # per-principal key tables, windowed the same way perf
+        # snapshots are so the perf_query module can diff endpoints
+        # into rates
+        self.pq_snaps: deque = deque()
+        self.tiers = [deque() for _ in tier_spec]
+        self.nbytes = 0                # everything this series holds
+        self.aux_bytes = 0             # status+pg_stats+schema slice
+
+
+class _Shard:
+    __slots__ = ("lock", "series", "nbytes", "evicted", "trims")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.series: dict[str, _Series] = {}
+        self.nbytes = 0
+        self.evicted = 0               # series dropped by the budget
+        self.trims = 0                 # series squeezed by the budget
 
 
 def _counter_value(val):
@@ -62,15 +190,24 @@ def _counter_value(val):
 
 class MetricsAggregator:
     def __init__(self, history: int = 128, stale_after: float = 10.0,
-                 window: float = 5.0):
+                 window: float = 5.0, mem_budget: int = 64 << 20,
+                 shards: int = 4, tiers=DEFAULT_TIERS):
         self.history = history
         self.stale_after = stale_after
         self.window = window
-        self._lock = threading.Lock()
-        self._series: dict[str, _Series] = {}
+        self.mem_budget = int(mem_budget)
+        self.tier_spec = tuple(tiers)
+        n = max(1, int(shards))
+        self._shards = [_Shard() for _ in range(n)]
+        self._shard_budget = max(1, self.mem_budget // n)
+        self._vlock = threading.Lock()
         # free-form value series (balancer sweep timings, ...): the
         # measured-feedback store ROADMAP #4 closes its loop through
         self._values: dict[str, deque] = {}
+
+    def _shard(self, daemon: str) -> _Shard:
+        return self._shards[zlib.crc32(daemon.encode()) %
+                            len(self._shards)]
 
     # -- ingest --------------------------------------------------------
 
@@ -79,11 +216,29 @@ class MetricsAggregator:
                daemon_type: str = "", now: float | None = None,
                perf_query: dict | None = None) -> None:
         now = time.monotonic() if now is None else now
-        with self._lock:
-            s = self._series.get(daemon)
+        cost = approx_perf_bytes(perf)
+        shard = self._shard(daemon)
+        with shard.lock:
+            s = shard.series.get(daemon)
             if s is None:
-                s = self._series[daemon] = _Series(self.history)
-            s.snaps.append((now, perf))
+                s = shard.series[daemon] = _Series(self.tier_spec)
+            before = s.nbytes
+            s.snaps.append((now, perf, cost))
+            s.nbytes += cost
+            while len(s.snaps) > self.history:
+                s.nbytes -= s.snaps.popleft()[2]
+            # fold into every rollup tier (bucket = floor(now / res))
+            for (res, keep), dq in zip(self.tier_spec, s.tiers):
+                key = int(now // res)
+                b = dq[-1] if dq else None
+                if b is None or b.key != key:
+                    b = _Bucket(key, now)
+                    dq.append(b)
+                    s.nbytes += b.nbytes
+                    while len(dq) > keep:
+                        s.nbytes -= dq.popleft().nbytes
+                b.t1 = now
+                s.nbytes += b.fold(perf)
             if status is not None:
                 s.status = dict(status)
             if pg_stats is not None:
@@ -92,94 +247,201 @@ class MetricsAggregator:
                 s.schema = dict(schema)
             if daemon_type:
                 s.daemon_type = daemon_type
+            if status is not None or pg_stats is not None or schema:
+                aux = approx_perf_bytes(s.status) \
+                    + approx_perf_bytes(s.pg_stats) \
+                    + approx_perf_bytes(s.schema)
+                s.nbytes += aux - s.aux_bytes
+                s.aux_bytes = aux
             if perf_query is not None:
                 # {} is a real observation ("no live queries / no
                 # keys"), not a gap — recording it lets vanished
                 # clients age out of the windowed views
-                s.pq_snaps.append((now, perf_query))
+                pq_cost = approx_perf_bytes(perf_query)
+                s.pq_snaps.append((now, perf_query, pq_cost))
+                s.nbytes += pq_cost
+                while len(s.pq_snaps) > self.history:
+                    s.nbytes -= s.pq_snaps.popleft()[2]
             s.last_ts = now
+            shard.nbytes += s.nbytes - before
+            if shard.nbytes > self._shard_budget:
+                self._evict_locked(shard, protect=daemon)
+
+    def _squeeze(self, s: _Series) -> int:
+        """Shrink a series to its minimum useful footprint (2 newest
+        raw/pq snapshots, 1 newest bucket per tier); returns freed
+        bytes."""
+        freed = 0
+        while len(s.snaps) > 2:
+            freed += s.snaps.popleft()[2]
+        while len(s.pq_snaps) > 2:
+            freed += s.pq_snaps.popleft()[2]
+        for dq in s.tiers:
+            while len(dq) > 1:
+                freed += dq.popleft().nbytes
+        s.nbytes -= freed
+        return freed
+
+    def _evict_locked(self, shard: _Shard, protect: str) -> None:
+        """Coldest-series eviction (shard lock held): squeeze the
+        series with the oldest last_ts first; a series that is already
+        minimal is dropped entirely.  The daemon being recorded is
+        evicted last — fresh reporters must not vanish while colder
+        series still hold reclaimable bytes."""
+        while shard.nbytes > self._shard_budget and shard.series:
+            names = [n for n in shard.series if n != protect] \
+                or list(shard.series)
+            name = min(names, key=lambda n: shard.series[n].last_ts)
+            s = shard.series[name]
+            freed = self._squeeze(s)
+            if freed > 0:
+                shard.nbytes -= freed
+                shard.trims += 1
+                continue
+            shard.nbytes -= s.nbytes
+            del shard.series[name]
+            shard.evicted += 1
 
     def record_value(self, key: str, value: float,
                      now: float | None = None) -> None:
         now = time.monotonic() if now is None else now
-        with self._lock:
+        with self._vlock:
             dq = self._values.get(key)
             if dq is None:
                 dq = self._values[key] = deque(maxlen=self.history)
             dq.append((now, float(value)))
 
     def values(self, key: str) -> list[float]:
-        with self._lock:
+        with self._vlock:
             return [v for _, v in self._values.get(key, ())]
 
     def value_keys(self) -> list[str]:
-        with self._lock:
+        with self._vlock:
             return sorted(self._values)
 
     def remove(self, daemon: str) -> None:
-        with self._lock:
-            self._series.pop(daemon, None)
+        shard = self._shard(daemon)
+        with shard.lock:
+            s = shard.series.pop(daemon, None)
+            if s is not None:
+                shard.nbytes -= s.nbytes
 
     def prune(self, now: float | None = None) -> list[str]:
         """Drop series whose daemon stopped reporting long ago (10x the
         staleness window — stale daemons are merely hidden, pruned ones
-        are forgotten).  Returns what was dropped."""
+        are forgotten).  Value series (balancer sweep timings etc.) age
+        out on the same clock — record_value keys used to live forever.
+        Returns the daemon series that were dropped."""
         now = time.monotonic() if now is None else now
-        with self._lock:
-            dead = [n for n, s in self._series.items()
-                    if now - s.last_ts > 10 * self.stale_after]
-            for n in dead:
-                del self._series[n]
+        horizon = 10 * self.stale_after
+        dead = []
+        for shard in self._shards:
+            with shard.lock:
+                gone = [n for n, s in shard.series.items()
+                        if now - s.last_ts > horizon]
+                for n in gone:
+                    shard.nbytes -= shard.series.pop(n).nbytes
+                dead.extend(gone)
+        with self._vlock:
+            stale_keys = [k for k, dq in self._values.items()
+                          if not dq or now - dq[-1][0] > horizon]
+            for k in stale_keys:
+                del self._values[k]
         return dead
+
+    # -- memory accounting ---------------------------------------------
+
+    def tracked_bytes(self) -> int:
+        return sum(sh.nbytes for sh in self._shards)
+
+    def mem_stats(self) -> dict:
+        """The budget/eviction ledger the `ingest status` surface and
+        the MGR_MEM_BUDGET_FULL check read."""
+        per = []
+        total = series = evicted = trims = 0
+        for sh in self._shards:
+            with sh.lock:
+                per.append({"bytes": sh.nbytes,
+                            "series": len(sh.series),
+                            "evictions": sh.evicted,
+                            "trims": sh.trims})
+                total += sh.nbytes
+                series += len(sh.series)
+                evicted += sh.evicted
+                trims += sh.trims
+        return {"tracked_bytes": total, "budget": self.mem_budget,
+                "occupancy": (total / self.mem_budget
+                              if self.mem_budget else 0.0),
+                "series": series, "evictions": evicted,
+                "trims": trims, "shards": per}
 
     # -- introspection -------------------------------------------------
 
     def daemons(self, include_stale: bool = False,
                 now: float | None = None) -> list[str]:
         now = time.monotonic() if now is None else now
-        with self._lock:
-            return sorted(
-                n for n, s in self._series.items()
-                if include_stale or now - s.last_ts <= self.stale_after)
+        out = []
+        for shard in self._shards:
+            with shard.lock:
+                out.extend(
+                    n for n, s in shard.series.items()
+                    if include_stale
+                    or now - s.last_ts <= self.stale_after)
+        return sorted(out)
 
     fresh_daemons = daemons
 
     def latest(self, daemon: str) -> dict:
-        with self._lock:
-            s = self._series.get(daemon)
+        shard = self._shard(daemon)
+        with shard.lock:
+            s = shard.series.get(daemon)
             return dict(s.snaps[-1][1]) if s and s.snaps else {}
 
     def status(self, daemon: str) -> dict:
-        with self._lock:
-            s = self._series.get(daemon)
+        shard = self._shard(daemon)
+        with shard.lock:
+            s = shard.series.get(daemon)
             return dict(s.status) if s else {}
 
     def schema(self, daemon: str) -> dict:
-        with self._lock:
-            s = self._series.get(daemon)
+        shard = self._shard(daemon)
+        with shard.lock:
+            s = shard.series.get(daemon)
             return dict(s.schema) if s else {}
 
     def _window_snaps(self, daemon: str, window: float | None,
                       now: float | None) -> list | None:
-        """Every snapshot inside the lookback window, oldest first, or
+        """Every point inside the lookback window, oldest first, or
         None when fewer than two land inside it (or the daemon is
-        stale/unknown)."""
+        stale/unknown).  A point is (ts, raw perf dict | _Bucket):
+        rollup bucket endpoints extend the timeline past the raw
+        ring's reach, deduped by timestamp with raw snapshots winning
+        — on fresh data the merged list IS the raw list, so derived
+        answers stay bit-equal to the raw-only derivation."""
         window = self.window if window is None else window
         now = time.monotonic() if now is None else now
-        with self._lock:
-            s = self._series.get(daemon)
-            if s is None or len(s.snaps) < 2:
+        shard = self._shard(daemon)
+        with shard.lock:
+            s = shard.series.get(daemon)
+            if s is None:
                 return None
             if now - s.last_ts > self.stale_after:
                 return None            # dead daemons derive nothing
-            snaps = [sn for sn in s.snaps if now - sn[0] <= window]
-        if len(snaps) < 2:
+            pts: dict = {}
+            for dq in s.tiers:
+                for b in dq:
+                    if now - b.t1 <= window:
+                        pts[b.t1] = b
+            for ts, perf, _ in s.snaps:
+                if now - ts <= window:
+                    pts[ts] = perf
+        if len(pts) < 2:
             return None
-        return snaps
+        return sorted(pts.items())
 
     def _window_pair(self, daemon: str, window: float | None,
                      now: float | None):
-        """(oldest-in-window, newest) snapshots, or None when fewer
+        """(oldest-in-window, newest) points, or None when fewer
         than two samples land inside the window."""
         snaps = self._window_snaps(daemon, window, now)
         if snaps is None:
@@ -191,11 +453,14 @@ class MetricsAggregator:
                           now: float | None = None):
         """(oldest-in-window, newest) (ts, perf_query payload) pairs
         for the per-principal views, or None — same staleness and
-        window rules as the perf snapshots."""
+        window rules as the perf snapshots.  The pq tables are already
+        bounded top-K payloads and only ever endpoint-diffed, so they
+        ride the raw ring alone (no rollup tiers)."""
         window = self.window if window is None else window
         now = time.monotonic() if now is None else now
-        with self._lock:
-            s = self._series.get(daemon)
+        shard = self._shard(daemon)
+        with shard.lock:
+            s = shard.series.get(daemon)
             if s is None or len(s.pq_snaps) < 2:
                 return None
             if now - s.last_ts > self.stale_after:
@@ -203,15 +468,20 @@ class MetricsAggregator:
             snaps = [sn for sn in s.pq_snaps if now - sn[0] <= window]
         if len(snaps) < 2:
             return None
-        return snaps[0], snaps[-1]
+        return (snaps[0][0], snaps[0][1]), (snaps[-1][0], snaps[-1][1])
 
     def perf_query_latest(self, daemon: str) -> dict:
-        with self._lock:
-            s = self._series.get(daemon)
+        shard = self._shard(daemon)
+        with shard.lock:
+            s = shard.series.get(daemon)
             return dict(s.pq_snaps[-1][1]) if s and s.pq_snaps else {}
 
     @staticmethod
-    def _lookup(perf: dict, group: str, counter: str):
+    def _lookup(perf, group: str, counter: str):
+        """Counter value at a timeline point — raw snapshot dict or
+        rollup bucket endpoint, transparently."""
+        if isinstance(perf, _Bucket):
+            return perf.get(group, counter)
         return perf.get(group, {}).get(counter)
 
     # -- derivations ---------------------------------------------------
@@ -363,6 +633,22 @@ class MetricsAggregator:
                 "read_MBps": round(rd_b / 1e6, 3),
                 "write_MBps": round(wr_b / 1e6, 3)}
 
+    def _pg_rows(self, now: float) -> dict:
+        """Newest stats row per PG across fresh reporters (a PG whose
+        primary moved may be reported by two OSDs; trust the later
+        report)."""
+        rows: dict[str, tuple] = {}
+        for shard in self._shards:
+            with shard.lock:
+                for s in shard.series.values():
+                    if now - s.last_ts > self.stale_after:
+                        continue
+                    for pg, row in s.pg_stats.items():
+                        prev = rows.get(pg)
+                        if prev is None or s.last_ts > prev[0]:
+                            rows[pg] = (s.last_ts, row)
+        return rows
+
     def pg_summary(self, now: float | None = None) -> dict:
         """Recovery-convergence view of the reported PG stats rows:
         cluster degraded/misplaced object totals plus the per-PG rows
@@ -370,15 +656,7 @@ class MetricsAggregator:
         mgr progress module's completion fractions and the
         ceph_pg_degraded/misplaced Prometheus series."""
         now = time.monotonic() if now is None else now
-        rows: dict[str, tuple] = {}
-        with self._lock:
-            for s in self._series.values():
-                if now - s.last_ts > self.stale_after:
-                    continue
-                for pg, row in s.pg_stats.items():
-                    prev = rows.get(pg)
-                    if prev is None or s.last_ts > prev[0]:
-                        rows[pg] = (s.last_ts, row)
+        rows = self._pg_rows(now)
         degraded = misplaced = 0
         pgs: dict[str, dict] = {}
         for pg, (_, row) in rows.items():
@@ -462,17 +740,7 @@ class MetricsAggregator:
         footprint x k), `raw_used` the on-device total including
         replication (x size) or EC overhead (x (k+m)/k)."""
         now = time.monotonic() if now is None else now
-        # newest row per PG across reporters (a PG whose primary moved
-        # may be reported by two OSDs; trust the later report)
-        rows: dict[str, tuple] = {}
-        with self._lock:
-            for s in self._series.values():
-                if now - s.last_ts > self.stale_after:
-                    continue
-                for pg, row in s.pg_stats.items():
-                    prev = rows.get(pg)
-                    if prev is None or s.last_ts > prev[0]:
-                        rows[pg] = (s.last_ts, row)
+        rows = self._pg_rows(now)
         pools: dict = {}
         for pg, (_, row) in rows.items():
             pool_id = row.get("pool")

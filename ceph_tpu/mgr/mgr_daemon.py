@@ -6,18 +6,85 @@ perf-counter dumps; the mgr folds them into DaemonStateIndex, keeps the
 latest osdmap via its MonClient subscription, hosts MgrModule
 instances, fans out notify() on map changes, and routes module
 commands ("mgr module command") by COMMANDS prefix.
+
+Ingest at scale (ISSUE 18): report handling no longer runs on the
+dispatch thread.  ms_dispatch enqueues each MMgrReport onto one of N
+ingest shards hashed by daemon name (the same hash the aggregator
+shards its series store by, so two shards never contend on a lock);
+each shard thread drains its queue in batches, folds deltas through
+DaemonStateIndex.ingest, records into the TSDB, and sends the
+MMgrReportAck back to the sender.  Enqueue→folded lag is tracked per
+report and feeds the l_mgr_ingest_lag_us histogram, the `ingest
+status` surface, and the MGR_INGEST_LAG health check; the aggregator's
+byte ledger feeds MGR_MEM_BUDGET_FULL.  Both checks ride to the mon
+through a "health ingest-report" command posted from a worker thread
+(never the dispatch or timer thread — the progress-journal deadlock
+rule), where the HealthMonitor applies the same carry-until-first-
+report failover semantics as POOL_SLO_VIOLATION.
 """
 
 from __future__ import annotations
 
+import queue
 import threading
+import time
+import zlib
+from collections import deque
 
 from ..common.context import Context
+from ..common.perf_counters import PerfCountersBuilder
 from ..mon.mon_client import MonClient
 from ..msg.async_messenger import create_messenger
 from ..msg.messenger import Dispatcher
 
 __all__ = ["MgrDaemon"]
+
+
+class _IngestShard(threading.Thread):
+    """One ingest lane: a locked queue drained in batches by its own
+    worker, so a flood of reports costs the dispatch thread only an
+    append."""
+
+    def __init__(self, mgr, idx: int):
+        super().__init__(name="mgr-ingest-%d" % idx, daemon=True)
+        self.mgr = mgr
+        self.idx = idx
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.queue: deque = deque()
+        self.processed = 0
+        self.stopping = False
+
+    def put(self, msg, ts: float) -> None:
+        with self.cond:
+            self.queue.append((ts, msg))
+            self.cond.notify()
+
+    def depth(self) -> int:
+        with self.lock:
+            return len(self.queue)
+
+    def stop(self) -> None:
+        with self.cond:
+            self.stopping = True
+            self.cond.notify()
+
+    def run(self) -> None:
+        while True:
+            with self.cond:
+                while not self.queue and not self.stopping:
+                    self.cond.wait(0.5)
+                if self.stopping and not self.queue:
+                    return
+                batch = list(self.queue)
+                self.queue.clear()
+            for ts, msg in batch:
+                try:
+                    self.mgr._ingest_report(msg, ts)
+                except Exception:
+                    pass     # one bad report must not kill the lane
+            with self.lock:
+                self.processed += len(batch)
 
 
 class MgrDaemon(Dispatcher):
@@ -29,15 +96,53 @@ class MgrDaemon(Dispatcher):
         self.monmap = dict(monmap)
         self.mon_client: MonClient | None = None
         from .daemon_state import DaemonStateIndex
-        from .metrics import MetricsAggregator
+        from .metrics import MetricsAggregator, parse_tiers
         stale = conf.get_val("mgr_stats_stale_after")
         self.daemon_state = DaemonStateIndex(stale_after=stale)
-        # the telemetry store: bounded per-daemon snapshot rings the
-        # rate/percentile/df derivations read (mgr/metrics.py)
+        # ingest shards: 0 = fold inline on the dispatch thread
+        self._n_shards = max(0, int(conf.get_val("mgr_ingest_shards")))
+        # the telemetry store: raw rings + downsampling rollup tiers
+        # under one hard memory budget, lock-sharded to match the
+        # ingest lanes (mgr/metrics.py)
         self.metrics = MetricsAggregator(
             history=conf.get_val("mgr_metrics_history"),
             stale_after=stale,
-            window=conf.get_val("mgr_metrics_window"))
+            window=conf.get_val("mgr_metrics_window"),
+            mem_budget=conf.get_val("mgr_metrics_mem_budget"),
+            shards=max(1, self._n_shards),
+            tiers=parse_tiers(conf.get_val("mgr_metrics_tiers")))
+        self._ingest_shards: list[_IngestShard] = []
+        # enqueue->folded lag samples for the windowed p99 the health
+        # check and `ingest status` read (the histogram counter keeps
+        # the lifetime distribution)
+        self._lag_samples: deque = deque(maxlen=4096)  # (ts, lag_s)
+        self._ingest_health = {"lagging": False, "budget_full": False}
+        self._health_q: queue.Queue = queue.Queue(maxsize=4)
+        self._health_thread: threading.Thread | None = None
+        self.perf = (
+            PerfCountersBuilder("mgr")
+            .add_u64_counter("l_mgr_ingest_reports",
+                             "MMgrReports folded")
+            .add_u64_counter("l_mgr_ingest_bytes",
+                             "approx perf payload bytes ingested")
+            .add_u64_counter("l_mgr_ingest_delta",
+                             "reports that arrived delta-encoded")
+            .add_u64_counter("l_mgr_ingest_full",
+                             "reports that arrived as full dumps")
+            .add_u64_counter("l_mgr_ingest_resyncs",
+                             "full-resync requests sent to senders")
+            .add_histogram("l_mgr_ingest_lag_us",
+                           "report enqueue->folded lag (microseconds)")
+            .add_u64("l_mgr_ingest_queue_depth",
+                     "reports waiting across the ingest shards")
+            .add_u64("l_mgr_metrics_bytes",
+                     "bytes the telemetry store accounts for")
+            .add_u64("l_mgr_metrics_budget_occupancy_pct",
+                     "tracked bytes as % of mgr_metrics_mem_budget")
+            .add_u64("l_mgr_metrics_evictions",
+                     "series dropped by budget eviction (cumulative)")
+            .create_perf_counters())
+        self.ctx.perf.add(self.perf)
         self.modules: dict[str, object] = {}
         self.health: dict[str, dict] = {}     # module -> checks
         self._lock = threading.Lock()
@@ -59,11 +164,22 @@ class MgrDaemon(Dispatcher):
         self.mon_client.sub_want()
         self.timer.init()
         self._running = True
+        for i in range(self._n_shards):
+            shard = _IngestShard(self, i)
+            self._ingest_shards.append(shard)
+            shard.start()
         self._self_report_tick()
 
     def shutdown(self) -> None:
         self._running = False
         self.timer.shutdown()
+        for shard in self._ingest_shards:
+            shard.stop()
+        if self._health_thread is not None:
+            try:
+                self._health_q.put_nowait(None)
+            except queue.Full:
+                pass
         for mod in self.modules.values():
             try:
                 mod.shutdown()
@@ -74,12 +190,15 @@ class MgrDaemon(Dispatcher):
 
     def _self_report_tick(self) -> None:
         """The mgr reports on ITSELF through the same pipeline every
-        other daemon uses (no loopback message needed), and prunes
-        long-dead series while it's at it."""
+        other daemon uses (no loopback message needed), prunes
+        long-dead series, refreshes the ingest gauges, and evaluates
+        the MGR_INGEST_LAG / MGR_MEM_BUDGET_FULL verdicts."""
         if not self._running:
             return
         period = self.ctx.conf.get_val("mgr_stats_period")
         try:
+            self._refresh_ingest_gauges()
+            self._evaluate_ingest_health()
             if period > 0:
                 self.daemon_state.report(self.name,
                                          self.ctx.perf.perf_dump(),
@@ -92,6 +211,126 @@ class MgrDaemon(Dispatcher):
         finally:
             self.timer.add_event_after(max(period, 0.5),
                                        self._self_report_tick)
+
+    # -- ingest self-observability -------------------------------------
+
+    def _refresh_ingest_gauges(self) -> None:
+        mem = self.metrics.mem_stats()
+        self.perf.set("l_mgr_ingest_queue_depth",
+                      sum(sh.depth() for sh in self._ingest_shards))
+        self.perf.set("l_mgr_metrics_bytes", mem["tracked_bytes"])
+        self.perf.set("l_mgr_metrics_budget_occupancy_pct",
+                      int(round(mem["occupancy"] * 100)))
+        self.perf.set("l_mgr_metrics_evictions", mem["evictions"])
+
+    def ingest_lag_p99(self, window: float = 10.0,
+                       now: float | None = None) -> float:
+        """p99 of the enqueue->folded lag over the recent window,
+        seconds (0.0 with no recent samples)."""
+        now = time.monotonic() if now is None else now
+        lags = sorted(lag for ts, lag in self._lag_samples
+                      if now - ts <= window)
+        if not lags:
+            return 0.0
+        return lags[min(len(lags) - 1, int(0.99 * len(lags)))]
+
+    def ingest_status(self) -> dict:
+        """The `ceph mgr ingest status` / asok payload: one document
+        proving the telemetry plane itself is observable."""
+        mem = self.metrics.mem_stats()
+        delta = self.perf.get("l_mgr_ingest_delta")
+        full = self.perf.get("l_mgr_ingest_full")
+        return {
+            "reports": self.perf.get("l_mgr_ingest_reports"),
+            "ingest_bytes": self.perf.get("l_mgr_ingest_bytes"),
+            "delta_reports": delta,
+            "full_reports": full,
+            "delta_hit_ratio": round(delta / (delta + full), 4)
+            if (delta + full) else 0.0,
+            "resyncs": self.perf.get("l_mgr_ingest_resyncs"),
+            "lag_p99_ms": round(self.ingest_lag_p99() * 1e3, 3),
+            "queue_depth": sum(sh.depth()
+                               for sh in self._ingest_shards),
+            "shards": [{"idx": sh.idx, "queue_depth": sh.depth(),
+                        "processed": sh.processed}
+                       for sh in self._ingest_shards],
+            "daemons": len(self.metrics.daemons()),
+            "mem": mem,
+            "health": dict(self._ingest_health),
+        }
+
+    def _evaluate_ingest_health(self) -> None:
+        """Raise/clear MGR_INGEST_LAG and MGR_MEM_BUDGET_FULL: set the
+        mgr-local module checks and post the verdict to the mon's
+        HealthMonitor (worker thread — a mon command would deadlock on
+        the timer/dispatch threads)."""
+        conf = self.ctx.conf
+        lag_p99 = self.ingest_lag_p99()
+        mem = self.metrics.mem_stats()
+        lagging = lag_p99 > conf.get_val("mgr_ingest_lag_warn")
+        budget_full = self.metrics.mem_budget > 0 and \
+            mem["occupancy"] >= \
+            conf.get_val("mgr_metrics_budget_full_ratio")
+        self._ingest_health = {"lagging": lagging,
+                               "budget_full": budget_full,
+                               "lag_p99_ms": round(lag_p99 * 1e3, 3),
+                               "occupancy": round(mem["occupancy"], 4)}
+        checks = {}
+        if lagging:
+            checks["MGR_INGEST_LAG"] = {
+                "severity": "warning",
+                "summary": "mgr telemetry ingest lag p99 %.0fms"
+                           % (lag_p99 * 1e3),
+                "detail": ["%d reports queued across %d shards"
+                           % (sum(sh.depth()
+                                  for sh in self._ingest_shards),
+                              max(1, len(self._ingest_shards)))]}
+        if budget_full:
+            checks["MGR_MEM_BUDGET_FULL"] = {
+                "severity": "warning",
+                "summary": "mgr metrics store at %d%% of its %d MiB "
+                           "budget" % (round(mem["occupancy"] * 100),
+                                       self.metrics.mem_budget >> 20),
+                "detail": ["%d series, %d evicted, %d squeezed"
+                           % (mem["series"], mem["evictions"],
+                              mem["trims"])]}
+        self.set_module_health("ingest", checks)
+        self._post_ingest_health(lagging, budget_full, checks)
+
+    def _post_ingest_health(self, lagging: bool, budget_full: bool,
+                            checks: dict) -> None:
+        """Queue the mon-side verdict; posted every tick (the mon only
+        proposes on change) so a fresh mgr's first healthy report
+        clears a carried raise — the carry-until-first-report
+        contract."""
+        item = {"prefix": "health ingest-report",
+                "reporter": self.name,
+                "lagging": lagging, "budget_full": budget_full,
+                "detail": [c["summary"] for c in checks.values()]}
+        try:
+            self._health_q.put_nowait(item)
+        except queue.Full:
+            return                      # poster busy; next tick wins
+        if self._health_thread is None \
+                or not self._health_thread.is_alive():
+            self._health_thread = threading.Thread(
+                target=self._health_post_loop,
+                name="mgr-ingest-health", daemon=True)
+            self._health_thread.start()
+
+    def _health_post_loop(self) -> None:
+        while self._running:
+            item = self._health_q.get()
+            if item is None:
+                return
+            mon = self.mon_client
+            if mon is None:
+                continue
+            try:
+                mon.command(item, timeout=3.0)
+            except Exception:
+                pass   # the mgr-local check already raised; the mon
+                #        copy heals on the next tick
 
     # -- admin socket (counter dump / df / osd perf / iostat) ----------
 
@@ -119,6 +358,12 @@ class MgrDaemon(Dispatcher):
             "cluster read/write ops/s and MB/s over the window")
         # per-principal attribution surfaces (mgr/perf_query.py); the
         # module registers lazily so the hooks look it up per call
+        asok.register(
+            "ingest status",
+            lambda args: self.ingest_status(),
+            "telemetry-plane self-observability: reports/s, delta hit "
+            "ratio, resyncs, ingest lag p99, shard queues, memory "
+            "budget occupancy")
         asok.register(
             "iotop",
             lambda args: self._perf_query_asok(
@@ -240,20 +485,17 @@ class MgrDaemon(Dispatcher):
 
     def ms_dispatch(self, msg) -> bool:
         if msg.get_type() == "MMgrReport":
-            self.daemon_state.report(msg.daemon_name, msg.perf,
-                                     msg.metadata)
-            # the telemetry store keeps the timestamped history the
-            # derived rates/percentiles and df accounting read
-            self.metrics.record(
-                msg.daemon_name, msg.perf,
-                status=getattr(msg, "status", None) or None,
-                pg_stats=getattr(msg, "pg_stats", None),
-                schema=getattr(msg, "perf_schema", None) or None,
-                daemon_type=getattr(msg, "daemon_type", ""),
-                perf_query=(getattr(msg, "perf_query", None)
-                            if getattr(msg, "daemon_type", "") == "osd"
-                            else None))
-            self._notify_all("perf_schema", msg.daemon_name)
+            now = time.monotonic()
+            if self._ingest_shards:
+                # hashed onto the shard whose aggregator lock it will
+                # take — reports for one daemon stay ordered, reports
+                # for different daemons never contend
+                shard = self._ingest_shards[
+                    zlib.crc32(msg.daemon_name.encode())
+                    % len(self._ingest_shards)]
+                shard.put(msg, now)
+            else:
+                self._ingest_report(msg, now)
             return True
         if msg.get_type() == "MOSDPerfQueryReply":
             mod = self.modules.get("perf_query")
@@ -264,6 +506,56 @@ class MgrDaemon(Dispatcher):
                     pass
             return True
         return False
+
+    def _ingest_report(self, msg, enq_ts: float) -> None:
+        """Fold one MMgrReport (ingest shard thread — or inline when
+        mgr_ingest_shards=0): delta protocol through DaemonStateIndex,
+        TSDB record, module fan-out, and the ack back to the sender."""
+        from ..common.telemetry import approx_perf_bytes
+        seq = getattr(msg, "report_seq", 0) or 0
+        schema = getattr(msg, "perf_schema", None) or None
+        perf, resync, kind = self.daemon_state.ingest(
+            msg.daemon_name, msg.perf, msg.metadata, seq=seq,
+            incarnation=getattr(msg, "incarnation", "") or "",
+            schema_hash=getattr(msg, "schema_hash", "") or "",
+            delta_base=getattr(msg, "delta_base", -1),
+            has_schema=bool(schema))
+        self.perf.inc("l_mgr_ingest_reports")
+        self.perf.inc("l_mgr_ingest_bytes",
+                      approx_perf_bytes(msg.perf))
+        if kind == "delta":
+            self.perf.inc("l_mgr_ingest_delta")
+        elif kind in ("full", "legacy"):
+            self.perf.inc("l_mgr_ingest_full")
+        if resync:
+            self.perf.inc("l_mgr_ingest_resyncs")
+        if perf is not None:
+            # the telemetry store keeps the timestamped history the
+            # derived rates/percentiles and df accounting read
+            self.metrics.record(
+                msg.daemon_name, perf,
+                status=getattr(msg, "status", None) or None,
+                pg_stats=getattr(msg, "pg_stats", None),
+                schema=schema,
+                daemon_type=getattr(msg, "daemon_type", ""),
+                perf_query=(getattr(msg, "perf_query", None)
+                            if getattr(msg, "daemon_type", "") == "osd"
+                            else None))
+            self._notify_all("perf_schema", msg.daemon_name)
+        lag = time.monotonic() - enq_ts
+        self._lag_samples.append((enq_ts + lag, lag))
+        self.perf.hinc("l_mgr_ingest_lag_us", int(lag * 1e6))
+        # ack every protocol report (seq>0) so the sender can promote
+        # its delta base; legacy senders never look for one
+        if seq > 0 and msg.from_addr is not None:
+            from ..msg.message import MMgrReportAck
+            try:
+                self.msgr.send_message(
+                    MMgrReportAck(daemon_name=msg.daemon_name,
+                                  ack_seq=seq, resync=resync),
+                    msg.from_addr)
+            except Exception:
+                pass     # lost ack = sender keeps a wider delta base
 
     def _on_osdmap(self, newmap) -> None:
         self.osdmap = newmap

@@ -41,6 +41,11 @@ class PrometheusModule(MgrModule):
         self.name = "prometheus"
         self._httpd = None
         self._thread = None
+        # cumulative per-metric drop counters: series past the cap are
+        # folded into an {overflow="true"} bucket instead of growing
+        # the page, and the drops surface as
+        # ceph_mgr_series_dropped_total{metric=...}
+        self._dropped: dict[str, int] = {}
 
     # -- rendering -----------------------------------------------------
 
@@ -52,6 +57,16 @@ class PrometheusModule(MgrModule):
         # old per-emit interleaving scattered same-name series across
         # the per-daemon loop
         groups: dict[str, dict] = {}
+        # bounded cardinality (ISSUE 18): at most mgr_prom_series_cap
+        # labeled samples per metric name — a runaway label source
+        # (thousands of daemons, hostile pgids) can no longer grow the
+        # page without bound.  Overflowed values sum into one explicit
+        # {overflow="true"} series so totals stay conserved.
+        try:
+            cap = int(self.mgr.ctx.conf.get_val("mgr_prom_series_cap"))
+        except Exception:
+            cap = 2000
+        overflow: dict[str, float] = {}
 
         def emit(name: str, value, labels: dict | None = None,
                  mtype: str = "gauge", help_: str = ""):
@@ -61,6 +76,10 @@ class PrometheusModule(MgrModule):
                                     "samples": []}
             elif help_ and not g["help"]:
                 g["help"] = help_
+            if cap > 0 and len(g["samples"]) >= cap:
+                overflow[name] = overflow.get(name, 0.0) + float(value)
+                self._dropped[name] = self._dropped.get(name, 0) + 1
+                return
             lbl = ""
             if labels:
                 lbl = "{%s}" % ",".join(
@@ -431,6 +450,64 @@ class PrometheusModule(MgrModule):
                     elif isinstance(val, (int, float)):
                         emit(_metric_name("ceph", dtype, group, cname),
                              val, {"ceph_daemon": daemon})
+        # mgr self-observability lanes (ISSUE 18): the ingest plane's
+        # own health — report/byte/delta/resync totals, folded lag,
+        # TSDB memory accounting — so the telemetry pipeline watching
+        # the cluster is itself watchable
+        ing = getattr(self.mgr, "ingest_status", None)
+        if ing is not None:
+            try:
+                st = ing()
+            except Exception:
+                st = None
+            if st:
+                emit("ceph_mgr_ingest_reports_total", st["reports"],
+                     mtype="counter",
+                     help_="MMgrReports folded by the ingest shards")
+                emit("ceph_mgr_ingest_bytes_total",
+                     st["ingest_bytes"], mtype="counter")
+                emit("ceph_mgr_ingest_delta_reports_total",
+                     st["delta_reports"], mtype="counter")
+                emit("ceph_mgr_ingest_full_reports_total",
+                     st["full_reports"], mtype="counter")
+                emit("ceph_mgr_ingest_resyncs_total", st["resyncs"],
+                     mtype="counter")
+                emit("ceph_mgr_ingest_lag_seconds",
+                     st["lag_p99_ms"] / 1e3,
+                     help_="p99 enqueue-to-folded ingest lag")
+                for row in st.get("shards") or []:
+                    emit("ceph_mgr_ingest_queue_depth",
+                         row["queue_depth"],
+                         {"shard": row["idx"]})
+                mem = st.get("mem") or {}
+                emit("ceph_mgr_metrics_tracked_bytes",
+                     mem.get("tracked_bytes", 0),
+                     help_="TSDB bytes currently accounted against "
+                           "mgr_metrics_mem_budget")
+                emit("ceph_mgr_metrics_budget_bytes",
+                     mem.get("budget", 0))
+                emit("ceph_mgr_metrics_occupancy_ratio",
+                     mem.get("occupancy", 0.0))
+                emit("ceph_mgr_metrics_evictions_total",
+                     mem.get("evictions", 0), mtype="counter")
+        # capped metrics: one conserving overflow series per name,
+        # plus the cumulative drop counters (emitted last so the drop
+        # lane itself can never overflow anything)
+        for name in sorted(overflow):
+            g = groups[name]
+            g["samples"].append('%s{overflow="true"} %s'
+                                % (name, overflow[name]))
+        if self._dropped:
+            g = groups["ceph_mgr_series_dropped_total"] = {
+                "type": "counter",
+                "help": "samples folded into a metric's overflow "
+                        "bucket because its series cap was hit",
+                "samples": []}
+            for name in sorted(self._dropped):
+                g["samples"].append(
+                    'ceph_mgr_series_dropped_total{metric="%s"} %s'
+                    % (_escape_label(name),
+                       float(self._dropped[name])))
         out: list[str] = []
         for name, g in groups.items():
             out.append("# HELP %s %s"

@@ -81,6 +81,10 @@ class HealthMonitor:
         # first report reaches THIS mon — a fresh leader carries the
         # committed POOL_SLO_VIOLATION until the mgr re-reports
         self._slo_report: dict | None = None
+        # latest mgr telemetry-plane verdict ("health ingest-report",
+        # posted every mgr self-report tick); same carry-until-first-
+        # report failover rule as the SLO verdict
+        self._ingest_report: dict | None = None
         self._stats_gen = 0
         self._seen_epoch = -1
         self._seen_gen = -1
@@ -435,6 +439,33 @@ class HealthMonitor:
             elif "POOL_SLO_VIOLATION" in eff["checks"]:
                 checks["POOL_SLO_VIOLATION"] = \
                     eff["checks"]["POOL_SLO_VIOLATION"]
+            # MGR_INGEST_LAG / MGR_MEM_BUDGET_FULL from the mgr's
+            # telemetry self-observability (mgr_daemon posts "health
+            # ingest-report" every self-report tick, so a restarted
+            # mgr's first healthy post clears a carried raise); until
+            # that first post this mon carries the committed verdicts
+            # — a mon failover must not silently clear a live alarm
+            if self._ingest_report is not None:
+                detail = list(self._ingest_report.get("detail", []))
+                if self._ingest_report.get("lagging"):
+                    checks["MGR_INGEST_LAG"] = {
+                        "severity": "warning",
+                        "summary": "mgr '%s' telemetry ingest is "
+                                   "lagging" % self._ingest_report.get(
+                                       "reporter", "?"),
+                        "detail": detail}
+                if self._ingest_report.get("budget_full"):
+                    checks["MGR_MEM_BUDGET_FULL"] = {
+                        "severity": "warning",
+                        "summary": "mgr '%s' metrics store is at its "
+                                   "memory budget"
+                                   % self._ingest_report.get(
+                                       "reporter", "?"),
+                        "detail": detail}
+            else:
+                for name in ("MGR_INGEST_LAG", "MGR_MEM_BUDGET_FULL"):
+                    if name in eff["checks"]:
+                        checks[name] = eff["checks"][name]
             if checks == eff["checks"] and scrub == eff["scrub_errors"]:
                 return
             self.pending = {"checks": checks, "scrub_errors": scrub}
@@ -451,6 +482,15 @@ class HealthMonitor:
 
     def handle_command(self, cmd: dict):
         prefix = cmd.get("prefix", "")
+        if prefix == "health ingest-report":
+            with self._lock:
+                self._ingest_report = {
+                    "reporter": cmd.get("reporter", ""),
+                    "lagging": bool(cmd.get("lagging")),
+                    "budget_full": bool(cmd.get("budget_full")),
+                    "detail": list(cmd.get("detail", []) or [])}
+            self.recompute()
+            return 0, "", {"ack": True}
         if prefix == "health slo-report":
             with self._lock:
                 self._slo_report = {

@@ -98,6 +98,9 @@ class Monitor(Dispatcher):
         self.ctx.perf.add(self.perf)
         self.mgr_addr = None
         self._last_mgr_report = 0.0
+        # delta-encoded telemetry stream (common/telemetry.py)
+        from ..common.telemetry import DeltaReporter
+        self._mgr_reporter = DeltaReporter()
         # mon-internal shared secret: attests peon->leader forwarded
         # commands (the reference signs MForward the same way)
         self._mon_secret = (service_secrets or {}).get("mon")
@@ -168,13 +171,19 @@ class Monitor(Dispatcher):
         self._last_mgr_report = now
         self.perf.set("quorum_size", len(self.quorum))
         from ..msg.message import MMgrReport
+        rep = self._mgr_reporter.prepare(self.ctx.perf.perf_dump(),
+                                         self.ctx.perf.perf_schema())
         self.msgr.send_message(
             MMgrReport(daemon_name="mon.%d" % self.rank,
                        daemon_type="mon",
-                       perf=self.ctx.perf.perf_dump(),
+                       perf=rep["perf"],
                        metadata={"rank": self.rank,
                                  "state": self.state},
-                       perf_schema=self.ctx.perf.perf_schema()),
+                       perf_schema=rep["schema"],
+                       report_seq=rep["seq"],
+                       incarnation=rep["incarnation"],
+                       schema_hash=rep["schema_hash"],
+                       delta_base=rep["delta_base"]),
             self.mgr_addr)
 
     # -- roles ---------------------------------------------------------
@@ -363,6 +372,9 @@ class Monitor(Dispatcher):
             if self._forward_if_peon(msg):
                 return True
             self.healthmon.handle_pg_stats(msg)
+            return True
+        if t == "MMgrReportAck":
+            self._mgr_reporter.ack(msg.ack_seq, resync=msg.resync)
             return True
         if t == "MMonSubscribe":
             self._subscribe_addr(msg.reply_to or msg.from_addr,

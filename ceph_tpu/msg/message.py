@@ -31,6 +31,7 @@ __all__ = [
     "MOSDAlive", "MWatchNotify", "MWatchNotifyAck",
     "MMonCommand", "MMonCommandReply", "MMonSubscribe", "MMonPaxos",
     "MMonElection", "MAuth", "MAuthReply", "MMgrReport",
+    "MMgrReportAck",
     "MMDSBeacon", "MMDSMap", "MClientRequest", "MClientReply",
     "MAuthMap", "MLog", "MPGStats", "MBackfillReserve",
     "MOSDPerfQuery", "MOSDPerfQueryReply",
@@ -491,6 +492,35 @@ class MMgrReport(Message):
     # compatible-evolution pattern): query_id -> dumped key table from
     # the OSD's PerfQueryEngine; {} when no queries are subscribed
     perf_query: dict = field(default_factory=dict)
+    # delta-report protocol (appended fields, compatible evolution —
+    # the defaults spell exactly the legacy "full report, no protocol"
+    # shape so old senders keep ingesting unchanged):
+    #   report_seq   sender's per-incarnation report counter (0 = the
+    #                legacy path: full perf every period, no acks)
+    #   incarnation  distinguishes a restarted daemon reusing a name
+    #   schema_hash  hash of the sender's perf schema so the mgr can
+    #                detect staleness without the schema payload
+    #   delta_base   acked seq this report's perf is a delta against;
+    #                -1 = perf is a full dump
+    report_seq: int = 0
+    incarnation: str = ""
+    schema_hash: str = ""
+    delta_base: int = -1
+
+
+@dataclass
+class MMgrReportAck(Message):
+    """mgr -> daemon acknowledgment of an MMgrReport (the delta
+    protocol's return leg): promotes the acked snapshot to the
+    sender's delta base, or — resync=True — asks for a full report +
+    schema next period (first contact, seq gap, schema mismatch).
+
+    The field is `ack_seq`, not `seq`: the Message base stamps a
+    transport-level `seq` on every instance in __post_init__, which
+    would silently overwrite a payload field of the same name."""
+    daemon_name: str = ""
+    ack_seq: int = 0
+    resync: bool = False
 
 
 @dataclass

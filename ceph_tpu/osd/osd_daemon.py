@@ -308,6 +308,10 @@ class OSDDaemon(Dispatcher):
         self._tier_pool = None
         self._tier_client = None
         self.mgr_addr = None           # set when an mgr joins the cluster
+        # delta-encoded mgr telemetry: ship only changed counters once
+        # the mgr acks a full baseline (common/telemetry.py)
+        from ..common.telemetry import DeltaReporter
+        self._mgr_reporter = DeltaReporter()
         self._boot_sent_epoch = -1     # epoch of the last MOSDBoot sent
         self._boot_sent_at = 0.0       # for boot retransmit rate-limit
         # l_osd_* counters (OSD.cc's PerfCounters), streamed to the mgr
@@ -763,10 +767,11 @@ class OSDDaemon(Dispatcher):
         """The mgr telemetry stream (DaemonServer's MMgrReport role)
         on its OWN cadence — mgr_stats_period, decoupled from the
         heartbeat so operators can tune (or pin off, period=0) the
-        report volume without touching failure detection.  Each report
-        carries the full perf dump + schema, the store statfs and
-        device-utilization gauges, and the primary-PG stat rows the
-        mgr's `ceph df` accounting folds."""
+        report volume without touching failure detection.  Reports are
+        delta-encoded (ISSUE 18): after the mgr acks a full baseline
+        only changed counters travel, and the schema rides only on the
+        first report / hash change; status, pg stats and perf-query
+        values still ship whole each period."""
         if not self._running:
             return
         period = self.ctx.conf.get_val("mgr_stats_period")
@@ -777,17 +782,24 @@ class OSDDaemon(Dispatcher):
         try:
             if self.mgr_addr is not None:
                 from ..msg.message import MMgrReport
+                rep = self._mgr_reporter.prepare(
+                    self.ctx.perf.perf_dump(),
+                    self.ctx.perf.perf_schema())
                 self.public_msgr.send_message(
                     MMgrReport(daemon_name="osd.%d" % self.whoami,
                                daemon_type="osd",
-                               perf=self.ctx.perf.perf_dump(),
+                               perf=rep["perf"],
                                metadata={"id": self.whoami},
                                status=self._telemetry_status(),
                                pg_stats=self._collect_pg_stats(),
-                               perf_schema=self.ctx.perf.perf_schema(),
+                               perf_schema=rep["schema"],
                                perf_query=(self.perf_query.dump()
                                            if self.perf_query.active
-                                           else {})),
+                                           else {}),
+                               report_seq=rep["seq"],
+                               incarnation=rep["incarnation"],
+                               schema_hash=rep["schema_hash"],
+                               delta_base=rep["delta_base"]),
                     self.mgr_addr)
         finally:
             # a failed report must never kill the tick chain — the
@@ -1030,6 +1042,9 @@ class OSDDaemon(Dispatcher):
             return True
         if t == "MOSDPerfQuery":
             self._handle_perf_query(msg)
+            return True
+        if t == "MMgrReportAck":
+            self._mgr_reporter.ack(msg.ack_seq, resync=msg.resync)
             return True
         if t in ("MOSDECSubOpWrite", "MOSDECSubOpWriteReply",
                  "MOSDECSubOpRead", "MOSDECSubOpReadReply",

@@ -442,15 +442,38 @@ class RGWServer:
                 if getattr(client, "ctx", None) is not None else 0.5
         if period <= 0:
             return
+        from ..common.telemetry import DeltaReporter
+        reporter = DeltaReporter()
+
+        class _AckDispatcher:
+            # acks arrive on the borrowed client messenger; everything
+            # else falls through to the rados client's own dispatcher
+            def ms_dispatch(self, msg) -> bool:
+                if msg.get_type() == "MMgrReportAck" \
+                        and msg.daemon_name == name:
+                    reporter.ack(msg.ack_seq, resync=msg.resync)
+                    return True
+                return False
+
+        try:
+            client.msgr.add_dispatcher_head(_AckDispatcher())
+        except Exception:
+            pass                     # no acks = full reports, still fine
 
         def tick():
             from ..msg.message import MMgrReport
             try:
+                rep = reporter.prepare({"rgw": self.perf.dump()},
+                                       {"rgw": self.perf.schema()})
                 client.msgr.send_message(
                     MMgrReport(daemon_name=name, daemon_type="rgw",
-                               perf={"rgw": self.perf.dump()},
+                               perf=rep["perf"],
                                metadata={"addr": str(self.addr)},
-                               perf_schema={"rgw": self.perf.schema()}),
+                               perf_schema=rep["schema"],
+                               report_seq=rep["seq"],
+                               incarnation=rep["incarnation"],
+                               schema_hash=rep["schema_hash"],
+                               delta_base=rep["delta_base"]),
                     mgr_addr)
             except Exception:
                 return               # messenger gone: stop reporting

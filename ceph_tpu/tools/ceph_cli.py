@@ -301,6 +301,24 @@ def slo_status_command(asoks: list[str]) -> int:
         else 1
 
 
+def mgr_ingest_status_command(asoks: list[str]) -> int:
+    """`ceph mgr ingest status --asok MGR`: the telemetry ingest
+    plane's own health — report/delta/resync totals, p99 enqueue-to-
+    folded lag, per-shard queue depths, TSDB memory budget occupancy
+    and eviction counts."""
+    client = _mgr_asok(asoks, "mgr ingest status")
+    if client is None:
+        return 1
+    try:
+        reply = client.do_request("ingest status")
+    except (OSError, ValueError) as e:
+        sys.stderr.write("ceph mgr ingest status: %s\n" % e)
+        return 1
+    sys.stdout.write(json.dumps(reply, indent=1, default=str) + "\n")
+    return 0 if not (isinstance(reply, dict) and "error" in reply) \
+        else 1
+
+
 def daemon_command(words: list[str]) -> int:
     """`ceph daemon <asok-path> <command...>`: talk straight to one
     daemon's unix admin socket (perf dump, dump_ops_in_flight,
@@ -357,6 +375,7 @@ def main(argv=None) -> int:
                         "iotop --asok MGR [--period N --count M] | "
                         "osd perf query add|rm|ls --asok MGR | "
                         "slo status --asok MGR | "
+                        "mgr ingest status --asok MGR | "
                         "daemon ASOK CMD... | "
                         "trace tree TRACE_ID --asok PATH...")
     p.add_argument("--period", type=float, default=1.0,
@@ -395,6 +414,8 @@ def main(argv=None) -> int:
         return iotop_command(args.asok or [], args.period, args.count)
     if args.words == ["slo", "status"]:
         return slo_status_command(args.asok or [])
+    if args.words == ["mgr", "ingest", "status"]:
+        return mgr_ingest_status_command(args.asok or [])
     client = connect(args)
     try:
         w = args.words

