@@ -2892,11 +2892,427 @@ def run_scaleobs(out_path: str | None = None) -> dict:
     return doc
 
 
+def run_mapthrash(out_path: str | None = None) -> dict:
+    """Map-churn survival artifact (ROADMAP direction I, map-plane
+    leg): three hard-gated legs published into MAPTHRASH_r01.json.
+
+      1. Huge-map balance: a 1000-OSD / 131072-PG map (250 hosts)
+         balanced by the changes_per_sweep-batched calc_pg_upmaps
+         within a bounded sweep count, CRUSH failure-domain
+         separation validated on sampled remapped PGs, and a sampled
+         mesh_do_rule pass gated bit-identical to the compiled host
+         mapper rows on the SAME balanced map (the bulk sweeps run
+         the native backend — the honest comparator on a CPU-only
+         host, cf. the CRUSH row in run_bench; on real hardware the
+         full-width mesh sweep is interchangeable by this gate).
+      2. Catch-up wire accounting: a live mon driven through 500
+         committed epochs (mon_min_osdmap_epochs=450). A subscriber
+         snapshotted 400 epochs back catches up through batched
+         MOSDMap frames (each <= osd_map_message_max incrementals,
+         frame count <= ceil(behind/40)+1, total inc bytes <= 0.25x
+         what re-sending a full map per epoch would cost, final map
+         bit-equal). The epoch-0-era snapshot is BELOW the trim
+         floor: it must receive exactly ONE full-map frame.
+      3. Churn under live traffic: out/in storms, reweight sweeps,
+         and a pool resize against a 6-OSD cluster while a foreground
+         writer measures per-write latency. Gates: HEALTH_OK after
+         heal (time recorded), every mgr progress event monotone and
+         none left active, per-OSD peering p99 under bound, and
+         client p99-under-churn <= a fixed multiple of the quiet p99
+         measured in the same run.
+
+    Any gate failure raises SystemExit (rc != 0)."""
+    import random as _random
+    import threading
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_util import MiniCluster, wait_until
+
+    from ceph_tpu import encoding
+    from ceph_tpu.crush.batched import mesh_do_rule
+    from ceph_tpu.mgr.progress import ProgressModule
+    from ceph_tpu.native import crush_do_rule_batch_native
+    from ceph_tpu.osd.balancer import (calc_pg_upmaps,
+                                       eval_distribution,
+                                       parent_index, parent_of_type,
+                                       rule_failure_domain)
+    from ceph_tpu.osd.osd_map import CRUSH_ITEM_NONE, PGID, Incremental
+    from ceph_tpu.tools import osdmaptool
+
+    doc: dict = {"metric": "mapthrash_churn_p99_write_s", "unit": "s"}
+
+    # -- leg 1: 1000-OSD / 131072-PG balance ---------------------------
+
+    N_OSDS, N_PGS, N_HOSTS = 1000, 131072, 250
+    MAX_SWEEPS = 48
+    WORST_RATIO_GATE = 0.15
+    t0 = time.monotonic()
+    m = osdmaptool.create_simple(N_OSDS, pg_num=N_PGS, pool_size=3,
+                                 hosts=N_HOSTS)
+    before = eval_distribution(m, use_native=True)
+    res = calc_pg_upmaps(m, max_deviation_ratio=0.1,
+                         max_changes=20000, use_native=True,
+                         changes_per_sweep=512)
+    if res.sweeps > MAX_SWEEPS:
+        raise SystemExit("mapthrash gate: balancer needed %d sweeps "
+                         "(cap %d)" % (res.sweeps, MAX_SWEEPS))
+    inc = Incremental(m.epoch + 1)
+    res.apply_to(inc)
+    m.apply_incremental(inc)
+    after = eval_distribution(m, use_native=True)
+    if after.total_deviation > before.total_deviation:
+        raise SystemExit("mapthrash gate: balance made deviation "
+                         "WORSE (%.0f -> %.0f)"
+                         % (before.total_deviation,
+                            after.total_deviation))
+    worst = max(abs(after.deviation(o)) / t
+                for o, t in after.targets.items() if t > 0)
+    if worst > WORST_RATIO_GATE:
+        raise SystemExit("mapthrash gate: worst per-OSD deviation "
+                         "ratio %.3f after balance (gate %.2f)"
+                         % (worst, WORST_RATIO_GATE))
+    # CRUSH-constraint validation over sampled remapped PGs: no
+    # repeated OSD, no repeated failure domain
+    rng = _random.Random(7)
+    fd = rule_failure_domain(m.crush, 0)
+    pindex = parent_index(m.crush)
+    for pgid in rng.sample(sorted(m.pg_upmap_items, key=str),
+                           min(300, len(m.pg_upmap_items))):
+        up, _, _, _ = m.pg_to_up_acting_osds(pgid)
+        osds = [o for o in up if o != CRUSH_ITEM_NONE]
+        parents = [parent_of_type(m.crush, o, fd, pindex)
+                   for o in osds]
+        if len(set(osds)) != len(osds) or \
+                len(set(parents)) != len(parents):
+            raise SystemExit("mapthrash gate: upmap violated CRUSH "
+                             "constraints at %s: up=%s" % (pgid, up))
+    # sampled mesh-sweep parity on the balanced map
+    pool = m.pools[0]
+    sample_ps = rng.sample(range(pool.pg_num), 256)
+    seeds = np.array([pool.raw_pg_to_pps(PGID(0, ps))
+                      for ps in sample_ps], dtype=np.int64)
+    w = m._weight_vector()
+    mesh_rows = mesh_do_rule(m.crush, pool.crush_rule, seeds,
+                             pool.size, w, choose_args=0)
+    nat_rows = crush_do_rule_batch_native(m.crush, pool.crush_rule,
+                                          seeds, pool.size, w,
+                                          choose_args=0)
+    for i in range(len(seeds)):
+        dev_row = [int(v) for v in mesh_rows[i]
+                   if int(v) != CRUSH_ITEM_NONE]
+        if dev_row != nat_rows[i]:
+            raise SystemExit("mapthrash gate: mesh sweep != native "
+                             "mapper at seed %d" % int(seeds[i]))
+    doc["balance"] = {
+        "osds": N_OSDS, "pgs": N_PGS, "hosts": N_HOSTS,
+        "sweeps": res.sweeps, "num_changed": res.num_changed,
+        "start_deviation": round(before.total_deviation, 1),
+        "end_deviation": round(after.total_deviation, 1),
+        "worst_ratio": round(worst, 4),
+        "mesh_parity_seeds": len(seeds),
+        "elapsed_s": round(time.monotonic() - t0, 1)}
+    del m
+
+    # -- leg 2: 500-epoch catch-up wire accounting ---------------------
+
+    FAST = {"osd_tracing": False, "osd_profiler": False,
+            "osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+            "mon_osd_down_out_interval": 1.0,
+            "paxos_propose_interval": 0.02}
+    EPOCHS, FLOOR, BEHIND = 500, 450, 400
+    conf = dict(FAST)
+    conf["mon_min_osdmap_epochs"] = FLOOR
+    c = MiniCluster(num_mons=1, num_osds=3, conf_overrides=conf)
+    c.start()
+    try:
+        client = c.client()
+        mon = c.leader()
+        msg_max = c.osds[0].ctx.conf.get_val("osd_map_message_max")
+        deep = c.osdmap_epoch() - 1
+        stale_full = encoding.decode_any(
+            encoding.encode_any(mon.osdmon.osdmap))
+        stale_inc = None
+        rweights = _random.Random(11)
+        osd_ids = sorted(c.osds)
+        i = 0
+        while c.osdmap_epoch() < deep + 1 + EPOCHS:
+            # capture the target BEFORE the command: with a fast
+            # paxos_propose_interval the pend can commit before the
+            # command even returns, and an epoch read afterwards
+            # would name one that is never coming
+            want = c.osdmap_epoch() + 1
+            res_c, outs, _ = client.mon_command(
+                {"prefix": "osd reweight",
+                 "id": osd_ids[i % len(osd_ids)],
+                 "weight": rweights.uniform(0.7, 0.99)})
+            if res_c != 0:
+                raise SystemExit("mapthrash: churn reweight failed: "
+                                 "%s" % outs)
+            if not wait_until(lambda: c.osdmap_epoch() >= want,
+                              timeout=30):
+                raise SystemExit("mapthrash: churn epoch %d never "
+                                 "committed" % want)
+            i += 1
+            if stale_inc is None and \
+                    c.osdmap_epoch() >= deep + 1 + EPOCHS - BEHIND:
+                stale_inc = encoding.decode_any(
+                    encoding.encode_any(mon.osdmon.osdmap))
+        cur = mon.osdmon.osdmap.epoch
+        full_size = len(encoding.encode_any(mon.osdmon.osdmap))
+        behind = cur - stale_inc.epoch
+        # batched-inc catch-up for the subscriber above the floor
+        frames, inc_bytes = 0, 0
+        while True:
+            msg = mon.osdmon.build_map_message(stale_inc.epoch)
+            if msg is None:
+                break
+            frames += 1
+            if msg.full_map is not None:
+                raise SystemExit("mapthrash gate: %d-epoch-behind "
+                                 "subscriber (above floor) got a "
+                                 "full map" % behind)
+            if not 1 <= len(msg.incrementals) <= msg_max:
+                raise SystemExit("mapthrash gate: frame carries %d "
+                                 "incs (max %d)"
+                                 % (len(msg.incrementals), msg_max))
+            for finc in msg.incrementals:
+                inc_bytes += len(encoding.encode_any(finc))
+                stale_inc.apply_incremental(finc)
+            if frames > behind:
+                raise SystemExit("mapthrash: catch-up never "
+                                 "terminated")
+        frame_cap = -(-behind // msg_max) + 1
+        if frames > frame_cap:
+            raise SystemExit("mapthrash gate: %d catch-up frames for "
+                             "%d epochs behind (cap %d)"
+                             % (frames, behind, frame_cap))
+        naive_bytes = behind * full_size
+        if inc_bytes > 0.25 * naive_bytes:
+            raise SystemExit("mapthrash gate: batched incs cost %d B "
+                             "vs %d B naive full-map resend (gate "
+                             "0.25x)" % (inc_bytes, naive_bytes))
+        if encoding.encode_any(stale_inc) != \
+                encoding.encode_any(mon.osdmon.osdmap):
+            raise SystemExit("mapthrash gate: inc catch-up map not "
+                             "bit-equal to the mon's")
+        # trim-floor fallback for the 500-epoch-behind snapshot
+        if mon.osdmon.first_committed() <= stale_full.epoch + 1:
+            raise SystemExit("mapthrash: ring never trimmed past the "
+                             "deep snapshot")
+        msg = mon.osdmon.build_map_message(stale_full.epoch)
+        if msg is None or msg.full_map is None or msg.incrementals:
+            raise SystemExit("mapthrash gate: below-floor subscriber "
+                             "did not get exactly one full map")
+        caught = encoding.decode_any(msg.full_map)
+        if encoding.encode_any(caught) != \
+                encoding.encode_any(mon.osdmon.osdmap):
+            raise SystemExit("mapthrash gate: trim-floor full map "
+                             "not bit-equal to the mon's")
+        ring = mon.osdmon.osdmap_status()
+        doc["catchup"] = {
+            "epochs_churned": EPOCHS, "trim_floor_conf": FLOOR,
+            "behind": behind, "frames": frames,
+            "frame_cap": frame_cap, "inc_bytes": inc_bytes,
+            "full_map_bytes": full_size,
+            "naive_full_resend_bytes": naive_bytes,
+            "wire_ratio": round(inc_bytes / naive_bytes, 4),
+            "below_floor_behind": cur - stale_full.epoch,
+            "below_floor_frames": 1,
+            "mon_ring": {k: ring[k] for k in
+                         ("epoch", "trim_floor", "ring_epochs",
+                          "ring_bytes")}}
+    finally:
+        c.stop()
+
+    # -- leg 3: map churn under live traffic ---------------------------
+
+    CHURN_P99_MULT = 32.0
+    PEERING_P99_GATE_S = 5.0
+    conf = dict(FAST)
+    conf["mgr_stats_period"] = 0.25
+    c = MiniCluster(num_mons=1, num_osds=6, conf_overrides=conf)
+    c.start()
+    stop_load = threading.Event()
+    payload = np.random.default_rng(5).integers(
+        0, 256, size=1 << 13, dtype=np.uint8).tobytes()   # 8 KiB
+    quiet_lat: list = []
+    churn_lat: list = []
+    lat_sink = [quiet_lat]
+    try:
+        mgr = c.start_mgr(modules=(ProgressModule,))
+        progress = mgr.modules["progress"]
+        client = c.client()
+        pool_id = c.create_replicated_pool(client, "churnio", size=3,
+                                           pg_num=16)
+        c.create_replicated_pool(client, "churnmeta", size=2,
+                                 pg_num=8)
+        if not c.wait_clean(pool_id):
+            raise SystemExit("mapthrash: io pool never went clean")
+        ioctx = client.open_ioctx("churnio")
+
+        def writer():
+            i = 0
+            while not stop_load.is_set():
+                t0 = time.monotonic()
+                try:
+                    ioctx.write_full("w%d" % (i % 64), payload,
+                                     timeout=30.0)
+                    lat_sink[0].append(time.monotonic() - t0)
+                except Exception:
+                    pass
+                i += 1
+                stop_load.wait(0.02)
+        load = threading.Thread(target=writer, name="mapthrash-load",
+                                daemon=True)
+        load.start()
+        time.sleep(6.0)                      # quiet baseline
+        lat_sink[0] = churn_lat
+
+        from tests.thrasher import Thrasher
+        th = Thrasher(c, seed=0x13, min_in=4, interval=0.4,
+                      churn_pool="churnmeta")
+        t_churn = time.monotonic()
+        # riders coalesce: back-to-back mon commands merge into one
+        # paxos proposal (on a starved box ALL of them can), so wait
+        # for a commit between riders instead of demanding a fixed
+        # total afterwards
+        e0 = c.osdmap_epoch()
+        th.out_in_storm(count=2)
+        if not wait_until(lambda: c.osdmap_epoch() >= e0 + 1,
+                          timeout=30):
+            raise SystemExit("mapthrash gate: out/in storm drove no "
+                             "epoch")
+        e1 = c.osdmap_epoch()
+        th.reweight_sweep(count=3)
+        if not wait_until(lambda: c.osdmap_epoch() >= e1 + 1,
+                          timeout=30):
+            raise SystemExit("mapthrash gate: reweight sweep drove "
+                             "no epoch")
+        e2 = c.osdmap_epoch()
+        if th.pool_resize(grow_by=8) is None:
+            raise SystemExit("mapthrash: pool resize rider failed")
+        if not wait_until(lambda: c.osdmap_epoch() >= e2 + 1,
+                          timeout=30):
+            raise SystemExit("mapthrash gate: pool resize drove no "
+                             "epoch")
+        th.out_in_storm(count=2)
+        churn_s = time.monotonic() - t_churn
+        if c.osdmap_epoch() < e0 + 3:
+            raise SystemExit("mapthrash gate: riders drove only %d "
+                             "epochs" % (c.osdmap_epoch() - e0))
+        th.stop_and_heal(timeout=90)
+        if th.errors:
+            raise SystemExit("mapthrash gate: thrasher errors: %s"
+                             % th.errors)
+        t_heal = time.monotonic()
+
+        def health():
+            _, _, data = client.mon_command({"prefix": "health"})
+            return bool(data) and data.get("status") == "HEALTH_OK"
+        if not wait_until(health, timeout=120):
+            raise SystemExit("mapthrash gate: no HEALTH_OK after "
+                             "churn heal")
+        ttho = round(time.monotonic() - t_heal, 3)
+        # drain: writes must flow again before we stop the load
+        n0 = len(churn_lat)
+        if not wait_until(lambda: len(churn_lat) > n0 + 10,
+                          timeout=30):
+            raise SystemExit("mapthrash gate: IO never resumed after "
+                             "heal")
+        stop_load.set()
+        load.join(timeout=10)
+
+        # monotone-progress gate (the PR-12 machinery)
+        if not wait_until(lambda: not progress.active_events(),
+                          timeout=30):
+            raise SystemExit("mapthrash gate: progress events still "
+                             "active after HEALTH_OK: %s"
+                             % progress.active_events())
+        for ev in progress.completed_events():
+            hist = [f for _, f in ev["history"]]
+            if any(b < a for a, b in zip(hist, hist[1:])):
+                raise SystemExit("mapthrash gate: progress event %s "
+                                 "fraction regressed: %s"
+                                 % (ev["id"], hist))
+
+        # peering p99 + map-lag observability per OSD
+        peer_p99 = 0.0
+        osd_status = {}
+        for osd_id, osd in sorted(c.osds.items()):
+            st = osd._osdmap_status()
+            osd_status["osd.%d" % osd_id] = st
+            peer_p99 = max(peer_p99, st["peering_p99"])
+        if peer_p99 > PEERING_P99_GATE_S:
+            raise SystemExit("mapthrash gate: peering p99 %.3fs "
+                             "(gate %.1fs)"
+                             % (peer_p99, PEERING_P99_GATE_S))
+
+        # writes BLOCK (not fail) during storms, so only a handful
+        # complete inside the churn window itself — the post-heal
+        # drain above adds the recovery tail
+        if len(quiet_lat) < 30 or len(churn_lat) < 15:
+            raise SystemExit("mapthrash: writer starved (quiet=%d "
+                             "churn=%d)"
+                             % (len(quiet_lat), len(churn_lat)))
+        quiet_lat.sort()
+        churn_lat.sort()
+
+        def pct(lat, q):
+            return lat[min(len(lat) - 1, int(len(lat) * q))]
+        q99 = pct(quiet_lat, 0.99)
+        ch99 = pct(churn_lat, 0.99)
+        if ch99 > CHURN_P99_MULT * q99:
+            raise SystemExit("mapthrash gate: churn p99 %.4fs > "
+                             "%.0fx quiet p99 %.4fs"
+                             % (ch99, CHURN_P99_MULT, q99))
+        doc["churn"] = {
+            "osds": 6, "churn_window_s": round(churn_s, 2),
+            "epochs_driven": c.osdmap_epoch() - e0,
+            "time_to_health_ok_s": ttho,
+            "quiet": {"writes": len(quiet_lat),
+                      "p50_s": round(pct(quiet_lat, 0.5), 4),
+                      "p99_s": round(q99, 4)},
+            "under_churn": {"writes": len(churn_lat),
+                            "p50_s": round(pct(churn_lat, 0.5), 4),
+                            "p99_s": round(ch99, 4)},
+            "churn_over_quiet_p99": round(ch99 / q99, 2)
+            if q99 > 0 else None,
+            "p99_mult_gate": CHURN_P99_MULT,
+            "peering_p99_s": round(peer_p99, 4),
+            "peering_p99_gate_s": PEERING_P99_GATE_S,
+            "thrash_log": [str(entry) for entry in th.log],
+            "osdmap_status": osd_status}
+        doc["value"] = round(ch99, 4)
+    finally:
+        stop_load.set()
+        c.stop()
+
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "MAPTHRASH_r01.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"balance": doc["balance"],
+                      "catchup": {k: v for k, v in
+                                  doc["catchup"].items()
+                                  if k != "mon_ring"},
+                      "churn_p99_s": doc["value"],
+                      "time_to_health_ok_s":
+                      doc["churn"]["time_to_health_ok_s"]}))
+    return doc
+
+
 def main() -> None:
     import jax
 
     if "--cpu" in sys.argv:
         jax.config.update("jax_platforms", "cpu")
+    if "--mapthrash" in sys.argv:
+        run_mapthrash()
+        return
     if "--convergence" in sys.argv:
         run_convergence()
         return
@@ -3524,6 +3940,11 @@ if __name__ == "__main__":
         # telemetry-at-scale artifact: gates + cluster legs, no
         # supervisor (no device rows)
         run_scaleobs()
+    elif "--mapthrash" in sys.argv:
+        # map-churn survival artifact: huge-map convergence, catch-up
+        # wire accounting, churn-under-traffic — no supervisor (no
+        # device rows)
+        run_mapthrash()
     elif "--worker" in sys.argv:
         main()
     else:

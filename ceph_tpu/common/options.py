@@ -330,6 +330,25 @@ def _declare_defaults():
     o("mon_osd_down_out_interval", float, 2.0, LEVEL_ADVANCED,
       "seconds after down before an osd is marked out")
     o("mon_osd_min_down_reporters", int, 1, LEVEL_ADVANCED)
+    # incremental-osdmap pipeline (ISSUE 19: map churn at scale)
+    o("mon_min_osdmap_epochs", int, 500, LEVEL_ADVANCED,
+      "committed osdmap incrementals each mon retains in its epoch->"
+      "inc ring; a subscriber whose epoch falls behind the ring's "
+      "trim floor gets exactly ONE full map instead of an inc chain "
+      "(the OSDMonitor full/inc trim policy)")
+    o("osd_map_message_max", int, 40, LEVEL_ADVANCED,
+      "max incrementals batched into one MOSDMap frame; a rejoining "
+      "subscriber catches up in ceil(behind/this) bounded messages, "
+      "re-subscribing at its new epoch after each frame")
+    o("osd_map_max_advance", int, 150, LEVEL_ADVANCED,
+      "max osdmap epochs a daemon applies per advance slice; further "
+      "incrementals queue in the MonClient backlog and drain on the "
+      "next tick, so a long catch-up cannot stall op dispatch or "
+      "re-peer every PG in one stop-the-world step")
+    o("osd_peering_max_active", int, 64, LEVEL_ADVANCED,
+      "peering slots per OSD (AsyncReserver lane beside recovery/"
+      "backfill): a map-churn storm re-peers PGs in waves of this "
+      "size instead of flooding the op queue; 0 disables the gate")
     o("paxos_propose_interval", float, 0.05, LEVEL_ADVANCED)
     o("ms_type", str, "simple", LEVEL_ADVANCED,
       "messenger transport: simple (thread-per-connection) | async "

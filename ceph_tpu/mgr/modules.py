@@ -219,6 +219,23 @@ class PrometheusModule(MgrModule):
                              mtype="counter")
                         emit("ceph_tpu_fused_ratio",
                              fused.get("ratio_avg", 1.0), lbl)
+                # map-churn lane (ISSUE 19): per-daemon applied
+                # epoch vs the cluster series above, epochs behind
+                # the mon, and the peering-duration p99
+                mbag = status.get("osdmap") or {}
+                if mbag:
+                    emit("ceph_osdmap_epoch",
+                         mbag.get("epoch", 0), lbl,
+                         help_="current osdmap epoch")
+                    emit("ceph_osd_map_lag_epochs",
+                         mbag.get("lag_epochs", 0), lbl,
+                         help_="osdmap epochs the daemon trails the "
+                               "monitor (inc backlog + unfetched)")
+                    emit("ceph_pg_peering_seconds",
+                         mbag.get("peering_p99", 0.0),
+                         dict(lbl, quantile="0.99"),
+                         help_="per-interval peering duration p99 "
+                               "(start_peering to activate)")
                 hbm = status.get("hbm") or {}
                 if hbm:
                     emit("ceph_osd_hbm_resident_objects",

@@ -34,6 +34,16 @@ class MonClient(Dispatcher):
         self.map_callbacks: list = []
         self.mdsmap_callbacks: list = []
         self._map_event = threading.Event()
+        # map-advance throttle (ISSUE 19 peering storm control):
+        # incoming incrementals park in an epoch-keyed backlog and at
+        # most map_max_advance apply per drain — a 1000-epoch catch-up
+        # advances in slices across ticks instead of re-peering every
+        # PG in one stop-the-world step.  The daemon wires the
+        # osd_map_max_advance conf value in after construction.
+        self.map_max_advance = 150
+        self.mon_epoch = 0           # newest epoch the mon advertised
+        self._inc_backlog: dict[int, object] = {}
+        self._advance_lock = threading.Lock()
         self.auth_client = None      # CephxClient after authenticate()
         self._auth_creds = None      # (entity, secret, service) for re-auth
         # per-client nonce so the monitor's retransmit dedup never
@@ -83,24 +93,75 @@ class MonClient(Dispatcher):
         return False
 
     def _handle_osdmap(self, msg) -> None:
-        if msg.full_map is not None:
-            newmap = encoding.decode_any(msg.full_map)
-            if self.osdmap is None or newmap.epoch > self.osdmap.epoch:
-                self.osdmap = newmap
-        for inc in msg.incrementals:
-            if self.osdmap is not None and \
-                    inc.epoch == self.osdmap.epoch + 1:
-                self.osdmap.apply_incremental(inc)
-            elif self.osdmap is None or inc.epoch > self.osdmap.epoch + 1:
-                # gap: pull a full map
-                self.sub_want(start_epoch=0)
-        for cb in list(self.map_callbacks):
-            try:
-                cb(self.osdmap)
-            except Exception:
-                pass
+        with self._advance_lock:
+            if msg.full_map is not None:
+                newmap = encoding.decode_any(msg.full_map)
+                if self.osdmap is None or \
+                        newmap.epoch > self.osdmap.epoch:
+                    self.osdmap = newmap
+            base = self.osdmap.epoch if self.osdmap is not None else -1
+            for inc in msg.incrementals:
+                if inc.epoch > base:
+                    self._inc_backlog[inc.epoch] = inc
+            self.mon_epoch = max(
+                [self.mon_epoch, msg.epoch]
+                + [i.epoch for i in msg.incrementals])
+        advanced = self._advance_map()
+        if advanced or msg.full_map is not None:
+            for cb in list(self.map_callbacks):
+                try:
+                    cb(self.osdmap)
+                except Exception:
+                    pass
         with self._lock:
             self._map_event.set()
+
+    def _advance_map(self) -> bool:
+        """Drain the inc backlog contiguously, at most map_max_advance
+        epochs per call (osd_map_max_advance).  When more remains —
+        throttled leftovers or a gap the mon must fill — re-subscribe
+        at the CURRENT epoch: the mon answers with the next batched
+        inc frame, or one full map if we fell behind its trim floor.
+        Returns True if the map advanced."""
+        want = None
+        advanced = False
+        with self._advance_lock:
+            if self.osdmap is None:
+                if self._inc_backlog or self.mon_epoch > 0:
+                    want = 0
+            else:
+                budget = max(1, self.map_max_advance)
+                while budget > 0:
+                    inc = self._inc_backlog.pop(
+                        self.osdmap.epoch + 1, None)
+                    if inc is None:
+                        break
+                    self.osdmap.apply_incremental(inc)
+                    advanced = True
+                    budget -= 1
+                # stale backlog entries the drain jumped over (a full
+                # map landed past them) must not pin memory
+                for e in [e for e in self._inc_backlog
+                          if e <= self.osdmap.epoch]:
+                    del self._inc_backlog[e]
+                if self._inc_backlog or \
+                        self.mon_epoch > self.osdmap.epoch:
+                    if budget > 0:
+                        # gap (dropped frame): ask the mon to fill it
+                        want = self.osdmap.epoch
+                    # else: throttled — the next tick's renew_subs
+                    # continues the drain without another request
+        if want is not None:
+            self.sub_want(start_epoch=want)
+        return advanced
+
+    def map_lag_epochs(self) -> int:
+        """Epochs between the newest epoch the mon advertised and the
+        map we have applied (the ceph_osd_map_lag_epochs series)."""
+        with self._advance_lock:
+            have = self.osdmap.epoch if self.osdmap is not None else 0
+            return max(0, self.mon_epoch - have,
+                       max(self._inc_backlog, default=0) - have)
 
     # -- API -----------------------------------------------------------
 
@@ -205,6 +266,15 @@ class MonClient(Dispatcher):
         so anything waiting on map progress calls this in its loop. The
         mon only re-sends when it actually has a newer map."""
         import time as _time
+        # the renew tick is also the advance tick: drain the next
+        # throttled slice of the inc backlog (osd_map_max_advance)
+        if self._advance_map():
+            for cb in list(self.map_callbacks):
+                try:
+                    cb(self.osdmap)
+                except Exception:
+                    pass
+            self._map_event.set()
         now = _time.monotonic()
         if now - getattr(self, "_last_renew", 0.0) < min_interval:
             return

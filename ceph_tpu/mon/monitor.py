@@ -78,7 +78,12 @@ class Monitor(Dispatcher):
         self._auth_sessions: BoundedDict = BoundedDict(1024)
         self._lock = make_rlock("mon:%d" % rank)
         self._propose_pending = False
-        self._subscribers: dict = {}        # addr -> last epoch sent
+        self._subscribers: dict = {}        # addr -> last epoch REPORTED
+        # re-push bookkeeping (ISSUE 19): addr -> [last_push_ts,
+        # strikes, epoch_at_strike] — a lagging subscriber is re-sent
+        # a bounded catch-up frame on the tick until it either renews
+        # at a newer epoch or strikes out (dead client)
+        self._sub_repush: dict = {}
         self._cmd_replies: dict = {}        # (requester, tid) -> reply
         self._tick_token = None
         self._running = False
@@ -119,12 +124,28 @@ class Monitor(Dispatcher):
 
     # -- lifecycle -----------------------------------------------------
 
+    def register_admin_commands(self) -> None:
+        """asok surface: 'osdmap status' dumps the inc ring span, trim
+        floor and laggiest subscriber (ISSUE 19 operability rider).
+        Safe to call more than once — registration is idempotent."""
+        sock = self.ctx.admin_socket
+        if sock is None:
+            return
+        try:
+            sock.register(
+                "osdmap status",
+                lambda args: self.osdmon.osdmap_status(),
+                "osdmap inc ring span, trim floor, laggiest subscriber")
+        except ValueError:
+            pass                       # already registered
+
     def init(self) -> None:
         addr = self.monmap[self.rank]
         self.msgr.bind(addr[0], addr[1])
         self.msgr.add_dispatcher_head(self)
         self.msgr.start()
         self.timer.init()
+        self.register_admin_commands()
         self._running = True
         self.state = STATE_ELECTING
         self.elector.start()
@@ -157,7 +178,44 @@ class Monitor(Dispatcher):
             self._mgr_report()
         except Exception:
             pass
+        try:
+            # the MOSDMap push is otherwise one-shot: re-push bounded
+            # catch-up frames to subscribers whose reported epoch lags
+            # (the lossy-link gap noted in mon_client.wait_for_map)
+            self._repush_lagging_subs()
+        except Exception:
+            pass
         self.timer.add_event_after(0.25, self._tick)
+
+    def _repush_lagging_subs(self) -> None:
+        """Per-subscriber bounded re-push on the tick: at most one
+        catch-up frame per second, and at most 8 unacknowledged
+        re-pushes at the same reported epoch (a subscriber that never
+        renews is a dead client, not a retransmit target).  The strike
+        count rearms the moment the subscriber's reported epoch
+        moves."""
+        cur = self.osdmon.osdmap.epoch
+        now = time.monotonic()
+        with self._lock:
+            lagging = [(a, e) for a, e in self._subscribers.items()
+                       if e < cur]
+            # drop re-push state for subscribers that caught up
+            for addr in [a for a in self._sub_repush
+                         if self._subscribers.get(a, cur) >= cur]:
+                self._sub_repush.pop(addr, None)
+        for addr, epoch in lagging:
+            state = self._sub_repush.get(addr)
+            if state is None:
+                state = self._sub_repush[addr] = [0.0, 0, epoch]
+            if epoch != state[2]:
+                state[1], state[2] = 0, epoch      # progress: rearm
+            if now - state[0] < 1.0 or state[1] >= 8:
+                continue
+            state[0] = now
+            state[1] += 1
+            m = self.osdmon.build_map_message(epoch)
+            if m is not None:
+                self.msgr.send_message(m, addr)
 
     def _mgr_report(self) -> None:
         """Mon leg of the cluster telemetry stream: perf dump +
@@ -442,7 +500,8 @@ class Monitor(Dispatcher):
     # reference's mon profiles); everything else mutates cluster state
     # and needs "w".
     _READONLY_PREFIXES = frozenset((
-        "osd dump", "osd getmap", "mds stat", "osd status", "status",
+        "osd dump", "osd getmap", "osd map status", "mds stat",
+        "osd status", "status",
         "osd erasure-code-profile ls", "osd erasure-code-profile get",
         "health", "health detail", "log last", "events last",
         "events watch"))
@@ -571,12 +630,13 @@ class Monitor(Dispatcher):
             return
         with self._lock:
             self._subscribers[tuple(addr)] = start_epoch
-        # immediately share the current full maps
-        full = self.osdmon.osdmap
-        if full.epoch > start_epoch:
-            self.msgr.send_message(
-                MOSDMap(full_map=encoding.encode_any(full), epoch=full.epoch),
-                addr)
+        # immediate catch-up, batched: incrementals from the ring when
+        # start_epoch sits above the trim floor (at most
+        # osd_map_message_max per frame — the subscriber re-subscribes
+        # at its new epoch for the next batch), one full map otherwise
+        m = self.osdmon.build_map_message(start_epoch)
+        if m is not None:
+            self.msgr.send_message(m, addr)
         if self.mdsmon.mdsmap["epoch"] > 0:
             self.msgr.send_message(
                 MMDSMap(mdsmap=dict(self.mdsmon.mdsmap)), addr)

@@ -46,6 +46,12 @@ class OSDMonitor:
         #                                 an operator: re-in on boot
         self._lock = threading.RLock()
         self._next_pool_id = 1
+        # epoch -> (Incremental, encoded-size) ring of committed incs
+        # (the reference's mon store full/inc window, trimmed to
+        # mon_min_osdmap_epochs): catch-up for any subscriber above
+        # the trim floor is served as batched incrementals; below it,
+        # exactly one full map (build_map_message)
+        self.inc_ring: dict[int, tuple[Incremental, int]] = {}
 
     # -- pending incremental ------------------------------------------
 
@@ -73,6 +79,7 @@ class OSDMonitor:
                 self.failure_reports.pop(osd, None)
             changes = self._describe_inc(inc)
             self.osdmap.apply_incremental(inc)
+            self._record_inc(inc)
         self.mon.publish_osdmap(inc)
         # journal the epoch change (leader only — every mon commits
         # this incremental, but only the leader may stage journal
@@ -82,6 +89,79 @@ class OSDMonitor:
                 "osdmap", "osdmap e%d: %s"
                 % (inc.epoch, "; ".join(changes) or "map updated"),
                 data={"epoch": inc.epoch, "changes": changes})
+
+    def _record_inc(self, inc: Incremental) -> None:
+        """Ring the committed inc for subscriber catch-up, trimming to
+        mon_min_osdmap_epochs.  The encoded size is kept beside it so
+        wire accounting ('osdmap status', the mapthrash gates) never
+        re-encodes the window.  Caller holds the lock."""
+        try:
+            nbytes = len(encoding.encode_any(inc))
+        except Exception:
+            nbytes = 0
+        self.inc_ring[inc.epoch] = (inc, nbytes)
+        keep = max(1, self.mon.ctx.conf.get_val("mon_min_osdmap_epochs"))
+        while len(self.inc_ring) > keep:
+            del self.inc_ring[min(self.inc_ring)]
+
+    def first_committed(self) -> int:
+        """Oldest inc epoch still served from the ring (the trim
+        floor): a subscriber at epoch < this - 1 cannot catch up
+        incrementally and gets one full map."""
+        with self._lock:
+            return min(self.inc_ring) if self.inc_ring \
+                else self.osdmap.epoch + 1
+
+    def build_map_message(self, start_epoch: int):
+        """One MOSDMap catch-up frame for a subscriber at start_epoch:
+
+          - up to date -> None
+          - above the trim floor -> up to osd_map_message_max
+            incrementals (epoch on the frame is the mon's NEWEST, so
+            a capped subscriber knows to re-subscribe for the next
+            batch)
+          - at/below the trim floor (or epoch 0) -> exactly one full
+            map, never an unbounded inc chain"""
+        from ..msg.message import MOSDMap
+        with self._lock:
+            cur = self.osdmap.epoch
+            if start_epoch >= cur:
+                return None
+            batch = max(1, self.mon.ctx.conf.get_val(
+                "osd_map_message_max"))
+            floor = min(self.inc_ring) if self.inc_ring else cur + 1
+            # start_epoch 0 = a map-less subscriber: it cannot apply
+            # incrementals, so it always gets the full map
+            if start_epoch > 0 and start_epoch + 1 >= floor:
+                incs = [self.inc_ring[e][0]
+                        for e in range(start_epoch + 1,
+                                       min(cur, start_epoch + batch) + 1)]
+                return MOSDMap(incrementals=incs, epoch=cur)
+            return MOSDMap(full_map=encoding.encode_any(self.osdmap),
+                           epoch=cur)
+
+    def osdmap_status(self) -> dict:
+        """The 'osdmap status' asok payload: ring span, trim floor,
+        per-subscriber lag with the laggiest called out."""
+        with self._lock:
+            cur = self.osdmap.epoch
+            ring = sorted(self.inc_ring)
+            ring_bytes = sum(n for _i, n in self.inc_ring.values())
+        subs = dict(getattr(self.mon, "_subscribers", {}))
+        laggiest = None
+        if subs:
+            addr, epoch = min(subs.items(), key=lambda kv: kv[1])
+            laggiest = {"addr": list(addr), "epoch": epoch,
+                        "lag_epochs": max(0, cur - epoch)}
+        return {
+            "epoch": cur,
+            "trim_floor": ring[0] if ring else cur + 1,
+            "ring_span": [ring[0], ring[-1]] if ring else [],
+            "ring_epochs": len(ring),
+            "ring_bytes": ring_bytes,
+            "subscribers": len(subs),
+            "laggiest_subscriber": laggiest,
+        }
 
     def _describe_inc(self, inc: Incremental) -> list[str]:
         """Human-readable deltas for the event journal, computed
@@ -243,6 +323,22 @@ class OSDMonitor:
                 inc.new_down.append(int(cmd["id"]))
                 self.mon.propose_soon()
                 return 0, "marked down osd.%s" % cmd["id"], None
+            if prefix == "osd reweight":
+                try:
+                    w = float(cmd["weight"])
+                except (KeyError, TypeError, ValueError):
+                    return -22, "invalid weight %r" \
+                        % cmd.get("weight"), None
+                if not 0.0 <= w <= 1.0:
+                    return -22, "weight %.3f not in [0, 1]" % w, None
+                self._auto_outed.discard(int(cmd["id"]))
+                self._pend().new_weight[int(cmd["id"])] = \
+                    int(w * 0x10000)
+                self.mon.propose_soon()
+                return 0, "reweighted osd.%s to %.4f" \
+                    % (cmd["id"], w), None
+            if prefix == "osd map status":
+                return 0, "", self.osdmap_status()
             if prefix == "osd pg-upmap-items":
                 pgid = PGID(*cmd["pgid"])
                 self._pend().new_pg_upmap_items[pgid] = \
@@ -371,6 +467,7 @@ class OSDMonitor:
         "hit_set_fpp": float,
         "size": int,
         "min_size": int,
+        "pg_num": int,
         # dmclock QoS profile (rides the osdmap to every OSD op queue)
         "qos_reservation": float,
         "qos_weight": float,
@@ -495,7 +592,19 @@ class OSDMonitor:
         except (TypeError, ValueError):
             return -22, "invalid value %r for %s" % (cmd.get("val"),
                                                      var), None
-        setattr(self._pending_pool(pool), var, val)
+        staged = self._pending_pool(pool)
+        if var == "pg_num":
+            # pools only grow (OSDMonitor refuses pg_num decrease);
+            # pgp_num follows so placement actually splits — the
+            # stable_mod masks keep old objects addressable while the
+            # new PGs instantiate (the pool-resize churn rider)
+            if val < pool.pg_num:
+                return -22, "specified pg_num %d < current %d" \
+                    % (val, pool.pg_num), None
+            staged.pg_num = val
+            staged.pgp_num = val
+        else:
+            setattr(staged, var, val)
         self.mon.propose_soon()
         return 0, "set pool %s %s to %s" % (pool.name, var, val), None
 

@@ -153,14 +153,17 @@ class Distribution:
 
 def _sweep(osdmap: OSDMap, pools: set[int] | None,
            use_device: bool,
-           use_mesh: bool = False) -> dict[PGID, list[int]]:
+           use_mesh: bool = False,
+           use_native: bool = False) -> dict[PGID, list[int]]:
     """All-PG up mappings — one batched device CRUSH program per pool
     (the ParallelPGMapper-analog step of every balancer round).  With
     use_mesh the PG batch is sharded across every local chip
-    (crush.batched.mesh_do_rule) instead of running on one device."""
+    (crush.batched.mesh_do_rule) instead of running on one device;
+    use_native runs the compiled host mapper (bit-identical, and the
+    honest CPU comparator when no real accelerator is attached)."""
     mapping = OSDMapMapping()
-    mapping.update(osdmap, batched=use_device or use_mesh,
-                   mesh=True if use_mesh else None)
+    mapping.update(osdmap, batched=use_device or use_mesh or use_native,
+                   mesh=True if use_mesh else None, native=use_native)
     out: dict[PGID, list[int]] = {}
     for pgid, (up, _up_p, _acting, _acting_p) in mapping.by_pg.items():
         if pools is not None and pgid.pool not in pools:
@@ -171,7 +174,8 @@ def _sweep(osdmap: OSDMap, pools: set[int] | None,
 
 def measure_sweep(osdmap: OSDMap, use_device: bool,
                   pools: set[int] | None = None,
-                  use_mesh: bool = False) -> float:
+                  use_mesh: bool = False,
+                  use_native: bool = False) -> float:
     """Wall-time of one all-PG placement sweep on the named backend
     (mesh = PG batch sharded across local chips, device = batched
     CRUSH program on one chip, native = the host mapper).  The mgr
@@ -181,7 +185,8 @@ def measure_sweep(osdmap: OSDMap, use_device: bool,
     small map the mesh's collective overhead can lose to one chip."""
     import time as _time
     t0 = _time.perf_counter()
-    _sweep(osdmap, pools, use_device, use_mesh=use_mesh)
+    _sweep(osdmap, pools, use_device, use_mesh=use_mesh,
+           use_native=use_native)
     return _time.perf_counter() - t0
 
 
@@ -209,10 +214,12 @@ def _targets(osdmap: OSDMap,
 
 def eval_distribution(osdmap: OSDMap, pools: set[int] | None = None,
                       use_device: bool = True,
-                      use_mesh: bool = False) -> Distribution:
+                      use_mesh: bool = False,
+                      use_native: bool = False) -> Distribution:
     """Score the current map: per-OSD up-PG counts vs CRUSH-weight
     targets (the `balancer eval` / OSDUtilizationDumper role)."""
-    by_pg = _sweep(osdmap, pools, use_device, use_mesh=use_mesh)
+    by_pg = _sweep(osdmap, pools, use_device, use_mesh=use_mesh,
+                   use_native=use_native)
     counts: dict[int, int] = {}
     for up in by_pg.values():
         for osd in up:
@@ -308,19 +315,28 @@ def calc_pg_upmaps(osdmap: OSDMap,
                    max_changes: int = 10,
                    pools: set[int] | None = None,
                    use_device: bool = True,
-                   use_mesh: bool = False) -> BalancerResult:
+                   use_mesh: bool = False,
+                   use_native: bool = False,
+                   changes_per_sweep: int = 1) -> BalancerResult:
     """Greedy upmap optimization, one accepted change per device
     sweep, mirroring OSDMap::calc_pg_upmaps' restart loop.  Stops
     when the fullest OSD sits within max_deviation PGs of its target
     (and, when max_deviation_ratio > 0, additionally within that
     ratio of the target).  Returns the proposal; the caller routes it
     through the monitor ("osd pg-upmap-items" /
-    "osd rm-pg-upmap-items") or an Incremental."""
+    "osd rm-pg-upmap-items") or an Incremental.
+
+    changes_per_sweep > 1 amortizes the device sweep at scale (ISSUE
+    19 huge-map convergence): each accepted remap updates the sweep's
+    pg/deviation bookkeeping locally and the batch keeps hunting, so
+    a 1000-OSD map converges in O(deviation / batch) sweeps instead
+    of one CRUSH sweep per change."""
     tmp = osdmap.clone()
     res = BalancerResult()
     remaining = max_changes
     while remaining > 0:
-        by_pg = _sweep(tmp, pools, use_device, use_mesh=use_mesh)
+        by_pg = _sweep(tmp, pools, use_device, use_mesh=use_mesh,
+                       use_native=use_native)
         res.sweeps += 1
         pgs_by_osd: dict[int, list[PGID]] = {}
         for pgid, up in sorted(by_pg.items(),
@@ -358,42 +374,82 @@ def calc_pg_upmaps(osdmap: OSDMap,
                      if dev < -0.999]
         if not overfull or not underfull:
             break
-        restart = False
-        for osd in sorted(deviations, key=lambda o: -deviations[o]):
-            dev = deviations[osd]
-            target = weights.get(osd, 0.0) * per_weight
-            if max_deviation_ratio > 0 and target > 0 and \
-                    dev / target < max_deviation_ratio:
-                break                  # fullest is within tolerance
-            if dev < max(1.0, max_deviation):
-                break
-            # 1) un-remap: drop existing items that land on this osd
-            for pgid in pgs_by_osd[osd]:
-                items = tmp.pg_upmap_items.get(pgid)
-                if items and any(dst == osd for _src, dst in items):
-                    tmp.pg_upmap_items.pop(pgid)
-                    res.new_pg_upmap_items.pop(pgid, None)
-                    res.old_pg_upmap_items.append(pgid)
-                    res.num_changed += 1
-                    restart = True
+        underfull_set = set(underfull)
+        batch = max(1, int(changes_per_sweep))
+        accepted = 0
+        while accepted < batch and remaining > 0:
+            changed = None             # (pgid, pairs|None) on accept
+            for osd in sorted(deviations, key=lambda o: -deviations[o]):
+                dev = deviations[osd]
+                target = weights.get(osd, 0.0) * per_weight
+                if max_deviation_ratio > 0 and target > 0 and \
+                        dev / target < max_deviation_ratio:
+                    break              # fullest is within tolerance
+                if dev < max(1.0, max_deviation):
                     break
-            if restart:
+                # 1) un-remap: drop existing items landing on this osd
+                for pgid in pgs_by_osd[osd]:
+                    items = tmp.pg_upmap_items.get(pgid)
+                    if items and any(dst == osd
+                                     for _src, dst in items):
+                        tmp.pg_upmap_items.pop(pgid)
+                        res.new_pg_upmap_items.pop(pgid, None)
+                        res.old_pg_upmap_items.append(pgid)
+                        res.num_changed += 1
+                        changed = (pgid, None)
+                        break
+                if changed is not None:
+                    break
+                # 2) remap one PG shard off this osd
+                for pgid in pgs_by_osd[osd]:
+                    if pgid in tmp.pg_upmap \
+                            or pgid in tmp.pg_upmap_items:
+                        continue
+                    pairs = _try_pg_upmap(tmp, pgid, overfull,
+                                          underfull)
+                    if pairs is None:
+                        continue
+                    tmp.pg_upmap_items[pgid] = pairs
+                    res.new_pg_upmap_items[pgid] = pairs
+                    res.num_changed += 1
+                    changed = (pgid, pairs)
+                    break
+                if changed is not None:
+                    break
+            if changed is None:
                 break
-            # 2) remap one PG shard off this osd
-            for pgid in pgs_by_osd[osd]:
-                if pgid in tmp.pg_upmap or pgid in tmp.pg_upmap_items:
-                    continue
-                pairs = _try_pg_upmap(tmp, pgid, overfull, underfull)
-                if pairs is None:
-                    continue
-                tmp.pg_upmap_items[pgid] = pairs
-                res.new_pg_upmap_items[pgid] = pairs
-                res.num_changed += 1
-                restart = True
+            remaining -= 1
+            accepted += 1
+            if accepted >= batch or remaining <= 0:
                 break
-            if restart:
+            pgid, pairs = changed
+            if pairs is None:
+                # un-remap: the shard falls back to its raw CRUSH
+                # placement, unknowable without a sweep — stop the
+                # batch and resweep
                 break
-        if not restart:
+            # local bookkeeping: move the shard so the rest of the
+            # batch sees it without paying for a device sweep
+            for src, dst in pairs:
+                if pgid in pgs_by_osd.get(src, ()):
+                    pgs_by_osd[src].remove(pgid)
+                    pgs_by_osd.setdefault(dst, []).append(pgid)
+                    deviations[src] = deviations.get(src, 0.0) - 1
+                    deviations[dst] = deviations.get(dst, 0.0) + 1
+                    if deviations[src] < 1.0:
+                        overfull.discard(src)
+                    if deviations[dst] >= 1.0:
+                        overfull.add(dst)
+                        underfull_set.discard(dst)
+                    if deviations[dst] >= -0.999:
+                        underfull_set.discard(dst)
+            underfull = [o for o in
+                         sorted(underfull_set,
+                                key=lambda o: (deviations.get(o, 0.0),
+                                               o))
+                         if deviations.get(o, 0.0) < -0.999]
+            if not overfull or not underfull:
+                break
+        if accepted == 0:
             break                      # no further improvement found
-        remaining -= 1
     return res

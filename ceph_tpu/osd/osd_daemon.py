@@ -89,6 +89,11 @@ class OSDDaemon(Dispatcher):
         self.monmap = dict(monmap)
         self.mon_client = MonClient(monmap, self.public_msgr,
                                     "osd.%d" % whoami)
+        # map-advance throttle (ISSUE 19): the MonClient parks incoming
+        # incrementals and applies at most this many epochs per drain
+        # tick, so a 1000-epoch catch-up peers in slices
+        self.mon_client.map_max_advance = \
+            conf.get_val("osd_map_max_advance")
         self.osdmap = OSDMap()
         self.pgs: dict = {}
         # (session, tid) -> None (executing) | (result, data)
@@ -133,6 +138,20 @@ class OSDDaemon(Dispatcher):
             "remote_backfill": AsyncReserver("remote_backfill",
                                              max_backfills),
         }
+        # peering storm control (ISSUE 19): peering itself rides a
+        # reserver lane so a map-churn burst re-peers at most
+        # osd_peering_max_active PGs at once instead of flooding the
+        # op queue and starving client IO.  0 disables the gate
+        # (pg.start_recovery bypasses the lane).
+        peering_slots = conf.get_val("osd_peering_max_active")
+        self.peering_gate = peering_slots > 0
+        self.reservations["peering"] = AsyncReserver(
+            "peering", max(1, peering_slots))
+        # peering duration samples for the p99 lane
+        # (ceph_pg_peering_seconds): ring of the last 256 completed
+        # interval peerings, summarized in _telemetry_status
+        from collections import deque
+        self._peering_durations = deque(maxlen=256)
         # osd_recovery_sleep delay shaping: pushes acquire a unit for
         # the duration of the push, and BackoffThrottle injects an
         # occupancy-scaled sleep — the closer concurrent pushes sit to
@@ -300,6 +319,11 @@ class OSDDaemon(Dispatcher):
                 lambda args: self._dump_op_queue(),
                 "QoS op-queue state: per-class/per-pool depth, served "
                 "and limit-throttle wait merged across shards")
+            self.ctx.admin_socket.register(
+                "osdmap status",
+                lambda args: self._osdmap_status(),
+                "map pipeline state: applied epoch, mon epoch, lag, "
+                "inc backlog depth, peering lane occupancy + p99")
         self.hb_peers: dict = {}       # osd -> last reply stamp
         self.hb_pending: dict = {}     # osd -> first unacked ping stamp
         # cache tiering: base-pool IO runs on dedicated threads with an
@@ -406,6 +430,17 @@ class OSDDaemon(Dispatcher):
                      .add_u64_counter("l_osd_pq_evictions",
                                       "perf-query keys LRU-evicted at "
                                       "the table bound")
+                     # map-churn observability (ISSUE 19): per-interval
+                     # peering wall time (histogram in microseconds —
+                     # hinc buckets are integer powers of two) and the
+                     # epochs this daemon trails the mon's newest map
+                     .add_histogram("l_osd_peering_us",
+                                    "per-interval peering duration, "
+                                    "microseconds (start_peering to "
+                                    "activate)")
+                     .add_u64("l_osd_map_lag_epochs",
+                              "osdmap epochs this daemon trails the "
+                              "monitor (backlog + unfetched)")
                      .create_perf_counters())
         self.ctx.perf.add(self.perf)
         # per-principal perf-query engine (osd/perf_query.py): the
@@ -893,7 +928,57 @@ class OSDDaemon(Dispatcher):
                 status["op_queue"] = self.op_wq.dump()
         except Exception:
             pass
+        try:
+            # map-churn lane (ISSUE 19): the mgr's prometheus module
+            # emits ceph_osdmap_epoch{ceph_daemon}, ceph_osd_map_lag_
+            # epochs and the ceph_pg_peering_seconds p99 from this bag
+            status["osdmap"] = {
+                "epoch": self.osdmap.epoch,
+                "lag_epochs": self.mon_client.map_lag_epochs(),
+                "peering_p99": self.peering_p99(),
+            }
+        except Exception:
+            pass
         return status
+
+    # -- map-churn observability (ISSUE 19) ---------------------------
+
+    def note_peering_done(self, seconds: float) -> None:
+        """One interval's peering completed (start_peering ->
+        activate): feed the histogram + the p99 ring."""
+        try:
+            self.perf.hinc("l_osd_peering_us", int(seconds * 1e6))
+        except Exception:
+            pass
+        self._peering_durations.append(seconds)
+
+    def peering_p99(self) -> float:
+        """p99 of the last completed interval peerings (seconds)."""
+        samples = sorted(self._peering_durations)
+        if not samples:
+            return 0.0
+        return samples[min(len(samples) - 1,
+                           int(0.99 * len(samples)))]
+
+    def _osdmap_status(self) -> dict:
+        """The `osdmap status` asok payload: applied epoch vs the
+        mon's newest, inc-backlog depth behind the advance throttle,
+        and the peering lane's occupancy."""
+        mc = self.mon_client
+        with mc._advance_lock:
+            backlog = len(mc._inc_backlog)
+        res = self.reservations["peering"].dump()
+        return {
+            "epoch": self.osdmap.epoch,
+            "mon_epoch": mc.mon_epoch,
+            "lag_epochs": mc.map_lag_epochs(),
+            "inc_backlog": backlog,
+            "map_max_advance": mc.map_max_advance,
+            "peering_gate": self.peering_gate,
+            "peering_active": len(res.get("granted", [])),
+            "peering_waiting": len(res.get("waiting", [])),
+            "peering_p99": self.peering_p99(),
+        }
 
     # -- fullness ladder ----------------------------------------------
 
@@ -943,6 +1028,11 @@ class OSDDaemon(Dispatcher):
         self.perf.set("l_osd_reservation_granted", granted)
         self.perf.set("l_osd_reservation_waiting", waiting)
         self.perf.set("l_osd_reservation_preempted", preempted)
+        try:
+            self.perf.set("l_osd_map_lag_epochs",
+                          self.mon_client.map_lag_epochs())
+        except Exception:
+            pass
 
     def _collect_pg_stats(self) -> dict:
         """Primary PGs' stat rows (shared by the mon MPGStats report

@@ -180,6 +180,28 @@ class Incremental:
         self.new_crush: CrushMap | None = None
         self.new_ec_profiles: dict[str, dict] = {}
 
+    def overlay_only(self) -> bool:
+        """True when this inc only touches per-PG overlays (pg_temp /
+        primary_temp / upmap) or down-marks — the churn classes whose
+        affected-PG set is exactly enumerable, so a precomputed
+        mapping can advance without a full CRUSH re-sweep.  Weight,
+        boot, pool and crush changes move raw placements and need the
+        sweep."""
+        return not (self.new_pools or self.old_pools or self.new_up
+                    or self.new_weight or self.new_primary_affinity
+                    or self.new_max_osd is not None
+                    or self.new_crush is not None)
+
+    def overlay_pgs(self) -> set:
+        """The raw PGIDs named by this inc's overlay entries."""
+        pgs: set = set()
+        for d in (self.new_pg_temp, self.new_primary_temp,
+                  self.new_pg_upmap, self.new_pg_upmap_items):
+            pgs.update(d.keys())
+        pgs.update(self.old_pg_upmap)
+        pgs.update(self.old_pg_upmap_items)
+        return pgs
+
 
 class OSDMap:
     def __init__(self):
@@ -490,13 +512,20 @@ class OSDMapMapping:
         self.by_osd: dict[int, list] = {}
 
     def update(self, osdmap: OSDMap, batched: bool = True,
-               mesh=None) -> None:
+               mesh=None, native: bool = False) -> None:
         """Recompute every pool's PG mappings. With batched=True the
         CRUSH step for each pool's whole PG range runs as one device
         call (ceph_tpu.crush.batched.batched_do_rule); with mesh set
         (True for the default local-device mesh, or an explicit 1-axis
         jax Mesh) the PG batch is additionally sharded across chips
-        (ceph_tpu.crush.batched.mesh_do_rule)."""
+        (ceph_tpu.crush.batched.mesh_do_rule).  native=True routes the
+        bulk sweep through the compiled C mapper instead
+        (crush_do_rule_batch_native — the host-side ParallelPGMapper
+        analogue, bit-identical to the device kernels): on a CPU-only
+        host the device paths pay XLA emulation cost per seed, while a
+        datacenter-scale balancer round needs 10^5 placements per
+        sweep.  Falls back to the device path if the native lib is not
+        built."""
         self.by_pg.clear()
         self.by_osd = {o: [] for o in range(osdmap.max_osd)}
         mesh_obj = None
@@ -510,13 +539,23 @@ class OSDMapMapping:
                 from ..crush.batched import batched_do_rule, mesh_do_rule
                 seeds = np.array([pool.raw_pg_to_pps(p) for p in pgids],
                                  dtype=np.int64)
-                if mesh_obj is not None:
+                mat = None
+                if native:
+                    try:
+                        from ..native import crush_do_rule_batch_native
+                        mat = crush_do_rule_batch_native(
+                            osdmap.crush, pool.crush_rule, seeds,
+                            pool.size, osdmap._weight_vector(),
+                            choose_args=pool_id)
+                    except Exception:
+                        mat = None    # lib not built: device fallback
+                if mat is None and mesh_obj is not None:
                     mat = mesh_do_rule(osdmap.crush, pool.crush_rule,
                                        seeds, pool.size,
                                        osdmap._weight_vector(),
                                        mesh=mesh_obj,
                                        choose_args=pool_id)
-                else:
+                elif mat is None:
                     mat = batched_do_rule(osdmap.crush, pool.crush_rule,
                                           seeds, pool.size,
                                           osdmap._weight_vector(),
@@ -546,6 +585,54 @@ class OSDMapMapping:
                     if osd != CRUSH_ITEM_NONE and osd in self.by_osd:
                         self.by_osd[osd].append(pgid)
         self.epoch = osdmap.epoch
+
+    def apply_incremental(self, osdmap: OSDMap, inc: Incremental,
+                          batched: bool = True, mesh=None) -> dict:
+        """Advance the precomputed mapping by one epoch touching only
+        the PGs the inc can move (ISSUE 19: sub-linear apply).  The
+        caller applies `inc` to `osdmap` FIRST; this then either
+
+          - recomputes exactly the affected PG set on the host path
+            (overlay-only incs: pg_temp / primary_temp / upmap edits
+            and down-marks — the steady-state churn classes at 10^5+
+            PGs), or
+          - falls back to the full batched/mesh sweep when raw
+            placements moved (weight, boot, pool, crush changes).
+
+        Returns {"mode": "incremental"|"full", "recomputed": n}."""
+        if osdmap.epoch != inc.epoch or self.epoch != inc.epoch - 1 \
+                or not inc.overlay_only():
+            self.update(osdmap, batched=batched, mesh=mesh)
+            return {"mode": "full", "recomputed": len(self.by_pg)}
+        affected: set[PGID] = set()
+        for pgid in inc.overlay_pgs():
+            pool = osdmap.pools.get(pgid.pool)
+            if pool is not None:
+                affected.add(pool.raw_pg_to_pg(pgid))
+        for osd in inc.new_down:
+            # a downed osd only moves PGs it served: its acting set,
+            # plus pg_temp'd PGs where it sat in `up` but not acting
+            affected.update(self.by_osd.get(osd, []))
+            for pg in osdmap.pg_temp:
+                row = self.by_pg.get(pg)
+                if row is not None and osd in row[0]:
+                    affected.add(pg)
+        for pgid in affected:
+            old = self.by_pg.get(pgid)
+            if old is not None:
+                for osd in old[2]:
+                    lst = self.by_osd.get(osd)
+                    if lst is not None and pgid in lst:
+                        lst.remove(pgid)
+            up, upp, acting, actp = osdmap.pg_to_up_acting_osds(pgid)
+            if not up and not acting and old is None:
+                continue
+            self.by_pg[pgid] = (up, upp, acting, actp)
+            for osd in acting:
+                if osd != CRUSH_ITEM_NONE:
+                    self.by_osd.setdefault(osd, []).append(pgid)
+        self.epoch = inc.epoch
+        return {"mode": "incremental", "recomputed": len(affected)}
 
     def get(self, pgid: PGID):
         return self.by_pg.get(pgid)

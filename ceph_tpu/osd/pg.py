@@ -57,7 +57,7 @@ WHITEOUT_ATTR = "_whiteout"
 # reservation priorities (the reference's OSD_RECOVERY_PRIORITY
 # ladder, collapsed to two rungs): degraded-object recovery preempts
 # routine backfill in the AsyncReservers, never the other way around
-_RESV_PRIO = {"recovery": 180, "backfill": 90}
+_RESV_PRIO = {"recovery": 180, "backfill": 90, "peering": 250}
 
 
 def host_crc32(data) -> int:
@@ -1349,10 +1349,72 @@ class PG:
     def start_recovery(self) -> None:
         """Entry point from the recovery queue: run the peering rounds
         (log-based convergence), then scan-backfill any peer whose log
-        does not overlap."""
+        does not overlap.
+
+        Peering storm control (ISSUE 19): when the daemon's peering
+        gate is on, peering itself queues for a slot on the "peering"
+        AsyncReserver — a map-churn burst re-peers at most
+        osd_peering_max_active PGs concurrently instead of flooding
+        the op queue with a thousand simultaneous info exchanges."""
         if not self.is_primary():
             return
+        res = self._peering_reserver()
+        if res is None:
+            self.start_peering()
+            return
+        with self.lock:
+            # the grant callback re-reads this, so a newer interval's
+            # start_recovery retargets an already-queued request
+            # (request_reservation ignores the duplicate item)
+            self._peering_want = self.interval
+        self._peering_slot = True
+        res.request_reservation(str(self.pgid),
+                                self._peering_granted,
+                                _RESV_PRIO["peering"])
+
+    def _peering_reserver(self):
+        """The daemon's peering-slot reserver, or None when the gate
+        is off (osd_peering_max_active=0) or the PG runs against a
+        stub daemon — None short-circuits to ungated peering."""
+        if not getattr(self.daemon, "peering_gate", False):
+            return None
+        reservers = self._reservers()
+        if reservers is None:
+            return None
+        return reservers.get("peering")
+
+    def _peering_granted(self) -> None:
+        """Slot granted: run peering on the op queue's recovery class,
+        never inline — the grant callback fires on whatever thread
+        released the previous holder's slot."""
+        queue = getattr(self.daemon, "op_wq", None)
+        if queue is None:
+            self._run_gated_peering()
+            return
+        queue.queue(self.pgid, self._run_gated_peering,
+                    klass="recovery",
+                    priority=getattr(self.daemon,
+                                     "recovery_op_priority", 5))
+
+    def _run_gated_peering(self) -> None:
+        with self.lock:
+            stale = (getattr(self, "_peering_want", -1)
+                     != self.interval
+                     or self.acting_primary != self.whoami)
+        if stale:
+            # the interval moved while we queued: the map change that
+            # moved it already re-queued recovery, so just give the
+            # slot back
+            self._release_peering_slot()
+            return
         self.start_peering()
+
+    def _release_peering_slot(self) -> None:
+        res = self._peering_reserver()
+        if res is None or not getattr(self, "_peering_slot", False):
+            return
+        self._peering_slot = False
+        res.cancel_reservation(str(self.pgid))
 
     def _my_info(self) -> dict:
         with self.lock:
@@ -1374,6 +1436,8 @@ class PG:
             self.peer_state = "peering"
             self._peer_seq += 1
             seq = self._peer_seq
+            # wall-clock start for the ceph_pg_peering_seconds lane
+            self._peer_t0 = _time.monotonic()
             self._peer_infos = {self.whoami: self._my_info()}
             # a new interval recomputes who is missing what: replicas
             # re-report after activation (handle_log missing notify)
@@ -1433,6 +1497,11 @@ class PG:
         # silent peers): keep asking — the PG stays inactive, exactly
         # like the reference's down/incomplete states, until enough
         # peers return or a map change restarts peering
+        if attempt >= 2:
+            # wedged on silent peers: give the peering slot back so an
+            # incomplete PG can't pin the storm-control lane while it
+            # waits (possibly forever) for the dead peers to return
+            self._release_peering_slot()
         for osd in waiting:
             self.send_to_osd(osd, MOSDPGQuery(
                 pgid=self.pgid, from_osd=self.whoami, what="info",
@@ -1653,6 +1722,13 @@ class PG:
             waiting, self.waiting_for_active = \
                 self.waiting_for_active, []
             head = self.pg_log.head
+            t0 = getattr(self, "_peer_t0", None)
+        # peering done: free the storm-control slot and feed the
+        # duration histogram (ceph_pg_peering_seconds p99)
+        self._release_peering_slot()
+        note = getattr(self.daemon, "note_peering_done", None)
+        if note is not None and t0 is not None:
+            note(_time.monotonic() - t0)
         shards = self.acting_shards()
         backfill = []
         for osd, info in infos.items():
@@ -2557,6 +2633,7 @@ class PG:
     def _release_reservations(self) -> None:
         """Interval change: both primary-side rounds restart and every
         remote slot we granted a (possibly gone) primary is freed."""
+        self._release_peering_slot()
         for lane in ("recovery", "backfill"):
             self._release_reservation(lane)
         reservers = self._reservers()

@@ -24,7 +24,9 @@ class Thrasher:
                  interval: float = 0.5, revive_delay: float = 0.8,
                  partition_prob: float = 0.0,
                  mon_thrash_prob: float = 0.0,
-                 device_thrash_prob: float = 0.0):
+                 device_thrash_prob: float = 0.0,
+                 map_churn_prob: float = 0.0,
+                 churn_pool: str | None = None):
         self.cluster = cluster
         self.rng = random.Random(seed)
         self.min_in = min_in
@@ -33,6 +35,15 @@ class Thrasher:
         self.partition_prob = partition_prob
         self.mon_thrash_prob = mon_thrash_prob
         self.device_thrash_prob = device_thrash_prob
+        # map-churn riders (ISSUE 19): out/in storms, reweight sweeps
+        # and pool resizes drive osdmap epochs WITHOUT killing daemons
+        # — the churn class the incremental-map pipeline exists for.
+        # churn_pool names a dedicated pool the resize rider may grow
+        # (splits instantiate fresh PGs); None disables resizes.
+        self.map_churn_prob = map_churn_prob
+        self.churn_pool = churn_pool
+        self.reweighted: set[int] = set()     # osds left off weight 1
+        self.outed: set[int] = set()          # osds the storm left out
         self.dead: dict[int, object] = {}     # osd_id -> store
         self.dead_devices: set[int] = set()   # injector-killed chips
         self.partitions: set[tuple[int, int]] = set()  # (a, b) pairs
@@ -205,6 +216,107 @@ class Thrasher:
         self._journal("device stall", "device %d (%.0fms)" % (idx, ms),
                       device=idx, ms=ms)
 
+    # -- map churn (ISSUE 19: epochs without process deaths) -----------
+
+    def _mon_cmd(self, cmd: dict, what: str) -> bool:
+        """Issue a mon command through the cluster's first client; a
+        rider that cannot reach the mon records a finding instead of
+        crashing the thrash loop."""
+        client = self.cluster.clients[0] if self.cluster.clients \
+            else None
+        if client is None:
+            return False
+        try:
+            client.mon_command(cmd)
+            return True
+        except Exception as e:
+            self.errors.append("%s: %r" % (what, e))
+            return False
+
+    def out_in_storm(self, count: int | None = None) -> list[int]:
+        """Mark a random batch of up OSDs OUT in one burst, then back
+        IN: two epoch waves of pure placement churn (pg_temp, remap,
+        backfill scheduling) with every daemon still alive."""
+        alive = [o for o in self._alive() if o not in self.outed]
+        if count is None:
+            count = self.rng.randint(1, 3)
+        count = min(count, len(alive) - self.min_in)
+        if count <= 0:
+            return []
+        victims = self.rng.sample(alive, count)
+        for osd in victims:
+            if self._mon_cmd({"prefix": "osd out", "id": osd},
+                             "storm out osd.%d" % osd):
+                self.outed.add(osd)
+        self.log.append(("out_storm", tuple(victims)))
+        self._journal("out storm", "osds %s" % victims, osds=victims)
+        # dwell so the out-wave's peering actually starts before the
+        # in-wave reverses it — back-to-back epochs, not a no-op merge
+        self._stop.wait(self.interval)
+        self.in_all()
+        return victims
+
+    def in_all(self) -> None:
+        """Reverse every storm-out (the in-wave)."""
+        while self.outed:
+            osd = self.outed.pop()
+            self._mon_cmd({"prefix": "osd in", "id": osd},
+                          "storm in osd.%d" % osd)
+        self.log.append(("in_storm",))
+
+    def reweight_sweep(self, count: int = 3) -> list[int]:
+        """Override-reweight a few OSDs to random fractions in
+        [0.5, 1.0): each accepted reweight is one committed epoch that
+        MOVES RAW PLACEMENTS (weight feeds the CRUSH weight vector),
+        the heavier churn class than up/down flaps."""
+        alive = self._alive()
+        if not alive:
+            return []
+        victims = self.rng.sample(alive,
+                                  min(count, len(alive)))
+        for osd in victims:
+            w = self.rng.uniform(0.5, 0.99)
+            if self._mon_cmd({"prefix": "osd reweight", "id": osd,
+                              "weight": w},
+                             "reweight osd.%d" % osd):
+                self.reweighted.add(osd)
+        self.log.append(("reweight", tuple(victims)))
+        self._journal("reweight sweep", "osds %s" % victims,
+                      osds=victims)
+        return victims
+
+    def restore_weights(self) -> None:
+        while self.reweighted:
+            osd = self.reweighted.pop()
+            self._mon_cmd({"prefix": "osd reweight", "id": osd,
+                           "weight": 1.0},
+                          "restore weight osd.%d" % osd)
+        self.log.append(("reweight_restore",))
+
+    def pool_resize(self, grow_by: int = 8) -> int | None:
+        """Grow the dedicated churn pool's pg_num (pools only grow):
+        the split instantiates fresh PGs on every OSD the new masks
+        land on — the map-churn class that changes the PG POPULATION
+        rather than placements."""
+        if not self.churn_pool:
+            return None
+        mon = self.cluster.leader()
+        pool = next((p for p in mon.osdmon.osdmap.pools.values()
+                     if p.name == self.churn_pool), None)
+        if pool is None:
+            return None
+        target = pool.pg_num + grow_by
+        if not self._mon_cmd({"prefix": "osd pool set",
+                              "pool": self.churn_pool,
+                              "var": "pg_num", "val": target},
+                             "resize pool %s" % self.churn_pool):
+            return None
+        self.log.append(("pool_resize", self.churn_pool, target))
+        self._journal("pool resize",
+                      "%s pg_num -> %d" % (self.churn_pool, target),
+                      pool=self.churn_pool, pg_num=target)
+        return target
+
     # -- mon thrash (MonitorThrasher kill/revive) ----------------------
 
     def thrash_mon(self) -> int | None:
@@ -270,6 +382,15 @@ class Thrasher:
                         self.revive_device()
                     else:
                         self.kill_device()
+                if self.map_churn_prob and \
+                        self.rng.random() < self.map_churn_prob:
+                    roll = self.rng.random()
+                    if roll < 0.45:
+                        self.out_in_storm()
+                    elif roll < 0.85 or not self.churn_pool:
+                        self.reweight_sweep()
+                    else:
+                        self.pool_resize()
                 # weighted choice mirroring the reference's thrasher:
                 # mostly kill/revive churn
                 if self.dead and (len(self._alive()) <= self.min_in
@@ -293,6 +414,8 @@ class Thrasher:
         if self._thread is not None:
             self._thread.join(timeout=10)
         self.heal()
+        self.in_all()
+        self.restore_weights()
         while self.dead_devices:
             self.revive_device()
         while self.dead:
