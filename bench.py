@@ -521,6 +521,9 @@ def _bench_cluster() -> dict:
     c = MiniCluster(num_mons=1, num_osds=4,
                     conf_overrides={"osd_tracing": False,
                                     "osd_profiler": False,
+                                    # tail sampling off too: --forensics
+                                    # prices the retention path itself
+                                    "osd_trace_tail_sample_rate": 0,
                                     "mgr_stats_period": 0.0,
                                     "mgr_progress": False,
                                     # pin the op-queue discipline: this
@@ -2195,6 +2198,304 @@ def run_attribution(out_path: str | None = None) -> dict:
     return doc
 
 
+def run_forensics(out_path: str | None = None) -> dict:
+    """SLO-forensics artifact (ISSUE 20): tail-based trace retention,
+    cross-daemon stitching in the mgr, and critical-path attribution.
+
+    One MiniCluster, four legs:
+
+      A. Retention: a deterministic 60 ms stall is injected into the
+         REPLICA rep-op apply for 'slowpool' (the _SleepyDevOps
+         pattern); every slow write must be tail-kept (reason "slo")
+         with an intact cross-daemon tree in the mgr store, while
+         'fastpool' writes are kept only by the seeded reservoir.
+      B. Attribution: the pool's cross-trace critical-path profile
+         must name the injected bottleneck — the remote sub-op leg
+         ("rep_op": fan-out send -> replica apply -> ack) — and the
+         POOL_SLO_VIOLATION health detail must carry the same stamp.
+      C. Bounded store: the budget is shrunk and 'floodpool' (SLO
+         threshold ~0: every op is kept) floods >= 10x the budget
+         through the ingest lane; tracked bytes must stay <= budget.
+      D. Overhead: interleaved sampling-on/off legs on the fast pool;
+         on-throughput must be >= 0.97x off-throughput.
+
+    HARD GATES (SystemExit): (a) 100% slow retention, every slow tree
+    spanning >= 2 daemons, fast retention within the reservoir band;
+    (b) top critical-path stage == "rep_op" and the health detail
+    names it; (c) tracked_bytes <= budget after the 10x flood;
+    (d) throughput ratio >= 0.97."""
+    import random
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_util import MiniCluster, wait_until
+
+    from ceph_tpu.mgr import PerfQueryModule, TraceModule
+    from ceph_tpu.osd.replicated_backend import ReplicatedBackend
+
+    SLOW_MS = 60.0
+    RATE = 0.25
+    BUDGET = 512 << 10            # leg A/B: comfortably above demand
+    FLOOD_BUDGET = 64 << 10       # leg C: shrunk so the flood is 10x
+    doc: dict = {"metric": "forensics_gates_green", "unit": "bool",
+                 "injected_stall_ms": SLOW_MS, "reservoir_rate": RATE}
+    c = MiniCluster(num_mons=1, num_osds=3, conf_overrides={
+        "osd_tracing": True,
+        "osd_profiler": False,
+        "osd_heartbeat_interval": 0.1,
+        "osd_heartbeat_grace": 0.6,
+        "paxos_propose_interval": 0.02,
+        "mgr_stats_period": 0.25,
+        "osd_trace_tail_sample_rate": RATE,
+        "mgr_trace_store_bytes": BUDGET,
+        # slowpool: the 60 ms stall clears 25 ms on every op.
+        # fastpool: unreachable threshold — only the reservoir keeps.
+        # floodpool: ~0 threshold — EVERY op is kept (the flood).
+        "mgr_slo_pool_targets":
+            "slowpool:25:0.99,fastpool:2000:0.99,floodpool:0.05:0.99",
+    })
+    c.start()
+    orig_rep = ReplicatedBackend.handle_rep_op
+    try:
+        mgr = c.start_mgr(modules=(PerfQueryModule, TraceModule))
+        tm = mgr.modules["trace"]
+        admin = c.client()
+        slow_id = c.create_replicated_pool(admin, "slowpool",
+                                           size=2, pg_num=8)
+        fast_id = c.create_replicated_pool(admin, "fastpool",
+                                           size=2, pg_num=8)
+        for pid in (slow_id, fast_id):
+            if not c.wait_clean(pid):
+                raise SystemExit("forensics gate: pool %d never went "
+                                 "clean" % pid)
+        if not wait_until(lambda: all(o.mgr_addr is not None
+                                      for o in c.osds.values()),
+                          timeout=20):
+            raise SystemExit("forensics gate: OSDs never learned the "
+                             "mgr address")
+        # deterministic reservoir: seed each OSD's sampler RNG
+        for i, osd in c.osds.items():
+            osd.tail.rng = random.Random(1000 + i)
+
+        # -- leg A: retention ----------------------------------------
+        def sleepy_rep_op(self, msg, local=False):
+            # replica-side apply stall, slow pool only (the primary's
+            # local self-apply stays fast: the bottleneck is REMOTE)
+            if not local and self.pg.pgid.pool == slow_id:
+                time.sleep(SLOW_MS / 1e3)
+            return orig_rep(self, msg, local)
+
+        ReplicatedBackend.handle_rep_op = sleepy_rep_op
+        io_slow = admin.open_ioctx("slowpool")
+        n_slow = 20
+        for i in range(n_slow):
+            io_slow.write_full("slow-%d" % i, b"s" * 4096)
+        ReplicatedBackend.handle_rep_op = orig_rep
+
+        io_fast = admin.open_ioctx("fastpool")
+        n_fast = 200
+        for i in range(n_fast):
+            io_fast.write_full("fast-%d" % i, b"f" * 512)
+
+        def pool_entries(pool):
+            with tm._lock:
+                return [dict(e, daemons=set(e["daemons"]),
+                             spans=list(e["spans"]))
+                        for e in tm._traces.values()
+                        if e["pool"] == pool]
+
+        def sampler_kept(pool):
+            kept = seen = 0
+            for o in c.osds.values():
+                ps = o.tail.pool_stats.get(pool)
+                if ps:
+                    seen += ps["seen"]
+                    kept += ps["kept"]
+            return kept, seen
+
+        # replicas ship only after the root's verdict round-trips;
+        # wait for the store to agree with the samplers' own counts
+        def settled():
+            tm.flush(0.5)
+            slow = pool_entries("slowpool")
+            return (len(slow) >= n_slow
+                    and all(len(e["daemons"]) >= 2 for e in slow)
+                    and len(pool_entries("fastpool"))
+                    >= sampler_kept("fastpool")[0])
+        wait_until(settled, timeout=30, interval=0.25)
+
+        slow_entries = pool_entries("slowpool")
+        fast_kept, fast_seen = sampler_kept("fastpool")
+        fast_retained = len(pool_entries("fastpool"))
+        multi = sum(1 for e in slow_entries if len(e["daemons"]) >= 2)
+        with_rep_apply = sum(
+            1 for e in slow_entries
+            if any(s.get("name") == "rep_apply" for s in e["spans"]))
+        doc["retention"] = {
+            "slow_written": n_slow,
+            "slow_retained": len(slow_entries),
+            "slow_multi_daemon": multi,
+            "slow_with_rep_apply": with_rep_apply,
+            "slow_reasons": sorted({e["reason"]
+                                    for e in slow_entries}),
+            "fast_written": n_fast,
+            "fast_sampler_seen": fast_seen,
+            "fast_sampler_kept": fast_kept,
+            "fast_retained": fast_retained,
+            "fast_fraction": round(fast_retained / n_fast, 4)}
+        if len(slow_entries) != n_slow:
+            raise SystemExit("forensics gate A: %d/%d injected-slow "
+                             "traces retained"
+                             % (len(slow_entries), n_slow))
+        if multi != n_slow or with_rep_apply != n_slow:
+            raise SystemExit("forensics gate A: %d/%d slow trees "
+                             "multi-daemon, %d/%d carry the replica's "
+                             "rep_apply span"
+                             % (multi, n_slow, with_rep_apply, n_slow))
+        if not all(e["reason"] == "slo" for e in slow_entries):
+            raise SystemExit("forensics gate A: slow traces kept for "
+                             "%r, want 'slo'" % doc["retention"][
+                                 "slow_reasons"])
+        frac = fast_retained / n_fast
+        if not (0.10 <= frac <= 0.45):
+            raise SystemExit("forensics gate A: fast-op retention "
+                             "%.3f outside the reservoir band "
+                             "[0.10, 0.45] at rate %.2f"
+                             % (frac, RATE))
+
+        # -- leg B: attribution --------------------------------------
+        prof = tm.profile("slowpool")
+        doc["attribution"] = prof
+        if not prof["stages"] or prof["stages"][0]["stage"] != \
+                "rep_op":
+            raise SystemExit("forensics gate B: top critical-path "
+                             "stage %r, want 'rep_op' (the injected "
+                             "replica apply stall lives under the "
+                             "remote sub-op leg)"
+                             % (prof["stages"][:1]))
+        doc["attribution_top_fraction"] = prof["stages"][0]["fraction"]
+        if prof["stages"][0]["fraction"] < 0.4:
+            raise SystemExit("forensics gate B: rep_op holds only "
+                             "%.1f%% of the critical path, want >=40%%"
+                             % (100 * prof["stages"][0]["fraction"]))
+        # the SLO health detail must carry the same stamp
+        pq = mgr.modules["perf_query"]
+
+        def health_stamped():
+            pq.evaluate_slo()
+            check = mgr.get_state("health").get("POOL_SLO_VIOLATION")
+            return check is not None and any(
+                "slowpool" in line and "top stage rep_op" in line
+                for line in check.get("detail", ()))
+        if not wait_until(health_stamped, timeout=20, interval=0.5):
+            raise SystemExit("forensics gate B: POOL_SLO_VIOLATION "
+                             "detail never named top stage rep_op")
+        doc["health_detail"] = mgr.get_state("health")[
+            "POOL_SLO_VIOLATION"]["detail"]
+
+        # -- leg C: bounded store under a 10x flood ------------------
+        c.create_replicated_pool(admin, "floodpool", size=2, pg_num=8)
+        io_flood = admin.open_ioctx("floodpool")
+        tm.store_budget = FLOOD_BUDGET
+        base_ingested = tm.status()["ingested_bytes"]
+        flood_writes = 0
+        while flood_writes < 2000:
+            for i in range(100):
+                io_flood.write_full("fl-%d" % (flood_writes + i),
+                                    b"x" * 256)
+            flood_writes += 100
+            tm.flush(2.0)
+            if tm.status()["ingested_bytes"] - base_ingested >= \
+                    10 * FLOOD_BUDGET:
+                break
+        tm.flush(5.0)
+        st = tm.status()
+        doc["flood"] = {"writes": flood_writes,
+                        "budget_bytes": FLOOD_BUDGET,
+                        "ingested_bytes":
+                            st["ingested_bytes"] - base_ingested,
+                        "tracked_bytes": st["tracked_bytes"],
+                        "retained": st["retained"],
+                        "evicted": st["evicted"]}
+        if st["ingested_bytes"] - base_ingested < 10 * FLOOD_BUDGET:
+            raise SystemExit("forensics gate C: flood only pushed %d "
+                             "bytes, wanted >= 10x the %d budget"
+                             % (st["ingested_bytes"] - base_ingested,
+                                FLOOD_BUDGET))
+        if st["tracked_bytes"] > FLOOD_BUDGET:
+            raise SystemExit("forensics gate C: store holds %d bytes "
+                             "over the %d budget"
+                             % (st["tracked_bytes"], FLOOD_BUDGET))
+
+        # -- leg D: interleaved on/off overhead ----------------------
+        # leg C left the store pinned at a full 64 KiB budget; priced
+        # as-is every ON-leg ingest would pay an eviction scan (an
+        # operating point the budget exists to prevent).  Price the
+        # sampling path against a healthy store instead.
+        tm.store_budget = 8 << 20
+
+        def set_rate(rate):
+            for osd in c.osds.values():
+                osd.ctx.conf.set_val("osd_trace_tail_sample_rate",
+                                     rate)
+                osd.ctx.conf.apply_changes()
+
+        def timed_leg(tag, n=150):
+            t0 = time.perf_counter()
+            for i in range(n):
+                # reuse a small object set: leg D prices the sampling
+                # path, not store growth
+                io_fast.write_full("thr-%d" % (i % 32), b"t" * 512)
+            return n / (time.perf_counter() - t0)
+
+        timed_leg("warm")                     # steady-state warmup
+        timed_leg("warm2")
+        thr = {"on": [], "off": []}
+        for rep in range(6):
+            # alternate which mode runs first so slow monotonic drift
+            # (ring fill, history growth) cancels out of the ratio
+            order = ("on", "off") if rep % 2 == 0 else ("off", "on")
+            for mode in order:
+                set_rate(RATE if mode == "on" else 0.0)
+                thr[mode].append(timed_leg("%s%d" % (mode, rep)))
+        # compare PEAK throughput per mode: transient interference on
+        # a shared host only ever subtracts, so the fastest of six
+        # interleaved legs estimates each mode's uncontended capacity
+        # (a median would gate on the host's background load instead
+        # of the sampler)
+        best_on = max(thr["on"])
+        best_off = max(thr["off"])
+        ratio = best_on / best_off
+        doc["overhead"] = {
+            "on_ops_per_s": [round(v, 1) for v in thr["on"]],
+            "off_ops_per_s": [round(v, 1) for v in thr["off"]],
+            "best_on": round(best_on, 1),
+            "best_off": round(best_off, 1),
+            "ratio": round(ratio, 4)}
+        if ratio < 0.97:
+            raise SystemExit("forensics gate D: sampling-on "
+                             "throughput is %.3fx off, want >= 0.97x"
+                             % ratio)
+    finally:
+        ReplicatedBackend.handle_rep_op = orig_rep
+        c.stop()
+
+    doc["value"] = 1
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "FORENSICS_r01.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"retention": doc["retention"],
+                      "attribution_top":
+                      doc["attribution"]["stages"][:1],
+                      "flood": doc["flood"],
+                      "overhead_ratio": doc["overhead"]["ratio"]}))
+    return doc
+
+
 def _harness_brief(stats: dict) -> dict:
     """The artifact keeps the decision-relevant slice of a harness run,
     not the full recorder dump."""
@@ -3331,6 +3632,9 @@ def main() -> None:
     if "--scaleobs" in sys.argv:
         run_scaleobs()
         return
+    if "--forensics" in sys.argv:
+        run_forensics()
+        return
     run_bench()
 
 
@@ -3945,6 +4249,11 @@ if __name__ == "__main__":
         # wire accounting, churn-under-traffic — no supervisor (no
         # device rows)
         run_mapthrash()
+    elif "--forensics" in sys.argv:
+        # SLO-forensics artifact: tail retention, cross-daemon
+        # stitching, critical-path attribution — no supervisor (no
+        # device rows)
+        run_forensics()
     elif "--worker" in sys.argv:
         main()
     else:

@@ -221,10 +221,9 @@ def _declare_defaults():
       "OSD reports device-memory pressure and the monitor raises "
       "DEVICE_MEM_NEARFULL (mon_osd_nearfull_ratio analog for the "
       "device tier)")
-    # tracing (TracepointProvider/blkin gating)
-    o("trace_enable", bool, False, LEVEL_ADVANCED,
-      "collect zipkin-style spans on the op path (legacy utils.trace "
-      "gate; the op-path SpanCollector rides osd_tracing)")
+    # tracing (TracepointProvider/blkin gating).  The legacy
+    # `trace_enable` option (utils.trace gate) is retired: the op-path
+    # SpanCollector rides osd_tracing and the tail sampler below.
     o("osd_tracing", bool, True, LEVEL_ADVANCED,
       "collect ZTracer-style op spans end to end (client -> messenger "
       "-> op queue -> PG -> per-shard sub-ops -> store -> TPU device); "
@@ -234,6 +233,25 @@ def _declare_defaults():
       "trace 1 in N root ops (hot-path sampling knob; 1 = every op)")
     o("osd_tracing_max_spans", int, 8192, LEVEL_ADVANCED,
       "per-daemon bounded span ring capacity (oldest spans drop)")
+    # tail-based trace retention (SLO forensics): the keep/drop call
+    # happens at op COMPLETION on the root daemon, so slow and errored
+    # ops are always kept and dropped traces cost zero wire bytes
+    o("osd_trace_tail_sample_rate", float, 0.0, LEVEL_ADVANCED,
+      "per-pool reservoir probability that a FAST, clean op's trace is "
+      "still shipped to the mgr trace store (the baseline population "
+      "behind the always-kept SLO-slow and errored traces); 0 ships "
+      "only slow/errored traces, 1 ships everything")
+    o("osd_trace_pending_ttl", float, 5.0, LEVEL_ADVANCED,
+      "seconds a replica holds a trace's span fragments waiting for "
+      "the root daemon's keep/drop verdict; expired fragments drop "
+      "silently (the root died or dropped the trace)")
+    o("mgr_trace_store_bytes", int, 4 << 20, LEVEL_ADVANCED,
+      "byte budget for the mgr trace store (stitched cross-daemon "
+      "trees); over budget the coldest/fastest traces evict first, "
+      "slowest-N and errored traces last")
+    o("mgr_trace_protect_slowest", int, 16, LEVEL_ADVANCED,
+      "per-pool slowest-N traces protected from trace-store eviction "
+      "(the flight-recorder slowest_ops discipline, cluster-wide)")
     # per-principal perf queries (osd/perf_query.py + mgr/perf_query.py)
     o("osd_perf_query_max_keys", int, 256, LEVEL_ADVANCED,
       "bound on distinct keys one OSD-side perf query accumulates; "

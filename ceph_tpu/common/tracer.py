@@ -19,6 +19,12 @@ Pieces:
                  `osd_tracing` with an `osd_tracing_sample` 1-in-N knob
                  for hot paths; serves `dump_tracing` / `trace reset`
                  over the admin socket.
+  TailSampler    tail-based retention (Dapper/Canopy discipline): the
+                 keep/drop call moves to op COMPLETION on the root
+                 daemon — SLO-slow, errored, or reservoir-sampled
+                 traces ship to the mgr trace store; replicas buffer
+                 fragments until the verdict and dropped traces cost
+                 zero wire bytes.
   trace_ctx      (trace_id, parent_span_id) for a message envelope.
   device_segments  the one device-call shape everyone shares: run a
                  codec call split into h2d / compute / d2h segments
@@ -33,13 +39,15 @@ from __future__ import annotations
 
 import itertools
 import os
+import random
 import threading
 import time
 from collections import deque
 
 import numpy as np
 
-__all__ = ["Span", "NULL_SPAN", "SpanCollector", "trace_ctx",
+__all__ = ["Span", "NULL_SPAN", "SpanCollector", "TailSampler",
+           "parse_slo_targets", "trace_ctx", "wire_span",
            "device_segments", "render_tree"]
 
 # span ids must be unique ACROSS daemons for one trace (shards' spans
@@ -121,6 +129,19 @@ class Span:
                 "keyvals": dict(self.keyvals),
                 "events": list(self.events)}
 
+    def dump_wire(self) -> list:
+        """Compact fixed-order form for MTraceFragment payloads (see
+        wire_span): a fragment carries dozens of spans, and encoding
+        ten string keys per span would dominate the shipping cost.
+        trace_id and start_wall are omitted — the fragment envelope
+        carries the trace_id and the (anchor_wall, anchor_mono) pair
+        that re-anchors `start`."""
+        return [self.span_id, self.parent_id, self.name,
+                self.endpoint, self.start,
+                (self.end if self.end is not None
+                 else time.monotonic()) - self.start,
+                dict(self.keyvals), list(self.events)]
+
 
 class _NullSpan:
     """Shared no-op span: the disabled-tracing fast path."""
@@ -163,6 +184,40 @@ def trace_ctx(span) -> tuple[int, int]:
     return (span.trace_id, span.span_id)
 
 
+def wire_span(rec, trace_id: int) -> dict:
+    """Expand one Span.dump_wire record back into the dict form the
+    stores and render_tree consume."""
+    return {"trace_id": trace_id, "span_id": rec[0],
+            "parent_id": rec[1], "name": rec[2], "endpoint": rec[3],
+            "start": rec[4], "duration": rec[5],
+            "keyvals": rec[6], "events": rec[7]}
+
+
+def parse_slo_targets(raw: str) -> dict:
+    """'pool:latency_ms:objective,...' -> {pool: (threshold_s,
+    objective)}; malformed entries are skipped, never fatal.  Shared
+    by the mgr SLO evaluator and the OSD tail sampler so both judge
+    "slow" against the identical per-pool threshold."""
+    out = {}
+    for entry in (raw or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.rsplit(":", 2)
+        if len(parts) != 3:
+            continue
+        pool, lat_ms, objective = parts
+        try:
+            lat_s = float(lat_ms) / 1e3
+            obj = float(objective)
+        except ValueError:
+            continue
+        if not pool or lat_s <= 0 or not 0.0 < obj < 1.0:
+            continue
+        out[pool] = (lat_s, obj)
+    return out
+
+
 class SpanCollector:
     """Per-daemon bounded span store, `osd_tracing`-gated.
 
@@ -202,6 +257,9 @@ class SpanCollector:
                 conf.add_observer(_Obs())
         self.capacity = capacity
         self._spans: deque[Span] = deque(maxlen=capacity)
+        #: optional TailSampler: every recorded span is offered to it
+        #: so replicas can buffer fragments pending the root's verdict
+        self.tail = None
 
     # -- span minting --------------------------------------------------
 
@@ -230,6 +288,9 @@ class SpanCollector:
     def _record(self, span: Span) -> None:
         with self._lock:
             self._spans.append(span)
+        tail = self.tail
+        if tail is not None:
+            tail.observe(span)
 
     def dump(self, trace_id: int | None = None) -> list[dict]:
         with self._lock:
@@ -256,6 +317,158 @@ class SpanCollector:
         asok.register("trace reset",
                       lambda args: (self.clear(), {"reset": True})[1],
                       "drop all collected spans")
+
+
+class TailSampler:
+    """Tail-based trace retention: the keep/drop call at op COMPLETION.
+
+    Two roles share one object per daemon:
+
+      root side     `verdict(pool, duration, result, spans)` decides
+                    keep/drop once the op's wall latency and result are
+                    known — keep if latency exceeds the pool's SLO
+                    threshold (`mgr_slo_pool_targets`, the same string
+                    the mgr burns against), if the op errored or any
+                    span logged an error event, or by a reservoir draw
+                    (`osd_trace_tail_sample_rate`).
+      replica side  `observe(span)` (fed by SpanCollector._record via
+                    `.tail`) buffers finished span fragments keyed by
+                    trace_id; `take(trace_id)` pops them when the
+                    root's verdict arrives; fragments whose verdict
+                    never comes expire after `osd_trace_pending_ttl`
+                    seconds — a dropped trace costs zero wire bytes.
+
+    The RNG is injectable so reservoir statistics are testable on a
+    seeded stream.  The pending buffer is bounded (drop-oldest).
+    """
+
+    def __init__(self, conf=None, rng=None, max_pending: int = 4096):
+        self._lock = threading.Lock()
+        self.rng = rng if rng is not None else random.Random()
+        self.rate = 0.0
+        self.pending_ttl = 5.0
+        self.slo_targets: dict = {}
+        self.max_pending = max_pending
+        self._pending: dict[int, tuple[float, list]] = {}
+        self._last_sweep = time.monotonic()
+        self.stats = {"kept_slo": 0, "kept_error": 0,
+                      "kept_reservoir": 0, "dropped": 0,
+                      "pending_expired": 0, "pending_overflow": 0}
+        self.pool_stats: dict[str, dict] = {}
+        if conf is not None:
+            try:
+                self.rate = float(
+                    conf.get_val("osd_trace_tail_sample_rate"))
+                self.pending_ttl = float(
+                    conf.get_val("osd_trace_pending_ttl"))
+                self.slo_targets = parse_slo_targets(
+                    conf.get_val("mgr_slo_pool_targets"))
+            except KeyError:
+                pass  # options not in the schema: defaults stand
+            else:
+                sampler = self
+
+                class _Obs:  # md_config_obs_t contract
+                    def get_tracked_keys(self):
+                        return ("osd_trace_tail_sample_rate",
+                                "osd_trace_pending_ttl",
+                                "mgr_slo_pool_targets")
+
+                    def handle_conf_change(self, cfg, changed):
+                        sampler.rate = float(
+                            cfg.get_val("osd_trace_tail_sample_rate"))
+                        sampler.pending_ttl = float(
+                            cfg.get_val("osd_trace_pending_ttl"))
+                        sampler.slo_targets = parse_slo_targets(
+                            cfg.get_val("mgr_slo_pool_targets"))
+
+                conf.add_observer(_Obs())
+
+    # -- root side: the keep/drop call ---------------------------------
+
+    def verdict(self, pool: str, duration: float, result,
+                spans=None) -> tuple[bool, str]:
+        """(keep, reason) for a completed root op; reason one of
+        "slo" | "error" | "reservoir" | ""."""
+        keep, reason = False, ""
+        tgt = self.slo_targets.get(pool)
+        if tgt is not None and duration > tgt[0]:
+            keep, reason = True, "slo"
+        elif (result is not None and result < 0) or \
+                self._has_error_event(spans):
+            keep, reason = True, "error"
+        elif self.rate > 0.0 and self.rng.random() < self.rate:
+            keep, reason = True, "reservoir"
+        ps = self.pool_stats.setdefault(
+            pool, {"seen": 0, "kept": 0})
+        ps["seen"] += 1
+        if keep:
+            ps["kept"] += 1
+            self.stats["kept_" + reason] += 1
+        else:
+            self.stats["dropped"] += 1
+        return keep, reason
+
+    @staticmethod
+    def _has_error_event(spans) -> bool:
+        for s in spans or ():
+            events = s[7] if isinstance(s, (list, tuple)) \
+                else s.get("events")
+            for _, name in (events or ()):
+                if str(name).startswith("error"):
+                    return True
+        return False
+
+    # -- replica side: pending fragments -------------------------------
+
+    def observe(self, span) -> None:
+        """Buffer a finished span under its trace_id until the root's
+        verdict arrives (or the TTL reaps it) — in the compact
+        dump_wire form, ready to ship without another conversion."""
+        if not span.trace_id:
+            return
+        now = time.monotonic()
+        with self._lock:
+            entry = self._pending.get(span.trace_id)
+            if entry is None:
+                if len(self._pending) >= self.max_pending:
+                    oldest = min(self._pending,
+                                 key=lambda t: self._pending[t][0])
+                    del self._pending[oldest]
+                    self.stats["pending_overflow"] += 1
+                entry = self._pending[span.trace_id] = (now, [])
+            entry[1].append(span.dump_wire())
+        self._maybe_sweep(now)
+
+    def take(self, trace_id: int):
+        """Pop and return a trace's buffered span dumps (None if the
+        TTL already reaped them or nothing was traced here)."""
+        with self._lock:
+            entry = self._pending.pop(trace_id, None)
+        return entry[1] if entry is not None else None
+
+    def pending_traces(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def sweep(self, now: float | None = None) -> int:
+        """Reap pending fragments older than the TTL (the root died or
+        judged drop — drops send nothing).  Returns traces reaped."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            dead = [tid for tid, (t0, _) in self._pending.items()
+                    if now - t0 > self.pending_ttl]
+            for tid in dead:
+                del self._pending[tid]
+            self.stats["pending_expired"] += len(dead)
+        return len(dead)
+
+    def _maybe_sweep(self, now: float) -> None:
+        # opportunistic, timer-free: at most ~1 sweep/second, driven
+        # by whatever traffic flows through observe()
+        if now - self._last_sweep >= 1.0:
+            self._last_sweep = now
+            self.sweep(now)
 
 
 # -- shared device-call segmentation -----------------------------------
@@ -301,9 +514,9 @@ def render_tree(spans: list[dict], trace_id: int | None = None) -> str:
     """Render stitched spans (possibly gathered from several daemons'
     dump_tracing) as an indented tree with self-times.  Spans whose
     parent is not in the set render as roots — a partial gather still
-    produces a readable forest.  Within one daemon children sort by
-    monotonic start; across daemons by wall stamp (monotonic clocks
-    don't compare across processes)."""
+    produces a readable forest.  Siblings sort by wall stamp (the
+    anchor-aligned "wall" when the mgr stitched them, start_wall
+    otherwise) — monotonic clocks don't compare across processes."""
     if trace_id is not None:
         spans = [s for s in spans if s.get("trace_id") == trace_id]
     if not spans:
@@ -319,10 +532,16 @@ def render_tree(spans: list[dict], trace_id: int | None = None) -> str:
             roots.append(s)
 
     def order(kids: list) -> list:
-        endpoints = {k.get("endpoint") for k in kids}
-        if len(endpoints) > 1:
-            return sorted(kids, key=lambda s: s.get("start_wall", 0.0))
-        return sorted(kids, key=lambda s: s.get("start", 0.0))
+        # sort siblings uniformly by the wall axis: "wall" is the
+        # anchor-aligned stamp the mgr stitcher computes per fragment,
+        # start_wall the span's own time.time() fallback.  Monotonic
+        # `start` never orders spans across processes, and mixing the
+        # two keys (the old endpoint-count special case) mis-ordered
+        # same-endpoint siblings whenever a cross-daemon sibling sat
+        # beside them.
+        return sorted(kids, key=lambda s: (
+            s.get("wall", s.get("start_wall", 0.0)),
+            s.get("start", 0.0)))
 
     lines: list[str] = []
     traces = sorted({s.get("trace_id") for s in spans})

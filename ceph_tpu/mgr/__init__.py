@@ -20,3 +20,4 @@ from .modules import (BalancerModule, PrometheusModule,  # noqa: F401
                       StatusModule)
 from .perf_query import PerfQueryModule  # noqa: F401
 from .progress import ProgressModule  # noqa: F401
+from .trace_store import TraceModule  # noqa: F401

@@ -141,6 +141,20 @@ class MgrDaemon(Dispatcher):
                      "tracked bytes as % of mgr_metrics_mem_budget")
             .add_u64("l_mgr_metrics_evictions",
                      "series dropped by budget eviction (cumulative)")
+            # trace store (mgr/trace_store.py; counters live in the
+            # daemon's group because the collection keys one
+            # PerfCounters per group name — the module increments them)
+            .add_u64_counter("l_mgr_trace_fragments",
+                             "MTraceFragments stitched into the store")
+            .add_u64_counter("l_mgr_trace_spans",
+                             "span fragments ingested")
+            .add_u64("l_mgr_trace_bytes",
+                     "bytes the trace store accounts for")
+            .add_u64("l_mgr_trace_stored",
+                     "stitched traces currently retained")
+            .add_u64("l_mgr_trace_evicted",
+                     "traces evicted at the store byte budget "
+                     "(cumulative)")
             .create_perf_counters())
         self.ctx.perf.add(self.perf)
         self.modules: dict[str, object] = {}
@@ -380,6 +394,32 @@ class MgrDaemon(Dispatcher):
             "perf query",
             self._perf_query_control,
             "add/rm/ls dynamic per-principal OSD perf queries")
+        # trace forensics (mgr/trace_store.py) — cluster-wide, no
+        # per-daemon asok hop; lazy lookup like the perf_query hooks
+        asok.register(
+            "trace slowest",
+            lambda args: self._trace_asok(
+                "slowest", pool=args.get("pool") or None,
+                count=int(args.get("count") or 10)),
+            "slowest retained traces cluster-wide, with their "
+            "dominant critical-path stage")
+        asok.register(
+            "trace show",
+            lambda args: self._trace_asok(
+                "show", args.get("trace_id") or args.get("key")
+                or "0"),
+            "one stitched cross-daemon trace tree + critical path")
+        asok.register(
+            "trace profile",
+            lambda args: self._trace_asok(
+                "profile", args.get("pool") or ""),
+            "cross-trace critical-path profile for a pool")
+
+    def _trace_asok(self, method: str, *args, **kwargs):
+        mod = self.modules.get("trace")
+        if mod is None:
+            return {"error": "trace module not enabled"}
+        return getattr(mod, method)(*args, **kwargs)
 
     def _perf_query_asok(self, method: str, **kwargs):
         mod = self.modules.get("perf_query")
@@ -502,6 +542,15 @@ class MgrDaemon(Dispatcher):
             if mod is not None:
                 try:
                     mod.handle_query_reply(msg)
+                except Exception:
+                    pass
+            return True
+        if msg.get_type() == "MTraceFragment":
+            mod = self.modules.get("trace")
+            if mod is not None:
+                try:
+                    mod.enqueue(msg)   # one append; the module's own
+                    #                    lane does the stitching
                 except Exception:
                     pass
             return True

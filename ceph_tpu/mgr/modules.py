@@ -424,6 +424,38 @@ class PrometheusModule(MgrModule):
                                ">1.0 raises POOL_SLO_VIOLATION")
                     emit("ceph_pool_slo_violation_fraction",
                          r.get("violation_fraction", 0.0), plbl)
+        # trace forensics (mgr/trace_store.py): per-(pool, stage)
+        # critical-path seconds from the retained cross-daemon trees,
+        # plus one bounded exemplar series per pool — the SLOWEST
+        # retained trace's id as a label (plain series, not the
+        # OpenMetrics exemplar syntax: the exposition lint and scrape
+        # grammar here are text-format only).  Cardinality is bounded
+        # by construction: pools × pipeline stages, one slowest row
+        # per pool, store gauges.
+        tm = self.mgr.modules.get("trace")
+        if tm is not None and hasattr(tm, "prom_stats"):
+            tstats = tm.prom_stats()
+            for pool, stages in sorted(
+                    tstats.get("critical_path", {}).items()):
+                for stage, sec in sorted(stages.items()):
+                    emit("ceph_trace_critical_path_seconds", sec,
+                         {"pool": pool, "stage": stage},
+                         mtype="counter",
+                         help_="summed critical-path seconds "
+                               "attributed to a pipeline stage "
+                               "across the pool's retained traces")
+            for pool, (tid, dur) in sorted(
+                    tstats.get("slowest", {}).items()):
+                emit("ceph_trace_slowest_seconds", dur,
+                     {"pool": pool, "trace_id": tid},
+                     help_="wall latency of the pool's slowest "
+                           "retained trace; trace_id is the exemplar "
+                           "for `ceph trace show`")
+            emit("ceph_trace_store_bytes",
+                 tstats.get("tracked_bytes", 0),
+                 help_="bytes the mgr trace store accounts for")
+            emit("ceph_trace_retained", tstats.get("retained", 0),
+                 help_="stitched traces currently retained")
         # per-daemon perf counters (reference: perf_counters as
         # ceph_<daemon-type>_<counter>{ceph_daemon=...}); this includes
         # the l_bluefs_* and l_tpu_* groups the OSDs register.

@@ -41,27 +41,10 @@ _ROW_COUNTERS = ("ops", "rd_ops", "wr_ops", "rd_bytes", "wr_bytes",
                  "lat_sum", "lat_count")
 
 
-def _parse_slo_targets(raw: str) -> dict:
-    """'pool:latency_ms:objective,...' -> {pool: (threshold_s,
-    objective)}; malformed entries are skipped, never fatal."""
-    out = {}
-    for entry in (raw or "").split(","):
-        entry = entry.strip()
-        if not entry:
-            continue
-        parts = entry.rsplit(":", 2)
-        if len(parts) != 3:
-            continue
-        pool, lat_ms, objective = parts
-        try:
-            lat_s = float(lat_ms) / 1e3
-            obj = float(objective)
-        except ValueError:
-            continue
-        if not pool or lat_s <= 0 or not 0.0 < obj < 1.0:
-            continue
-        out[pool] = (lat_s, obj)
-    return out
+# the parser lives in common/tracer.py now: the OSD tail sampler must
+# judge "slow" against the IDENTICAL per-pool threshold the burn math
+# uses (kept as an alias for importers)
+from ..common.tracer import parse_slo_targets as _parse_slo_targets
 
 
 def _hist_percentile(buckets: list, bounds: list, q: float) -> float:
@@ -427,14 +410,21 @@ class PerfQueryModule(MgrModule):
             self._slo_alerting = bool(violating)
         checks = {}
         if violating:
-            detail = [
-                "pool '%s': %.1f%% of ops over %.0fms (objective "
-                "%.2f%%, burn %.2fx)"
-                % (p, 100 * state[p]["violation_fraction"],
-                   state[p]["threshold_ms"],
-                   100 * state[p]["objective"],
-                   state[p]["burn_ratio"])
-                for p in sorted(violating)]
+            detail = []
+            for p in sorted(violating):
+                line = ("pool '%s': %.1f%% of ops over %.0fms "
+                        "(objective %.2f%%, burn %.2fx)"
+                        % (p, 100 * state[p]["violation_fraction"],
+                           state[p]["threshold_ms"],
+                           100 * state[p]["objective"],
+                           state[p]["burn_ratio"]))
+                # forensics stamp: WHERE in the pipeline the burn
+                # lives, from the trace store's critical-path profile
+                top = self._trace_top_stage(p)
+                if top is not None:
+                    line += ", top stage %s (%d%%)" \
+                        % (top[0], round(100 * top[1]))
+                detail.append(line)
             checks[self.SLO_CHECK] = {
                 "severity": "warning",
                 "summary": "%d pool(s) violating their latency SLO"
@@ -446,6 +436,18 @@ class PerfQueryModule(MgrModule):
         if self.qos_adaptive and violating:
             self._qos_adapt(sorted(violating), now)
         return state
+
+    def _trace_top_stage(self, pool: str):
+        """(stage, fraction) from the trace module's cross-trace
+        critical-path profile — None when the module isn't loaded or
+        retains nothing for the pool."""
+        mod = self.mgr.modules.get("trace")
+        if mod is None:
+            return None
+        try:
+            return mod.top_stage(pool)
+        except Exception:
+            return None
 
     def _qos_adapt(self, violating: list, now: float) -> None:
         """SLO-driven reservation loop: each still-burning pool gets a

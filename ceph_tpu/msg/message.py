@@ -34,7 +34,7 @@ __all__ = [
     "MMgrReportAck",
     "MMDSBeacon", "MMDSMap", "MClientRequest", "MClientReply",
     "MAuthMap", "MLog", "MPGStats", "MBackfillReserve",
-    "MOSDPerfQuery", "MOSDPerfQueryReply",
+    "MOSDPerfQuery", "MOSDPerfQueryReply", "MTraceFragment",
 ]
 
 _seq = itertools.count(1)
@@ -541,6 +541,37 @@ class MOSDPerfQueryReply(Message):
     query_id: int = 0
     result: int = 0
     queries: dict = field(default_factory=dict)
+
+
+@dataclass
+class MTraceFragment(Message):
+    """Tail-sampled trace plumbing, two ops on one type:
+
+      op="verdict"  root OSD -> replica OSDs: the keep decision for
+                    `trace_id` made at op completion (SLO-slow /
+                    errored / reservoir).  Only KEEPS are sent — a
+                    dropped trace costs zero wire bytes; replicas
+                    expire unjudged fragments after
+                    `osd_trace_pending_ttl`.
+      op="ship"     OSD -> mgr: the daemon's span fragments for a kept
+                    trace.  `anchor_wall`/`anchor_mono` pair the
+                    sender's monotonic clock with its wall clock at
+                    ship time so the mgr aligns spans from different
+                    processes on one wall axis.
+
+    `reason` is slo | error | reservoir; `duration` the root op's wall
+    latency (the store's eviction/protection temperature)."""
+    op: str = "ship"
+    trace_id: int = 0
+    daemon_name: str = ""
+    pool: str = ""
+    op_type: str = ""
+    keep: bool = False
+    reason: str = ""
+    duration: float = 0.0
+    spans: list = field(default_factory=list)    # span dump() dicts
+    anchor_wall: float = 0.0
+    anchor_mono: float = 0.0
 
 
 # -- mds / cephfs ------------------------------------------------------

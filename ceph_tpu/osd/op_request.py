@@ -41,10 +41,17 @@ class OpRequest:
         # SpanCollector ring rolls over
         self.trace_id: int | None = None
         self.trace_spans: list[dict] | None = None
+        # tail-sampler verdict: did this op's trace ship to the mgr
+        # trace store, and why (slo | error | reservoir | "")
+        self.trace_kept: bool = False
+        self.trace_reason: str = ""
 
-    def set_trace(self, trace_id: int, spans: list[dict]) -> None:
+    def set_trace(self, trace_id: int, spans: list[dict],
+                  kept: bool = False, reason: str = "") -> None:
         self.trace_id = trace_id
         self.trace_spans = spans
+        self.trace_kept = kept
+        self.trace_reason = reason
 
     def mark_event(self, name: str) -> None:
         self.events.append((time.monotonic(), name))
@@ -84,6 +91,8 @@ class OpRequest:
         }
         if self.trace_spans is not None:
             doc["type_data"]["trace"] = {"trace_id": self.trace_id,
+                                         "kept": self.trace_kept,
+                                         "reason": self.trace_reason,
                                          "spans": self.trace_spans}
         return doc
 

@@ -26,7 +26,7 @@ from ..msg.async_messenger import create_messenger
 from ..msg.messenger import Dispatcher
 from ..store.mem_store import MemStore
 from ..common.lockdep import make_rlock
-from ..common.tracer import SpanCollector
+from ..common.tracer import SpanCollector, TailSampler
 from .op_queue import QosShardedOpWQ, make_op_queue
 from .op_request import OpTracker
 from .osd_map import OSDMap
@@ -180,6 +180,24 @@ class OSDDaemon(Dispatcher):
         # daemons via the message-envelope (trace_id, parent_span)
         self.tracer = SpanCollector(conf=conf,
                                     endpoint="osd.%d" % whoami)
+        # tail-based trace retention (SLO forensics): keep/drop at op
+        # completion; finished spans buffer here pending the root's
+        # verdict and kept traces ship to the mgr as MTraceFragments
+        self.tail = TailSampler(conf=conf)
+        self.tracer.tail = self.tail
+        self._tail_expired_synced = 0
+        # kept-trace wire work (verdict broadcast + mgr shipment) runs
+        # on its own lane: the verdict itself is cheap, but encoding
+        # span payloads on the commit path would tax every op that
+        # completes behind a kept one
+        from collections import deque as _deque
+        self._trace_ship_cond = threading.Condition()
+        self._trace_ship_q = _deque()
+        self._trace_ship_stop = False
+        self._trace_ship_thread = threading.Thread(
+            target=self._trace_ship_loop,
+            name="trace-ship-%d" % whoami, daemon=True)
+        self._trace_ship_thread.start()
         if self.ctx.admin_socket is not None:
             self.op_tracker.register_admin_commands(self.ctx.admin_socket)
             self.tracer.register_admin_commands(self.ctx.admin_socket)
@@ -441,6 +459,27 @@ class OSDDaemon(Dispatcher):
                      .add_u64("l_osd_map_lag_epochs",
                               "osdmap epochs this daemon trails the "
                               "monitor (backlog + unfetched)")
+                     # tail-based trace retention (SLO forensics):
+                     # verdicts by reason, plus the replica-side
+                     # pending-buffer churn
+                     .add_u64_counter("l_osd_trace_tail_kept_slo",
+                                      "traces kept: op latency over "
+                                      "the pool's SLO threshold")
+                     .add_u64_counter("l_osd_trace_tail_kept_error",
+                                      "traces kept: op errored or a "
+                                      "span logged an error event")
+                     .add_u64_counter("l_osd_trace_tail_kept_reservoir",
+                                      "traces kept by the baseline "
+                                      "reservoir draw")
+                     .add_u64_counter("l_osd_trace_tail_dropped",
+                                      "traces judged drop at "
+                                      "completion (zero wire bytes)")
+                     .add_u64_counter("l_osd_trace_tail_shipped_spans",
+                                      "span fragments shipped to the "
+                                      "mgr trace store")
+                     .add_u64_counter("l_osd_trace_tail_expired",
+                                      "pending replica fragments "
+                                      "reaped by the verdict TTL")
                      .create_perf_counters())
         self.ctx.perf.add(self.perf)
         # per-principal perf-query engine (osd/perf_query.py): the
@@ -524,6 +563,9 @@ class OSDDaemon(Dispatcher):
 
     def shutdown(self) -> None:
         self._running = False
+        with self._trace_ship_cond:
+            self._trace_ship_stop = True
+            self._trace_ship_cond.notify()
         self.timer.shutdown()
         if self.tpu_dispatcher is not None:
             self.tpu_dispatcher.shutdown()
@@ -1136,6 +1178,9 @@ class OSDDaemon(Dispatcher):
         if t == "MMgrReportAck":
             self._mgr_reporter.ack(msg.ack_seq, resync=msg.resync)
             return True
+        if t == "MTraceFragment":
+            self._handle_trace_verdict(msg)
+            return True
         if t in ("MOSDECSubOpWrite", "MOSDECSubOpWriteReply",
                  "MOSDECSubOpRead", "MOSDECSubOpReadReply",
                  "MOSDECSubOpRepairRead", "MOSDECSubOpRepairReadReply",
@@ -1164,6 +1209,124 @@ class OSDDaemon(Dispatcher):
                 MOSDPerfQueryReply(query_id=msg.query_id,
                                    result=result, queries=queries),
                 msg.from_addr)
+
+    # -- tail-based trace retention (SLO forensics) --------------------
+
+    def _trace_tail_verdict(self, pg, span, op, result,
+                            op_type: str) -> tuple[bool, str]:
+        """Root-side keep/drop for a completed client op's trace.
+        Returns (kept, reason).  On keep: this daemon's buffered
+        fragments ship to the mgr and the verdict broadcasts to the
+        acting set so replicas release theirs.  Spans still open at
+        reply time (a synchronous read's pg_do_op) miss the shipment —
+        the same snapshot boundary the flight recorder has."""
+        tail = self.tail
+        spans = tail.take(span.trace_id) or []
+        pool_name = ""
+        if pg is not None:
+            pool = self.osdmap.pools.get(pg.pgid.pool)
+            if pool is not None:
+                pool_name = pool.name
+        duration = op.duration
+        kept, reason = tail.verdict(pool_name, duration, result, spans)
+        self._sync_tail_perf()
+        if not kept:
+            self.perf.inc("l_osd_trace_tail_dropped")
+            return False, ""
+        self.perf.inc("l_osd_trace_tail_kept_" + reason)
+        # slo/error keeps are forensic: pull the replicas' fragments
+        # for a full cross-daemon tree.  Reservoir keeps are the
+        # baseline latency population — the root's own tree suffices,
+        # and skipping the broadcast keeps the steady-state sampling
+        # cost at one shipment per kept op (replica fragments TTL out)
+        if pg is not None and reason != "reservoir":
+            from ..msg.message import MTraceFragment
+            for peer in getattr(pg, "acting", ()):
+                if peer == self.whoami:
+                    continue
+                self._trace_ship_enqueue("osd", peer, MTraceFragment(
+                    op="verdict", trace_id=span.trace_id,
+                    daemon_name="osd.%d" % self.whoami,
+                    pool=pool_name, op_type=op_type, keep=True,
+                    reason=reason, duration=duration))
+        self._ship_trace_fragments(span.trace_id, spans, pool_name,
+                                   op_type, duration, reason)
+        return True, reason
+
+    def _ship_trace_fragments(self, trace_id: int, spans: list,
+                              pool: str, op_type: str, duration: float,
+                              reason: str) -> None:
+        """OSD -> mgr: one MTraceFragment with this daemon's span
+        dumps for a kept trace, anchored so the mgr can place the
+        sender's monotonic stamps on a shared wall axis.  The anchor
+        pair is stamped HERE (one instant) — the ship lane may send
+        it later, which cannot skew the alignment."""
+        if not spans:
+            return
+        from ..msg.message import MTraceFragment
+        self.perf.inc("l_osd_trace_tail_shipped_spans", len(spans))
+        # bulk diagnostic payload: pack the span records into ONE
+        # opaque blob so the wire codec prices a single bytes value,
+        # not hundreds of tagged ones (json round-trips the compact
+        # dump_wire lists; exotic keyval types fall back to raw)
+        try:
+            import json as _json
+            spans = _json.dumps(spans,
+                                separators=(",", ":")).encode()
+        except (TypeError, ValueError):
+            pass
+        self._trace_ship_enqueue("mgr", None, MTraceFragment(
+            op="ship", trace_id=trace_id,
+            daemon_name="osd.%d" % self.whoami,
+            pool=pool, op_type=op_type, keep=True,
+            reason=reason, duration=duration, spans=spans,
+            anchor_wall=time.time(),
+            anchor_mono=time.monotonic()))
+
+    def _trace_ship_enqueue(self, kind: str, target, msg) -> None:
+        with self._trace_ship_cond:
+            self._trace_ship_q.append((kind, target, msg))
+            self._trace_ship_cond.notify()
+
+    def _trace_ship_loop(self) -> None:
+        while True:
+            with self._trace_ship_cond:
+                while not self._trace_ship_q and \
+                        not self._trace_ship_stop:
+                    self._trace_ship_cond.wait(0.5)
+                if self._trace_ship_stop and not self._trace_ship_q:
+                    return
+                batch = list(self._trace_ship_q)
+                self._trace_ship_q.clear()
+            for kind, target, msg in batch:
+                try:
+                    if kind == "osd":
+                        self.send_to_osd_cluster(target, msg)
+                    elif self.mgr_addr is not None:
+                        self.public_msgr.send_message(msg,
+                                                      self.mgr_addr)
+                except Exception:
+                    pass       # a lost fragment is a lost fragment
+
+    def _handle_trace_verdict(self, msg) -> None:
+        """Replica side: the root's keep verdict arrived — ship the
+        fragments buffered under that trace_id (drop verdicts are
+        never sent; the pending TTL reaps those fragments)."""
+        spans = self.tail.take(msg.trace_id)
+        if msg.keep and spans:
+            self._ship_trace_fragments(msg.trace_id, spans, msg.pool,
+                                       msg.op_type, msg.duration,
+                                       msg.reason)
+        self._sync_tail_perf()
+
+    def _sync_tail_perf(self) -> None:
+        """Fold the TailSampler's TTL-reap count into the perf stream
+        (the sampler itself has no perf handle)."""
+        expired = self.tail.stats["pending_expired"]
+        delta = expired - self._tail_expired_synced
+        if delta > 0:
+            self._tail_expired_synced = expired
+            self.perf.inc("l_osd_trace_tail_expired", delta)
 
     WRITE_OP_KINDS = frozenset((
         "create", "write", "writefull", "append", "zero", "truncate",
@@ -1349,6 +1512,19 @@ class OSDDaemon(Dispatcher):
                 client_addr)
             span.keyval("result", result)
             span.finish()
+            # tail-sampler verdict (SLO forensics): judge the finished
+            # trace HERE, where latency and result are known — keep
+            # ships this daemon's fragments to the mgr and the verdict
+            # to the acting set; drop sends nothing anywhere (replica
+            # TTLs reap the unjudged fragments)
+            kept, reason = False, ""
+            if span.valid():
+                try:
+                    kept, reason = self._trace_tail_verdict(
+                        pg, span, op, result,
+                        "write" if mutating else "read")
+                except Exception:
+                    pass
             # flight recorder: snapshot the finished trace tree onto
             # the op BEFORE mark_done files it into history — the
             # historic dump keeps the cross-daemon tree even after the
@@ -1357,7 +1533,8 @@ class OSDDaemon(Dispatcher):
                 try:
                     op.set_trace(span.trace_id,
                                  self.tracer.dump(
-                                     trace_id=span.trace_id))
+                                     trace_id=span.trace_id),
+                                 kept=kept, reason=reason)
                 except Exception:
                     pass
             op.mark_done()

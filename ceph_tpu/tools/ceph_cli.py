@@ -319,6 +319,70 @@ def mgr_ingest_status_command(asoks: list[str]) -> int:
         else 1
 
 
+def trace_slowest_command(asoks: list[str], pool, count: int) -> int:
+    """`ceph trace slowest [--pool P] --asok MGR`: the slowest
+    retained traces cluster-wide — the mgr trace store serves the
+    stitched view, no per-daemon asok hop."""
+    client = _mgr_asok(asoks, "trace slowest")
+    if client is None:
+        return 1
+    try:
+        reply = client.do_request("trace slowest",
+                                  pool=pool, count=count)
+    except (OSError, ValueError) as e:
+        sys.stderr.write("ceph trace slowest: %s\n" % e)
+        return 1
+    sys.stdout.write(json.dumps(reply, indent=1, default=str) + "\n")
+    return 0 if not (isinstance(reply, dict) and "error" in reply) \
+        else 1
+
+
+def trace_show_command(words: list[str], asoks: list[str]) -> int:
+    """`ceph trace show <trace_id> --asok MGR`: one stitched
+    cross-daemon tree + its critical path, from the mgr store."""
+    if not words:
+        sys.stderr.write("ceph: trace show needs a trace id\n")
+        return 1
+    client = _mgr_asok(asoks, "trace show")
+    if client is None:
+        return 1
+    try:
+        reply = client.do_request("trace show", trace_id=words[0])
+    except (OSError, ValueError) as e:
+        sys.stderr.write("ceph trace show: %s\n" % e)
+        return 1
+    if isinstance(reply, dict) and "error" in reply:
+        sys.stderr.write("ceph trace show: %s\n" % reply["error"])
+        return 1
+    if isinstance(reply, dict) and reply.get("tree"):
+        meta = {k: v for k, v in reply.items() if k != "tree"}
+        sys.stdout.write(reply["tree"] + "\n"
+                         + json.dumps(meta, indent=1, default=str)
+                         + "\n")
+    else:
+        sys.stdout.write(json.dumps(reply, indent=1, default=str)
+                         + "\n")
+    return 0
+
+
+def trace_profile_command(words: list[str], asoks: list[str],
+                          pool) -> int:
+    """`ceph trace profile <pool> --asok MGR`: the pool's cross-trace
+    critical-path profile ("41% tpu_queue, 22% sub_write...")."""
+    target = words[0] if words else (pool or "")
+    client = _mgr_asok(asoks, "trace profile")
+    if client is None:
+        return 1
+    try:
+        reply = client.do_request("trace profile", pool=target)
+    except (OSError, ValueError) as e:
+        sys.stderr.write("ceph trace profile: %s\n" % e)
+        return 1
+    sys.stdout.write(json.dumps(reply, indent=1, default=str) + "\n")
+    return 0 if not (isinstance(reply, dict) and "error" in reply) \
+        else 1
+
+
 def daemon_command(words: list[str]) -> int:
     """`ceph daemon <asok-path> <command...>`: talk straight to one
     daemon's unix admin socket (perf dump, dump_ops_in_flight,
@@ -377,7 +441,10 @@ def main(argv=None) -> int:
                         "slo status --asok MGR | "
                         "mgr ingest status --asok MGR | "
                         "daemon ASOK CMD... | "
-                        "trace tree TRACE_ID --asok PATH...")
+                        "trace tree TRACE_ID --asok PATH... | "
+                        "trace slowest [--pool P] --asok MGR | "
+                        "trace show TRACE_ID --asok MGR | "
+                        "trace profile POOL --asok MGR")
     p.add_argument("--period", type=float, default=1.0,
                    help="iostat sampling window/interval, seconds")
     p.add_argument("--count", type=int, default=1,
@@ -398,6 +465,16 @@ def main(argv=None) -> int:
         return daemon_command(args.words[1:])   # no mon connection
     if args.words[:2] == ["trace", "tree"]:
         return trace_tree_command(args.words[2:], args.asok or [])
+    # forensics surfaces: the mgr trace store serves these cluster-
+    # wide (unlike `trace tree`, which asok-hops every daemon)
+    if args.words[:2] == ["trace", "slowest"]:
+        return trace_slowest_command(args.asok or [], args.pool,
+                                     args.count)
+    if args.words[:2] == ["trace", "show"]:
+        return trace_show_command(args.words[2:], args.asok or [])
+    if args.words[:2] == ["trace", "profile"]:
+        return trace_profile_command(args.words[2:], args.asok or [],
+                                     args.pool)
     # telemetry surfaces: served by the mgr's admin socket, no mon
     # connection needed
     if args.words == ["df"]:

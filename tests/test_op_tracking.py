@@ -12,8 +12,8 @@ import time
 import pytest
 
 from ceph_tpu.common.config import Config
+from ceph_tpu.common.tracer import NULL_SPAN, SpanCollector
 from ceph_tpu.osd.op_request import OpTracker
-from ceph_tpu.utils.trace import NULL_TRACE, Tracer
 
 
 class TestOpTracker:
@@ -80,10 +80,15 @@ class TestOpTracker:
 
 
 class TestTracer:
+    """SpanCollector semantics (the one tracer since the legacy
+    `trace_enable`-gated utils.trace shim was retired): NULL_SPAN fast
+    path, parent/child linkage, `osd_tracing` hot-toggling, bounded
+    ring."""
+
     def test_disabled_is_null_and_free(self):
-        tracer = Tracer()
+        tracer = SpanCollector()
         span = tracer.start_trace("op")
-        assert span is NULL_TRACE
+        assert span is NULL_SPAN
         assert not span.valid()
         with span.child("sub") as sub:
             sub.keyval("k", 1)
@@ -91,7 +96,7 @@ class TestTracer:
         assert tracer.dump() == []
 
     def test_enabled_records_parent_child(self):
-        tracer = Tracer()
+        tracer = SpanCollector()
         tracer.enabled = True
         root = tracer.start_trace("osd_op", "osd.0")
         root.keyval("tid", 7)
@@ -108,20 +113,22 @@ class TestTracer:
 
     def test_config_gating_hot_toggle(self):
         conf = Config()
-        tracer = Tracer(conf=conf)
-        assert tracer.start_trace("x") is NULL_TRACE
-        conf.set_val("trace_enable", True)
+        conf.set_val("osd_tracing", False)
+        conf.apply_changes()
+        tracer = SpanCollector(conf=conf)
+        assert tracer.start_trace("x") is NULL_SPAN
+        conf.set_val("osd_tracing", True)
         conf.apply_changes()
         assert tracer.enabled
         span = tracer.start_trace("y")
-        assert span is not NULL_TRACE
+        assert span is not NULL_SPAN
         span.finish()
-        conf.set_val("trace_enable", False)
+        conf.set_val("osd_tracing", False)
         conf.apply_changes()
-        assert tracer.start_trace("z") is NULL_TRACE
+        assert tracer.start_trace("z") is NULL_SPAN
 
     def test_ring_capacity(self):
-        tracer = Tracer(capacity=3)
+        tracer = SpanCollector(capacity=3)
         tracer.enabled = True
         for i in range(6):
             tracer.start_trace("s%d" % i).finish()
@@ -137,7 +144,7 @@ class TestOsdIntegration:
         FAST = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
                 "mon_osd_down_out_interval": 1.0,
                 "paxos_propose_interval": 0.02,
-                "trace_enable": True}
+                "osd_tracing": True}
         cluster = MiniCluster(num_mons=1, num_osds=3,
                               conf_overrides=FAST).start()
         try:
@@ -251,7 +258,7 @@ class TestFlightRecorder:
                 "osd_heartbeat_grace": 0.6,
                 "mon_osd_down_out_interval": 1.0,
                 "paxos_propose_interval": 0.02,
-                "trace_enable": True}
+                "osd_tracing": True}
         cluster = MiniCluster(num_mons=1, num_osds=3,
                               conf_overrides=FAST).start()
         try:

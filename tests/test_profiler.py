@@ -301,5 +301,36 @@ class TestPerfSchemaDrift:
                                "l_osd_pq_evictions"):
                     assert pq_ctr in osd_group, pq_ctr
                     assert pq_ctr in schema["osd"], pq_ctr
+                # the tail-sampler lanes ride the same builder
+                for tail_ctr in ("l_osd_trace_tail_kept_slo",
+                                 "l_osd_trace_tail_kept_error",
+                                 "l_osd_trace_tail_kept_reservoir",
+                                 "l_osd_trace_tail_dropped",
+                                 "l_osd_trace_tail_shipped_spans",
+                                 "l_osd_trace_tail_expired"):
+                    assert tail_ctr in osd_group, tail_ctr
+                    assert tail_ctr in schema["osd"], tail_ctr
         finally:
             cluster.stop()
+
+    def test_mgr_trace_counters_in_schema(self):
+        """The mgr's trace-store lanes (l_mgr_trace_*) must live in
+        the daemon's own 'mgr' PerfCounters group — a second group
+        with the same name would silently REPLACE it in the
+        collection — and carry schema-valid kinds."""
+        from ceph_tpu.common import Context
+        from ceph_tpu.mgr import MgrDaemon
+        mgr = MgrDaemon({}, ctx=Context(name="mgr.drift"))
+        try:
+            dump = mgr.ctx.perf.perf_dump()
+            schema = mgr.ctx.perf.perf_schema()
+            group = dump.get("mgr", {})
+            for ctr in ("l_mgr_trace_fragments", "l_mgr_trace_spans",
+                        "l_mgr_trace_bytes", "l_mgr_trace_stored",
+                        "l_mgr_trace_evicted"):
+                assert ctr in group, ctr
+                assert ctr in schema["mgr"], ctr
+                assert schema["mgr"][ctr]["type"] in \
+                    self.VALID_KINDS, ctr
+        finally:
+            mgr.shutdown()
